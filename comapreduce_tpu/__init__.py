@@ -1,0 +1,22 @@
+"""comapreduce_tpu: a TPU-native (JAX/XLA/Pallas) COMAP data-reduction framework.
+
+A ground-up re-design of the capabilities of SharperJBCA/COMAPreduce
+(``comancpipeline``): Level-1 -> Level-2 time-ordered-data (TOD) reduction
+(vane system-temperature calibration, atmosphere removal, bandpass
+normalisation, 1/f gain-fluctuation subtraction, frequency averaging, noise
+statistics), calibrator source fitting and flux calibration, and a
+conjugate-gradient destriping map-maker — expressed as batched JAX programs:
+
+- feeds/bands/channels live on a dense device array ``f32[F, B, C, T]``;
+- the pointing-matrix apply is a ``segment_sum``;
+- per-feed Python loops become ``vmap``/``shard_map`` over a device mesh;
+- MPI collectives become ``psum`` over ICI.
+
+The reference implementation is NumPy + mpi4py + Cython/C++/Fortran; see
+SURVEY.md at the repo root for the structural analysis this package is built
+to.
+"""
+
+__version__ = "0.1.0"
+
+from comapreduce_tpu import ops  # noqa: F401

@@ -1,0 +1,21 @@
+"""Device-side TOD kernels (JAX/XLA/Pallas).
+
+Each module here is the TPU-native re-design of one hot-path component of the
+reference pipeline (see SURVEY.md §2.2/§2.4). The ops are pure functions over
+dense arrays + validity masks — no data-dependent Python control flow — so
+everything composes under ``jax.jit``/``vmap``/``shard_map``.
+"""
+
+from comapreduce_tpu.ops import stats  # noqa: F401
+from comapreduce_tpu.ops.stats import (  # noqa: F401
+    auto_rms,
+    mad,
+    masked_mean,
+    masked_median,
+    masked_std,
+    nan_to_mask,
+    normalise,
+    tsys_rms,
+    weighted_mean,
+    weighted_var,
+)
