@@ -1,0 +1,16 @@
+"""Host-side data model and I/O.
+
+Mirrors the capability of the reference's ``Analysis/DataHandling.py``:
+an in-memory dict view of a COMAP HDF5 file (Level-1 raw TOD, Level-2
+reduced products) with lazy handling of the large raw TOD dataset, plus
+the device-side ``TODBlock`` pytree that the JAX kernels consume.
+"""
+
+from comapreduce_tpu.data.hdf5io import HDF5Store  # noqa: F401
+from comapreduce_tpu.data.level import COMAPLevel1, COMAPLevel2  # noqa: F401
+from comapreduce_tpu.data.blocks import TODBlock, Level2Block  # noqa: F401
+from comapreduce_tpu.data import scan_edges  # noqa: F401
+from comapreduce_tpu.data.synthetic import (  # noqa: F401
+    SyntheticObsParams,
+    generate_level1_file,
+)

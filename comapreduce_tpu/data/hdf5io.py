@@ -1,0 +1,186 @@
+"""Dict-backed HDF5 store with lazy large datasets.
+
+Capability parity with the reference ``Analysis/DataHandling.py:40-179``
+(``HDF5Data``): read a whole HDF5 file into a ``{path: array}`` mapping,
+keeping designated large datasets (the raw TOD) as lazy h5py handles; write
+appends/overwrites datasets and attributes into an existing file, which is
+what makes the Level-2 file double as the pipeline checkpoint.
+
+Differences by design (not omissions):
+
+- reading collects datasets *and* attributes in one traversal, but attributes
+  of groups that hold no dataset are kept too (the reference loses per-file
+  root attrs unless visited);
+- ``write`` never deletes unrelated paths, so concurrent stages appending
+  disjoint groups compose;
+- no global mutable singleton; stores are cheap value objects.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+import h5py
+import numpy as np
+
+__all__ = ["HDF5Store"]
+
+
+@dataclass
+class HDF5Store:
+    """In-memory mirror of an HDF5 file: ``{path: ndarray | h5py.Dataset}``.
+
+    ``lazy_paths`` entries stay as h5py dataset handles on read (sliceable,
+    never fully materialised); everything else is read eagerly.
+    """
+
+    name: str = "HDF5Store"
+    lazy_paths: tuple = ()
+    _data: dict = field(default_factory=dict)
+    _attrs: dict = field(default_factory=dict)
+    _file: h5py.File | None = field(default=None, repr=False)
+
+    # -- mapping protocol ---------------------------------------------------
+    def __getitem__(self, path: str):
+        return self._data[path]
+
+    def __setitem__(self, path: str, value) -> None:
+        self._data[path] = value
+
+    def __contains__(self, path: str) -> bool:
+        return path in self._data
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._data)
+
+    def keys(self):
+        return self._data.keys()
+
+    def items(self):
+        return self._data.items()
+
+    def get(self, path: str, default=None):
+        return self._data.get(path, default)
+
+    # -- attributes ---------------------------------------------------------
+    def attrs(self, path: str, key: str | None = None):
+        """Attributes dict of ``path``, or a single attribute if ``key``."""
+        if key is None:
+            return self._attrs.get(path, {})
+        return self._attrs[path][key]
+
+    def set_attrs(self, path: str, key: str, value) -> None:
+        self._attrs.setdefault(path, {})[key] = value
+
+    def attr_items(self):
+        return self._attrs.items()
+
+    @property
+    def groups(self) -> list[str]:
+        """Unique top-level group names present in the store."""
+        return sorted({p.split("/")[0] for p in self._data})
+
+    def contains_groups(self, groups: Iterable[str]) -> bool:
+        """True if every top-level group in ``groups`` is present.
+
+        This is the resume test the runner uses to skip completed stages
+        (reference ``DataHandling.py:432-437`` ``COMAPLevel2.contains``).
+        """
+        have = set(self.groups)
+        return all(g.split("/")[0] in have for g in groups)
+
+    # -- file I/O -----------------------------------------------------------
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            finally:
+                self._file = None
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        try:
+            self.close()
+        except Exception:
+            # h5py module state may already be torn down at interpreter exit.
+            pass
+
+    def read(self, filename: str) -> "HDF5Store":
+        """Read every dataset and attribute in ``filename`` into the store.
+
+        Resets any previously-read content — a store mirrors exactly one file.
+        """
+        self.close()
+        self._data = {}
+        self._attrs = {}
+        f = h5py.File(filename, "r")
+        self._file = f
+        # root attributes
+        for k, v in f.attrs.items():
+            self.set_attrs("", k, v)
+
+        keep_open = False
+
+        def visit(name: str, node) -> None:
+            nonlocal keep_open
+            for k, v in node.attrs.items():
+                self.set_attrs(name, k, v)
+            if isinstance(node, h5py.Dataset):
+                if name in self.lazy_paths:
+                    self._data[name] = node  # lazy handle; file stays open
+                    keep_open = True
+                else:
+                    self._data[name] = node[...]
+
+        f.visititems(visit)
+        if not keep_open:
+            # Don't hold a read lock when nothing stayed lazy — another store
+            # must be able to append to this file (stage checkpointing).
+            f.close()
+            self._file = None
+        return self
+
+    def write(self, filename: str) -> None:
+        """Append/overwrite the store's datasets + attrs into ``filename``.
+
+        Lazy (still-on-disk) datasets are skipped — they belong to the source
+        file. An existing output file is opened in append mode so repeated
+        stage checkpoints accumulate (reference ``DataHandling.py:110-139``).
+        """
+        # If we hold an open read handle on this same path, release it first.
+        if self._file is not None and os.path.abspath(
+            getattr(self._file, "filename", "")
+        ) == os.path.abspath(filename):
+            self.close()
+
+        mode = "a" if os.path.exists(filename) else "w"
+        with h5py.File(filename, mode) as out:
+            for path, value in self._data.items():
+                if isinstance(value, h5py.Dataset):
+                    continue
+                if path in out:
+                    del out[path]
+                arr = np.asarray(value)
+                out.create_dataset(path, data=arr)
+            for path, kv in self._attrs.items():
+                if path == "":
+                    target = out
+                elif path in out:
+                    target = out[path]
+                elif isinstance(self._data.get(path), h5py.Dataset):
+                    # attrs of a still-lazy source dataset: creating a group
+                    # at a dataset path would corrupt the schema — skip.
+                    continue
+                else:
+                    target = out.require_group(path)
+                for k, v in kv.items():
+                    target.attrs[k] = v
+
+    def materialise(self, path: str) -> np.ndarray:
+        """Force a lazy dataset into memory and return it."""
+        v = self._data[path]
+        if isinstance(v, h5py.Dataset):
+            v = v[...]
+            self._data[path] = v
+        return v

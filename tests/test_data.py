@@ -1,0 +1,112 @@
+"""Data layer: HDF5 round trip, Level-1 view semantics, synthetic truth."""
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.data import (
+    COMAPLevel1,
+    COMAPLevel2,
+    HDF5Store,
+    SyntheticObsParams,
+    TODBlock,
+    generate_level1_file,
+)
+from comapreduce_tpu.data import scan_edges as se
+
+
+def test_hdf5_store_roundtrip(tmp_path):
+    s = HDF5Store()
+    s["a/b"] = np.arange(10.0)
+    s["c"] = np.ones((2, 3), dtype=np.float32)
+    s.set_attrs("a", "meaning", 42)
+    s.set_attrs("", "rootattr", "hello")
+    fn = str(tmp_path / "t.hd5")
+    s.write(fn)
+    r = HDF5Store().read(fn)
+    np.testing.assert_array_equal(r["a/b"], np.arange(10.0))
+    assert r.attrs("a", "meaning") == 42
+    assert r.attrs("", "rootattr") == "hello"
+    # append mode: second write adds a path without clobbering others
+    s2 = HDF5Store()
+    s2["d/e"] = np.zeros(3)
+    s2.write(fn)
+    r2 = HDF5Store().read(fn)
+    assert "a/b" in r2 and "d/e" in r2
+
+
+def test_scan_edges_basics():
+    status = np.array([0, 0, 1, 1, 1, 0, 1, 1, 0])
+    edges = se.edges_from_status(status)
+    np.testing.assert_array_equal(edges, [[2, 5], [6, 8]])
+    ids = se.segment_ids_from_edges(edges, 9)
+    np.testing.assert_array_equal(ids, [-1, -1, 0, 0, 0, -1, 1, 1, -1])
+
+
+def test_previous_interp():
+    x = np.array([0.0, 1.0, 2.0])
+    y = np.array([5.0, 6.0, 7.0])
+    got = se.previous_interp(np.array([-0.5, 0.0, 0.5, 1.9, 2.5]), x, y)
+    np.testing.assert_array_equal(got, [5.0, 5.0, 5.0, 6.0, 7.0])
+
+
+@pytest.fixture(scope="module")
+def synth(tmp_path_factory):
+    fn = str(tmp_path_factory.mktemp("l1") / "synthetic.hd5")
+    params = generate_level1_file(fn, SyntheticObsParams())
+    return fn, params
+
+
+def test_synthetic_level1_view(synth):
+    fn, p = synth
+    l1 = COMAPLevel1()
+    l1.read(fn)
+    assert l1.obsid == p.obsid
+    assert l1.source_name == "co2"
+    assert not l1.is_calibrator
+    assert l1.tod_shape == (p.n_feeds, p.n_bands, p.n_channels, p.n_samples)
+    # vane temperature model must invert the sensor encoding
+    assert abs(l1.vane_temperature - p.t_vane) < 0.5
+    # vane flag matches truth
+    np.testing.assert_array_equal(l1.vane_flag, p.truth["vane_flag"])
+    # scan edges: same count, close boundaries (hk runs at ~10 Hz so edges
+    # can shift by up to one hk step ~ 5 samples)
+    edges = l1.scan_edges
+    truth_edges = p.truth["scan_edges"]
+    assert edges.shape == truth_edges.shape
+    assert np.abs(edges - truth_edges).max() <= 10
+    l1.close()
+
+
+def test_todblock_from_level1(synth):
+    fn, p = synth
+    l1 = COMAPLevel1()
+    l1.read(fn)
+    blk = TODBlock.from_level1(l1)
+    assert blk.tod.shape == (p.n_feeds, p.n_bands, p.n_channels, p.n_samples)
+    assert blk.mask.shape == blk.tod.shape
+    # masked-in samples only inside scans
+    ids = np.asarray(blk.scan_ids)
+    m = np.asarray(blk.mask[0, 0, 0])
+    assert np.all(m[ids < 0] == 0)
+    assert np.all(m[ids >= 0] == 1)
+    assert blk.n_scans == p.n_scans
+    l1.close()
+
+
+def test_level2_resume_contract(tmp_path):
+    fn = str(tmp_path / "l2.hd5")
+
+    class FakeStage:
+        groups = ["vane/system_temperature"]
+        save_data = ({"vane/system_temperature": np.ones((1, 2, 4, 8))},
+                     {"vane": {"version": 1}})
+
+    l2 = COMAPLevel2(filename=fn)
+    assert not l2.contains(FakeStage)
+    l2.update(FakeStage)
+    assert l2.contains(FakeStage)
+    l2.write(fn)
+    # new instance re-reads the checkpoint and still contains the stage
+    l2b = COMAPLevel2(filename=fn)
+    assert l2b.contains(FakeStage)
+    assert l2b.attrs("vane", "version") == 1
