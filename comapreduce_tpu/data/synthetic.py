@@ -84,6 +84,8 @@ class SyntheticObsParams:
     t_vane: float = 290.0         # hot-load physical temperature, K
     gain_mean: float = 2.0e7      # counts per K
     gain_spread: float = 0.2      # fractional per-channel gain scatter
+    passband_curvature: float = 0.3  # fractional Trx rise at band edges
+    t_rx_scatter: float = 0.05    # per-channel receiver temp scatter
     fknee: float = 1.0            # gain-fluctuation knee, Hz
     alpha: float = 1.5
     sigma_g: float = 5.0e-4       # per-sample rms of dg at f >> fknee
@@ -165,9 +167,13 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
     freq = _band_frequencies(B, C)  # GHz
     gain = p.gain_mean * (1.0 + p.gain_spread * rng.normal(size=(F, B, C)))
     gain = np.abs(gain).astype(np.float64)
-    # receiver temperature with a mild passband shape across channels
+    # receiver temperature: band-edge rise + per-channel scatter (the real
+    # instrument's Tsys varies strongly across a band, which is what makes
+    # the gain templates 1/Tsys distinguishable from the constant mode)
     chan = np.linspace(-1, 1, C)
-    t_rx = p.t_rx * (1.0 + 0.1 * chan[None, None, :] ** 2) * np.ones((F, B, 1))
+    t_rx = p.t_rx * (1.0 + p.passband_curvature * chan[None, None, :] ** 2
+                     + p.t_rx_scatter * rng.normal(size=(F, B, C)))
+    t_rx = np.maximum(t_rx, 0.2 * p.t_rx)
 
     # -- time streams -------------------------------------------------------
     dg = one_over_f_noise(rng, T, p.sigma_g, p.fknee, p.alpha, fs, size=(F,))

@@ -6,7 +6,16 @@ dense arrays + validity masks — no data-dependent Python control flow — so
 everything composes under ``jax.jit``/``vmap``/``shard_map``.
 """
 
-from comapreduce_tpu.ops import stats  # noqa: F401
+from comapreduce_tpu.ops import (  # noqa: F401
+    atmosphere,
+    average,
+    gain,
+    median_filter,
+    power,
+    reduce,
+    stats,
+    vane,
+)
 from comapreduce_tpu.ops.stats import (  # noqa: F401
     auto_rms,
     mad,

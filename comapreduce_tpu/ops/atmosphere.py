@@ -1,0 +1,120 @@
+"""Atmosphere removal: per-channel regression against airmass.
+
+The reference fits ``tod(c, t) ~ offset(c) + atmos(c) * A(t)`` per (scan,
+feed, band, channel) by assembling a sparse block-diagonal system and calling
+``scipy.sparse.linalg.spsolve`` (``Analysis/Level1Averaging.py:197-227``).
+That system is exactly C independent 2x2 normal-equation solves, so the
+TPU-native form is: accumulate the five moments (1, A, A^2, d, A*d) per scan
+with one ``segment_sum`` over the time axis and solve the 2x2 closed form —
+no sparse algebra, no Python scan loop, vmappable over (F, B, C).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fit_airmass_block", "fit_atmosphere_segments",
+           "subtract_atmosphere"]
+
+
+def fit_airmass_block(tod: jax.Array, airmass: jax.Array, mask: jax.Array):
+    """Masked per-channel linear fit ``tod ~ offset + slope * airmass`` over
+    one contiguous block (the per-scan form used inside the reduction).
+
+    ``tod``/``mask``: f32[..., C, L]; ``airmass``: f32[L]. Returns
+    ``(offset, slope)`` each f32[..., C]. Centered moments — the raw normal
+    equations cancel catastrophically in f32 at raw-count scales.
+    Degenerate blocks (under 2 valid samples or no airmass variance) return
+    slope 0 and offset = masked mean.
+    """
+    cnt = jnp.sum(mask, -1)
+    s1 = jnp.maximum(cnt, 1.0)
+    a_mean = jnp.sum(mask * airmass, -1) / s1
+    d_mean = jnp.sum(mask * tod, -1) / s1
+    da = airmass - a_mean[..., None]
+    dd = tod - d_mean[..., None]
+    saa = jnp.sum(mask * da * da, -1)
+    sad = jnp.sum(mask * da * dd, -1)
+    ok = (cnt >= 2.0) & (saa > 1e-12)
+    slope = jnp.where(ok, sad / jnp.maximum(saa, 1e-12), 0.0)
+    offset = d_mean - slope * a_mean
+    return offset, slope
+
+
+def fit_atmosphere_segments(tod: jax.Array, airmass: jax.Array,
+                            scan_ids: jax.Array, mask: jax.Array,
+                            n_scans: int):
+    """Per-scan, per-channel linear fit of TOD against airmass.
+
+    Parameters
+    ----------
+    tod:      f32[..., C, T]
+    airmass:  f32[T] (per-feed airmass is passed per vmapped feed)
+    scan_ids: i32[T], -1 outside scans
+    mask:     f32[..., C, T] validity
+    n_scans:  static number of scans
+
+    Returns ``(offset, atmos)`` each f32[..., C, n_scans]: the per-scan
+    regression coefficients. Degenerate scans (fewer than 2 valid samples or
+    zero airmass variance) return offset = weighted mean, atmos = 0 — same
+    effect as the reference's NaN fits + downstream masking, but mask-clean.
+    Parity: ``AtmosphereRemoval.fit_atmosphere``
+    (``Level1Averaging.py:197-227``).
+    """
+    seg = jnp.where(scan_ids < 0, n_scans, scan_ids)  # junk bucket at n_scans
+
+    def moments(x):
+        # x: f32[..., T] -> f32[..., n_scans]
+        return jax.vmap(
+            lambda row: jax.ops.segment_sum(row, seg, num_segments=n_scans + 1)
+        )(x.reshape((-1, x.shape[-1]))).reshape(x.shape[:-1] + (n_scans + 1,))[
+            ..., :n_scans
+        ]
+
+    m = mask
+    a = airmass  # broadcast over leading axes below
+    cnt = moments(m)
+    s1 = jnp.maximum(cnt, 1.0)
+    a_mean = moments(m * a) / s1
+    d_mean = moments(m * tod) / s1
+
+    # second pass with per-scan centered values (f32-stable: the raw normal
+    # equations cancel catastrophically at count scales)
+    n_sc = a_mean.shape[-1]
+    seg_c = jnp.clip(scan_ids, 0, n_sc - 1)
+    am_t = jnp.take_along_axis(
+        a_mean, jnp.broadcast_to(seg_c, a_mean.shape[:-1] + seg_c.shape), -1)
+    dm_t = jnp.take_along_axis(
+        d_mean, jnp.broadcast_to(seg_c, d_mean.shape[:-1] + seg_c.shape), -1)
+    da = a - am_t
+    dd = tod - dm_t
+    saa = moments(m * da * da)
+    sad = moments(m * da * dd)
+    ok = (cnt >= 2.0) & (saa > 1e-12)
+    atmos = jnp.where(ok, sad / jnp.maximum(saa, 1e-12), 0.0)
+    offset = d_mean - atmos * a_mean
+    return offset, atmos
+
+
+def subtract_atmosphere(tod: jax.Array, airmass: jax.Array,
+                        scan_ids: jax.Array, offset: jax.Array,
+                        atmos: jax.Array):
+    """Subtract the fitted per-scan atmosphere model from the TOD.
+
+    ``offset``/``atmos``: f32[..., C, n_scans] from
+    :func:`fit_atmosphere_segments`. Samples outside any scan are left
+    unchanged (their mask is 0 anyway). Parity:
+    ``AtmosphereRemoval.subtract_fitted_atmosphere``
+    (``Level1Averaging.py:188-195``).
+    """
+    n_scans = offset.shape[-1]
+    seg = jnp.clip(scan_ids, 0, n_scans - 1)
+    off_t = jnp.take_along_axis(
+        offset, jnp.broadcast_to(seg, offset.shape[:-1] + seg.shape[-1:]),
+        axis=-1)
+    atm_t = jnp.take_along_axis(
+        atmos, jnp.broadcast_to(seg, atmos.shape[:-1] + seg.shape[-1:]),
+        axis=-1)
+    model = off_t + atm_t * airmass
+    return jnp.where(scan_ids >= 0, tod - model, tod)

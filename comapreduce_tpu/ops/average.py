@@ -1,0 +1,98 @@
+"""Normalisation and frequency averaging kernels.
+
+Parity targets: ``Level1AveragingGainCorrection.normalise_data``
+(``Analysis/Level1Averaging.py:667-679``), ``weighted_average_over_band``
+(:592-599), and the generic frequency binner ``Level1Averaging.average_tod``
+(:292-321). All are masked reductions over the channel axis — pure VPU work
+that XLA fuses with neighbours.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from comapreduce_tpu.ops.stats import masked_std
+
+__all__ = ["normalise_by_rms", "weighted_band_average", "frequency_bin",
+           "edge_channel_mask"]
+
+_EPS = 1e-30
+
+
+def normalise_by_rms(tod: jax.Array, mask: jax.Array | None = None,
+                     bandwidth: float = 2e9 / 1024.0, tau: float = 1.0 / 50.0):
+    """Divide each channel by its stride-4 difference rms x sqrt(dnu*tau).
+
+    The reference differences samples (0,4,8,...) - (2,6,10,...) — pairs two
+    samples apart on a stride-4 grid — to estimate the white level immune to
+    slow drifts, then scales by sqrt(bandwidth x integration time) so the
+    normalised TOD is in units of the radiometer noise
+    (``Level1Averaging.py:667-679``). Returns ``(tod_norm, rms)`` with
+    ``rms``: f32[..., 1] broadcastable back.
+    """
+    n4 = tod.shape[-1] // 4 * 4
+    a = tod[..., 0:n4:4]
+    b = tod[..., 2:n4:4]
+    pm = None
+    if mask is not None:
+        pm = mask[..., 0:n4:4] * mask[..., 2:n4:4]
+    diff = a - b
+    rms = masked_std(diff, pm, axis=-1) / jnp.sqrt(2.0)
+    rms = rms * jnp.sqrt(bandwidth * tau)
+    rms = rms[..., None]
+    safe = jnp.maximum(rms, _EPS)
+    out = jnp.where(rms > 0, tod / safe, 0.0)
+    return out, rms
+
+
+def edge_channel_mask(n_channels: int, edge: int = 10, centre_below: int = 0,
+                      centre_above: int = 0, dtype=jnp.float32) -> jax.Array:
+    """1 everywhere except ``edge`` channels at each end and
+    ``[c-centre_below, c+centre_above)`` around the band centre ``c = C//2``
+    — the reference's recurring channel cuts (``Level1Averaging.py:843-845``
+    uses edge=10 + centre [510:515]; the gain templates use edge=20 +
+    centre 512±5; the band average uses edge=50 + centre {512})."""
+    m = jnp.ones((n_channels,), dtype=dtype)
+    if edge > 0:
+        m = m.at[:edge].set(0.0)
+        m = m.at[-edge:].set(0.0)
+    if centre_below or centre_above:
+        c = n_channels // 2
+        m = m.at[max(c - centre_below, 0):min(c + centre_above, n_channels)
+                 ].set(0.0)
+    return m
+
+
+def weighted_band_average(tod: jax.Array, weights: jax.Array):
+    """Collapse channels: ``sum_c w(c) x(c,t) / sum_c w(c)``.
+
+    ``tod``: f32[..., C, T]; ``weights``: f32[..., C] (zero = excluded).
+    Parity: ``weighted_average_over_band`` (``Level1Averaging.py:592-599``)
+    minus its in-place weight mutations, which the caller expresses through
+    the weight mask instead.
+    """
+    num = jnp.einsum("...ct,...c->...t", tod, weights)
+    den = jnp.sum(weights, axis=-1)[..., None]
+    return num / jnp.maximum(den, _EPS)
+
+
+def frequency_bin(tod: jax.Array, weights: jax.Array, bin_size: int):
+    """Weighted binning of C channels into C//bin_size coarse channels.
+
+    ``tod``: f32[..., C, T]; ``weights``: f32[..., C]. Returns
+    ``(binned, stddev)`` each f32[..., C//bin_size, T]. Parity:
+    ``Level1Averaging.average_tod`` (``Level1Averaging.py:292-321``), which
+    also records the in-bin standard deviation.
+    """
+    c = tod.shape[-2]
+    nb = c // bin_size
+    shape = tod.shape[:-2] + (nb, bin_size, tod.shape[-1])
+    x = tod[..., : nb * bin_size, :].reshape(shape)
+    w = weights[..., : nb * bin_size].reshape(
+        weights.shape[:-1] + (nb, bin_size))[..., None]
+    den = jnp.maximum(jnp.sum(w, axis=-2), _EPS)
+    avg = jnp.sum(x * w, axis=-2) / den
+    sqr = jnp.sum(x * x * w, axis=-2) / den
+    std = jnp.sqrt(jnp.maximum(sqr - avg * avg, 0.0))
+    return avg, std

@@ -1,0 +1,139 @@
+"""Gain-fluctuation solver: the Level-1 -> Level-2 hot kernel.
+
+Model (reference ``Analysis/GainSubtraction.py``): the normalised TOD
+``y(c, t)`` over the stacked band-channel axis ``c in [0, BC)`` contains a
+common-mode relative gain fluctuation ``dg(t)`` plus sky/atmosphere drifts
+that project onto per-channel templates. With
+
+  T = [1/Tsys(c), nu_scaled(c)/Tsys(c)]   (the "signal" templates, BC x 2)
+  p = 1(c) (masked)                       (the gain template, BC)
+
+the estimator solves the normal equations ``(P^T Z P) g = P^T Z y`` where
+``Z = I - T (T^T T)^{-1} T^T`` projects the signal templates out of each
+time step and ``P`` stretches ``g(t)`` across channels by ``p``
+(``GainSubtraction.py:27-78,129-168``).
+
+TPU-native formulation: every operator application is a (BC x k) matmul
+batched over time — pure MXU work. ``Z P g`` collapses algebraically:
+
+  A g = (p^T Z p) * g     —  because Z is a fixed projector and P acts
+                             per-time-step, A is DIAGONAL with the scalar
+                             ``zpp = p^T Z p`` on valid samples.
+
+The reference solves this diagonal system with scipy CG without exploiting
+the structure; we compute the closed form directly (one pass, no iterations)
+and keep a CG fallback (`solve_gain_cg`) for the optional circulant 1/f
+prior, where A = diag + C^{-1} is genuinely non-diagonal
+(``GainSubtraction.py:97-113``). With the prior, the matvec is an FFT scale
+— also ideal TPU work.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["build_templates", "gain_projector", "solve_gain",
+           "solve_gain_cg", "subtract_gain"]
+
+
+def build_templates(system_temperature: jax.Array, frequency_scaled: jax.Array,
+                    channel_mask: jax.Array):
+    """Templates (T, p) from per-channel Tsys.
+
+    ``system_temperature``: f32[B, C]; ``frequency_scaled``: f32[B, C]
+    ((nu - nu0)/nu0); ``channel_mask``: f32[B, C] with edge/centre channels
+    zeroed (``GainSubtraction.py:185-201``). Returns ``(T2, p)`` with
+    ``T2``: f32[BC, 2] and ``p``: f32[BC].
+    """
+    tsys = system_temperature
+    ok = (tsys > 0) & (channel_mask > 0) & jnp.isfinite(tsys)
+    inv_t = jnp.where(ok, 1.0 / jnp.where(ok, tsys, 1.0), 0.0)
+    t0 = inv_t
+    t1 = frequency_scaled * inv_t
+    p = ok.astype(tsys.dtype)
+    T2 = jnp.stack([t0.reshape(-1), t1.reshape(-1)], axis=-1)
+    return T2, p.reshape(-1)
+
+
+def gain_projector(T2: jax.Array, p: jax.Array):
+    """Precompute Z-projection pieces: returns ``(G_inv, zp, zpp)`` where
+    ``G_inv = (T^T T)^{-1}`` (2x2), ``zp = Z p`` (BC), ``zpp = p^T Z p``."""
+    G = T2.T @ T2  # (2, 2)
+    # guard singular Gram (all-masked): fall back to identity
+    det = G[0, 0] * G[1, 1] - G[0, 1] * G[1, 0]
+    ok = jnp.abs(det) > 1e-30
+    G = jnp.where(ok, G, jnp.eye(2, dtype=T2.dtype))
+    G_inv = jnp.linalg.inv(G)
+    zp = p - T2 @ (G_inv @ (T2.T @ p))
+    zpp = p @ zp
+    return G_inv, zp, zpp
+
+
+def solve_gain(y: jax.Array, T2: jax.Array, p: jax.Array,
+               time_mask: jax.Array | None = None):
+    """Closed-form solve of ``(P^T Z P) g = P^T Z y``.
+
+    ``y``: f32[BC, t] normalised TOD (masked channels zeroed);
+    returns ``dg``: f32[t]. Exact solution of the reference's CG system
+    (diagonal A), at one matmul's cost.
+    """
+    G_inv, zp, zpp = gain_projector(T2, p)
+    b = zp @ y  # (t,) == p^T Z y since Z is symmetric idempotent
+    dg = b / jnp.maximum(zpp, 1e-20)
+    if time_mask is not None:
+        dg = dg * time_mask
+    return dg
+
+
+def _prior_inv_ps(n: int, white_noise, fknee, alpha, sample_rate=50.0):
+    """1/PSD of the 1/f prior on the rfft grid
+    (``GainSubtraction.py:80-95``)."""
+    freqs = jnp.fft.rfftfreq(n, d=1.0 / sample_rate)
+    f1 = freqs.at[0].set(freqs[1])
+    ps = white_noise**2 * jnp.abs(f1 / fknee) ** alpha
+    return 1.0 / jnp.maximum(ps, 1e-30)
+
+
+@functools.partial(jax.jit, static_argnames=("n_iter", "use_prior"))
+def solve_gain_cg(y: jax.Array, T2: jax.Array, p: jax.Array,
+                  white_noise=1.0, fknee=1.0, alpha=-1.0,
+                  time_mask: jax.Array | None = None,
+                  n_iter: int = 50, use_prior: bool = True):
+    """CG solve of ``(P^T Z P + C^{-1}) g = P^T Z y`` with the circulant 1/f
+    prior applied in rfft space (``GainSubtraction.py:97-142``).
+
+    Matvec = diagonal term + irfft(rfft(g)/PSD): O(t log t), XLA-fused.
+    """
+    G_inv, zp, zpp = gain_projector(T2, p)
+    n = y.shape[-1]
+    b = zp @ y
+    if time_mask is not None:
+        b = b * time_mask
+
+    inv_ps = _prior_inv_ps(n, white_noise, fknee, alpha)
+
+    def matvec(g):
+        out = zpp * g
+        if use_prior:
+            out = out + jnp.fft.irfft(jnp.fft.rfft(g) * inv_ps, n=n)
+        if time_mask is not None:
+            out = out * time_mask
+        return out
+
+    dg, _ = jax.scipy.sparse.linalg.cg(matvec, b, maxiter=n_iter)
+    if time_mask is not None:
+        dg = dg * time_mask
+    return dg
+
+
+def subtract_gain(y: jax.Array, dg: jax.Array, p: jax.Array):
+    """Remove the common-mode gain: ``y - p(c) dg(t)``.
+
+    The reference subtracts ``dg`` from every channel unweighted
+    (``Level1Averaging.py:850``); using the masked gain template ``p`` keeps
+    excluded channels untouched (they are zeroed anyway).
+    """
+    return y - p[:, None] * dg[None, :]

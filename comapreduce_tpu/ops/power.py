@@ -1,0 +1,136 @@
+"""Noise power-spectrum estimation and model fits.
+
+Parity targets: ``Analysis/PowerSpectra.py`` (log-binned PSD :20-48, noise
+models :50-72, log-chi^2 minimisation :137-159) and the per-scan PSD fit in
+``Level1Averaging.fit_power_spectrum`` (:552-589). TPU-native: the binning
+is a ``segment_sum`` over precomputed log-bin ids; the 3-parameter fits use
+the jittable damped-Newton solver :func:`minimize_lm`, vmappable over
+(feed, band, scan) so a whole observation's noise fits are one jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["psd", "log_bin_psd", "fit_noise_model", "knee_model",
+           "red_noise_model"]
+
+
+def psd(tod: jax.Array, sample_rate: float = 50.0):
+    """One-sided power spectrum |rfft|^2 and its frequencies.
+
+    Returns ``(freqs[n//2+1], ps[..., n//2+1])``; the DC bin is kept but
+    callers exclude it via the bin mask.
+    """
+    n = tod.shape[-1]
+    ps = jnp.abs(jnp.fft.rfft(tod, axis=-1)) ** 2 / n
+    freqs = jnp.fft.rfftfreq(n, d=1.0 / sample_rate)
+    return freqs, ps
+
+
+@functools.partial(jax.jit, static_argnames=("nbins",))
+def log_bin_psd(freqs: jax.Array, ps: jax.Array, nbins: int = 15):
+    """Average the PSD in log-spaced frequency bins.
+
+    Parity: ``bin_power_spectrum`` (``Level1Averaging.py:534-550``). Empty
+    bins return 0 with ``counts`` 0 (the reference returns NaN and drops
+    them; masks compose better on device). Batched over leading axes of
+    ``ps``.
+    """
+    fmin = freqs[1]
+    fmax = freqs[-1]
+    edges = jnp.logspace(jnp.log10(fmin), jnp.log10(fmax), nbins + 1)
+    # clip into [0, nbins-1]: the top-edge sample (and any float-rounding
+    # overflow) belongs in the last bin, not a discarded overflow bucket
+    ids = jnp.clip(jnp.searchsorted(edges, freqs, side="right") - 1,
+                   0, nbins - 1)
+    # drop DC (freq < fmin lands in bin 0 too; exclude exact DC sample)
+    valid = (freqs >= fmin).astype(ps.dtype)
+
+    # counts and frequency sums are batch-independent: compute once
+    cnt = jax.ops.segment_sum(valid, ids, num_segments=nbins)
+    fsum = jax.ops.segment_sum(freqs * valid, ids, num_segments=nbins)
+
+    def bin_one(row):
+        return jax.ops.segment_sum(row * valid, ids, num_segments=nbins)
+
+    flat = ps.reshape((-1, ps.shape[-1]))
+    tops = jax.vmap(bin_one)(flat)
+    safe = jnp.maximum(cnt, 1.0)
+    p_bin = (tops / safe).reshape(ps.shape[:-1] + (nbins,))
+    nu_bin = fsum / safe
+    return nu_bin, p_bin, cnt
+
+
+def knee_model(params, nu):
+    """``sigma_w^2 (1 + |nu/fknee|^alpha)`` — PowerSpectra.py:50-60."""
+    sig2, fknee, alpha = params
+    return sig2 * (1.0 + jnp.abs(nu / fknee) ** alpha)
+
+
+def red_noise_model(params, nu):
+    """``sigma_w^2 + sigma_r^2 |nu|^alpha`` — PowerSpectra.py:62-72."""
+    sig2, red2, alpha = params
+    return sig2 + red2 * jnp.abs(nu) ** alpha
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def fit_noise_model(nu_bin: jax.Array, p_bin: jax.Array, counts: jax.Array,
+                    p0: jax.Array, model=knee_model):
+    """Fit a 3-parameter noise model to a binned PSD by log-chi^2 BFGS.
+
+    Positivity is enforced by optimising log(sig2), log(fknee/red2) with the
+    spectral index free — the reference uses L-BFGS-B bounds instead
+    (``PowerSpectra.py:137-159``). Returns the fitted params in natural
+    units. vmap over leading axes for batch fits.
+    """
+    good = (counts > 0) & (p_bin > 0) & (nu_bin > 0)
+    logp = jnp.where(good, jnp.log(jnp.maximum(p_bin, 1e-30)), 0.0)
+
+    def loss(q):
+        params = (jnp.exp(q[0]), jnp.exp(q[1]), q[2])
+        m = model(params, jnp.maximum(nu_bin, 1e-6))
+        r = (logp - jnp.log(jnp.maximum(m, 1e-30))) * good
+        return jnp.sum(r * r)
+
+    q0 = jnp.array([jnp.log(jnp.maximum(p0[0], 1e-20)),
+                    jnp.log(jnp.maximum(p0[1], 1e-20)), p0[2]])
+    q = minimize_lm(loss, q0, n_iter=60)
+    return jnp.array([jnp.exp(q[0]), jnp.exp(q[1]), q[2]])
+
+
+def minimize_lm(loss, q0: jax.Array, n_iter: int = 60,
+                lam0: float = 1e-2) -> jax.Array:
+    """Damped-Newton (Levenberg-Marquardt style) minimiser for small
+    parameter vectors, fully jittable/vmappable.
+
+    jax removed ``jax.scipy.optimize`` in 0.9; for 3-parameter noise-model
+    fits an explicit Hessian Newton step with multiplicative damping is
+    simpler and faster than BFGS anyway (the Hessian is 3x3).
+    """
+    grad_fn = jax.grad(loss)
+    hess_fn = jax.hessian(loss)
+    n = q0.shape[0]
+    eye = jnp.eye(n, dtype=q0.dtype)
+
+    def step(_, state):
+        q, lam, f = state
+        g = grad_fn(q)
+        H = hess_fn(q)
+        H = jnp.where(jnp.all(jnp.isfinite(H)), H, eye)
+        delta = jnp.linalg.solve(H + lam * eye, g)
+        q_new = q - delta
+        f_new = loss(q_new)
+        better = jnp.isfinite(f_new) & (f_new < f)
+        q = jnp.where(better, q_new, q)
+        f = jnp.where(better, f_new, f)
+        lam = jnp.where(better, lam * 0.3, lam * 10.0)
+        lam = jnp.clip(lam, 1e-9, 1e9)
+        return q, lam, f
+
+    q, _, _ = jax.lax.fori_loop(
+        0, n_iter, step, (q0, jnp.asarray(lam0, q0.dtype), loss(q0)))
+    return q

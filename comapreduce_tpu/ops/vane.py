@@ -1,0 +1,142 @@
+"""Vane (ambient hot-load) system-temperature calibration.
+
+TPU-native re-design of the reference ``Analysis/VaneCalibration.py:21-198``
+(``MeasureSystemTemperature``). The reference finds hot/cold samples with a
+data-dependent index search per (feed, band) (``find_hot_cold_from_tod``
+:86-141); here the same selection becomes fixed-shape boolean masks so a
+whole vane event is one jitted kernel over ``(F, B, C, t)``:
+
+  hot  = (x - mid) > 15*rms   and |grad x| < 2e-3        (x range-normalised)
+  cold = (x - mid) < 15*rms   and |grad x| < 2e-3  and  t > last hot sample
+
+then per channel ``gain = (<hot> - <cold>) / (T_vane - T_cmb)``,
+``tsys = <cold> / gain`` (``VaneCalibration.py:67-82``).
+
+Vane event windows are found on host (they gate host-side lazy HDF5 reads);
+the per-event kernel is jit + vmap over feeds/bands.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from comapreduce_tpu.ops.stats import auto_rms, masked_mean
+
+__all__ = ["find_vane_events", "hot_cold_masks", "tsys_gain_from_event",
+           "measure_system_temperature"]
+
+VANE_COLD_TEMP = 2.73  # K, reference VaneCalibration.py:33
+GRADIENT_LIMIT = 2e-3  # reference VaneCalibration.py:116
+SIGMA_FACTOR = 15.0    # reference VaneCalibration.py:116
+
+
+def find_vane_events(vane_flag: np.ndarray) -> np.ndarray:
+    """Half-open [start, end) windows of contiguous vane-in-beam samples.
+
+    Host-side (drives lazy TOD slicing). Equivalent of
+    ``find_vane_samples`` (``VaneCalibration.py:56-65``) but robust to events
+    touching the array ends.
+    """
+    flag = np.asarray(vane_flag).astype(np.int8)
+    d = np.diff(np.concatenate(([0], flag, [0])))
+    starts = np.where(d == 1)[0]
+    ends = np.where(d == -1)[0]
+    return np.stack([starts, ends], axis=1).astype(np.int64)
+
+
+def _gradient(x: jax.Array) -> jax.Array:
+    """Central differences matching ``np.gradient`` along the last axis."""
+    left = x[..., 1:2] - x[..., 0:1]
+    right = x[..., -1:] - x[..., -2:-1]
+    mid = (x[..., 2:] - x[..., :-2]) / 2.0
+    return jnp.concatenate([left, mid, right], axis=-1)
+
+
+def hot_cold_masks(band_avg: jax.Array):
+    """Hot/cold sample masks from the band-average TOD of one vane event.
+
+    ``band_avg``: f32[..., t] — batch axes vmap over (feed, band).
+    Returns ``(hot, cold)`` f32 masks of the same shape.
+
+    Mirrors ``find_hot_cold_from_tod`` (``VaneCalibration.py:86-141``): the
+    TOD is normalised by its range; samples well above the midpoint with a
+    flat gradient are hot (vane fully in); samples below the hot threshold
+    with flat gradient *after the last hot sample* are cold (vane fully out,
+    looking at sky).
+    """
+    rms = auto_rms(band_avg)[..., None]
+    rng = (jnp.max(band_avg, axis=-1) - jnp.min(band_avg, axis=-1))[..., None]
+    rng = jnp.maximum(rng, 1e-30)
+    x = band_avg / rng
+    rms_n = rms / rng
+    mid = ((jnp.max(x, axis=-1) + jnp.min(x, axis=-1)) / 2.0)[..., None]
+    flat = jnp.abs(_gradient(x)) < GRADIENT_LIMIT
+    hot = ((x - mid) > SIGMA_FACTOR * rms_n) & flat
+    cold = ((x - mid) < SIGMA_FACTOR * rms_n) & flat
+
+    t = jnp.arange(x.shape[-1])
+    # last hot sample index; -1 when no hot samples at all
+    last_hot = jnp.max(jnp.where(hot, t, -1), axis=-1, keepdims=True)
+    cold = cold & (t > last_hot)
+
+    has_both = (jnp.any(hot, axis=-1) & jnp.any(cold, axis=-1))[..., None]
+    hot = hot & has_both
+    cold = cold & has_both
+    return hot.astype(band_avg.dtype), cold.astype(band_avg.dtype)
+
+
+def tsys_gain_from_event(tod: jax.Array, hot: jax.Array, cold: jax.Array,
+                         vane_temperature: float):
+    """Per-channel Tsys and gain for one vane event.
+
+    ``tod``: f32[..., C, t]; ``hot``/``cold``: f32[..., t] masks broadcast
+    over channels. Returns ``(tsys, gain)`` f32[..., C]. Channels of events
+    with no valid hot/cold samples return 0 (flagged downstream by zero
+    weights). Parity: ``system_temperature_from_tod``
+    (``VaneCalibration.py:67-82``).
+    """
+    hot_b = hot[..., None, :]
+    cold_b = cold[..., None, :]
+    p_hot = masked_mean(tod, jnp.broadcast_to(hot_b, tod.shape), axis=-1)
+    p_cold = masked_mean(tod, jnp.broadcast_to(cold_b, tod.shape), axis=-1)
+    gain = (p_hot - p_cold) / (vane_temperature - VANE_COLD_TEMP)
+    ok = (jnp.sum(hot, axis=-1) > 0) & (jnp.sum(cold, axis=-1) > 0)
+    ok = ok[..., None] & (gain > 0)
+    gain = jnp.where(ok, gain, 0.0)
+    tsys = jnp.where(ok, p_cold / jnp.where(ok, gain, 1.0), 0.0)
+    return tsys, gain
+
+
+@jax.jit
+def _event_kernel(tod_event: jax.Array, vane_temperature: jax.Array):
+    """(F, B, C, t) event window -> per-channel (tsys, gain), each (F, B, C)."""
+    band_avg = jnp.mean(tod_event, axis=2)  # (F, B, t)
+    hot, cold = hot_cold_masks(band_avg)
+    return tsys_gain_from_event(tod_event, hot, cold, vane_temperature)
+
+
+def measure_system_temperature(tod_reader, vane_flag: np.ndarray,
+                               vane_temperature: float,
+                               pad: int = 50):
+    """All vane events of one observation -> ``(tsys, gain)`` of shape
+    ``(n_events, F, B, C)``.
+
+    ``tod_reader(start, end)`` returns the raw TOD slice ``(F, B, C, end-start)``
+    (lazy HDF5 read or in-memory slice). ``pad`` widens each event window so
+    the cold (sky) samples after vane retraction are included — the reference
+    relies on the feature flag staying set past the mechanical motion.
+    """
+    events = find_vane_events(vane_flag)
+    n = len(vane_flag)
+    out_t, out_g = [], []
+    for start, end in events:
+        s, e = max(0, int(start) - pad), min(n, int(end) + pad)
+        tod_event = jnp.asarray(np.asarray(tod_reader(s, e), dtype=np.float32))
+        tsys, gain = _event_kernel(tod_event, jnp.float32(vane_temperature))
+        out_t.append(tsys)
+        out_g.append(gain)
+    if not out_t:
+        return None, None
+    return jnp.stack(out_t), jnp.stack(out_g)

@@ -8,12 +8,19 @@ semantics). This must run before jax is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment sets JAX_PLATFORMS=axon (the tunnelled
+# TPU). Tests must not depend on — or wedge — the shared TPU relay.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# If the axon PJRT plugin is registered (via /root/.axon_site sitecustomize),
+# even CPU compiles are routed to the remote-compile relay; when that relay
+# is unavailable every jit hangs. Tests should therefore run with
+# `env PYTHONPATH= python -m pytest tests/` so the plugin never registers.
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

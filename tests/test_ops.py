@@ -1,0 +1,217 @@
+"""Numerical tests of the TOD kernels against NumPy oracles and truth."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from comapreduce_tpu.ops import atmosphere, average, gain, median_filter, power, vane
+
+
+# ---------------------------------------------------------------- median
+class TestRollingMedian:
+    def test_matches_numpy_oracle_odd_window(self, rng):
+        x = rng.normal(size=(3, 500)).astype(np.float32)
+        w = 31
+        got = np.asarray(median_filter.rolling_median(jnp.asarray(x), w, chunk=64))
+        pad = np.pad(x, [(0, 0), (w // 2, w // 2)], mode="edge")
+        ref = np.stack([
+            np.array([np.median(row[i:i + w]) for i in range(x.shape[1])])
+            for row in pad
+        ])
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_even_window(self, rng):
+        x = rng.normal(size=(200,)).astype(np.float32)
+        w = 10
+        got = np.asarray(median_filter.rolling_median(jnp.asarray(x), w, chunk=64))
+        left = (w - 1) // 2
+        right = w - 1 - left
+        pad = np.pad(x, (left, right), mode="edge")
+        ref = np.array([np.median(pad[i:i + w]) for i in range(x.size)])
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_removes_slow_drift(self, rng):
+        t = np.arange(4000) / 50.0
+        drift = 0.5 * np.sin(2 * np.pi * t / 60.0)  # 60 s period
+        x = (drift + 0.01 * rng.normal(size=t.size)).astype(np.float32)
+        med = np.asarray(median_filter.rolling_median(jnp.asarray(x), 501))
+        # the filter must track the slow drift
+        assert np.std(x - med) < 0.05
+
+
+class TestMedfiltHighpass:
+    def test_regresses_out_common_mode(self, rng):
+        B, C, T = 2, 32, 2000
+        common = np.cumsum(rng.normal(size=T)).astype(np.float32) * 0.01
+        coup = rng.uniform(0.5, 2.0, size=(B, C, 1)).astype(np.float32)
+        x = coup * common[None, None, :] + 0.001 * rng.normal(
+            size=(B, C, T)).astype(np.float32)
+        cm = np.ones((B, C), np.float32)
+        filt, med = median_filter.medfilt_highpass(
+            jnp.asarray(x), jnp.asarray(cm), 301)
+        # the channel-coupled common mode must be mostly gone
+        assert float(jnp.std(filt)) < 0.3 * float(np.std(x))
+
+
+# ---------------------------------------------------------------- vane
+class TestVane:
+    def test_find_vane_events(self):
+        flag = np.zeros(100, bool)
+        flag[5:20] = True
+        flag[80:95] = True
+        ev = vane.find_vane_events(flag)
+        np.testing.assert_array_equal(ev, [[5, 20], [80, 95]])
+
+    def test_recovers_tsys_gain(self, rng):
+        F, B, C, t = 2, 2, 32, 400
+        gain_true = rng.uniform(1e6, 3e6, size=(F, B, C))
+        tsys_true = rng.uniform(35.0, 55.0, size=(F, B, C))
+        t_vane = 290.0
+        hot = np.zeros(t, bool)
+        hot[50:180] = True
+        cold = np.zeros(t, bool)
+        cold[250:390] = True
+        temp = np.where(hot, t_vane - 2.73, 0.0)[None, None, None, :]
+        # P = gain * (Tsys + (Tvane-Tcmb) during hot)
+        tod = gain_true[..., None] * (tsys_true[..., None] + temp)
+        tod = tod * (1 + 3e-4 * rng.normal(size=tod.shape))
+        # ramp between: linear transitions (flagged by gradient cut)
+        tod[..., 180:250] = np.linspace(1, 0, 70)[None, None, None, :] * \
+            tod[..., 179:180] + np.linspace(0, 1, 70)[None, None, None, :] * \
+            tod[..., 250:251]
+        tsys, g = vane._event_kernel(jnp.asarray(tod, dtype=jnp.float32),
+                                     jnp.float32(t_vane))
+        np.testing.assert_allclose(np.asarray(g), gain_true, rtol=0.01)
+        np.testing.assert_allclose(np.asarray(tsys), tsys_true, rtol=0.02)
+
+
+# ---------------------------------------------------------------- atmosphere
+class TestAtmosphere:
+    def test_fit_and_subtract(self, rng):
+        C, T, S = 8, 3000, 3
+        ids = np.repeat(np.arange(S), T // S).astype(np.int32)
+        el = np.radians(40 + 10 * np.sin(np.arange(T) / 300.0))
+        A = (1.0 / np.sin(el)).astype(np.float32)
+        off_true = rng.uniform(10, 20, size=(C, S))
+        atm_true = rng.uniform(5, 9, size=(C, S))
+        tod = (off_true[:, ids] + atm_true[:, ids] * A[None, :]
+               + 0.01 * rng.normal(size=(C, T))).astype(np.float32)
+        mask = np.ones((C, T), np.float32)
+        off, atm = atmosphere.fit_atmosphere_segments(
+            jnp.asarray(tod), jnp.asarray(A), jnp.asarray(ids),
+            jnp.asarray(mask), S)
+        np.testing.assert_allclose(np.asarray(off), off_true, atol=0.05)
+        np.testing.assert_allclose(np.asarray(atm), atm_true, atol=0.05)
+        clean = atmosphere.subtract_atmosphere(
+            jnp.asarray(tod), jnp.asarray(A), jnp.asarray(ids), off, atm)
+        assert float(jnp.std(clean)) < 0.05
+
+    def test_degenerate_scan_returns_mean(self, rng):
+        C, T = 4, 100
+        tod = jnp.asarray(rng.normal(5.0, 0.1, size=(C, T)).astype(np.float32))
+        A = jnp.ones((T,))  # zero airmass variance -> degenerate
+        ids = jnp.zeros((T,), jnp.int32)
+        off, atm = atmosphere.fit_atmosphere_segments(
+            tod, A, ids, jnp.ones((C, T)), 1)
+        np.testing.assert_allclose(np.asarray(atm), 0.0)
+        np.testing.assert_allclose(np.asarray(off)[:, 0],
+                                   np.mean(np.asarray(tod), -1), atol=1e-3)
+
+
+# ---------------------------------------------------------------- gain
+class TestGainSolve:
+    def _make(self, rng, BC=128, T=1500):
+        tsys = rng.uniform(30, 60, size=BC).astype(np.float32)
+        nu = np.linspace(-0.13, 0.13, BC).astype(np.float32)
+        cm = np.ones(BC, np.float32)
+        T2, p = gain.build_templates(
+            jnp.asarray(tsys)[None, :], jnp.asarray(nu)[None, :],
+            jnp.asarray(cm)[None, :])
+        return tsys, nu, T2, p
+
+    def test_recovers_injected_gain(self, rng):
+        BC, T = 128, 1500
+        tsys, nu, T2, p = self._make(rng, BC, T)
+        dg_true = np.cumsum(rng.normal(size=T)).astype(np.float32) * 0.01
+        dg_true -= dg_true.mean()
+        # y = dg(t) * 1(c) + dT(t)/Tsys + noise  (the Z-projected templates)
+        dT = np.cumsum(rng.normal(size=T)).astype(np.float32) * 0.05
+        y = (dg_true[None, :] + dT[None, :] / tsys[:, None]
+             + 0.1 * rng.normal(size=(BC, T))).astype(np.float32)
+        dg = gain.solve_gain(jnp.asarray(y), T2, p)
+        # the estimator is unbiased with noise var sigma^2 / (p^T Z p): the
+        # Z-projection removes most of the constant template's power because
+        # 1/Tsys is nearly parallel to 1(c)
+        _, _, zpp = gain.gain_projector(T2, p)
+        resid = np.asarray(dg) - dg_true
+        assert np.std(resid) < 3 * 0.1 / np.sqrt(float(zpp)) + 0.005
+        # and the recovered gain must track the truth
+        corr = np.corrcoef(np.asarray(dg), dg_true)[0, 1]
+        assert corr > 0.95
+
+    def test_cg_with_prior_matches_closed_form_weak_prior(self, rng):
+        BC, T = 64, 512
+        tsys, nu, T2, p = self._make(rng, BC, T)
+        y = jnp.asarray(rng.normal(size=(BC, T)).astype(np.float32))
+        dg0 = gain.solve_gain(y, T2, p)
+        # a very weak prior (huge white_noise -> tiny 1/PSD) ~ no prior
+        dg1 = gain.solve_gain_cg(y, T2, p, white_noise=1e6, fknee=1.0,
+                                 alpha=-1.0, use_prior=True)
+        np.testing.assert_allclose(np.asarray(dg0), np.asarray(dg1),
+                                   atol=2e-3 * float(jnp.std(dg0)) * 100)
+
+
+# ---------------------------------------------------------------- averaging
+class TestAveraging:
+    def test_normalise_by_rms(self, rng):
+        C, T = 4, 4000
+        sig = rng.uniform(0.5, 2.0, size=(C, 1))
+        x = (sig * rng.normal(size=(C, T))).astype(np.float32)
+        out, rms = average.normalise_by_rms(jnp.asarray(x), bandwidth=1.0,
+                                            tau=1.0)
+        np.testing.assert_allclose(np.asarray(rms)[:, 0], sig[:, 0],
+                                   rtol=0.1)
+        np.testing.assert_allclose(np.std(np.asarray(out), axis=-1), 1.0,
+                                   rtol=0.1)
+
+    def test_weighted_band_average(self, rng):
+        C, T = 16, 100
+        x = rng.normal(size=(C, T)).astype(np.float32)
+        w = rng.uniform(0, 1, size=C).astype(np.float32)
+        got = np.asarray(average.weighted_band_average(
+            jnp.asarray(x), jnp.asarray(w)))
+        ref = (w[:, None] * x).sum(0) / w.sum()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_frequency_bin(self, rng):
+        C, T, bs = 16, 50, 4
+        x = rng.normal(size=(C, T)).astype(np.float32)
+        w = np.ones(C, np.float32)
+        avg, std = average.frequency_bin(jnp.asarray(x), jnp.asarray(w), bs)
+        ref = x.reshape(C // bs, bs, T).mean(1)
+        np.testing.assert_allclose(np.asarray(avg), ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------- power
+class TestPower:
+    def test_white_noise_psd_flat(self, rng):
+        x = rng.normal(0, 2.0, size=(8192,)).astype(np.float32)
+        freqs, ps = power.psd(jnp.asarray(x))
+        nu, pb, cnt = power.log_bin_psd(freqs, ps, nbins=12)
+        pb = np.asarray(pb)[np.asarray(cnt) > 0]
+        # flat at sigma^2 / (fs/2) per unit freq -> here |rfft|^2/n ~ sigma^2
+        assert np.std(np.log(pb)) < 0.5
+
+    def test_fit_recovers_knee(self, rng):
+        from comapreduce_tpu.data.synthetic import one_over_f_noise
+        x = one_over_f_noise(np.random.default_rng(7), 2 ** 15, 1.0, 1.0,
+                             2.0).astype(np.float32)
+        freqs, ps = power.psd(jnp.asarray(x))
+        nu, pb, cnt = power.log_bin_psd(freqs, ps, nbins=20)
+        fit = power.fit_noise_model(nu, pb, cnt,
+                                    jnp.asarray([1.0, 0.5, -1.5]),
+                                    model=power.knee_model)
+        fit = np.asarray(fit)
+        assert 0.3 < fit[1] < 3.0       # fknee ~ 1 Hz
+        assert -3.0 < fit[2] < -1.0     # alpha ~ -2
