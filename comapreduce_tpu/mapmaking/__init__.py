@@ -1,0 +1,30 @@
+"""Map-making: pixelization, binning, and the CG destriper.
+
+TPU-native re-design of the reference's ``MapMaking/`` package
+(``MapMaking/Destriper.py``, ``MapMaking/COMAPData.py``,
+``MapMaking/run_destriper.py``; see SURVEY.md §2.3):
+
+- pixelization (WCS flat-sky projections + HEALPix) is host-side numpy,
+  precomputed once per observation (the reference computes pixels per scan on
+  read, ``COMAPData.py:383-469``);
+- the pointing-matrix apply ``P`` is a gather and ``P^T`` is a
+  ``jax.ops.segment_sum`` — the north-star kernel replacing the Cython
+  scatter-add ``Tools/binFuncs.pyx``;
+- the destriper normal equations are solved by a fully jitted CG whose map
+  reduction is a ``psum`` over the device mesh (replacing the reference's
+  MPI ``Gather+Bcast`` per matvec, ``Destriper.py:183-204``).
+"""
+
+from comapreduce_tpu.mapmaking import (  # noqa: F401
+    binning,
+    destriper,
+    fits_io,
+    healpix,
+    wcs,
+)
+from comapreduce_tpu.mapmaking.binning import bin_map, bin_offset_map  # noqa: F401
+from comapreduce_tpu.mapmaking.destriper import (  # noqa: F401
+    DestriperResult,
+    destripe,
+)
+from comapreduce_tpu.mapmaking.wcs import WCS  # noqa: F401

@@ -1,0 +1,96 @@
+"""TOD <-> map binning: the pointing matrix as gather / segment_sum.
+
+The reference's Cython scatter-add kernels ``Tools/binFuncs.pyx``
+(``binValues`` :7-32, ``binValues2Map`` :35-46) are the innermost map-making
+ops. On TPU they are one primitive each:
+
+- ``P^T w d`` (TOD -> map accumulate) = ``jax.ops.segment_sum``;
+- ``P m`` (map -> TOD sample)         = ``m[pixels]`` gather.
+
+Invalid samples are encoded as pixel id ``npix`` and dropped by
+``mode="drop"``-equivalent masking (the reference masks with a separate
+array, ``binFuncs.pyx:20-23``). All functions are jittable; inside
+``shard_map`` pass ``axis_name`` so shard-local maps are ``psum``-reduced
+(the reference's MPI ``Gather+sum+Bcast``, ``Destriper.py:183-204``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bin_map", "bin_offset_map", "sample_map", "accumulate_weights",
+           "naive_map"]
+
+
+def _psum(x, axis_name):
+    return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+
+def _sanitize(pixels: jax.Array, npix: int) -> jax.Array:
+    """Map every invalid id (negative — e.g. WCS.ang2pix's -1 — or >= npix)
+    to the drop slot ``npix`` so P and P^T agree on validity."""
+    return jnp.where((pixels < 0) | (pixels >= npix), npix, pixels)
+
+
+def accumulate_weights(pixels: jax.Array, weights: jax.Array, npix: int,
+                       axis_name: str | None = None) -> jax.Array:
+    """``sum_w[p] = sum_{t: pix_t=p} w_t`` — the map-domain weight vector."""
+    pixels = _sanitize(pixels, npix)
+    return _psum(jax.ops.segment_sum(
+        weights, pixels, num_segments=npix, indices_are_sorted=False), axis_name)
+
+
+def bin_map(tod: jax.Array, pixels: jax.Array, weights: jax.Array, npix: int,
+            sum_w: jax.Array | None = None,
+            axis_name: str | None = None) -> jax.Array:
+    """Weighted naive map: ``m = (P^T W d) / (P^T W 1)``.
+
+    ``pixels`` is i32[N]; invalid samples (negative or >= npix) drop out of
+    the segment_sum. Returns f32[npix]; unhit pixels are 0 (the reference
+    leaves NaN after dividing by a zero hit count; masks compose better).
+    """
+    pixels = _sanitize(pixels, npix)
+    wsum = jax.ops.segment_sum(tod * weights, pixels, num_segments=npix)
+    wsum = _psum(wsum, axis_name)
+    if sum_w is None:
+        sum_w = accumulate_weights(pixels, weights, npix, axis_name)
+    return jnp.where(sum_w > 0, wsum / jnp.maximum(sum_w, 1e-30), 0.0)
+
+
+def bin_offset_map(offsets: jax.Array, pixels: jax.Array, weights: jax.Array,
+                   npix: int, offset_length: int,
+                   sum_w: jax.Array | None = None,
+                   axis_name: str | None = None) -> jax.Array:
+    """Map of the stretched offset vector (``binValues2Map`` analogue).
+
+    ``offsets``: f32[n_offsets]; sample t belongs to offset ``t // L``
+    (``OffsetTypes.py:11-54``). Equivalent to ``bin_map(repeat(offsets, L))``
+    without materialising the repeat through a reshape-free gather.
+    """
+    n = pixels.shape[0]
+    tod = jnp.repeat(offsets, offset_length, total_repeat_length=n)
+    return bin_map(tod, pixels, weights, npix, sum_w=sum_w,
+                   axis_name=axis_name)
+
+
+def sample_map(m: jax.Array, pixels: jax.Array) -> jax.Array:
+    """``(P m)_t = m[pix_t]`` with invalid pixels reading 0."""
+    npix = m.shape[-1]
+    valid = (pixels >= 0) & (pixels < npix)
+    safe = jnp.clip(pixels, 0, npix - 1)
+    return jnp.where(valid, m[..., safe], 0.0)
+
+
+def naive_map(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
+              npix: int, axis_name: str | None = None,
+              sum_w: jax.Array | None = None):
+    """(signal, weight, hit) maps in one pass — the reference's
+    ``destriper_iteration`` products (``Destriper.py:402-453``)."""
+    if sum_w is None:
+        sum_w = accumulate_weights(pixels, weights, npix, axis_name)
+    m = bin_map(tod, pixels, weights, npix, sum_w=sum_w, axis_name=axis_name)
+    hits = _psum(jax.ops.segment_sum(jnp.ones_like(weights),
+                                     _sanitize(pixels, npix),
+                                     num_segments=npix), axis_name)
+    return m, sum_w, hits

@@ -1,0 +1,192 @@
+"""Maximum-likelihood destriper: jitted conjugate-gradient solve.
+
+TPU-native re-design of ``MapMaking/Destriper.py`` (Sutton et al. 2011
+offset-model destriping). The model: ``d = P m + F a + n`` with ``F``
+stretching one offset over ``L`` consecutive samples. Destriping solves the
+normal equations
+
+    F^T W Z F a = F^T W Z d,      Z = I - P (P^T W P)^{-1} P^T W
+
+by CG (``Destriper.py:85-152``), where every matvec is:
+
+    repeat (F) -> segment_sum to map (P^T W) -> normalize -> gather (P)
+    -> subtract (Z) -> per-offset reduce (F^T W)
+
+All device math. The reference's per-matvec MPI ``Gather+Bcast`` of the map
+(``share_map`` :183-204) and per-iteration ``Allreduce`` scalars (:61-69)
+become ``psum`` over the mesh axis when run under ``shard_map`` with the
+time axis sharded (each shard owns whole offsets; the map and CG scalars
+are the only shared objects — SURVEY.md §2.5).
+
+The optional ground template (per-(obsid, feed) linear-in-azimuth terms,
+``op_Ax_with_ground`` :265-336) adds a small replicated unknown block
+solved jointly in the same CG.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from comapreduce_tpu.mapmaking.binning import (accumulate_weights, bin_map,
+                                               naive_map, sample_map)
+
+__all__ = ["DestriperResult", "destripe", "destripe_jit"]
+
+
+class DestriperResult(NamedTuple):
+    """Everything ``destriper_iteration`` produces (``Destriper.py:402-453``)."""
+
+    offsets: jax.Array        # f32[n_offsets]
+    ground: jax.Array         # f32[n_groups, 2] (zeros if unused)
+    destriped_map: jax.Array  # f32[npix]
+    naive_map: jax.Array      # f32[npix]
+    weight_map: jax.Array     # f32[npix]
+    hit_map: jax.Array        # f32[npix]
+    n_iter: jax.Array         # i32 — CG iterations actually run
+    residual: jax.Array       # f32 — final |r|/|b|
+
+
+def _expand(offsets, ground, ground_ids, az, n_samples, offset_length):
+    """Apply the template operator: ``F a (+ G g)`` -> TOD domain."""
+    d = jnp.repeat(offsets, offset_length, total_repeat_length=n_samples)
+    if ground is not None:
+        d = d + ground[ground_ids, 0] + ground[ground_ids, 1] * az
+    return d
+
+
+def _reduce(wr, ground_ids, az, n_offsets, offset_length, n_groups,
+            with_ground, axis_name):
+    """Apply the adjoint: TOD -> (per-offset sums, per-group az sums)."""
+    a = jnp.sum(wr.reshape(n_offsets, offset_length), axis=1)
+    if not with_ground:
+        return a, None
+    g0 = jax.ops.segment_sum(wr, ground_ids, num_segments=n_groups)
+    g1 = jax.ops.segment_sum(wr * az, ground_ids, num_segments=n_groups)
+    g = jnp.stack([g0, g1], axis=-1)
+    if axis_name is not None:
+        g = jax.lax.psum(g, axis_name)  # ground unknowns are replicated
+    return a, g
+
+
+def _dot(x, y, axis_name):
+    """CG inner product over the (offsets, ground) unknown pytree.
+
+    Offsets are shard-local (psum'd); the ground block is replicated
+    across shards (already globally consistent, no psum).
+    """
+    s = jnp.sum(x[0] * y[0])
+    if axis_name is not None:
+        s = jax.lax.psum(s, axis_name)
+    if x[1] is not None:
+        s = s + jnp.sum(x[1] * y[1])
+    return s
+
+
+def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
+             npix: int, offset_length: int = 50, n_iter: int = 100,
+             threshold: float = 1e-6, axis_name: str | None = None,
+             ground_ids: jax.Array | None = None,
+             az: jax.Array | None = None, n_groups: int = 0
+             ) -> DestriperResult:
+    """Destripe a flat TOD vector.
+
+    Parameters
+    ----------
+    tod, weights: f32[N] with ``N`` a multiple of ``offset_length``
+        (the data layer truncates scans to offset multiples, the reference's
+        ``countDataSize``, ``COMAPData.py:163-187``; zero-weight samples are
+        ignored everywhere).
+    pixels: i32[N]; invalid samples carry ``pixels >= npix``.
+    ground_ids, az: optional i32[N]/f32[N] enabling the joint ground
+        template (az should be pre-normalised to ~[-1, 1]).
+    axis_name: mesh axis name when called inside ``shard_map`` with the
+        time/offset axis sharded.
+    """
+    n = tod.shape[0]
+    n_offsets = n // offset_length
+    with_ground = ground_ids is not None
+    f32 = tod.dtype
+
+    sum_w = accumulate_weights(pixels, weights, npix, axis_name)
+
+    def Zmap(d):
+        """W Z d = W (d - P bin(d)) in the TOD domain."""
+        m = bin_map(d, pixels, weights, npix, sum_w=sum_w,
+                    axis_name=axis_name)
+        return weights * (d - sample_map(m, pixels))
+
+    def matvec(x):
+        offs, grd = x
+        d = _expand(offs, grd, ground_ids, az, n, offset_length)
+        return _reduce(Zmap(d), ground_ids, az, n_offsets, offset_length,
+                       n_groups, with_ground, axis_name)
+
+    b = _reduce(Zmap(tod), ground_ids, az, n_offsets, offset_length,
+                n_groups, with_ground, axis_name)
+    b_norm = _dot(b, b, axis_name)
+
+    x0 = (jnp.zeros(n_offsets, f32),
+          jnp.zeros((n_groups, 2), f32) if with_ground else None)
+
+    def cond(state):
+        _, _, _, rz, k = state
+        return (k < n_iter) & (rz > threshold**2 * jnp.maximum(b_norm, 1e-30))
+
+    def axpy(a, x, y):
+        """x + a*y over the (offsets, ground-or-None) pair."""
+        return (x[0] + a * y[0],
+                None if x[1] is None else x[1] + a * y[1])
+
+    def body(state):
+        x, r, p, rz, k = state
+        q = matvec(p)
+        pq = _dot(p, q, axis_name)
+        # The system is SPD but singular (a global constant offset is in the
+        # null space once Z removes the map mean). In f32, roundoff can
+        # eventually push the search direction out of the range space and
+        # p^T A p to <= 0 — detect the breakdown and stop with the current
+        # iterate rather than dividing into a NaN.
+        ok = jnp.isfinite(pq) & (pq > 0)
+        alpha = jnp.where(ok, rz / jnp.where(ok, pq, 1.0), 0.0)
+        x_new = axpy(alpha, x, p)
+        r_new = axpy(-alpha, r, q)
+        rz_new = _dot(r_new, r_new, axis_name)
+        ok = ok & jnp.isfinite(rz_new)
+        beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
+        p_new = axpy(beta, r_new, p)
+        # on breakdown: freeze the iterate and force the loop to exit
+        keep = lambda new, old: jax.tree.map(  # noqa: E731
+            lambda a_, b_: jnp.where(ok, a_, b_), new, old)
+        x = (keep(x_new[0], x[0]),
+             None if x[1] is None else keep(x_new[1], x[1]))
+        r = (keep(r_new[0], r[0]),
+             None if r[1] is None else keep(r_new[1], r[1]))
+        p = (keep(p_new[0], p[0]),
+             None if p[1] is None else keep(p_new[1], p[1]))
+        rz = jnp.where(ok, rz_new, 0.0)
+        return x, r, p, rz, k + 1
+
+    state0 = (x0, b, b, b_norm, jnp.asarray(0, jnp.int32))
+    x, r, _, rz, k = jax.lax.while_loop(cond, body, state0)
+    offsets, ground = x
+
+    # final products
+    template = _expand(offsets, ground, ground_ids, az, n, offset_length)
+    m_naive, w_map, h_map = naive_map(tod, pixels, weights, npix, axis_name,
+                                      sum_w=sum_w)
+    m_destriped = bin_map(tod - template, pixels, weights, npix,
+                          sum_w=sum_w, axis_name=axis_name)
+    if ground is None:
+        ground = jnp.zeros((0, 2), f32)
+    residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
+    return DestriperResult(offsets, ground, m_destriped, m_naive, w_map,
+                           h_map, k, residual)
+
+
+destripe_jit = jax.jit(
+    destripe,
+    static_argnames=("npix", "offset_length", "n_iter", "threshold",
+                     "axis_name", "n_groups"))

@@ -1,0 +1,211 @@
+"""Minimal FITS image I/O (no astropy dependency).
+
+The reference writes maps as multi-extension FITS via ``astropy.io.fits``
+(``MapMaking/run_destriper.py:19-50``) and HEALPix partial maps via
+``healpy.write_map`` (:53-77). This module implements the subset of FITS
+needed for those products: primary + IMAGE extensions of 2-D float32/float64
+arrays with WCS header cards, and a reader sufficient to round-trip them.
+HEALPix maps are stored as 1-D image extensions with ``PIXTYPE=HEALPIX``
+cards plus an explicit pixel-index extension (partial-sky storage).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["write_fits_image", "read_fits_image", "write_healpix_map",
+           "read_healpix_map"]
+
+BLOCK = 2880
+
+
+def _card(key: str, value, comment: str = "") -> bytes:
+    if isinstance(value, bool):
+        v = "T" if value else "F"
+        body = f"{key:<8}= {v:>20}"
+    elif isinstance(value, (int, np.integer)):
+        body = f"{key:<8}= {value:>20d}"
+    elif isinstance(value, (float, np.floating)):
+        body = f"{key:<8}= {value:>20.12G}"
+    else:
+        s = str(value).replace("'", "''")
+        body = f"{key:<8}= '{s:<8}'"
+    if comment:
+        body = f"{body} / {comment}"
+    return body[:80].ljust(80).encode("ascii")
+
+
+def _header_bytes(cards: list[bytes]) -> bytes:
+    raw = b"".join(cards) + b"END".ljust(80)
+    pad = (-len(raw)) % BLOCK
+    return raw + b" " * pad
+
+
+def _data_bytes(data: np.ndarray) -> bytes:
+    raw = data.astype(data.dtype.newbyteorder(">")).tobytes()
+    pad = (-len(raw)) % BLOCK
+    return raw + b"\x00" * pad
+
+
+_BITPIX = {np.dtype(">f4"): -32, np.dtype(">f8"): -64,
+           np.dtype(">i4"): 32, np.dtype(">i8"): 64, np.dtype(">i2"): 16}
+
+
+def _image_hdu(data: np.ndarray, header: dict | None, primary: bool,
+               name: str | None = None) -> bytes:
+    if data.dtype.kind == "f" and data.dtype.itemsize not in (4, 8):
+        data = data.astype(np.float32)
+    be = data.dtype.newbyteorder(">")
+    bitpix = _BITPIX[np.dtype(be)]
+    cards = []
+    if primary:
+        cards.append(_card("SIMPLE", True, "conforms to FITS standard"))
+    else:
+        cards.append(_card("XTENSION", "IMAGE", "image extension"))
+    cards.append(_card("BITPIX", bitpix))
+    cards.append(_card("NAXIS", data.ndim))
+    # FITS axis order is reversed w.r.t. numpy shape
+    for i, n in enumerate(reversed(data.shape)):
+        cards.append(_card(f"NAXIS{i + 1}", n))
+    if not primary:
+        cards.append(_card("PCOUNT", 0))
+        cards.append(_card("GCOUNT", 1))
+    if name:
+        cards.append(_card("EXTNAME", name))
+    for k, v in (header or {}).items():
+        cards.append(_card(k, v))
+    return _header_bytes(cards) + _data_bytes(data)
+
+
+def write_fits_image(path: str, images: dict[str, np.ndarray],
+                     header: dict | None = None):
+    """Write named 2-D images: first as primary HDU, rest as extensions.
+
+    Mirrors the reference's map file layout (``run_destriper.py:35-46``:
+    primary + extensions named per product).
+    """
+    names = list(images.keys())
+    out = b""
+    for i, nm in enumerate(names):
+        hdr = dict(header or {})
+        if i == 0:
+            hdr["EXTNAME"] = nm
+        out += _image_hdu(np.asarray(images[nm]), hdr, primary=(i == 0),
+                          name=None if i == 0 else nm)
+    with open(path, "wb") as f:
+        f.write(out)
+
+
+def _parse_header(raw: bytes) -> dict:
+    hdr = {}
+    for i in range(0, len(raw), 80):
+        card = raw[i:i + 80].decode("ascii", errors="replace")
+        key = card[:8].strip()
+        if key == "END":
+            break
+        if card[8:10] != "= ":
+            continue
+        raw_val = card[10:]
+        if raw_val.lstrip().startswith("'"):
+            # quoted string: scan to the closing quote ('' escapes one ')
+            s = raw_val.lstrip()
+            out = []
+            i = 1
+            while i < len(s):
+                if s[i] == "'":
+                    if i + 1 < len(s) and s[i + 1] == "'":
+                        out.append("'")
+                        i += 2
+                        continue
+                    break
+                out.append(s[i])
+                i += 1
+            hdr[key] = "".join(out).rstrip()
+            continue
+        val = raw_val.split("/")[0].strip()
+        if val == "T":
+            hdr[key] = True
+        elif val == "F":
+            hdr[key] = False
+        else:
+            try:
+                hdr[key] = int(val)
+            except ValueError:
+                try:
+                    hdr[key] = float(val)
+                except ValueError:
+                    hdr[key] = val
+    return hdr
+
+
+_NP_DTYPE = {-32: ">f4", -64: ">f8", 16: ">i2", 32: ">i4", 64: ">i8", 8: "u1"}
+
+
+def read_fits_image(path: str):
+    """Read all image HDUs: returns list of (name, header, ndarray)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    hdus = []
+    pos = 0
+    idx = 0
+    while pos < len(buf):
+        # read header blocks until END card
+        hdr_raw = b""
+        while True:
+            block = buf[pos:pos + BLOCK]
+            if len(block) < BLOCK:
+                return hdus
+            hdr_raw += block
+            pos += BLOCK
+            if _has_end(block):
+                break
+        hdr = _parse_header(hdr_raw)
+        naxis = hdr.get("NAXIS", 0)
+        shape = tuple(hdr[f"NAXIS{i + 1}"] for i in range(naxis))[::-1]
+        count = int(np.prod(shape)) if shape else 0
+        dtype = np.dtype(_NP_DTYPE[hdr["BITPIX"]])
+        nbytes = count * dtype.itemsize
+        data = np.frombuffer(buf[pos:pos + nbytes], dtype=dtype)
+        data = data.reshape(shape) if count else data
+        pos += nbytes + ((-nbytes) % BLOCK)
+        name = hdr.get("EXTNAME", f"HDU{idx}")
+        hdus.append((name, hdr, data.astype(dtype.newbyteorder("="))))
+        idx += 1
+    return hdus
+
+
+def _has_end(block: bytes) -> bool:
+    for i in range(0, len(block), 80):
+        if block[i:i + 8].rstrip() == b"END":
+            return True
+    return False
+
+
+def write_healpix_map(path: str, maps: dict[str, np.ndarray],
+                      pixels: np.ndarray, nside: int, nest: bool = False):
+    """Partial-sky HEALPix maps: PIXELS index HDU + one HDU per product
+    (the healpy ``write_map(..., partial=True)`` analogue,
+    ``run_destriper.py:68-77``)."""
+    hdr = {"PIXTYPE": "HEALPIX", "ORDERING": "NESTED" if nest else "RING",
+           "NSIDE": nside, "OBJECT": "PARTIAL"}
+    images: dict[str, np.ndarray] = {
+        "PIXELS": np.asarray(pixels, dtype=np.int64)}
+    for k, v in maps.items():
+        images[k] = np.asarray(v, dtype=np.float32)
+    write_fits_image(path, images, header=hdr)
+
+
+def read_healpix_map(path: str):
+    """Returns (maps dict, pixels, nside, nest)."""
+    hdus = read_fits_image(path)
+    hdr0 = hdus[0][1]
+    nside = hdr0["NSIDE"]
+    nest = hdr0.get("ORDERING", "RING") == "NESTED"
+    pixels = None
+    maps = {}
+    for name, _, data in hdus:
+        if name == "PIXELS":
+            pixels = data
+        else:
+            maps[name] = data
+    return maps, pixels, nside, nest

@@ -1,0 +1,359 @@
+"""HEALPix pixelization (RING and NESTED), vectorized numpy.
+
+The reference depends on ``healpy`` for map-making at nside 4096
+(``MapMaking/COMAPData.py:429-469`` ``read_pixels_healpix``,
+``run_destriper.py:53-77`` partial-map output). healpy is not in this
+image, and the subset the pipeline needs — ``ang2pix``/``pix2ang`` in both
+orderings, ``ring2nest``/``nest2ring``, nside/npix helpers, and the
+galactic rotation handled separately — is small enough to own. Algorithms
+follow the standard HEALPix indexing equations (Górski et al. 2005); this
+is an independent implementation, host-side (pixelization is precomputed
+per observation, never device-resident).
+
+Angles: ``theta`` colatitude [0, pi], ``phi`` longitude [0, 2pi), radians
+(healpy convention); lon/lat-degree wrappers provided.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "nside2npix", "npix2nside", "nside2resol",
+    "ang2pix", "pix2ang", "ang2pix_lonlat", "pix2ang_lonlat",
+    "ring2nest", "nest2ring", "ang2vec", "vec2ang",
+]
+
+
+def nside2npix(nside: int) -> int:
+    return 12 * nside * nside
+
+
+def npix2nside(npix: int) -> int:
+    nside = int(round(np.sqrt(npix / 12.0)))
+    if 12 * nside * nside != npix:
+        raise ValueError(f"{npix} is not a valid HEALPix map size")
+    return nside
+
+
+def nside2resol(nside: int) -> float:
+    """Mean pixel spacing in radians (sqrt of pixel area)."""
+    return np.sqrt(4.0 * np.pi / nside2npix(nside))
+
+
+def _check_nside(nside: int):
+    if nside < 1 or (nside & (nside - 1)):
+        raise ValueError(f"nside must be a positive power of 2, got {nside}")
+
+
+# ---------------------------------------------------------------------------
+# RING scheme
+# ---------------------------------------------------------------------------
+
+def _ang2pix_ring(nside, theta, phi):
+    z = np.cos(theta)
+    za = np.abs(z)
+    tt = np.mod(phi, 2.0 * np.pi) * (2.0 / np.pi)  # in [0, 4)
+
+    # equatorial belt |z| <= 2/3
+    temp1 = nside * (0.5 + tt)
+    temp2 = nside * z * 0.75
+    jp = np.floor(temp1 - temp2).astype(np.int64)  # ascending edge line
+    jm = np.floor(temp1 + temp2).astype(np.int64)  # descending edge line
+    ir = nside + 1 + jp - jm                       # ring counted from z=2/3
+    kshift = 1 - (ir & 1)
+    ip = (jp + jm - nside + kshift + 1) >> 1
+    ip = np.mod(ip, 4 * nside)
+    ncap = 2 * nside * (nside - 1)
+    pix_eq = ncap + (ir - 1) * 4 * nside + ip
+
+    # polar caps
+    tp = tt - np.floor(tt)
+    tmp = nside * np.sqrt(3.0 * (1.0 - za))
+    jp_p = np.floor(tp * tmp).astype(np.int64)
+    jm_p = np.floor((1.0 - tp) * tmp).astype(np.int64)
+    ir_p = jp_p + jm_p + 1                         # ring from the pole
+    ip_p = np.floor(tt * ir_p).astype(np.int64)
+    ip_p = np.mod(ip_p, 4 * ir_p)
+    npix = nside2npix(nside)
+    pix_north = 2 * ir_p * (ir_p - 1) + ip_p
+    pix_south = npix - 2 * ir_p * (ir_p + 1) + ip_p
+
+    return np.where(za <= 2.0 / 3.0, pix_eq,
+                    np.where(z > 0, pix_north, pix_south))
+
+
+def _pix2ang_ring(nside, pix):
+    pix = np.asarray(pix, dtype=np.int64)
+    npix = nside2npix(nside)
+    ncap = 2 * nside * (nside - 1)
+
+    # north cap: rings 1..nside-1, 2 i (i-1) pixels before ring i
+    iring_n = ((1.0 + np.sqrt(np.maximum(2.0 * pix + 1.0, 0.0))) / 2.0)
+    iring_n = iring_n.astype(np.int64)
+    # float-boundary fixup
+    iring_n = np.where(2 * iring_n * (iring_n + 1) <= pix, iring_n + 1,
+                       iring_n)
+    iring_n = np.where(2 * iring_n * (iring_n - 1) > pix, iring_n - 1,
+                       iring_n)
+    iring_n = np.maximum(iring_n, 1)
+    iphi_n = pix - 2 * iring_n * (iring_n - 1)
+    z_n = 1.0 - iring_n**2 / (3.0 * nside**2)
+    phi_n = (iphi_n + 0.5) * np.pi / (2.0 * np.maximum(iring_n, 1))
+
+    # equatorial belt: odd (iring+nside) rings start at phi=0, even at
+    # phi = pi/(4 nside) (Gorski et al. 2005 eq. 9)
+    p_eq = pix - ncap
+    iring_e = p_eq // (4 * nside) + nside
+    iphi_e = np.mod(p_eq, 4 * nside)
+    shift = 0.5 * (1 - np.mod(iring_e + nside, 2))
+    z_e = (2 * nside - iring_e) * 2.0 / (3.0 * nside)
+    phi_e = (iphi_e + shift) * np.pi / (2.0 * nside)
+
+    # south cap (mirror of north)
+    ps = npix - 1 - pix
+    iring_s = ((1.0 + np.sqrt(np.maximum(2.0 * ps + 1.0, 0.0))) / 2.0)
+    iring_s = iring_s.astype(np.int64)
+    iring_s = np.where(2 * iring_s * (iring_s + 1) <= ps, iring_s + 1,
+                       iring_s)
+    iring_s = np.where(2 * iring_s * (iring_s - 1) > ps, iring_s - 1,
+                       iring_s)
+    iring_s = np.maximum(iring_s, 1)
+    # index within the south ring, counted the same direction as north
+    ipix_in_ring = pix - (npix - 2 * iring_s * (iring_s + 1))
+    z_s = -1.0 + iring_s**2 / (3.0 * nside**2)
+    phi_s = (ipix_in_ring + 0.5) * np.pi / (2.0 * np.maximum(iring_s, 1))
+
+    north = pix < ncap
+    south = pix >= npix - ncap
+    z = np.where(north, z_n, np.where(south, z_s, z_e))
+    phi = np.where(north, phi_n, np.where(south, phi_s, phi_e))
+    return np.arccos(np.clip(z, -1.0, 1.0)), np.mod(phi, 2.0 * np.pi)
+
+
+# ---------------------------------------------------------------------------
+# NESTED scheme (via face/x/y coordinates and bit interleaving)
+# ---------------------------------------------------------------------------
+
+_JRLL = np.array([2, 2, 2, 2, 3, 3, 3, 3, 4, 4, 4, 4])
+_JPLL = np.array([1, 3, 5, 7, 0, 2, 4, 6, 1, 3, 5, 7])
+
+
+def _spread_bits(v):
+    """Interleave zeros between the bits of v (v < 2^29)."""
+    v = v.astype(np.int64)
+    v = (v | (v << 16)) & 0x0000FFFF0000FFFF
+    v = (v | (v << 8)) & 0x00FF00FF00FF00FF
+    v = (v | (v << 4)) & 0x0F0F0F0F0F0F0F0F
+    v = (v | (v << 2)) & 0x3333333333333333
+    v = (v | (v << 1)) & 0x5555555555555555
+    return v
+
+
+def _compress_bits(v):
+    v = v & 0x5555555555555555
+    v = (v | (v >> 1)) & 0x3333333333333333
+    v = (v | (v >> 2)) & 0x0F0F0F0F0F0F0F0F
+    v = (v | (v >> 4)) & 0x00FF00FF00FF00FF
+    v = (v | (v >> 8)) & 0x0000FFFF0000FFFF
+    v = (v | (v >> 16)) & 0x00000000FFFFFFFF
+    return v
+
+
+def _xyf2nest(nside, ix, iy, face):
+    return face * nside * nside + _spread_bits(ix) + (_spread_bits(iy) << 1)
+
+
+def _nest2xyf(nside, pix):
+    npface = nside * nside
+    face = pix // npface
+    p = pix & (npface - 1)
+    return _compress_bits(p), _compress_bits(p >> 1), face
+
+
+def _ang2xyf(nside, theta, phi):
+    z = np.cos(theta)
+    za = np.abs(z)
+    tt = np.mod(phi, 2.0 * np.pi) * (2.0 / np.pi)
+
+    # equatorial
+    temp1 = nside * (0.5 + tt)
+    temp2 = nside * z * 0.75
+    jp = np.floor(temp1 - temp2).astype(np.int64)
+    jm = np.floor(temp1 + temp2).astype(np.int64)
+    ifp = jp // nside
+    ifm = jm // nside
+    face_eq = np.where(ifp == ifm, (ifp & 3) + 4,
+                       np.where(ifp < ifm, ifp & 3, (ifm & 3) + 8))
+    ix_eq = jm & (nside - 1)
+    iy_eq = nside - (jp & (nside - 1)) - 1
+
+    # polar
+    ntt = np.minimum(tt.astype(np.int64), 3)
+    tp = tt - ntt
+    tmp = nside * np.sqrt(3.0 * (1.0 - za))
+    jp_p = np.minimum(np.floor(tp * tmp).astype(np.int64), nside - 1)
+    jm_p = np.minimum(np.floor((1.0 - tp) * tmp).astype(np.int64), nside - 1)
+    north = z >= 0
+    face_p = np.where(north, ntt, ntt + 8)
+    ix_p = np.where(north, nside - jm_p - 1, jp_p)
+    iy_p = np.where(north, nside - jp_p - 1, jm_p)
+
+    eq = za <= 2.0 / 3.0
+    return (np.where(eq, ix_eq, ix_p), np.where(eq, iy_eq, iy_p),
+            np.where(eq, face_eq, face_p))
+
+
+def _xyf2ang(nside, ix, iy, face):
+    jr = _JRLL[face] * nside - ix - iy - 1  # ring index 1..4nside-1
+
+    npolar = jr < nside
+    spolar = jr > 3 * nside
+    nr = np.where(npolar, jr, np.where(spolar, 4 * nside - jr, nside))
+    z = np.where(
+        npolar, 1.0 - nr**2 / (3.0 * nside**2),
+        np.where(spolar, -1.0 + nr**2 / (3.0 * nside**2),
+                 (2 * nside - jr) * 2.0 / (3.0 * nside)))
+    kshift = np.where(npolar | spolar, 0, (jr - nside) & 1)
+
+    jp = (_JPLL[face] * nr + ix - iy + 1 + kshift) // 2
+    jp = np.where(jp > 4 * nr, jp - 4 * nr, jp)
+    jp = np.where(jp < 1, jp + 4 * nr, jp)
+    phi = (jp - (kshift + 1) * 0.5) * (np.pi / (2.0 * nr))
+    return np.arccos(np.clip(z, -1.0, 1.0)), np.mod(phi, 2.0 * np.pi)
+
+
+def _xyf2ring(nside, ix, iy, face):
+    jr = _JRLL[face] * nside - ix - iy - 1
+    npix = nside2npix(nside)
+    ncap = 2 * nside * (nside - 1)
+
+    npolar = jr < nside
+    spolar = jr > 3 * nside
+    nr = np.where(npolar, jr, np.where(spolar, 4 * nside - jr, nside))
+    n_before = np.where(
+        npolar, 2 * nr * (nr - 1),
+        np.where(spolar, npix - 2 * nr * (nr + 1),
+                 ncap + (jr - nside) * 4 * nside))
+    kshift = np.where(npolar | spolar, 0, (jr - nside) & 1)
+
+    jp = (_JPLL[face] * nr + ix - iy + 1 + kshift) // 2
+    jp = np.where(jp > 4 * nr, jp - 4 * nr, jp)
+    jp = np.where(jp < 1, jp + 4 * nr, jp)
+    return n_before + jp - 1
+
+
+def _isqrt(v):
+    r = np.sqrt(v.astype(np.float64)).astype(np.int64)
+    r = np.where((r + 1) * (r + 1) <= v, r + 1, r)
+    return np.where(r * r > v, r - 1, r)
+
+
+def _ring2xyf(nside, pix):
+    """Exact integer RING -> (ix, iy, face), standard HEALPix indexing."""
+    npix = nside2npix(nside)
+    ncap = 2 * nside * (nside - 1)
+    north = pix < ncap
+    south = pix >= npix - ncap
+    eq = ~(north | south)
+
+    # north polar cap
+    ir_n = (1 + _isqrt(1 + 2 * pix)) >> 1
+    iphi_n = (pix + 1) - 2 * ir_n * (ir_n - 1)          # 1-based
+    face_n = (iphi_n - 1) // np.maximum(ir_n, 1)
+
+    # equatorial
+    ip = pix - ncap
+    tmp = ip // (4 * nside)
+    ir_e = tmp + nside
+    iphi_e = ip - tmp * 4 * nside + 1
+    kshift_e = (ir_e + nside) & 1
+    ire = ir_e - nside + 1
+    irm = 2 * nside + 2 - ire
+    ifm = (iphi_e - ire // 2 + nside - 1) // nside
+    ifp = (iphi_e - irm // 2 + nside - 1) // nside
+    face_e = np.where(ifp == ifm, (ifp & 3) + 4,
+                      np.where(ifp < ifm, ifp, ifm + 8))
+
+    # south polar cap
+    ip_s = npix - pix
+    ir_s = (1 + _isqrt(2 * ip_s - 1)) >> 1
+    iphi_s = 4 * ir_s + 1 - (ip_s - 2 * ir_s * (ir_s - 1))
+    face_s = 8 + (iphi_s - 1) // np.maximum(ir_s, 1)
+    ir_s_n = 4 * nside - ir_s                            # from north
+
+    iring = np.where(north, ir_n, np.where(eq, ir_e, ir_s_n))
+    iphi = np.where(north, iphi_n, np.where(eq, iphi_e, iphi_s))
+    face = np.where(north, face_n, np.where(eq, face_e, face_s))
+    nr = np.where(eq, nside, np.where(north, ir_n, ir_s))
+    kshift = np.where(eq, kshift_e, 0)
+
+    irt = iring - _JRLL[face] * nside + 1
+    ipt = 2 * iphi - _JPLL[face] * nr - kshift - 1
+    ipt = np.where(ipt >= 2 * nside, ipt - 8 * nside, ipt)
+    ix = (ipt - irt) >> 1
+    iy = (-(ipt + irt)) >> 1
+    return ix, iy, face
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def ang2pix(nside: int, theta, phi, nest: bool = False):
+    """(theta, phi) radians -> pixel index."""
+    _check_nside(nside)
+    theta = np.asarray(theta, dtype=np.float64)
+    phi = np.asarray(phi, dtype=np.float64)
+    if nest:
+        ix, iy, face = _ang2xyf(nside, theta, phi)
+        return _xyf2nest(nside, ix, iy, face)
+    return _ang2pix_ring(nside, theta, phi)
+
+
+def pix2ang(nside: int, pix, nest: bool = False):
+    """Pixel index -> (theta, phi) radians at pixel centers."""
+    _check_nside(nside)
+    pix = np.asarray(pix, dtype=np.int64)
+    if nest:
+        ix, iy, face = _nest2xyf(nside, pix)
+        return _xyf2ang(nside, ix, iy, face)
+    return _pix2ang_ring(nside, pix)
+
+
+def ang2pix_lonlat(nside: int, lon_deg, lat_deg, nest: bool = False):
+    """healpy's ``lonlat=True`` convention: longitude/latitude in degrees."""
+    theta = np.radians(90.0 - np.asarray(lat_deg, dtype=np.float64))
+    phi = np.radians(np.asarray(lon_deg, dtype=np.float64))
+    return ang2pix(nside, theta, phi, nest=nest)
+
+
+def pix2ang_lonlat(nside: int, pix, nest: bool = False):
+    theta, phi = pix2ang(nside, pix, nest=nest)
+    return np.degrees(phi), 90.0 - np.degrees(theta)
+
+
+def ring2nest(nside: int, pix):
+    _check_nside(nside)
+    ix, iy, face = _ring2xyf(nside, np.asarray(pix, dtype=np.int64))
+    return _xyf2nest(nside, ix, iy, face)
+
+
+def nest2ring(nside: int, pix):
+    _check_nside(nside)
+    ix, iy, face = _nest2xyf(nside, np.asarray(pix, dtype=np.int64))
+    return _xyf2ring(nside, ix, iy, face)
+
+
+def ang2vec(theta, phi):
+    st = np.sin(theta)
+    return np.stack([st * np.cos(phi), st * np.sin(phi), np.cos(theta)],
+                    axis=-1)
+
+
+def vec2ang(vec):
+    vec = np.asarray(vec, dtype=np.float64)
+    r = np.linalg.norm(vec, axis=-1)
+    theta = np.arccos(np.clip(vec[..., 2] / np.maximum(r, 1e-300), -1, 1))
+    phi = np.mod(np.arctan2(vec[..., 1], vec[..., 0]), 2 * np.pi)
+    return theta, phi

@@ -1,0 +1,185 @@
+"""Minimal flat-sky FITS-WCS: TAN (gnomonic) and CAR (plate carrée).
+
+The reference pipeline builds its map geometry from ``astropy.wcs`` with
+``CTYPE in {RA---TAN, RA---CAR, GLON-CAR, ...}`` (``MapMaking/
+run_destriper.py:118-128``, ``Tools/WCS.py:211-244``). astropy is not a
+dependency of this framework; map geometry is simple enough to own:
+
+- **TAN**: full gnomonic projection about the reference point, including the
+  spherical rotation to/from native coordinates (FITS WCS paper II), valid at
+  any declination. Used for per-source calibrator maps and CO fields.
+- **CAR**: plate carrée — linear in (lon, lat) about the reference point.
+  This matches astropy's CAR for ``crval2 == 0`` (the reference's galactic
+  survey geometry, ``ParameterFiles/parameters_GFields.ini:26-29``); nonzero
+  ``crval2`` keeps the same linear convention (documented divergence from the
+  FITS rotated-CAR corner case).
+
+All angles in degrees. Pixel convention is 0-based (like
+``astropy.wcs.wcs_world2pix(..., 0)``, which the reference uses:
+``Tools/WCS.py:240``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["WCS"]
+
+D2R = np.pi / 180.0
+
+
+def _rotation_to_native(lon_pole_deg, alpha_p, delta_p):
+    """Rows of the celestial->native rotation matrix (all degrees)."""
+    ap, dp, lp = alpha_p * D2R, delta_p * D2R, lon_pole_deg * D2R
+    # R = Rz(lonpole - pi) Rx(pi/2 - delta_p) Rz(alpha_p + pi/2) is the
+    # standard Euler chain; written out explicitly for clarity.
+    ca, sa = np.cos(ap), np.sin(ap)
+    cd, sd = np.cos(dp), np.sin(dp)
+    cl, sl = np.cos(lp), np.sin(lp)
+    r11 = -sa * sl - ca * cl * sd
+    r12 = ca * sl - sa * cl * sd
+    r13 = cl * cd
+    r21 = sa * cl - ca * sl * sd
+    r22 = -ca * cl - sa * sl * sd
+    r23 = sl * cd
+    r31 = ca * cd
+    r32 = sa * cd
+    r33 = sd
+    return np.array([[r11, r12, r13], [r21, r22, r23], [r31, r32, r33]])
+
+
+@dataclass(frozen=True)
+class WCS:
+    """A 2-D celestial WCS.
+
+    Parameters mirror the FITS keywords the reference feeds astropy
+    (``run_destriper.py:118-128``): ``crval`` (deg), ``cdelt`` (deg/pix,
+    cdelt[0] typically negative for RA), ``crpix`` (0-based reference pixel),
+    ``ctype`` like ``("RA---TAN", "DEC--TAN")``, and image shape
+    ``(nx, ny)``.
+    """
+
+    crval: tuple[float, float]
+    cdelt: tuple[float, float]
+    crpix: tuple[float, float]
+    ctype: tuple[str, str] = ("RA---TAN", "DEC--TAN")
+    shape: tuple[int, int] = (480, 480)  # (nx, ny)
+    _rot: np.ndarray = field(init=False, repr=False, compare=False)
+
+    def __post_init__(self):
+        proj = self.projection
+        if proj == "TAN":
+            # zenithal: fiducial native lat 90deg, default LONPOLE=180
+            object.__setattr__(
+                self, "_rot",
+                _rotation_to_native(180.0, self.crval[0], self.crval[1]))
+        elif proj != "CAR":
+            raise ValueError(f"unsupported projection {proj!r}")
+        else:
+            object.__setattr__(self, "_rot", np.eye(3))
+
+    # -- properties ------------------------------------------------------
+    @property
+    def projection(self) -> str:
+        return self.ctype[0][-3:]
+
+    @property
+    def nx(self) -> int:
+        return self.shape[0]
+
+    @property
+    def ny(self) -> int:
+        return self.shape[1]
+
+    @property
+    def npix(self) -> int:
+        return self.nx * self.ny
+
+    # -- core transforms -------------------------------------------------
+    def world2plane(self, lon, lat):
+        """Celestial (deg) -> intermediate plane coords (deg)."""
+        lon = np.asarray(lon, dtype=np.float64)
+        lat = np.asarray(lat, dtype=np.float64)
+        if self.projection == "CAR":
+            dlon = (lon - self.crval[0] + 180.0) % 360.0 - 180.0
+            return dlon, lat - self.crval[1]
+        # TAN: rotate to native, gnomonic project
+        cl, sl = np.cos(lon * D2R), np.sin(lon * D2R)
+        cb, sb = np.cos(lat * D2R), np.sin(lat * D2R)
+        vec = np.stack([cb * cl, cb * sl, sb], axis=-1)
+        R = self._rot
+        nx = vec @ R[0]
+        ny_ = vec @ R[1]
+        nz = vec @ R[2]
+        # with LONPOLE=180 the rows reduce to the classic standard
+        # coordinates: xi = ny/nz (east), eta = -nx/nz (north)
+        nz_safe = np.where(nz > 1e-12, nz, np.nan)  # behind tangent plane
+        x = (ny_ / nz_safe) / D2R
+        y = (-nx / nz_safe) / D2R
+        return x, y
+
+    def plane2world(self, x, y):
+        """Intermediate plane coords (deg) -> celestial (deg)."""
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        if self.projection == "CAR":
+            return (x + self.crval[0]) % 360.0, y + self.crval[1]
+        R = self._rot
+        xr, yr = x * D2R, y * D2R
+        denom = np.sqrt(1.0 + xr * xr + yr * yr)
+        nvec = np.stack([-yr / denom, xr / denom, 1.0 / denom], axis=-1)
+        cel = nvec @ R  # R^T applied to native vector: R rows are native axes
+        lon = (np.arctan2(cel[..., 1], cel[..., 0]) / D2R) % 360.0
+        lat = np.arcsin(np.clip(cel[..., 2], -1.0, 1.0)) / D2R
+        return lon, lat
+
+    def world2pix(self, lon, lat):
+        """Celestial (deg) -> continuous 0-based pixel coords (px, py)."""
+        x, y = self.world2plane(lon, lat)
+        px = x / self.cdelt[0] + self.crpix[0]
+        py = y / self.cdelt[1] + self.crpix[1]
+        return px, py
+
+    def pix2world(self, px, py):
+        x = (np.asarray(px, dtype=np.float64) - self.crpix[0]) * self.cdelt[0]
+        y = (np.asarray(py, dtype=np.float64) - self.crpix[1]) * self.cdelt[1]
+        return self.plane2world(x, y)
+
+    def ang2pix(self, lon, lat):
+        """Celestial (deg) -> flat pixel index ``iy * nx + ix``; -1 outside.
+
+        Parity: ``Tools/WCS.py:234-249`` (``ang2pixWCS``), which also flattens
+        as ``py * nx + px`` and marks out-of-range pixels invalid.
+        """
+        px, py = self.world2pix(lon, lat)
+        with np.errstate(invalid="ignore"):
+            ix = np.floor(px + 0.5).astype(np.int64)
+            iy = np.floor(py + 0.5).astype(np.int64)
+        bad = (~np.isfinite(px) | ~np.isfinite(py)
+               | (ix < 0) | (ix >= self.nx) | (iy < 0) | (iy >= self.ny))
+        return np.where(bad, -1, iy * self.nx + ix)
+
+    def pixel_centers(self):
+        """(lon, lat) of every pixel, each shaped (ny, nx)."""
+        py, px = np.mgrid[0 : self.ny, 0 : self.nx]
+        return self.pix2world(px, py)
+
+    # -- constructors ----------------------------------------------------
+    @classmethod
+    def from_field(cls, crval, cdelt, shape, ctype=("RA---TAN", "DEC--TAN")):
+        """Centered geometry like the reference's map params
+        (``run_destriper.py:118-128``: crpix = shape/2)."""
+        crpix = (shape[0] / 2.0, shape[1] / 2.0)
+        return cls(tuple(crval), tuple(cdelt), crpix, tuple(ctype),
+                   tuple(shape))
+
+    def header_cards(self):
+        """FITS header cards describing this WCS (1-based CRPIX)."""
+        return {
+            "CTYPE1": self.ctype[0], "CTYPE2": self.ctype[1],
+            "CRVAL1": self.crval[0], "CRVAL2": self.crval[1],
+            "CDELT1": self.cdelt[0], "CDELT2": self.cdelt[1],
+            "CRPIX1": self.crpix[0] + 1, "CRPIX2": self.crpix[1] + 1,
+        }
