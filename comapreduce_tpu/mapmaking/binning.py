@@ -65,8 +65,8 @@ def bin_offset_map(offsets: jax.Array, pixels: jax.Array, weights: jax.Array,
     """Map of the stretched offset vector (``binValues2Map`` analogue).
 
     ``offsets``: f32[n_offsets]; sample t belongs to offset ``t // L``
-    (``OffsetTypes.py:11-54``). Equivalent to ``bin_map(repeat(offsets, L))``
-    without materialising the repeat through a reshape-free gather.
+    (``OffsetTypes.py:11-54``). Computed as ``bin_map(repeat(offsets, L))``;
+    XLA fuses the repeat into the scatter, so it is never a separate buffer.
     """
     n = pixels.shape[0]
     tod = jnp.repeat(offsets, offset_length, total_repeat_length=n)

@@ -132,8 +132,9 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
           jnp.zeros((n_groups, 2), f32) if with_ground else None)
 
     def cond(state):
-        _, _, _, rz, k = state
-        return (k < n_iter) & (rz > threshold**2 * jnp.maximum(b_norm, 1e-30))
+        _, _, _, rz, k, done = state
+        return ((k < n_iter) & ~done
+                & (rz > threshold**2 * jnp.maximum(b_norm, 1e-30)))
 
     def axpy(a, x, y):
         """x + a*y over the (offsets, ground-or-None) pair."""
@@ -141,7 +142,7 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
                 None if x[1] is None else x[1] + a * y[1])
 
     def body(state):
-        x, r, p, rz, k = state
+        x, r, p, rz, k, done = state
         q = matvec(p)
         pq = _dot(p, q, axis_name)
         # The system is SPD but singular (a global constant offset is in the
@@ -157,7 +158,8 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
         ok = ok & jnp.isfinite(rz_new)
         beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
         p_new = axpy(beta, r_new, p)
-        # on breakdown: freeze the iterate and force the loop to exit
+        # on breakdown: freeze the iterate, keep the last good residual for
+        # reporting, and flag the loop to exit
         keep = lambda new, old: jax.tree.map(  # noqa: E731
             lambda a_, b_: jnp.where(ok, a_, b_), new, old)
         x = (keep(x_new[0], x[0]),
@@ -166,11 +168,12 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
              None if r[1] is None else keep(r_new[1], r[1]))
         p = (keep(p_new[0], p[0]),
              None if p[1] is None else keep(p_new[1], p[1]))
-        rz = jnp.where(ok, rz_new, 0.0)
-        return x, r, p, rz, k + 1
+        rz = jnp.where(ok, rz_new, rz)
+        return x, r, p, rz, k + 1, ~ok
 
-    state0 = (x0, b, b, b_norm, jnp.asarray(0, jnp.int32))
-    x, r, _, rz, k = jax.lax.while_loop(cond, body, state0)
+    state0 = (x0, b, b, b_norm, jnp.asarray(0, jnp.int32),
+              jnp.asarray(False))
+    x, r, _, rz, k, _ = jax.lax.while_loop(cond, body, state0)
     offsets, ground = x
 
     # final products

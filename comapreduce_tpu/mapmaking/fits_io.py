@@ -26,7 +26,16 @@ def _card(key: str, value, comment: str = "") -> bytes:
     elif isinstance(value, (int, np.integer)):
         body = f"{key:<8}= {value:>20d}"
     elif isinstance(value, (float, np.floating)):
-        body = f"{key:<8}= {value:>20.12G}"
+        if not np.isfinite(value):
+            # FITS headers have no representation for NaN/Inf; failing here
+            # beats writing a card every reader rejects or misparses
+            raise ValueError(f"non-finite FITS card value: {key}={value}")
+        v = f"{value:.12G}"
+        # FITS real values must carry a decimal point, or readers (including
+        # ours) parse them back as integers
+        if "." not in v and "E" not in v and "e" not in v:
+            v += "."
+        body = f"{key:<8}= {v:>20}"
     else:
         s = str(value).replace("'", "''")
         body = f"{key:<8}= '{s:<8}'"

@@ -1,0 +1,30 @@
+"""Distributed execution: device meshes, sharded reduction, sharded destriping.
+
+The reference parallelises with mpi4py: static file sharding for the TOD
+stages (``run_average.py:38-39``) and Allreduce/Gather+Bcast collectives
+inside the destriper CG (``Destriper.py:61-75,183-204``) — SURVEY.md §2.5.
+The TPU-native design replaces every MPI pattern with XLA collectives over a
+``jax.sharding.Mesh``:
+
+- **dp (data parallel)** — feeds/files shard over the ``'feed'`` mesh axis
+  (the reference's rank-per-file decomposition);
+- **sp (sequence parallel)** — the concatenated TOD time axis shards over
+  the ``'time'`` mesh axis in the destriper; each shard owns whole offsets,
+  the map and CG scalars are ``psum``-reduced over ICI (the reference's
+  rank-owns-samples decomposition, ``Destriper.py:217-263``);
+- multi-host scales the same program over DCN: same mesh, more devices.
+
+No point-to-point communication exists anywhere — every reference pattern is
+all-reduce-shaped (SURVEY.md §2.5), so ``psum`` is the only collective.
+"""
+
+from comapreduce_tpu.parallel.mesh import (  # noqa: F401
+    feed_time_mesh,
+    flat_axis_size,
+    local_mesh,
+)
+from comapreduce_tpu.parallel.sharded import (  # noqa: F401
+    destripe_sharded,
+    reduce_feeds_sharded,
+)
+from comapreduce_tpu.parallel.step import ObservationStep  # noqa: F401

@@ -1,0 +1,56 @@
+"""Device-mesh construction for the framework's two parallel axes.
+
+The canonical mesh is 2-D: ``('feed', 'time')``. The TOD reduction is data
+parallel over feeds (the reference's MPI-rank-per-file split,
+``run_average.py:38-39``); the destriper is sequence parallel over the
+concatenated time axis (the reference's rank-owns-samples split,
+``Destriper.py:217-263``). Either axis may be size 1; collapsing both gives
+the single-chip program unchanged — the same code runs on one chip, a v4-8,
+or a multi-host slice (DCN just extends the mesh).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["feed_time_mesh", "local_mesh", "flat_axis_size"]
+
+AXES = ("feed", "time")
+
+
+def feed_time_mesh(devices=None, n_feed: int | None = None) -> Mesh:
+    """Build a ``('feed', 'time')`` mesh over ``devices``.
+
+    ``n_feed`` fixes the feed-axis size (must divide the device count);
+    default splits devices as evenly as possible with feed >= time, which
+    suits the common case of more feeds than destriper shards.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+    if n_feed is None:
+        n_feed = 1
+        for cand in range(int(np.sqrt(n)), 0, -1):
+            if n % cand == 0:
+                n_feed = max(cand, n // cand)
+                break
+    if n % n_feed != 0:
+        raise ValueError(f"n_feed={n_feed} does not divide {n} devices")
+    return Mesh(devices.reshape(n_feed, n // n_feed), AXES)
+
+
+def local_mesh() -> Mesh:
+    """A 1x1 mesh on the first local device (single-chip path)."""
+    import jax
+
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), AXES)
+
+
+def flat_axis_size(mesh: Mesh) -> int:
+    """Total devices in the mesh — the shard count when both axes gang up
+    on one array axis (the destriper's flat time axis)."""
+    return int(np.prod(list(mesh.shape.values())))

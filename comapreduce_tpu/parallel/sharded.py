@@ -1,0 +1,144 @@
+"""Sharded versions of the two flagship programs.
+
+- :func:`reduce_feeds_sharded`: the Level-1 -> Level-2 reduction, data
+  parallel over feeds (reference: one MPI rank per file,
+  ``run_average.py:38-39``). Pure SPMD — no collectives; XLA partitions the
+  ``vmap``-over-feeds program from the input shardings alone.
+- :func:`destripe_sharded`: the destriper CG with the concatenated TOD time
+  axis sharded over every device. Each shard owns whole offsets; the map
+  accumulation and CG dot products are ``psum`` over the mesh (reference:
+  ``share_map`` Gather+Bcast and Allreduce scalars,
+  ``Destriper.py:61-75,183-204``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from comapreduce_tpu.mapmaking.destriper import DestriperResult, destripe
+from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
+                                        scan_starts_lengths)
+
+try:  # jax >= 0.4.35 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+__all__ = ["reduce_feeds_sharded", "destripe_sharded", "pad_for_shards"]
+
+
+def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
+                         tsys, sys_gain, freq_scaled, cfg: ReduceConfig):
+    """Run :func:`reduce_feed_scans` for every feed, feeds sharded over the
+    ``'feed'`` mesh axis.
+
+    Arrays carry a leading feed axis: ``tod``/``mask`` f32[F, B, C, T],
+    ``airmass`` f32[F, T], ``tsys``/``sys_gain`` f32[F, B, C]. Scan geometry
+    (``starts``/``lengths``) and ``freq_scaled`` f32[B, C] are shared by all
+    feeds (replicated). Returns the dict of :func:`reduce_feed_scans` with a
+    leading feed axis, feed-sharded.
+    """
+    n_scans = int(starts.shape[0])
+    # L is static inside reduce_feed_scans; recover it the same way the
+    # single-feed path does (scan blocks are padded to this length).
+    _, _, L = scan_starts_lengths(
+        np.stack([np.asarray(starts), np.asarray(starts) + np.asarray(lengths)],
+                 axis=1))
+
+    feed_sharded = NamedSharding(mesh, P("feed"))
+    repl = NamedSharding(mesh, P())
+
+    tod = jax.device_put(tod, feed_sharded)
+    mask = jax.device_put(mask, feed_sharded)
+    airmass = jax.device_put(airmass, feed_sharded)
+    tsys = jax.device_put(tsys, feed_sharded)
+    sys_gain = jax.device_put(sys_gain, feed_sharded)
+    starts = jax.device_put(jnp.asarray(starts), repl)
+    lengths = jax.device_put(jnp.asarray(lengths), repl)
+    freq_scaled = jax.device_put(freq_scaled, repl)
+
+    fn = jax.vmap(
+        functools.partial(reduce_feed_scans, cfg=cfg, n_scans=n_scans, L=L),
+        in_axes=(0, 0, 0, None, None, 0, 0, None))
+    with mesh:
+        return jax.jit(fn)(tod, mask, airmass, starts, lengths, tsys,
+                           sys_gain, freq_scaled)
+
+
+def pad_for_shards(tod, pixels, weights, n_shards: int, offset_length: int,
+                   npix: int):
+    """Pad flat destriper vectors so every shard gets whole offsets.
+
+    Padding samples carry zero weight and the drop pixel ``npix``, so they
+    change nothing (the reference instead truncates scans to offset
+    multiples, ``COMAPData.py:163-187``; padding wastes nothing on TPU where
+    shapes are static anyway).
+    """
+    n = tod.shape[0]
+    quantum = n_shards * offset_length
+    n_pad = (-n) % quantum
+    if n_pad:
+        tod = jnp.concatenate([tod, jnp.zeros(n_pad, tod.dtype)])
+        pixels = jnp.concatenate(
+            [pixels, jnp.full(n_pad, npix, pixels.dtype)])
+        weights = jnp.concatenate([weights, jnp.zeros(n_pad, weights.dtype)])
+    return tod, pixels, weights
+
+
+def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
+                     offset_length: int = 50, n_iter: int = 100,
+                     threshold: float = 1e-6,
+                     ground_ids=None, az=None, n_groups: int = 0
+                     ) -> DestriperResult:
+    """Destripe with the flat time axis sharded over the whole mesh.
+
+    ``tod``/``weights`` f32[N], ``pixels`` i32[N]; N is padded here to a
+    multiple of ``n_devices * offset_length``. The returned ``offsets``
+    vector is the concatenation over shards (global offset order); maps and
+    CG scalars come back replicated.
+    """
+    axes = tuple(mesh.axis_names)
+    n_shards = int(np.prod([mesh.shape[a] for a in axes]))
+    tod, pixels, weights = pad_for_shards(
+        tod, pixels, weights, n_shards, offset_length, npix)
+    with_ground = ground_ids is not None
+    if with_ground:
+        n = tod.shape[0]
+        pad = n - ground_ids.shape[0]
+        if pad:
+            ground_ids = jnp.concatenate(
+                [ground_ids, jnp.zeros(pad, ground_ids.dtype)])
+            az = jnp.concatenate([az, jnp.zeros(pad, az.dtype)])
+
+    shard = P(axes)
+    repl = P()
+
+    def local(tod_l, pixels_l, weights_l, ground_l, az_l):
+        return destripe(tod_l, pixels_l, weights_l, npix,
+                        offset_length=offset_length, n_iter=n_iter,
+                        threshold=threshold, axis_name=axes,
+                        ground_ids=ground_l if with_ground else None,
+                        az=az_l if with_ground else None, n_groups=n_groups)
+
+    out_specs = DestriperResult(
+        offsets=shard, ground=repl, destriped_map=repl, naive_map=repl,
+        weight_map=repl, hit_map=repl, n_iter=repl, residual=repl)
+
+    if with_ground:
+        fn = _shard_map(local, mesh=mesh,
+                        in_specs=(shard, shard, shard, shard, shard),
+                        out_specs=out_specs, check_vma=False)
+        args = (tod, pixels, weights, ground_ids, az)
+    else:
+        fn = _shard_map(lambda t, p, w: local(t, p, w, None, None), mesh=mesh,
+                        in_specs=(shard, shard, shard),
+                        out_specs=out_specs, check_vma=False)
+        args = (tod, pixels, weights)
+
+    with mesh:
+        return jax.jit(fn)(*args)

@@ -1,0 +1,87 @@
+"""End-to-end slice: synthetic Level-1 obs -> Level-2 TOD -> destriped map.
+
+The minimum full-pipeline program (SURVEY.md §7): generate a synthetic
+observation in the COMAP Level-1 HDF5 schema, vane-calibrate, reduce to
+Level-2, bin and destripe into a WCS map — all device math under one jit.
+
+Run:  PYTHONPATH=/root/repo:/root/.axon_site python examples/end_to_end.py
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+import numpy as np
+
+
+def main(n_feeds: int = 2, n_channels: int = 64) -> int:
+    import jax
+
+    from comapreduce_tpu.data.level import COMAPLevel1
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.ops.vane import find_vane_events
+    from comapreduce_tpu.parallel.mesh import local_mesh
+    from comapreduce_tpu.parallel.step import ObservationStep
+
+    print("devices:", jax.devices())
+
+    with tempfile.NamedTemporaryFile(suffix=".hd5") as tmp:
+        p = SyntheticObsParams(n_feeds=n_feeds, n_channels=n_channels,
+                               source_amplitude_k=0.5)
+        generate_level1_file(tmp.name, p)
+        lvl1 = COMAPLevel1()
+        lvl1.read(tmp.name)
+
+        F, B, C, T = lvl1.tod_shape
+        edges = lvl1.scan_edges
+        print(f"obs {lvl1.obsid}: shape {(F, B, C, T)}, "
+              f"{len(edges)} scans, Tvane={lvl1.vane_temperature:.1f} K")
+
+        # host-side geometry: vane window, pixels, masks
+        events = find_vane_events(lvl1.vane_flag)
+        vs, ve = int(events[0, 0]), int(events[0, 1]) + 50
+        wcs = WCS.from_field((p.ra0, p.dec0), (1.0 / 60, 1.0 / 60),
+                             (120, 120))
+        ra, dec = np.asarray(lvl1.ra), np.asarray(lvl1.dec)
+        pixels = np.asarray(wcs.ang2pix(ra, dec), np.int32)  # (F, T)
+
+        tod = np.stack([lvl1.read_tod_feed(i) for i in range(F)])
+        scan_mask = np.zeros(T, np.float32)
+        for s, e in edges:
+            scan_mask[s:e] = 1.0
+        mask = np.broadcast_to(scan_mask, (F, B, C, T)).astype(np.float32)
+        freq = lvl1.frequency
+        nu0 = freq.mean()
+        freq_scaled = ((freq - nu0) / nu0).astype(np.float32)
+
+        step = ObservationStep(
+            local_mesh(), scan_edges=edges, n_samples=T, npix=wcs.npix,
+            offset_length=50, n_iter=50, n_channels=C, medfilt_window=501,
+            vane_temperature=lvl1.vane_temperature)
+        level2, result = step(
+            tod=tod.astype(np.float32), mask=mask,
+            vane_tod=tod[..., vs:ve].astype(np.float32),
+            airmass=np.asarray(lvl1.airmass, np.float32),
+            pixels=pixels, freq_scaled=freq_scaled)
+        jax.block_until_ready(result.destriped_map)
+
+        m = np.asarray(result.destriped_map)
+        hits = np.asarray(result.hit_map)
+        peak = float(np.nanmax(np.where(hits > 0, m, -np.inf)))
+        print(f"level2 tod: {np.asarray(level2['tod']).shape}, "
+              f"cg iters: {int(result.n_iter)}, "
+              f"residual: {float(result.residual):.2e}")
+        print(f"map: {int((hits > 0).sum())}/{wcs.npix} px hit, "
+              f"peak {peak * 1e3:.1f} mK "
+              f"(injected {p.source_amplitude_k * 1e3:.0f} mK source)")
+        ok = (np.isfinite(m).all() and int(result.n_iter) > 0
+              and peak > 0.2 * p.source_amplitude_k)
+        print("OK" if ok else "FAIL")
+        return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,8 +8,38 @@ semantics). This must run before jax is imported anywhere.
 import os
 import sys
 
-# Force CPU: the ambient environment sets JAX_PLATFORMS=axon (the tunnelled
-# TPU). Tests must not depend on — or wedge — the shared TPU relay.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The ambient environment registers the axon (tunnelled TPU) PJRT plugin in
+# EVERY python process via /root/.axon_site sitecustomize + remote-compile
+# env vars; once registered, even CPU jits route through the remote-compile
+# relay and hang when it is busy/unavailable. The registration happens at
+# interpreter start — before pytest imports this file — so the only reliable
+# neutralisation is to re-exec pytest once with a scrubbed environment.
+# The exec lives in pytest_configure (below) so capture can be suspended
+# first — execve from module import time would inherit pytest's captured
+# stdout/stderr fds and the re-exec'd run's output would vanish.
+_NEEDS_REEXEC = (os.environ.get("PALLAS_AXON_POOL_IPS")
+                 and os.environ.get("_COMAP_TESTS_REEXEC") != "1")
+
+
+def pytest_configure(config):
+    if not _NEEDS_REEXEC:
+        return
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    env = dict(os.environ)
+    env["_COMAP_TESTS_REEXEC"] = "1"
+    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = _REPO  # drop /root/.axon_site
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
+
+# Force CPU with a virtual 8-device platform: multi-chip TPU hardware is not
+# available in CI; sharding/collective tests run on a virtual CPU mesh
+# instead (same XLA partitioner, same SPMD semantics).
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -17,12 +47,7 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# If the axon PJRT plugin is registered (via /root/.axon_site sitecustomize),
-# even CPU compiles are routed to the remote-compile relay; when that relay
-# is unavailable every jit hangs. Tests should therefore run with
-# `env PYTHONPATH= python -m pytest tests/` so the plugin never registers.
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
