@@ -1,0 +1,58 @@
+"""Spike detection: median-filter high-pass + thresholded run dilation.
+
+Parity target: ``Analysis/Statistics.py:30-104`` (``Spikes``) — high-pass
+the averaged TOD with a rolling median, flag samples beyond
+``threshold * auto_rms``, and pad each flagged run by ±``pad`` samples.
+The reference dilates with a Python loop over flagged indices; here the
+dilation is a max-pool (``lax.reduce_window``) so the whole (F, B, T) cube
+is one jitted kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from comapreduce_tpu.ops.median_filter import rolling_median
+from comapreduce_tpu.ops.stats import auto_rms
+
+__all__ = ["dilate_mask", "spike_mask"]
+
+DEFAULT_WINDOW = 501
+DEFAULT_THRESHOLD = 10.0  # Statistics.py: |tod| > 10 * rms
+DEFAULT_PAD = 100         # ±100-sample padding around each spike run
+
+
+@functools.partial(jax.jit, static_argnames=("pad",))
+def dilate_mask(mask: jax.Array, pad: int) -> jax.Array:
+    """Dilate a boolean/0-1 mask by ±``pad`` samples along the last axis."""
+    if pad <= 0:
+        return mask
+    m = mask.astype(jnp.float32)
+    flat = m.reshape((-1, m.shape[-1]))
+    out = lax.reduce_window(flat, -jnp.inf, lax.max,
+                            window_dimensions=(1, 2 * pad + 1),
+                            window_strides=(1, 1), padding="SAME")
+    return (out > 0).reshape(mask.shape)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("window", "pad"))
+def spike_mask(tod: jax.Array, window: int = DEFAULT_WINDOW,
+               threshold: float = DEFAULT_THRESHOLD, pad: int = DEFAULT_PAD,
+               valid: jax.Array | None = None) -> jax.Array:
+    """Boolean spike mask (True = spike) for ``tod`` f32[..., T].
+
+    ``valid``: optional f32[..., T]; invalid samples never flag. The rms is
+    the adjacent-pair ``auto_rms`` of the high-passed stream, so slow drifts
+    don't inflate the threshold.
+    """
+    hp = tod - rolling_median(tod, window)
+    rms = auto_rms(hp, valid)[..., None]
+    hits = jnp.abs(hp) > threshold * jnp.maximum(rms, 1e-30)
+    if valid is not None:
+        hits = hits & (valid > 0)
+    return dilate_mask(hits, pad)

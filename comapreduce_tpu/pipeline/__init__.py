@@ -1,0 +1,25 @@
+"""Pipeline runtime: config, stage registry, and the per-file runner.
+
+TPU-native counterpart of the reference's three config mechanisms and
+executor (``Analysis/Running.py``, ``Tools/Parser.py``, ``Tools/
+ParserClass.py``, ``run_average.py`` — SURVEY.md §2.1/§5):
+
+- :mod:`config` — TOML loading plus a legacy-INI parser with the same
+  coercion rules and ``Module.Class(variant)`` section semantics;
+- :mod:`registry` — the name-based stage registry with a per-stage
+  ``backend`` switch (``tpu`` | ``numpy``);
+- :mod:`stages` — the pipeline stages (``PipelineFunction`` contract);
+- :mod:`runner` — the ``Runner``: per-file loop, ``contains``/``overwrite``
+  resume against the Level-2 checkpoint file, falsy-``STATE`` abort,
+  per-stage timing and logging.
+"""
+
+from comapreduce_tpu.pipeline.config import (IniConfig, load_toml,
+                                             parse_stage_name)
+from comapreduce_tpu.pipeline.registry import (available_stages, register,
+                                               resolve)
+from comapreduce_tpu.pipeline.runner import Runner, set_logging
+from comapreduce_tpu.pipeline import stages  # noqa: F401  (registers stages)
+
+__all__ = ["IniConfig", "load_toml", "parse_stage_name", "register",
+           "resolve", "available_stages", "Runner", "set_logging", "stages"]

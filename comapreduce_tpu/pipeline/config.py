@@ -1,0 +1,123 @@
+"""Configuration loading: TOML (current) and legacy INI (ParserClass).
+
+The reference drives the current pipeline from a TOML file
+(``run_average.py:104-106``) and the legacy pipeline from hand-rolled INI
+files parsed by ``Tools/ParserClass.py:4-101`` (``:``/``=`` delimiters,
+automatic bool/int/float/list coercion) with ``Module.Class(variant)``
+section names enabling multiple configurations of one stage class
+(``ClassParameters.ini:110``, ``Tools/Parser.py:26-41``). Both mechanisms
+are supported here; both feed the same registry (:mod:`registry`).
+"""
+
+from __future__ import annotations
+
+import re
+import tomllib
+
+__all__ = ["load_toml", "IniConfig", "parse_stage_name", "coerce"]
+
+_STAGE_NAME_RE = re.compile(
+    r"^(?:(?P<module>[A-Za-z_]\w*)\.)?(?P<cls>[A-Za-z_]\w*)"
+    r"(?:\((?P<variant>[^)]*)\))?$")
+
+
+def load_toml(path: str) -> dict:
+    """Load a TOML pipeline configuration (``run_average.py:104``)."""
+    with open(path, "rb") as f:
+        return tomllib.load(f)
+
+
+def parse_stage_name(name: str):
+    """Split ``"Module.Class(variant)"`` into ``(module, cls, variant)``.
+
+    ``module`` and ``variant`` may be ``None``; bare class names are allowed
+    (the TOML path uses bare names, ``run_average.py:44-46``). Raises
+    ``ValueError`` on malformed names — the reference's ``getClass`` would
+    crash opaquely instead (``Tools/Parser.py:26-41``).
+    """
+    m = _STAGE_NAME_RE.match(name.strip())
+    if not m:
+        raise ValueError(f"malformed stage name: {name!r}")
+    return m.group("module"), m.group("cls"), m.group("variant")
+
+
+def coerce(value: str):
+    """Coerce an INI value string the way ``ParserClass.ReadLines`` does:
+    bools, ints, floats, comma lists (recursively coerced), else str."""
+    s = value.strip()
+    if "," in s:
+        items = [coerce(v) for v in s.split(",") if v.strip() != ""]
+        return items
+    low = s.lower()
+    if low in ("true", "yes"):
+        return True
+    if low in ("false", "no"):
+        return False
+    if low in ("none", ""):
+        return None
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+class IniConfig(dict):
+    """Nested-dict INI parser with the legacy coercion rules.
+
+    ``IniConfig(filename)`` or ``IniConfig.from_text(text)``. Sections map to
+    dicts; ``key : value`` and ``key = value`` are both accepted; ``#`` and
+    ``;`` start comments. Unlike the reference parser this one keeps the
+    raw section-name string as the key (including ``Class(variant)``),
+    which :func:`parse_stage_name` decodes.
+    """
+
+    def __init__(self, filename: str | None = None):
+        super().__init__()
+        if filename is not None:
+            with open(filename) as f:
+                self._parse(f.read())
+
+    @classmethod
+    def from_text(cls, text: str) -> "IniConfig":
+        cfg = cls()
+        cfg._parse(text)
+        return cfg
+
+    def _parse(self, text: str) -> None:
+        section = None
+        for raw in text.splitlines():
+            line = raw.split("#", 1)[0].split(";", 1)[0].strip()
+            if not line:
+                continue
+            if line.startswith("[") and line.endswith("]"):
+                section = line[1:-1].strip()
+                self.setdefault(section, {})
+                continue
+            delim = None
+            for d in (":", "="):
+                if d in line:
+                    delim = d
+                    break
+            if delim is None:
+                continue
+            key, value = line.split(delim, 1)
+            target = self.setdefault(section, {}) if section else self
+            target[key.strip()] = coerce(value)
+
+    def pipeline_jobs(self) -> list[tuple[str, dict]]:
+        """Legacy job list: the ``[Inputs] pipeline`` stage names, each with
+        its own section's kwargs (``Tools/Parser.py:44-96``)."""
+        inputs = self.get("Inputs", {})
+        pipeline = inputs.get("pipeline", [])
+        if isinstance(pipeline, str):
+            pipeline = [pipeline]
+        jobs = []
+        for name in pipeline:
+            kwargs = dict(self.get(name, {}))
+            jobs.append((name, kwargs))
+        return jobs

@@ -1,0 +1,67 @@
+"""Name-based pipeline-stage registry with a per-stage backend switch.
+
+The reference resolves stage names two ways: ``getattr(Analysis, name)``
+for the TOML path (``run_average.py:44-46``) and the dynamic
+``Module.Class(variant)`` import for the legacy path
+(``Tools/Parser.py:26-41``). Here both feed one explicit registry, and a
+stage may register distinct implementations per *backend* (``tpu`` — the
+JAX device path — and ``numpy`` — the host oracle used for parity tests
+and tiny jobs). ``resolve(name, backend=...)`` falls back to the other
+backend when a stage has only one implementation.
+"""
+
+from __future__ import annotations
+
+from comapreduce_tpu.pipeline.config import parse_stage_name
+
+__all__ = ["register", "resolve", "available_stages", "DEFAULT_BACKEND",
+           "KNOWN_BACKENDS"]
+
+DEFAULT_BACKEND = "tpu"
+KNOWN_BACKENDS = ("tpu", "numpy")
+
+# {class_name: {backend: stage_class}}
+_REGISTRY: dict[str, dict[str, type]] = {}
+
+
+def register(name: str | None = None, backend: str = DEFAULT_BACKEND):
+    """Class decorator: ``@register()`` or ``@register("Name", "numpy")``."""
+
+    def wrap(cls):
+        key = name or cls.__name__
+        _REGISTRY.setdefault(key, {})[backend] = cls
+        return cls
+
+    return wrap
+
+
+def resolve(name: str, backend: str | None = None, **kwargs):
+    """Instantiate stage ``name`` (may be ``Module.Class(variant)``).
+
+    ``backend`` may come from the call, from a ``backend`` key in
+    ``kwargs`` (per-stage config section), or default to ``tpu``. The
+    ``variant`` suffix is passed through as the stage's ``variant`` kwarg
+    when its class accepts one (legacy multi-config support).
+    """
+    _, cls_name, variant = parse_stage_name(name)
+    impls = _REGISTRY.get(cls_name)
+    if not impls:
+        raise KeyError(f"unknown pipeline stage: {name!r} "
+                       f"(known: {sorted(_REGISTRY)})")
+    backend = kwargs.pop("backend", None) if backend is None else backend
+    backend = backend or DEFAULT_BACKEND
+    if backend not in KNOWN_BACKENDS:
+        raise ValueError(f"unknown backend {backend!r} for stage {name!r} "
+                         f"(known: {KNOWN_BACKENDS})")
+    cls = impls.get(backend) or next(iter(impls.values()))
+    if variant is not None:
+        try:
+            return cls(variant=variant, **kwargs)
+        except TypeError:
+            pass
+    return cls(**kwargs)
+
+
+def available_stages() -> dict[str, list[str]]:
+    """Registered stage names -> list of backends."""
+    return {k: sorted(v) for k, v in sorted(_REGISTRY.items())}

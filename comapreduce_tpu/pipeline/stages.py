@@ -1,0 +1,449 @@
+"""Pipeline stages: the ``PipelineFunction`` contract over the TPU ops.
+
+Each stage is a small dataclass with the reference protocol
+(``Analysis/Running.py:51-80``): ``__call__(data, level2) -> STATE``,
+``groups`` (output HDF5 groups, drive the ``contains``/``overwrite``
+resume), and ``save_data`` (``(datasets, attributes)`` deposited into the
+Level-2 store by ``COMAPLevel2.update``). The heavy math lives in
+:mod:`comapreduce_tpu.ops`; stages do host-side orchestration only (lazy
+HDF5 reads, shape bookkeeping), so everything device-side stays jitted.
+
+Registered stages (name -> reference counterpart):
+
+- ``CheckLevel1File``      — ``Level1Averaging.py:324-356``
+- ``AssignLevel1Data``     — ``Level2Data.py:26-68``
+- ``MeasureSystemTemperature`` — ``VaneCalibration.py:21-198``
+- ``SkyDip``               — ``Level1Averaging.py:48-155``
+- ``AtmosphereRemoval``    — ``Level1Averaging.py:188-234``
+- ``Level1AveragingGainCorrection`` — ``Level1Averaging.py:499-943``
+- ``Spikes``               — ``Statistics.py:30-104``
+- ``Level2FitPowerSpectrum`` / ``NoiseStatistics``
+                           — ``Level2Data.py:246-329`` / ``Statistics.py:106-224``
+- ``WriteLevel2Data``      — ``Level2Data.py:113-139``
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from comapreduce_tpu.ops import power as power_ops
+from comapreduce_tpu.ops import vane as vane_ops
+from comapreduce_tpu.ops.atmosphere import fit_atmosphere_segments
+from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
+                                        scan_starts_lengths)
+from comapreduce_tpu.ops.spikes import spike_mask
+from comapreduce_tpu.ops.stats import auto_rms
+from comapreduce_tpu.data.scan_edges import segment_ids_from_edges
+from comapreduce_tpu.pipeline.registry import register
+
+__all__ = ["CheckLevel1File", "AssignLevel1Data", "MeasureSystemTemperature",
+           "SkyDip", "AtmosphereRemoval", "Level1AveragingGainCorrection",
+           "Spikes", "Level2FitPowerSpectrum", "NoiseStatistics",
+           "WriteLevel2Data", "mean_vane_tsys_gain"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+@dataclass
+class _StageBase:
+    """Shared stage state: outputs staged for ``COMAPLevel2.update``."""
+
+    overwrite: bool = False
+    STATE: bool = True
+    groups: tuple = ()
+    _data: dict = field(default_factory=dict, repr=False)
+    _attrs: dict = field(default_factory=dict, repr=False)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    @property
+    def save_data(self):
+        return self._data, self._attrs
+
+    def pre_init(self, data) -> None:  # hook parity (Running.py:141)
+        pass
+
+    def clear_outputs(self) -> None:
+        """Drop staged outputs; the runner calls this before each file so a
+        failing stage can never deposit the previous file's results."""
+        self._data = {}
+        self._attrs = {}
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@register()
+@dataclass
+class CheckLevel1File(_StageBase):
+    """Gate: reject too-short files and operator-flagged observations.
+
+    Parity: ``CheckLevel1File`` (``Level1Averaging.py:324-356``) — files
+    under ``min_duration_seconds`` or whose comment marks a sky dip/test
+    abort the stage chain (falsy STATE). Always runs (stateless)."""
+
+    min_duration_seconds: float = 300.0
+    bad_keywords: tuple = ("sky dip", "skydip", "sky nod", "test")
+    overwrite: bool = True
+
+    def __call__(self, data, level2) -> bool:
+        mjd = data.mjd
+        duration = float(mjd[-1] - mjd[0]) * 86400.0
+        comment = data.comment.lower()
+        bad = next((k for k in self.bad_keywords if k in comment), None)
+        self.STATE = True
+        if duration < self.min_duration_seconds:
+            logger.info("CheckLevel1File: obs %s too short (%.0f s)",
+                        data.obsid, duration)
+            self.STATE = False
+        elif bad is not None:
+            logger.info("CheckLevel1File: obs %s flagged (%r in comment)",
+                        data.obsid, bad)
+            self.STATE = False
+        return self.STATE
+
+
+@register()
+@dataclass
+class AssignLevel1Data(_StageBase):
+    """Copy pointing and metadata from Level-1 into the Level-2 store
+    (parity: ``AssignLevel1Data``, ``Level2Data.py:26-68``)."""
+
+    groups: tuple = ("spectrometer",)
+
+    def __call__(self, data, level2) -> bool:
+        self._data = {
+            "spectrometer/MJD": data.mjd,
+            "spectrometer/feeds": data.feeds,
+            "spectrometer/features": data.materialise("spectrometer/features"),
+            "spectrometer/frequency": data.frequency,
+            "spectrometer/pixel_pointing/pixel_ra": np.asarray(data.ra),
+            "spectrometer/pixel_pointing/pixel_dec": np.asarray(data.dec),
+            "spectrometer/pixel_pointing/pixel_az": np.asarray(data.az),
+            "spectrometer/pixel_pointing/pixel_el": np.asarray(data.el),
+        }
+        self._attrs = {"comap": {
+            "obsid": data.obsid,
+            "source": data.attrs("comap", "source"),
+            "comment": data.comment,
+        }}
+        self.STATE = True
+        return True
+
+
+@register()
+@dataclass
+class MeasureSystemTemperature(_StageBase):
+    """Vane calibration: per-channel system temperature and gain per vane
+    event (parity: ``VaneCalibration.py:21-198``). Writes
+    ``vane/system_temperature`` and ``vane/system_gain``, each
+    ``(n_events, F, B, C)``."""
+
+    groups: tuple = ("vane",)
+    pad: int = 50
+
+    def __call__(self, data, level2) -> bool:
+        tod = data["spectrometer/tod"]
+
+        def reader(s, e):
+            return tod[..., s:e]
+
+        tsys, gain = vane_ops.measure_system_temperature(
+            reader, data.vane_flag, data.vane_temperature, pad=self.pad)
+        if tsys is None:
+            logger.warning("MeasureSystemTemperature: obs %s has no vane "
+                           "events", data.obsid)
+            self.STATE = False
+            return False
+        self._data = {
+            "vane/system_temperature": np.asarray(tsys),
+            "vane/system_gain": np.asarray(gain),
+        }
+        self.STATE = True
+        return True
+
+
+def mean_vane_tsys_gain(level2):
+    """Event-averaged (tsys, gain), each f32[F, B, C]; zeros stay zero.
+
+    Channels a vane event failed to calibrate hold 0; averaging counts only
+    the valid events per channel (the reference indexes a single event
+    instead, ``Level1Averaging.py:592-599``)."""
+    tsys = np.asarray(level2.system_temperature, dtype=np.float32)
+    gain = np.asarray(level2.system_gain, dtype=np.float32)
+    ok_t = (tsys > 0).sum(axis=0)
+    ok_g = (gain > 0).sum(axis=0)
+    tsys_m = tsys.sum(axis=0) / np.maximum(ok_t, 1)
+    gain_m = gain.sum(axis=0) / np.maximum(ok_g, 1)
+    return tsys_m, gain_m
+
+
+@register()
+@dataclass
+class SkyDip(_StageBase):
+    """Per-channel linear fit of the TOD against airmass over the whole
+    observation (parity: ``SkyDip``, ``Level1Averaging.py:48-155``, which
+    fits the previous obsid's sky-nod; here the fit runs on the current
+    file's elevation coverage). Writes ``skydip/fits`` (F, B, 2, C):
+    [offset, slope-vs-airmass]."""
+
+    groups: tuple = ("skydip",)
+
+    def __call__(self, data, level2) -> bool:
+        import jax.numpy as jnp
+
+        F = int(data.tod_shape[0])
+        on = ~np.asarray(data.vane_flag)
+        fits = []
+        for ifeed in range(F):
+            tod = data.read_tod_feed(ifeed).astype(np.float32)  # (B, C, T)
+            airmass = np.asarray(data.airmass)[ifeed].astype(np.float32)
+            mask = (np.isfinite(tod) & on[None, None, :]).astype(np.float32)
+            seg = np.zeros(tod.shape[-1], np.int32)
+            seg[~on] = -1
+            off, slope = fit_atmosphere_segments(
+                jnp.asarray(np.nan_to_num(tod)), jnp.asarray(airmass),
+                jnp.asarray(seg), jnp.asarray(mask), n_scans=1)
+            fits.append(np.stack([np.asarray(off)[..., 0],
+                                  np.asarray(slope)[..., 0]], axis=-2))
+        self._data = {"skydip/fits": np.stack(fits)}  # (F, B, 2, C)
+        self.STATE = True
+        return True
+
+
+@register()
+@dataclass
+class AtmosphereRemoval(_StageBase):
+    """Per-(scan, feed, band, channel) regression of the TOD against
+    airmass; stores coefficients only (subtraction happens in the
+    reduction). Parity: ``AtmosphereRemoval`` (``Level1Averaging.py:
+    188-234``), which stores ``atmosphere/fit_values`` (S, F, B, 2, C)."""
+
+    groups: tuple = ("atmosphere",)
+
+    def __call__(self, data, level2) -> bool:
+        import jax.numpy as jnp
+
+        edges = data.scan_edges
+        if len(edges) == 0:
+            logger.warning("AtmosphereRemoval: obs %s has no scans",
+                           data.obsid)
+            self.STATE = False
+            return False
+        S = len(edges)
+        T = int(data.tod_shape[-1])
+        seg = segment_ids_from_edges(edges, T).astype(np.int32)
+        F = int(data.tod_shape[0])
+        out = []
+        for ifeed in range(F):
+            tod = data.read_tod_feed(ifeed).astype(np.float32)
+            airmass = np.asarray(data.airmass)[ifeed].astype(np.float32)
+            mask = np.isfinite(tod).astype(np.float32)
+            off, atm = fit_atmosphere_segments(
+                jnp.asarray(np.nan_to_num(tod)), jnp.asarray(airmass),
+                jnp.asarray(seg), jnp.asarray(mask), n_scans=S)
+            # (B, C, S) -> (S, B, 2, C)
+            fit = np.stack([np.asarray(off), np.asarray(atm)], axis=0)
+            out.append(np.transpose(fit, (3, 1, 0, 2)))
+        self._data = {"atmosphere/fit_values":
+                      np.stack(out, axis=1)}  # (S, F, B, 2, C)
+        self.STATE = True
+        return True
+
+
+@register()
+@dataclass
+class Level1AveragingGainCorrection(_StageBase):
+    """The flagship reduction: Level-1 -> Level-2 averaged TOD.
+
+    Per feed (lazy HDF5 read), one jitted program
+    (:func:`~comapreduce_tpu.ops.reduce.reduce_feed_scans`): NaN fill,
+    atmosphere subtraction, radiometer normalisation, median-filter
+    high-pass, gain-fluctuation solve, Tsys-weighted band average.
+    Parity: ``Level1AveragingGainCorrection.average_tod``
+    (``Level1Averaging.py:792-872``). Writes ``averaged_tod/{tod,
+    tod_original, weights, scan_edges}``."""
+
+    groups: tuple = ("averaged_tod",)
+    medfilt_window: int = 6000
+    pad_to: int = 128
+
+    def __call__(self, data, level2) -> bool:
+        edges = np.asarray(data.scan_edges)
+        if len(edges) == 0:
+            logger.warning("Level1AveragingGainCorrection: obs %s has no "
+                           "scans", data.obsid)
+            self.STATE = False
+            return False
+        try:
+            tsys, sys_gain = mean_vane_tsys_gain(level2)
+        except KeyError:
+            logger.warning("Level1AveragingGainCorrection: obs %s has no "
+                           "vane calibration", data.obsid)
+            self.STATE = False
+            return False
+
+        F, B, C, T = data.tod_shape
+        starts, lengths, L = scan_starts_lengths(edges, pad_to=self.pad_to)
+        cfg = ReduceConfig(C, medfilt_window=min(self.medfilt_window, L),
+                           is_calibrator=data.is_calibrator)
+        freq = data.frequency.astype(np.float32)  # (B, C) GHz
+        f0 = freq.mean(axis=1, keepdims=True)
+        freq_scaled = ((freq - f0) / f0).astype(np.float32)
+
+        tod_out = np.zeros((F, B, T), np.float32)
+        orig_out = np.zeros((F, B, T), np.float32)
+        wei_out = np.zeros((F, B, T), np.float32)
+        starts_j = starts.astype(np.int32)
+        lengths_j = lengths.astype(np.int32)
+        for ifeed in range(F):
+            raw = data.read_tod_feed(ifeed).astype(np.float32)
+            mask = np.isfinite(raw).astype(np.float32)
+            airmass = np.asarray(data.airmass)[ifeed].astype(np.float32)
+            res = reduce_feed_scans(
+                np.nan_to_num(raw), mask, airmass, starts_j, lengths_j,
+                tsys[ifeed], sys_gain[ifeed], freq_scaled,
+                cfg=cfg, n_scans=len(starts), L=L)
+            tod_out[ifeed] = np.asarray(res["tod"])
+            orig_out[ifeed] = np.asarray(res["tod_original"])
+            wei_out[ifeed] = np.asarray(res["weights"])
+        self._data = {
+            "averaged_tod/tod": tod_out,
+            "averaged_tod/tod_original": orig_out,
+            "averaged_tod/weights": wei_out,
+            "averaged_tod/scan_edges": edges,
+        }
+        self.STATE = True
+        return True
+
+
+@register()
+@dataclass
+class Spikes(_StageBase):
+    """Spike flagging of the averaged TOD -> ``spikes/spike_mask``
+    (F, B, T) uint8 (parity: ``Statistics.py:30-104``)."""
+
+    groups: tuple = ("spikes",)
+    window: int = 501
+    threshold: float = 10.0
+    pad: int = 100
+
+    def __call__(self, data, level2) -> bool:
+        tod = np.asarray(level2.tod, dtype=np.float32)
+        valid = (tod != 0).astype(np.float32)
+        T = tod.shape[-1]
+        mask = spike_mask(tod, window=min(self.window, max(3, T // 2 * 2 - 1)),
+                          threshold=self.threshold, pad=self.pad, valid=valid)
+        self._data = {"spikes/spike_mask":
+                      np.asarray(mask).astype(np.uint8)}
+        self.STATE = True
+        return True
+
+
+@register()
+@dataclass
+class Level2FitPowerSpectrum(_StageBase):
+    """Per-(feed, band, scan) noise power-spectrum fit of the averaged TOD.
+
+    Red-noise model ``sigma_w^2 + sigma_r^2 |nu|^alpha``
+    (``Level2Data.py:246-329``). Scans are truncated to the shortest scan
+    (static FFT length — one compiled kernel for the whole cube). Writes
+    ``fnoise_fits/{fnoise_fit_parameters (F,B,S,3), auto_rms (F,B,S)}``."""
+
+    groups: tuple = ("fnoise_fits",)
+    nbins: int = 30
+    sample_rate: float = 50.0
+    model_name: str = "red_noise"
+    out_group: str = "fnoise_fits"
+
+    def __call__(self, data, level2) -> bool:
+        import jax.numpy as jnp
+
+        tod = np.asarray(level2.tod, dtype=np.float32)  # (F, B, T)
+        edges = np.asarray(level2.scan_edges)
+        if len(edges) == 0:
+            self.STATE = False
+            return False
+        Lmin = int((edges[:, 1] - edges[:, 0]).min()) // 2 * 2
+        if Lmin < 16:
+            self.STATE = False
+            return False
+        F, B = tod.shape[:2]
+        S = len(edges)
+        blocks = np.stack([tod[..., s:s + Lmin] for s, _ in edges],
+                          axis=2)  # (F, B, S, Lmin)
+        model = (power_ops.red_noise_model if self.model_name == "red_noise"
+                 else power_ops.knee_model)
+        freqs, ps = power_ops.psd(jnp.asarray(blocks), self.sample_rate)
+        nu, pb, cnt = power_ops.log_bin_psd(freqs, ps, nbins=self.nbins)
+        pb_flat = np.asarray(pb).reshape(-1, self.nbins)
+        nu_np = np.asarray(nu)
+        good_hi = nu_np > 0.5 * nu_np.max()
+        sig2 = np.maximum(pb_flat[:, good_hi].mean(axis=1), 1e-20)
+        p_low = np.maximum(pb_flat[:, 1], sig2 * 1.01)
+        nu_low = max(nu_np[1], 1e-3)
+        alpha0 = -1.5
+        if self.model_name == "red_noise":
+            # second parameter is the red-noise power amplitude sigma_r^2
+            red2 = (p_low - sig2) * nu_low ** (-alpha0)
+            p1 = np.maximum(red2, sig2 * 1e-3)
+        else:
+            # knee model: second parameter is fknee [Hz] — the frequency
+            # where the 1/f power equals the white level:
+            # p_low/sig2 - 1 = (nu_low/fknee)^alpha0
+            excess = np.maximum(p_low / sig2 - 1.0, 1e-3)
+            p1 = np.clip(nu_low * excess ** (-1.0 / alpha0),
+                         nu_low, 0.5 * self.sample_rate)
+        p0 = np.stack([sig2, p1, np.full_like(sig2, alpha0)], axis=-1)
+
+        fit = jax.vmap(lambda pbr, p0r: power_ops.fit_noise_model(
+            nu, pbr, cnt, p0r, model=model))(jnp.asarray(pb_flat),
+                                             jnp.asarray(p0))
+        params = np.asarray(fit).reshape(F, B, S, 3)
+        rms = np.asarray(auto_rms(jnp.asarray(blocks)))  # (F, B, S)
+        self._data = {
+            f"{self.out_group}/fnoise_fit_parameters": params,
+            f"{self.out_group}/auto_rms": rms,
+        }
+        self.STATE = True
+        return True
+
+
+@register()
+@dataclass
+class NoiseStatistics(Level2FitPowerSpectrum):
+    """Knee-model variant writing ``noise_statistics/fnoise``
+    (parity: ``Statistics.py:106-224``)."""
+
+    groups: tuple = ("noise_statistics",)
+    model_name: str = "knee"
+    out_group: str = "noise_statistics"
+
+
+@register()
+@dataclass
+class WriteLevel2Data(_StageBase):
+    """Write the Level-2 store to its target file (parity:
+    ``WriteLevel2Data``, ``Level2Data.py:113-139``). The runner already
+    checkpoints after every stage; this stage exists for chain parity and
+    for explicit final placement via ``output_dir``."""
+
+    overwrite: bool = True
+    output_dir: str = ""
+
+    def __call__(self, data, level2) -> bool:
+        path = level2.filename
+        if self.output_dir:
+            os.makedirs(self.output_dir, exist_ok=True)
+            path = os.path.join(self.output_dir, os.path.basename(path))
+            level2.filename = path
+        level2.write(path)
+        self.STATE = True
+        return True
