@@ -1,0 +1,30 @@
+"""Astrometry: the framework's SLALIB-equivalent (host-side).
+
+The reference drives all pointing through vendored Fortran SLALIB
+(``Tools/sla.f`` + ``Tools/pysla.f90`` f2py wrappers) called from
+``Tools/Coordinates.py``. Here the same capability is a small astrometry
+library with two interchangeable backends:
+
+- :mod:`core` — vectorised NumPy (always available; the parity oracle);
+- the native C++ library in ``csrc/astrometry.cpp`` loaded through
+  :mod:`native` (ctypes), built on demand with ``g++`` — the production
+  path for long pointing streams.
+
+High-level COMAP-specific API (site constants, calibrator catalogue,
+apparent-place chains, relative-coordinate rotations) is in
+:mod:`coordinates`. Pointing is precomputed per observation on host
+(the reference already 50x-downsamples + interpolates,
+``Tools/Coordinates.py:302-304``), so none of this is a device hot loop.
+"""
+
+from comapreduce_tpu.astro import core  # noqa: F401
+from comapreduce_tpu.astro.coordinates import (COMAP_LATITUDE,
+                                               COMAP_LONGITUDE,
+                                               CALIBRATORS, e2g, g2e,
+                                               e2h_full, h2e_full, pa,
+                                               precess, rotate, unrotate,
+                                               sex2deg, source_position)
+
+__all__ = ["core", "COMAP_LONGITUDE", "COMAP_LATITUDE", "CALIBRATORS",
+           "h2e_full", "e2h_full", "precess", "pa", "e2g", "g2e",
+           "rotate", "unrotate", "sex2deg", "source_position"]

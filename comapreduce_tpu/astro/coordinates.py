@@ -1,0 +1,255 @@
+"""COMAP-level coordinate API (degrees in, degrees out).
+
+Re-design of the reference ``Tools/Coordinates.py``: observatory site,
+calibrator catalogue, apparent-place chains ``h2e_full``/``e2h_full``
+(``Tools/Coordinates.py:279-342``, which 50x-downsamples + interpolates —
+kept here as ``downsample_factor``), precession, parallactic angle,
+galactic conversion, planet ephemerides and the source-relative rotation
+used by the calibrator fitting (``Rotate``/``UnRotate``,
+``Coordinates.py:77-116``).
+
+Backend: :mod:`comapreduce_tpu.astro.native` (C++ via ctypes) when the
+shared library is available, :mod:`comapreduce_tpu.astro.core` (NumPy)
+otherwise. Both are exact peers; tests assert parity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.astro import core
+
+__all__ = ["COMAP_LONGITUDE", "COMAP_LATITUDE", "CALIBRATORS", "sex2deg",
+           "h2e_full", "e2h_full", "precess", "pa", "e2g", "g2e",
+           "rotate", "unrotate", "source_position", "planet_distance_au"]
+
+# OVRO 10.4-m site (reference Tools/Coordinates.py:16-17).
+COMAP_LONGITUDE = -118.2941  # deg east
+COMAP_LATITUDE = 37.2314     # deg
+
+# J2000 positions of the point-source calibrators
+# (reference Tools/Coordinates.py:7-15 CalibratorList).
+CALIBRATORS = {
+    "TauA": (83.6331, 22.0145),
+    "CasA": (350.8500, 58.8150),
+    "CygA": (299.8682, 40.7339),
+}
+
+_PLANET_NAMES = ("sun", "moon", "mercury", "venus", "mars", "jupiter",
+                 "saturn", "uranus", "neptune")
+
+
+def sex2deg(text: str, hours: bool = False) -> float:
+    """``'dd:mm:ss.s'`` (or ``'hh:mm:ss.s'``) -> degrees
+    (``Coordinates.py sex2deg`` role)."""
+    parts = [float(p) for p in str(text).split(":")]
+    while len(parts) < 3:
+        parts.append(0.0)
+    sign = -1.0 if str(text).strip().startswith("-") else 1.0
+    deg = abs(parts[0]) + parts[1] / 60.0 + parts[2] / 3600.0
+    deg *= sign
+    return deg * 15.0 if hours else deg
+
+
+def _slow_terms(mjd, longitude, dut1, downsample_factor):
+    """The expensive, slowly-varying pieces of the apparent-place chain —
+    local apparent sidereal time, the combined nutation@precession matrix,
+    and the aberration velocity — evaluated on a ``downsample_factor``-
+    subsampled time grid and linearly interpolated back (the reference
+    computes the whole transform 50x-downsampled and interpolates the
+    output angles, ``Coordinates.py:302-304``; interpolating the *slow
+    terms* instead keeps the fast az/el spherical trig exact per sample).
+    """
+    mjd = np.atleast_1d(np.asarray(mjd, np.float64))
+    n = mjd.size
+    f = max(int(downsample_factor), 1)
+    if f <= 1 or n <= 2 * f:
+        sub = np.arange(n)
+    else:
+        sub = np.unique(np.r_[np.arange(0, n, f), n - 1])
+    t_sub = mjd.ravel()[sub]
+    lst_sub = np.unwrap(core.last(t_sub, np.radians(longitude), dut1))
+    m_sub = core.nutation_matrix(t_sub) @ core.precession_matrix(t_sub)
+    beta_sub = core._earth_velocity(t_sub) / core._C_AU_PER_DAY
+    if len(sub) == n:
+        return lst_sub, m_sub, beta_sub
+    x = np.arange(n, dtype=np.float64)
+    lst = np.interp(x, x[sub], lst_sub)
+    m = np.empty((n, 3, 3))
+    beta = np.empty((n, 3))
+    for i in range(3):
+        beta[:, i] = np.interp(x, x[sub], beta_sub[:, i])
+        for j in range(3):
+            m[:, i, j] = np.interp(x, x[sub], m_sub[:, i, j])
+    return lst, m, beta
+
+
+def h2e_full(az_deg, el_deg, mjd, longitude: float = COMAP_LONGITUDE,
+             latitude: float = COMAP_LATITUDE, dut1: float = 0.0,
+             apply_refraction: bool = True, downsample_factor: int = 50,
+             backend: str = "auto"):
+    """Observed azimuth/elevation -> mean J2000 RA/Dec [deg].
+
+    The ``sla_oap``+``sla_amp`` chain of the reference ``h2e_full``
+    (``pysla.f90``): un-refract, horizontal -> apparent (ha, dec) at the
+    local apparent sidereal time, then apparent -> J2000. The slow terms
+    (LAST, nutation x precession, aberration) are evaluated on a
+    ``downsample_factor`` subgrid; the per-sample trig is exact.
+    ``backend``: 'auto' uses the C++ library when it loads, 'native'
+    requires it, 'numpy' forces the oracle."""
+    if backend in ("auto", "native"):
+        from comapreduce_tpu.astro import native
+        if native.available():
+            az = np.atleast_1d(np.asarray(az_deg, np.float64))
+            el = np.atleast_1d(np.asarray(el_deg, np.float64))
+            ra, dec = native.h2e_full(
+                np.radians(az.ravel()), np.radians(el.ravel()), mjd,
+                np.radians(longitude), np.radians(latitude), dut1,
+                apply_refraction, stride=max(int(downsample_factor), 1))
+            return (np.degrees(ra).reshape(az.shape) % 360.0,
+                    np.degrees(dec).reshape(az.shape))
+        if backend == "native":
+            raise RuntimeError("native astrometry library unavailable")
+    az = np.atleast_1d(np.asarray(az_deg, np.float64))
+    el = np.atleast_1d(np.asarray(el_deg, np.float64))
+    mjd_b = np.broadcast_to(np.atleast_1d(np.asarray(mjd, np.float64)),
+                            az.shape).ravel()
+    lst, m, beta = _slow_terms(mjd_b, longitude, dut1, downsample_factor)
+
+    azr, elr = np.radians(az.ravel()), np.radians(el.ravel())
+    if apply_refraction:
+        elr = elr - core.refraction_bennett(elr)
+    ha, dec = core.azel_to_hadec(azr, elr, np.radians(latitude))
+    ra_app = (lst - ha) % (2 * np.pi)
+    v = core.equatorial_to_cartesian(ra_app, dec)
+    v = core._apply(np.swapaxes(m, -1, -2), v)
+    v = v - beta
+    v = v / np.linalg.norm(v, axis=-1, keepdims=True)
+    ra, dec = core.cartesian_to_equatorial(v)
+    return (np.degrees(ra).reshape(az.shape) % 360.0,
+            np.degrees(dec).reshape(az.shape))
+
+
+def e2h_full(ra_deg, dec_deg, mjd, longitude: float = COMAP_LONGITUDE,
+             latitude: float = COMAP_LATITUDE, dut1: float = 0.0,
+             apply_refraction: bool = True, downsample_factor: int = 50,
+             backend: str = "auto"):
+    """Mean J2000 RA/Dec -> observed azimuth/elevation [deg]
+    (``sla_map``+``sla_aop`` chain of the reference ``e2h_full``)."""
+    if backend in ("auto", "native"):
+        from comapreduce_tpu.astro import native
+        if native.available():
+            ra = np.atleast_1d(np.asarray(ra_deg, np.float64))
+            dec = np.atleast_1d(np.asarray(dec_deg, np.float64))
+            az, el = native.e2h_full(
+                np.radians(ra.ravel()), np.radians(dec.ravel()), mjd,
+                np.radians(longitude), np.radians(latitude), dut1,
+                apply_refraction)
+            return (np.degrees(az).reshape(ra.shape) % 360.0,
+                    np.degrees(el).reshape(ra.shape))
+        if backend == "native":
+            raise RuntimeError("native astrometry library unavailable")
+    ra = np.atleast_1d(np.asarray(ra_deg, np.float64))
+    dec = np.atleast_1d(np.asarray(dec_deg, np.float64))
+    mjd_b = np.broadcast_to(np.atleast_1d(np.asarray(mjd, np.float64)),
+                            ra.shape).ravel()
+    lst, m, beta = _slow_terms(mjd_b, longitude, dut1, downsample_factor)
+
+    v = core.equatorial_to_cartesian(np.radians(ra.ravel()),
+                                     np.radians(dec.ravel()))
+    v = v + beta
+    v = v / np.linalg.norm(v, axis=-1, keepdims=True)
+    v = core._apply(m, v)
+    ra_app, dec_app = core.cartesian_to_equatorial(v)
+    ha = (lst - ra_app + np.pi) % (2 * np.pi) - np.pi
+    az, el = core.hadec_to_azel(ha, dec_app, np.radians(latitude))
+    if apply_refraction:
+        el = el + core.refraction_bennett(el)
+    return (np.degrees(az).reshape(ra.shape) % 360.0,
+            np.degrees(el).reshape(ra.shape))
+
+
+def precess(ra_deg, dec_deg, mjd, reverse: bool = False):
+    """J2000 <-> mean-of-date precession [deg] (``sla_preces`` role)."""
+    v = core.equatorial_to_cartesian(np.radians(ra_deg), np.radians(dec_deg))
+    m = core.precession_matrix(mjd)
+    if reverse:
+        m = np.swapaxes(m, -1, -2)
+    ra, dec = core.cartesian_to_equatorial(core._apply(m, v))
+    return np.degrees(ra) % 360.0, np.degrees(dec)
+
+
+def pa(ra_deg, dec_deg, mjd, longitude: float = COMAP_LONGITUDE,
+       latitude: float = COMAP_LATITUDE) -> np.ndarray:
+    """Parallactic angle [deg] of a J2000 position at time ``mjd``
+    (``Coordinates.py pa`` role)."""
+    lst = core.last(np.asarray(mjd, np.float64), np.radians(longitude))
+    ha = lst - np.radians(np.asarray(ra_deg, np.float64))
+    return np.degrees(core.parallactic_angle(
+        ha, np.radians(np.asarray(dec_deg, np.float64)),
+        np.radians(latitude)))
+
+
+def e2g(ra_deg, dec_deg):
+    """J2000 -> galactic [deg] (``Coordinates.py e2g``)."""
+    gl, gb = core.equ_to_gal(np.radians(ra_deg), np.radians(dec_deg))
+    return np.degrees(gl) % 360.0, np.degrees(gb)
+
+
+def g2e(gl_deg, gb_deg):
+    ra, dec = core.gal_to_equ(np.radians(gl_deg), np.radians(gb_deg))
+    return np.degrees(ra) % 360.0, np.degrees(dec)
+
+
+def _relative_matrix(lon0_deg: float, lat0_deg: float, angle_deg: float):
+    return (core._rx(np.radians(angle_deg))
+            @ core._ry(-np.radians(lat0_deg))
+            @ core._rz(np.radians(lon0_deg)))
+
+
+def rotate(lon_deg, lat_deg, lon0_deg, lat0_deg, angle_deg=0.0):
+    """Source-relative coordinates: rotate so (lon0, lat0) is the origin,
+    then roll by ``angle_deg`` (parallactic-angle rotation of the
+    calibrator maps). Returns (dlon, dlat) [deg], dlon in (-180, 180].
+    Parity: ``Coordinates.Rotate`` (``Coordinates.py:77-116``)."""
+    v = core.equatorial_to_cartesian(np.radians(lon_deg),
+                                     np.radians(lat_deg))
+    m = _relative_matrix(lon0_deg, lat0_deg, angle_deg)
+    dlon, dlat = core.cartesian_to_equatorial(core._apply(m, v))
+    dlon = np.degrees(dlon)
+    dlon = (dlon + 180.0) % 360.0 - 180.0
+    return dlon, np.degrees(dlat)
+
+
+def unrotate(dlon_deg, dlat_deg, lon0_deg, lat0_deg, angle_deg=0.0):
+    """Inverse of :func:`rotate` (``Coordinates.UnRotate``)."""
+    v = core.equatorial_to_cartesian(np.radians(dlon_deg),
+                                     np.radians(dlat_deg))
+    m = _relative_matrix(lon0_deg, lat0_deg, angle_deg)
+    lon, lat = core.cartesian_to_equatorial(core._apply(m.T, v))
+    return np.degrees(lon) % 360.0, np.degrees(lat)
+
+
+def source_position(name: str, mjd):
+    """(ra_deg, dec_deg, distance_au) of a named source at ``mjd``.
+
+    Fixed calibrators return their catalogue J2000 position with distance
+    0; solar-system bodies come from the ephemerides
+    (``Coordinates.sourcePosition``, ``Coordinates.py:225-253``)."""
+    if name in CALIBRATORS:
+        ra, dec = CALIBRATORS[name]
+        shape = np.shape(mjd)
+        return (np.broadcast_to(ra, shape).copy() if shape else ra,
+                np.broadcast_to(dec, shape).copy() if shape else dec,
+                np.zeros(shape) if shape else 0.0)
+    lname = name.lower()
+    if lname not in _PLANET_NAMES:
+        raise KeyError(f"unknown source {name!r} (calibrators: "
+                       f"{sorted(CALIBRATORS)}; planets: {_PLANET_NAMES})")
+    ra, dec, dist = core.planet_position(lname, mjd)
+    return np.degrees(ra) % 360.0, np.degrees(dec), dist
+
+
+def planet_distance_au(name: str, mjd):
+    """Geocentric distance [AU] (Jupiter flux-model scaling input)."""
+    return source_position(name, mjd)[2]

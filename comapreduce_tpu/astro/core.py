@@ -1,0 +1,502 @@
+"""Vectorised NumPy astrometry kernels (the SLALIB-subset oracle).
+
+Everything the reference uses from SLALIB (``Tools/pysla.f90``:
+``h2e``/``e2h`` GMST chains, ``h2e_full``/``e2h_full`` apparent-place
+chains, ``precess``, ``pa``, ``e2g``/``g2e``, ``rdplan``/``planet``,
+``refro``) re-derived from the standard published algorithms:
+
+- GMST: IAU 1982 polynomial (Meeus ch. 12).
+- Precession: IAU 1976 zeta/z/theta rotation (Meeus 21.2).
+- Nutation: IAU 1980 series truncated to the 13 largest terms
+  (|dpsi| error < 0.1 arcsec — the acceptance level of the reference's
+  own round-trip test, ``pysla.f90 test_oap_aop``).
+- Annual aberration: Earth velocity by central difference of the solar
+  position (equivalent to the classical kappa formulation to < 0.01").
+- Solar position: Meeus ch. 25 low precision (~1").
+- Lunar position: truncated ELP series (Meeus ch. 47, ~0.01 deg).
+- Planets: Standish (1992) approximate Keplerian elements, 1800-2050
+  (~1 arcmin for Jupiter; the COMAP beam is 4.5 arcmin).
+- Refraction: Bennett (1982) with pressure/temperature scaling.
+
+All angles radians unless a function name says ``_deg``. Times are MJD
+(UTC); TT-UTC is applied internally where precession/nutation/ephemerides
+need it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "mjd_to_jd", "julian_centuries_tt", "gmst", "last",
+    "mean_obliquity", "nutation", "precession_matrix",
+    "equatorial_to_cartesian", "cartesian_to_equatorial",
+    "apparent_from_j2000", "j2000_from_apparent",
+    "hadec_to_azel", "azel_to_hadec", "parallactic_angle",
+    "equ_to_gal", "gal_to_equ", "refraction_bennett",
+    "sun_position", "moon_position", "planet_position", "PLANETS",
+]
+
+TT_MINUS_UTC_DAYS = 69.184 / 86400.0  # TAI-UTC(37s) + 32.184s, post-2017
+ARCSEC = np.pi / (180.0 * 3600.0)
+J2000_MJD = 51544.5
+
+
+# -- time scales ------------------------------------------------------------
+
+def mjd_to_jd(mjd):
+    return np.asarray(mjd, dtype=np.float64) + 2400000.5
+
+
+def julian_centuries_tt(mjd):
+    """Julian centuries of TT since J2000.0 from a UTC MJD."""
+    return (np.asarray(mjd, dtype=np.float64) + TT_MINUS_UTC_DAYS
+            - J2000_MJD) / 36525.0
+
+
+def gmst(mjd, dut1: float = 0.0):
+    """Greenwich mean sidereal time [rad] from UTC MJD (IAU 1982)."""
+    d = np.asarray(mjd, dtype=np.float64) + dut1 / 86400.0 - J2000_MJD
+    t = d / 36525.0
+    deg = (280.46061837 + 360.98564736629 * d
+           + 0.000387933 * t * t - t * t * t / 38710000.0)
+    return np.radians(deg % 360.0)
+
+
+def last(mjd, longitude, dut1: float = 0.0):
+    """Local apparent sidereal time [rad]; ``longitude`` rad east-positive."""
+    dpsi, _, eps = nutation(mjd)
+    return (gmst(mjd, dut1) + longitude + dpsi * np.cos(eps)) % (2 * np.pi)
+
+
+# -- precession / nutation --------------------------------------------------
+
+def mean_obliquity(mjd):
+    """Mean obliquity of the ecliptic [rad] (IAU 1980)."""
+    t = julian_centuries_tt(mjd)
+    sec = 84381.448 - 46.8150 * t - 0.00059 * t**2 + 0.001813 * t**3
+    return sec * ARCSEC
+
+
+# IAU 1980 nutation, 13 largest terms (Meeus Table 22.A).
+# Columns: D, M, M', F, Omega multipliers; psi_sin, psi_sin_t;
+# eps_cos, eps_cos_t (units 0.0001 arcsec).
+_NUTATION_TERMS = np.array([
+    [0, 0, 0, 0, 1, -171996.0, -174.2, 92025.0, 8.9],
+    [-2, 0, 0, 2, 2, -13187.0, -1.6, 5736.0, -3.1],
+    [0, 0, 0, 2, 2, -2274.0, -0.2, 977.0, -0.5],
+    [0, 0, 0, 0, 2, 2062.0, 0.2, -895.0, 0.5],
+    [0, 1, 0, 0, 0, 1426.0, -3.4, 54.0, -0.1],
+    [0, 0, 1, 0, 0, 712.0, 0.1, -7.0, 0.0],
+    [-2, 1, 0, 2, 2, -517.0, 1.2, 224.0, -0.6],
+    [0, 0, 0, 2, 1, -386.0, -0.4, 200.0, 0.0],
+    [0, 0, 1, 2, 2, -301.0, 0.0, 129.0, -0.1],
+    [-2, -1, 0, 2, 2, 217.0, -0.5, -95.0, 0.3],
+    [-2, 0, 1, 0, 0, -158.0, 0.0, 0.0, 0.0],
+    [-2, 0, 0, 2, 1, 129.0, 0.1, -70.0, 0.0],
+    [0, 0, -1, 2, 2, 123.0, 0.0, -53.0, 0.0],
+])
+
+
+def _fundamental_arguments(t):
+    """Delaunay arguments [rad] (Meeus ch. 22)."""
+    D = (297.85036 + 445267.111480 * t - 0.0019142 * t**2 + t**3 / 189474.0)
+    M = (357.52772 + 35999.050340 * t - 0.0001603 * t**2 - t**3 / 300000.0)
+    Mp = (134.96298 + 477198.867398 * t + 0.0086972 * t**2 + t**3 / 56250.0)
+    F = (93.27191 + 483202.017538 * t - 0.0036825 * t**2 + t**3 / 327270.0)
+    Om = (125.04452 - 1934.136261 * t + 0.0020708 * t**2 + t**3 / 450000.0)
+    return tuple(np.radians(np.mod(x, 360.0)) for x in (D, M, Mp, F, Om))
+
+
+def nutation(mjd):
+    """Nutation (dpsi, deps) and TRUE obliquity eps [rad]."""
+    t = np.asarray(julian_centuries_tt(mjd), dtype=np.float64)
+    D, M, Mp, F, Om = _fundamental_arguments(t)
+    args = np.stack([D, M, Mp, F, Om], axis=-1)  # (..., 5)
+    mult = _NUTATION_TERMS[:, :5]                # (13, 5)
+    phase = np.tensordot(args, mult.T, axes=([-1], [0]))  # (..., 13)
+    psi = (_NUTATION_TERMS[:, 5] + _NUTATION_TERMS[:, 6] * t[..., None])
+    eps = (_NUTATION_TERMS[:, 7] + _NUTATION_TERMS[:, 8] * t[..., None])
+    dpsi = np.sum(psi * np.sin(phase), axis=-1) * 1e-4 * ARCSEC
+    deps = np.sum(eps * np.cos(phase), axis=-1) * 1e-4 * ARCSEC
+    eps_true = mean_obliquity(mjd) + deps
+    return dpsi, deps, eps_true
+
+
+def _rx(a):
+    c, s = np.cos(a), np.sin(a)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack([np.stack([o, z, z], -1),
+                     np.stack([z, c, s], -1),
+                     np.stack([z, -s, c], -1)], -2)
+
+
+def _ry(a):
+    c, s = np.cos(a), np.sin(a)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack([np.stack([c, z, -s], -1),
+                     np.stack([z, o, z], -1),
+                     np.stack([s, z, c], -1)], -2)
+
+
+def _rz(a):
+    c, s = np.cos(a), np.sin(a)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack([np.stack([c, s, z], -1),
+                     np.stack([-s, c, z], -1),
+                     np.stack([z, z, o], -1)], -2)
+
+
+def precession_matrix(mjd):
+    """IAU 1976 precession matrix J2000 -> mean of date (Meeus 21.2).
+
+    Returns (..., 3, 3); apply to a J2000 cartesian vector.
+    """
+    t = np.asarray(julian_centuries_tt(mjd), dtype=np.float64)
+    zeta = (2306.2181 * t + 0.30188 * t**2 + 0.017998 * t**3) * ARCSEC
+    z = (2306.2181 * t + 1.09468 * t**2 + 0.018203 * t**3) * ARCSEC
+    theta = (2004.3109 * t - 0.42665 * t**2 - 0.041833 * t**3) * ARCSEC
+    return _rz(-z) @ _ry(theta) @ _rz(-zeta)
+
+
+def nutation_matrix(mjd):
+    """Nutation matrix mean-of-date -> true-of-date."""
+    dpsi, deps, eps_true = nutation(mjd)
+    eps0 = mean_obliquity(mjd)
+    return _rx(-(eps0 + deps)) @ _rz(-dpsi) @ _rx(eps0)
+
+
+# -- vectors ----------------------------------------------------------------
+
+def equatorial_to_cartesian(ra, dec):
+    ra = np.asarray(ra, dtype=np.float64)
+    dec = np.asarray(dec, dtype=np.float64)
+    return np.stack([np.cos(dec) * np.cos(ra),
+                     np.cos(dec) * np.sin(ra),
+                     np.sin(dec)], axis=-1)
+
+
+def cartesian_to_equatorial(v):
+    v = np.asarray(v, dtype=np.float64)
+    ra = np.arctan2(v[..., 1], v[..., 0]) % (2 * np.pi)
+    dec = np.arcsin(np.clip(v[..., 2] / np.linalg.norm(v, axis=-1), -1, 1))
+    return ra, dec
+
+
+def _apply(m, v):
+    return np.einsum("...ij,...j->...i", m, v)
+
+
+# -- aberration -------------------------------------------------------------
+
+_C_AU_PER_DAY = 173.144632674  # speed of light [AU/day]
+
+
+def _earth_velocity(mjd):
+    """Earth barycentric-ish velocity [AU/day] by central difference of the
+    geocentric solar position (annual aberration only, < 0.01" error)."""
+    dt = 0.05
+    r1 = _sun_vector(np.asarray(mjd, dtype=np.float64) - dt)
+    r2 = _sun_vector(np.asarray(mjd, dtype=np.float64) + dt)
+    # geocentric sun moves opposite to heliocentric earth
+    return (r2 - r1) / (2 * dt)
+
+
+def aberrate(v, mjd):
+    """Apply annual aberration to unit vector(s) ``v`` (true direction ->
+    apparent direction)."""
+    beta = _earth_velocity(mjd) / _C_AU_PER_DAY
+    out = v + beta
+    return out / np.linalg.norm(out, axis=-1, keepdims=True)
+
+
+def unaberrate(v, mjd):
+    beta = _earth_velocity(mjd) / _C_AU_PER_DAY
+    out = v - beta
+    return out / np.linalg.norm(out, axis=-1, keepdims=True)
+
+
+# -- apparent place chain ---------------------------------------------------
+
+def apparent_from_j2000(ra, dec, mjd):
+    """Mean J2000 RA/Dec -> apparent (true-of-date) RA/Dec [rad].
+
+    Chain: aberration -> precession -> nutation (the reference's
+    ``sla_map`` role; proper motion/parallax are zero for COMAP targets).
+    """
+    v = equatorial_to_cartesian(ra, dec)
+    v = aberrate(v, mjd)
+    m = nutation_matrix(mjd) @ precession_matrix(mjd)
+    return cartesian_to_equatorial(_apply(m, v))
+
+
+def j2000_from_apparent(ra, dec, mjd):
+    """Apparent RA/Dec of date -> mean J2000 (the ``sla_amp`` role)."""
+    v = equatorial_to_cartesian(ra, dec)
+    m = nutation_matrix(mjd) @ precession_matrix(mjd)
+    v = _apply(np.swapaxes(m, -1, -2), v)
+    return cartesian_to_equatorial(unaberrate(v, mjd))
+
+
+# -- horizontal <-> equatorial ----------------------------------------------
+
+def hadec_to_azel(ha, dec, lat):
+    """Hour angle/declination -> azimuth (N=0, E=90deg)/elevation [rad]."""
+    ha, dec = np.asarray(ha, np.float64), np.asarray(dec, np.float64)
+    sl, cl = np.sin(lat), np.cos(lat)
+    se = sl * np.sin(dec) + cl * np.cos(dec) * np.cos(ha)
+    el = np.arcsin(np.clip(se, -1, 1))
+    az = np.arctan2(-np.cos(dec) * np.sin(ha),
+                    np.sin(dec) * cl - np.cos(dec) * np.cos(ha) * sl)
+    return az % (2 * np.pi), el
+
+
+def azel_to_hadec(az, el, lat):
+    az, el = np.asarray(az, np.float64), np.asarray(el, np.float64)
+    sl, cl = np.sin(lat), np.cos(lat)
+    sd = sl * np.sin(el) + cl * np.cos(el) * np.cos(az)
+    dec = np.arcsin(np.clip(sd, -1, 1))
+    ha = np.arctan2(-np.cos(el) * np.sin(az),
+                    np.sin(el) * cl - np.cos(el) * np.cos(az) * sl)
+    return ha, dec
+
+
+def parallactic_angle(ha, dec, lat):
+    """Parallactic angle [rad] (the ``sla_pa`` role)."""
+    ha, dec = np.asarray(ha, np.float64), np.asarray(dec, np.float64)
+    return np.arctan2(np.sin(ha),
+                      np.tan(lat) * np.cos(dec) - np.sin(dec) * np.cos(ha))
+
+
+# -- galactic ---------------------------------------------------------------
+
+# J2000 equatorial -> galactic rotation matrix (IAU 1958 pole at J2000:
+# NGP RA 192.85948 deg, Dec 27.12825 deg, l of NCP 122.93192 deg). The
+# standard matrix (e.g. Hipparcos vol. 1 eq. 1.5.11), not re-derived.
+_EQ2GAL = np.array([
+    [-0.0548755604, -0.8734370902, -0.4838350155],
+    [0.4941094279, -0.4448296300, 0.7469822445],
+    [-0.8676661490, -0.1980763734, 0.4559837762],
+])
+# orthonormalise the 10-digit literal so round trips are exact
+_u, _, _vt = np.linalg.svd(_EQ2GAL)
+_EQ2GAL = _u @ _vt
+
+
+def equ_to_gal(ra, dec):
+    """J2000 RA/Dec -> galactic l, b [rad] (``sla_eqgal`` role)."""
+    v = equatorial_to_cartesian(ra, dec)
+    return cartesian_to_equatorial(_apply(_EQ2GAL, v))
+
+
+def gal_to_equ(gl, gb):
+    v = equatorial_to_cartesian(gl, gb)
+    return cartesian_to_equatorial(_apply(_EQ2GAL.T, v))
+
+
+# -- refraction -------------------------------------------------------------
+
+def refraction_bennett(el, pressure_mb: float = 870.0,
+                       temperature_c: float = 0.0):
+    """Atmospheric refraction [rad] to ADD to the true elevation
+    (Bennett 1982 with P/T scaling; ~1000 m site default). The reference
+    uses ``sla_refro``; at el > 30 deg (COMAP's observing range) the two
+    agree to ~1 arcsec."""
+    h = np.degrees(np.asarray(el, dtype=np.float64))
+    r_arcmin = 1.02 / np.tan(np.radians(h + 10.3 / (h + 5.11)))
+    scale = (pressure_mb / 1010.0) * (283.0 / (273.0 + temperature_c))
+    return np.radians(np.maximum(r_arcmin, 0.0) * scale / 60.0)
+
+
+# -- solar / lunar / planetary ephemerides ----------------------------------
+
+def _sun_ecliptic(mjd):
+    """Geometric solar ecliptic longitude [rad] and distance [AU]
+    (Meeus ch. 25)."""
+    t = julian_centuries_tt(mjd)
+    L0 = 280.46646 + 36000.76983 * t + 0.0003032 * t**2
+    M = np.radians((357.52911 + 35999.05029 * t - 0.0001537 * t**2) % 360.0)
+    e = 0.016708634 - 0.000042037 * t
+    C = ((1.914602 - 0.004817 * t - 0.000014 * t**2) * np.sin(M)
+         + (0.019993 - 0.000101 * t) * np.sin(2 * M)
+         + 0.000289 * np.sin(3 * M))
+    lon = np.radians((L0 + C) % 360.0)
+    nu = M + np.radians(C)
+    r = 1.000001018 * (1 - e**2) / (1 + e * np.cos(nu))
+    return lon, r
+
+
+def _sun_vector(mjd):
+    """Geocentric solar position vector [AU], mean equator/equinox of date
+    approximated with the J2000 obliquity (aberration use only)."""
+    lon, r = _sun_ecliptic(mjd)
+    eps = mean_obliquity(mjd)
+    x = r * np.cos(lon)
+    y = r * np.sin(lon) * np.cos(eps)
+    z = r * np.sin(lon) * np.sin(eps)
+    return np.stack([x, y, z], axis=-1)
+
+
+def sun_position(mjd):
+    """Apparent geocentric RA/Dec [rad] and distance [AU] of the Sun."""
+    lon, r = _sun_ecliptic(mjd)
+    t = julian_centuries_tt(mjd)
+    om = np.radians(125.04 - 1934.136 * t)
+    lam = lon - np.radians(0.00569 + 0.00478 * np.sin(om))
+    eps = mean_obliquity(mjd) + np.radians(0.00256) * np.cos(om)
+    ra = np.arctan2(np.cos(eps) * np.sin(lam), np.cos(lam)) % (2 * np.pi)
+    dec = np.arcsin(np.clip(np.sin(eps) * np.sin(lam), -1, 1))
+    return ra, dec, r
+
+
+# Truncated lunar series (Meeus ch. 47, largest terms).
+def moon_position(mjd):
+    """Geocentric apparent RA/Dec [rad] and distance [AU] of the Moon
+    (truncated ELP: ~0.01 deg, fine vs the 0.5 deg lunar disc)."""
+    t = julian_centuries_tt(mjd)
+    Lp = np.radians((218.3164477 + 481267.88123421 * t
+                     - 0.0015786 * t**2) % 360.0)
+    D = np.radians((297.8501921 + 445267.1114034 * t
+                    - 0.0018819 * t**2) % 360.0)
+    M = np.radians((357.5291092 + 35999.0502909 * t) % 360.0)
+    Mp = np.radians((134.9633964 + 477198.8675055 * t
+                     + 0.0087414 * t**2) % 360.0)
+    F = np.radians((93.2720950 + 483202.0175233 * t
+                    - 0.0036539 * t**2) % 360.0)
+    # eccentricity damping of solar-anomaly terms (Meeus 47.6)
+    E = 1.0 - 0.002516 * t - 0.0000074 * t**2
+    # longitude terms (1e-6 deg; Meeus Table 47.A, |coeff| > 3500)
+    dlon = (6288774 * np.sin(Mp) + 1274027 * np.sin(2 * D - Mp)
+            + 658314 * np.sin(2 * D) + 213618 * np.sin(2 * Mp)
+            - 185116 * E * np.sin(M) - 114332 * np.sin(2 * F)
+            + 58793 * np.sin(2 * D - 2 * Mp)
+            + 57066 * E * np.sin(2 * D - M - Mp)
+            + 53322 * np.sin(2 * D + Mp)
+            + 45758 * E * np.sin(2 * D - M)
+            - 40923 * E * np.sin(M - Mp) - 34720 * np.sin(D)
+            - 30383 * E * np.sin(M + Mp) + 15327 * np.sin(2 * D - 2 * F)
+            - 12528 * np.sin(Mp + 2 * F) + 10980 * np.sin(Mp - 2 * F)
+            + 10675 * np.sin(4 * D - Mp) + 10034 * np.sin(3 * Mp)
+            + 8548 * np.sin(4 * D - 2 * Mp)
+            - 7888 * E * np.sin(2 * D + M - Mp)
+            - 6766 * E * np.sin(2 * D + M) - 5163 * np.sin(D - Mp)
+            + 4987 * E * np.sin(D + M)
+            + 4036 * E * np.sin(2 * D - M + Mp)
+            + 3994 * np.sin(2 * D + 2 * Mp) + 3861 * np.sin(4 * D)
+            + 3665 * np.sin(2 * D - 3 * Mp)) * 1e-6
+    # latitude terms (Meeus Table 47.B, |coeff| > 4000)
+    dlat = (5128122 * np.sin(F) + 280602 * np.sin(Mp + F)
+            + 277693 * np.sin(Mp - F) + 173237 * np.sin(2 * D - F)
+            + 55413 * np.sin(2 * D - Mp + F)
+            + 46271 * np.sin(2 * D - Mp - F)
+            + 32573 * np.sin(2 * D + F) + 17198 * np.sin(2 * Mp + F)
+            + 9266 * np.sin(2 * D + Mp - F) + 8822 * np.sin(2 * Mp - F)
+            + 8216 * E * np.sin(2 * D - M - F)
+            + 4324 * np.sin(2 * D - 2 * Mp - F)
+            + 4200 * np.sin(2 * D + Mp + F)) * 1e-6
+    dr = (-20905355 * np.cos(Mp) - 3699111 * np.cos(2 * D - Mp)
+          - 2955968 * np.cos(2 * D) - 569925 * np.cos(2 * Mp)
+          + 48888 * E * np.cos(M) - 3149 * np.cos(2 * F)
+          + 246158 * np.cos(2 * D - 2 * Mp)
+          - 152138 * E * np.cos(2 * D - M - Mp)
+          - 170733 * np.cos(2 * D + Mp)
+          - 204586 * E * np.cos(2 * D - M)
+          - 129620 * E * np.cos(M - Mp) + 108743 * np.cos(D)
+          + 104755 * E * np.cos(M + Mp) + 10321 * np.cos(2 * D - 2 * F)
+          + 79661 * np.cos(Mp - 2 * F)) * 1e-3
+    lon = Lp + np.radians(dlon)
+    lat = np.radians(dlat)
+    dist_km = 385000.56 + dr
+    eps = mean_obliquity(mjd)
+    sl, cl = np.sin(lon), np.cos(lon)
+    sb, cb = np.sin(lat), np.cos(lat)
+    x = cb * cl
+    y = cb * sl * np.cos(eps) - sb * np.sin(eps)
+    z = cb * sl * np.sin(eps) + sb * np.cos(eps)
+    ra = np.arctan2(y, x) % (2 * np.pi)
+    dec = np.arcsin(np.clip(z, -1, 1))
+    return ra, dec, dist_km / 149597870.7
+
+
+# Standish (1992) approximate Keplerian elements, J2000 ecliptic, valid
+# 1800-2050. Per planet: a[AU], e, I[deg], L[deg], varpi[deg], Omega[deg]
+# and their per-century rates.
+PLANETS = {
+    "mercury": ((0.38709927, 0.20563593, 7.00497902, 252.25032350,
+                 77.45779628, 48.33076593),
+                (0.00000037, 0.00001906, -0.00594749, 149472.67411175,
+                 0.16047689, -0.12534081)),
+    "venus": ((0.72333566, 0.00677672, 3.39467605, 181.97909950,
+               131.60246718, 76.67984255),
+              (0.00000390, -0.00004107, -0.00078890, 58517.81538729,
+               0.00268329, -0.27769418)),
+    "earth": ((1.00000261, 0.01671123, -0.00001531, 100.46457166,
+               102.93768193, 0.0),
+              (0.00000562, -0.00004392, -0.01294668, 35999.37244981,
+               0.32327364, 0.0)),
+    "mars": ((1.52371034, 0.09339410, 1.84969142, -4.55343205,
+              -23.94362959, 49.55953891),
+             (0.00001847, 0.00007882, -0.00813131, 19140.30268499,
+              0.44441088, -0.29257343)),
+    "jupiter": ((5.20288700, 0.04838624, 1.30439695, 34.39644051,
+                 14.72847983, 100.47390909),
+                (-0.00011607, -0.00013253, -0.00183714, 3034.74612775,
+                 0.21252668, 0.20469106)),
+    "saturn": ((9.53667594, 0.05386179, 2.48599187, 49.95424423,
+                92.59887831, 113.66242448),
+               (-0.00125060, -0.00050991, 0.00193609, 1222.49362201,
+                -0.41897216, -0.28867794)),
+    "uranus": ((19.18916464, 0.04725744, 0.77263783, 313.23810451,
+                170.95427630, 74.01692503),
+               (-0.00196176, -0.00004397, -0.00242939, 428.48202785,
+                0.40805281, 0.04240589)),
+    "neptune": ((30.06992276, 0.00859048, 1.77004347, -55.12002969,
+                 44.96476227, 131.78422574),
+                (0.00026291, 0.00005105, 0.00035372, 218.45945325,
+                 -0.32241464, -0.00508664)),
+}
+
+
+def _heliocentric_ecliptic(name, mjd):
+    """Heliocentric J2000-ecliptic position [AU] from Standish elements."""
+    el0, rate = PLANETS[name]
+    t = julian_centuries_tt(mjd)
+    a, e, inc, L, varpi, Om = (np.asarray(el0[i] + rate[i] * t)
+                               for i in range(6))
+    inc, L, varpi, Om = (np.radians(x) for x in (inc, L, varpi, Om))
+    w = varpi - Om                      # argument of perihelion
+    M = np.mod(L - varpi, 2 * np.pi)    # mean anomaly
+    # Kepler solve (Newton, e < 0.21 for all planets: 6 iters ~ 1e-14)
+    E = M + e * np.sin(M)
+    for _ in range(6):
+        E = E - (E - e * np.sin(E) - M) / (1 - e * np.cos(E))
+    xp = a * (np.cos(E) - e)            # orbital plane
+    yp = a * np.sqrt(1 - e * e) * np.sin(E)
+    cw, sw = np.cos(w), np.sin(w)
+    cO, sO = np.cos(Om), np.sin(Om)
+    ci, si = np.cos(inc), np.sin(inc)
+    x = (cw * cO - sw * sO * ci) * xp + (-sw * cO - cw * sO * ci) * yp
+    y = (cw * sO + sw * cO * ci) * xp + (-sw * sO + cw * cO * ci) * yp
+    z = (sw * si) * xp + (cw * si) * yp
+    return np.stack([x, y, z], axis=-1)
+
+
+_ECL2EQU_J2000 = _rx(-np.radians(23.43928))  # J2000 obliquity
+
+
+def planet_position(name: str, mjd):
+    """Geocentric astrometric J2000 RA/Dec [rad] and distance [AU] of a
+    planet (the ``sla_rdplan``/``planet`` role; also accepts 'sun'/'moon').
+
+    Light-time is not iterated (< 20 arcsec for Jupiter motion over the
+    ~40 min light travel — below the Standish element accuracy)."""
+    name = name.lower()
+    if name == "sun":
+        return sun_position(mjd)
+    if name == "moon":
+        return moon_position(mjd)
+    p = _heliocentric_ecliptic(name, mjd)
+    e = _heliocentric_ecliptic("earth", mjd)
+    geo = _apply(_ECL2EQU_J2000, p - e)
+    ra, dec = cartesian_to_equatorial(geo)
+    return ra, dec, np.linalg.norm(geo, axis=-1)

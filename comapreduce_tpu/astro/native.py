@@ -1,0 +1,175 @@
+"""ctypes bindings for the native astrometry library (csrc/astrometry.cpp).
+
+The shared library is built on demand with ``g++ -O3 -shared -fPIC`` into
+the package directory (pybind11 is not available in this image; the C ABI
++ ctypes keeps the binding dependency-free). If no compiler is available
+the NumPy oracle in :mod:`core` serves alone — ``available()`` gates all
+callers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+__all__ = ["available", "load", "h2e_full", "e2h_full", "gmst", "last",
+           "nutation", "apparent_from_j2000", "j2000_from_apparent",
+           "planet_position"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc",
+                    "astrometry.cpp")
+_LIB_PATH = os.path.join(_HERE, "_astrometry.so")
+
+_lib = None
+_tried = False
+
+_D = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.info("native astrometry build failed (%s); using NumPy", exc)
+        return False
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as exc:
+        logger.info("native astrometry load failed (%s)", exc)
+        return None
+    lib.cr_gmst.argtypes = [_D, ctypes.c_long, ctypes.c_double, _D]
+    lib.cr_last.argtypes = [_D, ctypes.c_long, ctypes.c_double,
+                            ctypes.c_double, _D]
+    lib.cr_nutation.argtypes = [_D, ctypes.c_long, _D, _D, _D]
+    lib.cr_precession_matrix.argtypes = [_D, ctypes.c_long, _D]
+    lib.cr_apparent_from_j2000.argtypes = [_D, _D, _D, ctypes.c_long, _D, _D]
+    lib.cr_j2000_from_apparent.argtypes = [_D, _D, _D, ctypes.c_long, _D, _D]
+    lib.cr_h2e_full.argtypes = [_D, _D, _D, ctypes.c_long, ctypes.c_double,
+                                ctypes.c_double, ctypes.c_double,
+                                ctypes.c_int, ctypes.c_long, _D, _D]
+    lib.cr_e2h_full.argtypes = [_D, _D, _D, ctypes.c_long, ctypes.c_double,
+                                ctypes.c_double, ctypes.c_double,
+                                ctypes.c_int, ctypes.c_long, _D, _D]
+    lib.cr_planet.argtypes = [ctypes.c_char_p, _D, ctypes.c_long, _D, _D, _D]
+    lib.cr_planet.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _as1d(x):
+    return np.ascontiguousarray(np.atleast_1d(x), dtype=np.float64)
+
+
+def gmst(mjd, dut1: float = 0.0):
+    lib = load()
+    m = _as1d(mjd)
+    out = np.empty_like(m)
+    lib.cr_gmst(m, m.size, dut1, out)
+    return out
+
+
+def last(mjd, longitude, dut1: float = 0.0):
+    lib = load()
+    m = _as1d(mjd)
+    out = np.empty_like(m)
+    lib.cr_last(m, m.size, float(longitude), dut1, out)
+    return out
+
+
+def nutation(mjd):
+    lib = load()
+    m = _as1d(mjd)
+    dpsi = np.empty_like(m)
+    deps = np.empty_like(m)
+    eps = np.empty_like(m)
+    lib.cr_nutation(m, m.size, dpsi, deps, eps)
+    return dpsi, deps, eps
+
+
+def apparent_from_j2000(ra, dec, mjd):
+    lib = load()
+    r, d = _as1d(ra), _as1d(dec)
+    m = np.ascontiguousarray(np.broadcast_to(_as1d(mjd), r.shape))
+    ra_o = np.empty_like(r)
+    dec_o = np.empty_like(r)
+    lib.cr_apparent_from_j2000(r, d, m, r.size, ra_o, dec_o)
+    return ra_o, dec_o
+
+
+def j2000_from_apparent(ra, dec, mjd):
+    lib = load()
+    r, d = _as1d(ra), _as1d(dec)
+    m = np.ascontiguousarray(np.broadcast_to(_as1d(mjd), r.shape))
+    ra_o = np.empty_like(r)
+    dec_o = np.empty_like(r)
+    lib.cr_j2000_from_apparent(r, d, m, r.size, ra_o, dec_o)
+    return ra_o, dec_o
+
+
+def h2e_full(az_rad, el_rad, mjd, longitude_rad, latitude_rad,
+             dut1: float = 0.0, apply_refraction: bool = True,
+             stride: int = 50):
+    """Radian-domain batch h2e chain (see coordinates.h2e_full)."""
+    lib = load()
+    a, e = _as1d(az_rad), _as1d(el_rad)
+    m = np.ascontiguousarray(np.broadcast_to(_as1d(mjd), a.shape))
+    ra = np.empty_like(a)
+    dec = np.empty_like(a)
+    lib.cr_h2e_full(a, e, m, a.size, float(longitude_rad),
+                    float(latitude_rad), dut1, int(apply_refraction),
+                    int(stride), ra, dec)
+    return ra, dec
+
+
+def e2h_full(ra_rad, dec_rad, mjd, longitude_rad, latitude_rad,
+             dut1: float = 0.0, apply_refraction: bool = True):
+    lib = load()
+    r, d = _as1d(ra_rad), _as1d(dec_rad)
+    m = np.ascontiguousarray(np.broadcast_to(_as1d(mjd), r.shape))
+    az = np.empty_like(r)
+    el = np.empty_like(r)
+    lib.cr_e2h_full(r, d, m, r.size, float(longitude_rad),
+                    float(latitude_rad), dut1, int(apply_refraction), 1,
+                    az, el)
+    return az, el
+
+
+def planet_position(name: str, mjd):
+    lib = load()
+    m = _as1d(mjd)
+    ra = np.empty_like(m)
+    dec = np.empty_like(m)
+    dist = np.empty_like(m)
+    rc = lib.cr_planet(name.lower().encode(), m, m.size, ra, dec, dist)
+    if rc != 0:
+        raise KeyError(f"unknown planet {name!r}")
+    return ra, dec, dist

@@ -141,12 +141,17 @@ class HDF5Store:
             self._file = None
         return self
 
-    def write(self, filename: str) -> None:
+    def write(self, filename: str, atomic: bool = False) -> None:
         """Append/overwrite the store's datasets + attrs into ``filename``.
 
         Lazy (still-on-disk) datasets are skipped — they belong to the source
         file. An existing output file is opened in append mode so repeated
         stage checkpoints accumulate (reference ``DataHandling.py:110-139``).
+
+        ``atomic=True`` stages the update in a temp copy and ``os.replace``s
+        it into place, so a run killed mid-write never leaves a
+        partially-written checkpoint — a resume would otherwise see a
+        stage's group present but incomplete and skip it forever.
         """
         # If we hold an open read handle on this same path, release it first.
         if self._file is not None and os.path.abspath(
@@ -154,7 +159,30 @@ class HDF5Store:
         ) == os.path.abspath(filename):
             self.close()
 
+        if atomic:
+            import shutil
+            import tempfile
+
+            d = os.path.dirname(os.path.abspath(filename))
+            fd, tmp = tempfile.mkstemp(suffix=".hd5.tmp", dir=d)
+            os.close(fd)
+            try:
+                if os.path.exists(filename):
+                    shutil.copy2(filename, tmp)
+                    self._write_into(tmp, "a")
+                else:
+                    self._write_into(tmp, "w")
+                os.replace(tmp, filename)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+            return
+
         mode = "a" if os.path.exists(filename) else "w"
+        self._write_into(filename, mode)
+
+    def _write_into(self, filename: str, mode: str) -> None:
         with h5py.File(filename, mode) as out:
             for path, value in self._data.items():
                 if isinstance(value, h5py.Dataset):

@@ -84,7 +84,21 @@ def scatter_scan_blocks(blocks: jax.Array, starts: jax.Array,
 
 
 class ReduceConfig:
-    """Static knobs of the reduction (mirrors the reference's constants)."""
+    """Static knobs of the reduction (mirrors the reference's constants).
+
+    Value-hashable: it is a ``jit`` static argument, and identity hashing
+    would recompile the flagship kernel once per file in a filelist run.
+    """
+
+    def _key(self):
+        return (self.n_channels, self.medfilt_window, self.is_calibrator,
+                self.bandwidth, self.tau)
+
+    def __eq__(self, other):
+        return (type(other) is ReduceConfig and self._key() == other._key())
+
+    def __hash__(self):
+        return hash(self._key())
 
     def __init__(self, n_channels: int, medfilt_window: int = 6000,
                  is_calibrator: bool = False,

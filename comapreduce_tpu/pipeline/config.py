@@ -98,13 +98,12 @@ class IniConfig(dict):
                 section = line[1:-1].strip()
                 self.setdefault(section, {})
                 continue
-            delim = None
-            for d in (":", "="):
-                if d in line:
-                    delim = d
-                    break
-            if delim is None:
+            # first delimiter by position, so '=' values containing ':'
+            # (paths, times) split at the right place
+            positions = [(line.index(d), d) for d in (":", "=") if d in line]
+            if not positions:
                 continue
+            _, delim = min(positions)
             key, value = line.split(delim, 1)
             target = self.setdefault(section, {}) if section else self
             target[key.strip()] = coerce(value)

@@ -123,7 +123,9 @@ class Runner:
                             pname, filename)
                 break
             lvl2.update(process)
-            lvl2.write(lvl2.filename)  # checkpoint after EVERY stage
+            # checkpoint after EVERY stage; atomic so a kill mid-write
+            # can't strand a half-written group that resume would skip
+            lvl2.write(lvl2.filename, atomic=True)
         return lvl2
 
     # -- config-driven construction ----------------------------------------

@@ -94,10 +94,14 @@ class CheckLevel1File(_StageBase):
     overwrite: bool = True
 
     def __call__(self, data, level2) -> bool:
+        import re
+
         mjd = data.mjd
         duration = float(mjd[-1] - mjd[0]) * 86400.0
         comment = data.comment.lower()
-        bad = next((k for k in self.bad_keywords if k in comment), None)
+        # word-boundary match: 'test' must not fire on 'latest'
+        bad = next((k for k in self.bad_keywords
+                    if re.search(rf"\b{re.escape(k)}\b", comment)), None)
         self.STATE = True
         if duration < self.min_duration_seconds:
             logger.info("CheckLevel1File: obs %s too short (%.0f s)",
