@@ -97,26 +97,39 @@ def h2e_full(az_deg, el_deg, mjd, longitude: float = COMAP_LONGITUDE,
     ``downsample_factor`` subgrid; the per-sample trig is exact.
     ``backend``: 'auto' uses the C++ library when it loads, 'native'
     requires it, 'numpy' forces the oracle."""
-    if backend in ("auto", "native"):
-        from comapreduce_tpu.astro import native
-        if native.available():
-            az = np.atleast_1d(np.asarray(az_deg, np.float64))
-            el = np.atleast_1d(np.asarray(el_deg, np.float64))
-            ra, dec = native.h2e_full(
-                np.radians(az.ravel()), np.radians(el.ravel()), mjd,
-                np.radians(longitude), np.radians(latitude), dut1,
-                apply_refraction, stride=max(int(downsample_factor), 1))
-            return (np.degrees(ra).reshape(az.shape) % 360.0,
-                    np.degrees(dec).reshape(az.shape))
-        if backend == "native":
-            raise RuntimeError("native astrometry library unavailable")
     az = np.atleast_1d(np.asarray(az_deg, np.float64))
     el = np.atleast_1d(np.asarray(el_deg, np.float64))
     mjd_b = np.broadcast_to(np.atleast_1d(np.asarray(mjd, np.float64)),
-                            az.shape).ravel()
-    lst, m, beta = _slow_terms(mjd_b, longitude, dut1, downsample_factor)
+                            az.shape)
+    if az.ndim > 1:
+        # per-feed streams: each row is its own time series — the slow-term
+        # subsampling must never interpolate across a feed boundary
+        ra = np.empty_like(az)
+        dec = np.empty_like(az)
+        flat_a = az.reshape(-1, az.shape[-1])
+        flat_e = el.reshape(-1, az.shape[-1])
+        flat_m = mjd_b.reshape(-1, az.shape[-1])
+        fr = ra.reshape(-1, az.shape[-1])
+        fd = dec.reshape(-1, az.shape[-1])
+        for i in range(flat_a.shape[0]):
+            fr[i], fd[i] = h2e_full(
+                flat_a[i], flat_e[i], flat_m[i], longitude, latitude, dut1,
+                apply_refraction, downsample_factor, backend)
+        return ra, dec
+    if backend in ("auto", "native"):
+        from comapreduce_tpu.astro import native
+        if native.available():
+            ra, dec = native.h2e_full(
+                np.radians(az), np.radians(el), mjd_b,
+                np.radians(longitude), np.radians(latitude), dut1,
+                apply_refraction, stride=max(int(downsample_factor), 1))
+            return np.degrees(ra) % 360.0, np.degrees(dec)
+        if backend == "native":
+            raise RuntimeError("native astrometry library unavailable")
+    lst, m, beta = _slow_terms(mjd_b.ravel(), longitude, dut1,
+                               downsample_factor)
 
-    azr, elr = np.radians(az.ravel()), np.radians(el.ravel())
+    azr, elr = np.radians(az), np.radians(el)
     if apply_refraction:
         elr = elr - core.refraction_bennett(elr)
     ha, dec = core.azel_to_hadec(azr, elr, np.radians(latitude))
@@ -126,8 +139,7 @@ def h2e_full(az_deg, el_deg, mjd, longitude: float = COMAP_LONGITUDE,
     v = v - beta
     v = v / np.linalg.norm(v, axis=-1, keepdims=True)
     ra, dec = core.cartesian_to_equatorial(v)
-    return (np.degrees(ra).reshape(az.shape) % 360.0,
-            np.degrees(dec).reshape(az.shape))
+    return np.degrees(ra) % 360.0, np.degrees(dec)
 
 
 def e2h_full(ra_deg, dec_deg, mjd, longitude: float = COMAP_LONGITUDE,
@@ -136,24 +148,35 @@ def e2h_full(ra_deg, dec_deg, mjd, longitude: float = COMAP_LONGITUDE,
              backend: str = "auto"):
     """Mean J2000 RA/Dec -> observed azimuth/elevation [deg]
     (``sla_map``+``sla_aop`` chain of the reference ``e2h_full``)."""
-    if backend in ("auto", "native"):
-        from comapreduce_tpu.astro import native
-        if native.available():
-            ra = np.atleast_1d(np.asarray(ra_deg, np.float64))
-            dec = np.atleast_1d(np.asarray(dec_deg, np.float64))
-            az, el = native.e2h_full(
-                np.radians(ra.ravel()), np.radians(dec.ravel()), mjd,
-                np.radians(longitude), np.radians(latitude), dut1,
-                apply_refraction)
-            return (np.degrees(az).reshape(ra.shape) % 360.0,
-                    np.degrees(el).reshape(ra.shape))
-        if backend == "native":
-            raise RuntimeError("native astrometry library unavailable")
     ra = np.atleast_1d(np.asarray(ra_deg, np.float64))
     dec = np.atleast_1d(np.asarray(dec_deg, np.float64))
     mjd_b = np.broadcast_to(np.atleast_1d(np.asarray(mjd, np.float64)),
-                            ra.shape).ravel()
-    lst, m, beta = _slow_terms(mjd_b, longitude, dut1, downsample_factor)
+                            ra.shape)
+    if ra.ndim > 1:
+        az = np.empty_like(ra)
+        el = np.empty_like(ra)
+        fa = az.reshape(-1, ra.shape[-1])
+        fe = el.reshape(-1, ra.shape[-1])
+        flat_r = ra.reshape(-1, ra.shape[-1])
+        flat_d = dec.reshape(-1, ra.shape[-1])
+        flat_m = mjd_b.reshape(-1, ra.shape[-1])
+        for i in range(flat_r.shape[0]):
+            fa[i], fe[i] = e2h_full(
+                flat_r[i], flat_d[i], flat_m[i], longitude, latitude, dut1,
+                apply_refraction, downsample_factor, backend)
+        return az, el
+    if backend in ("auto", "native"):
+        from comapreduce_tpu.astro import native
+        if native.available():
+            az, el = native.e2h_full(
+                np.radians(ra), np.radians(dec), mjd_b,
+                np.radians(longitude), np.radians(latitude), dut1,
+                apply_refraction)
+            return np.degrees(az) % 360.0, np.degrees(el)
+        if backend == "native":
+            raise RuntimeError("native astrometry library unavailable")
+    lst, m, beta = _slow_terms(mjd_b.ravel(), longitude, dut1,
+                               downsample_factor)
 
     v = core.equatorial_to_cartesian(np.radians(ra.ravel()),
                                      np.radians(dec.ravel()))
@@ -226,7 +249,8 @@ def unrotate(dlon_deg, dlat_deg, lon0_deg, lat0_deg, angle_deg=0.0):
     v = core.equatorial_to_cartesian(np.radians(dlon_deg),
                                      np.radians(dlat_deg))
     m = _relative_matrix(lon0_deg, lat0_deg, angle_deg)
-    lon, lat = core.cartesian_to_equatorial(core._apply(m.T, v))
+    lon, lat = core.cartesian_to_equatorial(
+        core._apply(np.swapaxes(m, -1, -2), v))
     return np.degrees(lon) % 360.0, np.degrees(lat)
 
 

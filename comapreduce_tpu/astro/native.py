@@ -36,13 +36,22 @@ _D = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
 def _build() -> bool:
     if not os.path.exists(_SRC):
         return False
+    # build to a per-PID path and rename: concurrent ranks must never
+    # dlopen a half-written .so
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB_PATH)
         return True
     except (OSError, subprocess.SubprocessError) as exc:
         logger.info("native astrometry build failed (%s); using NumPy", exc)
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 
@@ -164,6 +173,12 @@ def e2h_full(ra_rad, dec_rad, mjd, longitude_rad, latitude_rad,
 
 
 def planet_position(name: str, mjd):
+    if name.lower() in ("sun", "moon"):
+        # backend parity with core.planet_position: sun/moon use the
+        # Meeus series, which live only in the NumPy oracle (they are not
+        # per-sample hot paths)
+        from comapreduce_tpu.astro import core
+        return core.planet_position(name, mjd)
     lib = load()
     m = _as1d(mjd)
     ra = np.empty_like(m)

@@ -1,0 +1,20 @@
+"""Calibration stack: source fitting, flux models, factor application.
+
+Re-design of the reference calibration chain (SURVEY.md §2.2):
+
+- :mod:`fitting` — the 2-D Gaussian model zoo + batched Levenberg-
+  Marquardt solver (replaces ``Tools/Fitting.py``'s scipy/emcee fits and
+  the OpenMP ALGLIB batch fitter ``Tools/alglib_optimize.pyx`` with one
+  ``vmap``-ed JAX solver);
+- :mod:`flux_models` — calibrator flux models (``Tools/CaliModels.py``);
+- :mod:`unitconv` — K/Jy/CMB conversions (``Tools/UnitConv.py``);
+- :mod:`source_fit` — the ``FitSource`` pipeline stage
+  (``Analysis/AstroCalibration.py``);
+- :mod:`apply_cal` — ``ApplyCalibration``: factors from calibrator fits,
+  nearest-MJD assignment (``Analysis/PostCalibration.py``).
+"""
+
+from comapreduce_tpu.calibration import (apply_cal, fitting, flux_models,
+                                         source_fit, unitconv)  # noqa: F401
+
+__all__ = ["fitting", "flux_models", "unitconv", "source_fit", "apply_cal"]

@@ -1,0 +1,178 @@
+"""``ApplyCalibration``: astronomical calibration factors
+(``Analysis/PostCalibration.py`` parity).
+
+Reads the Gaussian source fits from every calibrator Level-2 file,
+converts fitted amplitudes to flux densities (``S = 2 k nu^2/c^2 *
+2 pi sx sy * A``, ``PostCalibration.py:179-199``), divides by the flux
+model to get per-(feed, band) calibration factors, masks bad fits
+(factor outside ``[factor_min, factor_max]``, ``:318-335``), and assigns
+the nearest-in-MJD factor to each target observation
+(``:387-408``) — written to ``astro_calibration/*``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from comapreduce_tpu.calibration.flux_models import flux_model
+from comapreduce_tpu.calibration.unitconv import (gaussian_solid_angle,
+                                                  k_to_jy)
+from comapreduce_tpu.data.level import COMAPLevel2
+from comapreduce_tpu.pipeline.registry import register
+from comapreduce_tpu.pipeline.stages import _StageBase
+
+__all__ = ["CalibratorDatabase", "ApplyCalibration", "source_flux_jy"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+def source_flux_jy(fits: np.ndarray, freq_ghz: np.ndarray) -> np.ndarray:
+    """Fitted Gaussian (F, B, 7) -> flux density (F, B) [Jy]
+    (``get_source_flux``, ``PostCalibration.py:179-199``)."""
+    amp = fits[..., 0]
+    sx = np.abs(fits[..., 2])
+    sy = np.abs(fits[..., 4])
+    omega = gaussian_solid_angle(sx, sy)
+    return k_to_jy(amp, freq_ghz, omega)
+
+
+@dataclass
+class CalibratorDatabase:
+    """Calibration factors harvested from calibrator Level-2 files.
+
+    ``factors``: list of (mjd, source, factor[F, B], good[F, B]).
+    The reference caches this scan to ``.npy`` (``PostCalibration.py:
+    232-235``); here :meth:`save`/:meth:`load` use ``.npz``.
+    """
+
+    factor_min: float = 0.5
+    factor_max: float = 1.5
+    entries: list = field(default_factory=list)
+
+    def harvest(self, filenames: list[str]) -> int:
+        """Scan calibrator Level-2 files for source fits; returns the
+        number of files that contributed."""
+        n0 = len(self.entries)
+        for fname in filenames:
+            try:
+                lvl2 = COMAPLevel2(filename=fname)
+            except OSError:
+                logger.warning("CalibratorDatabase: cannot read %s", fname)
+                continue
+            self.add_level2(lvl2)
+        return len(self.entries) - n0
+
+    def add_level2(self, lvl2) -> bool:
+        fit_groups = [k.split("/")[0] for k in lvl2.keys()
+                      if k.endswith("/fits") and "_source_fit" in k]
+        if not fit_groups:
+            return False
+        g = fit_groups[0]
+        src = g.replace("_source_fit", "")
+        fits = np.asarray(lvl2[f"{g}/fits"])
+        try:
+            mjd = float(lvl2.attrs(g, "mjd"))
+        except KeyError:
+            mjd = float(np.mean(np.asarray(lvl2.mjd)))
+        freq = self._band_freqs(lvl2, fits.shape[1])
+        s_meas = source_flux_jy(fits, freq[None, :])
+        s_model = np.asarray(flux_model(src, freq, mjd))
+        factor = np.where(s_model > 0, s_meas / s_model, 0.0)
+        good = ((factor > self.factor_min) & (factor < self.factor_max)
+                & np.isfinite(factor) & (fits[..., 0] > 0))
+        self.entries.append((mjd, src, factor, good))
+        return True
+
+    @staticmethod
+    def _band_freqs(lvl2, n_bands: int) -> np.ndarray:
+        if "spectrometer/frequency" in lvl2:
+            return np.asarray(
+                lvl2["spectrometer/frequency"]).mean(axis=-1)[:n_bands]
+        # COMAP band plan fallback: centres of four 2 GHz bands
+        return 27.0 + 2.0 * np.arange(n_bands)
+
+    def nearest(self, mjd: float):
+        """(factor[F, B], good[F, B], source, dt_days) of the nearest
+        calibrator observation; per-channel fallback to the next-nearest
+        good value (``assign_calibration_factors``,
+        ``PostCalibration.py:387-408``)."""
+        if not self.entries:
+            raise RuntimeError("empty calibrator database")
+        order = np.argsort([abs(e[0] - mjd) for e in self.entries])
+        f0 = self.entries[order[0]][2].copy()
+        g0 = self.entries[order[0]][3].copy()
+        for i in order[1:]:
+            fill = (~g0) & self.entries[i][3]
+            f0[fill] = self.entries[i][2][fill]
+            g0 |= fill
+        e = self.entries[order[0]]
+        return f0, g0, e[1], abs(e[0] - mjd)
+
+    def save(self, path: str) -> None:
+        mjds = np.array([e[0] for e in self.entries])
+        srcs = np.array([e[1] for e in self.entries])
+        np.savez(path, mjds=mjds, sources=srcs,
+                 factors=np.stack([e[2] for e in self.entries]),
+                 good=np.stack([e[3] for e in self.entries]),
+                 factor_min=self.factor_min, factor_max=self.factor_max)
+
+    @classmethod
+    def load(cls, path: str) -> "CalibratorDatabase":
+        z = np.load(path, allow_pickle=False)
+        db = cls(factor_min=float(z["factor_min"]),
+                 factor_max=float(z["factor_max"]))
+        for i in range(len(z["mjds"])):
+            db.entries.append((float(z["mjds"][i]), str(z["sources"][i]),
+                               z["factors"][i], z["good"][i]))
+        return db
+
+
+@register()
+@dataclass
+class ApplyCalibration(_StageBase):
+    """Assign the nearest-in-MJD calibration factors to an observation.
+
+    ``calibrator_filelist`` (or a prebuilt ``database``) provides the
+    factors; the stage writes ``astro_calibration/{calibration_factors,
+    calibration_good}`` plus provenance attrs."""
+
+    groups: tuple = ("astro_calibration",)
+    calibrator_filelist: tuple = ()
+    cache_path: str = ""
+    database: object = None
+    # factors depend on the external calibrator set, not on the target
+    # file's own contents — a rerun must refresh them, never resume-skip
+    overwrite: bool = True
+
+    def _database(self) -> CalibratorDatabase:
+        if self.database is None:
+            if self.cache_path and os.path.exists(self.cache_path):
+                self.database = CalibratorDatabase.load(self.cache_path)
+            else:
+                db = CalibratorDatabase()
+                db.harvest(list(self.calibrator_filelist))
+                if self.cache_path:
+                    db.save(self.cache_path)
+                self.database = db
+        return self.database
+
+    def __call__(self, data, level2) -> bool:
+        db = self._database()
+        if not db.entries:
+            logger.warning("ApplyCalibration: no calibrator fits available")
+            self.STATE = False
+            return False
+        mjd = float(np.mean(np.asarray(data.mjd)))
+        factor, good, src, dt = db.nearest(mjd)
+        self._data = {
+            "astro_calibration/calibration_factors": factor,
+            "astro_calibration/calibration_good": good.astype(np.uint8),
+        }
+        self._attrs = {"astro_calibration": {
+            "source": src, "delta_mjd": dt}}
+        self.STATE = True
+        return True

@@ -1,0 +1,104 @@
+"""Calibrator flux models (``Tools/CaliModels.py`` parity).
+
+The reference models: Jupiter (WMAP-anchored brightness temperature +
+geocentric-distance scaling, ``CaliModels.py:12-58``), CasA with secular
+decay (``:85-112``), TauA and CygA (Baars et al. 1977 / Weiland et al.
+2011 power laws). Same published anchors here; each model returns Jy at
+the requested frequency and epoch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.calibration.unitconv import k_to_jy
+
+__all__ = ["tau_a_flux", "cas_a_flux", "cyg_a_flux", "jupiter_flux",
+           "flux_model", "FLUX_MODELS", "JUPITER_MEAN_SOLID_ANGLE_SR"]
+
+_MJD_YEAR0 = 51544.5  # J2000.0
+_DAYS_PER_YEAR = 365.25
+
+
+def _years_since(mjd, epoch_year):
+    return (np.asarray(mjd, np.float64) - _MJD_YEAR0) / _DAYS_PER_YEAR \
+        + 2000.0 - epoch_year
+
+
+def tau_a_flux(freq_ghz, mjd=None):
+    """Crab nebula [Jy]: log S = 3.915 - 0.299 log nu[MHz] (Baars 1977)
+    with a secular decline of 0.167 %/yr from epoch 2005 (Weiland 2011)."""
+    nu_mhz = np.asarray(freq_ghz, np.float64) * 1e3
+    s = 10.0 ** (3.915 - 0.299 * np.log10(nu_mhz))
+    if mjd is not None:
+        s = s * (1.0 - 0.00167) ** _years_since(mjd, 2005.0)
+    return s
+
+
+def cas_a_flux(freq_ghz, mjd=None):
+    """Cassiopeia A [Jy]: log S = 5.745 - 0.770 log nu[MHz] (Baars 1977,
+    epoch 1980) with a ~0.55 %/yr fade at cm wavelengths."""
+    nu_mhz = np.asarray(freq_ghz, np.float64) * 1e3
+    s = 10.0 ** (5.745 - 0.770 * np.log10(nu_mhz))
+    if mjd is not None:
+        s = s * (1.0 - 0.0055) ** _years_since(mjd, 1980.0)
+    return s
+
+
+def cyg_a_flux(freq_ghz, mjd=None):
+    """Cygnus A [Jy]: log S = 7.161 - 1.244 log nu[MHz] (Baars 1977;
+    steady)."""
+    nu_mhz = np.asarray(freq_ghz, np.float64) * 1e3
+    return 10.0 ** (7.161 - 1.244 * np.log10(nu_mhz))
+
+
+# WMAP 7-yr Jupiter brightness temperatures (Weiland et al. 2011),
+# RJ temperature at the band effective frequencies.
+_JUPITER_NU_GHZ = np.array([22.85, 33.11, 40.92, 60.41, 93.0])
+_JUPITER_TB_K = np.array([136.2, 147.2, 154.7, 165.6, 173.5])
+
+# Jupiter angular radii -> solid angle at the standard 4.04 AU
+_JUPITER_EQ_RADIUS_KM = 71492.0
+_JUPITER_POL_RADIUS_KM = 66854.0
+_AU_KM = 149597870.7
+JUPITER_MEAN_SOLID_ANGLE_SR = (np.pi * _JUPITER_EQ_RADIUS_KM
+                               * _JUPITER_POL_RADIUS_KM
+                               / (4.04 * _AU_KM) ** 2)
+
+
+def jupiter_flux(freq_ghz, mjd=None, distance_au=None):
+    """Jupiter [Jy]: WMAP-anchored T_b interpolated in log-frequency,
+    disc solid angle scaled by the true geocentric distance
+    (``CaliModels.JupiterFluxModel``, ``CaliModels.py:12-58,134``).
+
+    ``distance_au``: geocentric distance; if None and ``mjd`` given it
+    comes from the ephemerides, else the 4.04 AU convention."""
+    nu = np.asarray(freq_ghz, np.float64)
+    tb = np.interp(np.log(nu), np.log(_JUPITER_NU_GHZ), _JUPITER_TB_K)
+    if distance_au is None and mjd is not None:
+        from comapreduce_tpu.astro.coordinates import planet_distance_au
+        distance_au = planet_distance_au("jupiter", mjd)
+    if distance_au is None:
+        distance_au = 4.04
+    omega = (np.pi * _JUPITER_EQ_RADIUS_KM * _JUPITER_POL_RADIUS_KM
+             / (np.asarray(distance_au, np.float64) * _AU_KM) ** 2)
+    return k_to_jy(tb, nu, omega)
+
+
+FLUX_MODELS = {
+    "TauA": tau_a_flux,
+    "CasA": cas_a_flux,
+    "CygA": cyg_a_flux,
+    "jupiter": jupiter_flux,
+    "Jupiter": jupiter_flux,
+}
+
+
+def flux_model(source: str, freq_ghz, mjd=None):
+    """Model flux [Jy] for a named calibrator at ``freq_ghz`` and ``mjd``."""
+    try:
+        fn = FLUX_MODELS[source]
+    except KeyError:
+        raise KeyError(f"no flux model for source {source!r} "
+                       f"(have: {sorted(set(FLUX_MODELS))})") from None
+    return fn(freq_ghz, mjd)

@@ -1,0 +1,68 @@
+"""Radio-astronomy unit conversions (``Tools/UnitConv.py`` parity).
+
+Rayleigh-Jeans/thermodynamic temperatures, flux densities, and solid
+angles for the 26-34 GHz COMAP bands.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["K_B", "C_LIGHT", "T_CMB", "toJy", "jy_to_k", "k_to_jy",
+           "planck_correction", "cmb_to_rj", "rj_to_cmb", "blackbody",
+           "gaussian_solid_angle"]
+
+K_B = 1.380649e-23        # J/K
+C_LIGHT = 2.99792458e8    # m/s
+H_PLANCK = 6.62607015e-34  # J s
+T_CMB = 2.7255            # K
+
+
+def gaussian_solid_angle(sigma_x_deg, sigma_y_deg):
+    """Solid angle [sr] of an elliptical Gaussian beam: 2 pi sx sy
+    (``PostCalibration.py:179-199`` flux conversion)."""
+    sx = np.radians(np.asarray(sigma_x_deg, np.float64))
+    sy = np.radians(np.asarray(sigma_y_deg, np.float64))
+    return 2.0 * np.pi * sx * sy
+
+
+def k_to_jy(t_k, freq_ghz, solid_angle_sr):
+    """Rayleigh-Jeans brightness temperature [K] over a solid angle ->
+    flux density [Jy]: S = 2 k nu^2 / c^2 * Omega * T * 1e26."""
+    nu = np.asarray(freq_ghz, np.float64) * 1e9
+    return (2.0 * K_B * nu**2 / C_LIGHT**2
+            * np.asarray(solid_angle_sr, np.float64)
+            * np.asarray(t_k, np.float64) * 1e26)
+
+
+def jy_to_k(s_jy, freq_ghz, solid_angle_sr):
+    nu = np.asarray(freq_ghz, np.float64) * 1e9
+    return (np.asarray(s_jy, np.float64) * 1e-26 * C_LIGHT**2
+            / (2.0 * K_B * nu**2 * np.asarray(solid_angle_sr, np.float64)))
+
+
+# keep the reference's exported name (``UnitConv.toJy``)
+toJy = k_to_jy
+
+
+def planck_correction(freq_ghz, t_k=T_CMB):
+    """g(x) = (e^x - 1)^2 / (x^2 e^x): thermodynamic <-> RJ factor."""
+    nu = np.asarray(freq_ghz, np.float64) * 1e9
+    x = H_PLANCK * nu / (K_B * np.asarray(t_k, np.float64))
+    return (np.expm1(x)) ** 2 / (x**2 * np.exp(x))
+
+
+def cmb_to_rj(dt_cmb, freq_ghz):
+    """Thermodynamic (CMB) dT -> Rayleigh-Jeans dT."""
+    return np.asarray(dt_cmb, np.float64) / planck_correction(freq_ghz)
+
+
+def rj_to_cmb(dt_rj, freq_ghz):
+    return np.asarray(dt_rj, np.float64) * planck_correction(freq_ghz)
+
+
+def blackbody(freq_ghz, t_k):
+    """Planck specific intensity B_nu [W m^-2 Hz^-1 sr^-1]."""
+    nu = np.asarray(freq_ghz, np.float64) * 1e9
+    x = H_PLANCK * nu / (K_B * np.asarray(t_k, np.float64))
+    return 2.0 * H_PLANCK * nu**3 / C_LIGHT**2 / np.expm1(x)

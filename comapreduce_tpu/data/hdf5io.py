@@ -166,8 +166,13 @@ class HDF5Store:
             d = os.path.dirname(os.path.abspath(filename))
             fd, tmp = tempfile.mkstemp(suffix=".hd5.tmp", dir=d)
             os.close(fd)
+            # When the store fully mirrors the target (no lazy handles —
+            # the Level-2 checkpoint case), a fresh write is equivalent to
+            # copy+append and skips copying the whole file every stage.
+            fresh = not any(isinstance(v, h5py.Dataset)
+                            for v in self._data.values())
             try:
-                if os.path.exists(filename):
+                if os.path.exists(filename) and not fresh:
                     shutil.copy2(filename, tmp)
                     self._write_into(tmp, "a")
                 else:

@@ -128,6 +128,23 @@ class Runner:
             lvl2.write(lvl2.filename, atomic=True)
         return lvl2
 
+    def run_astro_cal(self, filelist: list[str],
+                      calibrator_level2: list[str],
+                      cache_path: str = "") -> list[COMAPLevel2]:
+        """Apply astronomical calibration factors to target files
+        (``Running.run_astro_cal``, ``Running.py:156-173``): factors are
+        harvested from the calibrator Level-2 files, the nearest-in-MJD
+        factor is written into each target's Level-2 store."""
+        from comapreduce_tpu.calibration.apply_cal import ApplyCalibration
+
+        stage = ApplyCalibration(
+            calibrator_filelist=tuple(calibrator_level2),
+            cache_path=cache_path)
+        sub = Runner(processes=[stage], output_dir=self.output_dir,
+                     prefix=self.prefix, rank=self.rank,
+                     n_ranks=self.n_ranks, timings=self.timings)
+        return sub.run_tod(filelist)
+
     # -- config-driven construction ----------------------------------------
     @classmethod
     def from_config(cls, config: dict | str, rank: int = 0,

@@ -276,3 +276,32 @@ def test_native_planet_parity(native_lib):
         assert np.allclose(ra_n, ra_p, atol=1e-12), name
         assert np.allclose(dec_n, dec_p, atol=1e-12), name
         assert np.allclose(d_n, d_p, atol=1e-12), name
+
+
+def test_h2e_full_2d_feed_streams():
+    """(F, T) pointing with (T,) mjd: each feed row must transform exactly
+    like its own 1-D call (no slow-term interpolation across feeds)."""
+    n = 600
+    mjd = 59620.0 + np.arange(n) / 50.0 / 86400.0
+    az = np.stack([180.0 + 2.0 * np.sin(np.arange(n) / 60.0),
+                   181.0 + 2.0 * np.sin(np.arange(n) / 55.0)])
+    el = np.stack([np.full(n, 55.0), np.full(n, 54.5)])
+    ra2d, dec2d = coords.h2e_full(az, el, mjd, downsample_factor=50)
+    for f in range(2):
+        ra1, dec1 = coords.h2e_full(az[f], el[f], mjd, downsample_factor=50)
+        assert np.allclose(ra2d[f], ra1, atol=1e-12)
+        assert np.allclose(dec2d[f], dec1, atol=1e-12)
+    az_b, el_b = coords.e2h_full(ra2d, dec2d, mjd, downsample_factor=50)
+    assert np.max(np.abs(az_b - az)) < 3 * ARCSEC_DEG
+
+
+def test_unrotate_array_angles():
+    """unrotate must invert rotate for per-sample angle arrays."""
+    rng = np.random.default_rng(5)
+    lon = 83.0 + rng.uniform(-1, 1, 20)
+    lat = 22.0 + rng.uniform(-1, 1, 20)
+    ang = rng.uniform(-90, 90, 20)
+    dlon, dlat = coords.rotate(lon, lat, 83.0, 22.0, angle_deg=ang)
+    lon2, lat2 = coords.unrotate(dlon, dlat, 83.0, 22.0, angle_deg=ang)
+    assert np.allclose(lon2, lon, atol=1e-9)
+    assert np.allclose(lat2, lat, atol=1e-9)
