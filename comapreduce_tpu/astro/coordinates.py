@@ -104,8 +104,10 @@ def h2e_full(az_deg, el_deg, mjd, longitude: float = COMAP_LONGITUDE,
     if az.ndim > 1:
         # per-feed streams: each row is its own time series — the slow-term
         # subsampling must never interpolate across a feed boundary
-        ra = np.empty_like(az)
-        dec = np.empty_like(az)
+        # np.empty (not empty_like): the output must be C-contiguous so the
+        # row views written below alias the returned array
+        ra = np.empty(az.shape)
+        dec = np.empty(az.shape)
         flat_a = az.reshape(-1, az.shape[-1])
         flat_e = el.reshape(-1, az.shape[-1])
         flat_m = mjd_b.reshape(-1, az.shape[-1])
@@ -153,8 +155,8 @@ def e2h_full(ra_deg, dec_deg, mjd, longitude: float = COMAP_LONGITUDE,
     mjd_b = np.broadcast_to(np.atleast_1d(np.asarray(mjd, np.float64)),
                             ra.shape)
     if ra.ndim > 1:
-        az = np.empty_like(ra)
-        el = np.empty_like(ra)
+        az = np.empty(ra.shape)
+        el = np.empty(ra.shape)
         fa = az.reshape(-1, ra.shape[-1])
         fe = el.reshape(-1, ra.shape[-1])
         flat_r = ra.reshape(-1, ra.shape[-1])

@@ -67,25 +67,34 @@ class CalibratorDatabase:
         return len(self.entries) - n0
 
     def add_level2(self, lvl2) -> bool:
-        fit_groups = [k.split("/")[0] for k in lvl2.keys()
-                      if k.endswith("/fits") and "_source_fit" in k]
-        if not fit_groups:
-            return False
-        g = fit_groups[0]
-        src = g.replace("_source_fit", "")
-        fits = np.asarray(lvl2[f"{g}/fits"])
-        try:
-            mjd = float(lvl2.attrs(g, "mjd"))
-        except KeyError:
-            mjd = float(np.mean(np.asarray(lvl2.mjd)))
-        freq = self._band_freqs(lvl2, fits.shape[1])
-        s_meas = source_flux_jy(fits, freq[None, :])
-        s_model = np.asarray(flux_model(src, freq, mjd))
-        factor = np.where(s_model > 0, s_meas / s_model, 0.0)
-        good = ((factor > self.factor_min) & (factor < self.factor_max)
-                & np.isfinite(factor) & (fits[..., 0] > 0))
-        self.entries.append((mjd, src, factor, good))
-        return True
+        fit_groups = sorted({k.split("/")[0] for k in lvl2.keys()
+                             if k.endswith("/fits")
+                             and "_source_fit" in k})
+        added = False
+        for g in fit_groups:
+            src = g.replace("_source_fit", "")
+            fits = np.asarray(lvl2[f"{g}/fits"])
+            try:
+                mjd = float(lvl2.attrs(g, "mjd"))
+            except KeyError:
+                mjd = float(np.mean(np.asarray(lvl2.mjd)))
+            freq = self._band_freqs(lvl2, fits.shape[1])
+            s_meas = source_flux_jy(fits, freq[None, :])
+            try:
+                s_model = np.asarray(flux_model(src, freq, mjd))
+            except KeyError:
+                # fitted source without a flux model (e.g. moon): the fit
+                # is still useful for pointing/beam checks, just not for
+                # flux calibration
+                logger.info("CalibratorDatabase: no flux model for %r; "
+                            "skipping its fits", src)
+                continue
+            factor = np.where(s_model > 0, s_meas / s_model, 0.0)
+            good = ((factor > self.factor_min) & (factor < self.factor_max)
+                    & np.isfinite(factor) & (fits[..., 0] > 0))
+            self.entries.append((mjd, src, factor, good))
+            added = True
+        return added
 
     @staticmethod
     def _band_freqs(lvl2, n_bands: int) -> np.ndarray:
@@ -114,10 +123,12 @@ class CalibratorDatabase:
 
     def save(self, path: str) -> None:
         mjds = np.array([e[0] for e in self.entries])
-        srcs = np.array([e[1] for e in self.entries])
-        np.savez(path, mjds=mjds, sources=srcs,
-                 factors=np.stack([e[2] for e in self.entries]),
-                 good=np.stack([e[3] for e in self.entries]),
+        srcs = np.array([e[1] for e in self.entries], dtype="U32")
+        factors = (np.stack([e[2] for e in self.entries]) if self.entries
+                   else np.zeros((0, 0, 0)))
+        good = (np.stack([e[3] for e in self.entries]) if self.entries
+                else np.zeros((0, 0, 0), bool))
+        np.savez(path, mjds=mjds, sources=srcs, factors=factors, good=good,
                  factor_min=self.factor_min, factor_max=self.factor_max)
 
     @classmethod

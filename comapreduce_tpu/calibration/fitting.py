@@ -57,7 +57,6 @@ def gauss2d_fixed_pos(p, x, y, x0=0.0, y0=0.0):
     return gauss2d_rot(full, x, y)
 
 
-@functools.partial(jax.jit, static_argnames=("residual_fn", "n_iter"))
 def lm_fit(residual_fn, p0: jax.Array, n_iter: int = 50,
            lam0: float = 1e-3):
     """Levenberg-Marquardt on ``residual_fn(p) -> r`` (weighted residuals).
@@ -65,7 +64,10 @@ def lm_fit(residual_fn, p0: jax.Array, n_iter: int = 50,
     Returns ``(p, cov, chi2)`` where ``cov`` is the parameter covariance
     ``inv(J^T J) * chi2/dof`` (the reference propagates errors through the
     analytic Jacobian the same way, ``AstroCalibration.py:396-400``).
-    Fully jittable; ``vmap`` for batches.
+    Traceable (call under jit/vmap — :func:`fit_gauss2d` is the jitted
+    entry); deliberately NOT jitted itself, because jitting on a
+    fresh-closure static argument would recompile per call and retain
+    every closure's captured arrays in the jit cache.
     """
     jac_fn = jax.jacfwd(residual_fn)
     n = p0.shape[0]

@@ -1,0 +1,7 @@
+"""Command-line entry points (the reference's repo-root drivers).
+
+- ``python -m comapreduce_tpu.cli.run_average config.toml`` — the TOD
+  reduction pipeline (``run_average.py`` parity);
+- ``python -m comapreduce_tpu.cli.run_destriper params.ini`` — the
+  destriping map-maker (``MapMaking/run_destriper.py`` parity).
+"""

@@ -1,0 +1,71 @@
+"""TOD-reduction driver: ``python -m comapreduce_tpu.cli.run_average
+configuration.toml`` (reference ``run_average.py:100-118``).
+
+TOML layout::
+
+    [Global]
+    processes = ["CheckLevel1File", "AssignLevel1Data", ...]
+    filelist = "filelist.txt"        # one Level-1 path per line
+    output_dir = "level2"
+    log_dir = "logs"
+    calibrator_filelist = "cals.txt" # optional: enables run_astro_cal
+
+    [StageName]
+    # per-stage kwargs
+
+Multi-host sharding (reference: MPI rank filelist shard,
+``run_average.py:38-39``): rank/n_ranks come from ``jax.process_index``
+when jax.distributed is initialised, else 0/1 (single host).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from comapreduce_tpu.pipeline import Runner, load_toml, set_logging
+
+
+def _read_filelist(path: str) -> list[str]:
+    with open(path) as f:
+        return [ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")]
+
+
+def _rank_info():
+    try:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m comapreduce_tpu.cli.run_average "
+              "configuration.toml", file=sys.stderr)
+        return 2
+    config = load_toml(argv[0])
+    glob = config.get("Global", {})
+    rank, n_ranks = _rank_info()
+    set_logging(base="run_average", log_dir=glob.get("log_dir", "."),
+                rank=rank, level=str(glob.get("log_level", "INFO")))
+    runner = Runner.from_config(config, rank=rank, n_ranks=n_ranks)
+    filelist = _read_filelist(glob["filelist"])
+    runner.run_tod(filelist)
+    cal_list_path = glob.get("calibrator_filelist")
+    if cal_list_path:
+        from comapreduce_tpu.pipeline.runner import level2_path
+
+        cal_l2 = [level2_path(runner.output_dir, f, runner.prefix)
+                  for f in _read_filelist(cal_list_path)]
+        runner.run_astro_cal(filelist, cal_l2,
+                             cache_path=glob.get("calibration_cache", ""))
+    for name, times in sorted(runner.timings.items()):
+        print(f"{name}: {sum(times):.2f} s over {len(times)} files")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

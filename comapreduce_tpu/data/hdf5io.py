@@ -41,6 +41,9 @@ class HDF5Store:
     _data: dict = field(default_factory=dict)
     _attrs: dict = field(default_factory=dict)
     _file: h5py.File | None = field(default=None, repr=False)
+    # abspath of the file this store mirrors (set by read(); also set by a
+    # from-scratch write) — gates the atomic-write fast path
+    _mirrors: str = field(default="", repr=False)
 
     # -- mapping protocol ---------------------------------------------------
     def __getitem__(self, path: str):
@@ -114,6 +117,7 @@ class HDF5Store:
         self.close()
         self._data = {}
         self._attrs = {}
+        self._mirrors = os.path.abspath(filename)
         f = h5py.File(filename, "r")
         self._file = f
         # root attributes
@@ -166,17 +170,25 @@ class HDF5Store:
             d = os.path.dirname(os.path.abspath(filename))
             fd, tmp = tempfile.mkstemp(suffix=".hd5.tmp", dir=d)
             os.close(fd)
-            # When the store fully mirrors the target (no lazy handles —
-            # the Level-2 checkpoint case), a fresh write is equivalent to
-            # copy+append and skips copying the whole file every stage.
-            fresh = not any(isinstance(v, h5py.Dataset)
-                            for v in self._data.values())
+            # When the store fully mirrors the target (it read this very
+            # file, or the file doesn't exist yet) and holds no lazy
+            # handles, a fresh write is equivalent to copy+append and
+            # skips copying the whole file every stage. A store that
+            # never read an existing target must copy+append — rewriting
+            # would delete datasets it doesn't hold.
+            target = os.path.abspath(filename)
+            fresh = (not any(isinstance(v, h5py.Dataset)
+                             for v in self._data.values())
+                     and (not os.path.exists(filename)
+                          or self._mirrors == target))
             try:
                 if os.path.exists(filename) and not fresh:
                     shutil.copy2(filename, tmp)
                     self._write_into(tmp, "a")
                 else:
                     self._write_into(tmp, "w")
+                    # the file now equals this store's content exactly
+                    self._mirrors = target
                 os.replace(tmp, filename)
             except BaseException:
                 if os.path.exists(tmp):

@@ -1,0 +1,58 @@
+"""Map-making filelist curation (``MapMaking/CreateFilelist.py`` parity).
+
+Splits a Level-2 filelist into good/rejected sets by the white-noise
+quality cut: median per-scan 1/f-fit white level (or the TOD auto-rms
+fallback) under ``sigma_cut_mk`` millikelvin (reference threshold 4 mK,
+``CreateFilelist.py:17-63``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from comapreduce_tpu.data.level import COMAPLevel2
+
+__all__ = ["noise_level_mk", "create_filelist", "write_filelist"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+def noise_level_mk(lvl2, band: int = 0) -> float:
+    """Median white-noise level [mK] across feeds/scans of one band."""
+    if "fnoise_fits/auto_rms" in lvl2:
+        rms = np.asarray(lvl2["fnoise_fits/auto_rms"])[:, band]
+        rms = rms[np.isfinite(rms) & (rms > 0)]
+        if rms.size:
+            return float(np.median(rms)) * 1e3
+    tod = np.asarray(lvl2["averaged_tod/tod"])[:, band]
+    vals = []
+    for row in tod:
+        nz = row[row != 0]
+        n = nz.size // 2 * 2
+        if n >= 2:
+            vals.append(np.nanstd(nz[0:n:2] - nz[1:n:2]) / np.sqrt(2.0))
+    return float(np.median(vals)) * 1e3 if vals else np.inf
+
+
+def create_filelist(level2_files, band: int = 0,
+                    sigma_cut_mk: float = 4.0):
+    """Returns ``(good, rejected)`` file lists by the noise cut."""
+    good, rejected = [], []
+    for fname in level2_files:
+        try:
+            lvl2 = COMAPLevel2(filename=fname)
+            sigma = noise_level_mk(lvl2, band)
+        except (OSError, KeyError) as exc:
+            logger.warning("create_filelist: BAD FILE %s (%s)", fname, exc)
+            rejected.append(fname)
+            continue
+        (good if sigma < sigma_cut_mk else rejected).append(fname)
+    return good, rejected
+
+
+def write_filelist(path: str, files) -> None:
+    with open(path, "w") as f:
+        for line in files:
+            f.write(f"{line}\n")

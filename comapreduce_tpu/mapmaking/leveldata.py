@@ -1,0 +1,193 @@
+"""Level-2 files -> flat destriper vectors (``MapMaking/COMAPData.py``).
+
+Capability parity with ``read_comap_data`` (``COMAPData.py:471-577``) and
+``get_tod`` (``:247-380``):
+
+- per-file, per-feed extraction of the band's averaged TOD and weights;
+- calibrator files use ``tod_original`` (no gain filter), field files get
+  a rolling-median (400-sample) high-pass (``:255-258, 359-360``);
+- spike-mask zero-weighting, first/last ``edge_frac`` of every scan
+  zero-weighted, scans truncated to offset multiples (``countDataSize``,
+  ``:163-187``);
+- astronomical calibration factors applied when present, bad feeds
+  dropped (``:238-244, 306-314``);
+- WCS or HEALPix pixelisation with optional celestial->galactic rotation
+  (``read_pixels``/``read_pixels_healpix``, ``:383-469``);
+- HEALPix seen-pixel compaction: the destriper solves on the compact
+  pixel set and maps re-expand on write (``:43-70, 570-574`` — the
+  reference allgathers seen pixels across ranks; here each host compacts
+  its own shard and the sharded destriper psums compact maps over a
+  shared index space built host-side).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from comapreduce_tpu.astro.coordinates import e2g
+from comapreduce_tpu.data.level import COMAPLevel2
+from comapreduce_tpu.mapmaking import healpix as hp
+from comapreduce_tpu.mapmaking.wcs import WCS
+from comapreduce_tpu.ops.median_filter import rolling_median
+
+__all__ = ["DestriperData", "read_comap_data"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+@dataclass
+class DestriperData:
+    """Flat, concatenated inputs for the destriper."""
+
+    tod: np.ndarray            # f32[N]
+    pixels: np.ndarray         # i32[N] (compact ids for healpix)
+    weights: np.ndarray        # f32[N]
+    ground_ids: np.ndarray     # i32[N] — per (file, feed) group
+    az: np.ndarray             # f32[N] — normalised azimuth per group
+    n_groups: int
+    npix: int
+    wcs: WCS | None = None
+    nside: int | None = None
+    sky_pixels: np.ndarray | None = None  # healpix: compact -> sky pixel id
+    files: list = field(default_factory=list)
+
+    def expand_map(self, compact_map: np.ndarray) -> np.ndarray:
+        """Compact-pixel map -> full-sky-indexable (pixels, values)."""
+        if self.sky_pixels is None:
+            return compact_map
+        return compact_map  # values already align with ``sky_pixels``
+
+
+def _truncated_scan_mask(edges: np.ndarray, T: int, offset_length: int,
+                         edge_frac: float):
+    """(use, wzero): use[t] selects samples kept (scans truncated to offset
+    multiples); wzero[t] marks the first/last ``edge_frac`` of each scan
+    (kept but zero-weighted, ``COMAPData.py:332-366``)."""
+    use = np.zeros(T, bool)
+    wzero = np.zeros(T, bool)
+    for s, e in edges:
+        L = ((e - s) // offset_length) * offset_length
+        if L <= 0:
+            continue
+        use[s:s + L] = True
+        k = int(L * edge_frac)
+        if k > 0:
+            wzero[s:s + k] = True
+            wzero[s + L - k:s + L] = True
+    return use, wzero
+
+
+def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
+                    nside: int | None = None, galactic: bool = False,
+                    offset_length: int = 50, medfilt_window: int = 400,
+                    edge_frac: float = 0.1, use_calibration: bool = True,
+                    feed_mask: np.ndarray | None = None) -> DestriperData:
+    """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
+    ``nside`` selects the pixelisation."""
+    if (wcs is None) == (nside is None):
+        raise ValueError("pass exactly one of wcs= or nside=")
+    tods, pixs, wgts, gids, azs = [], [], [], [], []
+    group = 0
+    kept_files = []
+    for fname in filenames:
+        try:
+            lvl2 = COMAPLevel2(filename=fname)
+            tod_all = np.asarray(lvl2["averaged_tod/tod"], np.float32)
+        except (OSError, KeyError) as exc:
+            logger.warning("BAD FILE %s (%s)", fname, exc)
+            continue
+        F, B, T = tod_all.shape
+        if not 0 <= band < B:
+            logger.warning("%s: band %d out of range", fname, band)
+            continue
+        is_cal = lvl2.is_calibrator
+        src_name = lvl2.source_name
+        if is_cal and "averaged_tod/tod_original" in lvl2:
+            tod_fb = np.asarray(lvl2["averaged_tod/tod_original"],
+                                np.float32)[:, band]
+        else:
+            tod_fb = tod_all[:, band]
+        weights = np.asarray(lvl2["averaged_tod/weights"],
+                             np.float32)[:, band].copy()
+        edges = np.asarray(lvl2.scan_edges)
+        use, wzero = _truncated_scan_mask(edges, T, offset_length, edge_frac)
+        if not use.any():
+            logger.warning("%s: no usable scans", fname)
+            continue
+        weights[:, wzero] = 0.0
+        if "spikes/spike_mask" in lvl2:
+            sm = np.asarray(lvl2["spikes/spike_mask"])[:, band] > 0
+            weights[sm] = 0.0
+        if use_calibration and "astro_calibration/calibration_factors" \
+                in lvl2:
+            fac = np.asarray(
+                lvl2["astro_calibration/calibration_factors"])[:, band]
+            good = np.asarray(
+                lvl2["astro_calibration/calibration_good"])[:, band] > 0
+            safe = np.where(good & (fac > 0), fac, 1.0)
+            tod_fb = tod_fb / safe[:, None].astype(np.float32)
+            weights[~good] = 0.0
+        if not is_cal and medfilt_window > 1:
+            w = min(medfilt_window, max(3, T // 2 * 2 - 1))
+            tod_fb = tod_fb - np.asarray(rolling_median(
+                jnp.asarray(tod_fb), w))
+        ra = np.asarray(lvl2.ra, np.float64)
+        dec = np.asarray(lvl2.dec, np.float64)
+        az_full = np.asarray(lvl2.az, np.float64)
+        lon, lat = (e2g(ra, dec) if galactic else (ra, dec))
+        for ifeed in range(F):
+            if feed_mask is not None and not feed_mask[ifeed]:
+                continue
+            w_f = weights[ifeed, use]
+            if not (w_f > 0).any():
+                continue
+            if wcs is not None:
+                pix = wcs.ang2pix(lon[ifeed, use], lat[ifeed, use])
+                pix = np.asarray(pix, np.int64)
+            else:
+                pix = np.asarray(hp.ang2pix_lonlat(
+                    nside, lon[ifeed, use], lat[ifeed, use]), np.int64)
+            a = az_full[ifeed, use]
+            throw = max(np.max(a) - np.min(a), 1e-3)
+            a_norm = (2.0 * (a - np.min(a)) / throw - 1.0).astype(np.float32)
+            tods.append(np.nan_to_num(tod_fb[ifeed, use]))
+            pixs.append(pix)
+            wgts.append(np.nan_to_num(w_f))
+            gids.append(np.full(w_f.size, group, np.int32))
+            azs.append(a_norm)
+            group += 1
+        kept_files.append(fname)
+
+    if not tods:
+        raise RuntimeError("no usable data in filelist "
+                           f"({len(list(filenames))} files)")
+    tod = np.concatenate(tods)
+    pixels = np.concatenate(pixs)
+    weights = np.concatenate(wgts)
+    ground_ids = np.concatenate(gids)
+    az = np.concatenate(azs)
+
+    sky_pixels = None
+    if wcs is not None:
+        npix = wcs.npix
+        pixels32 = np.where((pixels < 0) | (pixels >= npix), npix,
+                            pixels).astype(np.int32)
+    else:
+        # seen-pixel compaction (COMAPData.py:43-70,570-574)
+        valid = (pixels >= 0) & (pixels < hp.nside2npix(nside))
+        sky_pixels = np.unique(pixels[valid])
+        npix = int(sky_pixels.size)
+        idx = np.searchsorted(sky_pixels, np.clip(pixels, 0, None))
+        idx = np.clip(idx, 0, max(npix - 1, 0))
+        match = valid & (sky_pixels[idx] == pixels) if npix else \
+            np.zeros_like(valid)
+        pixels32 = np.where(match, idx, npix).astype(np.int32)
+    return DestriperData(tod=tod.astype(np.float32), pixels=pixels32,
+                         weights=weights.astype(np.float32),
+                         ground_ids=ground_ids, az=az, n_groups=group,
+                         npix=npix, wcs=wcs, nside=nside,
+                         sky_pixels=sky_pixels, files=kept_files)

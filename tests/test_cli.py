@@ -1,0 +1,201 @@
+"""CLI end-to-end: synthetic Level-1 filelist -> run_average ->
+run_destriper -> FITS maps with the injected source recovered."""
+
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                            generate_level1_file)
+from comapreduce_tpu.mapmaking.fits_io import read_fits_image
+from comapreduce_tpu.mapmaking.filelist import (create_filelist,
+                                                noise_level_mk,
+                                                write_filelist)
+
+
+@pytest.fixture(scope="module")
+def field_dataset(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cli")
+    files = []
+    for i in range(2):
+        params = SyntheticObsParams(
+            obsid=2_000_000 + i, source="co2", n_feeds=2, n_bands=2,
+            n_channels=32, n_scans=4, scan_samples=1200, vane_samples=250,
+            seed=100 + i, source_amplitude_k=5.0, source_fwhm_deg=0.15,
+            az_throw=2.0, fknee=1.0)
+        path = str(tmp / f"comap-{2_000_000 + i}.hd5")
+        generate_level1_file(path, params)
+        files.append(path)
+    return str(tmp), files
+
+
+def test_run_average_cli(field_dataset):
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli import run_average
+
+    filelist = os.path.join(tmp, "filelist.txt")
+    write_filelist(filelist, files)
+    config = os.path.join(tmp, "config.toml")
+    with open(config, "w") as f:
+        f.write(f'''
+[Global]
+processes = ["CheckLevel1File", "AssignLevel1Data",
+             "MeasureSystemTemperature", "Level1AveragingGainCorrection",
+             "Spikes", "Level2FitPowerSpectrum"]
+filelist = "{filelist}"
+output_dir = "{tmp}/level2"
+log_dir = "{tmp}/logs"
+
+[CheckLevel1File]
+min_duration_seconds = 1.0
+
+[Level1AveragingGainCorrection]
+medfilt_window = 501
+
+[Spikes]
+window = 101
+pad = 10
+
+[Level2FitPowerSpectrum]
+nbins = 12
+''')
+    assert run_average.main([config]) == 0
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2)
+    # logs written
+    logs = os.listdir(os.path.join(tmp, "logs"))
+    assert any("run_average" in p for p in logs)
+
+
+def test_create_filelist(field_dataset):
+    tmp, files = field_dataset
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2), "run after test_run_average_cli"
+    from comapreduce_tpu.data.level import COMAPLevel2
+
+    sig = noise_level_mk(COMAPLevel2(filename=l2[0]), band=0)
+    assert np.isfinite(sig) and sig > 0
+    good, rejected = create_filelist(l2, band=0, sigma_cut_mk=sig * 2)
+    assert set(good) | set(rejected) == set(l2)
+    assert l2[0] in good
+    bad, rej = create_filelist(["/nonexistent.hd5"], band=0)
+    assert rej == ["/nonexistent.hd5"] and not bad
+
+
+def test_run_destriper_cli(field_dataset):
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli import run_destriper
+
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2), "run after test_run_average_cli"
+    l2list = os.path.join(tmp, "l2list.txt")
+    write_filelist(l2list, l2)
+    ini = os.path.join(tmp, "params.ini")
+    with open(ini, "w") as f:
+        f.write(f"""
+[Inputs]
+filelist : {l2list}
+output_dir : {tmp}/maps
+prefix : co2
+bands : 0, 1
+offset_length : 50
+niter : 80
+threshold : 1e-6
+# the az-linear ground template is degenerate with a bright fixed-RA
+# source crossed at the same azimuths every sweep; keep it off here
+# (it has its own test below)
+ground : false
+
+[Pixelization]
+type : wcs
+crval : 170.0, 52.0
+cdelt : 0.0333333, 0.0333333
+shape : 240, 240
+""")
+    assert run_destriper.main([ini]) == 0
+    for band in (0, 1):
+        path = os.path.join(tmp, "maps", f"co2_band{band}.fits")
+        assert os.path.exists(path)
+        hdus = read_fits_image(path)
+        by_name = {name: data for name, hdr, data in hdus}
+        assert set(by_name) >= {"DESTRIPED", "NAIVE", "WEIGHTS", "HITS"}
+        hits = by_name["HITS"]
+        assert hits.shape == (240, 240)
+        assert hits.sum() > 0
+        # source region (map centre) was observed
+        c = hits[110:130, 110:130]
+        assert c.sum() > 0
+        m = by_name["DESTRIPED"]
+        # injected 5 K source dominates the map: the peak lands at the
+        # centre (within the beam + pixelisation)
+        iy, ix = np.unravel_index(np.nanargmax(np.where(hits > 0, m,
+                                                        -np.inf)), m.shape)
+        assert abs(iy - 120) < 8 and abs(ix - 120) < 8, (iy, ix)
+        # destriping does not inflate the noise: off-source rms no worse
+        # than the naive map's. Offsets crossing the bright source smear
+        # it along the scan rows, so exclude those rows entirely.
+        off = (hits > 0)
+        off[95:145, :] = False
+        if off.sum() > 100:
+            assert (np.nanstd(m[off])
+                    <= np.nanstd(by_name["NAIVE"][off]) * 1.2)
+
+
+def test_run_destriper_healpix(field_dataset):
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli.run_destriper import make_band_map
+    from comapreduce_tpu.mapmaking import healpix as hp
+
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2), "run after test_run_average_cli"
+    data, result = make_band_map(l2, 0, nside=512, offset_length=50,
+                                 n_iter=50)
+    assert data.sky_pixels is not None
+    assert data.npix == data.sky_pixels.size
+    assert data.npix < hp.nside2npix(512)  # compacted
+    assert np.isfinite(np.asarray(result.destriped_map)).all()
+    # seen pixels cluster around the field centre
+    lon, lat = hp.pix2ang_lonlat(512, data.sky_pixels)
+    assert (np.abs(lat - 52.0) < 6.0).all()
+
+
+def test_ground_template_removes_az_signal(field_dataset):
+    """The az-linear ground template absorbs an azimuth-locked
+    contaminant (op_Ax_with_ground, Destriper.py:265-336)."""
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli.run_destriper import make_band_map
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.destriper import destripe_jit
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2), "run after test_run_average_cli"
+    wcs = WCS.from_field((170.0, 52.0), (1.0 / 30, 1.0 / 30), (240, 240))
+    data = read_comap_data(l2, band=1, wcs=wcs, offset_length=50)
+    n = (data.tod.size // 50) * 50
+    # inject a pure ground signal: linear in normalised az per group
+    ground_amp = 0.5
+    tod = data.tod[:n] + ground_amp * data.az[:n]
+    res_plain = destripe_jit(tod, data.pixels[:n], data.weights[:n],
+                             data.npix, offset_length=50, n_iter=60)
+    res_ground = destripe_jit(tod, data.pixels[:n], data.weights[:n],
+                              data.npix, offset_length=50, n_iter=60,
+                              ground_ids=data.ground_ids[:n], az=data.az[:n],
+                              n_groups=data.n_groups)
+    g = np.asarray(res_ground.ground)
+    assert g.shape == (data.n_groups, 2)
+    # the az->RA mapping of a CES scan makes an az-linear signal partly
+    # degenerate with a sky gradient, so only part of the slope is
+    # attributed to the ground template (the reference breaks this with
+    # multi-geometry data); assert the right sign and magnitude range
+    assert (g[:, 1] > 0.15).all() and (g[:, 1] < ground_amp).all(), g
+    hit = np.asarray(res_ground.hit_map) > 0
+    std_g = np.nanstd(np.asarray(res_ground.destriped_map)[hit])
+    std_p = np.nanstd(np.asarray(res_plain.destriped_map)[hit])
+    assert std_g < std_p
