@@ -1,0 +1,16 @@
+"""Observation-metadata database (parity with ``COMAPDatabase/``).
+
+Fleet-level observability store: per-obsid stats, quality flags, and
+calibration factors in one HDF5 file, with the reference's tooling roles
+— stats harvesting from Level-2 files, threshold-based flag assignment
+(``assign_stats_flags.py``), smoothed calibration-factor assignment
+(``assign_calibration_factors.py:7-60`` + the outlier-robust smoothing of
+``data/Data.py:13-98``), and source-based filelist queries
+(``query_source.py:31-60``). The Google-Sheets observer-flag sync is
+replaced by a CSV import (no gspread in this image).
+"""
+
+from comapreduce_tpu.database.obsdb import (ObsDatabase, robust_smooth,
+                                            assign_stats_flags)
+
+__all__ = ["ObsDatabase", "robust_smooth", "assign_stats_flags"]

@@ -1,0 +1,215 @@
+"""HDF5-backed observation database.
+
+Schema (one group per obsid, ``COMAPDatabase/README`` parity)::
+
+    <obsid>/
+        attrs: source, mjd, level2_path, flag (int; 0 = good)
+        stats/   noise_mk, tsys_median, fnoise_median (per band)
+        calibration/ factors (F, B), good (F, B)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from comapreduce_tpu.data.hdf5io import HDF5Store
+from comapreduce_tpu.data.level import COMAPLevel2
+
+__all__ = ["ObsDatabase", "robust_smooth", "assign_stats_flags"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+# flag bits (assign_stats_flags.py role)
+FLAG_GOOD = 0
+FLAG_NOISY = 1 << 0        # white level above threshold
+FLAG_NO_CAL = 1 << 1       # no valid calibration factors
+FLAG_OBSERVER = 1 << 2     # manual/observer flag (CSV import)
+FLAG_BAD_WEATHER = 1 << 3  # high fnoise
+
+
+def robust_smooth(mjds: np.ndarray, values: np.ndarray,
+                  window_days: float = 30.0, n_sigma: float = 3.0):
+    """Outlier-robust running median (``data/Data.py:13-98`` smoothing):
+    median within ±window/2, after rejecting points > n_sigma MADs."""
+    mjds = np.asarray(mjds, np.float64)
+    values = np.asarray(values, np.float64)
+    out = np.empty_like(values)
+    med_all = np.nanmedian(values)
+    mad = np.nanmedian(np.abs(values - med_all)) * 1.4826 + 1e-30
+    keep = np.abs(values - med_all) < n_sigma * mad
+    for i, t in enumerate(mjds):
+        sel = keep & (np.abs(mjds - t) <= window_days / 2.0)
+        out[i] = np.nanmedian(values[sel]) if sel.any() else med_all
+    return out
+
+
+class ObsDatabase:
+    """Dict-of-obsid records persisted to one HDF5 file."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.store = HDF5Store(name="obsdb")
+        if os.path.exists(filename):
+            self.store.read(filename)
+
+    # -- record access ------------------------------------------------------
+    def obsids(self) -> list[int]:
+        ids = {p.split("/")[0] for p in self.store.keys()}
+        ids |= {p.split("/")[0] for p, _ in self.store.attr_items() if p}
+        return sorted(int(i) for i in ids if i.isdigit())
+
+    def get_attr(self, obsid: int, key: str, default=None):
+        try:
+            return self.store.attrs(str(obsid), key)
+        except KeyError:
+            return default
+
+    def set_attr(self, obsid: int, key: str, value) -> None:
+        self.store.set_attrs(str(obsid), key, value)
+
+    def get(self, obsid: int, path: str, default=None):
+        return self.store.get(f"{obsid}/{path}", default)
+
+    def set(self, obsid: int, path: str, value) -> None:
+        self.store[f"{obsid}/{path}"] = value
+
+    def save(self) -> None:
+        self.store.write(self.filename, atomic=True)
+
+    # -- harvesting ---------------------------------------------------------
+    def update_from_level2(self, filenames) -> int:
+        """Harvest per-obsid stats from Level-2 files
+        (the ``COMAPDatabase`` stats-collection role)."""
+        from comapreduce_tpu.mapmaking.filelist import noise_level_mk
+
+        count = 0
+        for fname in filenames:
+            try:
+                lvl2 = COMAPLevel2(filename=fname)
+                obsid = lvl2.obsid
+                if obsid < 0:
+                    continue
+                tod = np.asarray(lvl2["averaged_tod/tod"])
+                B = tod.shape[1]
+                noise = np.array([noise_level_mk(lvl2, b)
+                                  for b in range(B)])
+                self.set(obsid, "stats/noise_mk", noise)
+                if "vane/system_temperature" in lvl2:
+                    tsys = np.asarray(lvl2.system_temperature)
+                    ok = tsys > 0
+                    med = np.where(
+                        ok.any(axis=(0, 3)),
+                        np.nanmedian(np.where(ok, tsys, np.nan),
+                                     axis=(0, 3)), 0.0)
+                    self.set(obsid, "stats/tsys_median", med)
+                if "fnoise_fits/fnoise_fit_parameters" in lvl2:
+                    fn = np.asarray(
+                        lvl2["fnoise_fits/fnoise_fit_parameters"])
+                    self.set(obsid, "stats/fnoise_median",
+                             np.nanmedian(fn, axis=(0, 2)))
+                if "astro_calibration/calibration_factors" in lvl2:
+                    fac = np.asarray(
+                        lvl2["astro_calibration/calibration_factors"])
+                    self.set(obsid, "calibration/factors", fac)
+                    good = lvl2.get("astro_calibration/calibration_good")
+                    self.set(obsid, "calibration/good",
+                             np.asarray(good) if good is not None
+                             else np.ones(fac.shape, np.uint8))
+                self.set_attr(obsid, "source", lvl2.source_name)
+                self.set_attr(obsid, "mjd",
+                              float(np.mean(np.asarray(lvl2.mjd))))
+                self.set_attr(obsid, "level2_path", os.path.abspath(fname))
+                if self.get_attr(obsid, "flag") is None:
+                    self.set_attr(obsid, "flag", FLAG_GOOD)
+                count += 1
+            except (OSError, KeyError) as exc:
+                logger.warning("obsdb: BAD FILE %s (%s)", fname, exc)
+        return count
+
+    # -- flags --------------------------------------------------------------
+    def import_observer_flags(self, csv_path: str) -> int:
+        """CSV ``obsid,flagged`` import (the Google-Sheets sync stand-in,
+        ``comap_wiki_flags.py:24-38``)."""
+        n = 0
+        with open(csv_path) as f:
+            for line in f:
+                parts = line.strip().split(",")
+                if len(parts) < 2 or not parts[0].strip().isdigit():
+                    continue
+                obsid = int(parts[0])
+                flagged = parts[1].strip().lower() in ("1", "true", "yes")
+                flag = int(self.get_attr(obsid, "flag", FLAG_GOOD) or 0)
+                if flagged:
+                    flag |= FLAG_OBSERVER
+                else:
+                    flag &= ~FLAG_OBSERVER
+                self.set_attr(obsid, "flag", flag)
+                n += 1
+        return n
+
+    # -- queries ------------------------------------------------------------
+    def query_source(self, source: str, good_only: bool = True
+                     ) -> list[str]:
+        """Level-2 paths of observations of ``source``
+        (``query_source.py:31-60``)."""
+        out = []
+        for obsid in self.obsids():
+            if str(self.get_attr(obsid, "source", "")) != source:
+                continue
+            if good_only and int(self.get_attr(obsid, "flag", 0) or 0):
+                continue
+            path = self.get_attr(obsid, "level2_path")
+            if path is not None:
+                out.append(str(path))
+        return out
+
+    def smoothed_calibration_factors(self, window_days: float = 30.0):
+        """Per-(feed, band) calibration factors smoothed over time with
+        the outlier-robust median (``assign_calibration_factors.py:7-60``).
+        Returns (mjds, smoothed[T, F, B])."""
+        recs = []
+        for obsid in self.obsids():
+            fac = self.get(obsid, "calibration/factors")
+            mjd = self.get_attr(obsid, "mjd")
+            if fac is None or mjd is None:
+                continue
+            recs.append((float(mjd), np.asarray(fac)))
+        if not recs:
+            return np.zeros(0), np.zeros((0, 0, 0))
+        recs.sort(key=lambda r: r[0])
+        mjds = np.array([r[0] for r in recs])
+        fac = np.stack([r[1] for r in recs])  # (T, F, B)
+        out = np.empty_like(fac)
+        T, F, B = fac.shape
+        for f in range(F):
+            for b in range(B):
+                out[:, f, b] = robust_smooth(mjds, fac[:, f, b],
+                                             window_days)
+        return mjds, out
+
+
+def assign_stats_flags(db: ObsDatabase, noise_cut_mk: float = 4.0,
+                       fnoise_red_cut: float | None = None) -> int:
+    """Threshold-based quality flags (``assign_stats_flags.py`` role)."""
+    n = 0
+    for obsid in db.obsids():
+        flag = int(db.get_attr(obsid, "flag", FLAG_GOOD) or 0)
+        noise = db.get(obsid, "stats/noise_mk")
+        flag &= ~(FLAG_NOISY | FLAG_BAD_WEATHER | FLAG_NO_CAL)
+        if noise is not None and np.nanmedian(np.asarray(noise)) \
+                > noise_cut_mk:
+            flag |= FLAG_NOISY
+        if fnoise_red_cut is not None:
+            fn = db.get(obsid, "stats/fnoise_median")
+            if fn is not None and np.nanmedian(
+                    np.asarray(fn)[..., 1]) > fnoise_red_cut:
+                flag |= FLAG_BAD_WEATHER
+        good = db.get(obsid, "calibration/good")
+        if good is not None and not np.asarray(good).any():
+            flag |= FLAG_NO_CAL
+        db.set_attr(obsid, "flag", flag)
+        n += 1
+    return n

@@ -196,7 +196,12 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
         safe_gain = jnp.where(sys_gain > 0, sys_gain, 1.0)
         residual = sub * norm / safe_gain[..., None]
         tod_clean = weighted_band_average(residual, w)            # (B, L)
-        in_kelvin = filtered * tsys[..., None]
+        # tod_original: same exact counts->kelvin reconstruction
+        # (norm/gain), just without the gain-fluctuation subtraction.
+        # Scaling by tsys instead would distort whenever the auto-rms is
+        # contaminated (e.g. by a bright calibrator transit): norm/gain
+        # cancels the normalisation exactly, tsys only approximates it.
+        in_kelvin = filtered * norm / safe_gain[..., None]
         tod_orig = weighted_band_average(in_kelvin, w)            # (B, L)
 
         # per-band weights from the residual's auto-rms

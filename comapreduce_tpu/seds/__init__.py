@@ -1,0 +1,16 @@
+"""SED fitting toolkit (parity with the reference ``SEDs/`` package).
+
+Emission-component models (synchrotron, free-free, AME, thermal dust,
+CMB — ``SEDs/emission.py:14-107``) and a fitting driver (``SEDs/tools.py
+SED`` class). The reference fits with emcee MCMC; emcee is not in this
+image, so the driver offers the batched Levenberg-Marquardt solver (the
+pipeline's workhorse) plus a dependency-free Metropolis-Hastings sampler
+for posterior estimates.
+"""
+
+from comapreduce_tpu.seds.emission import (ame, cmb, freefree, synchrotron,
+                                           thermal_dust, total_model)
+from comapreduce_tpu.seds.fit import SED, mh_sample
+
+__all__ = ["synchrotron", "freefree", "ame", "thermal_dust", "cmb",
+           "total_model", "SED", "mh_sample"]

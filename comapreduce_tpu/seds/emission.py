@@ -1,0 +1,90 @@
+"""Emission-component SED models (``SEDs/emission.py:14-107`` parity).
+
+All models return flux density [Jy] over a solid angle ``omega_sr`` at
+``freq_ghz``. Parameters are in the log/natural units the fitter uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.calibration.unitconv import (blackbody, k_to_jy,
+                                                  planck_correction)
+from comapreduce_tpu.simulations.frequency_models import lognormal_ame
+
+__all__ = ["synchrotron", "freefree", "ame", "thermal_dust", "cmb",
+           "total_model", "DEFAULT_COMPONENTS"]
+
+
+def _rj_to_jy(t_k, freq_ghz, omega_sr):
+    return k_to_jy(t_k, freq_ghz, omega_sr)
+
+
+def synchrotron(freq_ghz, omega_sr, amp_k, index=-3.0, freq0=30.0):
+    """Power-law synchrotron: ``amp_k`` K RJ at ``freq0``."""
+    t = amp_k * (np.asarray(freq_ghz, np.float64) / freq0) ** index
+    return _rj_to_jy(t, freq_ghz, omega_sr)
+
+
+def freefree(freq_ghz, omega_sr, em_pc_cm6, t_e=7500.0):
+    """Free-free from emission measure [pc cm^-6] (Draine 2011 approx
+    gaunt factor, as the reference uses)."""
+    nu9 = np.asarray(freq_ghz, np.float64)
+    t4 = t_e / 1e4
+    g = np.log(np.exp(5.960 - np.sqrt(3.0) / np.pi
+                      * np.log(nu9 * t4 ** (-1.5))) + np.e)
+    tau = 5.468e-2 * t_e ** (-1.5) * nu9 ** (-2.0) * em_pc_cm6 * g
+    t_ff = t_e * (1.0 - np.exp(-tau))
+    return _rj_to_jy(t_ff, freq_ghz, omega_sr)
+
+
+def ame(freq_ghz, omega_sr, amp_k, freq_peak=25.0, width=0.5):
+    """Anomalous microwave emission: log-normal bump (the spdust-table
+    stand-in; same parameterisation as Simulations)."""
+    t = amp_k * lognormal_ame(freq_ghz, freq_peak, width)
+    return _rj_to_jy(t, freq_ghz, omega_sr)
+
+
+def thermal_dust(freq_ghz, omega_sr, tau_353, beta=1.6, t_dust=19.6):
+    """Modified blackbody anchored at 353 GHz optical depth."""
+    nu = np.asarray(freq_ghz, np.float64)
+    tau = tau_353 * (nu / 353.0) ** beta
+    i_nu = tau * blackbody(nu, t_dust)  # W m^-2 Hz^-1 sr^-1
+    return i_nu * omega_sr * 1e26
+
+
+def cmb(freq_ghz, omega_sr, dt_cmb_k):
+    """CMB anisotropy: thermodynamic dT -> Jy (dT_RJ = dT_CMB / g)."""
+    conv = 1.0 / planck_correction(freq_ghz)
+    return _rj_to_jy(dt_cmb_k * conv, freq_ghz, omega_sr)
+
+
+DEFAULT_COMPONENTS = ("synchrotron", "freefree", "ame", "thermal_dust",
+                      "cmb")
+
+
+def total_model(params: dict, freq_ghz, omega_sr,
+                components=DEFAULT_COMPONENTS):
+    """Sum the selected components. ``params`` keys: ``sync_amp``,
+    ``sync_index``, ``em``, ``ame_amp``, ``ame_peak``, ``tau353``,
+    ``dust_beta``, ``dust_temp``, ``cmb_dt`` (missing -> defaults/0)."""
+    p = params
+    total = np.zeros_like(np.asarray(freq_ghz, np.float64))
+    if "synchrotron" in components:
+        total = total + synchrotron(freq_ghz, omega_sr,
+                                    p.get("sync_amp", 0.0),
+                                    p.get("sync_index", -3.0))
+    if "freefree" in components:
+        total = total + freefree(freq_ghz, omega_sr, p.get("em", 0.0))
+    if "ame" in components:
+        total = total + ame(freq_ghz, omega_sr, p.get("ame_amp", 0.0),
+                            p.get("ame_peak", 25.0),
+                            p.get("ame_width", 0.5))
+    if "thermal_dust" in components:
+        total = total + thermal_dust(freq_ghz, omega_sr,
+                                     p.get("tau353", 0.0),
+                                     p.get("dust_beta", 1.6),
+                                     p.get("dust_temp", 19.6))
+    if "cmb" in components:
+        total = total + cmb(freq_ghz, omega_sr, p.get("cmb_dt", 0.0))
+    return total

@@ -1,0 +1,181 @@
+"""SED fitting driver (``SEDs/tools.py`` ``SED`` class parity).
+
+Least-squares via the shared LM solver (log-parameter positivity), plus
+a dependency-free Metropolis-Hastings sampler standing in for the
+reference's emcee MCMC (``SEDs/mcmc.py:40``, ``tools.py:333``): returns
+chains, means, and covariances — everything the reference's corner/
+walker plots consume.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from comapreduce_tpu.seds.emission import DEFAULT_COMPONENTS, total_model
+
+__all__ = ["SED", "mh_sample"]
+
+# fit parameters per component: (name, default, positive?)
+_COMPONENT_PARAMS = {
+    "synchrotron": (("sync_amp", 1e-3, True), ("sync_index", -3.0, False)),
+    "freefree": (("em", 10.0, True),),
+    "ame": (("ame_amp", 1e-3, True), ("ame_peak", 25.0, True)),
+    "thermal_dust": (("tau353", 1e-6, True),),
+    "cmb": (("cmb_dt", 1e-5, False),),
+}
+
+
+def mh_sample(log_prob, p0: np.ndarray, n_steps: int = 4000,
+              step_scale: np.ndarray | float = 0.05,
+              seed: int = 0, burn: int = 1000):
+    """Random-walk Metropolis with a FIXED symmetric proposal.
+
+    Step sizes are frozen from the starting point (``step_scale *
+    max(|p0|, 0.05)`` per parameter) — a state-dependent scale would make
+    the proposal asymmetric and bias the chain without a Hastings
+    correction, and a pure relative scale freezes parameters near zero.
+    Returns (chain, acceptance)."""
+    rng = np.random.default_rng(seed)
+    p = np.asarray(p0, np.float64).copy()
+    lp = log_prob(p)
+    rel = np.broadcast_to(np.asarray(step_scale, np.float64), p.shape)
+    step = rel * np.maximum(np.abs(p), 0.05)
+    chain = np.empty((n_steps, p.size))
+    accepted = 0
+    for i in range(n_steps):
+        prop = p + step * rng.normal(size=p.shape)
+        lp_new = log_prob(prop)
+        if np.isfinite(lp_new) and np.log(rng.random()) < lp_new - lp:
+            p, lp = prop, lp_new
+            accepted += 1
+        chain[i] = p
+    return chain[burn:], accepted / n_steps
+
+
+@dataclass
+class SED:
+    """Fit emission components to flux measurements.
+
+    ``freq_ghz``/``flux_jy``/``flux_err_jy``: 1-D measurement vectors;
+    ``omega_sr``: aperture solid angle; ``components``: subset of
+    :data:`DEFAULT_COMPONENTS`.
+    """
+
+    freq_ghz: np.ndarray
+    flux_jy: np.ndarray
+    flux_err_jy: np.ndarray
+    omega_sr: float
+    components: tuple = DEFAULT_COMPONENTS
+    params: dict = field(default_factory=dict)
+    errors: dict = field(default_factory=dict)
+    chain: np.ndarray | None = None
+
+    def _param_spec(self):
+        spec = []
+        for c in self.components:
+            spec.extend(_COMPONENT_PARAMS[c])
+        return spec
+
+    def _to_dict(self, vec):
+        out = {}
+        for (name, _, positive), v in zip(self._param_spec(), vec):
+            out[name] = float(np.exp(v)) if positive else float(v)
+        return out
+
+    def _to_vec(self, d):
+        vec = []
+        for name, default, positive in self._param_spec():
+            v = d.get(name, default)
+            vec.append(np.log(max(v, 1e-30)) if positive else v)
+        return np.asarray(vec, np.float64)
+
+    def model(self, params: dict, freq_ghz=None):
+        return total_model(params,
+                           self.freq_ghz if freq_ghz is None else freq_ghz,
+                           self.omega_sr, self.components)
+
+    def chi2(self, params: dict) -> float:
+        r = (self.model(params) - self.flux_jy) / self.flux_err_jy
+        return float(np.sum(r * r))
+
+    def fit(self, p0: dict | None = None, n_iter: int = 200) -> dict:
+        """Levenberg-Marquardt least squares in the transformed
+        (log-positive) parameter space. Host NumPy with finite-difference
+        Jacobians — SED fits are tiny (N_freq x ~8 params) and never a
+        device hot path (the reference runs emcee on host too)."""
+        spec = self._param_spec()
+
+        def residual(v):
+            m = self.model(self._to_dict(v))
+            return (m - self.flux_jy) / self.flux_err_jy
+
+        def jacobian(v):
+            r0 = residual(v)
+            J = np.empty((r0.size, v.size))
+            for i in range(v.size):
+                h = 1e-6 * max(abs(v[i]), 1.0)
+                vp = v.copy()
+                vp[i] += h
+                J[:, i] = (residual(vp) - r0) / h
+            return J, r0
+
+        v = self._to_vec(p0 or {})
+        lam = 1e-3
+        c2 = float(np.sum(residual(v) ** 2))
+        for _ in range(n_iter):
+            J, r = jacobian(v)
+            H = J.T @ J
+            g = J.T @ r
+            try:
+                delta = np.linalg.solve(
+                    H + lam * np.diag(np.maximum(np.diag(H), 1e-12)), g)
+            except np.linalg.LinAlgError:
+                lam *= 10.0
+                continue
+            v_new = v - delta
+            c2_new = float(np.sum(residual(v_new) ** 2))
+            if np.isfinite(c2_new) and c2_new < c2:
+                v, c2 = v_new, c2_new
+                lam = max(lam * 0.3, 1e-10)
+                if abs(delta).max() < 1e-10:
+                    break
+            else:
+                lam = min(lam * 8.0, 1e8)
+        J, r = jacobian(v)
+        dof = max(r.size - v.size, 1)
+        cov = np.linalg.pinv(J.T @ J) * c2 / dof
+        err = np.sqrt(np.maximum(np.diag(cov), 0.0))
+        self.params = self._to_dict(v)
+        self.errors = {}
+        for (name, _, positive), vi, ei in zip(spec, v, err):
+            # transform log-space sigma back to natural units
+            self.errors[name] = (float(np.exp(vi) * ei) if positive
+                                 else float(ei))
+        self.chi2_value = float(c2)
+        return self.params
+
+    def mcmc_fit(self, n_steps: int = 4000, seed: int = 0) -> dict:
+        """Posterior sampling (the emcee stand-in). Seeds from the LM fit
+        when available; stores the chain for corner-style analysis."""
+        if not self.params:
+            self.fit()
+        v0 = self._to_vec(self.params)
+
+        def log_prob(v):
+            d = self._to_dict(v)
+            return -0.5 * self.chi2(d)
+
+        chain, acc = mh_sample(log_prob, v0, n_steps=n_steps, seed=seed)
+        self.chain = chain
+        mean = chain.mean(axis=0)
+        std = chain.std(axis=0)
+        spec = self._param_spec()
+        self.params = self._to_dict(mean)
+        self.errors = {name: (float(np.exp(m) * s) if positive
+                              else float(s))
+                       for (name, _, positive), m, s
+                       in zip(spec, mean, std)}
+        self.acceptance = acc
+        return self.params

@@ -1,0 +1,21 @@
+"""Sky-model simulations and TOD signal injection.
+
+Parity with the reference ``Simulations/`` package (SURVEY.md §2.7):
+frequency laws (``FrequencyModels.py:7-35``), sky components evaluated at
+(lon, lat, freq) (``Models.py:11-100``), a summing ``SkyModel``
+(``SkyModel.py:6-37``), and TOD injection into Level-1 files for
+pipeline-level signal-recovery tests (the reference configures this via
+``ParameterFiles/Sim_SkyMaps.ini``).
+"""
+
+from comapreduce_tpu.simulations.frequency_models import (blackbody_law,
+                                                          lognormal_ame,
+                                                          power_law)
+from comapreduce_tpu.simulations.models import (GaussianComponent,
+                                                HealpixComponent,
+                                                PointSourceComponent)
+from comapreduce_tpu.simulations.skymodel import SkyModel, inject_level1
+
+__all__ = ["power_law", "lognormal_ame", "blackbody_law",
+           "GaussianComponent", "PointSourceComponent", "HealpixComponent",
+           "SkyModel", "inject_level1"]

@@ -1,0 +1,38 @@
+"""Frequency scaling laws for sky components
+(``Simulations/FrequencyModels.py:7-35`` parity).
+
+Each law maps ``freq_ghz -> multiplicative amplitude`` relative to a
+reference frequency, in Rayleigh-Jeans temperature units.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.calibration.unitconv import blackbody
+
+__all__ = ["power_law", "lognormal_ame", "blackbody_law"]
+
+
+def power_law(freq_ghz, freq0_ghz: float = 30.0, index: float = -3.0):
+    """``(nu/nu0)^index`` — synchrotron-like RJ scaling."""
+    return (np.asarray(freq_ghz, np.float64) / freq0_ghz) ** index
+
+
+def lognormal_ame(freq_ghz, freq_peak_ghz: float = 25.0,
+                  width: float = 0.5):
+    """Log-normal bump peaking at ``freq_peak_ghz`` — the spinning-dust
+    (AME) approximation the reference draws from its spdust tables."""
+    nu = np.asarray(freq_ghz, np.float64)
+    x = np.log(nu / freq_peak_ghz)
+    return np.exp(-0.5 * (x / width) ** 2)
+
+
+def blackbody_law(freq_ghz, freq0_ghz: float = 30.0, t_dust: float = 19.6,
+                  beta: float = 1.6):
+    """Modified-blackbody (thermal dust) RJ scaling relative to ``nu0``:
+    ``(nu/nu0)^(beta) * B_nu(T)/B_nu0(T) * (nu0/nu)^2`` in RJ units."""
+    nu = np.asarray(freq_ghz, np.float64)
+    b_ratio = blackbody(nu, t_dust) / blackbody(freq0_ghz, t_dust)
+    rj = (freq0_ghz / nu) ** 2  # intensity -> RJ temperature
+    return (nu / freq0_ghz) ** beta * b_ratio * rj

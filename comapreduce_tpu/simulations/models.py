@@ -1,0 +1,94 @@
+"""Sky components evaluated at (lon, lat, freq)
+(``Simulations/Models.py:11-100`` parity).
+
+Each component returns RJ brightness temperature [K] with shape
+``broadcast(lon/lat) x freq``. The reference's ``BasicSkyComponent``
+wraps an analytic profile, ``HealpixSkyComponent`` interpolates a map;
+here: Gaussian / point-source analytic components plus a HEALPix map
+component backed by the framework's own pixelisation (nearest-pixel
+lookup — the reference uses healpy ``get_interp_val``; COMAP beams are
+much wider than the nside used, see ``Sim_SkyMaps.ini``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from comapreduce_tpu.mapmaking import healpix as hp
+
+__all__ = ["GaussianComponent", "PointSourceComponent", "HealpixComponent"]
+
+
+def _unity(freq_ghz):
+    return np.ones_like(np.asarray(freq_ghz, np.float64))
+
+
+@dataclass
+class GaussianComponent:
+    """Elliptical Gaussian blob: amplitude [K RJ] at ``freq0``."""
+
+    lon0: float
+    lat0: float
+    amplitude_k: float
+    fwhm_deg: float
+    freq_law: Callable = field(default=_unity)
+
+    def __call__(self, lon_deg, lat_deg, freq_ghz):
+        sig = self.fwhm_deg / 2.355
+        dx = ((np.asarray(lon_deg, np.float64) - self.lon0 + 180.0) % 360.0
+              - 180.0) * np.cos(np.radians(np.asarray(lat_deg, np.float64)))
+        dy = np.asarray(lat_deg, np.float64) - self.lat0
+        spatial = self.amplitude_k * np.exp(-0.5 * (dx**2 + dy**2) / sig**2)
+        law = np.asarray(self.freq_law(freq_ghz), np.float64)
+        return spatial[..., None] * law[None, ...] if law.ndim else \
+            spatial * law
+
+
+@dataclass
+class PointSourceComponent:
+    """Point source smoothed by the instrument beam (delta x beam =
+    Gaussian at the beam width)."""
+
+    lon0: float
+    lat0: float
+    flux_jy: float
+    beam_fwhm_deg: float = 0.075
+    freq0_ghz: float = 30.0
+    freq_law: Callable = field(default=_unity)
+
+    def peak_k(self) -> float:
+        from comapreduce_tpu.calibration.unitconv import (
+            gaussian_solid_angle, jy_to_k)
+
+        sig = self.beam_fwhm_deg / 2.355
+        return float(jy_to_k(self.flux_jy, self.freq0_ghz,
+                             gaussian_solid_angle(sig, sig)))
+
+    def __call__(self, lon_deg, lat_deg, freq_ghz):
+        g = GaussianComponent(self.lon0, self.lat0, self.peak_k(),
+                              self.beam_fwhm_deg, self.freq_law)
+        return g(lon_deg, lat_deg, freq_ghz)
+
+
+@dataclass
+class HealpixComponent:
+    """A HEALPix map [K RJ] sampled by nearest pixel, with a frequency
+    law (``HealpixSkyComponent``, ``Models.py:54-100``)."""
+
+    sky_map: np.ndarray
+    nest: bool = False
+    freq_law: Callable = field(default=_unity)
+
+    def __post_init__(self):
+        self.nside = hp.npix2nside(len(self.sky_map))
+
+    def __call__(self, lon_deg, lat_deg, freq_ghz):
+        pix = np.asarray(hp.ang2pix_lonlat(self.nside, lon_deg, lat_deg,
+                                           nest=self.nest))
+        spatial = np.asarray(self.sky_map)[pix]
+        law = np.asarray(self.freq_law(freq_ghz), np.float64)
+        return spatial[..., None] * law[None, ...] if law.ndim else \
+            spatial * law

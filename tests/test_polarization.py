@@ -1,0 +1,84 @@
+"""Polarized destriper: recover I/Q/U from a simulated polarized scan
+with 1/f noise (the asserted version of the reference's ``testpol``,
+``MapMaking/Destriper.py:617-753``)."""
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.data.synthetic import one_over_f_noise
+from comapreduce_tpu.mapmaking.polarization import (destripe_pol_jit,
+                                                    pol_map_solve,
+                                                    _pol_accumulate)
+
+import jax.numpy as jnp
+
+
+def _simulate(npix=64, revisits=40, sigma=0.1, fknee=0.5, seed=0):
+    """Scan a tiny pixel ring many times with rotating psi."""
+    rng = np.random.default_rng(seed)
+    n = npix * revisits
+    n = (n // 50) * 50
+    pixels = np.arange(n) % npix
+    psi = np.linspace(0, np.pi, n) + 0.3 * np.sin(np.arange(n) / 77.0)
+    I = 1.0 + rng.normal(size=npix) * 0.3
+    Q = 0.3 * rng.normal(size=npix)
+    U = 0.3 * rng.normal(size=npix)
+    d = (I[pixels] + Q[pixels] * np.cos(2 * psi)
+         + U[pixels] * np.sin(2 * psi))
+    noise = one_over_f_noise(rng, n, sigma, fknee, 1.5, fs=50.0)
+    weights = np.full(n, 1.0 / sigma**2, np.float32)
+    return (jnp.asarray(d + noise, jnp.float32),
+            jnp.asarray(pixels.astype(np.int32)),
+            jnp.asarray(weights), jnp.asarray(psi, jnp.float32),
+            npix, I, Q, U)
+
+
+def test_pol_map_solve_noiseless():
+    d, pixels, weights, psi, npix, I, Q, U = _simulate(sigma=1e-9, seed=1)
+    c2, s2 = jnp.cos(2 * psi), jnp.sin(2 * psi)
+    state = _pol_accumulate(pixels, weights, c2, s2, npix, None)
+    assert bool(state.rcond_ok.all())
+    m = np.asarray(pol_map_solve(d, pixels, weights, c2, s2, npix, state))
+    assert np.allclose(m[:, 0], I, atol=1e-4)
+    assert np.allclose(m[:, 1], Q, atol=1e-4)
+    assert np.allclose(m[:, 2], U, atol=1e-4)
+
+
+def test_destripe_pol_recovers_iqu():
+    d, pixels, weights, psi, npix, I, Q, U = _simulate(
+        sigma=0.05, fknee=1.0, seed=2)
+    res = destripe_pol_jit(d, pixels, weights, psi, npix,
+                           offset_length=50, n_iter=80)
+    m = np.asarray(res.iqu_destriped)
+    naive = np.asarray(res.iqu_naive)
+    ok = np.asarray(res.solvable)
+    assert ok.all()
+    # destriped IQU within a few noise sigma of the truth; per-pixel noise
+    # rms ~ sigma/sqrt(revisits/3)
+    for k, truth in enumerate((I, Q, U)):
+        err_d = np.abs(m[:, k] - truth)
+        assert np.median(err_d) < 0.05, (k, np.median(err_d))
+    # the slowly-varying 1/f noise aliases mostly into I (psi rotates
+    # slowly, so cos/sin 2psi are near-constant within an offset): the
+    # destriper's comparative win over the naive solve shows in I
+    err_d_i = np.abs(m[:, 0] - I)
+    err_n_i = np.abs(naive[:, 0] - I)
+    assert np.median(err_d_i) <= np.median(err_n_i) * 1.05
+    assert int(res.n_iter) > 0
+    assert float(res.residual) < 1e-2
+
+
+def test_destripe_pol_rank_deficient_pixels_masked():
+    """Pixels observed at a single angle can't separate I/Q/U."""
+    n = 500
+    npix = 10
+    pixels = np.arange(n) % npix
+    psi = np.zeros(n)  # no angle diversity anywhere
+    rng = np.random.default_rng(3)
+    d = rng.normal(size=n).astype(np.float32)
+    w = np.ones(n, np.float32)
+    res = destripe_pol_jit(jnp.asarray(d), jnp.asarray(pixels, jnp.int32),
+                           jnp.asarray(w), jnp.asarray(psi, jnp.float32),
+                           npix, offset_length=50, n_iter=10)
+    assert not bool(np.asarray(res.solvable).any())
+    assert np.allclose(np.asarray(res.iqu_destriped), 0.0)

@@ -1,0 +1,229 @@
+"""Simulations, SEDs, and observation-database tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.simulations import (GaussianComponent,
+                                         HealpixComponent,
+                                         PointSourceComponent, SkyModel,
+                                         blackbody_law, inject_level1,
+                                         lognormal_ame, power_law)
+
+
+# -- frequency laws ---------------------------------------------------------
+
+def test_frequency_laws():
+    assert abs(power_law(30.0, 30.0, -3.0) - 1.0) < 1e-12
+    assert power_law(60.0, 30.0, -3.0) == pytest.approx(0.125)
+    assert lognormal_ame(25.0, 25.0) == pytest.approx(1.0)
+    assert lognormal_ame(80.0, 25.0) < 0.1
+    # dust rises steeply with frequency (beta+2-2 = beta RJ slope approx)
+    assert blackbody_law(60.0) > blackbody_law(30.0)
+
+
+# -- components / sky model -------------------------------------------------
+
+def test_gaussian_component_and_model():
+    comp = GaussianComponent(170.0, 52.0, 2.0, 0.2,
+                             freq_law=lambda f: power_law(f, 30.0, -2.0))
+    model = SkyModel([comp])
+    freq = np.array([30.0, 60.0])
+    t = model(np.array([170.0, 171.0]), np.array([52.0, 52.0]), freq)
+    assert t.shape == (2, 2)
+    assert t[0, 0] == pytest.approx(2.0)
+    assert t[0, 1] == pytest.approx(0.5)   # (60/30)^-2
+    assert t[1, 0] < 1e-6                  # 1 deg away >> fwhm
+
+
+def test_point_source_component():
+    ps = PointSourceComponent(83.6, 22.0, flux_jy=370.0)
+    peak = ps.peak_k()
+    assert 5.0 < peak < 9.0  # TauA-like in the COMAP beam
+    v = ps(np.array([83.6]), np.array([22.0]), 30.0)
+    assert v[0] == pytest.approx(peak)
+
+
+def test_healpix_component():
+    from comapreduce_tpu.mapmaking import healpix as hp
+
+    nside = 32
+    m = np.zeros(hp.nside2npix(nside))
+    pix = int(np.asarray(hp.ang2pix_lonlat(nside, 170.0, 52.0)))
+    m[pix] = 3.0
+    comp = HealpixComponent(m)
+    v = comp(np.array([170.0]), np.array([52.0]), 30.0)
+    assert v[0] == pytest.approx(3.0)
+
+
+def test_inject_level1_recovered_by_pipeline(tmp_path):
+    """Injected sky signal survives the full reduction: the backbone of
+    signal-recovery testing (reference Simulations role)."""
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
+                                                 Level1AveragingGainCorrection,
+                                                 MeasureSystemTemperature)
+
+    params = SyntheticObsParams(n_feeds=1, n_bands=2, n_channels=32,
+                                n_scans=3, scan_samples=800,
+                                vane_samples=250, seed=33,
+                                az_throw=1.0)
+    path = str(tmp_path / "obs.hd5")
+    p = generate_level1_file(path, params)
+    # beam-sized, ~1 K source: bright enough to stand over the noise,
+    # narrow enough not to contaminate the auto-rms normalisation (a
+    # broad many-K source inflates the adjacent-pair rms and the whole
+    # stream gets scaled down — the reference's normalisation behaves
+    # identically, Level1Averaging.py:667-679)
+    amp = 1.0
+    model = SkyModel([GaussianComponent(p.ra0, p.dec0, amp, 0.075)])
+    inject_level1(path, model,
+                  gain_estimate=None)  # self-estimated gains
+
+    chain = [AssignLevel1Data(), MeasureSystemTemperature(),
+             Level1AveragingGainCorrection(medfilt_window=401)]
+    (lvl2,) = Runner(processes=chain,
+                     output_dir=str(tmp_path)).run_tod([path])
+    # the gain-fluctuation filter deliberately removes common-mode signal
+    # (which a bright source is) — calibrator reductions bypass it and
+    # the map-maker uses tod_original for sources; assert recovery there
+    tod = np.asarray(lvl2["averaged_tod/tod_original"])[0]  # (B, T)
+    ra = np.asarray(lvl2.ra)[0]
+    dec = np.asarray(lvl2.dec)[0]
+    near = np.hypot((ra - p.ra0) * np.cos(np.radians(dec)),
+                    dec - p.dec0) < 0.05
+    assert near.any()
+    peak = np.nanmax(tod[:, near])
+    assert peak > 0.5 * amp, peak
+    # and the transit stands clearly above the off-source background
+    assert peak > 3 * np.nanstd(tod[:, ~near])
+
+
+# -- SEDs -------------------------------------------------------------------
+
+def test_sed_components_positive():
+    from comapreduce_tpu.seds import ame, cmb, freefree, synchrotron, \
+        thermal_dust
+
+    freq = np.array([22.8, 28.5, 33.0, 40.0, 60.0])
+    omega = 1e-5
+    assert (synchrotron(freq, omega, 1e-3) > 0).all()
+    assert (freefree(freq, omega, 50.0) > 0).all()
+    assert (ame(freq, omega, 1e-3) > 0).all()
+    assert (thermal_dust(freq, omega, 1e-5) > 0).all()
+    assert (cmb(freq, omega, 1e-5) > 0).all()
+    # spectral shapes: synchrotron falls, dust rises
+    s = synchrotron(freq, omega, 1e-3)
+    d = thermal_dust(freq, omega, 1e-5)
+    assert s[-1] / s[0] < (freq[-1] / freq[0]) ** -0.5
+    assert d[-1] > d[0]
+
+
+def test_sed_fit_recovers_two_component():
+    from comapreduce_tpu.seds import SED, total_model
+
+    rng = np.random.default_rng(7)
+    freq = np.geomspace(10.0, 100.0, 12)
+    omega = 1e-5
+    truth = {"sync_amp": 2e-3, "sync_index": -2.8, "em": 80.0}
+    flux = total_model(truth, freq, omega, ("synchrotron", "freefree"))
+    err = 0.02 * flux
+    flux_obs = flux + err * rng.normal(size=flux.shape)
+    sed = SED(freq, flux_obs, err, omega,
+              components=("synchrotron", "freefree"))
+    fit = sed.fit()
+    # sync/free-free are partially degenerate at these frequencies, so
+    # individual parameters carry large correlated errors; the recovered
+    # *model* must match the true SED closely, parameters loosely
+    pred = sed.model(fit)
+    assert np.max(np.abs(pred - flux) / flux) < 0.1
+    assert abs(fit["sync_index"] - truth["sync_index"]) < 0.5
+    assert abs(fit["em"] - truth["em"]) / truth["em"] < 0.6
+    assert sed.chi2(fit) < 3 * len(freq)
+
+
+def test_sed_mcmc_runs():
+    from comapreduce_tpu.seds import SED, total_model
+
+    freq = np.geomspace(15.0, 90.0, 10)
+    omega = 1e-5
+    truth = {"sync_amp": 1e-3, "sync_index": -3.0}
+    flux = total_model(truth, freq, omega, ("synchrotron",))
+    sed = SED(freq, flux, 0.05 * flux, omega, components=("synchrotron",))
+    params = sed.mcmc_fit(n_steps=1500, seed=1)
+    assert sed.chain is not None and sed.chain.shape[0] == 500
+    assert 0.01 < sed.acceptance < 0.9
+    assert abs(params["sync_index"] + 3.0) < 0.5
+
+
+# -- observation database ---------------------------------------------------
+
+def test_obsdb_roundtrip_and_queries(tmp_path):
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.database import (ObsDatabase, assign_stats_flags,
+                                          robust_smooth)
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
+                                                 Level1AveragingGainCorrection,
+                                                 Level2FitPowerSpectrum,
+                                                 MeasureSystemTemperature)
+
+    files = []
+    for i in range(2):
+        params = SyntheticObsParams(obsid=4_000_000 + i, n_feeds=1,
+                                    n_bands=2, n_channels=16, n_scans=2,
+                                    scan_samples=600, vane_samples=200,
+                                    seed=50 + i,
+                                    mjd_start=59620.0 + 10 * i)
+        path = str(tmp_path / f"obs{i}.hd5")
+        generate_level1_file(path, params)
+        files.append(path)
+    chain = [AssignLevel1Data(), MeasureSystemTemperature(),
+             Level1AveragingGainCorrection(medfilt_window=301),
+             Level2FitPowerSpectrum(nbins=10)]
+    runner = Runner(processes=chain, output_dir=str(tmp_path))
+    results = runner.run_tod(files)
+    l2_files = [r.filename for r in results]
+
+    db_path = str(tmp_path / "obsdb.hd5")
+    db = ObsDatabase(db_path)
+    assert db.update_from_level2(l2_files) == 2
+    assert db.obsids() == [4_000_000, 4_000_001]
+    assert db.get(4_000_000, "stats/noise_mk") is not None
+    assert db.get_attr(4_000_000, "source") == "co2"
+
+    # flags: generous cut keeps them good; tiny cut flags them noisy
+    assign_stats_flags(db, noise_cut_mk=1e9)
+    assert db.get_attr(4_000_000, "flag") == 0
+    paths = db.query_source("co2")
+    assert len(paths) == 2
+    assign_stats_flags(db, noise_cut_mk=1e-9)
+    assert db.get_attr(4_000_000, "flag") & 1
+    assert db.query_source("co2") == []
+    assert len(db.query_source("co2", good_only=False)) == 2
+
+    # observer flags via CSV
+    csv = str(tmp_path / "flags.csv")
+    with open(csv, "w") as f:
+        f.write("obsid,flagged\n4000000,true\n4000001,false\n")
+    assign_stats_flags(db, noise_cut_mk=1e9)  # reset stats flags
+    assert db.import_observer_flags(csv) == 2
+    assert db.get_attr(4_000_000, "flag") & 4
+    assert db.get_attr(4_000_001, "flag") == 0
+
+    # persistence
+    db.save()
+    db2 = ObsDatabase(db_path)
+    assert db2.obsids() == [4_000_000, 4_000_001]
+    assert db2.get_attr(4_000_000, "flag") & 4
+
+    # robust smoothing rejects outliers
+    mjds = np.arange(20, dtype=float)
+    vals = np.ones(20)
+    vals[7] = 50.0
+    sm = robust_smooth(mjds, vals, window_days=10.0)
+    assert np.allclose(sm, 1.0, atol=1e-9)
