@@ -147,7 +147,9 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
 
     # -- pointing: CES triangle az sweeps at fixed elevation ----------------
     phase = np.cumsum(scan_flag) / fs  # seconds of scanning
-    sweep_period = 2 * p.az_throw / 0.5  # 0.5 deg/s scan speed
+    # triangle sweep: full period covers 4 x az_throw of azimuth travel,
+    # so the az rate is 4*throw/period = 0.5 deg/s
+    sweep_period = 4 * p.az_throw / 0.5
     tri = 2.0 * np.abs((phase / sweep_period) % 1.0 - 0.5) * 2.0 - 1.0
     az = p.az_centre + tri * p.az_throw * scan_flag
     el = np.full(T, p.elevation)

@@ -33,16 +33,25 @@ FLAG_BAD_WEATHER = 1 << 3  # high fnoise
 def robust_smooth(mjds: np.ndarray, values: np.ndarray,
                   window_days: float = 30.0, n_sigma: float = 3.0):
     """Outlier-robust running median (``data/Data.py:13-98`` smoothing):
-    median within ±window/2, after rejecting points > n_sigma MADs."""
+    median within ±window/2, after rejecting points > n_sigma MADs.
+    Windows are found by binary search on the time-sorted series, so a
+    fleet-sized series stays O(T log T), not O(T^2)."""
     mjds = np.asarray(mjds, np.float64)
     values = np.asarray(values, np.float64)
-    out = np.empty_like(values)
-    med_all = np.nanmedian(values)
-    mad = np.nanmedian(np.abs(values - med_all)) * 1.4826 + 1e-30
-    keep = np.abs(values - med_all) < n_sigma * mad
-    for i, t in enumerate(mjds):
-        sel = keep & (np.abs(mjds - t) <= window_days / 2.0)
-        out[i] = np.nanmedian(values[sel]) if sel.any() else med_all
+    order = np.argsort(mjds)
+    ts = mjds[order]
+    vs = values[order]
+    med_all = np.nanmedian(vs)
+    mad = np.nanmedian(np.abs(vs - med_all)) * 1.4826 + 1e-30
+    keep = np.abs(vs - med_all) < n_sigma * mad
+    lo = np.searchsorted(ts, ts - window_days / 2.0, side="left")
+    hi = np.searchsorted(ts, ts + window_days / 2.0, side="right")
+    out_sorted = np.empty_like(vs)
+    for i in range(len(ts)):
+        seg = vs[lo[i]:hi[i]][keep[lo[i]:hi[i]]]
+        out_sorted[i] = np.nanmedian(seg) if seg.size else med_all
+    out = np.empty_like(out_sorted)
+    out[order] = out_sorted
     return out
 
 
@@ -179,6 +188,16 @@ class ObsDatabase:
             recs.append((float(mjd), np.asarray(fac)))
         if not recs:
             return np.zeros(0), np.zeros((0, 0, 0))
+        # one inconsistent record (different F or B) must not break the
+        # fleet: keep the most common shape, skip the rest
+        from collections import Counter
+
+        shape = Counter(r[1].shape for r in recs).most_common(1)[0][0]
+        dropped = [r for r in recs if r[1].shape != shape]
+        if dropped:
+            logger.warning("smoothed_calibration_factors: skipping %d "
+                           "records with shape != %s", len(dropped), shape)
+        recs = [r for r in recs if r[1].shape == shape]
         recs.sort(key=lambda r: r[0])
         mjds = np.array([r[0] for r in recs])
         fac = np.stack([r[1] for r in recs])  # (T, F, B)

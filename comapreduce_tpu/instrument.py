@@ -1,0 +1,72 @@
+"""Instrument constants: feed focal-plane layout and beam widths.
+
+The reference ships these as packaged data files
+(``data/COMAP_FEEDS.dat``: per-feed focal-plane offsets;
+``data/AverageBeamWidths.dat``: per-feed beam FWHM) with loaders in
+``data/Data.py``. The actual COMAP tables are observatory data and not in
+this repository; this module provides (a) parsers for the same
+whitespace-column file format, and (b) a documented synthetic default —
+the 19-feed hexagonal close-packed layout the real array approximates —
+so every pipeline path runs without the proprietary files.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["feed_positions", "beam_widths", "load_feed_positions",
+           "load_beam_widths", "N_FEEDS", "NOMINAL_BEAM_FWHM_DEG"]
+
+N_FEEDS = 19
+NOMINAL_BEAM_FWHM_DEG = 4.5 / 60.0  # 4.5 arcmin at 30 GHz
+_HEX_SPACING_DEG = 0.2              # ~12 arcmin feed separation
+
+
+def feed_positions(n_feeds: int = N_FEEDS,
+                   spacing_deg: float = _HEX_SPACING_DEG) -> np.ndarray:
+    """(n_feeds, 2) focal-plane offsets [deg]: hexagonal rings around the
+    boresight (feed 1 at centre, 6 in ring 1, 12 in ring 2)."""
+    pts = [(0.0, 0.0)]
+    ring = 1
+    while len(pts) < n_feeds:
+        for k in range(6 * ring):
+            ang = 2 * np.pi * k / (6 * ring) + (0 if ring % 2 else
+                                                np.pi / (6 * ring))
+            pts.append((ring * spacing_deg * np.cos(ang),
+                        ring * spacing_deg * np.sin(ang)))
+            if len(pts) == n_feeds:
+                break
+        ring += 1
+    return np.asarray(pts[:n_feeds])
+
+
+def beam_widths(n_feeds: int = N_FEEDS,
+                fwhm_deg: float = NOMINAL_BEAM_FWHM_DEG) -> np.ndarray:
+    """(n_feeds,) beam FWHM [deg] — nominal uniform beam."""
+    return np.full(n_feeds, fwhm_deg)
+
+
+def load_feed_positions(path: str) -> np.ndarray:
+    """Parse a ``COMAP_FEEDS.dat``-format file: whitespace columns
+    ``feed x y``; returns (n_feeds, 2) [deg] ordered by feed number."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            s = line.split("#", 1)[0].split()
+            if len(s) >= 3:
+                rows.append((int(float(s[0])), float(s[1]), float(s[2])))
+    rows.sort()
+    return np.asarray([(x, y) for _, x, y in rows])
+
+
+def load_beam_widths(path: str) -> np.ndarray:
+    """Parse an ``AverageBeamWidths.dat``-format file: ``feed fwhm``
+    (arcmin); returns (n_feeds,) FWHM [deg]."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            s = line.split("#", 1)[0].split()
+            if len(s) >= 2:
+                rows.append((int(float(s[0])), float(s[1])))
+    rows.sort()
+    return np.asarray([w for _, w in rows]) / 60.0

@@ -34,7 +34,8 @@ from comapreduce_tpu.mapmaking import healpix as hp
 from comapreduce_tpu.mapmaking.wcs import WCS
 from comapreduce_tpu.ops.median_filter import rolling_median
 
-__all__ = ["DestriperData", "read_comap_data"]
+__all__ = ["DestriperData", "read_comap_data", "scan_speed_mask",
+           "export_madam"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -81,13 +82,31 @@ def _truncated_scan_mask(edges: np.ndarray, T: int, offset_length: int,
     return use, wzero
 
 
+def scan_speed_mask(az: np.ndarray, el: np.ndarray,
+                    sample_rate: float = 50.0,
+                    speed_range: tuple = (0.1, 0.45)) -> np.ndarray:
+    """True where the on-sky scan speed is inside ``speed_range`` [deg/s]
+    — masks azimuth-sweep turnarounds (``DataReader.py:332-336,386``)."""
+    az = np.asarray(az, np.float64)
+    el = np.asarray(el, np.float64)
+    daz = np.gradient(az, axis=-1) * np.cos(np.radians(el))
+    de = np.gradient(el, axis=-1)
+    speed = np.hypot(daz, de) * sample_rate
+    return (speed > speed_range[0]) & (speed < speed_range[1])
+
+
 def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     nside: int | None = None, galactic: bool = False,
                     offset_length: int = 50, medfilt_window: int = 400,
                     edge_frac: float = 0.1, use_calibration: bool = True,
-                    feed_mask: np.ndarray | None = None) -> DestriperData:
+                    feed_mask: np.ndarray | None = None,
+                    mask_turnarounds: bool = False,
+                    speed_range: tuple = (0.1, 0.45)) -> DestriperData:
     """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
-    ``nside`` selects the pixelisation."""
+    ``nside`` selects the pixelisation. ``mask_turnarounds`` zero-weights
+    samples outside the ``speed_range`` deg/s scan-speed band (the legacy
+    fg-survey pipeline's turnaround cut); the sample rate comes from each
+    file's own MJD axis."""
     if (wcs is None) == (nside is None):
         raise ValueError("pass exactly one of wcs= or nside=")
     tods, pixs, wgts, gids, azs = [], [], [], [], []
@@ -138,6 +157,15 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
         ra = np.asarray(lvl2.ra, np.float64)
         dec = np.asarray(lvl2.dec, np.float64)
         az_full = np.asarray(lvl2.az, np.float64)
+        if mask_turnarounds:
+            el_full = np.asarray(lvl2.el, np.float64)
+            mjd_t = np.asarray(lvl2.mjd, np.float64)
+            dt = np.median(np.diff(mjd_t)) * 86400.0 if mjd_t.size > 1 \
+                else 0.02
+            ok_speed = scan_speed_mask(az_full, el_full,
+                                       sample_rate=1.0 / max(dt, 1e-6),
+                                       speed_range=speed_range)
+            weights[~ok_speed] = 0.0
         lon, lat = (e2g(ra, dec) if galactic else (ra, dec))
         for ifeed in range(F):
             if feed_mask is not None and not feed_mask[ifeed]:
@@ -191,3 +219,26 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                          ground_ids=ground_ids, az=az, n_groups=group,
                          npix=npix, wcs=wcs, nside=nside,
                          sky_pixels=sky_pixels, files=kept_files)
+
+
+def export_madam(data: DestriperData, path: str) -> None:
+    """Export flat destriper vectors as a MADAM-style NEST-ordered HDF5
+    bundle (the ``ReadDataLevel2_MADAM`` role, ``DataReader.py:450-667``):
+    per-sample tod/weight/NEST-pixel vectors plus the geometry needed by
+    an external maximum-likelihood map-maker."""
+    import h5py
+
+    if data.nside is None:
+        raise ValueError("MADAM export requires HEALPix pixelisation")
+    sky = data.sky_pixels[np.clip(data.pixels, 0, data.npix - 1)]
+    invalid = data.pixels >= data.npix
+    nest_pix = hp.ring2nest(data.nside, sky)
+    nest_pix = np.where(invalid, -1, np.asarray(nest_pix))
+    with h5py.File(path, "w") as f:
+        f.create_dataset("tod", data=data.tod)
+        f.create_dataset("pixels_nest", data=nest_pix.astype(np.int64))
+        f.create_dataset("weights", data=data.weights)
+        f.create_dataset("ground_ids", data=data.ground_ids)
+        f.attrs["nside"] = data.nside
+        f.attrs["ordering"] = "NESTED"
+        f.attrs["n_files"] = len(data.files)

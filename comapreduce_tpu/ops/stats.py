@@ -154,3 +154,22 @@ def normalise(tod: jax.Array, mask: jax.Array | None = None):
     sd = masked_std(tod, mask, axis=-1)[..., None]
     out = jnp.where(sd > 0, (tod - mu) / jnp.where(sd > 0, sd, 1.0), 0.0)
     return out if mask is None else out * mask
+
+
+def downsample(tod: jax.Array, factor: int = 50):
+    """Block-average along time: f32[..., T] -> f32[..., T//factor]
+    (the reference's 1-second downsample, ``Tools/stats.py:104-117``)."""
+    n = tod.shape[-1] // factor * factor
+    blocks = tod[..., :n].reshape(tod.shape[:-1] + (n // factor, factor))
+    return jnp.mean(blocks, axis=-1)
+
+
+def correlation_matrix(tod: jax.Array, factor: int = 50):
+    """Channel-channel correlation of the downsampled TOD
+    (``Tools/stats.py:104-139``): ``tod`` f32[C, T] -> f32[C, C]."""
+    d = downsample(tod, factor)
+    d = d - jnp.mean(d, axis=-1, keepdims=True)
+    sd = jnp.sqrt(jnp.mean(d * d, axis=-1))
+    cov = d @ d.T / d.shape[-1]
+    denom = jnp.maximum(sd[:, None] * sd[None, :], _EPS)
+    return cov / denom

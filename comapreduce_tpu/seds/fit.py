@@ -1,7 +1,10 @@
 """SED fitting driver (``SEDs/tools.py`` ``SED`` class parity).
 
-Least-squares via the shared LM solver (log-parameter positivity), plus
-a dependency-free Metropolis-Hastings sampler standing in for the
+Least squares is a host-side NumPy Levenberg-Marquardt with
+finite-difference Jacobians (the emission models are NumPy; tracing them
+through the JAX solver in :mod:`calibration.fitting` would require
+rewriting the physics in jnp for fits that are tiny and never a device
+hot path), plus a dependency-free Metropolis sampler standing in for the
 reference's emcee MCMC (``SEDs/mcmc.py:40``, ``tools.py:333``): returns
 chains, means, and covariances — everything the reference's corner/
 walker plots consume.

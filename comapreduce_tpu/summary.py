@@ -1,0 +1,110 @@
+"""Fleet-level summaries across Level-2 files.
+
+Covers two reference roles:
+
+- ``Level2Timelines`` (``Analysis/Level2Data.py:142-223``): system
+  temperature / gain / noise timelines over many observations;
+- the ``Summary/`` package (``Summary/CalibrationFactors.py:19-165``):
+  aggregation of calibration factors into a ``gains.hd5``-style product,
+  read back with outlier-robust smoothing (``data/Data.py:13-98``
+  ``read_gains``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from comapreduce_tpu.data.hdf5io import HDF5Store
+from comapreduce_tpu.data.level import COMAPLevel2
+from comapreduce_tpu.database.obsdb import robust_smooth
+
+__all__ = ["level2_timelines", "write_gains", "read_gains"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+def level2_timelines(filenames) -> dict:
+    """Per-observation median Tsys/gain/noise timelines.
+
+    Returns dict of arrays sorted by MJD: ``mjd[T]``, ``obsid[T]``,
+    ``tsys[T, F, B]``, ``gain[T, F, B]``, ``auto_rms[T, F, B]``
+    (``Level2Timelines``, ``Level2Data.py:142-223``). Files missing a
+    product contribute NaN rows.
+    """
+    rows = []
+    for fname in filenames:
+        try:
+            lvl2 = COMAPLevel2(filename=fname)
+            mjd = float(np.mean(np.asarray(lvl2.mjd)))
+            tsys = gain = rms = None
+            if "vane/system_temperature" in lvl2:
+                t = np.asarray(lvl2.system_temperature)  # (E, F, B, C)
+                g = np.asarray(lvl2.system_gain)
+                tsys = np.nanmedian(np.where(t > 0, t, np.nan), axis=(0, 3))
+                gain = np.nanmedian(np.where(g > 0, g, np.nan), axis=(0, 3))
+            if "fnoise_fits/auto_rms" in lvl2:
+                rms = np.nanmedian(
+                    np.asarray(lvl2["fnoise_fits/auto_rms"]), axis=-1)
+            rows.append((mjd, lvl2.obsid, tsys, gain, rms))
+        except (OSError, KeyError) as exc:
+            logger.warning("level2_timelines: BAD FILE %s (%s)", fname, exc)
+    if not rows:
+        return {"mjd": np.zeros(0), "obsid": np.zeros(0, np.int64)}
+    rows.sort(key=lambda r: r[0])
+    # (F, B) from any product in any file — tsys may be absent everywhere
+    # while auto_rms is present
+    shapes = [r[i].shape for r in rows for i in (2, 3, 4)
+              if r[i] is not None]
+    fb = shapes[0] if shapes else (0, 0)
+
+    def stack(idx):
+        out = np.full((len(rows),) + fb, np.nan)
+        for i, r in enumerate(rows):
+            if r[idx] is not None and r[idx].shape == fb:
+                out[i] = r[idx]
+        return out
+
+    return {
+        "mjd": np.array([r[0] for r in rows]),
+        "obsid": np.array([r[1] for r in rows], np.int64),
+        "tsys": stack(2),
+        "gain": stack(3),
+        "auto_rms": stack(4),
+    }
+
+
+def write_gains(path: str, timelines: dict) -> None:
+    """Persist timelines as the ``gains.hd5`` analogue
+    (``Summary/CalibrationFactors.py`` output role)."""
+    store = HDF5Store(name="gains")
+    for k, v in timelines.items():
+        store[f"gains/{k}"] = np.asarray(v)
+    store.write(path)
+
+
+def read_gains(path: str, smooth_window_days: float = 30.0) -> dict:
+    """Load a gains file; adds outlier-robust smoothed ``tsys_smooth`` /
+    ``gain_smooth`` (``data/Data.py:57-98`` ``read_gains``)."""
+    store = HDF5Store(name="gains")
+    store.read(path)
+    out = {k.split("/", 1)[1]: np.asarray(v) for k, v in store.items()}
+    mjd = out.get("mjd")
+    for key in ("tsys", "gain"):
+        arr = out.get(key)
+        if arr is None or mjd is None or arr.ndim != 3 or not len(mjd):
+            continue
+        sm = np.empty_like(arr)
+        for f in range(arr.shape[1]):
+            for b in range(arr.shape[2]):
+                v = arr[:, f, b]
+                ok = np.isfinite(v)
+                if ok.sum() < 2:
+                    sm[:, f, b] = v
+                    continue
+                sm[ok, f, b] = robust_smooth(mjd[ok], v[ok],
+                                             smooth_window_days)
+                sm[~ok, f, b] = np.interp(mjd[~ok], mjd[ok], sm[ok, f, b])
+        out[f"{key}_smooth"] = sm
+    return out

@@ -199,3 +199,47 @@ def test_ground_template_removes_az_signal(field_dataset):
     std_g = np.nanstd(np.asarray(res_ground.destriped_map)[hit])
     std_p = np.nanstd(np.asarray(res_plain.destriped_map)[hit])
     assert std_g < std_p
+
+
+def test_export_madam_and_turnarounds(field_dataset, tmp_path):
+    import h5py
+
+    from comapreduce_tpu.mapmaking.leveldata import (export_madam,
+                                                     read_comap_data,
+                                                     scan_speed_mask)
+    from comapreduce_tpu.mapmaking import healpix as hp
+
+    tmp, files = field_dataset
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2), "run after test_run_average_cli"
+    data = read_comap_data(l2, band=0, nside=256, offset_length=50,
+                           mask_turnarounds=True)
+    # turnaround masking keeps most samples but kills some weight
+    plain = read_comap_data(l2, band=0, nside=256, offset_length=50)
+    assert (data.weights > 0).sum() < (plain.weights > 0).sum()
+    assert (data.weights > 0).sum() > 0.3 * data.weights.size
+
+    out = str(tmp_path / "madam.h5")
+    export_madam(data, out)
+    with h5py.File(out) as f:
+        assert f.attrs["ordering"] == "NESTED"
+        nest = f["pixels_nest"][...]
+        assert len(nest) == data.tod.size
+        valid = nest >= 0
+        assert valid.any()
+        assert nest[valid].max() < hp.nside2npix(256)
+        # NEST pixels decode back to the field region
+        lon, lat = hp.pix2ang_lonlat(256, hp.nest2ring(256, nest[valid]))
+        assert (np.abs(np.asarray(lat) - 52.0) < 8.0).all()
+
+
+def test_scan_speed_mask_shape():
+    from comapreduce_tpu.mapmaking.leveldata import scan_speed_mask
+
+    t = np.arange(2000) / 50.0
+    az = 180 + 2.0 * np.abs((t / 8.0) % 2 - 1.0) * 2 - 2  # triangle 0.5 deg/s
+    el = np.full_like(az, 55.0)
+    ok = scan_speed_mask(az, el)
+    # most samples move at ~0.5*cos(55 deg)=0.29 deg/s -> inside the band
+    assert ok.mean() > 0.8
