@@ -89,6 +89,8 @@ def scan_speed_mask(az: np.ndarray, el: np.ndarray,
     — masks azimuth-sweep turnarounds (``DataReader.py:332-336,386``)."""
     az = np.asarray(az, np.float64)
     el = np.asarray(el, np.float64)
+    # unwrap: a sweep crossing 0/360 must not register as a 360 deg jump
+    az = np.degrees(np.unwrap(np.radians(az), axis=-1))
     daz = np.gradient(az, axis=-1) * np.cos(np.radians(el))
     de = np.gradient(el, axis=-1)
     speed = np.hypot(daz, de) * sample_rate

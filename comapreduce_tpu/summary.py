@@ -62,8 +62,14 @@ def level2_timelines(filenames) -> dict:
     def stack(idx):
         out = np.full((len(rows),) + fb, np.nan)
         for i, r in enumerate(rows):
-            if r[idx] is not None and r[idx].shape == fb:
-                out[i] = r[idx]
+            if r[idx] is None:
+                continue
+            if r[idx].shape != fb:
+                logger.warning("level2_timelines: obsid %s has shape %s "
+                               "!= %s; NaN-filled", rows[i][1],
+                               r[idx].shape, fb)
+                continue
+            out[i] = r[idx]
         return out
 
     return {
