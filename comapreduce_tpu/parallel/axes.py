@@ -1,0 +1,79 @@
+"""Dataset axis roles -> mesh shardings (``Tools/Types.py`` parity).
+
+The reference annotates every COMAP dataset path with the physical role
+of each axis (``_HORNS_/_SIDEBANDS_/_FREQUENCY_/_TIME_``,
+``Types.py:33-44``) and derives MPI split structures from them
+(``getSplitStructure``/``getSelectStructure`` :52-94). The TPU-native
+counterpart maps those roles onto mesh axes and produces
+``PartitionSpec``s: feeds shard over the ``'feed'`` axis, time over
+``'time'``, bands/channels stay local (they ride the VPU lanes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["AXIS_ROLES", "DATASET_AXES", "partition_spec", "sharding_for",
+           "split_slices"]
+
+# physical axis roles
+HORNS = "horns"          # feeds (<= 20)
+SIDEBANDS = "sidebands"  # bands (4)
+FREQUENCY = "frequency"  # channels (1024)
+TIME = "time"            # samples
+
+AXIS_ROLES = (HORNS, SIDEBANDS, FREQUENCY, TIME)
+
+# per-dataset axis roles (the reference's _COMAPDATA_, Types.py:33-44)
+DATASET_AXES = {
+    "spectrometer/tod": (HORNS, SIDEBANDS, FREQUENCY, TIME),
+    "spectrometer/MJD": (TIME,),
+    "spectrometer/features": (TIME,),
+    "spectrometer/frequency": (SIDEBANDS, FREQUENCY),
+    "spectrometer/feeds": (HORNS,),
+    "spectrometer/bands": (SIDEBANDS,),
+    "spectrometer/pixel_pointing/pixel_ra": (HORNS, TIME),
+    "spectrometer/pixel_pointing/pixel_dec": (HORNS, TIME),
+    "spectrometer/pixel_pointing/pixel_az": (HORNS, TIME),
+    "spectrometer/pixel_pointing/pixel_el": (HORNS, TIME),
+    "averaged_tod/tod": (HORNS, SIDEBANDS, TIME),
+    "averaged_tod/tod_original": (HORNS, SIDEBANDS, TIME),
+    "averaged_tod/weights": (HORNS, SIDEBANDS, TIME),
+    "spikes/spike_mask": (HORNS, SIDEBANDS, TIME),
+    "vane/system_temperature": (None, HORNS, SIDEBANDS, FREQUENCY),
+    "vane/system_gain": (None, HORNS, SIDEBANDS, FREQUENCY),
+}
+
+# which mesh axis (if any) each physical role shards over
+_ROLE_TO_MESH = {HORNS: "feed", TIME: "time",
+                 SIDEBANDS: None, FREQUENCY: None, None: None}
+
+
+def partition_spec(dataset: str, mesh_axes=("feed", "time")) -> P:
+    """PartitionSpec for a dataset path on a mesh with ``mesh_axes``.
+
+    Roles whose mesh axis is absent from ``mesh_axes`` stay replicated
+    (the reference's select-vs-split distinction, ``Types.py:71-94``).
+    """
+    roles = DATASET_AXES.get(dataset)
+    if roles is None:
+        return P()
+    spec = []
+    for role in roles:
+        m = _ROLE_TO_MESH.get(role)
+        spec.append(m if m in mesh_axes else None)
+    return P(*spec)
+
+
+def sharding_for(dataset: str, mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, partition_spec(dataset,
+                                              tuple(mesh.axis_names)))
+
+
+def split_slices(n: int, n_parts: int, part: int) -> slice:
+    """Contiguous block split of an axis (the reference's ``hi/lo``
+    ``getDataRange``, ``DOCS/main.tex:258-269``)."""
+    step = -(-n // n_parts)
+    lo = min(step * part, n)
+    return slice(lo, min(lo + step, n))

@@ -79,6 +79,10 @@ class Runner:
     rank: int = 0
     n_ranks: int = 1
     timings: dict = field(default_factory=dict)
+    # when set, each file's stage chain runs under jax.profiler.trace
+    # writing TensorBoard-readable traces here (the reference has no
+    # profiler at all — SURVEY.md §5 'Tracing/profiling: none')
+    profile_dir: str = ""
 
     def shard(self, filelist: list[str]) -> list[str]:
         return [f for i, f in enumerate(filelist)
@@ -101,6 +105,23 @@ class Runner:
         return results
 
     def run_file(self, filename: str) -> COMAPLevel2:
+        if self.profile_dir:
+            import contextlib
+
+            import jax
+
+            os.makedirs(self.profile_dir, exist_ok=True)
+            try:
+                ctx = jax.profiler.trace(self.profile_dir)
+            except Exception:  # profiler unsupported on this backend
+                logger.warning("jax.profiler.trace unavailable; "
+                               "running unprofiled")
+                ctx = contextlib.nullcontext()
+            with ctx:
+                return self._run_file(filename)
+        return self._run_file(filename)
+
+    def _run_file(self, filename: str) -> COMAPLevel2:
         data = COMAPLevel1()
         data.read(filename)
         lvl2 = COMAPLevel2(

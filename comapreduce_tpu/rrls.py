@@ -1,0 +1,125 @@
+"""Radio recombination line (RRL) analysis.
+
+Capability parity with the reference ``RRLs/`` package (legacy, broken
+at upstream HEAD — ``RRLFuncs.py:14`` imports the removed BaseClasses):
+hydrogen-alpha line frequencies in the COMAP band, velocity-grid spectral
+stacking across lines (a ``segment_sum`` on device), Gaussian line fits,
+and the line-to-continuum electron-temperature relation
+(``RRLs/RRLequations.py:3-50``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["hydrogen_alpha_frequency", "lines_in_band", "channel_velocity",
+           "stack_spectra", "electron_temperature", "fit_line"]
+
+_RYDBERG_HZ = 3.2898419603e15  # R_H * c for hydrogen
+C_KMS = 299792.458
+
+
+def hydrogen_alpha_frequency(n: int) -> float:
+    """Rest frequency [GHz] of the H(n)alpha transition n+1 -> n."""
+    nu = _RYDBERG_HZ * (1.0 / n**2 - 1.0 / (n + 1) ** 2)
+    return nu / 1e9
+
+
+def lines_in_band(fmin_ghz: float = 26.0, fmax_ghz: float = 34.0):
+    """{n: freq_ghz} of the Hnalpha lines inside [fmin, fmax] (the COMAP
+    band holds H58a-H62a)."""
+    out = {}
+    for n in range(40, 120):
+        f = hydrogen_alpha_frequency(n)
+        if fmin_ghz <= f <= fmax_ghz:
+            out[n] = f
+    return out
+
+
+def channel_velocity(freq_ghz, line_freq_ghz: float):
+    """Radio-convention velocity [km/s] of each channel relative to a
+    line: ``v = c (nu0 - nu) / nu0``."""
+    nu = np.asarray(freq_ghz, np.float64)
+    return C_KMS * (line_freq_ghz - nu) / line_freq_ghz
+
+
+def stack_spectra(spectra, freq_ghz, line_freqs, v_grid,
+                  weights=None):
+    """Stack spectra from several lines onto one velocity grid.
+
+    ``spectra``/``freq_ghz``: f32[..., C] per-channel brightness and
+    frequency; ``line_freqs``: list of rest frequencies [GHz]; ``v_grid``:
+    bin edges [km/s] (length nbins+1). Returns ``(stacked[..., nbins],
+    hits[..., nbins])`` — a ``segment_sum`` over velocity-bin ids, the
+    device analogue of the reference's per-line loop (``RRLFuncs.py``
+    ``read_data``/stacking)."""
+    import jax
+    import jax.numpy as jnp
+
+    spectra = jnp.asarray(spectra)
+    w = jnp.ones_like(spectra) if weights is None else jnp.asarray(weights)
+    nbins = len(v_grid) - 1
+    v_grid = np.asarray(v_grid, np.float64)
+    total = None
+    hits = None
+    for f0 in line_freqs:
+        v = channel_velocity(np.asarray(freq_ghz, np.float64), float(f0))
+        ids = np.searchsorted(v_grid, v, side="right") - 1
+        valid = (ids >= 0) & (ids < nbins)
+        ids = np.where(valid, ids, nbins)
+        ids_j = jnp.asarray(ids.reshape(-1), jnp.int32)
+        flat_s = (spectra * w).reshape(-1, spectra.shape[-1])
+        flat_w = (w * jnp.asarray(valid, w.dtype)).reshape(
+            -1, spectra.shape[-1])
+
+        def bin_rows(rows):
+            return jax.vmap(lambda r: jax.ops.segment_sum(
+                r, ids_j, num_segments=nbins + 1)[:nbins])(rows)
+
+        s = bin_rows(flat_s * jnp.asarray(valid, flat_s.dtype))
+        h = bin_rows(flat_w)
+        total = s if total is None else total + s
+        hits = h if hits is None else hits + h
+    shape = spectra.shape[:-1] + (nbins,)
+    stacked = jnp.where(hits > 0, total / jnp.maximum(hits, 1e-30), 0.0)
+    return stacked.reshape(shape), hits.reshape(shape)
+
+
+def electron_temperature(line_peak_k, continuum_k, delta_v_kms,
+                         freq_ghz, helium_fraction: float = 0.08):
+    """LTE electron temperature [K] from the line-to-continuum ratio
+    (``RRLequations.py:3-50``):
+
+    ``T_e = (7103.3 nu_GHz^1.1 / ((T_L/T_C) dv (1 + y+)))^0.87``
+    """
+    ratio = np.asarray(line_peak_k, np.float64) \
+        / np.maximum(np.asarray(continuum_k, np.float64), 1e-30)
+    x = (7103.3 * np.asarray(freq_ghz, np.float64) ** 1.1
+         / np.maximum(ratio * np.asarray(delta_v_kms, np.float64)
+                      * (1.0 + helium_fraction), 1e-30))
+    return x ** 0.87
+
+
+def fit_line(v_kms, spectrum, weights=None):
+    """Gaussian line fit on a stacked velocity spectrum: returns
+    ``(amplitude, v0, fwhm_kms, offset)`` via the shared LM solver."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.calibration import fitting
+
+    v = jnp.asarray(v_kms, jnp.float32)
+    s = jnp.asarray(spectrum, jnp.float32)
+    w = jnp.ones_like(s) if weights is None else jnp.asarray(weights,
+                                                             jnp.float32)
+
+    def model(p, x, y):
+        amp, v0, sig, off = p
+        return amp * jnp.exp(-0.5 * ((x - v0) / sig) ** 2) + off
+
+    i = int(jnp.argmax(s))
+    p0 = jnp.asarray([float(s[i]) - float(jnp.median(s)), float(v[i]),
+                      20.0, float(jnp.median(s))], jnp.float32)
+    p, err, chi2 = fitting.fit_gauss2d(
+        s, v, jnp.zeros_like(v), w, p0, model=model)
+    amp, v0, sig, off = (float(x) for x in p)
+    return amp, v0, abs(sig) * 2.355, off

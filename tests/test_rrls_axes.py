@@ -1,0 +1,90 @@
+"""RRL analysis and dataset-axis sharding metadata."""
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.rrls import (channel_velocity, electron_temperature,
+                                  fit_line, hydrogen_alpha_frequency,
+                                  lines_in_band, stack_spectra)
+
+
+def test_hydrogen_alpha_frequencies():
+    # published values: H58a = 32.852 GHz, H60a = 29.700 GHz
+    assert hydrogen_alpha_frequency(58) == pytest.approx(32.852, abs=0.01)
+    assert hydrogen_alpha_frequency(60) == pytest.approx(29.700, abs=0.01)
+    lines = lines_in_band(26.0, 34.0)
+    assert set(lines) == {58, 59, 60, 61, 62}
+
+
+def test_channel_velocity_sign():
+    # a channel below the line frequency is redshifted (positive radio v)
+    v = channel_velocity(np.array([29.6, 29.7, 29.8]), 29.7)
+    assert v[0] > 0 and abs(v[1]) < 1e-9 and v[2] < 0
+
+
+def test_stack_and_fit_line():
+    """Inject the same Gaussian line (in velocity) at two Hna rest
+    frequencies; stacking doubles the effective integration."""
+    rng = np.random.default_rng(0)
+    lines = [hydrogen_alpha_frequency(n) for n in (59, 60)]
+    C = 512
+    freq = np.linspace(28.9, 30.5, C)  # covers both lines
+    spectrum = np.zeros(C)
+    v_true, fwhm, amp = 10.0, 30.0, 0.05
+    for f0 in lines:
+        v = channel_velocity(freq, f0)
+        spectrum += amp * np.exp(-0.5 * ((v - v_true)
+                                         / (fwhm / 2.355)) ** 2)
+    noisy = spectrum + 0.01 * rng.normal(size=C)
+    v_grid = np.linspace(-300, 300, 61)
+    stacked, hits = stack_spectra(noisy[None], freq[None], lines, v_grid)
+    stacked = np.asarray(stacked)[0]
+    assert stacked.shape == (60,)
+    assert np.asarray(hits)[0].sum() > 0
+    v_centers = 0.5 * (v_grid[:-1] + v_grid[1:])
+    a, v0, w, off = fit_line(v_centers, stacked)
+    assert abs(v0 - v_true) < 6.0
+    assert 10.0 < w < 80.0
+    assert a > 0.02
+
+
+def test_electron_temperature_scaling():
+    # T_L/T_C = 0.1 at dv = 25 km/s, 30 GHz -> few thousand K; weaker
+    # lines (hotter gas) give higher Te
+    te1 = electron_temperature(0.1, 1.0, 25.0, 30.0)
+    te2 = electron_temperature(0.05, 1.0, 25.0, 30.0)
+    assert 3000 < te1 < 20000
+    assert te2 > te1
+
+
+def test_partition_specs():
+    from jax.sharding import PartitionSpec as P
+
+    from comapreduce_tpu.parallel.axes import (partition_spec,
+                                               split_slices)
+
+    assert partition_spec("spectrometer/tod") == P("feed", None, None,
+                                                   "time")
+    assert partition_spec("averaged_tod/tod") == P("feed", None, "time")
+    assert partition_spec("spectrometer/MJD") == P("time")
+    # mesh without a time axis replicates the time role
+    assert partition_spec("averaged_tod/tod", mesh_axes=("feed",)) == \
+        P("feed", None, None)
+    assert partition_spec("unknown/path") == P()
+    # contiguous block split covers the axis exactly once
+    n, parts = 103, 4
+    seen = []
+    for p in range(parts):
+        s = split_slices(n, parts, p)
+        seen.extend(range(n)[s])
+    assert seen == list(range(n))
+
+
+def test_sharding_for_on_mesh():
+    import jax
+    from comapreduce_tpu.parallel.axes import sharding_for
+    from comapreduce_tpu.parallel.mesh import feed_time_mesh
+
+    mesh = feed_time_mesh(jax.devices())
+    s = sharding_for("averaged_tod/tod", mesh)
+    assert s.mesh is mesh
