@@ -91,8 +91,8 @@ class ReduceConfig:
     """
 
     def _key(self):
-        return (self.n_channels, self.medfilt_window, self.is_calibrator,
-                self.bandwidth, self.tau)
+        return (self.n_channels, self.medfilt_window, self.medfilt_stride,
+                self.is_calibrator, self.bandwidth, self.tau)
 
     def __eq__(self, other):
         return (type(other) is ReduceConfig and self._key() == other._key())
@@ -102,13 +102,17 @@ class ReduceConfig:
 
     def __init__(self, n_channels: int, medfilt_window: int = 6000,
                  is_calibrator: bool = False,
-                 bandwidth: float | None = None, tau: float = 1.0 / 50.0):
+                 bandwidth: float | None = None, tau: float = 1.0 / 50.0,
+                 medfilt_stride: int | None = None):
         c = n_channels
         # channel cuts scale with C so small test configs behave like 1024
         def s(n):
             return max(int(round(n * c / 1024.0)), 1)
         self.n_channels = c
         self.medfilt_window = medfilt_window
+        # None = subsample windows beyond MAX_EXACT_WINDOW (fast path);
+        # 1 = exact rolling median at any window (the reference's filter)
+        self.medfilt_stride = medfilt_stride
         self.is_calibrator = is_calibrator
         self.bandwidth = bandwidth if bandwidth is not None else 2e9 / c
         self.tau = tau
@@ -126,9 +130,16 @@ def _fill_bad(tod, mask):
 
     The median runs on a stride-4 subsample: it only supplies fill values
     for already-masked samples, and the full-length per-channel sort is
-    one of the costliest ops in the reduction."""
-    med = masked_median(tod[..., ::4], mask[..., ::4], axis=-1)[..., None]
-    return jnp.where(mask > 0, tod, med)
+    one of the costliest ops in the reduction. When a channel's valid
+    samples all fall off the stride-4 grid the subsampled median is
+    undefined — fall back to the full-length masked mean (cheap reduction)
+    instead of filling with 0 raw counts."""
+    med = masked_median(tod[..., ::4], mask[..., ::4], axis=-1)
+    sub_cnt = jnp.sum(mask[..., ::4], axis=-1)
+    cnt = jnp.sum(mask, axis=-1)
+    mean = jnp.sum(tod * mask, axis=-1) / jnp.maximum(cnt, 1.0)
+    fill = jnp.where(sub_cnt > 0, med, mean)[..., None]
+    return jnp.where(mask > 0, tod, fill)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_scans", "L"))
@@ -182,7 +193,8 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
         # -- median-filter high-pass --------------------------------------
         filtered, _ = medfilt_highpass(clean, cfg.mask_medfilt[None, :]
                                        * jnp.ones((B, 1)), cfg.medfilt_window,
-                                       time_mask=tv)
+                                       time_mask=tv,
+                                       stride=cfg.medfilt_stride)
 
         # -- gain fluctuation solve ---------------------------------------
         T2, p = gain_ops.build_templates(

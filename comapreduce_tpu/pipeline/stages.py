@@ -277,6 +277,9 @@ class Level1AveragingGainCorrection(_StageBase):
 
     groups: tuple = ("averaged_tod",)
     medfilt_window: int = 6000
+    # None = two-level block-median filter beyond 512-sample windows (fast
+    # path, quantified in tests/test_medfilt_parity.py); 1 = exact filter
+    medfilt_stride: int | None = None
     pad_to: int = 128
 
     def __call__(self, data, level2) -> bool:
@@ -297,7 +300,8 @@ class Level1AveragingGainCorrection(_StageBase):
         F, B, C, T = data.tod_shape
         starts, lengths, L = scan_starts_lengths(edges, pad_to=self.pad_to)
         cfg = ReduceConfig(C, medfilt_window=min(self.medfilt_window, L),
-                           is_calibrator=data.is_calibrator)
+                           is_calibrator=data.is_calibrator,
+                           medfilt_stride=self.medfilt_stride)
         freq = data.frequency.astype(np.float32)  # (B, C) GHz
         f0 = freq.mean(axis=1, keepdims=True)
         freq_scaled = ((freq - f0) / f0).astype(np.float32)

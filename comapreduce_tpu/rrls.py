@@ -15,7 +15,11 @@ import numpy as np
 __all__ = ["hydrogen_alpha_frequency", "lines_in_band", "channel_velocity",
            "stack_spectra", "electron_temperature", "fit_line"]
 
-_RYDBERG_HZ = 3.2898419603e15  # R_H * c for hydrogen
+# Hydrogen's reduced-mass Rydberg frequency R_H*c = R_inf*c / (1 + m_e/m_p).
+# Using the infinite-nuclear-mass R_inf*c (3.28984e15) would put every
+# Hn-alpha line ~18 MHz (~170 km/s) high — e.g. H58a at 32.870 instead of
+# the published 32.852 GHz.
+_RYDBERG_HZ = 3.2880513e15
 C_KMS = 299792.458
 
 
@@ -57,29 +61,40 @@ def stack_spectra(spectra, freq_ghz, line_freqs, v_grid,
     import jax.numpy as jnp
 
     spectra = jnp.asarray(spectra)
-    w = jnp.ones_like(spectra) if weights is None else jnp.asarray(weights)
+    w = (jnp.ones_like(spectra) if weights is None
+         else jnp.broadcast_to(jnp.asarray(weights, spectra.dtype),
+                               spectra.shape))
+    C = spectra.shape[-1]
     nbins = len(v_grid) - 1
     v_grid = np.asarray(v_grid, np.float64)
-    total = None
-    hits = None
+    # Velocity-bin ids are per ROW: freq_ghz broadcasts against the full
+    # spectra shape, so a multi-row stack (different feeds with different
+    # frequency grids) bins each row on its own grid. Ids are computed at
+    # freq_ghz's natural shape and only the (cheap, int) ids broadcast —
+    # a shared 1-D grid does one searchsorted pass, not one per row.
+    freq = np.asarray(freq_ghz, np.float64)
+    flat_s = (spectra * w).reshape(-1, C)
+    flat_w = w.reshape(-1, C)
+
+    def bin_row(sr, wr, idr):
+        s = jax.ops.segment_sum(sr, idr, num_segments=nbins + 1)[:nbins]
+        h = jax.ops.segment_sum(wr, idr, num_segments=nbins + 1)[:nbins]
+        return s, h
+
+    total = jnp.zeros((flat_s.shape[0], nbins), spectra.dtype)
+    hits = jnp.zeros_like(total)
     for f0 in line_freqs:
-        v = channel_velocity(np.asarray(freq_ghz, np.float64), float(f0))
+        v = channel_velocity(freq, float(f0))
         ids = np.searchsorted(v_grid, v, side="right") - 1
         valid = (ids >= 0) & (ids < nbins)
         ids = np.where(valid, ids, nbins)
-        ids_j = jnp.asarray(ids.reshape(-1), jnp.int32)
-        flat_s = (spectra * w).reshape(-1, spectra.shape[-1])
-        flat_w = (w * jnp.asarray(valid, w.dtype)).reshape(
-            -1, spectra.shape[-1])
-
-        def bin_rows(rows):
-            return jax.vmap(lambda r: jax.ops.segment_sum(
-                r, ids_j, num_segments=nbins + 1)[:nbins])(rows)
-
-        s = bin_rows(flat_s * jnp.asarray(valid, flat_s.dtype))
-        h = bin_rows(flat_w)
-        total = s if total is None else total + s
-        hits = h if hits is None else hits + h
+        ids_flat = np.broadcast_to(ids, spectra.shape).reshape(-1, C)
+        valid_flat = np.broadcast_to(valid, spectra.shape).reshape(-1, C)
+        ids_j = jnp.asarray(ids_flat, jnp.int32)
+        valid_j = jnp.asarray(valid_flat, spectra.dtype)
+        s, h = jax.vmap(bin_row)(flat_s * valid_j, flat_w * valid_j, ids_j)
+        total = total + s
+        hits = hits + h
     shape = spectra.shape[:-1] + (nbins,)
     stacked = jnp.where(hits > 0, total / jnp.maximum(hits, 1e-30), 0.0)
     return stacked.reshape(shape), hits.reshape(shape)
@@ -102,24 +117,45 @@ def electron_temperature(line_peak_k, continuum_k, delta_v_kms,
 
 def fit_line(v_kms, spectrum, weights=None):
     """Gaussian line fit on a stacked velocity spectrum: returns
-    ``(amplitude, v0, fwhm_kms, offset)`` via the shared LM solver."""
+    ``(amplitude, v0, fwhm_kms, offset)`` via the shared LM solver.
+
+    ``weights`` should be the ``hits`` array from :func:`stack_spectra`
+    (or any per-bin inverse-variance weight): when channel spacing exceeds
+    the velocity-bin width the stack zero-fills empty bins, and fitting
+    those as real zeros drags the fit away from the line. Zero-weight bins
+    are excluded from both the initial guess and the solve.
+    """
     import jax.numpy as jnp
 
     from comapreduce_tpu.calibration import fitting
 
-    v = jnp.asarray(v_kms, jnp.float32)
-    s = jnp.asarray(spectrum, jnp.float32)
-    w = jnp.ones_like(s) if weights is None else jnp.asarray(weights,
-                                                             jnp.float32)
+    v_np = np.asarray(v_kms, np.float64)
+    s_np = np.asarray(spectrum, np.float64)
+    w_np = (np.ones_like(s_np) if weights is None
+            else np.asarray(weights, np.float64))
+    valid = w_np > 0
+    if not valid.any():
+        raise ValueError("fit_line: all bins have zero weight")
 
     def model(p, x, y):
         amp, v0, sig, off = p
         return amp * jnp.exp(-0.5 * ((x - v0) / sig) ** 2) + off
 
-    i = int(jnp.argmax(s))
-    p0 = jnp.asarray([float(s[i]) - float(jnp.median(s)), float(v[i]),
-                      20.0, float(jnp.median(s))], jnp.float32)
+    i = int(np.argmax(np.where(valid, s_np, -np.inf)))
+    med = float(np.median(s_np[valid]))
+    # moment-based initial width/centre from the positive excess
+    excess = np.where(valid, np.maximum(s_np - med, 0.0), 0.0)
+    norm = excess.sum()
+    if norm > 0:
+        v0_0 = float((excess * v_np).sum() / norm)
+        sig0 = float(np.sqrt((excess * (v_np - v0_0) ** 2).sum() / norm))
+        sig0 = max(sig0, 1e-3)
+    else:
+        v0_0, sig0 = float(v_np[i]), 20.0
+    p0 = jnp.asarray([s_np[i] - med, v0_0, sig0, med], jnp.float32)
     p, err, chi2 = fitting.fit_gauss2d(
-        s, v, jnp.zeros_like(v), w, p0, model=model)
+        jnp.asarray(s_np, jnp.float32), jnp.asarray(v_np, jnp.float32),
+        jnp.zeros(s_np.shape, jnp.float32), jnp.asarray(w_np, jnp.float32),
+        p0, model=model)
     amp, v0, sig, off = (float(x) for x in p)
     return amp, v0, abs(sig) * 2.355, off

@@ -1,0 +1,144 @@
+"""Quantified parity of the two-level rolling median vs the exact filter.
+
+The reference's median filter (``Tools/median_filter/Mediator.h:36-60``) is
+exact at any window; the gain path regresses the TOD against the filter
+output (``Level1Averaging.py:700-705``), so filter error propagates into the
+calibration. Our ``rolling_median`` switches to a two-level block-median
+filter beyond ``MAX_EXACT_WINDOW`` (512) for speed; ``stride=1`` is the
+exactness escape hatch. These tests measure the approximation error at the
+production window (6000 samples) on realistic 1/f + atmosphere data and pin
+the end-to-end Level-2 impact.
+"""
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.ops.median_filter import (MAX_EXACT_WINDOW,
+                                               rolling_median)
+
+
+def one_over_f(rng, T, sigma_w=1.0, fknee=0.02, alpha=1.5, fs=50.0):
+    """White + 1/f noise stream via FFT shaping (the reference's
+    Destriper.get_noise recipe)."""
+    freqs = np.fft.rfftfreq(T, d=1.0 / fs)
+    freqs[0] = freqs[1]
+    psd = 1.0 + (fknee / freqs) ** alpha
+    spec = (rng.normal(size=freqs.size) + 1j * rng.normal(size=freqs.size))
+    tod = np.fft.irfft(spec * np.sqrt(psd), n=T)
+    return sigma_w * tod / tod.std()
+
+
+@pytest.fixture(scope="module")
+def tod_6000():
+    """Band-mean-like TOD: 1/f + slow atmosphere drift + white noise."""
+    rng = np.random.default_rng(7)
+    T = 30000
+    t = np.arange(T) / 50.0
+    atmos = 0.8 * np.sin(2 * np.pi * t / 300.0) + 0.3 * (t / t[-1]) ** 2
+    return (one_over_f(rng, T, sigma_w=1.0) + atmos).astype(np.float32)
+
+
+def test_two_level_vs_exact_window_6000(tod_6000):
+    """At the production window the two-level (block-median) filter tracks
+    the exact one to a few percent of the white-noise sigma under the
+    pipeline's symmetric boundary mode."""
+    w = 6000
+    exact = np.asarray(rolling_median(tod_6000, w, stride=1,
+                                      pad_mode="symmetric"))
+    fast = np.asarray(rolling_median(tod_6000, w, pad_mode="symmetric"))
+    err = fast - exact
+    # measured on this data: rms 0.025 sigma_w, max 0.072 sigma_w,
+    # mean -0.0008 (a strided subsample measures rms 0.057 here)
+    assert np.sqrt(np.mean(err**2)) < 0.05
+    assert np.abs(err).max() < 0.15
+    assert abs(err.mean()) < 0.01
+
+
+def test_two_level_edge_replicate_interior(tod_6000):
+    """Under edge-replicate padding the block-median estimator deviates
+    near the boundaries (long runs of one replicated extreme value pull
+    the exact median differently); the interior stays tight. The pipeline
+    never uses edge mode for large windows (medfilt_highpass pads
+    symmetric), so only the interior bound is load-bearing."""
+    w = 6000
+    exact = np.asarray(rolling_median(tod_6000, w, stride=1))
+    fast = np.asarray(rolling_median(tod_6000, w))
+    interior = slice(w, tod_6000.size - w)
+    err = (fast - exact)[interior]
+    assert np.sqrt(np.mean(err**2)) < 0.05
+    assert np.abs(err).max() < 0.15
+
+
+def test_strided_grid_is_centred():
+    """On a pure ramp the rolling median equals the sample itself; a
+    left-aligned strided grid would bias the centre early by ~stride/2."""
+    T, w = 4000, 1200
+    ramp = np.arange(T, dtype=np.float32)
+    out = np.asarray(rolling_median(ramp, w))
+    stride = -(-w // MAX_EXACT_WINDOW)
+    interior = slice(w, T - w)
+    err = out[interior] - ramp[interior]
+    # centred grid: |bias| <= stride/2 (grid quantisation), not ~stride/2
+    # plus a one-sided offset
+    assert abs(err.mean()) <= stride / 2.0
+    assert np.abs(err).max() <= stride
+
+
+def test_exact_matches_numpy_oracle(tod_6000):
+    """stride=1 is the reference-exact filter (interior samples)."""
+    w = 601
+    x = tod_6000[:4000]
+    out = np.asarray(rolling_median(x, w, stride=1))
+    left = (w - 1) // 2
+    # numpy oracle on interior windows
+    idx = np.arange(1000, 1200)
+    oracle = np.array([np.median(x[i - left:i - left + w]) for i in idx])
+    np.testing.assert_allclose(out[idx], oracle, rtol=0, atol=1e-6)
+
+
+def test_end_to_end_level2_impact():
+    """Subsampled vs exact filter through the FULL reduction: the
+    difference in the band-averaged Level-2 TOD stays well below the
+    white-noise level."""
+    from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
+                                            scan_starts_lengths)
+
+    rng = np.random.default_rng(3)
+    B, C = 1, 32
+    edges = np.asarray([(64, 8064), (8192, 16192)], dtype=np.int64)
+    starts, lengths, L = scan_starts_lengths(edges)
+    T = 16256
+    t = np.arange(T) / 50.0
+
+    tsys = (45.0 * (1.0 + 0.1 * rng.random(size=(B, C)))).astype(np.float32)
+    gain = (1e6 * (1.0 + 0.05 * rng.normal(size=(B, C)))).astype(np.float32)
+    atmos = 2.0 * np.sin(2 * np.pi * t / 200.0)
+    drift = np.stack([one_over_f(rng, T, sigma_w=0.05)
+                      for _ in range(B * C)]).reshape(B, C, T)
+    tod = gain[..., None] * tsys[..., None] * (
+        1.0 + 0.01 * rng.normal(size=(B, C, T))
+        + 0.002 * atmos[None, None, :] + drift)
+    mask = np.zeros((B, C, T), np.float32)
+    for s, e in edges:
+        mask[..., s:e] = 1.0
+    airmass = (1.2 + 0.01 * np.sin(2 * np.pi * t / 600.0)).astype(np.float32)
+    freq_scaled = np.broadcast_to(
+        np.linspace(-0.1, 0.1, C), (B, C)).astype(np.float32).copy()
+
+    outs = {}
+    for label, stride in (("fast", None), ("exact", 1)):
+        cfg = ReduceConfig(C, medfilt_window=6000, medfilt_stride=stride)
+        outs[label] = reduce_feed_scans(
+            tod.astype(np.float32), mask, airmass,
+            starts.astype(np.int32), lengths.astype(np.int32),
+            tsys, gain, freq_scaled, cfg=cfg, n_scans=len(starts), L=L)
+
+    sci = np.asarray(mask[:, 0, :] > 0)
+    for key in ("tod", "tod_original"):
+        a = np.asarray(outs["fast"][key])[sci]
+        b = np.asarray(outs["exact"][key])[sci]
+        white = b.std()
+        diff_rms = np.sqrt(np.mean((a - b) ** 2))
+        # measured: 0.35% (tod) / 3.1% (tod_original) of the Level-2
+        # white level; assert 5% with margin
+        assert diff_rms < 0.05 * white, (key, diff_rms, white)
