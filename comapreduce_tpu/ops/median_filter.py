@@ -86,8 +86,13 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
                          + [(0, nblocks * stride - P0)], mode="edge")
         bm = jnp.median(
             padded.reshape(x.shape[:-1] + (nblocks, stride)), axis=-1)
+        # recurse with stride=None so an explicitly oversized stride (e.g.
+        # stride=2 at window=6000 -> block window 3000) re-splits instead
+        # of running an exact rolling median far above MAX_EXACT_WINDOW;
+        # for the default stride the block window is <= MAX_EXACT_WINDOW
+        # and this resolves to the exact filter either way
         wb = max(window // stride, 1)
-        rm_b = rolling_median(bm, wb, chunk=chunk, stride=1,
+        rm_b = rolling_median(bm, wb, chunk=chunk, stride=None,
                               pad_mode="edge")
         # sample i's window is padded[i : i+window]; its centre block
         j = jnp.clip((jnp.arange(T) + left) // stride, 0, nblocks - 1)
@@ -137,10 +142,11 @@ def medfilt_highpass(tod: jax.Array, channel_mask: jax.Array, window: int,
     excluded from the regression moments so short scan blocks aren't biased
     by their padding. ``stride``: forwarded to :func:`rolling_median` —
     ``1`` forces the exact filter at any window, ``None`` uses the
-    two-level block-median filter beyond ``MAX_EXACT_WINDOW``. Returns
-    ``(filtered,
-    medfilt_tod)`` where ``filtered`` is (B, C, T) with excluded channels
-    zeroed and ``medfilt_tod`` is (B, T). Batch axes may precede B.
+    two-level block-median filter beyond ``MAX_EXACT_WINDOW``.
+
+    Returns ``(filtered, medfilt_tod)`` where ``filtered`` is (B, C, T)
+    with excluded channels zeroed and ``medfilt_tod`` is (B, T). Batch
+    axes may precede B.
     """
     cm = channel_mask[..., :, :, None]  # (B, C, 1)
     nch = jnp.maximum(jnp.sum(channel_mask, axis=-1), 1.0)[..., :, None]

@@ -132,8 +132,9 @@ def _fill_bad(tod, mask):
     for already-masked samples, and the full-length per-channel sort is
     one of the costliest ops in the reduction. When a channel's valid
     samples all fall off the stride-4 grid the subsampled median is
-    undefined — fall back to the full-length masked mean (cheap reduction)
-    instead of filling with 0 raw counts."""
+    undefined — ``masked_median`` on an empty subsample returns its
+    float32-max sort sentinel (~3.4e38), so fall back to the full-length
+    masked mean (cheap reduction) instead of filling with the sentinel."""
     med = masked_median(tod[..., ::4], mask[..., ::4], axis=-1)
     sub_cnt = jnp.sum(mask[..., ::4], axis=-1)
     cnt = jnp.sum(mask, axis=-1)
