@@ -1,50 +1,104 @@
 """Benchmark: full 19-feed CES observation -> Level-2 -> destriped map.
 
-Times the flagship jitted program (``parallel/step.py``: vane calibration +
-Level-1 -> Level-2 reduction + destriper CG) on one chip at production shape
-(19 feeds x 4 bands x 1024 channels, BASELINE.md config 3/5), and prints ONE
-JSON line::
+Production shape (BASELINE.md configs 3/5): 19 feeds x 4 bands x 1024
+channels x ~45 min of 50 Hz data (T ~ 136k over 10 scans), median-filter
+window 6000, destriped onto the production 480x480 field with a realistic
+raster sweep. Prints ONE JSON line::
 
     {"metric": "tod_samples_per_sec", "value": ..., "unit": "samples/s",
      "vs_baseline": ...}
 
-``value`` counts raw Level-1 samples (F*B*C*T) reduced per second of device
-time. ``vs_baseline`` is the ratio to the reference-equivalent throughput:
-a measured single-core NumPy implementation of the same hot chain (atmosphere
-fit, normalisation, rolling-median high-pass regression, gain solve, band
-average — the per-scan loop of ``Level1Averaging.py:792-872``) scaled by the
-reference's production scale of 16 MPI ranks (``scripts/general/pbs.script``).
+``value`` counts raw Level-1 samples (F*B*C*T) reduced per second of wall
+time (per-feed reduction stream + destriper CG, like the real pipeline).
 
-Env knobs: ``BENCH_SCALE`` (float, default 1.0) scales the sample count;
-``BENCH_SMALL=1`` runs a tiny config (CI smoke).
+``vs_baseline`` is measured, not assumed: the denominator wall time comes
+from a line-faithful single-core port of the reference's per-(feed, scan)
+hot chain (``Level1Averaging.py:792-872``) run at the SAME scan length and
+window — NaN fill via ``np.nanmedian``, per-channel atmosphere regression,
+auto-rms normalisation, the reference's own C++ dual-heap ``Mediator``
+median filter (compiled from ``/root/reference`` sources at runtime) with
+its 3x reflect padding, the scipy ``cg``/``LinearOperator`` gain solve over
+the flattened (time*4096) f64 vector, and the Tsys^2-weighted band average
+— timed on one unit in a single-threaded subprocess and scaled by the
+reference's production deployment of 16 MPI ranks
+(``scripts/general/pbs.script:27``). The baseline excludes the reference's
+HDF5 reads and its destriper (both would make it slower), so the ratio is
+conservative.
+
+Env knobs: ``BENCH_SCALE`` (float, default 1.0) scales the per-scan sample
+count; ``BENCH_SMALL=1`` runs a tiny config (CI smoke);
+``BENCH_BASELINE_S`` overrides the measured baseline unit seconds (skips
+the ~60 s single-core measurement, e.g. for quick re-runs).
 """
 
 from __future__ import annotations
 
+import ctypes
+import functools
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 REFERENCE_RANKS = 16  # mpirun -n 16, scripts/general/pbs.script:27
+_REF_MEDFILT_DIR = "/root/reference/comancpipeline/Tools/median_filter"
+_SHIM_DIR = "/tmp/comap_bench_ref"
 
 
-def _sliding_median_sorted(x: np.ndarray, window: int) -> np.ndarray:
-    """Sliding median via a maintained sorted window (bisect insort/remove).
+# --------------------------------------------------------------------------
+# Reference baseline: line-faithful single-core port of the hot chain
+# --------------------------------------------------------------------------
 
-    The same work class as the reference's C++ dual-heap ``Mediator``
-    (``Tools/median_filter/Mediator.h``): O(T) inserts/deletes into an
-    ordered structure, O(1) median reads. Python-level loop, C-speed
-    memmoves — the honest single-process stand-in for the Cython-wrapped
-    reference filter.
+def _build_reference_medfilt():
+    """Compile the reference's own C++ median filter to a ctypes lib.
+
+    Builds ``medianFilter.cpp`` (the dual-heap ``Mediator``) from the
+    read-only reference tree into /tmp with a tiny extern-C shim; nothing is
+    copied into this repo. Returns a callable ``medfilt(x_f64, window)`` or
+    None when the toolchain/sources are unavailable.
     """
+    so = os.path.join(_SHIM_DIR, "refmedfilt.so")
+    if not os.path.exists(so):
+        if not os.path.isdir(_REF_MEDFILT_DIR):
+            return None
+        os.makedirs(_SHIM_DIR, exist_ok=True)
+        shim = os.path.join(_SHIM_DIR, "shim.cpp")
+        with open(shim, "w") as f:
+            f.write('#include "medianFilter.h"\n'
+                    'extern "C" void ref_filter(double* a, int n, int w)'
+                    '{ filter(a, n, w); }\n')
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-I", _REF_MEDFILT_DIR,
+               shim, os.path.join(_REF_MEDFILT_DIR, "medianFilter.cpp"),
+               "-o", so]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+    lib = ctypes.CDLL(so)
+    lib.ref_filter.argtypes = [ctypes.POINTER(ctypes.c_double),
+                               ctypes.c_int, ctypes.c_int]
+
+    def medfilt(x, window):
+        buf = np.ascontiguousarray(x, dtype=np.float64)
+        lib.ref_filter(buf.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), buf.size, int(window))
+        return buf
+
+    return medfilt
+
+
+def _insort_medfilt(x, window):
+    """Pure-python fallback sliding median (same work class as the C++
+    dual-heap: ordered-window maintenance), used only if g++ or the
+    reference sources are missing."""
     import bisect
 
     half = window // 2
     out = np.empty_like(x)
-    win = sorted(x[:half + 1].tolist())  # window of i=0: x[0 : half+1]
+    win = sorted(x[:half + 1].tolist())
     out[0] = win[len(win) // 2]
     for i in range(1, len(x)):
         hi = i + half
@@ -57,99 +111,187 @@ def _sliding_median_sorted(x: np.ndarray, window: int) -> np.ndarray:
     return out
 
 
-def numpy_oracle_throughput(n_channels=1024, n_samples=2000, window=600,
-                            n_bands=1) -> float:
-    """Single-core NumPy samples/sec on the reduction hot chain.
+def reference_unit_seconds(L: int, window: int, B: int = 4,
+                           C: int = 1024, seed: int = 0) -> float:
+    """Wall seconds for ONE (feed, scan) of the reference hot chain.
 
-    Small slice, extrapolated per-sample: the chain is linear in T per
-    channel.
+    Mirrors the per-scan body of ``average_tod`` (``Level1Averaging.py:
+    792-872``) step by step in f64 numpy/scipy, calling the reference's own
+    compiled median filter. Run this single-threaded (see
+    ``measure_baseline``).
     """
-    rng = np.random.default_rng(0)
-    C, T, B = n_channels, n_samples, n_bands
-    tod = rng.normal(1000.0, 1.0, size=(B, C, T))
-    airmass = 1.2 + 0.01 * rng.normal(size=T)
+    from scipy.sparse.linalg import LinearOperator, cg
+
+    medfilt = _build_reference_medfilt() or _insort_medfilt
+    rng = np.random.default_rng(seed)
+    # raw counts with a common-mode gain drift so the chain sees
+    # realistically correlated data
+    drift = 1.0 + 1e-3 * np.cumsum(rng.normal(size=L)) / np.sqrt(L)
+    tod = (1000.0 + rng.normal(0, 1.0, size=(B, C, L))) * drift
+    airmass = 1.2 + 0.01 * rng.normal(size=L)
+    tsys = 45.0 * (1.0 + 0.2 * rng.random(size=(B, C)))
+    gains = 1e6 * np.ones((B, C))
+    atmos_fits = rng.normal(0, 0.1, size=(B, 2, C))
+    atmos_fits[:, 0, :] += 1000.0
 
     t0 = time.perf_counter()
-    # atmosphere: per-channel [1, A] regression
-    A = np.stack([np.ones(T), airmass])          # (2, T)
-    G = A @ A.T
-    coef = np.linalg.solve(G, A @ tod.reshape(B * C, T).T).T
-    clean = tod - (coef[:, 0:1] + coef[:, 1:2] * airmass).reshape(B, C, T)
-    # normalisation by auto-rms
-    d = clean[..., 1::2][..., :T // 2 * 2 // 2] - clean[..., ::2][..., :T // 2]
-    rms = np.sqrt(np.mean(d * d, axis=-1) / 2.0)
-    clean = clean / np.maximum(rms[..., None], 1e-30)
-    # rolling median of the band average (reference medfilt window ~ T/3)
-    mean_tod = clean.mean(axis=1)                # (B, T)
-    med = np.stack([_sliding_median_sorted(mean_tod[b], window)
-                    for b in range(B)])
-    # per-channel regression vs filter + gain solve + band average
-    dm = med - med.mean(axis=-1, keepdims=True)
-    smm = np.sum(dm * dm, axis=-1, keepdims=True)
-    slope = (clean @ dm[..., None] / np.maximum(smm, 1e-30)[..., None])
-    filtered = clean - slope * dm[:, None, :]
-    p = np.ones(B * C)
-    y = filtered.reshape(B * C, T)
-    dg = (p @ y) / (p @ p)
-    resid = y - p[:, None] * dg[None, :]
-    w = 1.0 / np.maximum(rms.reshape(B * C, 1) ** 2, 1e-30)
-    _ = (resid * w).reshape(B, C, T).sum(axis=1) / w.reshape(B, C, 1).sum(1)
-    dt = time.perf_counter() - t0
-    return (B * C * T) / dt
+    # fill_bad_data (:658-665): per-channel nanmedian fill
+    dr = tod.reshape(B * C, L)
+    nan_tod = np.isnan(dr)
+    ones = np.ones(dr.shape) * np.nanmedian(dr, axis=1)[:, None]
+    dr[nan_tod] = ones[nan_tod]
+    tod = dr.reshape(B, C, L)
+    # remove_atmosphere (:642-656): per-band [offset, slope] model
+    clean = np.zeros((B, C, L))
+    A = np.stack([np.ones(L), airmass])  # (2, L)
+    for ib in range(B):
+        clean[ib] = tod[ib] - atmos_fits[ib].T @ A
+    # normalise_data (:667-679): stride-4 pair differences
+    N4 = L // 4 * 4
+    diff = clean[..., np.arange(0, N4, 4)] - clean[..., np.arange(2, N4, 4)]
+    rms = np.nanstd(diff, axis=-1) / np.sqrt(2) * np.sqrt(
+        (2e9 / 1024.0) * (1 / 50.0))
+    clean = clean / rms[..., None]
+    # median_filter (:681-708): band mean -> 3x reflect pad -> C++ filter
+    # -> per-channel affine regression
+    filt = np.zeros((B, C, L))
+    index = np.arange(1024, dtype=int)[10:-10]
+    index = index[(index < 512 - 5) | (index > 512 + 5)]
+    index = index[index < C]
+    for ib in range(B):
+        masked = clean[ib, index, :]
+        mean_tod = np.nanmean(masked, axis=0)
+        pad = np.concatenate([mean_tod[::-1], mean_tod, mean_tod[::-1]])
+        med = medfilt(pad, window)[L:2 * L]
+        A2 = np.ones((L, 2))
+        A2[:, 1] = med
+        x = np.linalg.solve(A2.T @ A2, A2.T @ masked.T)
+        filt[ib, index] = masked - (A2 @ x).T
+    # gain_subtraction (:710, GainSubtraction.py:144-209): band-mean PS
+    # prerequisite + scipy cg over the flattened (L * B*C) f64 vector
+    for ib in range(B):
+        _ = np.abs(np.fft.fft(np.nanmean(filt[ib], axis=0))) ** 2
+    templates = np.ones((B, C, 3))
+    v = np.linspace(-1, 1, B * C).reshape((B, C))
+    templates[..., 0] = 1.0 / tsys
+    templates[..., 1] = v / tsys
+    templates[:, :20, :] = 0
+    templates[:, -20:, :] = 0
+    mid = C // 2
+    templates[:, mid - 5:mid + 5, :] = 0
+    d = filt.copy()
+    d[:, :20, :] = 0
+    d[:, -20:, :] = 0
+    d[:, mid - 5:mid + 5, :] = 0
+    tmpl = templates.reshape(B * C, 3)
+    dflat = d.reshape(B * C, L).T.flatten()
+
+    def z_op(dd, tm):
+        data = dd.reshape((L, tm.shape[0])).T
+        TT = np.linalg.inv(tm.T @ tm)
+        d_sub = tm @ (TT @ (tm.T @ data))
+        return dd - d_sub.T.flatten()
+
+    def p_op(g, tm):
+        return np.repeat(g, tm.size) * np.tile(tm, g.size)
+
+    def pt_op(dd, tm):
+        return np.sum(dd.reshape((L, tm.size)) * tm[None, :], axis=1)
+
+    def matvec(g):
+        return pt_op(z_op(p_op(g, tmpl[:, 2]), tmpl[:, :2]), tmpl[:, 2])
+
+    Aop = LinearOperator((L, L), matvec=matvec, dtype=np.float64)
+    b = pt_op(z_op(dflat, tmpl[:, :2]), tmpl[:, 2])
+    dG, _info = cg(Aop, b)
+    # weights + residual + band averages + auto-rms weights (:843-867)
+    weights = 1.0 / tsys ** 2
+    weights[:, :10] = 0
+    weights[:, -10:] = 0
+    weights[:, mid - 2:mid + 3] = 0
+    residual = (filt - dG[None, None, :]) * rms[..., None] / gains[..., None]
+    wsum = np.nansum(weights, axis=1)[:, None]
+    avg = np.nansum(residual * weights[..., None], axis=1) / wsum
+    clean_k = filt * tsys[..., None]
+    _avg2 = np.nansum(clean_k * weights[..., None], axis=1) / wsum
+    n2 = L // 2
+    ar = np.nanstd(avg[:, 0:2 * n2:2] - avg[:, 1:2 * n2:2],
+                   axis=1) / np.sqrt(2)
+    _ = 1.0 / np.maximum(ar, 1e-30)[:, None] ** 2
+    return time.perf_counter() - t0
 
 
-def device_inputs(F, B, C, T, scan_mask, vane_samples, npix, seed=7):
-    """Generate the observation arrays ON DEVICE (jax.random inside jit).
+def measure_baseline(L: int, window: int) -> float:
+    """Single-threaded wall seconds of one reference (feed, scan) unit.
 
-    The production-shape TOD is ~GBs; generating on host and pushing it
-    through the host->device link would dominate the benchmark setup (and
-    the reference equally excludes data simulation from its runtime).
+    Spawns a subprocess with BLAS/OpenMP pinned to one thread — the
+    per-rank budget the production `mpirun -n 16` on a 32-core node gives
+    the reference (2 cores/rank; 1 thread is generous to nobody and
+    reproducible).
     """
-    import jax
-    import jax.numpy as jnp
+    env = dict(os.environ)
+    for k in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS",
+              "NUMEXPR_NUM_THREADS"):
+        env[k] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    code = (f"import bench; "
+            f"print(bench.reference_unit_seconds({L}, {window}))")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"baseline subprocess failed (rc={out.returncode}):\n"
+            f"{out.stderr}")
+    return float(out.stdout.strip().splitlines()[-1])
 
-    @jax.jit
-    def gen(key):
-        k = jax.random.split(key, 6)
-        gain = 1e6 * (1.0 + 0.1 * jax.random.normal(k[0], (F, B, C)))
-        tsys = 45.0 * (1.0 + 0.2 * jax.random.uniform(k[1], (F, B, C)))
-        tod = gain[..., None] * tsys[..., None] * (
-            1.0 + 0.01 * jax.random.normal(k[2], (F, B, C, T)))
-        mask = jnp.broadcast_to(jnp.asarray(scan_mask), (F, B, C, T))
-        tv = vane_samples
-        vane_step = jnp.where(jnp.arange(tv) < tv // 2, 290.0, 0.0)
-        vane_tod = gain[..., None] * (tsys[..., None] + vane_step) * (
-            1.0 + 1e-3 * jax.random.normal(k[3], (F, B, C, tv)))
-        airmass = jnp.full((F, T), 1.2, jnp.float32)
-        sweep = (jnp.arange(T) * 7) % npix
-        pixels = jnp.broadcast_to(sweep, (F, T)).astype(jnp.int32)
-        freq = jnp.broadcast_to(jnp.linspace(-0.1, 0.1, C), (B, C))
-        return dict(tod=tod.astype(jnp.float32), mask=mask,
-                    vane_tod=vane_tod.astype(jnp.float32), airmass=airmass,
-                    pixels=pixels, freq_scaled=freq.astype(jnp.float32))
 
-    out = gen(jax.random.key(seed))
-    jax.block_until_ready(out["tod"])
-    return out
+# --------------------------------------------------------------------------
+# TPU pipeline at production shape
+# --------------------------------------------------------------------------
+
+def ces_pixels(T: int, nx: int, ny: int, feed: int, n_feeds: int):
+    """Raster-scan pixel stream over an (ny, nx) field.
+
+    Constant-elevation sweep: azimuth triangles across the field ~10 px/s
+    while the field drifts through elevation rows over the observation —
+    every row is crossed many times and most of the map is hit, so the
+    destriper CG does production work. Feeds are offset across the focal
+    plane.
+    """
+    t = np.arange(T, dtype=np.float64)
+    period = 2.0 * nx / 10.0 * 50.0  # full sweep and back at 10 px/s, 50 Hz
+    phase = (t / period + feed / max(n_feeds, 1)) % 1.0
+    x = np.where(phase < 0.5, phase * 2, 2 - 2 * phase) * (nx - 1)
+    y = (t / T) * (ny - 1 - 8) + (feed * 8) / max(n_feeds, 1)
+    pix = np.round(y) * nx + np.round(x)
+    return pix.astype(np.int32)
 
 
 def main():
     import jax
+    import jax.numpy as jnp
 
-    from comapreduce_tpu.parallel.mesh import local_mesh
-    from comapreduce_tpu.parallel.step import ObservationStep
+    from comapreduce_tpu.mapmaking.destriper import destripe_planned
+    from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+    from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
+                                            scan_starts_lengths)
+    from comapreduce_tpu.ops.vane import _event_kernel
 
     small = os.environ.get("BENCH_SMALL", "") == "1"
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
 
     if small:
         F, B, C, scan_samples, n_scans, window = 2, 2, 64, 1000, 2, 101
-        npix, vane_samples = 64, 128
+        nx = ny = 8
+        vane_samples, scan_batch = 128, None
     else:
-        F, B, C, n_scans, window = 19, 4, 1024, 2, 6001
-        scan_samples = max(int(2000 * scale), 500)
-        npix, vane_samples = 480 * 480, 256
+        F, B, C, n_scans, window = 19, 4, 1024, 10, 6000
+        scan_samples = max(int(13500 * scale), 1000)
+        nx = ny = 480
+        vane_samples, scan_batch = 256, 2
 
+    npix = nx * ny
     gap = 64
     edges, t = [], gap
     for _ in range(n_scans):
@@ -161,47 +303,116 @@ def main():
     for s, e in edges:
         scan_mask[s:e] = 1.0
 
-    arrays = device_inputs(F, B, C, T, scan_mask, vane_samples, npix)
-    n_raw = F * B * C * T
+    starts, lengths, L = scan_starts_lengths(edges)
+    starts_j = jnp.asarray(starts, jnp.int32)
+    lengths_j = jnp.asarray(lengths, jnp.int32)
+    cfg = ReduceConfig(C, medfilt_window=window, scan_batch=scan_batch)
+    freq = np.broadcast_to(np.linspace(-0.1, 0.1, C), (B, C))
+    freq_j = jnp.asarray(freq, jnp.float32)
+    mask_j = jnp.asarray(scan_mask)
 
-    mesh = local_mesh()
-    step = ObservationStep(mesh, scan_edges=edges, n_samples=T, npix=npix,
-                           offset_length=50, n_iter=50, n_channels=C,
-                           medfilt_window=window)
+    @jax.jit
+    def feed_step(key):
+        """One feed: generate raw counts on device, vane-calibrate, reduce.
+
+        Generation runs on device because the production-shape per-feed TOD
+        (~2.2 GB) would otherwise bottleneck on the host link; the reference
+        equally excludes data simulation from its runtime (its analogue, the
+        HDF5 read, is excluded from the baseline too).
+        """
+        k = jax.random.split(key, 4)
+        gain = 1e6 * (1.0 + 0.1 * jax.random.normal(k[0], (B, C)))
+        tsys = 45.0 * (1.0 + 0.2 * jax.random.uniform(k[1], (B, C)))
+        tod = gain[..., None] * tsys[..., None] * (
+            1.0 + 0.01 * jax.random.normal(k[2], (B, C, T)))
+        mask = jnp.broadcast_to(mask_j, (B, C, T))
+        vane_step = jnp.where(jnp.arange(vane_samples) < vane_samples // 2,
+                              290.0, 0.0)
+        vane_tod = gain[..., None] * (tsys[..., None] + vane_step) * (
+            1.0 + 1e-3 * jax.random.normal(k[3], (B, C, vane_samples)))
+        airmass = jnp.full((T,), 1.2, jnp.float32)
+        # _event_kernel expects a leading feed axis: add a singleton
+        tsys_cal, gain_cal = _event_kernel(vane_tod[None], jnp.float32(290.0))
+        tsys_cal, gain_cal = tsys_cal[0], gain_cal[0]
+        red = reduce_feed_scans(tod, mask, airmass, starts_j, lengths_j,
+                                tsys_cal, gain_cal, freq_j,
+                                cfg=cfg, n_scans=len(starts), L=L)
+        return red["tod"], red["weights"]
+
+    all_pix = np.stack([ces_pixels(T, nx, ny, f, F) for f in range(F)])
+
+    offset_length, n_iter = 50, 100
+    # static pointing -> plan built once (host), reused every run; the
+    # per-sample pixel stream for the destriper is (F, B, T) flattened
+    pix_flat = np.broadcast_to(all_pix[:, None, :], (F, B, T)).reshape(-1)
+    n_pad = (-pix_flat.size) % offset_length
+    pix_flat = np.concatenate([pix_flat, np.full(n_pad, npix, np.int64)])
+    plan = build_pointing_plan(pix_flat, npix, offset_length)
+    jitted_destripe = jax.jit(functools.partial(
+        destripe_planned, plan=plan, n_iter=n_iter, threshold=1e-6))
+
+    def run_pipeline():
+        keys = jax.random.split(jax.random.key(7), F)
+        tods, weis = [], []
+        for f in range(F):
+            tod_f, w_f = feed_step(keys[f])
+            tods.append(tod_f)
+            weis.append(w_f)
+        flat_tod = jnp.stack(tods).reshape(-1)
+        flat_w = jnp.stack(weis).reshape(-1)
+        if n_pad:
+            flat_tod = jnp.concatenate(
+                [flat_tod, jnp.zeros(n_pad, flat_tod.dtype)])
+            flat_w = jnp.concatenate(
+                [flat_w, jnp.zeros(n_pad, flat_w.dtype)])
+        return jitted_destripe(flat_tod, flat_w)
 
     # warm-up: compile + first run
-    level2, result = step(**arrays)
-    jax.block_until_ready((level2["tod"], result.destriped_map))
+    result = run_pipeline()
+    jax.block_until_ready(result.destriped_map)
 
-    n_rep = 3
+    n_rep = 2 if not small else 1
     best = float("inf")
     for _ in range(n_rep):
         t0 = time.perf_counter()
-        level2, result = step(**arrays)
-        jax.block_until_ready((level2["tod"], result.destriped_map))
+        result = run_pipeline()
+        jax.block_until_ready(result.destriped_map)
         best = min(best, time.perf_counter() - t0)
 
+    n_raw = F * B * C * T
     throughput = n_raw / best
     cg_iters_per_sec = float(result.n_iter) / best
 
-    oracle = numpy_oracle_throughput(
-        n_channels=min(C, 256), n_samples=1500,
-        window=min(window, 301), n_bands=1)
-    baseline = oracle * REFERENCE_RANKS
+    # ---- measured reference baseline ------------------------------------
+    env_unit = os.environ.get("BENCH_BASELINE_S", "")
+    if env_unit:
+        unit_s = float(env_unit)
+    else:
+        unit_s = measure_baseline(L=int(L), window=window)
+    # full job single-core = one unit per (feed, scan); production = 16 ranks
+    baseline_wall = unit_s * F * n_scans / REFERENCE_RANKS
+    vs_baseline = baseline_wall / best
+
     line = {
         "metric": "tod_samples_per_sec",
         "value": round(throughput, 1),
         "unit": "samples/s",
-        "vs_baseline": round(throughput / baseline, 2),
+        "vs_baseline": round(vs_baseline, 2),
         "detail": {
             "shape": [F, B, C, T],
+            "medfilt_window": window,
             "wall_s": round(best, 4),
+            "cg_iters": int(result.n_iter),
             "cg_iters_per_sec": round(cg_iters_per_sec, 1),
-            "numpy_1core_samples_per_sec": round(oracle, 1),
+            "map_hit_fraction": None,
+            "baseline_unit_s": round(unit_s, 3),
+            "baseline_wall_s_16rank": round(baseline_wall, 2),
             "baseline_ranks": REFERENCE_RANKS,
             "device": str(jax.devices()[0].platform),
         },
     }
+    hits = np.asarray(result.hit_map)
+    line["detail"]["map_hit_fraction"] = round(float((hits > 0).mean()), 3)
     print(json.dumps(line))
 
 

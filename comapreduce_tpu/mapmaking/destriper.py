@@ -32,8 +32,10 @@ import jax.numpy as jnp
 
 from comapreduce_tpu.mapmaking.binning import (accumulate_weights, bin_map,
                                                naive_map, sample_map)
+from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
+                                                     binned_window_sum)
 
-__all__ = ["DestriperResult", "destripe", "destripe_jit"]
+__all__ = ["DestriperResult", "destripe", "destripe_jit", "destripe_planned"]
 
 
 class DestriperResult(NamedTuple):
@@ -85,6 +87,54 @@ def _dot(x, y, axis_name):
     return s
 
 
+def _cg_loop(matvec, b, dot, n_iter: int, threshold: float):
+    """Shared CG driver over an arbitrary pytree of unknowns.
+
+    Both destriper paths (scatter and planned) use this one loop so the
+    singular-system breakdown guard and convergence criterion cannot drift
+    apart: the system is SPD but singular (a global constant offset is in
+    the null space once Z removes the map mean), and in f32 roundoff can
+    eventually push the search direction out of the range space and
+    ``p^T A p`` to <= 0 — detect the breakdown and stop with the current
+    iterate rather than dividing into a NaN. ``dot`` supplies the (possibly
+    psum-reduced) inner product. Returns ``(x, rz, k, b_norm)``.
+    """
+    b_norm = dot(b, b)
+
+    def axpy(a, x, y):
+        return jax.tree.map(lambda xi, yi: xi + a * yi, x, y)
+
+    def cond(state):
+        _, _, _, rz, k, done = state
+        return ((k < n_iter) & ~done
+                & (rz > threshold**2 * jnp.maximum(b_norm, 1e-30)))
+
+    def body(state):
+        x, r, p, rz, k, done = state
+        q = matvec(p)
+        pq = dot(p, q)
+        ok = jnp.isfinite(pq) & (pq > 0)
+        alpha = jnp.where(ok, rz / jnp.where(ok, pq, 1.0), 0.0)
+        x_new = axpy(alpha, x, p)
+        r_new = axpy(-alpha, r, q)
+        rz_new = dot(r_new, r_new)
+        ok = ok & jnp.isfinite(rz_new)
+        beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
+        p_new = axpy(beta, r_new, p)
+        # on breakdown: freeze the iterate, keep the last good residual
+        # for reporting, and flag the loop to exit
+        sel = lambda new, old: jax.tree.map(  # noqa: E731
+            lambda a_, b_: jnp.where(ok, a_, b_), new, old)
+        return (sel(x_new, x), sel(r_new, r), sel(p_new, p),
+                jnp.where(ok, rz_new, rz), k + 1, ~ok)
+
+    x0 = jax.tree.map(jnp.zeros_like, b)
+    state0 = (x0, b, b, b_norm, jnp.asarray(0, jnp.int32),
+              jnp.asarray(False))
+    x, _, _, rz, k, _ = jax.lax.while_loop(cond, body, state0)
+    return x, rz, k, b_norm
+
+
 def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
              npix: int, offset_length: int = 50, n_iter: int = 100,
              threshold: float = 1e-6, axis_name: str | None = None,
@@ -126,54 +176,8 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
 
     b = _reduce(Zmap(tod), ground_ids, az, n_offsets, offset_length,
                 n_groups, with_ground, axis_name)
-    b_norm = _dot(b, b, axis_name)
-
-    x0 = (jnp.zeros(n_offsets, f32),
-          jnp.zeros((n_groups, 2), f32) if with_ground else None)
-
-    def cond(state):
-        _, _, _, rz, k, done = state
-        return ((k < n_iter) & ~done
-                & (rz > threshold**2 * jnp.maximum(b_norm, 1e-30)))
-
-    def axpy(a, x, y):
-        """x + a*y over the (offsets, ground-or-None) pair."""
-        return (x[0] + a * y[0],
-                None if x[1] is None else x[1] + a * y[1])
-
-    def body(state):
-        x, r, p, rz, k, done = state
-        q = matvec(p)
-        pq = _dot(p, q, axis_name)
-        # The system is SPD but singular (a global constant offset is in the
-        # null space once Z removes the map mean). In f32, roundoff can
-        # eventually push the search direction out of the range space and
-        # p^T A p to <= 0 — detect the breakdown and stop with the current
-        # iterate rather than dividing into a NaN.
-        ok = jnp.isfinite(pq) & (pq > 0)
-        alpha = jnp.where(ok, rz / jnp.where(ok, pq, 1.0), 0.0)
-        x_new = axpy(alpha, x, p)
-        r_new = axpy(-alpha, r, q)
-        rz_new = _dot(r_new, r_new, axis_name)
-        ok = ok & jnp.isfinite(rz_new)
-        beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
-        p_new = axpy(beta, r_new, p)
-        # on breakdown: freeze the iterate, keep the last good residual for
-        # reporting, and flag the loop to exit
-        keep = lambda new, old: jax.tree.map(  # noqa: E731
-            lambda a_, b_: jnp.where(ok, a_, b_), new, old)
-        x = (keep(x_new[0], x[0]),
-             None if x[1] is None else keep(x_new[1], x[1]))
-        r = (keep(r_new[0], r[0]),
-             None if r[1] is None else keep(r_new[1], r[1]))
-        p = (keep(p_new[0], p[0]),
-             None if p[1] is None else keep(p_new[1], p[1]))
-        rz = jnp.where(ok, rz_new, rz)
-        return x, r, p, rz, k + 1, ~ok
-
-    state0 = (x0, b, b, b_norm, jnp.asarray(0, jnp.int32),
-              jnp.asarray(False))
-    x, r, _, rz, k, _ = jax.lax.while_loop(cond, body, state0)
+    x, rz, k, b_norm = _cg_loop(
+        matvec, b, lambda u, v: _dot(u, v, axis_name), n_iter, threshold)
     offsets, ground = x
 
     # final products
@@ -193,3 +197,97 @@ destripe_jit = jax.jit(
     destripe,
     static_argnames=("npix", "offset_length", "n_iter", "threshold",
                      "axis_name", "n_groups"))
+
+
+def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
+                     n_iter: int = 100, threshold: float = 1e-6
+                     ) -> DestriperResult:
+    """Destripe with a precomputed :class:`PointingPlan` — the fast path.
+
+    Mathematically identical to :func:`destripe` (same normal equations,
+    same CG with breakdown guard), but every per-iteration binning runs in
+    the coarse (pixel, offset)-pair space with MXU one-hot binning instead
+    of per-sample scatter-adds (see ``pointing_plan`` module docstring) —
+    measured >10x faster per CG iteration at production shape. Use when the
+    pointing is fixed for the whole solve (always true per band); the
+    scatter-based :func:`destripe` remains the general/oracle path and the
+    one used under ``shard_map``.
+
+    ``tod``/``weights``: f32[N] in natural sample order, N as the plan was
+    built. Ground-template solves stay on the general path.
+    """
+    dv = plan.device()
+    f32 = tod.dtype
+    n_off, n_rank = plan.n_offsets, plan.n_rank
+    P_pad = int(dv["pair_rank"].shape[0])
+    N_pad = int(dv["sample_perm"].shape[0])
+    N = tod.shape[0]
+
+    # sorted sample values; padding slots (which alias sample 0) zeroed
+    pad_mask = (jnp.arange(N_pad) < N).astype(f32)
+    w_s = weights[dv["sample_perm"]] * pad_mask
+    wd_s = w_s * tod[dv["sample_perm"]]
+
+    def pair_sum(v):
+        return binned_window_sum(v, dv["sample_pair"], dv["sample_base"],
+                                 plan.sample_window, plan.sample_chunk,
+                                 P_pad)
+
+    def rank_sum(pv):
+        return binned_window_sum(pv, dv["pair_rank"], dv["rank_base"],
+                                 plan.rank_window, plan.pair_chunk, n_rank)
+
+    po_off = dv["pair_offset"][dv["pair_perm_off"]]
+
+    def off_sum(pv):
+        return binned_window_sum(pv[dv["pair_perm_off"]], po_off,
+                                 dv["off_base"], plan.off_window,
+                                 plan.pair_chunk, n_off)
+
+    # one-time aggregates
+    pair_w = pair_sum(w_s)           # P^T-pair weights
+    pair_wd = pair_sum(wd_s)
+    pair_cnt = pair_sum(pad_mask)
+    sum_w = rank_sum(pair_w)         # compact weight map
+    diag = off_sum(pair_w)           # diagonal of F^T W F
+
+    def to_map(pv):
+        s = rank_sum(pv)
+        return jnp.where(sum_w > 0, s / jnp.maximum(sum_w, 1e-30), 0.0)
+
+    def gather_a(a):
+        # padding pairs' sentinel offset clamps to a[-1]; their pair_w is 0
+        return a[jnp.clip(dv["pair_offset"], 0, n_off - 1)]
+
+    def gather_m(m):
+        # invalid-pixel pairs (sentinel rank) read 0 from the map — the
+        # scatter path's sample_map semantics
+        ranks = dv["pair_rank"]
+        return jnp.where(ranks < n_rank,
+                         m[jnp.clip(ranks, 0, n_rank - 1)], 0.0)
+
+    def matvec(a):
+        pav = pair_w * gather_a(a)
+        m = to_map(pav)
+        return diag * a - off_sum(pair_w * gather_m(m))
+
+    m_d = to_map(pair_wd)
+    b = off_sum(pair_wd) - off_sum(pair_w * gather_m(m_d))
+    a, rz, k, b_norm = _cg_loop(matvec, b, lambda u, v: jnp.sum(u * v),
+                                n_iter, threshold)
+
+    # final products, scattered once from compact ranks to the full map
+    pair_res = pair_wd - pair_w * gather_a(a)
+    uniq = dv["uniq_pixels"]
+
+    def expand(cmp):
+        return jnp.zeros(plan.npix, f32).at[uniq].set(
+            cmp, mode="drop", unique_indices=True)
+
+    m_destriped = expand(to_map(pair_res))
+    m_naive = expand(m_d)
+    w_map = expand(sum_w)
+    h_map = expand(rank_sum(pair_cnt))
+    residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
+    return DestriperResult(a, jnp.zeros((0, 2), f32), m_destriped, m_naive,
+                           w_map, h_map, k, residual)

@@ -1,0 +1,222 @@
+"""Static pointing plan: scatter-free destriper binning on TPU.
+
+XLA lowers ``segment_sum``/scatter-add onto TPU as a serialized scatter —
+measured ~75 ms per 10M-sample binning on a v5e, which made the destriper
+CG (one bin per matvec, ``Destriper.py:217-263``) two orders of magnitude
+slower than the memory bound. The pointing never changes across CG
+iterations, so all data-dependent index structure can be computed ONCE on
+host and the per-iteration work recast as dense MXU math:
+
+1. **Compact ranks**: unique hit pixels -> rank space (the reference's
+   seen-pixel compaction, ``COMAPData.py:43-70,570-574``), so map vectors
+   are ~#hit-pixels, not npix.
+2. **(rank, offset) pairs**: within one destriper offset (L consecutive
+   samples) the telescope crosses only ~10-20 pixels, so the weighted
+   pointing matrix ``P^T W F`` has one aggregate per (pixel, offset) pair —
+   ~4x fewer entries than samples. The CG matvec runs entirely in pair
+   space.
+3. **Windowed one-hot binning**: pairs sorted by rank (or offset) are
+   binned in fixed chunks; within a chunk every id lies in a static
+   ``[base, base+window)`` range, so binning is an equality one-hot times
+   values — an MXU matmul — plus one tiny (n_chunks*window) assembly
+   scatter. No large scatter ever runs.
+
+The plan is plain numpy (host, built once per pointing); ``device()``
+uploads the index arrays. ``mapmaking.destriper.destripe_planned`` consumes
+it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PointingPlan", "build_pointing_plan", "binned_window_sum"]
+
+
+def _round_up(x: int, q: int) -> int:
+    return -(-int(x) // q) * q
+
+
+@dataclass
+class PointingPlan:
+    """Static index structure for one pointing (see module docstring).
+
+    Sample arrays are in *sorted* order (by (rank, offset)); device data
+    enters through ``sample_perm``. Pair arrays come in two orders: rank
+    order (for pair->map binning) and offset order (for pair->offset
+    binning), linked by ``pair_perm_off``.
+    """
+
+    npix: int
+    offset_length: int
+    n_offsets: int
+    n_rank: int                      # unique hit pixels
+    uniq_pixels: np.ndarray          # i64[n_rank] rank -> global pixel
+    # sample space (length N_pad, sorted by (rank, offset))
+    sample_perm: np.ndarray          # i32[N_pad] gather: x_sorted = x[perm]
+    sample_pair: np.ndarray          # i32[N_pad] pair id per sorted sample
+    sample_chunk: int
+    sample_window: int
+    sample_base: np.ndarray          # i32[n_s_chunks] pair-id base per chunk
+    # pair space, rank order (length P_pad)
+    n_pairs: int                     # valid pairs (excludes trash/padding)
+    pair_rank: np.ndarray            # i32[P_pad]
+    pair_offset: np.ndarray          # i32[P_pad]
+    pair_chunk: int
+    rank_window: int
+    rank_base: np.ndarray            # i32[n_p_chunks] rank base per chunk
+    # pair space, offset order
+    pair_perm_off: np.ndarray        # i32[P_pad]: x_off = x_rank[perm]
+    off_window: int
+    off_base: np.ndarray             # i32[n_p_chunks] offset base per chunk
+    _device: dict = field(default_factory=dict, repr=False)
+
+    def device(self) -> dict:
+        """Upload (and cache) the index arrays as device i32 arrays."""
+        if not self._device:
+            self._device = {
+                k: jnp.asarray(getattr(self, k), jnp.int32)
+                for k in ("sample_perm", "sample_pair", "sample_base",
+                          "pair_rank", "pair_offset", "rank_base",
+                          "pair_perm_off", "off_base", "uniq_pixels")}
+        return self._device
+
+
+def _window_layout(ids_sorted: np.ndarray, chunk: int, align: int = 128):
+    """Per-chunk base ids and the window width covering every chunk's span.
+
+    ``ids_sorted`` must be ascending; the caller pads its length to a chunk
+    multiple beforehand.
+    """
+    n_chunks = len(ids_sorted) // chunk
+    blocks = ids_sorted.reshape(n_chunks, chunk)
+    base = blocks[:, 0].astype(np.int64)
+    span = blocks[:, -1] - base + 1
+    window = _round_up(max(int(span.max()), 1), align)
+    return base.astype(np.int32), int(window)
+
+
+def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
+                        sample_chunk: int = 8192,
+                        pair_chunk: int = 4096) -> PointingPlan:
+    """Build the static plan for one flat pointing vector.
+
+    ``pixels``: integer pixel per sample (invalid = negative or >= npix);
+    length must be a multiple of ``offset_length`` (sample t belongs to
+    offset ``t // L``, ``OffsetTypes.py:11-54``). Invalid samples keep
+    their true offset but carry the sentinel rank ``n_rank``: they
+    participate in offset-domain sums (same semantics as the scatter path,
+    where an invalid sample reads 0 from the map but its weight still
+    enters ``F^T W``) while their map-domain sums land in a padding slot
+    that is sliced away.
+    """
+    pixels = np.asarray(pixels).astype(np.int64).ravel()
+    N = pixels.size
+    if N % offset_length:
+        raise ValueError(f"N={N} not a multiple of L={offset_length}")
+    n_offsets = N // offset_length
+    offs = np.arange(N, dtype=np.int64) // offset_length
+    valid = (pixels >= 0) & (pixels < npix)
+
+    uniq = np.unique(pixels[valid])
+    n_rank = int(uniq.size)
+    rank = np.full(N, n_rank, dtype=np.int64)
+    rank[valid] = np.searchsorted(uniq, pixels[valid])
+
+    # sort samples by (rank, offset); invalid (rank = n_rank) sort last
+    # but keep their true offset so offset-domain sums see them
+    key = rank * n_offsets + offs
+    perm = np.argsort(key, kind="stable")
+    skey = key[perm]
+
+    new_pair = np.empty(N, dtype=bool)
+    new_pair[0] = True
+    np.not_equal(skey[1:], skey[:-1], out=new_pair[1:])
+    pair_id = np.cumsum(new_pair) - 1
+    n_pairs_all = int(pair_id[-1]) + 1
+    n_pairs = n_pairs_all
+
+    firsts = np.flatnonzero(new_pair)
+    pair_rank = rank[perm][firsts]
+    pair_offset = offs[perm][firsts]
+
+    # ---- pad sample space to a chunk multiple ---------------------------
+    N_pad = _round_up(max(N, 1), sample_chunk)
+    sample_perm = np.concatenate(
+        [perm, np.zeros(N_pad - N, np.int64)]).astype(np.int32)
+    # padding samples point at slot 0's data but carry the sentinel pair id
+    # n_pairs_all, whose sums land in the sliced-off padding region
+    sample_pair = np.concatenate(
+        [pair_id, np.full(N_pad - N, n_pairs_all, np.int64)])
+    sample_base, sample_window = _window_layout(sample_pair, sample_chunk)
+    sample_pair = sample_pair.astype(np.int32)
+
+    # ---- pad pair space to a chunk multiple -----------------------------
+    P_pad = _round_up(max(n_pairs_all, 1), pair_chunk)
+    pad = P_pad - n_pairs_all
+    # padding pairs carry sentinel rank n_rank / offset n_offsets
+    pair_rank = np.concatenate(
+        [pair_rank, np.full(pad, n_rank, np.int64)])
+    pair_offset = np.concatenate(
+        [pair_offset, np.full(pad, n_offsets, np.int64)])
+    rank_base, rank_window = _window_layout(pair_rank, pair_chunk)
+
+    # offset-order view (pairs sorted by (offset, rank))
+    okey = pair_offset * (n_rank + 1) + pair_rank
+    pair_perm_off = np.argsort(okey, kind="stable")
+    off_base, off_window = _window_layout(
+        pair_offset[pair_perm_off], pair_chunk)
+
+    return PointingPlan(
+        npix=int(npix), offset_length=int(offset_length),
+        n_offsets=int(n_offsets), n_rank=n_rank,
+        uniq_pixels=uniq,
+        sample_perm=sample_perm, sample_pair=sample_pair,
+        sample_chunk=int(sample_chunk), sample_window=sample_window,
+        sample_base=sample_base,
+        n_pairs=n_pairs, pair_rank=pair_rank.astype(np.int32),
+        pair_offset=pair_offset.astype(np.int32),
+        pair_chunk=int(pair_chunk),
+        rank_window=rank_window, rank_base=rank_base,
+        pair_perm_off=pair_perm_off.astype(np.int32),
+        off_window=off_window, off_base=off_base)
+
+
+def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
+                      window: int, chunk: int, out_size: int,
+                      batch: int = 8) -> jax.Array:
+    """Sum ``values`` into ``out[id]`` for pre-sorted, chunk-windowed ids.
+
+    ``values``/``ids``: f32/i32[M] with ``M % chunk == 0`` and every id of
+    chunk c inside ``[base[c], base[c] + window)`` (ids outside — sentinels
+    — are dropped). The inner product against the equality one-hot is an
+    MXU matmul (f32-exact: one-hot entries are 0/1); chunks stream through
+    ``lax.map`` so the one-hot never materialises beyond
+    ``batch * chunk * window`` floats. Assembly of the per-chunk windows is
+    the only scatter left — ``n_chunks * window`` elements, orders of
+    magnitude smaller than a per-sample scatter.
+    """
+    M = values.shape[0]
+    n_chunks = M // chunk
+    v = values.reshape(n_chunks, chunk)
+    ids_c = ids.reshape(n_chunks, chunk)
+
+    def body(args):
+        v_c, id_c, b_c = args
+        local = id_c - b_c
+        oh = (local[:, None] == jnp.arange(window)[None, :])
+        return jax.lax.dot_general(
+            v_c[None, :], oh.astype(v_c.dtype),
+            (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)[0]
+
+    part = jax.lax.map(body, (v, ids_c, base), batch_size=batch)
+    out = jnp.zeros(out_size + window, values.dtype)
+    idx = (base[:, None].astype(jnp.int32)
+           + jnp.arange(window, dtype=jnp.int32)[None, :])
+    out = out.at[idx.reshape(-1)].add(part.reshape(-1), mode="drop")
+    return out[:out_size]

@@ -23,7 +23,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from comapreduce_tpu.ops.stats import median_lastaxis
+
 __all__ = ["rolling_median", "medfilt_highpass"]
+
+# windows at least this wide take the radix-bisection median (32 counting
+# passes) instead of the bitonic sort (~log^2 w passes); below it the sort
+# wins on launch simplicity
+_SELECT_MEDIAN_MIN_WINDOW = 65
 
 
 # Windows above this switch to the two-level block-median filter (see
@@ -106,11 +113,14 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
                      + [(0, total - T)], mode="edge")
     win_idx = (jnp.arange(chunk)[:, None] + jnp.arange(window)[None, :])
 
+    med_fn = (median_lastaxis if window >= _SELECT_MEDIAN_MIN_WINDOW
+              else functools.partial(jnp.median, axis=-1))
+
     def body(ci):
         seg = lax.dynamic_slice_in_dim(padded, ci * chunk, seg_len,
                                        axis=-1)
         mat = seg[..., win_idx]            # (..., chunk, window)
-        return jnp.median(mat, axis=-1)    # (..., chunk)
+        return med_fn(mat)                 # (..., chunk)
 
     out = lax.map(body, jnp.arange(n_chunks))  # (n_chunks, ..., chunk)
     out = jnp.moveaxis(out, 0, -2)             # (..., n_chunks, chunk)

@@ -23,6 +23,7 @@ __all__ = [
     "masked_mean",
     "masked_std",
     "masked_median",
+    "median_lastaxis",
     "mad",
     "auto_rms",
     "tsys_rms",
@@ -59,29 +60,108 @@ def masked_std(x: jax.Array, mask: jax.Array | None = None, axis=-1):
     return jnp.sqrt(jnp.maximum(var, 0.0))
 
 
+def _f32_sortable_u32(x: jax.Array) -> jax.Array:
+    """Monotone f32 -> u32 key: total order matches float comparison."""
+    u = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    neg = (u >> 31) == 1
+    return jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
+
+
+def _u32_sortable_f32(u: jax.Array) -> jax.Array:
+    """Inverse of :func:`_f32_sortable_u32`."""
+    was_neg = (u >> 31) == 0
+    v = jnp.where(was_neg, ~u, u & jnp.uint32(0x7FFFFFFF))
+    return jax.lax.bitcast_convert_type(v, jnp.float32)
+
+
+def _kth_smallest_u32(u: jax.Array, k: jax.Array) -> jax.Array:
+    """Exact k-th smallest (0-based) per row of u32 keys, by 32-step value
+    bisection: each step counts ``u <= mid`` — a fused compare+reduce pass.
+
+    On TPU this replaces a row sort: XLA lowers a length-n sort to a
+    bitonic network of ~log^2(n) full passes (measured ~20x slower than the
+    32 counting passes at the production row length of ~3400)."""
+    lo = jnp.zeros(u.shape[:-1], jnp.uint32)
+    hi = jnp.full(u.shape[:-1], 0xFFFFFFFF, jnp.uint32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo) // 2
+        c = jnp.sum((u <= mid[..., None]).astype(jnp.int32), axis=-1)
+        take = c >= (k + 1)
+        return (jnp.where(take, lo, mid + 1), jnp.where(take, mid, hi))
+
+    lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    return lo
+
+
+def median_lastaxis(x: jax.Array) -> jax.Array:
+    """Exact median over the last axis, no mask — radix bisection.
+
+    Drop-in for ``jnp.median(x, axis=-1)`` on TPU for wide f32 rows, where
+    the sort-based median pays ~log^2(n) bitonic passes vs 32 counting
+    passes here (plus 2 for the upper median on even lengths). Matches
+    ``jnp.median`` semantics: NaN inputs propagate to a NaN result; non-f32
+    dtypes fall back to the sort path rather than silently truncating.
+    """
+    if x.dtype != jnp.float32:
+        return jnp.median(x, axis=-1)
+    n = x.shape[-1]
+    u = _f32_sortable_u32(x)
+    k_lo = jnp.full(x.shape[:-1], (n - 1) // 2, jnp.int32)
+    v_lo = _kth_smallest_u32(u, k_lo)
+    if n % 2 == 1:
+        med = _u32_sortable_f32(v_lo)
+    else:
+        c_le = jnp.sum((u <= v_lo[..., None]).astype(jnp.int32), axis=-1)
+        above = jnp.where(u > v_lo[..., None], u, jnp.uint32(0xFFFFFFFF))
+        v_next = jnp.min(above, axis=-1)
+        v_hi = jnp.where(c_le >= n // 2 + 1, v_lo, v_next)
+        med = 0.5 * (_u32_sortable_f32(v_lo) + _u32_sortable_f32(v_hi))
+    return jnp.where(jnp.any(jnp.isnan(x), axis=-1), jnp.nan, med)
+
+
 def masked_median(x: jax.Array, mask: jax.Array | None = None, axis: int = -1):
     """Median over ``axis`` ignoring masked-out samples.
 
-    Implemented by sorting with masked-out entries pushed to +inf and reading
-    the element at index ``(count-1)/2`` (lower median for even counts after
-    averaging with the upper one). Fully jittable; O(n log n).
+    Exact (equals the sort-based definition: mean of the lower and upper
+    median), but computed by radix bisection on sortable u32 keys — O(32)
+    vectorised counting passes instead of a bitonic sort, the TPU-fast
+    formulation for the long rows of the NaN-fill path
+    (``Level1Averaging.py:658-665``).
     """
     axis = axis % x.ndim
     x = jnp.moveaxis(x, axis, -1)
-    n = x.shape[-1]
     if mask is None:
-        return jnp.median(x, axis=-1)
+        return (median_lastaxis(x) if x.shape[-1] >= 65
+                and x.dtype == jnp.float32 else jnp.median(x, axis=-1))
     m = jnp.broadcast_to(mask.astype(bool), x.shape) if mask.ndim != x.ndim else (
         jnp.moveaxis(mask, axis, -1) > 0
     )
-    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
-    xs = jnp.sort(jnp.where(m, x, big), axis=-1)
+    if x.dtype != jnp.float32:
+        # non-f32: keep the sort-based definition (no u32 key truncation)
+        big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+        xs = jnp.sort(jnp.where(m, x, big), axis=-1)
+        cnt = jnp.sum(m, axis=-1)
+        n = x.shape[-1]
+        lo = jnp.clip((jnp.maximum(cnt, 1) - 1) // 2, 0, n - 1)
+        hi = jnp.clip(jnp.maximum(cnt, 1) // 2, 0, n - 1)
+        vlo = jnp.take_along_axis(xs, lo[..., None], axis=-1)[..., 0]
+        vhi = jnp.take_along_axis(xs, hi[..., None], axis=-1)[..., 0]
+        return jnp.where(cnt > 0, 0.5 * (vlo + vhi), 0.0)
+    u = jnp.where(m, _f32_sortable_u32(x), jnp.uint32(0xFFFFFFFF))
     cnt = jnp.sum(m, axis=-1)
-    lo = jnp.clip((jnp.maximum(cnt, 1) - 1) // 2, 0, n - 1)
-    hi = jnp.clip(jnp.maximum(cnt, 1) // 2, 0, n - 1)
-    vlo = jnp.take_along_axis(xs, lo[..., None], axis=-1)[..., 0]
-    vhi = jnp.take_along_axis(xs, hi[..., None], axis=-1)[..., 0]
-    med = 0.5 * (vlo + vhi)
+    k_lo = (jnp.maximum(cnt, 1) - 1) // 2
+    k_hi = jnp.maximum(cnt, 1) // 2
+    v_lo = _kth_smallest_u32(u, k_lo)
+    # upper median from two more fused passes: the smallest key above v_lo,
+    # used only when the k_hi-th order statistic really exceeds v_lo
+    # (duplicates can make them equal even for even counts)
+    c_le = jnp.sum((u <= v_lo[..., None]).astype(jnp.int32), axis=-1)
+    above = jnp.where(u > v_lo[..., None], u, jnp.uint32(0xFFFFFFFF))
+    v_next = jnp.min(above, axis=-1)
+    v_hi = jnp.where(c_le >= k_hi + 1, v_lo, v_next)
+    med = 0.5 * (_u32_sortable_f32(v_lo) + _u32_sortable_f32(v_hi))
     return jnp.where(cnt > 0, med, 0.0)
 
 
