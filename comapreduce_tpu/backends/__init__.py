@@ -1,0 +1,20 @@
+"""Host (numpy, f64) backend for the core chain.
+
+The BASELINE north star keeps the legacy registry's per-stage ``backend``
+switch (``Tools/Parser.py:26-41``); this package provides the ``numpy``
+side: double-precision host implementations of the vane calibration, the
+Level-1 -> Level-2 reduction, and the destriper. They serve three roles —
+tiny jobs without an accelerator, the f64 parity oracles SURVEY §7 calls
+for (exercised by ``tests/test_numpy_backend.py``), and reference-free
+documentation of each kernel's math.
+
+Importing this package registers the numpy stages.
+"""
+
+from comapreduce_tpu.backends import stages_numpy  # noqa: F401
+from comapreduce_tpu.backends.numpy_ops import (destripe_np,
+                                                measure_system_temperature_np,
+                                                reduce_feed_scans_np)
+
+__all__ = ["destripe_np", "measure_system_temperature_np",
+           "reduce_feed_scans_np"]

@@ -1,0 +1,331 @@
+"""Double-precision numpy implementations of the core-chain kernels.
+
+Independent f64 mirrors of the jitted ops (``ops/vane.py``,
+``ops/reduce.py``, ``mapmaking/destriper.py``) with the same observable
+semantics: masked statistics instead of NaNs, edge-replicated scan padding,
+symmetric median-filter boundaries, closed-form gain solve, CG with the
+singular-system breakdown guard. Used as the ``numpy`` pipeline backend and
+as the parity oracles (SURVEY §7 hard part 5: f64-on-host oracles against
+the f32 device path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.ops.reduce import ReduceConfig, scan_starts_lengths
+from comapreduce_tpu.ops.vane import (GRADIENT_LIMIT, SIGMA_FACTOR,
+                                      VANE_COLD_TEMP, find_vane_events)
+
+__all__ = ["measure_system_temperature_np", "reduce_feed_scans_np",
+           "destripe_np", "rolling_median_np"]
+
+
+# -- shared helpers ---------------------------------------------------------
+
+def _masked_median(x, m, axis=-1):
+    """Mean of the lower and upper median over ``axis`` counting only
+    ``m > 0`` samples (same definition as ``ops.stats.masked_median``)."""
+    x = np.moveaxis(np.asarray(x, np.float64), axis, -1)
+    m = np.moveaxis(np.asarray(m), axis, -1) > 0
+    big = np.finfo(np.float64).max
+    xs = np.sort(np.where(m, x, big), axis=-1)
+    cnt = m.sum(axis=-1)
+    n = x.shape[-1]
+    lo = np.clip((np.maximum(cnt, 1) - 1) // 2, 0, n - 1)
+    hi = np.clip(np.maximum(cnt, 1) // 2, 0, n - 1)
+    vlo = np.take_along_axis(xs, lo[..., None], axis=-1)[..., 0]
+    vhi = np.take_along_axis(xs, hi[..., None], axis=-1)[..., 0]
+    return np.where(cnt > 0, 0.5 * (vlo + vhi), 0.0)
+
+
+def _masked_mean(x, m, axis=-1):
+    m = np.asarray(m, np.float64)
+    return (x * m).sum(axis=axis) / np.maximum(m.sum(axis=axis), 1.0)
+
+
+def _auto_rms(x, axis=-1):
+    """Adjacent-pair rms (``Tools/stats.py:58-71`` capability)."""
+    x = np.moveaxis(x, axis, -1)
+    n2 = x.shape[-1] // 2 * 2
+    d = x[..., 1:n2:2] - x[..., 0:n2:2]
+    return d.std(axis=-1) / np.sqrt(2.0)
+
+
+def rolling_median_np(x: np.ndarray, window: int, pad_mode="symmetric",
+                      chunk: int = 2048) -> np.ndarray:
+    """Exact centered rolling median along the last axis.
+
+    Same alignment as ``ops.median_filter.rolling_median``: output[i] is
+    the median of ``x[i-(w-1)//2 : i+w//2+1]`` with boundary handling by
+    ``pad_mode``. Chunked ``sliding_window_view`` + ``np.median`` so peak
+    memory stays ~``chunk * window`` f64.
+    """
+    if window <= 1:
+        return np.asarray(x, np.float64).copy()
+    x = np.asarray(x, np.float64)
+    T = x.shape[-1]
+    left = (window - 1) // 2
+    right = window - 1 - left
+    pad = [(0, 0)] * (x.ndim - 1) + [(left, right)]
+    padded = np.pad(x, pad, mode=pad_mode)
+    out = np.empty_like(x)
+    from numpy.lib.stride_tricks import sliding_window_view
+
+    win = sliding_window_view(padded, window, axis=-1)  # (..., T, window)
+    for s in range(0, T, chunk):
+        e = min(s + chunk, T)
+        out[..., s:e] = np.median(win[..., s:e, :], axis=-1)
+    return out
+
+
+# -- vane calibration -------------------------------------------------------
+
+def _hot_cold_masks_np(band_avg: np.ndarray):
+    """f64 mirror of ``ops.vane.hot_cold_masks`` over (..., t)."""
+    x = np.asarray(band_avg, np.float64)
+    rms = _auto_rms(x)[..., None]
+    rng = np.maximum(x.max(-1) - x.min(-1), 1e-30)[..., None]
+    xn = x / rng
+    rms_n = rms / rng
+    mid = ((xn.max(-1) + xn.min(-1)) / 2.0)[..., None]
+    flat = np.abs(np.gradient(xn, axis=-1)) < GRADIENT_LIMIT
+    hot = ((xn - mid) > SIGMA_FACTOR * rms_n) & flat
+    cold = ((xn - mid) < SIGMA_FACTOR * rms_n) & flat
+    t = np.arange(x.shape[-1])
+    last_hot = np.max(np.where(hot, t, -1), axis=-1, keepdims=True)
+    cold = cold & (t > last_hot)
+    has_both = (hot.any(-1) & cold.any(-1))[..., None]
+    return hot & has_both, cold & has_both
+
+
+def measure_system_temperature_np(tod_reader, vane_flag, vane_temperature,
+                                  pad: int = 50):
+    """f64 mirror of ``ops.vane.measure_system_temperature``:
+    ``(tsys, gain)`` each (n_events, F, B, C), or (None, None)."""
+    events = find_vane_events(vane_flag)
+    n = len(vane_flag)
+    out_t, out_g = [], []
+    for start, end in events:
+        s, e = max(0, int(start) - pad), min(n, int(end) + pad)
+        tod = np.asarray(tod_reader(s, e), np.float64)  # (F, B, C, t)
+        hot, cold = _hot_cold_masks_np(tod.mean(axis=2))
+        p_hot = _masked_mean(tod, hot[..., None, :])
+        p_cold = _masked_mean(tod, cold[..., None, :])
+        gain = (p_hot - p_cold) / (vane_temperature - VANE_COLD_TEMP)
+        ok = (hot.sum(-1) > 0) & (cold.sum(-1) > 0)
+        ok = ok[..., None] & (gain > 0)
+        gain = np.where(ok, gain, 0.0)
+        tsys = np.where(ok, p_cold / np.where(ok, gain, 1.0), 0.0)
+        out_t.append(tsys)
+        out_g.append(gain)
+    if not out_t:
+        return None, None
+    return np.stack(out_t), np.stack(out_g)
+
+
+# -- Level-1 -> Level-2 reduction ------------------------------------------
+
+def reduce_feed_scans_np(tod, mask, airmass, edges, tsys, sys_gain,
+                         freq_scaled, cfg: ReduceConfig,
+                         pad_to: int = 128):
+    """f64 mirror of ``ops.reduce.reduce_feed_scans`` for one feed.
+
+    Same chain and masks: NaN fill with the stride-4 masked median,
+    centered airmass regression (or median removal for calibrators),
+    radiometer normalisation, EXACT rolling-median high-pass with affine
+    regression, closed-form gain solve, Tsys^2-weighted band average.
+    Returns the same dict of (B, T) arrays (f64).
+    """
+    tod = np.asarray(tod, np.float64)
+    mask = np.asarray(mask, np.float64)
+    airmass = np.asarray(airmass, np.float64)
+    tsys = np.asarray(tsys, np.float64)
+    sys_gain = np.asarray(sys_gain, np.float64)
+    B, C, T = tod.shape
+    starts, lengths, L = scan_starts_lengths(np.asarray(edges),
+                                             pad_to=pad_to)
+    out = {k: np.zeros((B, T)) for k in ("tod", "tod_original", "weights")}
+    m_med = np.asarray(cfg.mask_medfilt, np.float64)
+    m_tmpl = np.asarray(cfg.mask_templates, np.float64)
+    m_w = (np.asarray(cfg.mask_weights, np.float64)
+           * np.asarray(cfg.mask_band_avg, np.float64))
+    dgs, atms = [], []
+
+    for start, length in zip(starts, lengths):
+        start, length = int(start), int(length)
+        # edge-replicated padded block (extract_scan_blocks semantics)
+        idx = np.minimum(np.arange(L) + start, start + max(length, 1) - 1)
+        idx = np.clip(idx, 0, T - 1)
+        d = tod[..., idx]
+        tv = (np.arange(L) < length).astype(np.float64)
+        m = mask[..., idx] * tv
+        a = airmass[idx]
+
+        # NaN fill: stride-4 masked median, masked-mean fallback
+        med = _masked_median(d[..., ::4], m[..., ::4])
+        sub_cnt = m[..., ::4].sum(-1)
+        mean = _masked_mean(d, m)
+        fill = np.where(sub_cnt > 0, med, mean)[..., None]
+        d = np.where(m > 0, d, fill)
+
+        if cfg.is_calibrator:
+            med_c = _masked_median(d, m)[..., None]
+            clean = d - med_c
+            atm = np.concatenate([med_c[..., 0][:, None, :],
+                                  np.zeros((B, 1, C))], axis=1)
+        else:
+            cnt = m.sum(-1)
+            s1 = np.maximum(cnt, 1.0)
+            a_mean = (m * a).sum(-1) / s1
+            d_mean = (m * d).sum(-1) / s1
+            da = a - a_mean[..., None]
+            dd = d - d_mean[..., None]
+            saa = (m * da * da).sum(-1)
+            sad = (m * da * dd).sum(-1)
+            ok = (cnt >= 2.0) & (saa > 1e-12)
+            slope = np.where(ok, sad / np.maximum(saa, 1e-12), 0.0)
+            off = d_mean - slope * a_mean
+            clean = d - (off[..., None] + slope[..., None] * a)
+            atm = np.stack([off, slope], axis=1)
+
+        # radiometer normalisation (stride-4 pair differences)
+        n4 = L // 4 * 4
+        diff = clean[..., 0:n4:4] - clean[..., 2:n4:4]
+        pm = m[..., 0:n4:4] * m[..., 2:n4:4]
+        dmean = _masked_mean(diff, pm)
+        var = _masked_mean((diff - dmean[..., None]) ** 2, pm)
+        norm = (np.sqrt(np.maximum(var, 0.0)) / np.sqrt(2.0)
+                * np.sqrt(cfg.bandwidth * cfg.tau))[..., None]
+        clean = np.where(norm > 0, clean / np.maximum(norm, 1e-30), 0.0)
+
+        # median-filter high-pass: band mean -> exact rolling median ->
+        # per-channel affine regression (time-masked)
+        cm = m_med[None, :, None]
+        nch = np.maximum(m_med.sum(), 1.0)
+        mean_tod = (clean * cm).sum(axis=1) / nch            # (B, L)
+        medf = rolling_median_np(mean_tod, int(cfg.medfilt_window))
+        n_t = np.maximum(tv.sum(), 1.0)
+        mf_mean = (medf * tv).sum(-1) / n_t
+        d_mean2 = (clean * tv).sum(-1) / n_t
+        dm = (medf - mf_mean[..., None]) * tv
+        smm = (dm * dm).sum(-1)
+        smd = np.einsum("bt,bct->bc", dm, clean)
+        safe = np.where(smm > 1e-20, smm, 1.0)
+        bcoef = np.where(smm[..., None] > 1e-20, smd / safe[..., None], 0.0)
+        acoef = d_mean2 - bcoef * mf_mean[..., None]
+        model = acoef[..., None] + bcoef[..., None] * medf[:, None, :]
+        filtered = (clean - model) * cm[..., 0][..., None]
+
+        # closed-form gain solve ((P^T Z P) g = P^T Z y, diagonal system)
+        ok_t = (tsys > 0) & (m_tmpl[None, :] > 0) & np.isfinite(tsys)
+        inv_t = np.where(ok_t, 1.0 / np.where(ok_t, tsys, 1.0), 0.0)
+        T2 = np.stack([inv_t.reshape(-1),
+                       (freq_scaled * inv_t).reshape(-1)], axis=-1)
+        p = ok_t.astype(np.float64).reshape(-1)
+        G = T2.T @ T2
+        det = G[0, 0] * G[1, 1] - G[0, 1] * G[1, 0]
+        G = G if abs(det) > 1e-30 else np.eye(2)
+        zp = p - T2 @ (np.linalg.inv(G) @ (T2.T @ p))
+        zpp = p @ zp
+        y = (filtered * m).reshape(B * C, L)
+        if cfg.is_calibrator:
+            dg = np.zeros(L)
+        else:
+            dg = (zp @ y) / max(zpp, 1e-20) * tv
+        sub = filtered - p.reshape(B, C)[..., None] * dg[None, None, :]
+
+        # back to kelvin, band average
+        w_tsys = np.where(tsys > 0, 1.0 / np.maximum(tsys, 1e-10) ** 2, 0.0)
+        w = w_tsys * m_w[None, :]
+        safe_gain = np.where(sys_gain > 0, sys_gain, 1.0)
+        residual = sub * norm / safe_gain[..., None]
+        den = np.maximum(w.sum(-1), 1e-30)[..., None]
+        tod_clean = np.einsum("bct,bc->bt", residual, w) / den
+        in_kelvin = filtered * norm / safe_gain[..., None]
+        tod_orig = np.einsum("bct,bc->bt", in_kelvin, w) / den
+
+        n2 = L // 2 * 2
+        dpair = tod_clean[..., 1:n2:2] - tod_clean[..., 0:n2:2]
+        pm2 = tv[1:n2:2] * tv[0:n2:2]
+        var2 = (dpair * dpair * pm2).sum(-1) / np.maximum(pm2.sum(), 1.0)
+        rms2 = var2 / 2.0
+        w_t = np.where(rms2 > 0, 1.0 / np.maximum(rms2, 1e-30), 0.0)
+
+        sl = slice(start, start + length)
+        keep = slice(0, length)
+        out["tod"][:, sl] = (tod_clean * tv)[:, keep]
+        out["tod_original"][:, sl] = (tod_orig * tv)[:, keep]
+        out["weights"][:, sl] = (np.broadcast_to(w_t[:, None], (B, L))
+                                 * tv)[:, keep]
+        dgs.append(dg)
+        atms.append(atm)
+    out["dg"] = np.stack(dgs) if dgs else np.zeros((0, L))
+    out["atmos_fits"] = np.stack(atms) if atms else np.zeros((0, B, 2, C))
+    return out
+
+
+# -- destriper --------------------------------------------------------------
+
+def destripe_np(tod, pixels, weights, npix: int, offset_length: int = 50,
+                n_iter: int = 100, threshold: float = 1e-6):
+    """f64 mirror of ``mapmaking.destriper.destripe`` (no ground template).
+
+    Same normal equations and CG (with the singular-system breakdown
+    guard); binning via ``np.bincount``. Returns a dict with ``offsets``,
+    ``destriped_map``, ``naive_map``, ``weight_map``, ``hit_map``,
+    ``n_iter``, ``residual``.
+    """
+    tod = np.asarray(tod, np.float64)
+    w = np.asarray(weights, np.float64)
+    pix = np.asarray(pixels, np.int64)
+    n = tod.size
+    n_off = n // offset_length
+    pix = np.where((pix < 0) | (pix >= npix), npix, pix)
+    valid = pix < npix
+
+    def bins(v):
+        return np.bincount(pix, weights=v, minlength=npix + 1)[:npix]
+
+    sum_w = bins(w)
+
+    def zmap(d):
+        m = np.where(sum_w > 0, bins(w * d) / np.maximum(sum_w, 1e-30), 0.0)
+        return w * (d - np.where(valid, m[np.minimum(pix, npix - 1)], 0.0))
+
+    def reduce_off(v):
+        return v.reshape(n_off, offset_length).sum(axis=1)
+
+    def matvec(a):
+        return reduce_off(zmap(np.repeat(a, offset_length)))
+
+    b = reduce_off(zmap(tod))
+    b_norm = float(b @ b)
+    x = np.zeros(n_off)
+    r = b.copy()
+    p = b.copy()
+    rz = b_norm
+    k = 0
+    while k < n_iter and rz > threshold**2 * max(b_norm, 1e-30):
+        q = matvec(p)
+        pq = float(p @ q)
+        if not np.isfinite(pq) or pq <= 0:
+            break
+        alpha = rz / pq
+        x = x + alpha * p
+        r = r - alpha * q
+        rz_new = float(r @ r)
+        if not np.isfinite(rz_new):
+            break
+        p = r + (rz_new / max(rz, 1e-30)) * p
+        rz = rz_new
+        k += 1
+
+    template = np.repeat(x, offset_length)
+    naive = np.where(sum_w > 0, bins(w * tod) / np.maximum(sum_w, 1e-30), 0)
+    destriped = np.where(sum_w > 0, bins(w * (tod - template))
+                         / np.maximum(sum_w, 1e-30), 0.0)
+    hits = bins(np.ones_like(w))
+    return {"offsets": x, "destriped_map": destriped, "naive_map": naive,
+            "weight_map": sum_w, "hit_map": hits, "n_iter": k,
+            "residual": float(np.sqrt(rz / max(b_norm, 1e-30)))}

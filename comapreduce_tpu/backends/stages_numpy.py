@@ -1,0 +1,116 @@
+"""NumPy-backend pipeline stages (``backend = "numpy"`` in the config).
+
+Same stage names and Level-2 outputs as the device stages
+(``pipeline/stages.py``); the math runs through the f64 host kernels in
+:mod:`comapreduce_tpu.backends.numpy_ops`. Capability parity target: the
+legacy registry's per-stage backend switch (``Tools/Parser.py:26-41``,
+BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import numpy as np
+
+from comapreduce_tpu.backends import numpy_ops
+from comapreduce_tpu.ops.reduce import ReduceConfig, scan_starts_lengths
+from comapreduce_tpu.pipeline.registry import register
+from comapreduce_tpu.pipeline.stages import _StageBase, mean_vane_tsys_gain
+
+__all__ = ["MeasureSystemTemperatureNumpy",
+           "Level1AveragingGainCorrectionNumpy"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+@register("MeasureSystemTemperature", backend="numpy")
+@dataclass
+class MeasureSystemTemperatureNumpy(_StageBase):
+    """Vane calibration on host in f64 (oracle for the device stage)."""
+
+    groups: tuple = ("vane",)
+    pad: int = 50
+
+    def __call__(self, data, level2) -> bool:
+        tod = data["spectrometer/tod"]
+
+        def reader(s, e):
+            return tod[..., s:e]
+
+        tsys, gain = numpy_ops.measure_system_temperature_np(
+            reader, data.vane_flag, data.vane_temperature, pad=self.pad)
+        if tsys is None:
+            logger.warning("MeasureSystemTemperature[numpy]: obs %s has no "
+                           "vane events", data.obsid)
+            self.STATE = False
+            return False
+        self._data = {
+            "vane/system_temperature": np.asarray(tsys, np.float32),
+            "vane/system_gain": np.asarray(gain, np.float32),
+        }
+        self.STATE = True
+        return True
+
+
+@register("Level1AveragingGainCorrection", backend="numpy")
+@dataclass
+class Level1AveragingGainCorrectionNumpy(_StageBase):
+    """Level-1 -> Level-2 reduction on host in f64 (oracle / tiny jobs).
+
+    Exact rolling median at any window (no two-level approximation), f64
+    throughout; otherwise the same chain and outputs as the device stage.
+    """
+
+    groups: tuple = ("averaged_tod",)
+    medfilt_window: int = 6000
+    pad_to: int = 128
+
+    def __call__(self, data, level2) -> bool:
+        edges = np.asarray(data.scan_edges)
+        if len(edges) == 0:
+            logger.warning("Level1AveragingGainCorrection[numpy]: obs %s "
+                           "has no scans", data.obsid)
+            self.STATE = False
+            return False
+        try:
+            tsys, sys_gain = mean_vane_tsys_gain(level2)
+        except KeyError:
+            logger.warning("Level1AveragingGainCorrection[numpy]: obs %s "
+                           "has no vane calibration", data.obsid)
+            self.STATE = False
+            return False
+
+        F, B, C, T = data.tod_shape
+        _, _, L = scan_starts_lengths(edges, pad_to=self.pad_to)
+        # clamp to the padded scan length like the device stage, so both
+        # backends run the same filter on short scans
+        cfg = ReduceConfig(C, medfilt_window=min(self.medfilt_window, L),
+                           is_calibrator=data.is_calibrator)
+        freq = np.asarray(data.frequency, np.float64)
+        f0 = freq.mean(axis=1, keepdims=True)
+        freq_scaled = (freq - f0) / f0
+        airmass_all = np.asarray(data.airmass, np.float64)
+
+        tod_out = np.zeros((F, B, T), np.float32)
+        orig_out = np.zeros((F, B, T), np.float32)
+        wei_out = np.zeros((F, B, T), np.float32)
+        for ifeed in range(F):
+            raw = np.asarray(data.read_tod_feed(ifeed), np.float64)
+            mask = np.isfinite(raw).astype(np.float64)
+            res = numpy_ops.reduce_feed_scans_np(
+                np.nan_to_num(raw), mask, airmass_all[ifeed], edges,
+                tsys[ifeed], sys_gain[ifeed], freq_scaled, cfg,
+                pad_to=self.pad_to)
+            tod_out[ifeed] = res["tod"]
+            orig_out[ifeed] = res["tod_original"]
+            wei_out[ifeed] = res["weights"]
+        self._data = {
+            "averaged_tod/tod": tod_out,
+            "averaged_tod/tod_original": orig_out,
+            "averaged_tod/weights": wei_out,
+            "averaged_tod/scan_edges": edges,
+        }
+        self.STATE = True
+        return True

@@ -15,8 +15,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["psd", "log_bin_psd", "fit_noise_model", "knee_model",
-           "red_noise_model"]
+__all__ = ["psd", "log_bin_psd", "psd_peak_mask", "fit_noise_model",
+           "fit_observation_noise", "knee_model", "red_noise_model"]
 
 
 def psd(tod: jax.Array, sample_rate: float = 50.0):
@@ -32,13 +32,16 @@ def psd(tod: jax.Array, sample_rate: float = 50.0):
 
 
 @functools.partial(jax.jit, static_argnames=("nbins",))
-def log_bin_psd(freqs: jax.Array, ps: jax.Array, nbins: int = 15):
+def log_bin_psd(freqs: jax.Array, ps: jax.Array, nbins: int = 15,
+                sample_mask: jax.Array | None = None):
     """Average the PSD in log-spaced frequency bins.
 
     Parity: ``bin_power_spectrum`` (``Level1Averaging.py:534-550``). Empty
     bins return 0 with ``counts`` 0 (the reference returns NaN and drops
     them; masks compose better on device). Batched over leading axes of
-    ``ps``.
+    ``ps``. ``sample_mask`` (same shape as ``ps``, 1 = keep) excludes
+    per-row frequency samples — the spike-masking path
+    (``Level2Data.py:288-298``); ``counts`` then gains the batch axes.
     """
     fmin = freqs[1]
     fmax = freqs[-1]
@@ -50,19 +53,55 @@ def log_bin_psd(freqs: jax.Array, ps: jax.Array, nbins: int = 15):
     # drop DC (freq < fmin lands in bin 0 too; exclude exact DC sample)
     valid = (freqs >= fmin).astype(ps.dtype)
 
-    # counts and frequency sums are batch-independent: compute once
-    cnt = jax.ops.segment_sum(valid, ids, num_segments=nbins)
-    fsum = jax.ops.segment_sum(freqs * valid, ids, num_segments=nbins)
-
     def bin_one(row):
-        return jax.ops.segment_sum(row * valid, ids, num_segments=nbins)
+        return jax.ops.segment_sum(row, ids, num_segments=nbins)
 
+    fsum = bin_one(freqs * valid)
+    valid_cnt = bin_one(valid)
     flat = ps.reshape((-1, ps.shape[-1]))
-    tops = jax.vmap(bin_one)(flat)
-    safe = jnp.maximum(cnt, 1.0)
-    p_bin = (tops / safe).reshape(ps.shape[:-1] + (nbins,))
-    nu_bin = fsum / safe
+    if sample_mask is None:
+        cnt = valid_cnt
+        tops = jax.vmap(bin_one)(flat * valid)
+        p_bin = tops / jnp.maximum(cnt, 1.0)
+    else:
+        m_flat = sample_mask.astype(ps.dtype).reshape(flat.shape) * valid
+        cnt_rows = jax.vmap(bin_one)(m_flat)
+        tops = jax.vmap(bin_one)(flat * m_flat)
+        p_bin = tops / jnp.maximum(cnt_rows, 1.0)
+        cnt = cnt_rows.reshape(ps.shape[:-1] + (nbins,))
+    p_bin = p_bin.reshape(ps.shape[:-1] + (nbins,))
+    # bin-centre frequencies from the unmasked grid (masking a few spike
+    # samples must not shift the fit's frequency axis)
+    nu_bin = fsum / jnp.maximum(valid_cnt, 1.0)
     return nu_bin, p_bin, cnt
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("threshold", "min_freq", "halfwidth"))
+def psd_peak_mask(freqs: jax.Array, ps: jax.Array, auto_rms2: jax.Array,
+                  threshold: float = 100.0, min_freq: float = 0.5,
+                  halfwidth: int = 4):
+    """Mask (1 = keep) of PSD samples free of resonance spikes.
+
+    Parity: the iterative ``find_peaks``/``peak_widths`` masking ahead of
+    the Level-2 noise fits (``Level2Data.py:288-298``): peaks above
+    ``threshold * auto_rms^2`` at ``freqs > min_freq`` are zapped. The
+    reference widens each peak to 85% of its height with
+    ``peak_widths``; here the candidate set is dilated by a fixed
+    ``halfwidth`` bins (max-pool), the jittable formulation — resonance
+    spikes in COMAP data are a few bins wide.
+
+    ``ps``: f32[..., n]; ``auto_rms2``: f32[...] white-noise variance per
+    row (the reference's ``auto_rms**2``).
+    """
+    cand = ((ps > threshold * auto_rms2[..., None])
+            & (freqs > min_freq)).astype(ps.dtype)
+    if halfwidth > 0:
+        w = 2 * halfwidth + 1
+        window = (1,) * (ps.ndim - 1) + (w,)
+        cand = jax.lax.reduce_window(
+            cand, -jnp.inf, jax.lax.max, window, (1,) * ps.ndim, "SAME")
+    return 1.0 - jnp.clip(cand, 0.0, 1.0)
 
 
 def knee_model(params, nu):
@@ -89,11 +128,16 @@ def fit_noise_model(nu_bin: jax.Array, p_bin: jax.Array, counts: jax.Array,
     """
     good = (counts > 0) & (p_bin > 0) & (nu_bin > 0)
     logp = jnp.where(good, jnp.log(jnp.maximum(p_bin, 1e-30)), 0.0)
+    # a bin averaging k exponentially-distributed PSD samples has
+    # var(log) ~ 1/k: weight by sqrt(k) so single-sample low-frequency
+    # bins cannot destabilise the fit (the reference fits unweighted,
+    # PowerSpectra.py:137-159, and inherits that instability)
+    wgt = jnp.sqrt(jnp.maximum(counts, 0.0)) * good
 
     def loss(q):
         params = (jnp.exp(q[0]), jnp.exp(q[1]), q[2])
         m = model(params, jnp.maximum(nu_bin, 1e-6))
-        r = (logp - jnp.log(jnp.maximum(m, 1e-30))) * good
+        r = (logp - jnp.log(jnp.maximum(m, 1e-30))) * wgt
         return jnp.sum(r * r)
 
     q0 = jnp.array([jnp.log(jnp.maximum(p0[0], 1e-20)),
@@ -134,3 +178,57 @@ def minimize_lm(loss, q0: jax.Array, n_iter: int = 60,
     q, _, _ = jax.lax.fori_loop(
         0, n_iter, step, (q0, jnp.asarray(lam0, q0.dtype), loss(q0)))
     return q
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("sample_rate", "nbins", "model_name",
+                                    "mask_peaks"))
+def fit_observation_noise(blocks: jax.Array, sample_rate: float = 50.0,
+                          nbins: int = 30, model_name: str = "red_noise",
+                          mask_peaks: bool = True):
+    """Whole-observation noise fits: PSD -> peak mask -> log bin -> p0 ->
+    LM, one jit.
+
+    ``blocks``: f32[..., Lmin] per-(feed, band, scan) TOD blocks. With
+    ``mask_peaks`` (default, reference behavior ``Level2Data.py:288-298``)
+    resonance spikes above 100x the white level are excluded from the
+    binned PSD before fitting, so they cannot bias the fnoise parameters.
+    The initial guess mirrors the host heuristic the pipeline stage used
+    to assemble in numpy (white level from the top half of the binned PSD;
+    the second parameter from the lowest usable bin's excess power) but
+    runs on device so the stage stays host-loop-free. Returns f32[..., 3].
+    """
+    model = red_noise_model if model_name == "red_noise" else knee_model
+    freqs, ps = psd(blocks, sample_rate)
+    if mask_peaks:
+        d = blocks[..., 1:] - blocks[..., :-1]
+        auto_rms2 = jnp.var(d, axis=-1) / 2.0
+        smask = psd_peak_mask(freqs, ps, auto_rms2)
+        nu, pb, cnt = log_bin_psd(freqs, ps, nbins=nbins,
+                                  sample_mask=smask)
+        cnt = cnt.reshape(-1, nbins)
+    else:
+        nu, pb, cnt = log_bin_psd(freqs, ps, nbins=nbins)
+    pb_flat = pb.reshape(-1, nbins)
+    good_hi = (nu > 0.5 * nu.max()).astype(pb.dtype)
+    n_hi = jnp.maximum(good_hi.sum(), 1.0)
+    sig2 = jnp.maximum((pb_flat * good_hi).sum(-1) / n_hi, 1e-20)
+    p_low = jnp.maximum(pb_flat[:, 1], sig2 * 1.01)
+    nu_low = jnp.maximum(nu[1], 1e-3)
+    alpha0 = -1.5
+    if model_name == "red_noise":
+        # second parameter: red-noise power amplitude sigma_r^2
+        p1 = jnp.maximum((p_low - sig2) * nu_low ** (-alpha0), sig2 * 1e-3)
+    else:
+        # knee model: fknee where the 1/f power equals the white level
+        excess = jnp.maximum(p_low / sig2 - 1.0, 1e-3)
+        p1 = jnp.clip(nu_low * excess ** (-1.0 / alpha0),
+                      nu_low, 0.5 * sample_rate)
+    p0 = jnp.stack([sig2, p1, jnp.full_like(sig2, alpha0)], axis=-1)
+    if mask_peaks:
+        fit = jax.vmap(lambda pbr, cntr, p0r: fit_noise_model(
+            nu, pbr, cntr, p0r, model=model))(pb_flat, cnt, p0)
+    else:
+        fit = jax.vmap(lambda pbr, p0r: fit_noise_model(
+            nu, pbr, cnt, p0r, model=model))(pb_flat, p0)
+    return fit.reshape(blocks.shape[:-1] + (3,))

@@ -32,6 +32,16 @@ except ImportError:  # pragma: no cover
 __all__ = ["reduce_feeds_sharded", "destripe_sharded", "pad_for_shards"]
 
 
+@functools.lru_cache(maxsize=32)
+def _reduce_feeds_fn(cfg: ReduceConfig, n_scans: int, L: int):
+    """Cached jitted vmap-over-feeds reduction (one compile per geometry,
+    not one per call — a filelist run calls this once per batch)."""
+    fn = jax.vmap(
+        functools.partial(reduce_feed_scans, cfg=cfg, n_scans=n_scans, L=L),
+        in_axes=(0, 0, 0, None, None, 0, 0, None))
+    return jax.jit(fn)
+
+
 def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
                          tsys, sys_gain, freq_scaled, cfg: ReduceConfig):
     """Run :func:`reduce_feed_scans` for every feed, feeds sharded over the
@@ -62,12 +72,10 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     lengths = jax.device_put(jnp.asarray(lengths), repl)
     freq_scaled = jax.device_put(freq_scaled, repl)
 
-    fn = jax.vmap(
-        functools.partial(reduce_feed_scans, cfg=cfg, n_scans=n_scans, L=L),
-        in_axes=(0, 0, 0, None, None, 0, 0, None))
+    fn = _reduce_feeds_fn(cfg, n_scans, L)
     with mesh:
-        return jax.jit(fn)(tod, mask, airmass, starts, lengths, tsys,
-                           sys_gain, freq_scaled)
+        return fn(tod, mask, airmass, starts, lengths, tsys,
+                  sys_gain, freq_scaled)
 
 
 def pad_for_shards(tod, pixels, weights, n_shards: int, offset_length: int,

@@ -23,6 +23,8 @@ from comapreduce_tpu.pipeline import stages  # noqa: F401  (registers stages)
 # calibration stages register themselves on package import
 from comapreduce_tpu.calibration import apply_cal as _apply_cal  # noqa: F401
 from comapreduce_tpu.calibration import source_fit as _source_fit  # noqa: F401
+# numpy-backend stages register themselves on package import
+from comapreduce_tpu import backends as _backends  # noqa: F401
 
 __all__ = ["IniConfig", "load_toml", "parse_stage_name", "register",
            "resolve", "available_stages", "Runner", "set_logging", "stages"]

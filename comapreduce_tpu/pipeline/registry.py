@@ -6,8 +6,9 @@ for the TOML path (``run_average.py:44-46``) and the dynamic
 (``Tools/Parser.py:26-41``). Here both feed one explicit registry, and a
 stage may register distinct implementations per *backend* (``tpu`` — the
 JAX device path — and ``numpy`` — the host oracle used for parity tests
-and tiny jobs). ``resolve(name, backend=...)`` falls back to the other
-backend when a stage has only one implementation.
+and tiny jobs; host-only stages register as ``"any"``).
+``resolve(name, backend=...)`` raises when a stage has no implementation
+for the requested backend — no silent fallback.
 """
 
 from __future__ import annotations
@@ -25,7 +26,11 @@ _REGISTRY: dict[str, dict[str, type]] = {}
 
 
 def register(name: str | None = None, backend: str = DEFAULT_BACKEND):
-    """Class decorator: ``@register()`` or ``@register("Name", "numpy")``."""
+    """Class decorator: ``@register()`` or ``@register("Name", "numpy")``.
+
+    ``backend="any"`` marks a host-only stage (pure file/metadata work,
+    e.g. ``CheckLevel1File``) that is valid under every backend.
+    """
 
     def wrap(cls):
         key = name or cls.__name__
@@ -41,7 +46,10 @@ def resolve(name: str, backend: str | None = None, **kwargs):
     ``backend`` may come from the call, from a ``backend`` key in
     ``kwargs`` (per-stage config section), or default to ``tpu``. The
     ``variant`` suffix is passed through as the stage's ``variant`` kwarg
-    when its class accepts one (legacy multi-config support).
+    when its class accepts one (legacy multi-config support). A stage with
+    no implementation registered for the requested backend raises — a
+    silent fallback would run f32 device code where the config demanded
+    the f64 host oracle (or vice versa).
     """
     _, cls_name, variant = parse_stage_name(name)
     impls = _REGISTRY.get(cls_name)
@@ -53,7 +61,11 @@ def resolve(name: str, backend: str | None = None, **kwargs):
     if backend not in KNOWN_BACKENDS:
         raise ValueError(f"unknown backend {backend!r} for stage {name!r} "
                          f"(known: {KNOWN_BACKENDS})")
-    cls = impls.get(backend) or next(iter(impls.values()))
+    cls = impls.get(backend) or impls.get("any")
+    if cls is None:
+        raise KeyError(
+            f"stage {name!r} has no {backend!r} backend "
+            f"(registered: {sorted(impls)})")
     if variant is not None:
         try:
             return cls(variant=variant, **kwargs)

@@ -34,17 +34,17 @@ import numpy as np
 from comapreduce_tpu.ops import power as power_ops
 from comapreduce_tpu.ops import vane as vane_ops
 from comapreduce_tpu.ops.atmosphere import fit_atmosphere_segments
-from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
-                                        scan_starts_lengths)
+from comapreduce_tpu.ops.reduce import ReduceConfig, scan_starts_lengths
 from comapreduce_tpu.ops.spikes import spike_mask
 from comapreduce_tpu.ops.stats import auto_rms
 from comapreduce_tpu.data.scan_edges import segment_ids_from_edges
 from comapreduce_tpu.pipeline.registry import register
 
-__all__ = ["CheckLevel1File", "AssignLevel1Data", "MeasureSystemTemperature",
-           "SkyDip", "AtmosphereRemoval", "Level1AveragingGainCorrection",
-           "Spikes", "Level2FitPowerSpectrum", "NoiseStatistics",
-           "WriteLevel2Data", "mean_vane_tsys_gain"]
+__all__ = ["CheckLevel1File", "AssignLevel1Data", "UseLevel2Pointing",
+           "MeasureSystemTemperature", "SkyDip", "AtmosphereRemoval",
+           "Level1AveragingGainCorrection", "Spikes",
+           "Level2FitPowerSpectrum", "NoiseStatistics", "WriteLevel2Data",
+           "mean_vane_tsys_gain"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -80,7 +80,7 @@ class _StageBase:
         return self.name
 
 
-@register()
+@register(backend="any")
 @dataclass
 class CheckLevel1File(_StageBase):
     """Gate: reject too-short files and operator-flagged observations.
@@ -114,7 +114,7 @@ class CheckLevel1File(_StageBase):
         return self.STATE
 
 
-@register()
+@register(backend="any")
 @dataclass
 class AssignLevel1Data(_StageBase):
     """Copy pointing and metadata from Level-1 into the Level-2 store
@@ -139,6 +139,43 @@ class AssignLevel1Data(_StageBase):
             "comment": data.comment,
         }}
         self.STATE = True
+        return True
+
+
+@register(backend="any")
+@dataclass
+class UseLevel2Pointing(_StageBase):
+    """Re-read pointing from an existing Level-2 file into both the Level-1
+    view and the Level-2 store (parity: ``UseLevel2Pointing``,
+    ``Level2Data.py:71-110`` — used when pointing was re-solved offline and
+    written back to Level-2). Acts only when ``overwrite`` is set AND the
+    Level-2 file already exists (reference behavior); otherwise a no-op."""
+
+    overwrite: bool = False
+
+    def __call__(self, data, level2) -> bool:
+        self.STATE = True
+        if not self.overwrite:
+            return True
+        if not os.path.exists(level2.filename):
+            return True
+        import h5py
+
+        with h5py.File(level2.filename, "r") as h:
+            base = "spectrometer/pixel_pointing"
+            if f"{base}/pixel_ra" not in h:
+                logger.warning("UseLevel2Pointing: %s has no pointing",
+                               level2.filename)
+                return True
+            ra = h[f"{base}/pixel_ra"][...]
+            dec = h[f"{base}/pixel_dec"][...]
+            az = h[f"{base}/pixel_az"][...]
+            el = h[f"{base}/pixel_el"][...]
+        for store in (data, level2):
+            store.ra = ra
+            store.dec = dec
+            store.az = az
+            store.el = el
         return True
 
 
@@ -267,13 +304,17 @@ class AtmosphereRemoval(_StageBase):
 class Level1AveragingGainCorrection(_StageBase):
     """The flagship reduction: Level-1 -> Level-2 averaged TOD.
 
-    Per feed (lazy HDF5 read), one jitted program
-    (:func:`~comapreduce_tpu.ops.reduce.reduce_feed_scans`): NaN fill,
-    atmosphere subtraction, radiometer normalisation, median-filter
-    high-pass, gain-fluctuation solve, Tsys-weighted band average.
-    Parity: ``Level1AveragingGainCorrection.average_tod``
-    (``Level1Averaging.py:792-872``). Writes ``averaged_tod/{tod,
-    tod_original, weights, scan_edges}``."""
+    Feeds are processed in device BATCHES through the fused multi-feed
+    program (:func:`~comapreduce_tpu.parallel.sharded.reduce_feeds_sharded`
+    — vmap over feeds, feed-sharded over every local device), with the
+    next batch's lazy HDF5 read prefetched on a worker thread while the
+    device reduces the current one (SURVEY hard part 4: overlap host
+    ingest with device compute). The chain per feed: NaN fill, atmosphere
+    subtraction, radiometer normalisation, median-filter high-pass,
+    gain-fluctuation solve, Tsys-weighted band average. Parity:
+    ``Level1AveragingGainCorrection.average_tod``
+    (``Level1Averaging.py:792-872``, which loops feeds serially on host).
+    Writes ``averaged_tod/{tod, tod_original, weights, scan_edges}``."""
 
     groups: tuple = ("averaged_tod",)
     medfilt_window: int = 6000
@@ -281,8 +322,17 @@ class Level1AveragingGainCorrection(_StageBase):
     # path, quantified in tests/test_medfilt_parity.py); 1 = exact filter
     medfilt_stride: int | None = None
     pad_to: int = 128
+    # feeds per device batch (0 = all feeds in one program); production
+    # observations (~45 min) need batching to bound HBM: ~2.2 GB per feed
+    feed_batch: int = 0
+    # scans streamed per chunk inside the reduction (None = all at once)
+    scan_batch: int | None = None
+    prefetch: bool = True
 
     def __call__(self, data, level2) -> bool:
+        from comapreduce_tpu.parallel.mesh import feed_time_mesh
+        from comapreduce_tpu.parallel.sharded import reduce_feeds_sharded
+
         edges = np.asarray(data.scan_edges)
         if len(edges) == 0:
             logger.warning("Level1AveragingGainCorrection: obs %s has no "
@@ -301,27 +351,61 @@ class Level1AveragingGainCorrection(_StageBase):
         starts, lengths, L = scan_starts_lengths(edges, pad_to=self.pad_to)
         cfg = ReduceConfig(C, medfilt_window=min(self.medfilt_window, L),
                            is_calibrator=data.is_calibrator,
-                           medfilt_stride=self.medfilt_stride)
+                           medfilt_stride=self.medfilt_stride,
+                           scan_batch=self.scan_batch)
         freq = data.frequency.astype(np.float32)  # (B, C) GHz
         f0 = freq.mean(axis=1, keepdims=True)
         freq_scaled = ((freq - f0) / f0).astype(np.float32)
+        airmass_all = np.asarray(data.airmass).astype(np.float32)  # (F, T)
+
+        # feed batches padded to a multiple of the local feed-mesh size so
+        # every batch shards evenly and compiles once
+        mesh = feed_time_mesh(jax.devices(), n_feed=len(jax.devices()))
+        n_dev = mesh.shape["feed"]
+        fb = self.feed_batch or F
+        fb = -(-min(fb, F) // n_dev) * n_dev
+        batches = [list(range(i, min(i + fb, F))) for i in range(0, F, fb)]
+
+        def load(idx):
+            """Read one feed batch from the lazy store (worker thread)."""
+            raws = [np.asarray(data.read_tod_feed(i), dtype=np.float32)
+                    for i in idx]
+            raws += [raws[0]] * (fb - len(idx))        # pad: results dropped
+            raw = np.stack(raws)
+            mask = np.isfinite(raw).astype(np.float32)
+            am = airmass_all[idx + [idx[0]] * (fb - len(idx))]
+            return np.nan_to_num(raw), mask, am
+
+        def pad_cal(x, idx):
+            sel = x[idx]
+            return np.concatenate([sel, np.repeat(sel[:1], fb - len(idx),
+                                                  axis=0)])
 
         tod_out = np.zeros((F, B, T), np.float32)
         orig_out = np.zeros((F, B, T), np.float32)
         wei_out = np.zeros((F, B, T), np.float32)
         starts_j = starts.astype(np.int32)
         lengths_j = lengths.astype(np.int32)
-        for ifeed in range(F):
-            raw = data.read_tod_feed(ifeed).astype(np.float32)
-            mask = np.isfinite(raw).astype(np.float32)
-            airmass = np.asarray(data.airmass)[ifeed].astype(np.float32)
-            res = reduce_feed_scans(
-                np.nan_to_num(raw), mask, airmass, starts_j, lengths_j,
-                tsys[ifeed], sys_gain[ifeed], freq_scaled,
-                cfg=cfg, n_scans=len(starts), L=L)
-            tod_out[ifeed] = np.asarray(res["tod"])
-            orig_out[ifeed] = np.asarray(res["tod_original"])
-            wei_out[ifeed] = np.asarray(res["weights"])
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(load, batches[0])
+            for bi, idx in enumerate(batches):
+                raw, mask, am = fut.result()
+                if self.prefetch and bi + 1 < len(batches):
+                    fut = ex.submit(load, batches[bi + 1])
+                res = reduce_feeds_sharded(
+                    mesh, raw, mask, am, starts_j, lengths_j,
+                    pad_cal(tsys, idx), pad_cal(sys_gain, idx),
+                    freq_scaled, cfg)
+                # device -> host copy blocks here while the worker thread
+                # reads the next batch from HDF5
+                tod_out[idx] = np.asarray(res["tod"])[:len(idx)]
+                orig_out[idx] = np.asarray(res["tod_original"])[:len(idx)]
+                wei_out[idx] = np.asarray(res["weights"])[:len(idx)]
+                if not self.prefetch and bi + 1 < len(batches):
+                    fut = ex.submit(load, batches[bi + 1])
         self._data = {
             "averaged_tod/tod": tod_out,
             "averaged_tod/tod_original": orig_out,
@@ -370,6 +454,9 @@ class Level2FitPowerSpectrum(_StageBase):
     sample_rate: float = 50.0
     model_name: str = "red_noise"
     out_group: str = "fnoise_fits"
+    # exclude resonance spikes >100x the white level from the binned PSD
+    # before fitting (Level2Data.py:288-298)
+    mask_peaks: bool = True
 
     def __call__(self, data, level2) -> bool:
         import jax.numpy as jnp
@@ -387,33 +474,10 @@ class Level2FitPowerSpectrum(_StageBase):
         S = len(edges)
         blocks = np.stack([tod[..., s:s + Lmin] for s, _ in edges],
                           axis=2)  # (F, B, S, Lmin)
-        model = (power_ops.red_noise_model if self.model_name == "red_noise"
-                 else power_ops.knee_model)
-        freqs, ps = power_ops.psd(jnp.asarray(blocks), self.sample_rate)
-        nu, pb, cnt = power_ops.log_bin_psd(freqs, ps, nbins=self.nbins)
-        pb_flat = np.asarray(pb).reshape(-1, self.nbins)
-        nu_np = np.asarray(nu)
-        good_hi = nu_np > 0.5 * nu_np.max()
-        sig2 = np.maximum(pb_flat[:, good_hi].mean(axis=1), 1e-20)
-        p_low = np.maximum(pb_flat[:, 1], sig2 * 1.01)
-        nu_low = max(nu_np[1], 1e-3)
-        alpha0 = -1.5
-        if self.model_name == "red_noise":
-            # second parameter is the red-noise power amplitude sigma_r^2
-            red2 = (p_low - sig2) * nu_low ** (-alpha0)
-            p1 = np.maximum(red2, sig2 * 1e-3)
-        else:
-            # knee model: second parameter is fknee [Hz] — the frequency
-            # where the 1/f power equals the white level:
-            # p_low/sig2 - 1 = (nu_low/fknee)^alpha0
-            excess = np.maximum(p_low / sig2 - 1.0, 1e-3)
-            p1 = np.clip(nu_low * excess ** (-1.0 / alpha0),
-                         nu_low, 0.5 * self.sample_rate)
-        p0 = np.stack([sig2, p1, np.full_like(sig2, alpha0)], axis=-1)
-
-        fit = jax.vmap(lambda pbr, p0r: power_ops.fit_noise_model(
-            nu, pbr, cnt, p0r, model=model))(jnp.asarray(pb_flat),
-                                             jnp.asarray(p0))
+        fit = power_ops.fit_observation_noise(
+            jnp.asarray(blocks), sample_rate=self.sample_rate,
+            nbins=self.nbins, model_name=self.model_name,
+            mask_peaks=self.mask_peaks)
         params = np.asarray(fit).reshape(F, B, S, 3)
         rms = np.asarray(auto_rms(jnp.asarray(blocks)))  # (F, B, S)
         self._data = {
@@ -435,7 +499,7 @@ class NoiseStatistics(Level2FitPowerSpectrum):
     out_group: str = "noise_statistics"
 
 
-@register()
+@register(backend="any")
 @dataclass
 class WriteLevel2Data(_StageBase):
     """Write the Level-2 store to its target file (parity:
