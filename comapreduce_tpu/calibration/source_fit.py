@@ -94,6 +94,7 @@ class FitSource(_StageBase):
     cdelt_deg: float = 1.0 / 60.0     # reference: 0.5' over 200 pix;
     beam_fwhm_deg: float = 0.075      # same 1.67 deg square field
     medfilt_window: int = 401
+    figure_dir: str = ""
 
     def pre_init(self, data) -> None:
         # groups depend on the observed source; the runner calls pre_init
@@ -132,6 +133,22 @@ class FitSource(_StageBase):
         params, errors, chi2 = fit_source_maps(maps, wmaps, wcs,
                                                self.beam_fwhm_deg)
         g = f"{src}_source_fit"
+        if self.figure_dir:
+            # postage stamp of the feed-0/band-0 source map with its fit
+            # (AstroCalibration.py:615-641)
+            from comapreduce_tpu import diagnostics
+
+            m2d = np.asarray(maps[0, 0]).reshape(self.ny, self.nx)
+            p = np.asarray(params[0, 0], np.float64).copy()
+            if p.size >= 5:  # world offsets (deg) -> pixel coordinates
+                p[1] = (p[1] / self.cdelt_deg) + self.nx / 2.0
+                p[3] = (p[3] / self.cdelt_deg) + self.ny / 2.0
+                p[2] = p[2] / self.cdelt_deg
+                p[4] = p[4] / self.cdelt_deg
+            diagnostics.plot_source_fit(
+                diagnostics.figure_path(self.figure_dir, data.obsid,
+                                        f"{g}_feed00_band00"),
+                m2d, p, source=src, feed=0, band=0)
         self._data = {f"{g}/fits": params, f"{g}/errors": errors,
                       f"{g}/chi2": chi2}
         self._attrs = {g: {"source": src, "ra0": float(ra0),

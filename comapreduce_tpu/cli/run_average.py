@@ -32,26 +32,39 @@ def _read_filelist(path: str) -> list[str]:
 
 
 def _rank_info():
-    try:
-        import jax
+    from comapreduce_tpu.parallel.multihost import rank_info
 
-        return jax.process_index(), jax.process_count()
-    except Exception:
-        return 0, 1
+    return rank_info()
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1:
+    figure_dir = ""
+    rest = []
+    for a in argv:
+        if a == "--figures":
+            figure_dir = "figures"
+        elif a.startswith("--figures="):
+            figure_dir = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    if len(rest) != 1:
         print("usage: python -m comapreduce_tpu.cli.run_average "
-              "configuration.toml", file=sys.stderr)
+              "[--figures[=DIR]] configuration.toml", file=sys.stderr)
         return 2
-    config = load_toml(argv[0])
+    config = load_toml(rest[0])
     glob = config.get("Global", {})
     rank, n_ranks = _rank_info()
     set_logging(base="run_average", log_dir=glob.get("log_dir", "."),
                 rank=rank, level=str(glob.get("log_level", "INFO")))
     runner = Runner.from_config(config, rank=rank, n_ranks=n_ranks)
+    figure_dir = figure_dir or str(glob.get("figure_dir", ""))
+    if figure_dir:
+        # per-obsid QA figures (reference: VaneCalibration.py:173-190,
+        # Level1Averaging.py:727-789, Level2Data.py:300-327)
+        for p in runner.processes:
+            if hasattr(p, "figure_dir"):
+                p.figure_dir = figure_dir
     filelist = _read_filelist(glob["filelist"])
     runner.run_tod(filelist)
     cal_list_path = glob.get("calibrator_filelist")

@@ -64,7 +64,9 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
 
         kw = dict(ground_ids=data.ground_ids, az=data.az,
                   n_groups=data.n_groups) if use_ground else {}
-        mesh = Mesh(np.array(jax.devices()), ("time",))
+        # LOCAL devices: multi-host destriping is data parallel over
+        # filelist shards (each process destripes its own files)
+        mesh = Mesh(np.array(jax.local_devices()), ("time",))
         result = destripe_sharded(mesh, data.tod, data.pixels, data.weights,
                                   data.npix, offset_length=offset_length,
                                   n_iter=n_iter, threshold=threshold, **kw)
@@ -102,12 +104,21 @@ def main(argv=None) -> int:
         print("usage: python -m comapreduce_tpu.cli.run_destriper "
               "parameters.ini", file=sys.stderr)
         return 2
+    from comapreduce_tpu.parallel.multihost import rank_info
+
     ini = IniConfig(argv[0])
     inputs = ini.get("Inputs", {})
     pixel = ini.get("Pixelization", {})
     with open(inputs["filelist"]) as f:
         filelist = [ln.strip() for ln in f
                     if ln.strip() and not ln.startswith("#")]
+    # multi-process launch: initialise the distributed runtime and take
+    # this process's round-robin filelist shard (same split as the
+    # Runner; the reference instead slices contiguous blocks,
+    # run_destriper.py:131-138); each process writes its own partial maps
+    rank, n_ranks = rank_info()
+    if n_ranks > 1:
+        filelist = filelist[rank::n_ranks]
     out_dir = inputs.get("output_dir", ".")
     os.makedirs(out_dir, exist_ok=True)
     prefix = inputs.get("prefix", "map")
@@ -138,7 +149,8 @@ def main(argv=None) -> int:
             use_ground=bool(inputs.get("ground", False)),
             use_calibration=bool(inputs.get("calibration", True)),
             sharded=bool(inputs.get("sharded", False)))
-        path = os.path.join(out_dir, f"{prefix}_band{band}.fits")
+        tag = f"_rank{rank}" if n_ranks > 1 else ""
+        path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         write_band_map(path, data, result)
         print(f"band {band}: {len(data.files)} files, "
               f"{data.tod.size} samples, {int(result.n_iter)} CG iters, "

@@ -18,14 +18,49 @@ Differences by design (not omissions):
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator
 
 import h5py
 import numpy as np
 
-__all__ = ["HDF5Store"]
+__all__ = ["HDF5Store", "safe_hdf5_open"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+def safe_hdf5_open(filename: str, mode: str = "r", retries: int = 10,
+                   delay: float = 1.0, backoff: float = 1.5) -> h5py.File:
+    """Open an HDF5 file, retrying while another writer holds the lock.
+
+    Parity: ``Tools/FileTools.py:40-52`` ``safe_hdf5_open`` — on shared
+    filesystems a Level-2 file may be mid-checkpoint by another rank; HDF5
+    then raises ``BlockingIOError``/``OSError`` ("unable to lock file").
+    Retries with exponential backoff, re-raising after ``retries``
+    attempts. Non-locking errors (missing file, not an HDF5 file) raise
+    immediately.
+    """
+    attempt = 0
+    while True:
+        try:
+            return h5py.File(filename, mode)
+        except (BlockingIOError, OSError) as err:
+            msg = str(err).lower()
+            locked = (isinstance(err, BlockingIOError)
+                      or "lock" in msg
+                      or "resource temporarily unavailable" in msg)
+            if not locked or not os.path.exists(filename):
+                raise
+            attempt += 1
+            if attempt > retries:
+                raise
+            logger.warning("safe_hdf5_open: %s locked, retry %d/%d in "
+                           "%.1f s", filename, attempt, retries, delay)
+            time.sleep(delay)
+            delay *= backoff
 
 
 @dataclass
@@ -118,7 +153,7 @@ class HDF5Store:
         self._data = {}
         self._attrs = {}
         self._mirrors = os.path.abspath(filename)
-        f = h5py.File(filename, "r")
+        f = safe_hdf5_open(filename, "r")
         self._file = f
         # root attributes
         for k, v in f.attrs.items():
@@ -200,7 +235,7 @@ class HDF5Store:
         self._write_into(filename, mode)
 
     def _write_into(self, filename: str, mode: str) -> None:
-        with h5py.File(filename, mode) as out:
+        with safe_hdf5_open(filename, mode) as out:
             for path, value in self._data.items():
                 if isinstance(value, h5py.Dataset):
                     continue

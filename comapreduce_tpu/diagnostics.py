@@ -1,0 +1,152 @@
+"""Per-obsid diagnostic figures (QA plots).
+
+The reference emits QA PNGs throughout the pipeline: vane hot/cold fits
+(``VaneCalibration.py:173-190``), gain-solution examples
+(``Level1Averaging.py:727-789``), power-spectrum fits
+(``Level2Data.py:300-327``), and source-fit postage stamps
+(``AstroCalibration.py:615-641``). These are host-side, matplotlib-based,
+and entirely optional: every entry point degrades to a warning when
+matplotlib is unavailable, and nothing here touches the device path.
+
+Stages call :func:`figure_path` with their ``figure_dir`` (set by the
+CLI's ``--figures`` flag or a ``figure_dir`` config key); an empty dir
+disables plotting.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+__all__ = ["figure_path", "plot_vane_event", "plot_gain_solution",
+           "plot_power_spectrum_fit", "plot_source_fit"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+def _pyplot():
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        from matplotlib import pyplot
+
+        return pyplot
+    except Exception:  # pragma: no cover - matplotlib missing
+        logger.warning("diagnostics: matplotlib unavailable, skipping plot")
+        return None
+
+
+def figure_path(figure_dir: str, obsid, name: str) -> str | None:
+    """``{figure_dir}/{obsid}/{name}.png`` (directories created), or None
+    when figures are disabled (reference pattern:
+    ``VaneCalibration.py:173-176``)."""
+    if not figure_dir:
+        return None
+    d = os.path.join(figure_dir, str(obsid))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"{name}.png")
+
+
+def plot_vane_event(path: str, band_avg, hot_mask, cold_mask, tsys,
+                    feed: int = 0):
+    """Vane event: band-average TOD with hot/cold samples marked, plus the
+    per-channel Tsys it produced (``VaneCalibration.py:173-190``)."""
+    plt = _pyplot()
+    if plt is None or path is None:
+        return
+    band_avg = np.asarray(band_avg)
+    hot = np.asarray(hot_mask) > 0
+    cold = np.asarray(cold_mask) > 0
+    tsys = np.asarray(tsys)
+    n_bands = band_avg.shape[0]
+    fig, axes = plt.subplots(2, 1, figsize=(10, 8))
+    t = np.arange(band_avg.shape[-1])
+    for ib in range(n_bands):
+        axes[0].plot(t, band_avg[ib], lw=0.7, label=f"band {ib}")
+        axes[0].plot(t[hot[ib]], band_avg[ib][hot[ib]], "r.", ms=2)
+        axes[0].plot(t[cold[ib]], band_avg[ib][cold[ib]], "b.", ms=2)
+    axes[0].set_xlabel("sample")
+    axes[0].set_ylabel("band-average counts")
+    axes[0].set_title(f"vane event, feed {feed} "
+                      "(red = hot, blue = cold)")
+    axes[0].legend(fontsize=8)
+    for ib in range(tsys.shape[0]):
+        axes[1].plot(np.where(tsys[ib] > 0, tsys[ib], np.nan), lw=0.7)
+    axes[1].set_xlabel("channel")
+    axes[1].set_ylabel("Tsys [K]")
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+
+
+def plot_gain_solution(path: str, avg_tod, dg, feed: int = 0,
+                       scan: int = 0):
+    """Scan gain solution against the band-averaged TOD
+    (``Level1Averaging.py:727-789``)."""
+    plt = _pyplot()
+    if plt is None or path is None:
+        return
+    fig, ax = plt.subplots(1, 1, figsize=(10, 5))
+    ax.plot(np.asarray(avg_tod), lw=0.5, label="band-averaged TOD")
+    ax.plot(np.asarray(dg), lw=0.8, label="gain solution dG")
+    ax.set_xlabel("sample")
+    ax.set_ylabel("normalised units")
+    ax.set_title(f"gain fluctuation, feed {feed} scan {scan}")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+
+
+def plot_power_spectrum_fit(path: str, nu, p_bin, params, model,
+                            feed: int = 0, band: int = 0, scan: int = 0):
+    """Binned PSD with the fitted noise model overlaid
+    (``Level2Data.py:300-327``)."""
+    plt = _pyplot()
+    if plt is None or path is None:
+        return
+    nu = np.asarray(nu)
+    pb = np.asarray(p_bin)
+    good = (nu > 0) & (pb > 0)
+    fig, ax = plt.subplots(1, 1, figsize=(8, 6))
+    ax.loglog(nu[good], pb[good], "o", ms=3, label="binned PSD")
+    m = np.asarray(model(np.asarray(params), nu[good]))
+    ax.loglog(nu[good], m, "-", label="fit")
+    ax.axhline(float(params[0]), color="k", ls="--", lw=0.7,
+               label="white level")
+    ax.set_xlabel("frequency [Hz]")
+    ax.set_ylabel("power")
+    ax.set_title(f"noise fit, feed {feed} band {band} scan {scan}")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+
+
+def plot_source_fit(path: str, map2d, fit_params, source: str = "",
+                    feed: int = 0, band: int = 0):
+    """Source postage stamp with the fitted Gaussian's centre/FWHM
+    (``AstroCalibration.py:615-641``). ``fit_params``: [amp, x0, sig_x,
+    y0, sig_y, ...] in pixel units as produced by the source fitter."""
+    plt = _pyplot()
+    if plt is None or path is None:
+        return
+    m = np.asarray(map2d)
+    fig, ax = plt.subplots(1, 1, figsize=(6, 6))
+    im = ax.imshow(m, origin="lower", cmap="viridis")
+    fig.colorbar(im, ax=ax, shrink=0.8)
+    p = np.asarray(fit_params, dtype=np.float64).ravel()
+    if p.size >= 5 and np.isfinite(p[:5]).all():
+        x0, sx, y0, sy = p[1], abs(p[2]), p[3], abs(p[4])
+        th = np.linspace(0, 2 * np.pi, 100)
+        k = 2.355 / 2.0  # FWHM/2 in sigma units
+        ax.plot(x0 + k * sx * np.cos(th), y0 + k * sy * np.sin(th),
+                "r-", lw=1.0)
+        ax.plot([x0], [y0], "r+")
+    ax.set_title(f"{source} feed {feed} band {band}")
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)

@@ -200,8 +200,10 @@ destripe_jit = jax.jit(
 
 
 def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
-                     n_iter: int = 100, threshold: float = 1e-6
-                     ) -> DestriperResult:
+                     n_iter: int = 100, threshold: float = 1e-6,
+                     axis_name: str | tuple | None = None,
+                     dense_maps: bool = True,
+                     device_arrays: dict | None = None) -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -210,13 +212,26 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     of per-sample scatter-adds (see ``pointing_plan`` module docstring) —
     measured >10x faster per CG iteration at production shape. Use when the
     pointing is fixed for the whole solve (always true per band); the
-    scatter-based :func:`destripe` remains the general/oracle path and the
-    one used under ``shard_map``.
+    scatter-based :func:`destripe` remains the general/oracle path.
 
     ``tod``/``weights``: f32[N] in natural sample order, N as the plan was
     built. Ground-template solves stay on the general path.
+
+    ``axis_name``: set when called inside ``shard_map`` with per-shard
+    plans from ``build_sharded_plans`` — compact map sums and CG scalars
+    are ``psum``-reduced across shards (the shared compact index space).
+    ``dense_maps=False`` returns COMPACT maps of shape (n_rank,) over
+    ``plan.uniq_pixels`` instead of materialising npix-sized vectors —
+    required at HEALPix nside 4096 where the dense map (~200M px) must
+    never exist on device (partial-map output, ``COMAPData.py:570-574``).
+    ``device_arrays`` overrides ``plan.device()`` — used by the shard_map
+    wrapper, which feeds each shard its own index arrays as traced inputs
+    (``plan`` then only supplies the shared static geometry).
     """
-    dv = plan.device()
+    dv = device_arrays if device_arrays is not None else plan.device()
+
+    def _psum(x):
+        return jax.lax.psum(x, axis_name) if axis_name is not None else x
     f32 = tod.dtype
     n_off, n_rank = plan.n_offsets, plan.n_rank
     P_pad = int(dv["pair_rank"].shape[0])
@@ -244,15 +259,40 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                                  dv["off_base"], plan.off_window,
                                  plan.pair_chunk, n_off)
 
+    # local -> global rank-space bridge (sharded plans): shard-local
+    # compact sums scatter into the global hit-pixel space (tiny static
+    # scatter), psum, and gather back for the pair-space reads
+    l2g = dv.get("rank_to_global")
+    if l2g is not None:
+        n_rank_out = plan.n_rank_global
+
+        def to_global(s):
+            g = jnp.zeros(n_rank_out, f32).at[l2g].add(s, mode="drop")
+            return _psum(g)
+
+        def from_global(mg):
+            # padding/sentinel local ranks read 0 — the scatter path's
+            # invalid-sample semantics
+            return jnp.where(l2g < n_rank_out,
+                             mg[jnp.clip(l2g, 0, n_rank_out - 1)], 0.0)
+    else:
+        n_rank_out = n_rank
+
+        def to_global(s):
+            return _psum(s)
+
+        def from_global(mg):
+            return mg
+
     # one-time aggregates
     pair_w = pair_sum(w_s)           # P^T-pair weights
     pair_wd = pair_sum(wd_s)
     pair_cnt = pair_sum(pad_mask)
-    sum_w = rank_sum(pair_w)         # compact weight map
-    diag = off_sum(pair_w)           # diagonal of F^T W F
+    sum_w = to_global(rank_sum(pair_w))  # compact weight map (global)
+    diag = off_sum(pair_w)           # diagonal of F^T W F (shard-local)
 
     def to_map(pv):
-        s = rank_sum(pv)
+        s = to_global(rank_sum(pv))
         return jnp.where(sum_w > 0, s / jnp.maximum(sum_w, 1e-30), 0.0)
 
     def gather_a(a):
@@ -268,26 +308,33 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
 
     def matvec(a):
         pav = pair_w * gather_a(a)
-        m = to_map(pav)
+        m = from_global(to_map(pav))
         return diag * a - off_sum(pair_w * gather_m(m))
 
     m_d = to_map(pair_wd)
-    b = off_sum(pair_wd) - off_sum(pair_w * gather_m(m_d))
-    a, rz, k, b_norm = _cg_loop(matvec, b, lambda u, v: jnp.sum(u * v),
-                                n_iter, threshold)
+    b = off_sum(pair_wd) - off_sum(pair_w * gather_m(from_global(m_d)))
+    a, rz, k, b_norm = _cg_loop(
+        matvec, b, lambda u, v: _psum(jnp.sum(u * v)), n_iter, threshold)
 
-    # final products, scattered once from compact ranks to the full map
+    # final products in the compact rank space; optionally scattered once
+    # to the full map (host-side partial-map writers take the compact form)
     pair_res = pair_wd - pair_w * gather_a(a)
     uniq = dv["uniq_pixels"]
 
     def expand(cmp):
+        if not dense_maps:
+            return cmp
+        if l2g is not None:
+            raise ValueError("dense_maps is not supported with sharded "
+                             "plans; write the compact maps over "
+                             "plan.uniq_global instead")
         return jnp.zeros(plan.npix, f32).at[uniq].set(
             cmp, mode="drop", unique_indices=True)
 
     m_destriped = expand(to_map(pair_res))
     m_naive = expand(m_d)
     w_map = expand(sum_w)
-    h_map = expand(rank_sum(pair_cnt))
+    h_map = expand(to_global(rank_sum(pair_cnt)))
     residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
     return DestriperResult(a, jnp.zeros((0, 2), f32), m_destriped, m_naive,
                            w_map, h_map, k, residual)

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PointingPlan", "build_pointing_plan", "binned_window_sum"]
+__all__ = ["PointingPlan", "build_pointing_plan", "build_sharded_plans",
+           "binned_window_sum"]
 
 
 def _round_up(x: int, q: int) -> int:
@@ -73,6 +74,13 @@ class PointingPlan:
     pair_perm_off: np.ndarray        # i32[P_pad]: x_off = x_rank[perm]
     off_window: int
     off_base: np.ndarray             # i32[n_p_chunks] offset base per chunk
+    # sharded-plan extras (build_sharded_plans): the shard's LOCAL rank
+    # space keeps binning windows dense; these map it into the global
+    # compact space for the cross-shard psum
+    rank_to_global: np.ndarray | None = None  # i32[n_rank] (global sentinel
+    #                                           n_rank_global on padding)
+    n_rank_global: int = 0
+    uniq_global: np.ndarray | None = None     # i64[n_rank_global]
     _device: dict = field(default_factory=dict, repr=False)
 
     def device(self) -> dict:
@@ -83,6 +91,9 @@ class PointingPlan:
                 for k in ("sample_perm", "sample_pair", "sample_base",
                           "pair_rank", "pair_offset", "rank_base",
                           "pair_perm_off", "off_base", "uniq_pixels")}
+            if self.rank_to_global is not None:
+                self._device["rank_to_global"] = jnp.asarray(
+                    self.rank_to_global, jnp.int32)
         return self._device
 
 
@@ -102,7 +113,10 @@ def _window_layout(ids_sorted: np.ndarray, chunk: int, align: int = 128):
 
 def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
                         sample_chunk: int = 8192,
-                        pair_chunk: int = 4096) -> PointingPlan:
+                        pair_chunk: int = 4096,
+                        uniq: np.ndarray | None = None,
+                        min_pair_pad: int = 0,
+                        min_windows: tuple = (0, 0, 0)) -> PointingPlan:
     """Build the static plan for one flat pointing vector.
 
     ``pixels``: integer pixel per sample (invalid = negative or >= npix);
@@ -113,6 +127,12 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     where an invalid sample reads 0 from the map but its weight still
     enters ``F^T W``) while their map-domain sums land in a padding slot
     that is sliced away.
+
+    ``uniq``: optional pre-computed sorted unique-pixel array defining a
+    SHARED compact rank space — pass the global union when building
+    per-shard plans so every shard bins into the same compact map and the
+    cross-shard reduction is one ``psum`` (the reference's allgather'd
+    seen-pixel compaction, ``COMAPData.py:43-70,570-574``).
     """
     pixels = np.asarray(pixels).astype(np.int64).ravel()
     N = pixels.size
@@ -122,7 +142,8 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     offs = np.arange(N, dtype=np.int64) // offset_length
     valid = (pixels >= 0) & (pixels < npix)
 
-    uniq = np.unique(pixels[valid])
+    if uniq is None:
+        uniq = np.unique(pixels[valid])
     n_rank = int(uniq.size)
     rank = np.full(N, n_rank, dtype=np.int64)
     rank[valid] = np.searchsorted(uniq, pixels[valid])
@@ -156,7 +177,9 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     sample_pair = sample_pair.astype(np.int32)
 
     # ---- pad pair space to a chunk multiple -----------------------------
-    P_pad = _round_up(max(n_pairs_all, 1), pair_chunk)
+    # (min_pair_pad / min_windows let per-shard plans share one compiled
+    # program: every shard pads to the fleet maxima)
+    P_pad = _round_up(max(n_pairs_all, 1, min_pair_pad), pair_chunk)
     pad = P_pad - n_pairs_all
     # padding pairs carry sentinel rank n_rank / offset n_offsets
     pair_rank = np.concatenate(
@@ -170,6 +193,9 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     pair_perm_off = np.argsort(okey, kind="stable")
     off_base, off_window = _window_layout(
         pair_offset[pair_perm_off], pair_chunk)
+    sample_window = max(sample_window, int(min_windows[0]))
+    rank_window = max(rank_window, int(min_windows[1]))
+    off_window = max(off_window, int(min_windows[2]))
 
     return PointingPlan(
         npix=int(npix), offset_length=int(offset_length),
@@ -184,6 +210,74 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
         rank_window=rank_window, rank_base=rank_base,
         pair_perm_off=pair_perm_off.astype(np.int32),
         off_window=off_window, off_base=off_base)
+
+
+def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
+                        n_shards: int, sample_chunk: int = 8192,
+                        pair_chunk: int = 4096) -> list[PointingPlan]:
+    """Per-shard plans over contiguous time shards with identical static
+    shapes (one compiled SPMD program) and a shared GLOBAL compact space.
+
+    Each shard compacts into its own LOCAL rank space — local ranks are
+    dense, so the one-hot binning windows stay narrow (a shared global
+    space would make a shard's pairs sparse in rank and blow the window to
+    ~the whole hit set). ``rank_to_global`` then scatters the shard's
+    compact sums into the global hit-pixel space for the cross-shard
+    ``psum`` (the reference's allgather'd seen-pixel compaction,
+    ``COMAPData.py:43-70,570-574``). Memory stays bounded by hit pixels,
+    never ``npix`` (SURVEY hard part 3, nside-4096 HEALPix destriping).
+    """
+    pixels = np.asarray(pixels).astype(np.int64).ravel()
+    N = pixels.size
+    quantum = n_shards * offset_length
+    if N % quantum:
+        raise ValueError(f"N={N} not a multiple of "
+                         f"n_shards*L={quantum}; pad first")
+    shard_n = N // n_shards
+    valid = (pixels >= 0) & (pixels < npix)
+    uniq_global = np.unique(pixels[valid])
+    n_rank_global = int(uniq_global.size)
+    shards = [pixels[i * shard_n:(i + 1) * shard_n]
+              for i in range(n_shards)]
+
+    def build_all(min_pair_pad=0, wins=(0, 0, 0)):
+        return [build_pointing_plan(s, npix, offset_length,
+                                    sample_chunk=sample_chunk,
+                                    pair_chunk=pair_chunk,
+                                    min_pair_pad=min_pair_pad,
+                                    min_windows=wins)
+                for s in shards]
+
+    plans = build_all()
+    # second pass: equalise pair padding and window widths across shards
+    p_max = max(p.pair_rank.shape[0] for p in plans)
+    wins = (max(p.sample_window for p in plans),
+            max(p.rank_window for p in plans),
+            max(p.off_window for p in plans))
+    if (any(p.pair_rank.shape[0] != p_max for p in plans)
+            or any((p.sample_window, p.rank_window, p.off_window) != wins
+                   for p in plans)):
+        plans = build_all(min_pair_pad=p_max, wins=wins)
+
+    # local -> global rank maps, local rank space padded to a common size.
+    # A shard's pairs keep their local sentinel rank (= that shard's own
+    # n_rank); after padding, slot n_rank_local maps to the global
+    # sentinel, so invalid/trash sums still drop in the global scatter.
+    n_rank_max = max(p.n_rank for p in plans)
+    import dataclasses
+
+    out = []
+    for p in plans:
+        l2g = np.full(n_rank_max, n_rank_global, np.int64)
+        l2g[:p.n_rank] = np.searchsorted(uniq_global, p.uniq_pixels)
+        uniq_pad = np.concatenate(
+            [p.uniq_pixels,
+             np.full(n_rank_max - p.n_rank, npix, np.int64)])
+        out.append(dataclasses.replace(
+            p, n_rank=n_rank_max, uniq_pixels=uniq_pad,
+            rank_to_global=l2g, n_rank_global=n_rank_global,
+            uniq_global=uniq_global, _device={}))
+    return out
 
 
 def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,

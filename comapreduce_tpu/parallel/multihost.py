@@ -1,0 +1,107 @@
+"""Multi-host (multi-process) initialisation and filelist sharding.
+
+The reference scales across nodes with MPI: every rank runs the same
+driver and takes filelist slice ``i % size == rank``
+(``run_average.py:13-16,38-39``; ``mpiexec -n X python run_average.py``).
+The TPU-native equivalent is JAX's distributed runtime: one process per
+host, ``jax.distributed.initialize`` wires them into one global device
+mesh (collectives ride ICI within a slice and DCN across hosts), and the
+filelist shards by ``jax.process_index()``.
+
+Launch recipe (one command per host/process)::
+
+    # host 0 (coordinator)
+    JAX_COORDINATOR_ADDRESS=host0:7632 JAX_NUM_PROCESSES=2 \
+        JAX_PROCESS_ID=0 python -m comapreduce_tpu.cli.run_average cfg.toml
+    # host 1
+    JAX_COORDINATOR_ADDRESS=host0:7632 JAX_NUM_PROCESSES=2 \
+        JAX_PROCESS_ID=1 python -m comapreduce_tpu.cli.run_average cfg.toml
+
+On managed clusters (Cloud TPU pods, SLURM), ``jax.distributed
+.initialize()`` auto-detects all three values; when a known cluster
+environment is detected the env vars are unnecessary. With no
+multi-process indication at all the call is a no-op and the run stays
+single-host.
+
+IMPORTANT: this is *data-parallel* multi-host — each process takes its
+own filelist shard and runs an independent program over its LOCAL
+devices. Meshes for the per-file analysis/destriping must therefore be
+built from ``jax.local_devices()``, never ``jax.devices()`` (which
+becomes the global cross-host list after initialisation, and a
+multi-controller program over divergent per-rank data would deadlock in
+its collectives).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["maybe_initialize_distributed", "rank_info"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_ENV_ADDR = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+# presence of any of these marks a managed cluster where the no-arg
+# jax.distributed.initialize() can auto-detect the topology
+_CLUSTER_ENV = ("TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
+                "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID")
+
+
+def maybe_initialize_distributed() -> bool:
+    """Initialise the JAX distributed runtime when the environment
+    indicates a multi-process launch; no-op otherwise.
+
+    Indication: either the explicit triple — a coordinator address in
+    ``JAX_COORDINATOR_ADDRESS`` (or ``COORDINATOR_ADDRESS``) plus
+    ``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` — or a recognised managed
+    cluster (Cloud TPU pod / SLURM), where the no-arg auto-detecting
+    ``initialize()`` is used. Raises if a clearly-indicated multi-process
+    launch fails to initialise — silently degrading to rank 0/1 would
+    make every process run the full filelist and clobber shared outputs.
+    Returns True when the distributed runtime is (now) initialised.
+    """
+    import jax
+
+    if jax.distributed.is_initialized():
+        return True
+    addr = next((os.environ[k] for k in _ENV_ADDR if os.environ.get(k)),
+                None)
+    n = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if addr and n and pid:
+        # explicit indication: failure here must propagate — degrading to
+        # rank 0/1 would duplicate the whole filelist on every process
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=int(n),
+                                   process_id=int(pid))
+    elif any(os.environ.get(k) for k in _CLUSTER_ENV):
+        # fuzzy indication (cluster-like env, e.g. a tunnelled single
+        # chip also sets TPU_WORKER_HOSTNAMES): try auto-detection, fall
+        # back to single-host when jax cannot resolve a topology
+        try:
+            jax.distributed.initialize()
+        except (ValueError, RuntimeError) as err:
+            logger.info("distributed auto-detect unavailable (%s); "
+                        "running single-host", err)
+            return False
+    else:
+        return False
+    logger.info("distributed: process %d/%d",
+                jax.process_index(), jax.process_count())
+    return True
+
+
+def rank_info() -> tuple[int, int]:
+    """(process_index, process_count) after optional distributed init —
+    the filelist-shard coordinates (reference ``run_average.py:38-39``).
+
+    Initialisation errors propagate (see
+    :func:`maybe_initialize_distributed`); only a missing jax degrades to
+    the single-process (0, 1)."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        return 0, 1
+    maybe_initialize_distributed()
+    return jax.process_index(), jax.process_count()

@@ -20,7 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from comapreduce_tpu.mapmaking.destriper import DestriperResult, destripe
+from comapreduce_tpu.mapmaking.destriper import (DestriperResult, destripe,
+                                                 destripe_planned)
+from comapreduce_tpu.mapmaking.pointing_plan import PointingPlan
 from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
                                         scan_starts_lengths)
 
@@ -29,7 +31,8 @@ try:  # jax >= 0.4.35 exports shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-__all__ = ["reduce_feeds_sharded", "destripe_sharded", "pad_for_shards"]
+__all__ = ["reduce_feeds_sharded", "destripe_sharded",
+           "destripe_sharded_planned", "pad_for_shards"]
 
 
 @functools.lru_cache(maxsize=32)
@@ -150,3 +153,54 @@ def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
 
     with mesh:
         return jax.jit(fn)(*args)
+
+
+_PLAN_KEYS = ("sample_perm", "sample_pair", "sample_base", "pair_rank",
+              "pair_offset", "rank_base", "pair_perm_off", "off_base",
+              "uniq_pixels", "rank_to_global")
+
+
+def destripe_sharded_planned(mesh: Mesh, tod, weights,
+                             plans: list[PointingPlan],
+                             n_iter: int = 100, threshold: float = 1e-6
+                             ) -> DestriperResult:
+    """Scatter-free destriping with the flat time axis sharded over the
+    mesh and a SHARED compact pixel space.
+
+    ``plans`` come from ``pointing_plan.build_sharded_plans`` (one per
+    device, identical static shapes, global rank space). ``tod``/``weights``
+    are the full f32[N] vectors in natural order; each shard receives its
+    contiguous slice plus its own index arrays as shard_map inputs. The
+    compact maps and CG scalars are ``psum``-reduced over the mesh; maps
+    come back COMPACT — (n_rank_global,) over ``plans[0].uniq_global`` —
+    so device memory is bounded by hit pixels, never npix (nside-4096
+    scale, SURVEY hard part 3).
+    """
+    axes = tuple(mesh.axis_names)
+    n_shards = int(np.prod([mesh.shape[a] for a in axes]))
+    if len(plans) != n_shards:
+        raise ValueError(f"{len(plans)} plans for {n_shards} shards")
+    p0 = plans[0]
+    if p0.rank_to_global is None:
+        raise ValueError("plans must come from build_sharded_plans")
+    stacked = {k: jnp.stack([jnp.asarray(getattr(p, k), jnp.int32)
+                             for p in plans])
+               for k in _PLAN_KEYS}
+
+    shard = P(axes)
+    repl = P()
+
+    def local(tod_l, w_l, arrs):
+        arrs = {k: v[0] for k, v in arrs.items()}
+        return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
+                                threshold=threshold, axis_name=axes,
+                                dense_maps=False, device_arrays=arrs)
+
+    out_specs = DestriperResult(
+        offsets=shard, ground=repl, destriped_map=repl, naive_map=repl,
+        weight_map=repl, hit_map=repl, n_iter=repl, residual=repl)
+    arr_specs = {k: shard for k in stacked}
+    fn = _shard_map(local, mesh=mesh, in_specs=(shard, shard, arr_specs),
+                    out_specs=out_specs, check_vma=False)
+    with mesh:
+        return jax.jit(fn)(jnp.asarray(tod), jnp.asarray(weights), stacked)

@@ -189,6 +189,7 @@ class MeasureSystemTemperature(_StageBase):
 
     groups: tuple = ("vane",)
     pad: int = 50
+    figure_dir: str = ""
 
     def __call__(self, data, level2) -> bool:
         tod = data["spectrometer/tod"]
@@ -207,8 +208,30 @@ class MeasureSystemTemperature(_StageBase):
             "vane/system_temperature": np.asarray(tsys),
             "vane/system_gain": np.asarray(gain),
         }
+        if self.figure_dir:
+            self._plot(data, reader, np.asarray(tsys))
         self.STATE = True
         return True
+
+    def _plot(self, data, reader, tsys):
+        """First vane event, feed 0: hot/cold selection + Tsys
+        (``VaneCalibration.py:173-190``)."""
+        from comapreduce_tpu import diagnostics
+
+        events = vane_ops.find_vane_events(data.vane_flag)
+        if not len(events):
+            return
+        n = len(data.vane_flag)
+        s = max(0, int(events[0][0]) - self.pad)
+        e = min(n, int(events[0][1]) + self.pad)
+        ev = np.asarray(reader(s, e), dtype=np.float32)[0]  # (B, C, t)
+        band_avg = ev.mean(axis=1)
+        hot, cold = vane_ops.hot_cold_masks(band_avg)
+        diagnostics.plot_vane_event(
+            diagnostics.figure_path(self.figure_dir, data.obsid,
+                                    "vane_feed00_event00"),
+            band_avg, np.asarray(hot), np.asarray(cold), tsys[0, 0],
+            feed=0)
 
 
 def mean_vane_tsys_gain(level2):
@@ -328,6 +351,7 @@ class Level1AveragingGainCorrection(_StageBase):
     # scans streamed per chunk inside the reduction (None = all at once)
     scan_batch: int | None = None
     prefetch: bool = True
+    figure_dir: str = ""
 
     def __call__(self, data, level2) -> bool:
         from comapreduce_tpu.parallel.mesh import feed_time_mesh
@@ -359,8 +383,11 @@ class Level1AveragingGainCorrection(_StageBase):
         airmass_all = np.asarray(data.airmass).astype(np.float32)  # (F, T)
 
         # feed batches padded to a multiple of the local feed-mesh size so
-        # every batch shards evenly and compiles once
-        mesh = feed_time_mesh(jax.devices(), n_feed=len(jax.devices()))
+        # every batch shards evenly and compiles once. LOCAL devices only:
+        # multi-host runs are data parallel over files (each process has
+        # different data), so a global mesh would deadlock its collectives
+        local = jax.local_devices()
+        mesh = feed_time_mesh(local, n_feed=len(local))
         n_dev = mesh.shape["feed"]
         fb = self.feed_batch or F
         fb = -(-min(fb, F) // n_dev) * n_dev
@@ -389,6 +416,7 @@ class Level1AveragingGainCorrection(_StageBase):
 
         from concurrent.futures import ThreadPoolExecutor
 
+        dg0 = None
         with ThreadPoolExecutor(max_workers=1) as ex:
             fut = ex.submit(load, batches[0])
             for bi, idx in enumerate(batches):
@@ -404,8 +432,18 @@ class Level1AveragingGainCorrection(_StageBase):
                 tod_out[idx] = np.asarray(res["tod"])[:len(idx)]
                 orig_out[idx] = np.asarray(res["tod_original"])[:len(idx)]
                 wei_out[idx] = np.asarray(res["weights"])[:len(idx)]
+                if bi == 0 and self.figure_dir:
+                    dg0 = np.asarray(res["dg"])[0]  # (S, L), feed 0
                 if not self.prefetch and bi + 1 < len(batches):
                     fut = ex.submit(load, batches[bi + 1])
+        if self.figure_dir and dg0 is not None and len(edges):
+            from comapreduce_tpu import diagnostics
+
+            s0, e0 = int(edges[0][0]), int(edges[0][1])
+            diagnostics.plot_gain_solution(
+                diagnostics.figure_path(self.figure_dir, data.obsid,
+                                        "gain_feed00_scan00"),
+                tod_out[0, 0, s0:e0], dg0[0][:e0 - s0], feed=0, scan=0)
         self._data = {
             "averaged_tod/tod": tod_out,
             "averaged_tod/tod_original": orig_out,
@@ -457,6 +495,7 @@ class Level2FitPowerSpectrum(_StageBase):
     # exclude resonance spikes >100x the white level from the binned PSD
     # before fitting (Level2Data.py:288-298)
     mask_peaks: bool = True
+    figure_dir: str = ""
 
     def __call__(self, data, level2) -> bool:
         import jax.numpy as jnp
@@ -479,6 +518,20 @@ class Level2FitPowerSpectrum(_StageBase):
             nbins=self.nbins, model_name=self.model_name,
             mask_peaks=self.mask_peaks)
         params = np.asarray(fit).reshape(F, B, S, 3)
+        if self.figure_dir:
+            from comapreduce_tpu import diagnostics
+
+            freqs, ps = power_ops.psd(jnp.asarray(blocks[0, 0, 0]),
+                                      self.sample_rate)
+            nu, pb, _ = power_ops.log_bin_psd(freqs, ps, nbins=self.nbins)
+            model = (power_ops.red_noise_model
+                     if self.model_name == "red_noise"
+                     else power_ops.knee_model)
+            diagnostics.plot_power_spectrum_fit(
+                diagnostics.figure_path(
+                    self.figure_dir, data.obsid,
+                    f"{self.out_group}_feed00_band00_scan00"),
+                np.asarray(nu), np.asarray(pb), params[0, 0, 0], model)
         rms = np.asarray(auto_rms(jnp.asarray(blocks)))  # (F, B, S)
         self._data = {
             f"{self.out_group}/fnoise_fit_parameters": params,

@@ -243,3 +243,45 @@ def test_scan_speed_mask_shape():
     ok = scan_speed_mask(az, el)
     # most samples move at ~0.5*cos(55 deg)=0.29 deg/s -> inside the band
     assert ok.mean() > 0.8
+
+
+def test_run_average_figures_flag(tmp_path):
+    """--figures writes per-obsid QA PNGs (vane fit, gain solution, PS
+    fit) from the CLI."""
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.cli import run_average
+
+    params = SyntheticObsParams(n_feeds=2, n_bands=2, n_channels=32,
+                                n_scans=2, scan_samples=500,
+                                vane_samples=250, seed=33)
+    obs = str(tmp_path / "comap-0042.hd5")
+    p = generate_level1_file(obs, params)
+    (tmp_path / "filelist.txt").write_text(obs + "\n")
+    fig_dir = str(tmp_path / "qa")
+    cfg = tmp_path / "run.toml"
+    cfg.write_text(f"""
+[Global]
+processes = ["CheckLevel1File", "AssignLevel1Data",
+             "MeasureSystemTemperature", "Level1AveragingGainCorrection",
+             "Level2FitPowerSpectrum"]
+filelist = "{tmp_path}/filelist.txt"
+output_dir = "{tmp_path}/level2"
+log_dir = "{tmp_path}/logs"
+
+[CheckLevel1File]
+min_duration_seconds = 1.0
+
+[Level1AveragingGainCorrection]
+medfilt_window = 301
+
+[Level2FitPowerSpectrum]
+nbins = 12
+""")
+    assert run_average.main([f"--figures={fig_dir}", str(cfg)]) == 0
+    import glob as globmod
+    pngs = sorted(globmod.glob(f"{fig_dir}/*/*.png"))
+    names = {os.path.basename(f) for f in pngs}
+    assert "vane_feed00_event00.png" in names, names
+    assert "gain_feed00_scan00.png" in names, names
+    assert "fnoise_fits_feed00_band00_scan00.png" in names, names

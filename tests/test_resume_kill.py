@@ -1,0 +1,137 @@
+"""Crash tolerance: kill a runner mid-file, resume off the checkpoint.
+
+The Level-2 file IS the checkpoint (written atomically after every stage,
+``Running.py:152-153``); a killed run must leave either a complete stage
+checkpoint or none, and a restart must finish the chain without
+corruption. Also covers ``safe_hdf5_open`` retrying through a concurrent
+writer's lock.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import sys
+from comapreduce_tpu.pipeline import Runner
+from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
+                                             CheckLevel1File,
+                                             Level1AveragingGainCorrection,
+                                             MeasureSystemTemperature,
+                                             Level2FitPowerSpectrum)
+
+path, outdir, slow = sys.argv[1], sys.argv[2], sys.argv[3] == "1"
+
+
+class SlowStage(MeasureSystemTemperature):
+    # hold the chain long enough for the parent to SIGKILL us mid-file
+    def __call__(self, data, level2):
+        ok = super().__call__(data, level2)
+        import time
+        print("STAGE_DONE vane", flush=True)
+        if slow:
+            time.sleep(30)
+        return ok
+
+
+chain = [CheckLevel1File(min_duration_seconds=1.0), AssignLevel1Data(),
+         SlowStage(), Level1AveragingGainCorrection(medfilt_window=301),
+         Level2FitPowerSpectrum(nbins=12)]
+runner = Runner(processes=chain, output_dir=outdir)
+runner.run_tod([path])
+print("RUN_COMPLETE", flush=True)
+"""
+
+
+def _spawn(worker, obs, outdir, slow):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON")}
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": _REPO})
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, str(worker), obs, outdir, "1" if slow else "0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_kill_mid_run_then_resume(tmp_path):
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.data.level import COMAPLevel2
+
+    params = SyntheticObsParams(n_feeds=1, n_bands=1, n_channels=16,
+                                n_scans=2, scan_samples=400,
+                                vane_samples=200, seed=13)
+    obs = str(tmp_path / "comap-0099.hd5")
+    generate_level1_file(obs, params)
+    outdir = str(tmp_path / "level2")
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+
+    # run 1: kill with SIGKILL right after the vane stage checkpointed
+    p = _spawn(worker, obs, outdir, slow=True)
+    t0 = time.time()
+    saw_vane = False
+    while time.time() - t0 < 120:
+        line = p.stdout.readline()
+        if "STAGE_DONE vane" in line:
+            saw_vane = True
+            break
+        if p.poll() is not None:
+            break
+    assert saw_vane, p.stderr.read()[-2000:]
+    time.sleep(0.5)  # let the runner finish the atomic checkpoint write
+    os.kill(p.pid, signal.SIGKILL)
+    p.wait(timeout=30)
+    assert p.returncode != 0  # it really died
+
+    # the checkpoint is either absent or a valid HDF5 with complete groups
+    l2_files = [f for f in os.listdir(outdir)] if os.path.isdir(outdir) \
+        else []
+    for f in l2_files:
+        lvl2 = COMAPLevel2(filename=os.path.join(outdir, f))
+        assert "averaged_tod" not in lvl2.groups  # died before reduction
+
+    # run 2: resume — must complete the remaining stages cleanly
+    p2 = _spawn(worker, obs, outdir, slow=False)
+    out, err = p2.communicate(timeout=300)
+    assert p2.returncode == 0, err[-2000:]
+    assert "RUN_COMPLETE" in out
+
+    (l2name,) = os.listdir(outdir)
+    lvl2 = COMAPLevel2(filename=os.path.join(outdir, l2name))
+    for group in ("spectrometer", "vane", "averaged_tod", "fnoise_fits"):
+        assert group in lvl2.groups, (group, lvl2.groups)
+    tod = np.asarray(lvl2.tod)
+    assert np.isfinite(tod).all() and tod.shape[0] == 1
+
+
+def test_safe_hdf5_open_retries(tmp_path):
+    """A writer-locked file is retried until the lock clears."""
+    import threading
+
+    import h5py
+
+    from comapreduce_tpu.data.hdf5io import safe_hdf5_open
+
+    path = str(tmp_path / "locked.hd5")
+    with h5py.File(path, "w") as f:
+        f.create_dataset("x", data=np.arange(4))
+
+    writer = h5py.File(path, "a")  # exclusive writer lock
+
+    def release():
+        time.sleep(1.5)
+        writer.close()
+
+    t = threading.Thread(target=release)
+    t.start()
+    f = safe_hdf5_open(path, "r", retries=20, delay=0.25, backoff=1.0)
+    assert np.array_equal(f["x"][...], np.arange(4))
+    f.close()
+    t.join()
