@@ -114,7 +114,6 @@ def _window_layout(ids_sorted: np.ndarray, chunk: int, align: int = 128):
 def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
                         sample_chunk: int = 8192,
                         pair_chunk: int = 4096,
-                        uniq: np.ndarray | None = None,
                         min_pair_pad: int = 0,
                         min_windows: tuple = (0, 0, 0)) -> PointingPlan:
     """Build the static plan for one flat pointing vector.
@@ -127,12 +126,6 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     where an invalid sample reads 0 from the map but its weight still
     enters ``F^T W``) while their map-domain sums land in a padding slot
     that is sliced away.
-
-    ``uniq``: optional pre-computed sorted unique-pixel array defining a
-    SHARED compact rank space — pass the global union when building
-    per-shard plans so every shard bins into the same compact map and the
-    cross-shard reduction is one ``psum`` (the reference's allgather'd
-    seen-pixel compaction, ``COMAPData.py:43-70,570-574``).
     """
     pixels = np.asarray(pixels).astype(np.int64).ravel()
     N = pixels.size
@@ -142,8 +135,7 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     offs = np.arange(N, dtype=np.int64) // offset_length
     valid = (pixels >= 0) & (pixels < npix)
 
-    if uniq is None:
-        uniq = np.unique(pixels[valid])
+    uniq = np.unique(pixels[valid])
     n_rank = int(uniq.size)
     rank = np.full(N, n_rank, dtype=np.int64)
     rank[valid] = np.searchsorted(uniq, pixels[valid])

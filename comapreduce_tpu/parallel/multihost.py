@@ -43,9 +43,12 @@ logger = logging.getLogger("comapreduce_tpu")
 
 _ENV_ADDR = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
 # presence of any of these marks a managed cluster where the no-arg
-# jax.distributed.initialize() can auto-detect the topology
+# jax.distributed.initialize() can auto-detect the topology. SLURM is
+# deliberately NOT auto-detected: a single-process launch inside a
+# multi-task batch allocation would block as coordinator waiting for
+# tasks that never connect — SLURM users pass the explicit env triple.
 _CLUSTER_ENV = ("TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID",
-                "MEGASCALE_COORDINATOR_ADDRESS", "SLURM_JOB_ID")
+                "MEGASCALE_COORDINATOR_ADDRESS")
 
 
 def maybe_initialize_distributed() -> bool:

@@ -18,33 +18,40 @@ import numpy as np
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = r"""
-import sys
+import json, sys
 from comapreduce_tpu.pipeline import Runner
 from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
                                              CheckLevel1File,
                                              Level1AveragingGainCorrection,
                                              MeasureSystemTemperature,
-                                             Level2FitPowerSpectrum)
+                                             Level2FitPowerSpectrum,
+                                             _StageBase)
 
 path, outdir, slow = sys.argv[1], sys.argv[2], sys.argv[3] == "1"
 
 
-class SlowStage(MeasureSystemTemperature):
-    # hold the chain long enough for the parent to SIGKILL us mid-file
+class Stall(_StageBase):
+    # runs AFTER the vane stage, so its sleep happens once the runner has
+    # already written the vane group's atomic checkpoint — the parent's
+    # SIGKILL then tests resuming off a genuinely completed checkpoint.
+    # (constructed with overwrite=True below: its groups are empty, so
+    # contains() is vacuously true and it would otherwise be skipped)
+
     def __call__(self, data, level2):
-        ok = super().__call__(data, level2)
         import time
-        print("STAGE_DONE vane", flush=True)
+        print("VANE_CHECKPOINTED", flush=True)
         if slow:
             time.sleep(30)
-        return ok
+        return True
 
 
 chain = [CheckLevel1File(min_duration_seconds=1.0), AssignLevel1Data(),
-         SlowStage(), Level1AveragingGainCorrection(medfilt_window=301),
+         MeasureSystemTemperature(), Stall(overwrite=True),
+         Level1AveragingGainCorrection(medfilt_window=301),
          Level2FitPowerSpectrum(nbins=12)]
 runner = Runner(processes=chain, output_dir=outdir)
 runner.run_tod([path])
+print("TIMINGS " + json.dumps(sorted(runner.timings)), flush=True)
 print("RUN_COMPLETE", flush=True)
 """
 
@@ -73,35 +80,39 @@ def test_kill_mid_run_then_resume(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
 
-    # run 1: kill with SIGKILL right after the vane stage checkpointed
+    # run 1: the Stall stage runs after the vane stage's checkpoint write;
+    # SIGKILL lands during its sleep, i.e. after a completed checkpoint
     p = _spawn(worker, obs, outdir, slow=True)
     t0 = time.time()
     saw_vane = False
     while time.time() - t0 < 120:
         line = p.stdout.readline()
-        if "STAGE_DONE vane" in line:
+        if "VANE_CHECKPOINTED" in line:
             saw_vane = True
             break
         if p.poll() is not None:
             break
     assert saw_vane, p.stderr.read()[-2000:]
-    time.sleep(0.5)  # let the runner finish the atomic checkpoint write
     os.kill(p.pid, signal.SIGKILL)
     p.wait(timeout=30)
     assert p.returncode != 0  # it really died
 
-    # the checkpoint is either absent or a valid HDF5 with complete groups
-    l2_files = [f for f in os.listdir(outdir)] if os.path.isdir(outdir) \
-        else []
-    for f in l2_files:
-        lvl2 = COMAPLevel2(filename=os.path.join(outdir, f))
-        assert "averaged_tod" not in lvl2.groups  # died before reduction
+    # the checkpoint holds the completed vane group but no reduction
+    (l2name,) = os.listdir(outdir)
+    lvl2 = COMAPLevel2(filename=os.path.join(outdir, l2name))
+    assert "vane" in lvl2.groups
+    assert "averaged_tod" not in lvl2.groups
 
-    # run 2: resume — must complete the remaining stages cleanly
+    # run 2: resume — the vane stage must be SKIPPED (contains() resume
+    # off the checkpoint) and the remaining stages complete cleanly
     p2 = _spawn(worker, obs, outdir, slow=False)
     out, err = p2.communicate(timeout=300)
     assert p2.returncode == 0, err[-2000:]
     assert "RUN_COMPLETE" in out
+    timings = [ln for ln in out.splitlines() if ln.startswith("TIMINGS ")]
+    ran = set(__import__("json").loads(timings[-1][len("TIMINGS "):]))
+    assert "MeasureSystemTemperature" not in ran, ran
+    assert "Level1AveragingGainCorrection" in ran, ran
 
     (l2name,) = os.listdir(outdir)
     lvl2 = COMAPLevel2(filename=os.path.join(outdir, l2name))
