@@ -175,12 +175,6 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
     B, C, T = tod.shape
     t_valid = (jnp.arange(L)[None, :] < lengths[:, None]).astype(tod.dtype)
 
-    # (S, B, C, L) scan blocks; pads repeat each scan's own last sample so
-    # the median filter sees benign edge replication, never foreign data
-    d = extract_scan_blocks(tod, starts, L, lengths)
-    m = extract_scan_blocks(mask, starts, L) * t_valid[:, None, None, :]
-    a = extract_scan_blocks(airmass, starts, L, lengths)  # (S, L)
-
     def per_scan(d_s, m_s, a_s, tv):
         # NaN fill is per-scan independent; doing it here (not on the full
         # block) lets scan_batch streaming bound its memory too
@@ -242,12 +236,29 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
                 dg, atmos_fit)
 
     if cfg.scan_batch is not None and cfg.scan_batch < n_scans:
-        # stream scans in fixed-size chunks: lax.map pads the trailing
-        # partial chunk internally; peak memory ~= scan_batch blocks
+        # stream scans in fixed-size chunks, EXTRACTING inside the loop:
+        # peak memory ~= scan_batch (B, C, L) working sets on top of the
+        # raw (B, C, T) input — the full (S, B, C, L) block pair (2x the
+        # observation) never materialises. lax.map pads the trailing
+        # partial chunk internally.
+        def per_scan_slice(args):
+            # extract_scan_blocks with a single-scan batch: one source of
+            # truth for the edge-replication clamping in both paths
+            start, length, tv = args
+            d_s = extract_scan_blocks(tod, start[None], L, length[None])[0]
+            m_s = extract_scan_blocks(mask, start[None], L)[0] * tv
+            a_s = extract_scan_blocks(airmass, start[None], L,
+                                      length[None])[0]
+            return per_scan(d_s, m_s, a_s, tv)
+
         tod_c, tod_o, wts, dgs, atm = jax.lax.map(
-            lambda xs: per_scan(*xs), (d, m, a, t_valid),
+            per_scan_slice, (starts, lengths, t_valid),
             batch_size=cfg.scan_batch)
     else:
+        # (S, B, C, L) scan blocks in one gather each
+        d = extract_scan_blocks(tod, starts, L, lengths)
+        m = extract_scan_blocks(mask, starts, L) * t_valid[:, None, None, :]
+        a = extract_scan_blocks(airmass, starts, L, lengths)  # (S, L)
         tod_c, tod_o, wts, dgs, atm = jax.vmap(per_scan)(d, m, a, t_valid)
 
     return {
