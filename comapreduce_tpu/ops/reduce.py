@@ -239,8 +239,9 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
         # stream scans in fixed-size chunks, EXTRACTING inside the loop:
         # peak memory ~= scan_batch (B, C, L) working sets on top of the
         # raw (B, C, T) input — the full (S, B, C, L) block pair (2x the
-        # observation) never materialises. lax.map pads the trailing
-        # partial chunk internally.
+        # observation) never materialises. NOTE lax.map compiles the body
+        # a second time for a trailing partial chunk — prefer scan_batch
+        # values dividing n_scans to avoid doubling compile time.
         def per_scan_slice(args):
             # extract_scan_blocks with a single-scan batch: one source of
             # truth for the edge-replication clamping in both paths

@@ -145,11 +145,6 @@ def test_full_reduction_suppresses_gain_noise(obs):
 
 def test_scan_batch_streaming_parity():
     """scan_batch streaming (in-loop extraction) == vmap-over-scans."""
-    import jax.numpy as jnp
-
-    from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
-                                            scan_starts_lengths)
-
     rng = np.random.default_rng(0)
     B, C = 2, 32
     edges = np.array([[40, 640], [700, 1240], [1300, 1750]])
