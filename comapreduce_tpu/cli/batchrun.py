@@ -1,0 +1,77 @@
+"""Batch launcher: run the TOD pipeline as N parallel processes.
+
+Reference: ``batchrun.py`` (legacy) and the PBS recipe
+(``scripts/general/pbs.script``: ``mpirun -n 16 python run_average.py``) —
+the operator-facing way to fan a filelist across ranks on one node. Here
+the launcher spawns N ``run_average`` worker processes, each with
+``COMAP_RANK``/``COMAP_NRANKS`` set (read by
+``parallel.multihost.rank_info``, ahead of any distributed runtime); the
+workers then take their round-robin filelist shard exactly as an
+``mpiexec`` launch would::
+
+    python -m comapreduce_tpu.cli.batchrun -n 4 configuration.toml
+
+For multi-NODE launches use the ``jax.distributed`` recipe in
+``parallel/multihost.py`` instead (one process per host).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+__all__ = ["main"]
+
+
+def _usage() -> int:
+    print("usage: python -m comapreduce_tpu.cli.batchrun "
+          "[-n N] configuration.toml [run_average args...]",
+          file=sys.stderr)
+    return 2
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    n_procs = 2
+    rest = []
+    it = iter(argv)
+    for a in it:
+        if a in ("-n", "--n-procs"):
+            try:
+                n_procs = int(next(it))
+            except (StopIteration, ValueError):
+                return _usage()
+        elif a.startswith("--n-procs="):
+            try:
+                n_procs = int(a.split("=", 1)[1])
+            except ValueError:
+                return _usage()
+        else:
+            rest.append(a)
+    if len(rest) < 1 or n_procs < 1:
+        return _usage()
+
+    procs = []
+    for rank in range(n_procs):
+        env = dict(os.environ)
+        # the workers shard by rank without a coordinator: the pipeline
+        # stages are embarrassingly parallel over files (reference ranks
+        # never talk during the TOD loop either)
+        env["COMAP_RANK"] = str(rank)
+        env["COMAP_NRANKS"] = str(n_procs)
+        # N processes cannot share one accelerator (libtpu is exclusive);
+        # host fan-out is a CPU pattern — a single process drives the
+        # chip(s) via the device mesh instead. Explicit JAX_PLATFORMS in
+        # the environment overrides this.
+        if n_procs > 1:
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "comapreduce_tpu.cli.run_average",
+             *rest], env=env))
+    rcs = [p.wait() for p in procs]
+    return next((r for r in rcs if r), 0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -96,12 +96,18 @@ def maybe_initialize_distributed() -> bool:
 
 
 def rank_info() -> tuple[int, int]:
-    """(process_index, process_count) after optional distributed init —
-    the filelist-shard coordinates (reference ``run_average.py:38-39``).
+    """(process_index, process_count) — the filelist-shard coordinates
+    (reference ``run_average.py:38-39``).
 
+    Resolution order: explicit ``COMAP_RANK``/``COMAP_NRANKS`` (set by
+    ``cli/batchrun.py`` for coordinator-less single-node fan-out), then
+    the jax distributed runtime after optional initialisation.
     Initialisation errors propagate (see
     :func:`maybe_initialize_distributed`); only a missing jax degrades to
     the single-process (0, 1)."""
+    r, n = os.environ.get("COMAP_RANK"), os.environ.get("COMAP_NRANKS")
+    if r is not None and n is not None:
+        return int(r), int(n)
     try:
         import jax
     except ImportError:  # pragma: no cover - jax is a hard dep in practice

@@ -285,3 +285,47 @@ nbins = 12
     assert "vane_feed00_event00.png" in names, names
     assert "gain_feed00_scan00.png" in names, names
     assert "fnoise_fits_feed00_band00_scan00.png" in names, names
+
+
+def test_batchrun_spawns_sharded_workers(tmp_path):
+    """batchrun fans a filelist across N worker processes (reference
+    batchrun.py / pbs.script capability)."""
+    import subprocess
+    import sys
+
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+
+    paths = []
+    for i in range(2):
+        params = SyntheticObsParams(n_feeds=1, n_bands=1, n_channels=16,
+                                    n_scans=2, scan_samples=400,
+                                    vane_samples=200, seed=40 + i)
+        p = str(tmp_path / f"comap-010{i}.hd5")
+        generate_level1_file(p, params)
+        paths.append(p)
+    (tmp_path / "filelist.txt").write_text("\n".join(paths) + "\n")
+    outdir = tmp_path / "level2"
+    cfg = tmp_path / "run.toml"
+    cfg.write_text(f"""
+[Global]
+processes = ["CheckLevel1File", "AssignLevel1Data",
+             "MeasureSystemTemperature"]
+filelist = "{tmp_path}/filelist.txt"
+output_dir = "{outdir}"
+log_dir = "{tmp_path}/logs"
+
+[CheckLevel1File]
+min_duration_seconds = 1.0
+""")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON")}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "comapreduce_tpu.cli.batchrun", "-n", "2",
+         str(cfg)], env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    produced = sorted(os.listdir(outdir))
+    assert len(produced) == 2, produced
