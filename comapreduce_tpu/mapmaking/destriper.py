@@ -87,8 +87,21 @@ def _dot(x, y, axis_name):
     return s
 
 
-def _cg_loop(matvec, b, dot, n_iter: int, threshold: float):
-    """Shared CG driver over an arbitrary pytree of unknowns.
+def _jacobi_inverse(diag_a: jax.Array, diag_fwf: jax.Array) -> jax.Array:
+    """1/diag(A) with fallbacks for degenerate offsets.
+
+    An offset whose samples are alone in their pixels has A_oo ~ 0 (Z
+    removes it entirely — a null direction): fall back to the plain
+    F^T W F diagonal there, and to identity on zero-weight (padding)
+    offsets."""
+    floor = 1e-6 * jnp.maximum(diag_fwf, 1e-30)
+    safe = jnp.where(diag_a > floor, diag_a,
+                     jnp.where(diag_fwf > 0, diag_fwf, 1.0))
+    return 1.0 / safe
+
+
+def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None):
+    """Shared (P)CG driver over an arbitrary pytree of unknowns.
 
     Both destriper paths (scatter and planned) use this one loop so the
     singular-system breakdown guard and convergence criterion cannot drift
@@ -97,42 +110,50 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float):
     eventually push the search direction out of the range space and
     ``p^T A p`` to <= 0 — detect the breakdown and stop with the current
     iterate rather than dividing into a NaN. ``dot`` supplies the (possibly
-    psum-reduced) inner product. Returns ``(x, rz, k, b_norm)``.
+    psum-reduced) inner product; ``precond`` an optional SPD ``M^{-1}``
+    application (e.g. Jacobi). Convergence tests the TRUE residual norm
+    ``|r|^2`` against ``threshold^2 |b|^2`` in both cases. Returns
+    ``(x, rz, k, b_norm)`` with ``rz = |r|^2``.
     """
     b_norm = dot(b, b)
+    minv = precond if precond is not None else (lambda v: v)
 
     def axpy(a, x, y):
         return jax.tree.map(lambda xi, yi: xi + a * yi, x, y)
 
     def cond(state):
-        _, _, _, rz, k, done = state
+        _, _, _, _, rr, k, done = state
         return ((k < n_iter) & ~done
-                & (rz > threshold**2 * jnp.maximum(b_norm, 1e-30)))
+                & (rr > threshold**2 * jnp.maximum(b_norm, 1e-30)))
 
     def body(state):
-        x, r, p, rz, k, done = state
+        x, r, p, rz, rr, k, done = state
         q = matvec(p)
         pq = dot(p, q)
         ok = jnp.isfinite(pq) & (pq > 0)
         alpha = jnp.where(ok, rz / jnp.where(ok, pq, 1.0), 0.0)
         x_new = axpy(alpha, x, p)
         r_new = axpy(-alpha, r, q)
-        rz_new = dot(r_new, r_new)
-        ok = ok & jnp.isfinite(rz_new)
+        z_new = minv(r_new)
+        rz_new = dot(r_new, z_new)
+        rr_new = dot(r_new, r_new)
+        ok = ok & jnp.isfinite(rz_new) & jnp.isfinite(rr_new)
         beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
-        p_new = axpy(beta, r_new, p)
+        p_new = axpy(beta, z_new, p)
         # on breakdown: freeze the iterate, keep the last good residual
         # for reporting, and flag the loop to exit
         sel = lambda new, old: jax.tree.map(  # noqa: E731
             lambda a_, b_: jnp.where(ok, a_, b_), new, old)
         return (sel(x_new, x), sel(r_new, r), sel(p_new, p),
-                jnp.where(ok, rz_new, rz), k + 1, ~ok)
+                jnp.where(ok, rz_new, rz), jnp.where(ok, rr_new, rr),
+                k + 1, ~ok)
 
     x0 = jax.tree.map(jnp.zeros_like, b)
-    state0 = (x0, b, b, b_norm, jnp.asarray(0, jnp.int32),
+    z0 = minv(b)
+    state0 = (x0, b, z0, dot(b, z0), b_norm, jnp.asarray(0, jnp.int32),
               jnp.asarray(False))
-    x, _, _, rz, k, _ = jax.lax.while_loop(cond, body, state0)
-    return x, rz, k, b_norm
+    x, _, _, _, rr, k, _ = jax.lax.while_loop(cond, body, state0)
+    return x, rr, k, b_norm
 
 
 def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
@@ -176,8 +197,33 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
 
     b = _reduce(Zmap(tod), ground_ids, az, n_offsets, offset_length,
                 n_groups, with_ground, axis_name)
+
+    # Jacobi preconditioner. True diagonal: A_oo = sum_i w_i -
+    # sum_p w_po^2 / sumw_p; without pair aggregates the correction is
+    # approximated per sample (sum_i w_i^2 / sumw_{pix_i} <= the true
+    # correction), which overestimates diag(A) — still SPD, still a valid
+    # (slightly weaker) preconditioner. The planned path uses the exact
+    # pair form.
+    inv_sw = jnp.where(sum_w > 0, 1.0 / jnp.maximum(sum_w, 1e-30), 0.0)
+    d_fwf = jnp.sum(weights.reshape(n_offsets, offset_length), axis=1)
+    corr = jnp.sum((weights * weights
+                    * sample_map(inv_sw, pixels)
+                    ).reshape(n_offsets, offset_length), axis=1)
+    inv_diag = _jacobi_inverse(d_fwf - corr, d_fwf)
+
+    def precond(v):
+        # identity on the ground block, deliberately: the unprojected
+        # G^T W G diagonal overestimates the true (Z-projected) ground
+        # diagonal by orders of magnitude when the template is nearly
+        # degenerate with the sky, and scaling by it starves those ~2 *
+        # n_groups directions (measured: ground slopes collapse from the
+        # injected truth to ~0). With only a handful of ground unknowns,
+        # unpreconditioned directions cost a few CG iterations at most.
+        return (v[0] * inv_diag, v[1])
+
     x, rz, k, b_norm = _cg_loop(
-        matvec, b, lambda u, v: _dot(u, v, axis_name), n_iter, threshold)
+        matvec, b, lambda u, v: _dot(u, v, axis_name), n_iter, threshold,
+        precond=precond)
     offsets, ground = x
 
     # final products
@@ -313,8 +359,16 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
 
     m_d = to_map(pair_wd)
     b = off_sum(pair_wd) - off_sum(pair_w * gather_m(from_global(m_d)))
+
+    # Jacobi preconditioner: exact diag(A) from the pair aggregates —
+    # A_oo = diag_o - sum_{pairs (r,o)} w_po^2 / sumw_r
+    inv_sw = jnp.where(sum_w > 0, 1.0 / jnp.maximum(sum_w, 1e-30), 0.0)
+    corr = off_sum(pair_w * pair_w * gather_m(from_global(inv_sw)))
+    inv_diag = _jacobi_inverse(diag - corr, diag)
+
     a, rz, k, b_norm = _cg_loop(
-        matvec, b, lambda u, v: _psum(jnp.sum(u * v)), n_iter, threshold)
+        matvec, b, lambda u, v: _psum(jnp.sum(u * v)), n_iter, threshold,
+        precond=lambda v: v * inv_diag)
 
     # final products in the compact rank space; optionally scattered once
     # to the full map (host-side partial-map writers take the compact form)

@@ -106,7 +106,7 @@ def rank_info() -> tuple[int, int]:
     :func:`maybe_initialize_distributed`); only a missing jax degrades to
     the single-process (0, 1)."""
     r, n = os.environ.get("COMAP_RANK"), os.environ.get("COMAP_NRANKS")
-    if r is not None and n is not None:
+    if r and n:  # empty string == unset, like the vars above
         return int(r), int(n)
     try:
         import jax

@@ -191,10 +191,12 @@ def test_ground_template_removes_az_signal(field_dataset):
     g = np.asarray(res_ground.ground)
     assert g.shape == (data.n_groups, 2)
     # the az->RA mapping of a CES scan makes an az-linear signal partly
-    # degenerate with a sky gradient, so only part of the slope is
-    # attributed to the ground template (the reference breaks this with
-    # multi-geometry data); assert the right sign and magnitude range
-    assert (g[:, 1] > 0.15).all() and (g[:, 1] < ground_amp).all(), g
+    # degenerate with a sky gradient, so where in that subspace the solver
+    # lands depends on the CG path (the Jacobi-preconditioned solver gets
+    # close to the injected truth; the reference breaks the degeneracy
+    # with multi-geometry data); assert sign and magnitude range with
+    # noise headroom above the truth
+    assert (g[:, 1] > 0.15).all() and (g[:, 1] < 1.2 * ground_amp).all(), g
     hit = np.asarray(res_ground.hit_map) > 0
     std_g = np.nanstd(np.asarray(res_ground.destriped_map)[hit])
     std_p = np.nanstd(np.asarray(res_plain.destriped_map)[hit])
