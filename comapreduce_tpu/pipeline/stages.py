@@ -24,11 +24,13 @@ Registered stages (name -> reference counterpart):
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 from dataclasses import dataclass, field
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from comapreduce_tpu.ops import power as power_ops
@@ -234,6 +236,15 @@ class MeasureSystemTemperature(_StageBase):
             feed=0)
 
 
+@functools.lru_cache(maxsize=32)
+def _batched_atmosphere_fit(n_scans: int):
+    """Cached jitted vmap-over-feeds atmosphere fit (one compile per scan
+    count, not one per file)."""
+    return jax.jit(jax.vmap(
+        functools.partial(fit_atmosphere_segments, n_scans=n_scans),
+        in_axes=(0, 0, None, 0)))
+
+
 def mean_vane_tsys_gain(level2):
     """Event-averaged (tsys, gain), each f32[F, B, C]; zeros stay zero.
 
@@ -259,25 +270,32 @@ class SkyDip(_StageBase):
     [offset, slope-vs-airmass]."""
 
     groups: tuple = ("skydip",)
+    # feeds per device batch; the default bounds memory at production
+    # scale (a feed is ~2.2 GB of raw counts; see the gain stage)
+    feed_batch: int = 4
 
     def __call__(self, data, level2) -> bool:
-        import jax.numpy as jnp
-
         F = int(data.tod_shape[0])
         on = ~np.asarray(data.vane_flag)
-        fits = []
-        for ifeed in range(F):
-            tod = data.read_tod_feed(ifeed).astype(np.float32)  # (B, C, T)
-            airmass = np.asarray(data.airmass)[ifeed].astype(np.float32)
-            mask = (np.isfinite(tod) & on[None, None, :]).astype(np.float32)
-            seg = np.zeros(tod.shape[-1], np.int32)
-            seg[~on] = -1
-            off, slope = fit_atmosphere_segments(
-                jnp.asarray(np.nan_to_num(tod)), jnp.asarray(airmass),
-                jnp.asarray(seg), jnp.asarray(mask), n_scans=1)
-            fits.append(np.stack([np.asarray(off)[..., 0],
-                                  np.asarray(slope)[..., 0]], axis=-2))
-        self._data = {"skydip/fits": np.stack(fits)}  # (F, B, 2, C)
+        seg = np.zeros(int(data.tod_shape[-1]), np.int32)
+        seg[~on] = -1
+        seg_j = jnp.asarray(seg)
+        airmass_all = np.asarray(data.airmass).astype(np.float32)
+        fit = _batched_atmosphere_fit(1)
+        fits = np.zeros((F, data.tod_shape[1], 2, data.tod_shape[2]),
+                        np.float32)
+        fb = self.feed_batch or F
+        for i in range(0, F, fb):
+            idx = list(range(i, min(i + fb, F)))
+            raw = np.stack([np.asarray(data.read_tod_feed(j),
+                                       dtype=np.float32) for j in idx])
+            mask = (np.isfinite(raw) & on).astype(np.float32)
+            off, slope = fit(jnp.asarray(np.nan_to_num(raw)),
+                             jnp.asarray(airmass_all[idx]), seg_j,
+                             jnp.asarray(mask))
+            fits[idx] = np.stack([np.asarray(off)[..., 0],
+                                  np.asarray(slope)[..., 0]], axis=-2)
+        self._data = {"skydip/fits": fits}  # (F, B, 2, C)
         self.STATE = True
         return True
 
@@ -291,10 +309,11 @@ class AtmosphereRemoval(_StageBase):
     188-234``), which stores ``atmosphere/fit_values`` (S, F, B, 2, C)."""
 
     groups: tuple = ("atmosphere",)
+    # feeds per device batch; the default bounds memory at production
+    # scale (a feed is ~2.2 GB of raw counts; see the gain stage)
+    feed_batch: int = 4
 
     def __call__(self, data, level2) -> bool:
-        import jax.numpy as jnp
-
         edges = data.scan_edges
         if len(edges) == 0:
             logger.warning("AtmosphereRemoval: obs %s has no scans",
@@ -303,21 +322,24 @@ class AtmosphereRemoval(_StageBase):
             return False
         S = len(edges)
         T = int(data.tod_shape[-1])
-        seg = segment_ids_from_edges(edges, T).astype(np.int32)
-        F = int(data.tod_shape[0])
-        out = []
-        for ifeed in range(F):
-            tod = data.read_tod_feed(ifeed).astype(np.float32)
-            airmass = np.asarray(data.airmass)[ifeed].astype(np.float32)
-            mask = np.isfinite(tod).astype(np.float32)
-            off, atm = fit_atmosphere_segments(
-                jnp.asarray(np.nan_to_num(tod)), jnp.asarray(airmass),
-                jnp.asarray(seg), jnp.asarray(mask), n_scans=S)
-            # (B, C, S) -> (S, B, 2, C)
-            fit = np.stack([np.asarray(off), np.asarray(atm)], axis=0)
-            out.append(np.transpose(fit, (3, 1, 0, 2)))
-        self._data = {"atmosphere/fit_values":
-                      np.stack(out, axis=1)}  # (S, F, B, 2, C)
+        seg_j = jnp.asarray(segment_ids_from_edges(edges, T).astype(np.int32))
+        F, B, C, _ = data.tod_shape
+        airmass_all = np.asarray(data.airmass).astype(np.float32)
+        fit = _batched_atmosphere_fit(S)
+        out = np.zeros((S, F, B, 2, C), np.float32)
+        fb = self.feed_batch or F
+        for i in range(0, F, fb):
+            idx = list(range(i, min(i + fb, F)))
+            raw = np.stack([np.asarray(data.read_tod_feed(j),
+                                       dtype=np.float32) for j in idx])
+            mask = np.isfinite(raw).astype(np.float32)
+            off, atm = fit(jnp.asarray(np.nan_to_num(raw)),
+                           jnp.asarray(airmass_all[idx]), seg_j,
+                           jnp.asarray(mask))
+            # (f, B, C, S) pair -> (S, f, B, 2, C)
+            blk = np.stack([np.asarray(off), np.asarray(atm)], axis=0)
+            out[:, idx] = np.transpose(blk, (4, 1, 2, 0, 3))
+        self._data = {"atmosphere/fit_values": out}
         self.STATE = True
         return True
 
@@ -498,8 +520,6 @@ class Level2FitPowerSpectrum(_StageBase):
     figure_dir: str = ""
 
     def __call__(self, data, level2) -> bool:
-        import jax.numpy as jnp
-
         tod = np.asarray(level2.tod, dtype=np.float32)  # (F, B, T)
         edges = np.asarray(level2.scan_edges)
         if len(edges) == 0:
