@@ -12,5 +12,9 @@ replaced by a CSV import (no gspread in this image).
 
 from comapreduce_tpu.database.obsdb import (ObsDatabase, robust_smooth,
                                             assign_stats_flags)
+from comapreduce_tpu.database.metadata import (parse_obsinfo,
+                                               query_obs_metadata,
+                                               obsinfo_from_database)
 
-__all__ = ["ObsDatabase", "robust_smooth", "assign_stats_flags"]
+__all__ = ["ObsDatabase", "robust_smooth", "assign_stats_flags",
+           "parse_obsinfo", "query_obs_metadata", "obsinfo_from_database"]

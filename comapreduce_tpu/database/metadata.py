@@ -1,0 +1,115 @@
+"""Observation-metadata queries (``Tools/FileTools.py:6-27`` parity).
+
+The reference shells out over SSH to a script on the OVRO archive host
+that prints one ``obsid target day time`` line per observation, then
+rebuilds the Level-2 filename from the COMAP convention
+``comap-{obsid:07d}-{YYYY-mm-dd-HHMMSS}{suffix}.hd5``. Here the same
+capability is split into
+
+* :func:`parse_obsinfo` — the line-format parser (pure, testable);
+* :func:`query_obs_metadata` — run a remote/local command and parse its
+  output (argv list, no ``shell=True``);
+* :func:`obsinfo_from_database` — answer the same query offline from a
+  local :class:`~comapreduce_tpu.database.obsdb.ObsDatabase`, which is
+  the TPU-cluster-native path (no SSH hop from worker hosts).
+"""
+
+from __future__ import annotations
+
+import logging
+import shlex
+import subprocess
+from datetime import datetime, timezone
+
+__all__ = ["parse_obsinfo", "query_obs_metadata", "obsinfo_from_database"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_FILENAME_FMT = "comap-{obsid:07d}-{stamp}{suffix}.hd5"
+
+
+def parse_obsinfo(text: str, suffix: str = "_Level2Cont") -> dict[str, str]:
+    """Parse ``obsid target day time`` lines into ``{filename: target}``.
+
+    Lines that do not have exactly four whitespace-separated fields, a
+    numeric obsid, or a parseable ``%Y-%m-%d %H:%M:%S[.f]`` timestamp
+    are skipped (the reference silently skips malformed lines too,
+    ``FileTools.py:17-18``).
+    """
+    obsinfo: dict[str, str] = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) != 4:
+            continue
+        obsid_s, target, day, time_s = parts
+        if not obsid_s.isdigit():
+            continue
+        stamp = None
+        for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S"):
+            try:
+                stamp = datetime.strptime(f"{day} {time_s}", fmt)
+                break
+            except ValueError:
+                continue
+        if stamp is None:
+            continue
+        filename = _FILENAME_FMT.format(
+            obsid=int(obsid_s), stamp=stamp.strftime("%Y-%m-%d-%H%M%S"),
+            suffix=suffix)
+        obsinfo[filename] = target
+    return obsinfo
+
+
+def query_obs_metadata(server: str | None, script_argv,
+                       suffix: str = "_Level2Cont",
+                       timeout: float = 120.0) -> dict[str, str]:
+    """Run the archive metadata script and parse its output.
+
+    ``server=None`` runs ``script_argv`` locally; otherwise it is wrapped
+    in ``ssh server ...``. ``script_argv`` may be an argv list or a
+    command string (split with :func:`shlex.split`, so both paths agree
+    on word boundaries). No local shell is involved, and for the ssh
+    path the command is re-quoted with :func:`shlex.join` so the remote
+    login shell sees exactly the given argv — embedded metacharacters
+    are not reinterpreted on either side. A dead archive host raises
+    instead of returning an empty dict silently.
+    """
+    if isinstance(script_argv, str):
+        script_argv = shlex.split(script_argv)
+    argv = [str(a) for a in script_argv]
+    if server is not None:
+        argv = ["ssh", server, "--", shlex.join(argv)]
+    out = subprocess.run(argv, capture_output=True, text=True,
+                         timeout=timeout, check=True)
+    info = parse_obsinfo(out.stdout, suffix=suffix)
+    logger.info("query_obs_metadata: %d observations from %s",
+                len(info), server or "localhost")
+    return info
+
+
+def obsinfo_from_database(db, suffix: str = "_Level2Cont",
+                          source: str | None = None) -> dict[str, str]:
+    """``{filename: target}`` from a local obs database — the offline
+    equivalent of the SSH query. The filename stamp encodes the
+    observation *start* time (``mjd_start`` attr, as harvested by
+    ``ObsDatabase.update_from_level2``); records that predate that attr
+    fall back to the mean-``mjd`` attr."""
+    out: dict[str, str] = {}
+    for obsid in db.obsids():
+        target = db.get_attr(obsid, "source")
+        mjd = db.get_attr(obsid, "mjd_start")
+        if mjd is None:
+            mjd = db.get_attr(obsid, "mjd")
+        if target is None or mjd is None:
+            continue
+        target = str(target)
+        if source is not None and target != source:
+            continue
+        # MJD 40587 = Unix epoch; render in UTC so filenames are
+        # host-timezone independent
+        stamp = datetime.fromtimestamp(
+            (float(mjd) - 40587.0) * 86400.0,
+            tz=timezone.utc).strftime("%Y-%m-%d-%H%M%S")
+        out[_FILENAME_FMT.format(obsid=int(obsid), stamp=stamp,
+                                 suffix=suffix)] = target
+    return out

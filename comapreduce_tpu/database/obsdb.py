@@ -128,8 +128,11 @@ class ObsDatabase:
                              np.asarray(good) if good is not None
                              else np.ones(fac.shape, np.uint8))
                 self.set_attr(obsid, "source", lvl2.source_name)
-                self.set_attr(obsid, "mjd",
-                              float(np.mean(np.asarray(lvl2.mjd))))
+                mjd = np.asarray(lvl2.mjd)
+                # mean for nearest-MJD factor assignment; start for the
+                # filename convention (comap-<obsid>-<start stamp>)
+                self.set_attr(obsid, "mjd", float(np.mean(mjd)))
+                self.set_attr(obsid, "mjd_start", float(mjd.flat[0]))
                 self.set_attr(obsid, "level2_path", os.path.abspath(fname))
                 if self.get_attr(obsid, "flag") is None:
                     self.set_attr(obsid, "flag", FLAG_GOOD)

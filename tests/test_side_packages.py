@@ -227,3 +227,51 @@ def test_obsdb_roundtrip_and_queries(tmp_path):
     vals[7] = 50.0
     sm = robust_smooth(mjds, vals, window_days=10.0)
     assert np.allclose(sm, 1.0, atol=1e-9)
+
+
+def test_obs_metadata_query(tmp_path):
+    """FileTools.py:6-27 parity: parse the 4-column archive listing and
+    query it via a (local) command; offline variant off the obs db."""
+    from comapreduce_tpu.database import (ObsDatabase, obsinfo_from_database,
+                                          parse_obsinfo, query_obs_metadata)
+
+    listing = (
+        "12345 TauA 2024-03-01 02:03:04.500\n"
+        "garbage line that is skipped\n"
+        "12346 co2 2024-03-02 10:00:00\n"
+        "notanid field 2024-03-02 10:00:00\n"
+        "12347 CasA 2024-13-99 10:00:00\n"  # bad date -> skipped
+    )
+    info = parse_obsinfo(listing)
+    assert info == {
+        "comap-0012345-2024-03-01-020304_Level2Cont.hd5": "TauA",
+        "comap-0012346-2024-03-02-100000_Level2Cont.hd5": "co2",
+    }
+    assert parse_obsinfo(listing, suffix="")[
+        "comap-0012345-2024-03-01-020304.hd5"] == "TauA"
+
+    # command-backed query, run locally (server=None -> no ssh wrapper)
+    script = tmp_path / "listing.txt"
+    script.write_text(listing)
+    info2 = query_obs_metadata(None, ["cat", str(script)])
+    assert info2 == info
+    # string command form word-splits the same way locally
+    assert query_obs_metadata(None, f"cat {script}") == info
+
+    # a dead archive host raises instead of silently returning {}
+    import subprocess
+    with pytest.raises(subprocess.CalledProcessError):
+        query_obs_metadata(None, ["false"])
+
+    # offline variant keyed off the obs database
+    db = ObsDatabase(str(tmp_path / "db.hd5"))
+    db.set_attr(777, "source", "TauA")
+    db.set_attr(777, "mjd", 60370.25)   # mean mjd (mid-obs)
+    db.set_attr(777, "mjd_start", 60370.0)  # 2024-03-01T00:00:00 UTC
+    db.set_attr(778, "source", "co2")
+    db.set_attr(778, "mjd", 60371.5)    # no mjd_start -> fallback
+    out = obsinfo_from_database(db)
+    assert out["comap-0000777-2024-03-01-000000_Level2Cont.hd5"] == "TauA"
+    assert out["comap-0000778-2024-03-02-120000_Level2Cont.hd5"] == "co2"
+    assert obsinfo_from_database(db, source="TauA") == {
+        "comap-0000777-2024-03-01-000000_Level2Cont.hd5": "TauA"}
