@@ -78,7 +78,9 @@ def query_obs_metadata(server: str | None, script_argv,
         script_argv = shlex.split(script_argv)
     argv = [str(a) for a in script_argv]
     if server is not None:
-        argv = ["ssh", server, "--", shlex.join(argv)]
+        # "--" BEFORE the destination (ssh's getopt does not permute;
+        # anything after the first non-option word is the remote command)
+        argv = ["ssh", "--", server, shlex.join(argv)]
     out = subprocess.run(argv, capture_output=True, text=True,
                          timeout=timeout, check=True)
     info = parse_obsinfo(out.stdout, suffix=suffix)
@@ -92,14 +94,17 @@ def obsinfo_from_database(db, suffix: str = "_Level2Cont",
     """``{filename: target}`` from a local obs database — the offline
     equivalent of the SSH query. The filename stamp encodes the
     observation *start* time (``mjd_start`` attr, as harvested by
-    ``ObsDatabase.update_from_level2``); records that predate that attr
-    fall back to the mean-``mjd`` attr."""
+    ``ObsDatabase.update_from_level2``); records without it are skipped
+    with a warning — a stamp fabricated from the mean MJD would yield
+    keys that never match real archive filenames."""
     out: dict[str, str] = {}
+    skipped = 0
     for obsid in db.obsids():
         target = db.get_attr(obsid, "source")
         mjd = db.get_attr(obsid, "mjd_start")
-        if mjd is None:
-            mjd = db.get_attr(obsid, "mjd")
+        if target is not None and mjd is None:
+            skipped += 1
+            continue
         if target is None or mjd is None:
             continue
         target = str(target)
@@ -112,4 +117,8 @@ def obsinfo_from_database(db, suffix: str = "_Level2Cont",
             tz=timezone.utc).strftime("%Y-%m-%d-%H%M%S")
         out[_FILENAME_FMT.format(obsid=int(obsid), stamp=stamp,
                                  suffix=suffix)] = target
+    if skipped:
+        logger.warning("obsinfo_from_database: %d records lack mjd_start "
+                       "(pre-upgrade harvest) — re-run update_from_level2 "
+                       "to include them", skipped)
     return out
