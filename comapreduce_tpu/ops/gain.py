@@ -76,12 +76,20 @@ def solve_gain(y: jax.Array, T2: jax.Array, p: jax.Array,
                time_mask: jax.Array | None = None):
     """Closed-form solve of ``(P^T Z P) g = P^T Z y``.
 
-    ``y``: f32[BC, t] normalised TOD (masked channels zeroed);
-    returns ``dg``: f32[t]. Exact solution of the reference's CG system
-    (diagonal A), at one matmul's cost.
+    ``y``: f32[BC, t] — or unflattened f32[B, C, t]; passing the natural
+    (B, C, t) block avoids a full-size layout-changing reshape copy (the
+    channel axes are contracted in place). Returns ``dg``: f32[t]. Exact
+    solution of the reference's CG system (diagonal A), at one matmul's
+    cost.
     """
     G_inv, zp, zpp = gain_projector(T2, p)
-    b = zp @ y  # (t,) == p^T Z y since Z is symmetric idempotent
+    if y.ndim > 2:
+        # p^T Z y contracting every leading (channel) axis in place
+        lead = list(range(y.ndim - 1))
+        b = jnp.einsum(zp.reshape(y.shape[:-1]), lead, y,
+                       lead + [y.ndim - 1], [y.ndim - 1])
+    else:
+        b = zp @ y  # (t,) == p^T Z y since Z is symmetric idempotent
     dg = b / jnp.maximum(zpp, 1e-20)
     if time_mask is not None:
         dg = dg * time_mask

@@ -91,8 +91,11 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
         nblocks = -(-P0 // stride)
         padded = jnp.pad(padded, [(0, 0)] * (x.ndim - 1)
                          + [(0, nblocks * stride - P0)], mode="edge")
+        # flatten batch x blocks into one big row axis for the sort: tiny
+        # trailing batch dims otherwise end up in the vector lanes
         bm = jnp.median(
-            padded.reshape(x.shape[:-1] + (nblocks, stride)), axis=-1)
+            padded.reshape((-1, stride)), axis=-1
+        ).reshape(x.shape[:-1] + (nblocks,))
         # recurse with stride=None so an explicitly oversized stride (e.g.
         # stride=2 at window=6000 -> block window 3000) re-splits instead
         # of running an exact rolling median far above MAX_EXACT_WINDOW;
@@ -120,7 +123,12 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
         seg = lax.dynamic_slice_in_dim(padded, ci * chunk, seg_len,
                                        axis=-1)
         mat = seg[..., win_idx]            # (..., chunk, window)
-        return med_fn(mat)                 # (..., chunk)
+        lead = mat.shape[:-1]
+        # flatten every leading dim: the radix/sort passes then tile as
+        # (rows, window) with both dims large — small batch dims (e.g.
+        # (scans, bands) under vmap) in the minor positions otherwise
+        # waste most of each 8x128 vector tile (profiled ~2x op time)
+        return med_fn(mat.reshape((-1, window))).reshape(lead)
 
     out = lax.map(body, jnp.arange(n_chunks))  # (n_chunks, ..., chunk)
     out = jnp.moveaxis(out, 0, -2)             # (..., n_chunks, chunk)

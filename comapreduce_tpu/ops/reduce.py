@@ -159,7 +159,10 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
     Parameters
     ----------
     tod:        f32[B, C, T] raw counts.
-    mask:       f32[B, C, T].
+    mask:       f32 validity mask, any shape broadcastable to [B, C, T]
+                (e.g. a plain time mask f32[T]); a pre-broadcast dense
+                mask forces an extra full-size gather + materialisation,
+                so pass the smallest true shape.
     airmass:    f32[T].
     starts, lengths: i32[S] scan geometry (host-derived, static count).
     tsys, sys_gain:  f32[B, C] from the vane calibration.
@@ -176,6 +179,11 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
     t_valid = (jnp.arange(L)[None, :] < lengths[:, None]).astype(tod.dtype)
 
     def per_scan(d_s, m_s, a_s, tv):
+        # masks arrive in their natural (possibly broadcast) shape; the
+        # lazy broadcast here fuses into consumers instead of
+        # materialising a (B, C, L) block. Padding samples are masked by
+        # tv here — the one place both call paths share.
+        m_s = jnp.broadcast_to(m_s, d_s.shape) * tv
         # NaN fill is per-scan independent; doing it here (not on the full
         # block) lets scan_batch streaming bound its memory too
         d_s = _fill_bad(d_s, m_s)
@@ -202,11 +210,12 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
         # -- gain fluctuation solve ---------------------------------------
         T2, p = gain_ops.build_templates(
             tsys, freq_scaled, cfg.mask_templates[None, :] * jnp.ones((B, 1)))
-        y = (filtered * m_s).reshape(B * C, L)
         if cfg.is_calibrator:
             dg = jnp.zeros((L,), tod.dtype)
         else:
-            dg = gain_ops.solve_gain(y, T2, p, time_mask=tv)
+            # natural (B, C, L) block: solve_gain contracts the channel
+            # axes in place (a (B*C, L) reshape costs a layout copy)
+            dg = gain_ops.solve_gain(filtered * m_s, T2, p, time_mask=tv)
         sub = (filtered - p.reshape(B, C)[..., None] * dg[None, None, :])
 
         # -- back to kelvin, band average ---------------------------------
@@ -247,18 +256,18 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
             # truth for the edge-replication clamping in both paths
             start, length, tv = args
             d_s = extract_scan_blocks(tod, start[None], L, length[None])[0]
-            m_s = extract_scan_blocks(mask, start[None], L)[0] * tv
+            m_s = extract_scan_blocks(mask, start[None], L)[0]
             a_s = extract_scan_blocks(airmass, start[None], L,
                                       length[None])[0]
-            return per_scan(d_s, m_s, a_s, tv)
+            return per_scan(d_s, m_s, a_s, tv)  # m_s broadcast/tv'd there
 
         tod_c, tod_o, wts, dgs, atm = jax.lax.map(
             per_scan_slice, (starts, lengths, t_valid),
             batch_size=cfg.scan_batch)
     else:
-        # (S, B, C, L) scan blocks in one gather each
+        # (S, ..., L) scan blocks in one gather each
         d = extract_scan_blocks(tod, starts, L, lengths)
-        m = extract_scan_blocks(mask, starts, L) * t_valid[:, None, None, :]
+        m = extract_scan_blocks(mask, starts, L)
         a = extract_scan_blocks(airmass, starts, L, lengths)  # (S, L)
         tod_c, tod_o, wts, dgs, atm = jax.vmap(per_scan)(d, m, a, t_valid)
 

@@ -171,3 +171,50 @@ def test_scan_batch_streaming_parity():
         for k in ("tod", "tod_original", "weights", "dg", "atmos_fits"):
             np.testing.assert_allclose(o[k], outs[0][k], rtol=2e-5,
                                        atol=1e-6, err_msg=k)
+
+
+def test_broadcast_mask_parity():
+    """A (T,) time mask == the same mask pre-broadcast to (B, C, T), in
+    both the vmap and scan_batch-streaming branches; and the gain solve's
+    in-place (B, C, t) contraction == the flattened (B*C, t) matvec."""
+    rng = np.random.default_rng(1)
+    B, C = 2, 32
+    edges = np.array([[40, 640], [700, 1240], [1300, 1750]])
+    starts, lengths, L = scan_starts_lengths(edges)
+    T = 1800
+    tod = (1e6 * 45 * (1 + 0.01 * rng.normal(size=(B, C, T)))
+           ).astype(np.float32)
+    tmask = np.zeros(T, np.float32)
+    for s, e in edges:
+        tmask[s:e] = 1.0
+    tmask[rng.choice(T, 31, replace=False)] = 0.0
+    airmass = (1.2 + 0.01 * rng.normal(size=T)).astype(np.float32)
+    tsys = (45 * (1 + 0.2 * rng.random((B, C)))).astype(np.float32)
+    gain = (1e6 * np.ones((B, C))).astype(np.float32)
+    freq = np.broadcast_to(np.linspace(-0.1, 0.1, C),
+                           (B, C)).astype(np.float32)
+    outs = []
+    for sb in (None, 2):
+        for m in (tmask, np.broadcast_to(tmask, (B, C, T)).copy()):
+            cfg = ReduceConfig(C, medfilt_window=301, scan_batch=sb)
+            r = reduce_feed_scans(
+                jnp.asarray(tod), jnp.asarray(m), jnp.asarray(airmass),
+                jnp.asarray(starts, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(tsys), jnp.asarray(gain), jnp.asarray(freq),
+                cfg=cfg, n_scans=len(starts), L=L)
+            outs.append({k: np.asarray(v) for k, v in r.items()})
+    for o in outs[1:]:
+        for k in ("tod", "tod_original", "weights", "dg", "atmos_fits"):
+            np.testing.assert_allclose(o[k], outs[0][k], rtol=2e-5,
+                                       atol=1e-6, err_msg=k)
+
+    # solve_gain: 3-D y (no reshape copy) == 2-D flattened y
+    from comapreduce_tpu.ops.gain import build_templates, solve_gain
+    T2, p = build_templates(jnp.asarray(tsys), jnp.asarray(freq),
+                            jnp.ones((B, C), jnp.float32))
+    y3 = jnp.asarray(rng.normal(size=(B, C, 400)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(solve_gain(y3, T2, p)),
+        np.asarray(solve_gain(y3.reshape(B * C, 400), T2, p)),
+        rtol=1e-5, atol=1e-6)
