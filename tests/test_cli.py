@@ -331,3 +331,26 @@ min_duration_seconds = 1.0
     assert out.returncode == 0, out.stderr[-2000:]
     produced = sorted(os.listdir(outdir))
     assert len(produced) == 2, produced
+
+
+def test_make_band_map_sharded_matches_single(field_dataset):
+    """CLI sharded=True (planned sharded destriper + compact-map
+    expansion) reproduces the single-process planned path."""
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli.run_destriper import make_band_map
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2), "run after test_run_average_cli"
+    wcs = WCS.from_field((170.0, 52.0), (1.0 / 30, 1.0 / 30), (240, 240))
+    _, single = make_band_map(l2, 0, wcs=wcs, offset_length=50, n_iter=60,
+                              threshold=1e-8)
+    _, sharded = make_band_map(l2, 0, wcs=wcs, offset_length=50, n_iter=60,
+                               threshold=1e-8, sharded=True)
+    a = np.asarray(single.destriped_map)
+    b = np.asarray(sharded.destriped_map)
+    scale = max(float(np.abs(a).max()), 1e-6)
+    np.testing.assert_allclose(b, a, atol=5e-3 * scale)
+    np.testing.assert_allclose(np.asarray(sharded.hit_map),
+                               np.asarray(single.hit_map))
