@@ -54,28 +54,63 @@ def _aslist(v):
 _PLAN_MEMO: dict = {}
 
 
+def _memoized(tag: str, pixels: np.ndarray, extra_key: tuple, build):
+    """Digest-keyed memo: the key hashes the pixel vector's content
+    (~10x cheaper than the plan build it avoids); one pointing is kept
+    in flight at a time."""
+    import hashlib
+
+    pixels = np.ascontiguousarray(pixels)
+    key = (tag, pixels.shape, str(pixels.dtype), extra_key,
+           hashlib.sha1(pixels.tobytes()).hexdigest())
+    hit = _PLAN_MEMO.get(key)
+    if hit is None:
+        hit = build(pixels)
+        _PLAN_MEMO.clear()
+        _PLAN_MEMO[key] = hit
+    return hit
+
+
 def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                     n_iter: int, threshold: float):
     import functools
-    import hashlib
 
     import jax
 
     from comapreduce_tpu.mapmaking.destriper import destripe_planned
     from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
 
-    pixels = np.ascontiguousarray(pixels)
-    key = (pixels.shape, str(pixels.dtype), int(npix), int(offset_length),
-           int(n_iter), float(threshold),
-           hashlib.sha1(pixels.tobytes()).hexdigest())
-    hit = _PLAN_MEMO.get(key)
-    if hit is None:
-        plan = build_pointing_plan(pixels, npix, offset_length)
-        fn = jax.jit(functools.partial(destripe_planned, plan=plan,
-                                       n_iter=n_iter, threshold=threshold))
-        _PLAN_MEMO.clear()   # one pointing in flight at a time
-        _PLAN_MEMO[key] = hit = fn
-    return hit
+    def build(pix):
+        plan = build_pointing_plan(pix, npix, offset_length)
+        return jax.jit(functools.partial(destripe_planned, plan=plan,
+                                         n_iter=n_iter,
+                                         threshold=threshold))
+
+    return _memoized("single", pixels,
+                     (int(npix), int(offset_length), int(n_iter),
+                      float(threshold)), build)
+
+
+def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
+                            offset_length: int, n_iter: int,
+                            threshold: float):
+    """Memoized sharded solver (plans + ONE compiled shard_map program
+    per pointing — bands share both)."""
+    from comapreduce_tpu.mapmaking.pointing_plan import build_sharded_plans
+    from comapreduce_tpu.parallel.sharded import (
+        make_destripe_sharded_planned)
+
+    n_shards = len(mesh.devices.ravel())
+
+    def build(pix):
+        plans = build_sharded_plans(pix, npix, offset_length, n_shards)
+        run = make_destripe_sharded_planned(mesh, plans, n_iter=n_iter,
+                                            threshold=threshold)
+        return run, np.asarray(plans[0].uniq_global)
+
+    return _memoized("sharded", pixels,
+                     (n_shards, int(npix), int(offset_length), int(n_iter),
+                      float(threshold)), build)
 
 
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
@@ -95,8 +130,7 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
     if sharded:
         import jax
 
-        from comapreduce_tpu.parallel.sharded import (
-            destripe_sharded, destripe_sharded_planned)
+        from comapreduce_tpu.parallel.sharded import destripe_sharded
         from jax.sharding import Mesh
 
         # LOCAL devices: multi-host destriping is data parallel over
@@ -111,28 +145,24 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
         else:
             import jax.numpy as jnp
 
-            from comapreduce_tpu.mapmaking.pointing_plan import (
-                build_sharded_plans)
-
             n_shards = len(mesh.devices.ravel())
             # pad on host: the pixel vector is consumed by the host plan
             # build only — routing it through pad_for_shards would cost a
             # full H2D+D2H round trip of several GB at production scale
+            pix_host = np.asarray(data.pixels)
             n_pad = (-data.tod.size) % (n_shards * offset_length)
-            pix_host = np.concatenate(
-                [np.asarray(data.pixels),
-                 np.full(n_pad, data.npix, np.asarray(data.pixels).dtype)])
-            tod = jnp.concatenate(
-                [jnp.asarray(data.tod), jnp.zeros(n_pad, jnp.float32)])
-            weights = jnp.concatenate(
-                [jnp.asarray(data.weights), jnp.zeros(n_pad, jnp.float32)])
-            plans = build_sharded_plans(pix_host, data.npix,
-                                        offset_length, n_shards)
-            result = destripe_sharded_planned(mesh, tod, weights, plans,
-                                              n_iter=n_iter,
-                                              threshold=threshold)
+            tod, weights = data.tod, data.weights
+            if n_pad:
+                pix_host = np.concatenate(
+                    [pix_host, np.full(n_pad, data.npix, pix_host.dtype)])
+                tod = jnp.concatenate(
+                    [jnp.asarray(tod), jnp.zeros(n_pad, jnp.float32)])
+                weights = jnp.concatenate(
+                    [jnp.asarray(weights), jnp.zeros(n_pad, jnp.float32)])
+            run, uniq = _sharded_planned_solver(
+                mesh, pix_host, data.npix, offset_length, n_iter, threshold)
+            result = run(tod, weights)
             # compact (hit-pixel) maps -> the band's full pixel space
-            uniq = np.asarray(plans[0].uniq_global)
 
             def expand(compact):
                 full = np.zeros(data.npix, np.float32)

@@ -32,7 +32,8 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 __all__ = ["reduce_feeds_sharded", "destripe_sharded",
-           "destripe_sharded_planned", "pad_for_shards"]
+           "destripe_sharded_planned", "make_destripe_sharded_planned",
+           "pad_for_shards"]
 
 
 @functools.lru_cache(maxsize=32)
@@ -160,21 +161,16 @@ _PLAN_KEYS = ("sample_perm", "sample_pair", "sample_base", "pair_rank",
               "uniq_pixels", "rank_to_global")
 
 
-def destripe_sharded_planned(mesh: Mesh, tod, weights,
-                             plans: list[PointingPlan],
-                             n_iter: int = 100, threshold: float = 1e-6
-                             ) -> DestriperResult:
-    """Scatter-free destriping with the flat time axis sharded over the
-    mesh and a SHARED compact pixel space.
+def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
+                                  n_iter: int = 100,
+                                  threshold: float = 1e-6):
+    """Build a reusable sharded planned-destriper: returns
+    ``run(tod, weights) -> DestriperResult``.
 
-    ``plans`` come from ``pointing_plan.build_sharded_plans`` (one per
-    device, identical static shapes, global rank space). ``tod``/``weights``
-    are the full f32[N] vectors in natural order; each shard receives its
-    contiguous slice plus its own index arrays as shard_map inputs. The
-    compact maps and CG scalars are ``psum``-reduced over the mesh; maps
-    come back COMPACT — (n_rank_global,) over ``plans[0].uniq_global`` —
-    so device memory is bounded by hit pixels, never npix (nside-4096
-    scale, SURVEY hard part 3).
+    The returned callable owns the uploaded per-shard index arrays and ONE
+    jitted shard_map program — callers solving several RHS against the
+    same pointing (e.g. the per-band loop of ``run_destriper``, whose
+    pixels are band-invariant) pay the plan upload and XLA compile once.
     """
     axes = tuple(mesh.axis_names)
     n_shards = int(np.prod([mesh.shape[a] for a in axes]))
@@ -200,7 +196,33 @@ def destripe_sharded_planned(mesh: Mesh, tod, weights,
         offsets=shard, ground=repl, destriped_map=repl, naive_map=repl,
         weight_map=repl, hit_map=repl, n_iter=repl, residual=repl)
     arr_specs = {k: shard for k in stacked}
-    fn = _shard_map(local, mesh=mesh, in_specs=(shard, shard, arr_specs),
-                    out_specs=out_specs, check_vma=False)
-    with mesh:
-        return jax.jit(fn)(jnp.asarray(tod), jnp.asarray(weights), stacked)
+    fn = jax.jit(_shard_map(local, mesh=mesh,
+                            in_specs=(shard, shard, arr_specs),
+                            out_specs=out_specs, check_vma=False))
+
+    def run(tod, weights) -> DestriperResult:
+        with mesh:
+            return fn(jnp.asarray(tod), jnp.asarray(weights), stacked)
+
+    return run
+
+
+def destripe_sharded_planned(mesh: Mesh, tod, weights,
+                             plans: list[PointingPlan],
+                             n_iter: int = 100, threshold: float = 1e-6
+                             ) -> DestriperResult:
+    """Scatter-free destriping with the flat time axis sharded over the
+    mesh and a SHARED compact pixel space.
+
+    ``plans`` come from ``pointing_plan.build_sharded_plans`` (one per
+    device, identical static shapes, global rank space). ``tod``/``weights``
+    are the full f32[N] vectors in natural order; each shard receives its
+    contiguous slice plus its own index arrays as shard_map inputs. The
+    compact maps and CG scalars are ``psum``-reduced over the mesh; maps
+    come back COMPACT — (n_rank_global,) over ``plans[0].uniq_global`` —
+    so device memory is bounded by hit pixels, never npix (nside-4096
+    scale, SURVEY hard part 3). One-shot wrapper over
+    :func:`make_destripe_sharded_planned`.
+    """
+    return make_destripe_sharded_planned(mesh, plans, n_iter=n_iter,
+                                         threshold=threshold)(tod, weights)
