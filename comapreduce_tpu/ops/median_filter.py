@@ -32,6 +32,11 @@ from comapreduce_tpu.ops.stats import (
 
 __all__ = ["rolling_median", "medfilt_highpass"]
 
+# windows at least this wide use the Pallas in-VMEM selection kernel on
+# TPU backends (ops/pallas_median.py); narrower windows keep the XLA
+# sort, whose mats are small enough not to matter
+_SELECT_MEDIAN_MIN_PALLAS = 65
+
 
 # Windows above this switch to the two-level block-median filter (see
 # rolling_median): block medians of ``stride = ceil(window/512)`` samples,
@@ -107,6 +112,18 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
         # sample i's window is padded[i : i+window]; its centre block
         j = jnp.clip((jnp.arange(T) + left) // stride, 0, nblocks - 1)
         return rm_b[..., j]
+
+    if window >= _SELECT_MEDIAN_MIN_PALLAS and x.dtype == jnp.float32:
+        from comapreduce_tpu.ops.pallas_median import (
+            pallas_supported, pallas_window_ok,
+            rolling_median_windows_pallas)
+        if pallas_supported() and pallas_window_ok(window):
+            # windowed selection entirely in VMEM (Mosaic kernel): no
+            # HBM window mats, no layout copies — bit-identical output
+            # (including NaN-in-window -> NaN)
+            return rolling_median_windows_pallas(
+                padded, window,
+                chunk=-(-max(chunk, 128) // 128) * 128)
 
     n_chunks = -(-T // chunk)
     total = n_chunks * chunk
