@@ -329,3 +329,130 @@ def destripe_np(tod, pixels, weights, npix: int, offset_length: int = 50,
     return {"offsets": x, "destriped_map": destriped, "naive_map": naive,
             "weight_map": sum_w, "hit_map": hits, "n_iter": k,
             "residual": float(np.sqrt(rz / max(b_norm, 1e-30)))}
+
+
+# -- noise statistics (f64 oracles for ops/power.py + ops/spikes.py) --------
+
+def spike_mask_np(tod, window: int = 501, threshold: float = 10.0,
+                  pad: int = 100, valid=None) -> np.ndarray:
+    """Spike mask of the averaged TOD, f64 (``Statistics.py:30-104``):
+    median-filter high-pass, flag ``|hp| > threshold * auto_rms(hp)``,
+    dilate each flag by ``+-pad`` samples. 1 = spike.
+
+    Same rms definition as the device ``ops.spikes.spike_mask``: masked
+    adjacent-pair rms of the HIGH-PASSED stream — a pair counts only when
+    both samples are valid, so invalid runs neither inflate the threshold
+    with boundary jumps nor deflate it with zero-difference pairs."""
+    from scipy.ndimage import maximum_filter1d
+
+    tod = np.asarray(tod, np.float64)
+    if valid is None:
+        valid = (tod != 0)
+    valid = np.asarray(valid) > 0
+    hp = tod - rolling_median_np(tod, window)
+    n2 = hp.shape[-1] // 2 * 2
+    d = hp[..., 1:n2:2] - hp[..., 0:n2:2]
+    pm = valid[..., 1:n2:2] & valid[..., 0:n2:2]
+    mu = _masked_mean(d, pm)[..., None]
+    var = _masked_mean((d - mu) ** 2, pm)
+    rms = (np.sqrt(np.maximum(var, 0.0)) / np.sqrt(2.0))[..., None]
+    hit = (np.abs(hp) > threshold * np.maximum(rms, 1e-30)) & valid
+    return maximum_filter1d(hit.astype(np.uint8), size=2 * pad + 1,
+                            axis=-1, mode="constant")
+
+
+def _psd_peak_mask_np(freqs, ps, auto_rms2, threshold=100.0, min_freq=0.5):
+    """Reference-faithful spike masking of a PSD row: iterative
+    ``find_peaks``/``peak_widths`` above ``threshold * auto_rms^2``
+    (``Level2Data.py:288-298``), f64. Returns 1 = keep."""
+    from scipy.signal import find_peaks, peak_widths
+
+    keep = np.ones(ps.shape, bool)
+    flat = ps.reshape(-1, ps.shape[-1])
+    kflat = keep.reshape(flat.shape)
+    a2 = np.asarray(auto_rms2, np.float64).reshape(-1)
+    for r in range(flat.shape[0]):
+        row = flat[r].copy()
+        for _ in range(10):  # the reference iterates until clean
+            pk, _ = find_peaks(row, height=threshold * a2[r])
+            pk = pk[freqs[pk] > min_freq]
+            if pk.size == 0:
+                break
+            widths = peak_widths(row, pk, rel_height=0.85)[0]
+            for p, w in zip(pk, widths):
+                lo = max(int(p - w), 0)
+                hi = min(int(p + w) + 1, row.size)
+                kflat[r, lo:hi] = False
+                row[lo:hi] = 0.0
+    return keep
+
+
+def fit_observation_noise_np(blocks, sample_rate: float = 50.0,
+                             nbins: int = 30, model_name: str = "red_noise",
+                             mask_peaks: bool = True) -> np.ndarray:
+    """Whole-observation noise fits in f64: PSD -> peak mask -> log bin
+    -> L-BFGS-B on the log-chi^2 (the reference's actual minimiser,
+    ``PowerSpectra.py:137-159``). Same outputs as
+    ``ops.power.fit_observation_noise``: f64[..., 3]."""
+    from scipy.optimize import minimize
+
+    blocks = np.asarray(blocks, np.float64)
+    n = blocks.shape[-1]
+    ps = np.abs(np.fft.rfft(blocks, axis=-1)) ** 2 / n
+    freqs = np.fft.rfftfreq(n, d=1.0 / sample_rate)
+    if mask_peaks:
+        d = np.diff(blocks, axis=-1)
+        auto_rms2 = d.var(axis=-1) / 2.0
+        smask = _psd_peak_mask_np(freqs, ps, auto_rms2)
+    else:
+        smask = np.ones(ps.shape, bool)
+    # log-spaced bins, identical layout to ops.power.log_bin_psd
+    edges = np.logspace(np.log10(freqs[1]), np.log10(freqs[-1]), nbins + 1)
+    ids = np.clip(np.searchsorted(edges, freqs, side="right") - 1,
+                  0, nbins - 1)
+    valid = freqs >= freqs[1]
+    fsum = np.bincount(ids, weights=freqs * valid, minlength=nbins)
+    vcnt = np.bincount(ids, weights=valid.astype(float), minlength=nbins)
+    nu = fsum / np.maximum(vcnt, 1.0)
+
+    flat = ps.reshape(-1, ps.shape[-1])
+    mflat = (smask.reshape(flat.shape) & valid)
+    out = np.zeros((flat.shape[0], 3))
+    for r in range(flat.shape[0]):
+        w = mflat[r].astype(float)
+        cnt = np.bincount(ids, weights=w, minlength=nbins)
+        pb = np.bincount(ids, weights=flat[r] * w, minlength=nbins) \
+            / np.maximum(cnt, 1.0)
+        good = (cnt > 0) & (pb > 0) & (nu > 0)
+        hi = nu > 0.5 * nu.max()
+        sig2 = max(pb[good & hi].mean() if (good & hi).any() else 0.0,
+                   1e-20)
+        p_low = max(pb[1], sig2 * 1.01)
+        nu_low = max(nu[1], 1e-3)
+        alpha0 = -1.5
+        if model_name == "red_noise":
+            p1 = max((p_low - sig2) * nu_low ** (-alpha0), sig2 * 1e-3)
+
+            def model(p, x):
+                return p[0] + p[1] * np.abs(x) ** p[2]
+        else:
+            excess = max(p_low / sig2 - 1.0, 1e-3)
+            p1 = np.clip(nu_low * excess ** (-1.0 / alpha0),
+                         nu_low, 0.5 * sample_rate)
+
+            def model(p, x):
+                return p[0] * (1.0 + np.abs(x / p[1]) ** p[2])
+        wgt = np.sqrt(np.maximum(cnt, 0.0)) * good
+
+        def loss(q):
+            p = (np.exp(q[0]), np.exp(q[1]), q[2])
+            m = model(p, np.maximum(nu, 1e-6))
+            resid = (np.where(good, np.log(np.maximum(pb, 1e-30)), 0.0)
+                     - np.log(np.maximum(m, 1e-30))) * wgt
+            return float(np.sum(resid * resid))
+
+        res = minimize(loss, [np.log(sig2), np.log(p1), alpha0],
+                       method="L-BFGS-B",
+                       bounds=[(-60, 60), (-60, 60), (-5.0, 0.0)])
+        out[r] = [np.exp(res.x[0]), np.exp(res.x[1]), res.x[2]]
+    return out.reshape(blocks.shape[:-1] + (3,))

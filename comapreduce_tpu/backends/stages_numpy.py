@@ -20,7 +20,9 @@ from comapreduce_tpu.pipeline.registry import register
 from comapreduce_tpu.pipeline.stages import _StageBase, mean_vane_tsys_gain
 
 __all__ = ["MeasureSystemTemperatureNumpy",
-           "Level1AveragingGainCorrectionNumpy"]
+           "Level1AveragingGainCorrectionNumpy",
+           "SpikesNumpy", "Level2FitPowerSpectrumNumpy",
+           "NoiseStatisticsNumpy"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -114,3 +116,106 @@ class Level1AveragingGainCorrectionNumpy(_StageBase):
         }
         self.STATE = True
         return True
+
+
+@register("Spikes", backend="numpy")
+@dataclass
+class SpikesNumpy(_StageBase):
+    """Spike flagging on host in f64 (oracle for the device stage;
+    ``Statistics.py:30-104``)."""
+
+    groups: tuple = ("spikes",)
+    window: int = 501
+    threshold: float = 10.0
+    pad: int = 100
+
+    def __call__(self, data, level2) -> bool:
+        tod = np.asarray(level2.tod, np.float64)
+        T = tod.shape[-1]
+        mask = numpy_ops.spike_mask_np(
+            tod, window=min(self.window, max(3, T // 2 * 2 - 1)),
+            threshold=self.threshold, pad=self.pad)
+        self._data = {"spikes/spike_mask": mask.astype(np.uint8)}
+        self.STATE = True
+        return True
+
+
+@register("Level2FitPowerSpectrum", backend="numpy")
+@dataclass
+class Level2FitPowerSpectrumNumpy(_StageBase):
+    """Per-(feed, band, scan) noise fits on host in f64, using the
+    reference's own machinery — iterative scipy ``find_peaks`` masking
+    (``Level2Data.py:288-298``) and L-BFGS-B on the log-chi^2
+    (``PowerSpectra.py:137-159``). Oracle for the device stage."""
+
+    groups: tuple = ("fnoise_fits",)
+    nbins: int = 30
+    sample_rate: float = 50.0
+    model_name: str = "red_noise"
+    out_group: str = "fnoise_fits"
+    mask_peaks: bool = True
+    figure_dir: str = ""   # same knob as the device stage: a config
+    #                        section must survive a backend switch
+
+    def __call__(self, data, level2) -> bool:
+        tod = np.asarray(level2.tod, np.float64)
+        edges = np.asarray(level2.scan_edges)
+        if len(edges) == 0:
+            self.STATE = False
+            return False
+        Lmin = int((edges[:, 1] - edges[:, 0]).min()) // 2 * 2
+        if Lmin < 16:
+            self.STATE = False
+            return False
+        blocks = np.stack([tod[..., s:s + Lmin] for s, _ in edges], axis=2)
+        params = numpy_ops.fit_observation_noise_np(
+            blocks, sample_rate=self.sample_rate, nbins=self.nbins,
+            model_name=self.model_name, mask_peaks=self.mask_peaks)
+        rms = numpy_ops._auto_rms(blocks)
+        if self.figure_dir:
+            self._plot_first_fit(blocks[0, 0, 0], params[0, 0, 0],
+                                 data.obsid)
+        self._data = {
+            f"{self.out_group}/fnoise_fit_parameters":
+                params.astype(np.float32),
+            f"{self.out_group}/auto_rms": rms.astype(np.float32),
+        }
+        self.STATE = True
+        return True
+
+    def _plot_first_fit(self, block, params, obsid) -> None:
+        """Same QA figure as the device stage (feed 0, band 0, scan 0)."""
+        from comapreduce_tpu import diagnostics
+
+        n = block.size
+        ps = np.abs(np.fft.rfft(block)) ** 2 / n
+        freqs = np.fft.rfftfreq(n, d=1.0 / self.sample_rate)
+        e = np.logspace(np.log10(freqs[1]), np.log10(freqs[-1]),
+                        self.nbins + 1)
+        ids = np.clip(np.searchsorted(e, freqs, side="right") - 1,
+                      0, self.nbins - 1)
+        v = (freqs >= freqs[1]).astype(float)
+        cnt = np.maximum(np.bincount(ids, weights=v,
+                                     minlength=self.nbins), 1.0)
+        nu = np.bincount(ids, weights=freqs * v,
+                         minlength=self.nbins) / cnt
+        pb = np.bincount(ids, weights=ps * v, minlength=self.nbins) / cnt
+        if self.model_name == "red_noise":
+            model = lambda p, x: p[0] + p[1] * np.abs(x) ** p[2]  # noqa: E731
+        else:
+            model = lambda p, x: p[0] * (1 + np.abs(x / p[1]) ** p[2])  # noqa: E731
+        diagnostics.plot_power_spectrum_fit(
+            diagnostics.figure_path(
+                self.figure_dir, obsid,
+                f"{self.out_group}_feed00_band00_scan00"),
+            nu, pb, params, model)
+
+
+@register("NoiseStatistics", backend="numpy")
+@dataclass
+class NoiseStatisticsNumpy(Level2FitPowerSpectrumNumpy):
+    """Knee-model variant (``Statistics.py:106-224``)."""
+
+    groups: tuple = ("noise_statistics",)
+    model_name: str = "knee"
+    out_group: str = "noise_statistics"

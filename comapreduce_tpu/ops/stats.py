@@ -147,8 +147,9 @@ def masked_median(x: jax.Array, mask: jax.Array | None = None, axis: int = -1):
     m = jnp.broadcast_to(mask.astype(bool), x.shape) if mask.ndim != x.ndim else (
         jnp.moveaxis(mask, axis, -1) > 0
     )
-    if x.dtype != jnp.float32:
-        # non-f32: keep the sort-based definition (no u32 key truncation)
+    if x.dtype != jnp.float32 or x.shape[-1] < SELECT_MEDIAN_MIN_WINDOW:
+        # non-f32 (no u32 key truncation) and narrow rows (sort wins
+        # below the measured crossover): sort-based definition
         big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
         xs = jnp.sort(jnp.where(m, x, big), axis=-1)
         cnt = jnp.sum(m, axis=-1)

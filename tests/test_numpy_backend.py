@@ -34,7 +34,7 @@ def test_registry_backend_dispatch():
     resolve("CheckLevel1File", backend="numpy")
     # device-only stages raise instead of silently falling back
     with pytest.raises(KeyError):
-        resolve("Spikes", backend="numpy")
+        resolve("SkyDip", backend="numpy")
     with pytest.raises(ValueError):
         resolve("Spikes", backend="cuda")
 
@@ -131,3 +131,78 @@ def test_toml_backend_switch():
     config["MeasureSystemTemperature"] = {"backend": "tpu"}
     runner = Runner.from_config(config)
     assert isinstance(runner.processes[1], MeasureSystemTemperature)
+
+
+def test_noise_stage_backend_parity(obs, tmp_path):
+    """Spikes + Level2FitPowerSpectrum: numpy (scipy find_peaks +
+    L-BFGS-B, f64) vs device (masked top-k + LM, f32) on the same
+    Level-2 data."""
+    path, p, _ = obs
+    data = COMAPLevel1()
+    data.read(path)
+    lvl2 = COMAPLevel2(filename=str(tmp_path / "l2_noise.hd5"))
+    for name in ("MeasureSystemTemperature", "Level1AveragingGainCorrection"):
+        stage = resolve(name, backend="numpy", **(
+            {"medfilt_window": 301}
+            if name == "Level1AveragingGainCorrection" else {}))
+        assert stage(data, lvl2)
+        lvl2.update(stage)
+
+    outs = {}
+    for backend in ("tpu", "numpy"):
+        spikes = resolve("Spikes", backend=backend, window=101)
+        fits = resolve("Level2FitPowerSpectrum", backend=backend, nbins=12)
+        for stage in (spikes, fits):
+            assert stage(data, lvl2)
+            lvl2.update(stage)
+        outs[backend] = {
+            "mask": np.asarray(lvl2["spikes/spike_mask"]),
+            "params": np.asarray(
+                lvl2["fnoise_fits/fnoise_fit_parameters"], np.float64),
+            "rms": np.asarray(lvl2["fnoise_fits/auto_rms"], np.float64),
+        }
+    t, n = outs["tpu"], outs["numpy"]
+    # spike masks: same flags up to boundary effects of the two filters
+    assert (t["mask"] != n["mask"]).mean() < 0.02
+    np.testing.assert_allclose(t["rms"], n["rms"], rtol=1e-3)
+    # the raw parameters sit in a degenerate valley (sigma_w^2 trades
+    # against sigma_r^2 |nu|^alpha on short scans), so the meaningful
+    # parity object is the fitted PSD CURVE, not the parameter vector
+    nu = np.array([1.0, 3.0, 8.0, 20.0])
+
+    def curve(p):
+        return (p[..., 0:1] + p[..., 1:2]
+                * np.abs(nu) ** p[..., 2:3])
+
+    ct, cn = curve(t["params"]), curve(n["params"])
+    np.testing.assert_allclose(ct, cn, rtol=0.35)
+    assert (n["params"][..., 2] <= 0).all()
+    assert np.isfinite(n["params"]).all()
+
+
+def test_spike_mask_np_masked_rms():
+    """The oracle's threshold rms is the masked pair-rms of the
+    high-passed stream: an invalid run must neither inflate it (baseline
+    -vs-zero boundary pairs) nor flag, and a genuine spike still flags."""
+    from comapreduce_tpu.backends.numpy_ops import spike_mask_np
+
+    rng = np.random.default_rng(0)
+    T = 4000
+    tod = 40.0 + 0.01 * rng.normal(size=(1, 1, T))
+    tod[0, 0, 500] += 0.5            # 50-sigma spike
+    valid = np.ones((1, 1, T), bool)
+    valid[0, 0, 1001:1101] = False   # odd-aligned invalid run
+    tod[0, 0, 1001:1101] = 0.0
+    mask = spike_mask_np(tod, window=101, pad=5, valid=valid)
+    assert mask[0, 0, 500] == 1                  # spike flagged
+    assert mask[0, 0, 1040:1060].max() == 0      # invalid never flags
+    assert mask.mean() < 0.02                    # threshold not deflated
+
+
+def test_figure_dir_survives_backend_switch(tmp_path):
+    """A [Level2FitPowerSpectrum] section with figure_dir must construct
+    under BOTH backends (per-stage backend switch on identical configs)."""
+    for backend in ("tpu", "numpy"):
+        s = resolve("Level2FitPowerSpectrum", backend=backend,
+                    figure_dir=str(tmp_path))
+        assert s.figure_dir == str(tmp_path)

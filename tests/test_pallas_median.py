@@ -75,3 +75,23 @@ def test_nan_propagates():
     clean = ~np.isnan(got)
     want = _oracle(x, w)  # numpy oracle propagates NaN the same way
     np.testing.assert_array_equal(got[clean], np.asarray(want)[clean])
+
+
+def test_dispatch_gate_cpu():
+    """On the CPU backend rolling_median never dispatches the Mosaic
+    kernel (pallas_supported gates it), and the gate helpers agree with
+    the kernel's own guard."""
+    import jax
+
+    from comapreduce_tpu.ops.pallas_median import (pallas_supported,
+                                                   pallas_window_ok)
+    assert jax.default_backend() == "cpu"
+    assert not pallas_supported()
+    assert pallas_window_ok(6000 // 12 + 1)   # production block window
+    assert pallas_window_ok(MAX_PALLAS_WINDOW)
+    assert not pallas_window_ok(MAX_PALLAS_WINDOW + 129)
+    # and the XLA path still runs fine for a pallas-eligible window
+    from comapreduce_tpu.ops.median_filter import rolling_median
+    x = jnp.asarray(np.arange(600, dtype=np.float32)[None, :])
+    out = np.asarray(rolling_median(x, 129, stride=1))
+    assert out.shape == (1, 600) and np.isfinite(out).all()
