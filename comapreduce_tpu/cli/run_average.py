@@ -23,12 +23,7 @@ from __future__ import annotations
 import sys
 
 from comapreduce_tpu.pipeline import Runner, load_toml, set_logging
-
-
-def _read_filelist(path: str) -> list[str]:
-    with open(path) as f:
-        return [ln.strip() for ln in f
-                if ln.strip() and not ln.startswith("#")]
+from comapreduce_tpu.pipeline.config import read_filelist as _read_filelist
 
 
 def _rank_info():

@@ -221,9 +221,9 @@ def main(argv=None) -> int:
     ini = IniConfig(argv[0])
     inputs = ini.get("Inputs", {})
     pixel = ini.get("Pixelization", {})
-    with open(inputs["filelist"]) as f:
-        filelist = [ln.strip() for ln in f
-                    if ln.strip() and not ln.startswith("#")]
+    from comapreduce_tpu.pipeline.config import read_filelist
+
+    filelist = read_filelist(inputs["filelist"])
     # multi-process launch: initialise the distributed runtime and take
     # this process's round-robin filelist shard (same split as the
     # Runner; the reference instead slices contiguous blocks,

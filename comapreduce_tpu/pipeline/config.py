@@ -14,7 +14,17 @@ from __future__ import annotations
 import re
 import tomllib
 
-__all__ = ["load_toml", "IniConfig", "parse_stage_name", "coerce"]
+__all__ = ["load_toml", "IniConfig", "parse_stage_name", "coerce",
+           "read_filelist"]
+
+
+def read_filelist(path: str) -> list[str]:
+    """Paths from a filelist text file: one per line, blank lines and
+    ``#`` comments (leading whitespace allowed) skipped. The single
+    shared parser for every filelist consumer."""
+    with open(path) as f:
+        return [ln.strip() for ln in f
+                if ln.strip() and not ln.strip().startswith("#")]
 
 _STAGE_NAME_RE = re.compile(
     r"^(?:(?P<module>[A-Za-z_]\w*)\.)?(?P<cls>[A-Za-z_]\w*)"

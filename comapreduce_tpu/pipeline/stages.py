@@ -592,3 +592,65 @@ class WriteLevel2Data(_StageBase):
         level2.write(path)
         self.STATE = True
         return True
+
+
+@register(backend="any")
+@dataclass
+class Level2Timelines(_StageBase):
+    """Fleet timelines product from a Level-2 filelist (parity:
+    ``Level2Timelines``, ``Level2Data.py:142-223``, which equally takes
+    its own filelist kwarg inside the per-file protocol).
+
+    Builds the Tsys/gain/noise timelines over ``filelist`` and writes the
+    ``gains.hd5``-style product to ``output_path`` ONCE per runner pass
+    (the reference recomputes per target file; rebuilding an identical
+    fleet product for every file is pure waste). ``filelist`` empty means
+    "the runner's own Level-2 output": the current file's path is
+    accumulated and the product (re)written after each file, so the
+    timelines stay complete however many files the run covers.
+    """
+
+    overwrite: bool = True
+    filelist: str = ""
+    output_path: str = "gains.hd5"
+
+    def _out_path(self) -> str:
+        """Per-rank output under a multi-process launch: ranks own
+        disjoint filelist shards, so sharing one path would leave a
+        last-writer-wins partial product (and risk concurrent-write
+        corruption). Single-process runs keep the plain name."""
+        from comapreduce_tpu.parallel.multihost import rank_info
+
+        rank, n_ranks = rank_info()
+        if n_ranks <= 1:
+            return self.output_path
+        base, ext = os.path.splitext(self.output_path)
+        return f"{base}_rank{rank}{ext}"
+
+    def __call__(self, data, level2) -> bool:
+        from comapreduce_tpu.summary import (assemble_timelines,
+                                             timeline_row, write_gains)
+
+        if self.filelist:
+            if getattr(self, "_done", False):
+                self.STATE = True
+                return True
+            from comapreduce_tpu.pipeline.config import read_filelist
+
+            rows = [r for r in map(timeline_row,
+                                   read_filelist(self.filelist))
+                    if r is not None]
+        else:
+            # the runner's own output: the runner has already checkpointed
+            # this file's store (atomic write after every stage), so only
+            # the NEW file needs reading; earlier rows are cached
+            cache = getattr(self, "_rows", {})
+            if level2.filename not in cache:
+                cache[level2.filename] = timeline_row(level2.filename)
+            self._rows = cache
+            rows = [r for r in cache.values() if r is not None]
+        write_gains(self._out_path(), assemble_timelines(rows))
+        if self.filelist:
+            self._done = True   # only after a successful write
+        self.STATE = True
+        return True

@@ -20,9 +20,33 @@ from comapreduce_tpu.data.hdf5io import HDF5Store
 from comapreduce_tpu.data.level import COMAPLevel2
 from comapreduce_tpu.database.obsdb import robust_smooth
 
-__all__ = ["level2_timelines", "write_gains", "read_gains"]
+__all__ = ["level2_timelines", "timeline_row", "assemble_timelines",
+           "write_gains", "read_gains"]
 
 logger = logging.getLogger("comapreduce_tpu")
+
+
+def timeline_row(fname):
+    """One observation's timeline row ``(mjd, obsid, tsys, gain, rms)``
+    from a Level-2 file, or ``None`` on a bad/unreadable file — the
+    incremental unit of :func:`level2_timelines` (cache these to avoid
+    re-reading the whole fleet per update)."""
+    try:
+        lvl2 = COMAPLevel2(filename=fname)
+        mjd = float(np.mean(np.asarray(lvl2.mjd)))
+        tsys = gain = rms = None
+        if "vane/system_temperature" in lvl2:
+            t = np.asarray(lvl2.system_temperature)  # (E, F, B, C)
+            g = np.asarray(lvl2.system_gain)
+            tsys = np.nanmedian(np.where(t > 0, t, np.nan), axis=(0, 3))
+            gain = np.nanmedian(np.where(g > 0, g, np.nan), axis=(0, 3))
+        if "fnoise_fits/auto_rms" in lvl2:
+            rms = np.nanmedian(
+                np.asarray(lvl2["fnoise_fits/auto_rms"]), axis=-1)
+        return (mjd, lvl2.obsid, tsys, gain, rms)
+    except (OSError, KeyError) as exc:
+        logger.warning("level2_timelines: BAD FILE %s (%s)", fname, exc)
+        return None
 
 
 def level2_timelines(filenames) -> dict:
@@ -33,26 +57,16 @@ def level2_timelines(filenames) -> dict:
     (``Level2Timelines``, ``Level2Data.py:142-223``). Files missing a
     product contribute NaN rows.
     """
-    rows = []
-    for fname in filenames:
-        try:
-            lvl2 = COMAPLevel2(filename=fname)
-            mjd = float(np.mean(np.asarray(lvl2.mjd)))
-            tsys = gain = rms = None
-            if "vane/system_temperature" in lvl2:
-                t = np.asarray(lvl2.system_temperature)  # (E, F, B, C)
-                g = np.asarray(lvl2.system_gain)
-                tsys = np.nanmedian(np.where(t > 0, t, np.nan), axis=(0, 3))
-                gain = np.nanmedian(np.where(g > 0, g, np.nan), axis=(0, 3))
-            if "fnoise_fits/auto_rms" in lvl2:
-                rms = np.nanmedian(
-                    np.asarray(lvl2["fnoise_fits/auto_rms"]), axis=-1)
-            rows.append((mjd, lvl2.obsid, tsys, gain, rms))
-        except (OSError, KeyError) as exc:
-            logger.warning("level2_timelines: BAD FILE %s (%s)", fname, exc)
+    rows = [r for r in (timeline_row(f) for f in filenames)
+            if r is not None]
+    return assemble_timelines(rows)
+
+
+def assemble_timelines(rows) -> dict:
+    """Stack :func:`timeline_row` tuples into the timelines dict."""
     if not rows:
         return {"mjd": np.zeros(0), "obsid": np.zeros(0, np.int64)}
-    rows.sort(key=lambda r: r[0])
+    rows = sorted(rows, key=lambda r: r[0])
     # (F, B) from any product in any file — tsys may be absent everywhere
     # while auto_rms is present
     shapes = [r[i].shape for r in rows for i in (2, 3, 4)

@@ -125,3 +125,33 @@ def test_instrument_constants(tmp_path):
         f.write("1 4.5\n2 4.8\n")
     widths = load_beam_widths(bwp)
     assert widths == pytest.approx([0.075, 0.08])
+
+
+def test_level2_timelines_stage(tmp_path):
+    """Level2Timelines is a registered stage (config parity with the
+    reference's process list) and writes the gains product."""
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.pipeline import Runner, resolve
+    from comapreduce_tpu.summary import read_gains
+
+    files = []
+    for i in range(2):
+        p = SyntheticObsParams(obsid=6_100_000 + i, n_feeds=1, n_bands=2,
+                               n_channels=16, n_scans=2, scan_samples=500,
+                               vane_samples=200, seed=70 + i,
+                               mjd_start=59600.0 + 5 * i)
+        path = str(tmp_path / f"obs{i}.hd5")
+        generate_level1_file(path, p)
+        files.append(path)
+    gains_path = str(tmp_path / "gains.hd5")
+    chain = [resolve("AssignLevel1Data"),
+             resolve("MeasureSystemTemperature"),
+             resolve("Level1AveragingGainCorrection", medfilt_window=201),
+             resolve("Level2Timelines", output_path=gains_path)]
+    runner = Runner(processes=chain, output_dir=str(tmp_path / "l2"))
+    runner.run_tod(files)
+    out = read_gains(gains_path)
+    assert len(out["mjd"]) == 2
+    assert np.all(np.diff(out["mjd"]) > 0)
+    assert np.isfinite(out["tsys"]).any()
