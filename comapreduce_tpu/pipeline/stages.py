@@ -20,6 +20,7 @@ Registered stages (name -> reference counterpart):
 - ``Level2FitPowerSpectrum`` / ``NoiseStatistics``
                            — ``Level2Data.py:246-329`` / ``Statistics.py:106-224``
 - ``WriteLevel2Data``      — ``Level2Data.py:113-139``
+- ``Level2Timelines``      — ``Level2Data.py:142-223``
 """
 
 from __future__ import annotations
@@ -46,7 +47,7 @@ __all__ = ["CheckLevel1File", "AssignLevel1Data", "UseLevel2Pointing",
            "MeasureSystemTemperature", "SkyDip", "AtmosphereRemoval",
            "Level1AveragingGainCorrection", "Spikes",
            "Level2FitPowerSpectrum", "NoiseStatistics", "WriteLevel2Data",
-           "mean_vane_tsys_gain"]
+           "Level2Timelines", "mean_vane_tsys_gain"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -615,10 +616,11 @@ class Level2Timelines(_StageBase):
     output_path: str = "gains.hd5"
 
     def _out_path(self) -> str:
-        """Per-rank output under a multi-process launch: ranks own
+        """Accumulate mode under a multi-process launch: ranks own
         disjoint filelist shards, so sharing one path would leave a
         last-writer-wins partial product (and risk concurrent-write
-        corruption). Single-process runs keep the plain name."""
+        corruption) — each rank writes a ``_rank{r}`` suffix. Single
+        -process runs keep the plain name."""
         from comapreduce_tpu.parallel.multihost import rank_info
 
         rank, n_ranks = rank_info()
@@ -632,7 +634,13 @@ class Level2Timelines(_StageBase):
                                              timeline_row, write_gains)
 
         if self.filelist:
-            if getattr(self, "_done", False):
+            # explicit filelist = the FULL fleet: every rank would build
+            # an identical product, so rank 0 alone writes the plain
+            # output_path and the others no-op
+            from comapreduce_tpu.parallel.multihost import rank_info
+
+            rank, _ = rank_info()
+            if rank != 0 or getattr(self, "_done", False):
                 self.STATE = True
                 return True
             from comapreduce_tpu.pipeline.config import read_filelist
@@ -640,6 +648,10 @@ class Level2Timelines(_StageBase):
             rows = [r for r in map(timeline_row,
                                    read_filelist(self.filelist))
                     if r is not None]
+            write_gains(self.output_path, assemble_timelines(rows))
+            self._done = True   # only after a successful write
+            self.STATE = True
+            return True
         else:
             # the runner's own output: the runner has already checkpointed
             # this file's store (atomic write after every stage), so only
@@ -650,7 +662,5 @@ class Level2Timelines(_StageBase):
             self._rows = cache
             rows = [r for r in cache.values() if r is not None]
         write_gains(self._out_path(), assemble_timelines(rows))
-        if self.filelist:
-            self._done = True   # only after a successful write
         self.STATE = True
         return True

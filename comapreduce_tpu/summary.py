@@ -101,7 +101,9 @@ def write_gains(path: str, timelines: dict) -> None:
     store = HDF5Store(name="gains")
     for k, v in timelines.items():
         store[f"gains/{k}"] = np.asarray(v)
-    store.write(path)
+    # atomic: the Level2Timelines stage rewrites this product after every
+    # processed file — a kill mid-write must not truncate it
+    store.write(path, atomic=True)
 
 
 def read_gains(path: str, smooth_window_days: float = 30.0) -> dict:

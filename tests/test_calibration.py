@@ -234,3 +234,38 @@ def test_fit_source_end_to_end(tmp_path):
     assert applied.contains_groups(["astro_calibration"])
     f = np.asarray(applied["astro_calibration/calibration_factors"])
     assert f.shape == amp.shape
+
+
+def test_bootstrap_errors_match_analytic():
+    """Bootstrap parameter scatter ~ the analytic inv(J^T J) errors on a
+    well-conditioned synthetic source (Gauss2dRot_General bootstrap
+    option, Tools/Fitting.py:471-531)."""
+    import jax
+
+    from comapreduce_tpu.calibration.fitting import (bootstrap_fit_gauss2d,
+                                                     fit_gauss2d,
+                                                     gauss2d_rot,
+                                                     initial_guess)
+
+    rng = np.random.default_rng(8)
+    n = 48
+    g = np.linspace(-0.5, 0.5, n)
+    xx, yy = np.meshgrid(g, g)
+    x = jnp.asarray(xx.ravel(), jnp.float32)
+    y = jnp.asarray(yy.ravel(), jnp.float32)
+    truth = jnp.asarray([5.0, 0.05, 0.08, -0.03, 0.06, 0.2, 0.4])
+    img = (np.asarray(gauss2d_rot(truth, x, y))
+           + 0.05 * rng.normal(size=n * n)).astype(np.float32)
+    w = np.full(n * n, 1.0 / 0.05**2, np.float32)
+    img_j, w_j = jnp.asarray(img), jnp.asarray(w)
+    p0 = initial_guess(img_j, x, y, w_j)
+    p, err, _ = fit_gauss2d(img_j, x, y, w_j, p0)
+    pb, berr = bootstrap_fit_gauss2d(jax.random.key(0), img_j, x, y, w_j,
+                                     p0, n_boot=48)
+    np.testing.assert_allclose(np.asarray(pb), np.asarray(p), rtol=1e-4,
+                               atol=1e-5)
+    # amplitude + position errors agree with the analytic covariance
+    # within bootstrap noise
+    a, b = np.asarray(err), np.asarray(berr)
+    for i in (0, 1, 3):
+        assert 0.4 * a[i] < b[i] < 2.5 * a[i], (i, a[i], b[i])
