@@ -15,10 +15,11 @@ over its bandwidth bound). This kernel keeps the whole selection on-chip:
    semantics, mapped to signed i32 keys because Mosaic lacks unsigned
    reductions) entirely in VMEM, plus two passes for the upper median.
 
-Exact: bit-identical to ``sort -> middle`` selection for finite inputs
-(NaNs do NOT propagate — callers fill/clean first, as the reduction's
-``_fill_bad`` does). Handles any window; VMEM bounds the padded window at
-``MAX_PALLAS_WINDOW``.
+Exact: bit-identical to ``sort -> middle`` selection, with full
+``jnp.median`` NaN semantics — any NaN inside a window yields NaN (the
+wrapper counts windowed NaNs by cumsum difference and overwrites those
+outputs; the kernel itself only orders finite keys). Handles any window;
+VMEM bounds the padded window at ``MAX_PALLAS_WINDOW``.
 """
 
 from __future__ import annotations
@@ -64,27 +65,24 @@ def _kernel(x_hbm, o_ref, seg_ref, mat_ref, sem, *, window, w_pad, chunk):
         seg_ref, sem)
     cp.start()
     cp.wait()
-    # monotone f32 -> signed i32 keys (same total order as the floats)
-    seg = seg_ref[...]
-    u = jax.lax.bitcast_convert_type(seg, jnp.uint32)
+    # monotone f32 -> signed i32 keys (same total order as the floats;
+    # NaN windows are overwritten by the wrapper, so NaN keys just need
+    # a consistent slot in the order)
+    u = jax.lax.bitcast_convert_type(seg_ref[...], jnp.uint32)
     neg = (u >> 31) == 1
     key_u = jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
     keys = jax.lax.bitcast_convert_type(
         key_u ^ jnp.uint32(0x80000000), jnp.int32)
-    nan_flag = (seg != seg).astype(jnp.int32)
 
-    def build(jj, nan_cnt):
+    def build(jj, _):
         # positive shift: pltpu.roll miscomputes NEGATIVE dynamic shifts
         # at non-power-of-two widths (observed off-by-(width-256) at 640)
-        shift = (chunk + w_pad) - jj
-        rolled = pltpu.roll(keys, shift, 1)[:, :chunk]
+        rolled = pltpu.roll(keys, (chunk + w_pad) - jj, 1)[:, :chunk]
         mat_ref[pl.ds(jj * _ROWS, _ROWS), :] = jnp.where(
             jj < window, rolled, IMAX)
-        rn = pltpu.roll(nan_flag, shift, 1)[:, :chunk]
-        return nan_cnt + jnp.where(jj < window, rn, 0)
+        return 0
 
-    nan_cnt = jax.lax.fori_loop(
-        0, w_pad, build, jnp.zeros((_ROWS, chunk), jnp.int32))
+    jax.lax.fori_loop(0, w_pad, build, 0)
     mat = mat_ref[...].reshape(w_pad, _ROWS, chunk)
 
     k_lo = (window - 1) // 2
@@ -115,9 +113,7 @@ def _kernel(x_hbm, o_ref, seg_ref, mat_ref, sem, *, window, w_pad, chunk):
         return jax.lax.bitcast_convert_type(
             jnp.where(was_neg, ~v, v & jnp.uint32(0x7FFFFFFF)), jnp.float32)
 
-    med = 0.5 * (tof(v_lo) + tof(v_hi))
-    # jnp.median semantics: any NaN in a window -> NaN out
-    o_ref[...] = jnp.where(nan_cnt > 0, jnp.float32(jnp.nan), med)
+    o_ref[...] = 0.5 * (tof(v_lo) + tof(v_hi))
 
 
 @functools.partial(jax.jit,
@@ -163,8 +159,14 @@ def rolling_median_windows_pallas(padded: jax.Array, window: int,
                 pltpu.SemaphoreType.DMA,
             ],
             interpret=interpret,
-        )(x)
-        return out[:R, :T]
+        )(x)[:R, :T]
+        # jnp.median NaN semantics, outside the kernel: windowed NaN
+        # counts by cumsum difference (two cheap XLA passes) instead of
+        # an extra roll+add per kernel build step
+        cs = jnp.cumsum(jnp.isnan(x[:R]).astype(jnp.int32), axis=-1)
+        cnt = (cs[:, window - 1:window - 1 + T]
+               - jnp.pad(cs, ((0, 0), (1, 0)))[:, :T])
+        return jnp.where(cnt > 0, jnp.float32(jnp.nan), out)
 
     # vmapping a pallas_call with an ANY-space input is not lowerable
     # (Mosaic requires whole-array blocks with trivial index maps there);
