@@ -320,3 +320,73 @@ class TestFits:
         assert nside2 == nside and not nest
         np.testing.assert_array_equal(pix2, pix)
         np.testing.assert_allclose(maps["MAP"], m, rtol=1e-7)
+
+
+def test_wcs_udgrade_and_queries():
+    """Map re-pixelisation + region queries (Tools/WCS.py:35-86,275-350
+    capabilities)."""
+    from comapreduce_tpu.mapmaking.wcs import (WCS, angular_separation,
+                                               query_annulus, query_disc,
+                                               query_slice, udgrade_map)
+
+    fine = WCS.from_field((170.0, 52.0), (1.0 / 60, 1.0 / 60), (120, 120))
+    coarse = WCS.from_field((170.0, 52.0), (1.0 / 30, 1.0 / 30), (60, 60))
+    rng = np.random.default_rng(0)
+    m = rng.normal(5.0, 1.0, fine.npix)
+
+    # identity regrid reproduces the map on hit pixels
+    same, var = udgrade_map(m, fine, fine)
+    hit = np.isfinite(same)
+    np.testing.assert_allclose(same[hit], m.reshape(-1)[hit])
+    # downgrade averages ~4 fine pixels per coarse pixel: mean preserved,
+    # variance of the binned map drops
+    down, dvar = udgrade_map(m, fine, coarse)
+    dh = np.isfinite(down)
+    assert dh.mean() > 0.8
+    assert abs(np.nanmean(down) - m.mean()) < 0.05
+    assert np.nanstd(down) < 0.8 * m.std()
+    assert np.nanmedian(dvar) < 0.5  # ~1/4 from 4-pixel averages
+
+    # frame-aware regrid: a galactic CAR geometry covering the same sky
+    from comapreduce_tpu.astro.coordinates import e2g
+
+    gl0, gb0 = e2g(170.0, 52.0)
+    gal = WCS.from_field((float(gl0), float(gb0)), (1.0 / 30, 1.0 / 30),
+                         (80, 80), ctype=("GLON-CAR", "GLAT-CAR"))
+    gmap, _ = udgrade_map(m, fine, gal)
+    assert np.isfinite(gmap).any()
+    assert abs(np.nanmean(gmap) - m.mean()) < 0.1
+
+    # disc/annulus partition: within r_out, disc(r_in) + annulus = disc(r_out)
+    sel_in, _, _ = query_disc(fine, 170.0, 52.0, 0.3)
+    sel_out, lon_o, lat_o = query_disc(fine, 170.0, 52.0, 0.6)
+    idx_ann, _, _ = query_annulus(fine, 170.0, 52.0, 0.3, 0.6)
+    assert sel_in.sum() + idx_ann.size == sel_out.sum()
+    assert (angular_separation(170.0, 52.0, lon_o, lat_o) < 0.6).all()
+
+    # slice: pixels along a horizontal cut, distances increase from start
+    sel, lon_s, lat_s, dist = query_slice(fine, 169.4, 52.0, 170.6, 52.0,
+                                          width=0.05)
+    assert sel.sum() > 10
+    assert (np.abs(lat_s - 52.0) < 0.06).all()
+    assert dist.max() > 0.5
+
+
+def test_query_slice_steep_and_wrapped():
+    """Perpendicular-distance slice: steep lines keep their full width
+    (the vertical-offset formulation collapses there) and RA 0/360
+    crossings select pixels on both sides of the wrap."""
+    from comapreduce_tpu.mapmaking.wcs import WCS, query_slice
+
+    w = WCS.from_field((170.0, 52.0), (1.0 / 60, 1.0 / 60), (120, 120))
+    # steep (nearly vertical, but lon1 != lon0)
+    sel, lon_s, lat_s, _ = query_slice(w, 170.0, 51.3, 170.01, 52.7,
+                                       width=0.05)
+    assert sel.sum() > 30
+    assert (np.abs(lon_s - 170.0) < 0.1).all()
+
+    w0 = WCS.from_field((0.0, 10.0), (1.0 / 60, 1.0 / 60), (120, 120))
+    sel, lon_s, _, _ = query_slice(w0, 359.6, 10.0, 0.4, 10.0, width=0.05)
+    assert sel.sum() > 20
+    # pixels from both sides of the wrap
+    assert (lon_s > 180).any() and (lon_s < 180).any()
