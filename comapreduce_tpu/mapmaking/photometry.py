@@ -1,0 +1,127 @@
+"""Map-space photometry and source fitting.
+
+The reference drives this through an *external* ``mapext`` package
+(``run_mapext.py:1-72``, absent upstream), so the capability was a
+permanent gap there. Here it is native: aperture photometry with an
+annulus background and a 2-D Gaussian source fit on a map cutout, built
+on the WCS region queries (:mod:`comapreduce_tpu.mapmaking.wcs`) and the
+batched LM fitter (:mod:`comapreduce_tpu.calibration.fitting`).
+
+All functions take a FLAT map vector over ``wcs`` (the destriper's
+output layout) in any unit; results come back in that unit (times
+steradian-free pixel counts for fluxes — multiply by the pixel solid
+angle for Jy-style integrals).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.mapmaking.wcs import WCS, query_disc
+
+__all__ = ["aperture_photometry", "fit_map_source"]
+
+
+def aperture_photometry(map_flat, wcs: WCS, lon0: float, lat0: float,
+                        r_aperture: float, r_in: float | None = None,
+                        r_out: float | None = None,
+                        weight_flat=None) -> dict:
+    """Background-subtracted aperture sum around ``(lon0, lat0)``.
+
+    ``r_aperture``/``r_in``/``r_out`` in degrees (annulus defaults:
+    ``1.5x`` and ``2.5x`` the aperture). Background is the annulus
+    MEDIAN (robust to nearby sources). The per-pixel noise comes from
+    per-pixel weights (``1/variance``) when given, else from the annulus
+    MAD scatter. NaN pixels are ignored.
+
+    Returns ``{"flux", "flux_err", "background", "n_pixels"}`` with
+    ``flux`` in map-unit * pixels.
+    """
+    from comapreduce_tpu.mapmaking.wcs import angular_separation
+
+    m = np.asarray(map_flat, np.float64).reshape(-1)
+    if r_in is None:
+        r_in = 1.5 * r_aperture
+    if r_out is None:
+        r_out = 2.5 * r_aperture
+    # one full-grid transform per source: disc and annulus both derive
+    # from the same separation array (cached pixel centers)
+    lon, lat = wcs.pixel_centers()
+    r = angular_separation(lon0, lat0, lon.ravel(), lat.ravel())
+    sel_ap = np.isfinite(r) & (r < r_aperture)
+    sel_bg = np.isfinite(r) & (r >= r_in) & (r < r_out)
+    ap = m[sel_ap]
+    bg = m[sel_bg]
+    ap = ap[np.isfinite(ap)]
+    bg = bg[np.isfinite(bg)]
+    n = ap.size
+    if n == 0:
+        return {"flux": np.nan, "flux_err": np.nan,
+                "background": np.nan, "n_pixels": 0}
+    background = float(np.median(bg)) if bg.size else 0.0
+    flux = float(np.sum(ap - background))
+    # per-pixel noise sigma; the background-median uncertainty adds
+    # n^2 * var_bg / n_bg to the aperture-sum variance
+    if weight_flat is not None:
+        w = np.asarray(weight_flat, np.float64).reshape(-1)[sel_ap]
+        sig = float(np.sqrt(np.nanmedian(1.0 / np.maximum(w, 1e-30))))
+    elif bg.size > 1:
+        sig = 1.4826 * float(np.median(np.abs(bg - background)))
+    else:
+        sig = float(np.std(ap))
+    err = sig * np.sqrt(n + (n * n / max(bg.size, 1)))
+    return {"flux": flux, "flux_err": float(err),
+            "background": background, "n_pixels": int(n)}
+
+
+def fit_map_source(map_flat, wcs: WCS, lon0: float, lat0: float,
+                   radius: float, weight_flat=None,
+                   fwhm_deg: float = 0.075) -> dict:
+    """2-D Gaussian fit of a source in a map cutout.
+
+    Pixels within ``radius`` degrees of ``(lon0, lat0)`` are fitted with
+    the rotated-Gaussian + offset model in source-relative plane
+    coordinates (degrees). Returns the parameter dict with 1-sigma
+    errors from the LM covariance:
+    ``amplitude, dx, sigma_x, dy, sigma_y, angle, offset`` (+``_err``),
+    plus ``chi2`` and ``n_pixels``.
+    """
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.calibration.fitting import (fit_gauss2d,
+                                                     initial_guess)
+
+    m = np.asarray(map_flat, np.float64).reshape(-1)
+    sel, lon, lat = query_disc(wcs, lon0, lat0, radius)
+    vals = m[sel]
+    good = np.isfinite(vals)
+    vals = vals[good]
+    lon, lat = lon[good], lat[good]
+    if vals.size < 10:
+        return {"n_pixels": int(vals.size)}
+    # source-relative plane coords: flat-sky about the source position
+    dx = ((lon - lon0 + 180.0) % 360.0 - 180.0) * np.cos(np.radians(lat0))
+    dy = lat - lat0
+    if weight_flat is not None:
+        w = np.asarray(weight_flat, np.float64).reshape(-1)[sel][good]
+        w = np.where(np.isfinite(w) & (w > 0), w, 0.0)
+    else:
+        w = np.ones_like(vals)
+    img = jnp.asarray(vals, jnp.float32)
+    xj = jnp.asarray(dx, jnp.float32)
+    yj = jnp.asarray(dy, jnp.float32)
+    wj = jnp.asarray(w, jnp.float32)
+    p0 = initial_guess(img, xj, yj, wj, fwhm_deg=fwhm_deg)
+    p, err, chi2 = fit_gauss2d(img, xj, yj, wj, p0)
+    p, err = np.asarray(p, np.float64), np.asarray(err, np.float64)
+    names = ("amplitude", "dx", "sigma_x", "dy", "sigma_y", "angle",
+             "offset")
+    out = {k: float(v) for k, v in zip(names, p)}
+    out.update({f"{k}_err": float(e) for k, e in zip(names, err)})
+    out["chi2"] = float(chi2)
+    out["n_pixels"] = int(vals.size)
+    # fitted centre back on the sky
+    out["lon"] = float((lon0 + out["dx"]
+                        / max(np.cos(np.radians(lat0)), 1e-9)) % 360.0)
+    out["lat"] = float(lat0 + out["dy"])
+    return out

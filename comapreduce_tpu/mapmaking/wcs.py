@@ -163,9 +163,16 @@ class WCS:
         return np.where(bad, -1, iy * self.nx + ix)
 
     def pixel_centers(self):
-        """(lon, lat) of every pixel, each shaped (ny, nx)."""
-        py, px = np.mgrid[0 : self.ny, 0 : self.nx]
-        return self.pix2world(px, py)
+        """(lon, lat) of every pixel, each shaped (ny, nx).
+
+        Cached: the geometry is immutable in practice, and the region
+        queries / photometry call this repeatedly per source."""
+        cached = getattr(self, "_centers", None)
+        if cached is None:
+            py, px = np.mgrid[0 : self.ny, 0 : self.nx]
+            cached = self.pix2world(px, py)
+            object.__setattr__(self, "_centers", cached)
+        return cached
 
     # -- constructors ----------------------------------------------------
     @classmethod
@@ -228,10 +235,12 @@ def udgrade_map(map_in, wcs_in: "WCS", wcs_out: "WCS", variance=None):
     lon, lat = _to_frame_of(lon.ravel(), lat.ravel(), wcs_in, wcs_out)
     pix = wcs_out.ang2pix(lon, lat)
     good = (pix >= 0) & np.isfinite(m) & np.isfinite(var) & (var > 0)
-    num = np.zeros(wcs_out.npix)
-    den = np.zeros(wcs_out.npix)
-    np.add.at(num, pix[good], m[good] / var[good])
-    np.add.at(den, pix[good], 1.0 / var[good])
+    # bincount, not np.add.at: same scatter-add an order of magnitude
+    # faster on survey-size maps
+    num = np.bincount(pix[good], weights=m[good] / var[good],
+                      minlength=wcs_out.npix).astype(np.float64)
+    den = np.bincount(pix[good], weights=1.0 / var[good],
+                      minlength=wcs_out.npix).astype(np.float64)
     with np.errstate(invalid="ignore", divide="ignore"):
         map_out = np.where(den > 0, num / den, np.nan)
         var_out = np.where(den > 0, 1.0 / den, np.nan)
@@ -274,9 +283,12 @@ def query_slice(wcs: "WCS", lon0, lat0, lon1, lat1, width=None):
     def unwrap(lo):
         return (np.asarray(lo, np.float64) - lon0 + 180.0) % 360.0 - 180.0
 
-    x, y = unwrap(lon), lat
+    # cos(lat) metric on the lon axis: a lon degree is smaller on the
+    # sky, and without it the strip's true width depends on orientation
+    clat = max(np.cos(np.radians((lat0 + lat1) / 2.0)), 1e-9)
+    x, y = unwrap(lon) * clat, lat
     x0, y0 = 0.0, float(lat0)
-    x1, y1 = float(unwrap(lon1)), float(lat1)
+    x1, y1 = float(unwrap(lon1)) * clat, float(lat1)
     dx, dy = x1 - x0, y1 - y0
     norm = max(np.hypot(dx, dy), 1e-12)
     off = np.abs(dx * (y0 - y) - (x0 - x) * dy) / norm
