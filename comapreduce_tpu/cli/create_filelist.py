@@ -1,0 +1,67 @@
+"""Filelist curation driver: ``python -m comapreduce_tpu.cli.
+create_filelist [options] <level2 files or @filelist>``.
+
+The reference's ``scripts/io/createFileList.py`` +
+``MapMaking/CreateFilelist.py`` role: split Level-2 files into good /
+rejected lists by the white-noise cut (default σ_f < 4 mK,
+``CreateFilelist.py:17``), optionally filtered to one source via the
+observation database.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from comapreduce_tpu.mapmaking.filelist import create_filelist, write_filelist
+from comapreduce_tpu.pipeline.config import read_filelist
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="create_filelist",
+        description="Split Level-2 files into good/rejected filelists by "
+                    "the white-noise cut.")
+    ap.add_argument("files", nargs="+",
+                    help="Level-2 paths, or @listfile to read a filelist")
+    ap.add_argument("--noise-cut-mk", type=float, default=4.0,
+                    help="white-noise cut in mK (default 4.0)")
+    ap.add_argument("--band", type=int, default=0,
+                    help="band whose noise level is tested (default 0)")
+    ap.add_argument("--source", default="",
+                    help="keep only observations of this source "
+                         "(obs database query)")
+    ap.add_argument("--database", default="",
+                    help="obs database for --source (required with it)")
+    ap.add_argument("--output", default="filelist.txt")
+    ap.add_argument("--rejected", default="rejected.txt")
+    args = ap.parse_args(argv)
+
+    files: list[str] = []
+    for f in args.files:
+        files.extend(read_filelist(f[1:]) if f.startswith("@") else [f])
+
+    if args.source:
+        if not args.database:
+            ap.error("--source requires --database")
+        from comapreduce_tpu.database import ObsDatabase
+
+        # the database stores abspath-normalized level2_path entries
+        keep = {os.path.abspath(p)
+                for p in ObsDatabase(args.database).query_source(
+                    args.source)}
+        files = [f for f in files if os.path.abspath(f) in keep]
+
+    good, rejected = create_filelist(files, band=args.band,
+                                     sigma_cut_mk=args.noise_cut_mk)
+    write_filelist(args.output, good)
+    write_filelist(args.rejected, rejected)
+    print(f"{len(good)} good -> {args.output}; "
+          f"{len(rejected)} rejected -> {args.rejected}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

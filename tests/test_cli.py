@@ -354,3 +354,28 @@ def test_make_band_map_sharded_matches_single(field_dataset):
     np.testing.assert_allclose(b, a, atol=5e-3 * scale)
     np.testing.assert_allclose(np.asarray(sharded.hit_map),
                                np.asarray(single.hit_map))
+
+
+def test_create_filelist_cli(field_dataset, tmp_path):
+    """create_filelist driver splits Level-2 files by the noise cut
+    (scripts/io/createFileList.py + CreateFilelist.py role)."""
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli.create_filelist import main
+
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2)
+    listfile = str(tmp_path / "all.txt")
+    with open(listfile, "w") as f:
+        f.write("# comment line\n" + "\n".join(l2) + "\n")
+    out, rej = str(tmp_path / "good.txt"), str(tmp_path / "rej.txt")
+    # generous cut keeps everything
+    assert main([f"@{listfile}", "--noise-cut-mk", "1e9",
+                 "--output", out, "--rejected", rej]) == 0
+    with open(out) as f:
+        assert len([ln for ln in f if ln.strip()]) == len(l2)
+    # impossible cut rejects everything
+    assert main([f"@{listfile}", "--noise-cut-mk", "1e-9",
+                 "--output", out, "--rejected", rej]) == 0
+    with open(rej) as f:
+        assert len([ln for ln in f if ln.strip()]) == len(l2)
