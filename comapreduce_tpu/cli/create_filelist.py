@@ -38,6 +38,8 @@ def main(argv=None) -> int:
     ap.add_argument("--output", default="filelist.txt")
     ap.add_argument("--rejected", default="rejected.txt")
     args = ap.parse_args(argv)
+    if args.band < 0:
+        ap.error("--band must be >= 0")
 
     files: list[str] = []
     for f in args.files:

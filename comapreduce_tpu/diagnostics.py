@@ -21,7 +21,8 @@ import os
 import numpy as np
 
 __all__ = ["figure_path", "plot_vane_event", "plot_gain_solution",
-           "plot_power_spectrum_fit", "plot_source_fit"]
+           "plot_power_spectrum_fit", "plot_source_fit",
+           "plot_sed_fit", "plot_sed_corner"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -147,6 +148,71 @@ def plot_source_fit(path: str, map2d, fit_params, source: str = "",
                 "r-", lw=1.0)
         ax.plot([x0], [y0], "r+")
     ax.set_title(f"{source} feed {feed} band {band}")
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+
+
+def plot_sed_fit(path: str, freqs_ghz, flux, flux_err, model_freqs,
+                 model_flux, title: str = ""):
+    """SED data points + fitted model curve (the ``SEDs/tools.py``
+    fit-plot role). Log-log axes; None path = disabled."""
+    if path is None:
+        return
+    plt = _pyplot()
+    if plt is None:
+        return
+    fig, ax = plt.subplots(figsize=(5, 4))
+    ax.errorbar(np.asarray(freqs_ghz), np.asarray(flux),
+                yerr=np.asarray(flux_err), fmt="o", ms=4, capsize=2,
+                label="data")
+    ax.plot(np.asarray(model_freqs), np.asarray(model_flux), "-",
+            label="model")
+    ax.set_xscale("log")
+    ax.set_yscale("log")
+    ax.set_xlabel("frequency [GHz]")
+    ax.set_ylabel("flux density")
+    if title:
+        ax.set_title(title)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+
+
+def plot_sed_corner(path: str, chain, names):
+    """Corner-style posterior grid from an MCMC chain (the
+    ``SEDs/tools.py:859-991`` corner/walker-plot role, matplotlib-only —
+    no external corner package). ``chain``: f64[n_samples, n_params] in
+    the sampler's internal (possibly log) parameterisation; ``names``
+    labels the columns."""
+    if path is None:
+        return
+    plt = _pyplot()
+    if plt is None:
+        return
+    chain = np.asarray(chain)
+    n = chain.shape[1]
+    fig, axes = plt.subplots(n, n, figsize=(2.0 * n, 2.0 * n))
+    axes = np.atleast_2d(axes)
+    for i in range(n):
+        for j in range(n):
+            ax = axes[i, j]
+            if j > i:
+                ax.axis("off")
+                continue
+            if i == j:
+                ax.hist(chain[:, i], bins=40, histtype="step")
+            else:
+                ax.hist2d(chain[:, j], chain[:, i], bins=40)
+            if i == n - 1:
+                ax.set_xlabel(names[j])
+            else:
+                ax.set_xticklabels([])
+            if j == 0 and i > 0:
+                ax.set_ylabel(names[i])
+            else:
+                ax.set_yticklabels([])
     fig.tight_layout()
     fig.savefig(path, dpi=100)
     plt.close(fig)

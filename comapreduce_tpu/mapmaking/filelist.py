@@ -44,7 +44,9 @@ def create_filelist(level2_files, band: int = 0,
         try:
             lvl2 = COMAPLevel2(filename=fname)
             sigma = noise_level_mk(lvl2, band)
-        except (OSError, KeyError) as exc:
+        except (OSError, KeyError, IndexError) as exc:
+            # IndexError: a band beyond the file's band count — reject
+            # the file (and warn) rather than crash the whole curation
             logger.warning("create_filelist: BAD FILE %s (%s)", fname, exc)
             rejected.append(fname)
             continue

@@ -75,6 +75,12 @@ class SED:
     errors: dict = field(default_factory=dict)
     chain: np.ndarray | None = None
 
+    @property
+    def param_names(self) -> list[str]:
+        """Parameter names in chain/vector column order (public API for
+        corner-plot labelling)."""
+        return [name for name, _, _ in self._param_spec()]
+
     def _param_spec(self):
         spec = []
         for c in self.components:

@@ -274,3 +274,28 @@ def test_obs_metadata_query(tmp_path):
     out = obsinfo_from_database(db)
     assert out == {"comap-0000777-2024-03-01-000000_Level2Cont.hd5": "TauA"}
     assert obsinfo_from_database(db, source="TauA") == out
+
+
+def test_sed_diagnostic_plots(tmp_path):
+    """SED fit + corner figures render from an mcmc_fit chain
+    (SEDs/tools.py corner/walker plot role)."""
+    from comapreduce_tpu import diagnostics
+    from comapreduce_tpu.seds import SED, total_model
+
+    nu = np.geomspace(15.0, 90.0, 10)
+    omega = 1e-5
+    flux = total_model({"sync_amp": 1e-3, "sync_index": -3.0}, nu, omega,
+                       ("synchrotron",))
+    err = 0.05 * flux
+    sed = SED(nu, flux, err, omega, components=("synchrotron",))
+    sed.mcmc_fit(n_steps=1500, seed=1)
+    assert sed.chain.shape[0] > 100
+
+    fit_png = str(tmp_path / "sed_fit.png")
+    model_nu = np.linspace(4, 80, 64)
+    diagnostics.plot_sed_fit(fit_png, nu, flux, err, model_nu,
+                             sed.model(sed.params, model_nu))
+    corner_png = str(tmp_path / "sed_corner.png")
+    diagnostics.plot_sed_corner(corner_png, sed.chain, sed.param_names)
+    assert os.path.getsize(fit_png) > 1000
+    assert os.path.getsize(corner_png) > 1000
