@@ -77,6 +77,15 @@ def main(n_feeds: int = 2, n_channels: int = 64) -> int:
         print(f"map: {int((hits > 0).sum())}/{wcs.npix} px hit, "
               f"peak {peak * 1e3:.1f} mK "
               f"(injected {p.source_amplitude_k * 1e3:.0f} mK source)")
+        # map-space source fit (photometry layer) on the destriped map
+        from comapreduce_tpu.mapmaking.photometry import fit_map_source
+
+        fit = fit_map_source(np.where(hits > 0, m, np.nan), wcs,
+                             p.ra0, p.dec0, radius=0.4)
+        if "amplitude" in fit:
+            print(f"source fit: {fit['amplitude'] * 1e3:.1f} mK at "
+                  f"({fit['lon']:.3f}, {fit['lat']:.3f}), "
+                  f"chi2 {fit['chi2']:.1f}")
         ok = (np.isfinite(m).all() and int(result.n_iter) > 0
               and peak > 0.2 * p.source_amplitude_k)
         print("OK" if ok else "FAIL")
