@@ -28,7 +28,8 @@ conservative.
 Env knobs: ``BENCH_SCALE`` (float, default 1.0) scales the per-scan sample
 count; ``BENCH_SMALL=1`` runs a tiny config (CI smoke);
 ``BENCH_BASELINE_S`` overrides the measured baseline unit seconds (skips
-the ~60 s single-core measurement, e.g. for quick re-runs).
+the ~60 s single-core measurement, e.g. for quick re-runs);
+``BENCH_NO_PROBE=1`` skips the wedged-relay pre-flight probe.
 """
 
 from __future__ import annotations
@@ -268,7 +269,40 @@ def ces_pixels(T: int, nx: int, ny: int, feed: int, n_feeds: int):
     return pix.astype(np.int32)
 
 
+def _probe_device(timeout_s: float = 600.0) -> None:
+    """Fail fast (with a clear message) when the TPU relay is wedged.
+
+    A wedged axon remote-compile relay hangs EVERY jit indefinitely —
+    including this bench, which would otherwise sit silent until the
+    caller's timeout. Probe with a tiny jit in a subprocess first;
+    ``BENCH_NO_PROBE=1`` skips."""
+    if os.environ.get("BENCH_NO_PROBE", "") == "1":
+        return
+    code = ("import jax, jax.numpy as jnp;"
+            "print(float(jax.jit(lambda x: (x + 1).sum())(jnp.ones(8))))")
+    # NEVER signal the child on timeout: killing a process mid-TPU-compile
+    # is itself the wedge trigger (SKILL.md gotcha) — on timeout the child
+    # is left running (it either finishes harmlessly or was already hung)
+    child = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.PIPE)
+    try:
+        _, err = child.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print("bench: device probe hung for "
+              f"{timeout_s:.0f}s — the TPU compile relay appears wedged "
+              "(see .claude/skills/verify/SKILL.md gotchas); aborting "
+              "instead of hanging (probe child left untouched)",
+              file=sys.stderr)
+        raise SystemExit(3)
+    if child.returncode != 0:
+        print("bench: device probe failed:\n"
+              f"{err.decode(errors='replace')[-2000:]}", file=sys.stderr)
+        raise SystemExit(3)
+
+
 def main():
+    _probe_device()
     import jax
     import jax.numpy as jnp
 
