@@ -282,23 +282,32 @@ def _probe_device(timeout_s: float = 600.0) -> None:
             "print(float(jax.jit(lambda x: (x + 1).sum())(jnp.ones(8))))")
     # NEVER signal the child on timeout: killing a process mid-TPU-compile
     # is itself the wedge trigger (SKILL.md gotcha) — on timeout the child
-    # is left running (it either finishes harmlessly or was already hung)
-    child = subprocess.Popen([sys.executable, "-c", code],
-                             stdout=subprocess.DEVNULL,
-                             stderr=subprocess.PIPE)
-    try:
-        _, err = child.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        print("bench: device probe hung for "
-              f"{timeout_s:.0f}s — the TPU compile relay appears wedged "
-              "(see .claude/skills/verify/SKILL.md gotchas); aborting "
-              "instead of hanging (probe child left untouched)",
-              file=sys.stderr)
-        raise SystemExit(3)
-    if child.returncode != 0:
-        print("bench: device probe failed:\n"
-              f"{err.decode(errors='replace')[-2000:]}", file=sys.stderr)
-        raise SystemExit(3)
+    # is left running (it either finishes harmlessly or was already hung).
+    # stderr goes to a temp FILE, not a pipe: if the parent exited holding
+    # a pipe, a slow-but-healthy child would be SIGPIPE-killed on its next
+    # stderr write — mid-compile, the very thing this code avoids.
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w+b", suffix=".probe.log",
+                                     delete=False) as errf:
+        child = subprocess.Popen([sys.executable, "-c", code],
+                                 stdout=subprocess.DEVNULL, stderr=errf)
+        try:
+            child.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            print("bench: device probe hung for "
+                  f"{timeout_s:.0f}s — the TPU compile relay appears "
+                  "wedged (see .claude/skills/verify/SKILL.md gotchas); "
+                  "aborting instead of hanging (probe child left "
+                  "untouched)", file=sys.stderr)
+            raise SystemExit(3)
+        if child.returncode != 0:
+            errf.seek(0)
+            print("bench: device probe failed:\n"
+                  f"{errf.read().decode(errors='replace')[-2000:]}",
+                  file=sys.stderr)
+            raise SystemExit(3)
+    os.unlink(errf.name)
 
 
 def main():

@@ -19,7 +19,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The exec lives in pytest_configure (below) so capture can be suspended
 # first — execve from module import time would inherit pytest's captured
 # stdout/stderr fds and the re-exec'd run's output would vanish.
-_NEEDS_REEXEC = (os.environ.get("PALLAS_AXON_POOL_IPS")
+_NEEDS_REEXEC = (any(k.startswith("PALLAS_AXON") for k in os.environ)
                  and os.environ.get("_COMAP_TESTS_REEXEC") != "1")
 
 
@@ -31,7 +31,8 @@ def pytest_configure(config):
         capman.suspend_global_capture(in_=True)
     env = dict(os.environ)
     env["_COMAP_TESTS_REEXEC"] = "1"
-    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+    # prefix match, not a hardcoded pair: every relay-config var goes
+    for k in [k for k in env if k.startswith("PALLAS_AXON")]:
         env.pop(k, None)
     env["PYTHONPATH"] = _REPO  # drop /root/.axon_site
     os.execve(sys.executable,
