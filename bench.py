@@ -295,22 +295,20 @@ def _probe_device(timeout_s: float = 600.0) -> None:
         try:
             child.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
+            # the hung child may still write the log: leave both alone
             print("bench: device probe hung for "
                   f"{timeout_s:.0f}s — the TPU compile relay appears "
                   "wedged (see .claude/skills/verify/SKILL.md gotchas); "
                   "aborting instead of hanging (probe child left "
-                  "untouched). Last verified on-chip measurement before "
-                  "the outage (2026-07-30): wall 6.28 s, vs_baseline "
-                  "89.7x at a 47.4 s baseline unit — ROOFLINE.md.",
-                  file=sys.stderr)
+                  "untouched). The last verified on-chip measurement is "
+                  "recorded in ROOFLINE.md.", file=sys.stderr)
             raise SystemExit(3)
-        if child.returncode != 0:
-            errf.seek(0)
-            print("bench: device probe failed:\n"
-                  f"{errf.read().decode(errors='replace')[-2000:]}",
-                  file=sys.stderr)
-            raise SystemExit(3)
+        errf.seek(0)
+        err_tail = errf.read().decode(errors="replace")[-2000:]
     os.unlink(errf.name)
+    if child.returncode != 0:
+        print(f"bench: device probe failed:\n{err_tail}", file=sys.stderr)
+        raise SystemExit(3)
 
 
 def main():

@@ -1,0 +1,36 @@
+"""bench.py CI smoke: the driver runs this script at the end of every
+round — a bitrotten bench must fail here first, not there."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+
+def test_bench_small_emits_json_line():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # scrub the axon relay env explicitly (the conftest re-exec usually
+    # does this for the pytest process, but this child must be safe even
+    # when the suite runs without that scrub): no relay vars, no
+    # .axon_site sitecustomize, pure-CPU platform
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
+    env.update(BENCH_SMALL="1", BENCH_BASELINE_S="1.0",
+               BENCH_NO_PROBE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo)
+    out = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        env=env, timeout=420, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "tod_samples_per_sec"
+    assert rec["unit"] == "samples/s"
+    assert rec["value"] > 0 and np.isfinite(rec["value"])
+    assert rec["vs_baseline"] > 0
+    d = rec["detail"]
+    assert d["cg_iters"] > 0 and d["wall_s"] > 0
+    assert 0 < d["map_hit_fraction"] <= 1
