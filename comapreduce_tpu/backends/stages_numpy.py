@@ -132,9 +132,13 @@ class SpikesNumpy(_StageBase):
     def __call__(self, data, level2) -> bool:
         tod = np.asarray(level2.tod, np.float64)
         T = tod.shape[-1]
+        # real validity from the reduction's weights (a genuine zero TOD
+        # sample stays valid); sentinel fallback for pre-weights stores
+        valid = (np.asarray(level2["averaged_tod/weights"]) > 0) \
+            if "averaged_tod/weights" in level2 else None
         mask = numpy_ops.spike_mask_np(
             tod, window=min(self.window, max(3, T // 2 * 2 - 1)),
-            threshold=self.threshold, pad=self.pad)
+            threshold=self.threshold, pad=self.pad, valid=valid)
         self._data = {"spikes/spike_mask": mask.astype(np.uint8)}
         self.STATE = True
         return True
@@ -154,27 +158,45 @@ class Level2FitPowerSpectrumNumpy(_StageBase):
     model_name: str = "red_noise"
     out_group: str = "fnoise_fits"
     mask_peaks: bool = True
+    # same quantised per-scan-length buckets as the device stage (a
+    # backend switch must fit identical blocks); 1 = the reference's
+    # exact full-length per-scan fits (free on host — no compile cost)
+    length_quantum: int = 128
     figure_dir: str = ""   # same knob as the device stage: a config
     #                        section must survive a backend switch
 
     def __call__(self, data, level2) -> bool:
+        from comapreduce_tpu.pipeline.stages import bucket_scan_lengths
+
         tod = np.asarray(level2.tod, np.float64)
         edges = np.asarray(level2.scan_edges)
         if len(edges) == 0:
             self.STATE = False
             return False
-        Lmin = int((edges[:, 1] - edges[:, 0]).min()) // 2 * 2
-        if Lmin < 16:
+        buckets = bucket_scan_lengths(edges, self.length_quantum)
+        if not buckets:
             self.STATE = False
             return False
-        blocks = np.stack([tod[..., s:s + Lmin] for s, _ in edges], axis=2)
-        params = numpy_ops.fit_observation_noise_np(
-            blocks, sample_rate=self.sample_rate, nbins=self.nbins,
-            model_name=self.model_name, mask_peaks=self.mask_peaks)
-        rms = numpy_ops._auto_rms(blocks)
+        F, B = tod.shape[:2]
+        S = len(edges)
+        # NaN, not 0, for unfittable stubs: fleet stats take nanmedians
+        params = np.full((F, B, S, 3), np.nan, np.float64)
+        rms = np.full((F, B, S), np.nan, np.float64)
+        for lq, sids in sorted(buckets.items()):
+            for si in sids:   # host path: no batching pressure
+                s = int(edges[si, 0])
+                blk = tod[..., s:s + lq][:, :, None, :]
+                params[:, :, si] = numpy_ops.fit_observation_noise_np(
+                    blk, sample_rate=self.sample_rate, nbins=self.nbins,
+                    model_name=self.model_name,
+                    mask_peaks=self.mask_peaks)[:, :, 0]
+                rms[:, :, si] = numpy_ops._auto_rms(blk)[:, :, 0]
         if self.figure_dir:
-            self._plot_first_fit(blocks[0, 0, 0], params[0, 0, 0],
-                                 data.obsid)
+            from comapreduce_tpu.pipeline.stages import first_fitted_scan
+
+            si0, lq0, s0 = first_fitted_scan(buckets, edges)
+            self._plot_first_fit(tod[0, 0, s0:s0 + lq0], params[0, 0, si0],
+                                 data.obsid, si0)
         self._data = {
             f"{self.out_group}/fnoise_fit_parameters":
                 params.astype(np.float32),
@@ -183,8 +205,9 @@ class Level2FitPowerSpectrumNumpy(_StageBase):
         self.STATE = True
         return True
 
-    def _plot_first_fit(self, block, params, obsid) -> None:
-        """Same QA figure as the device stage (feed 0, band 0, scan 0)."""
+    def _plot_first_fit(self, block, params, obsid, si0: int = 0) -> None:
+        """Same QA figure as the device stage (feed 0, band 0, first
+        fitted scan)."""
         from comapreduce_tpu import diagnostics
 
         n = block.size
@@ -207,7 +230,7 @@ class Level2FitPowerSpectrumNumpy(_StageBase):
         diagnostics.plot_power_spectrum_fit(
             diagnostics.figure_path(
                 self.figure_dir, obsid,
-                f"{self.out_group}_feed00_band00_scan00"),
+                f"{self.out_group}_feed00_band00_scan{si0:02d}"),
             nu, pb, params, model)
 
 

@@ -21,6 +21,7 @@ MPI-over-files analogue, SURVEY.md §2.5).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +35,8 @@ from comapreduce_tpu.ops.median_filter import medfilt_highpass
 from comapreduce_tpu.ops.stats import masked_median
 
 __all__ = ["scan_starts_lengths", "extract_scan_blocks",
-           "scatter_scan_blocks", "reduce_feed_scans", "ReduceConfig"]
+           "scatter_scan_blocks", "reduce_feed_scans", "ReduceConfig",
+           "estimate_reduce_hbm", "plan_reduce_memory", "device_hbm_bytes"]
 
 
 def scan_starts_lengths(edges: np.ndarray, pad_to: int = 128):
@@ -131,6 +133,105 @@ class ReduceConfig:
         self.mask_templates = edge_channel_mask(c, s(20), s(5), s(5))
 
 
+# Simultaneous (B, C, L)-sized working blocks the per-scan chain holds at
+# peak (gathered counts, NaN-filled copy, normalised, filtered, gain
+# residual, plus fusion slack) — the envelope behind the HBM planner. The
+# round-3 bench (scan_batch=2 at production shape, ~4 GB resident) sits
+# comfortably inside this estimate; it is deliberately conservative so the
+# planner errs toward smaller batches rather than a device OOM.
+REDUCE_CHAIN_BLOCKS = 6
+
+
+def estimate_reduce_hbm(feed_batch: int, B: int, C: int, T: int,
+                        n_scans: int, L: int, scan_batch: int | None = None,
+                        dense_mask: bool = False) -> int:
+    """Estimated peak HBM bytes of one feed-batched reduction program.
+
+    Inputs resident per feed: the raw f32[B, C, T] counts (plus a dense
+    mask of the same size when ``dense_mask`` — the ``mask=None`` ingest
+    path avoids it). Working set per feed: ``REDUCE_CHAIN_BLOCKS`` scan
+    blocks of f32[B, C, L], times the number of scans materialised at once
+    (``scan_batch`` when streaming, else all ``n_scans``).
+    """
+    unit_T = B * C * T * 4
+    blk = B * C * L * 4
+    k = n_scans if (scan_batch is None or scan_batch >= n_scans) \
+        else max(int(scan_batch), 1)
+    inputs = unit_T * (2 if dense_mask else 1)
+    return int(feed_batch) * (inputs + REDUCE_CHAIN_BLOCKS * k * blk)
+
+
+def device_hbm_bytes(default: int = 16 << 30) -> int:
+    """Accelerator memory of local device 0, or ``default`` (16 GB — the
+    v5e/v5p-class floor this framework budgets for) when the backend does
+    not report it (CPU meshes, older runtimes). Override with
+    ``COMAP_HBM_BYTES`` for planning against a different part."""
+    env = os.environ.get("COMAP_HBM_BYTES", "")
+    if env:
+        return int(env)
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # CPU backend: memory_stats is None/unsupported
+        pass
+    return default
+
+
+def plan_reduce_memory(feed_batch: int, B: int, C: int, T: int,
+                       n_scans: int, L: int, scan_batch: int | None,
+                       hbm_bytes: int | None = None,
+                       dense_mask: bool = False,
+                       headroom: float = 0.9,
+                       suggest_scale: int = 1) -> int | None:
+    """Validate (and auto-pick) the reduction's streaming knobs against the
+    device HBM budget, BEFORE the device OOMs mid-observation.
+
+    ``feed_batch`` here is the PER-DEVICE feed count; ``suggest_scale``
+    (the feed-mesh size) converts the error message's suggestion back to
+    the stage's total-feeds option. Returns the ``scan_batch`` to use —
+    possibly smaller than requested (an explicit value acts as an upper
+    bound; ``None`` = all scans at once when that fits). Raises
+    ``ValueError`` naming a ``feed_batch`` that fits when no scan
+    streaming can rescue the requested one. Scan-batch candidates prefer
+    divisors of ``n_scans``: a trailing partial chunk makes ``lax.map``
+    compile its body twice.
+    """
+    budget = int((hbm_bytes or device_hbm_bytes()) * headroom)
+
+    def fits(k):
+        return estimate_reduce_hbm(feed_batch, B, C, T, n_scans, L,
+                                   scan_batch=k,
+                                   dense_mask=dense_mask) <= budget
+
+    if fits(scan_batch):
+        return scan_batch
+    # shrink below the requested (or full) chunk size, largest fitting
+    # divisor of n_scans first; k=1 is always a divisor, and the estimate
+    # is monotone in k, so non-divisors can never fit when no divisor does
+    top = n_scans if scan_batch is None else min(int(scan_batch), n_scans)
+    for k in (k for k in range(top - 1, 0, -1) if n_scans % k == 0):
+        if fits(k):
+            return k
+    # no scan streaming rescues this feed_batch: suggest one that fits
+    # with single-scan streaming
+    per_feed = estimate_reduce_hbm(1, B, C, T, n_scans, L, scan_batch=1,
+                                   dense_mask=dense_mask)
+    fb_ok = max(budget // max(per_feed, 1), 0)
+    raise ValueError(
+        f"reduction batch does not fit device memory: feed_batch="
+        f"{feed_batch} feeds/device at shape (B={B}, C={C}, T={T}, "
+        f"S={n_scans}, L={L}) needs "
+        f"~{estimate_reduce_hbm(feed_batch, B, C, T, n_scans, L, 1, dense_mask) / 2**30:.1f} GiB "
+        f"even streaming one scan at a time; budget is "
+        f"{budget / 2**30:.1f} GiB. Set feed_batch="
+        f"{max(fb_ok, 1) * max(suggest_scale, 1)}"
+        + ("" if fb_ok else " and a smaller medfilt/scan geometry")
+        + " (stage option feed_batch=, see docs/OPERATIONS.md §2).")
+
+
 def _fill_bad(tod, mask):
     """Replace masked samples with the per-channel masked median
     (``fill_bad_data``, ``Level1Averaging.py:658-665``).
@@ -158,11 +259,16 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
 
     Parameters
     ----------
-    tod:        f32[B, C, T] raw counts.
+    tod:        f32[B, C, T] raw counts. With ``mask=None`` the counts may
+                carry NaNs: validity is derived on device.
     mask:       f32 validity mask, any shape broadcastable to [B, C, T]
                 (e.g. a plain time mask f32[T]); a pre-broadcast dense
                 mask forces an extra full-size gather + materialisation,
-                so pass the smallest true shape.
+                so pass the smallest true shape. ``None`` derives the mask
+                as ``isfinite(tod)`` and NaN-fills ``tod`` on device —
+                the HDF5 ingest path uses this so the host never ships a
+                dense mask (halves transfer bytes and HBM residency; the
+                ``isfinite`` fuses into the scan gather's consumers).
     airmass:    f32[T].
     starts, lengths: i32[S] scan geometry (host-derived, static count).
     tsys, sys_gain:  f32[B, C] from the vane calibration.
@@ -176,6 +282,9 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
     vmap over feeds; shard_map the feed axis over the mesh.
     """
     B, C, T = tod.shape
+    if mask is None:
+        mask = jnp.isfinite(tod).astype(tod.dtype)
+        tod = jnp.nan_to_num(tod)
     t_valid = (jnp.arange(L)[None, :] < lengths[:, None]).astype(tod.dtype)
 
     def per_scan(d_s, m_s, a_s, tv):

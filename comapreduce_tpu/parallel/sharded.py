@@ -37,12 +37,24 @@ __all__ = ["reduce_feeds_sharded", "destripe_sharded",
 
 
 @functools.lru_cache(maxsize=32)
-def _reduce_feeds_fn(cfg: ReduceConfig, n_scans: int, L: int):
+def _reduce_feeds_fn(cfg: ReduceConfig, n_scans: int, L: int,
+                     with_mask: bool = True):
     """Cached jitted vmap-over-feeds reduction (one compile per geometry,
-    not one per call — a filelist run calls this once per batch)."""
-    fn = jax.vmap(
-        functools.partial(reduce_feed_scans, cfg=cfg, n_scans=n_scans, L=L),
-        in_axes=(0, 0, 0, None, None, 0, 0, None))
+    not one per call — a filelist run calls this once per batch).
+
+    ``with_mask=False`` is the NaN-carrying ingest path: the per-feed mask
+    is derived on device (``reduce_feed_scans`` with ``mask=None``)."""
+    if with_mask:
+        fn = jax.vmap(
+            functools.partial(reduce_feed_scans, cfg=cfg, n_scans=n_scans,
+                              L=L),
+            in_axes=(0, 0, 0, None, None, 0, 0, None))
+    else:
+        def one(tod, airmass, starts, lengths, tsys, sys_gain, freq):
+            return reduce_feed_scans(tod, None, airmass, starts, lengths,
+                                     tsys, sys_gain, freq, cfg=cfg,
+                                     n_scans=n_scans, L=L)
+        fn = jax.vmap(one, in_axes=(0, 0, None, None, 0, 0, None))
     return jax.jit(fn)
 
 
@@ -55,7 +67,8 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     ``airmass`` f32[F, T], ``tsys``/``sys_gain`` f32[F, B, C]. Scan geometry
     (``starts``/``lengths``) and ``freq_scaled`` f32[B, C] are shared by all
     feeds (replicated). Returns the dict of :func:`reduce_feed_scans` with a
-    leading feed axis, feed-sharded.
+    leading feed axis, feed-sharded. ``mask=None`` ships NaN-carrying
+    counts and derives validity on device (half the host->device bytes).
     """
     n_scans = int(starts.shape[0])
     # L is static inside reduce_feed_scans; recover it the same way the
@@ -68,7 +81,8 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     repl = NamedSharding(mesh, P())
 
     tod = jax.device_put(tod, feed_sharded)
-    mask = jax.device_put(mask, feed_sharded)
+    if mask is not None:
+        mask = jax.device_put(mask, feed_sharded)
     airmass = jax.device_put(airmass, feed_sharded)
     tsys = jax.device_put(tsys, feed_sharded)
     sys_gain = jax.device_put(sys_gain, feed_sharded)
@@ -76,8 +90,11 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     lengths = jax.device_put(jnp.asarray(lengths), repl)
     freq_scaled = jax.device_put(freq_scaled, repl)
 
-    fn = _reduce_feeds_fn(cfg, n_scans, L)
+    fn = _reduce_feeds_fn(cfg, n_scans, L, with_mask=mask is not None)
     with mesh:
+        if mask is None:
+            return fn(tod, airmass, starts, lengths, tsys, sys_gain,
+                      freq_scaled)
         return fn(tod, mask, airmass, starts, lengths, tsys,
                   sys_gain, freq_scaled)
 

@@ -37,7 +37,8 @@ import numpy as np
 from comapreduce_tpu.ops import power as power_ops
 from comapreduce_tpu.ops import vane as vane_ops
 from comapreduce_tpu.ops.atmosphere import fit_atmosphere_segments
-from comapreduce_tpu.ops.reduce import ReduceConfig, scan_starts_lengths
+from comapreduce_tpu.ops.reduce import (ReduceConfig, plan_reduce_memory,
+                                        scan_starts_lengths)
 from comapreduce_tpu.ops.spikes import spike_mask
 from comapreduce_tpu.ops.stats import auto_rms
 from comapreduce_tpu.data.scan_edges import segment_ids_from_edges
@@ -240,10 +241,15 @@ class MeasureSystemTemperature(_StageBase):
 @functools.lru_cache(maxsize=32)
 def _batched_atmosphere_fit(n_scans: int):
     """Cached jitted vmap-over-feeds atmosphere fit (one compile per scan
-    count, not one per file)."""
-    return jax.jit(jax.vmap(
-        functools.partial(fit_atmosphere_segments, n_scans=n_scans),
-        in_axes=(0, 0, None, 0)))
+    count, not one per file). Takes NaN-carrying raw counts and a time
+    mask (f32[T] or scalar 1); validity is derived on device so the host
+    never builds or ships a dense (B, C, T) mask."""
+    def one(raw, airmass, seg, tmask):
+        mask = jnp.isfinite(raw).astype(jnp.float32) * tmask
+        return fit_atmosphere_segments(jnp.nan_to_num(raw), airmass, seg,
+                                       mask, n_scans=n_scans)
+
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, None)))
 
 
 def mean_vane_tsys_gain(level2):
@@ -285,15 +291,14 @@ class SkyDip(_StageBase):
         fit = _batched_atmosphere_fit(1)
         fits = np.zeros((F, data.tod_shape[1], 2, data.tod_shape[2]),
                         np.float32)
+        on_j = jnp.asarray(on.astype(np.float32))
         fb = self.feed_batch or F
         for i in range(0, F, fb):
             idx = list(range(i, min(i + fb, F)))
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
-            mask = (np.isfinite(raw) & on).astype(np.float32)
-            off, slope = fit(jnp.asarray(np.nan_to_num(raw)),
-                             jnp.asarray(airmass_all[idx]), seg_j,
-                             jnp.asarray(mask))
+            off, slope = fit(jnp.asarray(raw),
+                             jnp.asarray(airmass_all[idx]), seg_j, on_j)
             fits[idx] = np.stack([np.asarray(off)[..., 0],
                                   np.asarray(slope)[..., 0]], axis=-2)
         self._data = {"skydip/fits": fits}  # (F, B, 2, C)
@@ -333,10 +338,9 @@ class AtmosphereRemoval(_StageBase):
             idx = list(range(i, min(i + fb, F)))
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
-            mask = np.isfinite(raw).astype(np.float32)
-            off, atm = fit(jnp.asarray(np.nan_to_num(raw)),
+            off, atm = fit(jnp.asarray(raw),
                            jnp.asarray(airmass_all[idx]), seg_j,
-                           jnp.asarray(mask))
+                           jnp.float32(1.0))
             # (f, B, C, S) pair -> (S, f, B, 2, C)
             blk = np.stack([np.asarray(off), np.asarray(atm)], axis=0)
             out[:, idx] = np.transpose(blk, (4, 1, 2, 0, 3))
@@ -368,10 +372,15 @@ class Level1AveragingGainCorrection(_StageBase):
     # path, quantified in tests/test_medfilt_parity.py); 1 = exact filter
     medfilt_stride: int | None = None
     pad_to: int = 128
-    # feeds per device batch (0 = all feeds in one program); production
-    # observations (~45 min) need batching to bound HBM: ~2.2 GB per feed
-    feed_batch: int = 0
-    # scans streamed per chunk inside the reduction (None = all at once)
+    # feeds per device batch (0 = all feeds in one program). The default
+    # fits a 16 GB chip at production shape (F=19, B=4, C=1024, T~135k:
+    # ~2.2 GB of raw counts per feed) with scan streaming auto-selected;
+    # every config is re-checked against the device HBM budget before
+    # dispatch (ops.reduce.plan_reduce_memory), which raises with a
+    # suggested feed_batch instead of letting the device OOM.
+    feed_batch: int = 2
+    # scans streamed per chunk inside the reduction (None = auto: all at
+    # once when it fits the HBM budget, else the largest fitting chunk)
     scan_batch: int | None = None
     prefetch: bool = True
     figure_dir: str = ""
@@ -396,10 +405,6 @@ class Level1AveragingGainCorrection(_StageBase):
 
         F, B, C, T = data.tod_shape
         starts, lengths, L = scan_starts_lengths(edges, pad_to=self.pad_to)
-        cfg = ReduceConfig(C, medfilt_window=min(self.medfilt_window, L),
-                           is_calibrator=data.is_calibrator,
-                           medfilt_stride=self.medfilt_stride,
-                           scan_batch=self.scan_batch)
         freq = data.frequency.astype(np.float32)  # (B, C) GHz
         f0 = freq.mean(axis=1, keepdims=True)
         freq_scaled = ((freq - f0) / f0).astype(np.float32)
@@ -412,19 +417,34 @@ class Level1AveragingGainCorrection(_StageBase):
         local = jax.local_devices()
         mesh = feed_time_mesh(local, n_feed=len(local))
         n_dev = mesh.shape["feed"]
-        fb = self.feed_batch or F
-        fb = -(-min(fb, F) // n_dev) * n_dev
+        fb = -(-min(self.feed_batch or F, F) // n_dev) * n_dev
+        # HBM budget check on the PER-DEVICE footprint (each device of the
+        # feed mesh holds fb/n_dev feeds); auto-picks scan streaming, or
+        # raises naming a feed_batch that fits — before the device OOMs
+        scan_batch = plan_reduce_memory(fb // n_dev, B, C, T, len(edges),
+                                        L, self.scan_batch,
+                                        suggest_scale=n_dev)
+        if scan_batch != self.scan_batch:
+            logger.info("Level1AveragingGainCorrection: streaming %s "
+                        "scans per chunk to fit device memory", scan_batch)
+        cfg = ReduceConfig(C, medfilt_window=min(self.medfilt_window, L),
+                           is_calibrator=data.is_calibrator,
+                           medfilt_stride=self.medfilt_stride,
+                           scan_batch=scan_batch)
         batches = [list(range(i, min(i + fb, F))) for i in range(0, F, fb)]
 
         def load(idx):
-            """Read one feed batch from the lazy store (worker thread)."""
+            """Read one feed batch from the lazy store (worker thread).
+
+            NaNs ride along: the reduction derives validity on device
+            (``mask=None`` path) so neither a dense mask nor a NaN-filled
+            copy is built on host."""
             raws = [np.asarray(data.read_tod_feed(i), dtype=np.float32)
                     for i in idx]
             raws += [raws[0]] * (fb - len(idx))        # pad: results dropped
             raw = np.stack(raws)
-            mask = np.isfinite(raw).astype(np.float32)
             am = airmass_all[idx + [idx[0]] * (fb - len(idx))]
-            return np.nan_to_num(raw), mask, am
+            return raw, am
 
         def pad_cal(x, idx):
             sel = x[idx]
@@ -443,11 +463,11 @@ class Level1AveragingGainCorrection(_StageBase):
         with ThreadPoolExecutor(max_workers=1) as ex:
             fut = ex.submit(load, batches[0])
             for bi, idx in enumerate(batches):
-                raw, mask, am = fut.result()
+                raw, am = fut.result()
                 if self.prefetch and bi + 1 < len(batches):
                     fut = ex.submit(load, batches[bi + 1])
                 res = reduce_feeds_sharded(
-                    mesh, raw, mask, am, starts_j, lengths_j,
+                    mesh, raw, None, am, starts_j, lengths_j,
                     pad_cal(tsys, idx), pad_cal(sys_gain, idx),
                     freq_scaled, cfg)
                 # device -> host copy blocks here while the worker thread
@@ -490,7 +510,15 @@ class Spikes(_StageBase):
 
     def __call__(self, data, level2) -> bool:
         tod = np.asarray(level2.tod, dtype=np.float32)
-        valid = (tod != 0).astype(np.float32)
+        # validity comes from the reduction's real per-sample weights
+        # (zero outside scans / for dead channels) — a genuine zero-valued
+        # TOD sample stays valid. Fall back to the tod != 0 sentinel only
+        # for stores that predate the weights dataset.
+        if "averaged_tod/weights" in level2:
+            valid = (np.asarray(level2["averaged_tod/weights"],
+                                dtype=np.float32) > 0).astype(np.float32)
+        else:
+            valid = (tod != 0).astype(np.float32)
         T = tod.shape[-1]
         mask = spike_mask(tod, window=min(self.window, max(3, T // 2 * 2 - 1)),
                           threshold=self.threshold, pad=self.pad, valid=valid)
@@ -500,15 +528,49 @@ class Spikes(_StageBase):
         return True
 
 
+def bucket_scan_lengths(edges: np.ndarray, quantum: int) -> dict:
+    """Group scan indices by quantised fit length: {length: [scan ids]}.
+
+    Scans are fitted at their own length rounded DOWN to the ``quantum``
+    grid (scans shorter than the quantum round to an even length);
+    anything under 16 samples is unfittable and dropped. Shared by the
+    device and numpy noise stages so a per-stage backend switch fits
+    identical blocks; ``quantum=1`` reproduces the reference's exact
+    full-length fits (``Level2Data.py:288-329``)."""
+    q = max(int(quantum), 1)
+    buckets: dict[int, list[int]] = {}
+    for si, (s, e) in enumerate(np.asarray(edges)):
+        ln = int(e - s)
+        lq = (ln // q) * q if ln >= q else ln // 2 * 2
+        if lq >= 16:
+            buckets.setdefault(lq, []).append(si)
+    return buckets
+
+
+def first_fitted_scan(buckets: dict, edges: np.ndarray):
+    """(scan id, fit length, start) of the first fitted scan — the QA
+    figure target, shared by both noise-stage backends."""
+    si0 = min(min(v) for v in buckets.values())
+    lq0 = next(lq for lq, v in sorted(buckets.items()) if si0 in v)
+    return si0, lq0, int(np.asarray(edges)[si0, 0])
+
+
 @register()
 @dataclass
 class Level2FitPowerSpectrum(_StageBase):
     """Per-(feed, band, scan) noise power-spectrum fit of the averaged TOD.
 
     Red-noise model ``sigma_w^2 + sigma_r^2 |nu|^alpha``
-    (``Level2Data.py:246-329``). Scans are truncated to the shortest scan
-    (static FFT length — one compiled kernel for the whole cube). Writes
-    ``fnoise_fits/{fnoise_fit_parameters (F,B,S,3), auto_rms (F,B,S)}``."""
+    (``Level2Data.py:246-329``, which fits each scan at its own full
+    length). Each scan is fitted at its OWN length, rounded down to the
+    ``length_quantum`` grid: scans of like length share one compiled
+    kernel (one jit per distinct bucket, not per scan), and a single
+    short stub no longer destroys the low-frequency leverage of every
+    full-length scan the way a truncate-to-shortest scheme would. Writes
+    ``fnoise_fits/{fnoise_fit_parameters (F,B,S,3), auto_rms (F,B,S)}``;
+    scans too short to fit (< 16 samples) hold NaN — downstream medians
+    (``database/obsdb.py`` fleet stats) are nan-aware, and zeros would
+    silently drag them."""
 
     groups: tuple = ("fnoise_fits",)
     nbins: int = 30
@@ -518,7 +580,14 @@ class Level2FitPowerSpectrum(_StageBase):
     # exclude resonance spikes >100x the white level from the binned PSD
     # before fitting (Level2Data.py:288-298)
     mask_peaks: bool = True
+    # scans are fitted at their length rounded DOWN to this grid (<1% of
+    # a production 13.5k-sample scan); 1 = every distinct (even) length
+    # compiles its own kernel
+    length_quantum: int = 128
     figure_dir: str = ""
+
+    def _bucket_scans(self, edges: np.ndarray) -> dict[int, list[int]]:
+        return bucket_scan_lengths(edges, self.length_quantum)
 
     def __call__(self, data, level2) -> bool:
         tod = np.asarray(level2.tod, dtype=np.float32)  # (F, B, T)
@@ -526,23 +595,29 @@ class Level2FitPowerSpectrum(_StageBase):
         if len(edges) == 0:
             self.STATE = False
             return False
-        Lmin = int((edges[:, 1] - edges[:, 0]).min()) // 2 * 2
-        if Lmin < 16:
+        buckets = self._bucket_scans(edges)
+        if not buckets:
             self.STATE = False
             return False
         F, B = tod.shape[:2]
         S = len(edges)
-        blocks = np.stack([tod[..., s:s + Lmin] for s, _ in edges],
-                          axis=2)  # (F, B, S, Lmin)
-        fit = power_ops.fit_observation_noise(
-            jnp.asarray(blocks), sample_rate=self.sample_rate,
-            nbins=self.nbins, model_name=self.model_name,
-            mask_peaks=self.mask_peaks)
-        params = np.asarray(fit).reshape(F, B, S, 3)
+        params = np.full((F, B, S, 3), np.nan, np.float32)
+        rms = np.full((F, B, S), np.nan, np.float32)
+        for lq, sids in sorted(buckets.items()):
+            blocks = np.stack(
+                [tod[..., edges[si, 0]:edges[si, 0] + lq] for si in sids],
+                axis=2)  # (F, B, s, lq)
+            fit = power_ops.fit_observation_noise(
+                jnp.asarray(blocks), sample_rate=self.sample_rate,
+                nbins=self.nbins, model_name=self.model_name,
+                mask_peaks=self.mask_peaks)
+            params[:, :, sids] = np.asarray(fit)
+            rms[:, :, sids] = np.asarray(auto_rms(jnp.asarray(blocks)))
         if self.figure_dir:
             from comapreduce_tpu import diagnostics
 
-            freqs, ps = power_ops.psd(jnp.asarray(blocks[0, 0, 0]),
+            si0, lq0, s0 = first_fitted_scan(buckets, edges)
+            freqs, ps = power_ops.psd(jnp.asarray(tod[0, 0, s0:s0 + lq0]),
                                       self.sample_rate)
             nu, pb, _ = power_ops.log_bin_psd(freqs, ps, nbins=self.nbins)
             model = (power_ops.red_noise_model
@@ -551,9 +626,8 @@ class Level2FitPowerSpectrum(_StageBase):
             diagnostics.plot_power_spectrum_fit(
                 diagnostics.figure_path(
                     self.figure_dir, data.obsid,
-                    f"{self.out_group}_feed00_band00_scan00"),
-                np.asarray(nu), np.asarray(pb), params[0, 0, 0], model)
-        rms = np.asarray(auto_rms(jnp.asarray(blocks)))  # (F, B, S)
+                    f"{self.out_group}_feed00_band00_scan{si0:02d}"),
+                np.asarray(nu), np.asarray(pb), params[0, 0, si0], model)
         self._data = {
             f"{self.out_group}/fnoise_fit_parameters": params,
             f"{self.out_group}/auto_rms": rms,

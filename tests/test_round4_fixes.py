@@ -1,0 +1,250 @@
+"""Round-4 fixes: HBM budget guard, per-scan-length noise fits,
+weights-based spike validity, NaN-carrying (mask=None) reduction ingest.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from comapreduce_tpu.data.level import COMAPLevel1, COMAPLevel2
+from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                            generate_level1_file)
+from comapreduce_tpu.ops.reduce import (ReduceConfig, estimate_reduce_hbm,
+                                        plan_reduce_memory,
+                                        reduce_feed_scans,
+                                        scan_starts_lengths)
+from comapreduce_tpu.pipeline import resolve
+
+# production COMAP shape: 19 feeds x 4 bands x 1024 channels x ~45 min
+PROD = dict(B=4, C=1024, T=135_704, n_scans=10, L=13_568)
+
+
+# ---------------------------------------------------------------- HBM guard
+
+def test_default_feed_batch_fits_16gb():
+    """The stage default (feed_batch=2) must fit a 16 GB chip at the
+    production shape, possibly via auto scan streaming (VERDICT r3 #2)."""
+    sb = plan_reduce_memory(2, **PROD, scan_batch=None,
+                            hbm_bytes=16 << 30)
+    est = estimate_reduce_hbm(2, **PROD, scan_batch=sb)
+    assert est <= 0.9 * (16 << 30)
+
+
+def test_all_feeds_at_once_raises_with_suggestion():
+    """feed_batch=19 (all feeds, the old default 0) at production shape
+    cannot fit 16 GB; the guard must raise and name a batch that does."""
+    with pytest.raises(ValueError, match="feed_batch="):
+        plan_reduce_memory(19, **PROD, scan_batch=None,
+                           hbm_bytes=16 << 30)
+
+
+def test_auto_scan_batch_prefers_divisors():
+    """When all-scans-at-once busts the budget, the planner streams with
+    the largest divisor of n_scans that fits (no double-compile chunks)."""
+    sb = plan_reduce_memory(2, **PROD, scan_batch=None,
+                            hbm_bytes=16 << 30)
+    assert sb is not None and PROD["n_scans"] % sb == 0
+
+
+def test_explicit_scan_batch_respected_when_it_fits():
+    assert plan_reduce_memory(1, B=2, C=32, T=4000, n_scans=4, L=1024,
+                              scan_batch=2, hbm_bytes=16 << 30) == 2
+
+
+def test_explicit_scan_batch_shrinks_to_fit():
+    """An explicit scan_batch acts as an upper bound: when it busts the
+    budget but a smaller chunk fits, the planner shrinks instead of
+    raising (its docstring contract)."""
+    sb = plan_reduce_memory(4, **PROD, scan_batch=10, hbm_bytes=16 << 30)
+    assert sb is not None and sb < 10
+    assert estimate_reduce_hbm(4, **PROD, scan_batch=sb) <= 0.9 * (16 << 30)
+
+
+def test_unfittable_stub_scan_holds_nan_not_zero():
+    """Sub-16-sample stubs get NaN parameters so fleet nanmedians ignore
+    them (zeros would drag the stats toward zero)."""
+    rng = np.random.default_rng(9)
+    edges = np.array([[10, 1290], [1300, 1310]])  # 1280 + a 10-sample stub
+    T = 1400
+    tod = np.zeros((1, 1, T), np.float32)
+    tod[0, 0, 10:1290] = 1e-3 * rng.standard_normal(1280)
+    for backend in ("tpu", "numpy"):
+        lvl2 = COMAPLevel2(filename="unused.hd5")
+        lvl2["averaged_tod/tod"] = tod
+        lvl2["averaged_tod/scan_edges"] = edges
+        st = resolve("NoiseStatistics", backend=backend, nbins=20,
+                     mask_peaks=False)
+        assert st(None, lvl2)
+        p = dict(st.save_data[0])["noise_statistics/fnoise_fit_parameters"]
+        assert np.isfinite(p[0, 0, 0]).all(), backend
+        assert np.isnan(p[0, 0, 1]).all(), backend
+
+
+def test_guard_fires_through_the_stage(tmp_path, monkeypatch):
+    """The gain stage consults the guard before dispatch: with a tiny
+    HBM budget it raises (with the feed_batch hint) instead of OOMing."""
+    params = SyntheticObsParams(n_feeds=2, n_bands=2, n_channels=32,
+                                n_scans=2, scan_samples=500,
+                                vane_samples=250, seed=3)
+    path = str(tmp_path / "obs.hd5")
+    generate_level1_file(path, params)
+    data = COMAPLevel1()
+    data.read(path)
+    lvl2 = COMAPLevel2(filename=str(tmp_path / "l2.hd5"))
+    vane = resolve("MeasureSystemTemperature")
+    assert vane(data, lvl2)
+    lvl2.update(vane)
+    stage = resolve("Level1AveragingGainCorrection", medfilt_window=101)
+    monkeypatch.setenv("COMAP_HBM_BYTES", str(1 << 20))  # 1 MiB "chip"
+    with pytest.raises(ValueError, match="feed_batch"):
+        stage(data, lvl2)
+
+
+# ------------------------------------------------- NaN ingest (mask=None)
+
+def test_reduce_mask_none_matches_explicit_mask():
+    """reduce_feed_scans(mask=None) on NaN-carrying counts must equal the
+    explicit nan_to_num + isfinite-mask path bit for bit."""
+    rng = np.random.default_rng(11)
+    B, C, T = 2, 16, 1200
+    edges = np.array([[10, 590], [610, 1190]])
+    raw = 1e3 * (1.0 + 0.01 * rng.standard_normal((B, C, T))).astype(
+        np.float32)
+    raw[0, 3, 100:120] = np.nan
+    raw[1, :, 700] = np.nan
+    starts, lengths, L = scan_starts_lengths(edges)
+    cfg = ReduceConfig(C, medfilt_window=101)
+    tsys = np.full((B, C), 40.0, np.float32)
+    gain = np.full((B, C), 1e3, np.float32)
+    freq = np.broadcast_to(np.linspace(-0.1, 0.1, C), (B, C)).astype(
+        np.float32)
+    am = np.full(T, 1.2, np.float32)
+    kw = dict(cfg=cfg, n_scans=len(edges), L=L)
+    args = (jnp.asarray(am), jnp.asarray(starts, jnp.int32),
+            jnp.asarray(lengths, jnp.int32), jnp.asarray(tsys),
+            jnp.asarray(gain), jnp.asarray(freq))
+    explicit = reduce_feed_scans(
+        jnp.asarray(np.nan_to_num(raw)),
+        jnp.asarray(np.isfinite(raw).astype(np.float32)), *args, **kw)
+    derived = reduce_feed_scans(jnp.asarray(raw), None, *args, **kw)
+    for k in ("tod", "tod_original", "weights"):
+        np.testing.assert_array_equal(np.asarray(explicit[k]),
+                                      np.asarray(derived[k]))
+
+
+# ------------------------------------------- per-scan-length noise fits
+
+def _one_over_f(T, fknee, alpha, sigma, rng, fs=50.0):
+    """White + 1/f noise with a known knee, via FFT shaping."""
+    w = rng.standard_normal(T)
+    f = np.fft.rfftfreq(T, d=1.0 / fs)
+    shape = np.sqrt(1.0 + (np.maximum(f, f[1]) / fknee) ** alpha)
+    x = np.fft.irfft(np.fft.rfft(w) * shape, n=T)
+    return sigma * x / x.std()
+
+
+def test_ragged_scans_fit_at_own_length():
+    """A 10x scan-length spread: each scan is fitted at its own length,
+    and the long scan's fknee stays within 5% of the per-scan f64 numpy
+    oracle (VERDICT r3 #3; ref Level2Data.py:288-329)."""
+    rng = np.random.default_rng(5)
+    fs, fknee, alpha = 50.0, 1.0, -2.0
+    l_short, l_long = 1280, 12800
+    gap = 64
+    edges = np.array([[gap, gap + l_short],
+                      [2 * gap + l_short, 2 * gap + l_short + l_long]])
+    T = int(edges[-1, 1]) + gap
+    tod = np.zeros((1, 1, T), np.float32)
+    for s, e in edges:
+        tod[0, 0, s:e] = _one_over_f(e - s, fknee, alpha, 1e-3, rng, fs)
+
+    lvl2 = COMAPLevel2(filename="unused.hd5")
+    lvl2["averaged_tod/tod"] = tod
+    lvl2["averaged_tod/scan_edges"] = edges
+
+    outs = {}
+    for backend in ("tpu", "numpy"):
+        st = resolve("NoiseStatistics", backend=backend, nbins=25,
+                     mask_peaks=False)
+        assert st(None, lvl2)
+        outs[backend] = dict(st.save_data[0])[
+            "noise_statistics/fnoise_fit_parameters"][0, 0]
+    dev, orc = outs["tpu"], outs["numpy"]
+    # the long scan's knee is well constrained: device vs f64 oracle < 5%
+    assert abs(dev[1, 1] - orc[1, 1]) / orc[1, 1] < 0.05
+    # and the oracle itself recovers the injected knee sanely on the
+    # long scan (order-of-magnitude guard that the fit is real)
+    assert 0.5 * fknee < orc[1, 1] < 2.0 * fknee
+    # the short scan must NOT have been truncated into the long one's
+    # geometry: its fit ran, at its own (shorter) length
+    assert dev[0, 0] > 0  # sigma_w^2 fitted, not zeros
+
+
+def test_short_stub_does_not_poison_long_scans():
+    """Old behavior truncated EVERY scan to the shortest; a 100-sample
+    stub must now leave the long scan's parameters unchanged."""
+    rng = np.random.default_rng(7)
+    l_long = 12800
+    edges_solo = np.array([[64, 64 + l_long]])
+    tod_long = _one_over_f(l_long, 1.0, -2.0, 1e-3, rng)
+    T = 64 + l_long + 300
+    tod = np.zeros((1, 1, T), np.float32)
+    tod[0, 0, 64:64 + l_long] = tod_long
+
+    lvl2 = COMAPLevel2(filename="unused.hd5")
+    lvl2["averaged_tod/tod"] = tod
+    lvl2["averaged_tod/scan_edges"] = edges_solo
+    st = resolve("NoiseStatistics", nbins=25, mask_peaks=False)
+    assert st(None, lvl2)
+    solo = dict(st.save_data[0])[
+        "noise_statistics/fnoise_fit_parameters"][0, 0, 0]
+
+    # same observation plus a 100-sample stub scan in the tail gap
+    edges_stub = np.vstack([edges_solo,
+                            [64 + l_long + 100, 64 + l_long + 200]])
+    tod2 = tod.copy()
+    tod2[0, 0, 64 + l_long + 100:64 + l_long + 200] = \
+        1e-3 * rng.standard_normal(100)
+    lvl2b = COMAPLevel2(filename="unused2.hd5")
+    lvl2b["averaged_tod/tod"] = tod2
+    lvl2b["averaged_tod/scan_edges"] = edges_stub
+    st2 = resolve("NoiseStatistics", nbins=25, mask_peaks=False)
+    assert st2(None, lvl2b)
+    both = dict(st2.save_data[0])[
+        "noise_statistics/fnoise_fit_parameters"][0, 0]
+    np.testing.assert_allclose(both[0], solo, rtol=1e-6)
+
+
+# ------------------------------------------------- spike validity source
+
+def test_spike_on_genuine_zero_sample():
+    """A valid sample whose value is exactly 0.0 (a spike crossing zero)
+    must still be flaggable: validity comes from the weights, not the
+    tod != 0 sentinel (VERDICT r3 weak #5)."""
+    rng = np.random.default_rng(13)
+    T = 4000
+    base = 5.0 + 0.01 * rng.standard_normal(T).astype(np.float32)
+    tod = base.copy()
+    k = 2000
+    tod[k] = 0.0          # a -5 sigma... actually -500 sigma spike, AT 0.0
+    weights = np.ones(T, np.float32)
+    lvl2 = COMAPLevel2(filename="unused.hd5")
+    lvl2["averaged_tod/tod"] = tod[None, None, :]
+    lvl2["averaged_tod/weights"] = weights[None, None, :]
+    lvl2["averaged_tod/scan_edges"] = np.array([[0, T]])
+
+    for backend in ("tpu", "numpy"):
+        st = resolve("Spikes", backend=backend, window=101, pad=2)
+        assert st(None, lvl2)
+        mask = dict(st.save_data[0])["spikes/spike_mask"][0, 0]
+        assert mask[k] == 1, backend
+
+    # and samples with zero weight must never flag
+    weights2 = weights.copy()
+    weights2[k] = 0.0
+    lvl2["averaged_tod/weights"] = weights2[None, None, :]
+    for backend in ("tpu", "numpy"):
+        st = resolve("Spikes", backend=backend, window=101, pad=2)
+        assert st(None, lvl2)
+        mask = dict(st.save_data[0])["spikes/spike_mask"][0, 0]
+        assert mask[k] == 0, backend
