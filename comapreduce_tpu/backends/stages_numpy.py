@@ -19,7 +19,7 @@ from comapreduce_tpu.ops.reduce import ReduceConfig, scan_starts_lengths
 from comapreduce_tpu.pipeline.registry import register
 from comapreduce_tpu.pipeline.stages import _StageBase, mean_vane_tsys_gain
 
-__all__ = ["MeasureSystemTemperatureNumpy",
+__all__ = ["MeasureSystemTemperatureNumpy", "Level1AveragingNumpy",
            "Level1AveragingGainCorrectionNumpy",
            "SpikesNumpy", "Level2FitPowerSpectrumNumpy",
            "NoiseStatisticsNumpy"]
@@ -51,6 +51,63 @@ class MeasureSystemTemperatureNumpy(_StageBase):
         self._data = {
             "vane/system_temperature": np.asarray(tsys, np.float32),
             "vane/system_gain": np.asarray(gain, np.float32),
+        }
+        self.STATE = True
+        return True
+
+
+@register("Level1Averaging", backend="numpy")
+@dataclass
+class Level1AveragingNumpy(_StageBase):
+    """Plain frequency-binning reduction on host in f64 (oracle for the
+    device ``Level1Averaging``; ref ``Level1Averaging.py:292-321``)."""
+
+    groups: tuple = ("frequency_binned",)
+    frequency_bin_size: int = 512
+    feed_batch: int = 4   # config parity; the host path streams per feed
+
+    def __call__(self, data, level2) -> bool:
+        from comapreduce_tpu.ops.average import edge_channel_mask
+        from comapreduce_tpu.pipeline.stages import mean_vane_tsys_gain
+
+        try:
+            tsys, gain = mean_vane_tsys_gain(level2)
+        except KeyError:
+            logger.warning("Level1Averaging[numpy]: obs %s has no vane "
+                           "calibration", data.obsid)
+            self.STATE = False
+            return False
+        F, B, C, T = (int(x) for x in data.tod_shape)
+        bin_size = min(self.frequency_bin_size, C)
+        nb = C // bin_size
+
+        def s(n):
+            return max(int(round(n * C / 1024.0)), 1)
+        chan_mask = np.asarray(edge_channel_mask(C, s(10), s(1), s(2)),
+                               np.float64)
+        tsys = np.asarray(tsys, np.float64)
+        gain = np.asarray(gain, np.float64)
+        w = np.where(tsys > 0, 1.0 / np.maximum(tsys, 1e-10) ** 2, 0.0)
+        w = w * chan_mask                                 # (F, B, C)
+        tod_out = np.zeros((F, B, nb, T), np.float32)
+        std_out = np.zeros((F, B, nb, T), np.float32)
+        for ifeed in range(F):
+            raw = np.nan_to_num(
+                np.asarray(data.read_tod_feed(ifeed), np.float64))
+            g = np.where(gain[ifeed] > 0, gain[ifeed], 1.0)[..., None]
+            tod = raw / g
+            wf = w[ifeed][:, :C // bin_size * bin_size]
+            x = tod[:, :nb * bin_size].reshape(B, nb, bin_size, T)
+            wr = wf.reshape(B, nb, bin_size)[..., None]
+            den = np.maximum(wr.sum(axis=2), 1e-30)
+            avg = (x * wr).sum(axis=2) / den
+            d = x - avg[:, :, None, :]
+            var = (d * d * wr).sum(axis=2) / den
+            tod_out[ifeed] = avg
+            std_out[ifeed] = np.sqrt(np.maximum(var, 0.0))
+        self._data = {
+            "frequency_binned/tod": tod_out,
+            "frequency_binned/tod_stddev": std_out,
         }
         self.STATE = True
         return True

@@ -56,19 +56,18 @@ _PLAN_MEMO: dict = {}
 
 def _memoized(tag: str, pixels: np.ndarray, extra_key: tuple, build):
     """Digest-keyed memo: the key hashes the pixel vector's content
-    (~10x cheaper than the plan build it avoids); one pointing is kept
-    in flight at a time."""
+    (~10x cheaper than the plan build it avoids). One slot PER TAG —
+    'single' and 'sharded' solvers against the same pointing coexist
+    (alternating them must not thrash the memo and recompile)."""
     import hashlib
 
     pixels = np.ascontiguousarray(pixels)
-    key = (tag, pixels.shape, str(pixels.dtype), extra_key,
+    key = (pixels.shape, str(pixels.dtype), extra_key,
            hashlib.sha1(pixels.tobytes()).hexdigest())
-    hit = _PLAN_MEMO.get(key)
-    if hit is None:
-        hit = build(pixels)
-        _PLAN_MEMO.clear()
-        _PLAN_MEMO[key] = hit
-    return hit
+    slot = _PLAN_MEMO.get(tag)
+    if slot is None or slot[0] != key:
+        _PLAN_MEMO[tag] = slot = (key, build(pixels))
+    return slot[1]
 
 
 def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,

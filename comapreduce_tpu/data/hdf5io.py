@@ -144,6 +144,13 @@ class HDF5Store:
             # h5py module state may already be torn down at interpreter exit.
             pass
 
+    @property
+    def source_filename(self) -> str:
+        """Absolute path this store mirrors: the file last ``read``, or —
+        for a store that has only ever been written — the last full
+        ``write`` target ('' before either)."""
+        return self._mirrors
+
     def read(self, filename: str) -> "HDF5Store":
         """Read every dataset and attribute in ``filename`` into the store.
 

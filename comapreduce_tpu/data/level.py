@@ -19,7 +19,8 @@ import numpy as np
 from comapreduce_tpu.data import scan_edges as se
 from comapreduce_tpu.data.hdf5io import HDF5Store
 
-__all__ = ["COMAPLevel1", "COMAPLevel2", "CALIBRATOR_NAMES", "decode_features"]
+__all__ = ["COMAPLevel1", "COMAPLevel2", "CALIBRATOR_NAMES",
+           "decode_features", "find_level1_by_obsid"]
 
 # Calibrator source names recognised by the pipeline
 # (reference Tools/Coordinates.py:7-15 CalibratorList).
@@ -30,6 +31,31 @@ CALIBRATOR_NAMES = ("TauA", "CasA", "CygA", "jupiter", "Jupiter", "mars",
 # (DataHandling.py:320-326). Time('2022-02-01').mjd == 59611.0.
 _VANE_EPOCH_MJD = 59611.0
 _KELVIN_OFFSET = 273.15
+
+
+def find_level1_by_obsid(data_dir: str, obsid: int) -> str | None:
+    """Path of the Level-1 file for ``obsid`` in ``data_dir``, or None.
+
+    Matches the COMAP naming scheme ``comap-{obsid:07d}-*.hd5`` first,
+    then any ``*.hd5`` whose LEADING filename token (optionally after a
+    ``comap``/``comp`` prefix) is the obsid — a timestamp later in the
+    name that merely contains the digits (e.g. ``-010000.`` vs obsid
+    10000) can never match (parity: ``read_data_file_by_obsid``,
+    ``Analysis/DataHandling.py`` — the prior-observation lookup the
+    SkyDip stage uses)."""
+    import glob
+    import os
+    import re
+
+    hits = sorted(glob.glob(os.path.join(data_dir,
+                                         f"comap-{int(obsid):07d}-*.hd5")))
+    if hits:
+        return hits[0]
+    token = re.compile(rf"^(?:[A-Za-z]+[-_])?0*{int(obsid)}(?=[-_.])")
+    for path in sorted(glob.glob(os.path.join(data_dir, "*.hd5"))):
+        if token.match(os.path.basename(path)):
+            return path
+    return None
 
 
 def decode_features(features: np.ndarray) -> np.ndarray:

@@ -90,6 +90,10 @@ class SyntheticObsParams:
     alpha: float = 1.5
     sigma_g: float = 5.0e-4       # per-sample rms of dg at f >> fknee
     elevation: float = 55.0       # deg
+    # peak-to-peak elevation drift across the observation (deg) — >0
+    # simulates a sky-nod / sky-dip elevation sweep
+    el_sweep: float = 0.0
+    comment: str = "synthetic observation"
     az_centre: float = 180.0
     az_throw: float = 4.0         # deg, peak-to-peak/2
     ra0: float = 170.0
@@ -152,7 +156,7 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
     sweep_period = 4 * p.az_throw / 0.5
     tri = 2.0 * np.abs((phase / sweep_period) % 1.0 - 0.5) * 2.0 - 1.0
     az = p.az_centre + tri * p.az_throw * scan_flag
-    el = np.full(T, p.elevation)
+    el = p.elevation + p.el_sweep * (np.arange(T) / T - 0.5)
     # small per-feed focal-plane offsets
     feed_dx = 0.05 * rng.normal(size=F)
     feed_dy = 0.05 * rng.normal(size=F)
@@ -221,7 +225,7 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
     store["hk/antenna0/vane/Tshroud"] = tshroud_raw
     store.set_attrs("comap", "obsid", p.obsid)
     store.set_attrs("comap", "source", f"{p.source},sky")
-    store.set_attrs("comap", "comment", "synthetic observation")
+    store.set_attrs("comap", "comment", p.comment)
     store.write(filename)
 
     tsys_truth = t_rx + p.t_cmb + p.t_atm_zenith * np.mean(airmass)

@@ -50,26 +50,35 @@ def aperture_photometry(map_flat, wcs: WCS, lon0: float, lat0: float,
     r = angular_separation(lon0, lat0, lon.ravel(), lat.ravel())
     sel_ap = np.isfinite(r) & (r < r_aperture)
     sel_bg = np.isfinite(r) & (r >= r_in) & (r < r_out)
-    ap = m[sel_ap]
-    bg = m[sel_bg]
-    ap = ap[np.isfinite(ap)]
-    bg = bg[np.isfinite(bg)]
+    ap_raw = m[sel_ap]
+    bg_raw = m[sel_bg]
+    fin_ap = np.isfinite(ap_raw)
+    fin_bg = np.isfinite(bg_raw)
+    ap = ap_raw[fin_ap]
+    bg = bg_raw[fin_bg]
     n = ap.size
     if n == 0:
         return {"flux": np.nan, "flux_err": np.nan,
                 "background": np.nan, "n_pixels": 0}
     background = float(np.median(bg)) if bg.size else 0.0
     flux = float(np.sum(ap - background))
-    # per-pixel noise sigma; the background-median uncertainty adds
-    # n^2 * var_bg / n_bg to the aperture-sum variance
+    # per-pixel noise variances, APERTURE and ANNULUS separately: the
+    # aperture-sum term uses the aperture pixels' depth, the
+    # background-median term (n^2 * var_bg / n_bg) the annulus pixels' —
+    # mixing them misestimates flux_err whenever the two depths differ
     if weight_flat is not None:
-        w = np.asarray(weight_flat, np.float64).reshape(-1)[sel_ap]
-        sig = float(np.sqrt(np.nanmedian(1.0 / np.maximum(w, 1e-30))))
+        w_all = np.asarray(weight_flat, np.float64).reshape(-1)
+        w_ap = w_all[sel_ap][fin_ap]
+        w_bg = w_all[sel_bg][fin_bg]
+        var_ap = float(np.nanmedian(1.0 / np.maximum(w_ap, 1e-30)))
+        var_bg = (float(np.nanmedian(1.0 / np.maximum(w_bg, 1e-30)))
+                  if w_bg.size else var_ap)
     elif bg.size > 1:
-        sig = 1.4826 * float(np.median(np.abs(bg - background)))
+        var_bg = (1.4826 * float(np.median(np.abs(bg - background)))) ** 2
+        var_ap = var_bg
     else:
-        sig = float(np.std(ap))
-    err = sig * np.sqrt(n + (n * n / max(bg.size, 1)))
+        var_ap = var_bg = float(np.var(ap))
+    err = np.sqrt(n * var_ap + (n * n / max(bg.size, 1)) * var_bg)
     return {"flux": flux, "flux_err": float(err),
             "background": background, "n_pixels": int(n)}
 

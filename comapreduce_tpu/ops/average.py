@@ -93,6 +93,8 @@ def frequency_bin(tod: jax.Array, weights: jax.Array, bin_size: int):
         weights.shape[:-1] + (nb, bin_size))[..., None]
     den = jnp.maximum(jnp.sum(w, axis=-2), _EPS)
     avg = jnp.sum(x * w, axis=-2) / den
-    sqr = jnp.sum(x * x * w, axis=-2) / den
-    std = jnp.sqrt(jnp.maximum(sqr - avg * avg, 0.0))
-    return avg, std
+    # centered second pass: E[x^2] - E[x]^2 cancels catastrophically in
+    # f32 when the in-bin scatter is far below the mean (kelvin-scale TOD)
+    d = x - avg[..., None, :]
+    var = jnp.sum(d * d * w, axis=-2) / den
+    return avg, jnp.sqrt(jnp.maximum(var, 0.0))

@@ -115,21 +115,37 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
 
     if window >= _SELECT_MEDIAN_MIN_PALLAS and x.dtype == jnp.float32:
         from comapreduce_tpu.ops.pallas_median import (
-            pallas_supported, pallas_window_ok,
-            rolling_median_windows_pallas)
-        if pallas_supported() and pallas_window_ok(window):
+            pallas_window_ok, rolling_median_windows_pallas)
+        if pallas_window_ok(window):
             # windowed selection entirely in VMEM (Mosaic kernel): no
             # HBM window mats, no layout copies — bit-identical output
-            # (including NaN-in-window -> NaN)
-            return rolling_median_windows_pallas(
-                padded, window,
-                chunk=-(-max(chunk, 128) // 128) * 128)
+            # (including NaN-in-window -> NaN). Dispatch resolves at
+            # LOWERING time, not trace time: a CPU-placed computation
+            # traced on a TPU host takes the XLA branch instead of
+            # embedding an unlowerable Mosaic kernel ('axon' is the
+            # tunnelled-TPU platform name).
+            def _pallas(p):
+                return rolling_median_windows_pallas(
+                    p, window, chunk=-(-max(chunk, 128) // 128) * 128)
 
+            return jax.lax.platform_dependent(
+                padded, tpu=_pallas, axon=_pallas,
+                default=functools.partial(_rolling_median_xla,
+                                          window=window, chunk=chunk, T=T))
+
+    return _rolling_median_xla(padded, window=window, chunk=chunk, T=T)
+
+
+def _rolling_median_xla(padded: jax.Array, *, window: int, chunk: int,
+                        T: int) -> jax.Array:
+    """Generic XLA rolling-median path over pre-padded input (window mats
+    per chunk + radix/sort median) — the non-Mosaic branch of
+    :func:`rolling_median`."""
     n_chunks = -(-T // chunk)
     total = n_chunks * chunk
     seg_len = chunk + window - 1
     # pad tail so every chunk slice is full-size (values unused past T)
-    padded = jnp.pad(padded, [(0, 0)] * (x.ndim - 1)
+    padded = jnp.pad(padded, [(0, 0)] * (padded.ndim - 1)
                      + [(0, total - T)], mode="edge")
     win_idx = (jnp.arange(chunk)[:, None] + jnp.arange(window)[None, :])
 
@@ -149,7 +165,7 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
 
     out = lax.map(body, jnp.arange(n_chunks))  # (n_chunks, ..., chunk)
     out = jnp.moveaxis(out, 0, -2)             # (..., n_chunks, chunk)
-    out = out.reshape(x.shape[:-1] + (total,))
+    out = out.reshape(padded.shape[:-1] + (total,))
     return out[..., :T]
 
 

@@ -50,8 +50,13 @@ def pallas_window_ok(window: int) -> bool:
 
 
 def pallas_supported() -> bool:
-    """True when the default backend can run the Mosaic (TPU-only)
-    kernel; 'axon' is the tunnelled TPU platform."""
+    """True when the PROCESS-DEFAULT backend can run the Mosaic
+    (TPU-only) kernel; 'axon' is the tunnelled TPU platform.
+
+    Informational helper (tests/benches). Dispatch itself does NOT use
+    it: ``rolling_median`` selects the kernel via
+    ``jax.lax.platform_dependent``, which resolves per LOWERING platform
+    — a CPU-placed computation on a TPU host takes the XLA branch."""
     backend = jax.default_backend()
     return backend.startswith("tpu") or backend == "axon"
 

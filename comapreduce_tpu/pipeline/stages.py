@@ -37,6 +37,7 @@ import numpy as np
 from comapreduce_tpu.ops import power as power_ops
 from comapreduce_tpu.ops import vane as vane_ops
 from comapreduce_tpu.ops.atmosphere import fit_atmosphere_segments
+from comapreduce_tpu.ops.average import edge_channel_mask, frequency_bin
 from comapreduce_tpu.ops.reduce import (ReduceConfig, plan_reduce_memory,
                                         scan_starts_lengths)
 from comapreduce_tpu.ops.spikes import spike_mask
@@ -46,9 +47,10 @@ from comapreduce_tpu.pipeline.registry import register
 
 __all__ = ["CheckLevel1File", "AssignLevel1Data", "UseLevel2Pointing",
            "MeasureSystemTemperature", "SkyDip", "AtmosphereRemoval",
-           "Level1AveragingGainCorrection", "Spikes",
+           "Level1Averaging", "Level1AveragingGainCorrection", "Spikes",
            "Level2FitPowerSpectrum", "NoiseStatistics", "WriteLevel2Data",
-           "Level2Timelines", "mean_vane_tsys_gain"]
+           "Level2Timelines", "mean_vane_tsys_gain", "bucket_scan_lengths",
+           "first_fitted_scan"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -241,15 +243,16 @@ class MeasureSystemTemperature(_StageBase):
 @functools.lru_cache(maxsize=32)
 def _batched_atmosphere_fit(n_scans: int):
     """Cached jitted vmap-over-feeds atmosphere fit (one compile per scan
-    count, not one per file). Takes NaN-carrying raw counts and a time
-    mask (f32[T] or scalar 1); validity is derived on device so the host
-    never builds or ships a dense (B, C, T) mask."""
+    count, not one per file). Takes NaN-carrying raw counts and a
+    per-feed time mask (f32[n_feeds, T], or [n_feeds, 1] for all-on);
+    validity is derived on device so the host never builds or ships a
+    dense (B, C, T) mask."""
     def one(raw, airmass, seg, tmask):
         mask = jnp.isfinite(raw).astype(jnp.float32) * tmask
         return fit_atmosphere_segments(jnp.nan_to_num(raw), airmass, seg,
                                        mask, n_scans=n_scans)
 
-    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, None)))
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0)))
 
 
 def mean_vane_tsys_gain(level2):
@@ -270,39 +273,125 @@ def mean_vane_tsys_gain(level2):
 @register()
 @dataclass
 class SkyDip(_StageBase):
-    """Per-channel linear fit of the TOD against airmass over the whole
-    observation (parity: ``SkyDip``, ``Level1Averaging.py:48-155``, which
-    fits the previous obsid's sky-nod; here the fit runs on the current
-    file's elevation coverage). Writes ``skydip/fits`` (F, B, 2, C):
-    [offset, slope-vs-airmass]."""
+    """Per-channel linear fit of TOD against airmass -> ``skydip/fits``
+    (F, B, 2, C): [offset, slope-vs-airmass].
+
+    Two modes (parity: ``SkyDip``, ``Level1Averaging.py:48-155``):
+
+    - default: fit the CURRENT file's elevation coverage (useful for CES
+      scans with an elevation swing);
+    - ``sky_nod_obsid`` >= 0 or ``sky_nod_file`` set: the reference's
+      actual sky-dip workflow — fit the PRIOR observation's sky-nod.
+      ``sky_nod_obsid=0`` means "the observation before this one"
+      (the reference's hardwired ``obsid - 1`` lookup); a positive value
+      or an explicit file pins it. The sky-nod TOD is divided by the
+      current vane gain and restricted to the reference's elevation
+      window before the per-channel airmass regression. A missing or
+      non-sky-nod prior file is a logged no-op, like the reference.
+    """
 
     groups: tuple = ("skydip",)
     # feeds per device batch; the default bounds memory at production
     # scale (a feed is ~2.2 GB of raw counts; see the gain stage)
     feed_batch: int = 4
+    # prior-observation sky-nod mode (-1 = off -> fit the current file)
+    sky_nod_obsid: int = -1
+    sky_nod_file: str = ""
+    # elevation window of the sky-nod fit (Level1Averaging.py:124)
+    el_min: float = 40.0
+    el_max: float = 55.0
 
     def __call__(self, data, level2) -> bool:
-        F = int(data.tod_shape[0])
-        on = ~np.asarray(data.vane_flag)
-        seg = np.zeros(int(data.tod_shape[-1]), np.int32)
-        seg[~on] = -1
-        seg_j = jnp.asarray(seg)
+        self.STATE = True
+        if self.sky_nod_file or self.sky_nod_obsid >= 0:
+            return self._fit_sky_nod(data, level2)
+        fits = self._fit_file(data, gain=None,
+                              tmask=~np.asarray(data.vane_flag))
+        self._data = {"skydip/fits": fits}  # (F, B, 2, C)
+        return True
+
+    def _fit_file(self, data, gain, tmask) -> np.ndarray:
+        """Per-channel (offset, slope-vs-airmass) over ``tmask``-selected
+        samples of ``data``; ``gain`` (F, B, C) divides the counts into
+        kelvin when given (the sky-nod mode)."""
+        F, B, C, T = (int(x) for x in data.tod_shape)
+        tmask = np.broadcast_to(np.asarray(tmask), (F, T))
+        seg = np.zeros(T, np.int32)   # one global segment; masking via
+        seg_j = jnp.asarray(seg)      # the per-feed time mask
         airmass_all = np.asarray(data.airmass).astype(np.float32)
         fit = _batched_atmosphere_fit(1)
-        fits = np.zeros((F, data.tod_shape[1], 2, data.tod_shape[2]),
-                        np.float32)
-        on_j = jnp.asarray(on.astype(np.float32))
+        fits = np.zeros((F, B, 2, C), np.float32)
         fb = self.feed_batch or F
         for i in range(0, F, fb):
             idx = list(range(i, min(i + fb, F)))
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
+            if gain is not None:
+                g = gain[idx][..., None]
+                raw = np.where(g > 0, raw / np.where(g > 0, g, 1.0), np.nan)
             off, slope = fit(jnp.asarray(raw),
-                             jnp.asarray(airmass_all[idx]), seg_j, on_j)
+                             jnp.asarray(airmass_all[idx]), seg_j,
+                             jnp.asarray(tmask[idx].astype(np.float32)))
             fits[idx] = np.stack([np.asarray(off)[..., 0],
                                   np.asarray(slope)[..., 0]], axis=-2)
-        self._data = {"skydip/fits": fits}  # (F, B, 2, C)
-        self.STATE = True
+        return fits
+
+    def _fit_sky_nod(self, data, level2) -> bool:
+        from comapreduce_tpu.data.level import (COMAPLevel1,
+                                                find_level1_by_obsid)
+
+        path = self.sky_nod_file
+        if not path:
+            target = (data.obsid - 1 if self.sky_nod_obsid == 0
+                      else self.sky_nod_obsid)
+            path = find_level1_by_obsid(
+                os.path.dirname(data.source_filename) or ".", target)
+            if path is None:
+                logger.info("SkyDip: no file for obsid %s; skipping",
+                            target)
+                return True
+        prev = COMAPLevel1()
+        try:
+            prev.read(path)
+        except OSError as exc:
+            # an unreadable/missing prior file is a logged no-op, like the
+            # reference's silent return — it must not kill a field run
+            logger.warning("SkyDip: cannot read sky-nod %s (%s); skipping",
+                           path, exc)
+            return True
+        comment = prev.comment.lower()
+        if "sky nod" not in comment and "sky dip" not in comment:
+            logger.info("SkyDip: %s is not a sky-nod (comment %r); "
+                        "skipping", path, prev.comment)
+            return True
+        try:
+            _, gain = mean_vane_tsys_gain(level2)
+        except KeyError:
+            logger.warning("SkyDip: obs %s has no vane calibration",
+                           data.obsid)
+            self.STATE = False
+            return False
+        if tuple(prev.tod_shape[:3]) != gain.shape:
+            # the current vane gain can only normalise a sky-nod recorded
+            # with the same (feeds, bands, channels) layout
+            logger.warning("SkyDip: sky-nod %s shape %s does not match "
+                           "the current gain %s; skipping", path,
+                           tuple(prev.tod_shape[:3]), gain.shape)
+            self.STATE = False
+            return False
+        el = np.asarray(prev.el, dtype=np.float32)  # (F, T)
+        tmask = (el > self.el_min) & (el < self.el_max) \
+            & ~np.asarray(prev.vane_flag)[None, :]
+        if not tmask.any():
+            logger.warning("SkyDip: sky-nod %s has no samples in the "
+                           "%.0f-%.0f deg window", path, self.el_min,
+                           self.el_max)
+            self.STATE = False
+            return False
+        fits = self._fit_file(prev, gain=gain, tmask=tmask)
+        self._data = {"skydip/fits": fits}
+        self._attrs = {"skydip": {"sky_nod_obsid": prev.obsid,
+                                  "sky_nod_file": os.path.basename(path)}}
         return True
 
 
@@ -340,11 +429,81 @@ class AtmosphereRemoval(_StageBase):
                                        dtype=np.float32) for j in idx])
             off, atm = fit(jnp.asarray(raw),
                            jnp.asarray(airmass_all[idx]), seg_j,
-                           jnp.float32(1.0))
+                           jnp.ones((len(idx), 1), jnp.float32))
             # (f, B, C, S) pair -> (S, f, B, 2, C)
             blk = np.stack([np.asarray(off), np.asarray(atm)], axis=0)
             out[:, idx] = np.transpose(blk, (4, 1, 2, 0, 3))
         self._data = {"atmosphere/fit_values": out}
+        self.STATE = True
+        return True
+
+
+@functools.lru_cache(maxsize=8)
+def _batched_frequency_bin(bin_size: int):
+    """Cached jitted vmap-over-feeds frequency binner: counts / gain,
+    then the weighted in-bin mean + stddev (one compile per bin size)."""
+    def one(raw, gain, weights):
+        tod = jnp.nan_to_num(raw) / jnp.where(gain > 0, gain, 1.0)[..., None]
+        return frequency_bin(tod, weights, bin_size)
+
+    return jax.jit(jax.vmap(one))
+
+
+@register()
+@dataclass
+class Level1Averaging(_StageBase):
+    """Plain frequency-binning reduction — NO gain-fluctuation
+    correction (parity: ``Level1Averaging.average_tod``,
+    ``Level1Averaging.py:292-321``): counts / vane gain, 1/Tsys^2
+    weights with the reference's edge + band-centre channels cut, then a
+    weighted mean and in-bin standard deviation over
+    ``frequency_bin_size``-channel groups.
+
+    Writes ``frequency_binned/{tod, tod_stddev}`` (F, B, C//bin, T) —
+    its own group (the reference overwrites the Level-2 copy of
+    ``spectrometer/tod`` in place, which would break this runner's
+    group-based resume test against ``AssignLevel1Data``)."""
+
+    groups: tuple = ("frequency_binned",)
+    frequency_bin_size: int = 512
+    # feeds per device batch (a feed is ~2.2 GB of raw counts)
+    feed_batch: int = 4
+
+    def __call__(self, data, level2) -> bool:
+        try:
+            tsys, gain = mean_vane_tsys_gain(level2)
+        except KeyError:
+            logger.warning("Level1Averaging: obs %s has no vane "
+                           "calibration", data.obsid)
+            self.STATE = False
+            return False
+        F, B, C, T = (int(x) for x in data.tod_shape)
+        bin_size = min(self.frequency_bin_size, C)
+        # the reference's frequency mask: 10 edge channels each end plus
+        # the 3 band-centre channels [511:514] (Level1Averaging.py:267-271),
+        # scaled with C like the other channel cuts
+        def s(n):
+            return max(int(round(n * C / 1024.0)), 1)
+        chan_mask = np.asarray(edge_channel_mask(C, s(10), s(1), s(2)))
+        w = np.where(tsys > 0, 1.0 / np.maximum(tsys, 1e-10) ** 2, 0.0)
+        w = (w * chan_mask).astype(np.float32)          # (F, B, C)
+        fit = _batched_frequency_bin(bin_size)
+        nb = C // bin_size
+        tod_out = np.zeros((F, B, nb, T), np.float32)
+        std_out = np.zeros((F, B, nb, T), np.float32)
+        fb = self.feed_batch or F
+        for i in range(0, F, fb):
+            idx = list(range(i, min(i + fb, F)))
+            raw = np.stack([np.asarray(data.read_tod_feed(j),
+                                       dtype=np.float32) for j in idx])
+            avg, std = fit(jnp.asarray(raw), jnp.asarray(gain[idx]),
+                           jnp.asarray(w[idx]))
+            tod_out[idx] = np.asarray(avg)
+            std_out[idx] = np.asarray(std)
+        self._data = {
+            "frequency_binned/tod": tod_out,
+            "frequency_binned/tod_stddev": std_out,
+        }
         self.STATE = True
         return True
 
@@ -714,7 +873,16 @@ class Level2Timelines(_StageBase):
             from comapreduce_tpu.parallel.multihost import rank_info
 
             rank, _ = rank_info()
-            if rank != 0 or getattr(self, "_done", False):
+            # once-per-pass memo keyed on the filelist IDENTITY (path +
+            # mtime + size), not a sticky instance flag: a runner reused
+            # for a second pass over an UPDATED filelist rebuilds the
+            # product instead of silently skipping
+            try:
+                st = os.stat(self.filelist)
+                done_key = (self.filelist, st.st_mtime_ns, st.st_size)
+            except OSError:
+                done_key = (self.filelist, None, None)
+            if rank != 0 or getattr(self, "_done_key", None) == done_key:
                 self.STATE = True
                 return True
             from comapreduce_tpu.pipeline.config import read_filelist
@@ -723,7 +891,7 @@ class Level2Timelines(_StageBase):
                                    read_filelist(self.filelist))
                     if r is not None]
             write_gains(self.output_path, assemble_timelines(rows))
-            self._done = True   # only after a successful write
+            self._done_key = done_key   # only after a successful write
             self.STATE = True
             return True
         else:
