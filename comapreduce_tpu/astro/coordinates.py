@@ -85,7 +85,7 @@ def _slow_terms(mjd, longitude, dut1, downsample_factor):
 
 
 def h2e_full(az_deg, el_deg, mjd, longitude: float = COMAP_LONGITUDE,
-             latitude: float = COMAP_LATITUDE, dut1: float = 0.0,
+             latitude: float = COMAP_LATITUDE, dut1: float | None = None,
              apply_refraction: bool = True, downsample_factor: int = 50,
              backend: str = "auto"):
     """Observed azimuth/elevation -> mean J2000 RA/Dec [deg].
@@ -96,7 +96,15 @@ def h2e_full(az_deg, el_deg, mjd, longitude: float = COMAP_LONGITUDE,
     (LAST, nutation x precession, aberration) are evaluated on a
     ``downsample_factor`` subgrid; the per-sample trig is exact.
     ``backend``: 'auto' uses the C++ library when it loads, 'native'
-    requires it, 'numpy' forces the oracle."""
+    requires it, 'numpy' forces the oracle. ``dut1=None`` (default)
+    resolves UT1-UTC from the active dUT1 table at the mean epoch —
+    the reference's live-IERS behavior (``Tools/Coordinates.py:279-342``)
+    with an air-gapped table (:mod:`comapreduce_tpu.astro.dut1`, error
+    budget documented there); pass an explicit float to pin it."""
+    if dut1 is None:
+        from comapreduce_tpu.astro.dut1 import dut1_at
+
+        dut1 = dut1_at(mjd)
     az = np.atleast_1d(np.asarray(az_deg, np.float64))
     el = np.atleast_1d(np.asarray(el_deg, np.float64))
     mjd_b = np.broadcast_to(np.atleast_1d(np.asarray(mjd, np.float64)),
@@ -145,11 +153,16 @@ def h2e_full(az_deg, el_deg, mjd, longitude: float = COMAP_LONGITUDE,
 
 
 def e2h_full(ra_deg, dec_deg, mjd, longitude: float = COMAP_LONGITUDE,
-             latitude: float = COMAP_LATITUDE, dut1: float = 0.0,
+             latitude: float = COMAP_LATITUDE, dut1: float | None = None,
              apply_refraction: bool = True, downsample_factor: int = 50,
              backend: str = "auto"):
     """Mean J2000 RA/Dec -> observed azimuth/elevation [deg]
-    (``sla_map``+``sla_aop`` chain of the reference ``e2h_full``)."""
+    (``sla_map``+``sla_aop`` chain of the reference ``e2h_full``).
+    ``dut1=None`` resolves from the dUT1 table (see :func:`h2e_full`)."""
+    if dut1 is None:
+        from comapreduce_tpu.astro.dut1 import dut1_at
+
+        dut1 = dut1_at(mjd)
     ra = np.atleast_1d(np.asarray(ra_deg, np.float64))
     dec = np.atleast_1d(np.asarray(dec_deg, np.float64))
     mjd_b = np.broadcast_to(np.atleast_1d(np.asarray(mjd, np.float64)),

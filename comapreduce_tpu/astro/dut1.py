@@ -1,0 +1,119 @@
+"""UT1-UTC (dUT1) lookup for the astrometry chain.
+
+The reference pulls dUT1 from astropy's live IERS table
+(``Tools/Coordinates.py:279-342``); this framework is air-gapped, so it
+ships a coarse bundled table and accepts a user-supplied IERS one.
+
+Resolution order for :func:`dut1_at`:
+
+1. a table loaded explicitly with :func:`load_table`;
+2. the file named by ``COMAP_DUT1_TABLE`` (two whitespace-separated
+   columns ``mjd  ut1_utc_seconds``, ``#`` comments — trivially produced
+   from IERS ``finals2000A`` with awk, docs/OPERATIONS.md);
+3. the bundled coarse table below.
+
+**Pointing-error budget.** Neglected dUT1 rotates the hour angle by
+15 arcsec per second of dUT1. |dUT1| stays below 0.9 s (leap seconds), so
+ignoring it entirely costs up to ~13 arcsec — invisible next to COMAP's
+4.5 arcmin beam but not to the README's arcsecond-class astrometry
+claim. The bundled table is semiannual Bulletin-D-grade (+-0.1 s
+between nodes in the worst case) -> residual error under ~1.5 arcsec;
+a user-supplied IERS finals table (+-1 ms) retires the term completely
+(~0.015 arcsec).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+__all__ = ["dut1_at", "load_table", "bundled_table"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+# Coarse semiannual UT1-UTC anchors (seconds), Bulletin-D grade.
+# MJD of Jan 1 / Jul 1; values rounded to 0.01 s. Outside the range the
+# nearest node is held (extrapolating Earth rotation is meaningless).
+_BUNDLED = np.array([
+    (57754.0, 0.40),   # 2017-01-01 (after the 2016-12-31 leap second)
+    (57935.0, 0.35),   # 2017-07-01
+    (58119.0, 0.22),   # 2018-01-01
+    (58300.0, 0.10),   # 2018-07-01
+    (58484.0, -0.01),  # 2019-01-01
+    (58665.0, -0.10),  # 2019-07-01
+    (58849.0, -0.18),  # 2020-01-01
+    (59031.0, -0.24),  # 2020-07-01
+    (59215.0, -0.17),  # 2021-01-01
+    (59396.0, -0.11),  # 2021-07-01
+    (59580.0, -0.11),  # 2022-01-01
+    (59761.0, -0.07),  # 2022-07-01
+    (59945.0, -0.02),  # 2023-01-01
+    (60126.0, -0.01),  # 2023-07-01
+    (60310.0, 0.00),   # 2024-01-01
+])
+
+_loaded: np.ndarray | None = None
+_env_cache: tuple = ("", None)   # (path, parsed table | None on failure)
+
+
+def bundled_table() -> np.ndarray:
+    """The coarse built-in (mjd, ut1_utc) table, (N, 2) float64."""
+    return _BUNDLED.copy()
+
+
+def _parse_table(path: str) -> np.ndarray:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rows.append((float(parts[0]), float(parts[1])))
+    if len(rows) < 1:
+        raise ValueError(f"dUT1 table {path} has no rows")
+    tab = np.asarray(sorted(rows), np.float64)
+    if np.abs(tab[:, 1]).max() >= 0.9:
+        raise ValueError(f"dUT1 table {path}: |UT1-UTC| must stay "
+                         "below 0.9 s — wrong column?")
+    return tab
+
+
+def load_table(path: str) -> np.ndarray:
+    """Load and activate a user dUT1 table: two columns ``mjd  seconds``
+    (``#`` comments ignored). Returns the active (N, 2) table."""
+    global _loaded
+    _loaded = _parse_table(path)
+    return _loaded
+
+
+def _active_table() -> np.ndarray:
+    global _env_cache
+    if _loaded is not None:
+        return _loaded
+    env = os.environ.get("COMAP_DUT1_TABLE", "")
+    if not env:
+        return _BUNDLED
+    # re-resolved every call (setting the env var mid-process must take
+    # effect); the parse itself is cached per path
+    if _env_cache[0] != env:
+        try:
+            tab = _parse_table(env)
+        except (OSError, ValueError) as exc:
+            logger.warning("COMAP_DUT1_TABLE %s unusable (%s); using "
+                           "the bundled coarse table", env, exc)
+            tab = None
+        _env_cache = (env, tab)
+    return _env_cache[1] if _env_cache[1] is not None else _BUNDLED
+
+
+def dut1_at(mjd) -> float:
+    """UT1-UTC [s] at ``mjd`` (scalar or array -> mean epoch): linear
+    interpolation on the active table, nearest node held outside it.
+    dUT1 drifts ~1 ms/day, so one value per observation is exact to
+    ~0.1 ms over an hour-long file."""
+    t = float(np.mean(np.asarray(mjd, np.float64)))
+    tab = _active_table()
+    return float(np.interp(t, tab[:, 0], tab[:, 1]))

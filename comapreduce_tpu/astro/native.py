@@ -23,8 +23,15 @@ __all__ = ["available", "load", "h2e_full", "e2h_full", "gmst", "last",
 logger = logging.getLogger("comapreduce_tpu")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc",
-                    "astrometry.cpp")
+# repo layout first (csrc/ beside the package), then the copy installed
+# as package data by setup.py (non-editable installs have no csrc/)
+_SRC_CANDIDATES = (
+    os.path.join(os.path.dirname(os.path.dirname(_HERE)), "csrc",
+                 "astrometry.cpp"),
+    os.path.join(_HERE, "astrometry.cpp"),
+)
+_SRC = next((p for p in _SRC_CANDIDATES if os.path.exists(p)),
+            _SRC_CANDIDATES[0])
 _LIB_PATH = os.path.join(_HERE, "_astrometry.so")
 
 _lib = None

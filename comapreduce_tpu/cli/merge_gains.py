@@ -1,0 +1,40 @@
+"""Merge per-rank gains timeline shards into one fleet product.
+
+Role parity: ``Summary/CalibrationFactors.py:19-165`` builds the single
+fleet-wide ``gains.hd5``; a multi-process ``Level2Timelines`` run here
+leaves one ``{base}_rank{r}{ext}`` shard per rank instead (disjoint
+filelist shards — ``pipeline/stages.py``). Usage::
+
+    python -m comapreduce_tpu.cli.merge_gains gains.hd5 [shard1 shard2 ...]
+
+With no shard arguments, ``{base}_rank*{ext}`` next to the output are
+discovered automatically.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m comapreduce_tpu.cli.merge_gains "
+              "OUTPUT.hd5 [RANK_SHARD.hd5 ...]", file=sys.stderr)
+        return 2
+    from comapreduce_tpu.summary import merge_gains
+
+    output, inputs = argv[0], (argv[1:] or None)
+    try:
+        merged = merge_gains(output, inputs)
+    except FileNotFoundError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+    print(f"{output}: {len(merged['obsid'])} observations")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

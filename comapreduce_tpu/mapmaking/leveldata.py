@@ -35,9 +35,30 @@ from comapreduce_tpu.mapmaking.wcs import WCS
 from comapreduce_tpu.ops.median_filter import rolling_median
 
 __all__ = ["DestriperData", "read_comap_data", "scan_speed_mask",
-           "export_madam"]
+           "sun_centric_coords", "export_madam"]
 
 logger = logging.getLogger("comapreduce_tpu")
+
+
+def sun_centric_coords(ra_deg, dec_deg, mjd0: float):
+    """Rotate RA/Dec into sun-relative coordinates: the sun (at ``mjd0``,
+    from the framework's own ephemeris) sits at (lon, lat) = (0, 0).
+
+    Parity: ``get_sun_centric_coords`` (``COMAPData.py:213-232``), which
+    rotates with healpy's Rotator about the astropy sun position at the
+    first sample. Here it is the framework's own ephemeris
+    (``astro.core.sun_position``) + the tested source-relative rotation
+    (``astro.coordinates.rotate``) — no healpy/astropy. NaN pointing
+    rides through as NaN. Returns (lon, lat) in degrees, lon in
+    (-180, 180].
+    """
+    from comapreduce_tpu.astro.coordinates import rotate
+    from comapreduce_tpu.astro.core import sun_position
+
+    ra_s, dec_s, _ = sun_position(np.atleast_1d(float(mjd0)))
+    return rotate(np.asarray(ra_deg, np.float64),
+                  np.asarray(dec_deg, np.float64),
+                  float(np.degrees(ra_s[0])), float(np.degrees(dec_s[0])))
 
 
 @dataclass
@@ -103,12 +124,18 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     edge_frac: float = 0.1, use_calibration: bool = True,
                     feed_mask: np.ndarray | None = None,
                     mask_turnarounds: bool = False,
-                    speed_range: tuple = (0.1, 0.45)) -> DestriperData:
+                    speed_range: tuple = (0.1, 0.45),
+                    sun_centric: bool = False,
+                    min_sun_distance_deg: float = 10.0) -> DestriperData:
     """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
     ``nside`` selects the pixelisation. ``mask_turnarounds`` zero-weights
     samples outside the ``speed_range`` deg/s scan-speed band (the legacy
     fg-survey pipeline's turnaround cut); the sample rate comes from each
-    file's own MJD axis."""
+    file's own MJD axis. ``sun_centric`` maps in sun-relative
+    coordinates (per-file sun position at the first sample; parity
+    ``COMAPData.py:326-327``) and zero-weights samples within
+    ``min_sun_distance_deg`` of the sun (the reference's 10-degree cut,
+    ``:335``); it overrides ``galactic``."""
     if (wcs is None) == (nside is None):
         raise ValueError("pass exactly one of wcs= or nside=")
     tods, pixs, wgts, gids, azs = [], [], [], [], []
@@ -168,7 +195,17 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                                        sample_rate=1.0 / max(dt, 1e-6),
                                        speed_range=speed_range)
             weights[~ok_speed] = 0.0
-        lon, lat = (e2g(ra, dec) if galactic else (ra, dec))
+        if sun_centric:
+            from comapreduce_tpu.mapmaking.wcs import angular_separation
+
+            mjd0 = float(np.asarray(lvl2.mjd, np.float64)[0])
+            lon, lat = sun_centric_coords(ra, dec, mjd0)
+            if min_sun_distance_deg > 0:
+                near = angular_separation(0.0, 0.0, lon, lat) \
+                    < min_sun_distance_deg
+                weights[near] = 0.0
+        else:
+            lon, lat = (e2g(ra, dec) if galactic else (ra, dec))
         for ifeed in range(F):
             if feed_mask is not None and not feed_mask[ifeed]:
                 continue

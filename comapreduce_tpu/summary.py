@@ -21,7 +21,7 @@ from comapreduce_tpu.data.level import COMAPLevel2
 from comapreduce_tpu.database.obsdb import robust_smooth
 
 __all__ = ["level2_timelines", "timeline_row", "assemble_timelines",
-           "write_gains", "read_gains"]
+           "write_gains", "read_gains", "merge_gains"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -106,6 +106,63 @@ def write_gains(path: str, timelines: dict) -> None:
     store.write(path, atomic=True)
 
 
+def merge_gains(output_path: str, inputs=None) -> dict:
+    """Merge per-rank gains products into ONE fleet-wide ``gains.hd5``.
+
+    A multi-process ``Level2Timelines`` run writes ``{base}_rank{r}{ext}``
+    shards (disjoint filelist shards per rank — ``pipeline/stages.py``);
+    the reference builds the single fleet product in
+    ``Summary/CalibrationFactors.py:19-165``. ``inputs`` is a list of
+    shard paths; ``None`` discovers ``{output}_rank{N}{ext}`` next to
+    ``output_path`` (non-numeric ``_rank*`` strays are ignored). Rows
+    are concatenated, de-duplicated by obsid — the row with the LATEST
+    MJD wins, so a reprocessed observation beats its stale copy in any
+    shard — sorted by MJD, and written atomically to ``output_path``.
+    Returns the merged timelines dict.
+    """
+    import glob
+    import os
+    import re
+
+    if inputs is None:
+        base, ext = os.path.splitext(output_path)
+        numbered = []
+        for p in glob.glob(f"{base}_rank*{ext}"):
+            m = re.search(r"_rank(\d+)", os.path.basename(p))
+            if m:
+                numbered.append((int(m.group(1)), p))
+            else:
+                logger.warning("merge_gains: ignoring non-rank file %s", p)
+        inputs = [p for _, p in sorted(numbered)]
+    if not inputs:
+        raise FileNotFoundError(
+            f"merge_gains: no rank shards found for {output_path}")
+    rows: dict = {}   # obsid -> row tuple; latest-MJD row wins
+    for path in inputs:
+        shard = read_gains(path, smooth_window_days=0.0)
+        mjd = shard.get("mjd")
+        if mjd is None or not len(mjd):
+            logger.warning("merge_gains: %s is empty; skipped", path)
+            continue
+        for i in range(len(mjd)):
+            def pick(key):
+                arr = shard.get(key)
+                # a product-less shard stores (T, 0, 0) NaN arrays;
+                # treating those as data would poison the merged (F, B)
+                return (arr[i] if arr is not None and arr.ndim == 3
+                        and arr[i].size else None)
+            obsid = int(shard["obsid"][i])
+            row = (float(mjd[i]), obsid,
+                   pick("tsys"), pick("gain"), pick("auto_rms"))
+            if obsid not in rows or row[0] >= rows[obsid][0]:
+                rows[obsid] = row
+    merged = assemble_timelines(list(rows.values()))
+    write_gains(output_path, merged)
+    logger.info("merge_gains: %d observations from %d shards -> %s",
+                len(rows), len(inputs), output_path)
+    return merged
+
+
 def read_gains(path: str, smooth_window_days: float = 30.0) -> dict:
     """Load a gains file; adds outlier-robust smoothed ``tsys_smooth`` /
     ``gain_smooth`` (``data/Data.py:57-98`` ``read_gains``)."""
@@ -113,6 +170,8 @@ def read_gains(path: str, smooth_window_days: float = 30.0) -> dict:
     store.read(path)
     out = {k.split("/", 1)[1]: np.asarray(v) for k, v in store.items()}
     mjd = out.get("mjd")
+    if smooth_window_days <= 0:   # raw read (e.g. the merge tool)
+        return out
     for key in ("tsys", "gain"):
         arr = out.get(key)
         if arr is None or mjd is None or arr.ndim != 3 or not len(mjd):

@@ -1,0 +1,49 @@
+"""Build hooks for the native astrometry library.
+
+Role parity: the reference's ``setup.py:14-63`` builds its Cython/C++/F90
+extensions at install time. Here the single native component
+(``csrc/astrometry.cpp``, C ABI + ctypes — no pybind11 dependency) is
+compiled best-effort into the package as ``astro/_astrometry.so`` and the
+source is copied in as package data, so an installed (non-editable)
+package can still rebuild on demand (``astro/native.py``). A missing
+compiler is NOT an error: the NumPy astrometry oracle serves alone.
+"""
+
+import logging
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "csrc", "astrometry.cpp")
+PKG_ASTRO = os.path.join("comapreduce_tpu", "astro")
+
+log = logging.getLogger(__name__)
+
+
+def _stage_native() -> None:
+    """Copy the C++ source into the package and try to compile it."""
+    dst_src = os.path.join(HERE, PKG_ASTRO, "astrometry.cpp")
+    if os.path.exists(SRC):
+        shutil.copyfile(SRC, dst_src)
+    so = os.path.join(HERE, PKG_ASTRO, "_astrometry.so")
+    cc = shutil.which("g++") or shutil.which("c++")
+    if cc is None or not os.path.exists(SRC):
+        return
+    try:
+        subprocess.run([cc, "-O3", "-shared", "-fPIC", "-o", so, SRC],
+                       check=True, capture_output=True, timeout=300)
+    except (OSError, subprocess.SubprocessError) as exc:
+        log.info("native astrometry build skipped: %s", exc)
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        _stage_native()
+        super().run()
+
+
+setup(cmdclass={"build_py": build_py_with_native})

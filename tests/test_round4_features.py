@@ -1,0 +1,187 @@
+"""Sun-centric map coordinates (COMAPData.py:326-327 parity) and the
+fleet gains-product merge tool (Summary/CalibrationFactors.py role).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.data.level import COMAPLevel2
+from comapreduce_tpu.mapmaking.leveldata import (read_comap_data,
+                                                 sun_centric_coords)
+from comapreduce_tpu.mapmaking.wcs import WCS, angular_separation
+from comapreduce_tpu.summary import merge_gains, read_gains, write_gains
+
+
+# ---------------------------------------------------------- sun-centric
+
+def test_sun_centric_rotation_geometry():
+    """The sun lands at (0, 0); the rotation is rigid (separations to the
+    sun are preserved); NaNs ride through."""
+    from comapreduce_tpu.astro.core import sun_position
+
+    mjd0 = 59620.25
+    ra_s, dec_s, _ = sun_position(np.atleast_1d(mjd0))
+    ra_s_deg = float(np.degrees(ra_s[0]))
+    dec_s_deg = float(np.degrees(dec_s[0]))
+
+    rng = np.random.default_rng(2)
+    ra = ra_s_deg + rng.uniform(-40, 40, 50)
+    dec = np.clip(dec_s_deg + rng.uniform(-40, 40, 50), -85, 85)
+    ra[3] = np.nan
+    lon, lat = sun_centric_coords(ra, dec, mjd0)
+
+    lon_s, lat_s = sun_centric_coords(ra_s_deg, dec_s_deg, mjd0)
+    assert abs(lon_s) < 1e-8 and abs(lat_s) < 1e-8
+    good = np.isfinite(ra)
+    want = angular_separation(ra_s_deg, dec_s_deg, ra[good], dec[good])
+    got = angular_separation(0.0, 0.0, lon[good], lat[good])
+    np.testing.assert_allclose(got, want, atol=1e-9)
+    assert np.isnan(lon[3]) and np.isnan(lat[3])
+
+
+def _write_sun_tracking_level2(path, mjd0, offset_deg, T=1000):
+    """A Level-2 file whose pointing tracks the sun at a fixed offset."""
+    from comapreduce_tpu.astro.core import sun_position
+
+    rng = np.random.default_rng(int(mjd0 * 10) % 2**31)
+    mjd = mjd0 + np.arange(T) / 50.0 / 86400.0
+    ra_s, dec_s, _ = sun_position(np.atleast_1d(mjd0))
+    ra0 = np.degrees(float(ra_s[0]))
+    dec0 = np.degrees(float(dec_s[0]))
+    # small sweep around the offset point (a raster near the sun)
+    ra = ra0 + offset_deg + 0.3 * np.sin(np.arange(T) / 37.0)
+    dec = np.full(T, dec0) + 0.3 * np.cos(np.arange(T) / 53.0)
+    lvl2 = COMAPLevel2(filename=path)
+    tod = 1e-3 * rng.standard_normal((1, 1, T)).astype(np.float32)
+    lvl2["averaged_tod/tod"] = tod
+    lvl2["averaged_tod/weights"] = np.ones((1, 1, T), np.float32)
+    lvl2["averaged_tod/scan_edges"] = np.array([[0, T]])
+    lvl2["spectrometer/MJD"] = mjd
+    lvl2["spectrometer/pixel_pointing/pixel_ra"] = ra[None, :]
+    lvl2["spectrometer/pixel_pointing/pixel_dec"] = dec[None, :]
+    lvl2["spectrometer/pixel_pointing/pixel_az"] = \
+        np.linspace(100, 110, T)[None, :]
+    lvl2["spectrometer/pixel_pointing/pixel_el"] = np.full((1, T), 50.0)
+    lvl2.set_attrs("comap", "obsid", int(mjd0))
+    lvl2.set_attrs("comap", "source", "sunscan,sky")
+    lvl2.write(path)
+
+
+def test_read_comap_data_sun_centric(tmp_path):
+    """Three observations on different days tracking the sun at a 12-deg
+    offset: sun-centric binning stacks them on one spot; plain celestial
+    binning smears them by the sun's ~1 deg/day drift."""
+    files = []
+    for day in (0, 10, 20):
+        p = str(tmp_path / f"l2_{day}.hd5")
+        _write_sun_tracking_level2(p, 59620.0 + day, offset_deg=12.0)
+        files.append(p)
+    wcs = WCS.from_field((0.0, 0.0), (0.1, 0.1), (600, 600))
+
+    sun = read_comap_data(files, band=0, wcs=wcs, offset_length=50,
+                          medfilt_window=0, sun_centric=True)
+    # all three days collapse onto the same sun-relative spot
+    iy, ix = np.divmod(sun.pixels[sun.weights > 0], 600)
+    assert np.ptp(iy) < 40 and np.ptp(ix) < 40
+
+    plain = read_comap_data(files, band=0, wcs=wcs, offset_length=50,
+                            medfilt_window=0, sun_centric=False)
+    py, px = np.divmod(plain.pixels[plain.weights > 0], 600)
+    # the sun moved ~20 deg in RA over 20 days -> smeared in celestial
+    assert np.ptp(px) > np.ptp(ix) + 50
+
+    # the sun-avoidance cut: a 20-deg exclusion swallows the whole
+    # 12-deg-offset dataset
+    with pytest.raises(RuntimeError):
+        read_comap_data(files, band=0, wcs=wcs, offset_length=50,
+                        medfilt_window=0, sun_centric=True,
+                        min_sun_distance_deg=20.0)
+
+
+# ---------------------------------------------------------- gains merge
+
+def _timelines(obsids, mjds, value):
+    F, B = 2, 3
+    n = len(obsids)
+    return {
+        "mjd": np.asarray(mjds, float),
+        "obsid": np.asarray(obsids, np.int64),
+        "tsys": np.full((n, F, B), value, float),
+        "gain": np.full((n, F, B), 10.0 * value, float),
+        "auto_rms": np.full((n, F, B), value / 100.0, float),
+    }
+
+
+def test_merge_gains_rank_shards(tmp_path):
+    out = str(tmp_path / "gains.hd5")
+    write_gains(str(tmp_path / "gains_rank0.hd5"),
+                _timelines([11, 22], [100.0, 200.0], 40.0))
+    # rank 1 re-observes obsid 22 (newer shard wins) and adds 33
+    write_gains(str(tmp_path / "gains_rank1.hd5"),
+                _timelines([22, 33], [201.0, 300.0], 55.0))
+
+    merged = merge_gains(out)   # auto-discovers the _rank* shards
+    assert os.path.exists(out)
+    assert merged["obsid"].tolist() == [11, 22, 33]
+    assert merged["mjd"].tolist() == [100.0, 201.0, 300.0]
+    assert merged["tsys"].shape == (3, 2, 3)
+    assert merged["tsys"][0, 0, 0] == 40.0
+    assert merged["tsys"][1, 0, 0] == 55.0   # rank-1 row won obsid 22
+
+    back = read_gains(out)
+    assert back["obsid"].tolist() == [11, 22, 33]
+    assert "tsys_smooth" in back
+
+
+def test_merge_gains_latest_mjd_wins_regardless_of_rank(tmp_path):
+    """A reprocessed (newer-MJD) row in a LOWER rank shard must beat the
+    stale copy in a higher rank."""
+    out = str(tmp_path / "g.hd5")
+    write_gains(str(tmp_path / "g_rank0.hd5"),
+                _timelines([22], [250.0], 99.0))   # fresh reprocessing
+    write_gains(str(tmp_path / "g_rank1.hd5"),
+                _timelines([22], [200.0], 55.0))   # stale
+    merged = merge_gains(out)
+    assert merged["tsys"][0, 0, 0] == 99.0
+
+
+def test_merge_gains_productless_shard_cannot_poison_shapes(tmp_path):
+    """A shard whose files all lacked vane/fnoise products stores
+    (T, 0, 0) arrays; they must merge as missing, not as data."""
+    out = str(tmp_path / "g.hd5")
+    empty = {"mjd": np.array([5.0]), "obsid": np.array([9], np.int64),
+             "tsys": np.zeros((1, 0, 0)), "gain": np.zeros((1, 0, 0)),
+             "auto_rms": np.zeros((1, 0, 0))}
+    write_gains(str(tmp_path / "g_rank0.hd5"), empty)
+    write_gains(str(tmp_path / "g_rank1.hd5"),
+                _timelines([11], [100.0], 40.0))
+    # a stray non-numeric _rank file is ignored, not a crash
+    write_gains(str(tmp_path / "g_rankX.hd5"),
+                _timelines([77], [1.0], 1.0))
+    merged = merge_gains(out)
+    assert merged["obsid"].tolist() == [9, 11]
+    assert merged["tsys"].shape == (2, 2, 3)   # real (F, B) preserved
+    assert np.isnan(merged["tsys"][0]).all()   # product-less row = NaN
+    assert merged["tsys"][1, 0, 0] == 40.0
+
+
+def test_merge_gains_explicit_inputs_and_missing(tmp_path):
+    a = str(tmp_path / "a.hd5")
+    write_gains(a, _timelines([7], [50.0], 30.0))
+    out = str(tmp_path / "merged.hd5")
+    merged = merge_gains(out, [a])
+    assert merged["obsid"].tolist() == [7]
+    with pytest.raises(FileNotFoundError):
+        merge_gains(str(tmp_path / "none.hd5"))
+
+
+def test_merge_gains_cli(tmp_path, capsys):
+    from comapreduce_tpu.cli.merge_gains import main
+
+    write_gains(str(tmp_path / "g_rank0.hd5"), _timelines([1], [10.0], 42.0))
+    out = str(tmp_path / "g.hd5")
+    assert main([out]) == 0
+    assert os.path.exists(out)
+    assert main([str(tmp_path / "missing.hd5")]) == 1
