@@ -222,29 +222,46 @@ def reference_unit_seconds(L: int, window: int, B: int = 4,
     return time.perf_counter() - t0
 
 
-def measure_baseline(L: int, window: int) -> float:
+def measure_baseline(L: int, window: int, n_rep: int = 2) -> float:
     """Single-threaded wall seconds of one reference (feed, scan) unit.
 
     Spawns a subprocess with BLAS/OpenMP pinned to one thread — the
     per-rank budget the production `mpirun -n 16` on a 32-core node gives
     the reference (2 cores/rank; 1 thread is generous to nobody and
     reproducible).
+
+    The unit is measured ``n_rep`` times and the MINIMUM is returned,
+    with the subprocess pinned to one CPU (``sched_setaffinity``): host
+    load can only make the reference look slower, never faster, so the
+    minimum is the defensible denominator (round-3 review observed a
+    1.7x swing in ``vs_baseline`` from host load alone). The per-rep
+    values are printed to stderr for the record.
     """
     env = dict(os.environ)
     for k in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS",
               "NUMEXPR_NUM_THREADS"):
         env[k] = "1"
     env.pop("JAX_PLATFORMS", None)
-    code = (f"import bench; "
+    # pin the child to one core inside the child itself (portable across
+    # the taskset-less bench image); errors are non-fatal
+    code = ("import os\n"
+            "try: os.sched_setaffinity(0, {0})\n"
+            "except (AttributeError, OSError): pass\n"
+            "import bench\n"
             f"print(bench.reference_unit_seconds({L}, {window}))")
-    out = subprocess.run(
-        [sys.executable, "-c", code], env=env, capture_output=True,
-        text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
-    if out.returncode != 0:
-        raise RuntimeError(
-            f"baseline subprocess failed (rc={out.returncode}):\n"
-            f"{out.stderr}")
-    return float(out.stdout.strip().splitlines()[-1])
+    units = []
+    for rep in range(max(int(n_rep), 1)):
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, cwd=os.path.dirname(os.path.abspath(__file__)))
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"baseline subprocess failed (rc={out.returncode}):\n"
+                f"{out.stderr}")
+        units.append(float(out.stdout.strip().splitlines()[-1]))
+    print(f"bench: baseline unit reps {['%.1f' % u for u in units]} s "
+          f"-> min {min(units):.1f} s", file=sys.stderr)
+    return min(units)
 
 
 # --------------------------------------------------------------------------
@@ -455,6 +472,8 @@ def main():
             "cg_iters_per_sec": round(cg_iters_per_sec, 1),
             "map_hit_fraction": None,
             "baseline_unit_s": round(unit_s, 3),
+            "baseline_unit_policy": ("env-override" if env_unit
+                                     else "min-of-2, cpu-pinned"),
             "baseline_wall_s_16rank": round(baseline_wall, 2),
             "baseline_ranks": REFERENCE_RANKS,
             "device": str(jax.devices()[0].platform),

@@ -71,6 +71,9 @@ def _parse_table(path: str) -> np.ndarray:
             if not line:
                 continue
             parts = line.split()
+            if len(parts) < 2:
+                raise ValueError(f"dUT1 table {path}: line {line!r} "
+                                 "needs two columns (mjd ut1_utc)")
             rows.append((float(parts[0]), float(parts[1])))
     if len(rows) < 1:
         raise ValueError(f"dUT1 table {path} has no rows")

@@ -20,9 +20,13 @@ __all__ = ["main"]
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m comapreduce_tpu.cli.merge_gains "
-              "OUTPUT.hd5 [RANK_SHARD.hd5 ...]", file=sys.stderr)
+    usage = ("usage: python -m comapreduce_tpu.cli.merge_gains "
+             "OUTPUT.hd5 [RANK_SHARD.hd5 ...]")
+    if argv and argv[0] in ("-h", "--help"):
+        print(usage)
+        return 0
+    if not argv:
+        print(usage, file=sys.stderr)
         return 2
     from comapreduce_tpu.summary import merge_gains
 

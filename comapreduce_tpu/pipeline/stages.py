@@ -182,6 +182,19 @@ class UseLevel2Pointing(_StageBase):
             store.dec = dec
             store.az = az
             store.el = el
+        # ordering check the reference lacks: products derived from the
+        # OLD pointing (airmass fits, the reduction) already sit in the
+        # checkpointed store — re-solving the pointing without re-running
+        # them silently mixes epochs. The per-stage resume makes the fix
+        # one overwrite flag away, so say so loudly.
+        stale = [g for g in ("skydip", "atmosphere", "averaged_tod")
+                 if level2.contains_groups((g,))]
+        if stale:
+            logger.warning(
+                "UseLevel2Pointing: %s in %s were computed from the "
+                "PREVIOUS pointing; re-run those stages with "
+                "overwrite=True to refresh them", ", ".join(stale),
+                os.path.basename(level2.filename))
         return True
 
 

@@ -154,7 +154,14 @@ def merge_gains(output_path: str, inputs=None) -> dict:
             obsid = int(shard["obsid"][i])
             row = (float(mjd[i]), obsid,
                    pick("tsys"), pick("gain"), pick("auto_rms"))
-            if obsid not in rows or row[0] >= rows[obsid][0]:
+            old = rows.get(obsid)
+            has_data = any(v is not None for v in row[2:])
+            old_has_data = old is not None and any(
+                v is not None for v in old[2:])
+            # latest MJD wins — but a product-less row never displaces
+            # real calibration data
+            if old is None or (row[0] >= old[0]
+                               and (has_data or not old_has_data)):
                 rows[obsid] = row
     merged = assemble_timelines(list(rows.values()))
     write_gains(output_path, merged)

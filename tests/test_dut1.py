@@ -41,6 +41,20 @@ def test_user_table_and_validation(tmp_path, monkeypatch):
     bad.write_text("59000.0 37.0\n")   # TAI-UTC column, not UT1-UTC
     with pytest.raises(ValueError, match="wrong column"):
         dut1_mod.load_table(str(bad))
+    trunc = tmp_path / "trunc.txt"
+    trunc.write_text("59000.0\n")      # one column: truncated extraction
+    with pytest.raises(ValueError, match="two columns"):
+        dut1_mod.load_table(str(trunc))
+
+
+def test_env_table_malformed_falls_back(tmp_path, monkeypatch):
+    """An unusable env table warns and falls back to the bundled table —
+    the astrometry chain must never crash on it."""
+    p = tmp_path / "broken.txt"
+    p.write_text("59000.0\n")
+    monkeypatch.setenv("COMAP_DUT1_TABLE", str(p))
+    tab = dut1_mod.bundled_table()
+    assert dut1_mod.dut1_at(tab[0, 0]) == pytest.approx(tab[0, 1])
 
 
 def test_env_table(tmp_path, monkeypatch):

@@ -278,3 +278,27 @@ def test_use_level2_pointing(synthetic_obs, tmp_path):
     # with overwrite the Level-2 pointing replaces the Level-1 view's
     assert UseLevel2Pointing(overwrite=True)(data, lvl2)
     np.testing.assert_allclose(np.asarray(data.ra), ra_new)
+
+
+def test_use_level2_pointing_warns_on_stale_products(synthetic_obs,
+                                                     tmp_path, caplog):
+    """Replacing the pointing under products derived from the OLD
+    pointing must be called out (ordering check the reference lacks)."""
+    import logging
+
+    from comapreduce_tpu.data.level import COMAPLevel1, COMAPLevel2
+    from comapreduce_tpu.pipeline.stages import UseLevel2Pointing
+
+    path, p, outdir = synthetic_obs
+    data = COMAPLevel1()
+    data.read(path)
+    l2path = str(tmp_path / "l2_stale.hd5")
+    lvl2 = COMAPLevel2(filename=l2path)
+    stage = AssignLevel1Data()
+    assert stage(data, lvl2)
+    lvl2.update(stage)
+    lvl2["averaged_tod/tod"] = np.zeros((1, 1, 8), np.float32)
+    lvl2.write(l2path)
+    with caplog.at_level(logging.WARNING, logger="comapreduce_tpu"):
+        assert UseLevel2Pointing(overwrite=True)(data, lvl2)
+    assert any("PREVIOUS pointing" in r.message for r in caplog.records)

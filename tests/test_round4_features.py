@@ -167,6 +167,21 @@ def test_merge_gains_productless_shard_cannot_poison_shapes(tmp_path):
     assert merged["tsys"][1, 0, 0] == 40.0
 
 
+def test_merge_gains_newer_productless_row_keeps_old_data(tmp_path):
+    """A newer product-less re-observation must NOT displace an older
+    row that carries real calibration data."""
+    out = str(tmp_path / "g.hd5")
+    write_gains(str(tmp_path / "g_rank0.hd5"),
+                _timelines([22], [200.0], 40.0))   # real data
+    empty = {"mjd": np.array([250.0]), "obsid": np.array([22], np.int64),
+             "tsys": np.zeros((1, 0, 0)), "gain": np.zeros((1, 0, 0)),
+             "auto_rms": np.zeros((1, 0, 0))}
+    write_gains(str(tmp_path / "g_rank1.hd5"), empty)
+    merged = merge_gains(out)
+    assert merged["obsid"].tolist() == [22]
+    assert merged["tsys"][0, 0, 0] == 40.0
+
+
 def test_merge_gains_explicit_inputs_and_missing(tmp_path):
     a = str(tmp_path / "a.hd5")
     write_gains(a, _timelines([7], [50.0], 30.0))
