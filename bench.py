@@ -222,7 +222,11 @@ def reference_unit_seconds(L: int, window: int, B: int = 4,
     return time.perf_counter() - t0
 
 
-def measure_baseline(L: int, window: int, n_rep: int = 2) -> float:
+N_BASELINE_REPS = 2   # unit reps; the minimum is the denominator
+
+
+def measure_baseline(L: int, window: int,
+                     n_rep: int = N_BASELINE_REPS) -> float:
     """Single-threaded wall seconds of one reference (feed, scan) unit.
 
     Spawns a subprocess with BLAS/OpenMP pinned to one thread — the
@@ -472,8 +476,9 @@ def main():
             "cg_iters_per_sec": round(cg_iters_per_sec, 1),
             "map_hit_fraction": None,
             "baseline_unit_s": round(unit_s, 3),
-            "baseline_unit_policy": ("env-override" if env_unit
-                                     else "min-of-2, cpu-pinned"),
+            "baseline_unit_policy": (
+                "env-override" if env_unit
+                else f"min-of-{N_BASELINE_REPS}, cpu-pinned"),
             "baseline_wall_s_16rank": round(baseline_wall, 2),
             "baseline_ranks": REFERENCE_RANKS,
             "device": str(jax.devices()[0].platform),

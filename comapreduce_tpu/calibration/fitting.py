@@ -26,7 +26,7 @@ import numpy as np
 
 __all__ = ["gauss2d_rot", "gauss2d_rot_gradient", "gauss2d_fixed_pos",
            "lm_fit", "fit_gauss2d", "bootstrap_fit_gauss2d",
-           "initial_guess", "N_PARAMS"]
+           "posterior_fit_gauss2d", "initial_guess", "N_PARAMS"]
 
 N_PARAMS = {"gauss2d_rot": 7, "gauss2d_rot_gradient": 9,
             "gauss2d_fixed_pos": 5}
@@ -139,10 +139,12 @@ def fit_gauss2d(img: jax.Array, x: jax.Array, y: jax.Array, w: jax.Array,
     return p, err, c2
 
 
-@functools.partial(jax.jit, static_argnames=("model", "n_iter", "n_boot"))
+@functools.partial(jax.jit, static_argnames=("model", "n_iter", "n_boot",
+                                             "refit"))
 def bootstrap_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
                           w: jax.Array, p0: jax.Array, model=gauss2d_rot,
-                          n_iter: int = 60, n_boot: int = 64):
+                          n_iter: int = 60, n_boot: int = 64,
+                          refit: bool = True):
     """Nonparametric bootstrap errors for one map fit.
 
     The reference's ``Gauss2dRot_General`` bootstrap option
@@ -150,11 +152,16 @@ def bootstrap_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
     refit, take the parameter scatter. Here the replicas are one ``vmap``
     over ``n_boot`` index draws — the whole bootstrap is a single jitted
     program instead of a host loop. Returns ``(params, boot_err)`` where
-    ``params`` is the full-data fit.
+    ``params`` is the full-data fit. ``refit=False`` treats ``p0`` as an
+    ALREADY-CONVERGED solution (callers that just ran the analytic fit
+    skip a redundant 60-iteration solve per map).
     """
     m = img.shape[0]
-    p_full, _, _ = fit_gauss2d(img, x, y, w, p0, model=model,
-                               n_iter=n_iter)
+    if refit:
+        p_full, _, _ = fit_gauss2d(img, x, y, w, p0, model=model,
+                                   n_iter=n_iter)
+    else:
+        p_full = p0
 
     def one(k):
         idx = jax.random.randint(k, (m,), 0, m)
@@ -174,3 +181,78 @@ def bootstrap_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
     # read as infinite precision
     err = jnp.where(n_good >= 2, jnp.sqrt(var), jnp.nan)
     return p_full, err
+
+
+@functools.partial(jax.jit, static_argnames=("model", "n_iter", "n_steps",
+                                             "n_walkers", "burn"))
+def posterior_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
+                          w: jax.Array, p0: jax.Array, model=gauss2d_rot,
+                          n_iter: int = 60, n_steps: int = 1500,
+                          n_walkers: int = 8, burn: int = 500,
+                          step_scale: float = 0.5,
+                          proposal_sigma: jax.Array | None = None):
+    """Posterior sampling of a map fit — the ``Gauss2dRot_General`` emcee
+    option (``Tools/Fitting.py:363-531``), TPU-native.
+
+    Where the reference runs emcee's host ensemble sampler, this runs
+    ``n_walkers`` independent random-walk Metropolis chains as ONE jitted
+    program: the LM solution seeds the chains, the LM covariance sets the
+    (fixed, symmetric) proposal — so no Hastings correction is needed —
+    and ``lax.scan`` over steps x ``vmap`` over walkers keeps everything
+    on device. Flat priors except positivity of the amplitude and widths
+    (log-prob ``-inf`` outside), matching the reference's bounds.
+
+    Returns ``(p_map, samples, acceptance)``: the LM (maximum a
+    posteriori under flat priors) parameters, post-burn samples
+    ``f32[n_walkers, n_steps - burn, n_params]``, and the per-walker
+    acceptance fraction. Summarise with ``samples.reshape(-1, n)``
+    percentiles; feed walker/corner diagnostics directly.
+
+    ``proposal_sigma`` (per-parameter 1-sigma scales, e.g. the analytic
+    errors a caller already computed) skips the internal LM solve and
+    treats ``p0`` as the converged solution.
+    """
+    sw = jnp.sqrt(jnp.maximum(w, 0.0))
+    if proposal_sigma is None:
+        p_map, cov, _ = lm_fit(lambda p: (model(p, x, y) - img) * sw, p0,
+                               n_iter=n_iter)
+        base_sigma = jnp.sqrt(jnp.clip(jnp.diagonal(cov), 1e-16, None))
+    else:
+        p_map = p0
+        base_sigma = jnp.clip(jnp.asarray(proposal_sigma), 1e-8, None)
+    n = p_map.shape[0]
+    sigma = step_scale * base_sigma / jnp.sqrt(n)
+
+    # positivity of A, sigma_x, sigma_y — parameter slots 0, 2, 4 for the
+    # 7/9-parameter models, 0/1/2 for the fixed-pos 5-parameter model
+    pos_idx = jnp.array([0, 2, 4] if n >= 7 else [0, 1, 2])
+
+    def log_prob(p):
+        r = (model(p, x, y) - img) * sw
+        lp = -0.5 * jnp.sum(r * r)
+        ok = jnp.all(p[pos_idx] > 0)
+        return jnp.where(ok, lp, -jnp.inf)
+
+    k_init, k_chain = jax.random.split(key)
+    starts = p_map[None, :] + sigma[None, :] * jax.random.normal(
+        k_init, (n_walkers, n))
+
+    def walker_step(state, k):
+        p, lp = state
+        k1, k2 = jax.random.split(k)
+        prop = p + sigma * jax.random.normal(k1, (n,))
+        lp_new = log_prob(prop)
+        accept = jnp.log(jax.random.uniform(k2)) < (lp_new - lp)
+        p = jnp.where(accept, prop, p)
+        lp = jnp.where(accept, lp_new, lp)
+        return (p, lp), (p, accept)
+
+    def run_walker(p_start, k):
+        lp0 = log_prob(p_start)
+        keys = jax.random.split(k, n_steps)
+        _, (chain, acc) = jax.lax.scan(walker_step, (p_start, lp0), keys)
+        return chain[burn:], jnp.mean(acc.astype(jnp.float32))
+
+    samples, acceptance = jax.vmap(run_walker)(
+        starts, jax.random.split(k_chain, n_walkers))
+    return p_map, samples, acceptance

@@ -58,9 +58,22 @@ def bin_source_maps(tod, weights, dx, dy, wcs: WCS,
     return maps, wmaps
 
 
-def fit_source_maps(maps, wmaps, wcs: WCS, beam_fwhm_deg: float = 0.075):
+def fit_source_maps(maps, wmaps, wcs: WCS, beam_fwhm_deg: float = 0.075,
+                    error_func: str = "analytic", seed: int = 0,
+                    n_boot: int = 64, n_steps: int = 1500):
     """vmap-fit every (feed, band) map. Returns (params, errors, chi2)
-    with shapes (F, B, 7), (F, B, 7), (F, B)."""
+    with shapes (F, B, 7), (F, B, 7), (F, B).
+
+    ``error_func`` selects the error estimate — the reference's
+    ``Gauss2dRot_General`` choices (``Tools/Fitting.py:363-531``):
+    'analytic' (inv(J^T J), the lstsq default), 'bootstrap' (pixel
+    resampling), or 'posterior' (Metropolis chains — the emcee role) —
+    each as one vmapped jitted program over every (feed, band) map.
+    """
+    if error_func not in ("analytic", "bootstrap", "posterior"):
+        # fail before the expensive vmapped LM pass, not after it
+        raise ValueError(f"unknown error_func {error_func!r} (use "
+                         "'analytic', 'bootstrap', or 'posterior')")
     xg, yg = wcs.pixel_centers()  # (ny, nx) world coords [deg]
     x = jnp.asarray(xg.ravel(), jnp.float32)
     # tangent-plane longitude: wrap to (-180, 180] around the source
@@ -74,9 +87,34 @@ def fit_source_maps(maps, wmaps, wcs: WCS, beam_fwhm_deg: float = 0.075):
     flat_m = maps.reshape((-1, maps.shape[-1]))
     flat_w = wmaps.reshape((-1, wmaps.shape[-1]))
     p, e, c2 = jax.vmap(one)(flat_m, flat_w)
+    # refit=False / proposal_sigma: the analytic pass already converged
+    # p and its covariance — don't re-run 60 LM iterations per map
+    if error_func == "bootstrap":
+        keys = jax.random.split(jax.random.key(seed), flat_m.shape[0])
+        e = jax.vmap(lambda k, m, w, pf: fitting.bootstrap_fit_gauss2d(
+            k, m, x, y, w, pf, n_boot=n_boot, refit=False)[1])(
+            keys, flat_m, flat_w, p)
+    elif error_func == "posterior":
+        keys = jax.random.split(jax.random.key(seed), flat_m.shape[0])
+
+        def post_err(k, m, w, pf, ef):
+            _, samples, _ = fitting.posterior_fit_gauss2d(
+                k, m, x, y, w, pf, n_steps=n_steps, proposal_sigma=ef)
+            flat = samples.reshape(-1, pf.shape[0])
+            return jnp.std(flat, axis=0)
+
+        e = jax.vmap(post_err)(keys, flat_m, flat_w, p, e)
+    # no-zero-error-bar invariant for EVERY path: a dead map (too few
+    # hit pixels to constrain the model) gets NaN errors, never a ~0
+    # error bar that downstream inverse-variance weights would read as
+    # infinite precision
+    e = np.asarray(e).copy()
+    n_par = e.shape[-1]
+    dead = np.asarray((flat_w > 0).sum(axis=-1)) <= n_par
+    e[dead] = np.nan
     F, B = maps.shape[:2]
     return (np.asarray(p).reshape(F, B, -1),
-            np.asarray(e).reshape(F, B, -1),
+            e.reshape(F, B, -1),
             np.asarray(c2).reshape(F, B))
 
 
@@ -94,6 +132,9 @@ class FitSource(_StageBase):
     cdelt_deg: float = 1.0 / 60.0     # reference: 0.5' over 200 pix;
     beam_fwhm_deg: float = 0.075      # same 1.67 deg square field
     medfilt_window: int = 401
+    # 'analytic' | 'bootstrap' | 'posterior' — Gauss2dRot_General's
+    # lstsq/bootstrap/emcee error options (Tools/Fitting.py:363-531)
+    error_func: str = "analytic"
     figure_dir: str = ""
 
     def pre_init(self, data) -> None:
@@ -131,7 +172,8 @@ class FitSource(_StageBase):
                                       dy.astype(np.float32), wcs,
                                       self.medfilt_window)
         params, errors, chi2 = fit_source_maps(maps, wmaps, wcs,
-                                               self.beam_fwhm_deg)
+                                               self.beam_fwhm_deg,
+                                               error_func=self.error_func)
         g = f"{src}_source_fit"
         if self.figure_dir:
             # postage stamp of the feed-0/band-0 source map with its fit

@@ -158,10 +158,13 @@ def merge_gains(output_path: str, inputs=None) -> dict:
             has_data = any(v is not None for v in row[2:])
             old_has_data = old is not None and any(
                 v is not None for v in old[2:])
-            # latest MJD wins — but a product-less row never displaces
+            # latest MJD wins — but data beats product-less regardless of
+            # MJD or shard order, and a product-less row never displaces
             # real calibration data
-            if old is None or (row[0] >= old[0]
-                               and (has_data or not old_has_data)):
+            if old is None \
+                    or (has_data and not old_has_data) \
+                    or (row[0] >= old[0]
+                        and (has_data or not old_has_data)):
                 rows[obsid] = row
     merged = assemble_timelines(list(rows.values()))
     write_gains(output_path, merged)

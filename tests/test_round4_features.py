@@ -200,3 +200,19 @@ def test_merge_gains_cli(tmp_path, capsys):
     assert main([out]) == 0
     assert os.path.exists(out)
     assert main([str(tmp_path / "missing.hd5")]) == 1
+
+
+def test_merge_gains_data_beats_productless_any_order(tmp_path):
+    """Order independence: the data row wins whether the product-less
+    re-observation sits in a lower OR higher rank shard."""
+    empty = {"mjd": np.array([250.0]), "obsid": np.array([22], np.int64),
+             "tsys": np.zeros((1, 0, 0)), "gain": np.zeros((1, 0, 0)),
+             "auto_rms": np.zeros((1, 0, 0))}
+    data = _timelines([22], [200.0], 40.0)
+    for empty_rank in (0, 1):
+        d = tmp_path / f"case{empty_rank}"
+        d.mkdir()
+        write_gains(str(d / f"g_rank{empty_rank}.hd5"), empty)
+        write_gains(str(d / f"g_rank{1 - empty_rank}.hd5"), data)
+        merged = merge_gains(str(d / "g.hd5"))
+        assert merged["tsys"][0, 0, 0] == 40.0, empty_rank
