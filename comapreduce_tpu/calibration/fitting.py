@@ -188,7 +188,7 @@ def bootstrap_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
 def posterior_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
                           w: jax.Array, p0: jax.Array, model=gauss2d_rot,
                           n_iter: int = 60, n_steps: int = 1500,
-                          n_walkers: int = 8, burn: int = 500,
+                          n_walkers: int = 8, burn: int | None = None,
                           step_scale: float = 0.5,
                           proposal_sigma: jax.Array | None = None):
     """Posterior sampling of a map fit — the ``Gauss2dRot_General`` emcee
@@ -210,8 +210,14 @@ def posterior_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
 
     ``proposal_sigma`` (per-parameter 1-sigma scales, e.g. the analytic
     errors a caller already computed) skips the internal LM solve and
-    treats ``p0`` as the converged solution.
+    treats ``p0`` as the converged solution. ``burn=None`` discards the
+    first third of the chain; an explicit burn must leave samples.
     """
+    if burn is None:
+        burn = n_steps // 3
+    if not 0 <= burn < n_steps:
+        raise ValueError(f"burn={burn} leaves no samples from "
+                         f"n_steps={n_steps}")
     sw = jnp.sqrt(jnp.maximum(w, 0.0))
     if proposal_sigma is None:
         p_map, cov, _ = lm_fit(lambda p: (model(p, x, y) - img) * sw, p0,

@@ -28,6 +28,7 @@ it.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 import jax
@@ -274,7 +275,7 @@ def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
 
 def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
                       window: int, chunk: int, out_size: int,
-                      batch: int = 8) -> jax.Array:
+                      batch: int | None = None) -> jax.Array:
     """Sum ``values`` into ``out[id]`` for pre-sorted, chunk-windowed ids.
 
     ``values``/``ids``: f32/i32[M] with ``M % chunk == 0`` and every id of
@@ -285,7 +286,15 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
     ``batch * chunk * window`` floats. Assembly of the per-chunk windows is
     the only scatter left — ``n_chunks * window`` elements, orders of
     magnitude smaller than a per-sample scatter.
+
+    ``batch=None`` reads the ``COMAP_BIN_BATCH`` env default (8) — the
+    round-3 "next lever (c)" sweep knob: larger batches amortise
+    ``lax.map`` chunk streaming at the cost of a bigger live one-hot.
+    Read at CALL time so a sweep driver can vary it between jit traces
+    in one process.
     """
+    if batch is None:
+        batch = int(os.environ.get("COMAP_BIN_BATCH", "8"))
     M = values.shape[0]
     n_chunks = M // chunk
     v = values.reshape(n_chunks, chunk)

@@ -100,6 +100,44 @@ def test_guard_fires_through_the_stage(tmp_path, monkeypatch):
         stage(data, lvl2)
 
 
+def test_auto_stream_path_matches_unconstrained(tmp_path, monkeypatch):
+    """When the planner forces scan streaming (tight HBM budget), the
+    stage output must equal the unconstrained all-scans-at-once run."""
+    params = SyntheticObsParams(n_feeds=2, n_bands=2, n_channels=32,
+                                n_scans=2, scan_samples=500,
+                                vane_samples=250, seed=17)
+    path = str(tmp_path / "obs.hd5")
+    generate_level1_file(path, params)
+    data = COMAPLevel1()
+    data.read(path)
+    lvl2 = COMAPLevel2(filename=str(tmp_path / "l2.hd5"))
+    vane = resolve("MeasureSystemTemperature")
+    assert vane(data, lvl2)
+    lvl2.update(vane)
+
+    # a budget that admits single-scan streaming but NOT all-at-once
+    F, B, C, T = data.tod_shape
+    from comapreduce_tpu.ops.reduce import scan_starts_lengths
+    _, _, L = scan_starts_lengths(np.asarray(data.scan_edges))
+    tight = int(estimate_reduce_hbm(2, B, C, T, 2, L, scan_batch=1)
+                / 0.9 * 1.05)
+    assert plan_reduce_memory(2, B, C, T, 2, L, None,
+                              hbm_bytes=tight) == 1
+
+    outs = {}
+    for label, budget in (("free", None), ("tight", tight)):
+        if budget is None:
+            monkeypatch.delenv("COMAP_HBM_BYTES", raising=False)
+        else:
+            monkeypatch.setenv("COMAP_HBM_BYTES", str(budget))
+        st = resolve("Level1AveragingGainCorrection", medfilt_window=101)
+        assert st(data, lvl2)
+        outs[label] = {k: v.copy() for k, v in dict(st.save_data[0]).items()}
+    for k in ("averaged_tod/tod", "averaged_tod/weights"):
+        np.testing.assert_allclose(outs["tight"][k], outs["free"][k],
+                                   rtol=2e-5, atol=1e-6)
+
+
 # ------------------------------------------------- NaN ingest (mask=None)
 
 def test_reduce_mask_none_matches_explicit_mask():
