@@ -40,7 +40,8 @@ from comapreduce_tpu.mapmaking.leveldata import read_comap_data
 from comapreduce_tpu.mapmaking.wcs import WCS
 from comapreduce_tpu.pipeline.config import IniConfig
 
-__all__ = ["main", "make_band_map", "write_band_map"]
+__all__ = ["main", "make_band_map", "make_band_maps_joint", "solve_band",
+           "write_band_map"]
 
 
 def _aslist(v):
@@ -126,6 +127,16 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                            galactic=galactic, offset_length=offset_length,
                            use_calibration=use_calibration,
                            medfilt_window=medfilt_window)
+    return data, solve_band(data, offset_length=offset_length,
+                            n_iter=n_iter, threshold=threshold,
+                            use_ground=use_ground, sharded=sharded)
+
+
+def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
+               use_ground=False, sharded=False):
+    """Destripe one already-read band (the solve half of
+    :func:`make_band_map` — callers holding ``DestriperData`` reuse it
+    without re-reading the filelist)."""
     if sharded:
         import jax
 
@@ -189,7 +200,55 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                                  offset_length, n_iter, threshold)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]))
-    return data, result
+    return result
+
+
+def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
+                         galactic=False, offset_length=50, n_iter=100,
+                         threshold=1e-6, use_calibration=True,
+                         medfilt_window=400):
+    """ALL bands in one multi-RHS planned solve.
+
+    The per-band loop's pixel stream comes from pointing alone, so when
+    every band reads the same sample set the bands are independent RHS
+    against one pointing plan: stack (n_bands, N) tod/weights and let
+    ``destripe_planned`` run per-band CGs in a single program — each
+    CG iteration's one-hot binning is built once and contracted against
+    every band (MXU batching), and per-iteration gathers/dispatch are
+    paid once instead of n_bands times.
+
+    Returns ``(datas, results)``: the per-band ``DestriperData`` list
+    plus the per-band result list — or ``(datas, None)`` when the bands'
+    sample streams differ (e.g. a feed dead in one band only); the
+    caller then falls back to per-band ``solve_band`` calls on the SAME
+    ``datas`` (the reads are never repeated).
+    """
+    import jax.numpy as jnp
+
+    datas = [read_comap_data(filenames, band=b, wcs=wcs, nside=nside,
+                             galactic=galactic,
+                             offset_length=offset_length,
+                             use_calibration=use_calibration,
+                             medfilt_window=medfilt_window)
+             for b in bands]
+    pix0 = np.asarray(datas[0].pixels)
+    for d in datas[1:]:
+        if d.tod.size != datas[0].tod.size \
+                or not np.array_equal(np.asarray(d.pixels), pix0):
+            return datas, None
+    n = (datas[0].tod.size // offset_length) * offset_length
+    tod = np.stack([np.asarray(d.tod)[:n] for d in datas])
+    wgt = np.stack([np.asarray(d.weights)[:n] for d in datas])
+    fn = _planned_solver(pix0[:n], datas[0].npix, offset_length, n_iter,
+                         threshold)
+    res = fn(jnp.asarray(tod), jnp.asarray(wgt))
+    results = [res._replace(offsets=res.offsets[i],
+                            destriped_map=res.destriped_map[i],
+                            naive_map=res.naive_map[i],
+                            weight_map=res.weight_map[i],
+                            residual=res.residual[i])
+               for i in range(len(bands))]
+    return datas, results
 
 
 def write_band_map(path, data, result):
@@ -252,14 +311,36 @@ def main(argv=None) -> int:
         shape = [int(x) for x in _aslist(pixel.get("shape", [480, 480]))]
         wcs = WCS.from_field(tuple(crval), tuple(cdelt), tuple(shape))
 
-    for band in bands:
-        data, result = make_band_map(
-            filelist, band, wcs=wcs, nside=nside,
-            galactic=bool(pixel.get("galactic", False)),
-            offset_length=offset_length, n_iter=n_iter, threshold=threshold,
-            use_ground=bool(inputs.get("ground", False)),
-            use_calibration=bool(inputs.get("calibration", True)),
-            sharded=bool(inputs.get("sharded", False)))
+    use_ground = bool(inputs.get("ground", False))
+    use_cal = bool(inputs.get("calibration", True))
+    sharded = bool(inputs.get("sharded", False))
+    galactic = bool(pixel.get("galactic", False))
+
+    # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
+    # binning per iteration); ground/sharded solves keep their own paths
+    joint_datas = joint_results = None
+    if len(bands) > 1 and not use_ground and not sharded:
+        joint_datas, joint_results = make_band_maps_joint(
+            filelist, bands, wcs=wcs, nside=nside, galactic=galactic,
+            offset_length=offset_length, n_iter=n_iter,
+            threshold=threshold, use_calibration=use_cal)
+        if joint_results is None:
+            print("bands read different sample sets; falling back to "
+                  "per-band solves (reusing the reads)")
+
+    for i, band in enumerate(bands):
+        if joint_results is not None:
+            data, result = joint_datas[i], joint_results[i]
+        elif joint_datas is not None:
+            data = joint_datas[i]
+            result = solve_band(data, offset_length=offset_length,
+                                n_iter=n_iter, threshold=threshold)
+        else:
+            data, result = make_band_map(
+                filelist, band, wcs=wcs, nside=nside, galactic=galactic,
+                offset_length=offset_length, n_iter=n_iter,
+                threshold=threshold, use_ground=use_ground,
+                use_calibration=use_cal, sharded=sharded)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         write_band_map(path, data, result)

@@ -114,23 +114,34 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None):
     application (e.g. Jacobi). Convergence tests the TRUE residual norm
     ``|r|^2`` against ``threshold^2 |b|^2`` in both cases. Returns
     ``(x, rz, k, b_norm)`` with ``rz = |r|^2``.
+
+    ``dot`` may return a BATCH of inner products (shape ``(nb,)`` for a
+    multi-RHS solve over per-band leaves ``(nb, n)``): alpha/beta and the
+    breakdown guard then act per system — equivalent to independent CG
+    runs sharing one program — and the loop exits when every system has
+    converged or broken down.
     """
     b_norm = dot(b, b)
     minv = precond if precond is not None else (lambda v: v)
 
+    def bcast(s, leaf):
+        """Align a per-system scalar (shape S) onto a leaf (S + trailing)."""
+        s = jnp.asarray(s)
+        return s.reshape(s.shape + (1,) * (leaf.ndim - s.ndim))
+
     def axpy(a, x, y):
-        return jax.tree.map(lambda xi, yi: xi + a * yi, x, y)
+        return jax.tree.map(lambda xi, yi: xi + bcast(a, xi) * yi, x, y)
 
     def cond(state):
         _, _, _, _, rr, k, done = state
-        return ((k < n_iter) & ~done
-                & (rr > threshold**2 * jnp.maximum(b_norm, 1e-30)))
+        live = ~done & (rr > threshold**2 * jnp.maximum(b_norm, 1e-30))
+        return (k < n_iter) & jnp.any(live)
 
     def body(state):
         x, r, p, rz, rr, k, done = state
         q = matvec(p)
         pq = dot(p, q)
-        ok = jnp.isfinite(pq) & (pq > 0)
+        ok = jnp.isfinite(pq) & (pq > 0) & ~done
         alpha = jnp.where(ok, rz / jnp.where(ok, pq, 1.0), 0.0)
         x_new = axpy(alpha, x, p)
         r_new = axpy(-alpha, r, q)
@@ -140,18 +151,18 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None):
         ok = ok & jnp.isfinite(rz_new) & jnp.isfinite(rr_new)
         beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
         p_new = axpy(beta, z_new, p)
-        # on breakdown: freeze the iterate, keep the last good residual
-        # for reporting, and flag the loop to exit
+        # on breakdown: freeze that system's iterate, keep its last good
+        # residual for reporting, and (once every system is done) exit
         sel = lambda new, old: jax.tree.map(  # noqa: E731
-            lambda a_, b_: jnp.where(ok, a_, b_), new, old)
+            lambda a_, b_: jnp.where(bcast(ok, a_), a_, b_), new, old)
         return (sel(x_new, x), sel(r_new, r), sel(p_new, p),
                 jnp.where(ok, rz_new, rz), jnp.where(ok, rr_new, rr),
-                k + 1, ~ok)
+                k + 1, done | ~ok)
 
     x0 = jax.tree.map(jnp.zeros_like, b)
     z0 = minv(b)
     state0 = (x0, b, z0, dot(b, z0), b_norm, jnp.asarray(0, jnp.int32),
-              jnp.asarray(False))
+              jnp.zeros(jnp.shape(b_norm), bool))
     x, _, _, _, rr, k, _ = jax.lax.while_loop(cond, body, state0)
     return x, rr, k, b_norm
 
@@ -260,8 +271,16 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     pointing is fixed for the whole solve (always true per band); the
     scatter-based :func:`destripe` remains the general/oracle path.
 
-    ``tod``/``weights``: f32[N] in natural sample order, N as the plan was
-    built. Ground-template solves stay on the general path.
+    ``tod``/``weights``: f32[..., N] in natural sample order, N as the
+    plan was built. A leading axis is a MULTI-RHS solve (e.g. all four
+    bands against their shared pointing): every per-iteration one-hot is
+    built once per chunk and contracted against all bands in the same
+    MXU matmul, and the CG runs per-band alphas/convergence (equivalent
+    to independent solves). ``offsets``, the destriped/naive/weight maps
+    and ``residual`` gain the leading axis; ``hit_map`` and ``n_iter``
+    stay shared (hits depend on pointing alone; the loop runs until the
+    slowest band converges). Ground-template solves stay on the general
+    path.
 
     ``axis_name``: set when called inside ``shard_map`` with per-shard
     plans from ``build_sharded_plans`` — compact map sums and CG scalars
@@ -282,12 +301,12 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     n_off, n_rank = plan.n_offsets, plan.n_rank
     P_pad = int(dv["pair_rank"].shape[0])
     N_pad = int(dv["sample_perm"].shape[0])
-    N = tod.shape[0]
+    N = tod.shape[-1]
 
     # sorted sample values; padding slots (which alias sample 0) zeroed
     pad_mask = (jnp.arange(N_pad) < N).astype(f32)
-    w_s = weights[dv["sample_perm"]] * pad_mask
-    wd_s = w_s * tod[dv["sample_perm"]]
+    w_s = jnp.take(weights, dv["sample_perm"], axis=-1) * pad_mask
+    wd_s = w_s * jnp.take(tod, dv["sample_perm"], axis=-1)
 
     def pair_sum(v):
         return binned_window_sum(v, dv["sample_pair"], dv["sample_base"],
@@ -322,14 +341,18 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         n_rank_out = plan.n_rank_global
 
         def to_global(s):
-            g = jnp.zeros(n_rank_out, f32).at[l2g].add(s, mode="drop")
+            # leading (band) dims derive from the operand: the hit-count
+            # path stays unbatched while weight/map sums carry the bands
+            g = jnp.zeros(s.shape[:-1] + (n_rank_out,),
+                          f32).at[..., l2g].add(s, mode="drop")
             return _psum(g)
 
         def from_global(mg):
             # padding/sentinel local ranks read 0 — the scatter path's
             # invalid-sample semantics
             return jnp.where(l2g < n_rank_out,
-                             mg[jnp.clip(l2g, 0, n_rank_out - 1)], 0.0)
+                             jnp.take(mg, jnp.clip(l2g, 0, n_rank_out - 1),
+                                      axis=-1), 0.0)
     else:
         n_rank_out = n_rank
 
@@ -344,8 +367,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     pair_w = pair_sum(w_s)           # P^T-pair weights (rank order)
     pair_wd = pair_sum(wd_s)
     pair_cnt = pair_sum(pad_mask)
-    pair_w_off = pair_w[perm_off]
-    pair_wd_off = pair_wd[perm_off]
+    pair_w_off = jnp.take(pair_w, perm_off, axis=-1)
+    pair_wd_off = jnp.take(pair_wd, perm_off, axis=-1)
     sum_w = to_global(rank_sum(pair_w))  # compact weight map (global)
     diag = off_sum(pair_w_off)       # diagonal of F^T W F (shard-local)
 
@@ -355,13 +378,15 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
 
     def gather_a(a):
         # padding pairs' sentinel offset clamps to a[-1]; their pair_w is 0
-        return a[jnp.clip(dv["pair_offset"], 0, n_off - 1)]
+        return jnp.take(a, jnp.clip(dv["pair_offset"], 0, n_off - 1),
+                        axis=-1)
 
     def gather_m(m):
         # invalid-pixel pairs (sentinel rank) read 0 from the map — the
         # scatter path's sample_map semantics; OFFSET-order output
         return jnp.where(pr_off < n_rank,
-                         m[jnp.clip(pr_off, 0, n_rank - 1)], 0.0)
+                         jnp.take(m, jnp.clip(pr_off, 0, n_rank - 1),
+                                  axis=-1), 0.0)
 
     def matvec(a):
         pav = pair_w * gather_a(a)                 # rank order
@@ -378,9 +403,11 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     corr = off_sum(pair_w_off * pair_w_off * gather_m(from_global(inv_sw)))
     inv_diag = _jacobi_inverse(diag - corr, diag)
 
+    # per-band inner products (last axis only): a multi-RHS solve runs
+    # independent CGs in one program
     a, rz, k, b_norm = _cg_loop(
-        matvec, b, lambda u, v: _psum(jnp.sum(u * v)), n_iter, threshold,
-        precond=lambda v: v * inv_diag)
+        matvec, b, lambda u, v: _psum(jnp.sum(u * v, axis=-1)),
+        n_iter, threshold, precond=lambda v: v * inv_diag)
 
     # final products in the compact rank space; optionally scattered once
     # to the full map (host-side partial-map writers take the compact form)
@@ -394,7 +421,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
             raise ValueError("dense_maps is not supported with sharded "
                              "plans; write the compact maps over "
                              "plan.uniq_global instead")
-        return jnp.zeros(plan.npix, f32).at[uniq].set(
+        return jnp.zeros(cmp.shape[:-1] + (plan.npix,),
+                         f32).at[..., uniq].set(
             cmp, mode="drop", unique_indices=True)
 
     m_destriped = expand(to_map(pair_res))

@@ -85,16 +85,26 @@ class PointingPlan:
     _device: dict = field(default_factory=dict, repr=False)
 
     def device(self) -> dict:
-        """Upload (and cache) the index arrays as device i32 arrays."""
+        """Upload (and cache) the index arrays as device i32 arrays.
+
+        Called both eagerly and under ``jit`` tracing. Under a trace the
+        converted arrays are TRACERS of that trace — caching them would
+        leak stale tracers into the next differently-shaped trace of the
+        same (memoized) plan (observed: the single-band solver's trace
+        poisoning a later multi-RHS retrace). Cache only concrete
+        arrays."""
         if not self._device:
-            self._device = {
+            arrs = {
                 k: jnp.asarray(getattr(self, k), jnp.int32)
                 for k in ("sample_perm", "sample_pair", "sample_base",
                           "pair_rank", "pair_offset", "rank_base",
                           "pair_perm_off", "off_base", "uniq_pixels")}
             if self.rank_to_global is not None:
-                self._device["rank_to_global"] = jnp.asarray(
+                arrs["rank_to_global"] = jnp.asarray(
                     self.rank_to_global, jnp.int32)
+            if any(isinstance(v, jax.core.Tracer) for v in arrs.values()):
+                return arrs   # mid-trace: hand back, never cache
+            self._device = arrs
         return self._device
 
 
@@ -276,16 +286,22 @@ def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
 def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
                       window: int, chunk: int, out_size: int,
                       batch: int | None = None) -> jax.Array:
-    """Sum ``values`` into ``out[id]`` for pre-sorted, chunk-windowed ids.
+    """Sum ``values`` into ``out[..., id]`` for pre-sorted, chunk-windowed
+    ids.
 
-    ``values``/``ids``: f32/i32[M] with ``M % chunk == 0`` and every id of
-    chunk c inside ``[base[c], base[c] + window)`` (ids outside — sentinels
-    — are dropped). The inner product against the equality one-hot is an
-    MXU matmul (f32-exact: one-hot entries are 0/1); chunks stream through
-    ``lax.map`` so the one-hot never materialises beyond
-    ``batch * chunk * window`` floats. Assembly of the per-chunk windows is
-    the only scatter left — ``n_chunks * window`` elements, orders of
-    magnitude smaller than a per-sample scatter.
+    ``values``: f32[..., M]; ``ids``: i32[M] with ``M % chunk == 0`` and
+    every id of chunk c inside ``[base[c], base[c] + window)`` (ids
+    outside — sentinels — are dropped). The inner product against the
+    equality one-hot is an MXU matmul (f32-exact: one-hot entries are
+    0/1); chunks stream through ``lax.map`` so the one-hot never
+    materialises beyond ``batch * chunk * window`` floats. Assembly of
+    the per-chunk windows is the only scatter left — ``n_chunks *
+    window`` elements, orders of magnitude smaller than a per-sample
+    scatter.
+
+    Leading axes of ``values`` (the multi-RHS destriper's band axis) ride
+    through: the one-hot is built ONCE per chunk and contracted against
+    every band's value row in the same matmul.
 
     ``batch=None`` reads the ``COMAP_BIN_BATCH`` env default (8) — the
     round-3 "next lever (c)" sweep knob: larger batches amortise
@@ -295,23 +311,27 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
     """
     if batch is None:
         batch = int(os.environ.get("COMAP_BIN_BATCH", "8"))
-    M = values.shape[0]
+    M = values.shape[-1]
+    lead = values.shape[:-1]
     n_chunks = M // chunk
-    v = values.reshape(n_chunks, chunk)
+    # chunk axis FIRST so lax.map streams it; bands stay minor
+    v = jnp.moveaxis(values.reshape(lead + (n_chunks, chunk)), -2, 0)
     ids_c = ids.reshape(n_chunks, chunk)
 
     def body(args):
-        v_c, id_c, b_c = args
+        v_c, id_c, b_c = args                      # (..., chunk), (chunk,)
         local = id_c - b_c
         oh = (local[:, None] == jnp.arange(window)[None, :])
         return jax.lax.dot_general(
-            v_c[None, :], oh.astype(v_c.dtype),
-            (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST)[0]
+            v_c, oh.astype(v_c.dtype),
+            (((v_c.ndim - 1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)   # (..., window)
 
     part = jax.lax.map(body, (v, ids_c, base), batch_size=batch)
-    out = jnp.zeros(out_size + window, values.dtype)
+    part = jnp.moveaxis(part, 0, -2)               # (..., n_chunks, window)
+    out = jnp.zeros(lead + (out_size + window,), values.dtype)
     idx = (base[:, None].astype(jnp.int32)
            + jnp.arange(window, dtype=jnp.int32)[None, :])
-    out = out.at[idx.reshape(-1)].add(part.reshape(-1), mode="drop")
-    return out[:out_size]
+    out = out.at[..., idx.reshape(-1)].add(
+        part.reshape(lead + (n_chunks * window,)), mode="drop")
+    return out[..., :out_size]

@@ -379,3 +379,34 @@ def test_create_filelist_cli(field_dataset, tmp_path):
                  "--output", out, "--rejected", rej]) == 0
     with open(rej) as f:
         assert len([ln for ln in f if ln.strip()]) == len(l2)
+
+
+def test_joint_multiband_matches_per_band(field_dataset):
+    """make_band_maps_joint (one multi-RHS CG for all bands) reproduces
+    the independent per-band planned solves."""
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli.run_destriper import (make_band_map,
+                                                   make_band_maps_joint)
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2), "run after test_run_average_cli"
+    wcs = WCS.from_field((170.0, 52.0), (1.0 / 30, 1.0 / 30), (240, 240))
+    datas, results = make_band_maps_joint(l2, [0, 1], wcs=wcs,
+                                          offset_length=50,
+                                          n_iter=60, threshold=1e-8)
+    assert results is not None
+    for i, band in enumerate((0, 1)):
+        _, single = make_band_map(l2, band, wcs=wcs, offset_length=50,
+                                  n_iter=60, threshold=1e-8)
+        rj = results[i]
+        scale = np.nanstd(np.asarray(single.destriped_map))
+        np.testing.assert_allclose(np.asarray(rj.destriped_map),
+                                   np.asarray(single.destriped_map),
+                                   rtol=0, atol=5e-4 * max(scale, 1.0))
+        np.testing.assert_allclose(np.asarray(rj.naive_map),
+                                   np.asarray(single.naive_map),
+                                   rtol=0, atol=1e-4 * max(scale, 1.0))
+        np.testing.assert_array_equal(np.asarray(rj.hit_map),
+                                      np.asarray(single.hit_map))
