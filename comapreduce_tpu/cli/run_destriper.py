@@ -93,9 +93,10 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                             offset_length: int, n_iter: int,
-                            threshold: float):
+                            threshold: float, n_bands: int = 0):
     """Memoized sharded solver (plans + ONE compiled shard_map program
-    per pointing — bands share both)."""
+    per pointing — bands share both). ``n_bands > 0`` builds the
+    multi-RHS program (all bands in one CG)."""
     from comapreduce_tpu.mapmaking.pointing_plan import build_sharded_plans
     from comapreduce_tpu.parallel.sharded import (
         make_destripe_sharded_planned)
@@ -105,12 +106,37 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
     def build(pix):
         plans = build_sharded_plans(pix, npix, offset_length, n_shards)
         run = make_destripe_sharded_planned(mesh, plans, n_iter=n_iter,
-                                            threshold=threshold)
+                                            threshold=threshold,
+                                            n_bands=n_bands)
         return run, np.asarray(plans[0].uniq_global)
 
-    return _memoized("sharded", pixels,
+    return _memoized(f"sharded{n_bands}", pixels,
                      (n_shards, int(npix), int(offset_length), int(n_iter),
                       float(threshold)), build)
+
+
+def _shard_quantum(mesh, offset_length: int) -> int:
+    """Padding quantum of the sharded solvers: every shard gets whole
+    offsets."""
+    return len(mesh.devices.ravel()) * offset_length
+
+
+def _pad_pixels(pix: np.ndarray, n_pad: int, npix: int) -> np.ndarray:
+    """Host-side shard padding of the pixel stream: the out-of-range
+    ``npix`` sentinel carries zero weight downstream. ONE home for the
+    sentinel rule — the single-band and joint sharded paths must never
+    drift apart."""
+    if not n_pad:
+        return pix
+    return np.concatenate([pix, np.full(n_pad, npix, pix.dtype)])
+
+
+def _expand_compact(uniq: np.ndarray, npix: int, compact) -> np.ndarray:
+    """Compact (hit-pixel) map over ``uniq`` -> the band's full pixel
+    space (shared by both sharded paths)."""
+    full = np.zeros(npix, np.float32)
+    full[uniq] = np.asarray(compact)[: uniq.size]
+    return full
 
 
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
@@ -155,16 +181,14 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
         else:
             import jax.numpy as jnp
 
-            n_shards = len(mesh.devices.ravel())
             # pad on host: the pixel vector is consumed by the host plan
             # build only — routing it through pad_for_shards would cost a
             # full H2D+D2H round trip of several GB at production scale
-            pix_host = np.asarray(data.pixels)
-            n_pad = (-data.tod.size) % (n_shards * offset_length)
+            n_pad = (-data.tod.size) % _shard_quantum(mesh, offset_length)
+            pix_host = _pad_pixels(np.asarray(data.pixels), n_pad,
+                                   data.npix)
             tod, weights = data.tod, data.weights
             if n_pad:
-                pix_host = np.concatenate(
-                    [pix_host, np.full(n_pad, data.npix, pix_host.dtype)])
                 tod = jnp.concatenate(
                     [jnp.asarray(tod), jnp.zeros(n_pad, jnp.float32)])
                 weights = jnp.concatenate(
@@ -172,18 +196,14 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             run, uniq = _sharded_planned_solver(
                 mesh, pix_host, data.npix, offset_length, n_iter, threshold)
             result = run(tod, weights)
-            # compact (hit-pixel) maps -> the band's full pixel space
-
-            def expand(compact):
-                full = np.zeros(data.npix, np.float32)
-                full[uniq] = np.asarray(compact)[: uniq.size]
-                return full
-
             result = result._replace(
-                destriped_map=expand(result.destriped_map),
-                naive_map=expand(result.naive_map),
-                weight_map=expand(result.weight_map),
-                hit_map=expand(result.hit_map))
+                destriped_map=_expand_compact(uniq, data.npix,
+                                              result.destriped_map),
+                naive_map=_expand_compact(uniq, data.npix,
+                                          result.naive_map),
+                weight_map=_expand_compact(uniq, data.npix,
+                                           result.weight_map),
+                hit_map=_expand_compact(uniq, data.npix, result.hit_map))
     else:
         n = (data.tod.size // offset_length) * offset_length
         if use_ground:
@@ -206,7 +226,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
 def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          galactic=False, offset_length=50, n_iter=100,
                          threshold=1e-6, use_calibration=True,
-                         medfilt_window=400):
+                         medfilt_window=400, sharded=False):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -236,10 +256,40 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
         if d.tod.size != datas[0].tod.size \
                 or not np.array_equal(np.asarray(d.pixels), pix0):
             return datas, None
+    npix = datas[0].npix
+    nb = len(bands)
+    if sharded:
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.local_devices()), ("time",))
+        N = datas[0].tod.size
+        n_pad = (-N) % _shard_quantum(mesh, offset_length)
+        pix_host = _pad_pixels(pix0, n_pad, npix)
+        # ONE preallocated stack per input (no per-band concatenate
+        # temporaries on top of the datas already in memory)
+        tod = np.zeros((nb, N + n_pad), np.float32)
+        wgt = np.zeros((nb, N + n_pad), np.float32)
+        for i, d in enumerate(datas):
+            tod[i, :N] = d.tod
+            wgt[i, :N] = d.weights
+        run, uniq = _sharded_planned_solver(
+            mesh, pix_host, npix, offset_length, n_iter, threshold,
+            n_bands=nb)
+        res = run(jnp.asarray(tod), jnp.asarray(wgt))
+        hit_full = _expand_compact(uniq, npix, res.hit_map)
+        results = [res._replace(
+            offsets=res.offsets[i],
+            destriped_map=_expand_compact(uniq, npix, res.destriped_map[i]),
+            naive_map=_expand_compact(uniq, npix, res.naive_map[i]),
+            weight_map=_expand_compact(uniq, npix, res.weight_map[i]),
+            hit_map=hit_full,
+            residual=res.residual[i]) for i in range(nb)]
+        return datas, results
     n = (datas[0].tod.size // offset_length) * offset_length
     tod = np.stack([np.asarray(d.tod)[:n] for d in datas])
     wgt = np.stack([np.asarray(d.weights)[:n] for d in datas])
-    fn = _planned_solver(pix0[:n], datas[0].npix, offset_length, n_iter,
+    fn = _planned_solver(pix0[:n], npix, offset_length, n_iter,
                          threshold)
     res = fn(jnp.asarray(tod), jnp.asarray(wgt))
     results = [res._replace(offsets=res.offsets[i],
@@ -319,11 +369,12 @@ def main(argv=None) -> int:
     # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
     # binning per iteration); ground/sharded solves keep their own paths
     joint_datas = joint_results = None
-    if len(bands) > 1 and not use_ground and not sharded:
+    if len(bands) > 1 and not use_ground:
         joint_datas, joint_results = make_band_maps_joint(
             filelist, bands, wcs=wcs, nside=nside, galactic=galactic,
             offset_length=offset_length, n_iter=n_iter,
-            threshold=threshold, use_calibration=use_cal)
+            threshold=threshold, use_calibration=use_cal,
+            sharded=sharded)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -334,7 +385,8 @@ def main(argv=None) -> int:
         elif joint_datas is not None:
             data = joint_datas[i]
             result = solve_band(data, offset_length=offset_length,
-                                n_iter=n_iter, threshold=threshold)
+                                n_iter=n_iter, threshold=threshold,
+                                sharded=sharded)
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,

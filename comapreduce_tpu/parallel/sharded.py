@@ -180,7 +180,8 @@ _PLAN_KEYS = ("sample_perm", "sample_pair", "sample_base", "pair_rank",
 
 def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                   n_iter: int = 100,
-                                  threshold: float = 1e-6):
+                                  threshold: float = 1e-6,
+                                  n_bands: int = 0):
     """Build a reusable sharded planned-destriper: returns
     ``run(tod, weights) -> DestriperResult``.
 
@@ -188,6 +189,11 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     jitted shard_map program — callers solving several RHS against the
     same pointing (e.g. the per-band loop of ``run_destriper``, whose
     pixels are band-invariant) pay the plan upload and XLA compile once.
+
+    ``n_bands > 0`` builds the MULTI-RHS program: ``tod``/``weights`` are
+    f32[n_bands, N] with the band axis replicated and the time axis
+    sharded; offsets/maps/residual come back with the leading band axis
+    (see ``destripe_planned``), the whole stack in one CG.
     """
     axes = tuple(mesh.axis_names)
     n_shards = int(np.prod([mesh.shape[a] for a in axes]))
@@ -202,6 +208,9 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
 
     shard = P(axes)
     repl = P()
+    # band axis replicated, time axis sharded
+    v_spec = P(None, axes) if n_bands else shard
+    band_repl = P(None) if n_bands else repl
 
     def local(tod_l, w_l, arrs):
         arrs = {k: v[0] for k, v in arrs.items()}
@@ -210,11 +219,12 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                 dense_maps=False, device_arrays=arrs)
 
     out_specs = DestriperResult(
-        offsets=shard, ground=repl, destriped_map=repl, naive_map=repl,
-        weight_map=repl, hit_map=repl, n_iter=repl, residual=repl)
+        offsets=v_spec, ground=repl, destriped_map=band_repl,
+        naive_map=band_repl, weight_map=band_repl, hit_map=repl,
+        n_iter=repl, residual=band_repl)
     arr_specs = {k: shard for k in stacked}
     fn = jax.jit(_shard_map(local, mesh=mesh,
-                            in_specs=(shard, shard, arr_specs),
+                            in_specs=(v_spec, v_spec, arr_specs),
                             out_specs=out_specs, check_vma=False))
 
     def run(tod, weights) -> DestriperResult:
