@@ -26,9 +26,11 @@ import jax
 import jax.numpy as jnp
 
 from comapreduce_tpu.mapmaking.binning import _sanitize
+from comapreduce_tpu.mapmaking.destriper import _cg_loop
+from comapreduce_tpu.mapmaking.pointing_plan import binned_window_sum
 
 __all__ = ["PolMapState", "pol_map_solve", "destripe_pol",
-           "PolDestriperResult"]
+           "destripe_pol_planned", "PolDestriperResult"]
 
 
 class PolMapState(NamedTuple):
@@ -55,6 +57,26 @@ def _stokes_basis(c2, s2):
     return jnp.stack([one, c2, s2], axis=-1)
 
 
+def _ata_scale_solvable(ata, hits):
+    """(scale, rcond_ok) of per-pixel normal matrices — ONE home for the
+    solvability criterion and Tikhonov scale, shared by the scatter and
+    planned paths (drift here would mask different pixel sets).
+
+    Normalise by the trace BEFORE the determinant — weights can be huge
+    (1/sigma^2) and det(A) ~ w^3 overflows f32."""
+    trace = jnp.trace(ata, axis1=-2, axis2=-1)
+    scale = jnp.maximum(trace / 3.0, 1e-30)
+    det_n = jnp.linalg.det(ata / scale[:, None, None])
+    rcond_ok = (hits >= 3) & (det_n > 1e-6)
+    return scale, rcond_ok
+
+
+def _tikhonov(ata, scale):
+    """Per-pixel floor scaled to each pixel's weight magnitude."""
+    eye = jnp.eye(3, dtype=ata.dtype)
+    return ata + (1e-6 * scale)[:, None, None] * eye
+
+
 def _pol_accumulate(pixels, weights, c2, s2, npix, axis_name):
     s = _stokes_basis(c2, s2)                       # (N, 3)
     outer = s[:, :, None] * s[:, None, :]           # (N, 3, 3)
@@ -66,13 +88,7 @@ def _pol_accumulate(pixels, weights, c2, s2, npix, axis_name):
     if axis_name is not None:
         ata = jax.lax.psum(ata, axis_name)
         hits = jax.lax.psum(hits, axis_name)
-    # solvable: enough angle diversity that A is well conditioned.
-    # Normalise by the trace BEFORE the determinant — weights can be huge
-    # (1/sigma^2) and det(A) ~ w^3 overflows f32.
-    trace = jnp.trace(ata, axis1=-2, axis2=-1)
-    scale = jnp.maximum(trace / 3.0, 1e-30)
-    det_n = jnp.linalg.det(ata / scale[:, None, None])
-    rcond_ok = (hits >= 3) & (det_n > 1e-6)
+    _, rcond_ok = _ata_scale_solvable(ata, hits)
     return PolMapState(ata, hits, rcond_ok)
 
 
@@ -85,11 +101,8 @@ def pol_map_solve(d, pixels, weights, c2, s2, npix, state: PolMapState,
     b = jax.ops.segment_sum(wd, pix, num_segments=npix)
     if axis_name is not None:
         b = jax.lax.psum(b, axis_name)
-    eye = jnp.eye(3, dtype=d.dtype)
-    # Tikhonov floor scaled to each pixel's weight magnitude
-    scale = jnp.maximum(jnp.trace(state.ata, axis1=-2, axis2=-1) / 3.0,
-                        1e-30)
-    a_reg = state.ata + (1e-6 * scale)[:, None, None] * eye
+    scale, _ = _ata_scale_solvable(state.ata, state.hits)
+    a_reg = _tikhonov(state.ata, scale)
     m = jnp.linalg.solve(a_reg, b[..., None])[..., 0]
     return jnp.where(state.rcond_ok[:, None], m, 0.0)
 
@@ -184,3 +197,131 @@ destripe_pol_jit = jax.jit(
     destripe_pol,
     static_argnames=("npix", "offset_length", "n_iter", "threshold",
                      "axis_name"))
+
+
+def destripe_pol_planned(tod, weights, psi, plan, n_iter: int = 100,
+                         threshold: float = 1e-6) -> PolDestriperResult:
+    """Scatter-free polarized destriping on a :class:`PointingPlan`.
+
+    The unpolarized planned path (``destriper.destripe_planned``)
+    generalises: within a (pixel, offset) pair the Stokes basis varies
+    per sample, so the pair aggregates become per-pair 3-vectors
+    ``pws_k = sum_t w s_k`` and 6-vectors ``pwss`` (the unique entries
+    of ``w s s^T``) — carried as LEADING axes through the same windowed
+    one-hot binning (one one-hot per chunk, contracted against all
+    Stokes rows in one MXU matmul). The per-pixel 3x3 systems are
+    prefactored ONCE (masked inverse of the Tikhonov-regularised
+    ``A_p``), so each CG iteration is binning + two small batched
+    matmuls — no per-iteration scatter, no per-iteration solves.
+
+    Same math as :func:`destripe_pol` (parity-tested); single-process,
+    single-RHS (the sharded pol solve stays on the scatter path).
+    """
+    if tod.ndim != 1:
+        # a batched (nb, N) input would broadcast band rows against the
+        # 3 Stokes rows and return plausible-looking garbage
+        raise ValueError("destripe_pol_planned is single-RHS: tod must "
+                         f"be 1-D, got shape {tod.shape}")
+    dv = plan.device()
+    f32 = tod.dtype
+    n_off, n_rank = plan.n_offsets, plan.n_rank
+    P_pad = int(dv["pair_rank"].shape[0])
+    N_pad = int(dv["sample_perm"].shape[0])
+    N = tod.shape[-1]
+
+    perm = dv["sample_perm"]
+    pad_mask = (jnp.arange(N_pad) < N).astype(f32)
+    w_s = jnp.take(weights, perm, axis=-1) * pad_mask
+    d_s = jnp.take(tod, perm, axis=-1)
+    c2_s = jnp.take(jnp.cos(2.0 * psi), perm, axis=-1)
+    s2_s = jnp.take(jnp.sin(2.0 * psi), perm, axis=-1)
+    one = jnp.ones_like(c2_s)
+
+    def pair_sum(v):
+        return binned_window_sum(v, dv["sample_pair"], dv["sample_base"],
+                                 plan.sample_window, plan.sample_chunk,
+                                 P_pad)
+
+    def rank_sum(pv):
+        return binned_window_sum(pv, dv["pair_rank"], dv["rank_base"],
+                                 plan.rank_window, plan.pair_chunk, n_rank)
+
+    perm_off = dv["pair_perm_off"]
+    po_off = jnp.take(dv["pair_offset"], perm_off, axis=-1)
+    pr_off = jnp.take(dv["pair_rank"], perm_off, axis=-1)
+
+    def off_sum(pv_off):
+        return binned_window_sum(pv_off, po_off, dv["off_base"],
+                                 plan.off_window, plan.pair_chunk, n_off)
+
+    # -- one-time pair/rank aggregates: ONE stacked binning pass -------
+    # rows 0-2: w*s_k (pws); 3-5: w*d*s_k (pwds); 6-8: w*[cc, cs, ss]
+    # (the ss^T entries pws rows 0-2 don't already cover); 9: hit counts
+    stacked = pair_sum(jnp.stack(
+        [w_s, w_s * c2_s, w_s * s2_s,
+         w_s * d_s, w_s * d_s * c2_s, w_s * d_s * s2_s,
+         w_s * c2_s * c2_s, w_s * c2_s * s2_s, w_s * s2_s * s2_s,
+         (w_s > 0).astype(f32)]))                        # (10, P_pad)
+    pws = stacked[0:3]
+    pwds = stacked[3:6]
+    ranked = rank_sum(jnp.concatenate(
+        [stacked[0:3], stacked[6:9], stacked[9:10]]))    # (7, n_rank)
+    e0, e1, e2, e3, e4, e5 = ranked[:6]
+    hits = ranked[6]
+    ata = jnp.stack([jnp.stack([e0, e1, e2], -1),
+                     jnp.stack([e1, e3, e4], -1),
+                     jnp.stack([e2, e4, e5], -1)], -2)   # (n_rank, 3, 3)
+    scale, rcond_ok = _ata_scale_solvable(ata, hits)
+    a_reg = _tikhonov(ata, scale)
+    # masked prefactor: bad pixels read an all-zero inverse, so their
+    # maps and per-sample projections vanish exactly like the scatter
+    # path's rcond mask
+    inv_a = jnp.where(rcond_ok[:, None, None], jnp.linalg.inv(a_reg), 0.0)
+
+    pws_off = jnp.take(pws, perm_off, axis=-1)
+    pwds_off = jnp.take(pwds, perm_off, axis=-1)
+    diag = off_sum(pws_off[0])                           # sum_w per offset
+
+    def solve_map(b_rank):
+        """m = masked A^-1 b, (3, n_rank) -> (3, n_rank)."""
+        return jnp.einsum("rkj,jr->kr", inv_a, b_rank)
+
+    def gather_a(a):
+        return jnp.take(a, jnp.clip(dv["pair_offset"], 0, n_off - 1),
+                        axis=-1)
+
+    def gather_m(m):
+        return jnp.where(pr_off < n_rank,
+                         jnp.take(m, jnp.clip(pr_off, 0, n_rank - 1),
+                                  axis=-1), 0.0)
+
+    def matvec(a):
+        b_rank = rank_sum(pws * gather_a(a))             # (3, n_rank)
+        m = solve_map(b_rank)
+        return diag * a - off_sum(jnp.sum(
+            pws_off * gather_m(m), axis=0))
+
+    m_d = solve_map(rank_sum(pwds))                      # naive IQU
+    b = off_sum(pwds_off[0]
+                - jnp.sum(pws_off * gather_m(m_d), axis=0))
+
+    a, rz, k, b_norm = _cg_loop(
+        matvec, b, lambda u, v: jnp.sum(u * v, axis=-1), n_iter,
+        threshold)
+    # zero-mean pinning: same convention as the scatter path (a constant
+    # offset vector is near-degenerate with the I map)
+    a = a - jnp.mean(a)
+
+    pair_res = pwds - pws * gather_a(a)
+    iqu_destriped_c = solve_map(rank_sum(pair_res))      # (3, n_rank)
+
+    uniq = dv["uniq_pixels"]
+
+    def expand(cmp):
+        return jnp.zeros(cmp.shape[:-1] + (plan.npix,), f32).at[
+            ..., uniq].set(cmp, mode="drop", unique_indices=True)
+
+    residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
+    return PolDestriperResult(
+        a, expand(iqu_destriped_c).T, expand(m_d).T,
+        expand(hits), expand(rcond_ok.astype(f32)) > 0, k, residual)
