@@ -57,15 +57,20 @@ def _stokes_basis(c2, s2):
     return jnp.stack([one, c2, s2], axis=-1)
 
 
+def _ata_scale(ata):
+    """Per-pixel Tikhonov scale (trace/3) — cheap, used every solve."""
+    return jnp.maximum(jnp.trace(ata, axis1=-2, axis2=-1) / 3.0, 1e-30)
+
+
 def _ata_scale_solvable(ata, hits):
     """(scale, rcond_ok) of per-pixel normal matrices — ONE home for the
     solvability criterion and Tikhonov scale, shared by the scatter and
-    planned paths (drift here would mask different pixel sets).
+    planned paths (drift here would mask different pixel sets). Runs a
+    per-pixel determinant: call once at setup, not per CG iteration.
 
     Normalise by the trace BEFORE the determinant — weights can be huge
     (1/sigma^2) and det(A) ~ w^3 overflows f32."""
-    trace = jnp.trace(ata, axis1=-2, axis2=-1)
-    scale = jnp.maximum(trace / 3.0, 1e-30)
+    scale = _ata_scale(ata)
     det_n = jnp.linalg.det(ata / scale[:, None, None])
     rcond_ok = (hits >= 3) & (det_n > 1e-6)
     return scale, rcond_ok
@@ -101,8 +106,7 @@ def pol_map_solve(d, pixels, weights, c2, s2, npix, state: PolMapState,
     b = jax.ops.segment_sum(wd, pix, num_segments=npix)
     if axis_name is not None:
         b = jax.lax.psum(b, axis_name)
-    scale, _ = _ata_scale_solvable(state.ata, state.hits)
-    a_reg = _tikhonov(state.ata, scale)
+    a_reg = _tikhonov(state.ata, _ata_scale(state.ata))
     m = jnp.linalg.solve(a_reg, b[..., None])[..., 0]
     return jnp.where(state.rcond_ok[:, None], m, 0.0)
 
@@ -235,7 +239,6 @@ def destripe_pol_planned(tod, weights, psi, plan, n_iter: int = 100,
     d_s = jnp.take(tod, perm, axis=-1)
     c2_s = jnp.take(jnp.cos(2.0 * psi), perm, axis=-1)
     s2_s = jnp.take(jnp.sin(2.0 * psi), perm, axis=-1)
-    one = jnp.ones_like(c2_s)
 
     def pair_sum(v):
         return binned_window_sum(v, dv["sample_pair"], dv["sample_base"],

@@ -1,0 +1,138 @@
+"""One-command on-chip measurement session for when the TPU relay is up.
+
+Runs, in order, against the real chip:
+
+1. ``bench.py`` (full production-shape benchmark, measured baseline) —
+   the BENCH_r{N} evidence;
+2. a ``COMAP_BIN_BATCH`` sweep of the destriper's one-hot chunk batch
+   ("next lever (c)"), reusing the measured baseline so each point only
+   pays the TPU wall time;
+3. a joint multi-RHS vs per-band destriper timing at production pointing
+   (the round-4 multi-RHS lever).
+
+Appends one JSON line per measurement to ``SWEEP_r04.jsonl`` (repo root)
+so a wedge mid-session loses nothing. Never signals a child process (a
+signal landing mid-remote-compile wedges the relay — see
+.claude/skills/verify/SKILL.md).
+
+Usage: ``python tools/onchip_sweep.py [--skip-bench]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "SWEEP_r04.jsonl")
+
+
+def log_line(obj: dict) -> None:
+    obj["t"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(OUT, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+    print(json.dumps(obj), flush=True)
+
+
+def run_bench(env_extra: dict, label: str) -> dict | None:
+    env = dict(os.environ, **env_extra)
+    proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        log_line({"kind": "bench-failed", "label": label,
+                  "rc": proc.returncode,
+                  "err": proc.stderr.strip()[-400:]})
+        return None
+    line = _last_json(proc.stdout)
+    if line is None:
+        log_line({"kind": "bench-noparse", "label": label,
+                  "out": proc.stdout.strip()[-400:]})
+        return None
+    log_line({"kind": "bench", "label": label, **line})
+    return line
+
+
+def _last_json(stdout: str) -> dict | None:
+    """Last parseable JSON line of a child's stdout, or None — a stray
+    warning line must not abort the whole sweep session."""
+    for raw in reversed(stdout.strip().splitlines()):
+        try:
+            return json.loads(raw)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None
+
+
+def main() -> int:
+    skip_bench = "--skip-bench" in sys.argv
+    baseline_s = os.environ.get("BENCH_BASELINE_S", "")
+
+    first = None
+    if not skip_bench:
+        first = run_bench({}, "bench-default")
+        if first is None:
+            return 3
+        baseline_s = str(first["detail"]["baseline_unit_s"])
+
+    # lever (c): bin-batch sweep, baseline reused (one ~60 s measurement
+    # per session is enough; wall_s is the comparable number)
+    for batch in (8, 16, 32, 64):
+        run_bench({"COMAP_BIN_BATCH": str(batch),
+                   **({"BENCH_BASELINE_S": baseline_s} if baseline_s
+                      else {})},
+                  f"bin-batch-{batch}")
+
+    # multi-RHS destriper: 4 bands jointly vs serially on one pointing
+    code = r"""
+import json, time
+import numpy as np, jax, jax.numpy as jnp
+import functools
+from bench import ces_pixels
+from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+from comapreduce_tpu.mapmaking.destriper import destripe_planned
+
+import os
+small = os.environ.get("SWEEP_SMALL", "") == "1"   # CPU smoke of this code
+F, B, T, nx = (2, 2, 4000, 32) if small else (19, 4, 135704, 480)
+L, n_iter = (25, 20) if small else (50, 100)
+pix = np.concatenate([ces_pixels(T, nx, nx, f, F) for f in range(F)])
+n = (pix.size // L) * L
+pix = pix[:n]
+plan = build_pointing_plan(pix, nx * nx, L)
+key = jax.random.key(3, impl="rbg")
+tod = jax.random.normal(key, (B, n), jnp.float32)
+w = jnp.ones((B, n), jnp.float32)
+# one jitted fn serves both shapes (jit caches per input shape)
+solve = jax.jit(functools.partial(destripe_planned, plan=plan,
+                                  n_iter=n_iter, threshold=1e-8))
+
+def timed(fn, *a):
+    r = fn(*a); jax.block_until_ready(r.destriped_map)
+    float(jnp.sum(r.destriped_map))  # force host fetch (tunnel quirk)
+    t0 = time.perf_counter()
+    r = fn(*a); jax.block_until_ready(r.destriped_map)
+    float(jnp.sum(r.destriped_map))
+    return time.perf_counter() - t0
+
+tj = timed(solve, tod, w)
+ts = sum(timed(solve, tod[b], w[b]) for b in range(B))
+print(json.dumps({"joint_4band_s": round(tj, 3),
+                  "serial_4band_s": round(ts, 3),
+                  "speedup": round(ts / tj, 2)}))
+"""
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True)
+    parsed = _last_json(proc.stdout) if proc.returncode == 0 else None
+    if parsed is not None:
+        log_line({"kind": "multi-rhs", **parsed})
+    else:
+        log_line({"kind": "multi-rhs-failed", "rc": proc.returncode,
+                  "err": proc.stderr.strip()[-400:]})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
