@@ -367,9 +367,12 @@ def main(argv=None) -> int:
     galactic = bool(pixel.get("galactic", False))
 
     # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
-    # binning per iteration); ground/sharded solves keep their own paths
+    # binning per iteration); ground solves keep their own path.
+    # `[Inputs] joint : false` forces per-band solves (measurement
+    # escape hatch until the on-chip joint-vs-serial numbers land)
+    use_joint = bool(inputs.get("joint", True))
     joint_datas = joint_results = None
-    if len(bands) > 1 and not use_ground:
+    if use_joint and len(bands) > 1 and not use_ground:
         joint_datas, joint_results = make_band_maps_joint(
             filelist, bands, wcs=wcs, nside=nside, galactic=galactic,
             offset_length=offset_length, n_iter=n_iter,
