@@ -1,0 +1,55 @@
+"""Co-add per-rank partial maps into one map file.
+
+Usage::
+
+    python -m comapreduce_tpu.cli.coadd_maps OUTPUT.fits RANK1.fits ...
+    python -m comapreduce_tpu.cli.coadd_maps OUTPUT.fits --glob \
+        'maps/co2_band0_rank*.fits'
+
+Role parity: the reference's in-MPI map Allreduce
+(``MapMaking/Destriper.py:61-75``) — here an offline inverse-variance
+co-add over the rank files a sharded ``run_destriper`` launch writes.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    usage = ("usage: python -m comapreduce_tpu.cli.coadd_maps "
+             "OUTPUT.fits (RANK.fits ... | --glob PATTERN)")
+    if argv and argv[0] in ("-h", "--help"):
+        print(usage)
+        return 0
+    if len(argv) < 2:
+        print(usage, file=sys.stderr)
+        return 2
+    output, rest = argv[0], argv[1:]
+    if rest[0] == "--glob":
+        import glob as _glob
+
+        if len(rest) != 2:
+            print(usage, file=sys.stderr)
+            return 2
+        inputs = sorted(_glob.glob(rest[1]))
+    else:
+        inputs = rest
+    if not inputs:
+        print("coadd_maps: no input files", file=sys.stderr)
+        return 1
+    from comapreduce_tpu.mapmaking.coadd import coadd_fits_files
+
+    out = coadd_fits_files(inputs, output)
+    hits = out.get("HITS")
+    print(f"{output}: {len(inputs)} rank maps"
+          + (f", {int((hits > 0).sum())} hit pixels"
+             if hits is not None else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,109 @@
+"""Co-add per-rank partial maps into one map.
+
+A multi-process ``run_destriper`` launch shards the filelist and writes
+``{prefix}_band{b}_rank{r}.fits`` per rank (``cli/run_destriper.py``);
+the reference instead Allreduces into one map inside MPI
+(``MapMaking/Destriper.py:61-75``). This module is the offline
+equivalent: inverse-variance co-addition of the rank maps —
+
+    map = sum_r w_r m_r / sum_r w_r,   w = WEIGHTS,  hits add
+
+— for both the WCS FITS layout and the partial-sky HEALPix layout
+(ranks may cover different pixel sets; the union is taken).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.mapmaking.fits_io import (read_fits_image,
+                                               write_fits_image,
+                                               write_healpix_map)
+
+__all__ = ["coadd_maps", "coadd_fits_files"]
+
+_WEIGHTED = ("DESTRIPED", "NAIVE")   # weight-averaged products
+_SUMMED = ("WEIGHTS", "HITS")        # additive products
+
+
+def coadd_maps(rank_maps: list[dict]) -> dict:
+    """Inverse-variance co-add of per-rank map dicts (same pixel grid).
+
+    Each dict holds flat/2-D arrays for ``DESTRIPED``/``NAIVE`` (map
+    units), ``WEIGHTS`` (1/variance) and ``HITS``. Pixels with zero
+    total weight come back 0 (the destriper's unhit convention).
+    """
+    if not rank_maps:
+        raise ValueError("coadd_maps: no rank maps")
+    w_tot = np.sum([np.asarray(m["WEIGHTS"], np.float64)
+                    for m in rank_maps], axis=0)
+    # DESTRIPED first: write_fits_image makes the first key the primary
+    # HDU, and the rank maps (write_band_map, mirroring the reference
+    # layout) lead with the destriped sky map
+    out = {}
+    for key in _WEIGHTED:
+        if not all(key in m for m in rank_maps):
+            continue
+        num = np.sum([np.asarray(m[key], np.float64)
+                      * np.asarray(m["WEIGHTS"], np.float64)
+                      for m in rank_maps], axis=0)
+        out[key] = np.where(w_tot > 0, num / np.maximum(w_tot, 1e-30),
+                            0.0).astype(np.float32)
+    out["WEIGHTS"] = w_tot.astype(np.float32)
+    if all("HITS" in m for m in rank_maps):
+        out["HITS"] = np.sum([np.asarray(m["HITS"], np.float64)
+                              for m in rank_maps], axis=0).astype(
+            np.float32)
+    return out
+
+
+def coadd_fits_files(inputs: list[str], output: str) -> dict:
+    """Co-add rank map FILES (all WCS or all partial-HEALPix) into
+    ``output``. Returns the co-added maps dict."""
+    if not inputs:
+        raise ValueError("coadd_fits_files: no inputs")
+    # one parse per file; layout detected from the parsed headers so a
+    # glob mixing HEALPix and WCS maps fails with a clear message
+    parsed = [read_fits_image(p) for p in inputs]
+    is_hp = [hdus[0][1].get("PIXTYPE") == "HEALPIX" for hdus in parsed]
+    if any(is_hp) and not all(is_hp):
+        mixed = {p: ("healpix" if h else "wcs")
+                 for p, h in zip(inputs, is_hp)}
+        raise ValueError(f"coadd: mixed map layouts {mixed}")
+    if all(is_hp):
+        # union of the ranks' pixel sets
+        loaded = []
+        for hdus in parsed:
+            maps = {n: d for n, _, d in hdus if n != "PIXELS"}
+            pix = next(d for n, _, d in hdus if n == "PIXELS")
+            hdr = hdus[0][1]
+            loaded.append((maps, pix, hdr["NSIDE"],
+                           hdr.get("ORDERING", "RING") == "NESTED"))
+        nside, nest = loaded[0][2], loaded[0][3]
+        for _, _, ns, ne in loaded[1:]:
+            if ns != nside or ne != nest:
+                raise ValueError("coadd: mixed nside/ordering")
+        union = np.unique(np.concatenate([pix for _, pix, _, _ in loaded]))
+        idx = {int(p): i for i, p in enumerate(union)}
+        rank_maps = []
+        for maps, pix, _, _ in loaded:
+            dense = {}
+            sel = np.array([idx[int(p)] for p in pix], np.int64)
+            for k, v in maps.items():
+                full = np.zeros(union.size, np.float64)
+                full[sel] = v
+                dense[k] = full
+            rank_maps.append(dense)
+        out = coadd_maps(rank_maps)
+        write_healpix_map(output, out, union, nside, nest=nest)
+        return out
+    header = dict(parsed[0][0][1])
+    rank_maps = [{name: data for name, _, data in hdus} for hdus in parsed]
+    shapes = {m["WEIGHTS"].shape for m in rank_maps}
+    if len(shapes) != 1:
+        raise ValueError(f"coadd: mixed map shapes {shapes}")
+    out = coadd_maps(rank_maps)
+    keep = {k: header[k] for k in header
+            if k.startswith(("CRVAL", "CRPIX", "CDELT", "CTYPE", "CUNIT"))}
+    write_fits_image(output, out, header=keep)
+    return out

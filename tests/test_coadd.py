@@ -1,0 +1,129 @@
+"""Per-rank partial-map co-addition (offline analogue of the
+reference's in-MPI map Allreduce, ``MapMaking/Destriper.py:61-75``).
+"""
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.mapmaking.coadd import coadd_fits_files, coadd_maps
+from comapreduce_tpu.mapmaking.fits_io import (read_fits_image,
+                                               read_healpix_map,
+                                               write_fits_image,
+                                               write_healpix_map)
+
+
+def _rank_maps(seed, shape=(8, 8), w_scale=1.0):
+    rng = np.random.default_rng(seed)
+    w = rng.uniform(0.5, 2.0, shape) * w_scale
+    w[rng.random(shape) < 0.3] = 0.0   # unobserved pixels per rank
+    m = rng.normal(size=shape)
+    return {"DESTRIPED": np.where(w > 0, m, 0.0).astype(np.float32),
+            "NAIVE": np.where(w > 0, m + 0.1, 0.0).astype(np.float32),
+            "WEIGHTS": w.astype(np.float32),
+            "HITS": (w > 0).astype(np.float32) * 7}
+
+
+def test_coadd_maps_inverse_variance():
+    a, b = _rank_maps(1), _rank_maps(2, w_scale=3.0)
+    out = coadd_maps([a, b])
+    w = a["WEIGHTS"] + b["WEIGHTS"]
+    np.testing.assert_allclose(out["WEIGHTS"], w, rtol=1e-6)
+    np.testing.assert_allclose(out["HITS"], a["HITS"] + b["HITS"])
+    want = np.where(w > 0,
+                    (a["DESTRIPED"] * a["WEIGHTS"]
+                     + b["DESTRIPED"] * b["WEIGHTS"])
+                    / np.maximum(w, 1e-30), 0.0)
+    np.testing.assert_allclose(out["DESTRIPED"], want, rtol=1e-5,
+                               atol=1e-7)
+    # a pixel seen by only one rank keeps that rank's value exactly
+    only_a = (a["WEIGHTS"] > 0) & (b["WEIGHTS"] == 0)
+    if only_a.any():
+        np.testing.assert_allclose(out["DESTRIPED"][only_a],
+                                   a["DESTRIPED"][only_a], rtol=1e-5)
+
+
+def test_coadd_wcs_files_cli(tmp_path):
+    from comapreduce_tpu.cli.coadd_maps import main
+
+    header = {"CRVAL1": 170.0, "CRVAL2": 52.0, "CDELT1": 0.1,
+              "CDELT2": 0.1, "CTYPE1": "RA---TAN", "CTYPE2": "DEC--TAN"}
+    paths = []
+    ranks = [_rank_maps(3), _rank_maps(4)]
+    for r, maps in enumerate(ranks):
+        p = str(tmp_path / f"co2_band0_rank{r}.fits")
+        write_fits_image(p, maps, header=header)
+        paths.append(p)
+    out_path = str(tmp_path / "co2_band0.fits")
+    assert main([out_path, "--glob", str(tmp_path / "*_rank*.fits")]) == 0
+    hdus = read_fits_image(out_path)
+    by_name = {n: d for n, _, d in hdus}
+    want = coadd_maps(ranks)
+    np.testing.assert_allclose(by_name["DESTRIPED"], want["DESTRIPED"],
+                               rtol=1e-5, atol=1e-7)
+    # WCS geometry survives the co-add
+    assert hdus[0][1]["CRVAL1"] == 170.0
+    assert main(["-h"]) == 0
+    assert main([out_path]) == 2
+
+
+def test_coadd_healpix_partial_union(tmp_path):
+    rng = np.random.default_rng(5)
+    nside = 64
+    pix_a = np.arange(100, 140)
+    pix_b = np.arange(120, 170)      # overlapping + disjoint pixels
+    paths = []
+    for r, pix in enumerate((pix_a, pix_b)):
+        w = rng.uniform(0.5, 2.0, pix.size).astype(np.float32)
+        maps = {"DESTRIPED": rng.normal(size=pix.size).astype(np.float32),
+                "NAIVE": rng.normal(size=pix.size).astype(np.float32),
+                "WEIGHTS": w, "HITS": np.ones(pix.size, np.float32)}
+        p = str(tmp_path / f"hp_rank{r}.fits")
+        write_healpix_map(p, maps, pix, nside)
+        paths.append(p)
+    out_path = str(tmp_path / "hp.fits")
+    coadd_fits_files(paths, out_path)
+    maps, pixels, ns, nest = read_healpix_map(out_path)
+    assert ns == nside and not nest
+    np.testing.assert_array_equal(pixels,
+                                  np.union1d(pix_a, pix_b))
+    # disjoint pixels keep their rank's value; overlap pixels are
+    # weight-averaged with summed hits
+    a0 = {"maps": read_healpix_map(paths[0])}
+    overlap = np.intersect1d(pix_a, pix_b)
+    sel = np.searchsorted(pixels, overlap)
+    np.testing.assert_allclose(maps["HITS"][sel], 2.0)
+    only_a = np.setdiff1d(pix_a, pix_b)
+    sel_a = np.searchsorted(pixels, only_a)
+    src = a0["maps"][0]["DESTRIPED"][np.searchsorted(pix_a, only_a)]
+    np.testing.assert_allclose(maps["DESTRIPED"][sel_a], src, rtol=1e-6)
+
+
+def test_coadd_rejects_mixed_shapes(tmp_path):
+    p1 = str(tmp_path / "a.fits")
+    p2 = str(tmp_path / "b.fits")
+    write_fits_image(p1, _rank_maps(6, shape=(8, 8)))
+    write_fits_image(p2, _rank_maps(7, shape=(6, 6)))
+    with pytest.raises(ValueError, match="shapes"):
+        coadd_fits_files([p1, p2], str(tmp_path / "o.fits"))
+
+
+def test_coadd_rejects_mixed_layouts(tmp_path):
+    wcs_p = str(tmp_path / "w.fits")
+    write_fits_image(wcs_p, _rank_maps(8))
+    hp_p = str(tmp_path / "h.fits")
+    pix = np.arange(10)
+    write_healpix_map(hp_p, {"DESTRIPED": np.ones(10, np.float32),
+                             "WEIGHTS": np.ones(10, np.float32)},
+                      pix, 64)
+    with pytest.raises(ValueError, match="layouts"):
+        coadd_fits_files([hp_p, wcs_p], str(tmp_path / "o.fits"))
+
+
+def test_coadd_primary_hdu_is_destriped(tmp_path):
+    """Layout parity with the rank maps: DESTRIPED is the primary HDU."""
+    p = str(tmp_path / "r0.fits")
+    write_fits_image(p, _rank_maps(9))
+    out = str(tmp_path / "o.fits")
+    coadd_fits_files([p], out)
+    hdus = read_fits_image(out)
+    assert hdus[0][0] == "DESTRIPED"
