@@ -118,7 +118,9 @@ def _kernel(x_hbm, o_ref, seg_ref, mat_ref, sem, *, window, w_pad, chunk):
         return jax.lax.bitcast_convert_type(
             jnp.where(was_neg, ~v, v & jnp.uint32(0x7FFFFFFF)), jnp.float32)
 
-    o_ref[...] = 0.5 * (tof(v_lo) + tof(v_hi))
+    from comapreduce_tpu.ops.stats import _median_mid
+
+    o_ref[...] = _median_mid(tof(v_lo), tof(v_hi))
 
 
 @functools.partial(jax.jit,

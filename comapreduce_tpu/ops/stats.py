@@ -103,6 +103,14 @@ def _kth_smallest_u32(u: jax.Array, k: jax.Array) -> jax.Array:
     return lo
 
 
+def _median_mid(f_lo, f_hi):
+    """Midpoint of the lower/upper median with the equal-middles guard:
+    equal middles return the ELEMENT — 0.5*(v+v) rounds the minimum
+    subnormal to zero (hypothesis-found edge). One home for the formula;
+    the Pallas kernel (ops/pallas_median.py) calls it too."""
+    return jnp.where(f_lo == f_hi, f_lo, 0.5 * (f_lo + f_hi))
+
+
 def median_lastaxis(x: jax.Array) -> jax.Array:
     """Exact median over the last axis, no mask — radix bisection.
 
@@ -125,7 +133,8 @@ def median_lastaxis(x: jax.Array) -> jax.Array:
         above = jnp.where(u > v_lo[..., None], u, jnp.uint32(0xFFFFFFFF))
         v_next = jnp.min(above, axis=-1)
         v_hi = jnp.where(c_le >= n // 2 + 1, v_lo, v_next)
-        med = 0.5 * (_u32_sortable_f32(v_lo) + _u32_sortable_f32(v_hi))
+        med = _median_mid(_u32_sortable_f32(v_lo),
+                          _u32_sortable_f32(v_hi))
     return jnp.where(jnp.any(jnp.isnan(x), axis=-1), jnp.nan, med)
 
 
@@ -158,7 +167,8 @@ def masked_median(x: jax.Array, mask: jax.Array | None = None, axis: int = -1):
         hi = jnp.clip(jnp.maximum(cnt, 1) // 2, 0, n - 1)
         vlo = jnp.take_along_axis(xs, lo[..., None], axis=-1)[..., 0]
         vhi = jnp.take_along_axis(xs, hi[..., None], axis=-1)[..., 0]
-        return jnp.where(cnt > 0, 0.5 * (vlo + vhi), 0.0)
+        mid = _median_mid(vlo, vhi)
+        return jnp.where(cnt > 0, mid, 0.0)
     u = jnp.where(m, _f32_sortable_u32(x), jnp.uint32(0xFFFFFFFF))
     cnt = jnp.sum(m, axis=-1)
     k_lo = (jnp.maximum(cnt, 1) - 1) // 2
@@ -171,7 +181,8 @@ def masked_median(x: jax.Array, mask: jax.Array | None = None, axis: int = -1):
     above = jnp.where(u > v_lo[..., None], u, jnp.uint32(0xFFFFFFFF))
     v_next = jnp.min(above, axis=-1)
     v_hi = jnp.where(c_le >= k_hi + 1, v_lo, v_next)
-    med = 0.5 * (_u32_sortable_f32(v_lo) + _u32_sortable_f32(v_hi))
+    med = _median_mid(_u32_sortable_f32(v_lo),
+                      _u32_sortable_f32(v_hi))
     return jnp.where(cnt > 0, med, 0.0)
 
 

@@ -1,0 +1,122 @@
+"""Property-based invariants of the core kernels (hypothesis).
+
+Subnormals are excluded from draws AND tolerated in comparisons: XLA
+flushes them to zero (FTZ) — platform semantics, not a kernel defect —
+and even-count medians of tiny normals can produce subnormal averages.
+
+Shapes stay in a few fixed buckets (every distinct shape is a fresh XLA
+compile); the fuzzing is over CONTENT — values, masks, id
+distributions — where the masked/sentinel semantics live.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra import numpy as hnp
+
+from comapreduce_tpu.mapmaking.pointing_plan import binned_window_sum
+from comapreduce_tpu.ops.median_filter import rolling_median
+from comapreduce_tpu.ops.reduce import (extract_scan_blocks,
+                                        scatter_scan_blocks)
+from comapreduce_tpu.ops.stats import masked_median
+
+_SETTINGS = dict(max_examples=15, deadline=None)
+_TINY = float(np.finfo(np.float32).tiny)   # FTZ tolerance
+
+
+def _f32s(lo, hi):
+    return st.floats(lo, hi, width=32, allow_subnormal=False)
+
+
+def _farr(shape, lo=-1e3, hi=1e3):
+    return hnp.arrays(np.float32, shape, elements=_f32s(lo, hi))
+
+
+def _check_masked_median(x, m):
+    got = np.asarray(masked_median(jnp.asarray(x),
+                                   jnp.asarray(m, np.float32), axis=-1))
+    for r in range(x.shape[0]):
+        sel = x[r, m[r]]
+        if sel.size == 0:
+            continue   # empty-mask rows: callers guard with counts
+        want = np.float32(np.median(sel))
+        assert abs(float(got[r]) - float(want)) <= _TINY, (r, sel.size)
+
+
+@settings(**_SETTINGS)
+@given(x=_farr((4, 97), -1e4, 1e4),
+       m=hnp.arrays(np.bool_, (4, 97)))
+def test_masked_median_matches_numpy_sort_path(x, m):
+    """Masked median == np.median over the selected samples, narrow rows
+    (the sort fallback below SELECT_MEDIAN_MIN_WINDOW; FTZ-tolerant)."""
+    _check_masked_median(x, m)
+
+
+@settings(max_examples=8, deadline=None)
+@given(x=_farr((2, 1152), -1e4, 1e4),
+       m=hnp.arrays(np.bool_, (2, 1152)))
+def test_masked_median_matches_numpy_radix_path(x, m):
+    """Same property on >= SELECT_MEDIAN_MIN_WINDOW rows — the u32 radix
+    bisection path with its own upper-median selection and equal-middles
+    guard."""
+    from comapreduce_tpu.ops.stats import SELECT_MEDIAN_MIN_WINDOW
+
+    assert x.shape[-1] >= SELECT_MEDIAN_MIN_WINDOW
+    _check_masked_median(x, m)
+
+
+@settings(**_SETTINGS)
+@given(w=st.sampled_from([3, 8, 33, 64]), x=_farr((1, 160)))
+def test_rolling_median_exact_matches_numpy(w, x):
+    """Exact rolling median (stride=1) == per-window np.median with edge
+    padding, for random window parities (FTZ-tolerant: an even-window
+    average of tiny normals can be subnormal)."""
+    n = x.shape[-1]
+    got = np.asarray(rolling_median(jnp.asarray(x), w, stride=1))[0]
+    left = (w - 1) // 2
+    pad = np.pad(x[0], (left, w - 1 - left), mode="edge")
+    want = np.asarray([np.median(pad[i:i + w]) for i in range(n)],
+                      np.float32)
+    np.testing.assert_allclose(got, want, rtol=0, atol=_TINY)
+
+
+@settings(**_SETTINGS)
+@given(ids=hnp.arrays(np.int64, 512, elements=st.integers(0, 210)),
+       vals=_farr((2, 512)))
+def test_binned_window_sum_matches_bincount(ids, vals):
+    """Windowed one-hot binning == np.bincount for any sorted id stream
+    whose chunk spans fit the window (leading batch axis included)."""
+    M, chunk, out_size = 512, 128, 211
+    ids = np.sort(ids)
+    n_chunks = M // chunk
+    base = ids.reshape(n_chunks, chunk)[:, 0]
+    span = int((ids.reshape(n_chunks, chunk)[:, -1] - base + 1).max())
+    window = -(-max(span, 1) // 128) * 128
+    got = np.asarray(binned_window_sum(
+        jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, out_size))
+    for b in range(2):
+        want = np.bincount(ids, weights=vals[b].astype(np.float64),
+                           minlength=out_size)
+        # f32 accumulation over up to 512 same-bin samples of |v|<=1e3
+        np.testing.assert_allclose(got[b], want, rtol=2e-5, atol=0.1)
+
+
+@settings(**_SETTINGS)
+@given(s0=st.integers(0, 60), l0=st.integers(1, 64),
+       s1=st.integers(150, 200), l1=st.integers(1, 64),
+       vals=_farr(300))
+def test_scan_block_roundtrip(s0, l0, s1, l1, vals):
+    """scatter(extract(x)) restores x inside scans and zeroes outside,
+    for arbitrary scan geometries on a fixed time axis."""
+    T, L = 300, 64
+    starts = jnp.asarray([s0, s1], jnp.int32)
+    lengths = jnp.asarray([l0, l1], jnp.int32)
+    x = jnp.asarray(vals)
+    blocks = extract_scan_blocks(x, starts, L, lengths)
+    back = np.asarray(scatter_scan_blocks(blocks, starts, lengths, T))
+    inside = np.zeros(T, bool)
+    inside[s0:s0 + l0] = True
+    inside[s1:s1 + l1] = True
+    np.testing.assert_array_equal(back[inside], vals[inside])
+    assert (back[~inside] == 0).all()
