@@ -1,8 +1,13 @@
 """Property-based invariants of the core kernels (hypothesis).
 
-Subnormals are excluded from draws AND tolerated in comparisons: XLA
-flushes them to zero (FTZ) — platform semantics, not a kernel defect —
-and even-count medians of tiny normals can produce subnormal averages.
+Float draws are SCALED INTEGERS, not st.floats(): the first XLA CPU
+computation in the process sets fast-math/FTZ flags on the thread, and
+hypothesis's float-strategy validation then refuses to run (its
+``copysign(1.0, -0.0)`` sanity check fails) — making st.floats() usable
+only before any jax call, i.e. order-dependent. Integer draws are
+immune, and the quantised grid still covers the semantics under test
+(masks, duplicates, sign mixes, zero). The subnormal-flush (FTZ) edge
+hypothesis originally found is pinned by a DETERMINISTIC case instead.
 
 Shapes stay in a few fixed buckets (every distinct shape is a fresh XLA
 compile); the fuzzing is over CONTENT — values, masks, id
@@ -22,14 +27,37 @@ from comapreduce_tpu.ops.stats import masked_median
 
 _SETTINGS = dict(max_examples=15, deadline=None)
 _TINY = float(np.finfo(np.float32).tiny)   # FTZ tolerance
+_STEPS = 10**6
 
 
 def _f32s(lo, hi):
-    return st.floats(lo, hi, width=32, allow_subnormal=False)
+    """f32 values on a uniform grid over [lo, hi] via integer draws."""
+    lo, hi = float(lo), float(hi)
+    return st.integers(0, _STEPS).map(
+        lambda i: np.float32(lo + (hi - lo) * (i / _STEPS)))
 
 
 def _farr(shape, lo=-1e3, hi=1e3):
-    return hnp.arrays(np.float32, shape, elements=_f32s(lo, hi))
+    lo, hi = float(lo), float(hi)
+    return hnp.arrays(np.int32, shape,
+                      elements=st.integers(0, _STEPS)).map(
+        lambda a: (lo + (hi - lo)
+                   * (a.astype(np.float64) / _STEPS)).astype(np.float32))
+
+
+def test_median_minimum_subnormal_is_exact():
+    """Deterministic pin of the hypothesis-found edge: an odd-count
+    median equal to the minimum subnormal must not be halved to zero by
+    0.5*(v+v) (the _median_mid guard). XLA's FTZ may flush the VALUE,
+    but the guard must never introduce the halving on top."""
+    x = np.zeros((1, 5), np.float32)
+    x[0, 0] = np.float32(1.4012985e-45)   # min subnormal
+    x[0, 1] = 1e-5
+    x[0, 2] = 2.73
+    m = np.asarray([[1, 1, 1, 0, 0]], np.float32)
+    got = float(np.asarray(masked_median(jnp.asarray(x),
+                                         jnp.asarray(m)))[0])
+    assert got == np.float32(1e-5)   # odd count: the element, exactly
 
 
 def _check_masked_median(x, m):
@@ -120,3 +148,87 @@ def test_scan_block_roundtrip(s0, l0, s1, l1, vals):
     inside[s1:s1 + l1] = True
     np.testing.assert_array_equal(back[inside], vals[inside])
     assert (back[~inside] == 0).all()
+
+
+@settings(**_SETTINGS)
+@given(lon=_farr(40, 0.0, 360.0), lat=_farr(40, -89.9, 89.9),
+       nest=st.booleans())
+def test_healpix_pix_containment_and_orderings(lon, lat, nest):
+    """ang2pix -> pix2ang lands within the pixel scale, ring<->nest is a
+    bijection, and both orderings address the same pixel centre."""
+    from comapreduce_tpu.mapmaking import healpix as hp
+    from comapreduce_tpu.mapmaking.wcs import angular_separation
+
+    nside = 128
+    pix = np.asarray(hp.ang2pix_lonlat(nside, lon, lat, nest=nest))
+    assert ((pix >= 0) & (pix < hp.nside2npix(nside))).all()
+    clon, clat = hp.pix2ang_lonlat(nside, pix, nest=nest)
+    # pixel centre within ~2 pixel radii of the query point
+    res_deg = np.degrees(np.sqrt(np.pi / 3.0) / nside)
+    sep = angular_separation(lon, lat, np.asarray(clon), np.asarray(clat))
+    assert (sep < 2.5 * res_deg).all(), sep.max()
+    # ordering conversion is a bijection onto the same centres
+    other = np.asarray(hp.nest2ring(nside, pix) if nest
+                       else hp.ring2nest(nside, pix))
+    back = np.asarray(hp.ring2nest(nside, other) if nest
+                      else hp.nest2ring(nside, other))
+    np.testing.assert_array_equal(back, pix)
+    olon, olat = hp.pix2ang_lonlat(nside, other, nest=not nest)
+    np.testing.assert_allclose(np.asarray(olon), np.asarray(clon),
+                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(olat), np.asarray(clat),
+                               atol=1e-9)
+
+
+@settings(**_SETTINGS)
+@given(dlon=_farr(30, -3.5, 3.5), dlat=_farr(30, -3.5, 3.5))
+def test_wcs_pixel_roundtrip(dlon, dlat):
+    """WCS ang2pix hits the pixel whose centre is nearest (within a
+    pixel) for points inside the field."""
+    from comapreduce_tpu.mapmaking.wcs import WCS, angular_separation
+
+    wcs = WCS.from_field((180.0, 30.0), (0.1, 0.1), (80, 80))
+    lon = 180.0 + dlon
+    lat = 30.0 + dlat
+    pix = np.asarray(wcs.ang2pix(lon, lat))
+    ok = pix >= 0
+    assert ok.any()
+    clon, clat = wcs.pixel_centers()
+    sep = angular_separation(lon[ok], lat[ok],
+                             clon.ravel()[pix[ok]], clat.ravel()[pix[ok]])
+    assert (sep < 0.15).all(), sep.max()
+
+
+@settings(max_examples=8, deadline=None)
+@given(seed=st.integers(0, 2**31 - 1), noise=_f32s(0.01, 0.3))
+def test_destriper_recovers_injected_offsets(seed, noise):
+    """For any offset realisation and noise level, destriping removes
+    most of the injected offset power (the reference Destriper.test()
+    acceptance, asserted)."""
+    from comapreduce_tpu.mapmaking.destriper import destripe_jit
+
+    rng = np.random.default_rng(seed)
+    n, npix, L = 2000, 100, 25
+    # irregular random-walk scan: varied revisit phases give the
+    # crosslinking that makes offset/sky separation well-posed (a
+    # perfectly regular stride scan is exactly degenerate)
+    pix = np.abs(np.cumsum(rng.integers(-2, 3, n))) % npix
+    offs = np.repeat(rng.normal(0, 1, n // L), L).astype(np.float32)
+    sky = rng.normal(0, 1, npix).astype(np.float32)
+    tod = sky[pix] + offs + noise * rng.normal(size=n).astype(np.float32)
+    res = destripe_jit(jnp.asarray(tod), jnp.asarray(pix, jnp.int32),
+                       jnp.ones(n, jnp.float32), npix,
+                       offset_length=L, n_iter=60)
+    hit = np.asarray(res.hit_map) > 0   # the walk may not cover npix
+    m = np.asarray(res.destriped_map)[hit]
+    naive = np.asarray(res.naive_map)[hit]
+    s = sky[hit]
+    err_d = np.std((m - m.mean()) - (s - s.mean()))
+    err_n = np.std((naive - naive.mean()) - (s - s.mean()))
+    # destriping never loses to the naive map, and must win clearly
+    # whenever the injected offsets dominate the white noise (absolute
+    # accuracy depends on the scan's offset/sky degeneracy, so the
+    # acceptance is comparative — like the reference's Destriper.test())
+    assert err_d <= err_n + 1e-5
+    if err_n > 5.0 * noise:
+        assert err_d < 0.7 * err_n, (err_d, err_n, noise)
