@@ -72,7 +72,7 @@ def _memoized(tag: str, pixels: np.ndarray, extra_key: tuple, build):
 
 
 def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
-                    n_iter: int, threshold: float):
+                    n_iter: int, threshold: float, n_groups: int = 0):
     import functools
 
     import jax
@@ -84,11 +84,15 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
         plan = build_pointing_plan(pix, npix, offset_length)
         return jax.jit(functools.partial(destripe_planned, plan=plan,
                                          n_iter=n_iter,
-                                         threshold=threshold))
+                                         threshold=threshold,
+                                         n_groups=n_groups))
 
-    return _memoized("single", pixels,
+    # ground and plain solvers get separate slots: alternating them on
+    # one pointing must not thrash the per-tag memo
+    tag = "single-ground" if n_groups else "single"
+    return _memoized(tag, pixels,
                      (int(npix), int(offset_length), int(n_iter),
-                      float(threshold)), build)
+                      float(threshold), int(n_groups)), build)
 
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
@@ -146,9 +150,10 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
-    iteration at production shape) is the default; ground-template solves
-    stay on the general scatter path (the joint ground block is only
-    implemented there)."""
+    iteration at production shape) is the default — including joint
+    ground-template solves when the groups align to offsets (the data
+    layer guarantees it; misaligned geometries and sharded ground solves
+    fall back to the general scatter path)."""
     data = read_comap_data(filenames, band=band, wcs=wcs, nside=nside,
                            galactic=galactic, offset_length=offset_length,
                            use_calibration=use_calibration,
@@ -205,17 +210,36 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                            result.weight_map),
                 hit_map=_expand_compact(uniq, data.npix, result.hit_map))
     else:
+        import jax.numpy as jnp
+
         n = (data.tod.size // offset_length) * offset_length
         if use_ground:
-            result = destripe_jit(data.tod[:n], data.pixels[:n],
-                                  data.weights[:n], data.npix,
-                                  offset_length=offset_length,
-                                  n_iter=n_iter, threshold=threshold,
-                                  ground_ids=data.ground_ids[:n],
-                                  az=data.az[:n], n_groups=data.n_groups)
-        else:
-            import jax.numpy as jnp
+            from comapreduce_tpu.mapmaking.destriper import (
+                ground_ids_per_offset)
 
+            try:
+                gid_off = ground_ids_per_offset(
+                    np.asarray(data.ground_ids[:n]), offset_length)
+            except ValueError:
+                # groups not offset-aligned (unusual geometry):
+                # the scatter path handles per-sample group ids
+                gid_off = None
+            if gid_off is None:
+                return destripe_jit(data.tod[:n], data.pixels[:n],
+                                    data.weights[:n], data.npix,
+                                    offset_length=offset_length,
+                                    n_iter=n_iter, threshold=threshold,
+                                    ground_ids=data.ground_ids[:n],
+                                    az=data.az[:n],
+                                    n_groups=data.n_groups)
+            fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
+                                 offset_length, n_iter, threshold,
+                                 n_groups=data.n_groups)
+            result = fn(jnp.asarray(data.tod[:n]),
+                        jnp.asarray(data.weights[:n]),
+                        ground_off=jnp.asarray(gid_off),
+                        az=jnp.asarray(data.az[:n]))
+        else:
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold)
             result = fn(jnp.asarray(data.tod[:n]),

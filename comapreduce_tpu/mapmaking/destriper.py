@@ -29,13 +29,15 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from comapreduce_tpu.mapmaking.binning import (accumulate_weights, bin_map,
                                                naive_map, sample_map)
 from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
                                                      binned_window_sum)
 
-__all__ = ["DestriperResult", "destripe", "destripe_jit", "destripe_planned"]
+__all__ = ["DestriperResult", "destripe", "destripe_jit",
+           "destripe_planned", "ground_ids_per_offset"]
 
 
 class DestriperResult(NamedTuple):
@@ -256,11 +258,32 @@ destripe_jit = jax.jit(
                      "axis_name", "n_groups"))
 
 
+def ground_ids_per_offset(ground_ids: np.ndarray,
+                          offset_length: int) -> np.ndarray:
+    """Per-offset ground-group ids from per-sample ids (host helper).
+
+    The planned ground solve needs each offset to live inside ONE group;
+    the data layer guarantees it (scans are truncated to offset
+    multiples per (file, feed) group, ``COMAPData.py:163-187``), and
+    this validates rather than assumes."""
+    ids = np.asarray(ground_ids)
+    n = (ids.shape[0] // offset_length) * offset_length
+    blocks = ids[:n].reshape(-1, offset_length)
+    if not (blocks == blocks[:, :1]).all():
+        raise ValueError("ground_ids change inside an offset; the "
+                         "planned ground solve needs offset-aligned "
+                         "groups (use the scatter path)")
+    return blocks[:, 0].astype(np.int32)
+
+
 def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      n_iter: int = 100, threshold: float = 1e-6,
                      axis_name: str | tuple | None = None,
                      dense_maps: bool = True,
-                     device_arrays: dict | None = None) -> DestriperResult:
+                     device_arrays: dict | None = None,
+                     ground_off: jax.Array | None = None,
+                     az: jax.Array | None = None,
+                     n_groups: int = 0) -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -279,8 +302,16 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     to independent solves). ``offsets``, the destriped/naive/weight maps
     and ``residual`` gain the leading axis; ``hit_map`` and ``n_iter``
     stay shared (hits depend on pointing alone; the loop runs until the
-    slowest band converges). Ground-template solves stay on the general
-    path.
+    slowest band converges).
+
+    ``ground_off``/``az``/``n_groups`` enable the joint az-linear ground
+    template on this scatter-free path: ``ground_off`` is the PER-OFFSET
+    group id (:func:`ground_ids_per_offset`), ``az`` the per-sample
+    normalised azimuth. The ground couplings ride the same pair space —
+    two extra aggregate rows (``sum w az``, ``sum w az^2`` per pair) and
+    an (n_off -> n_groups) segment reduction per iteration. Single-RHS,
+    single-process (multi-RHS / sharded ground solves stay on the
+    scatter path).
 
     ``axis_name``: set when called inside ``shard_map`` with per-shard
     plans from ``build_sharded_plans`` — compact map sums and CG scalars
@@ -294,6 +325,10 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     (``plan`` then only supplies the shared static geometry).
     """
     dv = device_arrays if device_arrays is not None else plan.device()
+    with_ground = ground_off is not None
+    if with_ground and (tod.ndim != 1 or axis_name is not None):
+        raise ValueError("the planned ground solve is single-RHS and "
+                         "single-process; use destripe() otherwise")
 
     def _psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -372,6 +407,22 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     sum_w = to_global(rank_sum(pair_w))  # compact weight map (global)
     diag = off_sum(pair_w_off)       # diagonal of F^T W F (shard-local)
 
+    if with_ground:
+        az_s = jnp.take(az, dv["sample_perm"], axis=-1)
+        paz = pair_sum(w_s * az_s)           # sum w az   per pair
+        pazaz = pair_sum(w_s * az_s * az_s)  # sum w az^2 per pair
+        pazd = pair_sum(wd_s * az_s)         # sum w az d per pair
+        paz_off = jnp.take(paz, perm_off, axis=-1)
+        pazaz_off = jnp.take(pazaz, perm_off, axis=-1)
+        pazd_off = jnp.take(pazd, perm_off, axis=-1)
+        grp_off = jnp.asarray(ground_off, jnp.int32)
+        # offset-order coefficient gather (rank order reuses gather_a)
+        po_off_clip = jnp.clip(po_off, 0, n_off - 1)
+
+        def group_sum(v_off):
+            return jax.ops.segment_sum(v_off, grp_off,
+                                       num_segments=n_groups)
+
     def to_map(pv):
         s = to_global(rank_sum(pv))
         return jnp.where(sum_w > 0, s / jnp.maximum(sum_w, 1e-30), 0.0)
@@ -394,8 +445,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         return diag * a - off_sum(pair_w_off * gather_m(m))
 
     m_d = to_map(pair_wd)
-    b = off_sum(pair_wd_off
-                - pair_w_off * gather_m(from_global(m_d)))
+    gm_md = gather_m(from_global(m_d))
+    b = off_sum(pair_wd_off - pair_w_off * gm_md)
 
     # Jacobi preconditioner: exact diag(A) from the pair aggregates —
     # A_oo = diag_o - sum_{pairs (r,o)} w_po^2 / sumw_r
@@ -403,15 +454,54 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     corr = off_sum(pair_w_off * pair_w_off * gather_m(from_global(inv_sw)))
     inv_diag = _jacobi_inverse(diag - corr, diag)
 
-    # per-band inner products (last axis only): a multi-RHS solve runs
-    # independent CGs in one program
-    a, rz, k, b_norm = _cg_loop(
-        matvec, b, lambda u, v: _psum(jnp.sum(u * v, axis=-1)),
-        n_iter, threshold, precond=lambda v: v * inv_diag)
+    if with_ground:
+        # joint [offsets; ground] solve in the same pair space: the
+        # per-pair template coefficients are c0 = a + g0, c1 = g1 read
+        # through the small per-offset domain, so each matvec stays two
+        # one-hot binnings + one rank/map gather pair + a tiny
+        # (n_off -> n_groups) segment reduction
+        def q_off_of(c0, c1):
+            return (pair_w_off * jnp.take(c0, po_off_clip)
+                    + paz_off * jnp.take(c1, po_off_clip))
+
+        def matvec_g(x):
+            a_, g = x
+            c0 = a_ + g[:, 0][grp_off]
+            c1 = g[:, 1][grp_off]
+            q_rank = pair_w * gather_a(c0) + paz * gather_a(c1)
+            m = from_global(to_map(q_rank))
+            gm = gather_m(m)
+            off_f = off_sum(q_off_of(c0, c1) - pair_w_off * gm)
+            off_az = off_sum(paz_off * jnp.take(c0, po_off_clip)
+                             + pazaz_off * jnp.take(c1, po_off_clip)
+                             - paz_off * gm)
+            return (off_f, jnp.stack([group_sum(off_f),
+                                      group_sum(off_az)], -1))
+
+        b_az = off_sum(pazd_off - paz_off * gm_md)
+        b_g = (b, jnp.stack([group_sum(b), group_sum(b_az)], -1))
+        x, rz, k, b_norm = _cg_loop(
+            matvec_g, b_g,
+            lambda u, v: jnp.sum(u[0] * v[0]) + jnp.sum(u[1] * v[1]),
+            n_iter, threshold,
+            # identity on the ground block, as in the scatter path (see
+            # destripe's precond comment)
+            precond=lambda v: (v[0] * inv_diag, v[1]))
+        a, ground = x
+        c0 = a + ground[:, 0][grp_off]
+        c1 = ground[:, 1][grp_off]
+        pair_res = pair_wd - (pair_w * gather_a(c0) + paz * gather_a(c1))
+    else:
+        # per-band inner products (last axis only): a multi-RHS solve
+        # runs independent CGs in one program
+        a, rz, k, b_norm = _cg_loop(
+            matvec, b, lambda u, v: _psum(jnp.sum(u * v, axis=-1)),
+            n_iter, threshold, precond=lambda v: v * inv_diag)
+        ground = jnp.zeros((0, 2), f32)
+        pair_res = pair_wd - pair_w * gather_a(a)
 
     # final products in the compact rank space; optionally scattered once
     # to the full map (host-side partial-map writers take the compact form)
-    pair_res = pair_wd - pair_w * gather_a(a)
     uniq = dv["uniq_pixels"]
 
     def expand(cmp):
@@ -430,5 +520,5 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     w_map = expand(sum_w)
     h_map = expand(to_global(rank_sum(pair_cnt)))
     residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
-    return DestriperResult(a, jnp.zeros((0, 2), f32), m_destriped, m_naive,
+    return DestriperResult(a, ground, m_destriped, m_naive,
                            w_map, h_map, k, residual)

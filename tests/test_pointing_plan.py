@@ -206,3 +206,70 @@ def test_multi_rhs_dead_band_does_not_stall_live_band():
     assert np.all(np.asarray(multi.destriped_map[0]) == 0.0)
     assert np.all(np.asarray(multi.offsets[0]) == 0.0)
     assert float(multi.residual[1]) <= 1e-3
+
+
+def test_planned_ground_matches_scatter():
+    """The planned joint [offsets; ground] solve reproduces the scatter
+    path's destripe(ground_ids=...) — offsets, ground coefficients,
+    destriped map."""
+    from comapreduce_tpu.mapmaking.destriper import (destripe_jit,
+                                                     ground_ids_per_offset)
+
+    rng = np.random.default_rng(11)
+    n, npix, L = 4000, 144, 50
+    n_groups = 2
+    pix = _raster_pixels(n, npix, n_bad=0)
+    plan = build_pointing_plan(pix, npix, L)
+    gids = np.repeat(np.arange(n_groups), n // n_groups).astype(np.int32)
+    az = np.tile(np.linspace(-1, 1, 200), n // 200).astype(np.float32)
+    offs = np.repeat(rng.normal(0, 1, n // L), L)
+    sky = rng.normal(0, 1, npix + 8)
+    ground_truth = np.array([[0.0, 0.6], [0.0, -0.4]])
+    g_sig = ground_truth[gids, 0] + ground_truth[gids, 1] * az
+    tod = (sky[np.clip(pix, 0, npix - 1)] + offs + g_sig
+           + 0.05 * rng.normal(size=n)).astype(np.float32)
+    w = np.ones(n, np.float32)
+
+    ref = destripe_jit(jnp.asarray(tod), jnp.asarray(pix, jnp.int32),
+                       jnp.asarray(w), npix, offset_length=L, n_iter=80,
+                       ground_ids=jnp.asarray(gids), az=jnp.asarray(az),
+                       n_groups=n_groups)
+    got = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan,
+                           n_iter=80,
+                           ground_off=ground_ids_per_offset(gids, L),
+                           az=jnp.asarray(az), n_groups=n_groups)
+    # az slopes are well determined: tight parity with the scatter path
+    np.testing.assert_allclose(np.asarray(got.ground)[:, 1],
+                               np.asarray(ref.ground)[:, 1],
+                               rtol=0, atol=2e-3)
+    # the per-group CONSTANT trades freely against the offsets (null
+    # subspace); only the combined per-offset baseline is physical
+    gid_off = ground_ids_per_offset(gids, L)
+
+    def combined(res):
+        c = (np.asarray(res.offsets)
+             + np.asarray(res.ground)[gid_off, 0])
+        return c - c.mean()
+    np.testing.assert_allclose(combined(got), combined(ref),
+                               rtol=0, atol=5e-3)
+    md_g = np.asarray(got.destriped_map)
+    md_r = np.asarray(ref.destriped_map)
+    hit = np.asarray(got.hit_map) > 0
+    np.testing.assert_allclose(md_g[hit] - md_g[hit].mean(),
+                               md_r[hit] - md_r[hit].mean(),
+                               rtol=0, atol=5e-3)
+    # and the az slopes it recovered are the injected ones (sign +
+    # magnitude window, as in the CLI ground test)
+    g = np.asarray(got.ground)
+    assert g[0, 1] > 0.2 and g[1, 1] < -0.1, g
+
+
+def test_ground_ids_per_offset_validates():
+    from comapreduce_tpu.mapmaking.destriper import ground_ids_per_offset
+
+    ids = np.repeat([0, 1], 100)
+    out = ground_ids_per_offset(ids, 50)
+    np.testing.assert_array_equal(out, [0, 0, 1, 1])
+    bad = np.arange(200) // 75   # group flips mid-offset
+    with pytest.raises(ValueError, match="inside an offset"):
+        ground_ids_per_offset(bad, 50)
