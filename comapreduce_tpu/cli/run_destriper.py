@@ -97,10 +97,12 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                             offset_length: int, n_iter: int,
-                            threshold: float, n_bands: int = 0):
+                            threshold: float, n_bands: int = 0,
+                            n_groups: int = 0):
     """Memoized sharded solver (plans + ONE compiled shard_map program
     per pointing — bands share both). ``n_bands > 0`` builds the
-    multi-RHS program (all bands in one CG)."""
+    multi-RHS program (all bands in one CG); ``n_groups > 0`` the joint
+    ground program."""
     from comapreduce_tpu.mapmaking.pointing_plan import build_sharded_plans
     from comapreduce_tpu.parallel.sharded import (
         make_destripe_sharded_planned)
@@ -111,12 +113,13 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
         plans = build_sharded_plans(pix, npix, offset_length, n_shards)
         run = make_destripe_sharded_planned(mesh, plans, n_iter=n_iter,
                                             threshold=threshold,
-                                            n_bands=n_bands)
+                                            n_bands=n_bands,
+                                            n_groups=n_groups)
         return run, np.asarray(plans[0].uniq_global)
 
-    return _memoized(f"sharded{n_bands}", pixels,
+    return _memoized(f"sharded{n_bands}-g{n_groups}", pixels,
                      (n_shards, int(npix), int(offset_length), int(n_iter),
-                      float(threshold)), build)
+                      float(threshold), int(n_groups)), build)
 
 
 def _shard_quantum(mesh, offset_length: int) -> int:
@@ -177,7 +180,25 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
         # LOCAL devices: multi-host destriping is data parallel over
         # filelist shards (each process destripes its own files)
         mesh = Mesh(np.array(jax.local_devices()), ("time",))
+        # ONE padding quantum for everything below: gid_off, pixels,
+        # tod/weights and az must all agree on the padded offset count
+        n_pad = (-data.tod.size) % _shard_quantum(mesh, offset_length)
+        gid_off = None
         if use_ground:
+            from comapreduce_tpu.mapmaking.destriper import (
+                ground_ids_per_offset)
+
+            gids = np.asarray(data.ground_ids)
+            if n_pad:   # padding adds whole zero-weight offsets: park
+                # them in the last group (their weight is zero anyway)
+                fill = gids[-1] if gids.size else 0
+                gids = np.concatenate(
+                    [gids, np.full(n_pad, fill, gids.dtype)])
+            try:
+                gid_off = ground_ids_per_offset(gids, offset_length)
+            except ValueError:
+                gid_off = None   # misaligned: scatter fallback below
+        if use_ground and gid_off is None:
             result = destripe_sharded(
                 mesh, data.tod, data.pixels, data.weights, data.npix,
                 offset_length=offset_length, n_iter=n_iter,
@@ -189,7 +210,6 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             # pad on host: the pixel vector is consumed by the host plan
             # build only — routing it through pad_for_shards would cost a
             # full H2D+D2H round trip of several GB at production scale
-            n_pad = (-data.tod.size) % _shard_quantum(mesh, offset_length)
             pix_host = _pad_pixels(np.asarray(data.pixels), n_pad,
                                    data.npix)
             tod, weights = data.tod, data.weights
@@ -199,8 +219,16 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 weights = jnp.concatenate(
                     [jnp.asarray(weights), jnp.zeros(n_pad, jnp.float32)])
             run, uniq = _sharded_planned_solver(
-                mesh, pix_host, data.npix, offset_length, n_iter, threshold)
-            result = run(tod, weights)
+                mesh, pix_host, data.npix, offset_length, n_iter,
+                threshold,
+                n_groups=data.n_groups if gid_off is not None else 0)
+            if gid_off is not None:
+                az = np.asarray(data.az, np.float32)
+                if n_pad:
+                    az = np.concatenate([az, np.zeros(n_pad, np.float32)])
+                result = run(tod, weights, ground_off=gid_off, az=az)
+            else:
+                result = run(tod, weights)
             result = result._replace(
                 destriped_map=_expand_compact(uniq, data.npix,
                                               result.destriped_map),

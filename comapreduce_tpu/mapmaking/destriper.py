@@ -309,9 +309,10 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     group id (:func:`ground_ids_per_offset`), ``az`` the per-sample
     normalised azimuth. The ground couplings ride the same pair space —
     two extra aggregate rows (``sum w az``, ``sum w az^2`` per pair) and
-    an (n_off -> n_groups) segment reduction per iteration. Single-RHS,
-    single-process (multi-RHS / sharded ground solves stay on the
-    scatter path).
+    an (n_off -> n_groups) segment reduction per iteration. Works under
+    ``shard_map`` too (group sums and the offsets' dot psum; the ground
+    block is replicated). Single-RHS only (multi-RHS ground solves run
+    per band).
 
     ``axis_name``: set when called inside ``shard_map`` with per-shard
     plans from ``build_sharded_plans`` — compact map sums and CG scalars
@@ -326,9 +327,9 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     """
     dv = device_arrays if device_arrays is not None else plan.device()
     with_ground = ground_off is not None
-    if with_ground and (tod.ndim != 1 or axis_name is not None):
-        raise ValueError("the planned ground solve is single-RHS and "
-                         "single-process; use destripe() otherwise")
+    if with_ground and tod.ndim != 1:
+        raise ValueError("the planned ground solve is single-RHS; "
+                         "use destripe() or per-band solves otherwise")
 
     def _psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -420,8 +421,10 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         po_off_clip = jnp.clip(po_off, 0, n_off - 1)
 
         def group_sum(v_off):
-            return jax.ops.segment_sum(v_off, grp_off,
-                                       num_segments=n_groups)
+            # psum: under shard_map each shard owns whole offsets, so
+            # the global per-group sums are the psum of local segments
+            return _psum(jax.ops.segment_sum(v_off, grp_off,
+                                             num_segments=n_groups))
 
     def to_map(pv):
         s = to_global(rank_sum(pv))
@@ -482,7 +485,11 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         b_g = (b, jnp.stack([group_sum(b), group_sum(b_az)], -1))
         x, rz, k, b_norm = _cg_loop(
             matvec_g, b_g,
-            lambda u, v: jnp.sum(u[0] * v[0]) + jnp.sum(u[1] * v[1]),
+            # offsets are sharded (psum the partial dot); the ground
+            # block is replicated (group sums already psum'd), so its
+            # dot term must NOT be psum'd again
+            lambda u, v: (_psum(jnp.sum(u[0] * v[0]))
+                          + jnp.sum(u[1] * v[1])),
             n_iter, threshold,
             # identity on the ground block, as in the scatter path (see
             # destripe's precond comment)

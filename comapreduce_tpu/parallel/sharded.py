@@ -181,7 +181,8 @@ _PLAN_KEYS = ("sample_perm", "sample_pair", "sample_base", "pair_rank",
 def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                   n_iter: int = 100,
                                   threshold: float = 1e-6,
-                                  n_bands: int = 0):
+                                  n_bands: int = 0,
+                                  n_groups: int = 0):
     """Build a reusable sharded planned-destriper: returns
     ``run(tod, weights) -> DestriperResult``.
 
@@ -194,7 +195,14 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     f32[n_bands, N] with the band axis replicated and the time axis
     sharded; offsets/maps/residual come back with the leading band axis
     (see ``destripe_planned``), the whole stack in one CG.
+
+    ``n_groups > 0`` builds the joint GROUND-template program (single
+    RHS): ``run(tod, weights, ground_off, az)`` with the per-offset
+    group ids and per-sample azimuth sharded alongside; the ground block
+    is replicated (its group sums psum over the mesh).
     """
+    if n_bands and n_groups:
+        raise ValueError("ground solves are single-RHS; run per band")
     axes = tuple(mesh.axis_names)
     n_shards = int(np.prod([mesh.shape[a] for a in axes]))
     if len(plans) != n_shards:
@@ -212,17 +220,40 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     v_spec = P(None, axes) if n_bands else shard
     band_repl = P(None) if n_bands else repl
 
+    arr_specs = {k: shard for k in stacked}
+    out_specs = DestriperResult(
+        offsets=v_spec, ground=repl, destriped_map=band_repl,
+        naive_map=band_repl, weight_map=band_repl, hit_map=repl,
+        n_iter=repl, residual=band_repl)
+
+    if n_groups:
+        def local_g(tod_l, w_l, g_off_l, az_l, arrs):
+            arrs = {k: v[0] for k, v in arrs.items()}
+            return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
+                                    threshold=threshold, axis_name=axes,
+                                    dense_maps=False, device_arrays=arrs,
+                                    ground_off=g_off_l, az=az_l,
+                                    n_groups=n_groups)
+
+        fn = jax.jit(_shard_map(
+            local_g, mesh=mesh,
+            in_specs=(shard, shard, shard, shard, arr_specs),
+            out_specs=out_specs, check_vma=False))
+
+        def run(tod, weights, ground_off, az) -> DestriperResult:
+            with mesh:
+                return fn(jnp.asarray(tod), jnp.asarray(weights),
+                          jnp.asarray(ground_off, jnp.int32),
+                          jnp.asarray(az, jnp.float32), stacked)
+
+        return run
+
     def local(tod_l, w_l, arrs):
         arrs = {k: v[0] for k, v in arrs.items()}
         return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
                                 threshold=threshold, axis_name=axes,
                                 dense_maps=False, device_arrays=arrs)
 
-    out_specs = DestriperResult(
-        offsets=v_spec, ground=repl, destriped_map=band_repl,
-        naive_map=band_repl, weight_map=band_repl, hit_map=repl,
-        n_iter=repl, residual=band_repl)
-    arr_specs = {k: shard for k in stacked}
     fn = jax.jit(_shard_map(local, mesh=mesh,
                             in_specs=(v_spec, v_spec, arr_specs),
                             out_specs=out_specs, check_vma=False))
