@@ -229,6 +229,39 @@ def test_destriper_recovers_injected_offsets(seed, noise):
     # whenever the injected offsets dominate the white noise (absolute
     # accuracy depends on the scan's offset/sky degeneracy, so the
     # acceptance is comparative — like the reference's Destriper.test())
-    assert err_d <= err_n + 1e-5
+    assert err_d <= err_n * (1.0 + 1e-3) + 1e-4   # f32 slack: at high
+    # noise the two maps coincide to rounding
     if err_n > 5.0 * noise:
         assert err_d < 0.7 * err_n, (err_d, err_n, noise)
+
+
+@settings(max_examples=10, deadline=None)
+@given(amp=st.integers(-400, 400), seed=st.integers(0, 2**31 - 1))
+def test_gain_solve_recovers_injected_fluctuation(amp, seed):
+    """The closed-form gain solve recovers an injected dg(t) of any
+    amplitude/realisation from noisy multi-channel data (the flagship
+    reduction's core inversion)."""
+    from comapreduce_tpu.ops.average import edge_channel_mask
+    from comapreduce_tpu.ops.gain import build_templates, solve_gain
+
+    rng = np.random.default_rng(seed)
+    B, C, T = 2, 64, 256
+    tsys = (40.0 * (1.0 + 0.3 * rng.random((B, C)))).astype(np.float32)
+    freq = np.broadcast_to(np.linspace(-0.1, 0.1, C),
+                           (B, C)).astype(np.float32)
+    mask = np.asarray(edge_channel_mask(C, 4, 1, 1))[None, :] * np.ones(
+        (B, 1), np.float32)
+    T2, p = build_templates(jnp.asarray(tsys), jnp.asarray(freq),
+                            jnp.asarray(mask))
+    dg_true = (amp / 100.0) * np.sin(
+        np.arange(T) / 17.0).astype(np.float32)
+    # linearity + calibration: the solve is a fixed linear operator, so
+    # solving with and without the injected p*dg signal (same noise)
+    # must differ by EXACTLY dg (any amplitude, any realisation)
+    noise = 0.05 * rng.standard_normal((B, C, T)).astype(np.float32)
+    sig = (np.asarray(p).reshape(B, C)[..., None]
+           * dg_true[None, None, :]).astype(np.float32)
+    g0 = np.asarray(solve_gain(jnp.asarray(noise), T2, p))
+    g1 = np.asarray(solve_gain(jnp.asarray(sig + noise), T2, p))
+    tol = 1e-4 * max(1.0, abs(amp) / 100.0)
+    assert np.median(np.abs((g1 - g0) - dg_true)) < tol, (amp, seed)
