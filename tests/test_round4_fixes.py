@@ -286,3 +286,38 @@ def test_spike_on_genuine_zero_sample():
         assert st(None, lvl2)
         mask = dict(st.save_data[0])["spikes/spike_mask"][0, 0]
         assert mask[k] == 0, backend
+
+
+def test_production_channel_count_chain(tmp_path):
+    """The stage chain at the TRUE channel count (C=1024, where the
+    reference's edge/centre channel cuts apply unscaled): vane cal +
+    reduction + noise fits produce finite, populated products. Tests at
+    C=32/64 exercise the scaled cuts; this pins the production geometry
+    (short T to keep CPU runtime bounded)."""
+    params = SyntheticObsParams(n_feeds=1, n_bands=1, n_channels=1024,
+                                n_scans=2, scan_samples=700,
+                                vane_samples=250, seed=23)
+    path = str(tmp_path / "obs1024.hd5")
+    generate_level1_file(path, params)
+    data = COMAPLevel1()
+    data.read(path)
+    lvl2 = COMAPLevel2(filename=str(tmp_path / "l2_1024.hd5"))
+    for name, kw in (("MeasureSystemTemperature", {}),
+                     ("Level1AveragingGainCorrection",
+                      {"medfilt_window": 301}),
+                     ("Level1Averaging", {}),   # default 512-chan bins
+                     ("NoiseStatistics", {"nbins": 15})):
+        st = resolve(name, **kw)
+        assert st(data, lvl2), name
+        lvl2.update(st)
+    tod = np.asarray(lvl2.tod)
+    w = np.asarray(lvl2["averaged_tod/weights"])
+    edges = np.asarray(lvl2.scan_edges)
+    s, e = edges[0]
+    assert np.isfinite(tod).all()
+    assert (w[..., s:e] > 0).mean() > 0.9   # scans carry real weights
+    binned = np.asarray(lvl2["frequency_binned/tod"])
+    assert binned.shape[2] == 2              # 1024 // 512
+    assert np.isfinite(binned).all()
+    fn = np.asarray(lvl2["noise_statistics/fnoise_fit_parameters"])
+    assert np.isfinite(fn).all() and (fn[..., 0] > 0).all()
