@@ -191,6 +191,25 @@ class FitSource(_StageBase):
                 diagnostics.figure_path(self.figure_dir, data.obsid,
                                         f"{g}_feed00_band00"),
                 m2d, p, source=src, feed=0, band=0)
+            if self.error_func == "posterior" \
+                    and np.isfinite(errors[0, 0]).all():
+                # the reference's emcee runs come with corner plots
+                # (Fitting.py:363-531 -> plot_fits_*); same QA here
+                xg, yg = wcs.pixel_centers()
+                x = jnp.asarray(((xg.ravel() + 180.0) % 360.0) - 180.0,
+                                jnp.float32)
+                y = jnp.asarray(yg.ravel(), jnp.float32)
+                _, samples, _ = fitting.posterior_fit_gauss2d(
+                    jax.random.key(0), jnp.asarray(maps[0, 0]), x, y,
+                    jnp.asarray(wmaps[0, 0]),
+                    jnp.asarray(params[0, 0], jnp.float32),
+                    proposal_sigma=jnp.asarray(errors[0, 0], jnp.float32))
+                diagnostics.plot_sed_corner(
+                    diagnostics.figure_path(
+                        self.figure_dir, data.obsid,
+                        f"{g}_feed00_band00_posterior"),
+                    np.asarray(samples).reshape(-1, params.shape[-1]),
+                    ["A", "x0", "sx", "y0", "sy", "theta", "off"])
         self._data = {f"{g}/fits": params, f"{g}/errors": errors,
                       f"{g}/chi2": chi2}
         self._attrs = {g: {"source": src, "ra0": float(ra0),

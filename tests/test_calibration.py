@@ -362,3 +362,37 @@ def test_fit_source_maps_dead_map_gets_nan_errors():
         _, e, _ = fit_source_maps(maps, wmaps, wcs, error_func=ef,
                                   n_boot=8, n_steps=200)
         assert np.isnan(e).all(), ef
+
+
+def test_fit_source_posterior_corner_figure(tmp_path):
+    """FitSource(error_func='posterior', figure_dir=...) writes the
+    posterior corner PNG alongside the stamp (the reference's emcee
+    corner-plot QA)."""
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
+                                                 Level1AveragingGainCorrection,
+                                                 MeasureSystemTemperature)
+    from comapreduce_tpu.calibration.source_fit import FitSource
+
+    params = SyntheticObsParams(
+        source="TauA", n_feeds=1, n_bands=1, n_channels=32, n_scans=3,
+        scan_samples=1200, vane_samples=250, seed=29,
+        source_amplitude_k=7.0, source_fwhm_deg=0.075,
+        az_throw=1.0, ra0=83.6331, dec0=22.0145)
+    path = str(tmp_path / "taua.hd5")
+    generate_level1_file(path, params)
+    figdir = str(tmp_path / "figs")
+    chain = [AssignLevel1Data(), MeasureSystemTemperature(),
+             Level1AveragingGainCorrection(medfilt_window=601),
+             FitSource(medfilt_window=601, error_func="posterior",
+                       figure_dir=figdir)]
+    runner = Runner(processes=chain, output_dir=str(tmp_path))
+    (lvl2,) = runner.run_tod([path])
+    import glob as _glob
+
+    pngs = _glob.glob(figdir + "/**/*.png", recursive=True)
+    assert any("posterior" in p for p in pngs), pngs
+    errs = np.asarray(lvl2["TauA_source_fit/errors"])
+    assert np.isfinite(errs).all() and (errs > 0).all()
