@@ -153,6 +153,32 @@ def plot_source_fit(path: str, map2d, fit_params, source: str = "",
     plt.close(fig)
 
 
+def plot_skydip_fit(path: str, freq_ghz, fits, feed: int = 0):
+    """Sky-dip fit vs frequency for one feed: offset (zero-airmass
+    system temperature) and slope (sky brightness per airmass) — the
+    reference's per-feed sky-dip figure (``Level1Averaging.py:137-155``).
+    ``freq_ghz``: (B, C); ``fits``: (B, 2, C) [offset, slope]."""
+    if path is None:
+        return
+    plt = _pyplot()
+    if plt is None:
+        return
+    freq_ghz = np.asarray(freq_ghz)
+    fits = np.asarray(fits)
+    nu = freq_ghz.ravel()
+    order = np.argsort(nu)
+    fig, axes = plt.subplots(2, 1, sharex=True, figsize=(7, 5))
+    axes[0].plot(nu[order], fits[:, 0, :].ravel()[order], lw=0.8)
+    axes[0].set_ylabel("offset [K or counts]")
+    axes[1].plot(nu[order], fits[:, 1, :].ravel()[order], lw=0.8)
+    axes[1].set_ylabel("slope per airmass")
+    axes[1].set_xlabel("frequency [GHz]")
+    fig.suptitle(f"sky dip, feed {feed:02d}")
+    fig.tight_layout()
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+
+
 def plot_sed_fit(path: str, freqs_ghz, flux, flux_err, model_freqs,
                  model_flux, title: str = ""):
     """SED data points + fitted model curve (the ``SEDs/tools.py``

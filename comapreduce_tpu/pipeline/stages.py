@@ -313,6 +313,7 @@ class SkyDip(_StageBase):
     # elevation window of the sky-nod fit (Level1Averaging.py:124)
     el_min: float = 40.0
     el_max: float = 55.0
+    figure_dir: str = ""
 
     def __call__(self, data, level2) -> bool:
         self.STATE = True
@@ -321,7 +322,20 @@ class SkyDip(_StageBase):
         fits = self._fit_file(data, gain=None,
                               tmask=~np.asarray(data.vane_flag))
         self._data = {"skydip/fits": fits}  # (F, B, 2, C)
+        self._plot(data, fits)
         return True
+
+    def _plot(self, data, fits: np.ndarray) -> None:
+        """Feed-0 offset/slope vs frequency (the reference's per-feed
+        sky-dip figure, ``Level1Averaging.py:137-155``)."""
+        if not self.figure_dir:
+            return
+        from comapreduce_tpu import diagnostics
+
+        diagnostics.plot_skydip_fit(
+            diagnostics.figure_path(self.figure_dir, data.obsid,
+                                    "skydip_feed00"),
+            np.asarray(data.frequency), fits[0], feed=0)
 
     def _fit_file(self, data, gain, tmask) -> np.ndarray:
         """Per-channel (offset, slope-vs-airmass) over ``tmask``-selected
@@ -405,6 +419,7 @@ class SkyDip(_StageBase):
         self._data = {"skydip/fits": fits}
         self._attrs = {"skydip": {"sky_nod_obsid": prev.obsid,
                                   "sky_nod_file": os.path.basename(path)}}
+        self._plot(prev, fits)
         return True
 
 

@@ -168,3 +168,18 @@ frequency_bin_size = 8
         assert "frequency_binned/tod" in h
         assert "skydip/fits" in h
         assert h["skydip"].attrs["sky_nod_obsid"] == 1_000_000
+
+
+def test_skydip_figure(obs, tmp_path):
+    """figure_dir writes the per-feed sky-dip QA figure in both modes
+    (ref Level1Averaging.py:137-155)."""
+    import glob
+
+    data, lvl2, p, tmp = obs
+    figdir = str(tmp_path / "figs")
+    st = resolve("SkyDip", figure_dir=figdir)
+    assert st(data, lvl2)
+    st2 = resolve("SkyDip", sky_nod_obsid=0, figure_dir=figdir)
+    assert st2(data, lvl2)
+    pngs = glob.glob(figdir + "/**/*.png", recursive=True)
+    assert any("skydip_feed00" in q for q in pngs), pngs
