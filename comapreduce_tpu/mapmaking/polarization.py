@@ -148,32 +148,10 @@ def destripe_pol(tod, pixels, weights, psi, npix: int,
         return jax.lax.psum(v, axis_name) if axis_name is not None else v
 
     b = FT(Z(tod))
-    b_norm = dot(b, b)
-
-    def cond(st):
-        _, _, _, rz, k, done = st
-        return ((k < n_iter) & ~done
-                & (rz > threshold**2 * jnp.maximum(b_norm, 1e-30)))
-
-    def body(st):
-        x, r, p, rz, k, _ = st
-        q = matvec(p)
-        pq = dot(p, q)
-        ok = jnp.isfinite(pq) & (pq > 0)
-        alpha = jnp.where(ok, rz / jnp.where(ok, pq, 1.0), 0.0)
-        x = jnp.where(ok, x + alpha * p, x)
-        r_new = r - alpha * q
-        rz_new = dot(r_new, r_new)
-        ok = ok & jnp.isfinite(rz_new)
-        beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
-        r = jnp.where(ok, r_new, r)
-        p = jnp.where(ok, r + beta * p, p)
-        rz = jnp.where(ok, rz_new, rz)
-        return x, r, p, rz, k + 1, ~ok
-
-    st0 = (jnp.zeros(n_offsets, tod.dtype), b, b, b_norm,
-           jnp.asarray(0, jnp.int32), jnp.asarray(False))
-    a, _, _, rz, k, _ = jax.lax.while_loop(cond, body, st0)
+    # shared (P)CG driver: same breakdown guard and convergence test as
+    # every other destriper solve (without a preconditioner, rz == rr,
+    # so the criterion matches the old inline loop)
+    a, rz, k, b_norm = _cg_loop(matvec, b, dot, n_iter, threshold)
 
     # A constant offset vector is (near-)degenerate with the I map — the
     # Tikhonov floor in the map solve tips the balance so CG parks the
