@@ -1,0 +1,73 @@
+"""Op-level profile of the bench programs: xprof ``hlo_stats`` table.
+
+The round-3 review asked for the compiled programs' op table as
+secondary perf evidence when wall-clock measurement is unavailable.
+This traces one BENCH_SMALL-or-scaled bench iteration under
+``jax.profiler.trace`` and prints the top ops by self time (the
+``hlo_stats`` tool of xprof), excluding ``while`` rows (double counts).
+
+Usage::
+
+    python tools/hlo_stats.py [--scale 0.2] [--out HLO_STATS_r04.json]
+
+Runs on whatever backend jax selects; meaningful numbers need the real
+chip. Never signals children; safe under the relay rules.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    scale = "1.0"
+    out_path = os.path.join(REPO, "HLO_STATS_r04.json")
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--scale" and args:
+            scale = args.pop(0)
+        elif a == "--out" and args:
+            out_path = args.pop(0)
+        else:
+            print(__doc__, file=sys.stderr)
+            return 2
+
+    os.environ.setdefault("BENCH_SCALE", scale)
+    os.environ.setdefault("BENCH_BASELINE_S", "30")  # skip the baseline
+    os.environ.setdefault("BENCH_NO_PROBE", "")      # keep the probe
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    import jax
+
+    trace_dir = tempfile.mkdtemp(prefix="comap_hlo_")
+    with jax.profiler.trace(trace_dir):
+        bench.main()
+
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError:
+        print(f"trace written to {trace_dir}; xprof not importable "
+              "here — convert offline", file=sys.stderr)
+        return 1
+    planes = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
+    data, _ = rtd.xspace_to_tool_data(planes, "hlo_stats", {})
+    table = json.loads(data) if isinstance(data, (str, bytes)) else data
+    rows = [r for r in table if not isinstance(r, str)]
+    with open(out_path, "w") as f:
+        json.dump(table, f)
+    print(f"hlo_stats: {len(rows)} rows -> {out_path} "
+          f"(trace in {trace_dir})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
