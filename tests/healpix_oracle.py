@@ -1,0 +1,167 @@
+"""Independent scalar HEALPix oracle for the golden-value tests.
+
+A from-scratch transcription of the canonical HEALPix pixelisation
+algorithm as published (Gorski et al. 2005, ApJ 622, 759, and the
+reference C implementation's ang2pix/pix2ang recipes) — deliberately
+scalar, float64, and structured nothing like the repo's vectorised JAX
+``comapreduce_tpu.mapmaking.healpix`` so a self-consistent convention
+error there (e.g. an azimuthal offset within rings, a face relabel,
+a transposed bit interleave) cannot also live here. The ring<->nest
+oracle goes through pixel-centre angles (the two schemes index the SAME
+pixels), so it never mirrors the repo's xyf plumbing.
+
+The repo must match healpy exactly; healpy implements this algorithm.
+"""
+
+import math
+
+__all__ = ["ang2pix_ring", "ang2pix_nest", "pix2ang_ring",
+           "pix2ang_nest", "ring2nest", "nest2ring"]
+
+
+def ang2pix_ring(nside: int, theta: float, phi: float) -> int:
+    z = math.cos(theta)
+    za = abs(z)
+    tt = (phi % (2.0 * math.pi)) / (0.5 * math.pi)     # in [0, 4)
+    if za <= 2.0 / 3.0:                                 # equatorial belt
+        temp1 = nside * (0.5 + tt)
+        temp2 = nside * z * 0.75
+        jp = int(math.floor(temp1 - temp2))   # ascending edge index
+        jm = int(math.floor(temp1 + temp2))   # descending edge index
+        ir = nside + 1 + jp - jm              # ring counted from z=2/3
+        kshift = 1 - (ir & 1)                 # 1 on even rings
+        ip = (jp + jm - nside + kshift + 1) // 2
+        ip %= 4 * nside
+        ncap = 2 * nside * (nside - 1)
+        return ncap + (ir - 1) * 4 * nside + ip
+    else:                                               # polar caps
+        tp = tt - math.floor(tt)
+        tmp = nside * math.sqrt(3.0 * (1.0 - za))
+        jp = int(tp * tmp)
+        jm = int((1.0 - tp) * tmp)
+        ir = jp + jm + 1                      # ring counted from pole
+        ip = int(tt * ir)
+        ip %= 4 * ir
+        if z > 0:
+            return 2 * ir * (ir - 1) + ip
+        return 12 * nside * nside - 2 * ir * (ir + 1) + ip
+
+
+def pix2ang_ring(nside: int, pix: int) -> tuple:
+    npix = 12 * nside * nside
+    ncap = 2 * nside * (nside - 1)
+    if pix < ncap:                                      # north cap
+        iring = (1 + math.isqrt(1 + 2 * pix)) >> 1
+        iphi = pix + 1 - 2 * iring * (iring - 1)
+        z = 1.0 - iring * iring / (3.0 * nside * nside)
+        phi = (iphi - 0.5) * math.pi / (2.0 * iring)
+    elif pix < npix - ncap:                             # equatorial belt
+        ip = pix - ncap
+        iring = ip // (4 * nside) + nside
+        iphi = ip % (4 * nside) + 1
+        # odd (ring+nside) rings are shifted by half a pixel
+        fodd = 0.5 * (1 + ((iring + nside) & 1))
+        z = (2 * nside - iring) * 2.0 / (3.0 * nside)
+        phi = (iphi - fodd) * math.pi / (2.0 * nside)
+    else:                                               # south cap
+        ip = npix - pix
+        iring = (1 + math.isqrt(2 * ip - 1)) >> 1
+        iphi = 4 * iring + 1 - (ip - 2 * iring * (iring - 1))
+        z = -1.0 + iring * iring / (3.0 * nside * nside)
+        phi = (iphi - 0.5) * math.pi / (2.0 * iring)
+    return math.acos(max(-1.0, min(1.0, z))), phi
+
+
+def _interleave(ix: int, iy: int) -> int:
+    """ix bits on even positions, iy bits on odd positions."""
+    out = 0
+    for b in range(32):
+        out |= ((ix >> b) & 1) << (2 * b)
+        out |= ((iy >> b) & 1) << (2 * b + 1)
+    return out
+
+
+def _deinterleave(v: int) -> tuple:
+    ix = iy = 0
+    for b in range(32):
+        ix |= ((v >> (2 * b)) & 1) << b
+        iy |= ((v >> (2 * b + 1)) & 1) << b
+    return ix, iy
+
+
+def ang2pix_nest(nside: int, theta: float, phi: float) -> int:
+    order = nside.bit_length() - 1
+    assert 1 << order == nside, "nest needs power-of-two nside"
+    z = math.cos(theta)
+    za = abs(z)
+    tt = (phi % (2.0 * math.pi)) / (0.5 * math.pi)
+    if za <= 2.0 / 3.0:
+        temp1 = nside * (0.5 + tt)
+        temp2 = nside * z * 0.75
+        jp = int(math.floor(temp1 - temp2))
+        jm = int(math.floor(temp1 + temp2))
+        ifp = jp >> order
+        ifm = jm >> order
+        if ifp == ifm:
+            face = (ifp & 3) + 4
+        elif ifp < ifm:
+            face = ifp & 3
+        else:
+            face = (ifm & 3) + 8
+        ix = jm & (nside - 1)
+        iy = nside - (jp & (nside - 1)) - 1
+    else:
+        ntt = min(3, int(tt))
+        tp = tt - ntt
+        tmp = nside * math.sqrt(3.0 * (1.0 - za))
+        jp = min(int(tp * tmp), nside - 1)
+        jm = min(int((1.0 - tp) * tmp), nside - 1)
+        if z >= 0:
+            face = ntt
+            ix = nside - jm - 1
+            iy = nside - jp - 1
+        else:
+            face = ntt + 8
+            ix = jp
+            iy = jm
+    return face * nside * nside + _interleave(ix, iy)
+
+
+def ring2nest(nside: int, pix: int) -> int:
+    """Via the pixel-centre angle: both schemes index the same pixels,
+    and a centre is interior to its own pixel at any nside."""
+    return ang2pix_nest(nside, *pix2ang_ring(nside, pix))
+
+
+def nest2ring(nside: int, pix: int) -> int:
+    return ang2pix_ring(nside, *pix2ang_nest(nside, pix))
+
+
+def pix2ang_nest(nside: int, pix: int) -> tuple:
+    """Centre of nest pixel: invert the (face, ix, iy) construction with
+    the vertical-index geometry (jr = face_row-coeff * nside - ix - iy)."""
+    face, rem = divmod(pix, nside * nside)
+    ix, iy = _deinterleave(rem)
+    # jr: ring index 1..4nside-1 from the north pole
+    jrll = [2, 2, 2, 2, 3, 3, 3, 3, 4, 4, 4, 4]
+    jpll = [1, 3, 5, 7, 0, 2, 4, 6, 1, 3, 5, 7]
+    jr = jrll[face] * nside - ix - iy - 1
+    if jr < nside:                                      # north cap
+        nr = jr
+        z = 1.0 - nr * nr / (3.0 * nside * nside)
+        kshift = 0
+    elif jr > 3 * nside:                                # south cap
+        nr = 4 * nside - jr
+        z = -1.0 + nr * nr / (3.0 * nside * nside)
+        kshift = 0
+    else:                                               # equatorial
+        nr = nside
+        z = (2 * nside - jr) * 2.0 / (3.0 * nside)
+        kshift = (jr - nside) & 1
+    jp = (jpll[face] * nr + ix - iy + 1 + kshift) // 2
+    if jp > 4 * nside:
+        jp -= 4 * nside
+    if jp < 1:
+        jp += 4 * nside
+    phi = (jp - (kshift + 1) * 0.5) * (0.5 * math.pi / nr)
+    return math.acos(max(-1.0, min(1.0, z))), phi
