@@ -55,7 +55,9 @@ _BUNDLED = np.array([
 ])
 
 _loaded: np.ndarray | None = None
-_env_cache: tuple = ("", None)   # (path, parsed table | None on failure)
+# ((path, mtime_ns, size), parsed table | None on failure) — keyed on the
+# file's identity AND stat so editing the table in place takes effect
+_env_cache: tuple = (("", 0, 0), None)
 
 
 def bundled_table() -> np.ndarray:
@@ -99,16 +101,22 @@ def _active_table() -> np.ndarray:
     env = os.environ.get("COMAP_DUT1_TABLE", "")
     if not env:
         return _BUNDLED
-    # re-resolved every call (setting the env var mid-process must take
-    # effect); the parse itself is cached per path
-    if _env_cache[0] != env:
+    # re-resolved every call (setting the env var OR editing the file
+    # mid-process must take effect); the parse itself is cached per
+    # (path, mtime, size) so an in-place fix invalidates a failed parse
+    try:
+        st = os.stat(env)
+        key = (env, st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = (env, 0, 0)
+    if _env_cache[0] != key:
         try:
             tab = _parse_table(env)
         except (OSError, ValueError) as exc:
             logger.warning("COMAP_DUT1_TABLE %s unusable (%s); using "
                            "the bundled coarse table", env, exc)
             tab = None
-        _env_cache = (env, tab)
+        _env_cache = (key, tab)
     return _env_cache[1] if _env_cache[1] is not None else _BUNDLED
 
 

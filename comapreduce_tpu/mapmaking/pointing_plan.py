@@ -306,8 +306,12 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
     ``batch=None`` reads the ``COMAP_BIN_BATCH`` env default (8) — the
     round-3 "next lever (c)" sweep knob: larger batches amortise
     ``lax.map`` chunk streaming at the cost of a bigger live one-hot.
-    Read at CALL time so a sweep driver can vary it between jit traces
-    in one process.
+    The env value binds at FIRST TRACE per input shape: ``jax.jit``
+    caches executables per shape, so a same-shape re-call never
+    retraces and a changed env value is silently ignored in-process.
+    To sweep it, either spawn a fresh process per point (what
+    ``tools/onchip_sweep.py`` does), call ``jax.clear_caches()``
+    between points, or pass ``batch`` explicitly as an argument.
     """
     if batch is None:
         batch = int(os.environ.get("COMAP_BIN_BATCH", "8"))

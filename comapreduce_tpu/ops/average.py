@@ -77,24 +77,41 @@ def weighted_band_average(tod: jax.Array, weights: jax.Array):
     return num / jnp.maximum(den, _EPS)
 
 
-def frequency_bin(tod: jax.Array, weights: jax.Array, bin_size: int):
+def frequency_bin(tod: jax.Array, weights: jax.Array, bin_size: int,
+                  valid: jax.Array | None = None):
     """Weighted binning of C channels into C//bin_size coarse channels.
 
-    ``tod``: f32[..., C, T]; ``weights``: f32[..., C]. Returns
+    ``tod``: f32[..., C, T]; ``weights``: f32[..., C] per-channel.
+    ``valid``: optional bool[..., C, T] per-sample validity — invalid
+    (NaN-flagged) samples leave the in-bin mean entirely (zero weight)
+    instead of averaging in as zeros. Kept as a SEPARATE bool operand
+    (not a pre-multiplied f32[..., C, T] weight tensor): each
+    elementwise product below has a single reduce consumer, so XLA
+    fuses it into the reduction and the raw-TOD-sized f32 weight array
+    never lives in HBM (~2.2 GB/feed at production shape). Returns
     ``(binned, stddev)`` each f32[..., C//bin_size, T]. Parity:
-    ``Level1Averaging.average_tod`` (``Level1Averaging.py:292-321``), which
-    also records the in-bin standard deviation.
+    ``Level1Averaging.average_tod`` (``Level1Averaging.py:292-321``),
+    which also records the in-bin standard deviation.
     """
     c = tod.shape[-2]
     nb = c // bin_size
     shape = tod.shape[:-2] + (nb, bin_size, tod.shape[-1])
-    x = tod[..., : nb * bin_size, :].reshape(shape)
     w = weights[..., : nb * bin_size].reshape(
         weights.shape[:-1] + (nb, bin_size))[..., None]
-    den = jnp.maximum(jnp.sum(w, axis=-2), _EPS)
-    avg = jnp.sum(x * w, axis=-2) / den
-    # centered second pass: E[x^2] - E[x]^2 cancels catastrophically in
-    # f32 when the in-bin scatter is far below the mean (kelvin-scale TOD)
-    d = x - avg[..., None, :]
-    var = jnp.sum(d * d * w, axis=-2) / den
+    if valid is None:
+        x = tod[..., : nb * bin_size, :].reshape(shape)
+        den = jnp.maximum(jnp.sum(w, axis=-2), _EPS)
+        avg = jnp.sum(x * w, axis=-2) / den
+        d = x - avg[..., None, :]
+        var = jnp.sum(d * d * w, axis=-2) / den
+    else:
+        v = valid[..., : nb * bin_size, :].reshape(shape)
+        # NaNs at invalid slots must not poison 0*NaN products
+        x = jnp.where(v, tod[..., : nb * bin_size, :].reshape(shape), 0.0)
+        den = jnp.maximum(jnp.sum(w * v, axis=-2), _EPS)
+        avg = jnp.sum(x * w, axis=-2) / den
+        # centered second pass: E[x^2] - E[x]^2 cancels catastrophically
+        # in f32 when the in-bin scatter is far below the mean
+        d = jnp.where(v, x - avg[..., None, :], 0.0)
+        var = jnp.sum(d * d * w, axis=-2) / den
     return avg, jnp.sqrt(jnp.maximum(var, 0.0))
