@@ -17,7 +17,9 @@ import numpy as np
 from comapreduce_tpu.backends import numpy_ops
 from comapreduce_tpu.ops.reduce import ReduceConfig, scan_starts_lengths
 from comapreduce_tpu.pipeline.registry import register
-from comapreduce_tpu.pipeline.stages import _StageBase, mean_vane_tsys_gain
+from comapreduce_tpu.pipeline.stages import (_StageBase,
+                                             apply_fleet_channel_mask,
+                                             mean_vane_tsys_gain)
 
 __all__ = ["MeasureSystemTemperatureNumpy", "Level1AveragingNumpy",
            "Level1AveragingGainCorrectionNumpy",
@@ -65,6 +67,7 @@ class Level1AveragingNumpy(_StageBase):
     groups: tuple = ("frequency_binned",)
     frequency_bin_size: int = 512
     feed_batch: int = 4   # config parity; the host path streams per feed
+    normalised_mask_db: str = ""
 
     def __call__(self, data, level2) -> bool:
         from comapreduce_tpu.ops.average import edge_channel_mask
@@ -77,6 +80,8 @@ class Level1AveragingNumpy(_StageBase):
                            "calibration", data.obsid)
             self.STATE = False
             return False
+        tsys = apply_fleet_channel_mask(tsys, self.normalised_mask_db,
+                                        data.obsid)
         F, B, C, T = (int(x) for x in data.tod_shape)
         bin_size = min(self.frequency_bin_size, C)
         nb = C // bin_size
@@ -92,22 +97,30 @@ class Level1AveragingNumpy(_StageBase):
         tod_out = np.zeros((F, B, nb, T), np.float32)
         std_out = np.zeros((F, B, nb, T), np.float32)
         for ifeed in range(F):
-            raw = np.nan_to_num(
-                np.asarray(data.read_tod_feed(ifeed), np.float64))
+            raw = np.asarray(data.read_tod_feed(ifeed), np.float64)
+            # NaN-flagged samples carry zero weight into the bin average
+            # (the mask=None ingest policy) — NOT zero counts at full
+            # weight, which would drag the binned TOD toward zero.
+            # einsum contractions keep the per-sample weight product out
+            # of memory (the f64 (B, C, T) tensor would double the
+            # oracle's working set)
+            valid = np.isfinite(raw)
             g = np.where(gain[ifeed] > 0, gain[ifeed], 1.0)[..., None]
-            tod = raw / g
-            wf = w[ifeed][:, :C // bin_size * bin_size]
+            tod = np.where(valid, raw, 0.0) / g
+            wr = w[ifeed][:, :nb * bin_size].reshape(B, nb, bin_size)
             x = tod[:, :nb * bin_size].reshape(B, nb, bin_size, T)
-            wr = wf.reshape(B, nb, bin_size)[..., None]
-            den = np.maximum(wr.sum(axis=2), 1e-30)
-            avg = (x * wr).sum(axis=2) / den
-            d = x - avg[:, :, None, :]
-            var = (d * d * wr).sum(axis=2) / den
+            v = valid[:, :nb * bin_size].reshape(B, nb, bin_size, T)
+            den = np.maximum(
+                np.einsum("bkst,bks->bkt", v, wr), 1e-30)
+            avg = np.einsum("bkst,bks->bkt", x, wr) / den
+            d = np.where(v, x - avg[:, :, None, :], 0.0)
+            var = np.einsum("bkst,bkst,bks->bkt", d, d, wr) / den
             tod_out[ifeed] = avg
             std_out[ifeed] = np.sqrt(np.maximum(var, 0.0))
         self._data = {
             "frequency_binned/tod": tod_out,
             "frequency_binned/tod_stddev": std_out,
+            "frequency_binned/scan_edges": np.asarray(data.scan_edges),
         }
         self.STATE = True
         return True
@@ -125,6 +138,7 @@ class Level1AveragingGainCorrectionNumpy(_StageBase):
     groups: tuple = ("averaged_tod",)
     medfilt_window: int = 6000
     pad_to: int = 128
+    normalised_mask_db: str = ""
 
     def __call__(self, data, level2) -> bool:
         edges = np.asarray(data.scan_edges)
@@ -140,6 +154,8 @@ class Level1AveragingGainCorrectionNumpy(_StageBase):
                            "has no vane calibration", data.obsid)
             self.STATE = False
             return False
+        tsys = apply_fleet_channel_mask(tsys, self.normalised_mask_db,
+                                        data.obsid)
 
         F, B, C, T = data.tod_shape
         _, _, L = scan_starts_lengths(edges, pad_to=self.pad_to)
@@ -219,6 +235,9 @@ class Level2FitPowerSpectrumNumpy(_StageBase):
     # backend switch must fit identical blocks); 1 = the reference's
     # exact full-length per-scan fits (free on host — no compile cost)
     length_quantum: int = 128
+    # same cap as the device stage (identical blocks after a backend
+    # switch — on host it only bounds the loop count, not compiles)
+    max_length_buckets: int = 16
     figure_dir: str = ""   # same knob as the device stage: a config
     #                        section must survive a backend switch
 
@@ -230,7 +249,8 @@ class Level2FitPowerSpectrumNumpy(_StageBase):
         if len(edges) == 0:
             self.STATE = False
             return False
-        buckets = bucket_scan_lengths(edges, self.length_quantum)
+        buckets = bucket_scan_lengths(edges, self.length_quantum,
+                                      self.max_length_buckets)
         if not buckets:
             self.STATE = False
             return False

@@ -1,0 +1,76 @@
+"""Build fleet date-range channel masks in the observation database.
+
+Role parity: ``COMAPDatabase/assign_normalised_mask.py`` (channel masks
+applied uniformly over operator-defined date ranges, consumed by the
+next reduction level through the Tsys flags). Usage::
+
+    python -m comapreduce_tpu.cli.normalised_mask DB.hd5 CUTS.dat \\
+        [--filelist LEVEL2_LIST.txt] [--threshold 0.25] \\
+        [--feed-cuts N:FILE ...]
+
+``CUTS.dat``: two columns ``start_obsid end_obsid`` (inclusive),
+``#`` comments. ``--filelist`` harvests per-channel evidence from the
+named Level-2 files first (otherwise the evidence already in the
+database is reused). ``--feed-cuts N:FILE`` overrides the global cuts
+for feed index N (the reference's per-feed ``datecuts/FeedNN_cuts.dat``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    usage = ("usage: python -m comapreduce_tpu.cli.normalised_mask "
+             "DB.hd5 CUTS.dat [--filelist L2LIST] [--threshold 0.25] "
+             "[--feed-cuts N:FILE ...]")
+    if argv and argv[0] in ("-h", "--help"):
+        print(usage)
+        return 0
+    if len(argv) < 2:
+        print(usage, file=sys.stderr)
+        return 2
+    from comapreduce_tpu.database.normalised_mask import (
+        build_normalised_masks, harvest_channel_flags, read_date_cuts)
+    from comapreduce_tpu.database.obsdb import ObsDatabase
+    from comapreduce_tpu.pipeline.config import read_filelist
+
+    db_path, cuts_path = argv[0], argv[1]
+    threshold = 0.25
+    filelist = None
+    feed_cuts = {}
+    rest = argv[2:]
+    i = 0
+    while i < len(rest):
+        if rest[i] == "--threshold" and i + 1 < len(rest):
+            threshold = float(rest[i + 1])
+            i += 2
+        elif rest[i] == "--filelist" and i + 1 < len(rest):
+            filelist = rest[i + 1]
+            i += 2
+        elif rest[i] == "--feed-cuts" and i + 1 < len(rest):
+            feed, path = rest[i + 1].split(":", 1)
+            feed_cuts[int(feed)] = read_date_cuts(path)
+            i += 2
+        else:
+            print(f"unknown argument {rest[i]!r}\n{usage}",
+                  file=sys.stderr)
+            return 2
+
+    db = ObsDatabase(db_path)
+    if filelist is not None:
+        n = harvest_channel_flags(db, read_filelist(filelist))
+        print(f"harvested channel evidence from {n} Level-2 files")
+    cuts = read_date_cuts(cuts_path)
+    n = build_normalised_masks(db, cuts, feed_cuts=feed_cuts or None,
+                               threshold=threshold)
+    db.save()
+    print(f"{db_path}: normalised masks for {n} observations")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -72,7 +72,8 @@ def _memoized(tag: str, pixels: np.ndarray, extra_key: tuple, build):
 
 
 def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
-                    n_iter: int, threshold: float, n_groups: int = 0):
+                    n_iter: int, threshold: float, n_groups: int = 0,
+                    compact: bool = False):
     import functools
 
     import jax
@@ -82,14 +83,24 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
 
     def build(pix):
         plan = build_pointing_plan(pix, npix, offset_length)
-        return jax.jit(functools.partial(destripe_planned, plan=plan,
-                                         n_iter=n_iter,
-                                         threshold=threshold,
-                                         n_groups=n_groups))
+        fn = jax.jit(functools.partial(destripe_planned, plan=plan,
+                                       n_iter=n_iter,
+                                       threshold=threshold,
+                                       n_groups=n_groups,
+                                       dense_maps=not compact))
+        if compact:
+            return fn, np.asarray(plan.uniq_pixels)
+        return fn
 
     # ground and plain solvers get separate slots: alternating them on
     # one pointing must not thrash the per-tag memo
     tag = "single-ground" if n_groups else "single"
+    if compact:
+        # compact (hit-pixel) maps, expanded on host by the caller: the
+        # multi-RHS joint solve must never hold (n_bands, npix) dense
+        # products on device (3x the per-band peak; ~10 GB at nside 4096
+        # x 4 bands would OOM a 16 GB chip)
+        tag += "-compact"
     return _memoized(tag, pixels,
                      (int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups)), build)
@@ -146,10 +157,25 @@ def _expand_compact(uniq: np.ndarray, npix: int, compact) -> np.ndarray:
     return full
 
 
+def _expand_joint_results(res, uniq: np.ndarray, npix: int, nb: int):
+    """Split one compact multi-RHS result into per-band dense results:
+    host-expand each band's destriped/naive/weight products (the hit map
+    depends on pointing alone and is shared). ONE home for the rule —
+    the sharded and single-process joint paths must never drift."""
+    hit_full = _expand_compact(uniq, npix, res.hit_map)
+    return [res._replace(
+        offsets=res.offsets[i],
+        destriped_map=_expand_compact(uniq, npix, res.destriped_map[i]),
+        naive_map=_expand_compact(uniq, npix, res.naive_map[i]),
+        weight_map=_expand_compact(uniq, npix, res.weight_map[i]),
+        hit_map=hit_full,
+        residual=res.residual[i]) for i in range(nb)]
+
+
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   offset_length=50, n_iter=100, threshold=1e-6,
                   use_ground=False, use_calibration=True, sharded=False,
-                  medfilt_window=400):
+                  medfilt_window=400, tod_variant="auto"):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
@@ -160,7 +186,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
     data = read_comap_data(filenames, band=band, wcs=wcs, nside=nside,
                            galactic=galactic, offset_length=offset_length,
                            use_calibration=use_calibration,
-                           medfilt_window=medfilt_window)
+                           medfilt_window=medfilt_window,
+                           tod_variant=tod_variant)
     return data, solve_band(data, offset_length=offset_length,
                             n_iter=n_iter, threshold=threshold,
                             use_ground=use_ground, sharded=sharded)
@@ -278,7 +305,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
 def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          galactic=False, offset_length=50, n_iter=100,
                          threshold=1e-6, use_calibration=True,
-                         medfilt_window=400, sharded=False):
+                         medfilt_window=400, sharded=False,
+                         tod_variant="auto"):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -301,7 +329,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                              galactic=galactic,
                              offset_length=offset_length,
                              use_calibration=use_calibration,
-                             medfilt_window=medfilt_window)
+                             medfilt_window=medfilt_window,
+                             tod_variant=tod_variant)
              for b in bands]
     pix0 = np.asarray(datas[0].pixels)
     for d in datas[1:]:
@@ -329,28 +358,17 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
             mesh, pix_host, npix, offset_length, n_iter, threshold,
             n_bands=nb)
         res = run(jnp.asarray(tod), jnp.asarray(wgt))
-        hit_full = _expand_compact(uniq, npix, res.hit_map)
-        results = [res._replace(
-            offsets=res.offsets[i],
-            destriped_map=_expand_compact(uniq, npix, res.destriped_map[i]),
-            naive_map=_expand_compact(uniq, npix, res.naive_map[i]),
-            weight_map=_expand_compact(uniq, npix, res.weight_map[i]),
-            hit_map=hit_full,
-            residual=res.residual[i]) for i in range(nb)]
-        return datas, results
+        return datas, _expand_joint_results(res, uniq, npix, nb)
     n = (datas[0].tod.size // offset_length) * offset_length
     tod = np.stack([np.asarray(d.tod)[:n] for d in datas])
     wgt = np.stack([np.asarray(d.weights)[:n] for d in datas])
-    fn = _planned_solver(pix0[:n], npix, offset_length, n_iter,
-                         threshold)
+    # compact solve + host expansion (same shape handling as the sharded
+    # branch above): the joint program only ever holds (nb, n_rank)
+    # compact products on device, never (nb, npix) dense maps
+    fn, uniq = _planned_solver(pix0[:n], npix, offset_length, n_iter,
+                               threshold, compact=True)
     res = fn(jnp.asarray(tod), jnp.asarray(wgt))
-    results = [res._replace(offsets=res.offsets[i],
-                            destriped_map=res.destriped_map[i],
-                            naive_map=res.naive_map[i],
-                            weight_map=res.weight_map[i],
-                            residual=res.residual[i])
-               for i in range(len(bands))]
-    return datas, results
+    return datas, _expand_joint_results(res, uniq, npix, nb)
 
 
 def write_band_map(path, data, result):
@@ -417,6 +435,9 @@ def main(argv=None) -> int:
     use_cal = bool(inputs.get("calibration", True))
     sharded = bool(inputs.get("sharded", False))
     galactic = bool(pixel.get("galactic", False))
+    # which Level-2 TOD product to map (COMAPData.py:255-258 role);
+    # "frequency_binned" maps the plain no-gain-correction reduction
+    tod_variant = str(inputs.get("tod_variant", "auto"))
 
     # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
     # binning per iteration); ground solves keep their own path.
@@ -429,7 +450,7 @@ def main(argv=None) -> int:
             filelist, bands, wcs=wcs, nside=nside, galactic=galactic,
             offset_length=offset_length, n_iter=n_iter,
             threshold=threshold, use_calibration=use_cal,
-            sharded=sharded)
+            sharded=sharded, tod_variant=tod_variant)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -447,7 +468,8 @@ def main(argv=None) -> int:
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
                 offset_length=offset_length, n_iter=n_iter,
                 threshold=threshold, use_ground=use_ground,
-                use_calibration=use_cal, sharded=sharded)
+                use_calibration=use_cal, sharded=sharded,
+                tod_variant=tod_variant)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         write_band_map(path, data, result)

@@ -272,6 +272,8 @@ class COMAPLevel2(_COMAPCommon):
     def scan_edges(self) -> np.ndarray:
         if "averaged_tod/scan_edges" in self:
             return np.asarray(self["averaged_tod/scan_edges"])
+        if "frequency_binned/scan_edges" in self:
+            return np.asarray(self["frequency_binned/scan_edges"])
         return self._scan_edges_from_features()
 
     @property

@@ -15,6 +15,11 @@ from comapreduce_tpu.database.obsdb import (ObsDatabase, robust_smooth,
 from comapreduce_tpu.database.metadata import (parse_obsinfo,
                                                query_obs_metadata,
                                                obsinfo_from_database)
+from comapreduce_tpu.database.normalised_mask import (
+    harvest_channel_flags, build_normalised_masks, level2_channel_mask,
+    apply_mask_to_tsys, read_date_cuts)
 
 __all__ = ["ObsDatabase", "robust_smooth", "assign_stats_flags",
-           "parse_obsinfo", "query_obs_metadata", "obsinfo_from_database"]
+           "parse_obsinfo", "query_obs_metadata", "obsinfo_from_database",
+           "harvest_channel_flags", "build_normalised_masks",
+           "level2_channel_mask", "apply_mask_to_tsys", "read_date_cuts"]

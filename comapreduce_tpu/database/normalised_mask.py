@@ -1,0 +1,222 @@
+"""Fleet-wide "normalised" channel masks over operator date ranges.
+
+Role parity: ``COMAPDatabase/assign_normalised_mask.py:1-60`` — channels
+that misbehave in more than ``threshold`` of the observations inside an
+operator-defined date (obsid) range are masked for EVERY observation in
+that range, so one noisy week cannot leak a different channel set into
+each map. The coarse "level-2" mask (16-channel bins, >=2 bad channels
+masks the bin, +-1-bin dilation) matches the reference's ``Level2Mask``
+product; it is applied at the next reduction level through the Tsys
+flags (``tsys <= 0`` channels already carry zero weight in both
+averaging stages — see ``apply_mask_to_tsys``).
+
+Differences from the reference (deliberate):
+
+- date cuts are inclusive obsid ranges, not nearest-obsid matches (the
+  reference's ``argmin((obsid - start)**2)`` silently snaps a typo'd cut
+  to the nearest real obs);
+- per-feed cut files are optional — a single global cut list is the
+  common case (the reference requires 19 ``datecuts/FeedNN_cuts.dat``
+  files);
+- the per-channel "bad" evidence is harvested from the Level-2 vane
+  products (non-finite / non-positive Tsys, plus the vane spike mask
+  when present) instead of a separate fleet pickle.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from comapreduce_tpu.database.obsdb import ObsDatabase
+
+__all__ = ["harvest_channel_flags", "build_normalised_masks",
+           "level2_channel_mask", "apply_mask_to_tsys", "read_date_cuts"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+def read_date_cuts(path: str) -> list:
+    """Two-column ``start_obsid end_obsid`` file (``#`` comments) ->
+    list of (start, end) inclusive ranges (the ``datecuts/`` format)."""
+    cuts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) < 2:
+                raise ValueError(f"date-cut file {path}: line {line!r} "
+                                 "needs two columns (start end)")
+            cuts.append((int(float(parts[0])), int(float(parts[1]))))
+    return cuts
+
+
+def harvest_channel_flags(db: ObsDatabase, filenames) -> int:
+    """Record per-obs ``vane/channel_bad`` (F, B, C) uint8 evidence from
+    Level-2 stores: non-finite / non-positive Tsys in any vane event,
+    OR'd with the vane spike mask when present."""
+    from comapreduce_tpu.data.level import COMAPLevel2
+
+    n = 0
+    for fname in filenames:
+        try:
+            lvl2 = COMAPLevel2(filename=fname)
+            obsid = lvl2.obsid
+            tsys = np.asarray(lvl2.system_temperature, np.float64)
+        except (OSError, KeyError) as exc:
+            logger.warning("harvest_channel_flags: BAD FILE %s (%s)",
+                           fname, exc)
+            continue
+        # (Nvane, F, B, C) or (F, B, C): bad if bad in ANY vane event
+        if tsys.ndim == 4:
+            bad = (~np.isfinite(tsys) | (tsys <= 0)).any(axis=0)
+        else:
+            bad = ~np.isfinite(tsys) | (tsys <= 0)
+        spikes = lvl2.get("vane/spike_mask")
+        if spikes is not None:
+            sp = np.asarray(spikes) > 0
+            if sp.ndim == 4:
+                sp = sp.any(axis=0)
+            bad = bad | sp
+        db.set(obsid, "vane/channel_bad", bad.astype(np.uint8))
+        n += 1
+    return n
+
+
+def build_normalised_masks(db: ObsDatabase, cuts,
+                           feed_cuts: dict | None = None,
+                           threshold: float = 0.25,
+                           coarse_bin: int = 16, min_bad: int = 2,
+                           dilate: int = 1) -> int:
+    """Build + store the date-range masks from the harvested evidence.
+
+    ``cuts``: list of (start_obsid, end_obsid) inclusive ranges applied
+    to every feed; ``feed_cuts`` optionally overrides the list for
+    individual feed indices (the reference's per-feed
+    ``datecuts/FeedNN_cuts.dat`` role). Within each range a channel is
+    masked when it is bad in more than ``threshold`` of the range's
+    observations (``assign_normalised_mask.py`` uses ``s > 0.25 w``).
+
+    Writes per obs: ``vane/normalised_mask`` (F, B, C) uint8 (full-res
+    fleet mask) and ``vane/level2_mask`` (F, B, C//coarse_bin) uint8
+    (own-bad OR fleet mask, ``min_bad``-of-``coarse_bin`` rule, +-dilate
+    bins) — the product the next reduction level applies. Returns the
+    number of observations updated."""
+    evid = {o: np.asarray(db.get(o, "vane/channel_bad"), bool)
+            for o in db.obsids()
+            if db.get(o, "vane/channel_bad") is not None}
+    if not evid:
+        return 0
+    # mixed instrument epochs (different F or C) must not crash the
+    # fleet build: keep the most common evidence shape, skip the rest
+    # (same policy as obsdb.smoothed_calibration_factors)
+    from collections import Counter
+
+    shape = Counter(e.shape for e in evid.values()).most_common(1)[0][0]
+    dropped = [o for o, e in evid.items() if e.shape != shape]
+    if dropped:
+        logger.warning("build_normalised_masks: skipping %d obs with "
+                       "evidence shape != %s", len(dropped), shape)
+    obsids = sorted(o for o, e in evid.items() if e.shape == shape)
+    F, B, C = shape
+    fleet = {o: np.zeros(shape, bool) for o in obsids}
+
+    for ifeed in range(F):
+        for start, end in (feed_cuts or {}).get(ifeed, cuts):
+            inside = [o for o in obsids if start <= o <= end]
+            if not inside:
+                continue
+            stack = np.stack([evid[o][ifeed] for o in inside])  # (n,B,C)
+            frac = stack.mean(axis=0)
+            mask = frac > threshold
+            for o in inside:
+                fleet[o][ifeed] |= mask
+
+    nb = max(C // coarse_bin, 1)
+    for o in obsids:
+        db.set(o, "vane/normalised_mask", fleet[o].astype(np.uint8))
+        combined = (fleet[o] | evid[o])[:, :, : nb * coarse_bin]
+        counts = combined.reshape(F, B, nb, -1).sum(axis=-1)
+        lvl2 = counts >= min_bad
+        for d in range(1, dilate + 1):       # +-d-bin dilation, no wrap
+            grown = lvl2.copy()
+            grown[:, :, d:] |= lvl2[:, :, :-d]
+            grown[:, :, :-d] |= lvl2[:, :, d:]
+            lvl2 = grown
+        db.set(o, "vane/level2_mask", lvl2.astype(np.uint8))
+    return len(obsids)
+
+
+def level2_channel_mask(db: ObsDatabase, obsid: int,
+                        n_channels: int | None = None
+                        ) -> np.ndarray | None:
+    """Full-resolution (F, B, C) bool mask (True = masked) expanded from
+    the stored coarse ``vane/level2_mask``; None when the observation has
+    no mask (the stages then apply no fleet cut)."""
+    coarse = db.get(obsid, "vane/level2_mask")
+    if coarse is None:
+        return None
+    coarse = np.asarray(coarse, bool)
+    F, B, nb = coarse.shape
+    C = n_channels or nb * 16
+    reps = max(C // nb, 1)
+    full = np.repeat(coarse, reps, axis=-1)
+    if full.shape[-1] < C:                    # C not divisible: extend
+        pad = np.repeat(full[:, :, -1:], C - full.shape[-1], axis=-1)
+        full = np.concatenate([full, pad], axis=-1)
+    return full[:, :, :C]
+
+
+# one-slot db cache keyed on (path, mtime_ns, size): a batch reduction
+# calls apply_mask_to_tsys up to twice per observation and must not
+# re-read the whole fleet store every time
+_db_cache: tuple = (None, None)
+_warned_missing: set = set()
+
+
+def _cached_db(db_file: str) -> ObsDatabase:
+    global _db_cache
+    st = os.stat(db_file)
+    key = (os.path.abspath(db_file), st.st_mtime_ns, st.st_size)
+    if _db_cache[0] != key:
+        _db_cache = (key, ObsDatabase(db_file))
+    return _db_cache[1]
+
+
+def apply_mask_to_tsys(tsys: np.ndarray, db_file: str, obsid: int
+                       ) -> np.ndarray:
+    """Zero the Tsys of fleet-masked channels (zero Tsys == zero channel
+    weight in every averaging stage — the mask rides the existing Tsys
+    flags exactly as the reference applies ``Level2Mask`` on top of its
+    initial Tsys flags). Returns ``tsys`` unchanged when the database or
+    mask is absent (fail-open: a missing fleet product must not block a
+    reduction — but a MISSING DATABASE FILE is warned once per path,
+    since an operator configured it expecting a cut)."""
+    if not os.path.exists(db_file):
+        if db_file not in _warned_missing:
+            _warned_missing.add(db_file)
+            logger.warning("normalised_mask_db %s does not exist; "
+                           "reducing WITHOUT the fleet channel cut",
+                           db_file)
+        return tsys
+    try:
+        db = _cached_db(db_file)
+        mask = level2_channel_mask(db, obsid, tsys.shape[-1])
+    except (OSError, KeyError, ValueError) as exc:
+        logger.warning("normalised mask unavailable (%s); reducing "
+                       "without the fleet cut", exc)
+        return tsys
+    if mask is None:
+        return tsys
+    if mask.shape != tsys.shape:
+        logger.warning("normalised mask shape %s != tsys %s; skipping",
+                       mask.shape, tsys.shape)
+        return tsys
+    n = int(mask.sum())
+    if n:
+        logger.info("obs %s: masking %d fleet-flagged channels", obsid, n)
+    return np.where(mask, 0.0, tsys)

@@ -118,6 +118,48 @@ def scan_speed_mask(az: np.ndarray, el: np.ndarray,
     return (speed > speed_range[0]) & (speed < speed_range[1])
 
 
+def _read_averaged(lvl2, band: int, tod_variant: str):
+    """The gain-corrected Level-2 products: returns
+    ``(tod[F,T] | None, weights[F,T], (F,B,T))`` for one band (None when
+    the band is out of range)."""
+    tod_all = np.asarray(lvl2["averaged_tod/tod"], np.float32)
+    F, B, T = tod_all.shape
+    if not 0 <= band < B:
+        return None, None, (F, B, T)
+    want_orig = (tod_variant == "original"
+                 or (tod_variant == "auto" and lvl2.is_calibrator))
+    if want_orig and "averaged_tod/tod_original" in lvl2:
+        tod_fb = np.asarray(lvl2["averaged_tod/tod_original"],
+                            np.float32)[:, band]
+    elif tod_variant == "original":
+        raise KeyError("averaged_tod/tod_original")
+    else:
+        tod_fb = tod_all[:, band]
+    weights = np.asarray(lvl2["averaged_tod/weights"],
+                         np.float32)[:, band].copy()
+    return tod_fb, weights, (F, B, T)
+
+
+def _read_frequency_binned(lvl2, band: int):
+    """The plain ``Level1Averaging`` product: inverse-variance combine
+    the coarse channels; the summed ``1/stddev^2`` doubles as the
+    destriper weight (matching the reference's naive-weight convention
+    for its no-gain-filter reductions)."""
+    x = np.asarray(lvl2["frequency_binned/tod"], np.float32)
+    F, B, nb, T = x.shape
+    if not 0 <= band < B:
+        return None, None, (F, B, T)
+    x = x[:, band]                                        # (F, nb, T)
+    s = np.asarray(lvl2["frequency_binned/tod_stddev"],
+                   np.float32)[:, band]
+    iv = np.where(s > 0, 1.0 / np.maximum(s, 1e-20) ** 2, 0.0)
+    den = iv.sum(axis=1)                                  # (F, T)
+    num = (np.nan_to_num(x) * iv).sum(axis=1)
+    # den==0 samples carry zero weight downstream; their value is moot
+    tod = num / np.maximum(den, 1e-30)
+    return tod.astype(np.float32), den.astype(np.float32), (F, B, T)
+
+
 def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     nside: int | None = None, galactic: bool = False,
                     offset_length: int = 50, medfilt_window: int = 400,
@@ -126,7 +168,8 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     mask_turnarounds: bool = False,
                     speed_range: tuple = (0.1, 0.45),
                     sun_centric: bool = False,
-                    min_sun_distance_deg: float = 10.0) -> DestriperData:
+                    min_sun_distance_deg: float = 10.0,
+                    tod_variant: str = "auto") -> DestriperData:
     """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
     ``nside`` selects the pixelisation. ``mask_turnarounds`` zero-weights
     samples outside the ``speed_range`` deg/s scan-speed band (the legacy
@@ -135,32 +178,47 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     coordinates (per-file sun position at the first sample; parity
     ``COMAPData.py:326-327``) and zero-weights samples within
     ``min_sun_distance_deg`` of the sun (the reference's 10-degree cut,
-    ``:335``); it overrides ``galactic``."""
+    ``:335``); it overrides ``galactic``.
+
+    ``tod_variant`` selects which Level-2 TOD product feeds the map (the
+    reference chooses per use-case among the analogous datasets,
+    ``COMAPData.py:255-258``):
+
+    - ``"auto"`` (default): ``averaged_tod/tod``, switching calibrator
+      files to ``averaged_tod/tod_original`` when present (the
+      reference's ``use_gain_filter``/source rule);
+    - ``"gain_filtered"``: always ``averaged_tod/tod``;
+    - ``"original"``: always ``averaged_tod/tod_original``;
+    - ``"frequency_binned"``: the plain (no gain-correction)
+      ``Level1Averaging`` product — coarse channels are combined by
+      inverse-variance (``1/stddev^2``) and those variances also supply
+      the destriper weights (a frequency_binned-only store has no
+      ``averaged_tod/weights``)."""
     if (wcs is None) == (nside is None):
         raise ValueError("pass exactly one of wcs= or nside=")
+    variants = ("auto", "gain_filtered", "original", "frequency_binned")
+    if tod_variant not in variants:
+        raise ValueError(f"tod_variant must be one of {variants}")
     tods, pixs, wgts, gids, azs = [], [], [], [], []
     group = 0
     kept_files = []
     for fname in filenames:
         try:
             lvl2 = COMAPLevel2(filename=fname)
-            tod_all = np.asarray(lvl2["averaged_tod/tod"], np.float32)
+            if tod_variant == "frequency_binned":
+                tod_fb, weights, (F, B, T) = _read_frequency_binned(
+                    lvl2, band)
+            else:
+                tod_fb, weights, (F, B, T) = _read_averaged(
+                    lvl2, band, tod_variant)
         except (OSError, KeyError) as exc:
             logger.warning("BAD FILE %s (%s)", fname, exc)
             continue
-        F, B, T = tod_all.shape
-        if not 0 <= band < B:
+        if tod_fb is None:
             logger.warning("%s: band %d out of range", fname, band)
             continue
         is_cal = lvl2.is_calibrator
         src_name = lvl2.source_name
-        if is_cal and "averaged_tod/tod_original" in lvl2:
-            tod_fb = np.asarray(lvl2["averaged_tod/tod_original"],
-                                np.float32)[:, band]
-        else:
-            tod_fb = tod_all[:, band]
-        weights = np.asarray(lvl2["averaged_tod/weights"],
-                             np.float32)[:, band].copy()
         edges = np.asarray(lvl2.scan_edges)
         use, wzero = _truncated_scan_mask(edges, T, offset_length, edge_frac)
         if not use.any():

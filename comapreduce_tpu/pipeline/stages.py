@@ -268,6 +268,17 @@ def _batched_atmosphere_fit(n_scans: int):
     return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0)))
 
 
+def apply_fleet_channel_mask(tsys, db_file: str, obsid: int):
+    """ONE home for the stages' fleet-mask hook: zero fleet-masked
+    channels' Tsys (== zero weight) when ``db_file`` is configured;
+    no-op on the empty default."""
+    if not db_file:
+        return tsys
+    from comapreduce_tpu.database.normalised_mask import apply_mask_to_tsys
+
+    return apply_mask_to_tsys(tsys, db_file, obsid)
+
+
 def mean_vane_tsys_gain(level2):
     """Event-averaged (tsys, gain), each f32[F, B, C]; zeros stay zero.
 
@@ -469,10 +480,15 @@ class AtmosphereRemoval(_StageBase):
 @functools.lru_cache(maxsize=8)
 def _batched_frequency_bin(bin_size: int):
     """Cached jitted vmap-over-feeds frequency binner: counts / gain,
-    then the weighted in-bin mean + stddev (one compile per bin size)."""
+    then the weighted in-bin mean + stddev (one compile per bin size).
+    NaN-flagged raw samples carry ZERO weight into the bin average (the
+    ``mask=None`` ingest policy) rather than averaging in as zeros —
+    validity stays a bool operand so no raw-sized f32 weight tensor is
+    ever resident (see ``frequency_bin``)."""
     def one(raw, gain, weights):
-        tod = jnp.nan_to_num(raw) / jnp.where(gain > 0, gain, 1.0)[..., None]
-        return frequency_bin(tod, weights, bin_size)
+        valid = jnp.isfinite(raw)
+        tod = raw / jnp.where(gain > 0, gain, 1.0)[..., None]
+        return frequency_bin(tod, weights, bin_size, valid=valid)
 
     return jax.jit(jax.vmap(one))
 
@@ -496,6 +512,9 @@ class Level1Averaging(_StageBase):
     frequency_bin_size: int = 512
     # feeds per device batch (a feed is ~2.2 GB of raw counts)
     feed_batch: int = 4
+    # obsdb file with fleet date-range channel masks (empty = no fleet
+    # cut); masked channels get tsys=0 == zero weight
+    normalised_mask_db: str = ""
 
     def __call__(self, data, level2) -> bool:
         try:
@@ -505,6 +524,8 @@ class Level1Averaging(_StageBase):
                            "calibration", data.obsid)
             self.STATE = False
             return False
+        tsys = apply_fleet_channel_mask(tsys, self.normalised_mask_db,
+                                        data.obsid)
         F, B, C, T = (int(x) for x in data.tod_shape)
         bin_size = min(self.frequency_bin_size, C)
         # the reference's frequency mask: 10 edge channels each end plus
@@ -531,6 +552,10 @@ class Level1Averaging(_StageBase):
         self._data = {
             "frequency_binned/tod": tod_out,
             "frequency_binned/tod_stddev": std_out,
+            # the plain product must be mappable standalone: the
+            # destriper reads scan edges from the Level-2 store (the
+            # gain chain writes averaged_tod/scan_edges likewise)
+            "frequency_binned/scan_edges": np.asarray(data.scan_edges),
         }
         self.STATE = True
         return True
@@ -571,6 +596,9 @@ class Level1AveragingGainCorrection(_StageBase):
     scan_batch: int | None = None
     prefetch: bool = True
     figure_dir: str = ""
+    # obsdb file with fleet date-range channel masks (empty = no fleet
+    # cut); masked channels get tsys=0 == zero weight in the reduction
+    normalised_mask_db: str = ""
 
     def __call__(self, data, level2) -> bool:
         from comapreduce_tpu.parallel.mesh import feed_time_mesh
@@ -589,6 +617,8 @@ class Level1AveragingGainCorrection(_StageBase):
                            "vane calibration", data.obsid)
             self.STATE = False
             return False
+        tsys = apply_fleet_channel_mask(tsys, self.normalised_mask_db,
+                                        data.obsid)
 
         F, B, C, T = data.tod_shape
         starts, lengths, L = scan_starts_lengths(edges, pad_to=self.pad_to)
@@ -715,7 +745,8 @@ class Spikes(_StageBase):
         return True
 
 
-def bucket_scan_lengths(edges: np.ndarray, quantum: int) -> dict:
+def bucket_scan_lengths(edges: np.ndarray, quantum: int,
+                        max_buckets: int = 0) -> dict:
     """Group scan indices by quantised fit length: {length: [scan ids]}.
 
     Scans are fitted at their own length rounded DOWN to the ``quantum``
@@ -723,7 +754,15 @@ def bucket_scan_lengths(edges: np.ndarray, quantum: int) -> dict:
     anything under 16 samples is unfittable and dropped. Shared by the
     device and numpy noise stages so a per-stage backend switch fits
     identical blocks; ``quantum=1`` reproduces the reference's exact
-    full-length fits (``Level2Data.py:288-329``)."""
+    full-length fits (``Level2Data.py:288-329``).
+
+    ``max_buckets > 0`` caps the number of DISTINCT buckets — each
+    distinct length is its own XLA compile, and an adversarial filelist
+    with many distinct scan lengths would otherwise compile one kernel
+    per scan. Over-cap bucket sets are merged directly: the sorted
+    distinct lengths are split into ``max_buckets`` contiguous groups
+    and every group fits at its MINIMUM length (round-down stays safe
+    for every scan in the group); the worst extra trim is logged."""
     q = max(int(quantum), 1)
     buckets: dict[int, list[int]] = {}
     for si, (s, e) in enumerate(np.asarray(edges)):
@@ -731,6 +770,25 @@ def bucket_scan_lengths(edges: np.ndarray, quantum: int) -> dict:
         lq = (ln // q) * q if ln >= q else ln // 2 * 2
         if lq >= 16:
             buckets.setdefault(lq, []).append(si)
+
+    if max_buckets > 0 and len(buckets) > max_buckets:
+        n0 = len(buckets)
+        groups = np.array_split(np.asarray(sorted(buckets)), max_buckets)
+        merged: dict[int, list[int]] = {}
+        worst = 0
+        for g in groups:
+            if not g.size:
+                continue
+            tgt = int(g[0])                 # ascending: g[0] is the min
+            worst = max(worst, int(g[-1]) - tgt)
+            for ln in g:
+                merged.setdefault(tgt, []).extend(buckets[int(ln)])
+        buckets = {ln: sorted(v) for ln, v in merged.items()}
+        logger.warning(
+            "bucket_scan_lengths: %d distinct fit lengths exceed the "
+            "%d-compile cap; merged to %d buckets (up to %d extra "
+            "samples trimmed per scan)", n0, max_buckets, len(buckets),
+            worst)
     return buckets
 
 
@@ -771,10 +829,14 @@ class Level2FitPowerSpectrum(_StageBase):
     # a production 13.5k-sample scan); 1 = every distinct (even) length
     # compiles its own kernel
     length_quantum: int = 128
+    # cap on distinct compile buckets per observation (0 = uncapped);
+    # an adversarial filelist cannot force one XLA compile per scan
+    max_length_buckets: int = 16
     figure_dir: str = ""
 
     def _bucket_scans(self, edges: np.ndarray) -> dict[int, list[int]]:
-        return bucket_scan_lengths(edges, self.length_quantum)
+        return bucket_scan_lengths(edges, self.length_quantum,
+                                   self.max_length_buckets)
 
     def __call__(self, data, level2) -> bool:
         tod = np.asarray(level2.tod, dtype=np.float32)  # (F, B, T)

@@ -1,0 +1,50 @@
+"""Generate a small synthetic field dataset in the current directory.
+
+The zero-to-map quickstart companion of ``examples/configs/``::
+
+    mkdir run && cd run
+    python -m comapreduce_tpu.simulations.make_field [n_obs] [seed]
+    comap-run-average  .../examples/configs/configuration.toml
+    ls level2/Level2_*.hd5 > l2list.txt
+    comap-run-destriper .../examples/configs/parameters.ini
+
+Writes ``comap-<obsid>.hd5`` Level-1 files (4 bands, a 5 K point source
+at the co2 field centre) plus ``filelist.txt``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    n_obs = int(argv[0]) if argv else 2
+    seed = int(argv[1]) if len(argv) > 1 else 0
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.mapmaking.filelist import write_filelist
+
+    files = []
+    for i in range(n_obs):
+        params = SyntheticObsParams(
+            obsid=1_000_000 + i, source="co2", n_feeds=2, n_bands=4,
+            n_channels=32, n_scans=4, scan_samples=1200,
+            vane_samples=250, seed=seed + i, source_amplitude_k=5.0,
+            source_fwhm_deg=0.15, az_throw=2.0, fknee=1.0)
+        path = f"comap-{1_000_000 + i}.hd5"
+        generate_level1_file(path, params)
+        files.append(path)
+        print(f"wrote {path}")
+    write_filelist("filelist.txt", files)
+    print(f"wrote filelist.txt ({n_obs} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,323 @@
+"""Round-5 VERDICT features: mappable frequency_binned product,
+normalised date-range channel masks, shipped example configs."""
+
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                            generate_level1_file)
+from comapreduce_tpu.mapmaking.filelist import write_filelist
+
+
+@pytest.fixture(scope="module")
+def plain_level2(tmp_path_factory):
+    """Two obs reduced by the PLAIN (no gain-correction) chain — the
+    store has frequency_binned/* and NO averaged_tod group."""
+    from comapreduce_tpu.cli import run_average
+
+    tmp = tmp_path_factory.mktemp("r5plain")
+    files = []
+    for i in range(2):
+        params = SyntheticObsParams(
+            obsid=5_000_000 + i, source="co2", n_feeds=2, n_bands=2,
+            n_channels=32, n_scans=4, scan_samples=1200,
+            vane_samples=250, seed=500 + i, source_amplitude_k=5.0,
+            source_fwhm_deg=0.15, az_throw=2.0, fknee=1.0)
+        path = str(tmp / f"comap-{5_000_000 + i}.hd5")
+        generate_level1_file(path, params)
+        files.append(path)
+    filelist = os.path.join(tmp, "filelist.txt")
+    write_filelist(filelist, files)
+    config = os.path.join(tmp, "config.toml")
+    with open(config, "w") as f:
+        f.write(f'''
+[Global]
+processes = ["CheckLevel1File", "AssignLevel1Data",
+             "MeasureSystemTemperature", "Level1Averaging"]
+filelist = "{filelist}"
+output_dir = "{tmp}/level2"
+log_dir = "{tmp}/logs"
+
+[CheckLevel1File]
+min_duration_seconds = 1.0
+
+[Level1Averaging]
+frequency_bin_size = 16
+''')
+    assert run_average.main([config]) == 0
+    l2 = [os.path.join(tmp, "level2", f"Level2_{os.path.basename(p)}")
+          for p in files]
+    assert all(os.path.exists(p) for p in l2)
+    return str(tmp), l2
+
+
+def test_frequency_binned_store_reaches_a_map(plain_level2):
+    """VERDICT r4 #4: Level1Averaging -> destriper end-to-end. The
+    frequency_binned product must reach a FITS map through the CLI."""
+    from comapreduce_tpu.cli import run_destriper
+    from comapreduce_tpu.mapmaking.fits_io import read_fits_image
+
+    tmp, l2 = plain_level2
+    l2list = os.path.join(tmp, "l2list.txt")
+    write_filelist(l2list, l2)
+    ini = os.path.join(tmp, "params.ini")
+    with open(ini, "w") as f:
+        f.write(f"""
+[Inputs]
+filelist : {l2list}
+output_dir : {tmp}/maps
+prefix : plain
+bands : 0, 1
+offset_length : 50
+niter : 60
+threshold : 1e-6
+ground : false
+tod_variant : frequency_binned
+
+[Pixelization]
+type : wcs
+crval : 170.0, 52.0
+cdelt : 0.0333333, 0.0333333
+shape : 240, 240
+""")
+    assert run_destriper.main([ini]) == 0
+    for band in (0, 1):
+        path = os.path.join(tmp, "maps", f"plain_band{band}.fits")
+        assert os.path.exists(path)
+        by_name = {name: data for name, hdr, data in read_fits_image(path)}
+        hits = by_name["HITS"]
+        assert hits.sum() > 0
+        d = by_name["DESTRIPED"]
+        assert np.isfinite(d[hits > 0]).all()
+        # the 5 K injected source dominates the plain (uncorrected)
+        # reduction too: map peak sits in the source region
+        c = hits[110:130, 110:130]
+        assert c.sum() > 0
+
+
+def test_frequency_binned_reader_weights(plain_level2):
+    """The reader's inverse-variance combination: weights come from the
+    stored per-bin stddevs, and a store WITHOUT averaged_tod must not
+    raise (regression for the dead-end product)."""
+    from comapreduce_tpu.data.level import COMAPLevel2
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    tmp, l2 = plain_level2
+    lvl2 = COMAPLevel2(filename=l2[0])
+    assert "frequency_binned/tod" in lvl2
+    assert "averaged_tod/tod" not in lvl2
+
+    wcs = WCS.from_field((170.0, 52.0), (1 / 30, 1 / 30), (240, 240))
+    data = read_comap_data(l2, band=0, wcs=wcs, offset_length=50,
+                           tod_variant="frequency_binned")
+    assert data.tod.size > 0
+    w = np.asarray(data.weights)
+    assert (w >= 0).all() and (w > 0).any()
+    # auto mode on this store is a BAD FILE for every input (no
+    # averaged_tod) -> empty read raises
+    with pytest.raises(RuntimeError, match="no usable data"):
+        read_comap_data(l2, band=0, wcs=wcs, offset_length=50)
+
+
+def _make_db_with_evidence(tmp_path, n_obs=6, F=3, B=2, C=64,
+                           bad_feed=1, bad_band=0, bad_chan=slice(20, 23)):
+    """An obsdb with synthetic channel_bad evidence: ``bad_chan`` of
+    (bad_feed, bad_band) is bad in 4 of the 6 obs (frac 0.67 > 0.25);
+    channel 40 is bad in exactly 1 obs (frac 0.17 < 0.25)."""
+    from comapreduce_tpu.database import ObsDatabase
+
+    db = ObsDatabase(str(tmp_path / "obsdb.hd5"))
+    obsids = [9_000_000 + i for i in range(n_obs)]
+    for i, o in enumerate(obsids):
+        bad = np.zeros((F, B, C), np.uint8)
+        if i < 4:
+            bad[bad_feed, bad_band, bad_chan] = 1
+        if i == 0:
+            bad[bad_feed, bad_band, 40] = 1
+        db.set(o, "vane/channel_bad", bad)
+        db.set_attr(o, "mjd", 59000.0 + i)
+    return db, obsids
+
+
+def test_build_normalised_masks(tmp_path):
+    """VERDICT r4 #5: persistent channels inside a date cut are masked
+    fleet-wide; transient ones are not; the coarse level2 mask applies
+    the >=2-of-16 rule with +-1-bin dilation."""
+    from comapreduce_tpu.database import (build_normalised_masks,
+                                          level2_channel_mask)
+
+    db, obsids = _make_db_with_evidence(tmp_path)
+    n = build_normalised_masks(db, [(obsids[0], obsids[-1])])
+    assert n == len(obsids)
+    db.save()
+
+    for o in obsids:
+        norm = np.asarray(db.get(o, "vane/normalised_mask"), bool)
+        # persistent channels masked in EVERY obs of the range,
+        # including the two obs where they were individually fine
+        assert norm[1, 0, 20:23].all()
+        # the one-off channel stays unmasked (0.17 < 0.25)
+        assert not norm[1, 0, 40]
+        assert not norm[0].any() and not norm[2].any()
+
+    # coarse mask: channels 20:23 live in 16-bin #1 -> bins 0,1,2 masked
+    # (>=2 bad + dilation); obs 0's channel 40 (bin 2, only 1 bad) adds
+    # nothing on its own
+    full = level2_channel_mask(db, obsids[-1], n_channels=64)
+    assert full.shape == (3, 2, 64)
+    assert full[1, 0, 0:48].all()       # bins 0-2 via bin 1 + dilation
+    assert not full[1, 0, 48:].any()    # bin 3 untouched
+    assert not full[0].any()
+
+
+def test_feed_cuts_override(tmp_path):
+    from comapreduce_tpu.database import build_normalised_masks
+
+    db, obsids = _make_db_with_evidence(tmp_path)
+    # feed 1's cuts exclude the range entirely -> nothing masked there
+    build_normalised_masks(db, [(obsids[0], obsids[-1])],
+                           feed_cuts={1: []})
+    for o in obsids:
+        norm = np.asarray(db.get(o, "vane/normalised_mask"), bool)
+        assert not norm.any()
+
+
+def test_apply_mask_to_tsys(tmp_path):
+    from comapreduce_tpu.database import (apply_mask_to_tsys,
+                                          build_normalised_masks)
+
+    db, obsids = _make_db_with_evidence(tmp_path)
+    build_normalised_masks(db, [(obsids[0], obsids[-1])])
+    db.save()
+
+    tsys = np.full((3, 2, 64), 40.0, np.float32)
+    out = apply_mask_to_tsys(tsys, db.filename, obsids[2])
+    assert (out[1, 0, 0:48] == 0).all()
+    assert (out[1, 0, 48:] == 40.0).all()
+    assert (out[0] == 40.0).all()
+    # fail-open: missing db leaves tsys untouched — but warns (once),
+    # since a configured-but-absent fleet cut must be visible in logs
+    import logging
+
+    missing = str(tmp_path / "nope.hd5")
+    logger = logging.getLogger("comapreduce_tpu")
+    records = []
+    h = logging.Handler()
+    h.emit = records.append
+    logger.addHandler(h)
+    try:
+        out2 = apply_mask_to_tsys(tsys, missing, 1)
+        apply_mask_to_tsys(tsys, missing, 2)
+    finally:
+        logger.removeHandler(h)
+    assert (out2 == tsys).all()
+    warned = [r for r in records if "does not exist" in r.getMessage()]
+    assert len(warned) == 1
+    # unknown obsid: no mask stored -> untouched
+    out3 = apply_mask_to_tsys(tsys, db.filename, 123)
+    assert (out3 == tsys).all()
+
+
+def test_normalised_mask_cli_and_harvest(tmp_path, plain_level2):
+    """CLI end-to-end: harvest evidence from real Level-2 stores, build
+    masks from a cuts file, and reduce with the stage knob set."""
+    from comapreduce_tpu.cli import normalised_mask as cli
+    from comapreduce_tpu.database import ObsDatabase
+
+    _, l2 = plain_level2
+    l2list = tmp_path / "l2.txt"
+    write_filelist(str(l2list), l2)
+    cuts = tmp_path / "cuts.dat"
+    cuts.write_text("# fleet cut\n5000000 5000001\n")
+    dbf = tmp_path / "db.hd5"
+    assert cli.main([str(dbf), str(cuts), "--filelist", str(l2list)]) == 0
+    db = ObsDatabase(str(dbf))
+    assert len(db.obsids()) == 2
+    for o in db.obsids():
+        assert db.get(o, "vane/level2_mask") is not None
+
+
+def test_stage_applies_fleet_mask(tmp_path):
+    """A fleet-masked channel must carry zero weight through the plain
+    averaging stage (tsys=0 channels are already excluded)."""
+    from comapreduce_tpu.data.level import COMAPLevel1
+    from comapreduce_tpu.database import (ObsDatabase,
+                                          build_normalised_masks)
+    from comapreduce_tpu.pipeline import resolve
+    from comapreduce_tpu.pipeline.runner import Runner
+
+    p = SyntheticObsParams(obsid=9_100_000, n_feeds=2, n_bands=1,
+                           n_channels=32, n_scans=1, scan_samples=400)
+    path = tmp_path / "obs.hd5"
+    generate_level1_file(path, p)
+
+    # fleet mask: ALL channels of feed 0 masked in-range
+    db = ObsDatabase(str(tmp_path / "db.hd5"))
+    bad = np.zeros((2, 1, 32), np.uint8)
+    bad[0] = 1
+    db.set(9_100_000, "vane/channel_bad", bad)
+    build_normalised_masks(db, [(9_000_000, 9_200_000)])
+    db.save()
+
+    outs = {}
+    for tag, kwargs in (("with", {"normalised_mask_db": db.filename}),
+                        ("without", {})):
+        outdir = tmp_path / tag
+        outdir.mkdir()
+        runner = Runner(processes=[
+            resolve("AssignLevel1Data"),
+            resolve("MeasureSystemTemperature"),
+            resolve("Level1Averaging", frequency_bin_size=8, **kwargs),
+        ], output_dir=str(outdir))
+        (lvl2,) = runner.run_tod([str(path)])
+        outs[tag] = np.asarray(lvl2["frequency_binned/tod"])
+    # feed 0 fully masked -> zero-weight bins average to 0; feed 1 intact
+    assert np.allclose(outs["with"][0], 0.0)
+    assert not np.allclose(outs["with"][1], 0.0)
+    np.testing.assert_allclose(outs["with"][1], outs["without"][1])
+
+
+def test_shipped_configs_run_verbatim(tmp_path, monkeypatch):
+    """VERDICT r4 #6: the shipped examples/configs/ pair must drive the
+    full chain against a synthetic field out of the box — generate with
+    make_field, reduce with configuration.toml, map with parameters.ini,
+    all consumed VERBATIM from the repo."""
+    import glob
+
+    from comapreduce_tpu.cli import run_average, run_destriper
+    from comapreduce_tpu.mapmaking.filelist import write_filelist
+    from comapreduce_tpu.mapmaking.fits_io import read_fits_image
+    from comapreduce_tpu.simulations import make_field
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    toml_cfg = os.path.join(repo, "examples", "configs",
+                            "configuration.toml")
+    ini_cfg = os.path.join(repo, "examples", "configs", "parameters.ini")
+    assert os.path.exists(toml_cfg) and os.path.exists(ini_cfg)
+
+    monkeypatch.chdir(tmp_path)          # configs use cwd-relative paths
+    assert make_field.main(["2", "77"]) == 0
+    assert os.path.exists("filelist.txt")
+    assert run_average.main([toml_cfg]) == 0
+    l2 = sorted(glob.glob("level2/Level2_*.hd5"))
+    assert len(l2) == 2
+    write_filelist("l2list.txt", l2)
+    assert run_destriper.main([ini_cfg]) == 0
+    for band in range(4):
+        path = f"maps/field_band{band}.fits"
+        assert os.path.exists(path), path
+        by_name = {n: d for n, h, d in read_fits_image(path)}
+        assert by_name["HITS"].sum() > 0
+
+
+def test_tod_variant_validation(plain_level2):
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    tmp, l2 = plain_level2
+    wcs = WCS.from_field((170.0, 52.0), (1 / 30, 1 / 30), (240, 240))
+    with pytest.raises(ValueError, match="tod_variant"):
+        read_comap_data(l2, band=0, wcs=wcs, tod_variant="bogus")
