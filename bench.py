@@ -113,13 +113,21 @@ def _insort_medfilt(x, window):
 
 
 def reference_unit_seconds(L: int, window: int, B: int = 4,
-                           C: int = 1024, seed: int = 0) -> float:
+                           C: int = 1024, seed: int = 0,
+                           calibrator: bool = False) -> float:
     """Wall seconds for ONE (feed, scan) of the reference hot chain.
 
     Mirrors the per-scan body of ``average_tod`` (``Level1Averaging.py:
     792-872``) step by step in f64 numpy/scipy, calling the reference's own
     compiled median filter. Run this single-threaded (see
     ``measure_baseline``).
+
+    ``calibrator=True`` mirrors the reference's ``use_gain_filter=False``
+    calibrator path instead (TauA/CasA/CygA/Jupiter,
+    ``COMAPData.py:255-258`` / ``Level1Averaging.py:826-831``): the
+    median-filter regression and the scipy-cg gain solve are SKIPPED and
+    a per-channel median baseline is removed — the conservative
+    denominator for BASELINE configs 1/2.
     """
     from scipy.sparse.linalg import LinearOperator, cg
 
@@ -153,58 +161,67 @@ def reference_unit_seconds(L: int, window: int, B: int = 4,
     rms = np.nanstd(diff, axis=-1) / np.sqrt(2) * np.sqrt(
         (2e9 / 1024.0) * (1 / 50.0))
     clean = clean / rms[..., None]
-    # median_filter (:681-708): band mean -> 3x reflect pad -> C++ filter
-    # -> per-channel affine regression
-    filt = np.zeros((B, C, L))
-    index = np.arange(1024, dtype=int)[10:-10]
-    index = index[(index < 512 - 5) | (index > 512 + 5)]
-    index = index[index < C]
-    for ib in range(B):
-        masked = clean[ib, index, :]
-        mean_tod = np.nanmean(masked, axis=0)
-        pad = np.concatenate([mean_tod[::-1], mean_tod, mean_tod[::-1]])
-        med = medfilt(pad, window)[L:2 * L]
-        A2 = np.ones((L, 2))
-        A2[:, 1] = med
-        x = np.linalg.solve(A2.T @ A2, A2.T @ masked.T)
-        filt[ib, index] = masked - (A2 @ x).T
-    # gain_subtraction (:710, GainSubtraction.py:144-209): band-mean PS
-    # prerequisite + scipy cg over the flattened (L * B*C) f64 vector
-    for ib in range(B):
-        _ = np.abs(np.fft.fft(np.nanmean(filt[ib], axis=0))) ** 2
-    templates = np.ones((B, C, 3))
-    v = np.linspace(-1, 1, B * C).reshape((B, C))
-    templates[..., 0] = 1.0 / tsys
-    templates[..., 1] = v / tsys
-    templates[:, :20, :] = 0
-    templates[:, -20:, :] = 0
     mid = C // 2
-    templates[:, mid - 5:mid + 5, :] = 0
-    d = filt.copy()
-    d[:, :20, :] = 0
-    d[:, -20:, :] = 0
-    d[:, mid - 5:mid + 5, :] = 0
-    tmpl = templates.reshape(B * C, 3)
-    dflat = d.reshape(B * C, L).T.flatten()
+    if calibrator:
+        # calibrator path (use_gain_filter=False): per-channel median
+        # baseline instead of the filter+gain solve
+        filt = clean - np.median(clean, axis=-1, keepdims=True)
+        dG = np.zeros(L)
+    else:
+        # median_filter (:681-708): band mean -> 3x reflect pad -> C++
+        # filter -> per-channel affine regression
+        filt = np.zeros((B, C, L))
+        index = np.arange(1024, dtype=int)[10:-10]
+        index = index[(index < 512 - 5) | (index > 512 + 5)]
+        index = index[index < C]
+        for ib in range(B):
+            masked = clean[ib, index, :]
+            mean_tod = np.nanmean(masked, axis=0)
+            pad = np.concatenate([mean_tod[::-1], mean_tod,
+                                  mean_tod[::-1]])
+            med = medfilt(pad, window)[L:2 * L]
+            A2 = np.ones((L, 2))
+            A2[:, 1] = med
+            x = np.linalg.solve(A2.T @ A2, A2.T @ masked.T)
+            filt[ib, index] = masked - (A2 @ x).T
+        # gain_subtraction (:710, GainSubtraction.py:144-209): band-mean
+        # PS prerequisite + scipy cg over the flattened (time*4096)
+        # f64 vector
+        for ib in range(B):
+            _ = np.abs(np.fft.fft(np.nanmean(filt[ib], axis=0))) ** 2
+        templates = np.ones((B, C, 3))
+        v = np.linspace(-1, 1, B * C).reshape((B, C))
+        templates[..., 0] = 1.0 / tsys
+        templates[..., 1] = v / tsys
+        templates[:, :20, :] = 0
+        templates[:, -20:, :] = 0
+        templates[:, mid - 5:mid + 5, :] = 0
+        d = filt.copy()
+        d[:, :20, :] = 0
+        d[:, -20:, :] = 0
+        d[:, mid - 5:mid + 5, :] = 0
+        tmpl = templates.reshape(B * C, 3)
+        dflat = d.reshape(B * C, L).T.flatten()
 
-    def z_op(dd, tm):
-        data = dd.reshape((L, tm.shape[0])).T
-        TT = np.linalg.inv(tm.T @ tm)
-        d_sub = tm @ (TT @ (tm.T @ data))
-        return dd - d_sub.T.flatten()
+        def z_op(dd, tm):
+            data = dd.reshape((L, tm.shape[0])).T
+            TT = np.linalg.inv(tm.T @ tm)
+            d_sub = tm @ (TT @ (tm.T @ data))
+            return dd - d_sub.T.flatten()
 
-    def p_op(g, tm):
-        return np.repeat(g, tm.size) * np.tile(tm, g.size)
+        def p_op(g, tm):
+            return np.repeat(g, tm.size) * np.tile(tm, g.size)
 
-    def pt_op(dd, tm):
-        return np.sum(dd.reshape((L, tm.size)) * tm[None, :], axis=1)
+        def pt_op(dd, tm):
+            return np.sum(dd.reshape((L, tm.size)) * tm[None, :], axis=1)
 
-    def matvec(g):
-        return pt_op(z_op(p_op(g, tmpl[:, 2]), tmpl[:, :2]), tmpl[:, 2])
+        def matvec(g):
+            return pt_op(z_op(p_op(g, tmpl[:, 2]), tmpl[:, :2]),
+                         tmpl[:, 2])
 
-    Aop = LinearOperator((L, L), matvec=matvec, dtype=np.float64)
-    b = pt_op(z_op(dflat, tmpl[:, :2]), tmpl[:, 2])
-    dG, _info = cg(Aop, b)
+        Aop = LinearOperator((L, L), matvec=matvec, dtype=np.float64)
+        b = pt_op(z_op(dflat, tmpl[:, :2]), tmpl[:, 2])
+        dG, _info = cg(Aop, b)
     # weights + residual + band averages + auto-rms weights (:843-867)
     weights = 1.0 / tsys ** 2
     weights[:, :10] = 0
@@ -226,7 +243,9 @@ N_BASELINE_REPS = 2   # unit reps; the minimum is the denominator
 
 
 def measure_baseline(L: int, window: int,
-                     n_rep: int = N_BASELINE_REPS) -> float:
+                     n_rep: int = N_BASELINE_REPS,
+                     calibrator: bool = False,
+                     B: int = 4, C: int = 1024) -> float:
     """Single-threaded wall seconds of one reference (feed, scan) unit.
 
     Spawns a subprocess with BLAS/OpenMP pinned to one thread — the
@@ -252,7 +271,8 @@ def measure_baseline(L: int, window: int,
             "try: os.sched_setaffinity(0, {0})\n"
             "except (AttributeError, OSError): pass\n"
             "import bench\n"
-            f"print(bench.reference_unit_seconds({L}, {window}))")
+            f"print(bench.reference_unit_seconds({L}, {window}, "
+            f"B={B}, C={C}, calibrator={calibrator}))")
     units = []
     for rep in range(max(int(n_rep), 1)):
         out = subprocess.run(
@@ -488,6 +508,386 @@ def main():
     line["detail"]["map_hit_fraction"] = round(float((hits > 0).mean()), 3)
     print(json.dumps(line))
 
+    # relay-independent artifacts for the benched tree (VERDICT r4 #1b):
+    # op table + compiled-HLO fingerprint, written AFTER the result line
+    # (stderr only) so the driver's one-JSON-line contract holds
+    N_flat = F * B * T + n_pad
+
+    def _ev_run():
+        r = run_pipeline()
+        jax.block_until_ready(r.destriped_map)
+
+    sds = jax.ShapeDtypeStruct((N_flat,), jnp.float32)
+    try:
+        compiled = jitted_destripe.lower(sds, sds).compile()
+    except Exception:   # noqa: BLE001 — evidence is best-effort
+        compiled = None
+    write_evidence("config35", _ev_run, compiled=compiled,
+                   extra=line["detail"])
+
+
+# --------------------------------------------------------------------------
+# Relay-independent evidence: every successful bench leaves artifacts
+# --------------------------------------------------------------------------
+
+def write_evidence(tag: str, run_once, compiled=None, extra=None) -> str:
+    """Record op-level evidence for a successful bench run (VERDICT r4
+    #1b): one extra profiled repetition -> xprof ``hlo_stats`` top ops,
+    plus the compiled program's HLO sha256 fingerprint and XLA cost
+    analysis. Written to ``<BENCH_EVIDENCE_DIR or repo>/evidence/
+    bench_<tag>_<platform>.json`` so a later relay outage leaves
+    artifacts for the benched tree, not prose.
+
+    ``compiled`` may be the compiled program OR a zero-arg callable
+    returning it — callers pass a callable so the (relay-sensitive) AOT
+    compile runs inside this guard, after the skip check, and can never
+    turn an already-printed successful measurement into a failure.
+    ``BENCH_EVIDENCE=0`` skips. Returns the path ('' when skipped)."""
+    if os.environ.get("BENCH_EVIDENCE", "1") == "0":
+        return ""
+    import glob
+    import hashlib
+    import tempfile
+
+    import jax
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out_root = os.environ.get("BENCH_EVIDENCE_DIR", "") or repo
+    platform = jax.devices()[0].platform
+    rec: dict = {"tag": tag, "platform": platform,
+                 "jax": jax.__version__}
+    try:
+        rev = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
+                             capture_output=True, text=True)
+        rec["git_rev"] = rev.stdout.strip()
+    except OSError:
+        rec["git_rev"] = ""
+    if compiled is not None:
+        try:
+            if callable(compiled):
+                compiled = compiled()
+            txt = compiled.as_text()
+            rec["hlo_sha256"] = hashlib.sha256(txt.encode()).hexdigest()
+            rec["hlo_bytes"] = len(txt)
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            rec["cost_analysis"] = {k: float(v) for k, v in
+                                    sorted(dict(cost).items())[:40]}
+        except Exception as exc:   # noqa: BLE001 — evidence is best-effort
+            rec["compiled_error"] = repr(exc)
+    prof_dir = tempfile.mkdtemp(prefix=f"bench_ev_{tag}_")
+    try:
+        with jax.profiler.trace(prof_dir):
+            run_once()
+        planes = glob.glob(prof_dir + "/**/*.xplane.pb", recursive=True)
+        from xprof.convert import raw_to_tool_data as rtd
+
+        data, _ = rtd.xspace_to_tool_data(planes, "hlo_stats", {})
+        table = json.loads(data) if isinstance(data, (str, bytes)) else data
+        rows = [r for r in table if isinstance(r, (list, dict))]
+        # keep the header + top rows; drop 'while' rows (double counts)
+        if rows and isinstance(rows[0], list):
+            hdr, body = rows[0], rows[1:]
+            cat = hdr.index("HLO Category") if "HLO Category" in hdr else None
+            if cat is not None:
+                body = [r for r in body if r[cat] != "while"]
+            rec["hlo_stats"] = [hdr] + body[:60]
+        else:
+            rec["hlo_stats"] = rows[:60]
+    except Exception as exc:   # noqa: BLE001
+        rec["profile_error"] = repr(exc)
+    if extra:
+        rec["detail"] = extra
+    os.makedirs(os.path.join(out_root, "evidence"), exist_ok=True)
+    path = os.path.join(out_root, "evidence",
+                        f"bench_{tag}_{platform}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(f"bench: evidence -> {path}", file=sys.stderr)
+    return path
+
+
+# --------------------------------------------------------------------------
+# BASELINE.md configs 1 / 2 / 4 (VERDICT r4 #7)
+# --------------------------------------------------------------------------
+
+def bench_config1():
+    """Config 1: single TauA calibrator scan, 1 feed, 1 band, NumPy
+    backend — the f64 host oracle against the reference's own
+    single-core calibrator chain (both single-threaded on this host)."""
+    from comapreduce_tpu.backends.numpy_ops import reduce_feed_scans_np
+    from comapreduce_tpu.ops.reduce import ReduceConfig, scan_starts_lengths
+
+    small = os.environ.get("BENCH_SMALL", "") == "1"
+    B, C = 1, (64 if small else 1024)
+    scan_samples, n_scans, gap = (1000 if small else 6000), 4, 64
+    edges, t = [], gap
+    for _ in range(n_scans):
+        edges.append((t, t + scan_samples))
+        t += scan_samples + gap
+    T = t
+    edges = np.asarray(edges, np.int64)
+    rng = np.random.default_rng(11)
+    tod = 1e6 * 45.0 * (1.0 + 0.01 * rng.normal(size=(B, C, T)))
+    mask = np.zeros((B, C, T), np.float64)
+    for s, e in edges:
+        mask[..., s:e] = 1.0
+    airmass = np.full(T, 1.3)
+    tsys = 45.0 * (1.0 + 0.2 * rng.random((B, C)))
+    gain = 1e6 * np.ones((B, C))
+    freq = np.broadcast_to(np.linspace(-0.1, 0.1, C), (B, C))
+    cfg = ReduceConfig(C, medfilt_window=501, is_calibrator=True)
+
+    t0 = time.perf_counter()
+    out = reduce_feed_scans_np(tod, mask, airmass, edges, tsys, gain,
+                               freq, cfg)
+    wall = time.perf_counter() - t0
+    assert np.isfinite(out["tod"]).any()
+
+    _, _, L = scan_starts_lengths(edges)
+    env_unit = os.environ.get("BENCH_BASELINE_S", "")
+    # the reference unit must match the workload: ONE band, same C
+    unit_s = (float(env_unit) if env_unit else
+              measure_baseline(L=int(L), window=501, calibrator=True,
+                               B=B, C=C))
+    # single feed: the reference cannot spread one feed's scans across
+    # ranks inside average_tod (serial per-feed loop) -> 1 rank
+    baseline_wall = unit_s * n_scans
+    line = {
+        "metric": "calibrator_numpy_samples_per_sec",
+        "value": round(B * C * T / wall, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(baseline_wall / wall, 2),
+        "detail": {"config": 1, "shape": [1, B, C, T],
+                   "wall_s": round(wall, 3),
+                   "baseline_unit_s": round(unit_s, 3),
+                   "baseline_wall_s_1rank": round(baseline_wall, 2),
+                   "backend": "numpy(f64, host)"},
+    }
+    print(json.dumps(line))
+    return 0
+
+
+def bench_config2():
+    """Config 2: full 19-feed TauA scan, all 4 bands, gain+bandpass
+    chain only (no destriper) on device — calibrator reduction path."""
+    _probe_device()
+    import jax
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
+                                            scan_starts_lengths)
+    from comapreduce_tpu.ops.vane import _event_kernel
+
+    small = os.environ.get("BENCH_SMALL", "") == "1"
+    if small:
+        F, B, C, scan_samples, n_scans = 2, 2, 64, 1000, 2
+        vane_samples, scan_batch = 128, None
+    else:
+        F, B, C, scan_samples, n_scans = 19, 4, 1024, 6000, 8
+        vane_samples, scan_batch = 256, 2
+    gap = 64
+    edges, t = [], gap
+    for _ in range(n_scans):
+        edges.append((t, t + scan_samples))
+        t += scan_samples + gap
+    T = t
+    edges = np.asarray(edges, np.int64)
+    scan_mask = np.zeros(T, np.float32)
+    for s, e in edges:
+        scan_mask[s:e] = 1.0
+    starts, lengths, L = scan_starts_lengths(edges)
+    starts_j = jnp.asarray(starts, jnp.int32)
+    lengths_j = jnp.asarray(lengths, jnp.int32)
+    cfg = ReduceConfig(C, medfilt_window=501, is_calibrator=True,
+                       scan_batch=scan_batch)
+    freq_j = jnp.asarray(
+        np.broadcast_to(np.linspace(-0.1, 0.1, C), (B, C)), jnp.float32)
+    mask_j = jnp.asarray(scan_mask)
+
+    def feed_step(key):
+        k = jax.random.split(key, 4)
+        gain = 1e6 * (1.0 + 0.1 * jax.random.normal(k[0], (B, C)))
+        tsys = 45.0 * (1.0 + 0.2 * jax.random.uniform(k[1], (B, C)))
+        tod = gain[..., None] * tsys[..., None] * (
+            1.0 + 0.01 * jax.random.normal(k[2], (B, C, T)))
+        vane_step = jnp.where(jnp.arange(vane_samples) < vane_samples // 2,
+                              290.0, 0.0)
+        vane_tod = gain[..., None] * (tsys[..., None] + vane_step) * (
+            1.0 + 1e-3 * jax.random.normal(k[3], (B, C, vane_samples)))
+        airmass = jnp.full((T,), 1.2, jnp.float32)
+        tsys_cal, gain_cal = _event_kernel(vane_tod[None],
+                                           jnp.float32(290.0))
+        red = reduce_feed_scans(tod, mask_j, airmass, starts_j, lengths_j,
+                                tsys_cal[0], gain_cal[0], freq_j,
+                                cfg=cfg, n_scans=len(starts), L=L)
+        return red["tod"], red["weights"]
+
+    @jax.jit
+    def all_feeds(keys):
+        return jax.lax.map(feed_step, keys)
+
+    def run_once():
+        keys = jax.random.split(jax.random.key(5, impl="rbg"), F)
+        tods, weis = all_feeds(keys)
+        # force a host fetch: block_until_ready is not reliable through
+        # the axon tunnel (memory: tpu-bench-timing-pitfalls)
+        return float(jnp.sum(tods)) + float(jnp.sum(weis))
+
+    run_once()                                  # compile + warm
+    best = float("inf")
+    for _ in range(1 if small else 2):
+        t0 = time.perf_counter()
+        run_once()
+        best = min(best, time.perf_counter() - t0)
+
+    env_unit = os.environ.get("BENCH_BASELINE_S", "")
+    unit_s = (float(env_unit) if env_unit else
+              measure_baseline(L=int(L), window=501, calibrator=True,
+                               B=B, C=C))
+    baseline_wall = unit_s * F * n_scans / REFERENCE_RANKS
+    line = {
+        "metric": "calibrator_chain_samples_per_sec",
+        "value": round(F * B * C * T / best, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(baseline_wall / best, 2),
+        "detail": {"config": 2, "shape": [F, B, C, T],
+                   "wall_s": round(best, 4),
+                   "baseline_unit_s": round(unit_s, 3),
+                   "baseline_wall_s_16rank": round(baseline_wall, 2),
+                   "device": str(jax.devices()[0].platform)},
+    }
+    print(json.dumps(line))
+    write_evidence("config2", run_once,
+                   compiled=lambda: all_feeds.lower(jax.random.split(
+                       jax.random.key(5, impl="rbg"), F)).compile(),
+                   extra=line["detail"])
+    return 0
+
+
+def bench_config4():
+    """Config 4: ~50-obsid filelist -> naive binned HEALPix map (no
+    destripe) — the foreground-survey co-add. ang2pix + weighted
+    segment-sum binning on device, obs streamed through ``lax.map``;
+    baseline: the same binning as single-core ``np.add.at`` scaled to
+    16 ranks (conservative: the reference's Cython ``binFuncs`` also
+    pays its coordinate conversion, excluded here)."""
+    _probe_device()
+    import jax
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking import healpix as hp
+
+    small = os.environ.get("BENCH_SMALL", "") == "1"
+    if small:
+        n_obs, F, T, nside = 4, 2, 4000, 256
+    else:
+        n_obs, F, T, nside = 50, 19, 54_000, 1024
+    npix = 12 * nside * nside
+
+    # per-obs pointing: drifting raster in a ~10x10 deg patch (ra0
+    # varies per obs so the co-add covers a band of sky like the fg
+    # survey). Pixels come from the host HEALPix path (f64,
+    # healpy-exact) as in the reference's healpy+binFuncs flow; the
+    # device does the weighted co-add binning.
+    rng = np.random.default_rng(9)
+    t_h = np.arange(T, dtype=np.float64)
+    sweep = 10.0 * np.abs(((t_h / 500.0) % 2.0) - 1.0)
+    pix_all = np.empty((n_obs, F * T), np.int32)
+    for i in range(n_obs):
+        ra0 = 40.0 + 80.0 * rng.random()
+        ra = ra0 + sweep[None, :] + 0.3 * np.arange(F)[:, None]
+        dec = 30.0 + (t_h / T * 8.0)[None, :] \
+            + 0.2 * np.arange(F)[:, None]
+        pix_all[i] = np.asarray(hp.ang2pix_lonlat(
+            nside, ra.reshape(-1), dec.reshape(-1)), np.int32)
+    tod_all = (1.0 + 0.01 * rng.standard_normal(
+        (n_obs, F * T))).astype(np.float32)
+
+    def bin_obs(carry, x):
+        sig, wei = carry
+        pix, tod = x
+        sig = sig.at[pix].add(tod)
+        wei = wei.at[pix].add(1.0)
+        return (sig, wei), 0
+
+    @jax.jit
+    def coadd(pix, tod):
+        z = jnp.zeros(npix, jnp.float32)
+        # unit weights: the hit map IS the weight map (no third scatter
+        # — the host baseline pays exactly the same two passes)
+        (sig, wei), _ = jax.lax.scan(bin_obs, (z, z), (pix, tod))
+        return sig, wei
+
+    pix_j = jnp.asarray(pix_all)
+    tod_j = jnp.asarray(tod_all)
+
+    def run_once():
+        sig, wei = coadd(pix_j, tod_j)
+        return float(jnp.sum(wei))   # host fetch forces execution
+
+    run_once()
+    best = float("inf")
+    for _ in range(1 if small else 2):
+        t0 = time.perf_counter()
+        run_once()
+        best = min(best, time.perf_counter() - t0)
+
+    n_samples = n_obs * F * T
+    # single-core np.add.at binning of the SAME pointing and values the
+    # device binned (clustered raster, not random indices — random pixels
+    # would cache-miss their way to an inflated denominator), CPU-pinned,
+    # min of 2 reps (the measure_baseline policy)
+    try:
+        prev_aff = os.sched_getaffinity(0)
+        os.sched_setaffinity(0, {next(iter(prev_aff))})
+    except (AttributeError, OSError):
+        prev_aff = None
+    unit = float("inf")
+    try:
+        for _ in range(2):
+            sig_h = np.zeros(npix)
+            wei_h = np.zeros(npix)
+            t0 = time.perf_counter()
+            for i in range(n_obs):
+                np.add.at(sig_h, pix_all[i], tod_all[i])
+                np.add.at(wei_h, pix_all[i], 1.0)
+            unit = min(unit, time.perf_counter() - t0)
+    finally:
+        if prev_aff is not None:
+            try:
+                os.sched_setaffinity(0, prev_aff)
+            except OSError:
+                pass
+    baseline_wall = unit / REFERENCE_RANKS
+    line = {
+        "metric": "naive_healpix_samples_per_sec",
+        "value": round(n_samples / best, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(baseline_wall / best, 2),
+        "detail": {"config": 4, "n_obs": n_obs, "nside": nside,
+                   "n_samples": n_samples, "wall_s": round(best, 4),
+                   "baseline_wall_s_16rank": round(baseline_wall, 3),
+                   "baseline_policy": "np.add.at same pointing, "
+                                      "cpu-pinned min-of-2, /16 ranks, "
+                                      "pixels precomputed both sides",
+                   "device": str(jax.devices()[0].platform)},
+    }
+    print(json.dumps(line))
+    write_evidence("config4", run_once,
+                   compiled=lambda: coadd.lower(pix_j, tod_j).compile(),
+                   extra=line["detail"])
+    return 0
+
+
+_CONFIGS = {"1": bench_config1, "2": bench_config2, "4": bench_config4}
+
 
 if __name__ == "__main__":
-    sys.exit(main())
+    argv = sys.argv[1:]
+    cfg = os.environ.get("BENCH_CONFIG", "")
+    if len(argv) >= 2 and argv[0] == "--config":
+        cfg = argv[1]
+    # default (the driver's contract): configs 3+5, the flagship chain
+    sys.exit(_CONFIGS.get(cfg, main)())

@@ -19,13 +19,35 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The exec lives in pytest_configure (below) so capture can be suspended
 # first — execve from module import time would inherit pytest's captured
 # stdout/stderr fds and the re-exec'd run's output would vanish.
-_NEEDS_REEXEC = (any(k.startswith("PALLAS_AXON") for k in os.environ)
+# COMAP_ONCHIP=1 selects the on-chip tier: keep the axon registration
+# (tests run on the real TPU) and do NOT force the CPU platform. Use as
+#   COMAP_ONCHIP=1 python -m pytest tests -m onchip
+# only when the relay is verified healthy (bench.py's probe / SKILL.md).
+_ONCHIP = os.environ.get("COMAP_ONCHIP", "") == "1"
+
+_NEEDS_REEXEC = (not _ONCHIP
+                 and any(k.startswith("PALLAS_AXON") for k in os.environ)
                  and os.environ.get("_COMAP_TESTS_REEXEC") != "1")
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "onchip: runs on the real TPU chip (skipped unless "
+        "COMAP_ONCHIP=1 and an accelerator is present)")
     if not _NEEDS_REEXEC:
         return
+
+
+def pytest_ignore_collect(collection_path, config):
+    """COMAP_ONCHIP=1 hard-selects the on-chip tier: collecting the CPU
+    suite would import every heavy test module (and, forgotten
+    ``-m onchip``, push hundreds of jits through the wedge-prone relay
+    and fail the virtual-mesh tests on a 1-chip device). Only
+    ``test_onchip.py`` is collected at all in this mode."""
+    if _ONCHIP and collection_path.name.startswith("test_") \
+            and collection_path.name != "test_onchip.py":
+        return True
+    return None
     capman = config.pluginmanager.get_plugin("capturemanager")
     if capman is not None:
         capman.suspend_global_capture(in_=True)
@@ -40,13 +62,15 @@ def pytest_configure(config):
 
 # Force CPU with a virtual 8-device platform: multi-chip TPU hardware is not
 # available in CI; sharding/collective tests run on a virtual CPU mesh
-# instead (same XLA partitioner, same SPMD semantics).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# instead (same XLA partitioner, same SPMD semantics). The on-chip tier
+# keeps whatever platform the ambient env provides (the real chip).
+if not _ONCHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 sys.path.insert(0, _REPO)
 

@@ -34,3 +34,38 @@ def test_bench_small_emits_json_line():
     d = rec["detail"]
     assert d["cg_iters"] > 0 and d["wall_s"] > 0
     assert 0 < d["map_hit_fraction"] <= 1
+
+
+def test_bench_config_modes_emit_json(tmp_path):
+    """BASELINE configs 1/2/4 (--config N) each print one JSON line;
+    the device configs also leave an evidence artifact (the
+    relay-independent record, VERDICT r4 #1b/#7) — routed to tmp_path
+    via BENCH_EVIDENCE_DIR so test runs never clobber real-chip
+    artifacts in the repo's evidence/."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
+    env.update(BENCH_SMALL="1", BENCH_BASELINE_S="1.0",
+               BENCH_NO_PROBE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo, BENCH_EVIDENCE_DIR=str(tmp_path))
+    metrics = {"1": "calibrator_numpy_samples_per_sec",
+               "2": "calibrator_chain_samples_per_sec",
+               "4": "naive_healpix_samples_per_sec"}
+    for cfg, metric in metrics.items():
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--config", cfg],
+            capture_output=True, text=True, env=env, timeout=420,
+            cwd=repo)
+        assert out.returncode == 0, (cfg, out.stderr[-2000:])
+        lines = [ln for ln in out.stdout.splitlines()
+                 if ln.startswith("{")]
+        assert len(lines) == 1, (cfg, out.stdout)
+        rec = json.loads(lines[0])
+        assert rec["metric"] == metric
+        assert rec["value"] > 0 and np.isfinite(rec["value"])
+        assert rec["detail"]["config"] == int(cfg)
+    for tag in ("config2", "config4"):
+        p = tmp_path / "evidence" / f"bench_{tag}_cpu.json"
+        assert p.exists()
+        ev = json.loads(p.read_text())
+        assert ev["hlo_sha256"] and ev["git_rev"]

@@ -1,0 +1,137 @@
+"""On-chip test tier (VERDICT r4 #3): real-TPU parity checks that the
+CPU suite cannot provide — the Mosaic lowering of the Pallas median,
+planned-vs-scatter destriper parity on device, and one fused SPMD step.
+
+Run ONLY when the relay is verified healthy (bench.py's probe or
+/tmp-style tiny-jit probe; killing a hung run mid-compile wedges the
+relay — .claude/skills/verify/SKILL.md)::
+
+    COMAP_ONCHIP=1 python -m pytest tests/test_onchip.py -m onchip -v
+
+Under the normal CPU suite every test here is skipped (the conftest
+scrubs the axon env unless COMAP_ONCHIP=1).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+ONCHIP = os.environ.get("COMAP_ONCHIP", "") == "1"
+
+pytestmark = [
+    pytest.mark.onchip,
+    pytest.mark.skipif(not ONCHIP, reason="on-chip tier: set "
+                       "COMAP_ONCHIP=1 with a healthy relay"),
+]
+
+
+def _platform():
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def test_accelerator_present():
+    assert _platform() in ("tpu", "axon"), (
+        f"on-chip tier running on {_platform()!r} — the accelerator is "
+        "not registered; do not record this run as on-chip evidence")
+
+
+def test_pallas_median_mosaic_parity():
+    """The REAL Mosaic lowering (not interpret mode) must be
+    bit-identical to the interpret path and match jnp.median windows,
+    including NaN-in-window -> NaN (the post-round-3 NaN wrapper has
+    never been exercised by a compiler until this runs)."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.ops.pallas_median import (
+        rolling_median_windows_pallas, pallas_window_ok)
+
+    window, T = 385, 2048
+    assert pallas_window_ok(window)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, T + window - 1)).astype(np.float32)
+    x[1, 500] = np.nan                      # NaN propagation case
+    padded = jnp.asarray(x)
+
+    on_chip = np.asarray(rolling_median_windows_pallas(padded, window,
+                                                       chunk=256))
+    interp = np.asarray(rolling_median_windows_pallas(padded, window,
+                                                      chunk=256,
+                                                      interpret=True))
+    np.testing.assert_array_equal(on_chip, interp)
+
+    # oracle: jnp.median over explicit windows
+    wins = np.lib.stride_tricks.sliding_window_view(x, window, axis=-1)
+    oracle = np.median(wins, axis=-1).astype(np.float32)
+    np.testing.assert_array_equal(on_chip[..., :oracle.shape[-1]], oracle)
+
+
+def test_rolling_median_dispatch_parity():
+    """The public rolling_median (platform_dependent dispatch: tpu/axon
+    -> Mosaic, default -> XLA) must match the numpy oracle on device —
+    whichever platform key the axon plugin lowers under."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.backends.numpy_ops import rolling_median_np
+    from comapreduce_tpu.ops.median_filter import rolling_median
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 4096)).astype(np.float32)
+    window = 385
+    got = np.asarray(rolling_median(jnp.asarray(x), window))
+    want = rolling_median_np(x.astype(np.float64), window,
+                             pad_mode="edge").astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_planned_vs_scatter_destriper_on_device():
+    """destripe (scatter oracle) vs destripe_planned (pair-space MXU
+    path) on the chip itself; maps compared mean-removed over hit
+    pixels (the CG null space lands at path-dependent representatives)."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import (destripe,
+                                                     destripe_planned)
+    from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+
+    rng = np.random.default_rng(2)
+    N, npix, off = 20_000, 400, 50
+    pix = rng.integers(0, npix, N)
+    tod = (rng.normal(size=N)
+           + np.repeat(rng.normal(size=N // off), off)).astype(np.float32)
+    w = np.ones(N, np.float32)
+
+    r_scatter = destripe(jnp.asarray(tod), jnp.asarray(pix),
+                         jnp.asarray(w), npix, offset_length=off,
+                         n_iter=60, threshold=1e-7)
+    plan = build_pointing_plan(pix, npix, off)
+    r_planned = destripe_planned(jnp.asarray(tod), jnp.asarray(w),
+                                 plan=plan, n_iter=60, threshold=1e-7)
+    hit = np.asarray(r_scatter.hit_map) > 0
+    a = np.asarray(r_scatter.destriped_map)[hit]
+    b = np.asarray(r_planned.destriped_map)[hit]
+    np.testing.assert_allclose(a - a.mean(), b - b.mean(), atol=2e-3)
+
+
+def test_fused_spmd_step_on_chip():
+    """One fused ObservationStep (vane -> reduce -> destripe under
+    shard_map) compiled and executed on the real chip (1-device mesh:
+    the multi-device layout is covered by the virtual-mesh CI tier and
+    dryrun_multichip)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from comapreduce_tpu.parallel.step import (ObservationStep,
+                                               make_example_inputs)
+
+    rng = np.random.default_rng(3)
+    kwargs, arrays = make_example_inputs(rng)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("feed",))
+    step = ObservationStep(mesh, **kwargs)
+    level2, result = step(**arrays)
+    assert np.isfinite(np.asarray(level2["tod"])).all()
+    hits = np.asarray(result.hit_map)
+    assert hits.sum() > 0
+    assert np.isfinite(np.asarray(result.destriped_map)[hits > 0]).all()
