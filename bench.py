@@ -518,11 +518,13 @@ def main():
         jax.block_until_ready(r.destriped_map)
 
     sds = jax.ShapeDtypeStruct((N_flat,), jnp.float32)
-    try:
-        compiled = jitted_destripe.lower(sds, sds).compile()
-    except Exception:   # noqa: BLE001 — evidence is best-effort
-        compiled = None
-    write_evidence("config35", _ev_run, compiled=compiled,
+    # a thunk, NOT the compiled object: jax Compiled executables are
+    # callable, so write_evidence's callable() dispatch would invoke one
+    # with zero args (the pytree TypeError the round-5 cpu artifact
+    # recorded) — and the AOT lower must run inside its guard anyway
+    write_evidence("config35", _ev_run,
+                   compile_fn=lambda: jitted_destripe.lower(
+                       sds, sds).compile(),
                    extra=line["detail"])
 
 
@@ -530,7 +532,7 @@ def main():
 # Relay-independent evidence: every successful bench leaves artifacts
 # --------------------------------------------------------------------------
 
-def write_evidence(tag: str, run_once, compiled=None, extra=None) -> str:
+def write_evidence(tag: str, run_once, compile_fn=None, extra=None) -> str:
     """Record op-level evidence for a successful bench run (VERDICT r4
     #1b): one extra profiled repetition -> xprof ``hlo_stats`` top ops,
     plus the compiled program's HLO sha256 fingerprint and XLA cost
@@ -538,11 +540,14 @@ def write_evidence(tag: str, run_once, compiled=None, extra=None) -> str:
     bench_<tag>_<platform>.json`` so a later relay outage leaves
     artifacts for the benched tree, not prose.
 
-    ``compiled`` may be the compiled program OR a zero-arg callable
-    returning it — callers pass a callable so the (relay-sensitive) AOT
-    compile runs inside this guard, after the skip check, and can never
-    turn an already-printed successful measurement into a failure.
-    ``BENCH_EVIDENCE=0`` skips. Returns the path ('' when skipped)."""
+    ``compile_fn``: a ZERO-ARG THUNK returning the compiled program —
+    never the compiled object itself (jax ``Compiled`` is callable, so
+    a callable() dispatch would invoke it argless and record a pytree
+    TypeError instead of the fingerprint). The thunk runs inside this
+    guard, after the skip check, so a relay-sensitive AOT compile can
+    never turn an already-printed successful measurement into a
+    failure. ``BENCH_EVIDENCE=0`` skips. Returns the path ('' when
+    skipped)."""
     if os.environ.get("BENCH_EVIDENCE", "1") == "0":
         return ""
     import glob
@@ -562,10 +567,9 @@ def write_evidence(tag: str, run_once, compiled=None, extra=None) -> str:
         rec["git_rev"] = rev.stdout.strip()
     except OSError:
         rec["git_rev"] = ""
-    if compiled is not None:
+    if compile_fn is not None:
         try:
-            if callable(compiled):
-                compiled = compiled()
+            compiled = compile_fn()
             txt = compiled.as_text()
             rec["hlo_sha256"] = hashlib.sha256(txt.encode()).hexdigest()
             rec["hlo_bytes"] = len(txt)
@@ -612,6 +616,28 @@ def write_evidence(tag: str, run_once, compiled=None, extra=None) -> str:
 # BASELINE.md configs 1 / 2 / 4 (VERDICT r4 #7)
 # --------------------------------------------------------------------------
 
+class _pin_one_cpu:
+    """Pin the current process to one CPU for a timed region (the
+    measure_baseline child policy, applied in-process); restores the
+    previous affinity on exit. No-op where unsupported."""
+
+    def __enter__(self):
+        try:
+            self._prev = os.sched_getaffinity(0)
+            os.sched_setaffinity(0, {next(iter(self._prev))})
+        except (AttributeError, OSError):
+            self._prev = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is not None:
+            try:
+                os.sched_setaffinity(0, self._prev)
+            except OSError:
+                pass
+        return False
+
+
 def bench_config1():
     """Config 1: single TauA calibrator scan, 1 feed, 1 band, NumPy
     backend — the f64 host oracle against the reference's own
@@ -639,10 +665,12 @@ def bench_config1():
     freq = np.broadcast_to(np.linspace(-0.1, 0.1, C), (B, C))
     cfg = ReduceConfig(C, medfilt_window=501, is_calibrator=True)
 
-    t0 = time.perf_counter()
-    out = reduce_feed_scans_np(tod, mask, airmass, edges, tsys, gain,
-                               freq, cfg)
-    wall = time.perf_counter() - t0
+    # pin like the baseline child: single core vs single core
+    with _pin_one_cpu():
+        t0 = time.perf_counter()
+        out = reduce_feed_scans_np(tod, mask, airmass, edges, tsys, gain,
+                                   freq, cfg)
+        wall = time.perf_counter() - t0
     assert np.isfinite(out["tod"]).any()
 
     _, _, L = scan_starts_lengths(edges)
@@ -666,6 +694,10 @@ def bench_config1():
                    "backend": "numpy(f64, host)"},
     }
     print(json.dumps(line))
+    # provenance artifact (no jax program: no compile_fn, empty op
+    # table) — "every config leaves an evidence trail" holds for the
+    # host config too
+    write_evidence("config1", lambda: None, extra=line["detail"])
     return 0
 
 
@@ -760,7 +792,7 @@ def bench_config2():
     }
     print(json.dumps(line))
     write_evidence("config2", run_once,
-                   compiled=lambda: all_feeds.lower(jax.random.split(
+                   compile_fn=lambda: all_feeds.lower(jax.random.split(
                        jax.random.key(5, impl="rbg"), F)).compile(),
                    extra=line["detail"])
     return 0
@@ -839,13 +871,8 @@ def bench_config4():
     # device binned (clustered raster, not random indices — random pixels
     # would cache-miss their way to an inflated denominator), CPU-pinned,
     # min of 2 reps (the measure_baseline policy)
-    try:
-        prev_aff = os.sched_getaffinity(0)
-        os.sched_setaffinity(0, {next(iter(prev_aff))})
-    except (AttributeError, OSError):
-        prev_aff = None
     unit = float("inf")
-    try:
+    with _pin_one_cpu():
         for _ in range(2):
             sig_h = np.zeros(npix)
             wei_h = np.zeros(npix)
@@ -854,12 +881,6 @@ def bench_config4():
                 np.add.at(sig_h, pix_all[i], tod_all[i])
                 np.add.at(wei_h, pix_all[i], 1.0)
             unit = min(unit, time.perf_counter() - t0)
-    finally:
-        if prev_aff is not None:
-            try:
-                os.sched_setaffinity(0, prev_aff)
-            except OSError:
-                pass
     baseline_wall = unit / REFERENCE_RANKS
     line = {
         "metric": "naive_healpix_samples_per_sec",
@@ -876,7 +897,7 @@ def bench_config4():
     }
     print(json.dumps(line))
     write_evidence("config4", run_once,
-                   compiled=lambda: coadd.lower(pix_j, tod_j).compile(),
+                   compile_fn=lambda: coadd.lower(pix_j, tod_j).compile(),
                    extra=line["detail"])
     return 0
 

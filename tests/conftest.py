@@ -36,6 +36,17 @@ def pytest_configure(config):
         "COMAP_ONCHIP=1 and an accelerator is present)")
     if not _NEEDS_REEXEC:
         return
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    env = dict(os.environ)
+    env["_COMAP_TESTS_REEXEC"] = "1"
+    # prefix match, not a hardcoded pair: every relay-config var goes
+    for k in [k for k in env if k.startswith("PALLAS_AXON")]:
+        env.pop(k, None)
+    env["PYTHONPATH"] = _REPO  # drop /root/.axon_site
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 
 
 def pytest_ignore_collect(collection_path, config):
@@ -48,17 +59,6 @@ def pytest_ignore_collect(collection_path, config):
             and collection_path.name != "test_onchip.py":
         return True
     return None
-    capman = config.pluginmanager.get_plugin("capturemanager")
-    if capman is not None:
-        capman.suspend_global_capture(in_=True)
-    env = dict(os.environ)
-    env["_COMAP_TESTS_REEXEC"] = "1"
-    # prefix match, not a hardcoded pair: every relay-config var goes
-    for k in [k for k in env if k.startswith("PALLAS_AXON")]:
-        env.pop(k, None)
-    env["PYTHONPATH"] = _REPO  # drop /root/.axon_site
-    os.execve(sys.executable,
-              [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 
 # Force CPU with a virtual 8-device platform: multi-chip TPU hardware is not
 # available in CI; sharding/collective tests run on a virtual CPU mesh

@@ -3,14 +3,17 @@
 Runs, in order, against the real chip:
 
 1. ``bench.py`` (full production-shape benchmark, measured baseline) —
-   the BENCH_r{N} evidence;
-2. a ``COMAP_BIN_BATCH`` sweep of the destriper's one-hot chunk batch
+   the BENCH_r{N} evidence (also writes ``evidence/`` artifacts);
+2. BASELINE.md configs 1/2/4 (``bench.py --config N``);
+3. the on-chip pytest tier (``COMAP_ONCHIP=1 -m onchip``: real-Mosaic
+   Pallas parity, on-device planned-vs-scatter destriper, fused step);
+4. a ``COMAP_BIN_BATCH`` sweep of the destriper's one-hot chunk batch
    ("next lever (c)"), reusing the measured baseline so each point only
    pays the TPU wall time;
-3. a joint multi-RHS vs per-band destriper timing at production pointing
+5. a joint multi-RHS vs per-band destriper timing at production pointing
    (the round-4 multi-RHS lever).
 
-Appends one JSON line per measurement to ``SWEEP_r04.jsonl`` (repo root)
+Appends one JSON line per measurement to ``SWEEP_r05.jsonl`` (repo root)
 so a wedge mid-session loses nothing. Never signals a child process (a
 signal landing mid-remote-compile wedges the relay — see
 .claude/skills/verify/SKILL.md).
@@ -27,7 +30,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "SWEEP_r04.jsonl")
+OUT = os.path.join(REPO, "SWEEP_r05.jsonl")
 
 
 def log_line(obj: dict) -> None:
@@ -37,10 +40,10 @@ def log_line(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def run_bench(env_extra: dict, label: str) -> dict | None:
+def run_bench(env_extra: dict, label: str, argv=()) -> dict | None:
     env = dict(os.environ, **env_extra)
-    proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
-                          capture_output=True, text=True)
+    proc = subprocess.run([sys.executable, "bench.py", *argv], cwd=REPO,
+                          env=env, capture_output=True, text=True)
     if proc.returncode != 0:
         log_line({"kind": "bench-failed", "label": label,
                   "rc": proc.returncode,
@@ -76,6 +79,21 @@ def main() -> int:
         if first is None:
             return 3
         baseline_s = str(first["detail"]["baseline_unit_s"])
+
+    # BASELINE.md configs 1/2/4 (VERDICT r4 #7) — each writes its own
+    # evidence artifact too
+    for cfg in ("1", "2", "4"):
+        run_bench({}, f"config-{cfg}", argv=("--config", cfg))
+
+    # on-chip pytest tier (VERDICT r4 #3): Mosaic Pallas parity,
+    # on-device planned-vs-scatter, fused SPMD step
+    env = dict(os.environ, COMAP_ONCHIP="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_onchip.py",
+         "-m", "onchip", "-q"], cwd=REPO, env=env,
+        capture_output=True, text=True)
+    log_line({"kind": "onchip-tier", "rc": proc.returncode,
+              "tail": proc.stdout.strip()[-300:]})
 
     # lever (c): bin-batch sweep, baseline reused (one ~60 s measurement
     # per session is enough; wall_s is the comparable number)
