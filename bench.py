@@ -532,7 +532,8 @@ def main():
 # Relay-independent evidence: every successful bench leaves artifacts
 # --------------------------------------------------------------------------
 
-def write_evidence(tag: str, run_once, compile_fn=None, extra=None) -> str:
+def write_evidence(tag: str, run_once, compile_fn=None, extra=None,
+                   host_only: bool = False) -> str:
     """Record op-level evidence for a successful bench run (VERDICT r4
     #1b): one extra profiled repetition -> xprof ``hlo_stats`` top ops,
     plus the compiled program's HLO sha256 fingerprint and XLA cost
@@ -546,21 +547,26 @@ def write_evidence(tag: str, run_once, compile_fn=None, extra=None) -> str:
     TypeError instead of the fingerprint). The thunk runs inside this
     guard, after the skip check, so a relay-sensitive AOT compile can
     never turn an already-printed successful measurement into a
-    failure. ``BENCH_EVIDENCE=0`` skips. Returns the path ('' when
-    skipped)."""
+    failure. ``host_only=True`` (config 1) records provenance WITHOUT
+    importing jax at all — the host-only config must stay
+    relay-independent end to end (its dispatch path skips the probe,
+    and ``jax.devices()`` through a wedged relay hangs).
+    ``BENCH_EVIDENCE=0`` skips. Returns the path ('' when skipped)."""
     if os.environ.get("BENCH_EVIDENCE", "1") == "0":
         return ""
     import glob
     import hashlib
     import tempfile
 
-    import jax
-
     repo = os.path.dirname(os.path.abspath(__file__))
     out_root = os.environ.get("BENCH_EVIDENCE_DIR", "") or repo
-    platform = jax.devices()[0].platform
-    rec: dict = {"tag": tag, "platform": platform,
-                 "jax": jax.__version__}
+    if host_only:
+        rec: dict = {"tag": tag, "platform": "host"}
+    else:
+        import jax
+
+        platform = jax.devices()[0].platform
+        rec = {"tag": tag, "platform": platform, "jax": jax.__version__}
     try:
         rev = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
                              capture_output=True, text=True)
@@ -580,32 +586,36 @@ def write_evidence(tag: str, run_once, compile_fn=None, extra=None) -> str:
                                     sorted(dict(cost).items())[:40]}
         except Exception as exc:   # noqa: BLE001 — evidence is best-effort
             rec["compiled_error"] = repr(exc)
-    prof_dir = tempfile.mkdtemp(prefix=f"bench_ev_{tag}_")
-    try:
-        with jax.profiler.trace(prof_dir):
-            run_once()
-        planes = glob.glob(prof_dir + "/**/*.xplane.pb", recursive=True)
-        from xprof.convert import raw_to_tool_data as rtd
+    if not host_only:
+        prof_dir = tempfile.mkdtemp(prefix=f"bench_ev_{tag}_")
+        try:
+            with jax.profiler.trace(prof_dir):
+                run_once()
+            planes = glob.glob(prof_dir + "/**/*.xplane.pb",
+                               recursive=True)
+            from xprof.convert import raw_to_tool_data as rtd
 
-        data, _ = rtd.xspace_to_tool_data(planes, "hlo_stats", {})
-        table = json.loads(data) if isinstance(data, (str, bytes)) else data
-        rows = [r for r in table if isinstance(r, (list, dict))]
-        # keep the header + top rows; drop 'while' rows (double counts)
-        if rows and isinstance(rows[0], list):
-            hdr, body = rows[0], rows[1:]
-            cat = hdr.index("HLO Category") if "HLO Category" in hdr else None
-            if cat is not None:
-                body = [r for r in body if r[cat] != "while"]
-            rec["hlo_stats"] = [hdr] + body[:60]
-        else:
-            rec["hlo_stats"] = rows[:60]
-    except Exception as exc:   # noqa: BLE001
-        rec["profile_error"] = repr(exc)
+            data, _ = rtd.xspace_to_tool_data(planes, "hlo_stats", {})
+            table = (json.loads(data) if isinstance(data, (str, bytes))
+                     else data)
+            rows = [r for r in table if isinstance(r, (list, dict))]
+            # keep the header + top rows; drop 'while' rows (dbl counts)
+            if rows and isinstance(rows[0], list):
+                hdr, body = rows[0], rows[1:]
+                cat = (hdr.index("HLO Category")
+                       if "HLO Category" in hdr else None)
+                if cat is not None:
+                    body = [r for r in body if r[cat] != "while"]
+                rec["hlo_stats"] = [hdr] + body[:60]
+            else:
+                rec["hlo_stats"] = rows[:60]
+        except Exception as exc:   # noqa: BLE001
+            rec["profile_error"] = repr(exc)
     if extra:
         rec["detail"] = extra
     os.makedirs(os.path.join(out_root, "evidence"), exist_ok=True)
     path = os.path.join(out_root, "evidence",
-                        f"bench_{tag}_{platform}.json")
+                        f"bench_{tag}_{rec['platform']}.json")
     with open(path, "w") as f:
         json.dump(rec, f, indent=1)
     print(f"bench: evidence -> {path}", file=sys.stderr)
@@ -694,10 +704,11 @@ def bench_config1():
                    "backend": "numpy(f64, host)"},
     }
     print(json.dumps(line))
-    # provenance artifact (no jax program: no compile_fn, empty op
-    # table) — "every config leaves an evidence trail" holds for the
-    # host config too
-    write_evidence("config1", lambda: None, extra=line["detail"])
+    # provenance artifact, host_only: this config must never touch jax
+    # (its dispatch path skips the relay probe, and a wedged relay
+    # hangs jax.devices() — relay-independence is the point)
+    write_evidence("config1", lambda: None, extra=line["detail"],
+                   host_only=True)
     return 0
 
 

@@ -37,7 +37,8 @@ from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
                                                      binned_window_sum)
 
 __all__ = ["DestriperResult", "destripe", "destripe_jit",
-           "destripe_planned", "ground_ids_per_offset"]
+           "destripe_planned", "ground_ids_per_offset",
+           "build_coarse_preconditioner"]
 
 
 class DestriperResult(NamedTuple):
@@ -276,6 +277,85 @@ def ground_ids_per_offset(ground_ids: np.ndarray,
     return blocks[:, 0].astype(np.int32)
 
 
+def build_coarse_preconditioner(pixels, weights, npix: int,
+                                offset_length: int, block: int = 32,
+                                ridge: float = 3e-3,
+                                max_coarse: int = 4096):
+    """Two-level (coarse-offset) preconditioner setup — host side, f64.
+
+    The destriper normal matrix's small eigenvalues live on LONG offset
+    drifts (the large-scale stripes): Jacobi-preconditioned CG stalls on
+    them (measured: residual floor ~3e-5 after 400 iterations on a
+    production-like 1/f problem, while threshold 1e-6 is the production
+    spec). The coarse space P averages ``block`` consecutive offsets and
+    the Galerkin coarse matrix ``A_c = P^T A P`` is assembled EXACTLY
+    from the (pixel, coarse-block) pair aggregates::
+
+        A_c = diag(sum w per block) - Mat^T diag(1/sumw_pix) Mat,
+        Mat[pix, c] = sum of weights of block c's samples in pix
+
+    (same algebra as the fine system, one level up). The
+    global-constant null mode is pinned by a RANK-ONE shift along the
+    constant vector (+ mean(diag) * 11^T / n_c — exact for the null
+    direction, leaves every other eigenvalue untouched) plus a ``ridge``
+    sized for f32: small ridges (<=1e-3) leave A_c^-1 ill-conditioned
+    enough that the f32 preconditioner application can lose
+    positive-definiteness and trip the PCG breakdown guard mid-solve
+    (observed on two raster geometries; rounding-order dependent).
+    3e-3, with the inverse symmetrised after the f32 cast, converged on
+    every geometry tested at a few extra iterations. Inverted once
+    (n_c <= ``max_coarse``; ``block`` doubles as needed). The additive
+    correction ``M^-1 v = v/diag(A) + P A_c^-1 P^T v`` then costs one
+    tiny segment-sum + an (n_c, n_c) matmul per CG iteration — measured
+    to take the same f32 problem from "never converges past 2.7e-5
+    (400 iterations)" to threshold 1e-6 in 181 iterations at these
+    defaults (see ROOFLINE.md round-5 notes).
+
+    Returns ``(grp, ac_inv)`` for :func:`destripe_planned`'s ``coarse``
+    argument: ``grp`` i32[n_off] (offset -> coarse block) and ``ac_inv``
+    f32[n_c, n_c]. Build once per (pointing, weights); bands with their
+    own weights need their own ``ac_inv`` (stack them (nb, n_c, n_c)
+    for a multi-RHS solve).
+    """
+    import scipy.sparse as sp
+
+    pixels = np.asarray(pixels)
+    weights = np.asarray(weights, np.float64)
+    L = int(offset_length)
+    n = (pixels.size // L) * L
+    pixels = pixels[:n]
+    weights = weights[:n].copy()
+    # sentinel/out-of-range pixels carry zero weight (the solver's rule)
+    bad = (pixels < 0) | (pixels >= npix)
+    weights[bad] = 0.0
+    pix = np.clip(pixels, 0, npix - 1).astype(np.int64)
+    n_off = n // L
+    K = max(int(block), 1)
+    while -(-n_off // K) > max_coarse:
+        K *= 2
+    off_id = np.arange(n) // L
+    grp = (np.arange(n_off) // K).astype(np.int32)
+    n_c = int(grp[-1]) + 1 if n_off else 1
+
+    sw_pix = np.bincount(pix, weights=weights, minlength=npix)
+    inv_sw = np.where(sw_pix > 0, 1.0 / np.maximum(sw_pix, 1e-30), 0.0)
+    sw_off = np.bincount(off_id, weights=weights, minlength=n_off)
+    # (pixel, coarse) pair weights in one pass over the samples
+    key = pix * n_c + grp[off_id]
+    uk, inv = np.unique(key, return_inverse=True)
+    mw = np.bincount(inv, weights=weights)
+    mat = sp.coo_matrix((mw, (uk // n_c, uk % n_c)),
+                        shape=(npix, n_c)).tocsr()
+    d_c = np.bincount(grp, weights=sw_off, minlength=n_c)
+    a_c = np.diag(d_c) - (mat.T @ sp.diags(inv_sw) @ mat).toarray()
+    m = max(float(np.mean(np.diag(a_c))), 1e-30)
+    a_c += m / n_c                      # rank-one null shift: m * 11^T/n_c
+    a_c += np.eye(n_c) * ridge * m      # f32 round-off guard
+    inv = np.linalg.inv(a_c)
+    inv = (inv + inv.T) / 2.0           # SPD to the last f32 bit
+    return grp, inv.astype(np.float32)
+
+
 def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      n_iter: int = 100, threshold: float = 1e-6,
                      axis_name: str | tuple | None = None,
@@ -283,7 +363,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      device_arrays: dict | None = None,
                      ground_off: jax.Array | None = None,
                      az: jax.Array | None = None,
-                     n_groups: int = 0) -> DestriperResult:
+                     n_groups: int = 0,
+                     coarse: tuple | None = None) -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -324,8 +405,20 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     ``device_arrays`` overrides ``plan.device()`` — used by the shard_map
     wrapper, which feeds each shard its own index arrays as traced inputs
     (``plan`` then only supplies the shared static geometry).
+
+    ``coarse``: optional ``(grp, ac_inv)`` from
+    :func:`build_coarse_preconditioner` — upgrades the Jacobi
+    preconditioner to the additive two-level one (kills the long-drift
+    modes Jacobi stalls on). ``ac_inv`` may carry a leading band axis
+    matching a multi-RHS ``tod``. Traced inputs, so the memoized
+    compiled program is reused across bands/weights. Not supported
+    under ``axis_name`` (sharded solves keep Jacobi — the coarse blocks
+    would straddle shard boundaries).
     """
     dv = device_arrays if device_arrays is not None else plan.device()
+    if coarse is not None and axis_name is not None:
+        raise ValueError("the two-level preconditioner is not supported "
+                         "under shard_map; use Jacobi (coarse=None)")
     with_ground = ground_off is not None
     if with_ground and tod.ndim != 1:
         raise ValueError("the planned ground solve is single-RHS; "
@@ -457,6 +550,23 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     corr = off_sum(pair_w_off * pair_w_off * gather_m(from_global(inv_sw)))
     inv_diag = _jacobi_inverse(diag - corr, diag)
 
+    if coarse is not None:
+        c_grp, ac_inv = coarse
+        c_grp = jnp.asarray(c_grp, jnp.int32)
+        n_c = ac_inv.shape[-1]
+
+        def apply_precond(v):
+            # additive two-level: Jacobi + coarse-grid correction
+            # (segment-sum to blocks, small dense solve-as-matmul, gather
+            # back — negligible next to the matvec's one-hot binnings)
+            rc = jnp.zeros(v.shape[:-1] + (n_c,),
+                           f32).at[..., c_grp].add(v)
+            cc = jnp.einsum("...ij,...j->...i", ac_inv, rc)
+            return v * inv_diag + jnp.take(cc, c_grp, axis=-1)
+    else:
+        def apply_precond(v):
+            return v * inv_diag
+
     if with_ground:
         # joint [offsets; ground] solve in the same pair space: the
         # per-pair template coefficients are c0 = a + g0, c1 = g1 read
@@ -493,7 +603,7 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
             n_iter, threshold,
             # identity on the ground block, as in the scatter path (see
             # destripe's precond comment)
-            precond=lambda v: (v[0] * inv_diag, v[1]))
+            precond=lambda v: (apply_precond(v[0]), v[1]))
         a, ground = x
         c0 = a + ground[:, 0][grp_off]
         c1 = ground[:, 1][grp_off]
@@ -503,7 +613,7 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         # runs independent CGs in one program
         a, rz, k, b_norm = _cg_loop(
             matvec, b, lambda u, v: _psum(jnp.sum(u * v, axis=-1)),
-            n_iter, threshold, precond=lambda v: v * inv_diag)
+            n_iter, threshold, precond=apply_precond)
         ground = jnp.zeros((0, 2), f32)
         pair_res = pair_wd - pair_w * gather_a(a)
 

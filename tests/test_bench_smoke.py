@@ -65,10 +65,12 @@ def test_bench_config_modes_emit_json(tmp_path):
         assert rec["metric"] == metric
         assert rec["value"] > 0 and np.isfinite(rec["value"])
         assert rec["detail"]["config"] == int(cfg)
-    for tag in ("config1", "config2", "config4"):
-        p = tmp_path / "evidence" / f"bench_{tag}_cpu.json"
+    # config 1 is host_only (never imports jax -> platform "host")
+    for tag, plat in (("config1", "host"), ("config2", "cpu"),
+                      ("config4", "cpu")):
+        p = tmp_path / "evidence" / f"bench_{tag}_{plat}.json"
         assert p.exists()
         ev = json.loads(p.read_text())
         assert ev["git_rev"]
-        if tag != "config1":        # host-only config has no jax program
+        if plat != "host":          # host-only config has no jax program
             assert ev["hlo_sha256"]
