@@ -112,11 +112,12 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                             offset_length: int, n_iter: int,
                             threshold: float, n_bands: int = 0,
-                            n_groups: int = 0):
+                            n_groups: int = 0,
+                            with_coarse: bool = False):
     """Memoized sharded solver (plans + ONE compiled shard_map program
     per pointing — bands share both). ``n_bands > 0`` builds the
     multi-RHS program (all bands in one CG); ``n_groups > 0`` the joint
-    ground program."""
+    ground program; ``with_coarse`` the two-level-preconditioned one."""
     from comapreduce_tpu.mapmaking.pointing_plan import build_sharded_plans
     from comapreduce_tpu.parallel.sharded import (
         make_destripe_sharded_planned)
@@ -128,12 +129,15 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
         run = make_destripe_sharded_planned(mesh, plans, n_iter=n_iter,
                                             threshold=threshold,
                                             n_bands=n_bands,
-                                            n_groups=n_groups)
+                                            n_groups=n_groups,
+                                            with_coarse=with_coarse)
         return run, np.asarray(plans[0].uniq_global)
 
-    return _memoized(f"sharded{n_bands}-g{n_groups}", pixels,
+    return _memoized(f"sharded{n_bands}-g{n_groups}-c{int(with_coarse)}",
+                     pixels,
                      (n_shards, int(npix), int(offset_length), int(n_iter),
-                      float(threshold), int(n_groups)), build)
+                      float(threshold), int(n_groups),
+                      bool(with_coarse)), build)
 
 
 def _shard_quantum(mesh, offset_length: int) -> int:
@@ -205,10 +209,11 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     without re-reading the filelist).
 
     ``coarse_block > 0`` enables the two-level preconditioner on the
-    non-sharded planned paths (``destriper.build_coarse_preconditioner``
-    — reaches the threshold-1e-6 spec where Jacobi stalls; the coarse
-    system is built per (pointing, weights) on host). Ignored on the
-    sharded and scatter-fallback paths."""
+    planned paths — non-sharded AND sharded
+    (``destriper.build_coarse_preconditioner`` — reaches the
+    threshold-1e-6 spec where Jacobi stalls; the coarse system is built
+    per (pointing, weights) on host). The scatter fallbacks and the
+    sharded ground program keep Jacobi, with a warning."""
     if sharded:
         import jax
 
@@ -237,6 +242,10 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             except ValueError:
                 gid_off = None   # misaligned: scatter fallback below
         if use_ground and gid_off is None:
+            if coarse_block:
+                logger.warning("coarse_precond requested but the ground "
+                               "groups are not offset-aligned; sharded "
+                               "scatter fallback runs Jacobi only")
             result = destripe_sharded(
                 mesh, data.tod, data.pixels, data.weights, data.npix,
                 offset_length=offset_length, n_iter=n_iter,
@@ -256,15 +265,31 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                     [jnp.asarray(tod), jnp.zeros(n_pad, jnp.float32)])
                 weights = jnp.concatenate(
                     [jnp.asarray(weights), jnp.zeros(n_pad, jnp.float32)])
+            use_coarse = bool(coarse_block) and gid_off is None
             run, uniq = _sharded_planned_solver(
                 mesh, pix_host, data.npix, offset_length, n_iter,
                 threshold,
-                n_groups=data.n_groups if gid_off is not None else 0)
+                n_groups=data.n_groups if gid_off is not None else 0,
+                with_coarse=use_coarse)
             if gid_off is not None:
+                if coarse_block:
+                    logger.warning("coarse_precond: the sharded ground "
+                                   "program keeps Jacobi")
                 az = np.asarray(data.az, np.float32)
                 if n_pad:
                     az = np.concatenate([az, np.zeros(n_pad, np.float32)])
                 result = run(tod, weights, ground_off=gid_off, az=az)
+            elif use_coarse:
+                from comapreduce_tpu.mapmaking.destriper import (
+                    build_coarse_preconditioner)
+
+                w_host = np.zeros(pix_host.size, np.float32)
+                w_host[:data.tod.size] = np.asarray(data.weights)
+                result = run(tod, weights,
+                             coarse=build_coarse_preconditioner(
+                                 pix_host, w_host, data.npix,
+                                 offset_length,
+                                 block=int(coarse_block)))
             else:
                 result = run(tod, weights)
             result = result._replace(
@@ -383,14 +408,26 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
             wgt[i, :N] = d.weights
         run, uniq = _sharded_planned_solver(
             mesh, pix_host, npix, offset_length, n_iter, threshold,
-            n_bands=nb)
-        res = run(jnp.asarray(tod), jnp.asarray(wgt))
+            n_bands=nb, with_coarse=bool(coarse_block))
+        if coarse_block:
+            from comapreduce_tpu.mapmaking.destriper import (
+                build_coarse_preconditioner)
+
+            pre = [build_coarse_preconditioner(pix_host, wgt[i], npix,
+                                               offset_length,
+                                               block=int(coarse_block))
+                   for i in range(nb)]
+            res = run(jnp.asarray(tod), jnp.asarray(wgt),
+                      coarse=(pre[0][0],
+                              np.stack([p[1] for p in pre])))
+        else:
+            res = run(jnp.asarray(tod), jnp.asarray(wgt))
         return datas, _expand_joint_results(res, uniq, npix, nb)
     n = (datas[0].tod.size // offset_length) * offset_length
     tod = np.stack([np.asarray(d.tod)[:n] for d in datas])
     wgt = np.stack([np.asarray(d.weights)[:n] for d in datas])
     kwargs = {}
-    if coarse_block and not sharded:
+    if coarse_block:
         from comapreduce_tpu.mapmaking.destriper import (
             build_coarse_preconditioner)
 

@@ -411,14 +411,14 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     preconditioner to the additive two-level one (kills the long-drift
     modes Jacobi stalls on). ``ac_inv`` may carry a leading band axis
     matching a multi-RHS ``tod``. Traced inputs, so the memoized
-    compiled program is reused across bands/weights. Not supported
-    under ``axis_name`` (sharded solves keep Jacobi — the coarse blocks
-    would straddle shard boundaries).
+    compiled program is reused across bands/weights. Under
+    ``axis_name`` (shard_map), ``grp`` is the SHARD-LOCAL slice of the
+    global offset->block map while ``ac_inv`` is replicated: the coarse
+    vector is psum'd (blocks may span shards), the tiny dense solve is
+    computed redundantly per shard, and each shard gathers its own
+    offsets' correction.
     """
     dv = device_arrays if device_arrays is not None else plan.device()
-    if coarse is not None and axis_name is not None:
-        raise ValueError("the two-level preconditioner is not supported "
-                         "under shard_map; use Jacobi (coarse=None)")
     with_ground = ground_off is not None
     if with_ground and tod.ndim != 1:
         raise ValueError("the planned ground solve is single-RHS; "
@@ -558,9 +558,12 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         def apply_precond(v):
             # additive two-level: Jacobi + coarse-grid correction
             # (segment-sum to blocks, small dense solve-as-matmul, gather
-            # back — negligible next to the matvec's one-hot binnings)
+            # back — negligible next to the matvec's one-hot binnings).
+            # Sharded: psum assembles the global coarse vector (blocks
+            # may span shards); the dense solve is replicated.
             rc = jnp.zeros(v.shape[:-1] + (n_c,),
                            f32).at[..., c_grp].add(v)
+            rc = _psum(rc)
             cc = jnp.einsum("...ij,...j->...i", ac_inv, rc)
             return v * inv_diag + jnp.take(cc, c_grp, axis=-1)
     else:

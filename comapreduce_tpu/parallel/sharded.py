@@ -182,7 +182,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                   n_iter: int = 100,
                                   threshold: float = 1e-6,
                                   n_bands: int = 0,
-                                  n_groups: int = 0):
+                                  n_groups: int = 0,
+                                  with_coarse: bool = False):
     """Build a reusable sharded planned-destriper: returns
     ``run(tod, weights) -> DestriperResult``.
 
@@ -200,9 +201,21 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     RHS): ``run(tod, weights, ground_off, az)`` with the per-offset
     group ids and per-sample azimuth sharded alongside; the ground block
     is replicated (its group sums psum over the mesh).
+
+    ``with_coarse=True`` builds the program with the two-level
+    preconditioner inputs: ``run(tod, weights, coarse=(grp, ac_inv))``
+    where ``grp`` is the GLOBAL i32[n_off_total] offset->block map
+    (sharded here — every shard owns whole offsets, so its slice lines
+    up) and ``ac_inv`` the replicated coarse inverse
+    (``destriper.build_coarse_preconditioner``; stack (nb, n_c, n_c)
+    for multi-RHS). Not available on the ground program.
     """
     if n_bands and n_groups:
         raise ValueError("ground solves are single-RHS; run per band")
+    if with_coarse and n_groups:
+        raise ValueError("the sharded ground program keeps Jacobi; "
+                         "with_coarse applies to the plain/multi-RHS "
+                         "programs")
     axes = tuple(mesh.axis_names)
     n_shards = int(np.prod([mesh.shape[a] for a in axes]))
     if len(plans) != n_shards:
@@ -245,6 +258,28 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                 return fn(jnp.asarray(tod), jnp.asarray(weights),
                           jnp.asarray(ground_off, jnp.int32),
                           jnp.asarray(az, jnp.float32), stacked)
+
+        return run
+
+    if with_coarse:
+        def local_c(tod_l, w_l, grp_l, aci, arrs):
+            arrs = {k: v[0] for k, v in arrs.items()}
+            return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
+                                    threshold=threshold, axis_name=axes,
+                                    dense_maps=False, device_arrays=arrs,
+                                    coarse=(grp_l, aci))
+
+        fn = jax.jit(_shard_map(
+            local_c, mesh=mesh,
+            in_specs=(v_spec, v_spec, shard, band_repl, arr_specs),
+            out_specs=out_specs, check_vma=False))
+
+        def run(tod, weights, coarse) -> DestriperResult:
+            grp, aci = coarse
+            with mesh:
+                return fn(jnp.asarray(tod), jnp.asarray(weights),
+                          jnp.asarray(grp, jnp.int32),
+                          jnp.asarray(aci, jnp.float32), stacked)
 
         return run
 

@@ -103,6 +103,52 @@ def main() -> int:
                       else {})},
                   f"bin-batch-{batch}")
 
+    # two-level preconditioner A/B at production pointing: iterations
+    # and wall to reach the 1e-6 spec (Jacobi expected to hit the cap)
+    code_pre = r"""
+import json, time, functools, os
+import numpy as np, jax, jax.numpy as jnp
+from bench import ces_pixels
+from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+from comapreduce_tpu.mapmaking.destriper import (destripe_planned,
+                                                 build_coarse_preconditioner)
+
+small = os.environ.get("SWEEP_SMALL", "") == "1"
+F, T, nx = (2, 4000, 32) if small else (19, 135704, 480)
+L, n_iter = (25, 50) if small else (50, 400)
+rng = np.random.default_rng(1)
+pix = np.concatenate([ces_pixels(T, nx, nx, f, F) for f in range(F)])
+n = (pix.size // L) * L
+pix = pix[:n]
+toff = np.cumsum(rng.normal(0, 0.3, n // L)).astype(np.float32)
+tod = (rng.normal(0, 1, n).astype(np.float32) + np.repeat(toff, L))
+w = np.ones(n, np.float32)
+plan = build_pointing_plan(pix, nx * nx, L)
+grp, aci = build_coarse_preconditioner(pix, w, nx * nx, L, block=8)
+out = {}
+for name, kw in (("jacobi", {}),
+                 ("coarse", {"coarse": (grp, jnp.asarray(aci))})):
+    fn = jax.jit(functools.partial(destripe_planned, plan=plan,
+                                   n_iter=n_iter, threshold=1e-6))
+    r = fn(jnp.asarray(tod), jnp.asarray(w), **kw)
+    float(jnp.sum(r.destriped_map))          # warm + host fetch
+    t0 = time.perf_counter()
+    r = fn(jnp.asarray(tod), jnp.asarray(w), **kw)
+    float(jnp.sum(r.destriped_map))
+    out[name] = {"iters": int(r.n_iter),
+                 "residual": float(r.residual),
+                 "wall_s": round(time.perf_counter() - t0, 3)}
+print(json.dumps(out))
+"""
+    proc = subprocess.run([sys.executable, "-c", code_pre], cwd=REPO,
+                          capture_output=True, text=True)
+    parsed = _last_json(proc.stdout) if proc.returncode == 0 else None
+    if parsed is not None:
+        log_line({"kind": "coarse-precond", **parsed})
+    else:
+        log_line({"kind": "coarse-precond-failed", "rc": proc.returncode,
+                  "err": proc.stderr.strip()[-400:]})
+
     # multi-RHS destriper: 4 bands jointly vs serially on one pointing
     code = r"""
 import json, time
