@@ -411,11 +411,14 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
             n_bands=nb, with_coarse=bool(coarse_block))
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
-                build_coarse_preconditioner)
+                build_coarse_preconditioner, coarse_pattern)
 
+            pat = coarse_pattern(pix_host, npix, offset_length,
+                                 block=int(coarse_block))
             pre = [build_coarse_preconditioner(pix_host, wgt[i], npix,
                                                offset_length,
-                                               block=int(coarse_block))
+                                               block=int(coarse_block),
+                                               pattern=pat)
                    for i in range(nb)]
             res = run(jnp.asarray(tod), jnp.asarray(wgt),
                       coarse=(pre[0][0],
@@ -429,11 +432,14 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     kwargs = {}
     if coarse_block:
         from comapreduce_tpu.mapmaking.destriper import (
-            build_coarse_preconditioner)
+            build_coarse_preconditioner, coarse_pattern)
 
+        pat = coarse_pattern(pix0[:n], npix, offset_length,
+                             block=int(coarse_block))
         pre = [build_coarse_preconditioner(pix0[:n], wgt[i], npix,
                                            offset_length,
-                                           block=int(coarse_block))
+                                           block=int(coarse_block),
+                                           pattern=pat)
                for i in range(nb)]
         kwargs["coarse"] = (jnp.asarray(pre[0][0]),
                             jnp.stack([jnp.asarray(p[1]) for p in pre]))

@@ -38,7 +38,7 @@ from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
 
 __all__ = ["DestriperResult", "destripe", "destripe_jit",
            "destripe_planned", "ground_ids_per_offset",
-           "build_coarse_preconditioner"]
+           "build_coarse_preconditioner", "coarse_pattern"]
 
 
 class DestriperResult(NamedTuple):
@@ -90,15 +90,20 @@ def _dot(x, y, axis_name):
     return s
 
 
-def _jacobi_inverse(diag_a: jax.Array, diag_fwf: jax.Array) -> jax.Array:
+def _jacobi_inverse(diag_a: jax.Array, diag_fwf: jax.Array,
+                    floor: float = 1e-6) -> jax.Array:
     """1/diag(A) with fallbacks for degenerate offsets.
 
     An offset whose samples are alone in their pixels has A_oo ~ 0 (Z
     removes it entirely — a null direction): fall back to the plain
     F^T W F diagonal there, and to identity on zero-weight (padding)
-    offsets."""
-    floor = 1e-6 * jnp.maximum(diag_fwf, 1e-30)
-    safe = jnp.where(diag_a > floor, diag_a,
+    offsets. ``floor`` is the degeneracy cut as a fraction of the plain
+    diagonal — 1e-6 for the intensity solves; the polarized path raises
+    it to ``polarization._POL_JACOBI_FLOOR`` (pol pixels absorb 3 DOF
+    each, and aggressive 1/diag on nearly-absorbed offsets excites f32
+    CG breakdown)."""
+    cut = floor * jnp.maximum(diag_fwf, 1e-30)
+    safe = jnp.where(diag_a > cut, diag_a,
                      jnp.where(diag_fwf > 0, diag_fwf, 1.0))
     return 1.0 / safe
 
@@ -277,10 +282,38 @@ def ground_ids_per_offset(ground_ids: np.ndarray,
     return blocks[:, 0].astype(np.int32)
 
 
+def coarse_pattern(pixels, npix: int, offset_length: int,
+                   block: int = 32, max_coarse: int = 4096) -> dict:
+    """Weights-independent half of the coarse-preconditioner build: the
+    clipped pixel stream, offset/block maps, and the sorted
+    (pixel, coarse-block) index pattern. A multi-band joint solve shares
+    ONE pattern (pixels are band-invariant) and runs only the per-band
+    weight bincounts through :func:`build_coarse_preconditioner`."""
+    pixels = np.asarray(pixels)
+    L = int(offset_length)
+    n = (pixels.size // L) * L
+    pixels = pixels[:n]
+    bad = (pixels < 0) | (pixels >= npix)
+    pix = np.clip(pixels, 0, npix - 1).astype(np.int64)
+    n_off = n // L
+    K = max(int(block), 1)
+    while -(-n_off // K) > max_coarse:
+        K *= 2
+    off_id = np.arange(n) // L
+    grp = (np.arange(n_off) // K).astype(np.int32)
+    n_c = int(grp[-1]) + 1 if n_off else 1
+    key = pix * n_c + grp[off_id]
+    uk, inv = np.unique(key, return_inverse=True)
+    return {"n": n, "bad": bad, "pix": pix, "off_id": off_id,
+            "grp": grp, "n_c": n_c, "inv": inv,
+            "rows": uk // n_c, "cols": uk % n_c, "npix": int(npix)}
+
+
 def build_coarse_preconditioner(pixels, weights, npix: int,
                                 offset_length: int, block: int = 32,
                                 ridge: float = 3e-3,
-                                max_coarse: int = 4096):
+                                max_coarse: int = 4096,
+                                pattern: dict | None = None):
     """Two-level (coarse-offset) preconditioner setup — host side, f64.
 
     The destriper normal matrix's small eigenvalues live on LONG offset
@@ -315,36 +348,27 @@ def build_coarse_preconditioner(pixels, weights, npix: int,
     argument: ``grp`` i32[n_off] (offset -> coarse block) and ``ac_inv``
     f32[n_c, n_c]. Build once per (pointing, weights); bands with their
     own weights need their own ``ac_inv`` (stack them (nb, n_c, n_c)
-    for a multi-RHS solve).
+    for a multi-RHS solve), sharing one :func:`coarse_pattern` so the
+    pixel-side sort/unique work is not repeated per band.
     """
     import scipy.sparse as sp
 
-    pixels = np.asarray(pixels)
-    weights = np.asarray(weights, np.float64)
-    L = int(offset_length)
-    n = (pixels.size // L) * L
-    pixels = pixels[:n]
-    weights = weights[:n].copy()
+    if pattern is None:
+        pattern = coarse_pattern(pixels, npix, offset_length,
+                                 block=block, max_coarse=max_coarse)
+    n, pix, off_id = pattern["n"], pattern["pix"], pattern["off_id"]
+    grp, n_c = pattern["grp"], pattern["n_c"]
+    n_off = grp.size
+    weights = np.asarray(weights, np.float64)[:n].copy()
     # sentinel/out-of-range pixels carry zero weight (the solver's rule)
-    bad = (pixels < 0) | (pixels >= npix)
-    weights[bad] = 0.0
-    pix = np.clip(pixels, 0, npix - 1).astype(np.int64)
-    n_off = n // L
-    K = max(int(block), 1)
-    while -(-n_off // K) > max_coarse:
-        K *= 2
-    off_id = np.arange(n) // L
-    grp = (np.arange(n_off) // K).astype(np.int32)
-    n_c = int(grp[-1]) + 1 if n_off else 1
+    weights[pattern["bad"]] = 0.0
 
     sw_pix = np.bincount(pix, weights=weights, minlength=npix)
     inv_sw = np.where(sw_pix > 0, 1.0 / np.maximum(sw_pix, 1e-30), 0.0)
     sw_off = np.bincount(off_id, weights=weights, minlength=n_off)
     # (pixel, coarse) pair weights in one pass over the samples
-    key = pix * n_c + grp[off_id]
-    uk, inv = np.unique(key, return_inverse=True)
-    mw = np.bincount(inv, weights=weights)
-    mat = sp.coo_matrix((mw, (uk // n_c, uk % n_c)),
+    mw = np.bincount(pattern["inv"], weights=weights)
+    mat = sp.coo_matrix((mw, (pattern["rows"], pattern["cols"])),
                         shape=(npix, n_c)).tocsr()
     d_c = np.bincount(grp, weights=sw_off, minlength=n_c)
     a_c = np.diag(d_c) - (mat.T @ sp.diags(inv_sw) @ mat).toarray()

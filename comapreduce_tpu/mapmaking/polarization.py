@@ -33,6 +33,14 @@ __all__ = ["PolMapState", "pol_map_solve", "destripe_pol",
            "destripe_pol_planned", "PolDestriperResult"]
 
 
+# Jacobi degeneracy floor for the planned pol CG: offsets whose diag(A)
+# falls below this fraction of their plain sum-w diagonal are treated as
+# near-degenerate and scaled by sum w instead of 1/diag(A). 0.05 was the
+# most robust of the sweep {0.01 (still breaks), 0.05, 0.1, 0.3} — see
+# destripe_pol_planned's docstring for the measured behavior.
+_POL_JACOBI_FLOOR = 0.05
+
+
 class PolMapState(NamedTuple):
     """Per-pixel normal-equation pieces for the IQU solve."""
 
@@ -198,6 +206,23 @@ def destripe_pol_planned(tod, weights, psi, plan, n_iter: int = 100,
 
     Same math as :func:`destripe_pol` (parity-tested); single-process,
     single-RHS (the sharded pol solve stays on the scatter path).
+
+    Unlike the scatter oracle (deliberately plain CG), this path runs
+    FLOORED-Jacobi-preconditioned CG: ``diag(A)`` comes exactly from
+    the pair aggregates (``sum w`` per offset minus each pair's
+    ``s^T A_p^{-1} s`` quadratic), but offsets more than
+    ``1 - _POL_JACOBI_FLOOR`` absorbed by the per-pixel 3x3 blocks are
+    scaled by the plain ``sum w`` instead — the pol pixels eat 3 DOF
+    each, so near-degenerate offsets are common and an aggressive
+    1/diag excites f32 breakdown within ~6 iterations (measured).
+    Measured effect at the production budget: plain CG BREAKS DOWN
+    mid-solve (iteration ~142, residual degrading 3.4e-3 -> 1.5e-2 and
+    the I map error growing 14 -> 20); floored Jacobi keeps descending
+    through the same budget (1.6e-3 at 150, map error still improving).
+    A pol two-level coarse grid was prototyped and measured to add
+    nothing over this (the slow modes are entangled with the
+    ridge-regularised pixel blocks, not plain offset drifts) — not
+    shipped.
     """
     if tod.ndim != 1:
         # a batched (nb, N) input would broadcast band rows against the
@@ -263,6 +288,21 @@ def destripe_pol_planned(tod, weights, psi, plan, n_iter: int = 100,
     pwds_off = jnp.take(pwds, perm_off, axis=-1)
     diag = off_sum(pws_off[0])                           # sum_w per offset
 
+    # exact diag(A): sum_w per offset minus each pair's s^T A_p^{-1} s
+    # quadratic (the pol analogue of the unpolarized Jacobi correction),
+    # FLOORED: see the docstring
+    inv_a_off = jnp.take(inv_a, jnp.clip(pr_off, 0, n_rank - 1), axis=0)
+    inv_a_off = jnp.where((pr_off < n_rank)[:, None, None], inv_a_off,
+                          0.0)
+    quad = jnp.einsum("pij,ip,jp->p", inv_a_off, pws_off, pws_off)
+    from comapreduce_tpu.mapmaking.destriper import _jacobi_inverse
+
+    inv_diag = _jacobi_inverse(diag - off_sum(quad), diag,
+                               floor=_POL_JACOBI_FLOOR)
+
+    def apply_precond(v):
+        return v * inv_diag
+
     def solve_map(b_rank):
         """m = masked A^-1 b, (3, n_rank) -> (3, n_rank)."""
         return jnp.einsum("rkj,jr->kr", inv_a, b_rank)
@@ -288,7 +328,7 @@ def destripe_pol_planned(tod, weights, psi, plan, n_iter: int = 100,
 
     a, rz, k, b_norm = _cg_loop(
         matvec, b, lambda u, v: jnp.sum(u * v, axis=-1), n_iter,
-        threshold)
+        threshold, precond=apply_precond)
     # zero-mean pinning: same convention as the scatter path (a constant
     # offset vector is near-degenerate with the I map)
     a = a - jnp.mean(a)
