@@ -563,6 +563,18 @@ def main(argv=None) -> int:
         print(f"band {band}: {len(data.files)} files, "
               f"{data.tod.size} samples, {int(result.n_iter)} CG iters, "
               f"residual {float(result.residual):.2e} -> {path}")
+        if float(result.residual) > threshold:
+            # an unconverged solve leaves real large-scale stripes in
+            # the map (measured: ~1.7x the converged map error) — say so
+            # instead of letting the residual line scroll past
+            logger.warning(
+                "band %d did NOT reach threshold %.0e (residual %.2e "
+                "after %d iterations)%s", band, threshold,
+                float(result.residual), int(result.n_iter),
+                " — note the scatter and sharded-ground fallback paths "
+                "run Jacobi only (see warnings above)" if coarse_block
+                else " — consider [Inputs] coarse_precond : 8 "
+                "(two-level preconditioner; docs/OPERATIONS.md §3)")
     return 0
 
 

@@ -356,7 +356,13 @@ def build_coarse_preconditioner(pixels, weights, npix: int,
     if pattern is None:
         pattern = coarse_pattern(pixels, npix, offset_length,
                                  block=block, max_coarse=max_coarse)
+    elif pattern["npix"] != int(npix):
+        raise ValueError(f"pattern built for npix={pattern['npix']}, "
+                         f"got npix={npix}")
     n, pix, off_id = pattern["n"], pattern["pix"], pattern["off_id"]
+    if np.asarray(weights).shape[0] < n:
+        raise ValueError(f"weights size {np.asarray(weights).shape[0]} "
+                         f"< pattern sample count {n}")
     grp, n_c = pattern["grp"], pattern["n_c"]
     n_off = grp.size
     weights = np.asarray(weights, np.float64)[:n].copy()

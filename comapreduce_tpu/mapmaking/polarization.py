@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from comapreduce_tpu.mapmaking.binning import _sanitize
-from comapreduce_tpu.mapmaking.destriper import _cg_loop
+from comapreduce_tpu.mapmaking.destriper import _cg_loop, _jacobi_inverse
 from comapreduce_tpu.mapmaking.pointing_plan import binned_window_sum
 
 __all__ = ["PolMapState", "pol_map_solve", "destripe_pol",
@@ -295,8 +295,6 @@ def destripe_pol_planned(tod, weights, psi, plan, n_iter: int = 100,
     inv_a_off = jnp.where((pr_off < n_rank)[:, None, None], inv_a_off,
                           0.0)
     quad = jnp.einsum("pij,ip,jp->p", inv_a_off, pws_off, pws_off)
-    from comapreduce_tpu.mapmaking.destriper import _jacobi_inverse
-
     inv_diag = _jacobi_inverse(diag - off_sum(quad), diag,
                                floor=_POL_JACOBI_FLOOR)
 
