@@ -571,8 +571,10 @@ def main(argv=None) -> int:
                 "band %d did NOT reach threshold %.0e (residual %.2e "
                 "after %d iterations)%s", band, threshold,
                 float(result.residual), int(result.n_iter),
-                " — note the scatter and sharded-ground fallback paths "
-                "run Jacobi only (see warnings above)" if coarse_block
+                " — coarse_precond was set: if a 'Jacobi only' fallback "
+                "warning appeared above it did not apply; otherwise "
+                "raise niter (or the coarse block size)"
+                if coarse_block
                 else " — consider [Inputs] coarse_precond : 8 "
                 "(two-level preconditioner; docs/OPERATIONS.md §3)")
     return 0

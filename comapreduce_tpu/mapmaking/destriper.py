@@ -306,7 +306,8 @@ def coarse_pattern(pixels, npix: int, offset_length: int,
     uk, inv = np.unique(key, return_inverse=True)
     return {"n": n, "bad": bad, "pix": pix, "off_id": off_id,
             "grp": grp, "n_c": n_c, "inv": inv,
-            "rows": uk // n_c, "cols": uk % n_c, "npix": int(npix)}
+            "rows": uk // n_c, "cols": uk % n_c, "npix": int(npix),
+            "offset_length": L, "block": int(block)}
 
 
 def build_coarse_preconditioner(pixels, weights, npix: int,
@@ -356,9 +357,14 @@ def build_coarse_preconditioner(pixels, weights, npix: int,
     if pattern is None:
         pattern = coarse_pattern(pixels, npix, offset_length,
                                  block=block, max_coarse=max_coarse)
-    elif pattern["npix"] != int(npix):
-        raise ValueError(f"pattern built for npix={pattern['npix']}, "
-                         f"got npix={npix}")
+    elif (pattern["npix"] != int(npix)
+          or pattern["offset_length"] != int(offset_length)
+          or pattern["block"] != int(block)):
+        raise ValueError(
+            "pattern geometry mismatch: built for (npix, offset_length,"
+            f" block) = ({pattern['npix']}, {pattern['offset_length']},"
+            f" {pattern['block']}), called with ({npix},"
+            f" {offset_length}, {block})")
     n, pix, off_id = pattern["n"], pattern["pix"], pattern["off_id"]
     if np.asarray(weights).shape[0] < n:
         raise ValueError(f"weights size {np.asarray(weights).shape[0]} "

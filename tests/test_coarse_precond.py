@@ -313,6 +313,60 @@ shape : 240, 240
         assert np.isfinite(by_name["DESTRIPED"][hits > 0]).all()
 
 
+def test_pattern_validation():
+    pix, tod, w, npix, L, _ = _problem(seed=8, F=1, T=4_000, nx=32)
+    from comapreduce_tpu.mapmaking.destriper import coarse_pattern
+
+    pat = coarse_pattern(pix, npix, L, block=8)
+    with pytest.raises(ValueError, match="npix"):
+        build_coarse_preconditioner(pix, w, npix + 1, L, block=8,
+                                    pattern=pat)
+    with pytest.raises(ValueError, match="geometry"):
+        build_coarse_preconditioner(pix, w, npix, L, block=16,
+                                    pattern=pat)
+    with pytest.raises(ValueError, match="weights"):
+        build_coarse_preconditioner(pix, w[:100], npix, L, block=8,
+                                    pattern=pat)
+    # matching pattern reproduces the from-scratch build exactly
+    g1, a1 = build_coarse_preconditioner(pix, w, npix, L, block=8)
+    g2, a2 = build_coarse_preconditioner(pix, w, npix, L, block=8,
+                                         pattern=pat)
+    np.testing.assert_array_equal(g1, g2)
+    np.testing.assert_array_equal(a1, a2)
+
+
+def test_random_geometries_never_break_down():
+    """Property-style sweep: random pointings/weights (ragged coverage,
+    zero-weight stretches, sentinel pixels) must always yield an SPD
+    preconditioner — the CG may stall at its f32 floor but must not
+    break down EARLY (the f32-fragility class the ridge/symmetrise
+    guards exist for)."""
+    rng = np.random.default_rng(9)
+    for trial in range(4):
+        n = int(rng.integers(60, 120)) * 50
+        npix = int(rng.integers(100, 800))
+        pix = rng.integers(0, npix, n)
+        if trial % 2:
+            k = n // 200
+            pix[: k * 50] = np.repeat(
+                rng.integers(0, npix, k), 50)          # clustered revisits
+        w = rng.uniform(0.2, 3.0, n).astype(np.float32)
+        w[rng.random(n) < 0.05] = 0.0
+        pix[rng.random(n) < 0.01] = npix               # sentinels
+        tod = (rng.normal(size=n)
+               + np.repeat(np.cumsum(rng.normal(0, 0.3, n // 50)),
+                           50)).astype(np.float32)
+        plan = build_pointing_plan(pix, npix, 50)
+        grp, aci = build_coarse_preconditioner(pix, w, npix, 50, block=8)
+        r = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                             n_iter=150, threshold=1e-6,
+                             coarse=(grp, jnp.asarray(aci)))
+        # ran the full budget, converged, or at worst stopped late
+        assert (int(r.n_iter) >= 100 or float(r.residual) < 1e-6), \
+            (trial, int(r.n_iter), float(r.residual))
+        assert np.isfinite(float(r.residual))
+
+
 def test_block_doubles_to_cap():
     pix, tod, w, npix, L, _ = _problem(seed=6, F=1, T=6_000, nx=32)
     grp, aci = build_coarse_preconditioner(pix, w, npix, L, block=1,
