@@ -351,6 +351,13 @@ def build_coarse_preconditioner(pixels, weights, npix: int,
     own weights need their own ``ac_inv`` (stack them (nb, n_c, n_c)
     for a multi-RHS solve), sharing one :func:`coarse_pattern` so the
     pixel-side sort/unique work is not repeated per band.
+
+    Method lineage (public map-making literature, PAPERS.md): two-grid /
+    multigrid map-making CG (MAPCUMBA, astro-ph/0101112), coarse-mode
+    deflation preconditioners for scanning patterns (arXiv:1309.7473)
+    and the two-level preconditioners in MAPPRAISER
+    (arXiv:2112.03370); the pair-aggregate Galerkin assembly and the
+    TPU-side application are this framework's own.
     """
     import scipy.sparse as sp
 
