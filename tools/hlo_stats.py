@@ -8,7 +8,7 @@ This traces one BENCH_SMALL-or-scaled bench iteration under
 
 Usage::
 
-    python tools/hlo_stats.py [--scale 0.2] [--out HLO_STATS_r04.json]
+    python tools/hlo_stats.py [--scale 0.2] [--out HLO_STATS_r05.json]
 
 Runs on whatever backend jax selects; meaningful numbers need the real
 chip. Never signals children; safe under the relay rules.
@@ -27,7 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def main() -> int:
     scale = "1.0"
-    out_path = os.path.join(REPO, "HLO_STATS_r04.json")
+    out_path = os.path.join(REPO, "HLO_STATS_r05.json")
     args = sys.argv[1:]
     while args:
         a = args.pop(0)
