@@ -532,6 +532,25 @@ def main():
 # Relay-independent evidence: every successful bench leaves artifacts
 # --------------------------------------------------------------------------
 
+def gviz_rows(table) -> list:
+    """xprof tool data -> ``[header, *rows]``.
+
+    Current xprof returns a gviz-style ``{"cols": [...], "rows": [...]}``
+    mapping (each row ``{"c": [{"v": ...}, ...]}``); older versions
+    returned a plain list of rows. Anything else -> []."""
+    if isinstance(table, dict) and isinstance(table.get("cols"), list):
+        hdr = [(c.get("label") or c.get("id", ""))
+               if isinstance(c, dict) else str(c) for c in table["cols"]]
+        body = [[cell.get("v") if isinstance(cell, dict) else cell
+                 for cell in (row.get("c") or [])]
+                for row in (table.get("rows") or [])
+                if isinstance(row, dict)]
+        return [hdr] + body
+    if isinstance(table, list):
+        return [r for r in table if isinstance(r, (list, dict))]
+    return []
+
+
 def write_evidence(tag: str, run_once, compile_fn=None, extra=None,
                    host_only: bool = False) -> str:
     """Record op-level evidence for a successful bench run (VERDICT r4
@@ -598,17 +617,28 @@ def write_evidence(tag: str, run_once, compile_fn=None, extra=None,
             data, _ = rtd.xspace_to_tool_data(planes, "hlo_stats", {})
             table = (json.loads(data) if isinstance(data, (str, bytes))
                      else data)
-            rows = [r for r in table if isinstance(r, (list, dict))]
+            rows = gviz_rows(table)
             # keep the header + top rows; drop 'while' rows (dbl counts)
-            if rows and isinstance(rows[0], list):
+            if len(rows) > 1 and isinstance(rows[0], list):
                 hdr, body = rows[0], rows[1:]
-                cat = (hdr.index("HLO Category")
-                       if "HLO Category" in hdr else None)
+                cat = next((i for i, label in enumerate(hdr)
+                            if "category" in str(label).lower()), None)
                 if cat is not None:
-                    body = [r for r in body if r[cat] != "while"]
+                    # short rows (gviz may omit trailing cells) pass
+                    # through rather than IndexError the whole table
+                    body = [r for r in body
+                            if not (isinstance(r, list) and len(r) > cat
+                                    and r[cat] == "while")]
                 rec["hlo_stats"] = [hdr] + body[:60]
-            else:
+            elif rows and not isinstance(table, dict):
+                # legacy list-shaped tables stored verbatim; a gviz
+                # header with no body rows is the empty case below
                 rec["hlo_stats"] = rows[:60]
+            else:
+                # an artifact whose primary payload is missing must say
+                # so, not record success with an empty table
+                rec["profile_error"] = (
+                    f"empty hlo_stats table (shape {type(table).__name__})")
         except Exception as exc:   # noqa: BLE001
             rec["profile_error"] = repr(exc)
     if extra:

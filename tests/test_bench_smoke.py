@@ -37,6 +37,41 @@ def test_bench_small_emits_json_line(tmp_path):
     assert 0 < d["map_hit_fraction"] <= 1
 
 
+def test_gviz_rows_normalises_both_xprof_shapes():
+    """Current xprof returns a gviz ``{"cols","rows"}`` mapping — the
+    round-5 chip artifact initially recorded ``hlo_stats: []`` because
+    the old parser iterated the dict's keys. Both shapes must yield
+    ``[header, *rows]``; junk must yield []."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import bench
+
+    gviz = {"cols": [{"id": "a", "label": "Op"},
+                     {"id": "b", "label": "HLO op category"}],
+            "rows": [{"c": [{"v": "fusion.1"}, {"v": "fusion"}]},
+                     {"c": [{"v": "while.2"}, None]},
+                     {"c": [{"v": "short.3"}]},     # trailing cell omitted
+                     "junk-row"]}                   # non-dict row dropped
+    rows = bench.gviz_rows(gviz)
+    assert rows[0] == ["Op", "HLO op category"]
+    assert rows[1] == ["fusion.1", "fusion"]
+    assert rows[2] == ["while.2", None]
+    assert rows[3] == ["short.3"]
+    assert len(rows) == 4
+    bare = {"cols": [{"id": "a", "label": "Op"}, "b"],
+            "rows": [{"c": ["fusion.9", None]}]}   # bare-value cells
+    assert bench.gviz_rows(bare) == [["Op", "b"], ["fusion.9", None]]
+    assert bench.gviz_rows({"cols": None, "rows": []}) == []
+    nulls = {"cols": [{"id": "a", "label": "Op"}],
+             "rows": [{"c": None}, {"c": [{"v": "x"}]}]}
+    assert bench.gviz_rows(nulls) == [["Op"], [], ["x"]]
+    assert bench.gviz_rows({"cols": [{"id": "a"}], "rows": None}) == [["a"]]
+    legacy = [["Op", "HLO Category"], ["fusion.1", "fusion"], "junk"]
+    assert bench.gviz_rows(legacy) == legacy[:2]
+    assert bench.gviz_rows("not a table") == []
+    assert bench.gviz_rows({"unrelated": 1}) == []
+
+
 def test_bench_config_modes_emit_json(tmp_path):
     """BASELINE configs 1/2/4 (--config N) each print one JSON line;
     the device configs also leave an evidence artifact (the
