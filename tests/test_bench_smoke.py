@@ -82,6 +82,7 @@ def test_bench_config_modes_emit_json(tmp_path):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
     env.update(BENCH_SMALL="1", BENCH_BASELINE_S="1.0",
+               BENCH_BASELINE_CAL_S="1.0",
                BENCH_NO_PROBE="1", JAX_PLATFORMS="cpu",
                PYTHONPATH=repo, BENCH_EVIDENCE_DIR=str(tmp_path))
     metrics = {"1": "calibrator_numpy_samples_per_sec",
