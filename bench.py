@@ -601,8 +601,18 @@ def write_evidence(tag: str, run_once, compile_fn=None, extra=None,
         st = subprocess.run(["git", "status", "--porcelain"], cwd=repo,
                             capture_output=True, text=True)
         # evidence from a dirty tree must say so: a bare rev would
-        # attribute the measurement to code that cannot reproduce it
-        if st.stdout.strip():
+        # attribute the measurement to code that cannot reproduce it.
+        # Measurement OUTPUTS (evidence artifacts, sweep logs) are not
+        # dirt — a session writes them between runs, and without this
+        # filter every artifact after the first marks itself dirty
+        # against code identical to HEAD
+        ev_rel = os.path.relpath(os.path.join(out_root, "evidence"),
+                                 repo)
+        skip = ("SWEEP_",) if ev_rel.startswith("..") else (
+            ev_rel + os.sep, "SWEEP_")
+        dirt = [ln for ln in st.stdout.splitlines()
+                if ln[3:] and not ln[3:].startswith(skip)]
+        if dirt:
             rec["git_rev"] += "-dirty"
     except OSError:
         rec["git_rev"] = ""
