@@ -25,6 +25,13 @@ reference's production deployment of 16 MPI ranks
 HDF5 reads and its destriper (both would make it slower), so the ratio is
 conservative.
 
+QUIET HOST REQUIRED for any run that measures a baseline (no env
+override): the reference unit is CPU-pinned single-core, and ambient
+load (a concurrent test suite, a build) slows the pinned child — a
+contaminated baseline inflates ``vs_baseline`` (observed: config 2's
+calibrator unit 5.85 s under load vs 2.835 s quiet, a phantom 2x).
+Device walls are unaffected (stable to ~0.1% across all round-5 runs).
+
 Env knobs: ``BENCH_SCALE`` (float, default 1.0) scales the per-scan sample
 count; ``BENCH_SMALL=1`` runs a tiny config (CI smoke);
 ``BENCH_BASELINE_S`` overrides the measured FLAGSHIP baseline unit
