@@ -458,11 +458,10 @@ def main():
     jitted_destripe = jax.jit(functools.partial(
         destripe_planned, plan=plan, n_iter=n_iter, threshold=1e-6))
 
-    def run_pipeline():
-        # hardware RNG (rbg): synthetic-data generation is bench scaffolding,
-        # not pipeline work, and threefry costs ~35 ms/feed of the wall
-        keys = jax.random.split(jax.random.key(7, impl="rbg"), F)
-        tods, weis = all_feeds(keys)           # (F, B, T) each
+    def make_bands(tods, weis):
+        """(F, B, T) feed outputs -> padded (B, F*T) multi-RHS inputs.
+        ONE home for the band assembly: the headline pipeline and the
+        diagnostic stage split below must measure the same layout."""
         band_tod = jnp.moveaxis(tods, 1, 0).reshape(B, -1)   # (B, F*T)
         band_w = jnp.moveaxis(weis, 1, 0).reshape(B, -1)
         if n_pad:
@@ -470,7 +469,14 @@ def main():
                 [band_tod, jnp.zeros((B, n_pad), band_tod.dtype)], axis=-1)
             band_w = jnp.concatenate(
                 [band_w, jnp.zeros((B, n_pad), band_w.dtype)], axis=-1)
-        return jitted_destripe(band_tod, band_w)
+        return band_tod, band_w
+
+    def run_pipeline():
+        # hardware RNG (rbg): synthetic-data generation is bench scaffolding,
+        # not pipeline work, and threefry costs ~35 ms/feed of the wall
+        keys = jax.random.split(jax.random.key(7, impl="rbg"), F)
+        tods, weis = all_feeds(keys)           # (F, B, T) each
+        return jitted_destripe(*make_bands(tods, weis))
 
     # warm-up: compile + first run
     result = run_pipeline()
@@ -487,6 +493,21 @@ def main():
     n_raw = F * B * C * T
     throughput = n_raw / best
     cg_iters_per_sec = float(result.n_iter) / best
+
+    # diagnostic stage split (NOT the headline wall, which times the
+    # chained end-to-end pipeline): one extra rep of each half, so the
+    # roofline attribution is measured instead of inferred
+    keys_d = jax.random.split(jax.random.key(7, impl="rbg"), F)
+    t0 = time.perf_counter()
+    tods_d, weis_d = all_feeds(keys_d)
+    jax.block_until_ready((tods_d, weis_d))
+    reduce_wall = time.perf_counter() - t0
+    band_tod_d, band_w_d = make_bands(tods_d, weis_d)
+    jax.block_until_ready((band_tod_d, band_w_d))
+    t0 = time.perf_counter()
+    r_d = jitted_destripe(band_tod_d, band_w_d)
+    jax.block_until_ready(r_d.destriped_map)
+    destripe_wall = time.perf_counter() - t0
 
     # ---- measured reference baseline ------------------------------------
     env_unit = os.environ.get("BENCH_BASELINE_S", "")
@@ -509,6 +530,8 @@ def main():
             "wall_s": round(best, 4),
             "cg_iters": int(result.n_iter),
             "cg_iters_per_sec": round(cg_iters_per_sec, 1),
+            "reduce_wall_s": round(reduce_wall, 4),
+            "destripe_wall_s": round(destripe_wall, 4),
             "map_hit_fraction": None,
             "baseline_unit_s": round(unit_s, 3),
             "baseline_unit_policy": (

@@ -115,6 +115,37 @@ def test_planned_vs_scatter_destriper_on_device():
     np.testing.assert_allclose(a - a.mean(), b - b.mean(), atol=2e-3)
 
 
+def test_multi_rhs_vs_per_band_on_device():
+    """The bench's (and production CLI's) multi-RHS formulation on the
+    chip itself: one joint CG over (nb, N) must match the per-band
+    solves bit-for-policy (same per-band alphas by construction; f32
+    roundoff differs only through reduction order, so compare
+    mean-removed maps at a tight tolerance)."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import destripe_planned
+    from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+
+    rng = np.random.default_rng(4)
+    N, npix, off, nb = 20_000, 400, 50, 3
+    pix = rng.integers(0, npix, N)
+    plan = build_pointing_plan(pix, npix, off)
+    tod = (rng.normal(size=(nb, N))
+           + np.repeat(rng.normal(size=(nb, N // off)), off,
+                       axis=-1)).astype(np.float32)
+    w = (0.5 + rng.random((nb, N))).astype(np.float32)
+
+    joint = destripe_planned(jnp.asarray(tod), jnp.asarray(w),
+                             plan=plan, n_iter=60, threshold=1e-7)
+    hit = np.asarray(joint.hit_map) > 0
+    for b in range(nb):
+        single = destripe_planned(jnp.asarray(tod[b]), jnp.asarray(w[b]),
+                                  plan=plan, n_iter=60, threshold=1e-7)
+        a = np.asarray(single.destriped_map)[hit]
+        j = np.asarray(joint.destriped_map)[b][hit]
+        np.testing.assert_allclose(a - a.mean(), j - j.mean(), atol=2e-3)
+
+
 def test_fused_spmd_step_on_chip():
     """One fused ObservationStep (vane -> reduce -> destripe under
     shard_map) compiled and executed on the real chip (1-device mesh:
