@@ -81,7 +81,10 @@ def test_bench_config_modes_emit_json(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
-    env.update(BENCH_SMALL="1", BENCH_BASELINE_S="1.0",
+    # deliberately DIFFERENT flagship/calibrator overrides: configs 1/2
+    # must take the calibrator one — the round-5 sweep once leaked the
+    # 50.5 s flagship unit into their denominator (~66x/16x inflation)
+    env.update(BENCH_SMALL="1", BENCH_BASELINE_S="7.7",
                BENCH_BASELINE_CAL_S="1.0",
                BENCH_NO_PROBE="1", JAX_PLATFORMS="cpu",
                PYTHONPATH=repo, BENCH_EVIDENCE_DIR=str(tmp_path))
@@ -101,6 +104,8 @@ def test_bench_config_modes_emit_json(tmp_path):
         assert rec["metric"] == metric
         assert rec["value"] > 0 and np.isfinite(rec["value"])
         assert rec["detail"]["config"] == int(cfg)
+        if cfg in ("1", "2"):   # the calibrator unit, never the flagship
+            assert rec["detail"]["baseline_unit_s"] == 1.0
     # config 1 is host_only (never imports jax -> platform "host")
     for tag, plat in (("config1", "host"), ("config2", "cpu"),
                       ("config4", "cpu")):
