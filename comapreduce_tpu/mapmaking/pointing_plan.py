@@ -293,18 +293,20 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
     every id of chunk c inside ``[base[c], base[c] + window)`` (ids
     outside — sentinels — are dropped). The inner product against the
     equality one-hot is an MXU matmul (f32-exact: one-hot entries are
-    0/1); chunks stream through ``lax.map`` so the one-hot never
-    materialises beyond ``batch * chunk * window`` floats. Assembly of
-    the per-chunk windows is the only scatter left — ``n_chunks *
-    window`` elements, orders of magnitude smaller than a per-sample
-    scatter.
+    0/1). Two implementations, selected by ``COMAP_BIN_IMPL``:
+    ``fori`` (default) streams chunks through one ordered
+    ``fori_loop`` — dynamic-slice, contract, read-modify-write
+    ``dynamic_update_slice`` assembly, no scatter at all; ``map`` is
+    the older batched ``lax.map`` path whose only remaining scatter is
+    the ``n_chunks * window`` window assembly.
 
     Leading axes of ``values`` (the multi-RHS destriper's band axis) ride
     through: the one-hot is built ONCE per chunk and contracted against
     every band's value row in the same matmul.
 
     ``batch=None`` reads the ``COMAP_BIN_BATCH`` env default (8) — the
-    round-3 "next lever (c)" sweep knob: larger batches amortise
+    round-3 "next lever (c)" sweep knob, meaningful only under
+    ``COMAP_BIN_IMPL=map``: larger batches amortise
     ``lax.map`` chunk streaming at the cost of a bigger live one-hot.
     The env value binds at FIRST TRACE per input shape: ``jax.jit``
     caches executables per shape, so a same-shape re-call never
@@ -312,9 +314,22 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
     To sweep it, either spawn a fresh process per point (what
     ``tools/onchip_sweep.py`` does), call ``jax.clear_caches()``
     between points, or pass ``batch`` explicitly as an argument.
+    ``COMAP_BIN_IMPL`` binds the same way — an in-process impl A/B at
+    one shape needs fresh processes or ``jax.clear_caches()``, or the
+    cached executable silently keeps the first impl.
     """
     if batch is None:
         batch = int(os.environ.get("COMAP_BIN_BATCH", "8"))
+    # default impl: the ordered fori loop — measured on-chip (round 5)
+    # at production multi-RHS shape it takes the destriper 2.09 s ->
+    # 1.59 s (bench 150x -> 172x) by eliminating the chunk-major
+    # transpose, the lax.map slicing, and the serialized assembly
+    # scatter. COMAP_BIN_IMPL=map restores the batched-map path (where
+    # COMAP_BIN_BATCH applies) for A/B.
+    impl = os.environ.get("COMAP_BIN_IMPL", "fori")
+    if impl == "fori":
+        return _binned_window_sum_fori(values, ids, base, window, chunk,
+                                       out_size)
     M = values.shape[-1]
     lead = values.shape[:-1]
     n_chunks = M // chunk
@@ -338,4 +353,47 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
            + jnp.arange(window, dtype=jnp.int32)[None, :])
     out = out.at[..., idx.reshape(-1)].add(
         part.reshape(lead + (n_chunks * window,)), mode="drop")
+    return out[..., :out_size]
+
+
+def _binned_window_sum_fori(values: jax.Array, ids: jax.Array,
+                            base: jax.Array, window: int, chunk: int,
+                            out_size: int) -> jax.Array:
+    """``binned_window_sum`` as ONE ordered ``fori_loop`` (A/B via
+    ``COMAP_BIN_IMPL=fori``): per chunk, dynamic-slice the values (no
+    chunk-major transpose of the whole pair space), contract against
+    the equality one-hot on the MXU, and assemble by a read-modify-
+    write ``dynamic_update_slice`` into the output window — overlap
+    between consecutive chunks' windows is safe because the loop is
+    ordered, and no serialized per-element scatter ever runs. Same
+    result bit-for-bit (each output element is a sum of the same
+    values in the same chunk order)."""
+    M = values.shape[-1]
+    lead = values.shape[:-1]
+    n_chunks = M // chunk
+    ids_c = ids.reshape(n_chunks, chunk)
+    col = jnp.arange(window, dtype=jnp.int32)[None, :]
+    out0 = jnp.zeros(lead + (out_size + window,), values.dtype)
+
+    def step(c, out):
+        v_c = jax.lax.dynamic_slice_in_dim(values, c * chunk, chunk,
+                                           axis=-1)
+        id_c = jax.lax.dynamic_index_in_dim(ids_c, c, keepdims=False)
+        # clamp the window start BEFORE building the one-hot: landing
+        # positions stay absolute (start + local == id) and ids whose
+        # window falls outside [0, out_size] DROP via the one-hot,
+        # matching the map path's mode="drop" — dynamic_update_slice
+        # alone would clamp the start and silently shift such sums
+        # into the last real bins
+        b_c = jnp.clip(base[c], 0, out_size)
+        oh = ((id_c - b_c)[:, None] == col)
+        part = jax.lax.dot_general(
+            v_c, oh.astype(v_c.dtype),
+            (((v_c.ndim - 1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)   # (..., window)
+        cur = jax.lax.dynamic_slice_in_dim(out, b_c, window, axis=-1)
+        return jax.lax.dynamic_update_slice_in_dim(out, cur + part, b_c,
+                                                   axis=-1)
+
+    out = jax.lax.fori_loop(0, n_chunks, step, out0)
     return out[..., :out_size]

@@ -110,19 +110,33 @@ def test_rolling_median_exact_matches_numpy(w, x):
 
 @settings(**_SETTINGS)
 @given(ids=hnp.arrays(np.int64, 512, elements=st.integers(0, 210)),
-       vals=_farr((2, 512)))
-def test_binned_window_sum_matches_bincount(ids, vals):
+       vals=_farr((2, 512)),
+       impl=st.sampled_from(["fori", "map"]))
+def test_binned_window_sum_matches_bincount(ids, vals, impl):
     """Windowed one-hot binning == np.bincount for any sorted id stream
-    whose chunk spans fit the window (leading batch axis included)."""
+    whose chunk spans fit the window (leading batch axis included) —
+    BOTH impls (the fori default and the retained lax.map A/B
+    reference must not silently diverge). The env read happens per
+    eager call, so setting it here is effective."""
+    import os
+
     M, chunk, out_size = 512, 128, 211
     ids = np.sort(ids)
     n_chunks = M // chunk
     base = ids.reshape(n_chunks, chunk)[:, 0]
     span = int((ids.reshape(n_chunks, chunk)[:, -1] - base + 1).max())
     window = -(-max(span, 1) // 128) * 128
-    got = np.asarray(binned_window_sum(
-        jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
-        jnp.asarray(base, jnp.int32), window, chunk, out_size))
+    old = os.environ.get("COMAP_BIN_IMPL")
+    os.environ["COMAP_BIN_IMPL"] = impl
+    try:
+        got = np.asarray(binned_window_sum(
+            jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+            jnp.asarray(base, jnp.int32), window, chunk, out_size))
+    finally:
+        if old is None:
+            os.environ.pop("COMAP_BIN_IMPL", None)
+        else:
+            os.environ["COMAP_BIN_IMPL"] = old
     for b in range(2):
         want = np.bincount(ids, weights=vals[b].astype(np.float64),
                            minlength=out_size)
