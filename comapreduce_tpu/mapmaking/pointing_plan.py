@@ -322,10 +322,10 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
         batch = int(os.environ.get("COMAP_BIN_BATCH", "8"))
     # default impl: the ordered fori loop — measured on-chip (round 5)
     # at production multi-RHS shape it takes the destriper 2.09 s ->
-    # 1.59 s (bench 150x -> 172x) by eliminating the chunk-major
-    # transpose, the lax.map slicing, and the serialized assembly
-    # scatter. COMAP_BIN_IMPL=map restores the batched-map path (where
-    # COMAP_BIN_BATCH applies) for A/B.
+    # 1.59 s (full bench wall 4.00 s -> 3.50 s) by eliminating the
+    # chunk-major transpose, the lax.map slicing, and the serialized
+    # assembly scatter. COMAP_BIN_IMPL=map restores the batched-map
+    # path (where COMAP_BIN_BATCH applies) for A/B.
     impl = os.environ.get("COMAP_BIN_IMPL", "fori")
     if impl == "fori":
         return _binned_window_sum_fori(values, ids, base, window, chunk,
