@@ -47,6 +47,34 @@ def test_binned_window_sum_matches_bincount():
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
 
 
+def test_binned_window_sum_sentinel_chunks_drop(monkeypatch):
+    """A chunk whose base sits AT or BEYOND out_size (all-sentinel
+    padding chunks; out-of-range id streams) must contribute NOTHING
+    to the real bins under BOTH impls — the drop contract callers rely
+    on for padding chunks. (The fori path satisfies it two ways: the
+    clamp-before-one-hot keeps landing positions absolute, and the
+    window-padded output buffer absorbs any clamped write; this test
+    pins the observable contract, not the mechanism.)"""
+    M, chunk, out_size, window = 256, 64, 100, 64
+    vals = np.ones(M, np.float32)
+    # chunk 0: real ids; chunks 1-3: sentinel streams at, past, and far
+    # past out_size (base == out_size, > out_size, >> out_size)
+    ids = np.concatenate([
+        np.sort(np.random.default_rng(0).integers(0, window - 4, 64)),
+        np.full(64, out_size), np.full(64, out_size + 10),
+        np.full(64, out_size + 1000)]).astype(np.int64)
+    base = np.array([ids[0], out_size, out_size + 10, out_size + 1000],
+                    np.int64)
+    want = np.bincount(ids[:64], weights=vals[:64], minlength=out_size)
+    for impl in ("fori", "map"):
+        monkeypatch.setenv("COMAP_BIN_IMPL", impl)
+        got = np.asarray(binned_window_sum(
+            jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+            jnp.asarray(base, jnp.int32), window, chunk, out_size))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=0,
+                                   err_msg=impl)
+
+
 @pytest.mark.parametrize("n,npix,L", [(4000, 144, 50), (2600, 100, 25)])
 def test_planned_matches_scatter_destriper(n, npix, L):
     rng = np.random.default_rng(2)
