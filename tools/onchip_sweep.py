@@ -95,13 +95,13 @@ def main() -> int:
     log_line({"kind": "onchip-tier", "rc": proc.returncode,
               "tail": proc.stdout.strip()[-300:]})
 
-    # lever (c): bin-batch sweep, baseline reused (one ~60 s measurement
-    # per session is enough; wall_s is the comparable number)
-    for batch in (8, 16, 32, 64):
-        run_bench({"COMAP_BIN_BATCH": str(batch),
+    # binning impl A/B (fori is the default since round 5; map retained
+    # as the reference path — COMAP_BIN_BATCH only applies under map)
+    for impl in ("fori", "map"):
+        run_bench({"COMAP_BIN_IMPL": impl,
                    **({"BENCH_BASELINE_S": baseline_s} if baseline_s
                       else {})},
-                  f"bin-batch-{batch}")
+                  f"bin-impl-{impl}")
 
     # two-level preconditioner A/B at production pointing: iterations
     # and wall to reach the 1e-6 spec (Jacobi expected to hit the cap)
