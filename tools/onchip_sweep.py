@@ -7,9 +7,10 @@ Runs, in order, against the real chip:
 2. BASELINE.md configs 1/2/4 (``bench.py --config N``);
 3. the on-chip pytest tier (``COMAP_ONCHIP=1 -m onchip``: real-Mosaic
    Pallas parity, on-device planned-vs-scatter destriper, fused step);
-4. a ``COMAP_BIN_BATCH`` sweep of the destriper's one-hot chunk batch
-   ("next lever (c)"), reusing the measured baseline so each point only
-   pays the TPU wall time;
+4. a ``COMAP_BIN_IMPL`` fori-vs-map A/B of the destriper's one-hot
+   binning (fori has been the default since round 5; map is the
+   retained reference path, where ``COMAP_BIN_BATCH`` applies),
+   reusing the measured baseline so each point only pays TPU wall;
 5. a joint multi-RHS vs per-band destriper timing at production pointing
    (the round-4 multi-RHS lever).
 
