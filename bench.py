@@ -478,17 +478,32 @@ def main():
         tods, weis = all_feeds(keys)           # (F, B, T) each
         return jitted_destripe(*make_bands(tods, weis))
 
+    def finish(res):
+        """Force completion through the axon tunnel with a HOST FETCH —
+        ``block_until_ready`` alone once reported ready at 2.5 ms wall
+        on a 3.4 s computation (stale local ready-state; the sweep
+        scripts learned this first). A fetched scalar cannot exist
+        before the chain that produces it ran."""
+        return float(jnp.sum(res.destriped_map))
+
     # warm-up: compile + first run
     result = run_pipeline()
-    jax.block_until_ready(result.destriped_map)
+    finish(result)
 
     n_rep = 2 if not small else 1
     best = float("inf")
     for _ in range(n_rep):
         t0 = time.perf_counter()
         result = run_pipeline()
-        jax.block_until_ready(result.destriped_map)
-        best = min(best, time.perf_counter() - t0)
+        finish(result)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+    if not small and best < 0.05:
+        # a sub-50 ms "measurement" of a production-shape chain is a
+        # tunnel artifact, never a real wall — refuse to print it
+        print(f"bench: implausible wall {best:.4f}s (tunnel ready-state "
+              "artifact?); rerun", file=sys.stderr)
+        raise SystemExit(4)
 
     n_raw = F * B * C * T
     throughput = n_raw / best
@@ -500,13 +515,13 @@ def main():
     keys_d = jax.random.split(jax.random.key(7, impl="rbg"), F)
     t0 = time.perf_counter()
     tods_d, weis_d = all_feeds(keys_d)
-    jax.block_until_ready((tods_d, weis_d))
+    float(jnp.sum(tods_d) + jnp.sum(weis_d))   # host fetch, see finish()
     reduce_wall = time.perf_counter() - t0
     band_tod_d, band_w_d = make_bands(tods_d, weis_d)
-    jax.block_until_ready((band_tod_d, band_w_d))
+    float(jnp.sum(band_w_d))
     t0 = time.perf_counter()
     r_d = jitted_destripe(band_tod_d, band_w_d)
-    jax.block_until_ready(r_d.destriped_map)
+    finish(r_d)
     destripe_wall = time.perf_counter() - t0
 
     # ---- measured reference baseline ------------------------------------
@@ -553,7 +568,7 @@ def main():
 
     def _ev_run():
         r = run_pipeline()
-        jax.block_until_ready(r.destriped_map)
+        finish(r)
 
     sds = jax.ShapeDtypeStruct((B, N_flat), jnp.float32)
     # a thunk, NOT the compiled object: jax Compiled executables are
