@@ -513,6 +513,12 @@ def main():
     # chained end-to-end pipeline): one extra rep of each half, so the
     # roofline attribution is measured instead of inferred
     keys_d = jax.random.split(jax.random.key(7, impl="rbg"), F)
+    # warm pass FIRST: the fetch's sum over (F, B, T) is its own little
+    # program, and its remote compile (~seconds through the relay) must
+    # not land inside the timed region (observed: reduce_wall 7.4 s on
+    # a 1.96 s stage the first time the fetch compiled there)
+    tods_d, weis_d = all_feeds(keys_d)
+    float(jnp.sum(tods_d) + jnp.sum(weis_d))
     t0 = time.perf_counter()
     tods_d, weis_d = all_feeds(keys_d)
     float(jnp.sum(tods_d) + jnp.sum(weis_d))   # host fetch, see finish()
