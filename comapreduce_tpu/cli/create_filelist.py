@@ -37,6 +37,9 @@ def main(argv=None) -> int:
                     help="obs database for --source (required with it)")
     ap.add_argument("--output", default="filelist.txt")
     ap.add_argument("--rejected", default="rejected.txt")
+    ap.add_argument("--prefetch", type=int, default=0,
+                    help="read-ahead queue depth (0 = serial reads; "
+                         "see docs/ingest.md)")
     args = ap.parse_args(argv)
     if args.band < 0:
         ap.error("--band must be >= 0")
@@ -57,7 +60,8 @@ def main(argv=None) -> int:
         files = [f for f in files if os.path.abspath(f) in keep]
 
     good, rejected = create_filelist(files, band=args.band,
-                                     sigma_cut_mk=args.noise_cut_mk)
+                                     sigma_cut_mk=args.noise_cut_mk,
+                                     prefetch=max(args.prefetch, 0))
     write_filelist(args.output, good)
     write_filelist(args.rejected, rejected)
     print(f"{len(good)} good -> {args.output}; "

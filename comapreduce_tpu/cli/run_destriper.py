@@ -183,19 +183,23 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   offset_length=50, n_iter=100, threshold=1e-6,
                   use_ground=False, use_calibration=True, sharded=False,
                   medfilt_window=400, tod_variant="auto",
-                  coarse_block=0):
+                  coarse_block=0, prefetch=0, cache=None):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
     iteration at production shape) is the default — including joint
     ground-template solves when the groups align to offsets (the data
     layer guarantees it; misaligned geometries and sharded ground solves
-    fall back to the general scatter path)."""
+    fall back to the general scatter path). ``prefetch``/``cache`` are
+    the streaming-ingest knobs (docs/ingest.md): reads overlap the
+    per-file host prep, and a cache shared across per-band calls skips
+    re-decoding the filelist for bands past the first."""
     data = read_comap_data(filenames, band=band, wcs=wcs, nside=nside,
                            galactic=galactic, offset_length=offset_length,
                            use_calibration=use_calibration,
                            medfilt_window=medfilt_window,
-                           tod_variant=tod_variant)
+                           tod_variant=tod_variant,
+                           prefetch=prefetch, cache=cache)
     return data, solve_band(data, offset_length=offset_length,
                             n_iter=n_iter, threshold=threshold,
                             use_ground=use_ground, sharded=sharded,
@@ -360,7 +364,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          galactic=False, offset_length=50, n_iter=100,
                          threshold=1e-6, use_calibration=True,
                          medfilt_window=400, sharded=False,
-                         tod_variant="auto", coarse_block=0):
+                         tod_variant="auto", coarse_block=0,
+                         prefetch=0, cache=None):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -379,12 +384,16 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     """
     import jax.numpy as jnp
 
+    # one shared BlockCache across the per-band reads: bands 1..n decode
+    # nothing — the pixel/weight extraction reuses band 0's decoded
+    # stores (the multi-pass workload the ingest cache exists for)
     datas = [read_comap_data(filenames, band=b, wcs=wcs, nside=nside,
                              galactic=galactic,
                              offset_length=offset_length,
                              use_calibration=use_calibration,
                              medfilt_window=medfilt_window,
-                             tod_variant=tod_variant)
+                             tod_variant=tod_variant,
+                             prefetch=prefetch, cache=cache)
              for b in bands]
     pix0 = np.asarray(datas[0].pixels)
     for d in datas[1:]:
@@ -530,6 +539,14 @@ def main(argv=None) -> int:
     # would only pay the host-side build. `coarse_precond : 0` disables.
     coarse_block = int(inputs.get("coarse_precond",
                                   0 if calibrator else 8))
+    # streaming ingest (docs/ingest.md): `[Inputs] prefetch : N` reads
+    # ahead on a background thread; `cache_mb : M` caches decoded files
+    # so every band after the first skips the HDF5 decode entirely
+    from comapreduce_tpu.ingest import IngestConfig
+
+    ingest_cfg = IngestConfig.from_mapping(inputs)  # normalises knobs
+    prefetch = ingest_cfg.prefetch
+    cache = ingest_cfg.make_cache()
 
     # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
     # binning per iteration); ground solves keep their own path.
@@ -543,7 +560,7 @@ def main(argv=None) -> int:
             offset_length=offset_length, n_iter=n_iter,
             threshold=threshold, use_calibration=use_cal,
             sharded=sharded, tod_variant=tod_variant,
-            coarse_block=coarse_block)
+            coarse_block=coarse_block, prefetch=prefetch, cache=cache)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -563,7 +580,8 @@ def main(argv=None) -> int:
                 offset_length=offset_length, n_iter=n_iter,
                 threshold=threshold, use_ground=use_ground,
                 use_calibration=use_cal, sharded=sharded,
-                tod_variant=tod_variant, coarse_block=coarse_block)
+                tod_variant=tod_variant, coarse_block=coarse_block,
+                prefetch=prefetch, cache=cache)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         write_band_map(path, data, result)

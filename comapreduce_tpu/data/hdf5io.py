@@ -264,6 +264,34 @@ class HDF5Store:
                 for k, v in kv.items():
                     target.attrs[k] = v
 
+    # -- ingest payloads ----------------------------------------------------
+    def export_payload(self) -> dict:
+        """Decoded-content snapshot for the ingest cache: ``{'data',
+        'attrs', 'source'}`` with the dict *structure* copied (arrays
+        shared). Lazy datasets must be materialised first — an open
+        h5py handle is neither cacheable nor picklable."""
+        for path, v in self._data.items():
+            if isinstance(v, h5py.Dataset):
+                raise ValueError(
+                    f"export_payload: {path!r} is still a lazy h5py "
+                    "handle; materialise it first")
+        return {"data": dict(self._data),
+                "attrs": {k: dict(v) for k, v in self._attrs.items()},
+                "source": self._mirrors}
+
+    def adopt_payload(self, payload: dict) -> "HDF5Store":
+        """Rebuild this store from an :meth:`export_payload` snapshot.
+
+        Dict structure is copied again on adoption, so two stores
+        rebuilt from one cached payload never alias each other's
+        mutable state (the arrays themselves are shared read-only).
+        """
+        self.close()
+        self._data = dict(payload["data"])
+        self._attrs = {k: dict(v) for k, v in payload["attrs"].items()}
+        self._mirrors = payload.get("source", "")
+        return self
+
     def materialise(self, path: str) -> np.ndarray:
         """Force a lazy dataset into memory and return it."""
         v = self._data[path]

@@ -1,0 +1,45 @@
+"""Streaming ingest: overlap HDF5 I/O with TPU compute.
+
+The reference pipeline is I/O-dominated: each Level-1 observation is a
+multi-GB HDF5 file, and the per-file stage loop reads each file to
+completion before any compute starts — the accelerator idles for the
+whole read. This subsystem stages ingestion the way massively parallel
+map-makers do (MAPPRAISER, arXiv:2112.03370):
+
+- :class:`Prefetcher` — a background reader thread with a *bounded*
+  queue that reads ahead over the rank-sharded filelist and yields
+  ready payloads in filelist order. Worker exceptions are captured and
+  delivered per-file (never queue-fatal), and breaking out of the
+  consumer loop shuts the worker down cleanly.
+- :class:`BlockCache` — an LRU-by-bytes cache of decoded payloads keyed
+  on ``(path, mtime)`` with optional on-disk spill, so multi-pass
+  workloads (four destriper bands over one filelist, a re-run over
+  files just reduced) skip redundant HDF5 decode.
+- :func:`prefetch_to_device` — host→device double-buffering:
+  ``jax.device_put`` of the next block is issued while the current one
+  computes (the ``flax.jax_utils.prefetch_to_device`` idiom), aware of
+  mesh shardings via :mod:`comapreduce_tpu.parallel.axes`.
+- :func:`level1_stream` / :func:`level2_stream` — the shared file
+  iteration used by both the serial fallback and the prefetched path,
+  so the two can never drift apart (``Runner.run_tod`` and
+  ``mapmaking.leveldata.read_comap_data`` both consume them).
+
+Config surface (``IngestConfig``): ``prefetch`` (queue depth; 0 keeps
+the serial path), ``cache_mb`` (0 disables the cache), ``spill_dir``.
+See ``docs/ingest.md`` for the design and knobs.
+"""
+
+from comapreduce_tpu.ingest.cache import BlockCache, payload_nbytes  # noqa: F401
+from comapreduce_tpu.ingest.config import IngestConfig  # noqa: F401
+from comapreduce_tpu.ingest.device_buffer import prefetch_to_device  # noqa: F401
+from comapreduce_tpu.ingest.prefetcher import (  # noqa: F401
+    Prefetcher,
+    PrefetchItem,
+    iter_serial,
+)
+from comapreduce_tpu.ingest.loaders import (  # noqa: F401
+    level1_stream,
+    level2_stream,
+    load_level1,
+    load_level2,
+)

@@ -1,0 +1,222 @@
+"""LRU-by-bytes block cache for decoded HDF5 payloads.
+
+Keys are ``(abspath, mtime_ns)``: a file rewritten in place (a Level-2
+checkpoint updated by a later stage, a re-generated synthetic fixture)
+gets a fresh key and the stale entry is dropped on the next lookup.
+Values are arbitrary decoded payloads — typically the ``(data, attrs)``
+dict pair of an :class:`~comapreduce_tpu.data.hdf5io.HDF5Store` — whose
+size is accounted by :func:`payload_nbytes`.
+
+Eviction is LRU by total bytes. With ``spill_dir`` set, evicted entries
+are pickled to disk instead of discarded; a later ``get`` restores them
+(and promotes them back into memory), so a multi-pass workload larger
+than RAM still skips the HDF5 re-decode. Spill files self-identify
+their key — a stale spill (file changed since) is ignored and deleted.
+
+Thread-safe: the prefetcher worker thread populates the cache while the
+consumer reads it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["BlockCache", "payload_nbytes", "file_key"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_SPILL_SUFFIX = ".ingest.pkl"
+
+
+def payload_nbytes(payload) -> int:
+    """Recursive byte estimate of a payload: ndarrays count their
+    buffers, containers recurse, everything else counts a nominal 64."""
+    if isinstance(payload, np.ndarray):
+        return int(payload.nbytes)
+    if isinstance(payload, dict):
+        return sum(payload_nbytes(v) for v in payload.values())
+    if isinstance(payload, (list, tuple, set, frozenset)):
+        return sum(payload_nbytes(v) for v in payload)
+    if isinstance(payload, (bytes, bytearray, str)):
+        return len(payload)
+    return 64
+
+
+def file_key(path: str) -> tuple:
+    """Cache key of ``path``: ``(abspath, mtime_ns)``.
+
+    Raises ``OSError`` when the file does not exist — the caller's
+    per-file fault tolerance owns that, not the cache.
+    """
+    ap = os.path.abspath(path)
+    return ap, os.stat(ap).st_mtime_ns
+
+
+class BlockCache:
+    """Byte-bounded LRU cache with optional on-disk spill.
+
+    Parameters
+    ----------
+    max_bytes:
+        In-memory budget. Entries larger than the whole budget are
+        never held in memory (they go straight to spill, or are
+        dropped).
+    spill_dir:
+        When set, evicted entries are pickled here and restored on a
+        later ``get``. Created on first use.
+    """
+
+    def __init__(self, max_bytes: int, spill_dir: str = ""):
+        self.max_bytes = int(max_bytes)
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, tuple] = OrderedDict()
+        # keys with a valid spill file on disk: content per key is
+        # immutable (the key embeds mtime), so a re-evicted promoted
+        # entry must not pay the multi-GB pickle again
+        self._on_disk: set = set()
+        self._bytes = 0
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0,
+                      "spills": 0, "spill_hits": 0}
+
+    # -- internals ---------------------------------------------------------
+    def _spill_path(self, key: tuple) -> str:
+        digest = hashlib.sha1(repr(key[0]).encode()).hexdigest()
+        return os.path.join(self.spill_dir, digest + _SPILL_SUFFIX)
+
+    def _evict_locked(self, need: int = 0) -> list:
+        """Pop LRU entries until ``need`` fits; returns the victims so
+        the caller can spill them AFTER releasing the lock — a multi-GB
+        pickle write under the lock would stall the prefetch worker and
+        the consumer against each other, serialising exactly the I/O
+        and compute this subsystem overlaps."""
+        victims = []
+        while self._entries and self._bytes + need > self.max_bytes:
+            key, (payload, nbytes) = self._entries.popitem(last=False)
+            self._bytes -= nbytes
+            self.stats["evictions"] += 1
+            victims.append((key, payload))
+        return victims
+
+    def _spill(self, victims: list) -> None:
+        """Pickle evicted entries to ``spill_dir`` (lock NOT held);
+        entries whose (immutable-per-key) content is already on disk —
+        a promoted spill hit being re-evicted — skip the rewrite."""
+        if not self.spill_dir:
+            return
+        for key, payload in victims:
+            with self._lock:
+                if key in self._on_disk:
+                    continue
+            try:
+                os.makedirs(self.spill_dir, exist_ok=True)
+                tmp = self._spill_path(key) + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump((key, payload), f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, self._spill_path(key))
+                with self._lock:
+                    self.stats["spills"] += 1
+                    self._on_disk.add(key)
+            except OSError as exc:  # spill is best-effort
+                logger.warning("BlockCache: spill failed for %s (%s)",
+                               key[0], exc)
+
+    def _load_spill(self, key: tuple):
+        path = self._spill_path(key)
+        try:
+            with open(path, "rb") as f:
+                stored_key, payload = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            return None
+        if stored_key != key:  # file changed since the spill: stale
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            with self._lock:
+                self._on_disk.discard(stored_key)
+            return None
+        with self._lock:
+            self._on_disk.add(key)
+        return payload
+
+    # -- public API --------------------------------------------------------
+    @property
+    def current_bytes(self) -> int:
+        return self._bytes
+
+    def get(self, path: str):
+        """Cached payload for ``path`` at its *current* mtime, or None."""
+        try:
+            key = file_key(path)
+        except OSError:
+            return None
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.stats["hits"] += 1
+                return hit[0]
+            # a stale same-path entry (older mtime) is dead weight: drop
+            for k in [k for k in self._entries if k[0] == key[0]]:
+                _, nb = self._entries.pop(k)
+                self._bytes -= nb
+        if self.spill_dir:
+            payload = self._load_spill(key)
+            if payload is not None:
+                with self._lock:
+                    self.stats["hits"] += 1
+                    self.stats["spill_hits"] += 1
+                # promote back into memory — but an oversized payload
+                # would only bounce straight back out through another
+                # full pickle write; leave those on disk
+                if payload_nbytes(payload) <= self.max_bytes:
+                    self.put(path, payload, key=key)
+                return payload
+        with self._lock:
+            self.stats["misses"] += 1
+        return None
+
+    def put(self, path: str, payload, nbytes: int | None = None,
+            key: tuple | None = None) -> None:
+        """Insert ``payload`` for ``path``; evicts LRU entries over
+        budget. Oversized payloads (> the whole budget) go straight to
+        spill (when configured) and are never held in memory.
+
+        ``key`` lets the caller pin the identity observed BEFORE a slow
+        decode: stat'ing here would pair a file rewritten mid-read with
+        its stale decoded content (see ``prefetcher._load_one``).
+        """
+        if key is None:
+            try:
+                key = file_key(path)
+            except OSError:
+                return
+        nbytes = payload_nbytes(payload) if nbytes is None else int(nbytes)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            if nbytes > self.max_bytes:
+                # never resident: spill directly without evicting the
+                # (smaller, hotter) entries already in memory
+                self.stats["evictions"] += 1
+                victims = [(key, payload)]
+            else:
+                victims = self._evict_locked(need=nbytes)
+                self._entries[key] = (payload, nbytes)
+                self._bytes += nbytes
+        self._spill(victims)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0

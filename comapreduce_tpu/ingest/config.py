@@ -1,0 +1,86 @@
+"""Ingest configuration: the ``ingest: {prefetch: N, cache_mb: M}`` knob.
+
+One small value object shared by every ingest consumer (the pipeline
+Runner's TOML ``[ingest]`` table, the destriper driver's ``[Inputs]``
+keys) so the knob names cannot drift between entry points.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["IngestConfig"]
+
+
+@dataclass(frozen=True)
+class IngestConfig:
+    """Knobs for the streaming ingest subsystem.
+
+    prefetch:
+        Read-ahead queue depth. 0 (default) keeps the serial path —
+        files are read inline on the consumer thread, exactly the
+        pre-ingest behaviour. ``>= 1`` starts the background reader.
+    cache_mb:
+        In-memory :class:`~comapreduce_tpu.ingest.cache.BlockCache`
+        budget in MiB; 0 disables caching.
+    spill_dir:
+        Optional directory for on-disk spill of evicted cache entries.
+    eager_tod:
+        Prefetched Level-1 reads materialise the big
+        ``spectrometer/tod`` dataset on the worker thread (that *is*
+        the read being overlapped); the serial path keeps it lazy as
+        before. Only consulted when ``prefetch >= 1``.
+    """
+
+    prefetch: int = 0
+    cache_mb: float = 0.0
+    spill_dir: str = ""
+    eager_tod: bool = True
+
+    def __post_init__(self):
+        # normalise once, here, instead of at every consumer: INI
+        # coercion turns 'prefetch : none' (or an empty value) into
+        # None, and None must mean "disabled", not a downstream
+        # TypeError; negative values clamp to disabled likewise
+        object.__setattr__(self, "prefetch",
+                           max(int(self.prefetch or 0), 0))
+        object.__setattr__(self, "cache_mb",
+                           max(float(self.cache_mb or 0.0), 0.0))
+        object.__setattr__(self, "spill_dir", str(self.spill_dir or ""))
+        object.__setattr__(self, "eager_tod",
+                           True if self.eager_tod is None
+                           else bool(self.eager_tod))
+
+    # the knob names, once — every config entry point (TOML [ingest]
+    # table, INI [Inputs] keys, CLI flags) extracts against this tuple
+    KNOBS = ("prefetch", "cache_mb", "spill_dir", "eager_tod")
+
+    @classmethod
+    def from_mapping(cls, mapping) -> "IngestConfig":
+        """Pick the ingest knobs out of a wider config mapping (an INI
+        ``[Inputs]`` section, say), ignoring unrelated keys."""
+        return cls(**{k: mapping[k] for k in cls.KNOBS if k in mapping})
+
+    @classmethod
+    def coerce(cls, value) -> "IngestConfig":
+        """Build from None / dict / IngestConfig (config-file plumbing)."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            known = {k: value[k] for k in cls.KNOBS if k in value}
+            unknown = set(value) - set(known)
+            if unknown:
+                raise ValueError(f"unknown ingest keys: {sorted(unknown)}")
+            return cls(**known)
+        raise TypeError(f"cannot build IngestConfig from {type(value)}")
+
+    def make_cache(self):
+        """A configured BlockCache, or None when caching is off."""
+        if self.cache_mb <= 0:
+            return None
+        from comapreduce_tpu.ingest.cache import BlockCache
+
+        return BlockCache(max_bytes=int(self.cache_mb * (1 << 20)),
+                          spill_dir=self.spill_dir)

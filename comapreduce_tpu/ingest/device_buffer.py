@@ -1,0 +1,81 @@
+"""Host→device double-buffering for ingest streams.
+
+``jax.device_put`` is asynchronous: issuing the transfer of block
+``i+1`` while block ``i`` computes hides the PCIe/ICI copy behind
+compute (the ``flax.jax_utils.prefetch_to_device`` idiom). Unlike the
+flax helper this one is mesh/sharding-aware: a ``NamedSharding`` (or a
+pytree of them matching the block structure) places each block directly
+into its sharded layout, and :func:`sharding_for_dataset` derives the
+placement from the dataset axis-role table in
+:mod:`comapreduce_tpu.parallel.axes` so ingest and compute agree on the
+layout without a reshard.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Any, Callable, Iterable, Iterator
+
+__all__ = ["prefetch_to_device", "sharding_for_dataset"]
+
+
+def sharding_for_dataset(dataset: str, mesh=None):
+    """The ingest-side sharding for a COMAP dataset path: the axis-role
+    mapping of :func:`comapreduce_tpu.parallel.axes.sharding_for` on
+    ``mesh`` (default: a 1-D ``('feed', 'time')`` mesh over the local
+    devices via :func:`~comapreduce_tpu.parallel.mesh.feed_time_mesh`).
+    """
+    from comapreduce_tpu.parallel import axes as axes_mod
+    from comapreduce_tpu.parallel.mesh import feed_time_mesh
+
+    if mesh is None:
+        mesh = feed_time_mesh()
+    return axes_mod.sharding_for(dataset, mesh)
+
+
+def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
+                       sharding: Any | Callable[[Any], Any] = None
+                       ) -> Iterator[Any]:
+    """Yield device-resident blocks, keeping ``size`` in flight.
+
+    Parameters
+    ----------
+    blocks:
+        Host blocks — arrays or pytrees (``TODBlock`` works as-is).
+    size:
+        In-flight transfer depth. 2 = classic double-buffering: the
+        next block's H2D copy overlaps the current block's compute.
+        1 degenerates to plain per-block ``device_put``.
+    sharding:
+        ``None`` (commit to the default device), a ``Sharding`` applied
+        to every leaf, a pytree of shardings matching the block
+        structure, or a callable ``block -> sharding (pytree)`` for
+        streams of heterogeneous blocks.
+
+    The transfer queue drains lazily: breaking out of the consumer loop
+    abandons at most ``size`` in-flight blocks (harmless — transfers
+    complete in the background and are garbage-collected).
+    """
+    import jax
+
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+
+    def put(block):
+        shard = sharding(block) if callable(sharding) else sharding
+        if shard is None:
+            return jax.device_put(block)
+        return jax.device_put(block, shard)
+
+    it = iter(blocks)
+    buf: collections.deque = collections.deque()
+    for block in itertools.islice(it, size):
+        buf.append(put(block))
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

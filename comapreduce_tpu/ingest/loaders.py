@@ -1,0 +1,122 @@
+"""File loaders + shared streams for the pipeline's two file kinds.
+
+Loaders run on the prefetcher's worker thread and return *payloads*:
+either a live store object (the lazy serial Level-1 case, which keeps
+an open h5py handle) or a decoded payload dict
+(:meth:`HDF5Store.export_payload`) that is cache- and pickle-friendly.
+The streams rebuild a fresh store wrapper per consumption, so a cached
+payload handed out twice never aliases mutable wrapper state (the
+underlying numpy arrays ARE shared — consumers must not mutate them in
+place, and none do: every stage computes new arrays).
+
+``level1_stream``/``level2_stream`` are the ONE iteration code path for
+serial and prefetched ingest (``prefetch=0`` selects the inline serial
+read; ``>= 1`` the background reader) — consumers cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from comapreduce_tpu.data.level import COMAPLevel1, COMAPLevel2
+from comapreduce_tpu.ingest.prefetcher import (PrefetchItem, Prefetcher,
+                                               iter_serial)
+
+__all__ = ["load_level1", "load_level2", "level1_stream", "level2_stream"]
+
+
+def load_level1(filename: str, eager_tod: bool = True):
+    """Read a Level-1 file. ``eager_tod=True`` materialises the big
+    ``spectrometer/tod`` dataset here — on the prefetcher's worker
+    thread that IS the read being overlapped — and closes the file;
+    ``False`` keeps the reference behaviour (lazy handle, open file)."""
+    data = COMAPLevel1()
+    data.read(filename)
+    if not eager_tod:
+        return data
+    for path in data.lazy_paths:
+        if path in data:
+            data.materialise(path)
+    data.close()
+    return data.export_payload()
+
+
+def load_level2(filename: str):
+    """Read a Level-2 file into a decoded payload dict."""
+    lvl2 = COMAPLevel2(filename=filename)
+    return lvl2.export_payload()
+
+
+def _rebuild(cls, payload, **kwargs):
+    """Payload -> fresh store wrapper; live stores pass through."""
+    if isinstance(payload, dict) and "data" in payload and \
+            "attrs" in payload:
+        store = cls(**kwargs)
+        store.adopt_payload(payload)
+        return store
+    return payload
+
+
+def _stream(filenames, loader, rebuild, prefetch: int = 0,
+            cache=None) -> Iterator[PrefetchItem]:
+    if prefetch >= 1:
+        items = Prefetcher(filenames, loader, depth=prefetch, cache=cache)
+    else:
+        items = iter_serial(filenames, loader, cache)
+    try:
+        for item in items:
+            if item.fatal:
+                # a broken file LISTING aborts the run on the serial
+                # path (the iterator raises mid-loop); the prefetched
+                # path must fail identically, not truncate the run as
+                # one "bad file"
+                raise item.error
+            if item.error is None:
+                item.payload = rebuild(item.payload)
+            yield item
+    finally:
+        # deterministic worker shutdown: consumers call .close() on
+        # this generator (or exhaust it); either way the Prefetcher
+        # must not linger decoding ahead behind a kept-alive traceback
+        close = getattr(items, "close", None)
+        if close is not None:
+            close()
+
+
+def level1_stream(filenames, prefetch: int = 0, cache=None,
+                  eager_tod: bool = True,
+                  eager_for=None) -> Iterator[PrefetchItem]:
+    """Ordered ``PrefetchItem``s of :class:`COMAPLevel1` views.
+
+    The TOD is materialised on the worker when prefetching (that is the
+    read being overlapped) or when a cache is present (a lazy handle
+    cannot be cached); the plain serial cache-less path keeps it lazy,
+    exactly the pre-ingest behaviour. ``eager_tod=False`` always wins:
+    it keeps reads lazy even with a cache configured (Level-1 payloads
+    then simply bypass the cache — the explicit RAM ceiling outranks
+    cache hits).
+
+    ``eager_for`` (``path -> bool``) vetoes materialisation per file —
+    the Runner passes its resume test, so a file whose whole stage
+    chain will be skipped is not read end to end just to be dropped.
+    A lazily-read file is never cached (live h5py handles are neither
+    shareable nor picklable).
+    """
+    eager = eager_tod and (prefetch >= 1 or cache is not None)
+
+    def loader(path):
+        eager_this = eager and (eager_for is None or eager_for(path))
+        return load_level1(path, eager_tod=eager_this)
+
+    return _stream(filenames, loader,
+                   lambda p: _rebuild(COMAPLevel1, p),
+                   prefetch=prefetch, cache=cache)
+
+
+def level2_stream(filenames, prefetch: int = 0,
+                  cache=None) -> Iterator[PrefetchItem]:
+    """Ordered ``PrefetchItem``s of :class:`COMAPLevel2` views (the
+    destriper's filelist reader; always fully decoded)."""
+    return _stream(filenames, load_level2,
+                   lambda p: _rebuild(COMAPLevel2, p, filename=""),
+                   prefetch=prefetch, cache=cache)

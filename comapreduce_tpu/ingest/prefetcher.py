@@ -1,0 +1,229 @@
+"""Background-thread read-ahead with a bounded queue.
+
+One reader thread walks the (already rank-sharded) filelist in order,
+decodes each file with the caller's ``loader`` and parks the result in
+a bounded queue; the consumer iterates ready payloads while the worker
+reads ahead. HDF5 access stays on a single thread — h5py serialises
+library calls behind a global lock anyway, so extra reader threads buy
+nothing while losing the trivial ordering guarantee.
+
+Failure contract: a loader exception is captured into that file's
+:class:`PrefetchItem` and the worker moves on — one bad file never
+kills the queue or the files behind it (the consumer maps it onto the
+pipeline's per-file "BAD FILE" fault tolerance). Breaking out of the
+consumer loop (or ``close()``) stops the worker promptly: every
+blocking queue operation polls a stop event.
+
+:func:`iter_serial` is the same iteration contract without the thread —
+the serial fallback and the prefetched path share one code path in
+every consumer.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator
+
+__all__ = ["Prefetcher", "PrefetchItem", "iter_serial"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_POLL_S = 0.1  # stop-event poll period for blocking queue ops
+
+
+@dataclass
+class PrefetchItem:
+    """One file's ingest result: exactly one of ``payload``/``error``."""
+
+    index: int
+    filename: str
+    payload: Any = None
+    error: BaseException | None = None
+    read_s: float = 0.0     # wall seconds spent decoding (0 on cache hit)
+    cached: bool = False    # served from the BlockCache
+    # True marks a failure of the file *listing* itself, not of one
+    # file: consumers must abort (the serial path's iterator raises at
+    # the same point), never map it onto per-file fault tolerance
+    fatal: bool = False
+
+    def result(self):
+        """Payload, re-raising the captured per-file error."""
+        if self.error is not None:
+            raise self.error
+        return self.payload
+
+
+def _load_one(index: int, filename: str, loader, cache) -> PrefetchItem:
+    """Shared load step (cache probe -> loader -> cache fill) used by
+    both the worker thread and :func:`iter_serial`."""
+    t0 = time.perf_counter()
+    try:
+        key = None
+        if cache is not None:
+            payload = cache.get(filename)
+            if payload is not None:
+                return PrefetchItem(index, filename, payload=payload,
+                                    read_s=time.perf_counter() - t0,
+                                    cached=True)
+            # identity BEFORE the (possibly long) decode: a file
+            # rewritten mid-read must not pair its new mtime with the
+            # stale content we are about to load
+            from comapreduce_tpu.ingest.cache import file_key
+
+            key = file_key(filename)
+        payload = loader(filename)
+        # only decoded-payload dicts are cacheable: a live store (lazy
+        # h5py handle) must never reach the pickle-based disk spill
+        if cache is not None and isinstance(payload, dict):
+            cache.put(filename, payload, key=key)
+        return PrefetchItem(index, filename, payload=payload,
+                            read_s=time.perf_counter() - t0)
+    except Exception as exc:  # noqa: BLE001 — per-file fault tolerance
+        return PrefetchItem(index, filename, error=exc,
+                            read_s=time.perf_counter() - t0)
+
+
+def iter_serial(filenames: Iterable[str], loader: Callable[[str], Any],
+                cache=None) -> Iterator[PrefetchItem]:
+    """The serial path: identical items, read lazily at ``next()``."""
+    for i, fname in enumerate(filenames):
+        yield _load_one(i, fname, loader, cache)
+
+
+class Prefetcher:
+    """Iterate ``PrefetchItem``s over ``filenames``, reading ahead.
+
+    Parameters
+    ----------
+    filenames:
+        Iterable of paths (consumed lazily, so a generator — e.g. a
+        lazy rank shard — is fine).
+    loader:
+        ``path -> payload``; runs on the worker thread. Exceptions are
+        captured per-file.
+    depth:
+        Queue bound: at most ``depth`` decoded payloads wait in the
+        queue, plus one in the worker's hand (blocked on a full queue)
+        and the one the consumer currently processes — size host
+        memory for ``depth + 2`` decoded files.
+    cache:
+        Optional :class:`~comapreduce_tpu.ingest.cache.BlockCache`.
+
+    Use as an iterator (it closes itself when exhausted *or* when the
+    consumer breaks early) or as a context manager for explicit scope.
+    ``depth_log`` records ``(t_rel_s, qsize)`` after every enqueue —
+    the bench's queue-occupancy-over-time observable.
+    """
+
+    def __init__(self, filenames: Iterable[str],
+                 loader: Callable[[str], Any], depth: int = 2,
+                 cache=None, name: str = "ingest-prefetch"):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.depth = int(depth)
+        self._loader = loader
+        self._cache = cache
+        self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._sentinel = object()
+        self.depth_log: list[tuple[float, int]] = []
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._work, args=(iter(filenames),), name=name,
+            daemon=True)
+        self._thread.start()
+
+    # -- worker ------------------------------------------------------------
+    def _put(self, item) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self, files: Iterator[str]) -> None:
+        index = 0
+        try:
+            while not self._stop.is_set():
+                try:
+                    fname = next(files)
+                except StopIteration:
+                    break
+                except Exception as exc:  # noqa: BLE001 — a broken
+                    # filelist generator must surface to the consumer,
+                    # not vanish with the thread; fatal: the serial
+                    # path would raise out of its loop here, not skip
+                    # one file
+                    self._put(PrefetchItem(index, "<filelist>",
+                                           error=exc, fatal=True))
+                    break
+                item = _load_one(index, fname, self._loader, self._cache)
+                if not self._put(item):
+                    return
+                self.depth_log.append((time.perf_counter() - self._t0,
+                                       self._queue.qsize()))
+                index += 1
+        except BaseException as exc:  # noqa: BLE001 — even SystemExit
+            # from a loader must reach the consumer as a FATAL item:
+            # sentinel-after-crash would read as a clean (truncated) end
+            self._put(PrefetchItem(index, "<worker>", error=exc,
+                                   fatal=True))
+            raise
+        finally:
+            # ALWAYS mark end-of-stream (after any fatal item above) so
+            # the consumer never blocks on a dead worker
+            self._put(self._sentinel)
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self) -> Iterator[PrefetchItem]:
+        try:
+            while True:
+                try:
+                    item = self._queue.get(timeout=_POLL_S)
+                except queue.Empty:
+                    if not self._thread.is_alive() and self._queue.empty():
+                        if self._stop.is_set():
+                            return  # closed by the consumer
+                        # worker died without its sentinel: a silent
+                        # clean-looking end would truncate the run (a
+                        # short results list with nothing flagged) —
+                        # fail loudly like the serial path would
+                        raise RuntimeError(
+                            "Prefetcher worker died without completing "
+                            "the filelist")
+                    continue
+                if item is self._sentinel:
+                    return
+                yield item
+        finally:
+            self.close()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the worker and join it. Idempotent; safe mid-iteration
+        (the early-exit path of a breaking consumer)."""
+        self._stop.set()
+        # drain so a worker blocked on a full queue sees the stop event
+        # on its next put poll rather than after a timeout
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():  # pragma: no cover - loader hang
+                logger.warning("Prefetcher: worker did not stop within "
+                               "%.1f s (loader stuck in C code?)", timeout)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -12,8 +12,6 @@ import logging
 
 import numpy as np
 
-from comapreduce_tpu.data.level import COMAPLevel2
-
 __all__ = ["noise_level_mk", "create_filelist", "write_filelist"]
 
 logger = logging.getLogger("comapreduce_tpu")
@@ -37,20 +35,37 @@ def noise_level_mk(lvl2, band: int = 0) -> float:
 
 
 def create_filelist(level2_files, band: int = 0,
-                    sigma_cut_mk: float = 4.0):
-    """Returns ``(good, rejected)`` file lists by the noise cut."""
+                    sigma_cut_mk: float = 4.0,
+                    prefetch: int = 0, cache=None):
+    """Returns ``(good, rejected)`` file lists by the noise cut.
+
+    ``prefetch``/``cache`` route the reads through the streaming ingest
+    subsystem (``ingest.level2_stream``): curation ahead of a destriper
+    run shares its :class:`~comapreduce_tpu.ingest.cache.BlockCache`,
+    so the map-maker's first pass over the curated list skips the
+    decode entirely."""
+    from comapreduce_tpu.ingest import level2_stream
+
     good, rejected = [], []
-    for fname in level2_files:
-        try:
-            lvl2 = COMAPLevel2(filename=fname)
-            sigma = noise_level_mk(lvl2, band)
-        except (OSError, KeyError, IndexError) as exc:
-            # IndexError: a band beyond the file's band count — reject
-            # the file (and warn) rather than crash the whole curation
-            logger.warning("create_filelist: BAD FILE %s (%s)", fname, exc)
-            rejected.append(fname)
-            continue
-        (good if sigma < sigma_cut_mk else rejected).append(fname)
+    stream = level2_stream(level2_files, prefetch=prefetch, cache=cache)
+    try:
+        for item in stream:
+            fname = item.filename
+            try:
+                if item.error is not None:
+                    raise item.error
+                sigma = noise_level_mk(item.payload, band)
+            except (OSError, KeyError, IndexError) as exc:
+                # IndexError: a band beyond the file's band count —
+                # reject the file (and warn) rather than crash the
+                # whole curation
+                logger.warning("create_filelist: BAD FILE %s (%s)",
+                               fname, exc)
+                rejected.append(fname)
+                continue
+            (good if sigma < sigma_cut_mk else rejected).append(fname)
+    finally:
+        stream.close()  # stop the read-ahead worker deterministically
     return good, rejected
 
 

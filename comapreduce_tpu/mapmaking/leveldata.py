@@ -169,7 +169,8 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     speed_range: tuple = (0.1, 0.45),
                     sun_centric: bool = False,
                     min_sun_distance_deg: float = 10.0,
-                    tod_variant: str = "auto") -> DestriperData:
+                    tod_variant: str = "auto",
+                    prefetch: int = 0, cache=None) -> DestriperData:
     """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
     ``nside`` selects the pixelisation. ``mask_turnarounds`` zero-weights
     samples outside the ``speed_range`` deg/s scan-speed band (the legacy
@@ -193,103 +194,123 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
       ``Level1Averaging`` product — coarse channels are combined by
       inverse-variance (``1/stddev^2``) and those variances also supply
       the destriper weights (a frequency_binned-only store has no
-      ``averaged_tod/weights``)."""
+      ``averaged_tod/weights``).
+
+    ``prefetch >= 1`` reads ahead on a background thread (bounded queue
+    of that depth) so HDF5 decode overlaps the per-file host compute;
+    ``cache`` (a :class:`~comapreduce_tpu.ingest.cache.BlockCache`)
+    lets multi-pass workloads — the per-band destriper loop over one
+    filelist — skip redundant decode. Both paths share one iteration
+    (``ingest.level2_stream``), so results are identical."""
+    from comapreduce_tpu.ingest import level2_stream
+
     if (wcs is None) == (nside is None):
         raise ValueError("pass exactly one of wcs= or nside=")
     variants = ("auto", "gain_filtered", "original", "frequency_binned")
     if tod_variant not in variants:
         raise ValueError(f"tod_variant must be one of {variants}")
+    filenames = list(filenames)
     tods, pixs, wgts, gids, azs = [], [], [], [], []
     group = 0
     kept_files = []
-    for fname in filenames:
-        try:
-            lvl2 = COMAPLevel2(filename=fname)
-            if tod_variant == "frequency_binned":
-                tod_fb, weights, (F, B, T) = _read_frequency_binned(
-                    lvl2, band)
-            else:
-                tod_fb, weights, (F, B, T) = _read_averaged(
-                    lvl2, band, tod_variant)
-        except (OSError, KeyError) as exc:
-            logger.warning("BAD FILE %s (%s)", fname, exc)
-            continue
-        if tod_fb is None:
-            logger.warning("%s: band %d out of range", fname, band)
-            continue
-        is_cal = lvl2.is_calibrator
-        src_name = lvl2.source_name
-        edges = np.asarray(lvl2.scan_edges)
-        use, wzero = _truncated_scan_mask(edges, T, offset_length, edge_frac)
-        if not use.any():
-            logger.warning("%s: no usable scans", fname)
-            continue
-        weights[:, wzero] = 0.0
-        if "spikes/spike_mask" in lvl2:
-            sm = np.asarray(lvl2["spikes/spike_mask"])[:, band] > 0
-            weights[sm] = 0.0
-        if use_calibration and "astro_calibration/calibration_factors" \
-                in lvl2:
-            fac = np.asarray(
-                lvl2["astro_calibration/calibration_factors"])[:, band]
-            good = np.asarray(
-                lvl2["astro_calibration/calibration_good"])[:, band] > 0
-            safe = np.where(good & (fac > 0), fac, 1.0)
-            tod_fb = tod_fb / safe[:, None].astype(np.float32)
-            weights[~good] = 0.0
-        if not is_cal and medfilt_window > 1:
-            w = min(medfilt_window, max(3, T // 2 * 2 - 1))
-            tod_fb = tod_fb - np.asarray(rolling_median(
-                jnp.asarray(tod_fb), w))
-        ra = np.asarray(lvl2.ra, np.float64)
-        dec = np.asarray(lvl2.dec, np.float64)
-        az_full = np.asarray(lvl2.az, np.float64)
-        if mask_turnarounds:
-            el_full = np.asarray(lvl2.el, np.float64)
-            mjd_t = np.asarray(lvl2.mjd, np.float64)
-            dt = np.median(np.diff(mjd_t)) * 86400.0 if mjd_t.size > 1 \
-                else 0.02
-            ok_speed = scan_speed_mask(az_full, el_full,
-                                       sample_rate=1.0 / max(dt, 1e-6),
-                                       speed_range=speed_range)
-            weights[~ok_speed] = 0.0
-        if sun_centric:
-            from comapreduce_tpu.mapmaking.wcs import angular_separation
+    stream = level2_stream(filenames, prefetch=prefetch, cache=cache)
+    try:
+        for item in stream:
+            fname = item.filename
+            try:
+                if item.error is not None:
+                    raise item.error  # per-file: same handling as a
+                    # decode error below; non-(OSError, KeyError)
+                    # still propagates
+                lvl2 = item.payload
+                if tod_variant == "frequency_binned":
+                    tod_fb, weights, (F, B, T) = _read_frequency_binned(
+                        lvl2, band)
+                else:
+                    tod_fb, weights, (F, B, T) = _read_averaged(
+                        lvl2, band, tod_variant)
+            except (OSError, KeyError) as exc:
+                logger.warning("BAD FILE %s (%s)", fname, exc)
+                continue
+            if tod_fb is None:
+                logger.warning("%s: band %d out of range", fname, band)
+                continue
+            is_cal = lvl2.is_calibrator
+            src_name = lvl2.source_name
+            edges = np.asarray(lvl2.scan_edges)
+            use, wzero = _truncated_scan_mask(edges, T, offset_length, edge_frac)
+            if not use.any():
+                logger.warning("%s: no usable scans", fname)
+                continue
+            weights[:, wzero] = 0.0
+            if "spikes/spike_mask" in lvl2:
+                sm = np.asarray(lvl2["spikes/spike_mask"])[:, band] > 0
+                weights[sm] = 0.0
+            if use_calibration and "astro_calibration/calibration_factors" \
+                    in lvl2:
+                fac = np.asarray(
+                    lvl2["astro_calibration/calibration_factors"])[:, band]
+                good = np.asarray(
+                    lvl2["astro_calibration/calibration_good"])[:, band] > 0
+                safe = np.where(good & (fac > 0), fac, 1.0)
+                tod_fb = tod_fb / safe[:, None].astype(np.float32)
+                weights[~good] = 0.0
+            if not is_cal and medfilt_window > 1:
+                w = min(medfilt_window, max(3, T // 2 * 2 - 1))
+                tod_fb = tod_fb - np.asarray(rolling_median(
+                    jnp.asarray(tod_fb), w))
+            ra = np.asarray(lvl2.ra, np.float64)
+            dec = np.asarray(lvl2.dec, np.float64)
+            az_full = np.asarray(lvl2.az, np.float64)
+            if mask_turnarounds:
+                el_full = np.asarray(lvl2.el, np.float64)
+                mjd_t = np.asarray(lvl2.mjd, np.float64)
+                dt = np.median(np.diff(mjd_t)) * 86400.0 if mjd_t.size > 1 \
+                    else 0.02
+                ok_speed = scan_speed_mask(az_full, el_full,
+                                           sample_rate=1.0 / max(dt, 1e-6),
+                                           speed_range=speed_range)
+                weights[~ok_speed] = 0.0
+            if sun_centric:
+                from comapreduce_tpu.mapmaking.wcs import angular_separation
 
-            mjd0 = float(np.asarray(lvl2.mjd, np.float64)[0])
-            lon, lat = sun_centric_coords(ra, dec, mjd0)
-            if min_sun_distance_deg > 0:
-                near = angular_separation(0.0, 0.0, lon, lat) \
-                    < min_sun_distance_deg
-                weights[near] = 0.0
-        else:
-            lon, lat = (e2g(ra, dec) if galactic else (ra, dec))
-        for ifeed in range(F):
-            if feed_mask is not None and not feed_mask[ifeed]:
-                continue
-            w_f = weights[ifeed, use]
-            if not (w_f > 0).any():
-                continue
-            if wcs is not None:
-                pix = wcs.ang2pix(lon[ifeed, use], lat[ifeed, use])
-                pix = np.asarray(pix, np.int64)
+                mjd0 = float(np.asarray(lvl2.mjd, np.float64)[0])
+                lon, lat = sun_centric_coords(ra, dec, mjd0)
+                if min_sun_distance_deg > 0:
+                    near = angular_separation(0.0, 0.0, lon, lat) \
+                        < min_sun_distance_deg
+                    weights[near] = 0.0
             else:
-                pix = np.asarray(hp.ang2pix_lonlat(
-                    nside, lon[ifeed, use], lat[ifeed, use]), np.int64)
-            a = az_full[ifeed, use]
-            throw = max(np.max(a) - np.min(a), 1e-3)
-            a_norm = (2.0 * (a - np.min(a)) / throw - 1.0).astype(np.float32)
-            tods.append(np.nan_to_num(tod_fb[ifeed, use]))
-            pixs.append(pix)
-            wgts.append(np.nan_to_num(w_f))
-            gids.append(np.full(w_f.size, group, np.int32))
-            azs.append(a_norm)
-            group += 1
-        kept_files.append(fname)
+                lon, lat = (e2g(ra, dec) if galactic else (ra, dec))
+            for ifeed in range(F):
+                if feed_mask is not None and not feed_mask[ifeed]:
+                    continue
+                w_f = weights[ifeed, use]
+                if not (w_f > 0).any():
+                    continue
+                if wcs is not None:
+                    pix = wcs.ang2pix(lon[ifeed, use], lat[ifeed, use])
+                    pix = np.asarray(pix, np.int64)
+                else:
+                    pix = np.asarray(hp.ang2pix_lonlat(
+                        nside, lon[ifeed, use], lat[ifeed, use]), np.int64)
+                a = az_full[ifeed, use]
+                throw = max(np.max(a) - np.min(a), 1e-3)
+                a_norm = (2.0 * (a - np.min(a)) / throw - 1.0).astype(np.float32)
+                tods.append(np.nan_to_num(tod_fb[ifeed, use]))
+                pixs.append(pix)
+                wgts.append(np.nan_to_num(w_f))
+                gids.append(np.full(w_f.size, group, np.int32))
+                azs.append(a_norm)
+                group += 1
+            kept_files.append(fname)
+    finally:
+        stream.close()  # stop the read-ahead worker even on an
+        # exception the per-file (OSError, KeyError) net does not catch
 
     if not tods:
         raise RuntimeError("no usable data in filelist "
-                           f"({len(list(filenames))} files)")
+                           f"({len(filenames)} files)")
     tod = np.concatenate(tods)
     pixels = np.concatenate(pixs)
     weights = np.concatenate(wgts)

@@ -115,15 +115,21 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
 
     if window >= _SELECT_MEDIAN_MIN_PALLAS and x.dtype == jnp.float32:
         from comapreduce_tpu.ops.pallas_median import (
-            pallas_window_ok, rolling_median_windows_pallas)
-        if pallas_window_ok(window):
+            pallas_supported, pallas_window_ok,
+            rolling_median_windows_pallas)
+        if pallas_window_ok(window) and pallas_supported():
             # windowed selection entirely in VMEM (Mosaic kernel): no
             # HBM window mats, no layout copies — bit-identical output
-            # (including NaN-in-window -> NaN). Dispatch resolves at
-            # LOWERING time, not trace time: a CPU-placed computation
-            # traced on a TPU host takes the XLA branch instead of
-            # embedding an unlowerable Mosaic kernel ('axon' is the
-            # tunnelled-TPU platform name).
+            # (including NaN-in-window -> NaN). ``pallas_supported()``
+            # gates at TRACE time: current jax lowers EVERY
+            # ``platform_dependent`` branch, so on a CPU-only host an
+            # unlowerable Mosaic kernel in the unselected branch still
+            # breaks CPU lowering — keep it out of the jaxpr entirely.
+            # Residual limitation: on a TPU-default host a CPU-placed
+            # trace of this window still embeds the kernel and fails to
+            # lower (pre-existing; per-placement selection needs a
+            # lowering-time gate jax no longer offers). 'axon' is the
+            # tunnelled-TPU platform name.
             def _pallas(p):
                 return rolling_median_windows_pallas(
                     p, window, chunk=-(-max(chunk, 128) // 128) * 128)

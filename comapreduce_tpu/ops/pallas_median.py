@@ -53,10 +53,12 @@ def pallas_supported() -> bool:
     """True when the PROCESS-DEFAULT backend can run the Mosaic
     (TPU-only) kernel; 'axon' is the tunnelled TPU platform.
 
-    Informational helper (tests/benches). Dispatch itself does NOT use
-    it: ``rolling_median`` selects the kernel via
-    ``jax.lax.platform_dependent``, which resolves per LOWERING platform
-    — a CPU-placed computation on a TPU host takes the XLA branch."""
+    ``rolling_median`` uses this as its TRACE-time gate: current jax
+    lowers every ``platform_dependent`` branch, so the Mosaic kernel
+    must stay out of the jaxpr entirely on CPU-only hosts. On a
+    TPU-default host the ``platform_dependent`` lowering-time selection
+    still applies to TPU placements (CPU placements there cannot lower
+    the embedded kernel — pre-existing limitation)."""
     backend = jax.default_backend()
     return backend.startswith("tpu") or backend == "axon"
 

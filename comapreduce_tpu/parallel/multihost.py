@@ -66,7 +66,7 @@ def maybe_initialize_distributed() -> bool:
     """
     import jax
 
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized(jax):
         return True
     addr = next((os.environ[k] for k in _ENV_ADDR if os.environ.get(k)),
                 None)
@@ -93,6 +93,17 @@ def maybe_initialize_distributed() -> bool:
     logger.info("distributed: process %d/%d",
                 jax.process_index(), jax.process_count())
     return True
+
+
+def _distributed_is_initialized(jax) -> bool:
+    """``jax.distributed.is_initialized()`` exists only from jax 0.5;
+    older versions expose the same fact as a non-None global client."""
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
 
 
 def rank_info() -> tuple[int, int]:

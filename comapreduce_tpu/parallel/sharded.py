@@ -27,9 +27,22 @@ from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
                                         scan_starts_lengths)
 
 try:  # jax >= 0.4.35 exports shard_map at top level
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _jax_shard_map
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma; probe
+# the installed signature once and translate (callers use check_vma)
+import inspect as _inspect
+
+_CHECK_KW = ("check_vma" if "check_vma"
+             in _inspect.signature(_jax_shard_map).parameters
+             else "check_rep")
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    return _jax_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **{_CHECK_KW: check_vma})
 
 __all__ = ["reduce_feeds_sharded", "destripe_sharded",
            "destripe_sharded_planned", "make_destripe_sharded_planned",

@@ -141,6 +141,34 @@ class ObservationStep:
                         jnp.asarray(pixels), jnp.asarray(freq_scaled),
                         self.starts, self.lengths)
 
+    def input_shardings(self) -> dict:
+        """Per-input NamedShardings of :meth:`__call__`'s array kwargs —
+        the placement the ingest double-buffer must land blocks in so
+        the compiled step starts without a reshard."""
+        feed = NamedSharding(self.mesh, P("feed"))
+        repl = NamedSharding(self.mesh, P())
+        return dict(tod=feed, mask=feed, vane_tod=feed, airmass=feed,
+                    pixels=feed, freq_scaled=repl)
+
+    def run_stream(self, observations, buffer_size: int = 2):
+        """Stream observations through the compiled step with
+        host→device double-buffering: observation ``i+1``'s arrays
+        transfer (``jax.device_put`` is async) while observation ``i``
+        computes (``ingest.prefetch_to_device``; docs/ingest.md).
+
+        ``observations`` yields dicts with :meth:`__call__`'s array
+        kwargs (host numpy, e.g. built from a prefetched
+        ``level1_stream``). Yields one ``(level2_dict,
+        DestriperResult)`` per observation, in order.
+        """
+        from comapreduce_tpu.ingest.device_buffer import prefetch_to_device
+
+        shardings = self.input_shardings()
+        for block in prefetch_to_device(
+                observations, size=buffer_size,
+                sharding=lambda b: {k: shardings[k] for k in b}):
+            yield self(**block)
+
 
 def make_example_inputs(rng: np.random.Generator, n_feeds: int = 2,
                         n_bands: int = 2, n_channels: int = 16,

@@ -12,7 +12,14 @@ are supported here; both feed the same registry (:mod:`registry`).
 from __future__ import annotations
 
 import re
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is the same parser
+    try:
+        import tomli as tomllib
+    except ModuleNotFoundError:  # neither: gate to load_toml call time
+        tomllib = None
 
 __all__ = ["load_toml", "IniConfig", "parse_stage_name", "coerce",
            "read_filelist"]
@@ -33,6 +40,9 @@ _STAGE_NAME_RE = re.compile(
 
 def load_toml(path: str) -> dict:
     """Load a TOML pipeline configuration (``run_average.py:104``)."""
+    if tomllib is None:  # pragma: no cover - env without tomllib/tomli
+        raise ModuleNotFoundError(
+            "TOML configs need tomllib (Python >= 3.11) or tomli")
     with open(path, "rb") as f:
         return tomllib.load(f)
 

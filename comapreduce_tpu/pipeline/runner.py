@@ -83,28 +83,119 @@ class Runner:
     # writing TensorBoard-readable traces here (the reference has no
     # profiler at all — SURVEY.md §5 'Tracing/profiling: none')
     profile_dir: str = ""
+    # streaming-ingest knob: IngestConfig | {"prefetch": N, "cache_mb":
+    # M, ...} | None. prefetch=0 (default) is the serial path.
+    ingest: object = None
+    # the BlockCache lives on the Runner, not the run_tod call: a
+    # reduction pass followed by run_astro_cal (run_average's flow) or
+    # a second run_tod re-reads the same Level-1 files, and a per-call
+    # cache could never hit
+    _ingest_cache: object = field(default=None, repr=False)
+
+    def shard_iter(self, filelist):
+        """Lazy round-robin shard: rank r takes files ``i % n_ranks == r``.
+        Both the serial loop and the prefetcher consume this one
+        iterator, so the sharding rule cannot drift between paths."""
+        for i, f in enumerate(filelist):
+            if i % self.n_ranks == self.rank:
+                yield f
 
     def shard(self, filelist: list[str]) -> list[str]:
-        return [f for i, f in enumerate(filelist)
-                if i % self.n_ranks == self.rank]
+        return list(self.shard_iter(filelist))
 
     def run_tod(self, filelist: list[str]) -> list[COMAPLevel2]:
-        """The TOD-reduction loop (``Running.py:120-153``)."""
+        """The TOD-reduction loop (``Running.py:120-153``).
+
+        With ``ingest.prefetch >= 1`` a background thread reads ahead
+        over this rank's shard while the current file's stage chain
+        computes (``ingest/``); per-file read/compute wall times land in
+        ``timings['ingest.read']`` / ``timings['ingest.compute']`` on
+        both paths, so the overlap is observable. A file whose *read*
+        fails takes the same per-file "BAD FILE" -> ``None`` slot as a
+        file whose stage chain fails — a bad file never kills the queue
+        or the run.
+        """
+        from comapreduce_tpu.ingest import IngestConfig, level1_stream
+
         os.makedirs(self.output_dir, exist_ok=True)
+        cfg = IngestConfig.coerce(self.ingest)
+        if self._ingest_cache is None:
+            self._ingest_cache = cfg.make_cache()
+        cache = self._ingest_cache
         results = []
-        for filename in self.shard(list(filelist)):
-            logger.info("rank %d: processing %s", self.rank, filename)
-            try:
-                results.append(self.run_file(filename))
-            except Exception:
-                # per-file fault tolerance: a bad file never kills the run
-                # (reference: broad try/except + "BAD FILE" logging,
-                # COMAPData.py:169-173)
-                logger.exception("BAD FILE %s", filename)
-                results.append(None)
+        stream = level1_stream(self.shard_iter(filelist),
+                               prefetch=cfg.prefetch, cache=cache,
+                               eager_tod=cfg.eager_tod,
+                               eager_for=self._needs_tod)
+        try:
+            self._consume_stream(stream, results)
+        finally:
+            # deterministic shutdown even when a stage raises something
+            # the per-file net does not catch and the caller keeps the
+            # traceback alive: closing the generator stops the worker
+            stream.close()
         return results
 
-    def run_file(self, filename: str) -> COMAPLevel2:
+    def _consume_stream(self, stream, results: list) -> None:
+        for item in stream:
+            logger.info("rank %d: processing %s", self.rank, item.filename)
+            self.timings.setdefault("ingest.read", []).append(item.read_s)
+            t0 = time.perf_counter()
+            if item.error is not None:
+                # per-file fault tolerance: a bad file never kills the
+                # run (reference: broad try/except + "BAD FILE" logging,
+                # COMAPData.py:169-173); prefetch-worker failures are
+                # re-raised here, per file, never queue-fatal
+                logger.error("BAD FILE %s", item.filename,
+                             exc_info=item.error)
+                results.append(None)
+                # keep the read/compute lists index-aligned per file
+                self.timings.setdefault("ingest.compute", []).append(0.0)
+                continue
+            try:
+                results.append(self.run_file(item.filename,
+                                             data=item.payload))
+            except Exception:
+                logger.exception("BAD FILE %s", item.filename)
+                results.append(None)
+            finally:
+                self.timings.setdefault("ingest.compute", []).append(
+                    time.perf_counter() - t0)
+
+    def _needs_tod(self, filename: str) -> bool:
+        """False when every OUTPUT-producing stage of this file's chain
+        will resume-skip — then the prefetch worker must not
+        materialise its multi-GB TOD just for the chain to drop it (the
+        serial path's lazy read cost near zero on fully-resumed files;
+        prefetch must match). Group-less gate stages (CheckLevel1File:
+        ``overwrite=True``, ``groups=()``) always run but are metadata
+        checks a lazy handle serves; lazy is always *correct*, eager is
+        only the read-ahead optimisation, so mispredicting here can
+        never change results. The probe opens the checkpoint and lists
+        its top-level groups only — decoding the whole (potentially
+        hundreds of MB) Level-2 store here would compete with the very
+        read-ahead this hook optimises."""
+        from comapreduce_tpu.data.hdf5io import safe_hdf5_open
+
+        l2path = level2_path(self.output_dir, filename, self.prefix)
+        if not os.path.exists(l2path):
+            return True
+        try:
+            with safe_hdf5_open(l2path, "r") as f:
+                have = set(f.keys())
+        except Exception:  # unreadable/partial Level-2: read normally
+            return True
+
+        def contained(p) -> bool:
+            return all(g.split("/")[0] in have
+                       for g in getattr(p, "groups", ()))
+
+        return any(
+            getattr(p, "groups", ()) and
+            (not contained(p) or getattr(p, "overwrite", False))
+            for p in self.processes)
+
+    def run_file(self, filename: str, data=None) -> COMAPLevel2:
         if self.profile_dir:
             import contextlib
 
@@ -118,12 +209,13 @@ class Runner:
                                "running unprofiled")
                 ctx = contextlib.nullcontext()
             with ctx:
-                return self._run_file(filename)
-        return self._run_file(filename)
+                return self._run_file(filename, data)
+        return self._run_file(filename, data)
 
-    def _run_file(self, filename: str) -> COMAPLevel2:
-        data = COMAPLevel1()
-        data.read(filename)
+    def _run_file(self, filename: str, data=None) -> COMAPLevel2:
+        if data is None:
+            data = COMAPLevel1()
+            data.read(filename)
         lvl2 = COMAPLevel2(
             filename=level2_path(self.output_dir, filename, self.prefix))
         for process in self.processes:
@@ -163,8 +255,12 @@ class Runner:
             cache_path=cache_path)
         sub = Runner(processes=[stage], output_dir=self.output_dir,
                      prefix=self.prefix, rank=self.rank,
-                     n_ranks=self.n_ranks, timings=self.timings)
-        return sub.run_tod(filelist)
+                     n_ranks=self.n_ranks, timings=self.timings,
+                     ingest=self.ingest,
+                     _ingest_cache=self._ingest_cache)
+        results = sub.run_tod(filelist)
+        self._ingest_cache = sub._ingest_cache  # share warm cache back
+        return results
 
     # -- config-driven construction ----------------------------------------
     @classmethod
@@ -175,7 +271,11 @@ class Runner:
         Layout (mirrors ``configuration.toml``): ``[Global]`` has
         ``processes`` (stage-name list), ``output_dir``, optional
         ``backend``; each ``[StageName]`` section holds that stage's
-        kwargs (including per-stage ``backend``/``overwrite``)."""
+        kwargs (including per-stage ``backend``/``overwrite``). An
+        optional ``[ingest]`` table (``prefetch``, ``cache_mb``,
+        ``spill_dir``) turns on streaming ingest (docs/ingest.md)."""
+        from comapreduce_tpu.ingest import IngestConfig
+
         if isinstance(config, str):
             config = cfg_mod.load_toml(config)
         glob = config.get("Global", {})
@@ -188,16 +288,21 @@ class Runner:
         return cls(processes=processes,
                    output_dir=glob.get("output_dir", "."),
                    prefix=glob.get("prefix", "Level2"),
-                   rank=rank, n_ranks=n_ranks)
+                   rank=rank, n_ranks=n_ranks,
+                   ingest=IngestConfig.coerce(config.get("ingest")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,
                            n_ranks: int = 1) -> "Runner":
         """Build from a legacy INI (``Module.Class(variant)`` registry,
         ``Tools/Parser.py:44-96``)."""
+        from comapreduce_tpu.ingest import IngestConfig
+
         ini = cfg_mod.IniConfig(ini_path)
         processes = [resolve(name, **kwargs)
                      for name, kwargs in ini.pipeline_jobs()]
-        out = ini.get("Inputs", {}).get("output_dir", ".")
-        return cls(processes=processes, output_dir=out,
-                   rank=rank, n_ranks=n_ranks)
+        inputs = ini.get("Inputs", {})
+        return cls(processes=processes,
+                   output_dir=inputs.get("output_dir", "."),
+                   rank=rank, n_ranks=n_ranks,
+                   ingest=IngestConfig.from_mapping(inputs))

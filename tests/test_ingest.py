@@ -1,0 +1,463 @@
+"""Streaming ingest subsystem tests (``comapreduce_tpu/ingest/``).
+
+Covers the ISSUE-1 acceptance surface: prefetched results bit-identical
+to the serial path, the queue bound respected, LRU eviction + disk
+spill round-trip, clean shutdown when the consumer breaks early, and
+prefetch-worker failures mapping onto the per-file "BAD FILE" fault
+tolerance (result slot ``None``, never queue-fatal).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.data.hdf5io import HDF5Store
+from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                            generate_level1_file)
+from comapreduce_tpu.ingest import (BlockCache, IngestConfig, Prefetcher,
+                                    iter_serial, level2_stream,
+                                    prefetch_to_device)
+from comapreduce_tpu.pipeline import Runner
+from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
+                                             CheckLevel1File,
+                                             Level1AveragingGainCorrection,
+                                             MeasureSystemTemperature)
+
+
+# -- fixtures ---------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def level1_files(tmp_path_factory):
+    """Three small synthetic Level-1 observations (the multi-file
+    fixture of the acceptance criteria)."""
+    tmp = tmp_path_factory.mktemp("ingest_l1")
+    files = []
+    for i in range(3):
+        path = str(tmp / f"comap-{i:07d}-synth.hd5")
+        generate_level1_file(path, SyntheticObsParams(
+            obsid=i + 1, n_feeds=1, n_bands=1, n_channels=8, n_scans=2,
+            scan_samples=200, vane_samples=100, seed=100 + i))
+        files.append(path)
+    return files
+
+
+def _chain():
+    # the real TOD-reduction chain through the band average, so the
+    # bit-identity assertion covers `averaged_tod/*`, not just metadata
+    return [CheckLevel1File(min_duration_seconds=1.0), AssignLevel1Data(),
+            MeasureSystemTemperature(),
+            Level1AveragingGainCorrection(medfilt_window=101)]
+
+
+def _write_level2(path: str, seed: int, F=2, B=1, T=200) -> None:
+    """Minimal Level-2 store the destriper reader accepts."""
+    rng = np.random.default_rng(seed)
+    store = HDF5Store(name="l2")
+    store["averaged_tod/tod"] = rng.normal(
+        size=(F, B, T)).astype(np.float32)
+    store["averaged_tod/weights"] = np.ones((F, B, T), np.float32)
+    store["averaged_tod/scan_edges"] = np.array([[0, T]], np.int64)
+    ra = 170.0 + 0.5 * rng.random((F, T))
+    dec = 52.0 + 0.5 * rng.random((F, T))
+    store["spectrometer/pixel_pointing/pixel_ra"] = ra
+    store["spectrometer/pixel_pointing/pixel_dec"] = dec
+    store["spectrometer/pixel_pointing/pixel_az"] = ra
+    store["spectrometer/pixel_pointing/pixel_el"] = dec
+    store.set_attrs("comap", "source", "co2,sky")
+    store.set_attrs("comap", "obsid", seed)
+    store.write(path)
+
+
+# -- Runner integration -----------------------------------------------------
+
+def test_runner_prefetch_bit_identical(level1_files, tmp_path):
+    """Acceptance: with prefetch >= 2, run_tod output is bit-identical
+    to the serial path on the multi-file fixture; read/compute timings
+    are recorded on both paths."""
+    serial = Runner(processes=_chain(), output_dir=str(tmp_path / "s"))
+    pre = Runner(processes=_chain(), output_dir=str(tmp_path / "p"),
+                 ingest={"prefetch": 2, "cache_mb": 32})
+    res_s = serial.run_tod(level1_files)
+    res_p = pre.run_tod(level1_files)
+    assert len(res_s) == len(res_p) == len(level1_files)
+    assert all(x is not None for x in res_s + res_p)
+    for a, b in zip(res_s, res_p):
+        assert sorted(a.keys()) == sorted(b.keys())
+        for k in a.keys():
+            va, vb = np.asarray(a[k]), np.asarray(b[k])
+            assert va.shape == vb.shape, k
+            np.testing.assert_array_equal(va, vb, err_msg=k)
+    for runner in (serial, pre):
+        assert len(runner.timings["ingest.read"]) == len(level1_files)
+        assert len(runner.timings["ingest.compute"]) == len(level1_files)
+
+
+def test_prefetch_worker_failure_maps_to_bad_file(level1_files, tmp_path):
+    """Regression (ISSUE 1 satellite): a file whose *read* fails on the
+    prefetch worker takes the per-file "BAD FILE" -> None slot; the
+    queue survives and the files behind it still process."""
+    bad = str(tmp_path / "bad.hd5")
+    with open(bad, "wb") as f:
+        f.write(b"this is not an HDF5 file")
+    filelist = [level1_files[0], bad, level1_files[1]]
+    for ingest in (None, {"prefetch": 2}):
+        runner = Runner(processes=_chain(),
+                        output_dir=str(tmp_path / f"o{bool(ingest)}"),
+                        ingest=ingest)
+        results = runner.run_tod(filelist)
+        assert [r is None for r in results] == [False, True, False]
+        # the bad file still gets read AND compute slots, keeping the
+        # two observability lists index-aligned per file
+        assert len(runner.timings["ingest.read"]) == 3
+        assert len(runner.timings["ingest.compute"]) == 3
+
+
+def test_runner_shard_iter_matches_shard():
+    r = Runner(rank=1, n_ranks=3)
+    files = [f"f{i}" for i in range(10)]
+    assert list(r.shard_iter(files)) == r.shard(files) == \
+        ["f1", "f4", "f7"]
+
+
+# -- Prefetcher core --------------------------------------------------------
+
+def test_queue_bound_respected():
+    """At most depth queued + 1 in the worker's hand are ever decoded
+    ahead of the consumer — the host-memory ceiling the bounded queue
+    exists for."""
+    depth = 2
+    lock = threading.Lock()
+    live = {"now": 0, "max": 0}
+
+    def loader(_path):
+        with lock:
+            live["now"] += 1
+            live["max"] = max(live["max"], live["now"])
+        return object()
+
+    pre = Prefetcher([f"f{i}" for i in range(15)], loader, depth=depth)
+    for item in pre:
+        with lock:
+            live["now"] -= 1
+        time.sleep(0.01)  # slow consumer: the worker hits the bound
+    assert live["max"] <= depth + 1, live
+
+
+def test_clean_shutdown_on_early_break():
+    """Breaking the consumer loop stops the worker promptly and joins
+    it — no daemon thread left spinning over 500 pending files."""
+    started = {"n": 0}
+
+    def loader(_path):
+        started["n"] += 1
+        time.sleep(0.002)
+        return object()
+
+    pre = Prefetcher([f"f{i}" for i in range(500)], loader, depth=2)
+    for i, item in enumerate(pre):
+        if i == 2:
+            break
+    pre.close()
+    assert not pre._thread.is_alive()
+    assert started["n"] < 20  # read-ahead stopped, not ran to the end
+
+
+def test_prefetcher_context_manager_and_order():
+    with Prefetcher([f"f{i}" for i in range(8)],
+                    lambda p: p.upper(), depth=3) as pre:
+        items = list(pre)
+    assert [i.index for i in items] == list(range(8))
+    assert [i.payload for i in items] == [f"F{i}" for i in range(8)]
+    assert not pre._thread.is_alive()
+
+
+def test_prefetch_overlap_wall_time():
+    """The point of the subsystem: with read and compute both 30 ms,
+    depth-2 prefetch approaches max(read, compute) instead of their
+    sum (sleeps release the GIL, so this holds on a 1-core CI box)."""
+    n, dt = 6, 0.03
+
+    def loader(_path):
+        time.sleep(dt)
+        return object()
+
+    files = [f"f{i}" for i in range(n)]
+    t0 = time.perf_counter()
+    for item in iter_serial(files, loader):
+        time.sleep(dt)  # "compute"
+    serial_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for item in Prefetcher(files, loader, depth=2):
+        time.sleep(dt)
+    prefetch_wall = time.perf_counter() - t0
+    assert serial_wall >= 2 * n * dt * 0.95
+    assert prefetch_wall < serial_wall - (n - 2) * dt * 0.5, \
+        (serial_wall, prefetch_wall)
+
+
+def test_broken_filelist_generator_is_fatal():
+    """A failure of the file LISTING (not of one file) marks a fatal
+    item at the raw Prefetcher level, and the stream layer re-raises it
+    — the serial path's iterator raises at the same point, so the two
+    paths fail identically instead of prefetch truncating the run."""
+    def files():
+        yield "f0"
+        raise RuntimeError("broken listing")
+
+    items = list(Prefetcher(files(), lambda p: p, depth=2))
+    assert items[0].error is None and items[0].payload == "f0"
+    assert isinstance(items[1].error, RuntimeError) and items[1].fatal
+
+    from comapreduce_tpu.ingest.loaders import _stream
+    got = []
+    with pytest.raises(RuntimeError, match="broken listing"):
+        for item in _stream(files(), lambda p: p, lambda p: p,
+                            prefetch=2):
+            got.append(item.filename)
+    assert got == ["f0"]  # files before the break still processed
+
+
+# -- BlockCache -------------------------------------------------------------
+
+def test_lru_eviction_and_disk_spill_roundtrip(tmp_path):
+    paths = []
+    arrays = []
+    for i in range(3):
+        p = str(tmp_path / f"blob{i}.bin")
+        with open(p, "wb") as f:
+            f.write(b"x")
+        paths.append(p)
+        arrays.append(np.full(100, i, np.float64))  # 800 B each
+    cache = BlockCache(max_bytes=2000, spill_dir=str(tmp_path / "spill"))
+    for p, a in zip(paths, arrays):
+        cache.put(p, {"data": {"a": a}, "attrs": {}, "source": p})
+    # budget holds two ~870 B entries: the oldest was evicted + spilled
+    assert cache.stats["evictions"] == 1 and cache.stats["spills"] == 1
+    assert cache.current_bytes <= 2000
+    for p, a in zip(paths, arrays):  # spill hit restores bit-identical
+        got = cache.get(p)
+        assert got is not None, p
+        np.testing.assert_array_equal(got["data"]["a"], a)
+    assert cache.stats["spill_hits"] >= 1
+
+
+def test_cache_no_spill_drops_evicted(tmp_path):
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    for p in (p1, p2):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    cache = BlockCache(max_bytes=900)  # one 800 B entry fits
+    cache.put(p1, np.zeros(100))
+    cache.put(p2, np.ones(100))
+    assert cache.get(p1) is None          # evicted, no spill configured
+    assert cache.get(p2) is not None
+
+
+def test_cache_mtime_invalidation(tmp_path):
+    p = str(tmp_path / "f.bin")
+    with open(p, "wb") as f:
+        f.write(b"v1")
+    cache = BlockCache(max_bytes=1 << 20)
+    cache.put(p, {"v": 1})
+    assert cache.get(p) == {"v": 1}
+    os.utime(p, ns=(1, 1))  # "rewrite": different mtime, same path
+    assert cache.get(p) is None
+    cache.put(p, {"v": 2})
+    assert cache.get(p) == {"v": 2}
+
+
+def test_ingest_config_validation():
+    cfg = IngestConfig.coerce({"prefetch": 4, "cache_mb": 2.5})
+    assert cfg.prefetch == 4 and cfg.make_cache().max_bytes == \
+        int(2.5 * (1 << 20))
+    assert IngestConfig.coerce(None).prefetch == 0
+    assert IngestConfig.coerce(cfg) is cfg
+    with pytest.raises(ValueError):
+        IngestConfig.coerce({"prefetchh": 2})
+    with pytest.raises(ValueError):
+        Prefetcher([], lambda p: p, depth=0)
+    # INI coercion maps 'prefetch : none' / empty values to None, and
+    # None must mean disabled, not a downstream TypeError
+    cfg = IngestConfig.from_mapping(
+        {"prefetch": None, "cache_mb": None, "spill_dir": None,
+         "other_ini_key": 7})
+    assert cfg.prefetch == 0 and cfg.cache_mb == 0.0
+    assert cfg.spill_dir == "" and cfg.make_cache() is None
+    assert IngestConfig(prefetch=-3).prefetch == 0
+
+
+def test_resumed_files_not_materialised_by_prefetch(level1_files,
+                                                    tmp_path):
+    """A file whose whole stage chain will resume-skip must not have
+    its (multi-GB in production) TOD read end to end by the prefetch
+    worker — the lazy serial resume cost is the contract."""
+    outdir = str(tmp_path / "resume")
+    Runner(processes=_chain(), output_dir=outdir).run_tod(level1_files)
+
+    import comapreduce_tpu.ingest.loaders as loaders_mod
+    calls = []
+    orig = loaders_mod.load_level1
+
+    def spy(path, eager_tod=True):
+        calls.append((path, eager_tod))
+        return orig(path, eager_tod=eager_tod)
+
+    second = Runner(processes=_chain(), output_dir=outdir,
+                    ingest={"prefetch": 2})
+    try:
+        loaders_mod.load_level1 = spy
+        results = second.run_tod(level1_files)
+    finally:
+        loaders_mod.load_level1 = orig
+    assert all(r is not None for r in results)
+    assert calls and all(not eager for _, eager in calls), calls
+
+
+# -- destriper reader path --------------------------------------------------
+
+def test_read_comap_data_prefetch_identical_and_cached(tmp_path):
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    files = []
+    for i in range(3):
+        p = str(tmp_path / f"l2_{i}.hd5")
+        _write_level2(p, seed=40 + i)
+        files.append(p)
+    wcs = WCS.from_field((170.2, 52.2), (0.05, 0.05), (32, 32))
+    kw = dict(band=0, wcs=wcs, offset_length=50, medfilt_window=1)
+    serial = read_comap_data(files, **kw)
+    pre = read_comap_data(files, prefetch=2, **kw)
+    cache = IngestConfig(cache_mb=64).make_cache()
+    cold = read_comap_data(files, prefetch=2, cache=cache, **kw)
+    warm = read_comap_data(files, prefetch=2, cache=cache, **kw)
+    assert cache.stats["hits"] >= 3  # second pass decoded nothing
+    for other in (pre, cold, warm):
+        np.testing.assert_array_equal(other.tod, serial.tod)
+        np.testing.assert_array_equal(other.pixels, serial.pixels)
+        np.testing.assert_array_equal(other.weights, serial.weights)
+        assert other.files == serial.files
+
+
+def test_level2_stream_bad_file_slot(tmp_path):
+    good = str(tmp_path / "good.hd5")
+    _write_level2(good, seed=7)
+    bad = str(tmp_path / "bad.hd5")
+    with open(bad, "wb") as f:
+        f.write(b"junk")
+    items = list(level2_stream([good, bad], prefetch=2))
+    assert items[0].error is None
+    assert np.asarray(
+        items[0].payload["averaged_tod/tod"]).shape == (2, 1, 200)
+    assert isinstance(items[1].error, OSError)
+
+
+def test_create_filelist_prefetch_matches_serial(tmp_path):
+    from comapreduce_tpu.mapmaking.filelist import create_filelist
+
+    files = []
+    for i in range(3):
+        p = str(tmp_path / f"l2_{i}.hd5")
+        _write_level2(p, seed=60 + i)
+        files.append(p)
+    bad = str(tmp_path / "bad.hd5")
+    with open(bad, "wb") as f:
+        f.write(b"junk")
+    serial = create_filelist(files + [bad], sigma_cut_mk=1e9)
+    pre = create_filelist(files + [bad], sigma_cut_mk=1e9, prefetch=2)
+    assert serial == pre
+    assert serial[0] == files and serial[1] == [bad]
+
+
+# -- device double-buffering ------------------------------------------------
+
+def test_prefetch_to_device_values_and_types():
+    import jax
+
+    blocks = [np.full(8, i, np.float32) for i in range(5)]
+    out = list(prefetch_to_device(blocks, size=2))
+    assert len(out) == 5
+    for i, x in enumerate(out):
+        assert isinstance(x, jax.Array)
+        np.testing.assert_array_equal(np.asarray(x), blocks[i])
+    # pytrees (dict blocks) ride through device_put unchanged
+    tree = list(prefetch_to_device(
+        [{"a": np.arange(3), "b": np.ones(2)}], size=2))[0]
+    np.testing.assert_array_equal(np.asarray(tree["a"]), np.arange(3))
+
+
+def test_observation_step_run_stream_matches_call(rng):
+    """The streaming driver (double-buffered H2D) produces the same
+    maps as per-observation __call__."""
+    from comapreduce_tpu.parallel.mesh import local_mesh
+    from comapreduce_tpu.parallel.step import (ObservationStep,
+                                               make_example_inputs)
+
+    step_kwargs, arrays = make_example_inputs(rng)
+    step = ObservationStep(local_mesh(), **step_kwargs)
+    obs = [arrays,
+           {**arrays, "tod": arrays["tod"] * 1.01}]  # two observations
+    streamed = list(step.run_stream(iter(obs), buffer_size=2))
+    assert len(streamed) == 2
+    for block, (lvl2, res) in zip(obs, streamed):
+        _, res_ref = step(**block)
+        np.testing.assert_array_equal(np.asarray(res.destriped_map),
+                                      np.asarray(res_ref.destriped_map))
+        np.testing.assert_array_equal(np.asarray(res.hit_map),
+                                      np.asarray(res_ref.hit_map))
+
+
+def test_prefetch_to_device_sharded():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from comapreduce_tpu.parallel.mesh import feed_time_mesh
+
+    mesh = feed_time_mesh(jax.devices())
+    sharding = NamedSharding(mesh, P("feed"))
+    n = int(np.prod(list(mesh.shape.values())))
+    blocks = [np.arange(4 * n, dtype=np.float32) + i for i in range(3)]
+    out = list(prefetch_to_device(blocks, size=2, sharding=sharding))
+    for i, x in enumerate(out):
+        assert x.sharding == sharding
+        np.testing.assert_array_equal(np.asarray(x), blocks[i])
+
+
+# -- bench ingest mode (CI smoke) -------------------------------------------
+
+def test_bench_ingest_smoke(tmp_path):
+    """`bench.py --config ingest` emits one JSON line with the ingest
+    observables (MB/s, queue depth over time, overlap fraction)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
+    # small shapes but enough files + a slow-enough emulated device
+    # that the read/compute overlap rises well above timing noise
+    env.update(BENCH_SMALL="1", BENCH_INGEST_FILES="8",
+               BENCH_INGEST_DEVICE_MBPS="20", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo, BENCH_EVIDENCE_DIR=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--config", "ingest"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "ingest_mb_per_sec"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    d = rec["detail"]
+    assert d["n_files"] >= 3
+    assert d["prefetch_wall_s"] > 0 and d["serial_wall_s"] > 0
+    # acceptance: the prefetched wall beats serial read + compute
+    assert d["prefetch_wall_s"] < d["read_s_total"] + d["compute_s_total"]
+    assert d["queue_depth_log"] and \
+        max(q for _, q in d["queue_depth_log"]) <= d["queue_depth"]
+    assert d["cache_stats"]["hits"] == d["n_files"]
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "evidence", "bench_ingest_host.json"))

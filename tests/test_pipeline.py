@@ -149,7 +149,10 @@ def test_runner_resume_skips(tmp_path):
 
     second = Runner(processes=_stage_chain(), output_dir=str(tmp_path))
     second.run_tod([path])
-    heavy = [n for n in first.timings if n != "CheckLevel1File"]
+    # ingest.* keys are per-file read/compute observability, not stage
+    # timings — present on every run by design (docs/ingest.md)
+    heavy = [n for n in first.timings
+             if n != "CheckLevel1File" and not n.startswith("ingest.")]
     for name in heavy:
         assert name not in second.timings, f"{name} re-ran despite resume"
 
