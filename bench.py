@@ -1160,8 +1160,45 @@ def bench_ingest():
     return 0
 
 
+def bench_resilience():
+    """Resilience mode: the chaos drill as a benchmark config.
+
+    Runs ``resilience.drill.run_drill`` (the ``tools/check_resilience``
+    contract: every injected fault handled + ledgered, chaos map
+    byte-identical to the zero-weighted clean map, quarantine skip and
+    re-admit correct across runs) and reports faults handled per second
+    of drill wall time. Any broken promise raises — this config FAILING
+    is the signal, the throughput number is just the trend line.
+    """
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from comapreduce_tpu.resilience.drill import run_drill
+
+    tmp = tempfile.mkdtemp(prefix="bench_resilience_")
+    try:
+        evidence = run_drill(tmp, seed=0)
+        n_faults = len(evidence["injected"])
+        line = {
+            "metric": "resilience_faults_per_sec",
+            "value": round(n_faults / max(evidence["wall_s"], 1e-9), 3),
+            "unit": "faults/s",
+            # the contract is binary: 1.0 iff every promise held (the
+            # drill raises otherwise, so reaching here IS the pass)
+            "vs_baseline": 1.0,
+            "detail": {"config": "resilience", **evidence},
+        }
+        print(json.dumps(line))
+        write_evidence("resilience", lambda: None, extra=line["detail"],
+                       host_only=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
 _CONFIGS = {"1": bench_config1, "2": bench_config2, "4": bench_config4,
-            "ingest": bench_ingest}
+            "ingest": bench_ingest, "resilience": bench_resilience}
 
 
 if __name__ == "__main__":

@@ -35,20 +35,31 @@ def _rank_info():
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     figure_dir = ""
+    retry_quarantined = False
     rest = []
     for a in argv:
         if a == "--figures":
             figure_dir = "figures"
         elif a.startswith("--figures="):
             figure_dir = a.split("=", 1)[1]
+        elif a == "--retry-quarantined":
+            # re-admit everything the quarantine ledger currently skips
+            # (each re-admission is itself a ledger event; see
+            # docs/OPERATIONS.md §7)
+            retry_quarantined = True
         else:
             rest.append(a)
     if len(rest) != 1:
         print("usage: python -m comapreduce_tpu.cli.run_average "
-              "[--figures[=DIR]] configuration.toml", file=sys.stderr)
+              "[--figures[=DIR]] [--retry-quarantined] "
+              "configuration.toml", file=sys.stderr)
         return 2
     config = load_toml(rest[0])
     glob = config.get("Global", {})
+    if retry_quarantined:
+        config = dict(config)
+        config["resilience"] = dict(config.get("resilience", {}),
+                                    retry_quarantined=True)
     rank, n_ranks = _rank_info()
     set_logging(base="run_average", log_dir=glob.get("log_dir", "."),
                 rank=rank, level=str(glob.get("log_level", "INFO")))

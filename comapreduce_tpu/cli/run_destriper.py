@@ -170,20 +170,23 @@ def _expand_joint_results(res, uniq: np.ndarray, npix: int, nb: int):
     depends on pointing alone and is shared). ONE home for the rule —
     the sharded and single-process joint paths must never drift."""
     hit_full = _expand_compact(uniq, npix, res.hit_map)
+    div = np.asarray(res.diverged)
     return [res._replace(
         offsets=res.offsets[i],
         destriped_map=_expand_compact(uniq, npix, res.destriped_map[i]),
         naive_map=_expand_compact(uniq, npix, res.naive_map[i]),
         weight_map=_expand_compact(uniq, npix, res.weight_map[i]),
         hit_map=hit_full,
-        residual=res.residual[i]) for i in range(nb)]
+        residual=res.residual[i],
+        diverged=div[i] if div.ndim else div) for i in range(nb)]
 
 
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   offset_length=50, n_iter=100, threshold=1e-6,
                   use_ground=False, use_calibration=True, sharded=False,
                   medfilt_window=400, tod_variant="auto",
-                  coarse_block=0, prefetch=0, cache=None):
+                  coarse_block=0, prefetch=0, cache=None,
+                  resilience=None):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
@@ -199,7 +202,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                            use_calibration=use_calibration,
                            medfilt_window=medfilt_window,
                            tod_variant=tod_variant,
-                           prefetch=prefetch, cache=cache)
+                           prefetch=prefetch, cache=cache,
+                           resilience=resilience)
     return data, solve_band(data, offset_length=offset_length,
                             n_iter=n_iter, threshold=threshold,
                             use_ground=use_ground, sharded=sharded,
@@ -357,6 +361,41 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                  offset_length, n_iter, threshold)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]), **kwargs)
+        if kwargs.get("coarse") is not None and \
+                bool(np.any(np.asarray(result.diverged))):
+            # CG divergence tripwire fired under the two-level
+            # preconditioner (an ill-assembled A_c^-1 can lose SPD in
+            # f32): re-solve under plain Jacobi — warm-started from the
+            # monitored solve's best iterate on the offsets-only path;
+            # the joint ground solve restarts cold (x0 is offsets-only
+            # by construction). Slower but safe — and recorded, not
+            # silent (docs/OPERATIONS.md §7).
+            if use_ground:
+                logger.warning(
+                    "CG diverged under the coarse preconditioner "
+                    "(diverged=%s); re-solving ground solve with "
+                    "Jacobi from a cold start",
+                    np.asarray(result.diverged))
+                result = fn(jnp.asarray(data.tod[:n]),
+                            jnp.asarray(data.weights[:n]),
+                            ground_off=jnp.asarray(gid_off),
+                            az=jnp.asarray(data.az[:n]))
+            else:
+                logger.warning(
+                    "CG diverged under the coarse preconditioner "
+                    "(diverged=%s); re-solving with Jacobi from the "
+                    "best iterate", np.asarray(result.diverged))
+                result = fn(jnp.asarray(data.tod[:n]),
+                            jnp.asarray(data.weights[:n]),
+                            x0=result.offsets)
+    if sharded and bool(np.any(np.asarray(result.diverged))):
+        # the sharded programs are memoized per-(geometry, coarse) pair;
+        # flag the divergence for the operator instead of compiling a
+        # second program mid-run
+        logger.warning("sharded CG solve flagged divergence "
+                       "(diverged=%s); re-run with [Inputs] "
+                       "coarse_precond : 0 to force Jacobi",
+                       np.asarray(result.diverged))
     return result
 
 
@@ -365,7 +404,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          threshold=1e-6, use_calibration=True,
                          medfilt_window=400, sharded=False,
                          tod_variant="auto", coarse_block=0,
-                         prefetch=0, cache=None):
+                         prefetch=0, cache=None, resilience=None):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -393,7 +432,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                              use_calibration=use_calibration,
                              medfilt_window=medfilt_window,
                              tod_variant=tod_variant,
-                             prefetch=prefetch, cache=cache)
+                             prefetch=prefetch, cache=cache,
+                             resilience=resilience)
              for b in bands]
     pix0 = np.asarray(datas[0].pixels)
     for d in datas[1:]:
@@ -436,6 +476,15 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                               np.stack([p[1] for p in pre])))
         else:
             res = run(jnp.asarray(tod), jnp.asarray(wgt))
+        if bool(np.any(np.asarray(res.diverged))):
+            # same operator contract as solve_band's sharded branch:
+            # the memoized program is not recompiled mid-run, but a
+            # diverged (best-iterate, non-converged) map must never
+            # ship silently
+            logger.warning("sharded joint CG solve flagged divergence "
+                           "(diverged=%s); re-run with [Inputs] "
+                           "coarse_precond : 0 to force Jacobi",
+                           np.asarray(res.diverged))
         return datas, _expand_joint_results(res, uniq, npix, nb)
     n = (datas[0].tod.size // offset_length) * offset_length
     tod = np.stack([np.asarray(d.tod)[:n] for d in datas])
@@ -460,6 +509,15 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     fn, uniq = _planned_solver(pix0[:n], npix, offset_length, n_iter,
                                threshold, compact=True)
     res = fn(jnp.asarray(tod), jnp.asarray(wgt), **kwargs)
+    if kwargs.get("coarse") is not None and \
+            bool(np.any(np.asarray(res.diverged))):
+        # same divergence fallback as solve_band: drop to Jacobi, warm-
+        # started per band from the monitored solve's best iterates
+        logger.warning(
+            "joint CG diverged under the coarse preconditioner "
+            "(diverged=%s); re-solving with Jacobi from the best "
+            "iterates", np.asarray(res.diverged))
+        res = fn(jnp.asarray(tod), jnp.asarray(wgt), x0=res.offsets)
     return datas, _expand_joint_results(res, uniq, npix, nb)
 
 
@@ -482,9 +540,11 @@ def write_band_map(path, data, result):
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    retry_quarantined = "--retry-quarantined" in argv
+    argv = [a for a in argv if a != "--retry-quarantined"]
     if len(argv) != 1:
         print("usage: python -m comapreduce_tpu.cli.run_destriper "
-              "parameters.ini", file=sys.stderr)
+              "[--retry-quarantined] parameters.ini", file=sys.stderr)
         return 2
     from comapreduce_tpu.parallel.multihost import rank_info
 
@@ -548,6 +608,21 @@ def main(argv=None) -> int:
     prefetch = ingest_cfg.prefetch
     cache = ingest_cfg.make_cache()
 
+    # resilience layer (docs/OPERATIONS.md §7): `[Resilience]` section
+    # tunes the quarantine ledger / retry policy / chaos injection; ONE
+    # runtime (one ledger) is shared across every band's read
+    from comapreduce_tpu.resilience import ResilienceConfig
+
+    # coerce, not from_mapping: a typo'd knob in the dedicated section
+    # must raise, not silently run with the default
+    res_cfg = ResilienceConfig.coerce(dict(ini.get("Resilience", {})))
+    if retry_quarantined:
+        import dataclasses
+
+        res_cfg = dataclasses.replace(res_cfg, retry_quarantined=True)
+    resilience = res_cfg.make_runtime(out_dir, rank=rank,
+                                      n_ranks=n_ranks)
+
     # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
     # binning per iteration); ground solves keep their own path.
     # `[Inputs] joint : false` forces per-band solves (measurement
@@ -560,7 +635,8 @@ def main(argv=None) -> int:
             offset_length=offset_length, n_iter=n_iter,
             threshold=threshold, use_calibration=use_cal,
             sharded=sharded, tod_variant=tod_variant,
-            coarse_block=coarse_block, prefetch=prefetch, cache=cache)
+            coarse_block=coarse_block, prefetch=prefetch, cache=cache,
+            resilience=resilience)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -581,7 +657,7 @@ def main(argv=None) -> int:
                 threshold=threshold, use_ground=use_ground,
                 use_calibration=use_cal, sharded=sharded,
                 tod_variant=tod_variant, coarse_block=coarse_block,
-                prefetch=prefetch, cache=cache)
+                prefetch=prefetch, cache=cache, resilience=resilience)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         write_band_map(path, data, result)
@@ -602,6 +678,9 @@ def main(argv=None) -> int:
                 if coarse_block
                 else " — consider [Inputs] coarse_precond : 8 "
                 "(two-level preconditioner; docs/OPERATIONS.md §3)")
+    if resilience.ledger is not None and resilience.ledger.entries:
+        print(f"quarantine ledger {resilience.ledger.path}: "
+              f"{resilience.ledger.summary()}")
     return 0
 
 

@@ -58,11 +58,21 @@ def _rebuild(cls, payload, **kwargs):
 
 
 def _stream(filenames, loader, rebuild, prefetch: int = 0,
-            cache=None) -> Iterator[PrefetchItem]:
+            cache=None, retry=None, chaos=None) -> Iterator[PrefetchItem]:
+    if chaos is not None:
+        # fault injection wraps the loader INSIDE the retry net — an
+        # injected read error exercises the same retry/quarantine path
+        # a real one would (resilience/chaos.py). The cache is disabled
+        # for the drill: a poisoned payload written to a (possibly
+        # disk-spilled) cache would outlive the drill and serve NaNs to
+        # a later clean run as a cache hit, bypassing chaos.decide
+        loader = chaos.wrap_loader(loader)
+        cache = None
     if prefetch >= 1:
-        items = Prefetcher(filenames, loader, depth=prefetch, cache=cache)
+        items = Prefetcher(filenames, loader, depth=prefetch, cache=cache,
+                           retry=retry)
     else:
-        items = iter_serial(filenames, loader, cache)
+        items = iter_serial(filenames, loader, cache, retry=retry)
     try:
         for item in items:
             if item.fatal:
@@ -84,8 +94,8 @@ def _stream(filenames, loader, rebuild, prefetch: int = 0,
 
 
 def level1_stream(filenames, prefetch: int = 0, cache=None,
-                  eager_tod: bool = True,
-                  eager_for=None) -> Iterator[PrefetchItem]:
+                  eager_tod: bool = True, eager_for=None,
+                  retry=None, chaos=None) -> Iterator[PrefetchItem]:
     """Ordered ``PrefetchItem``s of :class:`COMAPLevel1` views.
 
     The TOD is materialised on the worker when prefetching (that is the
@@ -101,6 +111,11 @@ def level1_stream(filenames, prefetch: int = 0, cache=None,
     chain will be skipped is not read end to end just to be dropped.
     A lazily-read file is never cached (live h5py handles are neither
     shareable nor picklable).
+
+    ``retry`` (a ``resilience.RetryPolicy``) re-attempts transient read
+    failures with backoff before a file takes its error slot; ``chaos``
+    (a ``resilience.ChaosMonkey``) injects faults around the loader —
+    both off (None) by default.
     """
     eager = eager_tod and (prefetch >= 1 or cache is not None)
 
@@ -110,13 +125,16 @@ def level1_stream(filenames, prefetch: int = 0, cache=None,
 
     return _stream(filenames, loader,
                    lambda p: _rebuild(COMAPLevel1, p),
-                   prefetch=prefetch, cache=cache)
+                   prefetch=prefetch, cache=cache, retry=retry,
+                   chaos=chaos)
 
 
-def level2_stream(filenames, prefetch: int = 0,
-                  cache=None) -> Iterator[PrefetchItem]:
+def level2_stream(filenames, prefetch: int = 0, cache=None,
+                  retry=None, chaos=None) -> Iterator[PrefetchItem]:
     """Ordered ``PrefetchItem``s of :class:`COMAPLevel2` views (the
-    destriper's filelist reader; always fully decoded)."""
+    destriper's filelist reader; always fully decoded). ``retry``/
+    ``chaos`` as in :func:`level1_stream`."""
     return _stream(filenames, load_level2,
                    lambda p: _rebuild(COMAPLevel2, p, filename=""),
-                   prefetch=prefetch, cache=cache)
+                   prefetch=prefetch, cache=cache, retry=retry,
+                   chaos=chaos)

@@ -45,6 +45,7 @@ class PrefetchItem:
     error: BaseException | None = None
     read_s: float = 0.0     # wall seconds spent decoding (0 on cache hit)
     cached: bool = False    # served from the BlockCache
+    retries: int = 0        # transient-failure re-attempts burned
     # True marks a failure of the file *listing* itself, not of one
     # file: consumers must abort (the serial path's iterator raises at
     # the same point), never map it onto per-file fault tolerance
@@ -57,10 +58,15 @@ class PrefetchItem:
         return self.payload
 
 
-def _load_one(index: int, filename: str, loader, cache) -> PrefetchItem:
+def _load_one(index: int, filename: str, loader, cache,
+              retry=None, sleep=None) -> PrefetchItem:
     """Shared load step (cache probe -> loader -> cache fill) used by
-    both the worker thread and :func:`iter_serial`."""
+    both the worker thread and :func:`iter_serial`. ``retry`` (a
+    ``resilience.RetryPolicy``) re-attempts transient loader failures
+    with backoff before the error is captured into the item — applied
+    here so the serial and prefetched paths share one retry site."""
     t0 = time.perf_counter()
+    retries = 0
     try:
         key = None
         if cache is not None:
@@ -75,23 +81,33 @@ def _load_one(index: int, filename: str, loader, cache) -> PrefetchItem:
             from comapreduce_tpu.ingest.cache import file_key
 
             key = file_key(filename)
-        payload = loader(filename)
+        if retry is not None:
+            from comapreduce_tpu.resilience.retry import retry_call
+
+            payload, retries = retry_call(
+                lambda: loader(filename), retry, key=filename,
+                label=f"ingest.read {filename}",
+                **({"sleep": sleep} if sleep is not None else {}))
+        else:
+            payload = loader(filename)
         # only decoded-payload dicts are cacheable: a live store (lazy
         # h5py handle) must never reach the pickle-based disk spill
         if cache is not None and isinstance(payload, dict):
             cache.put(filename, payload, key=key)
         return PrefetchItem(index, filename, payload=payload,
-                            read_s=time.perf_counter() - t0)
+                            read_s=time.perf_counter() - t0,
+                            retries=retries)
     except Exception as exc:  # noqa: BLE001 — per-file fault tolerance
         return PrefetchItem(index, filename, error=exc,
-                            read_s=time.perf_counter() - t0)
+                            read_s=time.perf_counter() - t0,
+                            retries=getattr(exc, "_retries", retries))
 
 
 def iter_serial(filenames: Iterable[str], loader: Callable[[str], Any],
-                cache=None) -> Iterator[PrefetchItem]:
+                cache=None, retry=None) -> Iterator[PrefetchItem]:
     """The serial path: identical items, read lazily at ``next()``."""
     for i, fname in enumerate(filenames):
-        yield _load_one(i, fname, loader, cache)
+        yield _load_one(i, fname, loader, cache, retry)
 
 
 class Prefetcher:
@@ -121,12 +137,14 @@ class Prefetcher:
 
     def __init__(self, filenames: Iterable[str],
                  loader: Callable[[str], Any], depth: int = 2,
-                 cache=None, name: str = "ingest-prefetch"):
+                 cache=None, name: str = "ingest-prefetch",
+                 retry=None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self.depth = int(depth)
         self._loader = loader
         self._cache = cache
+        self._retry = retry
         self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._sentinel = object()
@@ -164,7 +182,13 @@ class Prefetcher:
                     self._put(PrefetchItem(index, "<filelist>",
                                            error=exc, fatal=True))
                     break
-                item = _load_one(index, fname, self._loader, self._cache)
+                # backoff sleeps poll the stop event; wait() returning
+                # True (stop set) ABORTS the retry schedule, so a
+                # closing consumer is never held behind it — neither by
+                # the sleeps nor by zero-delay re-attempts of a dying
+                # loader
+                item = _load_one(index, fname, self._loader, self._cache,
+                                 self._retry, sleep=self._stop.wait)
                 if not self._put(item):
                     return
                 self.depth_log.append((time.perf_counter() - self._t0,

@@ -35,10 +35,28 @@ from comapreduce_tpu.mapmaking.binning import (accumulate_weights, bin_map,
                                                naive_map, sample_map)
 from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
                                                      binned_window_sum)
+from comapreduce_tpu.resilience.tripwires import scrub_tod
 
 __all__ = ["DestriperResult", "destripe", "destripe_jit",
            "destripe_planned", "ground_ids_per_offset",
            "build_coarse_preconditioner", "coarse_pattern"]
+
+# CG divergence tripwire: a system is diverged when its true residual
+# sits more than sqrt(DIVERGENCE_GROWTH)x above the best iterate's for
+# DIVERGENCE_K CONSECUTIVE checks (and is not already converged). It
+# then freezes at its best iterate and ``DestriperResult.diverged``
+# reports it (the host-side fallback in cli/run_destriper re-solves
+# under Jacobi). The thresholds are set from measured trajectories, not
+# taste: |r| is not the quantity PCG minimises, and on the singular
+# ground-template solves the TRUE residual of a perfectly healthy run
+# spikes to ~90x its floor for one-two iterations before snapping back
+# (tier-1 CES geometry, see ISSUE 2 notes) — so short streaks and big
+# single spikes must NOT trip. A genuinely poisoned operator (non-SPD
+# coarse inverse, skew-dominant matvec) grows monotonically without
+# recovery and crosses 10x-in-norm-for-6-straight-checks within a
+# handful of iterations.
+DIVERGENCE_K = 6
+DIVERGENCE_GROWTH = 100.0  # squared-norm factor over the best iterate
 
 
 class DestriperResult(NamedTuple):
@@ -52,6 +70,11 @@ class DestriperResult(NamedTuple):
     hit_map: jax.Array        # f32[npix]
     n_iter: jax.Array         # i32 — CG iterations actually run
     residual: jax.Array       # f32 — final |r|/|b|
+    # i32 0/1 (per system for multi-RHS) — the CG divergence monitor
+    # tripped and the result is the best iterate, not a converged one.
+    # Trailing default keeps positional construction of the 8 original
+    # fields working everywhere.
+    diverged: jax.Array = 0
 
 
 def _expand(offsets, ground, ground_ids, az, n_samples, offset_length):
@@ -108,7 +131,8 @@ def _jacobi_inverse(diag_a: jax.Array, diag_fwf: jax.Array,
     return 1.0 / safe
 
 
-def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None):
+def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
+             x0=None, divergence_k: int = DIVERGENCE_K):
     """Shared (P)CG driver over an arbitrary pytree of unknowns.
 
     Both destriper paths (scatter and planned) use this one loop so the
@@ -121,13 +145,30 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None):
     psum-reduced) inner product; ``precond`` an optional SPD ``M^{-1}``
     application (e.g. Jacobi). Convergence tests the TRUE residual norm
     ``|r|^2`` against ``threshold^2 |b|^2`` in both cases. Returns
-    ``(x, rz, k, b_norm)`` with ``rz = |r|^2``.
+    ``(x, rz, k, b_norm, diverged)`` with ``rz = |r|^2`` and ``diverged``
+    an i32 0/1 flag (per system).
 
     ``dot`` may return a BATCH of inner products (shape ``(nb,)`` for a
     multi-RHS solve over per-band leaves ``(nb, n)``): alpha/beta and the
     breakdown guard then act per system — equivalent to independent CG
     runs sharing one program — and the loop exits when every system has
     converged or broken down.
+
+    Resilience additions (both cheap next to one matvec):
+
+    - divergence monitor — ``divergence_k`` CONSECUTIVE checks with the
+      true residual more than ``DIVERGENCE_GROWTH``x (squared) above
+      the best iterate's mark the system diverged (a poisoned or
+      indefinite preconditioner walks the iterate away from the
+      solution and never recovers; healthy singular solves spike and
+      snap back — see the constants' comment). A diverged system
+      freezes like a breakdown and sets its flag.
+    - best-iterate tracking — a DIVERGED system returns the iterate
+      with the lowest true residual seen instead of the runaway one
+      (healthy systems keep the plain final iterate); the host-side
+      Jacobi fallback restarts from exactly this point.
+    - ``x0`` — optional warm start (the fallback's restart vector);
+      ``None`` keeps the zero start.
     """
     b_norm = dot(b, b)
     minv = precond if precond is not None else (lambda v: v)
@@ -140,13 +181,17 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None):
     def axpy(a, x, y):
         return jax.tree.map(lambda xi, yi: xi + bcast(a, xi) * yi, x, y)
 
+    def sel_where(mask, new, old):
+        return jax.tree.map(
+            lambda a_, b_: jnp.where(bcast(mask, a_), a_, b_), new, old)
+
     def cond(state):
-        _, _, _, _, rr, k, done = state
+        rr, k, done = state[4], state[5], state[6]
         live = ~done & (rr > threshold**2 * jnp.maximum(b_norm, 1e-30))
         return (k < n_iter) & jnp.any(live)
 
     def body(state):
-        x, r, p, rz, rr, k, done = state
+        (x, r, p, rz, rr, k, done, xb, rrb, inc, div) = state
         q = matvec(p)
         pq = dot(p, q)
         ok = jnp.isfinite(pq) & (pq > 0) & ~done
@@ -157,22 +202,54 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None):
         rz_new = dot(r_new, z_new)
         rr_new = dot(r_new, r_new)
         ok = ok & jnp.isfinite(rz_new) & jnp.isfinite(rr_new)
+        # divergence monitor: count consecutive checks the residual
+        # spends far above the best iterate's (not mere increases —
+        # healthy singular solves have long non-monotone streaks; see
+        # the DIVERGENCE_* constants). Already-converged systems are
+        # exempt: f32 wobble at the floor is 'far above' a tiny best.
+        not_conv = rr_new > threshold**2 * jnp.maximum(b_norm, 1e-30)
+        elevated = ok & not_conv & (rr_new > DIVERGENCE_GROWTH * rrb)
+        inc_new = jnp.where(elevated, inc + 1, jnp.where(ok, 0, inc))
+        div_new = div | (inc_new >= divergence_k)
+        # best-iterate tracking (live systems only)
+        better = ok & (rr_new < rrb)
+        xb_new = sel_where(better, x_new, xb)
+        rrb_new = jnp.where(better, rr_new, rrb)
         beta = jnp.where(ok, rz_new / jnp.maximum(rz, 1e-30), 0.0)
         p_new = axpy(beta, z_new, p)
-        # on breakdown: freeze that system's iterate, keep its last good
-        # residual for reporting, and (once every system is done) exit
-        sel = lambda new, old: jax.tree.map(  # noqa: E731
-            lambda a_, b_: jnp.where(bcast(ok, a_), a_, b_), new, old)
-        return (sel(x_new, x), sel(r_new, r), sel(p_new, p),
-                jnp.where(ok, rz_new, rz), jnp.where(ok, rr_new, rr),
-                k + 1, done | ~ok)
+        # on breakdown OR divergence: freeze that system's iterate, keep
+        # its last good residual, and (once every system is done) exit
+        adv = ok & ~div_new
+        return (sel_where(adv, x_new, x), sel_where(adv, r_new, r),
+                sel_where(adv, p_new, p),
+                jnp.where(adv, rz_new, rz), jnp.where(adv, rr_new, rr),
+                k + 1, done | ~ok | div_new, xb_new, rrb_new, inc_new,
+                div_new)
 
-    x0 = jax.tree.map(jnp.zeros_like, b)
-    z0 = minv(b)
-    state0 = (x0, b, z0, dot(b, z0), b_norm, jnp.asarray(0, jnp.int32),
-              jnp.zeros(jnp.shape(b_norm), bool))
-    x, _, _, _, rr, k, _ = jax.lax.while_loop(cond, body, state0)
-    return x, rr, k, b_norm
+    if x0 is None:
+        x_start = jax.tree.map(jnp.zeros_like, b)
+        r0 = b
+    else:
+        x_start = x0
+        q0 = matvec(x0)
+        r0 = jax.tree.map(lambda bi, qi: bi - qi, b, q0)
+    rr0 = dot(r0, r0)
+    z0 = minv(r0)
+    zeros = jnp.zeros(jnp.shape(b_norm))
+    state0 = (x_start, r0, z0, dot(r0, z0), rr0,
+              jnp.asarray(0, jnp.int32), zeros.astype(bool),
+              x_start, rr0, zeros.astype(jnp.int32), zeros.astype(bool))
+    x, _, _, _, rr, k, _, xb, rrb, _, div = jax.lax.while_loop(
+        cond, body, state0)
+    # a DIVERGED system hands back its best iterate, never the runaway
+    # one. Healthy systems keep the final iterate untouched: in the
+    # near-degenerate subspaces of these solves (ground template vs sky
+    # gradient) iterates of almost equal residual differ meaningfully,
+    # and swapping one in would silently move converged results.
+    use_best = div & (rrb < rr)
+    x = sel_where(use_best, xb, x)
+    rr = jnp.where(use_best, rrb, rr)
+    return x, rr, k, b_norm, div.astype(jnp.int32)
 
 
 def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
@@ -199,6 +276,11 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
     n_offsets = n // offset_length
     with_ground = ground_ids is not None
     f32 = tod.dtype
+
+    # numerical tripwire: one NaN/Inf sample would poison every CG inner
+    # product — mask to (value 0, weight 0), exactly the solve on clean
+    # data with those samples zero-weighted (resilience/tripwires.py)
+    tod, weights = scrub_tod(tod, weights)
 
     sum_w = accumulate_weights(pixels, weights, npix, axis_name)
 
@@ -240,7 +322,7 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
         # unpreconditioned directions cost a few CG iterations at most.
         return (v[0] * inv_diag, v[1])
 
-    x, rz, k, b_norm = _cg_loop(
+    x, rz, k, b_norm, diverged = _cg_loop(
         matvec, b, lambda u, v: _dot(u, v, axis_name), n_iter, threshold,
         precond=precond)
     offsets, ground = x
@@ -255,7 +337,7 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
         ground = jnp.zeros((0, 2), f32)
     residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
     return DestriperResult(offsets, ground, m_destriped, m_naive, w_map,
-                           h_map, k, residual)
+                           h_map, k, residual, diverged)
 
 
 destripe_jit = jax.jit(
@@ -426,7 +508,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      ground_off: jax.Array | None = None,
                      az: jax.Array | None = None,
                      n_groups: int = 0,
-                     coarse: tuple | None = None) -> DestriperResult:
+                     coarse: tuple | None = None,
+                     x0: jax.Array | None = None) -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -479,12 +562,21 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     vector is psum'd (blocks may span shards), the tiny dense solve is
     computed redundantly per shard, and each shard gathers its own
     offsets' correction.
+
+    ``x0``: optional warm-start offsets (leading band axis allowed,
+    matching ``tod``) — the divergence-fallback path restarts the
+    Jacobi solve from the coarse solve's best iterate through this.
+    When the CG divergence monitor trips, ``result.diverged`` is 1 for
+    that system and ``offsets`` hold the best (lowest-residual)
+    iterate seen, not a converged solution.
     """
     dv = device_arrays if device_arrays is not None else plan.device()
     with_ground = ground_off is not None
     if with_ground and tod.ndim != 1:
         raise ValueError("the planned ground solve is single-RHS; "
                          "use destripe() or per-band solves otherwise")
+    # numerical tripwire (see destripe): non-finite samples -> (0, 0)
+    tod, weights = scrub_tod(tod, weights)
 
     def _psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -658,7 +750,10 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
 
         b_az = off_sum(pazd_off - paz_off * gm_md)
         b_g = (b, jnp.stack([group_sum(b), group_sum(b_az)], -1))
-        x, rz, k, b_norm = _cg_loop(
+        if x0 is not None:
+            raise ValueError("x0 warm start is offsets-only; the joint "
+                             "ground solve restarts cold")
+        x, rz, k, b_norm, diverged = _cg_loop(
             matvec_g, b_g,
             # offsets are sharded (psum the partial dot); the ground
             # block is replicated (group sums already psum'd), so its
@@ -676,9 +771,9 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     else:
         # per-band inner products (last axis only): a multi-RHS solve
         # runs independent CGs in one program
-        a, rz, k, b_norm = _cg_loop(
+        a, rz, k, b_norm, diverged = _cg_loop(
             matvec, b, lambda u, v: _psum(jnp.sum(u * v, axis=-1)),
-            n_iter, threshold, precond=apply_precond)
+            n_iter, threshold, precond=apply_precond, x0=x0)
         ground = jnp.zeros((0, 2), f32)
         pair_res = pair_wd - pair_w * gather_a(a)
 
@@ -703,4 +798,4 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     h_map = expand(to_global(rank_sum(pair_cnt)))
     residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
     return DestriperResult(a, ground, m_destriped, m_naive,
-                           w_map, h_map, k, residual)
+                           w_map, h_map, k, residual, diverged)

@@ -33,6 +33,7 @@ from comapreduce_tpu.data.level import COMAPLevel2
 from comapreduce_tpu.mapmaking import healpix as hp
 from comapreduce_tpu.mapmaking.wcs import WCS
 from comapreduce_tpu.ops.median_filter import rolling_median
+from comapreduce_tpu.resilience.tripwires import scrub_tod_host
 
 __all__ = ["DestriperData", "read_comap_data", "scan_speed_mask",
            "sun_centric_coords", "export_madam"]
@@ -144,20 +145,32 @@ def _read_frequency_binned(lvl2, band: int):
     """The plain ``Level1Averaging`` product: inverse-variance combine
     the coarse channels; the summed ``1/stddev^2`` doubles as the
     destriper weight (matching the reference's naive-weight convention
-    for its no-gain-filter reductions)."""
+    for its no-gain-filter reductions).
+
+    Returns ``(tod, weights, (F, B, T), n_masked[F])``: a non-finite
+    coarse-channel sample is EXCLUDED from the combine (its inverse
+    variance zeroed) — the old ``nan_to_num`` alone turned a NaN into
+    value 0 under a live weight, biasing its pixel toward zero, the
+    exact failure the tripwires exist to stop. A sample with every
+    channel bad ends at weight 0. ``n_masked`` counts excluded channel
+    samples per feed so the caller can ledger the unit."""
     x = np.asarray(lvl2["frequency_binned/tod"], np.float32)
     F, B, nb, T = x.shape
     if not 0 <= band < B:
-        return None, None, (F, B, T)
+        return None, None, (F, B, T), np.zeros(F, np.int64)
     x = x[:, band]                                        # (F, nb, T)
     s = np.asarray(lvl2["frequency_binned/tod_stddev"],
                    np.float32)[:, band]
-    iv = np.where(s > 0, 1.0 / np.maximum(s, 1e-20) ** 2, 0.0)
+    finite = np.isfinite(x) & np.isfinite(s)
+    iv = np.where(finite & (s > 0), 1.0 / np.maximum(s, 1e-20) ** 2,
+                  0.0)
     den = iv.sum(axis=1)                                  # (F, T)
     num = (np.nan_to_num(x) * iv).sum(axis=1)
     # den==0 samples carry zero weight downstream; their value is moot
     tod = num / np.maximum(den, 1e-30)
-    return tod.astype(np.float32), den.astype(np.float32), (F, B, T)
+    n_masked = (~finite).sum(axis=(1, 2))
+    return (tod.astype(np.float32), den.astype(np.float32), (F, B, T),
+            n_masked)
 
 
 def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
@@ -170,7 +183,8 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     sun_centric: bool = False,
                     min_sun_distance_deg: float = 10.0,
                     tod_variant: str = "auto",
-                    prefetch: int = 0, cache=None) -> DestriperData:
+                    prefetch: int = 0, cache=None,
+                    resilience=None) -> DestriperData:
     """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
     ``nside`` selects the pixelisation. ``mask_turnarounds`` zero-weights
     samples outside the ``speed_range`` deg/s scan-speed band (the legacy
@@ -201,7 +215,14 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     ``cache`` (a :class:`~comapreduce_tpu.ingest.cache.BlockCache`)
     lets multi-pass workloads — the per-band destriper loop over one
     filelist — skip redundant decode. Both paths share one iteration
-    (``ingest.level2_stream``), so results are identical."""
+    (``ingest.level2_stream``), so results are identical.
+
+    ``resilience`` (a ``resilience.Resilience`` bundle) adds the fault
+    layer: files the quarantine ledger marks bad are skipped without a
+    read, transient read failures retry with backoff, injected chaos
+    wraps the loader, failures are ledgered, and any non-finite
+    TOD/weight sample is zero-weighted (with a 'masked' ledger event
+    naming the file/feed/band) before it can reach the destriper."""
     from comapreduce_tpu.ingest import level2_stream
 
     if (wcs is None) == (nside is None):
@@ -209,14 +230,34 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     variants = ("auto", "gain_filtered", "original", "frequency_binned")
     if tod_variant not in variants:
         raise ValueError(f"tod_variant must be one of {variants}")
-    filenames = list(filenames)
+    if resilience is None:
+        from comapreduce_tpu.resilience import Resilience
+
+        resilience = Resilience()  # all capabilities off
+    admitted = []
+    for f in filenames:
+        if resilience.admit(f):
+            admitted.append(f)
+        else:
+            # same per-file visibility as Runner._admitted: a map
+            # missing an observation must be traceable in THIS run's
+            # log, not only in the end-of-run ledger summary
+            logger.warning("%s is quarantined — skipping (re-admit "
+                           "with --retry-quarantined)", f)
+    filenames = admitted
     tods, pixs, wgts, gids, azs = [], [], [], [], []
     group = 0
     kept_files = []
-    stream = level2_stream(filenames, prefetch=prefetch, cache=cache)
+    stream = level2_stream(filenames, prefetch=prefetch, cache=cache,
+                           retry=resilience.retry,
+                           chaos=resilience.chaos)
     try:
         for item in stream:
             fname = item.filename
+            if item.error is None:
+                # a retry-saved read: bookkeeping only, never skipped
+                resilience.record_recovered(fname, item.retries,
+                                            stage="destriper.read")
             try:
                 if item.error is not None:
                     raise item.error  # per-file: same handling as a
@@ -224,17 +265,58 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     # still propagates
                 lvl2 = item.payload
                 if tod_variant == "frequency_binned":
-                    tod_fb, weights, (F, B, T) = _read_frequency_binned(
-                        lvl2, band)
+                    (tod_fb, weights, (F, B, T),
+                     fb_masked) = _read_frequency_binned(lvl2, band)
+                    for ifeed in np.flatnonzero(fb_masked):
+                        logger.warning(
+                            "%s: feed %d band %d: %d non-finite coarse-"
+                            "channel sample(s) excluded from the "
+                            "inverse-variance combine", fname, ifeed,
+                            band, int(fb_masked[ifeed]))
+                        resilience.record_masked(
+                            fname, int(fb_masked[ifeed]),
+                            stage="destriper.tripwire",
+                            feed=int(ifeed), band=band)
                 else:
                     tod_fb, weights, (F, B, T) = _read_averaged(
                         lvl2, band, tod_variant)
             except (OSError, KeyError) as exc:
                 logger.warning("BAD FILE %s (%s)", fname, exc)
+                resilience.record_failure(fname, exc,
+                                          stage="destriper.read")
                 continue
             if tod_fb is None:
                 logger.warning("%s: band %d out of range", fname, band)
                 continue
+
+            def tripwire(t, w, ifeed, fname=fname):
+                """Scrub one feed's samples to (value 0, weight 0);
+                warn + ledger the (file, feed, band) unit when anything
+                was masked. The ONE home for the rule — used before
+                the median filter and again per feed at the end."""
+                t2, w2, n_bad = scrub_tod_host(np.asarray(t),
+                                               np.asarray(w))
+                if n_bad:
+                    logger.warning(
+                        "%s: feed %d band %d: %d non-finite sample(s) "
+                        "zero-weighted", fname, ifeed, band, n_bad)
+                    resilience.record_masked(
+                        fname, n_bad, stage="destriper.tripwire",
+                        feed=int(ifeed), band=band)
+                return t2, w2
+
+            # numerical tripwire, BEFORE the rolling-median high-pass: a
+            # NaN inside a filter window would shift every neighbouring
+            # sample's filtered value (jnp sort parks NaNs at the end,
+            # silently biasing the median) — the burst must become
+            # (value 0, weight 0) before any cross-sample operator sees
+            # it.
+            if not (np.isfinite(tod_fb).all()
+                    and np.isfinite(weights).all()):
+                pairs = [tripwire(tod_fb[i], weights[i], i)
+                         for i in range(tod_fb.shape[0])]
+                tod_fb = np.stack([t for t, _ in pairs])
+                weights = np.stack([w for _, w in pairs])
             is_cal = lvl2.is_calibrator
             src_name = lvl2.source_name
             edges = np.asarray(lvl2.scan_edges)
@@ -297,9 +379,15 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                 a = az_full[ifeed, use]
                 throw = max(np.max(a) - np.min(a), 1e-3)
                 a_norm = (2.0 * (a - np.min(a)) / throw - 1.0).astype(np.float32)
-                tods.append(np.nan_to_num(tod_fb[ifeed, use]))
+                # final guard behind the pre-filter scrub: catches
+                # non-finites INTRODUCED since (a fully-masked median
+                # window, a degenerate calibration factor). A non-finite
+                # sample becomes (value 0, weight 0) — NOT value 0 with
+                # live weight, which would bias the map at its pixel.
+                t_f, w_f = tripwire(tod_fb[ifeed, use], w_f, ifeed)
+                tods.append(t_f)
                 pixs.append(pix)
-                wgts.append(np.nan_to_num(w_f))
+                wgts.append(w_f)
                 gids.append(np.full(w_f.size, group, np.int32))
                 azs.append(a_norm)
                 group += 1

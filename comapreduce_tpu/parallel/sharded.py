@@ -169,7 +169,8 @@ def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
 
     out_specs = DestriperResult(
         offsets=shard, ground=repl, destriped_map=repl, naive_map=repl,
-        weight_map=repl, hit_map=repl, n_iter=repl, residual=repl)
+        weight_map=repl, hit_map=repl, n_iter=repl, residual=repl,
+        diverged=repl)
 
     if with_ground:
         fn = _shard_map(local, mesh=mesh,
@@ -250,7 +251,7 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     out_specs = DestriperResult(
         offsets=v_spec, ground=repl, destriped_map=band_repl,
         naive_map=band_repl, weight_map=band_repl, hit_map=repl,
-        n_iter=repl, residual=band_repl)
+        n_iter=repl, residual=band_repl, diverged=band_repl)
 
     if n_groups:
         def local_g(tod_l, w_l, g_off_l, az_l, arrs):

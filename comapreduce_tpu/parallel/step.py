@@ -106,7 +106,8 @@ class ObservationStep:
 
             out_specs = DestriperResult(
                 offsets=spec, ground=P(), destriped_map=P(), naive_map=P(),
-                weight_map=P(), hit_map=P(), n_iter=P(), residual=P())
+                weight_map=P(), hit_map=P(), n_iter=P(), residual=P(),
+                diverged=P())
             result = _shard_map(
                 lambda t, p, w: destripe(
                     t, p, w, npix, offset_length=oflen, n_iter=self.n_iter,

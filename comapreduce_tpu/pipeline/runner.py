@@ -49,10 +49,21 @@ def set_logging(base: str = "pipeline", log_dir: str = ".",
     logger.addHandler(handler)
     logger.setLevel(getattr(logging, level.upper(), logging.INFO))
 
-    def excepthook(exc_type, exc, tb):
-        logger.error("uncaught exception", exc_info=(exc_type, exc, tb))
-        sys.__excepthook__(exc_type, exc, tb)
+    # CHAIN to whatever hook was installed before us (a debugger, a
+    # crash reporter, an earlier set_logging) instead of clobbering it
+    # — but when the previous hook is one of ours, chain to ITS parent
+    # so repeated set_logging calls never stack an unbounded chain.
+    prev_hook = sys.excepthook
+    if getattr(prev_hook, "_comap_excepthook", False):
+        prev_hook = prev_hook._comap_prev
 
+    def excepthook(exc_type, exc, tb):
+        logger.error("rank %d: uncaught exception", rank,
+                     exc_info=(exc_type, exc, tb))
+        prev_hook(exc_type, exc, tb)
+
+    excepthook._comap_excepthook = True
+    excepthook._comap_prev = prev_hook
     sys.excepthook = excepthook
     return path
 
@@ -86,11 +97,20 @@ class Runner:
     # streaming-ingest knob: IngestConfig | {"prefetch": N, "cache_mb":
     # M, ...} | None. prefetch=0 (default) is the serial path.
     ingest: object = None
+    # resilience knob: ResilienceConfig | {"quarantine": ..., ...} |
+    # None. The default config quarantines failures into
+    # <output_dir>/quarantine.jsonl and retries transient reads;
+    # {"quarantine": "off"} restores the bare BAD-FILE-log behaviour.
+    resilience: object = None
     # the BlockCache lives on the Runner, not the run_tod call: a
     # reduction pass followed by run_astro_cal (run_average's flow) or
     # a second run_tod re-reads the same Level-1 files, and a per-call
     # cache could never hit
     _ingest_cache: object = field(default=None, repr=False)
+    # the built Resilience runtime (ledger/retry/chaos) — Runner-lifetime
+    # for the same reason as the cache: run_astro_cal and repeated
+    # run_tod calls must consult ONE ledger
+    _resilience: object = field(default=None, repr=False)
 
     def shard_iter(self, filelist):
         """Lazy round-robin shard: rank r takes files ``i % n_ranks == r``.
@@ -114,6 +134,12 @@ class Runner:
         fails takes the same per-file "BAD FILE" -> ``None`` slot as a
         file whose stage chain fails — a bad file never kills the queue
         or the run.
+
+        Failures also land in the quarantine ledger
+        (``<output_dir>/quarantine.jsonl`` by default, ``resilience``
+        knob): transient read errors are retried with backoff first;
+        files the ledger already quarantines are skipped WITHOUT a read
+        (no result slot) until ``retry_quarantined`` re-admits them.
         """
         from comapreduce_tpu.ingest import IngestConfig, level1_stream
 
@@ -122,21 +148,51 @@ class Runner:
         if self._ingest_cache is None:
             self._ingest_cache = cfg.make_cache()
         cache = self._ingest_cache
+        res = self._resilience_runtime()
         results = []
-        stream = level1_stream(self.shard_iter(filelist),
+        stream = level1_stream(self._admitted(filelist, res),
                                prefetch=cfg.prefetch, cache=cache,
                                eager_tod=cfg.eager_tod,
-                               eager_for=self._needs_tod)
+                               eager_for=self._needs_tod,
+                               retry=res.retry, chaos=res.chaos)
         try:
-            self._consume_stream(stream, results)
+            self._consume_stream(stream, results, res)
         finally:
             # deterministic shutdown even when a stage raises something
             # the per-file net does not catch and the caller keeps the
             # traceback alive: closing the generator stops the worker
             stream.close()
+        if res.ledger is not None and res.ledger.entries:
+            logger.info("quarantine ledger %s: %s", res.ledger.path,
+                        res.ledger.summary())
         return results
 
-    def _consume_stream(self, stream, results: list) -> None:
+    def _resilience_runtime(self):
+        """The Runner-lifetime Resilience bundle (built on first use).
+        Multi-rank runs get per-rank ledger files (single-writer JSONL;
+        the shard split is stable, so each rank's skip set is its own)."""
+        from comapreduce_tpu.resilience import ResilienceConfig
+
+        if self._resilience is None:
+            cfg = ResilienceConfig.coerce(self.resilience)
+            self._resilience = cfg.make_runtime(
+                self.output_dir, rank=self.rank, n_ranks=self.n_ranks)
+        return self._resilience
+
+    def _admitted(self, filelist, res):
+        """This rank's shard, minus currently-quarantined files (the
+        cheap resume skip — no read, no decode, one log line)."""
+        for f in self.shard_iter(filelist):
+            if res.admit(f):
+                yield f
+            else:
+                logger.warning("rank %d: %s is quarantined — skipping "
+                               "(re-admit with --retry-quarantined)",
+                               self.rank, f)
+
+    def _consume_stream(self, stream, results: list, res=None) -> None:
+        if res is None:  # direct callers/tests without a runtime
+            res = self._resilience_runtime()
         for item in stream:
             logger.info("rank %d: processing %s", self.rank, item.filename)
             self.timings.setdefault("ingest.read", []).append(item.read_s)
@@ -145,22 +201,49 @@ class Runner:
                 # per-file fault tolerance: a bad file never kills the
                 # run (reference: broad try/except + "BAD FILE" logging,
                 # COMAPData.py:169-173); prefetch-worker failures are
-                # re-raised here, per file, never queue-fatal
+                # re-raised here, per file, never queue-fatal. The
+                # ledger entry carries what the log line loses: the
+                # failure class, retry count and traceback digest.
                 logger.error("BAD FILE %s", item.filename,
                              exc_info=item.error)
+                res.record_failure(item.filename, item.error,
+                                   stage="ingest.read")
                 results.append(None)
                 # keep the read/compute lists index-aligned per file
                 self.timings.setdefault("ingest.compute", []).append(0.0)
                 continue
+            # a retry-saved read is bookkeeping only, never skipped
+            res.record_recovered(item.filename, item.retries,
+                                 stage="ingest.read")
             try:
-                results.append(self.run_file(item.filename,
-                                             data=item.payload))
-            except Exception:
+                results.append(self._run_file_with_retry(item, res))
+            except Exception as exc:
                 logger.exception("BAD FILE %s", item.filename)
+                # never quarantine the INPUT over a stage-chain error:
+                # it may indict the output side (a full disk during the
+                # checkpoint write), and skipping the input durably
+                # would turn an environment outage into missing data
+                res.record_failure(item.filename, exc,
+                                   stage="stage_chain",
+                                   may_quarantine=False)
                 results.append(None)
             finally:
                 self.timings.setdefault("ingest.compute", []).append(
                     time.perf_counter() - t0)
+
+    def _run_file_with_retry(self, item, res):
+        """The per-file stage loop under the retry policy: a transient
+        failure (an NFS flake mid-checkpoint-write) re-runs the chain —
+        resume-safe, since completed stages skip off the checkpoint.
+        Permanent (shape/validation) errors propagate immediately."""
+        from comapreduce_tpu.resilience.retry import retry_call
+
+        value, retries = retry_call(
+            lambda: self.run_file(item.filename, data=item.payload),
+            res.retry, key=item.filename,
+            label=f"stage chain {item.filename}")
+        res.record_recovered(item.filename, retries, stage="stage_chain")
+        return value
 
     def _needs_tod(self, filename: str) -> bool:
         """False when every OUTPUT-producing stage of this file's chain
@@ -179,11 +262,21 @@ class Runner:
 
         l2path = level2_path(self.output_dir, filename, self.prefix)
         if not os.path.exists(l2path):
-            return True
+            return True  # checkpoint missing: normal first-run state
         try:
             with safe_hdf5_open(l2path, "r") as f:
                 have = set(f.keys())
-        except Exception:  # unreadable/partial Level-2: read normally
+        except Exception as exc:
+            # checkpoint PRESENT but unreadable — that is never normal
+            # (a partial copy, bit rot, a foreign file squatting on the
+            # checkpoint name): say so and ledger the stale Level-2 file
+            # instead of silently re-reading as if nothing happened.
+            # Returning True re-runs the chain, whose atomic checkpoint
+            # write replaces the corrupt file.
+            logger.warning("corrupt/unreadable Level-2 checkpoint %s "
+                           "(%s: %s); re-reducing %s from Level-1",
+                           l2path, type(exc).__name__, exc, filename)
+            self._quarantine_checkpoint(l2path, filename, exc)
             return True
 
         def contained(p) -> bool:
@@ -194,6 +287,28 @@ class Runner:
             getattr(p, "groups", ()) and
             (not contained(p) or getattr(p, "overwrite", False))
             for p in self.processes)
+
+    def _quarantine_checkpoint(self, l2path: str, filename: str,
+                               exc: BaseException) -> None:
+        """Ledger a corrupt Level-2 checkpoint (shared by the resume
+        probe and ``_run_file``): quarantined until the re-reduction
+        rewrites it, so a destriper run in between never maps stale
+        data. Idempotent (a checkpoint that stays corrupt across runs
+        appends one entry, not one per probe), and lock contention is
+        exempt — another rank mid-write is not a corrupt file."""
+        from comapreduce_tpu.resilience.retry import (classify_error,
+                                                      is_lock_error)
+
+        res = self._resilience_runtime()
+        if res.ledger is None or is_lock_error(exc) \
+                or res.ledger.is_quarantined(l2path):
+            return
+        res.ledger.record(l2path, error=exc,
+                          failure_class=classify_error(exc),
+                          disposition="quarantined",
+                          stage="resume.checkpoint",
+                          message=f"unreadable checkpoint for "
+                                  f"{filename}: {exc}")
 
     def run_file(self, filename: str, data=None) -> COMAPLevel2:
         if self.profile_dir:
@@ -216,8 +331,37 @@ class Runner:
         if data is None:
             data = COMAPLevel1()
             data.read(filename)
-        lvl2 = COMAPLevel2(
-            filename=level2_path(self.output_dir, filename, self.prefix))
+        l2path = level2_path(self.output_dir, filename, self.prefix)
+        try:
+            lvl2 = COMAPLevel2(filename=l2path)
+        except Exception as exc:
+            from comapreduce_tpu.resilience.retry import is_lock_error
+
+            if is_lock_error(exc):
+                # a WRITER holds the checkpoint (contention, not
+                # corruption): never unlink a live file — let the
+                # per-file retry policy re-attempt the chain
+                raise
+            # checkpoint present but unreadable (partial copy, bit rot):
+            # start from a FRESH store under the same name — the first
+            # stage's atomic write replaces the corrupt file whole. The
+            # old behaviour let the open error bubble into the per-file
+            # net, burning the whole observation on a stale checkpoint.
+            logger.warning("unreadable Level-2 checkpoint %s (%s: %s); "
+                           "starting fresh", l2path, type(exc).__name__,
+                           exc)
+            self._quarantine_checkpoint(l2path, filename, exc)
+            try:
+                # the corrupt file must go NOW: the atomic checkpoint
+                # write copies an existing target before appending, and
+                # appending into garbage raises. A kill between unlink
+                # and first write just means a clean re-reduce on resume.
+                os.unlink(l2path)
+            except OSError:
+                pass
+            lvl2 = COMAPLevel2(filename="")
+            lvl2.filename = l2path
+        wrote = False
         for process in self.processes:
             pname = getattr(process, "name", type(process).__name__)
             process.pre_init(data)
@@ -239,6 +383,19 @@ class Runner:
             # checkpoint after EVERY stage; atomic so a kill mid-write
             # can't strand a half-written group that resume would skip
             lvl2.write(lvl2.filename, atomic=True)
+            wrote = True
+        res = self._resilience_runtime()
+        if wrote and res.ledger is not None and \
+                res.ledger.is_quarantined(lvl2.filename):
+            # a checkpoint _needs_tod flagged as corrupt has now been
+            # rewritten whole: lift its quarantine so downstream
+            # (destriper filelists) sees it live again. Gated on an
+            # ACTUAL write — a chain that aborted on falsy STATE before
+            # writing must not record a recovery that never happened.
+            res.ledger.record(lvl2.filename, disposition="recovered",
+                              stage="resume.checkpoint",
+                              message="checkpoint rewritten by "
+                                      "re-reduction")
         return lvl2
 
     def run_astro_cal(self, filelist: list[str],
@@ -256,10 +413,12 @@ class Runner:
         sub = Runner(processes=[stage], output_dir=self.output_dir,
                      prefix=self.prefix, rank=self.rank,
                      n_ranks=self.n_ranks, timings=self.timings,
-                     ingest=self.ingest,
-                     _ingest_cache=self._ingest_cache)
+                     ingest=self.ingest, resilience=self.resilience,
+                     _ingest_cache=self._ingest_cache,
+                     _resilience=self._resilience)
         results = sub.run_tod(filelist)
         self._ingest_cache = sub._ingest_cache  # share warm cache back
+        self._resilience = sub._resilience      # ... and the ledger
         return results
 
     # -- config-driven construction ----------------------------------------
@@ -273,8 +432,12 @@ class Runner:
         ``backend``; each ``[StageName]`` section holds that stage's
         kwargs (including per-stage ``backend``/``overwrite``). An
         optional ``[ingest]`` table (``prefetch``, ``cache_mb``,
-        ``spill_dir``) turns on streaming ingest (docs/ingest.md)."""
+        ``spill_dir``) turns on streaming ingest (docs/ingest.md); an
+        optional ``[resilience]`` table (``quarantine``,
+        ``max_retries``, ``inject``, ...) tunes the quarantine/retry/
+        chaos layer (docs/OPERATIONS.md §7)."""
         from comapreduce_tpu.ingest import IngestConfig
+        from comapreduce_tpu.resilience import ResilienceConfig
 
         if isinstance(config, str):
             config = cfg_mod.load_toml(config)
@@ -289,14 +452,18 @@ class Runner:
                    output_dir=glob.get("output_dir", "."),
                    prefix=glob.get("prefix", "Level2"),
                    rank=rank, n_ranks=n_ranks,
-                   ingest=IngestConfig.coerce(config.get("ingest")))
+                   ingest=IngestConfig.coerce(config.get("ingest")),
+                   resilience=ResilienceConfig.coerce(
+                       config.get("resilience")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,
                            n_ranks: int = 1) -> "Runner":
         """Build from a legacy INI (``Module.Class(variant)`` registry,
-        ``Tools/Parser.py:44-96``)."""
+        ``Tools/Parser.py:44-96``). Resilience knobs live in a
+        ``[Resilience]`` section (same names as the TOML table)."""
         from comapreduce_tpu.ingest import IngestConfig
+        from comapreduce_tpu.resilience import ResilienceConfig
 
         ini = cfg_mod.IniConfig(ini_path)
         processes = [resolve(name, **kwargs)
@@ -305,4 +472,9 @@ class Runner:
         return cls(processes=processes,
                    output_dir=inputs.get("output_dir", "."),
                    rank=rank, n_ranks=n_ranks,
-                   ingest=IngestConfig.from_mapping(inputs))
+                   ingest=IngestConfig.from_mapping(inputs),
+                   # coerce, not from_mapping: [Resilience] is a
+                   # DEDICATED section, so a typo'd knob must raise
+                   # instead of silently running with the default
+                   resilience=ResilienceConfig.coerce(
+                       dict(ini.get("Resilience", {}))))

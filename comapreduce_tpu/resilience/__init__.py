@@ -1,0 +1,56 @@
+"""Resilience layer: quarantine ledger, retry policy, tripwires, chaos.
+
+The reference pipeline's whole answer to bad data is a broad
+``try/except`` that logs ``BAD FILE`` and drops the observation
+(``COMAPData.py:169-173``); nothing records *what* failed, *why*, or
+whether a retry could have saved it, and a re-run pays the full read
+cost of every known-bad file again. Real reductions are dominated by
+data-quality rejection (COMAP ESIII, arXiv:2111.05929) and large
+map-making runs must survive detector-level failures without
+restarting the solve (MAPPRAISER, arXiv:2112.03370). This subsystem
+gives the framework the same property:
+
+- :class:`QuarantineLedger` (``ledger``) — a persistent JSONL record of
+  every failed/suspect unit (file, feed, band, scan) with failure
+  class, traceback digest, retry count and disposition. Consulted on
+  resume: known-bad files are skipped without a read, and re-admitted
+  only on explicit ``--retry-quarantined``.
+- :class:`RetryPolicy` (``retry``) — bounded retries with exponential
+  backoff + deterministic jitter, driven by transient-vs-permanent
+  error classification (``OSError``/truncated-HDF5 reads are worth a
+  retry; shape/validation errors never are).
+- ``tripwires`` — cheap jitted finite-fraction checks that mask NaN/Inf
+  TOD samples into zero weight before they can poison a CG solve, plus
+  the host-side scrub bookkeeping. The destriper's CG loop carries the
+  matching divergence monitor (``destriper._cg_loop``).
+- :class:`ChaosMonkey` (``chaos``) — deterministic fault injection
+  (read errors, NaN bursts, truncated files, slow reads, first-attempt
+  flakes) by seed, so every path above is exercised in CI
+  (``tools/check_resilience.py``) instead of discovered in production.
+
+Config surface: :class:`ResilienceConfig` (TOML ``[resilience]`` table,
+INI ``[Resilience]`` section) -> :meth:`ResilienceConfig.make_runtime`
+-> a :class:`Resilience` bundle threaded through ``pipeline.Runner``,
+``ingest`` streams and ``mapmaking.leveldata``. See
+``docs/OPERATIONS.md`` §7.
+"""
+
+from comapreduce_tpu.resilience.chaos import ChaosMonkey  # noqa: F401
+from comapreduce_tpu.resilience.config import (  # noqa: F401
+    Resilience,
+    ResilienceConfig,
+)
+from comapreduce_tpu.resilience.ledger import (  # noqa: F401
+    LedgerEntry,
+    QuarantineLedger,
+)
+from comapreduce_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy,
+    classify_error,
+    retry_call,
+)
+from comapreduce_tpu.resilience.tripwires import (  # noqa: F401
+    finite_fraction,
+    scrub_tod,
+    scrub_tod_host,
+)

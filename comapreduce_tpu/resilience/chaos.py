@@ -1,0 +1,182 @@
+"""Fault injection: deterministic chaos for the resilience layer.
+
+Every failure path the resilience layer promises to survive — read
+errors, truncated files, NaN bursts, slow reads, first-attempt flakes
+— can be injected here *deterministically by seed*, so CI drills
+(``tools/check_resilience.py``, ``bench.py --config resilience``)
+exercise them on every run instead of production discovering them.
+
+Config knob: ``[resilience] inject = "read_error:0.25,nan_burst:0.25"``
+(TOML) / ``inject : read_error:0.25,nan_burst:0.25`` (INI) — a comma
+list of ``kind[@substr][:rate]`` with rate in [0, 1] (default 1);
+``@substr`` limits the fault to files whose basename contains
+``substr`` (how the drills aim one fault at one file). Kinds:
+
+- ``read_error`` — the loader raises ``OSError`` (every attempt);
+- ``truncate``   — ``OSError`` worded like h5py's truncated-file error
+  (same class as read_error on purpose: both are the retryable kind);
+- ``flaky``      — ``OSError`` on the FIRST attempt only; a retry
+  succeeds (the recovered-by-retry path);
+- ``nan_burst``  — the decoded payload's TOD gets a NaN burst in one
+  feed (copy-on-poison: a shared cache payload is never mutated);
+- ``slow_read``  — the read sleeps ``slow_s`` first (exercises the
+  prefetch queue under a lagging producer).
+
+Whether a given file draws a given fault depends only on
+``(seed, kind, basename)`` — stable across runs, across iteration
+order, and across serial-vs-prefetched paths.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["ChaosMonkey", "parse_inject_spec", "CHAOS_KINDS"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+CHAOS_KINDS = ("read_error", "truncate", "flaky", "nan_burst",
+               "slow_read")
+
+# TOD datasets a NaN burst can poison, by payload schema
+_POISON_KEYS = ("spectrometer/tod", "averaged_tod/tod",
+                "frequency_binned/tod")
+
+
+def parse_inject_spec(spec: str) -> list:
+    """``"read_error@0003:0.5,nan_burst"`` -> ``[(kind, substr, rate)]``
+    (``substr`` '' = every file). Empty spec -> ``[]``. Unknown kinds
+    and rates outside [0, 1] raise."""
+    out: list[tuple[str, str, float]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, rate_s = part.partition(":")
+        kind, _, substr = head.partition("@")
+        kind = kind.strip()
+        if kind not in CHAOS_KINDS:
+            raise ValueError(f"unknown chaos kind {kind!r} "
+                             f"(know {CHAOS_KINDS})")
+        rate = float(rate_s) if rate_s.strip() else 1.0
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"chaos rate for {kind!r} must be in "
+                             f"[0, 1], got {rate}")
+        out.append((kind, substr.strip(), rate))
+    return out
+
+
+class ChaosMonkey:
+    """Deterministic fault injector wrapping an ingest loader.
+
+    ``injected`` logs every fault actually fired as
+    ``(filename, kind)`` — the drill's ground truth when asserting the
+    quarantine ledger caught everything.
+    """
+
+    def __init__(self, spec: str | list, seed: int = 0,
+                 slow_s: float = 0.05, burst_frac: float = 0.05):
+        self.entries = (list(spec) if isinstance(spec, list)
+                        else parse_inject_spec(spec))
+        self.seed = int(seed)
+        self.slow_s = float(slow_s)
+        self.burst_frac = float(burst_frac)
+        self.injected: list[tuple[str, str]] = []
+        self._attempts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def decide(self, filename: str) -> list:
+        """Kinds that fire for this file — a pure function of
+        ``(seed, kind, basename)`` (and the spec's ``@substr``
+        targeting)."""
+        base = os.path.basename(filename)
+        fired = []
+        for kind, substr, rate in self.entries:
+            if kind in fired or rate <= 0.0:
+                continue
+            if substr and substr not in base:
+                continue
+            if random.Random(f"{self.seed}:{kind}:{base}").random() < rate:
+                fired.append(kind)
+        return fired
+
+    def _note(self, filename: str, kind: str) -> None:
+        with self._lock:
+            self.injected.append((filename, kind))
+        logger.info("chaos: injected %s into %s", kind, filename)
+
+    def wrap_loader(self, loader):
+        """``loader(path) -> payload`` with faults injected around it."""
+
+        def chaotic(path):
+            kinds = self.decide(path)
+            if "slow_read" in kinds:
+                self._note(path, "slow_read")
+                time.sleep(self.slow_s)
+            if "flaky" in kinds:
+                with self._lock:
+                    n = self._attempts[path] = \
+                        self._attempts.get(path, 0) + 1
+                if n == 1:
+                    self._note(path, "flaky")
+                    raise OSError(f"chaos: flaky read of {path} "
+                                  "(succeeds on retry)")
+            if "read_error" in kinds:
+                self._note(path, "read_error")
+                raise OSError(f"chaos: injected read error for {path}")
+            if "truncate" in kinds:
+                self._note(path, "truncate")
+                # h5py's wording for a file cut short mid-copy
+                raise OSError(f"chaos: unable to open file {path} "
+                              "(truncated file, injected)")
+            payload = loader(path)
+            if "nan_burst" in kinds:
+                payload = self._poison(path, payload)
+            return payload
+
+        return chaotic
+
+    # -- NaN bursts --------------------------------------------------------
+    def burst_coords(self, path: str, shape: tuple):
+        """Deterministic burst placement for an array of ``shape``:
+        ``(feed | None, start, n)`` — shared by the injector and by the
+        drill, which reconstructs the exact faulted unit to build its
+        zero-weighted reference run."""
+        rng = random.Random(f"{self.seed}:burst:{os.path.basename(path)}")
+        t_axis = int(shape[-1])
+        n = max(1, int(t_axis * self.burst_frac))
+        start = rng.randrange(max(t_axis - n, 1))
+        feed = rng.randrange(shape[0]) if len(shape) > 1 else None
+        return feed, start, n
+
+    def _poison(self, path: str, payload):
+        """NaN-burst one feed of the payload's TOD (copy-on-poison)."""
+        data = payload.get("data") if isinstance(payload, dict) else None
+        if data is None and hasattr(payload, "materialise") \
+                and hasattr(payload, "__setitem__"):
+            data = payload  # live store: item assignment replaces the
+            # array, the store's own copy semantics apply
+        if data is None:
+            return payload
+        for key in _POISON_KEYS:
+            if key in data:
+                arr = data[key]
+                if hasattr(payload, "materialise") and data is payload:
+                    arr = payload.materialise(key)
+                arr = np.array(arr, copy=True)  # never poison a shared
+                # cache payload in place
+                feed, start, n = self.burst_coords(path, arr.shape)
+                if feed is None:
+                    arr[start:start + n] = np.nan
+                else:
+                    arr[feed, ..., start:start + n] = np.nan
+                data[key] = arr
+                self._note(path, "nan_burst")
+                break
+        return payload

@@ -1,0 +1,276 @@
+"""Resilience configuration + the runtime bundle threaded through.
+
+Mirrors :class:`~comapreduce_tpu.ingest.config.IngestConfig`: one value
+object owning the knob names so the TOML ``[resilience]`` table, the
+INI ``[Resilience]`` section and the CLI flags cannot drift apart, plus
+:class:`Resilience` — the built runtime (ledger + retry policy + chaos
+monkey) that ``Runner``/``read_comap_data`` actually consume.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from comapreduce_tpu.resilience.chaos import (ChaosMonkey,
+                                              parse_inject_spec)
+from comapreduce_tpu.resilience.ledger import QuarantineLedger
+from comapreduce_tpu.resilience.retry import RetryPolicy
+
+__all__ = ["ResilienceConfig", "Resilience"]
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Knobs for the resilience subsystem.
+
+    quarantine:
+        Ledger path. ``"auto"`` (default) puts ``quarantine.jsonl``
+        next to the run's outputs; an explicit path is used verbatim;
+        ``"off"``/``"none"``/empty disables the ledger (failures fall
+        back to the plain ``BAD FILE`` log line).
+    max_retries / retry_base_s / retry_max_s / retry_jitter:
+        :class:`~comapreduce_tpu.resilience.retry.RetryPolicy` fields —
+        bounded exponential backoff for transient (I/O-class) failures.
+    retry_quarantined:
+        Re-admit every currently-quarantined unit at startup (the
+        ``--retry-quarantined`` CLI flag lands here). Each re-admission
+        is itself a ledger event.
+    inject / inject_seed:
+        Chaos spec (``chaos.parse_inject_spec`` syntax) + seed. Empty
+        spec = no injection (production default).
+    """
+
+    quarantine: str = "auto"
+    max_retries: int = 2
+    retry_base_s: float = 0.5
+    retry_max_s: float = 30.0
+    retry_jitter: float = 0.25
+    retry_quarantined: bool = False
+    inject: str = ""
+    inject_seed: int = 0
+
+    def __post_init__(self):
+        # normalise INI-coerced values (None from 'none'/'', bools,
+        # numbers-as-strings) once, here — same contract as IngestConfig
+        q = self.quarantine
+        if q is None or str(q).strip().lower() in ("off", "none", "false",
+                                                   ""):
+            q = ""
+        elif q is True or str(q).strip().lower() in ("auto", "true"):
+            q = "auto"
+        object.__setattr__(self, "quarantine", str(q))
+        object.__setattr__(self, "max_retries",
+                           max(int(self.max_retries or 0), 0))
+        object.__setattr__(self, "retry_base_s",
+                           max(float(self.retry_base_s or 0.0), 0.0))
+        object.__setattr__(self, "retry_max_s",
+                           max(float(self.retry_max_s or 0.0), 0.0))
+        object.__setattr__(self, "retry_jitter",
+                           max(float(self.retry_jitter or 0.0), 0.0))
+        object.__setattr__(self, "retry_quarantined",
+                           bool(self.retry_quarantined))
+        # INI coercion splits a comma value into a LIST (the documented
+        # multi-fault spec `inject : read_error:0.05,nan_burst:0.05`
+        # arrives as ['read_error:0.05', 'nan_burst:0.05']) — rejoin it;
+        # then parse eagerly so a typo'd spec fails at config load, not
+        # mid-run
+        inj = self.inject
+        if isinstance(inj, (list, tuple)):
+            inj = ",".join(str(v).strip() for v in inj)
+        inj = str(inj or "")
+        parse_inject_spec(inj)
+        object.__setattr__(self, "inject", inj)
+        object.__setattr__(self, "inject_seed",
+                           int(self.inject_seed or 0))
+
+    KNOBS = ("quarantine", "max_retries", "retry_base_s", "retry_max_s",
+             "retry_jitter", "retry_quarantined", "inject", "inject_seed")
+
+    @classmethod
+    def from_mapping(cls, mapping) -> "ResilienceConfig":
+        """Pick the resilience knobs out of a wider MIXED mapping (an
+        ``[Inputs]``-style section holding other subsystems' keys too),
+        ignoring unrelated keys. A dedicated ``[Resilience]``/TOML
+        ``[resilience]`` section must go through :meth:`coerce`, which
+        REJECTS unknown keys — a typo'd knob silently falling back to
+        its default is exactly the failure a dedicated section can
+        catch."""
+        return cls(**{k: mapping[k] for k in cls.KNOBS if k in mapping})
+
+    @classmethod
+    def coerce(cls, value) -> "ResilienceConfig":
+        """Build from None / dict / ResilienceConfig."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            known = {k: value[k] for k in cls.KNOBS if k in value}
+            unknown = set(value) - set(known)
+            if unknown:
+                raise ValueError(
+                    f"unknown resilience keys: {sorted(unknown)}")
+            return cls(**known)
+        raise TypeError(f"cannot build ResilienceConfig from {type(value)}")
+
+    def ledger_path(self, output_dir: str = ".", rank: int = 0,
+                    n_ranks: int = 1) -> str:
+        """Resolved ledger path ('' when disabled).
+
+        Multi-rank runs get per-rank auto paths: JSONL appends are only
+        atomic single-writer (NFS interleaving would garble lines), and
+        the round-robin filelist shard is stable across runs, so each
+        rank owning its shard's failures in its own file keeps both the
+        append and the resume-skip correct."""
+        if not self.quarantine:
+            return ""
+        if self.quarantine == "auto":
+            name = ("quarantine.jsonl" if n_ranks <= 1
+                    else f"quarantine.rank{rank}.jsonl")
+            return os.path.join(output_dir or ".", name)
+        return self.quarantine
+
+    def make_runtime(self, output_dir: str = ".", rank: int = 0,
+                     n_ranks: int = 1) -> "Resilience":
+        """Build the runtime bundle this config describes."""
+        import logging
+
+        path = self.ledger_path(output_dir, rank=rank, n_ranks=n_ranks)
+        if path and self.quarantine == "auto":
+            # fold in every sibling auto ledger read-only: a run with a
+            # DIFFERENT rank count than the one that recorded a failure
+            # must still see it (writes stay single-file, single-writer)
+            import glob as _glob
+
+            siblings = sorted(_glob.glob(os.path.join(
+                os.path.dirname(path) or ".", "quarantine*.jsonl")))
+            ledger = QuarantineLedger(path, read_paths=tuple(siblings))
+        elif path:
+            ledger = QuarantineLedger(path)
+        else:
+            ledger = None
+        retry = RetryPolicy(max_retries=self.max_retries,
+                            base_s=self.retry_base_s,
+                            max_s=self.retry_max_s,
+                            jitter=self.retry_jitter,
+                            seed=self.inject_seed)
+        chaos = (ChaosMonkey(self.inject, seed=self.inject_seed)
+                 if self.inject else None)
+        if chaos is not None:
+            # loud on purpose: injected faults go through the REAL
+            # quarantine path (that is the drill's point), so running a
+            # drill against a production ledger would durably skip
+            # healthy files — point drills at a scratch output_dir
+            logging.getLogger("comapreduce_tpu").warning(
+                "chaos injection ACTIVE (inject=%r, seed=%d): injected "
+                "failures will be ledgered and may QUARANTINE files in "
+                "%s — use a scratch output dir for drills",
+                self.inject, self.inject_seed, path or "<no ledger>")
+        return Resilience(ledger=ledger, retry=retry, chaos=chaos,
+                          retry_quarantined=self.retry_quarantined)
+
+
+@dataclass
+class Resilience:
+    """The built runtime bundle consumers thread through.
+
+    Any field may be None (that capability is off); the helpers below
+    keep the call sites free of ``if ... is not None`` noise.
+    """
+
+    ledger: QuarantineLedger | None = None
+    retry: RetryPolicy | None = None
+    chaos: ChaosMonkey | None = None
+    retry_quarantined: bool = False
+    _readmitted: set = field(default_factory=set)
+    # quarantine snapshot, frozen at the first admit() of this runtime:
+    # a file quarantined MID-run must not change which files the rest of
+    # the same run covers (per-band destriper maps over one shared
+    # runtime would otherwise cover different observation sets); the
+    # next run's fresh runtime picks the new entries up
+    _admit_snapshot: set | None = field(default=None, repr=False)
+
+    def admit(self, filename: str) -> bool:
+        """Quarantine gate for one file: True = process it.
+
+        With ``retry_quarantined`` a quarantined file is re-admitted
+        (ledger event, once per run) and processed; otherwise it is
+        skipped cheaply — no read, no decode. The quarantined set is
+        snapshotted at this runtime's first admit() call (see field
+        comment)."""
+        if self.ledger is None:
+            return True
+        if self._admit_snapshot is None:
+            self._admit_snapshot = self.ledger.quarantined_files()
+        if filename not in self._admit_snapshot:
+            return True
+        if self.retry_quarantined:
+            if filename not in self._readmitted:
+                self._readmitted.add(filename)
+                self.ledger.readmit(filename)
+            return True
+        return False
+
+    def record_failure(self, filename: str, error: BaseException,
+                       stage: str, may_quarantine: bool = True,
+                       **unit) -> None:
+        """Ledger a failed unit. Classification and retry count come
+        off the annotations ``retry_call`` leaves.
+
+        Disposition triage: only failures that indict the FILE itself
+        quarantine (skip on future runs) — exhausted-transient I/O
+        errors, from a READ of that file, that are not mere lock
+        contention. Everything else is ``rejected``: recorded for
+        audit, re-attempted next run. A permanent error often encodes
+        the CONFIG, not the data (a wrong ``tod_variant`` raises
+        KeyError on every file); lock contention means another writer,
+        not a bad file; and callers reporting failures from OUTSIDE the
+        file's own read (``may_quarantine=False`` — e.g. a stage chain
+        whose checkpoint WRITE hit a full output disk) must never
+        durably skip the input over an environment problem."""
+        if self.ledger is None:
+            return
+        from comapreduce_tpu.resilience.retry import (classify_error,
+                                                      is_lock_error)
+
+        failure_class = getattr(error, "_failure_class",
+                                classify_error(error))
+        quarantine = (may_quarantine and failure_class == "transient"
+                      and not is_lock_error(error))
+        self.ledger.record(
+            filename, error=error,
+            failure_class=failure_class,
+            retries=getattr(error, "_retries", 0),
+            disposition="quarantined" if quarantine else "rejected",
+            stage=stage, **unit)
+
+    def record_recovered(self, filename: str, retries: int,
+                         stage: str) -> None:
+        """Ledger a retry-saved read (bookkeeping only, never skipped)."""
+        if self.ledger is None or not retries:
+            return
+        self.ledger.record(filename, retries=retries,
+                           failure_class="transient",
+                           disposition="recovered", stage=stage)
+
+    def record_masked(self, filename: str, n_masked: int, stage: str,
+                      **unit) -> None:
+        """Ledger a numerical-tripwire event (unit stays live).
+
+        Deduplicated: re-reading the same poisoned unit (a second band
+        pass, a campaign re-run) must not re-append — and re-fsync —
+        an identical line every time; only a CHANGED mask size is a new
+        event worth recording."""
+        if self.ledger is None or n_masked <= 0:
+            return
+        message = f"{n_masked} non-finite sample(s) zero-weighted"
+        prev = self.ledger.latest(filename, feed=unit.get("feed"),
+                                  band=unit.get("band"),
+                                  scan=unit.get("scan"))
+        if prev is not None and prev.disposition == "masked" \
+                and prev.message == message:
+            return
+        self.ledger.record(
+            filename, failure_class="numerical", disposition="masked",
+            stage=stage, message=message, **unit)

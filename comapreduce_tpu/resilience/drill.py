@@ -1,0 +1,210 @@
+"""The chaos drill: inject every fault class, assert the promises hold.
+
+This is the executable form of the resilience layer's contract
+(ISSUE 2 acceptance criteria), run by ``tools/check_resilience.py``
+and ``bench.py --config resilience``:
+
+1. a chaos run (read error + truncated file + NaN burst + slow read +
+   first-attempt flake injected over a synthetic fixture set) completes
+   with no unhandled exception;
+2. every injected fault appears in the quarantine ledger with the
+   correct classification (read error/truncate -> ``transient``
+   quarantines, NaN burst -> ``numerical``/``masked``, flake ->
+   ``transient``/``recovered``);
+3. the destriped map from the chaos run is byte-identical to the
+   clean run's map with the faulted units zero-weighted (dead files
+   dropped, NaN-touched samples at weight 0);
+4. a second pass consults the ledger: quarantined files are skipped
+   without a read, and ``retry_quarantined`` re-admits exactly the
+   quarantined set.
+
+Everything is deterministic by seed (chaos decisions, jitter, synthetic
+data), so a CI failure reproduces locally bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as np
+
+__all__ = ["run_drill"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+def _write_level2(path: str, seed: int, F: int = 2, T: int = 600) -> None:
+    """Minimal single-band Level-2 store the destriper reader accepts
+    (same schema as the pipeline's checkpoint output)."""
+    from comapreduce_tpu.data.hdf5io import HDF5Store
+
+    rng = np.random.default_rng(seed)
+    store = HDF5Store(name="l2")
+    tod = (rng.normal(size=(F, 1, T))
+           + np.sin(np.arange(T) / 37.0)).astype(np.float32)
+    store["averaged_tod/tod"] = tod
+    store["averaged_tod/weights"] = np.ones((F, 1, T), np.float32)
+    store["averaged_tod/scan_edges"] = np.array([[0, T]], np.int64)
+    ra = 170.0 + 0.5 * rng.random((F, T))
+    dec = 52.0 + 0.5 * rng.random((F, T))
+    store["spectrometer/pixel_pointing/pixel_ra"] = ra
+    store["spectrometer/pixel_pointing/pixel_dec"] = dec
+    store["spectrometer/pixel_pointing/pixel_az"] = ra
+    store["spectrometer/pixel_pointing/pixel_el"] = dec
+    store.set_attrs("comap", "source", "co2,sky")
+    store.set_attrs("comap", "obsid", seed)
+    store.write(path)
+
+
+def _read(files, wcs, resilience=None, prefetch: int = 0):
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+
+    return read_comap_data(files, band=0, wcs=wcs, offset_length=50,
+                           medfilt_window=51, use_calibration=False,
+                           prefetch=prefetch, resilience=resilience)
+
+
+def _solve(data):
+    from comapreduce_tpu.cli.run_destriper import solve_band
+
+    return solve_band(data, offset_length=50, n_iter=50, threshold=1e-5)
+
+
+def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
+              prefetch: int = 2) -> dict:
+    """Run the full drill in ``workdir``; returns the evidence dict.
+
+    Raises ``AssertionError`` (with a named criterion) on any broken
+    promise — the CI contract is 'exit 0 means all four held'.
+    """
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.resilience import QuarantineLedger, Resilience
+    from comapreduce_tpu.resilience.chaos import ChaosMonkey
+    from comapreduce_tpu.resilience.retry import RetryPolicy
+
+    t0 = time.perf_counter()
+    os.makedirs(workdir, exist_ok=True)
+    files = []
+    for i in range(n_files):
+        path = os.path.join(workdir, f"Level2_comap-{i:04d}.hd5")
+        if not os.path.exists(path):
+            _write_level2(path, seed=1000 + seed * 10 + i)
+        files.append(path)
+    wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
+
+    # one fault of every class, each aimed at a known file
+    spec = ("read_error@0001,truncate@0002,flaky@0003,"
+            "nan_burst@0004,slow_read@0000")
+    monkey = ChaosMonkey(spec, seed=seed, slow_s=0.01, burst_frac=0.1)
+    ledger_path = os.path.join(workdir, "quarantine.jsonl")
+    if os.path.exists(ledger_path):
+        os.unlink(ledger_path)
+    res = Resilience(ledger=QuarantineLedger(ledger_path),
+                     retry=RetryPolicy(max_retries=1, base_s=0.0,
+                                       seed=seed),
+                     chaos=monkey)
+
+    # -- 1. chaos run completes ------------------------------------------
+    data_chaos = _read(files, wcs, resilience=res, prefetch=prefetch)
+    result_chaos = _solve(data_chaos)
+    assert np.isfinite(
+        np.asarray(result_chaos.destriped_map)).all(), \
+        "criterion 1: chaos-run map contains non-finite pixels"
+
+    dead = [files[1], files[2]]          # read_error, truncate
+    survivors = [f for f in files if f not in dead]
+    assert data_chaos.files == survivors, \
+        f"criterion 1: expected survivors {survivors}, " \
+        f"got {data_chaos.files}"
+
+    # -- 2. every injected fault is ledgered, correctly classified ------
+    ledger = QuarantineLedger(ledger_path)  # re-read from disk
+    by_file = {}
+    for e in ledger.entries:
+        by_file.setdefault(os.path.basename(e.unit["file"]), []).append(e)
+
+    def _has(fname, failure_class, disposition):
+        return any(e.failure_class == failure_class
+                   and e.disposition == disposition
+                   for e in by_file.get(os.path.basename(fname), []))
+
+    assert _has(files[1], "transient", "quarantined"), \
+        "criterion 2: injected read_error not quarantined as transient"
+    assert _has(files[2], "transient", "quarantined"), \
+        "criterion 2: injected truncate not quarantined as transient"
+    assert _has(files[3], "transient", "recovered"), \
+        "criterion 2: flaky read not recorded as recovered-by-retry"
+    assert _has(files[4], "numerical", "masked"), \
+        "criterion 2: NaN burst not recorded as numerical/masked"
+    injected_kinds = {k for _, k in monkey.injected}
+    assert injected_kinds >= {"read_error", "truncate", "flaky",
+                              "nan_burst", "slow_read"}, \
+        f"chaos harness fired only {sorted(injected_kinds)}"
+
+    # -- 3. chaos map == clean map with faulted units zero-weighted -----
+    # The reference run reads clean copies of the SURVIVING files with
+    # the burst unit (file 4's (feed, start, n), reconstructed from the
+    # monkey's own deterministic placement) zero-weighted at the source:
+    # value 0, weight 0 — exactly what the tripwire turns the NaNs into,
+    # so every downstream operator (median filter included) sees
+    # identical inputs and the maps must agree to the last byte.
+    import h5py
+    import shutil
+
+    ref_dir = os.path.join(workdir, "ref")
+    os.makedirs(ref_dir, exist_ok=True)
+    ref_files = []
+    n_masked = 0
+    for f in survivors:
+        dst = os.path.join(ref_dir, os.path.basename(f))
+        shutil.copy2(f, dst)
+        if f == files[4]:
+            with h5py.File(dst, "a") as h:
+                shape = h["averaged_tod/tod"].shape    # (F, B, T)
+                feed, start, n = monkey.burst_coords(f, shape)
+                h["averaged_tod/tod"][feed, ..., start:start + n] = 0.0
+                h["averaged_tod/weights"][feed, ...,
+                                          start:start + n] = 0.0
+                n_masked = n
+        ref_files.append(dst)
+    assert n_masked > 0, "criterion 3: NaN burst masked no samples"
+    data_ref = _read(ref_files, wcs)
+    assert data_ref.tod.size == data_chaos.tod.size, \
+        "criterion 3: chaos run changed the sample stream shape"
+    result_ref = _solve(data_ref)
+    identical = np.array_equal(np.asarray(result_chaos.destriped_map),
+                               np.asarray(result_ref.destriped_map))
+    assert identical, \
+        "criterion 3: chaos map != clean map with faulted units " \
+        "zero-weighted"
+
+    # -- 4. resume consults the ledger; retry_quarantined re-admits -----
+    res2 = Resilience(ledger=QuarantineLedger(ledger_path))
+    admitted = [f for f in files if res2.admit(f)]
+    assert admitted == survivors, \
+        f"criterion 4: resume admitted {admitted}, expected {survivors}"
+    res3 = Resilience(ledger=QuarantineLedger(ledger_path),
+                      retry_quarantined=True)
+    readmitted = [f for f in files if res3.admit(f)]
+    assert readmitted == files, \
+        "criterion 4: retry_quarantined did not re-admit the " \
+        "quarantined set"
+    # ... and exactly the quarantined set was re-admitted
+    assert sorted(res3._readmitted) == sorted(dead), \
+        f"criterion 4: re-admitted {sorted(res3._readmitted)}, " \
+        f"expected {sorted(dead)}"
+
+    return {
+        "n_files": n_files,
+        "injected": sorted({(os.path.basename(f), k)
+                            for f, k in monkey.injected}),
+        "quarantined": sorted(os.path.basename(f)
+                              for f in ledger.quarantined_files()),
+        "ledger_summary": ledger.summary(),
+        "n_masked_samples": n_masked,
+        "map_byte_identical": bool(identical),
+        "cg_iters_chaos": int(result_chaos.n_iter),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }

@@ -1,0 +1,124 @@
+"""Retry policy: bounded backoff + jitter, transient/permanent triage.
+
+The split that matters operationally (``safe_hdf5_open`` already knew
+it for lock contention): an ``OSError`` — an NFS hiccup, a file still
+being copied in, a truncated read racing a writer — may succeed on the
+next attempt, while a shape/validation error (``ValueError``,
+``KeyError``: wrong schema, missing group) is the same data every time
+and retrying it only burns wall time. h5py raises plain ``OSError``
+for both unreadable *and* truncated files, which is exactly the
+retry-worthy class (a genuinely corrupt file fails every attempt and
+then lands in the quarantine ledger with its retry count).
+
+Jitter is deterministic by ``(seed, key, attempt)`` — fleet ranks
+hammering one NFS server desynchronise, while a re-run of the same
+rank reproduces the same schedule (CI requirement: the chaos drills
+assert on timing-independent outcomes).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from dataclasses import dataclass
+
+__all__ = ["RetryPolicy", "classify_error", "is_lock_error",
+           "retry_call", "TRANSIENT_ERRORS", "PERMANENT_ERRORS"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+# OSError covers BlockingIOError / TimeoutError / ConnectionError and
+# every h5py read failure (unable to open, truncated file, bad symbol
+# table) — the I/O class worth a second attempt.
+TRANSIENT_ERRORS = (OSError,)
+# data/shape/schema problems: deterministic, never retried
+PERMANENT_ERRORS = (ValueError, TypeError, KeyError, IndexError,
+                    AttributeError, ArithmeticError, AssertionError)
+
+
+def is_lock_error(exc: BaseException) -> bool:
+    """True for HDF5/NFS lock contention (``safe_hdf5_open``'s own
+    heuristic): the FILE is fine, another writer holds it — worth a
+    retry, but never worth a durable quarantine."""
+    if not isinstance(exc, OSError):
+        return False
+    msg = str(exc).lower()
+    return (isinstance(exc, BlockingIOError) or "lock" in msg
+            or "resource temporarily unavailable" in msg)
+
+
+def classify_error(exc: BaseException) -> str:
+    """``"transient"`` (worth retrying) or ``"permanent"`` (not).
+
+    Unknown exception types classify permanent: retrying a failure mode
+    nobody has triaged just delays the quarantine entry that gets it
+    triaged."""
+    if isinstance(exc, TRANSIENT_ERRORS):
+        return "transient"
+    if isinstance(exc, PERMANENT_ERRORS):
+        return "permanent"
+    return "permanent"
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``max_retries`` is the number of *re*-attempts after the first try
+    (0 disables retrying while keeping the classification/ledger
+    plumbing). Delay before re-attempt ``a`` (1-based) is
+    ``min(base_s * 2**(a-1), max_s) * (1 + jitter * u)`` with
+    ``u ~ U[0, 1)`` seeded by ``(seed, key, a)``.
+    """
+
+    max_retries: int = 2
+    base_s: float = 0.5
+    max_s: float = 30.0
+    jitter: float = 0.25
+    seed: int = 0
+
+    def delay_s(self, attempt: int, key: str = "") -> float:
+        base = min(self.base_s * (2.0 ** max(attempt - 1, 0)), self.max_s)
+        u = random.Random(f"{self.seed}:{key}:{attempt}").random()
+        return base * (1.0 + self.jitter * u)
+
+
+def retry_call(fn, policy: RetryPolicy | None = None, key: str = "",
+               classify=classify_error, sleep=time.sleep,
+               label: str = ""):
+    """Call ``fn()`` under ``policy``; returns ``(result, retries)``.
+
+    Retries only failures ``classify`` deems transient. When attempts
+    run out (or the failure is permanent) the ORIGINAL exception
+    propagates, annotated with ``_retries`` (attempts burned) and
+    ``_failure_class`` so the caller's ledger entry can report both
+    without re-deriving them.
+
+    ``sleep`` returning TRUTHY aborts the remaining schedule and
+    re-raises immediately — pass a stop event's ``wait`` so a shutting-
+    down consumer cancels the retries instead of burning them back-to-
+    back against a dying filesystem (``time.sleep`` returns None, so
+    the default never aborts).
+    """
+    policy = policy or RetryPolicy()
+    attempt = 0
+    while True:
+        try:
+            return fn(), attempt
+        except Exception as exc:  # noqa: BLE001 — triaged via classify
+            kind = classify(exc)
+            exc._retries = attempt          # type: ignore[attr-defined]
+            exc._failure_class = kind       # type: ignore[attr-defined]
+            if kind != "transient" or attempt >= policy.max_retries:
+                raise
+            attempt += 1
+            d = policy.delay_s(attempt, key=key)
+            logger.warning("%s: transient %s (%s); retry %d/%d in %.2f s",
+                           label or key or "retry_call",
+                           type(exc).__name__, exc, attempt,
+                           policy.max_retries, d)
+            if d > 0 and sleep(d):
+                # the sleeper says stop (consumer shutting down):
+                # abort the schedule, don't accelerate it
+                raise

@@ -1,0 +1,61 @@
+"""Numerical tripwires: NaN/Inf containment before the CG solve.
+
+One NaN sample entering the destriper poisons every inner product of
+the CG within an iteration (the breakdown guard then freezes the whole
+system — the *map* survives but that band's solve is dead). The
+destriper's own convention already has the answer: a zero-weight
+sample contributes nothing anywhere, *provided its value is finite*
+(``0 * inf`` is NaN). So the tripwire masks every non-finite TOD or
+weight sample to ``value 0, weight 0`` — exactly equivalent to the
+clean solve with those samples zero-weighted, which is what the chaos
+drill (``tools/check_resilience.py``) asserts byte-for-byte.
+
+``scrub_tod`` is pure ``jnp`` elementwise work (one fused pass under
+jit, negligible next to a single CG iteration) and is applied at the
+entry of both ``destripe`` and ``destripe_planned`` — defense in
+depth behind the host-side scrub in ``leveldata.read_comap_data``
+(which also *records* the event in the quarantine ledger; a jitted
+trace cannot).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["scrub_tod", "scrub_tod_host", "finite_fraction"]
+
+
+def scrub_tod(tod, weights):
+    """Mask non-finite samples to (0, 0): returns ``(tod', weights')``.
+
+    jnp in, jnp out; shapes preserved; safe under jit/shard_map (pure
+    elementwise). A sample is bad when its TOD *or* its weight is
+    non-finite — a NaN weight silently zeroes nothing and poisons
+    ``sum_w`` otherwise.
+    """
+    import jax.numpy as jnp
+
+    ok = jnp.isfinite(tod) & jnp.isfinite(weights)
+    return jnp.where(ok, tod, 0.0), jnp.where(ok, weights, 0.0)
+
+
+def scrub_tod_host(tod: np.ndarray, weights: np.ndarray):
+    """Host (numpy) twin of :func:`scrub_tod`: returns
+    ``(tod', weights', n_masked)`` so the caller can ledger-record the
+    event with a count. Copies only when something is actually bad."""
+    ok = np.isfinite(tod) & np.isfinite(weights)
+    n_bad = int(ok.size - np.count_nonzero(ok))
+    if n_bad == 0:
+        return tod, weights, 0
+    return (np.where(ok, tod, 0.0).astype(tod.dtype, copy=False),
+            np.where(ok, weights, 0.0).astype(weights.dtype, copy=False),
+            n_bad)
+
+
+def finite_fraction(x) -> float:
+    """Fraction of finite samples (host scalar) — the cheap health
+    check logged per file/band."""
+    x = np.asarray(x)
+    if x.size == 0:
+        return 1.0
+    return float(np.count_nonzero(np.isfinite(x))) / x.size

@@ -1,0 +1,692 @@
+"""Resilience layer (ISSUE 2): ledger, retry, tripwires, chaos, drill.
+
+Covers the quarantine ledger's persistence contract (append-only JSONL,
+latest-entry-wins, kill-truncation tolerance), transient/permanent
+retry triage with deterministic jitter, the NaN tripwires' exact
+zero-weight equivalence through both destriper paths, the CG divergence
+monitor + best-iterate guarantee, deterministic chaos injection, and
+the integration through Runner / read_comap_data — ending with the full
+chaos drill that CI runs as ``bench.py --config resilience``.
+"""
+
+import json
+import logging
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.resilience import (ChaosMonkey, QuarantineLedger,
+                                        Resilience, ResilienceConfig,
+                                        RetryPolicy, classify_error,
+                                        finite_fraction, retry_call,
+                                        scrub_tod_host)
+from comapreduce_tpu.resilience.chaos import parse_inject_spec
+
+
+# -- quarantine ledger ------------------------------------------------------
+
+def test_ledger_roundtrip_and_latest_wins(tmp_path):
+    path = str(tmp_path / "q.jsonl")
+    led = QuarantineLedger(path)
+    led.record("/d/a.hd5", error=OSError("io"), failure_class="transient",
+               retries=2, stage="ingest.read")
+    led.record("/d/b.hd5", failure_class="numerical",
+               disposition="masked", feed=3, band=1, stage="tripwire")
+    assert led.is_quarantined("/d/a.hd5")
+    # the feed-level masked unit never skips its file
+    assert not led.is_quarantined("/d/b.hd5")
+    assert led.quarantined_files() == {"/d/a.hd5"}
+
+    # a fresh process sees the same state (JSONL round-trip)
+    led2 = QuarantineLedger(path)
+    assert led2.is_quarantined("/d/a.hd5")
+    (entry,) = [e for e in led2.entries if e.unit["file"] == "/d/a.hd5"]
+    assert entry.error == "OSError" and entry.retries == 2
+    assert entry.failure_class == "transient"
+
+    # summary reports current latest-per-unit STATE, not history
+    assert led2.summary() == {"transient:quarantined": 1,
+                              "numerical:masked": 1}
+
+    # latest entry wins: readmit flips the disposition durably
+    led2.readmit("/d/a.hd5")
+    assert not led2.is_quarantined("/d/a.hd5")
+    led3 = QuarantineLedger(path)
+    assert not led3.is_quarantined("/d/a.hd5")
+    # ... and the superseded quarantine no longer reads as one
+    assert "transient:quarantined" not in led3.summary()
+    assert led3.summary()["n/a:readmitted"] == 1
+
+
+def test_ledger_tolerates_kill_truncation(tmp_path):
+    """A kill mid-append leaves a partial trailing line: load drops it,
+    and the NEXT append must not glue onto the stump (regression)."""
+    path = str(tmp_path / "q.jsonl")
+    led = QuarantineLedger(path)
+    led.record("/d/a.hd5", failure_class="transient")
+    with open(path, "a") as f:
+        f.write('{"unit": {"fi')          # the kill signature
+    led2 = QuarantineLedger(path)
+    assert led2.is_quarantined("/d/a.hd5")  # earlier entries survive
+    led2.record("/d/c.hd5", failure_class="transient")
+    led3 = QuarantineLedger(path)
+    assert led3.is_quarantined("/d/a.hd5")
+    assert led3.is_quarantined("/d/c.hd5")  # not corrupted by the stump
+
+
+def test_ledger_entries_are_one_json_per_line(tmp_path):
+    path = str(tmp_path / "q.jsonl")
+    led = QuarantineLedger(path)
+    led.record("/d/a.hd5", error=ValueError("x" * 1000),
+               failure_class="permanent")
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln]
+    raw = json.loads(lines[0])
+    assert raw["unit"]["file"] == "/d/a.hd5"
+    assert len(raw["message"]) <= 500  # messages are truncated
+
+
+# -- retry policy -----------------------------------------------------------
+
+def test_record_failure_triage(tmp_path):
+    """Only file-indicting failures quarantine: a config-dependent
+    KeyError and lock contention are 'rejected' (re-attempted next
+    run), so a corrected config or a released lock processes the file
+    again without --retry-quarantined."""
+    led = QuarantineLedger(str(tmp_path / "q.jsonl"))
+    res = Resilience(ledger=led)
+    res.record_failure("/d/a.hd5", OSError("truncated file"),
+                       stage="ingest.read")
+    res.record_failure("/d/b.hd5", KeyError("averaged_tod/tod_original"),
+                       stage="destriper.read")
+    res.record_failure("/d/c.hd5",
+                       BlockingIOError("unable to lock file"),
+                       stage="ingest.read")
+    assert led.is_quarantined("/d/a.hd5")          # real I/O failure
+    assert not led.is_quarantined("/d/b.hd5")      # config-dependent
+    assert not led.is_quarantined("/d/c.hd5")      # contention
+    by_file = {e.unit["file"]: e.disposition for e in led.entries}
+    assert by_file == {"/d/a.hd5": "quarantined",
+                       "/d/b.hd5": "rejected",
+                       "/d/c.hd5": "rejected"}
+
+
+def test_record_failure_stage_chain_never_quarantines(tmp_path):
+    """An output-side failure (full disk during the checkpoint write)
+    must not durably skip the INPUT file."""
+    led = QuarantineLedger(str(tmp_path / "q.jsonl"))
+    res = Resilience(ledger=led)
+    res.record_failure("/d/a.hd5", OSError(28, "No space left on device"),
+                       stage="stage_chain", may_quarantine=False)
+    assert not led.is_quarantined("/d/a.hd5")
+    assert led.entries[0].disposition == "rejected"
+
+
+def test_frequency_binned_nan_channels_zero_weighted(tmp_path):
+    """tod_variant='frequency_binned': a NaN coarse-channel sample is
+    EXCLUDED from the inverse-variance combine (weight contribution 0),
+    never folded in as value 0 under a live weight, and the event is
+    ledgered."""
+    from comapreduce_tpu.data.hdf5io import HDF5Store
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    rng = np.random.default_rng(5)
+    F, nb, T = 1, 2, 600
+    tod = rng.normal(size=(F, 1, nb, T)).astype(np.float32) + 10.0
+    tod[0, 0, :, 100:160] = np.nan          # burst across ALL channels
+    tod[0, 0, 0, 200:220] = np.nan          # burst in ONE channel
+    store = HDF5Store(name="l2")
+    store["frequency_binned/tod"] = tod
+    store["frequency_binned/tod_stddev"] = np.ones((F, 1, nb, T),
+                                                   np.float32)
+    store["frequency_binned/scan_edges"] = np.array([[0, T]], np.int64)
+    ra = 170.0 + 0.5 * rng.random((F, T))
+    dec = 52.0 + 0.5 * rng.random((F, T))
+    store["spectrometer/pixel_pointing/pixel_ra"] = ra
+    store["spectrometer/pixel_pointing/pixel_dec"] = dec
+    store["spectrometer/pixel_pointing/pixel_az"] = ra
+    store["spectrometer/pixel_pointing/pixel_el"] = dec
+    store.set_attrs("comap", "source", "co2,sky")
+    path = str(tmp_path / "Level2_fb.hd5")
+    store.write(path)
+
+    ledger = QuarantineLedger(str(tmp_path / "q.jsonl"))
+    wcs = WCS.from_field((170.25, 52.25), (1 / 60, 1 / 60), (64, 64))
+    data = read_comap_data([path], band=0, wcs=wcs, offset_length=50,
+                           medfilt_window=0, use_calibration=False,
+                           tod_variant="frequency_binned",
+                           resilience=Resilience(ledger=ledger))
+    w = np.asarray(data.weights)
+    tod_out = np.asarray(data.tod)
+    assert np.isfinite(tod_out).all() and np.isfinite(w).all()
+    # all-channel burst: sample weight 0; one-channel burst: halved
+    assert (w[100:160] == 0).all()
+    np.testing.assert_allclose(w[200:220], 1.0)   # one of 2 channels
+    np.testing.assert_allclose(w[300:320], 2.0)   # clean: both
+    masked = [e for e in ledger.entries if e.disposition == "masked"]
+    assert masked and masked[0].failure_class == "numerical"
+
+
+def test_admit_snapshot_frozen_per_runtime(tmp_path):
+    """A file quarantined MID-run must not change what the rest of the
+    SAME run covers (per-band consistency); the next runtime sees it."""
+    led = QuarantineLedger(str(tmp_path / "q.jsonl"))
+    res = Resilience(ledger=led)
+    assert res.admit("/d/a.hd5")                   # snapshot taken here
+    res.record_failure("/d/a.hd5", OSError("io"), stage="ingest.read")
+    assert res.admit("/d/a.hd5")                   # same run: still in
+    res2 = Resilience(ledger=QuarantineLedger(str(tmp_path / "q.jsonl")))
+    assert not res2.admit("/d/a.hd5")              # next run: skipped
+
+
+def test_record_masked_dedup(tmp_path):
+    """Re-reading the same poisoned unit (another band pass, a re-run)
+    must not re-append identical masked lines."""
+    led = QuarantineLedger(str(tmp_path / "q.jsonl"))
+    res = Resilience(ledger=led)
+    for _ in range(3):
+        res.record_masked("/d/a.hd5", 60, stage="tripwire", feed=1,
+                          band=0)
+    assert len(led.entries) == 1
+    res.record_masked("/d/a.hd5", 61, stage="tripwire", feed=1, band=0)
+    assert len(led.entries) == 2                   # changed mask: new
+
+
+def test_chaos_bypasses_cache(tmp_path):
+    """A poisoned payload must never be served to a later clean run as
+    a cache hit (the cache may spill to disk and outlive the drill)."""
+    from comapreduce_tpu.ingest.cache import BlockCache
+    from comapreduce_tpu.ingest.loaders import _stream
+
+    cache = BlockCache(max_bytes=1 << 20)
+    payload = {"data": {"averaged_tod/tod": np.zeros((1, 1, 50),
+                                                     np.float32)},
+               "attrs": {}}
+    monkey = ChaosMonkey("nan_burst", seed=0)
+    items = list(_stream(["f.hd5"], lambda p: payload, lambda p: p,
+                         cache=cache, chaos=monkey))
+    assert np.isnan(
+        items[0].payload["data"]["averaged_tod/tod"]).any()
+    assert cache.get("f.hd5") is None              # nothing cached
+
+
+def test_classify_error():
+    assert classify_error(OSError("nfs hiccup")) == "transient"
+    assert classify_error(BlockingIOError()) == "transient"
+    assert classify_error(TimeoutError()) == "transient"     # OSError
+    assert classify_error(ValueError("bad shape")) == "permanent"
+    assert classify_error(KeyError("averaged_tod")) == "permanent"
+    assert classify_error(RuntimeError("unknown")) == "permanent"
+
+
+def test_retry_call_transient_then_success():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("flake")
+        return 42
+
+    v, retries = retry_call(flaky, RetryPolicy(max_retries=5, base_s=0.0))
+    assert (v, retries, len(calls)) == (42, 2, 3)
+
+
+def test_retry_call_permanent_raises_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("schema")
+
+    with pytest.raises(ValueError) as ei:
+        retry_call(broken, RetryPolicy(max_retries=5, base_s=0.0))
+    assert len(calls) == 1
+    assert ei.value._failure_class == "permanent"
+    assert ei.value._retries == 0
+
+
+def test_retry_call_exhaustion_annotates():
+    def dead():
+        raise OSError("always")
+
+    with pytest.raises(OSError) as ei:
+        retry_call(dead, RetryPolicy(max_retries=2, base_s=0.0))
+    assert ei.value._retries == 2
+    assert ei.value._failure_class == "transient"
+
+
+def test_retry_backoff_deterministic_and_bounded():
+    p = RetryPolicy(max_retries=9, base_s=1.0, max_s=4.0, jitter=0.5,
+                    seed=13)
+    d1 = [p.delay_s(a, key="f.hd5") for a in range(1, 6)]
+    d2 = [p.delay_s(a, key="f.hd5") for a in range(1, 6)]
+    assert d1 == d2                              # same seed -> same plan
+    assert d1 != [p.delay_s(a, key="other") for a in range(1, 6)]
+    for a, d in enumerate(d1, start=1):
+        base = min(1.0 * 2 ** (a - 1), 4.0)
+        assert base <= d <= base * 1.5           # jitter in [0, 50%)
+
+
+# -- chaos ------------------------------------------------------------------
+
+def test_parse_inject_spec():
+    assert parse_inject_spec("") == []
+    assert parse_inject_spec("read_error") == [("read_error", "", 1.0)]
+    assert parse_inject_spec("nan_burst@0004:0.5, slow_read:0.1") == [
+        ("nan_burst", "0004", 0.5), ("slow_read", "", 0.1)]
+    with pytest.raises(ValueError):
+        parse_inject_spec("frobnicate:0.5")
+    with pytest.raises(ValueError):
+        parse_inject_spec("read_error:1.5")
+
+
+def test_chaos_deterministic_by_seed():
+    files = [f"comap-{i:04d}.hd5" for i in range(20)]
+    a = ChaosMonkey("read_error:0.3,nan_burst:0.3", seed=5)
+    b = ChaosMonkey("read_error:0.3,nan_burst:0.3", seed=5)
+    c = ChaosMonkey("read_error:0.3,nan_burst:0.3", seed=6)
+    assert [a.decide(f) for f in files] == [b.decide(f) for f in files]
+    assert [a.decide(f) for f in files] != [c.decide(f) for f in files]
+
+
+def test_chaos_targeting_and_kinds(tmp_path):
+    monkey = ChaosMonkey("read_error@0001,flaky@0002", seed=0)
+    loads = []
+    loader = monkey.wrap_loader(lambda p: loads.append(p) or {"ok": p})
+
+    with pytest.raises(OSError, match="injected read error"):
+        loader("comap-0001.hd5")
+    with pytest.raises(OSError, match="injected read error"):
+        loader("comap-0001.hd5")             # every attempt fails
+    with pytest.raises(OSError, match="flaky"):
+        loader("comap-0002.hd5")             # first attempt fails ...
+    assert loader("comap-0002.hd5")["ok"] == "comap-0002.hd5"  # retry OK
+    assert loader("comap-0003.hd5")["ok"] == "comap-0003.hd5"  # untouched
+    assert ("comap-0001.hd5", "read_error") in monkey.injected
+
+
+def test_chaos_nan_burst_copies_never_mutates():
+    tod = np.zeros((2, 1, 100), np.float32)
+    payload = {"data": {"averaged_tod/tod": tod}, "attrs": {}}
+    monkey = ChaosMonkey("nan_burst", seed=3, burst_frac=0.1)
+    out = monkey.wrap_loader(lambda p: payload)("f.hd5")
+    poisoned = out["data"]["averaged_tod/tod"]
+    assert np.isnan(poisoned).sum() == 10    # one feed, 10% of T
+    assert not np.isnan(tod).any()           # original untouched
+    feed, start, n = monkey.burst_coords("f.hd5", tod.shape)
+    assert np.isnan(poisoned[feed, 0, start:start + n]).all()
+
+
+# -- tripwires --------------------------------------------------------------
+
+def test_scrub_tod_host_and_finite_fraction():
+    tod = np.array([1.0, np.nan, 3.0, np.inf], np.float32)
+    w = np.array([1.0, 1.0, np.nan, 1.0], np.float32)
+    t2, w2, n_bad = scrub_tod_host(tod, w)
+    assert n_bad == 3
+    np.testing.assert_array_equal(t2, [1.0, 0.0, 0.0, 0.0])
+    np.testing.assert_array_equal(w2, [1.0, 0.0, 0.0, 0.0])
+    assert np.isfinite(t2).all() and np.isfinite(w2).all()
+    # clean input: zero-copy no-op
+    t3, w3, n0 = scrub_tod_host(t2, w2)
+    assert n0 == 0 and t3 is t2 and w3 is w2
+    assert finite_fraction(tod) == 0.5   # nan AND inf are non-finite
+    assert finite_fraction(np.zeros(0)) == 1.0
+
+
+def test_scrub_tod_jnp():
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.resilience.tripwires import scrub_tod
+
+    tod = jnp.asarray([1.0, jnp.nan, -jnp.inf, 4.0])
+    w = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    t2, w2 = scrub_tod(tod, w)
+    np.testing.assert_array_equal(np.asarray(t2), [1.0, 0.0, 0.0, 4.0])
+    np.testing.assert_array_equal(np.asarray(w2), [1.0, 0.0, 0.0, 4.0])
+
+
+def _toy_problem(N=4000, L=50, npix=144, seed=0):
+    rng = np.random.default_rng(seed)
+    pix = ((np.arange(N) * 7) % npix).astype(np.int32)
+    tod = (rng.standard_normal(N)
+           + np.repeat(rng.standard_normal(N // L), L)).astype(np.float32)
+    return tod, pix, np.ones(N, np.float32), L, npix
+
+
+def test_destripe_nan_burst_equals_zero_weighted_clean():
+    """The acceptance equivalence at the solver level, BOTH paths: a
+    NaN-poisoned solve is byte-identical to the clean solve with the
+    poisoned samples zero-weighted."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import (destripe,
+                                                     destripe_planned)
+    from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+
+    tod, pix, w, L, npix = _toy_problem()
+    bad = np.zeros(tod.size, bool)
+    bad[500:620] = True
+    tod_f = tod.copy()
+    tod_f[bad] = np.nan
+    tod_z, w_z = tod.copy(), w.copy()
+    tod_z[bad] = 0.0
+    w_z[bad] = 0.0
+
+    r_f = destripe(jnp.asarray(tod_f), jnp.asarray(pix), jnp.asarray(w),
+                   npix, offset_length=L)
+    r_z = destripe(jnp.asarray(tod_z), jnp.asarray(pix),
+                   jnp.asarray(w_z), npix, offset_length=L)
+    np.testing.assert_array_equal(np.asarray(r_f.destriped_map),
+                                  np.asarray(r_z.destriped_map))
+    assert np.isfinite(np.asarray(r_f.destriped_map)).all()
+    assert int(r_f.diverged) == 0
+
+    plan = build_pointing_plan(pix, npix, L)
+    p_f = destripe_planned(jnp.asarray(tod_f), jnp.asarray(w), plan)
+    p_z = destripe_planned(jnp.asarray(tod_z), jnp.asarray(w_z), plan)
+    np.testing.assert_array_equal(np.asarray(p_f.destriped_map),
+                                  np.asarray(p_z.destriped_map))
+    # a NaN WEIGHT is scrubbed identically (it would poison sum_w)
+    w_nan = w.copy()
+    w_nan[bad] = np.nan
+    p_wn = destripe_planned(jnp.asarray(tod), jnp.asarray(w_nan), plan)
+    np.testing.assert_array_equal(np.asarray(p_wn.destriped_map),
+                                  np.asarray(p_z.destriped_map))
+
+
+def test_destripe_planned_warm_start():
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import destripe_planned
+    from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+
+    tod, pix, w, L, npix = _toy_problem(seed=2)
+    plan = build_pointing_plan(pix, npix, L)
+    cold = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan)
+    warm = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan,
+                            x0=cold.offsets)
+    assert int(warm.n_iter) <= 2 < int(cold.n_iter)
+    np.testing.assert_allclose(np.asarray(warm.destriped_map),
+                               np.asarray(cold.destriped_map), atol=1e-5)
+
+
+def test_cg_divergence_monitor_trips_and_returns_best():
+    """A system CG's assumptions don't hold on (skew-dominant, so every
+    ``p^T A p`` stays positive and finite while the residual grows
+    monotonically — the signature of a poisoned operator that the
+    breakdown guard alone can NOT catch): the monitor must flag it and
+    hand back the best iterate, never the diverged one."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import _cg_loop
+
+    n = 16
+    rng = np.random.default_rng(0)
+    skew = rng.standard_normal((n, n))
+    a_mat = jnp.asarray(np.eye(n) + 3.0 * (skew - skew.T), jnp.float32)
+    b = jnp.asarray(np.ones(n), jnp.float32)
+    dot = lambda u, v: jnp.sum(u * v)                 # noqa: E731
+    x, rr, k, b_norm, div = _cg_loop(lambda p: a_mat @ p, b, dot,
+                                     100, 1e-8)
+    assert int(div) == 1
+    assert int(k) < 100                               # froze early
+    assert float(rr) <= float(b_norm) * (1 + 1e-6)    # never worse than x0
+    # a healthy SPD system: no flag, converges to the exact solution
+    diag = jnp.asarray(np.linspace(1.0, 3.0, n), jnp.float32)
+    x2, rr2, k2, bn2, div2 = _cg_loop(lambda p: diag * p, b, dot,
+                                      100, 1e-6,
+                                      precond=lambda v: v / diag)
+    assert int(div2) == 0
+    assert float(rr2) <= 1e-10 * float(bn2)
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(b / diag),
+                               rtol=1e-5)
+
+
+def test_destriper_result_positional_compat():
+    """Trailing ``diverged`` default keeps 8-field positional
+    construction (every pre-ISSUE-2 call site) working."""
+    from comapreduce_tpu.mapmaking.destriper import DestriperResult
+
+    r = DestriperResult(1, 2, 3, 4, 5, 6, 7, 8)
+    assert r.residual == 8 and r.diverged == 0
+
+
+# -- config -----------------------------------------------------------------
+
+def test_resilience_config_normalises_ini_values(tmp_path):
+    cfg = ResilienceConfig.from_mapping(
+        {"quarantine": None, "max_retries": None, "inject": None,
+         "unrelated_key": 1})
+    assert cfg.quarantine == "" and cfg.max_retries == 0
+    assert cfg.ledger_path(str(tmp_path)) == ""
+    assert cfg.make_runtime(str(tmp_path)).ledger is None
+
+    cfg2 = ResilienceConfig()
+    assert cfg2.quarantine == "auto"
+    assert cfg2.ledger_path("/out") == os.path.join("/out",
+                                                    "quarantine.jsonl")
+    explicit = ResilienceConfig(quarantine=str(tmp_path / "led.jsonl"))
+    assert explicit.ledger_path("/out") == str(tmp_path / "led.jsonl")
+
+    with pytest.raises(ValueError, match="unknown resilience keys"):
+        ResilienceConfig.coerce({"quarantine": "auto", "typo": 1})
+    rt = ResilienceConfig(inject="read_error:0.5",
+                          inject_seed=9).make_runtime(str(tmp_path))
+    assert rt.chaos is not None and rt.chaos.seed == 9
+    assert rt.retry.max_retries == 2
+
+
+def test_runner_toml_and_ini_carry_resilience(tmp_path):
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.config import IniConfig
+
+    toml_runner = Runner.from_config(
+        {"Global": {"processes": []},
+         "resilience": {"max_retries": 7, "inject": "slow_read:0.1"}})
+    assert toml_runner.resilience.max_retries == 7
+
+    ini = tmp_path / "p.ini"
+    ini.write_text("[Inputs]\noutput_dir : out\n"
+                   "[Resilience]\nmax_retries : 5\n"
+                   "quarantine : off\n")
+    ini_runner = Runner.from_legacy_config(str(ini))
+    assert ini_runner.resilience.max_retries == 5
+    assert ini_runner.resilience.quarantine == ""
+
+    # a typo in the DEDICATED section must raise, not silently default
+    bad_ini = tmp_path / "typo.ini"
+    bad_ini.write_text("[Inputs]\noutput_dir : out\n"
+                       "[Resilience]\nmax_retrys : 5\n")
+    with pytest.raises(ValueError, match="unknown resilience keys"):
+        Runner.from_legacy_config(str(bad_ini))
+
+
+def test_inject_spec_survives_ini_list_coercion():
+    """The documented multi-fault INI syntax arrives as a LIST after
+    IniConfig coercion splits the comma value — it must round-trip,
+    and a typo'd spec must fail at config load, not mid-run."""
+    cfg = ResilienceConfig(inject=["read_error:0.05", "nan_burst:0.05"])
+    assert cfg.inject == "read_error:0.05,nan_burst:0.05"
+    assert cfg.make_runtime("/tmp").chaos is not None
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        ResilienceConfig(inject="frobnicate:0.5")
+
+
+def test_ledger_reads_sibling_rank_files(tmp_path):
+    """Quarantines recorded by a multi-rank run are visible to a later
+    run with a different rank count (auto paths fold in siblings
+    read-only; writes stay single-file)."""
+    rank_led = QuarantineLedger(str(tmp_path / "quarantine.rank2.jsonl"))
+    rank_led.record("/d/bad.hd5", error=OSError("io"),
+                    failure_class="transient")
+    cfg = ResilienceConfig()
+    single = cfg.make_runtime(str(tmp_path))        # n_ranks=1
+    assert not single.admit("/d/bad.hd5")           # sees rank2's entry
+    # --retry-quarantined from the single-process run re-admits it ...
+    retry = ResilienceConfig(retry_quarantined=True).make_runtime(
+        str(tmp_path))
+    assert retry.admit("/d/bad.hd5")
+    # ... durably: the readmit (written to quarantine.jsonl) outranks
+    # the sibling's quarantine on the next load
+    fresh = ResilienceConfig().make_runtime(str(tmp_path))
+    assert fresh.admit("/d/bad.hd5")
+
+
+def test_retry_sleep_abort_cancels_schedule():
+    """A sleep that reports 'stop' (Event.wait with the event set)
+    aborts the remaining retries instead of burning them with no
+    delay."""
+    calls = []
+
+    def dying():
+        calls.append(1)
+        raise OSError("nfs going away")
+
+    with pytest.raises(OSError):
+        retry_call(dying, RetryPolicy(max_retries=5, base_s=0.1),
+                   sleep=lambda d: True)            # stop already set
+    assert len(calls) == 1                          # no re-attempts
+
+
+def test_ledger_path_per_rank(tmp_path):
+    """Multi-rank runs write per-rank ledger files (JSONL appends are
+    single-writer-atomic only; the shard split is stable across runs)."""
+    cfg = ResilienceConfig()
+    assert cfg.ledger_path("/out").endswith("/quarantine.jsonl")
+    assert cfg.ledger_path("/out", rank=2, n_ranks=4).endswith(
+        "/quarantine.rank2.jsonl")
+    explicit = ResilienceConfig(quarantine=str(tmp_path / "q.jsonl"))
+    # an explicit path is used verbatim (the operator owns the choice)
+    assert explicit.ledger_path("/out", rank=2, n_ranks=4) == \
+        str(tmp_path / "q.jsonl")
+
+
+# -- excepthook chaining (satellite) ---------------------------------------
+
+def test_set_logging_excepthook_chains(tmp_path):
+    from comapreduce_tpu.pipeline import set_logging
+
+    seen = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: seen.append(a)
+    try:
+        set_logging(base="t", log_dir=str(tmp_path), rank=3)
+        hook1 = sys.excepthook
+        # repeated set_logging must chain to the FOREIGN hook, not stack
+        set_logging(base="t", log_dir=str(tmp_path), rank=3)
+        hook2 = sys.excepthook
+        assert hook2._comap_prev is not hook1
+        assert hook2._comap_prev is hook1._comap_prev
+
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        assert len(seen) == 1          # the previous hook still ran
+        (log,) = [p for p in os.listdir(tmp_path)
+                  if p.startswith("t_") and p.endswith("rank3.log")]
+        text = (tmp_path / log).read_text()
+        assert "rank 3: uncaught exception" in text  # rank in the line
+        assert "boom" in text
+    finally:
+        sys.excepthook = prev
+        logger = logging.getLogger("comapreduce_tpu")
+        for h in list(logger.handlers):
+            if isinstance(h, logging.FileHandler):
+                logger.removeHandler(h)
+                h.close()
+
+
+# -- integration ------------------------------------------------------------
+
+def _small_l1(tmp_path, i):
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+
+    p = str(tmp_path / f"comap-{i:04d}.hd5")
+    generate_level1_file(p, SyntheticObsParams(
+        n_feeds=1, n_bands=1, n_channels=8, n_scans=1, scan_samples=200,
+        vane_samples=100, seed=70 + i, obsid=7000 + i))
+    return p
+
+
+@pytest.mark.chaos
+def test_runner_chaos_injection_quarantines(tmp_path):
+    """Chaos configured purely through the Runner's ``resilience`` knob:
+    the injected read error retries, fails, quarantines; the flake
+    retries, succeeds, and is ledgered as recovered."""
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
+                                                 CheckLevel1File)
+
+    files = [_small_l1(tmp_path, i) for i in range(3)]
+    outdir = str(tmp_path / "l2")
+    runner = Runner(
+        processes=[CheckLevel1File(min_duration_seconds=0.0),
+                   AssignLevel1Data()],
+        output_dir=outdir,
+        ingest={"prefetch": 2},
+        resilience={"max_retries": 1, "retry_base_s": 0.0,
+                    "inject": "read_error@0001,flaky@0002"})
+    results = runner.run_tod(files)
+    assert [r is None for r in results] == [False, True, False]
+
+    led = QuarantineLedger(os.path.join(outdir, "quarantine.jsonl"))
+    assert led.is_quarantined(files[1])
+    kinds = {(os.path.basename(e.unit["file"]),
+              e.failure_class, e.disposition) for e in led.entries}
+    assert ("comap-0001.hd5", "transient", "quarantined") in kinds
+    assert ("comap-0002.hd5", "transient", "recovered") in kinds
+
+
+@pytest.mark.chaos
+def test_read_comap_data_resilience(tmp_path):
+    """Destriper read path: quarantined files are skipped pre-read, NaN
+    bursts are masked + ledgered with the (file, feed, band) unit."""
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.resilience.drill import _write_level2
+
+    files = []
+    for i in range(3):
+        p = str(tmp_path / f"Level2_comap-{i:04d}.hd5")
+        _write_level2(p, seed=80 + i)
+        files.append(p)
+    wcs = WCS.from_field((170.25, 52.25), (1 / 60, 1 / 60), (64, 64))
+    ledger = QuarantineLedger(str(tmp_path / "q.jsonl"))
+    ledger.record(files[0], failure_class="transient")   # pre-quarantined
+    res = Resilience(ledger=ledger,
+                     chaos=ChaosMonkey("nan_burst@0002", seed=1,
+                                       burst_frac=0.1))
+
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+
+    data = read_comap_data(files, band=0, wcs=wcs, offset_length=50,
+                           medfilt_window=51, use_calibration=False,
+                           resilience=res)
+    assert data.files == files[1:]                       # skip, no read
+    masked = [e for e in ledger.entries if e.disposition == "masked"]
+    assert masked and masked[0].failure_class == "numerical"
+    assert masked[0].unit["feed"] is not None
+    assert masked[0].unit["band"] == 0
+    # the masked samples really carry zero weight
+    assert (np.asarray(data.weights) == 0).sum() > 0
+
+
+@pytest.mark.chaos
+def test_full_chaos_drill(tmp_path):
+    """The CI contract end to end (= ``tools/check_resilience.py``)."""
+    from comapreduce_tpu.resilience.drill import run_drill
+
+    evidence = run_drill(str(tmp_path / "drill"), seed=0)
+    assert evidence["map_byte_identical"]
+    assert evidence["ledger_summary"]["transient:quarantined"] == 2
+    assert evidence["ledger_summary"]["numerical:masked"] == 1
+    assert evidence["ledger_summary"]["transient:recovered"] == 1
+    kinds = {k for _, k in evidence["injected"]}
+    assert kinds == {"read_error", "truncate", "flaky", "nan_burst",
+                     "slow_read"}

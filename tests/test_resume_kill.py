@@ -4,7 +4,9 @@ The Level-2 file IS the checkpoint (written atomically after every stage,
 ``Running.py:152-153``); a killed run must leave either a complete stage
 checkpoint or none, and a restart must finish the chain without
 corruption. Also covers ``safe_hdf5_open`` retrying through a concurrent
-writer's lock.
+writer's lock, and the quarantine ledger surviving kills/resumes: a file
+quarantined in run 1 stays skipped in run 2 (ISSUE 2 satellite) and
+``--retry-quarantined`` re-admits exactly the quarantined set.
 """
 
 import os
@@ -14,6 +16,7 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -66,6 +69,7 @@ def _spawn(worker, obs, outdir, slow):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
+@pytest.mark.slow
 def test_kill_mid_run_then_resume(tmp_path):
     from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
                                                 generate_level1_file)
@@ -120,6 +124,123 @@ def test_kill_mid_run_then_resume(tmp_path):
         assert group in lvl2.groups, (group, lvl2.groups)
     tod = np.asarray(lvl2.tod)
     assert np.isfinite(tod).all() and tod.shape[0] == 1
+
+
+def _ledger_chain():
+    from comapreduce_tpu.pipeline.stages import (AssignLevel1Data,
+                                                 CheckLevel1File)
+
+    return [CheckLevel1File(min_duration_seconds=0.0), AssignLevel1Data()]
+
+
+def _gen_files(tmp_path, n=2):
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+
+    files = []
+    for i in range(n):
+        p = str(tmp_path / f"comap-{i:04d}.hd5")
+        generate_level1_file(p, SyntheticObsParams(
+            n_feeds=1, n_bands=1, n_channels=8, n_scans=1,
+            scan_samples=200, vane_samples=100, seed=40 + i,
+            obsid=4000 + i))
+        files.append(p)
+    return files
+
+
+def test_quarantine_survives_resume(tmp_path):
+    """ISSUE 2 satellite: a file quarantined in run 1 stays skipped in
+    run 2 — even after the bad file is repaired on disk (proving the
+    skip consults the LEDGER, not a fresh failure) — and
+    ``retry_quarantined`` re-admits exactly the quarantined set."""
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.resilience import QuarantineLedger
+
+    files = _gen_files(tmp_path)
+    bad = str(tmp_path / "comap-0099.hd5")
+    with open(bad, "wb") as f:
+        f.write(b"not an hdf5 file")
+    filelist = [files[0], bad, files[1]]
+    outdir = str(tmp_path / "level2")
+    rescfg = {"max_retries": 1, "retry_base_s": 0.0}
+
+    # run 1: the bad file burns its retry, takes the None slot, and
+    # lands in <outdir>/quarantine.jsonl as transient/quarantined
+    r1 = Runner(processes=_ledger_chain(), output_dir=outdir,
+                resilience=rescfg)
+    results = r1.run_tod(filelist)
+    assert [r is None for r in results] == [False, True, False]
+    ledger_path = os.path.join(outdir, "quarantine.jsonl")
+    led = QuarantineLedger(ledger_path)
+    assert led.is_quarantined(bad)
+    (entry,) = [e for e in led.entries if e.unit["file"] == bad]
+    assert entry.failure_class == "transient" and entry.retries == 1
+
+    # a kill mid-append leaves a truncated trailing line — the next
+    # run's load must shrug it off without losing the earlier entries
+    with open(ledger_path, "a") as f:
+        f.write('{"unit": {"fi')
+
+    # repair the bad file, then run 2 (fresh Runner = fresh process
+    # after a kill): STILL skipped — the ledger is consulted, the file
+    # is not even read (no result slot, no read timing)
+    import shutil
+
+    shutil.copy2(files[0], bad)
+    r2 = Runner(processes=_ledger_chain(), output_dir=outdir,
+                resilience=rescfg)
+    results2 = r2.run_tod(filelist)
+    assert len(results2) == 2 and all(r is not None for r in results2)
+    assert len(r2.timings["ingest.read"]) == 2
+
+    # run 3: --retry-quarantined re-admits exactly the quarantined set
+    r3 = Runner(processes=_ledger_chain(), output_dir=outdir,
+                resilience=dict(rescfg, retry_quarantined=True))
+    results3 = r3.run_tod(filelist)
+    assert len(results3) == 3 and all(r is not None for r in results3)
+    led3 = QuarantineLedger(ledger_path)
+    readmits = [e for e in led3.entries if e.disposition == "readmitted"]
+    assert [e.unit["file"] for e in readmits] == [bad]
+    assert not led3.is_quarantined(bad)
+
+    # run 4: the (repaired, re-admitted) file processes normally with no
+    # flag needed — re-admission is durable, not per-run
+    r4 = Runner(processes=_ledger_chain(), output_dir=outdir,
+                resilience=rescfg)
+    assert len(r4.run_tod(filelist)) == 3
+
+
+def test_corrupt_checkpoint_detected_and_requarantined(tmp_path):
+    """ISSUE 2 satellite (``_needs_tod``): a PRESENT-but-unreadable
+    Level-2 checkpoint is ledgered (not silently re-read) and its
+    quarantine lifts once the re-reduction rewrites it."""
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.runner import level2_path
+    from comapreduce_tpu.resilience import QuarantineLedger
+
+    (path,) = _gen_files(tmp_path, n=1)
+    outdir = str(tmp_path / "level2")
+    rescfg = {"max_retries": 0}
+    r1 = Runner(processes=_ledger_chain(), output_dir=outdir,
+                resilience=rescfg, ingest={"prefetch": 1})
+    (lvl2,) = r1.run_tod([path])
+    l2path = level2_path(outdir, path)
+    assert os.path.exists(l2path)
+
+    # corrupt the checkpoint (a partial copy / bit rot)
+    with open(l2path, "wb") as f:
+        f.write(b"\0" * 64)
+    r2 = Runner(processes=_ledger_chain(), output_dir=outdir,
+                resilience=rescfg, ingest={"prefetch": 1})
+    (lvl2b,) = r2.run_tod([path])
+    assert lvl2b is not None
+    led = QuarantineLedger(os.path.join(outdir, "quarantine.jsonl"))
+    mine = [e for e in led.entries if e.unit["file"] == l2path]
+    assert [e.disposition for e in mine] == ["quarantined", "recovered"]
+    assert mine[0].stage == "resume.checkpoint"
+    # the rewritten checkpoint is live again (a destriper filelist
+    # containing it must not skip it)
+    assert not led.is_quarantined(l2path)
 
 
 def test_safe_hdf5_open_retries(tmp_path):
