@@ -1164,11 +1164,14 @@ def bench_resilience():
     """Resilience mode: the chaos drill as a benchmark config.
 
     Runs ``resilience.drill.run_drill`` (the ``tools/check_resilience``
-    contract: every injected fault handled + ledgered, chaos map
-    byte-identical to the zero-weighted clean map, quarantine skip and
-    re-admit correct across runs) and reports faults handled per second
-    of drill wall time. Any broken promise raises — this config FAILING
-    is the signal, the throughput number is just the trend line.
+    contract: every injected fault handled + ledgered — including a
+    hanging read cancelled at the watchdog's hard deadline within
+    ``hard + grace`` — chaos map byte-identical to the zero-weighted
+    clean map, quarantine skip and re-admit correct across runs) and
+    reports faults handled per second of drill wall time. Any broken
+    promise raises — this config FAILING is the signal, the throughput
+    number is just the trend line. The evidence line carries the
+    measured per-attempt hang cancel latencies (``hang_cancel_s``).
     """
     import shutil
     import tempfile

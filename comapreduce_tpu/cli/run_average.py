@@ -7,7 +7,7 @@ TOML layout::
     processes = ["CheckLevel1File", "AssignLevel1Data", ...]
     filelist = "filelist.txt"        # one Level-1 path per line
     output_dir = "level2"
-    log_dir = "logs"
+    log_dir = "logs"                 # default: <output_dir>/logs
     calibrator_filelist = "cals.txt" # optional: enables run_astro_cal
 
     [StageName]
@@ -20,6 +20,7 @@ when jax.distributed is initialised, else 0/1 (single host).
 
 from __future__ import annotations
 
+import os
 import sys
 
 from comapreduce_tpu.pipeline import Runner, load_toml, set_logging
@@ -61,9 +62,34 @@ def main(argv=None) -> int:
         config["resilience"] = dict(config.get("resilience", {}),
                                     retry_quarantined=True)
     rank, n_ranks = _rank_info()
-    set_logging(base="run_average", log_dir=glob.get("log_dir", "."),
+    # run logs default under the OUTPUT dir, never the CWD: a fleet of
+    # campaign runs must not strew per-rank logfiles over whatever
+    # directory the operator happened to launch from (or the repo root)
+    log_dir = str(glob.get("log_dir", "") or
+                  os.path.join(str(glob.get("output_dir", ".")), "logs"))
+    set_logging(base="run_average", log_dir=log_dir,
                 rank=rank, level=str(glob.get("log_level", "INFO")))
     runner = Runner.from_config(config, rank=rank, n_ranks=n_ranks)
+    if n_ranks > 1:
+        # pre-shard straggler barrier: don't start a campaign shard
+        # against ranks that are already dead — ledger their shards as
+        # rejected (re-attempted next run) and continue degraded
+        from comapreduce_tpu.parallel.multihost import (degraded_shard,
+                                                        straggler_barrier)
+
+        res = runner._resilience_runtime()
+        if res.straggler_timeout_s > 0 and res.heartbeat is not None:
+            res.heartbeat.start()
+            alive, dead = straggler_barrier(
+                runner.output_dir, rank, n_ranks,
+                timeout_s=res.straggler_timeout_s,
+                heartbeat=res.heartbeat)
+            if dead:
+                # Runner.run_tod re-derives this rank's own shard; the
+                # barrier's job here is ledgering the dead ranks'
+                # shards as rejected (lowest alive rank writes)
+                degraded_shard(_read_filelist(glob["filelist"]), rank,
+                               n_ranks, dead, alive, ledger=res.ledger)
     figure_dir = figure_dir or str(glob.get("figure_dir", ""))
     if figure_dir:
         # per-obsid QA figures (reference: VaneCalibration.py:173-190,

@@ -207,11 +207,33 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
     return data, solve_band(data, offset_length=offset_length,
                             n_iter=n_iter, threshold=threshold,
                             use_ground=use_ground, sharded=sharded,
-                            coarse_block=coarse_block)
+                            coarse_block=coarse_block,
+                            watchdog=getattr(resilience, "watchdog",
+                                             None),
+                            unit=f"band{band}")
+
+
+def _watched_cg(solve, watchdog, unit: str):
+    """Run ``solve`` under the ``mapmaking.cg_solve`` wall budget and
+    translate a blown hard deadline into the operator warning — ONE
+    wrapper for the per-band and joint solve paths, so the default
+    (joint multi-RHS) route is watched exactly like the fallback."""
+    from comapreduce_tpu.mapmaking.destriper import watched_solve
+
+    result, state = watched_solve(solve, watchdog, unit=unit)
+    if state is not None and state.hard_expired:
+        logger.warning(
+            "CG solve %s blew its wall budget (%.1f s > hard "
+            "%.1f s); the map below is LATE, not wrong — raise the "
+            "[Resilience] deadlines budget for mapmaking.cg_solve "
+            "or investigate the stall (tools/watchdog_report.py)",
+            unit or "<band>", state.elapsed_s, state.hard_s)
+    return result
 
 
 def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
-               use_ground=False, sharded=False, coarse_block=0):
+               use_ground=False, sharded=False, coarse_block=0,
+               watchdog=None, unit=""):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -221,7 +243,20 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     (``destriper.build_coarse_preconditioner`` — reaches the
     threshold-1e-6 spec where Jacobi stalls; the coarse system is built
     per (pointing, weights) on host). The scatter fallbacks and the
-    sharded ground program keep Jacobi, with a warning."""
+    sharded ground program keep Jacobi, with a warning.
+
+    ``watchdog`` puts the whole solve under the ``mapmaking.cg_solve``
+    wall budget (``destriper.watched_solve``): device compute cannot be
+    cancelled, so the soft deadline warns/ledgers a stall and a blown
+    hard deadline flags the late result through the same operator
+    signal path as a tripped divergence monitor."""
+    if watchdog is not None:
+        return _watched_cg(
+            lambda: solve_band(data, offset_length=offset_length,
+                               n_iter=n_iter, threshold=threshold,
+                               use_ground=use_ground, sharded=sharded,
+                               coarse_block=coarse_block),
+            watchdog, unit)
     if sharded:
         import jax
 
@@ -404,7 +439,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          threshold=1e-6, use_calibration=True,
                          medfilt_window=400, sharded=False,
                          tod_variant="auto", coarse_block=0,
-                         prefetch=0, cache=None, resilience=None):
+                         prefetch=0, cache=None, resilience=None,
+                         watchdog=None):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -419,7 +455,10 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     plus the per-band result list — or ``(datas, None)`` when the bands'
     sample streams differ (e.g. a feed dead in one band only); the
     caller then falls back to per-band ``solve_band`` calls on the SAME
-    ``datas`` (the reads are never repeated).
+    ``datas`` (the reads are never repeated). ``watchdog`` puts every
+    joint CG solve under the same ``mapmaking.cg_solve`` wall budget
+    as ``solve_band`` (``_watched_cg``) — the DEFAULT multi-band path
+    must not escape the deadline the fallback path honours.
     """
     import jax.numpy as jnp
 
@@ -471,11 +510,15 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                                                block=int(coarse_block),
                                                pattern=pat)
                    for i in range(nb)]
-            res = run(jnp.asarray(tod), jnp.asarray(wgt),
-                      coarse=(pre[0][0],
-                              np.stack([p[1] for p in pre])))
+            res = _watched_cg(
+                lambda: run(jnp.asarray(tod), jnp.asarray(wgt),
+                            coarse=(pre[0][0],
+                                    np.stack([p[1] for p in pre]))),
+                watchdog, "joint(sharded)")
         else:
-            res = run(jnp.asarray(tod), jnp.asarray(wgt))
+            res = _watched_cg(
+                lambda: run(jnp.asarray(tod), jnp.asarray(wgt)),
+                watchdog, "joint(sharded)")
         if bool(np.any(np.asarray(res.diverged))):
             # same operator contract as solve_band's sharded branch:
             # the memoized program is not recompiled mid-run, but a
@@ -508,7 +551,9 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     # compact products on device, never (nb, npix) dense maps
     fn, uniq = _planned_solver(pix0[:n], npix, offset_length, n_iter,
                                threshold, compact=True)
-    res = fn(jnp.asarray(tod), jnp.asarray(wgt), **kwargs)
+    res = _watched_cg(
+        lambda: fn(jnp.asarray(tod), jnp.asarray(wgt), **kwargs),
+        watchdog, "joint")
     if kwargs.get("coarse") is not None and \
             bool(np.any(np.asarray(res.diverged))):
         # same divergence fallback as solve_band: drop to Jacobi, warm-
@@ -517,7 +562,10 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
             "joint CG diverged under the coarse preconditioner "
             "(diverged=%s); re-solving with Jacobi from the best "
             "iterates", np.asarray(res.diverged))
-        res = fn(jnp.asarray(tod), jnp.asarray(wgt), x0=res.offsets)
+        res = _watched_cg(
+            lambda: fn(jnp.asarray(tod), jnp.asarray(wgt),
+                       x0=res.offsets),
+            watchdog, "joint(fallback)")
     return datas, _expand_joint_results(res, uniq, npix, nb)
 
 
@@ -554,13 +602,12 @@ def main(argv=None) -> int:
     from comapreduce_tpu.pipeline.config import read_filelist
 
     filelist = read_filelist(inputs["filelist"])
-    # multi-process launch: initialise the distributed runtime and take
-    # this process's round-robin filelist shard (same split as the
-    # Runner; the reference instead slices contiguous blocks,
-    # run_destriper.py:131-138); each process writes its own partial maps
+    # multi-process launch: initialise the distributed runtime; the
+    # round-robin filelist shard (same split as the Runner; the
+    # reference instead slices contiguous blocks,
+    # run_destriper.py:131-138) is taken AFTER the straggler barrier
+    # below — each process writes its own partial maps
     rank, n_ranks = rank_info()
-    if n_ranks > 1:
-        filelist = filelist[rank::n_ranks]
     out_dir = inputs.get("output_dir", ".")
     os.makedirs(out_dir, exist_ok=True)
     prefix = inputs.get("prefix", "map")
@@ -622,6 +669,24 @@ def main(argv=None) -> int:
         res_cfg = dataclasses.replace(res_cfg, retry_quarantined=True)
     resilience = res_cfg.make_runtime(out_dir, rank=rank,
                                       n_ranks=n_ranks)
+    if resilience.heartbeat is not None:
+        # per-rank liveness for the whole mapping run (read by sibling
+        # ranks' straggler barriers and tools/watchdog_report.py)
+        resilience.heartbeat.start()
+    if n_ranks > 1:
+        if resilience.straggler_timeout_s > 0 \
+                and resilience.heartbeat is not None:
+            from comapreduce_tpu.parallel.multihost import (
+                degraded_shard, straggler_barrier)
+
+            alive, dead = straggler_barrier(
+                out_dir, rank, n_ranks,
+                timeout_s=resilience.straggler_timeout_s,
+                heartbeat=resilience.heartbeat)
+            filelist = degraded_shard(filelist, rank, n_ranks, dead,
+                                      alive, ledger=resilience.ledger)
+        else:
+            filelist = filelist[rank::n_ranks]
 
     # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
     # binning per iteration); ground solves keep their own path.
@@ -636,7 +701,7 @@ def main(argv=None) -> int:
             threshold=threshold, use_calibration=use_cal,
             sharded=sharded, tod_variant=tod_variant,
             coarse_block=coarse_block, prefetch=prefetch, cache=cache,
-            resilience=resilience)
+            resilience=resilience, watchdog=resilience.watchdog)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -649,7 +714,9 @@ def main(argv=None) -> int:
             result = solve_band(data, offset_length=offset_length,
                                 n_iter=n_iter, threshold=threshold,
                                 sharded=sharded,
-                                coarse_block=coarse_block)
+                                coarse_block=coarse_block,
+                                watchdog=resilience.watchdog,
+                                unit=f"band{band}")
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
@@ -681,6 +748,8 @@ def main(argv=None) -> int:
     if resilience.ledger is not None and resilience.ledger.entries:
         print(f"quarantine ledger {resilience.ledger.path}: "
               f"{resilience.ledger.summary()}")
+    if resilience.heartbeat is not None:
+        resilience.heartbeat.stop(final_stage="run_destriper.done")
     return 0
 
 

@@ -1,0 +1,64 @@
+"""Crash-durable atomic file replacement.
+
+``os.replace`` makes a rename atomic against CONCURRENT readers, but
+not against POWER LOSS: without an ``fsync`` of the temp file first,
+the rename can be journalled to disk before the file's data blocks
+are, and a crash then leaves a fully-committed name pointing at a
+zero-length (or partially-written) file — exactly the torn checkpoint
+the atomic write existed to prevent, resurfacing after the one failure
+mode it was sold against. Syncing the *directory* afterwards makes the
+rename itself durable (POSIX leaves directory-entry durability to an
+explicit fsync of the directory fd; on platforms where directories
+cannot be opened, that step is skipped — the data-blocks fsync is the
+part that prevents torn content).
+
+:func:`durable_replace` is the one home for the rule, used by the
+Level-2 checkpoint writer (``data.hdf5io.HDF5Store.write(atomic=True)``)
+and the ingest cache's disk spill (``ingest.cache.BlockCache``).
+``durable=False`` restores the plain (fast, crash-torn-able) replace
+for advisory files where a lost update costs one tick, not data.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["durable_replace", "fsync_path"]
+
+
+def fsync_path(path: str) -> None:
+    """fsync ``path``'s data blocks (opened read-only; the file must
+    already be closed/flushed by the writer)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(path or ".", flags)
+    except OSError:
+        return  # non-POSIX: directory fds unsupported; rename
+        # durability is then the filesystem's problem, torn content
+        # is still prevented by the data fsync
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def durable_replace(tmp: str, dst: str, durable: bool = True) -> None:
+    """``os.replace(tmp, dst)`` with fsync-before-rename (and a POSIX
+    directory fsync after), so a power cut leaves either the complete
+    old file or the complete new one — never a committed name over
+    unwritten blocks."""
+    if durable:
+        fsync_path(tmp)
+    os.replace(tmp, dst)
+    if durable:
+        _fsync_dir(os.path.dirname(os.path.abspath(dst)))

@@ -187,7 +187,8 @@ class HDF5Store:
             self._file = None
         return self
 
-    def write(self, filename: str, atomic: bool = False) -> None:
+    def write(self, filename: str, atomic: bool = False,
+              durable: bool = True) -> None:
         """Append/overwrite the store's datasets + attrs into ``filename``.
 
         Lazy (still-on-disk) datasets are skipped — they belong to the source
@@ -198,6 +199,13 @@ class HDF5Store:
         it into place, so a run killed mid-write never leaves a
         partially-written checkpoint — a resume would otherwise see a
         stage's group present but incomplete and skip it forever.
+        ``durable=True`` (default) additionally fsyncs the temp file
+        before the rename (and the directory after, on POSIX): without
+        it a POWER CUT — unlike a mere kill — can commit the rename
+        ahead of the data blocks and leave a zero-length "checkpoint"
+        under the final name, defeating the corrupt-checkpoint recovery
+        that trusts atomically-named files. ``durable=False`` trades
+        that guarantee for write latency (scratch/throwaway outputs).
         """
         # If we hold an open read handle on this same path, release it first.
         if self._file is not None and os.path.abspath(
@@ -231,7 +239,9 @@ class HDF5Store:
                     self._write_into(tmp, "w")
                     # the file now equals this store's content exactly
                     self._mirrors = target
-                os.replace(tmp, filename)
+                from comapreduce_tpu.data.durable import durable_replace
+
+                durable_replace(tmp, filename, durable=durable)
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)

@@ -71,11 +71,22 @@ class BlockCache:
     spill_dir:
         When set, evicted entries are pickled here and restored on a
         later ``get``. Created on first use.
+    durable:
+        fsync each spill file before its atomic rename (default).
+        A spill that survives a crash is consulted by the NEXT run's
+        warm start; without the fsync a power cut can commit the
+        rename ahead of the data and leave a zero-length .pkl under a
+        valid name (it would be dropped as unreadable — safe — but a
+        torn-yet-unpicklable payload under a matching key is the kind
+        of corruption ``_load_spill``'s key check cannot see).
+        ``durable=False`` restores the lower-latency spill.
     """
 
-    def __init__(self, max_bytes: int, spill_dir: str = ""):
+    def __init__(self, max_bytes: int, spill_dir: str = "",
+                 durable: bool = True):
         self.max_bytes = int(max_bytes)
         self.spill_dir = spill_dir
+        self.durable = bool(durable)
         self._lock = threading.Lock()
         self._entries: OrderedDict[tuple, tuple] = OrderedDict()
         # keys with a valid spill file on disk: content per key is
@@ -116,12 +127,15 @@ class BlockCache:
                 if key in self._on_disk:
                     continue
             try:
+                from comapreduce_tpu.data.durable import durable_replace
+
                 os.makedirs(self.spill_dir, exist_ok=True)
                 tmp = self._spill_path(key) + ".tmp"
                 with open(tmp, "wb") as f:
                     pickle.dump((key, payload), f,
                                 protocol=pickle.HIGHEST_PROTOCOL)
-                os.replace(tmp, self._spill_path(key))
+                durable_replace(tmp, self._spill_path(key),
+                                durable=self.durable)
                 with self._lock:
                     self.stats["spills"] += 1
                     self._on_disk.add(key)

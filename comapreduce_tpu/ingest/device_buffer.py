@@ -35,8 +35,8 @@ def sharding_for_dataset(dataset: str, mesh=None):
 
 
 def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
-                       sharding: Any | Callable[[Any], Any] = None
-                       ) -> Iterator[Any]:
+                       sharding: Any | Callable[[Any], Any] = None,
+                       watchdog: Any = None) -> Iterator[Any]:
     """Yield device-resident blocks, keeping ``size`` in flight.
 
     Parameters
@@ -56,6 +56,14 @@ def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
     The transfer queue drains lazily: breaking out of the consumer loop
     abandons at most ``size`` in-flight blocks (harmless — transfers
     complete in the background and are garbage-collected).
+
+    ``watchdog`` (a ``resilience.Watchdog``) supervises each H2D issue
+    under the ``ingest.h2d`` deadline. ``device_put`` is asynchronous —
+    the call itself only enqueues — but a wedged transfer backend (a
+    PCIe reset, a dead ICI link) blocks right here at issue time once
+    the transfer queue fills, which is exactly the hang the soft
+    deadline surfaces; monitoring only, no cancellation (an abandoned
+    transfer would leak device buffers).
     """
     import jax
 
@@ -64,6 +72,11 @@ def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
 
     def put(block):
         shard = sharding(block) if callable(sharding) else sharding
+        if watchdog is not None:
+            with watchdog.watch("ingest.h2d"):
+                if shard is None:
+                    return jax.device_put(block)
+                return jax.device_put(block, shard)
         if shard is None:
             return jax.device_put(block)
         return jax.device_put(block, shard)

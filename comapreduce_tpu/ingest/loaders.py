@@ -58,21 +58,27 @@ def _rebuild(cls, payload, **kwargs):
 
 
 def _stream(filenames, loader, rebuild, prefetch: int = 0,
-            cache=None, retry=None, chaos=None) -> Iterator[PrefetchItem]:
+            cache=None, retry=None, chaos=None, watchdog=None,
+            on_hang=None) -> Iterator[PrefetchItem]:
     if chaos is not None:
         # fault injection wraps the loader INSIDE the retry net — an
         # injected read error exercises the same retry/quarantine path
         # a real one would (resilience/chaos.py). The cache is disabled
         # for the drill: a poisoned payload written to a (possibly
         # disk-spilled) cache would outlive the drill and serve NaNs to
-        # a later clean run as a cache hit, bypassing chaos.decide
+        # a later clean run as a cache hit, bypassing chaos.decide.
+        # The watchdog wraps OUTSIDE the chaos loader (below, via
+        # _load_one): an injected hang must be cancelled by the same
+        # deadline a real one would be.
         loader = chaos.wrap_loader(loader)
         cache = None
     if prefetch >= 1:
         items = Prefetcher(filenames, loader, depth=prefetch, cache=cache,
-                           retry=retry)
+                           retry=retry, watchdog=watchdog,
+                           on_hang=on_hang)
     else:
-        items = iter_serial(filenames, loader, cache, retry=retry)
+        items = iter_serial(filenames, loader, cache, retry=retry,
+                            watchdog=watchdog)
     try:
         for item in items:
             if item.fatal:
@@ -95,7 +101,8 @@ def _stream(filenames, loader, rebuild, prefetch: int = 0,
 
 def level1_stream(filenames, prefetch: int = 0, cache=None,
                   eager_tod: bool = True, eager_for=None,
-                  retry=None, chaos=None) -> Iterator[PrefetchItem]:
+                  retry=None, chaos=None, watchdog=None,
+                  on_hang=None) -> Iterator[PrefetchItem]:
     """Ordered ``PrefetchItem``s of :class:`COMAPLevel1` views.
 
     The TOD is materialised on the worker when prefetching (that is the
@@ -114,8 +121,12 @@ def level1_stream(filenames, prefetch: int = 0, cache=None,
 
     ``retry`` (a ``resilience.RetryPolicy``) re-attempts transient read
     failures with backoff before a file takes its error slot; ``chaos``
-    (a ``resilience.ChaosMonkey``) injects faults around the loader —
-    both off (None) by default.
+    (a ``resilience.ChaosMonkey``) injects faults around the loader;
+    ``watchdog`` (a ``resilience.Watchdog``) runs each attempt under
+    the ``ingest.read`` soft/hard deadline (a hung read is cancelled,
+    retried, and only then captured); ``on_hang`` is the prefetcher's
+    abandoned-worker callback (see ``Prefetcher``) — all off (None) by
+    default.
     """
     eager = eager_tod and (prefetch >= 1 or cache is not None)
 
@@ -126,15 +137,16 @@ def level1_stream(filenames, prefetch: int = 0, cache=None,
     return _stream(filenames, loader,
                    lambda p: _rebuild(COMAPLevel1, p),
                    prefetch=prefetch, cache=cache, retry=retry,
-                   chaos=chaos)
+                   chaos=chaos, watchdog=watchdog, on_hang=on_hang)
 
 
 def level2_stream(filenames, prefetch: int = 0, cache=None,
-                  retry=None, chaos=None) -> Iterator[PrefetchItem]:
+                  retry=None, chaos=None, watchdog=None,
+                  on_hang=None) -> Iterator[PrefetchItem]:
     """Ordered ``PrefetchItem``s of :class:`COMAPLevel2` views (the
     destriper's filelist reader; always fully decoded). ``retry``/
-    ``chaos`` as in :func:`level1_stream`."""
+    ``chaos``/``watchdog``/``on_hang`` as in :func:`level1_stream`."""
     return _stream(filenames, load_level2,
                    lambda p: _rebuild(COMAPLevel2, p, filename=""),
                    prefetch=prefetch, cache=cache, retry=retry,
-                   chaos=chaos)
+                   chaos=chaos, watchdog=watchdog, on_hang=on_hang)

@@ -12,7 +12,13 @@ Failure contract: a loader exception is captured into that file's
 kills the queue or the files behind it (the consumer maps it onto the
 pipeline's per-file "BAD FILE" fault tolerance). Breaking out of the
 consumer loop (or ``close()``) stops the worker promptly: every
-blocking queue operation polls a stop event.
+blocking queue operation polls a stop event. A worker that ignores the
+stop event (a loader hung inside C code) is abandoned after the join
+timeout: the prefetcher is poisoned (iterating it again raises) and
+the in-flight file is reported through ``on_hang`` for the quarantine
+ledger. With a ``resilience.Watchdog`` each read attempt additionally
+runs under the ``ingest.read`` soft/hard deadline and a hung attempt
+is cancelled (``HangError``) instead of wedging the worker at all.
 
 :func:`iter_serial` is the same iteration contract without the thread —
 the serial fallback and the prefetched path share one code path in
@@ -59,12 +65,16 @@ class PrefetchItem:
 
 
 def _load_one(index: int, filename: str, loader, cache,
-              retry=None, sleep=None) -> PrefetchItem:
+              retry=None, sleep=None, watchdog=None) -> PrefetchItem:
     """Shared load step (cache probe -> loader -> cache fill) used by
     both the worker thread and :func:`iter_serial`. ``retry`` (a
     ``resilience.RetryPolicy``) re-attempts transient loader failures
     with backoff before the error is captured into the item — applied
-    here so the serial and prefetched paths share one retry site."""
+    here so the serial and prefetched paths share one retry site.
+    ``watchdog`` (a ``resilience.Watchdog``) runs each attempt under
+    the ``ingest.read`` deadline INSIDE the retry net: a read cancelled
+    at the hard deadline (``HangError``) is retried with a fresh budget
+    like any transient, and only then captured into the item."""
     t0 = time.perf_counter()
     retries = 0
     try:
@@ -81,15 +91,22 @@ def _load_one(index: int, filename: str, loader, cache,
             from comapreduce_tpu.ingest.cache import file_key
 
             key = file_key(filename)
+        if watchdog is not None:
+            def attempt(fname=filename, _loader=loader):
+                return watchdog.call(_loader, "ingest.read", unit=fname,
+                                     args=(fname,))
+        else:
+            def attempt(fname=filename, _loader=loader):
+                return _loader(fname)
         if retry is not None:
             from comapreduce_tpu.resilience.retry import retry_call
 
             payload, retries = retry_call(
-                lambda: loader(filename), retry, key=filename,
+                attempt, retry, key=filename,
                 label=f"ingest.read {filename}",
                 **({"sleep": sleep} if sleep is not None else {}))
         else:
-            payload = loader(filename)
+            payload = attempt()
         # only decoded-payload dicts are cacheable: a live store (lazy
         # h5py handle) must never reach the pickle-based disk spill
         if cache is not None and isinstance(payload, dict):
@@ -104,10 +121,12 @@ def _load_one(index: int, filename: str, loader, cache,
 
 
 def iter_serial(filenames: Iterable[str], loader: Callable[[str], Any],
-                cache=None, retry=None) -> Iterator[PrefetchItem]:
+                cache=None, retry=None,
+                watchdog=None) -> Iterator[PrefetchItem]:
     """The serial path: identical items, read lazily at ``next()``."""
     for i, fname in enumerate(filenames):
-        yield _load_one(i, fname, loader, cache, retry)
+        yield _load_one(i, fname, loader, cache, retry,
+                        watchdog=watchdog)
 
 
 class Prefetcher:
@@ -138,13 +157,21 @@ class Prefetcher:
     def __init__(self, filenames: Iterable[str],
                  loader: Callable[[str], Any], depth: int = 2,
                  cache=None, name: str = "ingest-prefetch",
-                 retry=None):
+                 retry=None, watchdog=None, on_hang=None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self.depth = int(depth)
         self._loader = loader
         self._cache = cache
         self._retry = retry
+        self._watchdog = watchdog
+        # called with the in-flight filename when close() abandons a
+        # worker that never returned (the resilience layer ledgers it
+        # as a hang); the prefetcher is then POISONED: iterating it
+        # again would consume from a half-dead queue
+        self._on_hang = on_hang
+        self._poisoned = False
+        self._inflight: str | None = None
         self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._sentinel = object()
@@ -187,8 +214,11 @@ class Prefetcher:
                 # closing consumer is never held behind it — neither by
                 # the sleeps nor by zero-delay re-attempts of a dying
                 # loader
+                self._inflight = fname
                 item = _load_one(index, fname, self._loader, self._cache,
-                                 self._retry, sleep=self._stop.wait)
+                                 self._retry, sleep=self._stop.wait,
+                                 watchdog=self._watchdog)
+                self._inflight = None
                 if not self._put(item):
                     return
                 self.depth_log.append((time.perf_counter() - self._t0,
@@ -205,10 +235,37 @@ class Prefetcher:
             # the consumer never blocks on a dead worker
             self._put(self._sentinel)
 
+    def _close_timeout(self) -> float:
+        """close()'s default join budget, derived AT CLOSE TIME: when a
+        watchdog supervises ``ingest.read``, a read attempt cannot
+        outlive its hard deadline, so the worker gets every attempt's
+        full budget (+grace) before it is declared hung. Resolved here
+        rather than at construction because adaptive extension can
+        legally GROW the hard deadline mid-run — a read still inside
+        its (extended) budget must never be ledgered as a hang by the
+        shutdown path racing it."""
+        timeout = 10.0
+        if self._watchdog is not None:
+            dl = self._watchdog.deadline_for("ingest.read")
+            if dl is not None and dl.hard_s is not None:
+                attempts = 1 + getattr(self._retry, "max_retries", 0)
+                timeout = max(10.0, attempts * (
+                    dl.hard_s + getattr(self._watchdog, "grace_s",
+                                        0.0)))
+        return timeout
+
     # -- consumer ----------------------------------------------------------
     def __iter__(self) -> Iterator[PrefetchItem]:
         try:
             while True:
+                if self._poisoned:
+                    # a previous close() abandoned a hung worker: its
+                    # queue may still fill with stale results at any
+                    # moment — consuming them would silently mix files
+                    # from before and after the hang
+                    raise RuntimeError(
+                        "Prefetcher is poisoned (its worker hung and "
+                        "was abandoned); build a fresh Prefetcher")
                 try:
                     item = self._queue.get(timeout=_POLL_S)
                 except queue.Empty:
@@ -229,9 +286,22 @@ class Prefetcher:
         finally:
             self.close()
 
-    def close(self, timeout: float = 10.0) -> None:
+    def close(self, timeout: float | None = None) -> None:
         """Stop the worker and join it. Idempotent; safe mid-iteration
-        (the early-exit path of a breaking consumer)."""
+        (the early-exit path of a breaking consumer).
+
+        When the worker does not stop within ``timeout`` (default:
+        10 s, or the full per-file retry x hard-deadline budget when a
+        watchdog supervises the reads — a loader stuck in HDF5/NFS C
+        code ignores the stop event) it is ABANDONED: the prefetcher
+        is marked poisoned — later iteration raises instead of
+        consuming from the half-dead queue — and ``on_hang`` is
+        invoked with the in-flight filename so the resilience layer
+        can ledger the hang (``rejected``: re-attempted next run, so a
+        slow-but-healthy read mis-flagged at shutdown costs one run's
+        deferral, never the file)."""
+        if timeout is None:
+            timeout = self._close_timeout()
         self._stop.set()
         # drain so a worker blocked on a full queue sees the stop event
         # on its next put poll rather than after a timeout
@@ -242,9 +312,21 @@ class Prefetcher:
             pass
         if self._thread.is_alive():
             self._thread.join(timeout=timeout)
-            if self._thread.is_alive():  # pragma: no cover - loader hang
-                logger.warning("Prefetcher: worker did not stop within "
-                               "%.1f s (loader stuck in C code?)", timeout)
+            if self._thread.is_alive():
+                inflight = self._inflight
+                self._poisoned = True
+                logger.warning(
+                    "Prefetcher: worker did not stop within %.1f s "
+                    "(loader stuck in C code?); abandoning it%s and "
+                    "poisoning the prefetcher", timeout,
+                    f" mid-read of {inflight}" if inflight else "")
+                if inflight and self._on_hang is not None:
+                    try:
+                        self._on_hang(inflight)
+                    except Exception:  # pragma: no cover - ledger I/O
+                        logger.exception(
+                            "Prefetcher: on_hang callback failed for %s",
+                            inflight)
 
     def __enter__(self) -> "Prefetcher":
         return self

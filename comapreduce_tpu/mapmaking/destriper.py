@@ -39,7 +39,8 @@ from comapreduce_tpu.resilience.tripwires import scrub_tod
 
 __all__ = ["DestriperResult", "destripe", "destripe_jit",
            "destripe_planned", "ground_ids_per_offset",
-           "build_coarse_preconditioner", "coarse_pattern"]
+           "build_coarse_preconditioner", "coarse_pattern",
+           "watched_solve"]
 
 # CG divergence tripwire: a system is diverged when its true residual
 # sits more than sqrt(DIVERGENCE_GROWTH)x above the best iterate's for
@@ -75,6 +76,32 @@ class DestriperResult(NamedTuple):
     # Trailing default keeps positional construction of the 8 original
     # fields working everywhere.
     diverged: jax.Array = 0
+
+
+def watched_solve(solve, watchdog=None, name: str = "mapmaking.cg_solve",
+                  unit: str = ""):
+    """Run one (jitted, device-driving) CG solve under a wall budget.
+
+    Device compute cannot be cancelled in place, so this is the
+    UNCANCELLABLE arm of the watchdog (``Watchdog.watch``): the soft
+    deadline fires the structured ``stalled`` warning + ledger event
+    mid-solve; a blown hard deadline sets ``state.hard_expired`` and
+    the caller routes the late result through the SAME operator signal
+    path as a tripped divergence monitor — a loud warning naming the
+    band, never a silent late map. Completed solve durations feed the
+    watchdog's adaptive percentile, so a campaign's per-CG budget
+    tightens around measured behaviour (hard = p95 x scale, floored by
+    config).
+
+    Returns ``(result, state)``; ``state`` is None when unwatched.
+    ONE home for the rule — ``cli.run_destriper.solve_band`` and the
+    chaos drill must not drift apart.
+    """
+    if watchdog is None:
+        return solve(), None
+    with watchdog.watch(name, unit=unit) as state:
+        result = solve()
+    return result, state
 
 
 def _expand(offsets, ground, ground_ids, az, n_samples, offset_length):

@@ -220,9 +220,15 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     ``resilience`` (a ``resilience.Resilience`` bundle) adds the fault
     layer: files the quarantine ledger marks bad are skipped without a
     read, transient read failures retry with backoff, injected chaos
-    wraps the loader, failures are ledgered, and any non-finite
+    wraps the loader, failures are ledgered, any non-finite
     TOD/weight sample is zero-weighted (with a 'masked' ledger event
-    naming the file/feed/band) before it can reach the destriper."""
+    naming the file/feed/band) before it can reach the destriper, and
+    — with a watchdog configured — each read runs under the
+    ``ingest.read`` soft/hard deadline: a hung read is cancelled
+    (``HangError``, an ``OSError``, lands in the same per-file net
+    below), retried with a fresh budget, and on exhaustion ledgered
+    ``hang``/``rejected`` with the file excluded from this run's
+    map."""
     from comapreduce_tpu.ingest import level2_stream
 
     if (wcs is None) == (nside is None):
@@ -250,7 +256,12 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     kept_files = []
     stream = level2_stream(filenames, prefetch=prefetch, cache=cache,
                            retry=resilience.retry,
-                           chaos=resilience.chaos)
+                           chaos=resilience.chaos,
+                           watchdog=resilience.watchdog,
+                           on_hang=lambda f: resilience.record_hang(
+                               f, stage="destriper.close",
+                               message="loader never returned; "
+                                       "prefetcher abandoned"))
     try:
         for item in stream:
             fname = item.filename

@@ -36,8 +36,10 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
-__all__ = ["maybe_initialize_distributed", "rank_info"]
+__all__ = ["maybe_initialize_distributed", "rank_info",
+           "straggler_barrier", "degraded_shard"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -104,6 +106,113 @@ def _distributed_is_initialized(jax) -> bool:
         from jax._src import distributed
 
         return distributed.global_state.client is not None
+
+
+def straggler_barrier(heartbeat_dir: str, rank: int, n_ranks: int,
+                      timeout_s: float = 120.0, poll_s: float = 0.5,
+                      heartbeat=None, clock=time.monotonic,
+                      sleep=time.sleep) -> tuple[list, list]:
+    """Pre-shard barrier over heartbeat files; returns
+    ``(alive_ranks, dead_ranks)``.
+
+    Every rank beats its own ``heartbeat.rank{r}.json`` on entering the
+    barrier (``resilience.heartbeat``; pass this rank's own
+    ``heartbeat``) and then polls for the siblings'. A sibling counts
+    as ALIVE only when its heartbeat is observed to CHANGE during our
+    polling — a new ``seq``/timestamp/mtime, or a file appearing — a
+    liveness signal that no leftover can fake: a heartbeat file from a
+    crashed rank (even one written seconds ago by the final beat of a
+    dying process, or by a previous run a supervisor just relaunched
+    over) never changes again, while an alive sibling re-beats at
+    least every ticker period and on its own barrier entry. It is
+    also immune to cross-host clock skew — a moving file is a moving
+    file regardless of what its timestamps claim. The price is
+    latency: proving a sibling alive takes until its next write, so
+    ``timeout_s`` must comfortably exceed the fleet's ``heartbeat_s``
+    ticker period (warned below when it does not).
+
+    Ranks still unchanged at ``timeout_s`` are declared DEAD and the
+    caller enters degraded mode (:func:`degraded_shard`) instead of
+    deadlocking a collective against a rank that will never arrive.
+    The barrier is advisory and read-only: it never blocks a healthy
+    single-rank run (``n_ranks <= 1`` returns immediately) and a rank
+    declared dead by mistake (a paused VM resuming late) costs one
+    run's shard — ledgered ``rejected``, re-attempted next run — not
+    the campaign.
+    """
+    from comapreduce_tpu.resilience.heartbeat import read_heartbeats
+
+    if heartbeat is not None:
+        # our own barrier-entry beat doubles as the change siblings
+        # polling right now are waiting to observe
+        heartbeat.note(stage="multihost.barrier")
+        period = getattr(heartbeat, "period_s", 0.0)
+        if period and timeout_s <= 2 * period:
+            logger.warning(
+                "straggler barrier: timeout_s=%.0f is not comfortably "
+                "above the heartbeat period (%.0f s) — healthy "
+                "siblings may not beat within the window; raise "
+                "straggler_timeout_s or lower heartbeat_s",
+                timeout_s, period)
+    if n_ranks <= 1:
+        return [rank], []
+    others = [r for r in range(n_ranks) if r != rank]
+
+    def signature(hb: dict) -> tuple:
+        return (hb.get("seq"), hb.get("t_wall_unix"), hb.get("_mtime"))
+
+    # baseline scan: whatever is on disk NOW proves nothing (it may be
+    # a dead rank's last beat); only change from here on does
+    baseline = {r: signature(hb)
+                for r, hb in read_heartbeats(heartbeat_dir).items()
+                if r in others}
+    alive: set = set()
+    deadline = clock() + max(timeout_s, 0.0)
+    while clock() < deadline and len(alive) < len(others):
+        sleep(poll_s)
+        hbs = read_heartbeats(heartbeat_dir)
+        for r in others:
+            hb = hbs.get(r)
+            if hb is None or r in alive:
+                continue
+            if r not in baseline or signature(hb) != baseline[r]:
+                alive.add(r)  # appeared or changed: someone is home
+    dead = sorted(set(others) - alive)
+    if dead:
+        logger.warning(
+            "straggler barrier: rank(s) %s missed the barrier within "
+            "%.1f s (heartbeats in %s missing or stale); continuing "
+            "DEGRADED — their filelist shards will be ledgered as "
+            "rejected and re-attempted next run", dead, timeout_s,
+            heartbeat_dir)
+    return sorted(alive | {rank}), dead
+
+
+def degraded_shard(filelist, rank: int, n_ranks: int, dead,
+                   alive, ledger=None) -> list:
+    """This rank's round-robin filelist shard under degraded mode.
+
+    The shard rule is the same ``i % n_ranks == r`` split as
+    ``Runner.shard_iter`` / the destriper CLI — sharding does NOT
+    change when a rank dies (re-sharding mid-campaign would silently
+    move files between ranks' per-rank quarantine ledgers and partial
+    maps). Instead the LOWEST alive rank — one writer, no duplicate
+    entries — ledgers every dead rank's file as ``hang``/``rejected``
+    so the next run re-attempts it, and every survivor just runs its
+    own shard.
+    """
+    files = list(filelist)
+    dead = sorted(set(dead))
+    alive = sorted(set(alive))
+    if dead and ledger is not None and alive and rank == alive[0]:
+        for r in dead:
+            for f in files[r::n_ranks]:
+                ledger.record(
+                    f, failure_class="hang", disposition="rejected",
+                    stage="multihost.straggler",
+                    message=f"rank {r} missed the straggler barrier; "
+                            f"shard deferred to the next run")
+    return files[rank::n_ranks]
 
 
 def rank_info() -> tuple[int, int]:

@@ -151,7 +151,8 @@ class ObservationStep:
         return dict(tod=feed, mask=feed, vane_tod=feed, airmass=feed,
                     pixels=feed, freq_scaled=repl)
 
-    def run_stream(self, observations, buffer_size: int = 2):
+    def run_stream(self, observations, buffer_size: int = 2,
+                   watchdog=None):
         """Stream observations through the compiled step with
         host→device double-buffering: observation ``i+1``'s arrays
         transfer (``jax.device_put`` is async) while observation ``i``
@@ -160,14 +161,20 @@ class ObservationStep:
         ``observations`` yields dicts with :meth:`__call__`'s array
         kwargs (host numpy, e.g. built from a prefetched
         ``level1_stream``). Yields one ``(level2_dict,
-        DestriperResult)`` per observation, in order.
+        DestriperResult)`` per observation, in order. ``watchdog`` (a
+        ``resilience.Watchdog``, e.g. ``Resilience.watchdog``) puts
+        each H2D issue under the ``ingest.h2d`` deadline — a wedged
+        transfer backend blocks at issue time once the queue fills,
+        and the soft deadline surfaces it (monitor-only; see
+        ``prefetch_to_device``).
         """
         from comapreduce_tpu.ingest.device_buffer import prefetch_to_device
 
         shardings = self.input_shardings()
         for block in prefetch_to_device(
                 observations, size=buffer_size,
-                sharding=lambda b: {k: shardings[k] for k in b}):
+                sharding=lambda b: {k: shardings[k] for k in b},
+                watchdog=watchdog):
             yield self(**block)
 
 

@@ -154,7 +154,18 @@ class Runner:
                                prefetch=cfg.prefetch, cache=cache,
                                eager_tod=cfg.eager_tod,
                                eager_for=self._needs_tod,
-                               retry=res.retry, chaos=res.chaos)
+                               retry=res.retry, chaos=res.chaos,
+                               watchdog=res.watchdog,
+                               on_hang=lambda f: res.record_hang(
+                                   f, stage="ingest.close",
+                                   message="loader never returned; "
+                                           "prefetcher abandoned"))
+        if res.heartbeat is not None:
+            # liveness for the whole loop: the ticker keeps beating
+            # even while one file wedges inside a stage, which is
+            # exactly when sibling ranks (and operators reading
+            # tools/watchdog_report.py) need the signal most
+            res.heartbeat.start()
         try:
             self._consume_stream(stream, results, res)
         finally:
@@ -162,6 +173,8 @@ class Runner:
             # the per-file net does not catch and the caller keeps the
             # traceback alive: closing the generator stops the worker
             stream.close()
+            if res.heartbeat is not None:
+                res.heartbeat.stop(final_stage="run_tod.done")
         if res.ledger is not None and res.ledger.entries:
             logger.info("quarantine ledger %s: %s", res.ledger.path,
                         res.ledger.summary())
@@ -177,6 +190,11 @@ class Runner:
             cfg = ResilienceConfig.coerce(self.resilience)
             self._resilience = cfg.make_runtime(
                 self.output_dir, rank=self.rank, n_ranks=self.n_ranks)
+            if self._resilience.watchdog is not None:
+                # the Runner's own per-stage timings feed the adaptive
+                # deadlines (hard = p95 x scale of prior same-stage
+                # durations, floored by config)
+                self._resilience.watchdog.timings = self.timings
         return self._resilience
 
     def _admitted(self, filelist, res):
@@ -193,9 +211,20 @@ class Runner:
     def _consume_stream(self, stream, results: list, res=None) -> None:
         if res is None:  # direct callers/tests without a runtime
             res = self._resilience_runtime()
+        hb, wd = res.heartbeat, res.watchdog
         for item in stream:
             logger.info("rank %d: processing %s", self.rank, item.filename)
-            self.timings.setdefault("ingest.read", []).append(item.read_s)
+            if hb is not None:
+                hb.note(stage="stage_chain", unit=item.filename)
+            # errored reads record 0.0, keeping the per-file lists
+            # index-aligned WITHOUT feeding failure durations into the
+            # adaptive deadline percentile (timings backs
+            # watchdog.deadline_for): a hang-cancelled read lasts
+            # ~attempts x hard deadline, and letting that into the p95
+            # would grow the very budget that cancelled it — each
+            # generation of hangs inflating the next's, unbounded
+            self.timings.setdefault("ingest.read", []).append(
+                item.read_s if item.error is None else 0.0)
             t0 = time.perf_counter()
             if item.error is not None:
                 # per-file fault tolerance: a bad file never kills the
@@ -211,12 +240,26 @@ class Runner:
                 results.append(None)
                 # keep the read/compute lists index-aligned per file
                 self.timings.setdefault("ingest.compute", []).append(0.0)
+                if hb is not None:
+                    hb.advance(files_failed=1)
                 continue
             # a retry-saved read is bookkeeping only, never skipped
             res.record_recovered(item.filename, item.retries,
                                  stage="ingest.read")
             try:
-                results.append(self._run_file_with_retry(item, res))
+                if wd is not None:
+                    # soft/hard monitoring only: a stage chain drives
+                    # jitted device compute and cannot be cancelled in
+                    # place — a blown hard deadline is flagged (event +
+                    # heartbeat + log), never killed mid-solve
+                    with wd.watch("pipeline.stage_chain",
+                                  unit=item.filename):
+                        value = self._run_file_with_retry(item, res)
+                else:
+                    value = self._run_file_with_retry(item, res)
+                results.append(value)
+                if hb is not None:
+                    hb.advance(files_done=1)
             except Exception as exc:
                 logger.exception("BAD FILE %s", item.filename)
                 # never quarantine the INPUT over a stage-chain error:
@@ -227,6 +270,8 @@ class Runner:
                                    stage="stage_chain",
                                    may_quarantine=False)
                 results.append(None)
+                if hb is not None:
+                    hb.advance(files_failed=1)
             finally:
                 self.timings.setdefault("ingest.compute", []).append(
                     time.perf_counter() - t0)

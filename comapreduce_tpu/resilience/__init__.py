@@ -24,9 +24,23 @@ gives the framework the same property:
   the host-side scrub bookkeeping. The destriper's CG loop carries the
   matching divergence monitor (``destriper._cg_loop``).
 - :class:`ChaosMonkey` (``chaos``) — deterministic fault injection
-  (read errors, NaN bursts, truncated files, slow reads, first-attempt
-  flakes) by seed, so every path above is exercised in CI
+  (read errors, NaN bursts, truncated files, slow reads, hangs,
+  first-attempt flakes) by seed, so every path above is exercised in CI
   (``tools/check_resilience.py``) instead of discovered in production.
+- :class:`Watchdog` (``watchdog``) — soft/hard wall-clock deadlines
+  over named operations: soft fires a structured ``stalled``
+  warning + ledger event, hard CANCELS (reads run on a disposable
+  worker thread, so a call stuck in HDF5/NFS C code is abandoned, not
+  joined forever) and raises :class:`HangError` — a new ``hang``
+  failure class that is retried like a transient and ledgered
+  ``rejected`` on exhaustion. Deadlines are static from config plus
+  adaptive from recorded stage durations (p95 x scale, floored by
+  config).
+- :class:`Heartbeat` (``heartbeat``) — atomic per-rank
+  ``heartbeat.rank{r}.json`` (stage, unit, progress counters, last
+  deadline state, monotonic + wall clocks) on a background ticker;
+  read by ``parallel.multihost``'s straggler barrier and rendered by
+  ``tools/watchdog_report.py``.
 
 Config surface: :class:`ResilienceConfig` (TOML ``[resilience]`` table,
 INI ``[Resilience]`` section) -> :meth:`ResilienceConfig.make_runtime`
@@ -49,8 +63,18 @@ from comapreduce_tpu.resilience.retry import (  # noqa: F401
     classify_error,
     retry_call,
 )
+from comapreduce_tpu.resilience.heartbeat import (  # noqa: F401
+    Heartbeat,
+    read_heartbeats,
+)
 from comapreduce_tpu.resilience.tripwires import (  # noqa: F401
     finite_fraction,
     scrub_tod,
     scrub_tod_host,
+)
+from comapreduce_tpu.resilience.watchdog import (  # noqa: F401
+    Deadline,
+    HangError,
+    Watchdog,
+    parse_deadlines,
 )

@@ -20,7 +20,15 @@ list of ``kind[@substr][:rate]`` with rate in [0, 1] (default 1);
 - ``nan_burst``  — the decoded payload's TOD gets a NaN burst in one
   feed (copy-on-poison: a shared cache payload is never mutated);
 - ``slow_read``  — the read sleeps ``slow_s`` first (exercises the
-  prefetch queue under a lagging producer).
+  prefetch queue under a lagging producer);
+- ``hang``       — the read BLOCKS (up to ``hang_s``, or until
+  :meth:`ChaosMonkey.release`) on EVERY attempt — the stuck-NFS/
+  stuck-in-C-code failure the watchdog's hard deadline exists to
+  cancel. Unlike ``slow_read`` the block outlasts any sane deadline;
+  the drill asserts the read is abandoned at the hard deadline and the
+  unit ledgered as a ``hang``. Call ``release()`` when a drill ends so
+  abandoned worker threads exit promptly instead of sleeping out
+  ``hang_s``.
 
 Whether a given file draws a given fault depends only on
 ``(seed, kind, basename)`` — stable across runs, across iteration
@@ -42,7 +50,7 @@ __all__ = ["ChaosMonkey", "parse_inject_spec", "CHAOS_KINDS"]
 logger = logging.getLogger("comapreduce_tpu")
 
 CHAOS_KINDS = ("read_error", "truncate", "flaky", "nan_burst",
-               "slow_read")
+               "slow_read", "hang")
 
 # TOD datasets a NaN burst can poison, by payload schema
 _POISON_KEYS = ("spectrometer/tod", "averaged_tod/tod",
@@ -81,15 +89,23 @@ class ChaosMonkey:
     """
 
     def __init__(self, spec: str | list, seed: int = 0,
-                 slow_s: float = 0.05, burst_frac: float = 0.05):
+                 slow_s: float = 0.05, burst_frac: float = 0.05,
+                 hang_s: float = 60.0):
         self.entries = (list(spec) if isinstance(spec, list)
                         else parse_inject_spec(spec))
         self.seed = int(seed)
         self.slow_s = float(slow_s)
         self.burst_frac = float(burst_frac)
+        self.hang_s = float(hang_s)
         self.injected: list[tuple[str, str]] = []
         self._attempts: dict[str, int] = {}
         self._lock = threading.Lock()
+        self._release = threading.Event()
+
+    def release(self) -> None:
+        """Unblock every in-flight (abandoned) ``hang`` read — drills
+        call this on exit so orphaned worker threads die promptly."""
+        self._release.set()
 
     def decide(self, filename: str) -> list:
         """Kinds that fire for this file — a pure function of
@@ -116,6 +132,13 @@ class ChaosMonkey:
 
         def chaotic(path):
             kinds = self.decide(path)
+            if "hang" in kinds:
+                # blocks EVERY attempt (a retried hang hangs again)
+                # until release() or hang_s — then falls through to the
+                # real read, so an abandoned watchdog worker finishes
+                # harmlessly (its result is discarded)
+                self._note(path, "hang")
+                self._release.wait(self.hang_s)
             if "slow_read" in kinds:
                 self._note(path, "slow_read")
                 time.sleep(self.slow_s)

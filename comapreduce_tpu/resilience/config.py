@@ -14,8 +14,10 @@ from dataclasses import dataclass, field
 
 from comapreduce_tpu.resilience.chaos import (ChaosMonkey,
                                               parse_inject_spec)
+from comapreduce_tpu.resilience.heartbeat import Heartbeat
 from comapreduce_tpu.resilience.ledger import QuarantineLedger
 from comapreduce_tpu.resilience.retry import RetryPolicy
+from comapreduce_tpu.resilience.watchdog import Watchdog, parse_deadlines
 
 __all__ = ["ResilienceConfig", "Resilience"]
 
@@ -39,6 +41,35 @@ class ResilienceConfig:
     inject / inject_seed:
         Chaos spec (``chaos.parse_inject_spec`` syntax) + seed. Empty
         spec = no injection (production default).
+    deadlines:
+        Watchdog spec, ``"name=soft/hard,*=soft/hard"`` in seconds
+        (``watchdog.parse_deadlines`` syntax). Empty (default) watches
+        nothing; operations with no entry (and no ``*``) are never
+        deadline-cancelled. Typical production value:
+        ``"ingest.read=60/300,*=120/1800"``.
+    deadline_scale / deadline_min_s:
+        Adaptive rule: once an operation has enough recorded durations
+        (``Runner.timings`` + the watchdog's own history), each
+        CONFIGURED deadline side grows to the measured estimate — hard
+        to ``max(configured hard, p95 x deadline_scale)`` — so the
+        config is a floor and adaptive budgets only ever extend it; a
+        side the config left empty is never invented, and estimates
+        below ``deadline_min_s`` are ignored (cache-hit histories must
+        not drive budgets).
+    hang_grace_s:
+        Cancellation latency allowance on top of a hard deadline (the
+        drill asserts cancels land within ``hard + grace``).
+    heartbeat_s:
+        Per-rank ``heartbeat.rank{r}.json`` ticker period (written into
+        the run's output dir next to the quarantine ledger); 0
+        disables. The ticker starts with the run (``Runner.run_tod`` /
+        the destriper CLI), not at config build.
+    straggler_timeout_s:
+        Multi-host pre-shard barrier budget: how long a rank waits for
+        every sibling's fresh heartbeat before declaring the laggards
+        dead and entering degraded mode
+        (``parallel.multihost.straggler_barrier``); 0 disables the
+        barrier.
     """
 
     quarantine: str = "auto"
@@ -49,6 +80,12 @@ class ResilienceConfig:
     retry_quarantined: bool = False
     inject: str = ""
     inject_seed: int = 0
+    deadlines: str = ""
+    deadline_scale: float = 4.0
+    deadline_min_s: float = 30.0
+    hang_grace_s: float = 0.5
+    heartbeat_s: float = 10.0
+    straggler_timeout_s: float = 120.0
 
     def __post_init__(self):
         # normalise INI-coerced values (None from 'none'/'', bools,
@@ -83,9 +120,30 @@ class ResilienceConfig:
         object.__setattr__(self, "inject", inj)
         object.__setattr__(self, "inject_seed",
                            int(self.inject_seed or 0))
+        # deadlines: rejoin INI list-coercion like inject, parse eagerly
+        # so a typo'd spec fails at config load, not mid-run
+        dl = self.deadlines
+        if isinstance(dl, (list, tuple)):
+            dl = ",".join(str(v).strip() for v in dl)
+        dl = str(dl or "")
+        parse_deadlines(dl)
+        object.__setattr__(self, "deadlines", dl)
+        object.__setattr__(self, "deadline_scale",
+                           max(float(self.deadline_scale or 0.0), 1.0))
+        object.__setattr__(self, "deadline_min_s",
+                           max(float(self.deadline_min_s or 0.0), 0.0))
+        object.__setattr__(self, "hang_grace_s",
+                           max(float(self.hang_grace_s or 0.0), 0.0))
+        object.__setattr__(self, "heartbeat_s",
+                           max(float(self.heartbeat_s or 0.0), 0.0))
+        object.__setattr__(self, "straggler_timeout_s",
+                           max(float(self.straggler_timeout_s or 0.0),
+                               0.0))
 
     KNOBS = ("quarantine", "max_retries", "retry_base_s", "retry_max_s",
-             "retry_jitter", "retry_quarantined", "inject", "inject_seed")
+             "retry_jitter", "retry_quarantined", "inject", "inject_seed",
+             "deadlines", "deadline_scale", "deadline_min_s",
+             "hang_grace_s", "heartbeat_s", "straggler_timeout_s")
 
     @classmethod
     def from_mapping(cls, mapping) -> "ResilienceConfig":
@@ -155,6 +213,18 @@ class ResilienceConfig:
                             max_s=self.retry_max_s,
                             jitter=self.retry_jitter,
                             seed=self.inject_seed)
+        heartbeat = (Heartbeat(output_dir or ".", rank=rank,
+                               period_s=self.heartbeat_s)
+                     if self.heartbeat_s > 0 else None)
+        # the watchdog exists whenever deadlines are configured; with an
+        # empty spec every name is unwatched and no supervisor threads
+        # are ever spawned, so None keeps call sites one-branch cheap
+        watchdog = (Watchdog(deadlines=parse_deadlines(self.deadlines),
+                             ledger=ledger, scale=self.deadline_scale,
+                             min_s=self.deadline_min_s,
+                             grace_s=self.hang_grace_s,
+                             heartbeat=heartbeat)
+                    if self.deadlines else None)
         chaos = (ChaosMonkey(self.inject, seed=self.inject_seed)
                  if self.inject else None)
         if chaos is not None:
@@ -168,7 +238,9 @@ class ResilienceConfig:
                 "%s — use a scratch output dir for drills",
                 self.inject, self.inject_seed, path or "<no ledger>")
         return Resilience(ledger=ledger, retry=retry, chaos=chaos,
-                          retry_quarantined=self.retry_quarantined)
+                          retry_quarantined=self.retry_quarantined,
+                          watchdog=watchdog, heartbeat=heartbeat,
+                          straggler_timeout_s=self.straggler_timeout_s)
 
 
 @dataclass
@@ -183,6 +255,9 @@ class Resilience:
     retry: RetryPolicy | None = None
     chaos: ChaosMonkey | None = None
     retry_quarantined: bool = False
+    watchdog: Watchdog | None = None
+    heartbeat: Heartbeat | None = None
+    straggler_timeout_s: float = 0.0
     _readmitted: set = field(default_factory=set)
     # quarantine snapshot, frozen at the first admit() of this runtime:
     # a file quarantined MID-run must not change which files the rest of
@@ -225,10 +300,13 @@ class Resilience:
         audit, re-attempted next run. A permanent error often encodes
         the CONFIG, not the data (a wrong ``tod_variant`` raises
         KeyError on every file); lock contention means another writer,
-        not a bad file; and callers reporting failures from OUTSIDE the
-        file's own read (``may_quarantine=False`` — e.g. a stage chain
-        whose checkpoint WRITE hit a full output disk) must never
-        durably skip the input over an environment problem."""
+        not a bad file; a ``hang`` (a deadline-cancelled read) indicts
+        the ENVIRONMENT — a stalled mount, a dying disk — so it lands
+        ``rejected`` too, never durably skipped; and callers reporting
+        failures from OUTSIDE the file's own read
+        (``may_quarantine=False`` — e.g. a stage chain whose checkpoint
+        WRITE hit a full output disk) must never durably skip the input
+        over an environment problem."""
         if self.ledger is None:
             return
         from comapreduce_tpu.resilience.retry import (classify_error,
@@ -244,6 +322,19 @@ class Resilience:
             retries=getattr(error, "_retries", 0),
             disposition="quarantined" if quarantine else "rejected",
             stage=stage, **unit)
+
+    def record_hang(self, filename: str, stage: str,
+                    message: str = "") -> None:
+        """Ledger a hang with no live exception in hand (the prefetch
+        worker that never returned, a dead rank's shard) — same
+        ``hang``/``rejected`` triage the :class:`HangError` path takes
+        through :meth:`record_failure`."""
+        if self.ledger is None:
+            return
+        self.ledger.record(
+            filename, failure_class="hang", disposition="rejected",
+            stage=stage,
+            message=message or "operation never returned (hang)")
 
     def record_recovered(self, filename: str, retries: int,
                          stage: str) -> None:

@@ -1,25 +1,32 @@
 """The chaos drill: inject every fault class, assert the promises hold.
 
 This is the executable form of the resilience layer's contract
-(ISSUE 2 acceptance criteria), run by ``tools/check_resilience.py``
-and ``bench.py --config resilience``:
+(ISSUE 2 + ISSUE 3 acceptance criteria), run by
+``tools/check_resilience.py`` and ``bench.py --config resilience``:
 
 1. a chaos run (read error + truncated file + NaN burst + slow read +
-   first-attempt flake injected over a synthetic fixture set) completes
-   with no unhandled exception;
+   first-attempt flake + HANGING read injected over a synthetic
+   fixture set) completes with no unhandled exception;
 2. every injected fault appears in the quarantine ledger with the
    correct classification (read error/truncate -> ``transient``
    quarantines, NaN burst -> ``numerical``/``masked``, flake ->
-   ``transient``/``recovered``);
+   ``transient``/``recovered``, hang -> ``hang``/``rejected`` after a
+   ``hang``/``stalled`` soft warning);
 3. the destriped map from the chaos run is byte-identical to the
-   clean run's map with the faulted units zero-weighted (dead files
-   dropped, NaN-touched samples at weight 0);
+   clean run's map with the faulted units zero-weighted (dead and
+   hung files dropped, NaN-touched samples at weight 0);
 4. a second pass consults the ledger: quarantined files are skipped
-   without a read, and ``retry_quarantined`` re-admits exactly the
-   quarantined set.
+   without a read, the HUNG file is re-attempted (rejected, not
+   quarantined — a hang indicts the environment), and
+   ``retry_quarantined`` re-admits exactly the quarantined set;
+5. the watchdog honoured its deadline budget: each hung read was
+   cancelled within ``hard + grace`` seconds (every retry gets its
+   own fresh budget), and the run never joined a stuck read.
 
 Everything is deterministic by seed (chaos decisions, jitter, synthetic
-data), so a CI failure reproduces locally bit-for-bit.
+data), so a CI failure reproduces locally bit-for-bit. (Deadline
+checks bound wall time from ABOVE only — cancels must not be late;
+nothing asserts a minimum, so fast machines stay green.)
 """
 
 from __future__ import annotations
@@ -72,15 +79,18 @@ def _solve(data):
     return solve_band(data, offset_length=50, n_iter=50, threshold=1e-5)
 
 
-def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
-              prefetch: int = 2) -> dict:
+def run_drill(workdir: str, seed: int = 0, n_files: int = 7,
+              prefetch: int = 2, hard_deadline_s: float = 0.4,
+              soft_deadline_s: float = 0.1,
+              grace_s: float = 1.0) -> dict:
     """Run the full drill in ``workdir``; returns the evidence dict.
 
     Raises ``AssertionError`` (with a named criterion) on any broken
-    promise — the CI contract is 'exit 0 means all four held'.
+    promise — the CI contract is 'exit 0 means all five held'.
     """
     from comapreduce_tpu.mapmaking.wcs import WCS
-    from comapreduce_tpu.resilience import QuarantineLedger, Resilience
+    from comapreduce_tpu.resilience import (QuarantineLedger, Resilience,
+                                            Watchdog, parse_deadlines)
     from comapreduce_tpu.resilience.chaos import ChaosMonkey
     from comapreduce_tpu.resilience.retry import RetryPolicy
 
@@ -94,17 +104,38 @@ def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
         files.append(path)
     wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
 
-    # one fault of every class, each aimed at a known file
+    # one fault of every class, each aimed at a known file; the hang
+    # blocks far past the hard deadline (abandoned workers are released
+    # in the finally below so they die promptly, not after hang_s)
     spec = ("read_error@0001,truncate@0002,flaky@0003,"
-            "nan_burst@0004,slow_read@0000")
-    monkey = ChaosMonkey(spec, seed=seed, slow_s=0.01, burst_frac=0.1)
+            "nan_burst@0004,slow_read@0000,hang@0005")
+    monkey = ChaosMonkey(spec, seed=seed, slow_s=0.01, burst_frac=0.1,
+                         hang_s=60.0)
     ledger_path = os.path.join(workdir, "quarantine.jsonl")
     if os.path.exists(ledger_path):
         os.unlink(ledger_path)
-    res = Resilience(ledger=QuarantineLedger(ledger_path),
+    ledger = QuarantineLedger(ledger_path)
+    watchdog = Watchdog(
+        deadlines=parse_deadlines(
+            f"ingest.read={soft_deadline_s}/{hard_deadline_s}"),
+        ledger=ledger, grace_s=grace_s)
+    res = Resilience(ledger=ledger,
                      retry=RetryPolicy(max_retries=1, base_s=0.0,
                                        seed=seed),
-                     chaos=monkey)
+                     chaos=monkey, watchdog=watchdog)
+
+    try:
+        return _run_drill_criteria(
+            workdir, files, wcs, res, monkey, ledger_path, watchdog,
+            hard_deadline_s, grace_s, prefetch, n_files, t0)
+    finally:
+        monkey.release()
+
+
+def _run_drill_criteria(workdir, files, wcs, res, monkey, ledger_path,
+                        watchdog, hard_deadline_s, grace_s, prefetch,
+                        n_files, t0) -> dict:
+    from comapreduce_tpu.resilience import QuarantineLedger, Resilience
 
     # -- 1. chaos run completes ------------------------------------------
     data_chaos = _read(files, wcs, resilience=res, prefetch=prefetch)
@@ -114,7 +145,8 @@ def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
         "criterion 1: chaos-run map contains non-finite pixels"
 
     dead = [files[1], files[2]]          # read_error, truncate
-    survivors = [f for f in files if f not in dead]
+    hung = [files[5]]                    # hang (cancelled, rejected)
+    survivors = [f for f in files if f not in dead and f not in hung]
     assert data_chaos.files == survivors, \
         f"criterion 1: expected survivors {survivors}, " \
         f"got {data_chaos.files}"
@@ -138,9 +170,14 @@ def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
         "criterion 2: flaky read not recorded as recovered-by-retry"
     assert _has(files[4], "numerical", "masked"), \
         "criterion 2: NaN burst not recorded as numerical/masked"
+    assert _has(files[5], "hang", "stalled"), \
+        "criterion 2: hung read fired no soft-deadline 'stalled' event"
+    assert _has(files[5], "hang", "rejected"), \
+        "criterion 2: cancelled hang not ledgered as hang/rejected " \
+        "(a hang indicts the environment — it must never quarantine)"
     injected_kinds = {k for _, k in monkey.injected}
     assert injected_kinds >= {"read_error", "truncate", "flaky",
-                              "nan_burst", "slow_read"}, \
+                              "nan_burst", "slow_read", "hang"}, \
         f"chaos harness fired only {sorted(injected_kinds)}"
 
     # -- 3. chaos map == clean map with faulted units zero-weighted -----
@@ -181,10 +218,13 @@ def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
         "zero-weighted"
 
     # -- 4. resume consults the ledger; retry_quarantined re-admits -----
+    # the HUNG file is rejected, not quarantined: resume re-attempts it
+    expected_admit = [f for f in files if f not in dead]
     res2 = Resilience(ledger=QuarantineLedger(ledger_path))
     admitted = [f for f in files if res2.admit(f)]
-    assert admitted == survivors, \
-        f"criterion 4: resume admitted {admitted}, expected {survivors}"
+    assert admitted == expected_admit, \
+        f"criterion 4: resume admitted {admitted}, " \
+        f"expected {expected_admit}"
     res3 = Resilience(ledger=QuarantineLedger(ledger_path),
                       retry_quarantined=True)
     readmitted = [f for f in files if res3.admit(f)]
@@ -196,6 +236,19 @@ def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
         f"criterion 4: re-admitted {sorted(res3._readmitted)}, " \
         f"expected {sorted(dead)}"
 
+    # -- 5. deadline budget honoured -------------------------------------
+    # every cancelled attempt must land within hard + grace of its own
+    # start (the watchdog's audit trail records per-event elapsed); one
+    # event per attempt (retry = a fresh budget, so 2 with max_retries=1)
+    hangs = [e for e in watchdog.events if e[0] == "hang"]
+    assert len(hangs) == 2, \
+        f"criterion 5: expected 2 cancelled hang attempts (1 retry), " \
+        f"saw {len(hangs)}: {hangs}"
+    late = [e for e in hangs if e[3] > hard_deadline_s + grace_s]
+    assert not late, \
+        f"criterion 5: cancel latency exceeded hard deadline " \
+        f"{hard_deadline_s} s + grace {grace_s} s: {late}"
+
     return {
         "n_files": n_files,
         "injected": sorted({(os.path.basename(f), k)
@@ -206,5 +259,9 @@ def run_drill(workdir: str, seed: int = 0, n_files: int = 6,
         "n_masked_samples": n_masked,
         "map_byte_identical": bool(identical),
         "cg_iters_chaos": int(result_chaos.n_iter),
+        "hang_cancel_s": [round(e[3], 4) for e in hangs],
+        "hard_deadline_s": hard_deadline_s,
+        "hang_grace_s": grace_s,
+        "watchdog_events": [list(e) for e in watchdog.events][:50],
         "wall_s": round(time.perf_counter() - t0, 3),
     }

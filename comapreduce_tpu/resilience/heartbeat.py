@@ -1,0 +1,253 @@
+"""Per-rank heartbeat files: liveness + progress for multi-host runs.
+
+Each rank writes ``heartbeat.rank{r}.json`` into the run's output
+directory — atomically (tmp + ``os.replace``), so a reader never sees a
+torn file — carrying what an operator (or a sibling rank's straggler
+detector, ``parallel.multihost``) needs to tell a slow rank from a dead
+one:
+
+```json
+{"rank": 0, "pid": 12345, "host": "vm", "seq": 42,
+ "stage": "ingest.read", "unit": "/data/comap-0001.hd5",
+ "progress": {"files_done": 3, "files_failed": 1},
+ "deadline": {"name": "ingest.read", "state": "stalled",
+              "elapsed_s": 31.2},
+ "t_wall": "2026-08-04T07:00:00Z", "t_wall_unix": 1785913200.0,
+ "t_mono": 12345.6}
+```
+
+``seq`` increments on every write (progress is "seq advanced", even
+when the wall clock of two hosts disagrees); ``t_mono`` is the writer's
+monotonic clock (meaningful only within one host — stale-ness across
+hosts is judged by ``t_wall_unix``/file mtime); ``deadline`` mirrors
+the watchdog's last event for the rank so a stall is visible without
+grepping logs. Writes are advisory and NOT fsynced — a lost heartbeat
+costs one tick, never data.
+
+A background ticker (:meth:`Heartbeat.start`) rewrites the file every
+``period_s`` even when the rank is stuck inside one long operation —
+that is exactly when liveness information matters most; the watchdog
+additionally :meth:`note`\\ s stage transitions and deadline events
+through immediately. ``tools/watchdog_report.py`` renders these files
+plus the quarantine ledger into the operator stall report.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import logging
+import os
+import re
+import socket
+import tempfile
+import threading
+import time
+
+__all__ = ["Heartbeat", "heartbeat_age_s", "heartbeat_path",
+           "read_heartbeats"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_NAME_RE = re.compile(r"heartbeat\.rank(\d+)\.json$")
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory or ".", f"heartbeat.rank{rank}.json")
+
+
+def read_heartbeats(directory: str) -> dict:
+    """``{rank: parsed_heartbeat}`` for every readable
+    ``heartbeat.rank*.json`` in ``directory``. A torn/foreign file is
+    skipped with a warning, never fatal (the writer replaces
+    atomically, but NFS caching or a partial copy can still serve
+    garbage). Each entry gains ``_mtime`` (the file's mtime) for
+    local-clock staleness checks."""
+    out: dict[int, dict] = {}
+    for path in sorted(_glob.glob(
+            os.path.join(directory or ".", "heartbeat.rank*.json"))):
+        m = _NAME_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                hb = json.load(f)
+            hb["_mtime"] = os.stat(path).st_mtime
+        except (OSError, ValueError) as exc:
+            logger.warning("unreadable heartbeat %s (%s: %s)", path,
+                           type(exc).__name__, exc)
+            continue
+        out[int(m.group(1))] = hb
+    return out
+
+
+def heartbeat_age_s(hb: dict, now: float | None = None) -> float:
+    """Heartbeat age against the local clock: the freshest NON-NEGATIVE
+    of the wall timestamp inside the file and the file's own mtime (two
+    hosts' wall clocks may disagree; mtime is assigned by the
+    filesystem). A timestamp in the FUTURE is no evidence of life — a
+    dead rank whose clock ran ahead must not read fresh for the whole
+    skew window — so when every component is in the future the
+    (negative) age is returned as-is for the caller's out-of-range
+    test. ONE home for the rule: ``tools/watchdog_report`` staleness
+    and any freshness heuristic must not drift apart."""
+    now = time.time() if now is None else now
+    ages = [now - float(hb.get("t_wall_unix", 0.0)),
+            now - float(hb.get("_mtime", 0.0))]
+    valid = [a for a in ages if a >= 0.0]
+    return min(valid) if valid else min(ages)
+
+
+class Heartbeat:
+    """Atomic per-rank heartbeat writer with a background ticker.
+
+    Thread-safe: the ticker, the watchdog (from prefetcher worker
+    threads) and the consumer all write through one lock. ``start`` /
+    ``stop`` are idempotent and re-startable (``run_tod`` followed by
+    ``run_astro_cal`` reuses one instance).
+    """
+
+    def __init__(self, directory: str, rank: int = 0,
+                 period_s: float = 10.0, clock=time.monotonic):
+        self.directory = directory or "."
+        self.rank = int(rank)
+        self.path = heartbeat_path(directory, rank)
+        self.period_s = float(period_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        # commit order lock: snapshot + tmp-write + replace must be one
+        # unit, or two racing writers can land their replaces out of
+        # order and seq would REGRESS on disk — the straggler barrier's
+        # seq-advance liveness check must never see a healthy rank go
+        # backwards. (_lock alone guards state and is never held across
+        # I/O; heartbeat payloads are ~300 B, so holding _io_lock
+        # through the write is cheap.)
+        self._io_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._state = {
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "seq": 0,
+            "stage": "",
+            "unit": "",
+            "progress": {},
+            "deadline": None,
+        }
+
+    # -- state --------------------------------------------------------------
+    def _publish(self) -> None:
+        """Get the updated state onto disk WITHOUT blocking the caller
+        on heartbeat I/O when the ticker runs: the watchdog calls
+        :meth:`note` from the very paths it supervises, and a stalled
+        output mount must not wedge the hang supervisor inside its own
+        liveness write — the ticker thread (whose whole job is this
+        I/O) is woken to write instead. With no live ticker (period 0,
+        or not started) the write happens inline."""
+        if self._thread is not None and self._thread.is_alive():
+            self._wake.set()
+        else:
+            self.write()
+
+    def note(self, stage: str | None = None, unit: str | None = None,
+             deadline: dict | None = None) -> None:
+        """Update the current position (and/or last deadline event) and
+        publish (see :meth:`_publish`)."""
+        with self._lock:
+            if stage is not None:
+                self._state["stage"] = stage
+            if unit is not None:
+                self._state["unit"] = unit
+            if deadline is not None:
+                self._state["deadline"] = dict(deadline)
+        self._publish()
+
+    def advance(self, **counters) -> None:
+        """Increment progress counters (``files_done=1, ...``) and
+        publish."""
+        with self._lock:
+            prog = self._state["progress"]
+            for k, v in counters.items():
+                prog[k] = prog.get(k, 0) + int(v)
+        self._publish()
+
+    # -- persistence --------------------------------------------------------
+    def write(self) -> None:
+        """One atomic heartbeat write (never torn; advisory, so I/O
+        failures are logged and swallowed — a full disk must not kill
+        the run through its liveness channel). Commits are serialised
+        (see ``_io_lock``) so ``seq`` on disk is monotonic."""
+        with self._io_lock:
+            with self._lock:
+                self._state["seq"] += 1
+                snap = dict(self._state,
+                            progress=dict(self._state["progress"]),
+                            t_mono=self.clock(),
+                            t_wall_unix=time.time(),
+                            t_wall=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime()))
+            try:
+                os.makedirs(self.directory, exist_ok=True)
+                fd, tmp = tempfile.mkstemp(
+                    prefix=".heartbeat.", suffix=".tmp",
+                    dir=self.directory)
+                try:
+                    with os.fdopen(fd, "w", encoding="utf-8") as f:
+                        json.dump(snap, f)
+                    os.replace(tmp, self.path)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
+            except OSError as exc:
+                logger.warning("heartbeat write failed (%s: %s)",
+                               type(exc).__name__, exc)
+
+    # -- ticker -------------------------------------------------------------
+    def start(self) -> "Heartbeat":
+        """Start (or restart) the background ticker; writes one beat
+        immediately so the file exists before any barrier reads it."""
+        if self.period_s <= 0:
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._wake.clear()
+        self.write()
+        self._thread = threading.Thread(
+            target=self._tick, name=f"heartbeat.rank{self.rank}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _tick(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.period_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                break  # stop() writes the final beat itself
+            self.write()
+
+    def stop(self, final_stage: str = "") -> None:
+        """Stop the ticker and write one final beat (so the last state
+        on disk says where the rank ended, not where the ticker
+        happened to catch it)."""
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self.period_s, 1.0))
+            self._thread = None
+        if final_stage:
+            with self._lock:
+                self._state["stage"] = final_stage
+        self.write()
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -11,7 +11,7 @@ Entry schema (one JSON object per line)::
 
     {"t": "2026-08-04T07:00:00Z",      # UTC timestamp
      "unit": {"file": "...", "feed": null, "band": null, "scan": null},
-     "failure_class": "transient" | "permanent" | "numerical",
+     "failure_class": "transient" | "permanent" | "numerical" | "hang",
      "error": "OSError",               # exception type name ('' if n/a)
      "message": "...",                 # str(exc), truncated
      "digest": "1f2e3d4c5b6a",         # sha1 of the traceback, 12 hex
@@ -29,7 +29,11 @@ unit still flows; never skipped); ``rejected`` — the unit failed this
 run but is re-attempted on the next one (never skipped: used for
 failures that may be config-dependent — a ``KeyError`` from a wrong
 ``tod_variant`` must not poison the ledger against the corrected
-re-run — and for lock contention, where the file itself is fine).
+re-run — for lock contention, where the file itself is fine, and for
+``hang``-class failures, which indict the environment — an NFS mount,
+a dead rank — rather than the data); ``stalled`` — a watchdog soft
+deadline fired mid-operation (informational; never skipped — the
+operation itself may still have succeeded).
 """
 
 from __future__ import annotations

@@ -49,11 +49,20 @@ def is_lock_error(exc: BaseException) -> bool:
 
 
 def classify_error(exc: BaseException) -> str:
-    """``"transient"`` (worth retrying) or ``"permanent"`` (not).
+    """``"transient"`` (worth retrying), ``"hang"`` (a cancelled
+    deadline — retried like a transient, but ledgered ``rejected``
+    rather than quarantined: a hang indicts the environment, not the
+    file) or ``"permanent"`` (never retried).
 
+    ``HangError`` subclasses ``OSError`` so existing per-file nets
+    catch it; it must therefore be checked BEFORE the transient class.
     Unknown exception types classify permanent: retrying a failure mode
     nobody has triaged just delays the quarantine entry that gets it
     triaged."""
+    from comapreduce_tpu.resilience.watchdog import HangError
+
+    if isinstance(exc, HangError):
+        return "hang"
     if isinstance(exc, TRANSIENT_ERRORS):
         return "transient"
     if isinstance(exc, PERMANENT_ERRORS):
@@ -89,7 +98,9 @@ def retry_call(fn, policy: RetryPolicy | None = None, key: str = "",
                label: str = ""):
     """Call ``fn()`` under ``policy``; returns ``(result, retries)``.
 
-    Retries only failures ``classify`` deems transient. When attempts
+    Retries only failures ``classify`` deems ``transient`` or ``hang``
+    (a cancelled deadline may be a recovered NFS server — each retry
+    gets a fresh deadline of its own). When attempts
     run out (or the failure is permanent) the ORIGINAL exception
     propagates, annotated with ``_retries`` (attempts burned) and
     ``_failure_class`` so the caller's ledger entry can report both
@@ -110,12 +121,13 @@ def retry_call(fn, policy: RetryPolicy | None = None, key: str = "",
             kind = classify(exc)
             exc._retries = attempt          # type: ignore[attr-defined]
             exc._failure_class = kind       # type: ignore[attr-defined]
-            if kind != "transient" or attempt >= policy.max_retries:
+            if kind not in ("transient", "hang") \
+                    or attempt >= policy.max_retries:
                 raise
             attempt += 1
             d = policy.delay_s(attempt, key=key)
-            logger.warning("%s: transient %s (%s); retry %d/%d in %.2f s",
-                           label or key or "retry_call",
+            logger.warning("%s: %s %s (%s); retry %d/%d in %.2f s",
+                           label or key or "retry_call", kind,
                            type(exc).__name__, exc, attempt,
                            policy.max_retries, d)
             if d > 0 and sleep(d):

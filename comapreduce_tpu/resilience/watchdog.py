@@ -1,0 +1,406 @@
+"""Watchdog: soft/hard wall-clock deadlines over named operations.
+
+PR 2's resilience layer handles operations that *fail*; nothing in the
+pipeline handled operations that *hang* — a stalled NFS read, a loader
+stuck inside the HDF5 C library, one slow rank holding a whole
+multi-host campaign. Production map-making frameworks treat wall-clock
+budgets and per-rank progress as first-class operational signals
+(MAPPRAISER, arXiv:2112.03370; COMAP ES III, arXiv:2111.05929); this
+module is that signal source.
+
+Two supervision modes, one deadline table:
+
+- :meth:`Watchdog.call` — run ``fn`` on a disposable worker thread.
+  At the **soft** deadline a structured ``stalled`` warning is logged
+  and ledgered (the unit stays live); at the **hard** deadline the
+  operation is CANCELLED: the worker thread is abandoned (a thread
+  stuck in C code cannot be killed, but it can be orphaned — it is a
+  daemon and its eventual result is discarded) and :class:`HangError`
+  is raised to the caller. Use for reads and anything else whose
+  side effects tolerate abandonment.
+- :meth:`Watchdog.watch` — a context manager that monitors a block it
+  cannot cancel (a jitted CG solve, a stage chain driving device
+  compute). The soft deadline warns + ledgers identically; the hard
+  deadline sets ``WatchState.hard_expired`` so the caller can route
+  the late result through an operator signal path (the destriper
+  treats it like a tripped divergence monitor: warn, never silent).
+
+Deadlines come from two sources, merged per name:
+
+- **static** — the ``[resilience] deadlines`` spec
+  (``"name=soft/hard,*=soft/hard"``, seconds; either side may be
+  empty). A name with no static entry (and no ``*`` default) is
+  UNWATCHED — the watchdog never invents a deadline for an operation
+  nobody budgeted.
+- **adaptive** — once an operation has ``history_min`` recorded
+  durations (the watchdog's own completions plus any external
+  ``timings`` dict, e.g. ``Runner.timings``), each CONFIGURED side
+  grows to the measured estimate: hard becomes
+  ``max(static hard, p95 × scale)``, soft
+  ``max(static soft, p95 × scale / 2)``. Adaptive deadlines only
+  ever *extend* budgets the config set (a soft-only spec never grows
+  a hard deadline — measurement must not overrule a never-cancel
+  decision), and estimates below ``min_s`` are ignored outright (a
+  history of near-zero cache hits must not drive budgets). A
+  genuinely slow stage earns a longer leash; a tight static budget
+  on a fast machine never produces false cancellations.
+
+``HangError`` is a new failure class ``"hang"`` in the retry/ledger
+triage: hangs are retried like transients (the NFS server may come
+back) but on exhaustion they are ledgered ``rejected`` — re-attempted
+next run, never durably quarantined, because a hang indicts the
+ENVIRONMENT (a mount, a rank, a disk), not the file.
+
+Everything here is host-side wall clock; nothing touches jit.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+__all__ = ["Deadline", "HangError", "WatchState", "Watchdog",
+           "parse_deadlines", "percentile"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+# durations remembered per operation name for the adaptive percentile;
+# bounded so a campaign-length run cannot grow without limit
+_HISTORY_CAP = 512
+
+
+class HangError(OSError):
+    """An operation exceeded its hard deadline and was cancelled.
+
+    Subclasses ``OSError`` so every existing per-file I/O net
+    (``except (OSError, KeyError)``) catches it, but
+    ``retry.classify_error`` checks this type FIRST and classifies it
+    ``"hang"`` — retried like a transient, ledgered ``rejected`` (not
+    quarantined) when retries run out.
+    """
+
+    def __init__(self, op: str, unit: str, hard_s: float,
+                 elapsed_s: float):
+        super().__init__(
+            f"{op}: {unit or '<anonymous>'} exceeded its hard deadline "
+            f"({elapsed_s:.2f} s > {hard_s:.2f} s); operation cancelled")
+        self.op = op
+        self.unit = unit
+        self.hard_s = float(hard_s)
+        self.elapsed_s = float(elapsed_s)
+
+
+@dataclass(frozen=True)
+class Deadline:
+    """Soft/hard wall budget for one operation name (``None`` = no
+    limit on that side)."""
+
+    soft_s: float | None = None
+    hard_s: float | None = None
+
+    def __post_init__(self):
+        for name in ("soft_s", "hard_s"):
+            v = getattr(self, name)
+            if v is not None and not v > 0:
+                raise ValueError(f"deadline {name} must be > 0, got {v}")
+        if self.soft_s is not None and self.hard_s is not None \
+                and self.hard_s < self.soft_s:
+            raise ValueError(
+                f"hard deadline ({self.hard_s}) must be >= soft "
+                f"({self.soft_s})")
+
+
+def parse_deadlines(spec: str) -> dict:
+    """``"ingest.read=30/120,stage=60/,*=/600"`` ->
+    ``{name: Deadline}``. ``soft/hard`` in seconds; either side may be
+    empty (no limit on that side); a bare number is the hard deadline.
+    ``*`` is the default for any watched-by-name lookup that has no
+    exact entry. Empty spec -> ``{}``. Malformed entries raise (config
+    load is the place to find a typo, not mid-run)."""
+    out: dict[str, Deadline] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, budget = part.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(f"deadline entry {part!r} is not "
+                             "'name=soft/hard'")
+        soft_s, sep2, hard_s = budget.partition("/")
+        if not sep2:          # bare number = hard deadline
+            soft_s, hard_s = "", soft_s
+        soft = float(soft_s) if soft_s.strip() else None
+        hard = float(hard_s) if hard_s.strip() else None
+        if soft is None and hard is None:
+            raise ValueError(f"deadline entry {part!r} sets neither a "
+                             "soft nor a hard budget")
+        out[name] = Deadline(soft_s=soft, hard_s=hard)
+    return out
+
+
+def percentile(samples, q: float) -> float:
+    """Plain nearest-rank percentile (no numpy: this runs on the read
+    hot path's supervision side)."""
+    xs = sorted(float(s) for s in samples)
+    if not xs:
+        raise ValueError("percentile of no samples")
+    k = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+@dataclass
+class WatchState:
+    """Live state of one supervised operation (yielded by
+    :meth:`Watchdog.watch`, recorded into :attr:`Watchdog.events`)."""
+
+    name: str
+    unit: str = ""
+    soft_s: float | None = None
+    hard_s: float | None = None
+    stalled: bool = False        # soft deadline fired
+    hard_expired: bool = False   # hard deadline fired (uncancellable op)
+    elapsed_s: float = 0.0
+    _done: threading.Event = field(default_factory=threading.Event,
+                                   repr=False)
+
+
+class Watchdog:
+    """Deadline supervisor for named operations.
+
+    Parameters
+    ----------
+    deadlines:
+        ``{name: Deadline}`` static table (see :func:`parse_deadlines`).
+        Names without an entry (and no ``"*"`` default) are unwatched.
+    ledger:
+        Optional :class:`~comapreduce_tpu.resilience.ledger
+        .QuarantineLedger`; soft stalls are recorded as
+        ``hang``/``stalled`` events (informational — never skipped).
+    timings:
+        Optional external ``{name: [seconds]}`` durations dict
+        (``Runner.timings``) folded into the adaptive percentile.
+    scale / min_s / history_min:
+        Adaptive rule: with ``history_min`` samples for a name, hard
+        becomes ``max(p95 × scale, static hard or min_s)`` and soft
+        ``max(p95 × scale/2, static soft)``. Config is a floor —
+        adaptive only extends, never tightens.
+    grace_s:
+        Cancellation latency allowance on top of the hard deadline —
+        the drill/CI contract asserts cancels land within
+        ``hard + grace``.
+
+    ``events`` is the audit trail: ``(kind, name, unit, elapsed_s)``
+    with kind in ``stalled`` / ``hang`` / ``hard_expired``. Thread-safe
+    (reads run on prefetcher worker threads).
+    """
+
+    def __init__(self, deadlines: dict | None = None, ledger=None,
+                 timings: dict | None = None, scale: float = 4.0,
+                 min_s: float = 30.0, grace_s: float = 0.5,
+                 history_min: int = 8, heartbeat=None,
+                 clock=time.monotonic):
+        self.static = dict(deadlines or {})
+        self.ledger = ledger
+        self.timings = timings if timings is not None else {}
+        self.scale = float(scale)
+        self.min_s = float(min_s)
+        self.grace_s = float(grace_s)
+        self.history_min = int(history_min)
+        self.heartbeat = heartbeat
+        self.clock = clock
+        self.history: dict[str, list] = {}
+        self.events: list[tuple] = []
+        self._lock = threading.Lock()
+
+    # -- deadline resolution ------------------------------------------------
+    def record(self, name: str, elapsed_s: float) -> None:
+        """Remember a completed operation's duration (adaptive input)."""
+        with self._lock:
+            hist = self.history.setdefault(name, [])
+            hist.append(float(elapsed_s))
+            if len(hist) > _HISTORY_CAP:
+                del hist[: len(hist) - _HISTORY_CAP]
+
+    def _samples(self, name: str) -> list:
+        with self._lock:
+            own = list(self.history.get(name, ()))
+        try:
+            ext = list(self.timings.get(name, ()))
+        except AttributeError:
+            ext = []
+        return own + [float(v) for v in ext]
+
+    def deadline_for(self, name: str) -> Deadline | None:
+        """The effective deadline for ``name`` right now (static merged
+        with adaptive; ``None`` = unwatched).
+
+        Adaptive budgets only ever EXTEND sides the config budgeted: a
+        soft-only spec (``name=60/``) never grows a hard deadline — the
+        operator said never-cancel, and measurement must not overrule
+        that — and a hard-only spec never grows a soft one. Adaptive
+        estimates below ``min_s`` are ignored entirely (a history of
+        near-zero cache-hit reads must not drive budgets)."""
+        static = self.static.get(name) or self.static.get("*")
+        if static is None:
+            return None
+        soft, hard = static.soft_s, static.hard_s
+        samples = self._samples(name)
+        if len(samples) >= self.history_min:
+            estimate = percentile(samples, 95.0) * self.scale
+            if estimate >= self.min_s:
+                if hard is not None:
+                    hard = max(hard, estimate)
+                if soft is not None:
+                    soft = max(soft, estimate / 2.0)
+        return Deadline(soft_s=soft, hard_s=hard)
+
+    # -- event plumbing -----------------------------------------------------
+    def _event(self, kind: str, name: str, unit: str,
+               elapsed_s: float) -> None:
+        with self._lock:
+            self.events.append((kind, name, unit, round(elapsed_s, 4)))
+        if self.heartbeat is not None:
+            try:
+                self.heartbeat.note(deadline={
+                    "name": name, "state": kind,
+                    "elapsed_s": round(elapsed_s, 3)})
+            except Exception:  # pragma: no cover - advisory only
+                logger.exception("heartbeat note failed")
+
+    def _stall(self, st: WatchState) -> None:
+        st.stalled = True
+        elapsed = self.clock() - st._t0
+        logger.warning(
+            "watchdog: %s (%s) STALLED: %.2f s elapsed > soft deadline "
+            "%.2f s (hard %s)", st.name, st.unit or "<anonymous>",
+            elapsed, st.soft_s,
+            f"{st.hard_s:.2f} s" if st.hard_s else "none")
+        self._event("stalled", st.name, st.unit, elapsed)
+        if self.ledger is not None:
+            self.ledger.record(
+                st.unit or st.name, failure_class="hang",
+                disposition="stalled", stage=st.name,
+                message=f"stalled {elapsed:.2f} s > soft "
+                        f"{st.soft_s:.2f} s")
+
+    def _begin(self, name: str, unit: str) -> None:
+        if self.heartbeat is not None:
+            try:
+                self.heartbeat.note(stage=name, unit=unit)
+            except Exception:  # pragma: no cover - advisory only
+                logger.exception("heartbeat note failed")
+
+    # -- supervision --------------------------------------------------------
+    @contextmanager
+    def watch(self, name: str, unit: str = ""):
+        """Monitor a block this thread runs itself (UNCANCELLABLE: a
+        jitted solve, a stage chain). Soft -> stall warning + ledger;
+        hard -> ``WatchState.hard_expired`` for the caller to act on.
+        Completed durations feed the adaptive history."""
+        dl = self.deadline_for(name)
+        st = WatchState(name=name, unit=unit,
+                        soft_s=dl.soft_s if dl else None,
+                        hard_s=dl.hard_s if dl else None)
+        st._t0 = self.clock()
+        self._begin(name, unit)
+        monitor = None
+        if st.soft_s is not None or st.hard_s is not None:
+            monitor = threading.Thread(
+                target=self._monitor, args=(st,),
+                name=f"watchdog:{name}", daemon=True)
+            monitor.start()
+        try:
+            yield st
+        finally:
+            st.elapsed_s = self.clock() - st._t0
+            st._done.set()
+            if monitor is not None:
+                monitor.join(timeout=1.0)
+            if not st.hard_expired:
+                self.record(name, st.elapsed_s)
+
+    def _monitor(self, st: WatchState) -> None:
+        if st.soft_s is not None:
+            if st._done.wait(timeout=st.soft_s):
+                return
+            self._stall(st)
+        if st.hard_s is None:
+            return
+        remaining = st.hard_s - (self.clock() - st._t0)
+        if remaining > 0 and st._done.wait(timeout=remaining):
+            return
+        if st._done.is_set():
+            return
+        st.hard_expired = True
+        elapsed = self.clock() - st._t0
+        logger.error(
+            "watchdog: %s (%s) exceeded its HARD deadline (%.2f s > "
+            "%.2f s) and cannot be cancelled in place; flagging for the "
+            "caller", st.name, st.unit or "<anonymous>", elapsed,
+            st.hard_s)
+        self._event("hard_expired", st.name, st.unit, elapsed)
+
+    def call(self, fn, name: str, unit: str = "", args: tuple = ()):
+        """Run ``fn(*args)`` under ``name``'s deadline, CANCELLABLY.
+
+        With a hard deadline the call runs on a disposable daemon
+        worker; past the deadline the worker is abandoned (its eventual
+        result/exception is discarded) and :class:`HangError` raises —
+        a stuck HDF5/NFS read in C code is orphaned, not joined
+        forever. Unwatched names call straight through (no thread).
+        Soft-only names run inline under :meth:`watch`.
+        """
+        dl = self.deadline_for(name)
+        if dl is None:
+            return fn(*args)
+        if dl.hard_s is None:
+            with self.watch(name, unit=unit):
+                return fn(*args)
+        st = WatchState(name=name, unit=unit, soft_s=dl.soft_s,
+                        hard_s=dl.hard_s)
+        st._t0 = self.clock()
+        self._begin(name, unit)
+        box: dict = {}
+        done = threading.Event()
+        abandoned = threading.Event()
+
+        def run():
+            try:
+                box["value"] = fn(*args)
+            except BaseException as exc:  # noqa: BLE001 — relayed below
+                box["error"] = exc
+                if abandoned.is_set():
+                    # nobody will re-raise this; keep the log trail
+                    logger.warning(
+                        "watchdog: abandoned %s worker for %s finally "
+                        "failed: %s: %s", name, unit or "<anonymous>",
+                        type(exc).__name__, exc)
+            finally:
+                done.set()
+
+        worker = threading.Thread(target=run, daemon=True,
+                                  name=f"watchdog-call:{name}")
+        worker.start()
+        budget = dl.hard_s
+        if dl.soft_s is not None:
+            if not done.wait(timeout=dl.soft_s):
+                self._stall(st)
+            budget = dl.hard_s - (self.clock() - st._t0)
+        if not done.wait(timeout=max(budget, 0.0)):
+            abandoned.set()
+            elapsed = self.clock() - st._t0
+            self._event("hang", name, unit, elapsed)
+            logger.error(
+                "watchdog: %s (%s) HUNG: %.2f s > hard deadline %.2f s; "
+                "abandoning the worker thread and cancelling the "
+                "operation", name, unit or "<anonymous>", elapsed,
+                dl.hard_s)
+            raise HangError(name, unit, dl.hard_s, elapsed)
+        if "error" in box:
+            raise box["error"]
+        self.record(name, self.clock() - st._t0)
+        return box["value"]

@@ -330,7 +330,11 @@ min_duration_seconds = 1.0
          str(cfg)], env=env, capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stderr[-2000:]
     produced = sorted(os.listdir(outdir))
-    assert len(produced) == 2, produced
+    level2 = [p for p in produced if p.startswith("Level2_")]
+    assert len(level2) == 2, produced
+    # each rank also beats its own liveness file (ISSUE 3)
+    assert [p for p in produced if p.startswith("heartbeat.rank")] == \
+        ["heartbeat.rank0.json", "heartbeat.rank1.json"]
 
 
 def test_make_band_map_sharded_matches_single(field_dataset):

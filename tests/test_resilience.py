@@ -687,6 +687,12 @@ def test_full_chaos_drill(tmp_path):
     assert evidence["ledger_summary"]["transient:quarantined"] == 2
     assert evidence["ledger_summary"]["numerical:masked"] == 1
     assert evidence["ledger_summary"]["transient:recovered"] == 1
+    assert evidence["ledger_summary"]["hang:rejected"] == 1
     kinds = {k for _, k in evidence["injected"]}
     assert kinds == {"read_error", "truncate", "flaky", "nan_burst",
-                     "slow_read"}
+                     "slow_read", "hang"}
+    # the watchdog contract rides in the same drill: both hang attempts
+    # (first try + one retry) were cancelled within hard + grace
+    assert len(evidence["hang_cancel_s"]) == 2
+    budget = evidence["hard_deadline_s"] + evidence["hang_grace_s"]
+    assert all(dt <= budget for dt in evidence["hang_cancel_s"])

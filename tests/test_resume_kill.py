@@ -102,7 +102,8 @@ def test_kill_mid_run_then_resume(tmp_path):
     assert p.returncode != 0  # it really died
 
     # the checkpoint holds the completed vane group but no reduction
-    (l2name,) = os.listdir(outdir)
+    # (the run also beats heartbeat.rank0.json next to it — ISSUE 3)
+    (l2name,) = [f for f in os.listdir(outdir) if f.startswith("Level2_")]
     lvl2 = COMAPLevel2(filename=os.path.join(outdir, l2name))
     assert "vane" in lvl2.groups
     assert "averaged_tod" not in lvl2.groups
@@ -118,7 +119,7 @@ def test_kill_mid_run_then_resume(tmp_path):
     assert "MeasureSystemTemperature" not in ran, ran
     assert "Level1AveragingGainCorrection" in ran, ran
 
-    (l2name,) = os.listdir(outdir)
+    (l2name,) = [f for f in os.listdir(outdir) if f.startswith("Level2_")]
     lvl2 = COMAPLevel2(filename=os.path.join(outdir, l2name))
     for group in ("spectrometer", "vane", "averaged_tod", "fnoise_fits"):
         assert group in lvl2.groups, (group, lvl2.groups)

@@ -6,14 +6,18 @@ Usage::
     python tools/check_resilience.py [--workdir DIR] [--seed N] [--keep]
 
 Injects one fault of every class (read error, truncated file,
-first-attempt flake, NaN burst, slow read) over a synthetic Level-2
-fixture set and asserts the resilience layer's contract
-(``comapreduce_tpu/resilience/drill.py``): zero unhandled exceptions,
-every fault ledgered with the correct classification, the destriped map
+first-attempt flake, NaN burst, slow read, HANGING read) over a
+synthetic Level-2 fixture set and asserts the resilience layer's
+contract (``comapreduce_tpu/resilience/drill.py``): zero unhandled
+exceptions, every fault ledgered with the correct classification
+(including the hung read: soft-deadline ``stalled`` warning, then
+hard-deadline cancel triaged ``hang``/``rejected``), the destriped map
 byte-identical to the clean run with the faulted units zero-weighted,
-and quarantine skip/re-admit behaving across runs. Prints one JSON
-evidence line; non-zero exit (with the broken criterion named) on any
-failure. Also wired into CI as ``bench.py --config resilience``.
+quarantine skip/re-admit behaving across runs, and every cancelled
+hang landing within ``hard deadline + grace`` — the watchdog contract
+is exercised on every run. Prints one JSON evidence line; non-zero
+exit (with the broken criterion named) on any failure. Also wired into
+CI as ``bench.py --config resilience``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Operator stall report: render heartbeats + quarantine ledger.
+
+Usage::
+
+    python tools/watchdog_report.py OUTPUT_DIR [--stale-s 60]
+                                    [--n-ranks N] [--json]
+
+Reads every ``heartbeat.rank*.json`` and ``quarantine*.jsonl`` in the
+run's output directory and answers the on-call questions in one
+screen: which ranks are alive, where each one is (stage/unit/progress
+counters), how stale each heartbeat is, which operations stalled or
+hung, and which units the run deferred (``rejected``) or durably
+skipped (``quarantined``).
+
+Exit code: 0 when every expected rank's heartbeat is fresher than
+``--stale-s``; 1 when any rank is stale/missing (so the report doubles
+as a liveness probe in cron/CI). ``--n-ranks`` sets the expected rank
+count (default: the ranks that have heartbeat files — a fully dead
+rank that never wrote one can only be caught with an explicit count).
+
+The runbook lives in docs/OPERATIONS.md ("Hangs, deadlines &
+heartbeats").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_report(output_dir: str, stale_s: float = 60.0,
+                 n_ranks: int = 0) -> dict:
+    """The report as data (rendering and exit policy live in main)."""
+    from comapreduce_tpu.resilience.heartbeat import (heartbeat_age_s,
+                                                      read_heartbeats)
+    from comapreduce_tpu.resilience.ledger import QuarantineLedger
+
+    now = time.time()
+    beats = read_heartbeats(output_dir)
+    expected = range(n_ranks) if n_ranks > 0 else sorted(beats)
+    ranks = []
+    for r in expected:
+        hb = beats.get(r)
+        if hb is None:
+            ranks.append({"rank": r, "present": False, "stale": True})
+            continue
+        age = heartbeat_age_s(hb, now)
+        ranks.append({
+            "rank": r, "present": True,
+            "age_s": round(age, 1),
+            # out-of-range on EITHER side is stale: too old is dead,
+            # and a negative age (future clock) is a skewed host with
+            # no live evidence — exit-1 material for the cron probe
+            "stale": not 0.0 <= age <= stale_s,
+            "stage": hb.get("stage", ""),
+            "unit": hb.get("unit", ""),
+            "seq": hb.get("seq", 0),
+            "pid": hb.get("pid"),
+            "host": hb.get("host", ""),
+            "progress": hb.get("progress", {}),
+            "deadline": hb.get("deadline"),
+        })
+
+    # one merged read-only view over every rank's ledger file
+    import glob as _glob
+
+    ledgers = sorted(_glob.glob(os.path.join(output_dir,
+                                             "quarantine*.jsonl")))
+    entries = []
+    summary: dict = {}
+    stalls, hangs = [], []
+    if ledgers:
+        led = QuarantineLedger(ledgers[0],
+                               read_paths=tuple(ledgers[1:]))
+        entries = led.entries
+        summary = led.summary()
+        for e in entries:
+            if e.failure_class != "hang":
+                continue
+            row = {"t": e.t, "unit": e.unit.get("file", ""),
+                   "stage": e.stage, "message": e.message,
+                   "disposition": e.disposition}
+            (stalls if e.disposition == "stalled" else hangs).append(row)
+
+    return {
+        "output_dir": output_dir,
+        "stale_s": stale_s,
+        "ranks": ranks,
+        "n_stale": sum(1 for r in ranks if r["stale"]),
+        "ledger_files": [os.path.basename(p) for p in ledgers],
+        "ledger_summary": summary,
+        "n_ledger_events": len(entries),
+        "stalls": stalls[-20:],
+        "hangs": hangs[-20:],
+    }
+
+
+def render_text(rep: dict) -> str:
+    lines = [f"watchdog report — {rep['output_dir']} "
+             f"(stale threshold {rep['stale_s']:.0f} s)", ""]
+    if not rep["ranks"]:
+        lines.append("no heartbeat files found (heartbeat_s = 0, or "
+                     "the run never started)")
+    for r in rep["ranks"]:
+        if not r.get("present"):
+            lines.append(f"  rank {r['rank']}: NO HEARTBEAT "
+                         "(never started, or died before first beat)")
+            continue
+        flag = "STALE" if r["stale"] else "ok"
+        prog = " ".join(f"{k}={v}" for k, v in
+                        sorted(r["progress"].items())) or "-"
+        lines.append(
+            f"  rank {r['rank']} [{flag}] age {r['age_s']:.1f} s  "
+            f"seq {r['seq']}  {r['host']}:{r['pid']}")
+        lines.append(f"    at: {r['stage'] or '-'}  "
+                     f"unit: {r['unit'] or '-'}  progress: {prog}")
+        dl = r.get("deadline")
+        if dl:
+            lines.append(f"    last deadline event: {dl.get('name')} "
+                         f"{dl.get('state')} after "
+                         f"{dl.get('elapsed_s')} s")
+    lines.append("")
+    if rep["ledger_summary"]:
+        lines.append(f"ledger ({', '.join(rep['ledger_files'])}): " +
+                     ", ".join(f"{k}: {v}" for k, v in
+                               sorted(rep["ledger_summary"].items())))
+    else:
+        lines.append("ledger: no events")
+    for title, rows in (("stall warnings", rep["stalls"]),
+                        ("hangs / deferred shards", rep["hangs"])):
+        if rows:
+            lines.append(f"{title} (latest {len(rows)}):")
+            for e in rows:
+                lines.append(f"  {e['t']} {e['disposition']:<9} "
+                             f"{e['stage']:<22} "
+                             f"{os.path.basename(e['unit'] or '')} "
+                             f"{e['message']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("output_dir", help="the run's output directory "
+                    "(holds heartbeat.rank*.json + quarantine*.jsonl)")
+    ap.add_argument("--stale-s", type=float, default=60.0,
+                    help="heartbeat age beyond which a rank counts as "
+                    "stale (default 60)")
+    ap.add_argument("--n-ranks", type=int, default=0,
+                    help="expected rank count (default: the ranks that "
+                    "wrote heartbeats)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    rep = build_report(args.output_dir, stale_s=args.stale_s,
+                       n_ranks=args.n_ranks)
+    print(json.dumps(rep) if args.json else render_text(rep))
+    return 1 if rep["n_stale"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
