@@ -443,6 +443,16 @@ def main():
     all_pix = np.stack([ces_pixels(T, nx, ny, f, F) for f in range(F)])
 
     offset_length, n_iter = 50, 100
+    # CG preconditioner selection (the [Destriper] knob's bench end):
+    # jacobi (default) | none | twolevel. The CG terminates on the 1e-6
+    # tolerance, so cg_iters_to_tol in the detail line reports iterations
+    # ACTUALLY run — None when the budget expired unconverged.
+    from comapreduce_tpu.mapmaking.destriper import CONFIG_PRECONDITIONERS
+    precond_name = os.environ.get("BENCH_PRECOND", "jacobi")
+    if precond_name not in CONFIG_PRECONDITIONERS:
+        raise SystemExit(
+            f"BENCH_PRECOND must be {'|'.join(CONFIG_PRECONDITIONERS)}, "
+            f"got {precond_name!r}")
     # static pointing -> plan built once (host), reused every run. The
     # four bands share the feed pointing exactly (one telescope
     # direction), so the destriper solves them as ONE multi-RHS CG over
@@ -454,9 +464,11 @@ def main():
     pix_feed = all_pix.reshape(-1)
     n_pad = (-pix_feed.size) % offset_length
     pix_feed = np.concatenate([pix_feed, np.full(n_pad, npix, np.int64)])
+    # pair_batch auto-sized by the HBM planner (COMAP_PAIR_BATCH pins it)
     plan = build_pointing_plan(pix_feed, npix, offset_length)
     jitted_destripe = jax.jit(functools.partial(
-        destripe_planned, plan=plan, n_iter=n_iter, threshold=1e-6))
+        destripe_planned, plan=plan, n_iter=n_iter, threshold=1e-6,
+        precond="none" if precond_name == "none" else "jacobi"))
 
     def make_bands(tods, weis):
         """(F, B, T) feed outputs -> padded (B, F*T) multi-RHS inputs.
@@ -471,12 +483,55 @@ def main():
                 [band_w, jnp.zeros((B, n_pad), band_w.dtype)], axis=-1)
         return band_tod, band_w
 
+    # dispatch accounting, COUNTED AT CALL TIME: the timed pipeline only
+    # ever launches programs through the _counted wrappers below, so a
+    # regression back to per-feed/per-band Python-loop dispatch inside
+    # run_pipeline (e.g. `for f in range(F): feeds(keys[f:f+1])`) raises
+    # the count by construction — no hand-maintained increment to forget.
+    # Scope: this counts the BENCH pipeline's dispatches (reduction =
+    # ONE lax.map-over-feeds program, destriper = ONE multi-RHS CG);
+    # the library stage programs' chunking policy is pinned separately
+    # (ops.reduce.plan_stage_feed_batch unit tests). tools/check_perf.py
+    # gates on ANY increase.
+    dispatch_n = {"reduce": 0, "destripe": 0}
+
+    def _counted(fn, which):
+        def call(*a, **k):
+            dispatch_n[which] += 1
+            return fn(*a, **k)
+        return call
+
+    all_feeds_counted = _counted(all_feeds, "reduce")
+    destripe_counted = _counted(jitted_destripe, "destripe")
+
+    coarse_kwargs = {}
+    if precond_name == "twolevel":
+        # the coarse system needs the post-reduction weights on host;
+        # pointing and weights are run-invariant, so build once here
+        # (per band, sharing one pattern) — the same amortisation the
+        # CLI's per-(pointing, weights) build relies on
+        from comapreduce_tpu.mapmaking.destriper import (
+            build_coarse_preconditioner, coarse_pattern)
+
+        keys_w = jax.random.split(jax.random.key(7, impl="rbg"), F)
+        tods_w, weis_w = all_feeds(keys_w)
+        _, band_w0 = make_bands(tods_w, weis_w)
+        band_w_host = np.asarray(band_w0)
+        pat = coarse_pattern(pix_feed, npix, offset_length, block=8)
+        pre = [build_coarse_preconditioner(pix_feed, band_w_host[i],
+                                           npix, offset_length, block=8,
+                                           pattern=pat)
+               for i in range(B)]
+        coarse_kwargs["coarse"] = (
+            jnp.asarray(pre[0][0]),
+            jnp.stack([jnp.asarray(p[1]) for p in pre]))
+
     def run_pipeline():
         # hardware RNG (rbg): synthetic-data generation is bench scaffolding,
         # not pipeline work, and threefry costs ~35 ms/feed of the wall
         keys = jax.random.split(jax.random.key(7, impl="rbg"), F)
-        tods, weis = all_feeds(keys)           # (F, B, T) each
-        return jitted_destripe(*make_bands(tods, weis))
+        tods, weis = all_feeds_counted(keys)   # (F, B, T) each
+        return destripe_counted(*make_bands(tods, weis), **coarse_kwargs)
 
     def finish(res):
         """Force completion through the axon tunnel with a HOST FETCH —
@@ -493,11 +548,13 @@ def main():
     n_rep = 2 if not small else 1
     best = float("inf")
     for _ in range(n_rep):
+        dispatch_n["reduce"] = dispatch_n["destripe"] = 0
         t0 = time.perf_counter()
         result = run_pipeline()
         finish(result)
         dt = time.perf_counter() - t0
         best = min(best, dt)
+    dispatch_count = dispatch_n["reduce"] + dispatch_n["destripe"]
     if not small and best < 0.05:
         # a sub-50 ms "measurement" of a production-shape chain is a
         # tunnel artifact, never a real wall — refuse to print it
@@ -508,6 +565,12 @@ def main():
     n_raw = F * B * C * T
     throughput = n_raw / best
     cg_iters_per_sec = float(result.n_iter) / best
+    # iterations ACTUALLY used: the CG exits on the 1e-6 tolerance, so
+    # n_iter < budget means converged-to-tol; an unconverged run reports
+    # None rather than pretending the budget was the requirement
+    resid = np.asarray(result.residual)
+    cg_converged = bool((resid <= 1e-6).all())
+    cg_iters_to_tol = int(result.n_iter) if cg_converged else None
 
     # diagnostic stage split (NOT the headline wall, which times the
     # chained end-to-end pipeline): one extra rep of each half, so the
@@ -526,7 +589,10 @@ def main():
     band_tod_d, band_w_d = make_bands(tods_d, weis_d)
     float(jnp.sum(band_w_d))
     t0 = time.perf_counter()
-    r_d = jitted_destripe(band_tod_d, band_w_d)
+    # same coarse_kwargs as run_pipeline: under BENCH_PRECOND=twolevel
+    # the split must time the SELECTED solver path (omitting the coarse
+    # operand would measure plain Jacobi — and compile a second program)
+    r_d = jitted_destripe(band_tod_d, band_w_d, **coarse_kwargs)
     finish(r_d)
     destripe_wall = time.perf_counter() - t0
 
@@ -550,7 +616,13 @@ def main():
             "medfilt_window": window,
             "wall_s": round(best, 4),
             "cg_iters": int(result.n_iter),
+            "cg_iters_to_tol": cg_iters_to_tol,
+            "cg_residual": [round(float(r), 9) for r in resid.ravel()],
             "cg_iters_per_sec": round(cg_iters_per_sec, 1),
+            "preconditioner": precond_name,
+            "pair_batch": int(plan.pair_batch),
+            "dispatch_count": int(dispatch_count),
+            "reduce_dispatches": int(dispatch_n["reduce"]),
             "reduce_wall_s": round(reduce_wall, 4),
             "destripe_wall_s": round(destripe_wall, 4),
             "map_hit_fraction": None,
@@ -583,7 +655,7 @@ def main():
     # recorded) — and the AOT lower must run inside its guard anyway
     write_evidence("config35", _ev_run,
                    compile_fn=lambda: jitted_destripe.lower(
-                       sds, sds).compile(),
+                       sds, sds, **coarse_kwargs).compile(),
                    extra=line["detail"])
 
 

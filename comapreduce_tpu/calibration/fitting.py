@@ -121,13 +121,44 @@ def initial_guess(img: jax.Array, x: jax.Array, y: jax.Array,
     return jnp.array([A, x.ravel()[i], sig, y.ravel()[i], sig, 0.0, off])
 
 
+def _canonicalise_gauss(p, err):
+    """Resolve the rotated-Gaussian labeling degeneracy: (sx, sy, th) and
+    (sy, sx, th ± pi/2) are THE SAME model (and so are negated widths),
+    and which equivalent minimum LM lands in depends on roundoff-level
+    backend details. Canonical form: widths positive, |sx| <= |sy|
+    (minor axis first), theta wrapped to (-pi/2, pi/2]. Applied to the
+    7/9-parameter ``gauss2d_rot`` layouts (sx/sy/theta at slots 2/4/5)
+    and the 5-parameter fixed-pos layout (slots 1/2/3); errors ride the
+    same swap."""
+    n = p.shape[0]
+    isx, isy, ith = (2, 4, 5) if n >= 7 else (1, 2, 3)
+    sx, sy = jnp.abs(p[isx]), jnp.abs(p[isy])
+    swap = sx > sy
+    th = p[ith] + jnp.where(swap, jnp.pi / 2, 0.0)
+    # wrap mod pi into [-pi/2, pi/2), then fold the -pi/2 end (PLUS a
+    # roundoff margin: a fit landing at -pi/2+eps on one backend and
+    # +pi/2-eps' on another is the same model, and the half-to-even
+    # round() wrap used previously left such pairs ~pi apart) onto the
+    # +pi/2 side — canonical values may exceed pi/2 by < 1e-6 rad
+    th = jnp.mod(th + jnp.pi / 2, jnp.pi) - jnp.pi / 2
+    th = jnp.where(th <= -jnp.pi / 2 + 1e-6, th + jnp.pi, th)
+    p = p.at[isx].set(jnp.where(swap, sy, sx))
+    p = p.at[isy].set(jnp.where(swap, sx, sy))
+    p = p.at[ith].set(th)
+    esx, esy = err[isx], err[isy]
+    err = err.at[isx].set(jnp.where(swap, esy, esx))
+    err = err.at[isy].set(jnp.where(swap, esx, esy))
+    return p, err
+
+
 @functools.partial(jax.jit, static_argnames=("model", "n_iter"))
 def fit_gauss2d(img: jax.Array, x: jax.Array, y: jax.Array, w: jax.Array,
                 p0: jax.Array, model=gauss2d_rot, n_iter: int = 60):
     """Weighted fit of one map: ``img``/``x``/``y``/``w`` flat f32[m].
 
-    Zero-weight pixels contribute nothing. Returns (params, errors, chi2).
-    vmap over (feed, band) maps for whole-observation fits (the ALGLIB
+    Zero-weight pixels contribute nothing. Returns (params, errors, chi2)
+    in the canonical labeling (see :func:`_canonicalise_gauss`). vmap
+    over (feed, band) maps for whole-observation fits (the ALGLIB
     ``prange`` replacement)."""
     sw = jnp.sqrt(jnp.maximum(w, 0.0))
 
@@ -136,6 +167,8 @@ def fit_gauss2d(img: jax.Array, x: jax.Array, y: jax.Array, w: jax.Array,
 
     p, cov, c2 = lm_fit(residual, p0, n_iter=n_iter)
     err = jnp.sqrt(jnp.maximum(jnp.diagonal(cov), 0.0))
+    if model in (gauss2d_rot, gauss2d_rot_gradient, gauss2d_fixed_pos):
+        p, err = _canonicalise_gauss(p, err)
     return p, err, c2
 
 
@@ -223,6 +256,10 @@ def posterior_fit_gauss2d(key, img: jax.Array, x: jax.Array, y: jax.Array,
         p_map, cov, _ = lm_fit(lambda p: (model(p, x, y) - img) * sw, p0,
                                n_iter=n_iter)
         base_sigma = jnp.sqrt(jnp.clip(jnp.diagonal(cov), 1e-16, None))
+        if model in (gauss2d_rot, gauss2d_rot_gradient, gauss2d_fixed_pos):
+            # same labeling as fit_gauss2d (chains seed AT the canonical
+            # minimum; proposal sigmas ride the swap)
+            p_map, base_sigma = _canonicalise_gauss(p_map, base_sigma)
     else:
         p_map = p0
         base_sigma = jnp.clip(jnp.asarray(proposal_sigma), 1e-8, None)

@@ -43,8 +43,8 @@ from comapreduce_tpu.mapmaking.leveldata import read_comap_data
 from comapreduce_tpu.mapmaking.wcs import WCS
 from comapreduce_tpu.pipeline.config import IniConfig
 
-__all__ = ["main", "make_band_map", "make_band_maps_joint", "solve_band",
-           "write_band_map"]
+__all__ = ["main", "make_band_map", "make_band_maps_joint",
+           "parse_destriper_section", "solve_band", "write_band_map"]
 
 
 def _aslist(v):
@@ -76,7 +76,8 @@ def _memoized(tag: str, pixels: np.ndarray, extra_key: tuple, build):
 
 def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                     n_iter: int, threshold: float, n_groups: int = 0,
-                    compact: bool = False):
+                    compact: bool = False, precond: str = "jacobi",
+                    pair_batch: int | None = None):
     import functools
 
     import jax
@@ -85,12 +86,14 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
     from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
 
     def build(pix):
-        plan = build_pointing_plan(pix, npix, offset_length)
+        plan = build_pointing_plan(pix, npix, offset_length,
+                                   pair_batch=pair_batch)
         fn = jax.jit(functools.partial(destripe_planned, plan=plan,
                                        n_iter=n_iter,
                                        threshold=threshold,
                                        n_groups=n_groups,
-                                       dense_maps=not compact))
+                                       dense_maps=not compact,
+                                       precond=precond))
         if compact:
             return fn, np.asarray(plan.uniq_pixels)
         return fn
@@ -106,14 +109,17 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
         tag += "-compact"
     return _memoized(tag, pixels,
                      (int(npix), int(offset_length), int(n_iter),
-                      float(threshold), int(n_groups)), build)
+                      float(threshold), int(n_groups), str(precond),
+                      pair_batch), build)
 
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                             offset_length: int, n_iter: int,
                             threshold: float, n_bands: int = 0,
                             n_groups: int = 0,
-                            with_coarse: bool = False):
+                            with_coarse: bool = False,
+                            precond: str = "jacobi",
+                            pair_batch: int | None = None):
     """Memoized sharded solver (plans + ONE compiled shard_map program
     per pointing — bands share both). ``n_bands > 0`` builds the
     multi-RHS program (all bands in one CG); ``n_groups > 0`` the joint
@@ -125,19 +131,21 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
     n_shards = len(mesh.devices.ravel())
 
     def build(pix):
-        plans = build_sharded_plans(pix, npix, offset_length, n_shards)
+        plans = build_sharded_plans(pix, npix, offset_length, n_shards,
+                                    pair_batch=pair_batch)
         run = make_destripe_sharded_planned(mesh, plans, n_iter=n_iter,
                                             threshold=threshold,
                                             n_bands=n_bands,
                                             n_groups=n_groups,
-                                            with_coarse=with_coarse)
+                                            with_coarse=with_coarse,
+                                            precond=precond)
         return run, np.asarray(plans[0].uniq_global)
 
     return _memoized(f"sharded{n_bands}-g{n_groups}-c{int(with_coarse)}",
                      pixels,
                      (n_shards, int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups),
-                      bool(with_coarse)), build)
+                      bool(with_coarse), str(precond), pair_batch), build)
 
 
 def _shard_quantum(mesh, offset_length: int) -> int:
@@ -181,12 +189,68 @@ def _expand_joint_results(res, uniq: np.ndarray, npix: int, nb: int):
         diverged=div[i] if div.ndim else div) for i in range(nb)]
 
 
+def parse_destriper_section(destr: dict, coarse_default: int = 0):
+    """``[Destriper]`` knobs -> ``(precond, coarse_block, pair_batch)``
+    (docs/OPERATIONS.md §3):
+
+    - ``preconditioner = none | jacobi | twolevel`` — CG preconditioner
+      selection; ``twolevel`` = Jacobi + the coarse correction (block
+      from ``coarse_block``, default 8). Absent, the legacy
+      ``[Inputs] coarse_precond`` default (``coarse_default``) stands.
+    - ``pair_batch = N | auto`` — one-hot binning chunks merged per MXU
+      matmul in the planned matvec (auto = HBM-planner sized).
+
+    A typo'd or contradictory knob raises instead of silently running
+    the default (the ``[Resilience]`` section's rule)."""
+    from comapreduce_tpu.mapmaking.destriper import CONFIG_PRECONDITIONERS
+
+    coarse_block = int(coarse_default)
+    pname = str(destr.get("preconditioner", "")).strip().lower()
+    if pname not in ("",) + CONFIG_PRECONDITIONERS:
+        raise ValueError(
+            f"[Destriper] preconditioner must be "
+            f"{'|'.join(CONFIG_PRECONDITIONERS)}, got {pname!r}")
+    if "coarse_block" in destr and pname != "twolevel":
+        # the knob only exists under twolevel; accepting-and-ignoring it
+        # (or letting the legacy [Inputs] default override it) would be
+        # the silent-drop this section's rule forbids
+        raise ValueError(
+            "[Destriper] coarse_block only applies under preconditioner"
+            f"=twolevel (preconditioner is {pname or 'absent'!r}); remove "
+            "the knob or select twolevel")
+    precond = "none" if pname == "none" else "jacobi"
+    if pname == "none":
+        coarse_block = 0
+    elif pname == "jacobi":
+        coarse_block = 0
+    elif pname == "twolevel":
+        if "coarse_block" in destr:
+            coarse_block = int(destr["coarse_block"])
+            if coarse_block < 1:
+                # 0 means "coarse disabled" everywhere else ([Inputs]
+                # coarse_precond : 0) — contradicting twolevel; raise
+                # like any other bad knob instead of silently running
+                # the default block
+                raise ValueError(
+                    "[Destriper] coarse_block must be >= 1 under "
+                    f"preconditioner=twolevel, got {coarse_block}")
+        else:
+            coarse_block = coarse_block or 8
+    pb_raw = destr.get("pair_batch", "auto")
+    pair_batch = (None if str(pb_raw).strip().lower() in ("auto", "")
+                  else int(pb_raw))
+    if pair_batch is not None and pair_batch < 1:
+        raise ValueError(f"[Destriper] pair_batch must be >= 1 or auto, "
+                         f"got {pb_raw!r}")
+    return precond, coarse_block, pair_batch
+
+
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   offset_length=50, n_iter=100, threshold=1e-6,
                   use_ground=False, use_calibration=True, sharded=False,
                   medfilt_window=400, tod_variant="auto",
                   coarse_block=0, prefetch=0, cache=None,
-                  resilience=None):
+                  resilience=None, precond="jacobi", pair_batch=None):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
@@ -210,7 +274,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                             coarse_block=coarse_block,
                             watchdog=getattr(resilience, "watchdog",
                                              None),
-                            unit=f"band{band}")
+                            unit=f"band{band}", precond=precond,
+                            pair_batch=pair_batch)
 
 
 def _watched_cg(solve, watchdog, unit: str):
@@ -233,7 +298,8 @@ def _watched_cg(solve, watchdog, unit: str):
 
 def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                use_ground=False, sharded=False, coarse_block=0,
-               watchdog=None, unit=""):
+               watchdog=None, unit="", precond="jacobi",
+               pair_batch=None):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -249,13 +315,22 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     wall budget (``destriper.watched_solve``): device compute cannot be
     cancelled, so the soft deadline warns/ledgers a stall and a blown
     hard deadline flags the late result through the same operator
-    signal path as a tripped divergence monitor."""
+    signal path as a tripped divergence monitor.
+
+    ``precond``/``pair_batch`` are the ``[Destriper]`` section's knobs
+    (docs/OPERATIONS.md §3): CG preconditioner selection
+    ('jacobi'|'none'; the two-level upgrade rides ``coarse_block``) and
+    the merged one-hot binning batch (None = HBM-planner auto)."""
+    from comapreduce_tpu.mapmaking.destriper import _check_precond
+
+    _check_precond(precond, coarse=coarse_block or None)
     if watchdog is not None:
         return _watched_cg(
             lambda: solve_band(data, offset_length=offset_length,
                                n_iter=n_iter, threshold=threshold,
                                use_ground=use_ground, sharded=sharded,
-                               coarse_block=coarse_block),
+                               coarse_block=coarse_block,
+                               precond=precond, pair_batch=pair_batch),
             watchdog, unit)
     if sharded:
         import jax
@@ -294,7 +369,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 mesh, data.tod, data.pixels, data.weights, data.npix,
                 offset_length=offset_length, n_iter=n_iter,
                 threshold=threshold, ground_ids=data.ground_ids,
-                az=data.az, n_groups=data.n_groups)
+                az=data.az, n_groups=data.n_groups, precond=precond)
         else:
             import jax.numpy as jnp
 
@@ -314,7 +389,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 mesh, pix_host, data.npix, offset_length, n_iter,
                 threshold,
                 n_groups=data.n_groups if gid_off is not None else 0,
-                with_coarse=use_coarse)
+                with_coarse=use_coarse, precond=precond,
+                pair_batch=pair_batch)
             if gid_off is not None:
                 if coarse_block:
                     logger.warning("coarse_precond: the sharded ground "
@@ -373,7 +449,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                     n_iter=n_iter, threshold=threshold,
                                     ground_ids=data.ground_ids[:n],
                                     az=data.az[:n],
-                                    n_groups=data.n_groups)
+                                    n_groups=data.n_groups,
+                                    precond=precond)
         kwargs = {}
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
@@ -386,14 +463,16 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
         if use_ground:
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold,
-                                 n_groups=data.n_groups)
+                                 n_groups=data.n_groups, precond=precond,
+                                 pair_batch=pair_batch)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]),
                         ground_off=jnp.asarray(gid_off),
                         az=jnp.asarray(data.az[:n]), **kwargs)
         else:
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
-                                 offset_length, n_iter, threshold)
+                                 offset_length, n_iter, threshold,
+                                 precond=precond, pair_batch=pair_batch)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]), **kwargs)
         if kwargs.get("coarse") is not None and \
@@ -440,7 +519,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          medfilt_window=400, sharded=False,
                          tod_variant="auto", coarse_block=0,
                          prefetch=0, cache=None, resilience=None,
-                         watchdog=None):
+                         watchdog=None, precond="jacobi",
+                         pair_batch=None):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -498,7 +578,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
             wgt[i, :N] = d.weights
         run, uniq = _sharded_planned_solver(
             mesh, pix_host, npix, offset_length, n_iter, threshold,
-            n_bands=nb, with_coarse=bool(coarse_block))
+            n_bands=nb, with_coarse=bool(coarse_block), precond=precond,
+            pair_batch=pair_batch)
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
                 build_coarse_preconditioner, coarse_pattern)
@@ -550,7 +631,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     # branch above): the joint program only ever holds (nb, n_rank)
     # compact products on device, never (nb, npix) dense maps
     fn, uniq = _planned_solver(pix0[:n], npix, offset_length, n_iter,
-                               threshold, compact=True)
+                               threshold, compact=True, precond=precond,
+                               pair_batch=pair_batch)
     res = _watched_cg(
         lambda: fn(jnp.asarray(tod), jnp.asarray(wgt), **kwargs),
         watchdog, "joint")
@@ -646,6 +728,8 @@ def main(argv=None) -> int:
     # would only pay the host-side build. `coarse_precond : 0` disables.
     coarse_block = int(inputs.get("coarse_precond",
                                   0 if calibrator else 8))
+    precond, coarse_block, pair_batch = parse_destriper_section(
+        ini.get("Destriper", {}), coarse_block)
     # streaming ingest (docs/ingest.md): `[Inputs] prefetch : N` reads
     # ahead on a background thread; `cache_mb : M` caches decoded files
     # so every band after the first skips the HDF5 decode entirely
@@ -701,7 +785,8 @@ def main(argv=None) -> int:
             threshold=threshold, use_calibration=use_cal,
             sharded=sharded, tod_variant=tod_variant,
             coarse_block=coarse_block, prefetch=prefetch, cache=cache,
-            resilience=resilience, watchdog=resilience.watchdog)
+            resilience=resilience, watchdog=resilience.watchdog,
+            precond=precond, pair_batch=pair_batch)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -716,7 +801,8 @@ def main(argv=None) -> int:
                                 sharded=sharded,
                                 coarse_block=coarse_block,
                                 watchdog=resilience.watchdog,
-                                unit=f"band{band}")
+                                unit=f"band{band}", precond=precond,
+                                pair_batch=pair_batch)
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
@@ -724,7 +810,8 @@ def main(argv=None) -> int:
                 threshold=threshold, use_ground=use_ground,
                 use_calibration=use_cal, sharded=sharded,
                 tod_variant=tod_variant, coarse_block=coarse_block,
-                prefetch=prefetch, cache=cache, resilience=resilience)
+                prefetch=prefetch, cache=cache, resilience=resilience,
+                precond=precond, pair_batch=pair_batch)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         write_band_map(path, data, result)

@@ -37,10 +37,16 @@ from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
                                                      binned_window_sum)
 from comapreduce_tpu.resilience.tripwires import scrub_tod
 
-__all__ = ["DestriperResult", "destripe", "destripe_jit",
-           "destripe_planned", "ground_ids_per_offset",
+__all__ = ["CONFIG_PRECONDITIONERS", "DestriperResult", "destripe",
+           "destripe_jit", "destripe_planned", "ground_ids_per_offset",
            "build_coarse_preconditioner", "coarse_pattern",
            "watched_solve"]
+
+#: the config-level preconditioner names ([Destriper] preconditioner =,
+#: BENCH_PRECOND) — ONE home so the CLI parser and bench can't drift
+#: from each other. The SOLVER-level rule is narrower (_check_precond:
+#: jacobi|none, twolevel = jacobi + coarse=...) by design.
+CONFIG_PRECONDITIONERS = ("none", "jacobi", "twolevel")
 
 # CG divergence tripwire: a system is diverged when its true residual
 # sits more than sqrt(DIVERGENCE_GROWTH)x above the best iterate's for
@@ -279,12 +285,27 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
     return x, rr, k, b_norm, div.astype(jnp.int32)
 
 
+def _check_precond(precond: str, coarse=None) -> str:
+    """ONE home for the preconditioner-name rule (``destripe``,
+    ``destripe_planned`` and the config layer must not drift):
+    ``jacobi`` (default) | ``none``; the two-level preconditioner is
+    Jacobi + the coarse correction, so ``coarse`` requires ``jacobi``."""
+    if precond not in ("jacobi", "none"):
+        raise ValueError(f"precond must be 'jacobi' or 'none', got "
+                         f"{precond!r} (the two-level preconditioner is "
+                         "selected by passing coarse=...)")
+    if coarse is not None and precond != "jacobi":
+        raise ValueError("the two-level preconditioner is additive over "
+                         "Jacobi; coarse=... requires precond='jacobi'")
+    return precond
+
+
 def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
              npix: int, offset_length: int = 50, n_iter: int = 100,
              threshold: float = 1e-6, axis_name: str | None = None,
              ground_ids: jax.Array | None = None,
-             az: jax.Array | None = None, n_groups: int = 0
-             ) -> DestriperResult:
+             az: jax.Array | None = None, n_groups: int = 0,
+             precond: str = "jacobi") -> DestriperResult:
     """Destripe a flat TOD vector.
 
     Parameters
@@ -298,7 +319,12 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
         template (az should be pre-normalised to ~[-1, 1]).
     axis_name: mesh axis name when called inside ``shard_map`` with the
         time/offset axis sharded.
+    precond: ``"jacobi"`` (default) or ``"none"`` — plain CG without the
+        diagonal scaling, for A/B runs and the
+        ``[Destriper] preconditioner`` config knob. Same fixed point
+        either way; only the iteration path changes.
     """
+    _check_precond(precond)
     n = tod.shape[0]
     n_offsets = n // offset_length
     with_ground = ground_ids is not None
@@ -332,26 +358,29 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
     # correction), which overestimates diag(A) — still SPD, still a valid
     # (slightly weaker) preconditioner. The planned path uses the exact
     # pair form.
-    inv_sw = jnp.where(sum_w > 0, 1.0 / jnp.maximum(sum_w, 1e-30), 0.0)
-    d_fwf = jnp.sum(weights.reshape(n_offsets, offset_length), axis=1)
-    corr = jnp.sum((weights * weights
-                    * sample_map(inv_sw, pixels)
-                    ).reshape(n_offsets, offset_length), axis=1)
-    inv_diag = _jacobi_inverse(d_fwf - corr, d_fwf)
+    if precond == "none":
+        precond_fn = None
+    else:
+        inv_sw = jnp.where(sum_w > 0, 1.0 / jnp.maximum(sum_w, 1e-30), 0.0)
+        d_fwf = jnp.sum(weights.reshape(n_offsets, offset_length), axis=1)
+        corr = jnp.sum((weights * weights
+                        * sample_map(inv_sw, pixels)
+                        ).reshape(n_offsets, offset_length), axis=1)
+        inv_diag = _jacobi_inverse(d_fwf - corr, d_fwf)
 
-    def precond(v):
-        # identity on the ground block, deliberately: the unprojected
-        # G^T W G diagonal overestimates the true (Z-projected) ground
-        # diagonal by orders of magnitude when the template is nearly
-        # degenerate with the sky, and scaling by it starves those ~2 *
-        # n_groups directions (measured: ground slopes collapse from the
-        # injected truth to ~0). With only a handful of ground unknowns,
-        # unpreconditioned directions cost a few CG iterations at most.
-        return (v[0] * inv_diag, v[1])
+        def precond_fn(v):
+            # identity on the ground block, deliberately: the unprojected
+            # G^T W G diagonal overestimates the true (Z-projected) ground
+            # diagonal by orders of magnitude when the template is nearly
+            # degenerate with the sky, and scaling by it starves those ~2 *
+            # n_groups directions (measured: ground slopes collapse from the
+            # injected truth to ~0). With only a handful of ground unknowns,
+            # unpreconditioned directions cost a few CG iterations at most.
+            return (v[0] * inv_diag, v[1])
 
     x, rz, k, b_norm, diverged = _cg_loop(
         matvec, b, lambda u, v: _dot(u, v, axis_name), n_iter, threshold,
-        precond=precond)
+        precond=precond_fn)
     offsets, ground = x
 
     # final products
@@ -370,7 +399,7 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
 destripe_jit = jax.jit(
     destripe,
     static_argnames=("npix", "offset_length", "n_iter", "threshold",
-                     "axis_name", "n_groups"))
+                     "axis_name", "n_groups", "precond"))
 
 
 def ground_ids_per_offset(ground_ids: np.ndarray,
@@ -536,7 +565,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      az: jax.Array | None = None,
                      n_groups: int = 0,
                      coarse: tuple | None = None,
-                     x0: jax.Array | None = None) -> DestriperResult:
+                     x0: jax.Array | None = None,
+                     precond: str = "jacobi") -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -596,7 +626,13 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     When the CG divergence monitor trips, ``result.diverged`` is 1 for
     that system and ``offsets`` hold the best (lowest-residual)
     iterate seen, not a converged solution.
+
+    ``precond``: ``"jacobi"`` (default) or ``"none"`` — the
+    ``[Destriper] preconditioner`` knob's fast-path end. ``coarse``
+    (the two-level upgrade) is additive over Jacobi and requires it.
+    Same fixed point whichever is selected; only the CG path changes.
     """
+    _check_precond(precond, coarse)
     dv = device_arrays if device_arrays is not None else plan.device()
     with_ground = ground_off is not None
     if with_ground and tod.ndim != 1:
@@ -727,11 +763,17 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
 
     # Jacobi preconditioner: exact diag(A) from the pair aggregates —
     # A_oo = diag_o - sum_{pairs (r,o)} w_po^2 / sumw_r
-    inv_sw = jnp.where(sum_w > 0, 1.0 / jnp.maximum(sum_w, 1e-30), 0.0)
-    corr = off_sum(pair_w_off * pair_w_off * gather_m(from_global(inv_sw)))
-    inv_diag = _jacobi_inverse(diag - corr, diag)
+    if precond != "none":
+        inv_sw = jnp.where(sum_w > 0,
+                           1.0 / jnp.maximum(sum_w, 1e-30), 0.0)
+        corr = off_sum(pair_w_off * pair_w_off
+                       * gather_m(from_global(inv_sw)))
+        inv_diag = _jacobi_inverse(diag - corr, diag)
 
-    if coarse is not None:
+    if precond == "none":
+        def apply_precond(v):
+            return v
+    elif coarse is not None:
         c_grp, ac_inv = coarse
         c_grp = jnp.asarray(c_grp, jnp.int32)
         n_c = ac_inv.shape[-1]

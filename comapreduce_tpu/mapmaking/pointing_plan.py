@@ -75,6 +75,9 @@ class PointingPlan:
     pair_perm_off: np.ndarray        # i32[P_pad]: x_off = x_rank[perm]
     off_window: int
     off_base: np.ndarray             # i32[n_p_chunks] offset base per chunk
+    # chunks merged per binning step (pair_chunk above is the EFFECTIVE
+    # chunk = base chunk x pair_batch; see build_pointing_plan)
+    pair_batch: int = 1
     # sharded-plan extras (build_sharded_plans): the shard's LOCAL rank
     # space keeps binning windows dense; these map it into the global
     # compact space for the cross-shard psum
@@ -122,11 +125,50 @@ def _window_layout(ids_sorted: np.ndarray, chunk: int, align: int = 128):
     return base.astype(np.int32), int(window)
 
 
+def _resolve_pair_batch(pair_batch) -> int:
+    """Normalise the knob: explicit int >= 1 pins it; None reads
+    ``COMAP_PAIR_BATCH`` (int, or unset/0/"auto" = HBM-planner auto)."""
+    if pair_batch is None:
+        env = os.environ.get("COMAP_PAIR_BATCH", "").strip().lower()
+        if env in ("", "auto", "0"):
+            return 0
+        return max(int(env), 1)
+    return max(int(pair_batch), 0)
+
+
+# one-hot budget of the auto-sizer: the merged chunk's (chunk, window)
+# equality matrix is the per-step live block of binned_window_sum; cap it
+# at a small HBM fraction so batching never eats the solve's headroom
+_PAIR_BATCH_CANDIDATES = (8, 4, 2, 1)
+
+
+def _auto_pair_batch_budget() -> int:
+    from comapreduce_tpu.ops.reduce import device_hbm_bytes
+
+    return max(device_hbm_bytes() // 64, 64 << 20)
+
+
+def _mxu_backend() -> bool:
+    """Auto pair-batching is an MXU trade: the merged chunk's one-hot
+    window grows ~quadratically with the batch, which a systolic matmul
+    unit absorbs while the trip-count/dispatch saving pays. Off-TPU the
+    wider contraction is plain FLOPs — measured 4x SLOWER at batch 8 on
+    CPU — so auto stays at 1 there; explicit knobs still pin any value
+    (the CPU parity tests exercise the merged layout that way)."""
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
                         sample_chunk: int = 8192,
                         pair_chunk: int = 4096,
                         min_pair_pad: int = 0,
-                        min_windows: tuple = (0, 0, 0)) -> PointingPlan:
+                        min_windows: tuple = (0, 0, 0),
+                        pair_batch: int | None = None) -> PointingPlan:
     """Build the static plan for one flat pointing vector.
 
     ``pixels``: integer pixel per sample (invalid = negative or >= npix);
@@ -137,6 +179,21 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     where an invalid sample reads 0 from the map but its weight still
     enters ``F^T W``) while their map-domain sums land in a padding slot
     that is sliced away.
+
+    ``pair_batch`` merges that many consecutive ``pair_chunk`` windows
+    into ONE binning step: the plan's effective pair chunk becomes
+    ``pair_chunk * pair_batch`` and every per-CG-iteration
+    ``binned_window_sum`` contracts ``pair_batch`` windows in a single
+    MXU matmul — the ``lax.map``/``fori`` trip count drops by the same
+    factor (the round-3 "next lever (c)", raised per ISSUE 4). The
+    window widens with the merged chunk's id span, so the one-hot grows
+    ~quadratically with the batch; ``None`` (default) auto-sizes via the
+    HBM planner — the largest candidate whose merged one-hot fits a
+    small budget (``device_hbm_bytes()/64``, >= 64 MiB), on MXU
+    backends only (auto = 1 off-TPU; see ``_mxu_backend``) — and
+    ``COMAP_PAIR_BATCH`` pins it (1 = the pre-batching layout). Merged
+    chunks change the f32 accumulation grouping, so results are equal to
+    the unbatched plan only to rounding, not bit-for-bit.
     """
     pixels = np.asarray(pixels).astype(np.int64).ravel()
     N = pixels.size
@@ -182,20 +239,39 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     # ---- pad pair space to a chunk multiple -----------------------------
     # (min_pair_pad / min_windows let per-shard plans share one compiled
     # program: every shard pads to the fleet maxima)
-    P_pad = _round_up(max(n_pairs_all, 1, min_pair_pad), pair_chunk)
-    pad = P_pad - n_pairs_all
-    # padding pairs carry sentinel rank n_rank / offset n_offsets
-    pair_rank = np.concatenate(
-        [pair_rank, np.full(pad, n_rank, np.int64)])
-    pair_offset = np.concatenate(
-        [pair_offset, np.full(pad, n_offsets, np.int64)])
-    rank_base, rank_window = _window_layout(pair_rank, pair_chunk)
+    def pair_layout(chunk_eff):
+        P_pad = _round_up(max(n_pairs_all, 1, min_pair_pad), chunk_eff)
+        pad = P_pad - n_pairs_all
+        # padding pairs carry sentinel rank n_rank / offset n_offsets
+        pr = np.concatenate([pair_rank, np.full(pad, n_rank, np.int64)])
+        po = np.concatenate(
+            [pair_offset, np.full(pad, n_offsets, np.int64)])
+        rank_base, rank_window = _window_layout(pr, chunk_eff)
+        # offset-order view (pairs sorted by (offset, rank))
+        okey = po * (n_rank + 1) + pr
+        perm_off = np.argsort(okey, kind="stable")
+        off_base, off_window = _window_layout(po[perm_off], chunk_eff)
+        return (pr, po, rank_base, rank_window, perm_off, off_base,
+                off_window)
 
-    # offset-order view (pairs sorted by (offset, rank))
-    okey = pair_offset * (n_rank + 1) + pair_rank
-    pair_perm_off = np.argsort(okey, kind="stable")
-    off_base, off_window = _window_layout(
-        pair_offset[pair_perm_off], pair_chunk)
+    pb = _resolve_pair_batch(pair_batch)
+    if pb == 0 and not _mxu_backend():
+        pb = 1  # merged windows only pay on the MXU (see _mxu_backend)
+    if pb == 0:  # auto: largest candidate whose merged one-hot fits
+        budget = _auto_pair_batch_budget()
+        for cand in _PAIR_BATCH_CANDIDATES:
+            layout = pair_layout(pair_chunk * cand)
+            onehot = pair_chunk * cand * max(layout[3], layout[6],
+                                             int(min_windows[1]),
+                                             int(min_windows[2])) * 4
+            pb = cand
+            if onehot <= budget:
+                break
+    else:
+        layout = pair_layout(pair_chunk * pb)
+    pair_chunk = pair_chunk * pb
+    (pair_rank, pair_offset, rank_base, rank_window, pair_perm_off,
+     off_base, off_window) = layout
     sample_window = max(sample_window, int(min_windows[0]))
     rank_window = max(rank_window, int(min_windows[1]))
     off_window = max(off_window, int(min_windows[2]))
@@ -212,12 +288,13 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
         pair_chunk=int(pair_chunk),
         rank_window=rank_window, rank_base=rank_base,
         pair_perm_off=pair_perm_off.astype(np.int32),
-        off_window=off_window, off_base=off_base)
+        off_window=off_window, off_base=off_base, pair_batch=pb)
 
 
 def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
                         n_shards: int, sample_chunk: int = 8192,
-                        pair_chunk: int = 4096) -> list[PointingPlan]:
+                        pair_chunk: int = 4096,
+                        pair_batch: int | None = None) -> list[PointingPlan]:
     """Per-shard plans over contiguous time shards with identical static
     shapes (one compiled SPMD program) and a shared GLOBAL compact space.
 
@@ -243,15 +320,23 @@ def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
     shards = [pixels[i * shard_n:(i + 1) * shard_n]
               for i in range(n_shards)]
 
-    def build_all(min_pair_pad=0, wins=(0, 0, 0)):
+    def build_all(min_pair_pad=0, wins=(0, 0, 0), pb=pair_batch):
         return [build_pointing_plan(s, npix, offset_length,
                                     sample_chunk=sample_chunk,
                                     pair_chunk=pair_chunk,
                                     min_pair_pad=min_pair_pad,
-                                    min_windows=wins)
+                                    min_windows=wins,
+                                    pair_batch=pb)
                 for s in shards]
 
     plans = build_all()
+    # the shared compiled program needs ONE static layout: auto
+    # pair_batch may differ per shard — force the MINIMUM (the batch
+    # every shard's one-hot budget accepted) before equalising windows,
+    # so the window maxima are measured at the final merged chunk
+    pb = min(p.pair_batch for p in plans)
+    if any(p.pair_batch != pb for p in plans):
+        plans = build_all(pb=pb)
     # second pass: equalise pair padding and window widths across shards
     p_max = max(p.pair_rank.shape[0] for p in plans)
     wins = (max(p.sample_window for p in plans),
@@ -260,7 +345,7 @@ def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
     if (any(p.pair_rank.shape[0] != p_max for p in plans)
             or any((p.sample_window, p.rank_window, p.off_window) != wins
                    for p in plans)):
-        plans = build_all(min_pair_pad=p_max, wins=wins)
+        plans = build_all(min_pair_pad=p_max, wins=wins, pb=pb)
 
     # local -> global rank maps, local rank space padded to a common size.
     # A shard's pairs keep their local sentinel rank (= that shard's own

@@ -29,14 +29,14 @@ import numpy as np
 
 from comapreduce_tpu.ops import gain as gain_ops
 from comapreduce_tpu.ops.atmosphere import fit_airmass_block
-from comapreduce_tpu.ops.average import (edge_channel_mask, normalise_by_rms,
-                                         weighted_band_average)
+from comapreduce_tpu.ops.average import edge_channel_mask
 from comapreduce_tpu.ops.median_filter import medfilt_highpass
-from comapreduce_tpu.ops.stats import masked_median
+from comapreduce_tpu.ops.stats import masked_median, masked_std
 
 __all__ = ["scan_starts_lengths", "extract_scan_blocks",
            "scatter_scan_blocks", "reduce_feed_scans", "ReduceConfig",
-           "estimate_reduce_hbm", "plan_reduce_memory", "device_hbm_bytes"]
+           "estimate_reduce_hbm", "plan_reduce_memory", "device_hbm_bytes",
+           "plan_stage_feed_batch", "stage_feed_batches"]
 
 
 def scan_starts_lengths(edges: np.ndarray, pad_to: int = 128):
@@ -251,6 +251,48 @@ def plan_reduce_memory(feed_batch: int, B: int, C: int, T: int,
         + " (stage option feed_batch=, see docs/OPERATIONS.md §2).")
 
 
+# per-feed working blocks of the lax.map-streamed stage programs
+# (atmosphere fit / frequency bin): the mapped body holds the NaN-filled
+# copy plus fusion slack for ONE feed while the raw counts of the whole
+# chunk stay resident. Conservative, like REDUCE_CHAIN_BLOCKS.
+STAGE_CHAIN_BLOCKS = 3
+
+
+def plan_stage_feed_batch(F: int, B: int, C: int, T: int,
+                          requested: int = 0, n_arrays: int = 1,
+                          hbm_bytes: int | None = None,
+                          headroom: float = 0.9) -> int:
+    """ONE sizing policy for the feed-batched stage programs
+    (SkyDip / AtmosphereRemoval / Level1Averaging — ISSUE 4 satellite:
+    no more hard-coded ``fb`` copies).
+
+    The stage programs ``lax.map`` over the feed axis, so their working
+    set is ONE feed's ``STAGE_CHAIN_BLOCKS`` raw-sized blocks on top of
+    the chunk's resident inputs (``n_arrays`` f32[B, C, T] device arrays
+    per feed — the raw counts, plus e.g. a dense per-feed mask where a
+    stage ships one). Returns the largest feed chunk that fits the HBM
+    budget; ``requested`` > 0 acts as an upper bound (the stage knob),
+    0/None means auto. Always >= 1: a single feed that cannot fit is a
+    geometry problem the downstream OOM reports better than a zero
+    batch would."""
+    budget = int((hbm_bytes or device_hbm_bytes()) * headroom)
+    unit = B * C * T * 4 * max(int(n_arrays), 1)
+    work = STAGE_CHAIN_BLOCKS * B * C * T * 4
+    fit = max((budget - work) // max(unit, 1), 1)
+    fb = F if not requested else min(int(requested), F)
+    return int(max(min(fb, fit), 1))
+
+
+def stage_feed_batches(F: int, B: int, C: int, T: int,
+                       requested: int = 0, n_arrays: int = 1,
+                       hbm_bytes: int | None = None) -> list[list[int]]:
+    """Feed-index chunks for one whole-observation stage pass, sized by
+    :func:`plan_stage_feed_batch` (each chunk = ONE jitted dispatch)."""
+    fb = plan_stage_feed_batch(F, B, C, T, requested=requested,
+                               n_arrays=n_arrays, hbm_bytes=hbm_bytes)
+    return [list(range(i, min(i + fb, F))) for i in range(0, F, fb)]
+
+
 def _fill_bad(tod, mask):
     """Replace masked samples with the per-channel masked median
     (``fill_bad_data``, ``Level1Averaging.py:658-665``).
@@ -268,6 +310,101 @@ def _fill_bad(tod, mask):
     mean = jnp.sum(tod * mask, axis=-1) / jnp.maximum(cnt, 1.0)
     fill = jnp.where(sub_cnt > 0, med, mean)[..., None]
     return jnp.where(mask > 0, tod, fill)
+
+
+def _prefilter_chain(d_s, m_s, a_s, cfg: ReduceConfig):
+    """Fused PRE-FILTER segment of the per-scan chain: NaN fill ->
+    atmosphere (field) or median (calibrator) removal -> radiometer
+    normalisation.
+
+    One module-level home so the compile-inspection pass-count test
+    (``tests/test_reduce.py::test_prefilter_pass_budget``) measures
+    exactly the segment the reduction runs: every step is elementwise /
+    reduction math over one ``(B, C, L)`` scan block and XLA fuses the
+    chain into a handful of logical HBM passes. Returns
+    ``(clean_norm, norm, atmos_fit)``; ``m_s`` must already carry the
+    time-validity mask (the caller's ``tv``)."""
+    B, C, L = d_s.shape
+    # NaN fill is per-scan independent; doing it here (not on the full
+    # block) lets scan_batch streaming bound its memory too
+    d_s = _fill_bad(d_s, m_s)
+    if cfg.is_calibrator:
+        med = masked_median(d_s, m_s, axis=-1)
+        base, slope = med, jnp.zeros_like(med)
+        atmos_fit = jnp.concatenate(
+            [med[:, None, :], jnp.zeros((B, 1, C))], axis=1)
+    else:
+        base, slope = fit_airmass_block(d_s, a_s, m_s)
+        atmos_fit = jnp.stack([base, slope], axis=1)  # (B, 2, C)
+    # radiometer rms straight from the FILLED block on the stride-4
+    # grid: diff(clean) == diff(d) - slope * diff(airmass) (the per-
+    # channel baseline cancels in the pair difference), so the
+    # detrended block is written ONCE — already normalised — instead
+    # of a detrended pass plus a normalising pass
+    # (``normalise_by_rms`` semantics, ``Level1Averaging.py:667-679``)
+    n4 = L // 4 * 4
+    am_d = (a_s[0:n4:4] - a_s[2:n4:4])[None, None, :]
+    diff = (d_s[..., 0:n4:4] - d_s[..., 2:n4:4]) - slope[..., None] * am_d
+    pm = m_s[..., 0:n4:4] * m_s[..., 2:n4:4]
+    rms = masked_std(diff, pm, axis=-1) / jnp.sqrt(2.0)
+    norm = (rms * jnp.sqrt(cfg.bandwidth * cfg.tau))[..., None]
+    safe = jnp.maximum(norm, 1e-30)
+    model = base[..., None] + slope[..., None] * a_s[None, None, :]
+    clean = jnp.where(norm > 0, (d_s - model) / safe, 0.0)
+    return clean, norm, atmos_fit
+
+
+def _postfilter_chain(filtered, m_s, tv, norm, tsys, sys_gain,
+                      freq_scaled, cfg: ReduceConfig):
+    """Fused POST-FILTER segment: gain solve + counts->kelvin band
+    averages in ONE traversal of the filtered block.
+
+    The unfused chain materialised ``sub = filtered - p dg`` and
+    ``in_kelvin = filtered * norm / gain`` as full ``(B, C, L)`` blocks
+    and band-averaged each — three extra logical HBM passes at
+    production shape. With ``kelvin = norm / gain`` per channel the
+    gain template's contribution to the band average is RANK-1::
+
+        wba((filtered - p dg) kelvin, w)
+            = wba(filtered kelvin, w) - (sum_c w p kelvin / sum_c w) dg
+
+    so ``tod_clean`` is ``tod_orig`` minus a per-band coefficient times
+    ``dg`` — no second traversal, no intermediate blocks. Returns
+    ``(tod_clean, tod_orig, weights, dg)`` (each already tv-masked)."""
+    B, C, L = filtered.shape
+    T2, p = gain_ops.build_templates(
+        tsys, freq_scaled, cfg.mask_templates[None, :] * jnp.ones((B, 1)))
+    if cfg.is_calibrator:
+        dg = jnp.zeros((L,), filtered.dtype)
+    else:
+        # natural (B, C, L) block: solve_gain contracts the channel
+        # axes in place (a (B*C, L) reshape costs a layout copy)
+        dg = gain_ops.solve_gain(filtered * m_s, T2, p, time_mask=tv)
+
+    w_tsys = jnp.where(tsys > 0, 1.0 / jnp.maximum(tsys, 1e-10) ** 2, 0.0)
+    w = w_tsys * cfg.mask_weights[None, :] * cfg.mask_band_avg[None, :]
+    safe_gain = jnp.where(sys_gain > 0, sys_gain, 1.0)
+    # tod_original: same exact counts->kelvin reconstruction
+    # (norm/gain), just without the gain-fluctuation subtraction.
+    # Scaling by tsys instead would distort whenever the auto-rms is
+    # contaminated (e.g. by a bright calibrator transit): norm/gain
+    # cancels the normalisation exactly, tsys only approximates it.
+    kelvin = norm[..., 0] / safe_gain                       # (B, C)
+    wk = w * kelvin
+    den = jnp.maximum(jnp.sum(w, axis=-1), 1e-30)[..., None]  # (B, 1)
+    tod_orig = jnp.einsum("...ct,...c->...t", filtered, wk) / den
+    coef = jnp.sum(wk * p.reshape(B, C), axis=-1)[..., None] / den
+    tod_clean = tod_orig - coef * dg[None, :]               # (B, L)
+
+    # per-band weights from the residual's auto-rms
+    n2 = L // 2 * 2
+    diff = (tod_clean[..., 1:n2:2] - tod_clean[..., 0:n2:2])
+    pm = tv[1:n2:2] * tv[0:n2:2]
+    var = jnp.sum(diff * diff * pm, -1) / jnp.maximum(jnp.sum(pm, -1), 1.0)
+    rms2 = var / 2.0
+    w_t = jnp.where(rms2 > 0, 1.0 / jnp.maximum(rms2, 1e-30), 0.0)
+    weights = jnp.broadcast_to(w_t[:, None], (B, L)) * tv[None, :]
+    return (tod_clean * tv[None, :], tod_orig * tv[None, :], weights, dg)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_scans", "L"))
@@ -312,65 +449,17 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
         # materialising a (B, C, L) block. Padding samples are masked by
         # tv here — the one place both call paths share.
         m_s = jnp.broadcast_to(m_s, d_s.shape) * tv
-        # NaN fill is per-scan independent; doing it here (not on the full
-        # block) lets scan_batch streaming bound its memory too
-        d_s = _fill_bad(d_s, m_s)
-        # -- atmosphere (field) or median (calibrator) removal ------------
-        if cfg.is_calibrator:
-            med = masked_median(d_s, m_s, axis=-1)[..., None]
-            clean = d_s - med
-            atmos_fit = jnp.concatenate(
-                [med[..., 0][:, None, :], jnp.zeros((B, 1, C))], axis=1)
-        else:
-            off, slope = fit_airmass_block(d_s, a_s, m_s)
-            clean = d_s - (off[..., None] + slope[..., None] * a_s[None, None, :])
-            atmos_fit = jnp.stack([off, slope], axis=1)  # (B, 2, C)
-
-        # -- radiometer normalisation -------------------------------------
-        clean, norm = normalise_by_rms(clean, m_s, cfg.bandwidth, cfg.tau)
-
-        # -- median-filter high-pass --------------------------------------
+        # two fused elementwise segments around the median filter (the
+        # only stage that genuinely needs its own passes); their pass
+        # budgets are pinned by compile inspection in tests/test_reduce
+        clean, norm, atmos_fit = _prefilter_chain(d_s, m_s, a_s, cfg)
         filtered, _ = medfilt_highpass(clean, cfg.mask_medfilt[None, :]
                                        * jnp.ones((B, 1)), cfg.medfilt_window,
                                        time_mask=tv,
                                        stride=cfg.medfilt_stride)
-
-        # -- gain fluctuation solve ---------------------------------------
-        T2, p = gain_ops.build_templates(
-            tsys, freq_scaled, cfg.mask_templates[None, :] * jnp.ones((B, 1)))
-        if cfg.is_calibrator:
-            dg = jnp.zeros((L,), tod.dtype)
-        else:
-            # natural (B, C, L) block: solve_gain contracts the channel
-            # axes in place (a (B*C, L) reshape costs a layout copy)
-            dg = gain_ops.solve_gain(filtered * m_s, T2, p, time_mask=tv)
-        sub = (filtered - p.reshape(B, C)[..., None] * dg[None, None, :])
-
-        # -- back to kelvin, band average ---------------------------------
-        w_tsys = jnp.where(tsys > 0, 1.0 / jnp.maximum(tsys, 1e-10) ** 2, 0.0)
-        w = w_tsys * cfg.mask_weights[None, :] * cfg.mask_band_avg[None, :]
-        safe_gain = jnp.where(sys_gain > 0, sys_gain, 1.0)
-        residual = sub * norm / safe_gain[..., None]
-        tod_clean = weighted_band_average(residual, w)            # (B, L)
-        # tod_original: same exact counts->kelvin reconstruction
-        # (norm/gain), just without the gain-fluctuation subtraction.
-        # Scaling by tsys instead would distort whenever the auto-rms is
-        # contaminated (e.g. by a bright calibrator transit): norm/gain
-        # cancels the normalisation exactly, tsys only approximates it.
-        in_kelvin = filtered * norm / safe_gain[..., None]
-        tod_orig = weighted_band_average(in_kelvin, w)            # (B, L)
-
-        # per-band weights from the residual's auto-rms
-        n2 = L // 2 * 2
-        diff = (tod_clean[..., 1:n2:2] - tod_clean[..., 0:n2:2])
-        pm = tv[1:n2:2] * tv[0:n2:2]
-        var = jnp.sum(diff * diff * pm, -1) / jnp.maximum(jnp.sum(pm, -1), 1.0)
-        rms2 = var / 2.0
-        w_t = jnp.where(rms2 > 0, 1.0 / jnp.maximum(rms2, 1e-30), 0.0)
-        weights = jnp.broadcast_to(w_t[:, None], (B, L)) * tv[None, :]
-
-        return (tod_clean * tv[None, :], tod_orig * tv[None, :], weights,
-                dg, atmos_fit)
+        tod_clean, tod_orig, weights, dg = _postfilter_chain(
+            filtered, m_s, tv, norm, tsys, sys_gain, freq_scaled, cfg)
+        return tod_clean, tod_orig, weights, dg, atmos_fit
 
     if cfg.scan_batch is not None and cfg.scan_batch < n_scans:
         # stream scans in fixed-size chunks, EXTRACTING inside the loop:

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from comapreduce_tpu.mapmaking.destriper import (DestriperResult, destripe,
+from comapreduce_tpu.mapmaking.destriper import (DestriperResult,
+                                                 _check_precond, destripe,
                                                  destripe_planned)
 from comapreduce_tpu.mapmaking.pointing_plan import PointingPlan
 from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
@@ -51,12 +52,14 @@ __all__ = ["reduce_feeds_sharded", "destripe_sharded",
 
 @functools.lru_cache(maxsize=32)
 def _reduce_feeds_fn(cfg: ReduceConfig, n_scans: int, L: int,
-                     with_mask: bool = True):
+                     with_mask: bool = True, donate_tod: bool = True):
     """Cached jitted vmap-over-feeds reduction (one compile per geometry,
     not one per call — a filelist run calls this once per batch).
 
     ``with_mask=False`` is the NaN-carrying ingest path: the per-feed mask
-    is derived on device (``reduce_feed_scans`` with ``mask=None``)."""
+    is derived on device (``reduce_feed_scans`` with ``mask=None``).
+    ``donate_tod=False`` builds the non-donating variant for callers whose
+    ``tod`` buffer must survive the call (see ``reduce_feeds_sharded``)."""
     if with_mask:
         fn = jax.vmap(
             functools.partial(reduce_feed_scans, cfg=cfg, n_scans=n_scans,
@@ -68,7 +71,12 @@ def _reduce_feeds_fn(cfg: ReduceConfig, n_scans: int, L: int,
                                      tsys, sys_gain, freq, cfg=cfg,
                                      n_scans=n_scans, L=L)
         fn = jax.vmap(one, in_axes=(0, 0, None, None, 0, 0, None))
-    return jax.jit(fn)
+    # donate the raw counts (ISSUE 4 tentpole 1): the stage ships a fresh
+    # batch every call, so XLA may reuse the ~2.2 GB/feed input
+    # allocation for the scan blocks instead of doubling residency.
+    # Accelerator backends only — CPU jit ignores donation and warns.
+    donate = (0,) if donate_tod and jax.default_backend() != "cpu" else ()
+    return jax.jit(fn, donate_argnums=donate)
 
 
 def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
@@ -82,6 +90,13 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     feeds (replicated). Returns the dict of :func:`reduce_feed_scans` with a
     leading feed axis, feed-sharded. ``mask=None`` ships NaN-carrying
     counts and derives validity on device (half the host->device bytes).
+
+    On accelerator backends the ``tod`` buffer is DONATED (XLA reuses
+    the ~2.2 GB/feed raw-counts allocation in place): treat the passed
+    array as consumed. Exception: a ``jax.Array`` already carrying the
+    feed sharding is NOT donated — ``device_put`` would hand the jit the
+    caller's own buffer, and donation must never invalidate an input the
+    caller still owns.
     """
     n_scans = int(starts.shape[0])
     # L is static inside reduce_feed_scans; recover it the same way the
@@ -93,6 +108,13 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     feed_sharded = NamedSharding(mesh, P("feed"))
     repl = NamedSharding(mesh, P())
 
+    # the raw-counts buffer is DONATED on accelerator backends — safe for
+    # host-shipped batches (device_put creates a fresh buffer), but a
+    # caller that pre-placed tod with the feed sharding would get the
+    # SAME buffer back from device_put and donation would invalidate
+    # their copy; use the non-donating program for that case
+    donate_tod = not (isinstance(tod, jax.Array)
+                      and getattr(tod, "sharding", None) == feed_sharded)
     tod = jax.device_put(tod, feed_sharded)
     if mask is not None:
         mask = jax.device_put(mask, feed_sharded)
@@ -103,7 +125,8 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     lengths = jax.device_put(jnp.asarray(lengths), repl)
     freq_scaled = jax.device_put(freq_scaled, repl)
 
-    fn = _reduce_feeds_fn(cfg, n_scans, L, with_mask=mask is not None)
+    fn = _reduce_feeds_fn(cfg, n_scans, L, with_mask=mask is not None,
+                          donate_tod=donate_tod)
     with mesh:
         if mask is None:
             return fn(tod, airmass, starts, lengths, tsys, sys_gain,
@@ -135,8 +158,8 @@ def pad_for_shards(tod, pixels, weights, n_shards: int, offset_length: int,
 def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
                      offset_length: int = 50, n_iter: int = 100,
                      threshold: float = 1e-6,
-                     ground_ids=None, az=None, n_groups: int = 0
-                     ) -> DestriperResult:
+                     ground_ids=None, az=None, n_groups: int = 0,
+                     precond: str = "jacobi") -> DestriperResult:
     """Destripe with the flat time axis sharded over the whole mesh.
 
     ``tod``/``weights`` f32[N], ``pixels`` i32[N]; N is padded here to a
@@ -165,7 +188,8 @@ def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
                         offset_length=offset_length, n_iter=n_iter,
                         threshold=threshold, axis_name=axes,
                         ground_ids=ground_l if with_ground else None,
-                        az=az_l if with_ground else None, n_groups=n_groups)
+                        az=az_l if with_ground else None, n_groups=n_groups,
+                        precond=precond)
 
     out_specs = DestriperResult(
         offsets=shard, ground=repl, destriped_map=repl, naive_map=repl,
@@ -197,7 +221,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                   threshold: float = 1e-6,
                                   n_bands: int = 0,
                                   n_groups: int = 0,
-                                  with_coarse: bool = False):
+                                  with_coarse: bool = False,
+                                  precond: str = "jacobi"):
     """Build a reusable sharded planned-destriper: returns
     ``run(tod, weights) -> DestriperResult``.
 
@@ -226,6 +251,7 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     """
     if n_bands and n_groups:
         raise ValueError("ground solves are single-RHS; run per band")
+    _check_precond(precond, coarse="coarse" if with_coarse else None)
     if with_coarse and n_groups:
         raise ValueError("the sharded ground program keeps Jacobi; "
                          "with_coarse applies to the plain/multi-RHS "
@@ -260,7 +286,7 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                     threshold=threshold, axis_name=axes,
                                     dense_maps=False, device_arrays=arrs,
                                     ground_off=g_off_l, az=az_l,
-                                    n_groups=n_groups)
+                                    n_groups=n_groups, precond=precond)
 
         fn = jax.jit(_shard_map(
             local_g, mesh=mesh,
@@ -281,7 +307,7 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
             return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
                                     threshold=threshold, axis_name=axes,
                                     dense_maps=False, device_arrays=arrs,
-                                    coarse=(grp_l, aci))
+                                    coarse=(grp_l, aci), precond=precond)
 
         fn = jax.jit(_shard_map(
             local_c, mesh=mesh,
@@ -301,7 +327,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
         arrs = {k: v[0] for k, v in arrs.items()}
         return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
                                 threshold=threshold, axis_name=axes,
-                                dense_maps=False, device_arrays=arrs)
+                                dense_maps=False, device_arrays=arrs,
+                                precond=precond)
 
     fn = jax.jit(_shard_map(local, mesh=mesh,
                             in_specs=(v_spec, v_spec, arr_specs),

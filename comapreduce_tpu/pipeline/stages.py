@@ -39,7 +39,8 @@ from comapreduce_tpu.ops import vane as vane_ops
 from comapreduce_tpu.ops.atmosphere import fit_atmosphere_segments
 from comapreduce_tpu.ops.average import edge_channel_mask, frequency_bin
 from comapreduce_tpu.ops.reduce import (ReduceConfig, plan_reduce_memory,
-                                        scan_starts_lengths)
+                                        scan_starts_lengths,
+                                        stage_feed_batches)
 from comapreduce_tpu.ops.spikes import spike_mask
 from comapreduce_tpu.ops.stats import auto_rms
 from comapreduce_tpu.data.scan_edges import segment_ids_from_edges
@@ -253,19 +254,35 @@ class MeasureSystemTemperature(_StageBase):
             feed=0)
 
 
+def _stage_donate(argnums: tuple) -> tuple:
+    """Donate the raw-counts buffer on accelerator backends only: CPU
+    jit ignores donation and warns once per compile — pytest noise for
+    zero benefit. On device, donation lets XLA reuse the 2.2 GB/feed
+    input allocation in place (the NaN-filled copy aliases the raw
+    counts instead of doubling residency)."""
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
 @functools.lru_cache(maxsize=32)
 def _batched_atmosphere_fit(n_scans: int):
-    """Cached jitted vmap-over-feeds atmosphere fit (one compile per scan
-    count, not one per file). Takes NaN-carrying raw counts and a
+    """Cached jitted whole-batch atmosphere fit (one compile per scan
+    count, not one per file): ONE dispatch per feed chunk, feeds
+    streamed by ``lax.map`` so the working set stays one feed's blocks
+    while the planner-sized chunk's raw counts are resident (donated —
+    see ``_stage_donate``). Takes NaN-carrying raw counts and a
     per-feed time mask (f32[n_feeds, T], or [n_feeds, 1] for all-on);
     validity is derived on device so the host never builds or ships a
     dense (B, C, T) mask."""
-    def one(raw, airmass, seg, tmask):
-        mask = jnp.isfinite(raw).astype(jnp.float32) * tmask
-        return fit_atmosphere_segments(jnp.nan_to_num(raw), airmass, seg,
-                                       mask, n_scans=n_scans)
+    def fit_all(raw, airmass, seg, tmask):
+        def one(args):
+            r, a, tm = args
+            mask = jnp.isfinite(r).astype(jnp.float32) * tm
+            return fit_atmosphere_segments(jnp.nan_to_num(r), a, seg,
+                                           mask, n_scans=n_scans)
 
-    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0)))
+        return jax.lax.map(one, (raw, airmass, tmask))
+
+    return jax.jit(fit_all, donate_argnums=_stage_donate((0,)))
 
 
 def apply_fleet_channel_mask(tsys, db_file: str, obsid: int):
@@ -315,9 +332,11 @@ class SkyDip(_StageBase):
     """
 
     groups: tuple = ("skydip",)
-    # feeds per device batch; the default bounds memory at production
-    # scale (a feed is ~2.2 GB of raw counts; see the gain stage)
-    feed_batch: int = 4
+    # feeds per device batch: 0 = auto — the HBM planner
+    # (ops.reduce.plan_stage_feed_batch) picks the largest chunk that
+    # fits, so the whole observation is ONE dispatch wherever the raw
+    # counts fit device memory; a positive value is an upper bound
+    feed_batch: int = 0
     # prior-observation sky-nod mode (-1 = off -> fit the current file)
     sky_nod_obsid: int = -1
     sky_nod_file: str = ""
@@ -359,9 +378,7 @@ class SkyDip(_StageBase):
         airmass_all = np.asarray(data.airmass).astype(np.float32)
         fit = _batched_atmosphere_fit(1)
         fits = np.zeros((F, B, 2, C), np.float32)
-        fb = self.feed_batch or F
-        for i in range(0, F, fb):
-            idx = list(range(i, min(i + fb, F)))
+        for idx in stage_feed_batches(F, B, C, T, self.feed_batch):
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
             if gain is not None:
@@ -443,9 +460,8 @@ class AtmosphereRemoval(_StageBase):
     188-234``), which stores ``atmosphere/fit_values`` (S, F, B, 2, C)."""
 
     groups: tuple = ("atmosphere",)
-    # feeds per device batch; the default bounds memory at production
-    # scale (a feed is ~2.2 GB of raw counts; see the gain stage)
-    feed_batch: int = 4
+    # feeds per device batch: 0 = auto via the HBM planner (see SkyDip)
+    feed_batch: int = 0
 
     def __call__(self, data, level2) -> bool:
         edges = data.scan_edges
@@ -461,9 +477,7 @@ class AtmosphereRemoval(_StageBase):
         airmass_all = np.asarray(data.airmass).astype(np.float32)
         fit = _batched_atmosphere_fit(S)
         out = np.zeros((S, F, B, 2, C), np.float32)
-        fb = self.feed_batch or F
-        for i in range(0, F, fb):
-            idx = list(range(i, min(i + fb, F)))
+        for idx in stage_feed_batches(F, B, C, T, self.feed_batch):
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
             off, atm = fit(jnp.asarray(raw),
@@ -479,18 +493,24 @@ class AtmosphereRemoval(_StageBase):
 
 @functools.lru_cache(maxsize=8)
 def _batched_frequency_bin(bin_size: int):
-    """Cached jitted vmap-over-feeds frequency binner: counts / gain,
-    then the weighted in-bin mean + stddev (one compile per bin size).
+    """Cached jitted whole-batch frequency binner: counts / gain, then
+    the weighted in-bin mean + stddev (one compile per bin size), feeds
+    streamed by ``lax.map`` with the raw counts donated (ONE dispatch
+    per planner-sized feed chunk — see ``_batched_atmosphere_fit``).
     NaN-flagged raw samples carry ZERO weight into the bin average (the
     ``mask=None`` ingest policy) rather than averaging in as zeros —
     validity stays a bool operand so no raw-sized f32 weight tensor is
     ever resident (see ``frequency_bin``)."""
-    def one(raw, gain, weights):
-        valid = jnp.isfinite(raw)
-        tod = raw / jnp.where(gain > 0, gain, 1.0)[..., None]
-        return frequency_bin(tod, weights, bin_size, valid=valid)
+    def bin_all(raw, gain, weights):
+        def one(args):
+            r, g, w = args
+            valid = jnp.isfinite(r)
+            tod = r / jnp.where(g > 0, g, 1.0)[..., None]
+            return frequency_bin(tod, w, bin_size, valid=valid)
 
-    return jax.jit(jax.vmap(one))
+        return jax.lax.map(one, (raw, gain, weights))
+
+    return jax.jit(bin_all, donate_argnums=_stage_donate((0,)))
 
 
 @register()
@@ -510,8 +530,8 @@ class Level1Averaging(_StageBase):
 
     groups: tuple = ("frequency_binned",)
     frequency_bin_size: int = 512
-    # feeds per device batch (a feed is ~2.2 GB of raw counts)
-    feed_batch: int = 4
+    # feeds per device batch: 0 = auto via the HBM planner (see SkyDip)
+    feed_batch: int = 0
     # obsdb file with fleet date-range channel masks (empty = no fleet
     # cut); masked channels get tsys=0 == zero weight
     normalised_mask_db: str = ""
@@ -540,9 +560,7 @@ class Level1Averaging(_StageBase):
         nb = C // bin_size
         tod_out = np.zeros((F, B, nb, T), np.float32)
         std_out = np.zeros((F, B, nb, T), np.float32)
-        fb = self.feed_batch or F
-        for i in range(0, F, fb):
-            idx = list(range(i, min(i + fb, F)))
+        for idx in stage_feed_batches(F, B, C, T, self.feed_batch):
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
             avg, std = fit(jnp.asarray(raw), jnp.asarray(gain[idx]),

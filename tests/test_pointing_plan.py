@@ -301,3 +301,66 @@ def test_ground_ids_per_offset_validates():
     bad = np.arange(200) // 75   # group flips mid-offset
     with pytest.raises(ValueError, match="inside an offset"):
         ground_ids_per_offset(bad, 50)
+
+
+def test_pair_batch_merged_layout_parity(monkeypatch):
+    """ISSUE 4 tentpole 4: a plan built with ``pair_batch > 1`` (several
+    pair-chunk windows merged into one binning step) reproduces the
+    unbatched plan's solve to f32 rounding — merged chunks regroup the
+    accumulation order, never the math. Auto stays at 1 off-TPU (the
+    merged one-hot only pays on the MXU) while COMAP_PAIR_BATCH pins any
+    value on any backend."""
+    rng = np.random.default_rng(11)
+    n, npix, L = 12_800, 256, 50
+    pix = _raster_pixels(n, npix)
+    tod = (np.repeat(rng.normal(0, 1, n // L), L)
+           + 0.3 * rng.normal(size=n)).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, n).astype(np.float32)
+
+    plans = {pb: build_pointing_plan(pix, npix, L, sample_chunk=512,
+                                     pair_chunk=256, pair_batch=pb)
+             for pb in (1, 4)}
+    assert plans[4].pair_chunk == 4 * plans[1].pair_chunk
+    assert plans[4].pair_batch == 4
+    res = {pb: destripe_planned(jnp.asarray(tod), jnp.asarray(w), p,
+                                n_iter=60, threshold=1e-7)
+           for pb, p in plans.items()}
+    np.testing.assert_allclose(np.asarray(res[4].offsets),
+                               np.asarray(res[1].offsets),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(res[4].destriped_map),
+                               np.asarray(res[1].destriped_map),
+                               rtol=1e-4, atol=1e-4)
+    # hit/weight maps are permutation-invariant sums -> near-exact
+    np.testing.assert_array_equal(np.asarray(res[4].hit_map),
+                                  np.asarray(res[1].hit_map))
+
+    import jax
+
+    auto = build_pointing_plan(pix, npix, L, sample_chunk=512,
+                               pair_chunk=256)
+    if jax.default_backend() != "tpu":
+        assert auto.pair_batch == 1      # auto never merges off-MXU
+    monkeypatch.setenv("COMAP_PAIR_BATCH", "2")
+    pinned = build_pointing_plan(pix, npix, L, sample_chunk=512,
+                                 pair_chunk=256)
+    assert pinned.pair_batch == 2        # env pin beats the backend rule
+
+
+def test_sharded_plans_share_one_pair_batch():
+    """build_sharded_plans must hand every shard the SAME merged-chunk
+    layout (one compiled SPMD program): explicit pair_batch propagates,
+    and window equalisation happens at the final merged chunk."""
+    from comapreduce_tpu.mapmaking.pointing_plan import build_sharded_plans
+
+    n, npix, L = 12_800, 256, 50
+    pix = _raster_pixels(n, npix, n_bad=0)
+    plans = build_sharded_plans(pix, npix, L, n_shards=2,
+                                sample_chunk=512, pair_chunk=256,
+                                pair_batch=4)
+    assert len({p.pair_batch for p in plans}) == 1
+    assert plans[0].pair_batch == 4
+    assert len({p.pair_chunk for p in plans}) == 1
+    assert len({(p.sample_window, p.rank_window, p.off_window)
+                for p in plans}) == 1
+    assert len({p.pair_rank.shape[0] for p in plans}) == 1

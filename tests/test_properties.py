@@ -16,6 +16,12 @@ distributions — where the masked/sentinel semantics live.
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
+
+# absent in slim CI images — a graceful module skip, never a collection
+# ERROR (tier-1 runs with --continue-on-collection-errors, where an
+# import crash reads as a silent failure; ROADMAP "Open items")
+pytest.importorskip("hypothesis", reason="hypothesis not installed")
 from hypothesis import given, settings, strategies as st
 from hypothesis.extra import numpy as hnp
 

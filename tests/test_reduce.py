@@ -218,3 +218,80 @@ def test_broadcast_mask_parity():
         np.asarray(solve_gain(y3, T2, p)),
         np.asarray(solve_gain(y3.reshape(B * C, 400), T2, p)),
         rtol=1e-5, atol=1e-6)
+
+
+def test_fused_segment_pass_budgets():
+    """Compile-inspection (ISSUE 4 tentpole 2): the reduction's two fused
+    elementwise segments stay within their logical-HBM-pass budgets.
+
+    "Passes" = compiled bytes-accessed (XLA cost analysis) over the
+    (B, C, L) scan-block bytes. The post-filter segment is the hard
+    contract: the rank-1 gain identity band-averages in ONE traversal of
+    the filtered block — the unfused chain (sub/in_kelvin materialised +
+    two band-average einsums) measured 8.3 pass-equivalents on this same
+    cost model, the fused segment 3.3. The pre-filter bound is looser:
+    its floor is the exact masked-median fill (radix bisection re-reads
+    the stride-4 subsample ~34 times by design); the bound still catches
+    any re-materialisation of the detrended block (the fused segment
+    writes it once, already normalised)."""
+    import functools
+
+    from comapreduce_tpu.ops.reduce import (_postfilter_chain,
+                                            _prefilter_chain)
+
+    B, C, L = 2, 64, 1024
+    block = B * C * L * 4
+
+    def passes(fn, shapes):
+        args = [jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes]
+        compiled = jax.jit(fn).lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(dict(cost).get("bytes accessed", 0.0)) / block
+
+    for calib in (False, True):
+        cfg = ReduceConfig(C, medfilt_window=101, is_calibrator=calib)
+        pre = passes(functools.partial(_prefilter_chain, cfg=cfg),
+                     [(B, C, L), (B, C, L), (L,)])
+        post = passes(functools.partial(_postfilter_chain, cfg=cfg),
+                      [(B, C, L), (B, C, L), (L,), (B, C, 1),
+                       (B, C), (B, C), (B, C)])
+        assert post <= 4.5, (calib, post)
+        assert pre <= 40.0, (calib, pre)
+
+
+def test_stage_feed_batch_policy():
+    """ONE sizing policy for the feed-batched stage programs (ISSUE 4
+    satellite): auto = largest HBM-fitting chunk, an explicit request is
+    an upper bound, and the chunks always cover every feed exactly."""
+    from comapreduce_tpu.ops.reduce import (STAGE_CHAIN_BLOCKS,
+                                            plan_stage_feed_batch,
+                                            stage_feed_batches)
+
+    F, B, C, T = 19, 4, 1024, 80_000
+    unit = B * C * T * 4
+    # budget for 6 feeds resident + the lax.map working blocks (the
+    # headroom factor eats part of it -> expect 5)
+    hbm = int((6 * unit + STAGE_CHAIN_BLOCKS * unit) / 0.9) + unit // 2
+    fb = plan_stage_feed_batch(F, B, C, T, hbm_bytes=hbm)
+    assert 1 <= fb <= 6
+    # a huge budget puts the whole observation in ONE dispatch
+    assert plan_stage_feed_batch(F, B, C, T, hbm_bytes=1 << 50) == F
+    # explicit request is an upper bound, not a pin past the budget
+    assert plan_stage_feed_batch(F, B, C, T, requested=4,
+                                 hbm_bytes=1 << 50) == 4
+    assert plan_stage_feed_batch(F, B, C, T, requested=F + 10,
+                                 hbm_bytes=1 << 50) == F
+    # never zero, even when one feed exceeds the budget (downstream OOM
+    # reports the geometry problem better than a zero batch)
+    assert plan_stage_feed_batch(F, B, C, T, hbm_bytes=unit // 2) == 1
+    # chunks tile the feed axis exactly, in order
+    chunks = stage_feed_batches(F, B, C, T, hbm_bytes=hbm)
+    flat = [i for c in chunks for i in c]
+    assert flat == list(range(F))
+    assert all(len(c) == len(chunks[0]) for c in chunks[:-1])
+    # n_arrays scales the per-feed residency (a stage shipping a dense
+    # mask halves the fitting chunk)
+    assert plan_stage_feed_batch(F, B, C, T, n_arrays=2, hbm_bytes=hbm) \
+        <= plan_stage_feed_batch(F, B, C, T, n_arrays=1, hbm_bytes=hbm)
