@@ -1272,8 +1272,157 @@ def bench_resilience():
     return 0
 
 
+def bench_campaign():
+    """Campaign mode: whole-filelist executor A/B (ISSUE 5).
+
+    Generates N synthetic Level-1 files with realistic shape jitter
+    (per-file scan-sample counts differ, so every file is a distinct
+    ``(T, S, L)`` geometry) and runs the reduction chain over them two
+    ways:
+
+    - **campaign**: shape canonicalisation (one bucket for the whole
+      filelist), persistent compile cache + background AOT warm-up, and
+      async Level-2 writeback — the PR 5 executor;
+    - **baseline**: the pre-campaign executor (per-file exact shapes,
+      synchronous checkpoint writes) — run SECOND so any geometry-
+      independent program it shares with the campaign run is already
+      compiled, biasing the A/B *against* the campaign.
+
+    The first file of each run absorbs cold compiles; the timed segment
+    is files[1:] — the steady state. Reported: steady-state files/hour,
+    backend compiles in the steady segment for both runs (the campaign
+    number gated ``<= bucket_count`` by ``tools/check_perf.py``),
+    persistent-cache hits, and the write-overlap fraction (share of
+    async write seconds hidden behind stage compute).
+
+    Env: ``BENCH_SMALL=1`` tiny shapes; ``BENCH_CAMPAIGN_FILES``
+    overrides the file count.
+    """
+    import shutil
+    import tempfile
+
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.ops.reduce import ShapeBuckets
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.campaign import (CompileCounter,
+                                                   campaign_bucket_set,
+                                                   probe_observation)
+    from comapreduce_tpu.pipeline.stages import (
+        AssignLevel1Data, AtmosphereRemoval, CheckLevel1File,
+        Level1Averaging, Level1AveragingGainCorrection,
+        MeasureSystemTemperature, SkyDip)
+
+    small = os.environ.get("BENCH_SMALL", "") == "1"
+    n_files = int(os.environ.get("BENCH_CAMPAIGN_FILES",
+                                 "3" if small else "8"))
+    base_samples = 400 if small else 800
+    shape = (dict(n_feeds=2, n_bands=1, n_channels=16, n_scans=3,
+                  vane_samples=120) if small else
+             dict(n_feeds=2, n_bands=1, n_channels=32, n_scans=3,
+                  vane_samples=128))
+    quanta = (dict(t_quantum=2048, scan_quantum=4, l_quantum=512)
+              if small else
+              dict(t_quantum=4096, scan_quantum=4, l_quantum=1024))
+
+    def chain():
+        return [CheckLevel1File(min_duration_seconds=0.0),
+                AssignLevel1Data(), MeasureSystemTemperature(),
+                SkyDip(), AtmosphereRemoval(),
+                Level1Averaging(frequency_bin_size=8),
+                Level1AveragingGainCorrection(medfilt_window=301)]
+
+    tmp = tempfile.mkdtemp(prefix="bench_campaign_")
+    try:
+        files = []
+        for i in range(n_files):
+            # deterministic second-level duration jitter: every file a
+            # distinct T (and a mix of L buckets) — the adversarial
+            # filelist for a per-exact-shape compile cache
+            samples = base_samples + ((i * 29) % 97) - 48
+            path = os.path.join(tmp, f"comap-{2000 + i:07d}-synth.hd5")
+            generate_level1_file(path, SyntheticObsParams(
+                obsid=2000 + i, seed=200 + i,
+                scan_samples=samples, **shape))
+            files.append(path)
+
+        buckets = ShapeBuckets(**quanta)
+        shapes = [probe_observation(f) for f in files]
+        bucket_count = len(campaign_bucket_set(shapes, buckets))
+
+        def timed_run(tag, campaign, ingest):
+            outdir = os.path.join(tmp, tag)
+            runner = Runner(processes=chain(), output_dir=outdir,
+                            campaign=campaign, ingest=ingest,
+                            resilience={"quarantine": "off",
+                                        "heartbeat_s": 0})
+            with CompileCounter() as c:
+                runner.run_tod(files[:1])      # absorb cold compiles
+                c_first = c.snapshot()
+                t0 = time.perf_counter()
+                runner.run_tod(files[1:])
+                steady_wall = time.perf_counter() - t0
+                c_end = c.snapshot()
+            steady = {k: c_end[k] - c_first[k] for k in c_end}
+            return steady_wall, steady, dict(runner.writeback_stats)
+
+        cache_dir = os.path.join(tmp, "jaxcache")
+        camp_wall, camp_steady, wb = timed_run(
+            "campaign",
+            campaign={**quanta, "warm_compile": True},
+            ingest={"compile_cache_dir": cache_dir, "writeback": 2})
+
+        # baseline AFTER the campaign run (see docstring) with the
+        # persistent cache off — the pre-PR executor had neither
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        base_wall, base_steady, _ = timed_run("baseline", None, None)
+
+        write_s = wb.get("write_s", 0.0)
+        flush_wait = wb.get("flush_wait_s", 0.0)
+        overlap = (1.0 - flush_wait / write_s) if write_s > 0 else 0.0
+        n_steady = max(n_files - 1, 1)
+        line = {
+            "metric": "campaign_files_per_hour",
+            "value": round(3600.0 * n_steady / camp_wall, 2),
+            "unit": "files/h",
+            "vs_baseline": round(base_wall / camp_wall, 3),
+            "detail": {
+                "config": "campaign",
+                "n_files": n_files,
+                "bucket_count": bucket_count,
+                "quanta": quanta,
+                "raw_shapes": [[s["T"], s["S"], s["L"]] for s in shapes],
+                "steady_wall_s": round(camp_wall, 4),
+                "baseline_steady_wall_s": round(base_wall, 4),
+                # backend_compiles counts compile REQUESTS; with the
+                # persistent cache on, a request can be a fast disk hit
+                # (cache_hits) — cache_misses is the true XLA-compile
+                # count of the steady segment
+                "compiles_campaign_steady":
+                    camp_steady["backend_compiles"],
+                "compiles_baseline_steady":
+                    base_steady["backend_compiles"],
+                "cache_hits": camp_steady["cache_hits"],
+                "cache_misses": camp_steady["cache_misses"],
+                "writeback": {k: round(v, 4) if isinstance(v, float)
+                              else v for k, v in wb.items()},
+                "write_overlap_fraction":
+                    round(max(min(overlap, 1.0), 0.0), 3),
+            },
+        }
+        print(json.dumps(line))
+        write_evidence("campaign", lambda: None, extra=line["detail"],
+                       host_only=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
 _CONFIGS = {"1": bench_config1, "2": bench_config2, "4": bench_config4,
-            "ingest": bench_ingest, "resilience": bench_resilience}
+            "ingest": bench_ingest, "resilience": bench_resilience,
+            "campaign": bench_campaign}
 
 
 if __name__ == "__main__":

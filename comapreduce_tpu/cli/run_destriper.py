@@ -651,21 +651,34 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     return datas, _expand_joint_results(res, uniq, npix, nb)
 
 
-def write_band_map(path, data, result):
-    """Write destriped/naive/weight/hit maps (``run_destriper.py:19-77``)."""
+def band_map_writer(path, data, result):
+    """Materialise the (small) output maps and return a zero-arg writer
+    over them. The async writeback path submits THIS closure — it
+    captures only the maps plus the wcs/pixel geometry, never the
+    band's full ``data`` (GB-scale TOD/pointing arrays must not stay
+    alive on the write queue while later bands load theirs)."""
     maps = {
         "DESTRIPED": np.asarray(result.destriped_map),
         "NAIVE": np.asarray(result.naive_map),
         "WEIGHTS": np.asarray(result.weight_map),
         "HITS": np.asarray(result.hit_map),
     }
-    if data.wcs is not None:
-        shaped = {k: v.reshape(data.wcs.ny, data.wcs.nx)
-                  for k, v in maps.items()}
-        write_fits_image(path, shaped,
-                         header=dict(data.wcs.header_cards()))
-    else:
-        write_healpix_map(path, maps, data.sky_pixels, data.nside)
+    wcs, sky_pixels, nside = data.wcs, data.sky_pixels, data.nside
+
+    def write() -> None:
+        if wcs is not None:
+            shaped = {k: v.reshape(wcs.ny, wcs.nx)
+                      for k, v in maps.items()}
+            write_fits_image(path, shaped, header=dict(wcs.header_cards()))
+        else:
+            write_healpix_map(path, maps, sky_pixels, nside)
+
+    return write
+
+
+def write_band_map(path, data, result):
+    """Write destriped/naive/weight/hit maps (``run_destriper.py:19-77``)."""
+    band_map_writer(path, data, result)()
 
 
 def main(argv=None) -> int:
@@ -738,6 +751,13 @@ def main(argv=None) -> int:
     ingest_cfg = IngestConfig.from_mapping(inputs)  # normalises knobs
     prefetch = ingest_cfg.prefetch
     cache = ingest_cfg.make_cache()
+    if ingest_cfg.compile_cache_dir:
+        # persistent XLA compile cache (docs/OPERATIONS.md §9): repeat
+        # destriper runs (new bands, reruns after quarantine lifts)
+        # skip the CG program compiles entirely
+        from comapreduce_tpu.pipeline.campaign import enable_compile_cache
+
+        enable_compile_cache(ingest_cfg.compile_cache_dir)
 
     # resilience layer (docs/OPERATIONS.md §7): `[Resilience]` section
     # tunes the quarantine ledger / retry policy / chaos injection; ONE
@@ -753,6 +773,17 @@ def main(argv=None) -> int:
         res_cfg = dataclasses.replace(res_cfg, retry_quarantined=True)
     resilience = res_cfg.make_runtime(out_dir, rank=rank,
                                       n_ranks=n_ranks)
+    writeback = None
+    if ingest_cfg.writeback >= 1:
+        # async map writeback (docs/OPERATIONS.md §9): band N+1's CG
+        # solve overlaps band N's FITS write on the background writer;
+        # the flush barrier below surfaces any write error before exit
+        from comapreduce_tpu.data.writeback import Writeback
+
+        writeback = Writeback(depth=ingest_cfg.writeback,
+                              watchdog=resilience.watchdog,
+                              chaos=resilience.chaos,
+                              name="map-writeback")
     if resilience.heartbeat is not None:
         # per-rank liveness for the whole mapping run (read by sibling
         # ranks' straggler barriers and tools/watchdog_report.py)
@@ -814,7 +845,10 @@ def main(argv=None) -> int:
                 precond=precond, pair_batch=pair_batch)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
-        write_band_map(path, data, result)
+        if writeback is None:
+            write_band_map(path, data, result)
+        else:
+            writeback.submit(path, band_map_writer(path, data, result))
         print(f"band {band}: {len(data.files)} files, "
               f"{data.tod.size} samples, {int(result.n_iter)} CG iters, "
               f"residual {float(result.residual):.2e} -> {path}")
@@ -832,6 +866,13 @@ def main(argv=None) -> int:
                 if coarse_block
                 else " — consider [Inputs] coarse_precond : 8 "
                 "(two-level preconditioner; docs/OPERATIONS.md §3)")
+    if writeback is not None:
+        # the exit barrier: every queued map committed (or this run
+        # fails loudly) before the CLI reports success
+        try:
+            writeback.flush()
+        finally:
+            writeback.close()
     if resilience.ledger is not None and resilience.ledger.entries:
         print(f"quarantine ledger {resilience.ledger.path}: "
               f"{resilience.ledger.summary()}")

@@ -1,0 +1,393 @@
+"""Ordered asynchronous Level-2 writeback.
+
+The Runner's checkpoint-after-every-stage contract (the Level-2 file IS
+the checkpoint, ``Running.py:152-153``) serialises device compute
+behind host HDF5 writes: at production shape the ``averaged_tod`` group
+alone is hundreds of MB, and the synchronous atomic write blocks the
+stage chain while the accelerator idles. MAPPRAISER treats exactly this
+whole-campaign write overlap as a first-class throughput concern; this
+module is the mirror of the ingest :class:`~comapreduce_tpu.ingest
+.prefetcher.Prefetcher` for the OUTPUT side — one background writer
+thread, a bounded queue, per-file error capture, poisoning on hang.
+
+Contract (what makes the async path safe to substitute for the sync
+one):
+
+- **Ordering.** One FIFO worker commits jobs in submission order.
+  Each :meth:`submit_store` snapshot is the *cumulative* Level-2 state,
+  so a later commit always supersedes an earlier one for the same
+  path. A generation guard (``os.replace`` runs under a lock, gated on
+  the submission counter) means a write that was hang-cancelled and
+  later limps to completion on its abandoned worker thread can NEVER
+  clobber a newer committed checkpoint — late commits are skipped, and
+  counted in ``stats['late_skips']``.
+- **Durability.** Store writes stage into a temp file in the target
+  directory and commit through :func:`~comapreduce_tpu.data.durable
+  .durable_replace` — fsync-before-rename (+ POSIX directory fsync)
+  when ``durable=True`` (default), so a SIGKILL or power cut mid-async-
+  write leaves either the complete old checkpoint or the complete new
+  one, never a torn file (same guarantee as the synchronous
+  ``HDF5Store.write(atomic=True)``).
+- **Per-file flush barrier.** :meth:`flush` blocks until every queued
+  job for a path committed and re-raises the first captured error for
+  it — the Runner calls it at the end of each file's stage chain, so a
+  failed/hung write surfaces inside the SAME per-file retry/quarantine
+  net the synchronous write error would have hit, and by the time a
+  file's result slot exists its checkpoint is on disk (resume,
+  quarantine and kill-mid-write semantics are unchanged; only the
+  *intra-file* stage writes overlap compute).
+- **Failure isolation.** After a job for a path fails (or hangs), later
+  queued jobs for that SAME path are dropped (their content is stale
+  relative to the failure and committing one could reorder around the
+  abandoned write); other paths are unaffected. ``flush`` clears the
+  error it raises, so a chain re-run (the Runner's retry policy) can
+  resubmit cleanly.
+
+Supervision: with a ``resilience.Watchdog`` each write runs cancellably
+under the ``writeback.write`` deadline — a writer stuck in HDF5/NFS C
+code is abandoned at the hard deadline (``HangError``, the PR 3
+``hang`` failure class: retried like a transient by the chain retry,
+ledgered ``rejected`` on exhaustion, never quarantining the input).
+A ``resilience.ChaosMonkey`` with a ``write_stall`` fault stalls the
+write *inside* the supervised region, so drills exercise the cancel
+path end to end (``resilience/drill.py`` criterion 6).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+__all__ = ["Writeback", "snapshot_store"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_POLL_S = 0.1  # stop-event poll period (the Prefetcher's constant)
+
+
+def snapshot_store(store) -> dict:
+    """Host snapshot of an :class:`~comapreduce_tpu.data.hdf5io
+    .HDF5Store` for asynchronous writing: lazy datasets materialised,
+    dict structure copied (arrays shared — stages deposit fresh arrays
+    and never mutate in place, the ingest payload contract)."""
+    for path in list(store.keys()):
+        store.materialise(path)
+    return store.export_payload()
+
+
+@dataclass
+class _Job:
+    path: str
+    gen: int
+    fn: Callable[[], None]
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+
+class Writeback:
+    """Background writer with per-path ordering, flush barriers and
+    durable commits (module docstring has the full contract).
+
+    Parameters
+    ----------
+    depth:
+        Queue bound — at most ``depth`` snapshots wait in the queue
+        (plus the one being written). Size host memory accordingly:
+        each Level-2 snapshot holds the file's full reduced content.
+    durable:
+        Default commit durability (fsync-before-rename through
+        ``data.durable.durable_replace``); per-submit override wins.
+    watchdog / chaos:
+        Optional ``resilience`` hooks: the watchdog supervises each
+        write under the ``writeback.write`` deadline (hard deadline ->
+        cancel + ``HangError`` captured for the path); the chaos monkey
+        injects ``write_stall`` faults inside the supervised region.
+    on_hang:
+        Called with the in-flight path when :meth:`close` abandons a
+        writer that never returned (mirror of the Prefetcher's hook).
+    """
+
+    def __init__(self, depth: int = 2, durable: bool = True,
+                 watchdog=None, chaos=None, on_hang=None,
+                 name: str = "level2-writeback"):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.durable = bool(durable)
+        self._watchdog = watchdog
+        self._chaos = chaos
+        self._on_hang = on_hang
+        self._queue: queue.Queue = queue.Queue(maxsize=int(depth))
+        self._stop = threading.Event()
+        self._poisoned = False
+        self._inflight: str | None = None
+        self._lock = threading.Lock()          # errors/stats/cond
+        self._done = threading.Condition(self._lock)
+        # the commit gate gets its OWN lock: a durable commit fsyncs
+        # the whole checkpoint (seconds on slow storage), and holding
+        # the main lock through it would block submit_store — i.e. the
+        # stage chain — exactly the serialisation this module removes.
+        # Only an abandoned (hang-cancelled) writer limping to its own
+        # commit ever contends here
+        self._commit_lock = threading.Lock()   # committed_gen + replace
+        self._gen = 0
+        self._pending: dict[str, int] = {}     # path -> queued job count
+        self._errors: dict[str, BaseException] = {}
+        self._committed_gen: dict[str, int] = {}
+        self.stats = {"writes": 0, "write_s": 0.0, "flush_wait_s": 0.0,
+                      "bytes": 0, "dropped": 0, "late_skips": 0}
+        self._thread = threading.Thread(target=self._work, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit_store(self, path: str, payload: dict,
+                     durable: bool | None = None) -> None:
+        """Queue one durable atomic write of ``payload`` (a
+        :func:`snapshot_store` dict) to ``path``."""
+        durable = self.durable if durable is None else bool(durable)
+        job = self._make_job(path)
+        job.fn = self._store_writer(payload, path, durable, job)
+        self._enqueue(job)
+
+    def submit(self, path: str, fn: Callable[[], None]) -> None:
+        """Queue an arbitrary write callable (e.g. a FITS map write).
+        The callable owns its own atomicity; the generation guard of
+        :meth:`submit_store` does not apply — use this only for
+        terminal, written-once outputs."""
+        job = self._make_job(path)
+        job.fn = fn
+        self._enqueue(job)
+
+    def _make_job(self, path: str) -> _Job:
+        if self._poisoned:
+            raise RuntimeError(
+                "Writeback is poisoned (its worker hung and was "
+                "abandoned); build a fresh Writeback")
+        with self._lock:
+            # a path that already failed fails fast at the NEXT submit
+            # (the synchronous path would have raised at the earlier
+            # write; surfacing here keeps the chain from burning more
+            # stages on a dead output) — flush() is the other exit
+            err = self._errors.pop(path, None)
+            if err is not None:
+                raise err
+            self._gen += 1
+            return _Job(path=path, gen=self._gen, fn=lambda: None)
+
+    def _enqueue(self, job: _Job) -> None:
+        with self._lock:
+            self._pending[job.path] = self._pending.get(job.path, 0) + 1
+        while not self._stop.is_set():
+            try:
+                self._queue.put(job, timeout=_POLL_S)
+                return
+            except queue.Full:
+                continue
+        with self._lock:   # closed under the submitter's feet
+            self._pending[job.path] -= 1
+        raise RuntimeError("Writeback is closed")
+
+    # -- the store write (durable, generation-guarded) -----------------------
+    def _store_writer(self, payload: dict, path: str, durable: bool,
+                      job: _Job) -> Callable[[], None]:
+        def write() -> None:
+            from comapreduce_tpu.data.durable import durable_replace
+            from comapreduce_tpu.data.hdf5io import HDF5Store
+
+            store = HDF5Store(name="writeback")
+            store.adopt_payload(payload)
+            d = os.path.dirname(os.path.abspath(path)) or "."
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(suffix=".hd5.tmp", dir=d)
+            os.close(fd)
+            try:
+                store._write_into(tmp, "w")
+                n_bytes = os.path.getsize(tmp)
+                with self._commit_lock:
+                    # the commit gate: a hang-cancelled write finishing
+                    # late on its abandoned worker thread must never
+                    # replace a newer committed checkpoint — and a
+                    # cancelled job must not commit at all (its path
+                    # already failed over it)
+                    stale = (job.cancelled.is_set()
+                             or self._committed_gen.get(path, -1)
+                             > job.gen)
+                    if not stale:
+                        durable_replace(tmp, path, durable=durable)
+                        self._committed_gen[path] = job.gen
+                if stale:
+                    os.unlink(tmp)
+                    with self._lock:
+                        self.stats["late_skips"] += 1
+                    logger.warning(
+                        "writeback: stale/cancelled write of %s "
+                        "(gen %d) skipped at commit", path, job.gen)
+                else:
+                    with self._lock:
+                        self.stats["bytes"] += n_bytes
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+
+        return write
+
+    # -- worker --------------------------------------------------------------
+    def _work(self) -> None:
+        while True:
+            try:
+                job = self._queue.get(timeout=_POLL_S)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if job is None:   # close() sentinel after drain
+                return
+            self._inflight = job.path
+            try:
+                with self._lock:
+                    failed = job.path in self._errors
+                if failed:
+                    # a later snapshot of a failed path is dropped: the
+                    # failure (possibly an abandoned in-flight write)
+                    # makes any commit after it a potential reorder
+                    with self._lock:
+                        self.stats["dropped"] += 1
+                else:
+                    self._run_job(job)
+            except BaseException as exc:  # noqa: BLE001 — per-path net
+                job.cancelled.set()
+                with self._lock:
+                    self._errors.setdefault(job.path, exc)
+                logger.error("writeback: write of %s failed: %s: %s",
+                             job.path, type(exc).__name__, exc)
+            finally:
+                self._inflight = None
+                with self._lock:
+                    self._pending[job.path] -= 1
+                    self._done.notify_all()
+
+    def _run_job(self, job: _Job) -> None:
+        fn = job.fn
+        if self._chaos is not None:
+            chaos, inner = self._chaos, fn
+
+            def fn(path=job.path, inner=inner):
+                # the stall sits INSIDE the supervised region so the
+                # watchdog's hard deadline cancels it like a real
+                # stuck-in-C-code write would be
+                chaos.stall_write(path)
+                inner()
+        t0 = time.perf_counter()
+        try:
+            if self._watchdog is not None:
+                self._watchdog.call(fn, "writeback.write", unit=job.path)
+            else:
+                fn()
+        finally:
+            with self._lock:
+                self.stats["write_s"] += time.perf_counter() - t0
+        with self._lock:
+            self.stats["writes"] += 1
+
+    # -- barriers ------------------------------------------------------------
+    def flush(self, path: str | None = None,
+              timeout: float | None = None) -> None:
+        """Block until every queued job (for ``path``, or for every
+        path) has committed or failed; re-raise (and clear) the first
+        captured error. The Runner's per-file barrier."""
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        t0 = time.perf_counter()
+        try:
+            with self._done:
+                def drained():
+                    if path is None:
+                        return not any(self._pending.values())
+                    return self._pending.get(path, 0) == 0
+
+                while not drained():
+                    if self._poisoned:
+                        break
+                    if not self._thread.is_alive():
+                        raise RuntimeError(
+                            "Writeback worker died with writes pending")
+                    remaining = _POLL_S if deadline is None else \
+                        min(_POLL_S, deadline - time.monotonic())
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"writeback flush timed out "
+                            f"({timeout:.1f} s) with writes pending")
+                    self._done.wait(timeout=remaining)
+                if self._poisoned and not drained():
+                    # an abandoned worker means these writes never
+                    # committed: the caller must see a failure, never a
+                    # silent "flushed" (its file would look checkpointed
+                    # while the bytes are in limbo)
+                    err = (self._errors.pop(path, None) if path is not None
+                           else None)
+                    raise err or RuntimeError(
+                        "Writeback is poisoned (worker hung) with "
+                        "writes pending"
+                        + (f" for {path}" if path else ""))
+                if path is None:
+                    errs = list(self._errors.items())
+                    self._errors.clear()
+                    if errs:
+                        raise errs[0][1]
+                else:
+                    err = self._errors.pop(path, None)
+                    if err is not None:
+                        raise err
+        finally:
+            with self._lock:
+                self.stats["flush_wait_s"] += time.perf_counter() - t0
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Drain the queue, stop the worker and join it. Idempotent.
+        Captured errors are NOT raised here (close runs in ``finally``
+        blocks) — callers that care flush first. A worker that does not
+        stop (stuck in C code past any watchdog budget) is abandoned:
+        the writeback is poisoned and ``on_hang`` reports the in-flight
+        path."""
+        if not self._thread.is_alive():
+            self._stop.set()
+            return
+        try:
+            self._queue.put(None, timeout=timeout)
+        except queue.Full:
+            pass
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            inflight = self._inflight
+            self._poisoned = True
+            with self._done:
+                self._done.notify_all()
+            logger.warning(
+                "Writeback: worker did not stop within %.1f s "
+                "(writer stuck in C code?); abandoning it%s and "
+                "poisoning the writeback", timeout,
+                f" mid-write of {inflight}" if inflight else "")
+            if inflight and self._on_hang is not None:
+                try:
+                    self._on_hang(inflight)
+                except Exception:  # pragma: no cover - ledger I/O
+                    logger.exception(
+                        "Writeback: on_hang callback failed for %s",
+                        inflight)
+        with self._lock:
+            for p, err in self._errors.items():
+                logger.error("writeback: unflushed error for %s: %s: %s",
+                             p, type(err).__name__, err)
+
+    def __enter__(self) -> "Writeback":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

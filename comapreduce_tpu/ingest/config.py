@@ -30,12 +30,28 @@ class IngestConfig:
         ``spectrometer/tod`` dataset on the worker thread (that *is*
         the read being overlapped); the serial path keeps it lazy as
         before. Only consulted when ``prefetch >= 1``.
+    compile_cache_dir:
+        JAX persistent compilation cache directory (empty = off).
+        Compiled programs are reused across processes, so steady-state
+        campaign runs never XLA-compile on the critical path — and the
+        ``[campaign] warm_compile`` AOT warm-up lands its results here
+        (docs/OPERATIONS.md §9).
+    writeback:
+        Async Level-2 writeback queue depth. 0 (default) keeps the
+        synchronous checkpoint write; ``>= 1`` snapshots each stage
+        checkpoint to host and commits it on an ordered background
+        writer (``data/writeback.py``) with a per-file flush barrier —
+        resume/quarantine/kill semantics unchanged, stage compute
+        overlaps the write. Size host memory for ``writeback + 1``
+        Level-2 snapshots.
     """
 
     prefetch: int = 0
     cache_mb: float = 0.0
     spill_dir: str = ""
     eager_tod: bool = True
+    compile_cache_dir: str = ""
+    writeback: int = 0
 
     def __post_init__(self):
         # normalise once, here, instead of at every consumer: INI
@@ -50,10 +66,15 @@ class IngestConfig:
         object.__setattr__(self, "eager_tod",
                            True if self.eager_tod is None
                            else bool(self.eager_tod))
+        object.__setattr__(self, "compile_cache_dir",
+                           str(self.compile_cache_dir or ""))
+        object.__setattr__(self, "writeback",
+                           max(int(self.writeback or 0), 0))
 
     # the knob names, once — every config entry point (TOML [ingest]
     # table, INI [Inputs] keys, CLI flags) extracts against this tuple
-    KNOBS = ("prefetch", "cache_mb", "spill_dir", "eager_tod")
+    KNOBS = ("prefetch", "cache_mb", "spill_dir", "eager_tod",
+             "compile_cache_dir", "writeback")
 
     @classmethod
     def from_mapping(cls, mapping) -> "IngestConfig":

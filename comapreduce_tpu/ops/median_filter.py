@@ -51,13 +51,25 @@ MAX_EXACT_WINDOW = 512
                    static_argnames=("window", "chunk", "stride", "pad_mode"))
 def rolling_median(x: jax.Array, window: int, chunk: int = 256,
                    stride: int | None = None,
-                   pad_mode: str = "edge") -> jax.Array:
+                   pad_mode: str = "edge",
+                   fold_len: jax.Array | None = None) -> jax.Array:
     """Centered rolling median along the last axis, edge-replicate padded.
 
     ``x``: f32[..., T]; ``window`` static. Output[..., i] is the median of
     ``x[..., i-(w-1)//2 : i+w//2]`` with out-of-range samples replaced by the
     edge value — the streaming equivalent of the C++ ``Mediator`` filter's
     interior behavior.
+
+    ``fold_len``: optional DYNAMIC (traced i32 scalar) boundary for the
+    symmetric reflection — window samples are gathered through a
+    symmetric fold into ``[0, fold_len)`` instead of reflecting at the
+    static block end ``T``. This is the campaign shape-canonicalisation
+    hook (docs/OPERATIONS.md §9): a scan block padded from its per-file
+    length ``L_raw`` up to a bucket length ``Lb`` filters bit-identically
+    to the unpadded block when ``fold_len = L_raw``, because the fold is
+    a VALUE, not a shape — one compiled program serves every file in the
+    bucket. Requires ``pad_mode='symmetric'``; equals the static pad
+    exactly when ``fold_len == T``.
 
     ``stride``: approximation/performance knob. ``stride=1`` is exact;
     ``None`` picks ``ceil(window / MAX_EXACT_WINDOW)`` — exact up to
@@ -86,8 +98,21 @@ def rolling_median(x: jax.Array, window: int, chunk: int = 256,
     T = x.shape[-1]
     left = (window - 1) // 2
     right = window - 1 - left
-    pad_width = [(0, 0)] * (x.ndim - 1) + [(left, right)]
-    padded = jnp.pad(x, pad_width, mode=pad_mode)
+    if fold_len is not None:
+        if pad_mode != "symmetric":
+            raise ValueError("fold_len requires pad_mode='symmetric'")
+        # symmetric reflection at the DYNAMIC boundary: position i of the
+        # padded series reads x[fold(i)] with the period-2n fold
+        # (..., x1, x0 | x0, x1, ..., x_{n-1} | x_{n-1}, ...) — numpy's
+        # 'symmetric' rule at n = fold_len, multi-reflection included
+        n = jnp.asarray(fold_len, jnp.int32)
+        pos = jnp.arange(T + window - 1, dtype=jnp.int32) - left
+        m = jnp.mod(pos, 2 * n)
+        src = jnp.clip(jnp.where(m < n, m, 2 * n - 1 - m), 0, T - 1)
+        padded = jnp.take(x, src, axis=-1, mode="clip")
+    else:
+        pad_width = [(0, 0)] * (x.ndim - 1) + [(left, right)]
+        padded = jnp.pad(x, pad_width, mode=pad_mode)
 
     if stride > 1:
         # two-level median: decimate by block medians, exact rolling
@@ -185,7 +210,8 @@ def _reflect3(x: jax.Array) -> jax.Array:
 @functools.partial(jax.jit, static_argnames=("window", "chunk", "stride"))
 def medfilt_highpass(tod: jax.Array, channel_mask: jax.Array, window: int,
                      chunk: int = 256, time_mask: jax.Array | None = None,
-                     stride: int | None = None):
+                     stride: int | None = None,
+                     fold_len: jax.Array | None = None):
     """Median-filter high-pass of a (B, C, T) block, reference semantics.
 
     Per band (``Level1Averaging.py:681-708``):
@@ -200,6 +226,10 @@ def medfilt_highpass(tod: jax.Array, channel_mask: jax.Array, window: int,
     by their padding. ``stride``: forwarded to :func:`rolling_median` —
     ``1`` forces the exact filter at any window, ``None`` uses the
     two-level block-median filter beyond ``MAX_EXACT_WINDOW``.
+    ``fold_len``: optional dynamic reflection boundary (traced i32
+    scalar) forwarded to :func:`rolling_median` — the campaign padding
+    hook: a block padded past its per-file length filters identically to
+    the unpadded block when ``fold_len`` carries that length.
 
     Returns ``(filtered, medfilt_tod)`` where ``filtered`` is (B, C, T)
     with excluded channels zeroed and ``medfilt_tod`` is (B, T). Batch
@@ -214,8 +244,14 @@ def medfilt_highpass(tod: jax.Array, channel_mask: jax.Array, window: int,
         # symmetric boundary = the reference's 3x reflect padding without
         # computing the discarded outer thirds (3x less sort work)
         med = rolling_median(mean_tod, window, chunk=chunk,
-                             stride=stride, pad_mode="symmetric")
+                             stride=stride, pad_mode="symmetric",
+                             fold_len=fold_len)
     else:
+        if fold_len is not None:
+            raise NotImplementedError(
+                "fold_len with window >= 2T (the 3x-reflect branch) is "
+                "unused: the reduction clamps its window to the unpadded "
+                "block length")
         padded = _reflect3(mean_tod)
         med = rolling_median(padded, window, chunk=chunk,
                              stride=stride)[..., T:2 * T]

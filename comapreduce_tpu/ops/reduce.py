@@ -36,7 +36,8 @@ from comapreduce_tpu.ops.stats import masked_median, masked_std
 __all__ = ["scan_starts_lengths", "extract_scan_blocks",
            "scatter_scan_blocks", "reduce_feed_scans", "ReduceConfig",
            "estimate_reduce_hbm", "plan_reduce_memory", "device_hbm_bytes",
-           "plan_stage_feed_batch", "stage_feed_batches"]
+           "plan_stage_feed_batch", "stage_feed_batches", "ShapeBuckets",
+           "pad_time_axis", "pad_scan_geometry"]
 
 
 def scan_starts_lengths(edges: np.ndarray, pad_to: int = 128):
@@ -293,6 +294,142 @@ def stage_feed_batches(F: int, B: int, C: int, T: int,
     return [list(range(i, min(i + fb, F))) for i in range(0, F, fb)]
 
 
+class ShapeBuckets:
+    """Campaign-level shape canonicalisation policy (ISSUE 5 tentpole 1).
+
+    Every distinct ``(T, S, L)`` observation geometry is its own XLA
+    compile of the flagship programs; a production filelist (hundreds
+    of obsIDs with second-level duration jitter) would recompile them
+    per file. This policy rounds each axis UP to a quantum grid so the
+    whole campaign lands in a small set of canonical buckets — programs
+    compile once per bucket and are reused across every file:
+
+    - ``t_quantum``   rounds the time axis ``T`` (padded tail shipped
+      as NaN -> zero validity on device; outputs sliced back to ``T``);
+    - ``scan_quantum`` rounds the scan count ``S`` (padding scans have
+      ``length == 0``: their ``t_valid`` row is all-zero, and
+      ``scatter_scan_blocks`` routes every one of their samples to the
+      dropped junk slot);
+    - ``l_quantum``   rounds the padded scan-block length ``L`` on top
+      of ``scan_starts_lengths``'s ``pad_to`` grid (the masked-tail
+      semantics of ``extract_scan_blocks`` already carry any ``L`` >=
+      the longest scan).
+
+    A quantum of 0 leaves that axis untouched (the per-file exact
+    shape — zero behaviour change for existing configs). The padding
+    overhead is bounded: at most ``quantum - 1`` extra samples per
+    axis, i.e. a fractional compute/memory overhead under
+    ``quantum / axis_length`` per padded axis (see
+    :meth:`overhead_bound`). Value-hashable like :class:`ReduceConfig`.
+    """
+
+    def __init__(self, t_quantum: int = 0, scan_quantum: int = 0,
+                 l_quantum: int = 0):
+        self.t_quantum = max(int(t_quantum or 0), 0)
+        self.scan_quantum = max(int(scan_quantum or 0), 0)
+        self.l_quantum = max(int(l_quantum or 0), 0)
+
+    def _key(self):
+        return (self.t_quantum, self.scan_quantum, self.l_quantum)
+
+    def __eq__(self, other):
+        return (type(other) is ShapeBuckets and
+                self._key() == other._key())
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return (f"ShapeBuckets(t_quantum={self.t_quantum}, "
+                f"scan_quantum={self.scan_quantum}, "
+                f"l_quantum={self.l_quantum})")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.t_quantum or self.scan_quantum or self.l_quantum)
+
+    @staticmethod
+    def _up(n: int, q: int) -> int:
+        return int(n) if q <= 0 or n <= 0 else -(-int(n) // q) * q
+
+    def round_T(self, T: int) -> int:
+        return self._up(T, self.t_quantum)
+
+    def round_S(self, S: int) -> int:
+        return self._up(S, self.scan_quantum)
+
+    def round_L(self, L: int) -> int:
+        return self._up(L, self.l_quantum)
+
+    def canonical(self, T: int, S: int, L: int) -> tuple:
+        """The bucket ``(T, S, L)`` falls in."""
+        return (self.round_T(T), self.round_S(S), self.round_L(L))
+
+    def overhead_bound(self, T: int, S: int, L: int) -> float:
+        """Upper bound on the fractional sample-count overhead of
+        padding ``(T, S, L)`` to its bucket — the documented cost of
+        the policy (docs/OPERATIONS.md §9)."""
+        Tb, Sb, Lb = self.canonical(T, S, L)
+        raw = max(T, 1) * max(S, 1) * max(L, 1)
+        return (Tb * max(Sb, 1) * max(Lb, 1)) / raw - 1.0
+
+    @classmethod
+    def coerce(cls, value) -> "ShapeBuckets":
+        """None / dict / ShapeBuckets -> ShapeBuckets (config plumbing;
+        ``None`` is the disabled identity policy)."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            known = {k: value[k] for k in
+                     ("t_quantum", "scan_quantum", "l_quantum")
+                     if k in value}
+            unknown = set(value) - set(known)
+            if unknown:
+                raise ValueError(
+                    f"unknown shape-bucket keys: {sorted(unknown)}")
+            return cls(**known)
+        raise TypeError(f"cannot build ShapeBuckets from {type(value)}")
+
+
+def pad_time_axis(x: np.ndarray, n_to: int,
+                  fill: str = "nan") -> np.ndarray:
+    """Pad a host array's trailing (time) axis up to ``n_to`` samples.
+
+    ``fill='nan'`` marks the tail INVALID for the ``mask=None`` device
+    path (``isfinite`` -> zero weight); ``'edge'`` repeats the last
+    sample — for operands that must stay finite because they multiply
+    into masked sums (``0 * NaN`` is NaN, so a NaN airmass tail would
+    poison a zero-weight reduction); ``'zero'`` for masks."""
+    n = int(x.shape[-1])
+    n_to = int(n_to)
+    if n_to <= n:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, n_to - n)]
+    if fill == "edge":
+        return np.pad(x, pad, mode="edge")
+    if fill == "zero":
+        return np.pad(x, pad)
+    return np.pad(x, pad, constant_values=np.nan)
+
+
+def pad_scan_geometry(starts: np.ndarray, lengths: np.ndarray,
+                      n_to: int):
+    """Pad scan ``starts``/``lengths`` up to ``n_to`` scans with
+    zero-length scans at start 0 (all-masked: ``t_valid`` rows are
+    all-zero and the scatter drops every sample)."""
+    S = len(starts)
+    n_to = int(n_to)
+    if n_to <= S:
+        return starts, lengths
+    z = np.zeros(n_to - S, dtype=np.asarray(starts).dtype)
+    return (np.concatenate([np.asarray(starts), z]),
+            np.concatenate([np.asarray(lengths),
+                            np.zeros(n_to - S,
+                                     np.asarray(lengths).dtype)]))
+
+
 def _fill_bad(tod, mask):
     """Replace masked samples with the per-channel masked median
     (``fill_bad_data``, ``Level1Averaging.py:658-665``).
@@ -410,7 +547,7 @@ def _postfilter_chain(filtered, m_s, tv, norm, tsys, sys_gain,
 @functools.partial(jax.jit, static_argnames=("cfg", "n_scans", "L"))
 def reduce_feed_scans(tod, mask, airmass, starts, lengths,
                       tsys, sys_gain, freq_scaled, cfg: ReduceConfig,
-                      n_scans: int, L: int):
+                      n_scans: int, L: int, fold_len=None):
     """Full reduction of one feed's observation.
 
     Parameters
@@ -429,6 +566,15 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
     starts, lengths: i32[S] scan geometry (host-derived, static count).
     tsys, sys_gain:  f32[B, C] from the vane calibration.
     freq_scaled:     f32[B, C] ``(nu-nu0)/nu0`` for the gain templates.
+    fold_len:   optional DYNAMIC i32 scalar: the per-file scan-block
+                length the median filter reflects at. A campaign shape
+                policy (``ShapeBuckets``) pads ``L`` up to a bucket; the
+                filter's symmetric boundary must stay at the UNPADDED
+                length or windows near a scan's end would mirror
+                different samples and break bucketed-vs-exact parity
+                (docs/OPERATIONS.md §9). ``None`` reflects at the static
+                ``L`` (the pre-campaign behaviour, exact when ``L`` is
+                the per-file length).
 
     Returns dict with ``tod`` (gain-subtracted, calibrated, band-averaged,
     f32[B, T]), ``tod_original`` (no gain subtraction), ``weights``
@@ -456,7 +602,8 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
         filtered, _ = medfilt_highpass(clean, cfg.mask_medfilt[None, :]
                                        * jnp.ones((B, 1)), cfg.medfilt_window,
                                        time_mask=tv,
-                                       stride=cfg.medfilt_stride)
+                                       stride=cfg.medfilt_stride,
+                                       fold_len=fold_len)
         tod_clean, tod_orig, weights, dg = _postfilter_chain(
             filtered, m_s, tv, norm, tsys, sys_gain, freq_scaled, cfg)
         return tod_clean, tod_orig, weights, dg, atmos_fit

@@ -52,25 +52,41 @@ __all__ = ["reduce_feeds_sharded", "destripe_sharded",
 
 @functools.lru_cache(maxsize=32)
 def _reduce_feeds_fn(cfg: ReduceConfig, n_scans: int, L: int,
-                     with_mask: bool = True, donate_tod: bool = True):
+                     with_mask: bool = True, donate_tod: bool = True,
+                     with_fold: bool = False):
     """Cached jitted vmap-over-feeds reduction (one compile per geometry,
     not one per call — a filelist run calls this once per batch).
 
     ``with_mask=False`` is the NaN-carrying ingest path: the per-feed mask
     is derived on device (``reduce_feed_scans`` with ``mask=None``).
     ``donate_tod=False`` builds the non-donating variant for callers whose
-    ``tod`` buffer must survive the call (see ``reduce_feeds_sharded``)."""
+    ``tod`` buffer must survive the call (see ``reduce_feeds_sharded``).
+    ``with_fold=True`` appends a trailing DYNAMIC ``fold_len`` i32 scalar
+    operand (the per-file scan-block length the median filter reflects
+    at) — the campaign shape policy's one value-dynamic knob, so every
+    file of a bucket shares this single compile."""
+    fold_axis = (None,) if with_fold else ()
     if with_mask:
-        fn = jax.vmap(
-            functools.partial(reduce_feed_scans, cfg=cfg, n_scans=n_scans,
-                              L=L),
-            in_axes=(0, 0, 0, None, None, 0, 0, None))
+        # keyword-bind cfg/n_scans/L through a wrapper: appending the
+        # fold tracer POSITIONALLY to a partial would land it on the
+        # static ``cfg`` parameter and fail at trace time
+        def one(tod, mask, airmass, starts, lengths, tsys, sys_gain,
+                freq, *fold):
+            return reduce_feed_scans(tod, mask, airmass, starts, lengths,
+                                     tsys, sys_gain, freq, cfg=cfg,
+                                     n_scans=n_scans, L=L,
+                                     fold_len=fold[0] if fold else None)
+        fn = jax.vmap(one, in_axes=(0, 0, 0, None, None, 0, 0, None)
+                      + fold_axis)
     else:
-        def one(tod, airmass, starts, lengths, tsys, sys_gain, freq):
+        def one(tod, airmass, starts, lengths, tsys, sys_gain, freq,
+                *fold):
             return reduce_feed_scans(tod, None, airmass, starts, lengths,
                                      tsys, sys_gain, freq, cfg=cfg,
-                                     n_scans=n_scans, L=L)
-        fn = jax.vmap(one, in_axes=(0, 0, None, None, 0, 0, None))
+                                     n_scans=n_scans, L=L,
+                                     fold_len=fold[0] if fold else None)
+        fn = jax.vmap(one, in_axes=(0, 0, None, None, 0, 0, None)
+                      + fold_axis)
     # donate the raw counts (ISSUE 4 tentpole 1): the stage ships a fresh
     # batch every call, so XLA may reuse the ~2.2 GB/feed input
     # allocation for the scan blocks instead of doubling residency.
@@ -80,7 +96,9 @@ def _reduce_feeds_fn(cfg: ReduceConfig, n_scans: int, L: int,
 
 
 def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
-                         tsys, sys_gain, freq_scaled, cfg: ReduceConfig):
+                         tsys, sys_gain, freq_scaled, cfg: ReduceConfig,
+                         L: int | None = None,
+                         fold_len: int | None = None):
     """Run :func:`reduce_feed_scans` for every feed, feeds sharded over the
     ``'feed'`` mesh axis.
 
@@ -99,11 +117,17 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     caller still owns.
     """
     n_scans = int(starts.shape[0])
-    # L is static inside reduce_feed_scans; recover it the same way the
-    # single-feed path does (scan blocks are padded to this length).
-    _, _, L = scan_starts_lengths(
-        np.stack([np.asarray(starts), np.asarray(starts) + np.asarray(lengths)],
-                 axis=1))
+    if L is None:
+        # L is static inside reduce_feed_scans; recover it the same way
+        # the single-feed path does (scan blocks are padded to this
+        # length). A caller running a campaign shape policy passes its
+        # canonical L explicitly instead — the masked-tail extract
+        # semantics carry any L >= the longest scan.
+        _, _, L = scan_starts_lengths(
+            np.stack([np.asarray(starts),
+                      np.asarray(starts) + np.asarray(lengths)],
+                     axis=1))
+    L = int(L)
 
     feed_sharded = NamedSharding(mesh, P("feed"))
     repl = NamedSharding(mesh, P())
@@ -124,15 +148,20 @@ def reduce_feeds_sharded(mesh: Mesh, tod, mask, airmass, starts, lengths,
     starts = jax.device_put(jnp.asarray(starts), repl)
     lengths = jax.device_put(jnp.asarray(lengths), repl)
     freq_scaled = jax.device_put(freq_scaled, repl)
+    # the campaign policy's one value-dynamic operand: the per-file
+    # block length the median filter reflects at (see reduce_feed_scans)
+    fold = () if fold_len is None else (
+        jax.device_put(jnp.asarray(int(fold_len), jnp.int32), repl),)
 
     fn = _reduce_feeds_fn(cfg, n_scans, L, with_mask=mask is not None,
-                          donate_tod=donate_tod)
+                          donate_tod=donate_tod,
+                          with_fold=fold_len is not None)
     with mesh:
         if mask is None:
             return fn(tod, airmass, starts, lengths, tsys, sys_gain,
-                      freq_scaled)
+                      freq_scaled, *fold)
         return fn(tod, mask, airmass, starts, lengths, tsys,
-                  sys_gain, freq_scaled)
+                  sys_gain, freq_scaled, *fold)
 
 
 def pad_for_shards(tod, pixels, weights, n_shards: int, offset_length: int,

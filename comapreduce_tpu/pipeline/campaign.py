@@ -1,0 +1,372 @@
+"""Campaign executor support: shape buckets, compile warm-up, counters.
+
+PR 4 made ONE observation nearly roofline-optimal; a production
+campaign is hundreds of Level-1 files, and today every distinct
+``(T, S, L)`` geometry recompiles the flagship programs on the
+critical path. This module moves the unit of optimisation from "one
+observation" to "the filelist" (ISSUE 5 tentpole):
+
+- :class:`CampaignConfig` — the ``[campaign]`` TOML table / ``[Campaign]``
+  INI section: the :class:`~comapreduce_tpu.ops.reduce.ShapeBuckets`
+  quanta plus the ``warm_compile`` switch. All defaults off: zero
+  behaviour change for existing configs.
+- :func:`enable_compile_cache` — turns on JAX's persistent compilation
+  cache (the ``[ingest] compile_cache_dir`` knob): compiled programs
+  are keyed by HLO and reused across *processes*, so a steady-state
+  campaign run never XLA-compiles on the critical path.
+- :func:`start_warmup` / :class:`Warmup` — AOT-compiles
+  (``jit(...).lower().compile()``) the campaign's bucket set on a
+  background thread, overlapped with the first file's prefetch. AOT
+  compiles do NOT prime a jit's in-process dispatch cache (measured:
+  the next call still triggers a backend compile request), but with the
+  persistent cache enabled that request is a disk HIT — which is why
+  warm-up requires ``compile_cache_dir`` and is skipped (loudly)
+  without it.
+- :class:`CompileCounter` — compile observability through
+  ``jax.monitoring`` event hooks: backend-compile requests and
+  persistent-cache hits/misses. ``bench.py`` reports them
+  (``compile_count`` / ``cache_hit_count``) and
+  ``tools/check_perf.py`` gates steady-state recompiles against the
+  bucket count.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from comapreduce_tpu.ops.reduce import ShapeBuckets, scan_starts_lengths
+
+__all__ = ["CampaignConfig", "CompileCounter", "enable_compile_cache",
+           "probe_observation", "campaign_bucket_set", "Warmup",
+           "start_warmup"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+
+@dataclass(frozen=True)
+class CampaignConfig:
+    """Knobs for the campaign-throughput layer.
+
+    t_quantum / scan_quantum / l_quantum:
+        :class:`~comapreduce_tpu.ops.reduce.ShapeBuckets` quanta — each
+        axis of an observation's ``(T, S, L)`` geometry is rounded UP
+        to its quantum so a whole filelist shares a small set of
+        compiled program shapes (0 = that axis stays per-file exact).
+        Padded samples are masked (NaN tail -> zero validity;
+        zero-length scans dropped by the scatter), so bucketed outputs
+        match the unpadded path (pinned by ``tests/test_campaign.py``).
+        Worst-case padding overhead per axis is ``quantum - 1``
+        samples; keep quanta a few percent of the axis (e.g.
+        ``t_quantum = 4096`` against a 135k-sample production T).
+    warm_compile:
+        AOT-compile the campaign's bucket set on a background thread
+        overlapped with the first file's prefetch. Requires
+        ``[ingest] compile_cache_dir`` (AOT results reach the steady
+        state only through the persistent cache); without it the
+        warm-up is skipped with a warning.
+    """
+
+    t_quantum: int = 0
+    scan_quantum: int = 0
+    l_quantum: int = 0
+    warm_compile: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(self, "t_quantum",
+                           max(int(self.t_quantum or 0), 0))
+        object.__setattr__(self, "scan_quantum",
+                           max(int(self.scan_quantum or 0), 0))
+        object.__setattr__(self, "l_quantum",
+                           max(int(self.l_quantum or 0), 0))
+        object.__setattr__(self, "warm_compile",
+                           bool(self.warm_compile))
+
+    KNOBS = ("t_quantum", "scan_quantum", "l_quantum", "warm_compile")
+
+    @classmethod
+    def coerce(cls, value) -> "CampaignConfig":
+        """Build from None / dict / CampaignConfig. A dedicated
+        ``[campaign]`` table rejects unknown keys (typo'd knobs raise
+        at config load, the ResilienceConfig contract)."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            known = {k: value[k] for k in cls.KNOBS if k in value}
+            unknown = set(value) - set(known)
+            if unknown:
+                raise ValueError(
+                    f"unknown campaign keys: {sorted(unknown)}")
+            return cls(**known)
+        raise TypeError(f"cannot build CampaignConfig from {type(value)}")
+
+    def shape_buckets(self) -> ShapeBuckets:
+        return ShapeBuckets(t_quantum=self.t_quantum,
+                            scan_quantum=self.scan_quantum,
+                            l_quantum=self.l_quantum)
+
+
+# --------------------------------------------------------------------------
+# Persistent compilation cache
+# --------------------------------------------------------------------------
+
+_CACHE_DIR_ENABLED: str | None = None
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Enable JAX's persistent compilation cache at ``cache_dir``
+    (idempotent; returns True when active). Thresholds are dropped to
+    zero so even the quick CI shapes cache — the default floors would
+    silently skip small programs and the no-recompile gate could never
+    observe a hit."""
+    global _CACHE_DIR_ENABLED
+    if not cache_dir:
+        return False
+    if _CACHE_DIR_ENABLED == cache_dir:
+        return True
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for knob, value in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                        ("jax_persistent_cache_min_compile_time_secs", 0)):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # older jax: thresholds unknown — cache still on
+            logger.info("compile cache: %s unsupported on this jax", knob)
+    try:
+        # jax latches its is-cache-used decision at the FIRST backend
+        # compile of the process; any jit call before this knob was set
+        # would have frozen "no cache" for the process lifetime. Reset
+        # the latch (and the in-memory cache object) so enabling
+        # mid-process takes effect — the campaign CLI path sets the knob
+        # before the first file, but library users may not.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - private API moved
+        logger.warning("compile cache: could not reset jax's cache "
+                       "latch; a pre-existing compile may have pinned "
+                       "the cache off for this process")
+    _CACHE_DIR_ENABLED = cache_dir
+    logger.info("persistent compilation cache enabled at %s", cache_dir)
+    return True
+
+
+# --------------------------------------------------------------------------
+# Compile-event observability
+# --------------------------------------------------------------------------
+
+_ACTIVE_COUNTERS: list = []
+_HOOKS_INSTALLED = False
+_HOOK_LOCK = threading.Lock()
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        for c in list(_ACTIVE_COUNTERS):
+            c._bump("cache_hits")
+    elif event == "/jax/compilation_cache/cache_misses":
+        for c in list(_ACTIVE_COUNTERS):
+            c._bump("cache_misses")
+
+
+def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
+    if event.endswith("backend_compile_duration"):
+        for c in list(_ACTIVE_COUNTERS):
+            c._bump("backend_compiles", duration_secs)
+
+
+def _install_hooks() -> None:
+    global _HOOKS_INSTALLED
+    with _HOOK_LOCK:
+        if _HOOKS_INSTALLED:
+            return
+        import jax
+
+        # jax.monitoring has no per-listener removal, so ONE pair of
+        # module-level dispatchers is registered for the process
+        # lifetime and counters attach/detach from _ACTIVE_COUNTERS
+        jax.monitoring.register_event_listener(_on_event)
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _HOOKS_INSTALLED = True
+
+
+class CompileCounter:
+    """Counts XLA compile activity through ``jax.monitoring`` hooks.
+
+    - ``backend_compiles``: compile REQUESTS that reached the backend
+      (in-process jit-cache misses). With the persistent cache enabled
+      a request can still be a fast disk hit — split by
+      ``cache_hits`` / ``cache_misses``. In a steady-state campaign
+      (shapes canonicalised, programs in the in-process caches) this
+      stays at zero per file, which is what the no-recompile gate
+      measures.
+    - ``compile_s``: wall seconds spent in backend compiles.
+
+    Use :meth:`install` / :meth:`remove` (or as a context manager);
+    :meth:`snapshot` returns a plain dict copy for deltas.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = {"backend_compiles": 0, "cache_hits": 0,
+                       "cache_misses": 0, "compile_s": 0.0}
+
+    def _bump(self, key: str, duration: float = 0.0) -> None:
+        with self._lock:
+            self.counts[key] += 1
+            if duration:
+                self.counts["compile_s"] += float(duration)
+
+    def install(self) -> "CompileCounter":
+        _install_hooks()
+        if self not in _ACTIVE_COUNTERS:
+            _ACTIVE_COUNTERS.append(self)
+        return self
+
+    def remove(self) -> None:
+        try:
+            _ACTIVE_COUNTERS.remove(self)
+        except ValueError:
+            pass
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.counts)
+
+    def __enter__(self) -> "CompileCounter":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.remove()
+
+
+# --------------------------------------------------------------------------
+# Bucket probing + AOT warm-up
+# --------------------------------------------------------------------------
+
+def probe_observation(path: str, pad_to: int = 128) -> dict:
+    """Header-only geometry probe of one Level-1 file: ``{F, B, C, T,
+    S, L, calibrator}``. Reads the TOD *shape* and the (small) feature/
+    housekeeping streams; the multi-GB TOD itself stays on disk — cheap
+    enough to probe a whole campaign on the warm-up thread."""
+    from comapreduce_tpu.data.level import COMAPLevel1
+
+    data = COMAPLevel1()
+    data.read(path)
+    try:
+        F, B, C, T = (int(x) for x in data.tod_shape)
+        edges = np.asarray(data.scan_edges)
+        calibrator = bool(data.is_calibrator)
+    finally:
+        data.close()
+    if len(edges):
+        _, _, L = scan_starts_lengths(edges, pad_to=pad_to)
+    else:
+        L = int(pad_to)
+    return {"F": F, "B": B, "C": C, "T": T, "S": int(len(edges)),
+            "L": int(L), "calibrator": calibrator}
+
+
+def campaign_bucket_set(shapes, buckets: ShapeBuckets) -> set:
+    """Distinct canonical buckets of a probed shape list:
+    ``{(F, B, C, Tb, Sb, Lb, calibrator)}`` — the campaign's compile
+    budget (one program set per member)."""
+    out = set()
+    for s in shapes:
+        Tb, Sb, Lb = buckets.canonical(s["T"], s["S"], s["L"])
+        out.add((s["F"], s["B"], s["C"], Tb, Sb, Lb,
+                 bool(s.get("calibrator", False))))
+    return out
+
+
+class Warmup:
+    """Background AOT warm-up of the campaign's bucket set.
+
+    Probes every file's geometry, canonicalises it, and calls each
+    stage's ``warm_programs(**shape)`` hook once per distinct bucket —
+    the stages AOT-compile (``lower().compile()``) exactly the programs
+    their ``__call__`` will launch, at exactly the canonical shapes, so
+    the persistent cache is hot before the first file's stage chain
+    runs. Failures are logged, never fatal: warm-up is an optimisation,
+    the inline compile path remains correct.
+    """
+
+    def __init__(self, stages, files, pad_to: int = 128,
+                 buckets: ShapeBuckets | None = None):
+        self._stages = [s for s in stages
+                        if callable(getattr(s, "warm_programs", None))]
+        self._files = list(files)
+        self._pad_to = int(pad_to)
+        self._buckets = buckets
+        self.warmed: list[dict] = []
+        self.errors: list[str] = []
+        self.shapes: list[dict] = []
+        self._thread = threading.Thread(target=self._run,
+                                        name="campaign-warmup",
+                                        daemon=True)
+
+    def start(self) -> "Warmup":
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout=timeout)
+
+    @property
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def _run(self) -> None:
+        seen: set = set()
+        for path in self._files:
+            try:
+                shape = probe_observation(path, pad_to=self._pad_to)
+            except Exception as exc:  # noqa: BLE001 — probe-only
+                self.errors.append(f"probe {path}: {exc!r}")
+                continue
+            self.shapes.append(shape)
+            # dedup on the CANONICAL bucket when the campaign policy is
+            # known (a jittered 500-file campaign must warm ~bucket-set
+            # programs, not ~500x; each stage still applies its own
+            # policy inside warm_programs — the same one its __call__
+            # uses — so warm and run can never disagree on shapes).
+            # Without a policy, dedup on the raw geometry. Warm-up is
+            # best-effort either way: a rare same-bucket program
+            # variant (e.g. a file whose unpadded L undercuts a stage's
+            # filter window) just compiles inline on first use.
+            if self._buckets is not None:
+                key = ((shape["F"], shape["B"], shape["C"])
+                       + self._buckets.canonical(shape["T"], shape["S"],
+                                                 shape["L"])
+                       + (bool(shape.get("calibrator", False)),))
+            else:
+                key = tuple(sorted(shape.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            for stage in self._stages:
+                try:
+                    stage.warm_programs(**shape)
+                    self.warmed.append(
+                        {"stage": getattr(stage, "name",
+                                          type(stage).__name__), **shape})
+                except Exception as exc:  # noqa: BLE001 — best effort
+                    self.errors.append(
+                        f"{type(stage).__name__} @ {shape}: {exc!r}")
+                    logger.warning(
+                        "campaign warm-up: %s failed for %s: %s",
+                        type(stage).__name__, shape, exc)
+
+
+def start_warmup(stages, files, pad_to: int = 128,
+                 buckets: ShapeBuckets | None = None) -> Warmup:
+    """Start (and return) a daemon :class:`Warmup` over ``files``."""
+    return Warmup(stages, files, pad_to=pad_to, buckets=buckets).start()

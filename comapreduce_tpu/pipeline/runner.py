@@ -102,6 +102,18 @@ class Runner:
     # <output_dir>/quarantine.jsonl and retries transient reads;
     # {"quarantine": "off"} restores the bare BAD-FILE-log behaviour.
     resilience: object = None
+    # campaign-throughput knob (TOML [campaign] / INI [Campaign]):
+    # CampaignConfig | {"t_quantum": ..., "warm_compile": ...} | None.
+    # Shape canonicalisation pads each observation up to its campaign
+    # bucket so the fused programs compile once per bucket, not once
+    # per file; warm_compile AOT-compiles the bucket set on a
+    # background thread (needs [ingest] compile_cache_dir). All off by
+    # default (docs/OPERATIONS.md §9).
+    campaign: object = None
+    # cumulative async-writeback stats ({"writes", "write_s",
+    # "flush_wait_s", ...}) across this Runner's run_tod calls — the
+    # bench's write-overlap observable
+    writeback_stats: dict = field(default_factory=dict)
     # the BlockCache lives on the Runner, not the run_tod call: a
     # reduction pass followed by run_astro_cal (run_average's flow) or
     # a second run_tod re-reads the same Level-1 files, and a per-call
@@ -111,6 +123,9 @@ class Runner:
     # for the same reason as the cache: run_astro_cal and repeated
     # run_tod calls must consult ONE ledger
     _resilience: object = field(default=None, repr=False)
+    # the live async writer during a run_tod call (None = synchronous
+    # checkpoint writes, the default)
+    _writeback: object = field(default=None, repr=False)
 
     def shard_iter(self, filelist):
         """Lazy round-robin shard: rank r takes files ``i % n_ranks == r``.
@@ -142,13 +157,66 @@ class Runner:
         (no result slot) until ``retry_quarantined`` re-admits them.
         """
         from comapreduce_tpu.ingest import IngestConfig, level1_stream
+        from comapreduce_tpu.pipeline.campaign import CampaignConfig
 
         os.makedirs(self.output_dir, exist_ok=True)
         cfg = IngestConfig.coerce(self.ingest)
+        camp = CampaignConfig.coerce(self.campaign)
+        buckets = camp.shape_buckets()
+        if buckets.enabled:
+            # campaign shape canonicalisation (docs/OPERATIONS.md §9):
+            # stages pad each observation up to its bucket so the fused
+            # programs compile once per bucket, not once per file
+            for p in self.processes:
+                if hasattr(p, "shape_buckets"):
+                    p.shape_buckets = buckets
+        if cfg.compile_cache_dir:
+            from comapreduce_tpu.pipeline.campaign import \
+                enable_compile_cache
+
+            enable_compile_cache(cfg.compile_cache_dir)
         if self._ingest_cache is None:
             self._ingest_cache = cfg.make_cache()
         cache = self._ingest_cache
         res = self._resilience_runtime()
+        if camp.warm_compile:
+            # AOT warm-up of the campaign's bucket set, overlapped with
+            # the first file's prefetch (a daemon thread: probe every
+            # file's geometry, lower+compile each stage's programs once
+            # per bucket). AOT results reach the run only through the
+            # persistent compile cache, so it is a hard prerequisite.
+            if not cfg.compile_cache_dir:
+                logger.warning(
+                    "campaign warm_compile needs [ingest] "
+                    "compile_cache_dir (AOT compiles reach the run only "
+                    "through the persistent cache); skipping warm-up")
+            elif not isinstance(filelist, (list, tuple)):
+                logger.warning(
+                    "campaign warm_compile needs a concrete filelist "
+                    "(got a one-shot iterable); skipping warm-up")
+            else:
+                from comapreduce_tpu.pipeline.campaign import \
+                    start_warmup
+
+                start_warmup(self.processes, self.shard(list(filelist)),
+                             buckets=buckets)
+        wb = None
+        if cfg.writeback >= 1:
+            # async Level-2 writeback (docs/OPERATIONS.md §9): stage
+            # checkpoints snapshot to host and commit on an ordered
+            # background writer; the per-file flush barrier in
+            # _run_file keeps resume/quarantine/kill semantics
+            # byte-identical to the synchronous path
+            from comapreduce_tpu.data.writeback import Writeback
+
+            wb = Writeback(
+                depth=cfg.writeback, watchdog=res.watchdog,
+                chaos=res.chaos,
+                on_hang=lambda f: res.record_hang(
+                    f, stage="writeback.write",
+                    message="checkpoint write never returned; "
+                            "writer abandoned"))
+        self._writeback = wb
         results = []
         stream = level1_stream(self._admitted(filelist, res),
                                prefetch=cfg.prefetch, cache=cache,
@@ -173,6 +241,14 @@ class Runner:
             # the per-file net does not catch and the caller keeps the
             # traceback alive: closing the generator stops the worker
             stream.close()
+            if wb is not None:
+                self._writeback = None
+                try:
+                    wb.close()
+                finally:
+                    for k, v in wb.stats.items():
+                        self.writeback_stats[k] = \
+                            self.writeback_stats.get(k, 0) + v
             if res.heartbeat is not None:
                 res.heartbeat.stop(final_stage="run_tod.done")
         if res.ledger is not None and res.ledger.entries:
@@ -427,8 +503,19 @@ class Runner:
             lvl2.update(process)
             # checkpoint after EVERY stage; atomic so a kill mid-write
             # can't strand a half-written group that resume would skip
-            lvl2.write(lvl2.filename, atomic=True)
+            # (async under [ingest] writeback: the snapshot queues on
+            # the ordered background writer and the NEXT stage's device
+            # compute overlaps this write)
+            self._checkpoint(lvl2)
             wrote = True
+        if self._writeback is not None:
+            # per-file flush barrier: every queued checkpoint for this
+            # file commits (durably) before the file's result exists.
+            # A failed/hung async write surfaces HERE — inside the same
+            # per-file retry/quarantine net a synchronous write error
+            # would have hit — so resume, quarantine and kill-mid-write
+            # semantics are byte-identical to the synchronous path.
+            self._writeback.flush(lvl2.filename)
         res = self._resilience_runtime()
         if wrote and res.ledger is not None and \
                 res.ledger.is_quarantined(lvl2.filename):
@@ -442,6 +529,18 @@ class Runner:
                               message="checkpoint rewritten by "
                                       "re-reduction")
         return lvl2
+
+    def _checkpoint(self, lvl2) -> None:
+        """One stage checkpoint: synchronous atomic write, or — with
+        ``[ingest] writeback >= 1`` — a host snapshot queued on the
+        ordered background writer (``data/writeback.py``)."""
+        wb = self._writeback
+        if wb is None:
+            lvl2.write(lvl2.filename, atomic=True)
+            return
+        from comapreduce_tpu.data.writeback import snapshot_store
+
+        wb.submit_store(lvl2.filename, snapshot_store(lvl2))
 
     def run_astro_cal(self, filelist: list[str],
                       calibrator_level2: list[str],
@@ -477,11 +576,17 @@ class Runner:
         ``backend``; each ``[StageName]`` section holds that stage's
         kwargs (including per-stage ``backend``/``overwrite``). An
         optional ``[ingest]`` table (``prefetch``, ``cache_mb``,
-        ``spill_dir``) turns on streaming ingest (docs/ingest.md); an
+        ``spill_dir``, ``compile_cache_dir``, ``writeback``) turns on
+        streaming ingest / the persistent compile cache / async
+        writeback (docs/ingest.md, docs/OPERATIONS.md §9); an
         optional ``[resilience]`` table (``quarantine``,
         ``max_retries``, ``inject``, ...) tunes the quarantine/retry/
-        chaos layer (docs/OPERATIONS.md §7)."""
+        chaos layer (docs/OPERATIONS.md §7); an optional ``[campaign]``
+        table (``t_quantum``, ``scan_quantum``, ``l_quantum``,
+        ``warm_compile``) turns on the campaign shape policy and
+        compile warm-up (docs/OPERATIONS.md §9)."""
         from comapreduce_tpu.ingest import IngestConfig
+        from comapreduce_tpu.pipeline.campaign import CampaignConfig
         from comapreduce_tpu.resilience import ResilienceConfig
 
         if isinstance(config, str):
@@ -499,15 +604,19 @@ class Runner:
                    rank=rank, n_ranks=n_ranks,
                    ingest=IngestConfig.coerce(config.get("ingest")),
                    resilience=ResilienceConfig.coerce(
-                       config.get("resilience")))
+                       config.get("resilience")),
+                   campaign=CampaignConfig.coerce(
+                       config.get("campaign")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,
                            n_ranks: int = 1) -> "Runner":
         """Build from a legacy INI (``Module.Class(variant)`` registry,
         ``Tools/Parser.py:44-96``). Resilience knobs live in a
-        ``[Resilience]`` section (same names as the TOML table)."""
+        ``[Resilience]`` section, campaign knobs in a ``[Campaign]``
+        section (same names as the TOML tables)."""
         from comapreduce_tpu.ingest import IngestConfig
+        from comapreduce_tpu.pipeline.campaign import CampaignConfig
         from comapreduce_tpu.resilience import ResilienceConfig
 
         ini = cfg_mod.IniConfig(ini_path)
@@ -518,8 +627,10 @@ class Runner:
                    output_dir=inputs.get("output_dir", "."),
                    rank=rank, n_ranks=n_ranks,
                    ingest=IngestConfig.from_mapping(inputs),
-                   # coerce, not from_mapping: [Resilience] is a
-                   # DEDICATED section, so a typo'd knob must raise
-                   # instead of silently running with the default
+                   # coerce, not from_mapping: [Resilience]/[Campaign]
+                   # are DEDICATED sections, so a typo'd knob must
+                   # raise instead of silently running with the default
                    resilience=ResilienceConfig.coerce(
-                       dict(ini.get("Resilience", {}))))
+                       dict(ini.get("Resilience", {}))),
+                   campaign=CampaignConfig.coerce(
+                       dict(ini.get("Campaign", {}))))

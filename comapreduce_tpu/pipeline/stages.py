@@ -38,7 +38,9 @@ from comapreduce_tpu.ops import power as power_ops
 from comapreduce_tpu.ops import vane as vane_ops
 from comapreduce_tpu.ops.atmosphere import fit_atmosphere_segments
 from comapreduce_tpu.ops.average import edge_channel_mask, frequency_bin
-from comapreduce_tpu.ops.reduce import (ReduceConfig, plan_reduce_memory,
+from comapreduce_tpu.ops.reduce import (ReduceConfig, ShapeBuckets,
+                                        pad_scan_geometry, pad_time_axis,
+                                        plan_reduce_memory,
                                         scan_starts_lengths,
                                         stage_feed_batches)
 from comapreduce_tpu.ops.spikes import spike_mask
@@ -63,6 +65,13 @@ class _StageBase:
     overwrite: bool = False
     STATE: bool = True
     groups: tuple = ()
+    # campaign shape-canonicalisation policy (ops.reduce.ShapeBuckets |
+    # dict | None = off). Set by the Runner from the [campaign] table:
+    # stages that launch shape-specialised device programs pad each
+    # observation up to its campaign bucket (masked tails, zero-length
+    # scans) so a whole filelist shares one compiled program set per
+    # bucket instead of recompiling per file (docs/OPERATIONS.md §9)
+    shape_buckets: object = None
     _data: dict = field(default_factory=dict, repr=False)
     _attrs: dict = field(default_factory=dict, repr=False)
 
@@ -254,6 +263,11 @@ class MeasureSystemTemperature(_StageBase):
             feed=0)
 
 
+def _stage_buckets(stage) -> ShapeBuckets:
+    """The stage's campaign shape policy (identity when unset)."""
+    return ShapeBuckets.coerce(getattr(stage, "shape_buckets", None))
+
+
 def _stage_donate(argnums: tuple) -> tuple:
     """Donate the raw-counts buffer on accelerator backends only: CPU
     jit ignores donation and warns once per compile — pytest noise for
@@ -372,24 +386,51 @@ class SkyDip(_StageBase):
         samples of ``data``; ``gain`` (F, B, C) divides the counts into
         kelvin when given (the sky-nod mode)."""
         F, B, C, T = (int(x) for x in data.tod_shape)
+        # campaign bucket: the padded tail ships as NaN (zero validity)
+        # with a zero time mask, so the fit is unchanged while every
+        # same-bucket file reuses ONE compiled program
+        Tb = _stage_buckets(self).round_T(T)
         tmask = np.broadcast_to(np.asarray(tmask), (F, T))
-        seg = np.zeros(T, np.int32)   # one global segment; masking via
+        seg = np.zeros(Tb, np.int32)  # one global segment; masking via
         seg_j = jnp.asarray(seg)      # the per-feed time mask
         airmass_all = np.asarray(data.airmass).astype(np.float32)
         fit = _batched_atmosphere_fit(1)
         fits = np.zeros((F, B, 2, C), np.float32)
-        for idx in stage_feed_batches(F, B, C, T, self.feed_batch):
+        for idx in stage_feed_batches(F, B, C, Tb, self.feed_batch):
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
             if gain is not None:
                 g = gain[idx][..., None]
                 raw = np.where(g > 0, raw / np.where(g > 0, g, 1.0), np.nan)
-            off, slope = fit(jnp.asarray(raw),
-                             jnp.asarray(airmass_all[idx]), seg_j,
-                             jnp.asarray(tmask[idx].astype(np.float32)))
+            off, slope = fit(jnp.asarray(pad_time_axis(raw, Tb)),
+                             jnp.asarray(pad_time_axis(
+                                 airmass_all[idx], Tb, fill="edge")),
+                             seg_j,
+                             jnp.asarray(pad_time_axis(
+                                 tmask[idx].astype(np.float32), Tb,
+                                 fill="zero")))
             fits[idx] = np.stack([np.asarray(off)[..., 0],
                                   np.asarray(slope)[..., 0]], axis=-2)
         return fits
+
+    def warm_programs(self, F, B, C, T, S, L, calibrator=False):
+        """AOT-compile this stage's device programs for one campaign
+        bucket (the ``pipeline.campaign.Warmup`` hook): the lax.map
+        atmosphere fit at the canonical padded time axis, one compile
+        per distinct feed-chunk size. Reaches the run through the
+        persistent compile cache (docs/OPERATIONS.md §9)."""
+        del S, L, calibrator   # the sky-dip fit is one global segment
+        F, B, C = int(F), int(B), int(C)
+        Tb = _stage_buckets(self).round_T(int(T))
+        fit = _batched_atmosphere_fit(1)
+        f32, i32 = jnp.float32, jnp.int32
+        for f in sorted({len(idx) for idx in
+                         stage_feed_batches(F, B, C, Tb,
+                                            self.feed_batch)}):
+            fit.lower(jax.ShapeDtypeStruct((f, B, C, Tb), f32),
+                      jax.ShapeDtypeStruct((f, Tb), f32),
+                      jax.ShapeDtypeStruct((Tb,), i32),
+                      jax.ShapeDtypeStruct((f, Tb), f32)).compile()
 
     def _fit_sky_nod(self, data, level2) -> bool:
         from comapreduce_tpu.data.level import (COMAPLevel1,
@@ -472,23 +513,51 @@ class AtmosphereRemoval(_StageBase):
             return False
         S = len(edges)
         T = int(data.tod_shape[-1])
-        seg_j = jnp.asarray(segment_ids_from_edges(edges, T).astype(np.int32))
+        # campaign bucket: pad T (NaN tail -> zero validity, segment id
+        # 0 with zero weight) and S (segments S..Sb-1 own no samples;
+        # their fit rows are sliced off) so same-bucket files share one
+        # compiled program
+        bk = _stage_buckets(self)
+        Tb, Sb = bk.round_T(T), bk.round_S(S)
+        seg = segment_ids_from_edges(edges, T).astype(np.int32)
+        seg_j = jnp.asarray(pad_time_axis(seg, Tb, fill="zero"))
         F, B, C, _ = data.tod_shape
         airmass_all = np.asarray(data.airmass).astype(np.float32)
-        fit = _batched_atmosphere_fit(S)
+        fit = _batched_atmosphere_fit(Sb)
         out = np.zeros((S, F, B, 2, C), np.float32)
-        for idx in stage_feed_batches(F, B, C, T, self.feed_batch):
+        for idx in stage_feed_batches(F, B, C, Tb, self.feed_batch):
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
-            off, atm = fit(jnp.asarray(raw),
-                           jnp.asarray(airmass_all[idx]), seg_j,
+            off, atm = fit(jnp.asarray(pad_time_axis(raw, Tb)),
+                           jnp.asarray(pad_time_axis(
+                               airmass_all[idx], Tb, fill="edge")),
+                           seg_j,
                            jnp.ones((len(idx), 1), jnp.float32))
-            # (f, B, C, S) pair -> (S, f, B, 2, C)
+            # (f, B, C, Sb) pair -> (Sb, f, B, 2, C) -> first S scans
             blk = np.stack([np.asarray(off), np.asarray(atm)], axis=0)
-            out[:, idx] = np.transpose(blk, (4, 1, 2, 0, 3))
+            out[:, idx] = np.transpose(blk, (4, 1, 2, 0, 3))[:S]
         self._data = {"atmosphere/fit_values": out}
         self.STATE = True
         return True
+
+    def warm_programs(self, F, B, C, T, S, L, calibrator=False):
+        """AOT-compile the per-scan atmosphere fit for one campaign
+        bucket (see ``SkyDip.warm_programs``)."""
+        del L, calibrator
+        if int(S) == 0:
+            return
+        F, B, C = int(F), int(B), int(C)
+        bk = _stage_buckets(self)
+        Tb, Sb = bk.round_T(int(T)), bk.round_S(int(S))
+        fit = _batched_atmosphere_fit(Sb)
+        f32, i32 = jnp.float32, jnp.int32
+        for f in sorted({len(idx) for idx in
+                         stage_feed_batches(F, B, C, Tb,
+                                            self.feed_batch)}):
+            fit.lower(jax.ShapeDtypeStruct((f, B, C, Tb), f32),
+                      jax.ShapeDtypeStruct((f, Tb), f32),
+                      jax.ShapeDtypeStruct((Tb,), i32),
+                      jax.ShapeDtypeStruct((f, 1), f32)).compile()
 
 
 @functools.lru_cache(maxsize=8)
@@ -558,15 +627,18 @@ class Level1Averaging(_StageBase):
         w = (w * chan_mask).astype(np.float32)          # (F, B, C)
         fit = _batched_frequency_bin(bin_size)
         nb = C // bin_size
+        # campaign bucket: NaN time tail -> zero bin weight; outputs
+        # sliced back to the file's own T
+        Tb = _stage_buckets(self).round_T(T)
         tod_out = np.zeros((F, B, nb, T), np.float32)
         std_out = np.zeros((F, B, nb, T), np.float32)
-        for idx in stage_feed_batches(F, B, C, T, self.feed_batch):
+        for idx in stage_feed_batches(F, B, C, Tb, self.feed_batch):
             raw = np.stack([np.asarray(data.read_tod_feed(j),
                                        dtype=np.float32) for j in idx])
-            avg, std = fit(jnp.asarray(raw), jnp.asarray(gain[idx]),
-                           jnp.asarray(w[idx]))
-            tod_out[idx] = np.asarray(avg)
-            std_out[idx] = np.asarray(std)
+            avg, std = fit(jnp.asarray(pad_time_axis(raw, Tb)),
+                           jnp.asarray(gain[idx]), jnp.asarray(w[idx]))
+            tod_out[idx] = np.asarray(avg)[..., :T]
+            std_out[idx] = np.asarray(std)[..., :T]
         self._data = {
             "frequency_binned/tod": tod_out,
             "frequency_binned/tod_stddev": std_out,
@@ -577,6 +649,22 @@ class Level1Averaging(_StageBase):
         }
         self.STATE = True
         return True
+
+    def warm_programs(self, F, B, C, T, S, L, calibrator=False):
+        """AOT-compile the frequency binner for one campaign bucket
+        (see ``SkyDip.warm_programs``)."""
+        del S, L, calibrator
+        F, B, C = int(F), int(B), int(C)
+        Tb = _stage_buckets(self).round_T(int(T))
+        bin_size = min(self.frequency_bin_size, C)
+        fit = _batched_frequency_bin(bin_size)
+        f32 = jnp.float32
+        for f in sorted({len(idx) for idx in
+                         stage_feed_batches(F, B, C, Tb,
+                                            self.feed_batch)}):
+            fit.lower(jax.ShapeDtypeStruct((f, B, C, Tb), f32),
+                      jax.ShapeDtypeStruct((f, B, C), f32),
+                      jax.ShapeDtypeStruct((f, B, C), f32)).compile()
 
 
 @register()
@@ -639,7 +727,21 @@ class Level1AveragingGainCorrection(_StageBase):
                                         data.obsid)
 
         F, B, C, T = data.tod_shape
+        T = int(T)
         starts, lengths, L = scan_starts_lengths(edges, pad_to=self.pad_to)
+        # campaign bucket (docs/OPERATIONS.md §9): T padded with a NaN
+        # tail (the mask=None path derives zero validity on device), S
+        # padded with zero-length scans (all-masked; the scatter drops
+        # every one of their samples), L rounded up on the pad_to grid
+        # (masked-tail extract semantics carry any L >= the longest
+        # scan). The medfilt window is clamped against the UNPADDED L:
+        # padding must never change the filter the real samples see.
+        bk = _stage_buckets(self)
+        Tb = bk.round_T(T)
+        L_raw = L
+        L = bk.round_L(L)
+        Sb = bk.round_S(len(edges))
+        starts, lengths = pad_scan_geometry(starts, lengths, Sb)
         freq = data.frequency.astype(np.float32)  # (B, C) GHz
         f0 = freq.mean(axis=1, keepdims=True)
         freq_scaled = ((freq - f0) / f0).astype(np.float32)
@@ -656,13 +758,14 @@ class Level1AveragingGainCorrection(_StageBase):
         # HBM budget check on the PER-DEVICE footprint (each device of the
         # feed mesh holds fb/n_dev feeds); auto-picks scan streaming, or
         # raises naming a feed_batch that fits — before the device OOMs
-        scan_batch = plan_reduce_memory(fb // n_dev, B, C, T, len(edges),
+        scan_batch = plan_reduce_memory(fb // n_dev, B, C, Tb, Sb,
                                         L, self.scan_batch,
                                         suggest_scale=n_dev)
         if scan_batch != self.scan_batch:
             logger.info("Level1AveragingGainCorrection: streaming %s "
                         "scans per chunk to fit device memory", scan_batch)
-        cfg = ReduceConfig(C, medfilt_window=min(self.medfilt_window, L),
+        cfg = ReduceConfig(C,
+                           medfilt_window=min(self.medfilt_window, L_raw),
                            is_calibrator=data.is_calibrator,
                            medfilt_stride=self.medfilt_stride,
                            scan_batch=scan_batch)
@@ -677,8 +780,10 @@ class Level1AveragingGainCorrection(_StageBase):
             raws = [np.asarray(data.read_tod_feed(i), dtype=np.float32)
                     for i in idx]
             raws += [raws[0]] * (fb - len(idx))        # pad: results dropped
-            raw = np.stack(raws)
-            am = airmass_all[idx + [idx[0]] * (fb - len(idx))]
+            raw = pad_time_axis(np.stack(raws), Tb)    # NaN bucket tail
+            am = pad_time_axis(
+                airmass_all[idx + [idx[0]] * (fb - len(idx))], Tb,
+                fill="edge")
             return raw, am
 
         def pad_cal(x, idx):
@@ -704,12 +809,20 @@ class Level1AveragingGainCorrection(_StageBase):
                 res = reduce_feeds_sharded(
                     mesh, raw, None, am, starts_j, lengths_j,
                     pad_cal(tsys, idx), pad_cal(sys_gain, idx),
-                    freq_scaled, cfg)
+                    freq_scaled, cfg, L=L,
+                    # under a campaign bucket the filter must reflect at
+                    # the UNPADDED block length (a dynamic operand, so
+                    # every file of the bucket shares one compile) —
+                    # windows near a scan's end would otherwise mirror
+                    # different samples at different bucket sizes
+                    fold_len=L_raw if bk.enabled else None)
                 # device -> host copy blocks here while the worker thread
-                # reads the next batch from HDF5
-                tod_out[idx] = np.asarray(res["tod"])[:len(idx)]
-                orig_out[idx] = np.asarray(res["tod_original"])[:len(idx)]
-                wei_out[idx] = np.asarray(res["weights"])[:len(idx)]
+                # reads the next batch from HDF5 (the bucketed tail
+                # [T:Tb) holds no scan samples; slice it off)
+                tod_out[idx] = np.asarray(res["tod"])[:len(idx), :, :T]
+                orig_out[idx] = np.asarray(
+                    res["tod_original"])[:len(idx), :, :T]
+                wei_out[idx] = np.asarray(res["weights"])[:len(idx), :, :T]
                 if bi == 0 and self.figure_dir:
                     dg0 = np.asarray(res["dg"])[0]  # (S, L), feed 0
                 if not self.prefetch and bi + 1 < len(batches):
@@ -730,6 +843,56 @@ class Level1AveragingGainCorrection(_StageBase):
         }
         self.STATE = True
         return True
+
+    def warm_programs(self, F, B, C, T, S, L, calibrator=False):
+        """AOT-compile the fused reduction for one campaign bucket.
+
+        Mirrors ``__call__``'s planning EXACTLY — same mesh, same
+        rounded feed batch, same HBM-planned scan streaming, same
+        ``ReduceConfig`` — and lowers the same cached
+        ``_reduce_feeds_fn`` jit (NaN-carrying ``mask=None`` variant)
+        with the same input shardings, so the persistent compile cache
+        entry it writes is the one the batch loop's call will hit."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from comapreduce_tpu.parallel.mesh import feed_time_mesh
+        from comapreduce_tpu.parallel.sharded import _reduce_feeds_fn
+
+        if int(S) == 0:
+            return
+        F, B, C, T = int(F), int(B), int(C), int(T)
+        bk = _stage_buckets(self)
+        Tb = bk.round_T(T)
+        L_raw = int(L)
+        Lb = bk.round_L(L_raw)
+        Sb = bk.round_S(int(S))
+        local = jax.local_devices()
+        mesh = feed_time_mesh(local, n_feed=len(local))
+        n_dev = mesh.shape["feed"]
+        fb = -(-min(self.feed_batch or F, F) // n_dev) * n_dev
+        scan_batch = plan_reduce_memory(fb // n_dev, B, C, Tb, Sb, Lb,
+                                        self.scan_batch,
+                                        suggest_scale=n_dev)
+        cfg = ReduceConfig(C,
+                           medfilt_window=min(self.medfilt_window, L_raw),
+                           is_calibrator=bool(calibrator),
+                           medfilt_stride=self.medfilt_stride,
+                           scan_batch=scan_batch)
+        fn = _reduce_feeds_fn(cfg, Sb, Lb, with_mask=False,
+                              donate_tod=True, with_fold=bk.enabled)
+        feed_sh = NamedSharding(mesh, P("feed"))
+        repl = NamedSharding(mesh, P())
+        SDS, f32, i32 = jax.ShapeDtypeStruct, jnp.float32, jnp.int32
+        fold = (SDS((), i32, sharding=repl),) if bk.enabled else ()
+        with mesh:
+            fn.lower(SDS((fb, B, C, Tb), f32, sharding=feed_sh),
+                     SDS((fb, Tb), f32, sharding=feed_sh),
+                     SDS((Sb,), i32, sharding=repl),
+                     SDS((Sb,), i32, sharding=repl),
+                     SDS((fb, B, C), f32, sharding=feed_sh),
+                     SDS((fb, B, C), f32, sharding=feed_sh),
+                     SDS((B, C), f32, sharding=repl),
+                     *fold).compile()
 
 
 @register()

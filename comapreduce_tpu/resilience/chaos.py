@@ -29,6 +29,13 @@ list of ``kind[@substr][:rate]`` with rate in [0, 1] (default 1);
   unit ledgered as a ``hang``. Call ``release()`` when a drill ends so
   abandoned worker threads exit promptly instead of sleeping out
   ``hang_s``.
+- ``write_stall`` — the OUTPUT-side ``hang``: a ``data.writeback``
+  commit for a matching target path blocks (same release/``hang_s``
+  semantics) on the background writer thread. The drill asserts the
+  writeback watchdog cancels it at the hard deadline, the unit is
+  ledgered ``hang``/``rejected``, and the abandoned writer's late
+  commit is skipped (committed checkpoints are never dropped or
+  reordered).
 
 Whether a given file draws a given fault depends only on
 ``(seed, kind, basename)`` — stable across runs, across iteration
@@ -50,7 +57,7 @@ __all__ = ["ChaosMonkey", "parse_inject_spec", "CHAOS_KINDS"]
 logger = logging.getLogger("comapreduce_tpu")
 
 CHAOS_KINDS = ("read_error", "truncate", "flaky", "nan_burst",
-               "slow_read", "hang")
+               "slow_read", "hang", "write_stall")
 
 # TOD datasets a NaN burst can poison, by payload schema
 _POISON_KEYS = ("spectrometer/tod", "averaged_tod/tod",
@@ -126,6 +133,16 @@ class ChaosMonkey:
         with self._lock:
             self.injected.append((filename, kind))
         logger.info("chaos: injected %s into %s", kind, filename)
+
+    def stall_write(self, path: str) -> None:
+        """Block a writeback commit for ``path`` (kind ``write_stall``)
+        until :meth:`release` or ``hang_s`` — invoked by
+        ``data.writeback.Writeback`` inside its watchdog-supervised
+        region, so the ``writeback.write`` hard deadline must cancel it
+        exactly like a real stuck-in-C-code write."""
+        if "write_stall" in self.decide(path):
+            self._note(path, "write_stall")
+            self._release.wait(self.hang_s)
 
     def wrap_loader(self, loader):
         """``loader(path) -> payload`` with faults injected around it."""

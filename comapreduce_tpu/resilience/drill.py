@@ -21,7 +21,15 @@ This is the executable form of the resilience layer's contract
    ``retry_quarantined`` re-admits exactly the quarantined set;
 5. the watchdog honoured its deadline budget: each hung read was
    cancelled within ``hard + grace`` seconds (every retry gets its
-   own fresh budget), and the run never joined a stuck read.
+   own fresh budget), and the run never joined a stuck read;
+6. the async writeback path (ISSUE 5): a ``write_stall`` fault on the
+   background writer thread is cancelled by the ``writeback.write``
+   hard deadline within ``hard + grace``, ledgered ``hang``/
+   ``rejected`` (environment, never the file), the flush barrier
+   surfaces the failure, committed checkpoints are never dropped or
+   reordered (the surviving file holds its LAST submitted generation,
+   complete), and the abandoned writer's late commit is skipped at the
+   generation gate.
 
 Everything is deterministic by seed (chaos decisions, jitter, synthetic
 data), so a CI failure reproduces locally bit-for-bit. (Deadline
@@ -127,14 +135,14 @@ def run_drill(workdir: str, seed: int = 0, n_files: int = 7,
     try:
         return _run_drill_criteria(
             workdir, files, wcs, res, monkey, ledger_path, watchdog,
-            hard_deadline_s, grace_s, prefetch, n_files, t0)
+            hard_deadline_s, grace_s, prefetch, n_files, t0, seed=seed)
     finally:
         monkey.release()
 
 
 def _run_drill_criteria(workdir, files, wcs, res, monkey, ledger_path,
                         watchdog, hard_deadline_s, grace_s, prefetch,
-                        n_files, t0) -> dict:
+                        n_files, t0, seed=0) -> dict:
     from comapreduce_tpu.resilience import QuarantineLedger, Resilience
 
     # -- 1. chaos run completes ------------------------------------------
@@ -249,7 +257,13 @@ def _run_drill_criteria(workdir, files, wcs, res, monkey, ledger_path,
         f"criterion 5: cancel latency exceeded hard deadline " \
         f"{hard_deadline_s} s + grace {grace_s} s: {late}"
 
+    # -- 6. async writeback: stalled writer cancelled, ordering kept ----
+    wb_evidence = _writeback_drill(workdir, res, seed=seed, soft_s=0.1,
+                                   hard_s=hard_deadline_s,
+                                   grace_s=grace_s)
+
     return {
+        **wb_evidence,
         "n_files": n_files,
         "injected": sorted({(os.path.basename(f), k)
                             for f, k in monkey.injected}),
@@ -265,3 +279,97 @@ def _run_drill_criteria(workdir, files, wcs, res, monkey, ledger_path,
         "watchdog_events": [list(e) for e in watchdog.events][:50],
         "wall_s": round(time.perf_counter() - t0, 3),
     }
+
+
+def _writeback_drill(workdir, res, seed, soft_s, hard_s, grace_s) -> dict:
+    """Criterion 6: async writeback under a ``write_stall`` fault.
+
+    A stalled background writer must be cancelled by the
+    ``writeback.write`` hard deadline (within ``hard + grace``),
+    ledgered ``hang``/``rejected``, must never drop or reorder a
+    committed checkpoint, and its abandoned late commit must be skipped
+    at the generation gate. Returns the evidence fields merged into the
+    drill record."""
+    import h5py
+
+    from comapreduce_tpu.data.hdf5io import HDF5Store
+    from comapreduce_tpu.data.writeback import Writeback
+    from comapreduce_tpu.resilience.chaos import ChaosMonkey
+    from comapreduce_tpu.resilience.watchdog import (HangError, Watchdog,
+                                                     parse_deadlines)
+
+    wb_dir = os.path.join(workdir, "writeback")
+    os.makedirs(wb_dir, exist_ok=True)
+    ok = os.path.join(wb_dir, "Level2_ok.hd5")
+    victim = os.path.join(wb_dir, "Level2_stall.hd5")
+    for p in (ok, victim):
+        if os.path.exists(p):
+            os.unlink(p)
+
+    def payload(gen: int) -> dict:
+        store = HDF5Store(name="wb-drill")
+        store["averaged_tod/tod"] = np.full((2, 64), float(gen),
+                                            np.float32)
+        store["meta/gen"] = np.array([gen])
+        return store.export_payload()
+
+    monkey = ChaosMonkey("write_stall@stall", seed=seed, hang_s=60.0)
+    watchdog = Watchdog(
+        deadlines=parse_deadlines(f"writeback.write={soft_s}/{hard_s}"),
+        ledger=res.ledger, grace_s=grace_s)
+    wb = Writeback(depth=4, watchdog=watchdog, chaos=monkey)
+    try:
+        # ordering: three generations for the healthy file, committed
+        # in submission order; the survivor must hold the LAST one
+        for gen in (1, 2, 3):
+            wb.submit_store(ok, payload(gen))
+        wb.flush(ok)
+        with h5py.File(ok, "r") as h:
+            got = int(h["meta/gen"][0])
+            torn = not (h["averaged_tod/tod"][...] == float(got)).all()
+        assert got == 3 and not torn, \
+            f"criterion 6: committed checkpoint dropped/reordered " \
+            f"(gen {got}, torn={torn})"
+
+        err = None
+        try:
+            wb.submit_store(victim, payload(1))
+            wb.flush(victim)
+        except OSError as exc:    # HangError is an OSError subclass
+            err = exc
+        assert isinstance(err, HangError), \
+            "criterion 6: stalled writeback was not cancelled by the " \
+            "watchdog hard deadline"
+        res.record_failure(victim, err, stage="writeback.write",
+                           may_quarantine=False)
+        hangs = [e for e in watchdog.events if e[0] == "hang"]
+        late = [e for e in hangs if e[3] > hard_s + grace_s]
+        assert hangs and not late, \
+            f"criterion 6: writeback cancel latency exceeded " \
+            f"{hard_s} + {grace_s} s: {late}"
+        assert not os.path.exists(victim), \
+            "criterion 6: a cancelled write must not commit"
+        entries = [e for e in res.ledger.entries
+                   if e.unit["file"] == victim]
+        assert any(e.failure_class == "hang" and
+                   e.disposition == "rejected" for e in entries), \
+            "criterion 6: stalled write not ledgered hang/rejected"
+
+        # the abandoned writer, released, must SKIP its late commit
+        monkey.release()
+        deadline = time.perf_counter() + 10.0
+        while wb.stats["late_skips"] < 1 and \
+                time.perf_counter() < deadline:
+            time.sleep(0.02)
+        assert wb.stats["late_skips"] >= 1, \
+            "criterion 6: abandoned writer's late commit not skipped"
+        assert not os.path.exists(victim), \
+            "criterion 6: late commit landed after cancellation"
+        return {
+            "writeback_hang_cancel_s": [round(e[3], 4) for e in hangs],
+            "writeback_writes": wb.stats["writes"],
+            "writeback_late_skips": wb.stats["late_skips"],
+        }
+    finally:
+        monkey.release()
+        wb.close()

@@ -1,0 +1,131 @@
+"""Minimal deterministic stand-in for ``hypothesis`` (fallback only).
+
+``tests/test_properties.py`` is written against the real hypothesis
+API; slim images without it used to module-skip the whole property
+suite (ROADMAP open item). This shim implements just the surface those
+tests use — ``given``/``settings``, ``strategies.integers/booleans/
+sampled_from`` (+ ``.map``), and ``hypothesis.extra.numpy.arrays`` —
+over a seeded ``numpy`` RNG, so the properties still execute (as
+deterministic randomised tests) where hypothesis is absent. No
+shrinking, no example database: on failure the falsifying kwargs are
+printed and the exception re-raised. CI installs the real thing
+(``pip install .[dev]``); this keeps the invariants exercised
+everywhere else.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import inspect
+from types import SimpleNamespace
+
+import numpy as np
+
+__all__ = ["given", "settings", "st", "hnp"]
+
+
+class Strategy:
+    """A value generator: ``draw(rng) -> value`` plus ``.map``."""
+
+    def __init__(self, draw):
+        self._draw = draw
+
+    def draw(self, rng):
+        return self._draw(rng)
+
+    def map(self, f):
+        return Strategy(lambda rng: f(self._draw(rng)))
+
+
+def _integers(min_value, max_value):
+    lo, hi = int(min_value), int(max_value)
+    return Strategy(lambda rng: int(rng.integers(lo, hi + 1)))
+
+
+def _booleans():
+    return Strategy(lambda rng: bool(rng.integers(0, 2)))
+
+
+def _sampled_from(seq):
+    items = list(seq)
+    return Strategy(lambda rng: items[int(rng.integers(len(items)))])
+
+
+st = SimpleNamespace(integers=_integers, booleans=_booleans,
+                     sampled_from=_sampled_from)
+
+
+def _arrays(dtype, shape, elements: Strategy | None = None):
+    dtype = np.dtype(dtype)
+    dims = (int(shape),) if np.isscalar(shape) else tuple(
+        int(s) for s in shape)
+
+    def draw(rng):
+        if elements is None:
+            if dtype == np.bool_:
+                return rng.integers(0, 2, size=dims).astype(bool)
+            raise NotImplementedError(
+                f"mini-hypothesis arrays({dtype}) needs elements=")
+        n = int(np.prod(dims)) if dims else 1
+        flat = np.array([elements.draw(rng) for _ in range(n)])
+        return flat.reshape(dims).astype(dtype)
+
+    return Strategy(draw)
+
+
+hnp = SimpleNamespace(arrays=_arrays)
+
+
+def settings(max_examples: int = 20, deadline=None, **_ignored):
+    """Store the example budget on the (already ``given``-wrapped)
+    test; extra hypothesis knobs are accepted and ignored."""
+    def deco(fn):
+        fn._mini_settings = {"max_examples": int(max_examples)}
+        return fn
+
+    return deco
+
+
+def _seed(name: str, example: int) -> int:
+    digest = hashlib.sha256(f"{name}:{example}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def given(**strategies):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            n = getattr(wrapper, "_mini_settings",
+                        {}).get("max_examples", 20)
+            for i in range(n):
+                rng = np.random.default_rng(_seed(fn.__name__, i))
+                drawn = {name: s.draw(rng)
+                         for name, s in strategies.items()}
+                try:
+                    fn(*args, **drawn, **kwargs)
+                except BaseException:
+                    print(f"mini-hypothesis: falsifying example "
+                          f"{i}/{n} of {fn.__name__}: "
+                          f"{ {k: _brief(v) for k, v in drawn.items()} }")
+                    raise
+
+        # the strategy-supplied parameters are satisfied here, not by
+        # the test runner: the original signature must not leak through
+        # ``__wrapped__`` or pytest would resolve them as fixtures
+        # (the real hypothesis strips them the same way). Parameters
+        # NOT covered by a strategy (pytest fixtures) are kept.
+        sig = inspect.signature(fn)
+        keep = [p for name, p in sig.parameters.items()
+                if name not in strategies]
+        wrapper.__signature__ = sig.replace(parameters=keep)
+        del wrapper.__wrapped__
+        return wrapper
+
+    return deco
+
+
+def _brief(v):
+    if isinstance(v, np.ndarray):
+        return f"array{v.shape} dtype={v.dtype}"
+    return v

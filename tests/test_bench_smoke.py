@@ -92,7 +92,15 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
            "detail": {"device": "cpu", "dispatch_count": 2,
                       "reduce_dispatches": 1, "cg_iters_to_tol": 5,
                       "shape": [2, 2, 64, 2192]}}
+    camp = {"metric": "campaign_files_per_hour", "value": 9000.0,
+            "detail": {"config": "campaign", "bucket_count": 1,
+                       "compiles_campaign_steady": 1,
+                       "compiles_baseline_steady": 8,
+                       "cache_hits": 1, "cache_misses": 0,
+                       "write_overlap_fraction": 0.9}}
     monkeypatch.setattr(cp, "run_quick_bench", lambda: dict(rec))
+    monkeypatch.setattr(cp, "run_campaign_bench",
+                        lambda: json.loads(json.dumps(camp)))
     monkeypatch.setattr(
         cp, "reference_path",
         lambda platform: str(tmp_path / f"perf_quick_{platform}.json"))
@@ -109,6 +117,14 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     assert cp.main(["--reps", "1"]) == 1
     rec["detail"]["dispatch_count"] = 1          # fewer is fine
     assert cp.main(["--reps", "1"]) == 0
+    # the campaign no-recompile gate (ISSUE 5): steady-state backend
+    # compiles beyond the filelist's bucket count fail; --no-campaign
+    # (and --dispatch-only throughput-skips) leave the gate semantics
+    camp["detail"]["compiles_campaign_steady"] = 4
+    assert cp.main(["--reps", "1"]) == 1
+    assert cp.main(["--reps", "1", "--no-campaign"]) == 0
+    camp["detail"]["compiles_campaign_steady"] = 1
+    assert cp.main(["--reps", "1", "--dispatch-only"]) == 0
 
 
 def test_bench_config_modes_emit_json(tmp_path):
@@ -154,3 +170,37 @@ def test_bench_config_modes_emit_json(tmp_path):
         assert ev["git_rev"]
         if plat != "host":          # host-only config has no jax program
             assert ev["hlo_sha256"]
+
+
+def test_bench_campaign_smoke(tmp_path):
+    """``--config campaign`` (ISSUE 5): the whole-filelist executor A/B
+    on a small shape-jittered filelist — the steady state must respect
+    the no-recompile contract (compiles <= bucket count), report a
+    write-overlap fraction, and beat the per-file-exact baseline."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
+    env.update(BENCH_SMALL="1", BENCH_NO_PROBE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo, BENCH_EVIDENCE_DIR=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--config", "campaign"],
+        capture_output=True, text=True, env=env, timeout=420, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "campaign_files_per_hour"
+    assert rec["value"] > 0 and np.isfinite(rec["value"])
+    d = rec["detail"]
+    assert d["config"] == "campaign"
+    # the acceptance contract: shape jitter canonicalises into a small
+    # bucket set, and the steady state never compiles beyond it —
+    # while the pre-campaign executor recompiled for (at least) every
+    # distinct per-file geometry
+    assert 1 <= d["bucket_count"] <= 2
+    assert d["compiles_campaign_steady"] <= d["bucket_count"]
+    assert d["compiles_baseline_steady"] >= d["n_files"] - 1
+    assert 0.0 <= d["write_overlap_fraction"] <= 1.0
+    assert d["writeback"]["writes"] > 0
+    assert rec["vs_baseline"] > 1.0
+    assert (tmp_path / "evidence" / "bench_campaign_host.json").exists()

@@ -145,6 +145,54 @@ shape : 240, 240
                     <= np.nanstd(by_name["NAIVE"][off]) * 1.2)
 
 
+def test_run_destriper_cli_async_writeback(field_dataset):
+    """ISSUE 5: `[Inputs] writeback` routes the per-band FITS writes
+    through the background writer (band N+1's solve overlaps band N's
+    write) and `compile_cache_dir` turns on the persistent compile
+    cache — the maps must be byte-identical to the synchronous run of
+    ``test_run_destriper_cli`` and all committed by CLI exit."""
+    tmp, files = field_dataset
+    from comapreduce_tpu.cli import run_destriper
+
+    sync0 = os.path.join(tmp, "maps", "co2_band0.fits")
+    if not os.path.exists(sync0):   # standalone selection / reordering
+        pytest.skip("needs test_run_destriper_cli's synchronous maps "
+                    "as the bit-identity reference")
+    l2list = os.path.join(tmp, "l2list.txt")
+    ini = os.path.join(tmp, "params_wb.ini")
+    with open(ini, "w") as f:
+        f.write(f"""
+[Inputs]
+filelist : {l2list}
+output_dir : {tmp}/maps_wb
+prefix : co2
+bands : 0, 1
+offset_length : 50
+niter : 80
+threshold : 1e-6
+ground : false
+writeback : 2
+compile_cache_dir : {tmp}/jaxcache
+
+[Pixelization]
+type : wcs
+crval : 170.0, 52.0
+cdelt : 0.0333333, 0.0333333
+shape : 240, 240
+""")
+    assert run_destriper.main([ini]) == 0
+    for band in (0, 1):
+        sync_p = os.path.join(tmp, "maps", f"co2_band{band}.fits")
+        wb_p = os.path.join(tmp, "maps_wb", f"co2_band{band}.fits")
+        assert os.path.exists(wb_p)
+        sync_h = {n: d for n, h, d in read_fits_image(sync_p)}
+        wb_h = {n: d for n, h, d in read_fits_image(wb_p)}
+        assert set(wb_h) == set(sync_h)
+        for name in sync_h:
+            np.testing.assert_array_equal(wb_h[name], sync_h[name],
+                                          err_msg=f"band{band}/{name}")
+
+
 def test_run_destriper_healpix(field_dataset):
     tmp, files = field_dataset
     from comapreduce_tpu.cli.run_destriper import make_band_map

@@ -18,12 +18,15 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-# absent in slim CI images — a graceful module skip, never a collection
-# ERROR (tier-1 runs with --continue-on-collection-errors, where an
-# import crash reads as a silent failure; ROADMAP "Open items")
-pytest.importorskip("hypothesis", reason="hypothesis not installed")
-from hypothesis import given, settings, strategies as st
-from hypothesis.extra import numpy as hnp
+# the real hypothesis when installed (CI does, via the dev extra);
+# otherwise the deterministic tests/_mini_hypothesis.py shim — the
+# property suite used to module-skip wholesale on slim images (ROADMAP
+# open item), silently dropping every invariant below
+try:
+    from hypothesis import given, settings, strategies as st
+    from hypothesis.extra import numpy as hnp
+except ImportError:  # slim image: seeded-RNG shim, same test surface
+    from _mini_hypothesis import given, settings, st, hnp
 
 from comapreduce_tpu.mapmaking.pointing_plan import binned_window_sum
 from comapreduce_tpu.ops.median_filter import rolling_median

@@ -268,3 +268,58 @@ def test_safe_hdf5_open_retries(tmp_path):
     assert np.array_equal(f["x"][...], np.arange(4))
     f.close()
     t.join()
+
+
+_ASYNC_WRITER = r"""
+import sys
+import numpy as np
+from comapreduce_tpu.data.hdf5io import HDF5Store
+from comapreduce_tpu.data.writeback import Writeback, snapshot_store
+
+path = sys.argv[1]
+wb = Writeback(depth=2, durable=True)   # the data/durable.py commit path
+i = 0
+while True:
+    store = HDF5Store(name="t")
+    store["payload/marker"] = np.full(4096, float(i % 2))
+    store["payload/check"] = np.asarray([float(i % 2)])
+    wb.submit_store(path, snapshot_store(store))
+    if i == 0:
+        wb.flush(path)
+        print("FIRST_COMMIT_DONE", flush=True)
+    i += 1
+"""
+
+
+def test_sigkill_mid_async_writeback_never_torn(tmp_path):
+    """ISSUE 5 satellite: SIGKILL a process whose BACKGROUND writeback
+    thread is rewriting one Level-2 checkpoint in a tight loop. The
+    async writer commits through ``data/durable.py`` fsync-before-
+    rename (same guarantee as the synchronous ``write(atomic=True)``,
+    pinned next to the sync-path kill tests): the surviving committed
+    name must always open cleanly and hold ONE complete write's payload
+    — never a torn or mixed-generation file."""
+    import h5py
+
+    path = str(tmp_path / "Level2_ckpt.hd5")
+    worker = tmp_path / "worker.py"
+    worker.write_text(_ASYNC_WRITER)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON")}
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": _REPO})
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen([sys.executable, str(worker), path], env=env,
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        line = p.stdout.readline()
+        assert "FIRST_COMMIT_DONE" in line, line
+        time.sleep(0.4)   # let the writer thread overwrite mid-flight
+    finally:
+        p.kill()
+        p.wait(timeout=30)
+    with h5py.File(path, "r") as f:
+        marker = np.asarray(f["payload/marker"])
+        check = np.asarray(f["payload/check"])
+    assert marker.shape == (4096,)
+    assert np.all(marker == marker[0]), "torn marker dataset"
+    assert check[0] == marker[0], "datasets from different writes"

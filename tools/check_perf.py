@@ -28,8 +28,15 @@ The throughput half assumes a SAME-CLASS host as the committed
 reference (the key is platform only, not machine): on a slower box the
 absolute samples/s comparison fails spuriously with zero code change —
 run ``--update`` once on that host, or pass ``--dispatch-only`` to keep
-the machine-independent half of the gate (dispatch_count) and skip the
-throughput check.
+the machine-independent halves of the gate and skip the throughput
+check.
+
+The campaign no-recompile gate (ISSUE 5) also runs by default: one
+``bench.py --config campaign`` smoke (shape-jittered filelist, compile
+warm-up, async writeback) must show steady-state backend compiles
+``<= bucket_count`` — a recompile-per-file regression in the shape
+canonicalisation or warm-up fails here. Machine-independent (it is a
+count, not a throughput); ``--no-campaign`` skips it.
 """
 
 from __future__ import annotations
@@ -67,6 +74,30 @@ def run_quick_bench() -> dict:
     raise RuntimeError("no bench result line found in bench.py output")
 
 
+def run_campaign_bench() -> dict:
+    """One small-shape campaign bench child -> its parsed JSON line."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_SMALL": "1",
+        "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
+        "BENCH_EVIDENCE": "0",
+    })
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                          "--config", "campaign"],
+                         env=env, capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py --config campaign failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == "campaign_files_per_hour":
+            return rec
+    raise RuntimeError("no campaign result line in bench.py output")
+
+
 def reference_path(platform: str) -> str:
     return os.path.join(REPO, "evidence", f"perf_quick_{platform}.json")
 
@@ -82,7 +113,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dispatch-only", action="store_true",
                     help="skip the throughput comparison (foreign host: "
                          "the committed reference is another machine's "
-                         "samples/s); the dispatch_count gate still runs")
+                         "samples/s); the dispatch_count and campaign "
+                         "no-recompile gates still run")
+    ap.add_argument("--no-campaign", action="store_true",
+                    help="skip the campaign no-recompile gate")
     args = ap.parse_args(argv)
 
     best: dict | None = None
@@ -136,8 +170,26 @@ def main(argv=None) -> int:
         failures.append(
             f"dispatch_count increased: {cur['dispatch_count']} > "
             f"{ref_disp} (per-batch Python-loop dispatch reintroduced?)")
+
+    campaign = None
+    if not args.no_campaign:
+        # the no-recompile gate is ABSOLUTE (a count against the
+        # filelist's own bucket set, not a throughput vs a committed
+        # reference), so it needs no --update baseline and holds on any
+        # host class
+        camp = run_campaign_bench()["detail"]
+        campaign = {k: camp.get(k) for k in
+                    ("bucket_count", "compiles_campaign_steady",
+                     "compiles_baseline_steady", "cache_hits",
+                     "cache_misses", "write_overlap_fraction")}
+        if camp["compiles_campaign_steady"] > camp["bucket_count"]:
+            failures.append(
+                f"campaign steady-state recompiles: "
+                f"{camp['compiles_campaign_steady']} backend compiles > "
+                f"bucket count {camp['bucket_count']} (shape "
+                f"canonicalisation or compile warm-up regressed?)")
     print(json.dumps({"ok": not failures, "failures": failures,
-                      "current": cur,
+                      "current": cur, "campaign": campaign,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))
