@@ -319,6 +319,36 @@ def ces_pixels(T: int, nx: int, ny: int, feed: int, n_feeds: int):
     return pix.astype(np.int32)
 
 
+def weight_spread_raster(seed=0, T=12_000, nx=32, L=50):
+    """THE weight-spread raster fixture: ces raster + 1/f offsets + two
+    decades of weight spread. ONE home (used by ``--config destriper``,
+    ``tests/test_multigrid.py``, ``tests/test_pixel_space.py`` and
+    ``tests/test_precond_knob.py``) so the acceptance tests and the
+    perf gate's bench cannot silently drift onto different problem
+    classes. Returns ``(pix, tod, w, npix, L)`` with ``len(pix)``
+    truncated to an offset multiple."""
+    rng = np.random.default_rng(seed)
+    pix = ces_pixels(T, nx, nx, 0, 1).astype(np.int64)
+    n = (pix.size // L) * L
+    pix = pix[:n]
+    true_off = np.cumsum(rng.normal(0, 0.3, n // L)).astype(np.float32)
+    sky = rng.normal(0, 1.0, nx * nx).astype(np.float32)
+    tod = (sky[pix] + np.repeat(true_off, L)
+           + rng.normal(0, 1.0, n).astype(np.float32)).astype(np.float32)
+    w = (10.0 ** rng.uniform(-1, 1, n)).astype(np.float32)
+    return pix, tod, w, nx * nx, L
+
+
+def raster_to_healpix(pix, nx, nside):
+    """Walk the raster's (x, y) cells over a small HEALPix patch —
+    shared by the survey-smoke bench and the HEALPix parity tests."""
+    from comapreduce_tpu.mapmaking import healpix as hp
+
+    lon = 40.0 + (np.asarray(pix) % nx) * 0.05
+    lat = 10.0 + (np.asarray(pix) // nx) * 0.05
+    return np.asarray(hp.ang2pix_lonlat(nside, lon, lat), np.int64)
+
+
 def _probe_device(timeout_s: float = 600.0) -> None:
     """Fail fast (with a clear message) when the TPU relay is wedged.
 
@@ -505,18 +535,38 @@ def main():
     destripe_counted = _counted(jitted_destripe, "destripe")
 
     coarse_kwargs = {}
-    if precond_name == "twolevel":
-        # the coarse system needs the post-reduction weights on host;
-        # pointing and weights are run-invariant, so build once here
-        # (per band, sharing one pattern) — the same amortisation the
-        # CLI's per-(pointing, weights) build relies on
-        from comapreduce_tpu.mapmaking.destriper import (
-            build_coarse_preconditioner, coarse_pattern)
-
+    if precond_name in ("twolevel", "multigrid"):
+        # both knobs need the post-reduction weights on host; pointing
+        # and weights are run-invariant, so build once here (per band,
+        # sharing one pattern set) — the same amortisation the CLI's
+        # per-(pointing, weights) build relies on. The measurement must
+        # time the SELECTED preconditioner, never silently Jacobi (the
+        # PR 4 twolevel lesson).
         keys_w = jax.random.split(jax.random.key(7, impl="rbg"), F)
         tods_w, weis_w = all_feeds(keys_w)
         _, band_w0 = make_bands(tods_w, weis_w)
         band_w_host = np.asarray(band_w0)
+    if precond_name == "multigrid":
+        from comapreduce_tpu.mapmaking.destriper import (
+            build_multigrid_hierarchy, multigrid_patterns,
+            stack_multigrid)
+
+        pats_mg = multigrid_patterns(pix_feed, npix, offset_length,
+                                     block=8, levels=2)
+        # device-convert ONCE, like the twolevel branch's jnp.asarray:
+        # numpy kwargs would re-upload the whole hierarchy (incl. the
+        # per-band dense ac_inv) on every timed dispatch and bias the
+        # A/B against multigrid
+        coarse_kwargs["mg"] = jax.tree_util.tree_map(
+            jnp.asarray, stack_multigrid(
+                [build_multigrid_hierarchy(pix_feed, band_w_host[i],
+                                           npix, offset_length,
+                                           patterns=pats_mg)
+                 for i in range(B)]))
+    if precond_name == "twolevel":
+        from comapreduce_tpu.mapmaking.destriper import (
+            build_coarse_preconditioner, coarse_pattern)
+
         pat = coarse_pattern(pix_feed, npix, offset_length, block=8)
         pre = [build_coarse_preconditioner(pix_feed, band_w_host[i],
                                            npix, offset_length, block=8,
@@ -1420,9 +1470,181 @@ def bench_campaign():
     return 0
 
 
+def bench_destriper():
+    """Destriper mode: survey-scale compaction + preconditioner ladder
+    (ISSUE 6).
+
+    Three measurements on the weight-spread raster fixture (two decades
+    of weight spread, 1/f offsets — the class where preconditioning
+    works for its living):
+
+    - **preconditioner ladder**: iterations-to-1e-6 and ms/iter for
+      ``none | jacobi | twolevel | multigrid`` — the acceptance bound
+      is multigrid < twolevel in ITERATIONS (the V-cycle's 2 extra fine
+      matvecs per application are reported honestly in ms/iter, not
+      hidden);
+    - **compacted vs dense**: the same jacobi solve through a
+      ``PixelSpace`` seen-pixel dictionary vs the dense map space —
+      ms/iter for both plus the device map-vector bytes (the planned
+      matvec already runs in rank space, so compaction should cost ~0
+      per iteration and shrink the map products to coverage);
+    - **nside-4096 survey smoke**: the raster walked over a HEALPix
+      nside-4096 patch (~201M sky pixels), destriped compacted on THIS
+      container — recorded map-vector bytes are ``O(n_compact)``;
+      the dense equivalent (printed for scale) would be ~3.2 GB of map
+      products and is never allocated.
+
+    The result line's ``detail.compacted``/``detail.survey4096`` carry
+    ``map_vector_bytes``/``n_compact`` for the machine-independent
+    memory gate in ``tools/check_perf.py`` (bytes <= 2x the exact
+    ``4 B x (3 n_bands + 1) x n_compact`` budget). ``BENCH_SMALL=1``
+    shrinks the fixture (CI smoke). Unless ``BENCH_EVIDENCE=0``, the
+    line is also written to ``BENCH_r06.json`` (the round-7 ROOFLINE
+    artifact).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking import healpix as hp
+    from comapreduce_tpu.mapmaking.destriper import (
+        build_coarse_preconditioner, build_multigrid_hierarchy,
+        destripe_planned)
+    from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+    from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+
+    small = os.environ.get("BENCH_SMALL", "") == "1"
+    T = 12_000 if small else 120_000
+    nx = 32 if small else 64
+    L, n_iter = 50, 2000
+    pix, tod, w, npix, _ = weight_spread_raster(T=T, nx=nx, L=L)
+    n = pix.size
+    tod_j, w_j = jnp.asarray(tod), jnp.asarray(w)
+
+    def run(pixv, npixv, call_kwargs=None, **partial_kwargs):
+        """Compile+warm one planned solve, then time a repeat run.
+        Returns (result, wall_s of the timed run)."""
+        plan = build_pointing_plan(pixv, npixv, L)
+        fn = jax.jit(functools.partial(destripe_planned, plan=plan,
+                                       n_iter=n_iter, threshold=1e-6,
+                                       **partial_kwargs))
+        kw = call_kwargs or {}
+        r = fn(tod_j, w_j, **kw)
+        float(jnp.sum(r.destriped_map))          # compile + warm
+        t0 = time.perf_counter()
+        r = fn(tod_j, w_j, **kw)
+        float(jnp.sum(r.destriped_map))          # host fetch (see finish)
+        return r, time.perf_counter() - t0
+
+    def stats(r, wall):
+        resid = float(np.max(np.asarray(r.residual)))
+        iters = int(r.n_iter)
+        return {"iters_to_tol": iters if resid <= 1e-6 else None,
+                "residual": round(resid, 9),
+                "wall_s": round(wall, 4),
+                "ms_per_iter": round(1e3 * wall / max(iters, 1), 3)}
+
+    def map_bytes(r):
+        return int(sum(leaf.nbytes for leaf in
+                       (r.destriped_map, r.naive_map, r.weight_map,
+                        r.hit_map)))
+
+    # ---- preconditioner ladder (dense map space) ------------------------
+    ladder = {}
+    for name in ("none", "jacobi", "twolevel", "multigrid"):
+        call_kw, part_kw, extra = {}, {}, {}
+        if name == "none":
+            part_kw["precond"] = "none"
+        elif name == "twolevel":
+            # the default block (8) can trip the divergence monitor on
+            # some raster geometries (f32 SPD loss in the coarse
+            # inverse — the documented failure the CLI falls back
+            # from); escalate the block like an operator would and
+            # record every diverged attempt rather than hiding it
+            diverged_blocks = []
+            for blk in (8, 16, 32):
+                grp, aci = build_coarse_preconditioner(pix, w, npix, L,
+                                                       block=blk)
+                call_kw["coarse"] = (jnp.asarray(grp), jnp.asarray(aci))
+                r, wall = run(pix, npix, call_kwargs=call_kw)
+                if not np.any(np.asarray(r.diverged)):
+                    break
+                diverged_blocks.append(blk)
+            extra = {"coarse_block": blk,
+                     "diverged_blocks": diverged_blocks}
+            ladder[name] = {**stats(r, wall), **extra}
+            continue
+        elif name == "multigrid":
+            call_kw["mg"] = jax.tree_util.tree_map(
+                jnp.asarray,
+                build_multigrid_hierarchy(pix, w, npix, L, block=8,
+                                          levels=2))
+        r, wall = run(pix, npix, call_kwargs=call_kw, **part_kw)
+        ladder[name] = stats(r, wall)
+
+    # ---- compacted vs dense (jacobi) ------------------------------------
+    space = PixelSpace.from_pixels(pix, npix)
+    r_dense, wall_dense = run(pix, npix)
+    r_comp, wall_comp = run(space.remap(pix), space)
+    compacted = {
+        "dense": {**stats(r_dense, wall_dense),
+                  "map_vector_bytes": map_bytes(r_dense)},
+        **stats(r_comp, wall_comp),
+        "map_vector_bytes": map_bytes(r_comp),
+        "n_compact": space.n_compact, "npix_dense": npix,
+        "n_bands": 1,
+    }
+
+    # ---- nside-4096 survey smoke (compacted only — dense would be
+    # ~3.2 GB of map products and must never be allocated) ----------------
+    nside = 4096
+    hpix = raster_to_healpix(pix, nx, nside)
+    npix_sky = hp.nside2npix(nside)
+    sp4096 = PixelSpace.from_pixels(hpix, npix_sky)
+    r_s, wall_s = run(sp4096.remap(hpix), sp4096)
+    survey = {**stats(r_s, wall_s),
+              "nside": nside, "npix_sky": npix_sky,
+              "n_compact": sp4096.n_compact,
+              "coverage_fraction": round(sp4096.n_compact / npix_sky, 8),
+              "map_vector_bytes": map_bytes(r_s),
+              "dense_equiv_bytes": 4 * 4 * npix_sky,
+              "n_bands": 1}
+
+    line = {
+        "metric": "destriper_cg_iters_to_tol",
+        "value": ladder["multigrid"]["iters_to_tol"],
+        "unit": "iterations",
+        # the acceptance ratio: multigrid vs twolevel iterations (None
+        # when either burned its budget unconverged — never pretend)
+        "vs_baseline": (round(ladder["twolevel"]["iters_to_tol"]
+                              / ladder["multigrid"]["iters_to_tol"], 3)
+                        if ladder["multigrid"]["iters_to_tol"]
+                        and ladder["twolevel"]["iters_to_tol"] else None),
+        "detail": {
+            "config": "destriper",
+            "fixture": {"T": int(n), "nx": nx, "offset_length": L,
+                        "n_offsets": n // L, "threshold": 1e-6},
+            "preconditioners": ladder,
+            "compacted": compacted,
+            "survey4096": survey,
+            "device": str(jax.devices()[0].platform),
+        },
+    }
+    print(json.dumps(line))
+    if os.environ.get("BENCH_EVIDENCE", "1") != "0":
+        out_root = (os.environ.get("BENCH_EVIDENCE_DIR", "")
+                    or os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(out_root, "BENCH_r06.json"), "w") as f:
+            json.dump(line, f, indent=1)
+    write_evidence("destriper", lambda: None, extra=line["detail"],
+                   host_only=True)
+    return 0
+
+
 _CONFIGS = {"1": bench_config1, "2": bench_config2, "4": bench_config4,
             "ingest": bench_ingest, "resilience": bench_resilience,
-            "campaign": bench_campaign}
+            "campaign": bench_campaign, "destriper": bench_destriper}
 
 
 if __name__ == "__main__":

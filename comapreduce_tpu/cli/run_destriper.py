@@ -77,7 +77,7 @@ def _memoized(tag: str, pixels: np.ndarray, extra_key: tuple, build):
 def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                     n_iter: int, threshold: float, n_groups: int = 0,
                     compact: bool = False, precond: str = "jacobi",
-                    pair_batch: int | None = None):
+                    pair_batch: int | None = None, mg_smooth: int = 1):
     import functools
 
     import jax
@@ -93,6 +93,7 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                                        threshold=threshold,
                                        n_groups=n_groups,
                                        dense_maps=not compact,
+                                       mg_smooth=mg_smooth,
                                        precond=precond))
         if compact:
             return fn, np.asarray(plan.uniq_pixels)
@@ -110,7 +111,7 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
     return _memoized(tag, pixels,
                      (int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups), str(precond),
-                      pair_batch), build)
+                      pair_batch, int(mg_smooth)), build)
 
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
@@ -189,14 +190,31 @@ def _expand_joint_results(res, uniq: np.ndarray, npix: int, nb: int):
         diverged=div[i] if div.ndim else div) for i in range(nb)]
 
 
-def parse_destriper_section(destr: dict, coarse_default: int = 0):
-    """``[Destriper]`` knobs -> ``(precond, coarse_block, pair_batch)``
-    (docs/OPERATIONS.md §3):
+def _attach_dict(data, result):
+    """Stamp the seen-pixel dictionary onto a host-level result
+    (compacted solves only): ``DestriperResult.sky_pixels`` lets the
+    writers/coadd scatter compact map values to the sky at write time
+    without the ``DestriperData`` side channel. No-op for dense
+    solves (the field stays None)."""
+    space = getattr(data, "pixel_space", None)
+    if space is not None and space.compacted:
+        return result._replace(sky_pixels=space.pixels)
+    return result
 
-    - ``preconditioner = none | jacobi | twolevel`` — CG preconditioner
-      selection; ``twolevel`` = Jacobi + the coarse correction (block
-      from ``coarse_block``, default 8). Absent, the legacy
-      ``[Inputs] coarse_precond`` default (``coarse_default``) stands.
+
+def parse_destriper_section(destr: dict, coarse_default: int = 0):
+    """``[Destriper]`` knobs ->
+    ``(precond, coarse_block, pair_batch, mg)`` (docs/OPERATIONS.md §3):
+
+    - ``preconditioner = none | jacobi | twolevel | multigrid`` — CG
+      preconditioner selection; ``twolevel`` = Jacobi + the coarse
+      correction (block from ``coarse_block``, default 8);
+      ``multigrid`` = the V-cycle over the offset-block ladder
+      (``mg_levels`` levels x8 apart from ``mg_block``, ``mg_smooth``
+      damped-Jacobi sweeps per level — ``mg`` comes back as the config
+      dict for ``build_multigrid_hierarchy``, else None). Absent, the
+      legacy ``[Inputs] coarse_precond`` default (``coarse_default``)
+      stands.
     - ``pair_batch = N | auto`` — one-hot binning chunks merged per MXU
       matmul in the planned matvec (auto = HBM-planner sized).
 
@@ -205,6 +223,7 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
     from comapreduce_tpu.mapmaking.destriper import CONFIG_PRECONDITIONERS
 
     coarse_block = int(coarse_default)
+    mg = None
     pname = str(destr.get("preconditioner", "")).strip().lower()
     if pname not in ("",) + CONFIG_PRECONDITIONERS:
         raise ValueError(
@@ -218,11 +237,28 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
             "[Destriper] coarse_block only applies under preconditioner"
             f"=twolevel (preconditioner is {pname or 'absent'!r}); remove "
             "the knob or select twolevel")
+    mg_knobs = [k for k in ("mg_levels", "mg_smooth", "mg_block")
+                if k in destr]
+    if mg_knobs and pname != "multigrid":
+        raise ValueError(
+            f"[Destriper] {'/'.join(mg_knobs)} only apply under "
+            f"preconditioner=multigrid (preconditioner is "
+            f"{pname or 'absent'!r}); remove the knob(s) or select "
+            "multigrid")
     precond = "none" if pname == "none" else "jacobi"
     if pname == "none":
         coarse_block = 0
     elif pname == "jacobi":
         coarse_block = 0
+    elif pname == "multigrid":
+        coarse_block = 0
+        mg = {"levels": int(destr.get("mg_levels", 2)),
+              "smooth": int(destr.get("mg_smooth", 1)),
+              "block": int(destr.get("mg_block", 8))}
+        if mg["levels"] < 1 or mg["smooth"] < 1 or mg["block"] < 2:
+            raise ValueError(
+                f"[Destriper] multigrid knobs out of range (mg_levels "
+                f">= 1, mg_smooth >= 1, mg_block >= 2): {mg}")
     elif pname == "twolevel":
         if "coarse_block" in destr:
             coarse_block = int(destr["coarse_block"])
@@ -242,7 +278,7 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
     if pair_batch is not None and pair_batch < 1:
         raise ValueError(f"[Destriper] pair_batch must be >= 1 or auto, "
                          f"got {pb_raw!r}")
-    return precond, coarse_block, pair_batch
+    return precond, coarse_block, pair_batch, mg
 
 
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
@@ -250,7 +286,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   use_ground=False, use_calibration=True, sharded=False,
                   medfilt_window=400, tod_variant="auto",
                   coarse_block=0, prefetch=0, cache=None,
-                  resilience=None, precond="jacobi", pair_batch=None):
+                  resilience=None, precond="jacobi", pair_batch=None,
+                  mg=None, compact="auto"):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
@@ -260,14 +297,18 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
     fall back to the general scatter path). ``prefetch``/``cache`` are
     the streaming-ingest knobs (docs/ingest.md): reads overlap the
     per-file host prep, and a cache shared across per-band calls skips
-    re-decoding the filelist for bands past the first."""
+    re-decoding the filelist for bands past the first. ``compact``
+    selects seen-pixel compaction (``read_comap_data``; auto = HEALPix
+    on, WCS off) — every device map vector is then coverage-sized.
+    ``mg`` is the ``[Destriper] preconditioner = multigrid`` config
+    dict (``parse_destriper_section``)."""
     data = read_comap_data(filenames, band=band, wcs=wcs, nside=nside,
                            galactic=galactic, offset_length=offset_length,
                            use_calibration=use_calibration,
                            medfilt_window=medfilt_window,
                            tod_variant=tod_variant,
                            prefetch=prefetch, cache=cache,
-                           resilience=resilience)
+                           resilience=resilience, compact=compact)
     return data, solve_band(data, offset_length=offset_length,
                             n_iter=n_iter, threshold=threshold,
                             use_ground=use_ground, sharded=sharded,
@@ -275,7 +316,7 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                             watchdog=getattr(resilience, "watchdog",
                                              None),
                             unit=f"band{band}", precond=precond,
-                            pair_batch=pair_batch)
+                            pair_batch=pair_batch, mg=mg)
 
 
 def _watched_cg(solve, watchdog, unit: str):
@@ -299,7 +340,7 @@ def _watched_cg(solve, watchdog, unit: str):
 def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                use_ground=False, sharded=False, coarse_block=0,
                watchdog=None, unit="", precond="jacobi",
-               pair_batch=None):
+               pair_batch=None, mg=None):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -317,21 +358,37 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     hard deadline flags the late result through the same operator
     signal path as a tripped divergence monitor.
 
-    ``precond``/``pair_batch`` are the ``[Destriper]`` section's knobs
-    (docs/OPERATIONS.md §3): CG preconditioner selection
-    ('jacobi'|'none'; the two-level upgrade rides ``coarse_block``) and
-    the merged one-hot binning batch (None = HBM-planner auto)."""
+    ``precond``/``pair_batch``/``mg`` are the ``[Destriper]`` section's
+    knobs (docs/OPERATIONS.md §3): CG preconditioner selection
+    ('jacobi'|'none'; the two-level upgrade rides ``coarse_block``, the
+    multigrid V-cycle the ``mg`` config dict) and the merged one-hot
+    binning batch (None = HBM-planner auto). Multigrid runs on the
+    non-sharded planned paths (plain AND offset-aligned ground); the
+    sharded programs fall back to the two-level preconditioner with a
+    warning (the V-cycle's per-level scatter lattice is not yet
+    shard_map-threaded), and the scatter fallbacks keep Jacobi like
+    they do for ``coarse_block``."""
     from comapreduce_tpu.mapmaking.destriper import _check_precond
 
-    _check_precond(precond, coarse=coarse_block or None)
+    _check_precond(precond, coarse=coarse_block or None, mg=mg)
     if watchdog is not None:
         return _watched_cg(
             lambda: solve_band(data, offset_length=offset_length,
                                n_iter=n_iter, threshold=threshold,
                                use_ground=use_ground, sharded=sharded,
                                coarse_block=coarse_block,
-                               precond=precond, pair_batch=pair_batch),
+                               precond=precond, pair_batch=pair_batch,
+                               mg=mg),
             watchdog, unit)
+    if sharded and mg is not None:
+        # the sharded programs keep the two-level preconditioner: the
+        # V-cycle's intermediate-level operators are whole-offset-domain
+        # lattices that would need their own psum threading. Loud, not
+        # silent — and the fallback is the next-strongest knob.
+        logger.warning("preconditioner=multigrid: the sharded programs "
+                       "fall back to twolevel (coarse block %d)",
+                       mg["block"])
+        coarse_block, mg = mg["block"], None
     if sharded:
         import jax
 
@@ -437,20 +494,22 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 # the scatter path handles per-sample group ids
                 gid_off = None
             if gid_off is None:
-                if coarse_block:
+                if coarse_block or mg:
                     logger.warning(
-                        "coarse_precond active (default 8 for field "
-                        "runs) but the ground groups are not "
+                        "%s active but the ground groups are not "
                         "offset-aligned; scatter fallback runs "
-                        "Jacobi only")
-                return destripe_jit(data.tod[:n], data.pixels[:n],
-                                    data.weights[:n], data.npix,
-                                    offset_length=offset_length,
-                                    n_iter=n_iter, threshold=threshold,
-                                    ground_ids=data.ground_ids[:n],
-                                    az=data.az[:n],
-                                    n_groups=data.n_groups,
-                                    precond=precond)
+                        "Jacobi only",
+                        "multigrid" if mg else
+                        "coarse_precond (default 8 for field runs)")
+                return _attach_dict(data, destripe_jit(
+                    data.tod[:n], data.pixels[:n],
+                    data.weights[:n], data.npix,
+                    offset_length=offset_length,
+                    n_iter=n_iter, threshold=threshold,
+                    ground_ids=data.ground_ids[:n],
+                    az=data.az[:n],
+                    n_groups=data.n_groups,
+                    precond=precond))
         kwargs = {}
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
@@ -460,11 +519,30 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 np.asarray(data.pixels[:n]), np.asarray(data.weights[:n]),
                 data.npix, offset_length, block=int(coarse_block))
             kwargs["coarse"] = (jnp.asarray(grp), jnp.asarray(aci))
+        elif mg is not None:
+            from comapreduce_tpu.mapmaking.destriper import (
+                MultigridUnavailable, build_multigrid_hierarchy)
+
+            try:
+                kwargs["mg"] = build_multigrid_hierarchy(
+                    np.asarray(data.pixels[:n]),
+                    np.asarray(data.weights[:n]), data.npix,
+                    offset_length, block=mg["block"],
+                    levels=mg["levels"])
+            except MultigridUnavailable as exc:
+                # geometry too small for any >= 2-unknown level: a
+                # 1-block coarse system is pure null mode and would
+                # diverge by construction — Jacobi instead, loudly
+                logger.warning("multigrid unavailable for this "
+                               "geometry (%s); running Jacobi", exc)
+                mg = None
+        mg_smooth = mg["smooth"] if mg is not None else 1
         if use_ground:
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold,
                                  n_groups=data.n_groups, precond=precond,
-                                 pair_batch=pair_batch)
+                                 pair_batch=pair_batch,
+                                 mg_smooth=mg_smooth)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]),
                         ground_off=jnp.asarray(gid_off),
@@ -472,23 +550,26 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
         else:
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold,
-                                 precond=precond, pair_batch=pair_batch)
+                                 precond=precond, pair_batch=pair_batch,
+                                 mg_smooth=mg_smooth)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]), **kwargs)
-        if kwargs.get("coarse") is not None and \
+        if (kwargs.get("coarse") is not None
+                or kwargs.get("mg") is not None) and \
                 bool(np.any(np.asarray(result.diverged))):
-            # CG divergence tripwire fired under the two-level
-            # preconditioner (an ill-assembled A_c^-1 can lose SPD in
-            # f32): re-solve under plain Jacobi — warm-started from the
-            # monitored solve's best iterate on the offsets-only path;
-            # the joint ground solve restarts cold (x0 is offsets-only
-            # by construction). Slower but safe — and recorded, not
-            # silent (docs/OPERATIONS.md §7).
+            # CG divergence tripwire fired under the two-level/multigrid
+            # preconditioner (an ill-assembled coarse inverse can lose
+            # SPD in f32): re-solve under plain Jacobi — warm-started
+            # from the monitored solve's best iterate on the
+            # offsets-only path; the joint ground solve restarts cold
+            # (x0 is offsets-only by construction). Slower but safe —
+            # and recorded, not silent (docs/OPERATIONS.md §7).
+            which = "multigrid" if "mg" in kwargs else "coarse"
             if use_ground:
                 logger.warning(
-                    "CG diverged under the coarse preconditioner "
+                    "CG diverged under the %s preconditioner "
                     "(diverged=%s); re-solving ground solve with "
-                    "Jacobi from a cold start",
+                    "Jacobi from a cold start", which,
                     np.asarray(result.diverged))
                 result = fn(jnp.asarray(data.tod[:n]),
                             jnp.asarray(data.weights[:n]),
@@ -496,9 +577,9 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                             az=jnp.asarray(data.az[:n]))
             else:
                 logger.warning(
-                    "CG diverged under the coarse preconditioner "
+                    "CG diverged under the %s preconditioner "
                     "(diverged=%s); re-solving with Jacobi from the "
-                    "best iterate", np.asarray(result.diverged))
+                    "best iterate", which, np.asarray(result.diverged))
                 result = fn(jnp.asarray(data.tod[:n]),
                             jnp.asarray(data.weights[:n]),
                             x0=result.offsets)
@@ -510,7 +591,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                        "(diverged=%s); re-run with [Inputs] "
                        "coarse_precond : 0 to force Jacobi",
                        np.asarray(result.diverged))
-    return result
+    return _attach_dict(data, result)
 
 
 def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
@@ -520,7 +601,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          tod_variant="auto", coarse_block=0,
                          prefetch=0, cache=None, resilience=None,
                          watchdog=None, precond="jacobi",
-                         pair_batch=None):
+                         pair_batch=None, mg=None, compact="auto"):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -552,7 +633,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                              medfilt_window=medfilt_window,
                              tod_variant=tod_variant,
                              prefetch=prefetch, cache=cache,
-                             resilience=resilience)
+                             resilience=resilience, compact=compact)
              for b in bands]
     pix0 = np.asarray(datas[0].pixels)
     for d in datas[1:]:
@@ -565,6 +646,13 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
         import jax
         from jax.sharding import Mesh
 
+        if mg is not None:
+            # same fallback as solve_band's sharded branch: the V-cycle
+            # is not shard_map-threaded yet — loud two-level downgrade
+            logger.warning("preconditioner=multigrid: the sharded joint "
+                           "program falls back to twolevel (coarse "
+                           "block %d)", mg["block"])
+            coarse_block, mg = mg["block"], None
         mesh = Mesh(np.array(jax.local_devices()), ("time",))
         N = datas[0].tod.size
         n_pad = (-N) % _shard_quantum(mesh, offset_length)
@@ -609,7 +697,9 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                            "(diverged=%s); re-run with [Inputs] "
                            "coarse_precond : 0 to force Jacobi",
                            np.asarray(res.diverged))
-        return datas, _expand_joint_results(res, uniq, npix, nb)
+        return datas, [_attach_dict(d, r) for d, r in
+                       zip(datas, _expand_joint_results(res, uniq, npix,
+                                                        nb))]
     n = (datas[0].tod.size // offset_length) * offset_length
     tod = np.stack([np.asarray(d.tod)[:n] for d in datas])
     wgt = np.stack([np.asarray(d.weights)[:n] for d in datas])
@@ -627,28 +717,52 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                for i in range(nb)]
         kwargs["coarse"] = (jnp.asarray(pre[0][0]),
                             jnp.stack([jnp.asarray(p[1]) for p in pre]))
+    elif mg is not None:
+        from comapreduce_tpu.mapmaking.destriper import (
+            MultigridUnavailable, build_multigrid_hierarchy,
+            multigrid_patterns, stack_multigrid)
+
+        # one pattern set (pixels are band-invariant), per-band weight
+        # aggregates, stacked into the single multi-RHS hierarchy
+        try:
+            pats = multigrid_patterns(pix0[:n], npix, offset_length,
+                                      block=mg["block"],
+                                      levels=mg["levels"])
+            kwargs["mg"] = stack_multigrid(
+                [build_multigrid_hierarchy(pix0[:n], wgt[i], npix,
+                                           offset_length, patterns=pats)
+                 for i in range(nb)])
+        except MultigridUnavailable as exc:
+            # same degenerate-geometry fallback as solve_band
+            logger.warning("multigrid unavailable for this geometry "
+                           "(%s); running Jacobi", exc)
+            mg = None
     # compact solve + host expansion (same shape handling as the sharded
     # branch above): the joint program only ever holds (nb, n_rank)
     # compact products on device, never (nb, npix) dense maps
     fn, uniq = _planned_solver(pix0[:n], npix, offset_length, n_iter,
                                threshold, compact=True, precond=precond,
-                               pair_batch=pair_batch)
+                               pair_batch=pair_batch,
+                               mg_smooth=mg["smooth"] if mg else 1)
     res = _watched_cg(
         lambda: fn(jnp.asarray(tod), jnp.asarray(wgt), **kwargs),
         watchdog, "joint")
-    if kwargs.get("coarse") is not None and \
+    if (kwargs.get("coarse") is not None
+            or kwargs.get("mg") is not None) and \
             bool(np.any(np.asarray(res.diverged))):
         # same divergence fallback as solve_band: drop to Jacobi, warm-
         # started per band from the monitored solve's best iterates
         logger.warning(
-            "joint CG diverged under the coarse preconditioner "
+            "joint CG diverged under the %s preconditioner "
             "(diverged=%s); re-solving with Jacobi from the best "
-            "iterates", np.asarray(res.diverged))
+            "iterates", "multigrid" if "mg" in kwargs else "coarse",
+            np.asarray(res.diverged))
         res = _watched_cg(
             lambda: fn(jnp.asarray(tod), jnp.asarray(wgt),
                        x0=res.offsets),
             watchdog, "joint(fallback)")
-    return datas, _expand_joint_results(res, uniq, npix, nb)
+    return datas, [_attach_dict(d, r) for d, r in
+                   zip(datas, _expand_joint_results(res, uniq, npix, nb))]
 
 
 def band_map_writer(path, data, result):
@@ -656,7 +770,12 @@ def band_map_writer(path, data, result):
     over them. The async writeback path submits THIS closure — it
     captures only the maps plus the wcs/pixel geometry, never the
     band's full ``data`` (GB-scale TOD/pointing arrays must not stay
-    alive on the write queue while later bands load theirs)."""
+    alive on the write queue while later bands load theirs).
+
+    The seen-pixel dictionary comes from ``result.sky_pixels`` when the
+    solve attached one (``_attach_dict``) — the RESULT is authoritative
+    for the index space its map values live in; ``data`` supplies the
+    fallback for results produced outside the CLI solvers."""
     maps = {
         "DESTRIPED": np.asarray(result.destriped_map),
         "NAIVE": np.asarray(result.naive_map),
@@ -664,14 +783,35 @@ def band_map_writer(path, data, result):
         "HITS": np.asarray(result.hit_map),
     }
     wcs, sky_pixels, nside = data.wcs, data.sky_pixels, data.nside
+    space = getattr(data, "pixel_space", None)
+    if getattr(result, "sky_pixels", None) is not None:
+        from comapreduce_tpu.mapmaking import healpix as hp
+        from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+
+        npix_sky = wcs.npix if wcs is not None else hp.nside2npix(nside)
+        space = PixelSpace.from_dictionary(
+            np.asarray(result.sky_pixels), npix_sky)
+        sky_pixels = space.pixels
 
     def write() -> None:
         if wcs is not None:
+            # compacted WCS solves scatter to the field HERE — the one
+            # write-time expansion (PixelSpace.expand); dense solves
+            # pass through
+            vals = maps if space is None or not space.compacted else \
+                {k: space.expand(v) for k, v in maps.items()}
             shaped = {k: v.reshape(wcs.ny, wcs.nx)
-                      for k, v in maps.items()}
+                      for k, v in vals.items()}
             write_fits_image(path, shaped, header=dict(wcs.header_cards()))
-        else:
+        elif sky_pixels is not None:
+            # compacted HEALPix: partial map over the dictionary — the
+            # full sky is never materialised, not even on host
             write_healpix_map(path, maps, sky_pixels, nside)
+        else:
+            # dense (compact=false) HEALPix: every sky pixel explicit
+            write_healpix_map(path, maps,
+                              np.arange(maps["WEIGHTS"].shape[-1],
+                                        dtype=np.int64), nside)
 
     return write
 
@@ -741,8 +881,18 @@ def main(argv=None) -> int:
     # would only pay the host-side build. `coarse_precond : 0` disables.
     coarse_block = int(inputs.get("coarse_precond",
                                   0 if calibrator else 8))
-    precond, coarse_block, pair_batch = parse_destriper_section(
+    precond, coarse_block, pair_batch, mg = parse_destriper_section(
         ini.get("Destriper", {}), coarse_block)
+    # seen-pixel compaction ([Pixelization] compact : auto|true|false;
+    # docs/OPERATIONS.md §3): auto = HEALPix compacted (the survey
+    # regime), WCS dense. Compacted, every device map vector is
+    # coverage-sized and the writers scatter to the sky at write time.
+    # Validated HERE, at config load — a typo'd knob must fail before
+    # the campaign-scale ingest starts (the [Destriper] section's rule)
+    compact = str(pixel.get("compact", "auto")).strip().lower()
+    if compact not in ("auto", "true", "false"):
+        raise ValueError(f"[Pixelization] compact must be "
+                         f"auto|true|false, got {compact!r}")
     # streaming ingest (docs/ingest.md): `[Inputs] prefetch : N` reads
     # ahead on a background thread; `cache_mb : M` caches decoded files
     # so every band after the first skips the HDF5 decode entirely
@@ -817,7 +967,8 @@ def main(argv=None) -> int:
             sharded=sharded, tod_variant=tod_variant,
             coarse_block=coarse_block, prefetch=prefetch, cache=cache,
             resilience=resilience, watchdog=resilience.watchdog,
-            precond=precond, pair_batch=pair_batch)
+            precond=precond, pair_batch=pair_batch, mg=mg,
+            compact=compact)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -833,7 +984,7 @@ def main(argv=None) -> int:
                                 coarse_block=coarse_block,
                                 watchdog=resilience.watchdog,
                                 unit=f"band{band}", precond=precond,
-                                pair_batch=pair_batch)
+                                pair_batch=pair_batch, mg=mg)
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
@@ -842,7 +993,8 @@ def main(argv=None) -> int:
                 use_calibration=use_cal, sharded=sharded,
                 tod_variant=tod_variant, coarse_block=coarse_block,
                 prefetch=prefetch, cache=cache, resilience=resilience,
-                precond=precond, pair_batch=pair_batch)
+                precond=precond, pair_batch=pair_batch, mg=mg,
+                compact=compact)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         if writeback is None:

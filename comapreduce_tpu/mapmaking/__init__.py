@@ -20,9 +20,14 @@ from comapreduce_tpu.mapmaking import (  # noqa: F401
     destriper,
     fits_io,
     healpix,
+    pixel_space,
     wcs,
 )
 from comapreduce_tpu.mapmaking.binning import bin_map, bin_offset_map  # noqa: F401
+from comapreduce_tpu.mapmaking.pixel_space import (  # noqa: F401
+    PixelSpace,
+    build_seen_pixel_space,
+)
 from comapreduce_tpu.mapmaking.destriper import (  # noqa: F401
     DestriperResult,
     destripe,

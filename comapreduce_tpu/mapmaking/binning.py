@@ -12,6 +12,15 @@ Invalid samples are encoded as pixel id ``npix`` and dropped by
 array, ``binFuncs.pyx:20-23``). All functions are jittable; inside
 ``shard_map`` pass ``axis_name`` so shard-local maps are ``psum``-reduced
 (the reference's MPI ``Gather+sum+Bcast``, ``Destriper.py:183-204``).
+
+``npix`` may be a plain segment count or a
+:class:`~comapreduce_tpu.mapmaking.pixel_space.PixelSpace`: a compacted
+space sizes every map vector here to ``n_compact`` (hit pixels), never
+the sky — the caller remaps the pointing once per plan
+(``PixelSpace.remap``) and scatters back to the sky only at write time.
+Each public entry sanitizes the pixel stream ONCE and shares it across
+its internal segment sums (``bin_map`` -> weights -> hits used to
+re-sanitize per product — pure waste on every matvec).
 """
 
 from __future__ import annotations
@@ -19,8 +28,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from comapreduce_tpu.mapmaking.pixel_space import PixelSpace, resolve_npix
+
 __all__ = ["bin_map", "bin_offset_map", "sample_map", "accumulate_weights",
-           "naive_map"]
+           "naive_map", "PixelSpace", "resolve_npix"]
 
 
 def _psum(x, axis_name):
@@ -33,15 +44,23 @@ def _sanitize(pixels: jax.Array, npix: int) -> jax.Array:
     return jnp.where((pixels < 0) | (pixels >= npix), npix, pixels)
 
 
-def accumulate_weights(pixels: jax.Array, weights: jax.Array, npix: int,
+def _segment(values: jax.Array, pixels_sane: jax.Array, npix: int,
+             axis_name) -> jax.Array:
+    """One psum-reduced segment_sum over an ALREADY-sanitized stream —
+    the shared inner op, so multi-product entry points sanitize once."""
+    return _psum(jax.ops.segment_sum(
+        values, pixels_sane, num_segments=npix, indices_are_sorted=False),
+        axis_name)
+
+
+def accumulate_weights(pixels: jax.Array, weights: jax.Array, npix,
                        axis_name: str | None = None) -> jax.Array:
     """``sum_w[p] = sum_{t: pix_t=p} w_t`` — the map-domain weight vector."""
-    pixels = _sanitize(pixels, npix)
-    return _psum(jax.ops.segment_sum(
-        weights, pixels, num_segments=npix, indices_are_sorted=False), axis_name)
+    n = resolve_npix(npix)
+    return _segment(weights, _sanitize(pixels, n), n, axis_name)
 
 
-def bin_map(tod: jax.Array, pixels: jax.Array, weights: jax.Array, npix: int,
+def bin_map(tod: jax.Array, pixels: jax.Array, weights: jax.Array, npix,
             sum_w: jax.Array | None = None,
             axis_name: str | None = None) -> jax.Array:
     """Weighted naive map: ``m = (P^T W d) / (P^T W 1)``.
@@ -50,16 +69,20 @@ def bin_map(tod: jax.Array, pixels: jax.Array, weights: jax.Array, npix: int,
     the segment_sum. Returns f32[npix]; unhit pixels are 0 (the reference
     leaves NaN after dividing by a zero hit count; masks compose better).
     """
-    pixels = _sanitize(pixels, npix)
-    wsum = jax.ops.segment_sum(tod * weights, pixels, num_segments=npix)
-    wsum = _psum(wsum, axis_name)
+    n = resolve_npix(npix)
+    return _bin_map_sane(tod, _sanitize(pixels, n), weights, n,
+                         sum_w=sum_w, axis_name=axis_name)
+
+
+def _bin_map_sane(tod, pixels_sane, weights, npix: int, sum_w, axis_name):
+    wsum = _segment(tod * weights, pixels_sane, npix, axis_name)
     if sum_w is None:
-        sum_w = accumulate_weights(pixels, weights, npix, axis_name)
+        sum_w = _segment(weights, pixels_sane, npix, axis_name)
     return jnp.where(sum_w > 0, wsum / jnp.maximum(sum_w, 1e-30), 0.0)
 
 
 def bin_offset_map(offsets: jax.Array, pixels: jax.Array, weights: jax.Array,
-                   npix: int, offset_length: int,
+                   npix, offset_length: int,
                    sum_w: jax.Array | None = None,
                    axis_name: str | None = None) -> jax.Array:
     """Map of the stretched offset vector (``binValues2Map`` analogue).
@@ -83,14 +106,18 @@ def sample_map(m: jax.Array, pixels: jax.Array) -> jax.Array:
 
 
 def naive_map(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
-              npix: int, axis_name: str | None = None,
+              npix, axis_name: str | None = None,
               sum_w: jax.Array | None = None):
     """(signal, weight, hit) maps in one pass — the reference's
-    ``destriper_iteration`` products (``Destriper.py:402-453``)."""
+    ``destriper_iteration`` products (``Destriper.py:402-453``).
+
+    The pixel stream is sanitized ONCE and shared by all three segment
+    sums (weights, signal, hits)."""
+    n = resolve_npix(npix)
+    pixels = _sanitize(pixels, n)
     if sum_w is None:
-        sum_w = accumulate_weights(pixels, weights, npix, axis_name)
-    m = bin_map(tod, pixels, weights, npix, sum_w=sum_w, axis_name=axis_name)
-    hits = _psum(jax.ops.segment_sum(jnp.ones_like(weights),
-                                     _sanitize(pixels, npix),
-                                     num_segments=npix), axis_name)
+        sum_w = _segment(weights, pixels, n, axis_name)
+    m = _bin_map_sane(tod, pixels, weights, n, sum_w=sum_w,
+                      axis_name=axis_name)
+    hits = _segment(jnp.ones_like(weights), pixels, n, axis_name)
     return m, sum_w, hits

@@ -19,6 +19,8 @@ import numpy as np
 from comapreduce_tpu.mapmaking.fits_io import (read_fits_image,
                                                write_fits_image,
                                                write_healpix_map)
+from comapreduce_tpu.mapmaking.healpix import nside2npix
+from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
 
 __all__ = ["coadd_maps", "coadd_fits_files"]
 
@@ -71,7 +73,9 @@ def coadd_fits_files(inputs: list[str], output: str) -> dict:
                  for p, h in zip(inputs, is_hp)}
         raise ValueError(f"coadd: mixed map layouts {mixed}")
     if all(is_hp):
-        # union of the ranks' pixel sets
+        # union of the ranks' seen-pixel DICTIONARIES — partial maps
+        # stay partial: every intermediate is union-of-coverage sized,
+        # the dense sky vector (201M px at nside 4096) never exists
         loaded = []
         for hdus in parsed:
             maps = {n: d for n, _, d in hdus if n != "PIXELS"}
@@ -80,17 +84,39 @@ def coadd_fits_files(inputs: list[str], output: str) -> dict:
             loaded.append((maps, pix, hdr["NSIDE"],
                            hdr.get("ORDERING", "RING") == "NESTED"))
         nside, nest = loaded[0][2], loaded[0][3]
-        for _, _, ns, ne in loaded[1:]:
+        for (_, _, ns, ne), path in zip(loaded[1:], inputs[1:]):
             if ns != nside or ne != nest:
-                raise ValueError("coadd: mixed nside/ordering")
-        union = np.unique(np.concatenate([pix for _, pix, _, _ in loaded]))
-        idx = {int(p): i for i, p in enumerate(union)}
+                # name BOTH offenders: at campaign scale the glob spans
+                # hundreds of rank files and "mixed nside" without a
+                # filename is an hour of bisection
+                raise ValueError(
+                    f"coadd: mixed nside/ordering — {inputs[0]} is "
+                    f"nside {nside} "
+                    f"{'NESTED' if nest else 'RING'}, {path} is "
+                    f"nside {ns} {'NESTED' if ne else 'RING'}")
+        npix_sky = nside2npix(nside)
+        for (_, pix, _, _), path in zip(loaded, inputs):
+            bad = (np.asarray(pix) < 0) | (np.asarray(pix) >= npix_sky)
+            if bad.any():
+                # from_pixels would silently DROP these from the
+                # dictionary and the remap below would then scatter out
+                # of bounds — name the corrupt file instead
+                raise ValueError(
+                    f"coadd: {path} PIXELS outside [0, {npix_sky}) for "
+                    f"nside {nside} (e.g. {int(np.asarray(pix)[bad][0])})"
+                    " — corrupt partial map?")
+        spaces = [PixelSpace.from_pixels(pix, npix_sky)
+                  for _, pix, _, _ in loaded]
+        union = spaces[0].union(*spaces[1:])
         rank_maps = []
-        for maps, pix, _, _ in loaded:
+        for (maps, pix, _, _), space in zip(loaded, spaces):
+            # vectorised dictionary remap (rank ids -> union ids); the
+            # per-pixel Python dict this replaces was O(coverage) hash
+            # lookups per rank file
+            sel = union.remap(pix)
             dense = {}
-            sel = np.array([idx[int(p)] for p in pix], np.int64)
             for k, v in maps.items():
-                full = np.zeros(union.size, np.float64)
+                full = np.zeros(union.n_compact, np.float64)
                 full[sel] = v
                 dense[k] = full
             rank_maps.append(dense)
@@ -99,9 +125,12 @@ def coadd_fits_files(inputs: list[str], output: str) -> dict:
         return out
     header = dict(parsed[0][0][1])
     rank_maps = [{name: data for name, _, data in hdus} for hdus in parsed]
-    shapes = {m["WEIGHTS"].shape for m in rank_maps}
-    if len(shapes) != 1:
-        raise ValueError(f"coadd: mixed map shapes {shapes}")
+    shape0 = rank_maps[0]["WEIGHTS"].shape
+    for m, path in zip(rank_maps[1:], inputs[1:]):
+        if m["WEIGHTS"].shape != shape0:
+            raise ValueError(
+                f"coadd: mixed map shapes — {inputs[0]} is {shape0}, "
+                f"{path} is {m['WEIGHTS'].shape}")
     out = coadd_maps(rank_maps)
     keep = {k: header[k] for k in header
             if k.startswith(("CRVAL", "CRPIX", "CDELT", "CTYPE", "CUNIT"))}

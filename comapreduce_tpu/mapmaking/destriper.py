@@ -33,6 +33,7 @@ import numpy as np
 
 from comapreduce_tpu.mapmaking.binning import (accumulate_weights, bin_map,
                                                naive_map, sample_map)
+from comapreduce_tpu.mapmaking.pixel_space import PixelSpace, resolve_npix
 from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
                                                      binned_window_sum)
 from comapreduce_tpu.resilience.tripwires import scrub_tod
@@ -40,13 +41,24 @@ from comapreduce_tpu.resilience.tripwires import scrub_tod
 __all__ = ["CONFIG_PRECONDITIONERS", "DestriperResult", "destripe",
            "destripe_jit", "destripe_planned", "ground_ids_per_offset",
            "build_coarse_preconditioner", "coarse_pattern",
-           "watched_solve"]
+           "multigrid_levels", "multigrid_patterns",
+           "build_multigrid_hierarchy", "stack_multigrid",
+           "MultigridUnavailable", "watched_solve"]
+
+
+class MultigridUnavailable(ValueError):
+    """The geometry admits no multigrid ladder (every offset-block
+    level would have < 2 unknowns). A DEDICATED type so the config
+    layer's Jacobi fallback catches exactly this refusal and never
+    masks a genuine build bug (length mismatch, corrupt dictionary)
+    as 'multigrid unavailable'."""
 
 #: the config-level preconditioner names ([Destriper] preconditioner =,
 #: BENCH_PRECOND) — ONE home so the CLI parser and bench can't drift
 #: from each other. The SOLVER-level rule is narrower (_check_precond:
-#: jacobi|none, twolevel = jacobi + coarse=...) by design.
-CONFIG_PRECONDITIONERS = ("none", "jacobi", "twolevel")
+#: jacobi|none; twolevel = jacobi + coarse=...; multigrid = jacobi +
+#: mg=...) by design.
+CONFIG_PRECONDITIONERS = ("none", "jacobi", "twolevel", "multigrid")
 
 # CG divergence tripwire: a system is diverged when its true residual
 # sits more than sqrt(DIVERGENCE_GROWTH)x above the best iterate's for
@@ -82,6 +94,13 @@ class DestriperResult(NamedTuple):
     # Trailing default keeps positional construction of the 8 original
     # fields working everywhere.
     diverged: jax.Array = 0
+    # the seen-pixel dictionary when the solve ran in a COMPACTED
+    # PixelSpace: host i64[n_compact] sky ids aligning with the compact
+    # map vectors above. None inside jitted programs (a None leaf is an
+    # empty pytree node, so shard_map out_specs are unchanged); host
+    # wrappers attach it via `_replace` so writers/coadd can scatter to
+    # the sky at write time without a side channel.
+    sky_pixels: object = None
 
 
 def watched_solve(solve, watchdog=None, name: str = "mapmaking.cg_solve",
@@ -285,18 +304,29 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
     return x, rr, k, b_norm, div.astype(jnp.int32)
 
 
-def _check_precond(precond: str, coarse=None) -> str:
+def _check_precond(precond: str, coarse=None, mg=None) -> str:
     """ONE home for the preconditioner-name rule (``destripe``,
     ``destripe_planned`` and the config layer must not drift):
     ``jacobi`` (default) | ``none``; the two-level preconditioner is
-    Jacobi + the coarse correction, so ``coarse`` requires ``jacobi``."""
+    Jacobi + the coarse correction, so ``coarse`` requires ``jacobi``;
+    the multigrid V-cycle smooths with Jacobi, so ``mg`` requires
+    ``jacobi`` too and excludes ``coarse`` (the coarsest V-cycle level
+    IS the coarse solve — passing both would apply it twice)."""
     if precond not in ("jacobi", "none"):
         raise ValueError(f"precond must be 'jacobi' or 'none', got "
                          f"{precond!r} (the two-level preconditioner is "
-                         "selected by passing coarse=...)")
+                         "selected by passing coarse=..., the multigrid "
+                         "one by passing mg=...)")
     if coarse is not None and precond != "jacobi":
         raise ValueError("the two-level preconditioner is additive over "
                          "Jacobi; coarse=... requires precond='jacobi'")
+    if mg is not None and precond != "jacobi":
+        raise ValueError("the multigrid V-cycle smooths with Jacobi; "
+                         "mg=... requires precond='jacobi'")
+    if mg is not None and coarse is not None:
+        raise ValueError("pass coarse=... (two-level) OR mg=... "
+                         "(multigrid), not both — the V-cycle's coarsest "
+                         "level already is the coarse solve")
     return precond
 
 
@@ -315,6 +345,13 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
         ``countDataSize``, ``COMAPData.py:163-187``; zero-weight samples are
         ignored everywhere).
     pixels: i32[N]; invalid samples carry ``pixels >= npix``.
+    npix: segment count of the map vectors — an int, or a
+        :class:`PixelSpace` (content-hashable, so it rides the jit
+        static argument like the int): a COMPACTED space solves over
+        ``n_compact`` hit pixels with ``pixels`` already remapped
+        through ``PixelSpace.remap`` (once, host-side); every map
+        product comes back compact and the caller scatters to the sky
+        at write time only.
     ground_ids, az: optional i32[N]/f32[N] enabling the joint ground
         template (az should be pre-normalised to ~[-1, 1]).
     axis_name: mesh axis name when called inside ``shard_map`` with the
@@ -426,7 +463,11 @@ def coarse_pattern(pixels, npix: int, offset_length: int,
     clipped pixel stream, offset/block maps, and the sorted
     (pixel, coarse-block) index pattern. A multi-band joint solve shares
     ONE pattern (pixels are band-invariant) and runs only the per-band
-    weight bincounts through :func:`build_coarse_preconditioner`."""
+    weight bincounts through :func:`build_coarse_preconditioner`.
+    ``npix`` may be a :class:`PixelSpace` (compacted solves build their
+    coarse systems over ``n_compact`` pixels — the bincounts below are
+    coverage-, never sky-, sized)."""
+    npix = resolve_npix(npix)
     pixels = np.asarray(pixels)
     L = int(offset_length)
     n = (pixels.size // L) * L
@@ -499,6 +540,7 @@ def build_coarse_preconditioner(pixels, weights, npix: int,
     """
     import scipy.sparse as sp
 
+    npix = resolve_npix(npix)
     if pattern is None:
         pattern = coarse_pattern(pixels, npix, offset_length,
                                  block=block, max_coarse=max_coarse)
@@ -556,6 +598,203 @@ def build_coarse_preconditioner(pixels, weights, npix: int,
     return grp, inv.astype(np.float32)
 
 
+def multigrid_levels(n_offsets: int, block: int = 8, levels: int = 2,
+                     max_coarse: int = 4096) -> list[int]:
+    """The offset-block ladder ``b_1 < b_2 < ... < b_L`` of the
+    multigrid hierarchy (nested multiples, finest to coarsest).
+
+    ``block`` is the finest coarsening factor; each level multiplies it
+    by 8 (one V-cycle level per ~decade of offset drift wavelength —
+    the MAPCUMBA-style offset hierarchy, astro-ph/0101112). The
+    coarsest block doubles until its system fits ``max_coarse``
+    unknowns (the dense-inverse budget of
+    :func:`build_coarse_preconditioner`); doubling preserves the
+    nesting, so restriction between adjacent levels stays an exact
+    block sum. Levels that no longer strictly coarsen — or would leave
+    fewer than 2 unknowns (a 1-block system is PURE null mode: its
+    ridged inverse explodes and poisons the cycle) — are dropped, so on
+    small problems the ladder degrades toward a two-grid hierarchy
+    with a halving coarsest block, and to EMPTY (``[]``) when no >=
+    2-unknown level exists at all (``n_offsets < 3``) — the builders
+    then refuse and the config layer falls back to Jacobi rather than
+    assemble a guaranteed-divergent cycle."""
+    blocks = []
+    b = max(int(block), 2)
+    for _ in range(max(int(levels), 1)):
+        blocks.append(b)
+        b *= 8
+    n_off = max(int(n_offsets), 1)
+    while -(-n_off // blocks[-1]) > max_coarse:
+        blocks[-1] *= 2
+    # every surviving block divides every larger one (geometric x8 plus
+    # power-of-two growth on the last), so dropping a level never
+    # breaks the adjacent-level nesting
+    out = []
+    prev_n = n_off
+    for bk in blocks:
+        n_b = -(-n_off // bk)
+        if 2 <= n_b < prev_n:
+            out.append(bk)
+            prev_n = n_b
+    if out:
+        return out
+    # every candidate over-coarsened (block > n_off/2): the largest
+    # block still leaving 2 unknowns, or no ladder at all
+    half = -(-n_off // 2)
+    return [half] if half >= 2 and -(-n_off // half) >= 2 else []
+
+
+def multigrid_patterns(pixels, npix, offset_length: int, block: int = 8,
+                       levels: int = 2, max_coarse: int = 4096) -> dict:
+    """Weights-independent half of the multigrid build: one
+    :func:`coarse_pattern` per ladder level. A multi-band joint solve
+    shares ONE pattern set (pixels are band-invariant) and runs only
+    the per-band weight bincounts through
+    :func:`build_multigrid_hierarchy` — the same amortisation as the
+    two-level ``coarse_pattern``/``build_coarse_preconditioner``
+    split."""
+    npix = resolve_npix(npix)
+    pixels = np.asarray(pixels)
+    n_off = (pixels.size // int(offset_length))
+    blocks = multigrid_levels(n_off, block=block, levels=levels,
+                              max_coarse=max_coarse)
+    if not blocks:
+        raise MultigridUnavailable(
+            f"n_offsets={n_off} is too small for any multigrid level "
+            "(every block leaves < 2 unknowns — the coarse system "
+            "would be pure null mode); run jacobi/twolevel instead")
+    # intermediate patterns must keep their EXACT block (no internal
+    # doubling): pass a max_coarse no level can exceed
+    pats = [coarse_pattern(pixels, npix, offset_length, block=bk,
+                           max_coarse=max(n_off, 1))
+            for bk in blocks[:-1]]
+    pats.append(coarse_pattern(pixels, npix, offset_length,
+                               block=blocks[-1],
+                               max_coarse=max_coarse))
+    return {"blocks": blocks, "patterns": pats}
+
+
+def build_multigrid_hierarchy(pixels, weights, npix, offset_length: int,
+                              block: int = 8, levels: int = 2,
+                              max_coarse: int = 4096, ridge: float = 3e-3,
+                              patterns: dict | None = None) -> tuple:
+    """Galerkin offset-block hierarchy for the multigrid V-cycle —
+    host side, f64 assembly (the true multi-grid upgrade of
+    :func:`build_coarse_preconditioner`, which remains the coarsest
+    level of this ladder).
+
+    Per intermediate level ``k`` (block ``b_k``) the EXACT Galerkin
+    coarse operator ``A_k = R_k A P_k`` (piecewise-constant
+    prolongation over ``b_k`` consecutive offsets) is assembled from
+    the level's (pixel, block) pair aggregates — the same algebra as
+    the fine system one level up::
+
+        A_k = diag(sum w per block) - Mat_k^T diag(1/sumw_pix) Mat_k
+
+    — and kept SPARSE (COO triplets applied on device as one small
+    scatter-add per V-cycle visit; these systems are ``n_off / b_k``
+    sized, orders below the fine pair space). The coarsest level is the
+    existing dense ridged inverse. Every level inherits the fine
+    operator's two structural facts, which make the damped-Jacobi
+    V-cycle provably safe: row sums are exactly zero (the global-
+    constant null mode — Galerkin restriction of ``A 1 = 0``) and
+    off-diagonal entries are non-positive, so by Gershgorin
+    ``lambda(D_k^{-1} A_k) <= 2`` at EVERY level and any damping
+    ``omega < 1`` yields a convergent (hence SPD-preserving) smoother —
+    no spectral estimation needed.
+
+    Returns a tuple of per-level dicts of ARRAYS ONLY (a jit-traceable
+    pytree for ``destripe_planned(mg=...)``): intermediate levels carry
+    ``{grp, rows, cols, vals, invd}`` (``grp`` maps the PREVIOUS
+    level's index to this level's block — the restriction/prolongation
+    stencil), the coarsest ``{grp, ac_inv}``. Build once per
+    (pointing, weights); bands with their own weights build their own
+    (sharing ``patterns``) and stack via :func:`stack_multigrid` for a
+    multi-RHS solve.
+
+    Method lineage: MAPCUMBA's multigrid map-making CG
+    (astro-ph/0101112) and the two-level/deflation preconditioners of
+    arXiv:1309.7473 / MAPPRAISER (arXiv:2112.03370); the pair-aggregate
+    Galerkin assembly per level and the TPU-side V-cycle are this
+    framework's own.
+    """
+    import scipy.sparse as sp
+
+    npix = resolve_npix(npix)
+    if patterns is None:
+        patterns = multigrid_patterns(pixels, npix, offset_length,
+                                      block=block, levels=levels,
+                                      max_coarse=max_coarse)
+    blocks, pats = patterns["blocks"], patterns["patterns"]
+    p0 = pats[0]
+    n, pix, off_id = p0["n"], p0["pix"], p0["off_id"]
+    n_off = p0["grp"].size
+    w = np.asarray(weights, np.float64)[:n].copy()
+    w[p0["bad"]] = 0.0
+
+    sw_pix = np.bincount(pix, weights=w, minlength=npix)
+    inv_sw = np.where(sw_pix > 0, 1.0 / np.maximum(sw_pix, 1e-30), 0.0)
+    sw_off = np.bincount(off_id, weights=w, minlength=n_off)
+
+    out = []
+    for k, pat in enumerate(pats[:-1]):
+        n_c = pat["n_c"]
+        mw = np.bincount(pat["inv"], weights=w)
+        mat = sp.coo_matrix((mw, (pat["rows"], pat["cols"])),
+                            shape=(npix, n_c)).tocsr()
+        d_c = np.bincount(pat["grp"], weights=sw_off, minlength=n_c)
+        a_k = (sp.diags(d_c) - mat.T @ sp.diags(inv_sw) @ mat).tocsr()
+        diag = a_k.diagonal()
+        # level Jacobi inverse, same degenerate-offset rule as
+        # _jacobi_inverse: fall back to the plain block weight sum where
+        # Z absorbs the block, identity on zero-weight padding blocks
+        cut = 1e-6 * np.maximum(d_c, 1e-30)
+        safe = np.where(diag > cut, diag, np.where(d_c > 0, d_c, 1.0))
+        coo = a_k.tocoo()
+        grp = (pat["grp"] if k == 0 else
+               np.arange(-(-n_off // blocks[k - 1]), dtype=np.int64)
+               // (blocks[k] // blocks[k - 1]))
+        out.append({"grp": np.asarray(grp, np.int32),
+                    "rows": coo.row.astype(np.int32),
+                    "cols": coo.col.astype(np.int32),
+                    "vals": coo.data.astype(np.float32),
+                    "invd": (1.0 / safe).astype(np.float32)})
+    # coarsest: the existing dense ridged inverse, restricted FROM the
+    # last intermediate level (or from the fine offsets when the ladder
+    # collapsed to one level)
+    _, ac_inv = build_coarse_preconditioner(
+        pixels, weights, npix, offset_length, block=blocks[-1],
+        ridge=ridge, max_coarse=max_coarse, pattern=pats[-1])
+    if len(blocks) == 1:
+        grp_c = pats[-1]["grp"]
+    else:
+        n_prev = -(-n_off // blocks[-2])
+        grp_c = np.arange(n_prev, dtype=np.int64) \
+            // (blocks[-1] // blocks[-2])
+    out.append({"grp": np.asarray(grp_c, np.int32), "ac_inv": ac_inv})
+    return tuple(out)
+
+
+def stack_multigrid(hierarchies: list) -> tuple:
+    """Stack per-band hierarchies (shared ``patterns``) into ONE
+    multi-RHS hierarchy: weight-dependent leaves (``vals``, ``invd``,
+    ``ac_inv``) gain a leading band axis; the index stencils
+    (``grp``/``rows``/``cols``) are band-invariant and taken from the
+    first."""
+    first = hierarchies[0]
+    out = []
+    for lv_i, lv in enumerate(first):
+        stacked = {}
+        for key, val in lv.items():
+            if key in ("vals", "invd", "ac_inv"):
+                stacked[key] = np.stack(
+                    [np.asarray(h[lv_i][key]) for h in hierarchies])
+            else:
+                stacked[key] = val
+        out.append(stacked)
+    return tuple(out)
+
+
 def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      n_iter: int = 100, threshold: float = 1e-6,
                      axis_name: str | tuple | None = None,
@@ -565,6 +804,9 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      az: jax.Array | None = None,
                      n_groups: int = 0,
                      coarse: tuple | None = None,
+                     mg: tuple | None = None,
+                     mg_smooth: int = 1,
+                     mg_omega: float = 2.0 / 3.0,
                      x0: jax.Array | None = None,
                      precond: str = "jacobi") -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
@@ -631,8 +873,38 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     ``[Destriper] preconditioner`` knob's fast-path end. ``coarse``
     (the two-level upgrade) is additive over Jacobi and requires it.
     Same fixed point whichever is selected; only the CG path changes.
+
+    ``mg``: optional hierarchy from :func:`build_multigrid_hierarchy`
+    (or :func:`stack_multigrid` for multi-RHS) — replaces the additive
+    two-level correction with a SYMMETRIC V(nu, nu)-cycle over the
+    offset-block ladder: ``mg_smooth`` (= nu) damped-Jacobi smoothing
+    steps at every level around an exact-Galerkin residual restriction,
+    the coarsest level solved by the dense ridged inverse. The fine
+    level's operator is this solve's own ``matvec`` (exact, including
+    the map projection Z), so one preconditioner application costs
+    ``2 nu`` extra fine matvecs — the trade that buys the iteration
+    count (multiplicative V-cycle > additive two-level, MAPCUMBA
+    astro-ph/0101112). ``mg_omega`` is the Jacobi damping: every level
+    has exactly-zero row sums and non-positive off-diagonals, so
+    Gershgorin bounds ``lambda(D^{-1}A) <= 2`` and ANY ``omega < 1``
+    keeps the smoother convergent and the V-cycle SPD (see
+    ``build_multigrid_hierarchy``). Traced arrays — the memoized
+    compiled program is reused across bands/weights; ``mg_smooth`` /
+    ``mg_omega`` are static. Mutually exclusive with ``coarse``;
+    requires ``precond='jacobi'``. Ground solves apply the V-cycle to
+    the offsets block (identity on the small ground block, like every
+    other preconditioner here).
     """
-    _check_precond(precond, coarse)
+    _check_precond(precond, coarse, mg)
+    if mg is not None and axis_name is not None:
+        # the V-cycle's restriction/level solves are not psum-threaded
+        # (each shard would correct against a partial residual — no
+        # longer one SPD operator); every other knob either works
+        # sharded or raises, so this one raises too. The CLI downgrades
+        # sharded multigrid runs to the two-level preconditioner.
+        raise ValueError("mg (multigrid) is not supported under "
+                         "shard_map (axis_name=...); use coarse=... — "
+                         "the two-level preconditioner is psum-aware")
     dv = device_arrays if device_arrays is not None else plan.device()
     with_ground = ground_off is not None
     if with_ground and tod.ndim != 1:
@@ -773,6 +1045,52 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     if precond == "none":
         def apply_precond(v):
             return v
+    elif mg is not None:
+        mg_t = tuple(mg)
+        nu = max(int(mg_smooth), 1)
+        omega = float(mg_omega)
+        if not 0.0 < omega < 1.0:
+            raise ValueError(f"mg_omega must be in (0, 1) — the "
+                             f"Gershgorin-safe damping range — got "
+                             f"{omega}")
+
+        def coo_apply(lv, x):
+            """Sparse level operator A_k x: one small scatter-add over
+            the level's COO triplets (n_off/b_k-sized — negligible next
+            to the fine one-hot binnings; bands broadcast through)."""
+            n_k = lv["invd"].shape[-1]
+            contrib = lv["vals"] * jnp.take(x, lv["cols"], axis=-1)
+            return jnp.zeros(x.shape[:-1] + (n_k,),
+                             f32).at[..., lv["rows"]].add(contrib)
+
+        def restrict(grp, res, n_next):
+            return jnp.zeros(res.shape[:-1] + (n_next,),
+                             f32).at[..., grp].add(res)
+
+        def vcycle(idx, r, apply_a, invd):
+            # pre-smooth from zero: the first damped-Jacobi step needs
+            # no matvec (x = omega D^-1 r exactly)
+            x = omega * invd * r
+            for _ in range(nu - 1):
+                x = x + omega * invd * (r - apply_a(x))
+            lv = mg_t[idx]
+            grp = lv["grp"]
+            res = r - apply_a(x)
+            if "ac_inv" in lv:          # coarsest: dense ridged inverse
+                rc = restrict(grp, res, lv["ac_inv"].shape[-1])
+                ec = jnp.einsum("...ij,...j->...i", lv["ac_inv"], rc)
+            else:
+                invd_n = lv["invd"]
+                rc = restrict(grp, res, invd_n.shape[-1])
+                ec = vcycle(idx + 1, rc,
+                            lambda v, lv=lv: coo_apply(lv, v), invd_n)
+            x = x + jnp.take(ec, grp, axis=-1)
+            for _ in range(nu):          # symmetric post-smooth
+                x = x + omega * invd * (r - apply_a(x))
+            return x
+
+        def apply_precond(v):
+            return vcycle(0, v, matvec, inv_diag)
     elif coarse is not None:
         c_grp, ac_inv = coarse
         c_grp = jnp.asarray(c_grp, jnp.int32)

@@ -191,10 +191,24 @@ def _has_end(block: bytes) -> bool:
 
 
 def write_healpix_map(path: str, maps: dict[str, np.ndarray],
-                      pixels: np.ndarray, nside: int, nest: bool = False):
+                      pixels, nside: int, nest: bool = False):
     """Partial-sky HEALPix maps: PIXELS index HDU + one HDU per product
     (the healpy ``write_map(..., partial=True)`` analogue,
-    ``run_destriper.py:68-77``)."""
+    ``run_destriper.py:68-77``).
+
+    ``pixels`` is the seen-pixel index — an array of sky ids, or a
+    compacted ``mapmaking.pixel_space.PixelSpace`` whose dictionary is
+    written directly: compacted map values align with it as-is, so the
+    full-sky vector is never materialised anywhere on the write path.
+    """
+    from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+
+    if isinstance(pixels, PixelSpace):
+        if not pixels.compacted:
+            raise ValueError("partial-map write needs a compacted "
+                             "PixelSpace (a dense space has no "
+                             "seen-pixel dictionary)")
+        pixels = pixels.pixels
     hdr = {"PIXTYPE": "HEALPIX", "ORDERING": "NESTED" if nest else "RING",
            "NSIDE": nside, "OBJECT": "PARTIAL"}
     images: dict[str, np.ndarray] = {

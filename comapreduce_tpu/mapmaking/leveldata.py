@@ -31,6 +31,7 @@ import numpy as np
 from comapreduce_tpu.astro.coordinates import e2g
 from comapreduce_tpu.data.level import COMAPLevel2
 from comapreduce_tpu.mapmaking import healpix as hp
+from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
 from comapreduce_tpu.mapmaking.wcs import WCS
 from comapreduce_tpu.ops.median_filter import rolling_median
 from comapreduce_tpu.resilience.tripwires import scrub_tod_host
@@ -67,16 +68,24 @@ class DestriperData:
     """Flat, concatenated inputs for the destriper."""
 
     tod: np.ndarray            # f32[N]
-    pixels: np.ndarray         # i32[N] (compact ids for healpix)
+    pixels: np.ndarray         # i32[N] (solver ids: compact when
+    #                            pixel_space.compacted)
     weights: np.ndarray        # f32[N]
     ground_ids: np.ndarray     # i32[N] — per (file, feed) group
     az: np.ndarray             # f32[N] — normalised azimuth per group
     n_groups: int
-    npix: int
+    npix: int                  # solver segment count (= n_compact when
+    #                            compacted — the dense sky count never
+    #                            reaches the solver)
     wcs: WCS | None = None
     nside: int | None = None
-    sky_pixels: np.ndarray | None = None  # healpix: compact -> sky pixel id
+    sky_pixels: np.ndarray | None = None  # compact -> sky pixel id
     files: list = field(default_factory=list)
+    # the seen-pixel dictionary the solver ids live in; None = dense
+    # (legacy WCS default). Writers scatter compact maps to the sky
+    # through it at write time (PixelSpace.expand) — the only place an
+    # npix_sky-sized vector may exist.
+    pixel_space: PixelSpace | None = None
 
     def expand_map(self, compact_map: np.ndarray) -> np.ndarray:
         """Compact-pixel map -> full-sky-indexable (pixels, values)."""
@@ -184,7 +193,8 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     min_sun_distance_deg: float = 10.0,
                     tod_variant: str = "auto",
                     prefetch: int = 0, cache=None,
-                    resilience=None) -> DestriperData:
+                    resilience=None, compact="auto",
+                    pixel_space: PixelSpace | None = None) -> DestriperData:
     """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
     ``nside`` selects the pixelisation. ``mask_turnarounds`` zero-weights
     samples outside the ``speed_range`` deg/s scan-speed band (the legacy
@@ -217,6 +227,20 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     filelist — skip redundant decode. Both paths share one iteration
     (``ingest.level2_stream``), so results are identical.
 
+    ``compact`` selects the seen-pixel compaction
+    (``mapmaking.pixel_space``): ``"auto"`` (default) compacts HEALPix
+    (the survey regime — nside 4096 is ~201M sky pixels of which a
+    field hits well under 1%) and keeps WCS dense (legacy default for
+    small rasters); ``True``/``False`` force it either way. Compacted,
+    the solver ids in ``pixels`` index the campaign-level seen-pixel
+    dictionary (``pixel_space``) — the union of hit pixels across ALL
+    files of this filelist — and ``npix`` is its ``n_compact``, so
+    every downstream map vector is coverage-, never sky-, sized.
+    ``pixel_space`` overrides the locally-built dictionary with a
+    precomputed one (e.g. the union across every rank's filelist shard,
+    ``pixel_space.build_seen_pixel_space``) so all ranks agree on the
+    compacted ids and their partial maps coadd without re-indexing.
+
     ``resilience`` (a ``resilience.Resilience`` bundle) adds the fault
     layer: files the quarantine ledger marks bad are skipped without a
     read, transient read failures retry with backoff, injected chaos
@@ -236,6 +260,17 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     variants = ("auto", "gain_filtered", "original", "frequency_binned")
     if tod_variant not in variants:
         raise ValueError(f"tod_variant must be one of {variants}")
+    # validate the compaction knob BEFORE any file I/O (the section
+    # rule: a typo'd knob fails before work starts, not after a
+    # campaign-scale ingest)
+    if isinstance(compact, str):
+        c = compact.strip().lower()
+        if c not in ("auto", "true", "false"):
+            raise ValueError(f"compact must be auto|true|false, got "
+                             f"{compact!r}")
+        do_compact = (nside is not None) if c == "auto" else (c == "true")
+    else:
+        do_compact = bool(compact)
     if resilience is None:
         from comapreduce_tpu.resilience import Resilience
 
@@ -416,26 +451,29 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     ground_ids = np.concatenate(gids)
     az = np.concatenate(azs)
 
-    sky_pixels = None
-    if wcs is not None:
-        npix = wcs.npix
-        pixels32 = np.where((pixels < 0) | (pixels >= npix), npix,
-                            pixels).astype(np.int32)
+    npix_sky = wcs.npix if wcs is not None else hp.nside2npix(nside)
+    if pixel_space is not None:
+        if pixel_space.npix_sky != npix_sky:
+            raise ValueError(f"pixel_space is over {pixel_space.npix_sky} "
+                             f"sky pixels, the pixelisation has "
+                             f"{npix_sky}")
+        space = pixel_space
+    elif do_compact:
+        # seen-pixel compaction (COMAPData.py:43-70,570-574): the
+        # campaign-level dictionary is the union over every file of
+        # THIS filelist (pixels concatenated above)
+        space = PixelSpace.from_pixels(pixels, npix_sky)
     else:
-        # seen-pixel compaction (COMAPData.py:43-70,570-574)
-        valid = (pixels >= 0) & (pixels < hp.nside2npix(nside))
-        sky_pixels = np.unique(pixels[valid])
-        npix = int(sky_pixels.size)
-        idx = np.searchsorted(sky_pixels, np.clip(pixels, 0, None))
-        idx = np.clip(idx, 0, max(npix - 1, 0))
-        match = valid & (sky_pixels[idx] == pixels) if npix else \
-            np.zeros_like(valid)
-        pixels32 = np.where(match, idx, npix).astype(np.int32)
+        space = PixelSpace.dense(npix_sky)
+    # remap pointing ONCE (sky -> solver ids; invalid/unseen -> the
+    # drop sentinel n_solve)
+    pixels32 = space.remap(pixels)
     return DestriperData(tod=tod.astype(np.float32), pixels=pixels32,
                          weights=weights.astype(np.float32),
                          ground_ids=ground_ids, az=az, n_groups=group,
-                         npix=npix, wcs=wcs, nside=nside,
-                         sky_pixels=sky_pixels, files=kept_files)
+                         npix=space.n_solve, wcs=wcs, nside=nside,
+                         sky_pixels=space.pixels, files=kept_files,
+                         pixel_space=space)
 
 
 def export_madam(data: DestriperData, path: str) -> None:
@@ -447,7 +485,10 @@ def export_madam(data: DestriperData, path: str) -> None:
 
     if data.nside is None:
         raise ValueError("MADAM export requires HEALPix pixelisation")
-    sky = data.sky_pixels[np.clip(data.pixels, 0, data.npix - 1)]
+    if data.sky_pixels is not None:
+        sky = data.sky_pixels[np.clip(data.pixels, 0, data.npix - 1)]
+    else:   # dense (compact=False) healpix: solver ids ARE sky ids
+        sky = np.clip(data.pixels, 0, data.npix - 1).astype(np.int64)
     invalid = data.pixels >= data.npix
     nest_pix = hp.ring2nest(data.nside, sky)
     nest_pix = np.where(invalid, -1, np.asarray(nest_pix))

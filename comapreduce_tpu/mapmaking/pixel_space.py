@@ -1,0 +1,225 @@
+"""Seen-pixel dictionaries: the map vector's index space.
+
+At the production sky-survey regime (HEALPix nside 4096, ~201M pixels)
+a COMAP field hits well under 1% of the sky, so every dense
+``f32[npix]`` map vector — each ``segment_sum`` target, each CG state
+leaf — would waste >99% of its bytes and FLOPs. The reference pipeline
+compacts seen pixels for exactly this reason (``COMAPData.py:570-574``);
+this module makes that compaction a first-class object instead of an
+ad-hoc ``np.unique`` inside the data layer:
+
+- :class:`PixelSpace` is *dense* (identity: solver ids == sky ids) or
+  *compacted* (a sorted seen-pixel dictionary: solver id ``i`` is sky
+  pixel ``pixels[i]``). Everything downstream — binning segment counts,
+  destriper CG state, Jacobi/coarse/multigrid builds, the sharded
+  ``psum`` vectors — sizes itself to ``n_solve`` (= ``n_compact`` when
+  compacted), and the writers scatter compacted values into the full
+  map **only at write time**, host-side. ``npix``-sized vectors never
+  exist on device.
+- The dictionary is built host-side as the union of hit pixels across
+  all files of a campaign (:func:`build_seen_pixel_space`) — one
+  CAMPAIGN-level index, so every shard/rank that receives the same
+  dictionary agrees on the compacted ids and compact partial maps
+  psum/coadd without any re-indexing (the reference's allgather'd
+  seen-pixel list). :meth:`PixelSpace.union` merges dictionaries for
+  the coadd path.
+
+The class is content-hashable (shape + sha1 digest of the dictionary),
+so it can ride ``jax.jit`` static arguments and the CLI's plan memo the
+same way a plain ``npix`` int does.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["PixelSpace", "build_seen_pixel_space", "resolve_npix"]
+
+
+@dataclass(frozen=True)
+class PixelSpace:
+    """Dense or compacted pixel index space (see module docstring).
+
+    ``npix_sky``: the full sky/field pixel count (``12 nside^2`` for
+    HEALPix, ``nx*ny`` for a WCS field). ``pixels``: ``None`` for the
+    dense space, else the sorted unique seen-pixel dictionary
+    (i64[n_compact], strictly increasing, all in ``[0, npix_sky)``).
+    """
+
+    npix_sky: int
+    pixels: np.ndarray | None = None
+    _digest: str = field(default="", repr=False, compare=False)
+
+    def __post_init__(self):
+        object.__setattr__(self, "npix_sky", int(self.npix_sky))
+        if self.pixels is not None:
+            pix = np.ascontiguousarray(np.asarray(self.pixels, np.int64))
+            if pix.ndim != 1:
+                raise ValueError("pixel dictionary must be 1-D")
+            if pix.size:
+                if (np.diff(pix) <= 0).any():
+                    raise ValueError("pixel dictionary must be sorted "
+                                     "strictly increasing (use "
+                                     "build_seen_pixel_space)")
+                if pix[0] < 0 or pix[-1] >= self.npix_sky:
+                    raise ValueError(
+                        f"pixel dictionary ids outside [0, "
+                        f"{self.npix_sky}): [{pix[0]}, {pix[-1]}]")
+            object.__setattr__(self, "pixels", pix)
+            object.__setattr__(
+                self, "_digest", hashlib.sha1(pix.tobytes()).hexdigest())
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def dense(cls, npix: int) -> "PixelSpace":
+        return cls(npix_sky=int(npix))
+
+    @classmethod
+    def from_dictionary(cls, pixels, npix_sky: int) -> "PixelSpace":
+        """Wrap an ALREADY sorted-unique dictionary (validated)."""
+        return cls(npix_sky=int(npix_sky), pixels=np.asarray(pixels))
+
+    @classmethod
+    def from_pixels(cls, pixels, npix_sky: int) -> "PixelSpace":
+        """Compact from a raw pixel stream: sorted unique of the valid
+        (``0 <= p < npix_sky``) ids. Invalid/sentinel ids drop out here
+        and come back as the drop sentinel from :meth:`remap`."""
+        pix = np.asarray(pixels, np.int64).ravel()
+        valid = (pix >= 0) & (pix < int(npix_sky))
+        return cls(npix_sky=int(npix_sky), pixels=np.unique(pix[valid]))
+
+    def union(self, *others: "PixelSpace") -> "PixelSpace":
+        """Merged dictionary over several spaces (the coadd rule). Any
+        dense participant makes the union dense; sky sizes must agree
+        (the caller's mixed-nside check fires first with a better
+        message)."""
+        spaces = (self,) + others
+        npix = {s.npix_sky for s in spaces}
+        if len(npix) != 1:
+            raise ValueError(f"union over mixed sky sizes {sorted(npix)}")
+        if any(not s.compacted for s in spaces):
+            return PixelSpace.dense(self.npix_sky)
+        merged = np.unique(np.concatenate([s.pixels for s in spaces]))
+        return PixelSpace.from_dictionary(merged, self.npix_sky)
+
+    # -- properties -------------------------------------------------------
+
+    @property
+    def compacted(self) -> bool:
+        return self.pixels is not None
+
+    @property
+    def n_compact(self) -> int:
+        if self.pixels is None:
+            raise ValueError("dense PixelSpace has no compact size")
+        return int(self.pixels.size)
+
+    @property
+    def n_solve(self) -> int:
+        """Segment count the solver sees: ``n_compact`` when compacted,
+        else the full ``npix_sky`` (dense)."""
+        return int(self.pixels.size) if self.pixels is not None \
+            else self.npix_sky
+
+    # -- index maps -------------------------------------------------------
+
+    def remap(self, global_pixels) -> np.ndarray:
+        """Global sky ids -> solver ids (i32), ONCE per plan, host-side.
+
+        Ids outside the dictionary (including negatives and
+        ``>= npix_sky``) map to the drop sentinel ``n_solve`` — the
+        binning layer's invalid-sample convention, so a remapped stream
+        plugs into ``bin_map``/``build_pointing_plan`` unchanged. Dense
+        spaces only sentinel-ise the out-of-range ids."""
+        pix = np.asarray(global_pixels, np.int64)
+        if self.pixels is None:
+            return np.where((pix < 0) | (pix >= self.npix_sky),
+                            self.npix_sky, pix).astype(np.int32)
+        n = self.n_compact
+        if n == 0:
+            # empty dictionary (fully-flagged filelist): every sample
+            # sentinel-ises, same as the pre-PixelSpace data layer
+            return np.zeros(pix.shape, np.int32)
+        idx = np.clip(np.searchsorted(self.pixels, np.clip(pix, 0, None)),
+                      0, n - 1)
+        hit = ((pix >= 0) & (pix < self.npix_sky)
+               & (self.pixels[idx] == pix))
+        return np.where(hit, idx, n).astype(np.int32)
+
+    def to_global(self, solver_ids) -> np.ndarray:
+        """Solver ids -> global sky ids (sentinels ride through as
+        ``npix_sky``)."""
+        ids = np.asarray(solver_ids, np.int64)
+        if self.pixels is None:
+            return ids
+        out = np.full(ids.shape, self.npix_sky, np.int64)
+        ok = (ids >= 0) & (ids < self.n_compact)
+        out[ok] = self.pixels[ids[ok]]
+        return out
+
+    def expand(self, values: np.ndarray, fill: float = 0.0) -> np.ndarray:
+        """Scatter a compact map into the FULL sky vector — write time
+        only, host-side (this is the one place an ``npix_sky``-sized
+        array may exist, and it never touches a device). Dense spaces
+        pass values through. Leading axes (multi-RHS bands) ride."""
+        vals = np.asarray(values)
+        if self.pixels is None:
+            return vals
+        if vals.shape[-1] != self.n_compact:
+            # exact — a longer input is as wrong as a shorter one
+            # (e.g. an already-expanded dense map passed back in would
+            # otherwise scatter sky-indexed values into dictionary
+            # slots with no error)
+            raise ValueError(f"compact map has {vals.shape[-1]} entries "
+                             f"for a {self.n_compact}-pixel dictionary")
+        full = np.full(vals.shape[:-1] + (self.npix_sky,), fill,
+                       np.asarray(vals).dtype)
+        full[..., self.pixels] = vals
+        return full
+
+    # -- hashing (jit static args / plan memo keys) -----------------------
+
+    def __hash__(self):
+        return hash((self.npix_sky, self._digest))
+
+    def __eq__(self, other):
+        return (isinstance(other, PixelSpace)
+                and self.npix_sky == other.npix_sky
+                and self._digest == other._digest)
+
+
+def build_seen_pixel_space(pixel_streams, npix_sky: int) -> PixelSpace:
+    """CAMPAIGN-level seen-pixel dictionary: the sorted union of hit
+    pixels across all files/shards.
+
+    ``pixel_streams``: an iterable of per-file (or per-shard) global
+    pixel arrays — streamed, so the union never needs every file's
+    pointing in memory at once. The result is deterministic in the
+    stream CONTENT (sorted unique), not its order, so every rank that
+    unions the same campaign's files computes the identical dictionary
+    — the host-side analogue of the reference's allgather'd seen-pixel
+    list, and the property that makes per-shard compact maps
+    ``psum``-consistent and rank partial maps coadd-able without
+    re-indexing."""
+    seen: np.ndarray | None = None
+    for pix in pixel_streams:
+        part = PixelSpace.from_pixels(pix, npix_sky).pixels
+        seen = part if seen is None else \
+            np.union1d(seen, part)
+    if seen is None:
+        seen = np.empty(0, np.int64)
+    return PixelSpace.from_dictionary(seen, npix_sky)
+
+
+def resolve_npix(npix) -> int:
+    """``npix | PixelSpace`` -> the solver's segment count. ONE home for
+    the rule — every consumer of an ``npix``-like argument (binning,
+    destriper, plans, sharded wrappers) resolves through here so a
+    compacted space means ``n_compact`` everywhere at once."""
+    if isinstance(npix, PixelSpace):
+        return npix.n_solve
+    return int(npix)

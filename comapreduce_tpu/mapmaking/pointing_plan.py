@@ -35,8 +35,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from comapreduce_tpu.mapmaking.pixel_space import PixelSpace, resolve_npix
+
 __all__ = ["PointingPlan", "build_pointing_plan", "build_sharded_plans",
            "binned_window_sum"]
+
+
+def _resolve_pixel_space(pixels, npix, pixel_space):
+    """Shared plan-entry rule: remap GLOBAL sky pixels through a
+    compacted :class:`PixelSpace` ONCE per plan build (the sentinel
+    ``n_solve`` rides the existing invalid-pixel path), or resolve a
+    ``PixelSpace`` passed as ``npix`` (pixels then already solver
+    ids). A mismatched ``npix``/``pixel_space`` pair raises (the data
+    layer's rule) — remapping against a wrong-resolution dictionary
+    would silently sentinel-ise or misplace most samples."""
+    if pixel_space is not None:
+        n = resolve_npix(npix)
+        if n not in (pixel_space.npix_sky, pixel_space.n_solve):
+            raise ValueError(
+                f"npix {n} matches neither pixel_space.npix_sky "
+                f"{pixel_space.npix_sky} nor its n_solve "
+                f"{pixel_space.n_solve} — wrong-resolution dictionary?")
+        return pixel_space.remap(pixels), pixel_space.n_solve
+    return pixels, resolve_npix(npix)
 
 
 def _round_up(x: int, q: int) -> int:
@@ -168,8 +189,16 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
                         pair_chunk: int = 4096,
                         min_pair_pad: int = 0,
                         min_windows: tuple = (0, 0, 0),
-                        pair_batch: int | None = None) -> PointingPlan:
+                        pair_batch: int | None = None,
+                        pixel_space: PixelSpace | None = None
+                        ) -> PointingPlan:
     """Build the static plan for one flat pointing vector.
+
+    ``pixel_space``: a compacted seen-pixel dictionary — ``pixels`` are
+    then GLOBAL sky ids, remapped here once per plan (the plan's
+    ``npix`` becomes ``n_compact`` and ``uniq_pixels`` index the
+    dictionary, not the sky). ``npix`` alone may also be a
+    ``PixelSpace`` when the pixels are already solver ids.
 
     ``pixels``: integer pixel per sample (invalid = negative or >= npix);
     length must be a multiple of ``offset_length`` (sample t belongs to
@@ -195,6 +224,7 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
     chunks change the f32 accumulation grouping, so results are equal to
     the unbatched plan only to rounding, not bit-for-bit.
     """
+    pixels, npix = _resolve_pixel_space(pixels, npix, pixel_space)
     pixels = np.asarray(pixels).astype(np.int64).ravel()
     N = pixels.size
     if N % offset_length:
@@ -294,7 +324,9 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
 def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
                         n_shards: int, sample_chunk: int = 8192,
                         pair_chunk: int = 4096,
-                        pair_batch: int | None = None) -> list[PointingPlan]:
+                        pair_batch: int | None = None,
+                        pixel_space: PixelSpace | None = None
+                        ) -> list[PointingPlan]:
     """Per-shard plans over contiguous time shards with identical static
     shapes (one compiled SPMD program) and a shared GLOBAL compact space.
 
@@ -306,7 +338,13 @@ def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
     ``psum`` (the reference's allgather'd seen-pixel compaction,
     ``COMAPData.py:43-70,570-574``). Memory stays bounded by hit pixels,
     never ``npix`` (SURVEY hard part 3, nside-4096 HEALPix destriping).
+    ``pixel_space`` (or a ``PixelSpace`` as ``npix``) remaps once here,
+    exactly as in :func:`build_pointing_plan` — the global compact index
+    space every shard psums over then IS the campaign seen-pixel
+    dictionary, so every shard (and any other solve sharing the
+    dictionary) agrees on the compacted ids.
     """
+    pixels, npix = _resolve_pixel_space(pixels, npix, pixel_space)
     pixels = np.asarray(pixels).astype(np.int64).ravel()
     N = pixels.size
     quantum = n_shards * offset_length

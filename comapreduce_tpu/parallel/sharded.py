@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from comapreduce_tpu.mapmaking.destriper import (DestriperResult,
                                                  _check_precond, destripe,
                                                  destripe_planned)
+from comapreduce_tpu.mapmaking.pixel_space import resolve_npix
 from comapreduce_tpu.mapmaking.pointing_plan import PointingPlan
 from comapreduce_tpu.ops.reduce import (ReduceConfig, reduce_feed_scans,
                                         scan_starts_lengths)
@@ -171,8 +172,10 @@ def pad_for_shards(tod, pixels, weights, n_shards: int, offset_length: int,
     Padding samples carry zero weight and the drop pixel ``npix``, so they
     change nothing (the reference instead truncates scans to offset
     multiples, ``COMAPData.py:163-187``; padding wastes nothing on TPU where
-    shapes are static anyway).
+    shapes are static anyway). ``npix`` may be a ``PixelSpace`` — the
+    sentinel is then the compacted space's ``n_solve``.
     """
+    npix = resolve_npix(npix)
     n = tod.shape[0]
     quantum = n_shards * offset_length
     n_pad = (-n) % quantum
@@ -194,8 +197,12 @@ def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
     ``tod``/``weights`` f32[N], ``pixels`` i32[N]; N is padded here to a
     multiple of ``n_devices * offset_length``. The returned ``offsets``
     vector is the concatenation over shards (global offset order); maps and
-    CG scalars come back replicated.
+    CG scalars come back replicated. ``npix`` may be a compacted
+    ``PixelSpace`` (pixels already remapped to solver ids): every
+    psum'd map vector is then ``n_compact``-sized — the whole-mesh
+    reduction never materialises the sky.
     """
+    npix = resolve_npix(npix)
     axes = tuple(mesh.axis_names)
     n_shards = int(np.prod([mesh.shape[a] for a in axes]))
     tod, pixels, weights = pad_for_shards(

@@ -98,9 +98,22 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                        "compiles_baseline_steady": 8,
                        "cache_hits": 1, "cache_misses": 0,
                        "write_overlap_fraction": 0.9}}
+    dstr = {"metric": "destriper_cg_iters_to_tol", "value": 58,
+            "detail": {"config": "destriper",
+                       "preconditioners": {
+                           "none": {"iters_to_tol": 178},
+                           "jacobi": {"iters_to_tol": 160},
+                           "twolevel": {"iters_to_tol": 81},
+                           "multigrid": {"iters_to_tol": 58}},
+                       "compacted": {"map_vector_bytes": 12288,
+                                     "n_compact": 768, "n_bands": 1},
+                       "survey4096": {"map_vector_bytes": 12288,
+                                      "n_compact": 768, "n_bands": 1}}}
     monkeypatch.setattr(cp, "run_quick_bench", lambda: dict(rec))
     monkeypatch.setattr(cp, "run_campaign_bench",
                         lambda: json.loads(json.dumps(camp)))
+    monkeypatch.setattr(cp, "run_destriper_bench",
+                        lambda: json.loads(json.dumps(dstr)))
     monkeypatch.setattr(
         cp, "reference_path",
         lambda platform: str(tmp_path / f"perf_quick_{platform}.json"))
@@ -125,6 +138,21 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     assert cp.main(["--reps", "1", "--no-campaign"]) == 0
     camp["detail"]["compiles_campaign_steady"] = 1
     assert cp.main(["--reps", "1", "--dispatch-only"]) == 0
+    # the destriper memory gate (ISSUE 6): map-vector bytes beyond
+    # MEM_SLACK x 4 B x (3 nb + 1) x n_compact fail (an npix-sized
+    # vector leaked back onto the device); budget math per section
+    dstr["detail"]["survey4096"]["map_vector_bytes"] = \
+        40 * dstr["detail"]["survey4096"]["n_compact"]
+    assert cp.main(["--reps", "1"]) == 1
+    assert cp.main(["--reps", "1", "--no-destriper"]) == 0
+    dstr["detail"]["survey4096"]["map_vector_bytes"] = 12288
+    # ... and the iteration gate: multigrid must beat twolevel
+    dstr["detail"]["preconditioners"]["multigrid"]["iters_to_tol"] = 90
+    assert cp.main(["--reps", "1"]) == 1
+    dstr["detail"]["preconditioners"]["multigrid"]["iters_to_tol"] = None
+    assert cp.main(["--reps", "1"]) == 1
+    dstr["detail"]["preconditioners"]["multigrid"]["iters_to_tol"] = 58
+    assert cp.main(["--reps", "1"]) == 0
 
 
 def test_bench_config_modes_emit_json(tmp_path):
@@ -204,3 +232,38 @@ def test_bench_campaign_smoke(tmp_path):
     assert d["writeback"]["writes"] > 0
     assert rec["vs_baseline"] > 1.0
     assert (tmp_path / "evidence" / "bench_campaign_host.json").exists()
+
+
+def test_bench_destriper_smoke(tmp_path):
+    """``--config destriper`` (ISSUE 6): preconditioner ladder +
+    compaction on the small raster — multigrid must reach tolerance in
+    fewer iterations than twolevel, and every compacted device
+    map-vector byte count must be O(n_compact), including the
+    nside-4096 survey smoke (201M sky pixels on the CPU container)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
+    env.update(BENCH_SMALL="1", BENCH_NO_PROBE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo, BENCH_EVIDENCE_DIR=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--config", "destriper"],
+        capture_output=True, text=True, env=env, timeout=420, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "destriper_cg_iters_to_tol"
+    d = rec["detail"]
+    it = {k: v["iters_to_tol"] for k, v in d["preconditioners"].items()}
+    assert all(it[k] is not None for k in it), it
+    # the pinned ordering: every preconditioner beats none, multigrid
+    # beats the additive two-level (the acceptance criterion)
+    assert it["multigrid"] < it["twolevel"] < it["none"]
+    assert it["jacobi"] < it["none"]
+    for tag in ("compacted", "survey4096"):
+        sec = d[tag]
+        assert sec["map_vector_bytes"] <= 2 * 16 * sec["n_compact"]
+    assert d["survey4096"]["npix_sky"] == 201_326_592
+    assert d["survey4096"]["n_compact"] < 10_000
+    # the round-7 artifact lands next to the evidence dir
+    assert (tmp_path / "BENCH_r06.json").exists()

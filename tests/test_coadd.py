@@ -103,8 +103,69 @@ def test_coadd_rejects_mixed_shapes(tmp_path):
     p2 = str(tmp_path / "b.fits")
     write_fits_image(p1, _rank_maps(6, shape=(8, 8)))
     write_fits_image(p2, _rank_maps(7, shape=(6, 6)))
-    with pytest.raises(ValueError, match="shapes"):
+    # the error NAMES both offending files (a campaign glob spans
+    # hundreds of rank maps; a shape set alone is unactionable)
+    with pytest.raises(ValueError, match="a.fits.*b.fits"):
         coadd_fits_files([p1, p2], str(tmp_path / "o.fits"))
+
+
+def _partial_map(pix):
+    n = np.asarray(pix).size
+    return {"DESTRIPED": np.ones(n, np.float32),
+            "WEIGHTS": np.ones(n, np.float32)}
+
+
+def test_coadd_rejects_mixed_nside_naming_files(tmp_path):
+    """The mixed-pixelisation error path (ISSUE 6 satellite): mixed
+    nside AND mixed ordering each raise naming the two offending
+    files."""
+    p1 = str(tmp_path / "rank0.fits")
+    p2 = str(tmp_path / "rank1.fits")
+    p3 = str(tmp_path / "rank2.fits")
+    pix = np.arange(10)
+    write_healpix_map(p1, _partial_map(pix), pix, 64)
+    write_healpix_map(p2, _partial_map(pix), pix, 128)
+    write_healpix_map(p3, _partial_map(pix), pix, 64, nest=True)
+    with pytest.raises(ValueError,
+                       match=r"rank0.*nside 64.*rank1.*nside 128"):
+        coadd_fits_files([p1, p2], str(tmp_path / "o.fits"))
+    with pytest.raises(ValueError, match=r"rank0.*RING.*rank2.*NESTED"):
+        coadd_fits_files([p1, p3], str(tmp_path / "o.fits"))
+
+
+def test_coadd_rejects_out_of_range_pixels_naming_file(tmp_path):
+    """A corrupt PIXELS id (outside the sky for the header's nside)
+    raises naming the file — the dictionary union would silently drop
+    it and the remap would scatter out of bounds otherwise."""
+    p1 = str(tmp_path / "ok.fits")
+    p2 = str(tmp_path / "corrupt.fits")
+    pix_ok = np.arange(10)
+    pix_bad = np.array([1, 5, 12 * 64 * 64])      # >= nside2npix(64)
+    write_healpix_map(p1, _partial_map(pix_ok), pix_ok, 64)
+    write_healpix_map(p2, _partial_map(pix_bad), pix_bad, 64)
+    with pytest.raises(ValueError, match=r"corrupt\.fits.*49152"):
+        coadd_fits_files([p1, p2], str(tmp_path / "o.fits"))
+
+
+def test_coadd_healpix_never_densifies(tmp_path):
+    """Compacted inputs union DICTIONARIES: the output pixel set is the
+    coverage union even at survey nside (4096) — a densify-to-npix
+    implementation would allocate 201M-pixel vectors here and time
+    out/OOM instead of finishing instantly."""
+    nside = 4096
+    pix_a = np.array([5, 900_000, 150_000_000])
+    pix_b = np.array([900_000, 201_326_591])
+    p1 = str(tmp_path / "a.fits")
+    p2 = str(tmp_path / "b.fits")
+    write_healpix_map(p1, _partial_map(pix_a), pix_a, nside)
+    write_healpix_map(p2, _partial_map(pix_b), pix_b, nside)
+    out = coadd_fits_files([p1, p2], str(tmp_path / "o.fits"))
+    maps, pixels, ns, _ = read_healpix_map(str(tmp_path / "o.fits"))
+    assert ns == nside
+    np.testing.assert_array_equal(pixels, np.union1d(pix_a, pix_b))
+    assert out["WEIGHTS"].shape == (4,)   # union-of-coverage sized
+    sel = np.searchsorted(pixels, 900_000)
+    assert maps["WEIGHTS"][sel] == 2.0
 
 
 def test_coadd_rejects_mixed_layouts(tmp_path):

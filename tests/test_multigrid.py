@@ -1,0 +1,317 @@
+"""Multigrid V-cycle preconditioner (ISSUE 6 tentpole 2).
+
+The contract: ``preconditioner = multigrid`` converges to THE SAME
+fixed point as every other knob (a preconditioner changes the CG path,
+never the solution), reaches tolerance in measurably FEWER iterations
+than ``twolevel`` on the weight-spread raster (the acceptance
+criterion), applies a symmetric positive-definite operator (CG's
+admissibility condition), and leaves the divergence-monitor/watchdog
+plumbing untouched.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from comapreduce_tpu.mapmaking.destriper import (
+    build_coarse_preconditioner, build_multigrid_hierarchy,
+    destripe_planned, multigrid_levels, multigrid_patterns,
+    stack_multigrid, watched_solve)
+from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+
+
+def _dense_problem(N=4000, L=50, npix=144, seed=0):
+    rng = np.random.default_rng(seed)
+    pix = ((np.arange(N) * 7) % npix).astype(np.int32)
+    tod = (rng.standard_normal(N)
+           + np.repeat(rng.standard_normal(N // L), L)).astype(np.float32)
+    return tod, pix, np.ones(N, np.float32), L, npix
+
+
+def _spread_problem(seed=0, T=12_000, nx=32, L=50):
+    # ONE fixture home (bench.weight_spread_raster): the acceptance
+    # tests and the perf gate's bench must measure the same class
+    from bench import weight_spread_raster
+
+    return weight_spread_raster(seed=seed, T=T, nx=nx, L=L)
+
+
+def _weighted_rms_diff(a, b, w):
+    m = np.asarray(w) > 0
+    wm = np.asarray(w)[m]
+    da, db = np.asarray(a)[m], np.asarray(b)[m]
+    da = da - np.sum(wm * da) / np.sum(wm)
+    db = db - np.sum(wm * db) / np.sum(wm)
+    d = da - db
+    return float(np.sqrt(np.sum(wm * d * d) / np.sum(wm)))
+
+
+def test_multigrid_levels_ladder():
+    # geometric x8 from the base block, coarsest fits max_coarse
+    assert multigrid_levels(1_000_000, block=8, levels=3) == [8, 64, 512]
+    assert multigrid_levels(240, block=8, levels=2) == [8, 64]
+    # levels that stop coarsening (or leave < 2 unknowns) are dropped
+    assert multigrid_levels(240, block=4, levels=3) == [4, 32]
+    # over-coarsening candidates degrade to a halving two-grid block;
+    # no valid (>= 2-unknown) level at all -> empty ladder (the
+    # builders refuse, the CLI falls back to Jacobi)
+    assert multigrid_levels(5, block=8, levels=2) == [3]
+    assert multigrid_levels(2, block=8, levels=2) == []
+    # max_coarse grows the coarsest by powers of two (nesting kept)
+    lv = multigrid_levels(10_000_000, block=8, levels=2, max_coarse=4096)
+    assert lv[0] == 8 and lv[-1] % lv[0] == 0
+    assert -(-10_000_000 // lv[-1]) <= 4096
+
+
+def test_multigrid_same_fixed_point_as_jacobi():
+    tod, pix, w, L, npix = _dense_problem()
+    plan = build_pointing_plan(pix, npix, L)
+    r_j = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                           n_iter=500, threshold=1e-6)
+    mg = build_multigrid_hierarchy(pix, w, npix, L, block=8, levels=2)
+    r_m = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                           n_iter=500, threshold=1e-6, mg=mg)
+    assert float(r_m.residual) < 1e-6
+    assert not bool(np.asarray(r_m.diverged))
+    rms = _weighted_rms_diff(r_m.destriped_map, r_j.destriped_map,
+                             r_j.weight_map)
+    assert rms < 1e-5, rms
+
+
+def test_multigrid_fewer_iterations_than_twolevel():
+    """THE acceptance criterion: on the weight-spread raster, the
+    V-cycle reaches the 1e-6 tolerance in measurably fewer CG
+    iterations than the additive two-level preconditioner."""
+    pix, tod, w, npix, L = _spread_problem()
+    plan = build_pointing_plan(pix, npix, L)
+    grp, aci = build_coarse_preconditioner(pix, w, npix, L, block=8)
+    r_two = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                             n_iter=1000, threshold=1e-6,
+                             coarse=(grp, jnp.asarray(aci)))
+    mg = build_multigrid_hierarchy(pix, w, npix, L, block=8, levels=2)
+    r_mg = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                            n_iter=1000, threshold=1e-6, mg=mg)
+    assert float(r_two.residual) < 1e-6 and float(r_mg.residual) < 1e-6
+    assert int(r_mg.n_iter) < int(r_two.n_iter), \
+        (int(r_mg.n_iter), int(r_two.n_iter))
+
+
+def test_vcycle_is_symmetric_positive_definite():
+    """CG admissibility: the V-cycle application M^-1 is symmetric
+    (<M u, v> == <u, M v>) and positive definite on random vectors —
+    checked through the live destripe_planned closure by probing the
+    preconditioned first iterate... instead we probe the operator
+    directly via the hierarchy on a small dense problem."""
+    import jax
+
+    pix, tod, w, npix, L = _spread_problem(T=4000)
+    n_off = (pix.size // L)
+    mg = build_multigrid_hierarchy(pix, w, npix, L, block=4, levels=2)
+    # reconstruct the fine operator + V-cycle exactly as the solver
+    # does, via a tiny destripe_planned run instrumented through the
+    # mg pytree: here we rebuild A from its definition instead
+    off_id = np.arange(pix.size) // L
+    wd = np.asarray(w, np.float64)
+    sw = np.bincount(pix, weights=wd, minlength=npix)
+    inv_sw = np.where(sw > 0, 1.0 / np.maximum(sw, 1e-30), 0.0)
+
+    def a_mat(v):
+        d = np.repeat(v, L)
+        m = np.bincount(pix, weights=wd * d, minlength=npix) * inv_sw
+        return np.bincount(off_id, weights=wd * (d - m[pix]),
+                           minlength=n_off)
+
+    d_fwf = np.bincount(off_id, weights=wd, minlength=n_off)
+    corr = np.bincount(off_id, weights=wd * wd * inv_sw[pix],
+                       minlength=n_off)
+    inv_diag = 1.0 / np.maximum(d_fwf - corr, 1e-12)
+    omega, f32 = 2.0 / 3.0, np.float64
+
+    def vcycle(idx, r, apply_a, invd):
+        x = omega * invd * r
+        lv = mg[idx]
+        grp = np.asarray(lv["grp"], np.int64)
+        res = r - apply_a(x)
+        if "ac_inv" in lv:
+            n_c = lv["ac_inv"].shape[-1]
+            rc = np.zeros(n_c)
+            np.add.at(rc, grp, res)
+            ec = np.asarray(lv["ac_inv"], np.float64) @ rc
+        else:
+            invd_n = np.asarray(lv["invd"], np.float64)
+            rc = np.zeros(invd_n.size)
+            np.add.at(rc, grp, res)
+
+            def coo(v, lv=lv):
+                out = np.zeros(v.size)
+                np.add.at(out, np.asarray(lv["rows"], np.int64),
+                          np.asarray(lv["vals"], np.float64)
+                          * v[np.asarray(lv["cols"], np.int64)])
+                return out
+
+            ec = vcycle(idx + 1, rc, coo, invd_n)
+        x = x + ec[grp]
+        return x + omega * invd * (r - apply_a(x))
+
+    rng = np.random.default_rng(1)
+    us = rng.standard_normal((4, n_off))
+    vs = rng.standard_normal((4, n_off))
+    for u, v in zip(us, vs):
+        mu = vcycle(0, u, a_mat, inv_diag)
+        mv = vcycle(0, v, a_mat, inv_diag)
+        lhs, rhs = float(u @ mv), float(v @ mu)
+        assert abs(lhs - rhs) < 1e-6 * max(abs(lhs), abs(rhs), 1.0)
+        assert float(u @ mu) > 0 and float(v @ mv) > 0
+
+
+def test_multi_rhs_stacked_hierarchy():
+    pix, tod, w, npix, L = _spread_problem(T=6000)
+    tod2 = np.stack([tod, (tod * 0.5).astype(np.float32)])
+    w2 = np.stack([w, (w * 2.0).astype(np.float32)])
+    pats = multigrid_patterns(pix, npix, L, block=8, levels=2)
+    mg = stack_multigrid([
+        build_multigrid_hierarchy(pix, w2[i], npix, L, patterns=pats)
+        for i in range(2)])
+    plan = build_pointing_plan(pix, npix, L)
+    r = destripe_planned(jnp.asarray(tod2), jnp.asarray(w2), plan=plan,
+                         n_iter=800, threshold=1e-6, mg=mg)
+    assert (np.asarray(r.residual) < 1e-6).all()
+    assert r.destriped_map.shape[0] == 2
+
+
+def test_invalid_combinations_raise():
+    tod, pix, w, L, npix = _dense_problem(seed=5)
+    plan = build_pointing_plan(pix, npix, L)
+    mg = build_multigrid_hierarchy(pix, w, npix, L)
+    grp, aci = build_coarse_preconditioner(pix, w, npix, L, block=8)
+    with pytest.raises(ValueError, match="jacobi"):
+        destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         precond="none", mg=mg)
+    with pytest.raises(ValueError, match="not both"):
+        destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         coarse=(grp, jnp.asarray(aci)), mg=mg)
+    with pytest.raises(ValueError, match="mg_omega"):
+        destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         mg=mg, mg_omega=1.5)
+    # a geometry with no >= 2-unknown level refuses at build time (a
+    # 1-block coarse system is pure null mode — guaranteed divergence)
+    with pytest.raises(ValueError, match="too small"):
+        build_multigrid_hierarchy(pix[:2 * L], w[:2 * L], npix, L)
+    # the V-cycle is not psum-threaded: a sharded (axis_name) solve
+    # must raise, not silently apply shard-inconsistent corrections
+    with pytest.raises(ValueError, match="shard_map"):
+        destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         axis_name="time", mg=mg)
+
+
+def test_empty_dictionary_remap_sentinels():
+    """A fully-flagged filelist yields an EMPTY seen-pixel dictionary;
+    remap must sentinel-ise every sample (the old data-layer guard),
+    not crash."""
+    from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+
+    s = PixelSpace.from_pixels(np.array([-1, 500]), 100)
+    assert s.n_compact == 0
+    np.testing.assert_array_equal(s.remap([3, -1, 200]), [0, 0, 0])
+
+
+def test_solve_band_tiny_geometry_falls_back_to_jacobi(caplog):
+    """preconditioner=multigrid on a geometry too small for any ladder
+    level runs Jacobi with a warning instead of assembling a
+    guaranteed-divergent 1-block coarse system."""
+    import logging
+
+    from comapreduce_tpu.cli.run_destriper import solve_band
+    from comapreduce_tpu.mapmaking.leveldata import DestriperData
+
+    rng = np.random.default_rng(0)
+    L, npix = 50, 16
+    tod = rng.standard_normal(2 * L).astype(np.float32)
+    data = DestriperData(tod=tod,
+                         pixels=(np.arange(2 * L) % npix).astype(np.int32),
+                         weights=np.ones(2 * L, np.float32),
+                         ground_ids=np.zeros(2 * L, np.int32),
+                         az=np.zeros(2 * L, np.float32), n_groups=1,
+                         npix=npix)
+    with caplog.at_level(logging.WARNING, logger="comapreduce_tpu"):
+        r = solve_band(data, offset_length=L, n_iter=100,
+                       threshold=1e-6,
+                       mg={"levels": 2, "smooth": 1, "block": 8})
+    assert float(r.residual) < 1e-6
+    assert any("multigrid unavailable" in rec.message
+               for rec in caplog.records)
+
+
+def test_mg_smooth_two_converges_faster_or_equal():
+    pix, tod, w, npix, L = _spread_problem()
+    plan = build_pointing_plan(pix, npix, L)
+    mg = build_multigrid_hierarchy(pix, w, npix, L, block=8, levels=2)
+    r1 = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                          n_iter=1000, threshold=1e-6, mg=mg)
+    r2 = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                          n_iter=1000, threshold=1e-6, mg=mg,
+                          mg_smooth=2)
+    assert float(r2.residual) < 1e-6
+    assert int(r2.n_iter) <= int(r1.n_iter)
+
+
+def test_watchdog_contract_under_multigrid():
+    """``mapmaking.cg_solve`` semantics unchanged under mg: a watched
+    solve records deadline state; a blown hard deadline flags
+    ``hard_expired`` without touching the result."""
+    from comapreduce_tpu.resilience.watchdog import (Watchdog,
+                                                     parse_deadlines)
+
+    tod, pix, w, L, npix = _dense_problem(seed=6)
+    plan = build_pointing_plan(pix, npix, L)
+    mg = build_multigrid_hierarchy(pix, w, npix, L)
+
+    wd = Watchdog(deadlines=parse_deadlines("mapmaking.cg_solve=60/120"))
+    result, state = watched_solve(
+        lambda: destripe_planned(jnp.asarray(tod), jnp.asarray(w),
+                                 plan=plan, n_iter=300, threshold=1e-6,
+                                 mg=mg),
+        wd, unit="band0")
+    assert state is not None and not state.hard_expired
+    assert float(result.residual) < 1e-6
+
+    wd2 = Watchdog(deadlines=parse_deadlines("mapmaking.cg_solve=/1e-9"),
+                   grace_s=0.0)
+    result2, state2 = watched_solve(
+        lambda: destripe_planned(jnp.asarray(tod), jnp.asarray(w),
+                                 plan=plan, n_iter=300, threshold=1e-6,
+                                 mg=mg),
+        wd2, unit="band0")
+    assert state2 is not None and state2.hard_expired
+    np.testing.assert_array_equal(np.asarray(result2.destriped_map),
+                                  np.asarray(result.destriped_map))
+
+
+def test_solve_band_multigrid_end_to_end():
+    """The CLI-level mg config dict reaches the planned solver and the
+    sharded path falls back to twolevel with a warning."""
+    import logging
+
+    from comapreduce_tpu.cli.run_destriper import solve_band
+    from comapreduce_tpu.mapmaking.leveldata import DestriperData
+
+    pix, tod, w, npix, L = _spread_problem(T=6000)
+    data = DestriperData(tod=tod, pixels=pix.astype(np.int32), weights=w,
+                         ground_ids=np.zeros(tod.size, np.int32),
+                         az=np.zeros(tod.size, np.float32), n_groups=1,
+                         npix=npix)
+    mg_cfg = {"levels": 2, "smooth": 1, "block": 8}
+    r = solve_band(data, offset_length=L, n_iter=800, threshold=1e-6,
+                   mg=mg_cfg)
+    assert float(r.residual) < 1e-6
+    r_j = solve_band(data, offset_length=L, n_iter=800, threshold=1e-6)
+    assert int(r.n_iter) < int(r_j.n_iter)   # the V-cycle earned its keep
+    # this raster class wanders along weakly-determined modes, so the
+    # shared fixed point is checked through the f64 normal equations
+    # (the test_precond_knob rule), not map-vs-map
+    from tests.test_precond_knob import _normal_eq_residual
+
+    n = (tod.size // L) * L
+    for res in (r, r_j):
+        assert _normal_eq_residual(res.offsets, pix[:n], tod[:n], w[:n],
+                                   npix, L) < 5e-5

@@ -1,0 +1,325 @@
+"""Seen-pixel dictionaries (ISSUE 6 tentpole 1).
+
+The contract: a compacted :class:`PixelSpace` makes every solver map
+vector ``n_compact``-sized without changing a single map value — the
+destriped map of a compacted solve equals the dense solve at hit
+pixels (to f32 accumulation tolerance) and leaves unhit pixels
+untouched (zero), on the raster fixture, for WCS and HEALPix, single
+band and joint multi-RHS, under every preconditioner knob. Plus the
+nside-4096 smoke: a survey-resolution destripe completes on the CPU
+container with device map vectors sized ``O(n_compact)``, never
+``O(npix)``.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from comapreduce_tpu.mapmaking import healpix as hp
+from comapreduce_tpu.mapmaking.destriper import (
+    build_coarse_preconditioner, build_multigrid_hierarchy,
+    destripe_planned)
+from comapreduce_tpu.mapmaking.pixel_space import (PixelSpace,
+                                                   build_seen_pixel_space,
+                                                   resolve_npix)
+from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+
+
+# ---------------------------------------------------------------------------
+# unit behaviour
+# ---------------------------------------------------------------------------
+
+def test_from_pixels_unions_and_sorts():
+    s = PixelSpace.from_pixels([9, 3, 3, 5, -1, 200], 100)
+    np.testing.assert_array_equal(s.pixels, [3, 5, 9])
+    assert s.compacted and s.n_compact == 3 and s.n_solve == 3
+    assert s.npix_sky == 100
+    d = PixelSpace.dense(100)
+    assert not d.compacted and d.n_solve == 100
+    assert resolve_npix(s) == 3 and resolve_npix(d) == 100
+    assert resolve_npix(77) == 77
+
+
+def test_remap_and_expand_round_trip():
+    s = PixelSpace.from_pixels([3, 5, 9], 100)
+    # in-dictionary -> compact ids; everything else -> drop sentinel
+    np.testing.assert_array_equal(
+        s.remap([3, 5, 9, 4, -2, 100, 150]), [0, 1, 2, 3, 3, 3, 3])
+    full = s.expand(np.array([1.0, 2.0, 3.0], np.float32))
+    assert full.shape == (100,)
+    assert full[3] == 1.0 and full[5] == 2.0 and full[9] == 3.0
+    assert full.sum() == 6.0           # unhit pixels untouched
+    np.testing.assert_array_equal(s.to_global([0, 1, 2, 3]),
+                                  [3, 5, 9, 100])
+    # leading (band) axes ride through expand
+    two = s.expand(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert two.shape == (2, 100) and two[1, 9] == 5.0
+
+
+def test_dense_remap_keeps_ids_and_sentinels():
+    d = PixelSpace.dense(10)
+    np.testing.assert_array_equal(d.remap([0, 9, -1, 10]), [0, 9, 10, 10])
+    np.testing.assert_array_equal(d.expand(np.arange(10.0)),
+                                  np.arange(10.0))
+
+
+def test_union_and_build_seen_pixel_space():
+    a = PixelSpace.from_pixels([1, 5], 50)
+    b = PixelSpace.from_pixels([5, 7], 50)
+    u = a.union(b)
+    np.testing.assert_array_equal(u.pixels, [1, 5, 7])
+    # any dense participant collapses the union to dense
+    assert not a.union(PixelSpace.dense(50)).compacted
+    with pytest.raises(ValueError, match="mixed sky"):
+        a.union(PixelSpace.from_pixels([1], 60))
+    # streamed campaign union == one-shot union, order-independent
+    streams = [[7, 1], [5], [1, 7]]
+    s1 = build_seen_pixel_space(streams, 50)
+    s2 = build_seen_pixel_space(reversed(streams), 50)
+    np.testing.assert_array_equal(s1.pixels, [1, 5, 7])
+    assert s1 == s2 and hash(s1) == hash(s2)
+
+
+def test_validation_and_hashing():
+    with pytest.raises(ValueError, match="sorted"):
+        PixelSpace.from_dictionary([5, 3], 100)
+    with pytest.raises(ValueError, match="outside"):
+        PixelSpace.from_dictionary([5, 200], 100)
+    s1 = PixelSpace.from_pixels([3, 5], 100)
+    s2 = PixelSpace.from_pixels([5, 3, 3], 100)
+    assert s1 == s2 and hash(s1) == hash(s2)   # content-keyed
+    assert s1 != PixelSpace.from_pixels([3, 6], 100)
+    # hashable => usable as a jit static argument / memo key
+    {s1: "ok"}
+
+
+# ---------------------------------------------------------------------------
+# dense-vs-compacted parity (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+def _raster_problem(seed=0, T=12_000, nx=32, L=50):
+    """Weight-spread raster (the ISSUE 4/6 fixture class) — ONE home,
+    bench.weight_spread_raster, shared with the perf gate's bench."""
+    from bench import weight_spread_raster
+
+    return weight_spread_raster(seed=seed, T=T, nx=nx, L=L)
+
+
+def _healpix_problem(seed=0, nside=64, **kw):
+    """The same raster walked over a small HEALPix patch."""
+    from bench import raster_to_healpix
+
+    pix, tod, w, npix, L = _raster_problem(seed=seed, **kw)
+    hpix = raster_to_healpix(pix, int(np.sqrt(npix)), nside)
+    return hpix, tod, w, hp.nside2npix(nside), L
+
+
+def _solve(pix, tod, w, npix, L, knob, n_iter=600):
+    """One planned solve under a preconditioner knob; returns the
+    full-space map (npix may be a PixelSpace — the plan then sizes to
+    n_compact and we expand on host)."""
+    kwargs = {}
+    if knob == "none":
+        kwargs["precond"] = "none"
+    elif knob == "twolevel":
+        grp, aci = build_coarse_preconditioner(pix, w, npix, L, block=8)
+        kwargs["coarse"] = (grp, jnp.asarray(aci))
+    elif knob == "multigrid":
+        kwargs["mg"] = build_multigrid_hierarchy(pix, w, npix, L,
+                                                 block=8, levels=2)
+    plan = build_pointing_plan(pix, npix, L)
+    r = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         n_iter=n_iter, threshold=1e-6, **kwargs)
+    assert float(np.max(np.asarray(r.residual))) < 1e-6, knob
+    assert not np.any(np.asarray(r.diverged)), knob
+    return r
+
+
+KNOBS = ("none", "jacobi", "twolevel", "multigrid")
+
+
+@pytest.mark.parametrize("knob", KNOBS)
+@pytest.mark.parametrize("problem", ["wcs", "healpix"])
+def test_dense_vs_compacted_parity(problem, knob):
+    """Compacted destriped maps equal the dense solve at hit pixels to
+    f32 accumulation tolerance; unhit pixels stay exactly zero."""
+    make = _raster_problem if problem == "wcs" else _healpix_problem
+    pix, tod, w, npix, L = make()
+    dense = _solve(pix, tod, w, npix, L, knob)
+    space = PixelSpace.from_pixels(pix, npix)
+    assert space.n_compact < npix
+    comp = _solve(space.remap(pix), tod, w, space, L, knob)
+    # device vectors are n_compact-sized on the compacted path
+    assert comp.destriped_map.shape == (space.n_compact,)
+    full = space.expand(np.asarray(comp.destriped_map))
+    dense_map = np.asarray(dense.destriped_map)
+    hit = np.asarray(dense.hit_map) > 0
+    scale = max(float(np.abs(dense_map[hit]).max()), 1e-12)
+    np.testing.assert_allclose(full[hit], dense_map[hit],
+                               atol=2e-5 * scale, rtol=2e-4)
+    assert not np.any(full[~hit])      # unhit pixels untouched
+    np.testing.assert_allclose(space.expand(np.asarray(comp.weight_map)),
+                               np.asarray(dense.weight_map),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(
+        space.expand(np.asarray(comp.hit_map)), np.asarray(dense.hit_map))
+
+
+@pytest.mark.parametrize("knob", KNOBS)
+def test_dense_vs_compacted_parity_joint_multi_rhs(knob):
+    """The joint multi-RHS program under the same contract: both bands'
+    compacted maps match their dense counterparts."""
+    from comapreduce_tpu.mapmaking.destriper import (multigrid_patterns,
+                                                     stack_multigrid)
+
+    pix, tod, w, npix, L = _raster_problem()
+    tod2 = np.stack([tod, (tod * 0.5 + 0.1).astype(np.float32)])
+    w2 = np.stack([w, (w * 1.7).astype(np.float32)])
+    space = PixelSpace.from_pixels(pix, npix)
+    pixc = space.remap(pix)
+
+    def joint(p, np_, key):
+        kwargs = {}
+        if knob == "none":
+            kwargs["precond"] = "none"
+        elif knob == "twolevel":
+            from comapreduce_tpu.mapmaking.destriper import coarse_pattern
+
+            pat = coarse_pattern(p, np_, L, block=8)
+            pre = [build_coarse_preconditioner(p, w2[i], np_, L, block=8,
+                                               pattern=pat)
+                   for i in range(2)]
+            kwargs["coarse"] = (pre[0][0],
+                                np.stack([q[1] for q in pre]))
+        elif knob == "multigrid":
+            pats = multigrid_patterns(p, np_, L, block=8, levels=2)
+            kwargs["mg"] = stack_multigrid(
+                [build_multigrid_hierarchy(p, w2[i], np_, L,
+                                           patterns=pats)
+                 for i in range(2)])
+        plan = build_pointing_plan(p, np_, L)
+        r = destripe_planned(jnp.asarray(tod2), jnp.asarray(w2),
+                             plan=plan, n_iter=600, threshold=1e-6,
+                             **kwargs)
+        assert (np.asarray(r.residual) < 1e-6).all(), (key, knob)
+        return r
+
+    dense = joint(pix, npix, "dense")
+    comp = joint(pixc, space, "compact")
+    assert comp.destriped_map.shape == (2, space.n_compact)
+    hit = np.asarray(dense.hit_map) > 0
+    for b in range(2):
+        full = space.expand(np.asarray(comp.destriped_map[b]))
+        dm = np.asarray(dense.destriped_map[b])
+        scale = max(float(np.abs(dm[hit]).max()), 1e-12)
+        np.testing.assert_allclose(full[hit], dm[hit],
+                                   atol=2e-5 * scale, rtol=2e-4)
+        assert not np.any(full[~hit])
+
+
+# ---------------------------------------------------------------------------
+# nside-4096: the survey regime the compaction exists for
+# ---------------------------------------------------------------------------
+
+def test_nside4096_device_vectors_are_compact_sized(tmp_path):
+    """A survey-resolution (nside 4096, ~201M sky pixels) destripe
+    completes on the CPU container BECAUSE every device map vector is
+    n_compact-sized; the partial-map write round-trips without a dense
+    sky vector ever existing."""
+    nside = 4096
+    pix, tod, w, _, L = _healpix_problem(nside=nside, T=6000)
+    npix_sky = hp.nside2npix(nside)
+    assert npix_sky == 201_326_592
+    space = PixelSpace.from_pixels(pix, npix_sky)
+    frac = space.n_compact / npix_sky
+    assert frac < 1e-3                 # a field, not the sky
+    # remap once per plan: build_pointing_plan does it via pixel_space
+    plan = build_pointing_plan(pix, npix_sky, L, pixel_space=space)
+    assert plan.npix == space.n_compact
+    r = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         n_iter=150, threshold=1e-6)
+    # THE acceptance assert: device map vectors are O(n_compact)
+    for leaf in (r.destriped_map, r.naive_map, r.weight_map, r.hit_map):
+        assert leaf.shape == (space.n_compact,)
+        assert leaf.nbytes == 4 * space.n_compact
+    # write-time: the partial map stores the dictionary, not the sky
+    from comapreduce_tpu.mapmaking.fits_io import (read_healpix_map,
+                                                   write_healpix_map)
+
+    path = str(tmp_path / "survey.fits")
+    write_healpix_map(path, {"DESTRIPED":
+                             np.asarray(r.destriped_map)}, space, nside)
+    maps, pix_read, nside_read, _ = read_healpix_map(path)
+    assert nside_read == nside
+    np.testing.assert_array_equal(pix_read, space.pixels)
+    np.testing.assert_allclose(maps["DESTRIPED"],
+                               np.asarray(r.destriped_map), rtol=1e-6)
+
+
+def test_compact_knob_validated_before_any_io(tmp_path):
+    """A typo'd ``compact`` knob fails BEFORE the filelist is touched
+    (the config-section rule) — here the filelist points at a missing
+    file, so reaching the reader at all would raise a different
+    error."""
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+
+    with pytest.raises(ValueError, match="compact must be"):
+        read_comap_data([str(tmp_path / "missing.hd5")], nside=64,
+                        compact="ture")
+
+
+def test_band_map_writer_uses_result_dictionary(tmp_path):
+    """``DestriperResult.sky_pixels`` is AUTHORITATIVE for the writer:
+    a result carrying its dictionary writes the correct partial map
+    even when ``data`` lacks the pixel_space side channel (e.g. a
+    result round-tripped through a queue or built outside the CLI
+    solvers)."""
+    from comapreduce_tpu.cli.run_destriper import band_map_writer
+    from comapreduce_tpu.mapmaking.destriper import DestriperResult
+    from comapreduce_tpu.mapmaking.fits_io import read_healpix_map
+    from comapreduce_tpu.mapmaking.leveldata import DestriperData
+
+    nside = 64
+    dictionary = np.array([10, 20, 30], np.int64)
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    res = DestriperResult(
+        offsets=np.zeros(2, np.float32), ground=np.zeros((0, 2)),
+        destriped_map=vals, naive_map=vals, weight_map=vals,
+        hit_map=np.ones(3, np.float32), n_iter=1, residual=0.0,
+        sky_pixels=dictionary)
+    data = DestriperData(tod=np.zeros(2, np.float32),
+                         pixels=np.zeros(2, np.int32),
+                         weights=np.zeros(2, np.float32),
+                         ground_ids=np.zeros(2, np.int32),
+                         az=np.zeros(2, np.float32), n_groups=1,
+                         npix=3, nside=nside)       # no pixel_space
+    path = str(tmp_path / "band.fits")
+    band_map_writer(path, data, res)()
+    maps, pix, ns, _ = read_healpix_map(path)
+    assert ns == nside
+    np.testing.assert_array_equal(pix, dictionary)
+    np.testing.assert_allclose(maps["DESTRIPED"], vals)
+
+
+def test_sharded_plans_share_campaign_dictionary():
+    """Sharded plans built through a campaign PixelSpace psum over the
+    DICTIONARY's index space: uniq_global indexes the campaign
+    dictionary, so two solves (or ranks) sharing the space agree on
+    compacted ids."""
+    from comapreduce_tpu.mapmaking.pointing_plan import build_sharded_plans
+
+    pix, _, _, npix, L = _raster_problem(T=4000)
+    # a campaign dictionary that is a SUPERSET of this solve's coverage
+    space = build_seen_pixel_space([pix, [0, 1, 2]], npix)
+    plans = build_sharded_plans(pix, npix, L, n_shards=2,
+                                pixel_space=space)
+    for p in plans:
+        assert p.n_rank_global <= space.n_compact
+        # every global rank id is a valid dictionary slot
+        sky = space.to_global(p.uniq_global)
+        assert (sky < npix).all()
+    # the same pointing remapped by the same dictionary -> identical
+    # global index space (the psum-consistency property)
+    plans2 = build_sharded_plans(space.remap(pix), space, L, n_shards=2)
+    np.testing.assert_array_equal(plans[0].uniq_global,
+                                  plans2[0].uniq_global)

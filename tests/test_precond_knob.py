@@ -42,19 +42,11 @@ def _dense_problem(N=4000, L=50, npix=144, seed=0):
 
 def _spread_problem(seed=0, T=12_000, nx=32, L=50):
     """Raster + 1/f offsets + two decades of weight spread: diag(A)
-    varies enough that Jacobi/two-level genuinely cut iterations."""
-    from bench import ces_pixels
+    varies enough that Jacobi/two-level genuinely cut iterations.
+    ONE fixture home: bench.weight_spread_raster."""
+    from bench import weight_spread_raster
 
-    rng = np.random.default_rng(seed)
-    pix = ces_pixels(T, nx, nx, 0, 1)
-    n = (pix.size // L) * L
-    pix = pix[:n]
-    true_off = np.cumsum(rng.normal(0, 0.3, n // L)).astype(np.float32)
-    sky = rng.normal(0, 1.0, nx * nx).astype(np.float32)
-    tod = (sky[pix] + np.repeat(true_off, L)
-           + rng.normal(0, 1.0, n).astype(np.float32)).astype(np.float32)
-    w = (10.0 ** rng.uniform(-1, 1, n)).astype(np.float32)
-    return pix, tod, w, nx * nx, L
+    return weight_spread_raster(seed=seed, T=T, nx=nx, L=L)
 
 
 def _weighted_rms_diff(a, b, w):
@@ -180,18 +172,34 @@ def test_parse_destriper_section():
     from comapreduce_tpu.cli.run_destriper import parse_destriper_section
 
     # absent section: the legacy [Inputs] coarse_precond default stands
-    assert parse_destriper_section({}, 8) == ("jacobi", 8, None)
+    assert parse_destriper_section({}, 8) == ("jacobi", 8, None, None)
     assert parse_destriper_section({"preconditioner": "none"}, 8) \
-        == ("none", 0, None)
+        == ("none", 0, None, None)
     assert parse_destriper_section({"preconditioner": "jacobi"}, 8) \
-        == ("jacobi", 0, None)
+        == ("jacobi", 0, None, None)
     assert parse_destriper_section({"preconditioner": "twolevel"}, 0) \
-        == ("jacobi", 8, None)
+        == ("jacobi", 8, None, None)
     assert parse_destriper_section(
         {"preconditioner": "twolevel", "coarse_block": 16}, 0) \
-        == ("jacobi", 16, None)
+        == ("jacobi", 16, None, None)
     assert parse_destriper_section({"pair_batch": 4}, 0)[2] == 4
     assert parse_destriper_section({"pair_batch": "auto"}, 0)[2] is None
+    # multigrid: jacobi at the solver level + the mg config dict
+    assert parse_destriper_section({"preconditioner": "multigrid"}, 8) \
+        == ("jacobi", 0, None, {"levels": 2, "smooth": 1, "block": 8})
+    assert parse_destriper_section(
+        {"preconditioner": "multigrid", "mg_levels": 3, "mg_smooth": 2,
+         "mg_block": 4}, 0) \
+        == ("jacobi", 0, None, {"levels": 3, "smooth": 2, "block": 4})
+    # mg knobs without multigrid selected: silent-drop forbidden
+    with pytest.raises(ValueError, match="mg_levels"):
+        parse_destriper_section({"mg_levels": 3}, 0)
+    with pytest.raises(ValueError, match="mg_smooth"):
+        parse_destriper_section(
+            {"preconditioner": "twolevel", "mg_smooth": 2}, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        parse_destriper_section(
+            {"preconditioner": "multigrid", "mg_smooth": 0}, 0)
     with pytest.raises(ValueError, match="preconditioner"):
         parse_destriper_section({"preconditioner": "jaccobi"}, 0)
     with pytest.raises(ValueError, match="pair_batch"):

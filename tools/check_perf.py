@@ -98,6 +98,53 @@ def run_campaign_bench() -> dict:
     raise RuntimeError("no campaign result line in bench.py output")
 
 
+def run_destriper_bench() -> dict:
+    """One small-shape destriper bench child -> its parsed JSON line."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_SMALL": "1",
+        "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
+        "BENCH_EVIDENCE": "0",
+    })
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                          "--config", "destriper"],
+                         env=env, capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py --config destriper failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == "destriper_cg_iters_to_tol":
+            return rec
+    raise RuntimeError("no destriper result line in bench.py output")
+
+
+#: compacted-path memory budget multiplier: the exact device footprint
+#: of the four map products is 4 B x (3 n_bands + 1) x n_compact
+#: (per-band destriped/naive/weight + shared hits); the gate allows 2x
+#: for dtype/padding slack. Machine-independent — it is a byte count
+#: against the run's own coverage, not a throughput.
+MEM_SLACK = 2.0
+
+
+def check_map_vector_bytes(section: dict, tag: str) -> str | None:
+    """The ISSUE 6 memory gate: a compacted destriper's device
+    map-vector bytes must stay O(n_compact)."""
+    nb = int(section.get("n_bands", 1))
+    budget = MEM_SLACK * 4 * (3 * nb + 1) * int(section["n_compact"])
+    got = int(section["map_vector_bytes"])
+    if got > budget:
+        return (f"{tag}: device map-vector bytes {got} exceed "
+                f"{MEM_SLACK:g}x the compacted budget {budget:.0f} "
+                f"(= {MEM_SLACK:g} x 4 B x (3x{nb}+1) x "
+                f"{section['n_compact']} hit pixels) — an npix-sized "
+                "vector leaked back onto the device?")
+    return None
+
+
 def reference_path(platform: str) -> str:
     return os.path.join(REPO, "evidence", f"perf_quick_{platform}.json")
 
@@ -117,6 +164,8 @@ def main(argv=None) -> int:
                          "no-recompile gates still run")
     ap.add_argument("--no-campaign", action="store_true",
                     help="skip the campaign no-recompile gate")
+    ap.add_argument("--no-destriper", action="store_true",
+                    help="skip the destriper memory/iteration gate")
     args = ap.parse_args(argv)
 
     best: dict | None = None
@@ -188,8 +237,39 @@ def main(argv=None) -> int:
                 f"{camp['compiles_campaign_steady']} backend compiles > "
                 f"bucket count {camp['bucket_count']} (shape "
                 f"canonicalisation or compile warm-up regressed?)")
+    destriper = None
+    if not args.no_destriper:
+        # both halves machine-independent: the memory gate is a byte
+        # count against the run's own coverage (ISSUE 6 — an npix-sized
+        # device vector on the compacted path fails absolutely), the
+        # iteration gate an ordering of two counts on one fixture
+        d = run_destriper_bench()["detail"]
+        destriper = {
+            "iters": {k: v.get("iters_to_tol")
+                      for k, v in d["preconditioners"].items()},
+            "compacted_bytes": d["compacted"]["map_vector_bytes"],
+            "survey4096_bytes": d["survey4096"]["map_vector_bytes"],
+            "survey4096_n_compact": d["survey4096"]["n_compact"],
+        }
+        for section, tag in ((d["compacted"], "compacted"),
+                             (d["survey4096"], "survey4096")):
+            bad = check_map_vector_bytes(section, tag)
+            if bad:
+                failures.append(bad)
+        it = destriper["iters"]
+        if it.get("multigrid") is None:
+            failures.append("destriper: multigrid did not reach "
+                            "tolerance within the iteration budget")
+        elif it.get("twolevel") is not None \
+                and it["multigrid"] >= it["twolevel"]:
+            failures.append(
+                f"destriper: multigrid iterations ({it['multigrid']}) "
+                f"not below twolevel ({it['twolevel']}) — the V-cycle "
+                "regressed to (or below) the additive two-level "
+                "preconditioner")
     print(json.dumps({"ok": not failures, "failures": failures,
                       "current": cur, "campaign": campaign,
+                      "destriper": destriper,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))
