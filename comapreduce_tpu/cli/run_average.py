@@ -71,17 +71,24 @@ def main(argv=None) -> int:
                 rank=rank, level=str(glob.get("log_level", "INFO")))
     runner = Runner.from_config(config, rank=rank, n_ranks=n_ranks)
     if n_ranks > 1:
-        # pre-shard straggler barrier: don't start a campaign shard
-        # against ranks that are already dead — ledger their shards as
-        # rejected (re-attempted next run) and continue degraded
-        from comapreduce_tpu.parallel.multihost import (degraded_shard,
-                                                        straggler_barrier)
-
         res = runner._resilience_runtime()
-        if res.straggler_timeout_s > 0 and res.heartbeat is not None:
+        if res.lease_ttl_s > 0:
+            # elastic campaign (docs/OPERATIONS.md §11): no barrier, no
+            # degraded_shard — Runner claims files under leases, dead
+            # ranks' leases expire and survivors steal them, and a rank
+            # joining late simply starts claiming
+            pass
+        elif res.straggler_timeout_s > 0 and res.heartbeat is not None:
+            # legacy static shard: pre-shard straggler barrier — don't
+            # start a campaign shard against ranks that are already
+            # dead; ledger their shards as rejected (re-attempted next
+            # run) and continue degraded
+            from comapreduce_tpu.parallel.multihost import (
+                degraded_shard, straggler_barrier)
+
             res.heartbeat.start()
             alive, dead = straggler_barrier(
-                runner.output_dir, rank, n_ranks,
+                runner.state_dir or runner.output_dir, rank, n_ranks,
                 timeout_s=res.straggler_timeout_s,
                 heartbeat=res.heartbeat)
             if dead:

@@ -44,7 +44,8 @@ from comapreduce_tpu.mapmaking.wcs import WCS
 from comapreduce_tpu.pipeline.config import IniConfig
 
 __all__ = ["main", "make_band_map", "make_band_maps_joint",
-           "parse_destriper_section", "solve_band", "write_band_map"]
+           "parse_destriper_section", "solve_band",
+           "solve_band_checkpointed", "write_band_map"]
 
 
 def _aslist(v):
@@ -217,6 +218,12 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
       stands.
     - ``pair_batch = N | auto`` — one-hot binning chunks merged per MXU
       matmul in the planned matvec (auto = HBM-planner sized).
+    - ``checkpoint_every = N`` — validated here (>= 0; 0 = off) but
+      returned separately by the caller: every N CG iterations the
+      chunked solve durably snapshots ``(x, iter, residual history,
+      preconditioner id)`` so a killed solve resumes instead of
+      restarting (:func:`solve_band_checkpointed`,
+      docs/OPERATIONS.md §11).
 
     A typo'd or contradictory knob raises instead of silently running
     the default (the ``[Resilience]`` section's rule)."""
@@ -278,6 +285,10 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
     if pair_batch is not None and pair_batch < 1:
         raise ValueError(f"[Destriper] pair_batch must be >= 1 or auto, "
                          f"got {pb_raw!r}")
+    if int(destr.get("checkpoint_every", 0) or 0) < 0:
+        raise ValueError(
+            f"[Destriper] checkpoint_every must be >= 0 (0 = off), got "
+            f"{destr.get('checkpoint_every')!r}")
     return precond, coarse_block, pair_batch, mg
 
 
@@ -340,7 +351,7 @@ def _watched_cg(solve, watchdog, unit: str):
 def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                use_ground=False, sharded=False, coarse_block=0,
                watchdog=None, unit="", precond="jacobi",
-               pair_batch=None, mg=None):
+               pair_batch=None, mg=None, x0=None):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -367,10 +378,24 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     sharded programs fall back to the two-level preconditioner with a
     warning (the V-cycle's per-level scatter lattice is not yet
     shard_map-threaded), and the scatter fallbacks keep Jacobi like
-    they do for ``coarse_block``."""
+    they do for ``coarse_block``.
+
+    ``x0`` warm-starts the CG from a prior iterate (the solver-
+    checkpoint resume, :func:`solve_band_checkpointed`) — non-sharded
+    offsets-only planned path only; ground/sharded solves ignore it
+    with a warning and start cold."""
     from comapreduce_tpu.mapmaking.destriper import _check_precond
 
     _check_precond(precond, coarse=coarse_block or None, mg=mg)
+    if x0 is not None and (sharded or use_ground):
+        # destripe_planned's x0 is offsets-only by construction (the
+        # joint ground solve raises on it) and the sharded programs
+        # take no warm start — drop it loudly rather than crash a
+        # resume that would otherwise just cost iterations
+        logger.warning("solver warm start x0 ignored: only the "
+                       "non-sharded offsets-only planned solve "
+                       "supports it")
+        x0 = None
     if watchdog is not None:
         return _watched_cg(
             lambda: solve_band(data, offset_length=offset_length,
@@ -378,7 +403,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                use_ground=use_ground, sharded=sharded,
                                coarse_block=coarse_block,
                                precond=precond, pair_batch=pair_batch,
-                               mg=mg),
+                               mg=mg, x0=x0),
             watchdog, unit)
     if sharded and mg is not None:
         # the sharded programs keep the two-level preconditioner: the
@@ -552,6 +577,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                  offset_length, n_iter, threshold,
                                  precond=precond, pair_batch=pair_batch,
                                  mg_smooth=mg_smooth)
+            if x0 is not None:
+                kwargs["x0"] = jnp.asarray(x0)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]), **kwargs)
         if (kwargs.get("coarse") is not None
@@ -592,6 +619,90 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                        "coarse_precond : 0 to force Jacobi",
                        np.asarray(result.diverged))
     return _attach_dict(data, result)
+
+
+def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
+                            offset_length=50, n_iter=100,
+                            threshold=1e-6, watchdog=None, unit="",
+                            **kw):
+    """:func:`solve_band` in durable checkpoint/resume chunks
+    (``[Destriper] checkpoint_every``, docs/OPERATIONS.md §11).
+
+    A jitted CG solve cannot snapshot mid-program, so checkpointing
+    happens at the host level: the band solves in chunks of
+    ``checkpoint_every`` iterations, each warm-started from the last
+    iterate through ``solve_band``'s ``x0``, and after every chunk the
+    running state ``(x, iterations done, residual history,
+    preconditioner id)`` is durably written to ``checkpoint_path``
+    (``destriper.save_solver_checkpoint`` — tmp + fsync + atomic
+    replace, so a SIGKILL mid-write leaves the previous snapshot, never
+    a torn one). A relaunch loads the snapshot and pays only the
+    REMAINING iterations; a torn/alien/stale snapshot (schema or
+    preconditioner-id mismatch) is discarded and the solve starts cold.
+    The snapshot is deleted once the solve completes — it protects a
+    solve in flight, not a finished map.
+
+    Falls back to one plain un-checkpointed ``solve_band`` when
+    ``checkpoint_every <= 0`` or on the sharded/ground paths (no
+    ``x0`` warm start there — resuming would silently restart cold
+    every chunk and pay full price anyway)."""
+    from comapreduce_tpu.mapmaking.destriper import (
+        load_solver_checkpoint, save_solver_checkpoint)
+
+    chunk = int(checkpoint_every)
+    if chunk <= 0 or kw.get("sharded") or kw.get("use_ground"):
+        if chunk > 0:
+            logger.warning(
+                "checkpoint_every=%d ignored: the sharded/ground solve "
+                "paths have no x0 warm start, so a resumed chunk would "
+                "restart cold and checkpointing would only add I/O",
+                chunk)
+        return solve_band(data, offset_length=offset_length,
+                          n_iter=n_iter, threshold=threshold,
+                          watchdog=watchdog, unit=unit, **kw)
+    # the snapshot is only valid against the SAME linear system and
+    # preconditioner: bake the solve configuration and the trimmed
+    # sample count into an id the loader refuses to cross
+    mg = kw.get("mg") or {}
+    precond_id = "|".join(str(v) for v in (
+        kw.get("precond", "jacobi"), int(kw.get("coarse_block", 0) or 0),
+        int(mg.get("block", 0) or 0), offset_length, threshold,
+        (int(data.tod.size) // offset_length) * offset_length))
+    snap = load_solver_checkpoint(checkpoint_path, precond_id=precond_id)
+    x0, done, residuals = None, 0, []
+    if snap is not None:
+        x0 = np.asarray(snap["offsets"])
+        done = int(snap["n_done"])
+        residuals = list(snap["residuals"])
+        logger.info("solver checkpoint %s: resuming %s at iteration %d "
+                    "of %d", checkpoint_path, unit or "<band>", done,
+                    n_iter)
+    result = None
+    while True:
+        step = max(min(chunk, n_iter - done), 1)
+        result = solve_band(data, offset_length=offset_length,
+                            n_iter=step, threshold=threshold,
+                            watchdog=watchdog, unit=unit, x0=x0, **kw)
+        ran = int(np.asarray(result.n_iter))
+        done += ran
+        residual = float(np.asarray(result.residual))
+        residuals.append(residual)
+        x0 = np.asarray(result.offsets)
+        save_solver_checkpoint(checkpoint_path, x0, done, residuals,
+                               precond_id)
+        # ran < step means the chunk converged (or was already converged
+        # on entry, ran == 0) before exhausting its budget — done either
+        # way; the budget and threshold exits mirror the plain solve's
+        if done >= n_iter or residual <= threshold or ran < step:
+            break
+    try:
+        os.unlink(checkpoint_path)
+    except OSError:
+        pass
+    # solve_band already stamped sky_pixels; report the CUMULATIVE
+    # iteration count, not the last chunk's
+    return result._replace(n_iter=np.int32(done),
+                           residual=np.float32(residuals[-1]))
 
 
 def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
@@ -881,8 +992,13 @@ def main(argv=None) -> int:
     # would only pay the host-side build. `coarse_precond : 0` disables.
     coarse_block = int(inputs.get("coarse_precond",
                                   0 if calibrator else 8))
+    destr_sec = ini.get("Destriper", {})
     precond, coarse_block, pair_batch, mg = parse_destriper_section(
-        ini.get("Destriper", {}), coarse_block)
+        destr_sec, coarse_block)
+    # CG solve checkpointing (docs/OPERATIONS.md §11): validated by
+    # parse_destriper_section above, consumed here (its return tuple is
+    # pinned) — 0 = off
+    checkpoint_every = int(destr_sec.get("checkpoint_every", 0) or 0)
     # seen-pixel compaction ([Pixelization] compact : auto|true|false;
     # docs/OPERATIONS.md §3): auto = HEALPix compacted (the survey
     # regime), WCS dense. Compacted, every device map vector is
@@ -921,8 +1037,15 @@ def main(argv=None) -> int:
         import dataclasses
 
         res_cfg = dataclasses.replace(res_cfg, retry_quarantined=True)
+    # run state (heartbeats, leases, queue manifest, solver snapshots)
+    # routes under `[Inputs] log_dir`, default <output_dir>/logs — same
+    # layout as the Runner's (docs/OPERATIONS.md §11)
+    state_dir = str(inputs.get("log_dir", "") or
+                    os.path.join(out_dir, "logs"))
+    os.makedirs(state_dir, exist_ok=True)
     resilience = res_cfg.make_runtime(out_dir, rank=rank,
-                                      n_ranks=n_ranks)
+                                      n_ranks=n_ranks,
+                                      state_dir=state_dir)
     writeback = None
     if ingest_cfg.writeback >= 1:
         # async map writeback (docs/OPERATIONS.md §9): band N+1's CG
@@ -938,14 +1061,34 @@ def main(argv=None) -> int:
         # per-rank liveness for the whole mapping run (read by sibling
         # ranks' straggler barriers and tools/watchdog_report.py)
         resilience.heartbeat.start()
-    if n_ranks > 1:
+    sched = None
+    if res_cfg.lease_ttl_s > 0:
+        # elastic campaign (docs/OPERATIONS.md §11): claim this run's
+        # file set under heartbeat-fenced leases up front — a dead
+        # rank's expired leases are stolen here, a rank joining
+        # mid-campaign simply starts claiming — then destripe the
+        # claimed set and commit the leases only after the maps flush.
+        # Sorted: the per-band reads concatenate in filelist order, so
+        # the map over a stolen-and-redone set is byte-identical to a
+        # clean run over the same files.
+        from comapreduce_tpu.pipeline.scheduler import Scheduler
+
+        sched = Scheduler(list(filelist), state_dir, rank=rank,
+                          n_ranks=n_ranks,
+                          lease_ttl_s=res_cfg.lease_ttl_s,
+                          steal_after_s=res_cfg.steal_after_s,
+                          ledger=resilience.ledger,
+                          chaos=resilience.chaos,
+                          heartbeat=resilience.heartbeat)
+        filelist = sorted(sched.claim_iter())
+    elif n_ranks > 1:
         if resilience.straggler_timeout_s > 0 \
                 and resilience.heartbeat is not None:
             from comapreduce_tpu.parallel.multihost import (
                 degraded_shard, straggler_barrier)
 
             alive, dead = straggler_barrier(
-                out_dir, rank, n_ranks,
+                state_dir, rank, n_ranks,
                 timeout_s=resilience.straggler_timeout_s,
                 heartbeat=resilience.heartbeat)
             filelist = degraded_shard(filelist, rank, n_ranks, dead,
@@ -953,11 +1096,27 @@ def main(argv=None) -> int:
         else:
             filelist = filelist[rank::n_ranks]
 
+    if checkpoint_every > 0 and (sharded or use_ground):
+        # solve_band has no x0 warm start on these paths — a "resumed"
+        # chunk would restart cold every time and only pay snapshot I/O
+        logger.warning(
+            "[Destriper] checkpoint_every=%d disabled: the "
+            "sharded/ground solve paths have no warm-start resume",
+            checkpoint_every)
+        checkpoint_every = 0
     # shared-pointing bands solve as ONE multi-RHS CG (joint one-hot
     # binning per iteration); ground solves keep their own path.
     # `[Inputs] joint : false` forces per-band solves (measurement
     # escape hatch until the on-chip joint-vs-serial numbers land)
     use_joint = bool(inputs.get("joint", True))
+    if checkpoint_every > 0 and use_joint and len(bands) > 1:
+        # snapshots are per-band (one CG state each); the multi-RHS
+        # joint program solves all bands inside one jit and cannot
+        # checkpoint per band — trade the MXU batching for resumability
+        logger.info("checkpoint_every=%d: per-band checkpointed solves "
+                    "(joint multi-RHS path disabled for this run)",
+                    checkpoint_every)
+        use_joint = False
     joint_datas = joint_results = None
     if use_joint and len(bands) > 1 and not use_ground:
         joint_datas, joint_results = make_band_maps_joint(
@@ -985,6 +1144,25 @@ def main(argv=None) -> int:
                                 watchdog=resilience.watchdog,
                                 unit=f"band{band}", precond=precond,
                                 pair_batch=pair_batch, mg=mg)
+        elif checkpoint_every > 0:
+            # same read as make_band_map, solve split into durable
+            # checkpoint/resume chunks — a relaunch mid-CG pays only
+            # the remaining iterations (docs/OPERATIONS.md §11)
+            data = read_comap_data(
+                filelist, band=band, wcs=wcs, nside=nside,
+                galactic=galactic, offset_length=offset_length,
+                use_calibration=use_cal, medfilt_window=400,
+                tod_variant=tod_variant, prefetch=prefetch,
+                cache=cache, resilience=resilience, compact=compact)
+            ckpt = os.path.join(
+                state_dir,
+                f"solver.{prefix}.band{band}.rank{rank}.npz")
+            result = solve_band_checkpointed(
+                data, ckpt, checkpoint_every,
+                offset_length=offset_length, n_iter=n_iter,
+                threshold=threshold, watchdog=resilience.watchdog,
+                unit=f"band{band}", coarse_block=coarse_block,
+                precond=precond, pair_batch=pair_batch, mg=mg)
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
@@ -1025,6 +1203,22 @@ def main(argv=None) -> int:
             writeback.flush()
         finally:
             writeback.close()
+    if sched is not None:
+        # commit only AFTER the maps are durably flushed: a lease
+        # committed against an unwritten map would let a crash between
+        # solve and write lose the files forever (no survivor would
+        # re-claim a "done" lease)
+        for f in filelist:
+            if not sched.commit(f):
+                logger.warning(
+                    "lease commit fence-rejected for %s: this rank's "
+                    "lease was stolen (stale heartbeat?) and the file "
+                    "redone elsewhere; its partial products here are "
+                    "superseded", f)
+        logger.info("elastic campaign rank %d: %s", rank, sched.stats)
+        leftover = sched.release_held()
+        if leftover:
+            logger.warning("released %d uncommitted lease(s)", leftover)
     if resilience.ledger is not None and resilience.ledger.entries:
         print(f"quarantine ledger {resilience.ledger.path}: "
               f"{resilience.ledger.summary()}")

@@ -25,6 +25,9 @@ solved jointly in the same CG.
 
 from __future__ import annotations
 
+import logging
+import os
+import tempfile
 from typing import NamedTuple
 
 import jax
@@ -43,7 +46,10 @@ __all__ = ["CONFIG_PRECONDITIONERS", "DestriperResult", "destripe",
            "build_coarse_preconditioner", "coarse_pattern",
            "multigrid_levels", "multigrid_patterns",
            "build_multigrid_hierarchy", "stack_multigrid",
-           "MultigridUnavailable", "watched_solve"]
+           "MultigridUnavailable", "watched_solve",
+           "save_solver_checkpoint", "load_solver_checkpoint"]
+
+logger = logging.getLogger("comapreduce_tpu")
 
 
 class MultigridUnavailable(ValueError):
@@ -127,6 +133,88 @@ def watched_solve(solve, watchdog=None, name: str = "mapmaking.cg_solve",
     with watchdog.watch(name, unit=unit) as state:
         result = solve()
     return result, state
+
+
+def save_solver_checkpoint(path: str, offsets, n_done: int,
+                           residuals, precond_id: str,
+                           durable: bool = True) -> None:
+    """Durably snapshot a partial CG solve: ``(x, iter, residual
+    history, preconditioner id)`` — written every ``[Destriper]
+    checkpoint_every`` iterations by the chunked solve in
+    ``cli.run_destriper`` so a solve killed at iteration 140/142
+    resumes from 140, not 0.
+
+    Same discipline as every other checkpoint in the repo
+    (``data/durable.py``): full write + fsync to a temp file, then an
+    atomic replace — a SIGKILL mid-save leaves either the previous
+    complete snapshot or a stray temp file, never a torn snapshot
+    under the live name.
+    """
+    from comapreduce_tpu.data.durable import durable_replace
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".solver.", suffix=".tmp",
+                               dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, schema=np.int64(1),
+                     offsets=np.asarray(offsets),
+                     n_done=np.int64(n_done),
+                     residuals=np.asarray(residuals, dtype=np.float64),
+                     precond_id=np.bytes_(
+                         str(precond_id).encode("utf-8")))
+        durable_replace(tmp, path, durable=durable)
+        tmp = ""
+    finally:
+        if tmp:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def load_solver_checkpoint(path: str,
+                           precond_id: str | None = None) -> dict | None:
+    """Read a solver snapshot; None when absent, torn, foreign-schema
+    or written under a DIFFERENT preconditioner/geometry id (warm-
+    starting CG from another operator's iterate is a correctness trap,
+    not a resume) — every None falls back to a fresh solve, never an
+    error: a corrupt snapshot must cost iterations, not the campaign.
+
+    Returns ``{"offsets": f32[n], "n_done": int, "residuals":
+    [float...], "precond_id": str}``.
+    """
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            if int(z["schema"]) != 1:
+                logger.warning("solver checkpoint %s: unknown schema "
+                               "%s; starting fresh", path,
+                               int(z["schema"]))
+                return None
+            snap = {
+                "offsets": np.asarray(z["offsets"]),
+                "n_done": int(z["n_done"]),
+                "residuals": [float(v) for v in z["residuals"]],
+                "precond_id": bytes(z["precond_id"].item()
+                                    if z["precond_id"].shape == ()
+                                    else z["precond_id"]
+                                    ).decode("utf-8", "replace"),
+            }
+    except Exception as exc:
+        logger.warning("solver checkpoint %s unreadable (%s: %s); "
+                       "starting the solve fresh", path,
+                       type(exc).__name__, exc)
+        return None
+    if precond_id is not None and snap["precond_id"] != str(precond_id):
+        logger.warning(
+            "solver checkpoint %s was written under %r but this solve "
+            "is %r (preconditioner/geometry changed); starting fresh",
+            path, snap["precond_id"], str(precond_id))
+        return None
+    return snap
 
 
 def _expand(offsets, ground, ground_ids, az, n_samples, offset_length):

@@ -37,6 +37,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+import warnings
 
 __all__ = ["maybe_initialize_distributed", "rank_info",
            "straggler_barrier", "degraded_shard"]
@@ -190,17 +191,25 @@ def straggler_barrier(heartbeat_dir: str, rank: int, n_ranks: int,
 
 def degraded_shard(filelist, rank: int, n_ranks: int, dead,
                    alive, ledger=None) -> list:
-    """This rank's round-robin filelist shard under degraded mode.
+    """DEPRECATED — this rank's round-robin shard under degraded mode.
 
-    The shard rule is the same ``i % n_ranks == r`` split as
-    ``Runner.shard_iter`` / the destriper CLI — sharding does NOT
-    change when a rank dies (re-sharding mid-campaign would silently
-    move files between ranks' per-rank quarantine ledgers and partial
-    maps). Instead the LOWEST alive rank — one writer, no duplicate
-    entries — ledgers every dead rank's file as ``hang``/``rejected``
-    so the next run re-attempts it, and every survivor just runs its
-    own shard.
+    The ledger-and-abandon path: a dead rank's files are merely
+    recorded ``hang``/``rejected`` (by the LOWEST alive rank — one
+    writer, no duplicate entries) and LOST until a manual re-run,
+    while every survivor keeps its unchanged ``i % n_ranks == r``
+    shard. Elastic campaigns supersede it: with ``[resilience]
+    lease_ttl_s > 0`` the scheduler (``pipeline.scheduler``) lets
+    survivors STEAL a dead rank's files under heartbeat-fenced leases
+    and complete the campaign in the same run. This shim keeps the
+    legacy static-shard path working and will be removed once elastic
+    claiming is the default.
     """
+    warnings.warn(
+        "degraded_shard (ledger-and-abandon) is deprecated: set "
+        "[resilience] lease_ttl_s > 0 so surviving ranks steal a dead "
+        "rank's files this run (pipeline.scheduler) instead of "
+        "abandoning them to the ledger — docs/OPERATIONS.md §11",
+        DeprecationWarning, stacklevel=2)
     files = list(filelist)
     dead = sorted(set(dead))
     alive = sorted(set(alive))

@@ -11,7 +11,10 @@ ParserClass.py``, ``run_average.py`` — SURVEY.md §2.1/§5):
 - :mod:`stages` — the pipeline stages (``PipelineFunction`` contract);
 - :mod:`runner` — the ``Runner``: per-file loop, ``contains``/``overwrite``
   resume against the Level-2 checkpoint file, falsy-``STATE`` abort,
-  per-stage timing and logging.
+  per-stage timing and logging;
+- :mod:`scheduler` — the elastic-campaign work queue (lease-file
+  claiming with heartbeat-fenced stealing; ``[resilience]
+  lease_ttl_s > 0`` routes ``Runner.run_tod`` through it).
 """
 
 from comapreduce_tpu.pipeline.config import (IniConfig, load_toml,
@@ -19,6 +22,7 @@ from comapreduce_tpu.pipeline.config import (IniConfig, load_toml,
 from comapreduce_tpu.pipeline.registry import (available_stages, register,
                                                resolve)
 from comapreduce_tpu.pipeline.runner import Runner, set_logging
+from comapreduce_tpu.pipeline.scheduler import Scheduler  # noqa: F401
 from comapreduce_tpu.pipeline import stages  # noqa: F401  (registers stages)
 # calibration stages register themselves on package import
 from comapreduce_tpu.calibration import apply_cal as _apply_cal  # noqa: F401
@@ -27,4 +31,5 @@ from comapreduce_tpu.calibration import source_fit as _source_fit  # noqa: F401
 from comapreduce_tpu import backends as _backends  # noqa: F401
 
 __all__ = ["IniConfig", "load_toml", "parse_stage_name", "register",
-           "resolve", "available_stages", "Runner", "set_logging", "stages"]
+           "resolve", "available_stages", "Runner", "Scheduler",
+           "set_logging", "stages"]

@@ -102,6 +102,11 @@ class Runner:
     # <output_dir>/quarantine.jsonl and retries transient reads;
     # {"quarantine": "off"} restores the bare BAD-FILE-log behaviour.
     resilience: object = None
+    # run-state directory (heartbeats, lease files, queue manifest):
+    # '' keeps state next to the science outputs (historic behaviour);
+    # the CLIs pass [Global] log_dir so <log_dir> holds ALL run state
+    # and <output_dir> holds only data products
+    state_dir: str = ""
     # campaign-throughput knob (TOML [campaign] / INI [Campaign]):
     # CampaignConfig | {"t_quantum": ..., "warm_compile": ...} | None.
     # Shape canonicalisation pads each observation up to its campaign
@@ -126,6 +131,9 @@ class Runner:
     # the live async writer during a run_tod call (None = synchronous
     # checkpoint writes, the default)
     _writeback: object = field(default=None, repr=False)
+    # the live elastic scheduler during a run_tod call (None = static
+    # shard, the default; see [resilience] lease_ttl_s)
+    _scheduler: object = field(default=None, repr=False)
 
     def shard_iter(self, filelist):
         """Lazy round-robin shard: rank r takes files ``i % n_ranks == r``.
@@ -217,8 +225,37 @@ class Runner:
                     message="checkpoint write never returned; "
                             "writer abandoned"))
         self._writeback = wb
+        if res.heartbeat is not None:
+            # liveness for the whole loop: the ticker keeps beating
+            # even while one file wedges inside a stage, which is
+            # exactly when sibling ranks (and operators reading
+            # tools/watchdog_report.py) need the signal most — and in
+            # elastic mode a live heartbeat is what keeps this rank's
+            # leases from being stolen, so it must start BEFORE the
+            # first claim
+            res.heartbeat.start()
+        sched = None
+        if res.lease_ttl_s > 0:
+            # elastic campaign: replace the static rank::n_ranks shard
+            # with lease-based claiming — dead ranks' files are stolen
+            # by survivors, fresh ranks just start claiming
+            # (docs/OPERATIONS.md §11)
+            from comapreduce_tpu.pipeline.scheduler import Scheduler
+
+            sched = Scheduler(
+                list(filelist), state_dir=res.state_dir or
+                self.state_dir or self.output_dir,
+                rank=self.rank, n_ranks=self.n_ranks,
+                lease_ttl_s=res.lease_ttl_s,
+                steal_after_s=res.steal_after_s,
+                ledger=res.ledger, chaos=res.chaos,
+                heartbeat=res.heartbeat)
+            source = sched.claim_iter()
+        else:
+            source = self.shard_iter(filelist)
+        self._scheduler = sched
         results = []
-        stream = level1_stream(self._admitted(filelist, res),
+        stream = level1_stream(self._admitted(source, res),
                                prefetch=cfg.prefetch, cache=cache,
                                eager_tod=cfg.eager_tod,
                                eager_for=self._needs_tod,
@@ -228,15 +265,17 @@ class Runner:
                                    f, stage="ingest.close",
                                    message="loader never returned; "
                                            "prefetcher abandoned"))
-        if res.heartbeat is not None:
-            # liveness for the whole loop: the ticker keeps beating
-            # even while one file wedges inside a stage, which is
-            # exactly when sibling ranks (and operators reading
-            # tools/watchdog_report.py) need the signal most
-            res.heartbeat.start()
         try:
             self._consume_stream(stream, results, res)
         finally:
+            if sched is not None:
+                self._scheduler = None
+                n = sched.release_held()
+                if n:
+                    logger.warning("rank %d: released %d unprocessed "
+                                   "claim(s) on shutdown", self.rank, n)
+                logger.info("scheduler rank %d: %s", self.rank,
+                            sched.stats)
             # deterministic shutdown even when a stage raises something
             # the per-file net does not catch and the caller keeps the
             # traceback alive: closing the generator stops the worker
@@ -265,7 +304,8 @@ class Runner:
         if self._resilience is None:
             cfg = ResilienceConfig.coerce(self.resilience)
             self._resilience = cfg.make_runtime(
-                self.output_dir, rank=self.rank, n_ranks=self.n_ranks)
+                self.output_dir, rank=self.rank, n_ranks=self.n_ranks,
+                state_dir=self.state_dir)
             if self._resilience.watchdog is not None:
                 # the Runner's own per-stage timings feed the adaptive
                 # deadlines (hard = p95 x scale of prior same-stage
@@ -273,16 +313,36 @@ class Runner:
                 self._resilience.watchdog.timings = self.timings
         return self._resilience
 
-    def _admitted(self, filelist, res):
-        """This rank's shard, minus currently-quarantined files (the
-        cheap resume skip — no read, no decode, one log line)."""
-        for f in self.shard_iter(filelist):
+    def _admitted(self, source, res):
+        """``source`` (this rank's static shard, or its elastic claim
+        stream) minus currently-quarantined files (the cheap resume
+        skip — no read, no decode, one log line). A claimed-but-
+        quarantined unit is committed immediately: durably skipping IS
+        handling it, and leaving its lease open would make every other
+        rank steal, re-skip and re-stall on it forever."""
+        for f in source:
             if res.admit(f):
                 yield f
             else:
                 logger.warning("rank %d: %s is quarantined — skipping "
                                "(re-admit with --retry-quarantined)",
                                self.rank, f)
+                self._commit_unit(f)
+
+    def _commit_unit(self, filename: str) -> None:
+        """Elastic mode: publish this unit done through the lease
+        generation fence (no-op on the static shard). A rejected
+        commit means the unit was stolen and redone while this rank
+        worked — the thief's result stands, ours is superseded."""
+        sched = self._scheduler
+        if sched is None:
+            return
+        if not sched.commit(filename):
+            logger.warning(
+                "rank %d: commit of %s REJECTED at the lease "
+                "generation fence — the unit was stolen and redone "
+                "elsewhere; this rank's late result is superseded",
+                self.rank, filename)
 
     def _consume_stream(self, stream, results: list, res=None) -> None:
         if res is None:  # direct callers/tests without a runtime
@@ -318,6 +378,10 @@ class Runner:
                 self.timings.setdefault("ingest.compute", []).append(0.0)
                 if hb is not None:
                     hb.advance(files_failed=1)
+                # a failed read is still a HANDLED unit (ledgered): in
+                # elastic mode commit it so no survivor re-reads a file
+                # this rank already triaged
+                self._commit_unit(item.filename)
                 continue
             # a retry-saved read is bookkeeping only, never skipped
             res.record_recovered(item.filename, item.retries,
@@ -351,6 +415,7 @@ class Runner:
             finally:
                 self.timings.setdefault("ingest.compute", []).append(
                     time.perf_counter() - t0)
+                self._commit_unit(item.filename)
 
     def _run_file_with_retry(self, item, res):
         """The per-file stage loop under the retry policy: a transient
@@ -598,9 +663,14 @@ class Runner:
             kwargs = dict(config.get(name, {}))
             kwargs.setdefault("backend", backend)
             processes.append(resolve(name, **kwargs))
+        output_dir = glob.get("output_dir", ".")
         return cls(processes=processes,
-                   output_dir=glob.get("output_dir", "."),
+                   output_dir=output_dir,
                    prefix=glob.get("prefix", "Level2"),
+                   # run state (heartbeats/leases/queue) lives with the
+                   # logs, not the science products (OPERATIONS.md §11)
+                   state_dir=str(glob.get("log_dir", "") or
+                                 os.path.join(output_dir, "logs")),
                    rank=rank, n_ranks=n_ranks,
                    ingest=IngestConfig.coerce(config.get("ingest")),
                    resilience=ResilienceConfig.coerce(
@@ -623,8 +693,11 @@ class Runner:
         processes = [resolve(name, **kwargs)
                      for name, kwargs in ini.pipeline_jobs()]
         inputs = ini.get("Inputs", {})
+        output_dir = inputs.get("output_dir", ".")
         return cls(processes=processes,
-                   output_dir=inputs.get("output_dir", "."),
+                   output_dir=output_dir,
+                   state_dir=str(inputs.get("log_dir", "") or
+                                 os.path.join(output_dir, "logs")),
                    rank=rank, n_ranks=n_ranks,
                    ingest=IngestConfig.from_mapping(inputs),
                    # coerce, not from_mapping: [Resilience]/[Campaign]

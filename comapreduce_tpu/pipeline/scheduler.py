@@ -1,0 +1,248 @@
+"""Elastic campaign scheduler: a filesystem work queue over lease files.
+
+Replaces the static ``files[rank::n_ranks]`` shard with dynamic
+claiming: every rank runs the same two-phase loop over the SAME full
+filelist, and the lease board (``resilience/lease.py``) arbitrates who
+reduces what.
+
+- **Phase 1 (claim pass)** walks the filelist in this rank's shard
+  order first (``rank::n_ranks`` rotation — ranks start spread out
+  instead of stampeding the same file) and claims every unit whose
+  lease name is free. A rank that joins mid-campaign simply starts
+  here: whatever is unclaimed is its to take — there is no membership
+  list to update.
+- **Phase 2 (steal loop)** polls the leftovers: units finished by
+  other ranks drop out as ``done``; units whose owner's heartbeat went
+  stale past ``lease_ttl_s`` are STOLEN (generation bumped) and
+  re-reduced here. A rank that left — crashed, preempted, or paused
+  zombie — needs no goodbye: its leases expire and the survivors
+  drain them.
+
+The caller must :meth:`commit` each yielded file after reducing it;
+commit goes through the board's generation fence, so a zombie's late
+commit of a stolen-and-redone unit returns False (counted in
+``stats["fence_rejects"]``) and its output must be discarded. Steals
+and stolen-unit recoveries are ledgered (``stolen`` / ``recovered``
+dispositions) so the operator report can show exactly which units
+moved ranks.
+
+No services, no sockets: every rank only ever touches files in one
+state directory, with the same durability discipline as
+``data/durable.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Iterator
+
+from comapreduce_tpu.data.durable import durable_replace
+from comapreduce_tpu.resilience.lease import Lease, LeaseBoard
+
+__all__ = ["Scheduler", "QUEUE_MANIFEST"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+QUEUE_MANIFEST = "queue.json"
+
+
+class Scheduler:
+    """Per-rank view of one campaign's work queue.
+
+    Parameters mirror the knobs in ``[resilience]``: ``lease_ttl_s``
+    is the owner-heartbeat age beyond which a lease is stealable and
+    ``steal_after_s`` the minimum lease-file age (0 = the TTL).
+    ``ledger``/``chaos``/``heartbeat`` are the rank's
+    :class:`~comapreduce_tpu.resilience.config.Resilience` members —
+    the chaos hooks (``rank_kill`` / ``rank_pause``) fire at claim
+    time, which is exactly where a preemption or a zombie hurts most.
+    """
+
+    def __init__(self, filelist, state_dir: str, rank: int = 0,
+                 n_ranks: int = 1, heartbeat_dir: str | None = None,
+                 lease_ttl_s: float = 60.0, steal_after_s: float = 0.0,
+                 poll_s: float = 0.25, stall_timeout_s: float = 0.0,
+                 ledger=None, chaos=None, heartbeat=None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.files = list(filelist)
+        self.state_dir = state_dir or "."
+        self.rank = int(rank)
+        self.n_ranks = max(int(n_ranks), 1)
+        self.board = LeaseBoard(self.state_dir, rank=self.rank,
+                                heartbeat_dir=heartbeat_dir,
+                                lease_ttl_s=lease_ttl_s,
+                                steal_after_s=steal_after_s)
+        self.poll_s = float(poll_s)
+        # no unit going done/stolen for this long means the queue is
+        # wedged (e.g. a survivor-less campaign): bail out instead of
+        # spinning forever — generous default of several TTLs
+        self.stall_timeout_s = (float(stall_timeout_s)
+                                or 4.0 * self.board.lease_ttl_s + 30.0)
+        self.ledger = ledger
+        self.chaos = chaos
+        self.heartbeat = heartbeat
+        self.clock = clock
+        self.sleep = sleep
+        self._held: dict[str, Lease] = {}
+        self.stats = {"claimed": 0, "stolen": 0, "committed": 0,
+                      "recovered": 0, "fence_rejects": 0,
+                      "done_elsewhere": 0, "abandoned": 0}
+        self._write_manifest()
+
+    # -- the queue ----------------------------------------------------------
+    def claim_iter(self) -> Iterator[str]:
+        """Yield every file this rank gets to reduce; returns when the
+        whole campaign's queue has drained (every unit done somewhere,
+        or abandoned after ``stall_timeout_s`` of no progress)."""
+        order = (self.files[self.rank % self.n_ranks::self.n_ranks]
+                 + [f for r in range(self.n_ranks)
+                    if r != self.rank % self.n_ranks
+                    for f in self.files[r::self.n_ranks]])
+        pending = []  # held by other ranks: revisit in the steal loop
+        for f in order:
+            if self.board.is_done(f):
+                self.stats["done_elsewhere"] += 1
+                continue
+            lease = self.board.claim(f)
+            if lease is None:
+                pending.append(f)
+                continue
+            yield self._grant(f, lease)
+        # steal loop: wait out the other ranks' units
+        last_progress = self.clock()
+        while pending:
+            still = []
+            progressed = False
+            for f in pending:
+                if self.board.is_done(f):
+                    self.stats["done_elsewhere"] += 1
+                    progressed = True
+                    continue
+                lease = self.board.claim(f)  # released or fence-gap
+                if lease is None and self.board.expired(f):
+                    lease = self.board.steal(f)
+                    if lease is not None:
+                        self.stats["stolen"] += 1
+                        self._ledger_steal(f, lease)
+                if lease is None:
+                    still.append(f)
+                    continue
+                progressed = True
+                yield self._grant(f, lease)
+            pending = still
+            if progressed:
+                last_progress = self.clock()
+            elif self.clock() - last_progress > self.stall_timeout_s:
+                self._abandon(pending)
+                return
+            if pending:
+                self.sleep(self.poll_s)
+
+    def commit(self, filename: str) -> bool:
+        """Publish ``filename`` done through the generation fence.
+        False = the unit was stolen while we worked (we are the
+        zombie): the caller MUST discard its result for this unit."""
+        lease = self._held.pop(filename, None)
+        if lease is None:
+            return False
+        ok = self.board.commit(lease)
+        if ok:
+            self.stats["committed"] += 1
+            if lease.stolen_from is not None:
+                self.stats["recovered"] += 1
+                self._ledger_recovered(filename, lease)
+        else:
+            self.stats["fence_rejects"] += 1
+        return ok
+
+    def release_held(self) -> int:
+        """Give back any claims yielded but never committed (clean
+        shutdown mid-queue); returns how many were released."""
+        n = 0
+        for f, lease in list(self._held.items()):
+            if self.board.release(lease):
+                n += 1
+            self._held.pop(f, None)
+        return n
+
+    # -- internals ----------------------------------------------------------
+    def _grant(self, filename: str, lease: Lease) -> str:
+        self._held[filename] = lease
+        self.stats["claimed"] += 1
+        if self.chaos is not None:
+            # rank_kill: SIGKILL self mid-lease (the preempted rank);
+            # rank_pause: freeze the heartbeat but keep working (the
+            # zombie whose late commit the fence must reject)
+            self.chaos.maybe_kill(filename)
+            if self.chaos.maybe_pause(filename) and \
+                    self.heartbeat is not None:
+                self.heartbeat.pause()
+        return filename
+
+    def _abandon(self, pending) -> None:
+        self.stats["abandoned"] += len(pending)
+        logger.error(
+            "scheduler rank %d: queue stalled for %.0f s with %d "
+            "unit(s) still leased elsewhere and not expiring — "
+            "abandoning them (see the ledger)", self.rank,
+            self.stall_timeout_s, len(pending))
+        if self.ledger is None:
+            return
+        for f in pending:
+            st = self.board.state(f) or {}
+            self.ledger.record(
+                f, error=None, failure_class="hang",
+                disposition="rejected", stage="scheduler.queue",
+                message=f"queue stalled: lease held by rank "
+                        f"{st.get('owner')} gen {st.get('generation')} "
+                        f"never completed nor expired")
+
+    def _ledger_steal(self, filename: str, lease: Lease) -> None:
+        if self.ledger is None:
+            return
+        self.ledger.record(
+            filename, error=None, failure_class="hang",
+            disposition="stolen", stage="scheduler.steal",
+            message=f"lease stolen from rank {lease.stolen_from} "
+                    f"(heartbeat stale past "
+                    f"{self.board.lease_ttl_s:g} s); redoing here as "
+                    f"gen {lease.generation}")
+
+    def _ledger_recovered(self, filename: str, lease: Lease) -> None:
+        if self.ledger is None:
+            return
+        self.ledger.record(
+            filename, error=None, failure_class="hang",
+            disposition="recovered", stage="scheduler.steal",
+            message=f"stolen unit re-reduced and committed by rank "
+                    f"{self.rank} at gen {lease.generation}")
+
+    def _write_manifest(self) -> None:
+        """Durably publish the campaign's file set once (first rank
+        wins; later ranks verify they agree). The manifest is what
+        lets ``tools/watchdog_report.py`` count pending units."""
+        path = os.path.join(self.state_dir, QUEUE_MANIFEST)
+        names = [os.path.basename(f) for f in self.files]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                have = json.load(f)
+            if sorted(have.get("files", [])) != sorted(names):
+                logger.warning(
+                    "scheduler rank %d: %s lists %d unit(s) but this "
+                    "rank was given %d — ranks should share one "
+                    "filelist", self.rank, QUEUE_MANIFEST,
+                    len(have.get("files", [])), len(names))
+            return
+        except (OSError, ValueError):
+            pass
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = os.path.join(self.state_dir,
+                           f".{QUEUE_MANIFEST}.{os.getpid()}.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"schema": 1, "n": len(names), "files": names,
+                       "t_wall": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())}, f)
+        durable_replace(tmp, path)

@@ -41,6 +41,11 @@ gives the framework the same property:
   deadline state, monotonic + wall clocks) on a background ticker;
   read by ``parallel.multihost``'s straggler barrier and rendered by
   ``tools/watchdog_report.py``.
+- :class:`LeaseBoard` (``lease``) — heartbeat-fenced per-unit work
+  leases (claim / steal / generation-fenced commit over plain files),
+  the primitive under ``pipeline.scheduler``'s elastic campaigns: a
+  dead or zombie rank's units are stolen by survivors, its late
+  commits rejected at the generation fence (docs/OPERATIONS.md §11).
 
 Config surface: :class:`ResilienceConfig` (TOML ``[resilience]`` table,
 INI ``[Resilience]`` section) -> :meth:`ResilienceConfig.make_runtime`
@@ -66,6 +71,13 @@ from comapreduce_tpu.resilience.retry import (  # noqa: F401
 from comapreduce_tpu.resilience.heartbeat import (  # noqa: F401
     Heartbeat,
     read_heartbeats,
+)
+from comapreduce_tpu.resilience.lease import (  # noqa: F401
+    Lease,
+    LeaseBoard,
+    lease_key,
+    lease_path,
+    read_lease,
 )
 from comapreduce_tpu.resilience.tripwires import (  # noqa: F401
     finite_fraction,

@@ -36,6 +36,16 @@ list of ``kind[@substr][:rate]`` with rate in [0, 1] (default 1);
   ledgered ``hang``/``rejected``, and the abandoned writer's late
   commit is skipped (committed checkpoints are never dropped or
   reordered).
+- ``rank_kill``   — the whole PROCESS dies (SIGKILL to self) the
+  moment a matching file is claimed from the elastic queue
+  (``pipeline.scheduler``) — the preempted-node case: the lease file
+  leaks, the heartbeat goes silent, and a survivor must steal the
+  unit. Fired at most once per monkey (the process is gone anyway in
+  real runs; the cap keeps in-process tests sane).
+- ``rank_pause``  — the ZOMBIE case: the rank's heartbeat is frozen
+  (``Heartbeat.pause``) when a matching file is claimed, but the rank
+  keeps running and will try to commit late. The drill asserts the
+  stolen-and-redone unit's generation fence rejects that commit.
 
 Whether a given file draws a given fault depends only on
 ``(seed, kind, basename)`` — stable across runs, across iteration
@@ -57,7 +67,8 @@ __all__ = ["ChaosMonkey", "parse_inject_spec", "CHAOS_KINDS"]
 logger = logging.getLogger("comapreduce_tpu")
 
 CHAOS_KINDS = ("read_error", "truncate", "flaky", "nan_burst",
-               "slow_read", "hang", "write_stall")
+               "slow_read", "hang", "write_stall", "rank_kill",
+               "rank_pause")
 
 # TOD datasets a NaN burst can poison, by payload schema
 _POISON_KEYS = ("spectrometer/tod", "averaged_tod/tod",
@@ -133,6 +144,37 @@ class ChaosMonkey:
         with self._lock:
             self.injected.append((filename, kind))
         logger.info("chaos: injected %s into %s", kind, filename)
+
+    def maybe_kill(self, filename: str) -> None:
+        """SIGKILL the whole process (kind ``rank_kill``) — called by
+        the scheduler at claim time, so the lease is already on disk
+        and LEAKS exactly like a preempted node's would. No cleanup
+        handlers run: that is the point."""
+        if "rank_kill" not in self.decide(filename):
+            return
+        with self._lock:
+            if any(k == "rank_kill" for _, k in self.injected):
+                return  # at most once (a real kill never returns)
+            self.injected.append((filename, "rank_kill"))
+        logger.warning("chaos: rank_kill — SIGKILLing pid %d at claim "
+                       "of %s", os.getpid(), filename)
+        os.kill(os.getpid(), 9)  # signal.SIGKILL; never returns
+        time.sleep(60.0)  # pathological platform: at least stall
+
+    def maybe_pause(self, filename: str) -> bool:
+        """True once when ``rank_pause`` fires for this file — the
+        caller freezes the rank's heartbeat (``Heartbeat.pause``) but
+        keeps working: the zombie whose stolen unit's late commit the
+        lease generation fence must reject."""
+        if "rank_pause" not in self.decide(filename):
+            return False
+        with self._lock:
+            if any(k == "rank_pause" for _, k in self.injected):
+                return False  # already a zombie
+            self.injected.append((filename, "rank_pause"))
+        logger.warning("chaos: rank_pause — freezing heartbeat at "
+                       "claim of %s (zombie mode)", filename)
+        return True
 
     def stall_write(self, path: str) -> None:
         """Block a writeback commit for ``path`` (kind ``write_stall``)

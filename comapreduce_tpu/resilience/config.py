@@ -70,6 +70,19 @@ class ResilienceConfig:
         dead and entering degraded mode
         (``parallel.multihost.straggler_barrier``); 0 disables the
         barrier.
+    lease_ttl_s:
+        ELASTIC campaigns (``pipeline.scheduler``): > 0 replaces the
+        static ``rank::n_ranks`` shard with lease-based claiming —
+        each rank claims files under a heartbeat-fenced lease, and a
+        lease whose owner's heartbeat is older than this TTL is
+        stealable by any survivor. 0 (default) keeps the static shard.
+        Requires ``heartbeat_s > 0`` (the TTL is judged against the
+        owner's heartbeat file).
+    steal_after_s:
+        Minimum age of the lease FILE itself before it may be stolen
+        (a freshly-claimed lease whose owner has not beaten yet must
+        not be stolen instantly); 0 (default) = same as
+        ``lease_ttl_s``.
     """
 
     quarantine: str = "auto"
@@ -86,6 +99,8 @@ class ResilienceConfig:
     hang_grace_s: float = 0.5
     heartbeat_s: float = 10.0
     straggler_timeout_s: float = 120.0
+    lease_ttl_s: float = 0.0
+    steal_after_s: float = 0.0
 
     def __post_init__(self):
         # normalise INI-coerced values (None from 'none'/'', bools,
@@ -139,11 +154,21 @@ class ResilienceConfig:
         object.__setattr__(self, "straggler_timeout_s",
                            max(float(self.straggler_timeout_s or 0.0),
                                0.0))
+        object.__setattr__(self, "lease_ttl_s",
+                           max(float(self.lease_ttl_s or 0.0), 0.0))
+        object.__setattr__(self, "steal_after_s",
+                           max(float(self.steal_after_s or 0.0), 0.0))
+        if self.lease_ttl_s > 0 and self.heartbeat_s <= 0:
+            raise ValueError(
+                "lease_ttl_s > 0 (elastic campaigns) requires "
+                "heartbeat_s > 0: lease expiry is judged against the "
+                "owner's heartbeat file")
 
     KNOBS = ("quarantine", "max_retries", "retry_base_s", "retry_max_s",
              "retry_jitter", "retry_quarantined", "inject", "inject_seed",
              "deadlines", "deadline_scale", "deadline_min_s",
-             "hang_grace_s", "heartbeat_s", "straggler_timeout_s")
+             "hang_grace_s", "heartbeat_s", "straggler_timeout_s",
+             "lease_ttl_s", "steal_after_s")
 
     @classmethod
     def from_mapping(cls, mapping) -> "ResilienceConfig":
@@ -190,8 +215,14 @@ class ResilienceConfig:
         return self.quarantine
 
     def make_runtime(self, output_dir: str = ".", rank: int = 0,
-                     n_ranks: int = 1) -> "Resilience":
-        """Build the runtime bundle this config describes."""
+                     n_ranks: int = 1,
+                     state_dir: str = "") -> "Resilience":
+        """Build the runtime bundle this config describes.
+
+        ``state_dir`` is where run-state files (heartbeats, and the
+        scheduler's leases/queue manifest) live; '' keeps them in
+        ``output_dir`` (historic behaviour — the CLIs pass ``[Global]
+        log_dir`` so science products and run state stay separate)."""
         import logging
 
         path = self.ledger_path(output_dir, rank=rank, n_ranks=n_ranks)
@@ -213,7 +244,7 @@ class ResilienceConfig:
                             max_s=self.retry_max_s,
                             jitter=self.retry_jitter,
                             seed=self.inject_seed)
-        heartbeat = (Heartbeat(output_dir or ".", rank=rank,
+        heartbeat = (Heartbeat(state_dir or output_dir or ".", rank=rank,
                                period_s=self.heartbeat_s)
                      if self.heartbeat_s > 0 else None)
         # the watchdog exists whenever deadlines are configured; with an
@@ -240,7 +271,10 @@ class ResilienceConfig:
         return Resilience(ledger=ledger, retry=retry, chaos=chaos,
                           retry_quarantined=self.retry_quarantined,
                           watchdog=watchdog, heartbeat=heartbeat,
-                          straggler_timeout_s=self.straggler_timeout_s)
+                          straggler_timeout_s=self.straggler_timeout_s,
+                          lease_ttl_s=self.lease_ttl_s,
+                          steal_after_s=self.steal_after_s,
+                          state_dir=state_dir or output_dir or ".")
 
 
 @dataclass
@@ -258,6 +292,11 @@ class Resilience:
     watchdog: Watchdog | None = None
     heartbeat: Heartbeat | None = None
     straggler_timeout_s: float = 0.0
+    # elastic campaigns (pipeline.scheduler): lease_ttl_s > 0 turns on
+    # lease-based claiming; state_dir is where leases + queue.json live
+    lease_ttl_s: float = 0.0
+    steal_after_s: float = 0.0
+    state_dir: str = ""
     _readmitted: set = field(default_factory=set)
     # quarantine snapshot, frozen at the first admit() of this runtime:
     # a file quarantined MID-run must not change which files the rest of

@@ -29,7 +29,15 @@ This is the executable form of the resilience layer's contract
    surfaces the failure, committed checkpoints are never dropped or
    reordered (the surviving file holds its LAST submitted generation,
    complete), and the abandoned writer's late commit is skipped at the
-   generation gate.
+   generation gate;
+7. the elastic campaign (ISSUE 8, ``run_elastic_drill``): three REAL
+   processes share one lease-file queue; a ``rank_kill`` rank is
+   SIGKILLed mid-lease and a ``rank_pause`` zombie stops heartbeating
+   but keeps working. The survivor steals both expired leases
+   (ledgered ``stolen`` then ``recovered``), every file is committed
+   EXACTLY once, the zombie's late commit is rejected at the
+   generation fence, and the map over the committed set is
+   byte-identical to a clean run over the same filelist.
 
 Everything is deterministic by seed (chaos decisions, jitter, synthetic
 data), so a CI failure reproduces locally bit-for-bit. (Deadline
@@ -45,7 +53,7 @@ import time
 
 import numpy as np
 
-__all__ = ["run_drill"]
+__all__ = ["run_drill", "run_elastic_drill"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -373,3 +381,243 @@ def _writeback_drill(workdir, res, seed, soft_s, hard_s, grace_s) -> dict:
     finally:
         monkey.release()
         wb.close()
+
+
+def run_elastic_drill(workdir: str, seed: int = 0, n_files: int = 7,
+                      ttl_s: float = 1.0, hold_s: float = 10.0,
+                      timeout_s: float = 180.0) -> dict:
+    """Criterion 7: the elastic campaign under ``rank_kill`` +
+    ``rank_pause``, with REAL processes (a SIGKILL cannot be faked
+    in-process).
+
+    Three worker ranks (``python -m comapreduce_tpu.resilience.drill``)
+    share one lease directory over the same ``n_files`` fixtures:
+
+    - rank 1 draws ``rank_kill`` on its first rotation unit — SIGKILLed
+      the instant the lease is claimed, leaking it;
+    - rank 2 draws ``rank_pause`` on its first unit — the zombie: its
+      heartbeat freezes but it keeps "working" for ``hold_s`` (far past
+      the TTL) and then tries to commit;
+    - rank 0, the survivor, waits for both targets' leases to exist
+      (so the faults deterministically land on their ranks) and then
+      drains the whole queue, stealing both expired leases.
+
+    Asserts: the killed rank died by SIGKILL and wrote nothing; every
+    file was committed exactly once (by the survivor); both steals are
+    ledgered ``stolen`` then ``recovered``; the zombie's late commit
+    was fence-rejected exactly once; every lease file ends ``done`` by
+    the survivor; and the destriped map over the committed set is
+    byte-identical to a clean run over the same filelist.
+    """
+    import json
+    import shutil
+    import subprocess
+    import sys
+
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.resilience import QuarantineLedger
+    from comapreduce_tpu.resilience.lease import (lease_key, lease_path,
+                                                  read_lease)
+
+    t0 = time.perf_counter()
+    os.makedirs(workdir, exist_ok=True)
+    files = []
+    for i in range(n_files):
+        path = os.path.join(workdir, f"Level2_comap-{i:04d}.hd5")
+        if not os.path.exists(path):
+            _write_level2(path, seed=1000 + seed * 10 + i)
+        files.append(os.path.abspath(path))
+    state = os.path.join(workdir, "elastic")
+    shutil.rmtree(state, ignore_errors=True)
+    os.makedirs(state)
+    flist = os.path.join(state, "filelist.txt")
+    with open(flist, "w", encoding="utf-8") as f:
+        f.write("\n".join(files) + "\n")
+    # each fault targets its rank's FIRST rotation unit, so the rank
+    # dies/pauses before doing anything else — the worst case for the
+    # queue (nothing of its shard completed)
+    kill_target = os.path.basename(files[1])
+    pause_target = os.path.basename(files[2])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn(rank: int, **kw):
+        cmd = [sys.executable, "-m", "comapreduce_tpu.resilience.drill",
+               f"--rank={rank}", "--n-ranks=3", f"--state-dir={state}",
+               f"--filelist={flist}", f"--ttl={ttl_s}",
+               f"--seed={seed}"]
+        cmd += [f"--{k.replace('_', '-')}={v}" for k, v in kw.items()]
+        return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
+    procs = {
+        "killer": spawn(1, chaos=f"rank_kill@{kill_target}"),
+        "zombie": spawn(2, chaos=f"rank_pause@{pause_target}",
+                        hold_s=hold_s, max_files=1),
+        "survivor": spawn(0, wait_for=f"{kill_target},{pause_target}"),
+    }
+    rc, out = {}, {}
+    for name, pr in procs.items():
+        try:
+            stdout, _ = pr.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+            stdout, _ = pr.communicate()
+        rc[name] = pr.returncode
+        out[name] = (stdout or b"").decode(errors="replace")
+
+    assert rc["killer"] == -9, \
+        f"criterion 7: rank_kill rank exited {rc['killer']}, expected " \
+        f"SIGKILL (-9):\n{out['killer']}"
+    assert rc["zombie"] == 0, \
+        f"criterion 7: zombie rank failed ({rc['zombie']}):\n" \
+        f"{out['zombie']}"
+    assert rc["survivor"] == 0, \
+        f"criterion 7: survivor rank failed ({rc['survivor']}):\n" \
+        f"{out['survivor']}"
+    assert not os.path.exists(os.path.join(state, "result.rank1.json")), \
+        "criterion 7: the SIGKILLed rank wrote a result"
+
+    def result(rank: int) -> dict:
+        with open(os.path.join(state, f"result.rank{rank}.json"),
+                  encoding="utf-8") as f:
+            return json.load(f)
+
+    surv, zomb = result(0), result(2)
+    names = sorted(os.path.basename(f) for f in files)
+    committed = sorted(surv["committed"] + zomb["committed"])
+    # exactly once: equality of sorted MULTISETS catches both a lost
+    # unit and a double commit
+    assert committed == names, \
+        f"criterion 7: committed {committed} != filelist {names} " \
+        f"(unit lost or committed twice)"
+    assert zomb["committed"] == [] \
+        and zomb["stats"]["fence_rejects"] == 1, \
+        f"criterion 7: zombie's late commit was not fence-rejected " \
+        f"exactly once: {zomb}"
+    assert pause_target in zomb["processed"], \
+        f"criterion 7: zombie never claimed its pause target: {zomb}"
+    assert surv["stats"]["stolen"] == 2 \
+        and surv["stats"]["recovered"] == 2, \
+        f"criterion 7: survivor should steal AND recover exactly the " \
+        f"2 faulted units: {surv['stats']}"
+    ledger = QuarantineLedger(os.path.join(state,
+                                           "quarantine.rank0.jsonl"))
+    stolen = sorted({os.path.basename(e.unit["file"])
+                     for e in ledger.entries
+                     if e.disposition == "stolen"})
+    recovered = sorted({os.path.basename(e.unit["file"])
+                        for e in ledger.entries
+                        if e.disposition == "recovered"})
+    assert stolen == recovered == sorted([kill_target, pause_target]), \
+        f"criterion 7: ledger stole {stolen} / recovered {recovered}, " \
+        f"expected {sorted([kill_target, pause_target])}"
+    for f in files:
+        st = read_lease(lease_path(state, lease_key(f)))
+        assert st is not None and st.get("state") == "done", \
+            f"criterion 7: lease for {os.path.basename(f)} not done: {st}"
+        assert int(st.get("done_by", -1)) == 0, \
+            f"criterion 7: {os.path.basename(f)} finished by rank " \
+            f"{st.get('done_by')}, expected the survivor (0)"
+
+    # the map over the committed set must match a clean static run over
+    # the same filelist to the last byte — stealing moved units between
+    # ranks, it must not change WHAT gets reduced
+    wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
+    by_name = {os.path.basename(f): f for f in files}
+    map_elastic = np.asarray(_solve(_read(
+        [by_name[n] for n in committed], wcs)).destriped_map)
+    map_clean = np.asarray(_solve(_read(
+        sorted(files), wcs)).destriped_map)
+    identical = bool(np.array_equal(map_elastic, map_clean))
+    assert identical, \
+        "criterion 7: elastic-campaign map != clean run over the " \
+        "same filelist"
+
+    return {
+        "elastic_returncodes": dict(rc),
+        "elastic_committed": {"survivor": surv["committed"],
+                              "zombie": zomb["committed"]},
+        "elastic_stats": {"survivor": surv["stats"],
+                          "zombie": zomb["stats"]},
+        "elastic_stolen": stolen,
+        "elastic_recovered": recovered,
+        "elastic_fence_rejects": zomb["stats"]["fence_rejects"],
+        "elastic_map_byte_identical": identical,
+        "elastic_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def _elastic_worker_main(argv=None) -> int:
+    """One elastic-drill rank (the ``python -m`` entry): heartbeat +
+    scheduler over the shared state dir, committing every claimed unit.
+    The chaos spec (``rank_kill``/``rank_pause``) makes this rank the
+    drill's victim; ``--wait-for`` makes it the survivor (it defers
+    claiming until the victims' leases exist, so the faults land
+    deterministically). Results land in ``result.rank<r>.json``."""
+    import argparse
+    import json
+
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+    from comapreduce_tpu.resilience.chaos import ChaosMonkey
+    from comapreduce_tpu.resilience.heartbeat import Heartbeat
+    from comapreduce_tpu.resilience.ledger import QuarantineLedger
+    from comapreduce_tpu.resilience.lease import lease_key, lease_path
+
+    p = argparse.ArgumentParser(prog="drill-elastic-worker")
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--n-ranks", type=int, required=True)
+    p.add_argument("--state-dir", required=True)
+    p.add_argument("--filelist", required=True)
+    p.add_argument("--ttl", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chaos", default="")
+    p.add_argument("--wait-for", default="")
+    p.add_argument("--hold-s", type=float, default=0.0)
+    p.add_argument("--max-files", type=int, default=0)
+    a = p.parse_args(argv)
+    with open(a.filelist, encoding="utf-8") as f:
+        files = [ln.strip() for ln in f if ln.strip()]
+    hb = Heartbeat(a.state_dir, rank=a.rank,
+                   period_s=max(a.ttl / 5.0, 0.05))
+    hb.start()
+    monkey = ChaosMonkey(a.chaos, seed=a.seed) if a.chaos else None
+    ledger = QuarantineLedger(os.path.join(
+        a.state_dir, f"quarantine.rank{a.rank}.jsonl"))
+    sched = Scheduler(files, a.state_dir, rank=a.rank,
+                      n_ranks=a.n_ranks, lease_ttl_s=a.ttl,
+                      poll_s=min(a.ttl / 5.0, 0.25), ledger=ledger,
+                      chaos=monkey, heartbeat=hb)
+    if a.wait_for:
+        want = [lease_path(a.state_dir, lease_key(k))
+                for k in a.wait_for.split(",") if k]
+        deadline = time.monotonic() + 60.0
+        while not all(os.path.exists(w) for w in want):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"peer leases never appeared: {want}")
+            time.sleep(0.05)
+    processed, committed = [], []
+    for f in sched.claim_iter():
+        processed.append(os.path.basename(f))
+        if a.hold_s and getattr(hb, "_paused", False):
+            # the zombie: keep "working" far past the TTL so the
+            # survivor steals and redoes the unit before this commit
+            time.sleep(a.hold_s)
+        if sched.commit(f):
+            committed.append(os.path.basename(f))
+        if a.max_files and len(processed) >= a.max_files:
+            break
+    out = {"rank": a.rank, "processed": processed,
+           "committed": committed, "stats": sched.stats}
+    tmp = os.path.join(a.state_dir, f".result.rank{a.rank}.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(out, f)
+    os.replace(tmp, os.path.join(a.state_dir,
+                                 f"result.rank{a.rank}.json"))
+    hb.stop(final_stage="drill.elastic.done")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    raise SystemExit(_elastic_worker_main(_sys.argv[1:]))

@@ -125,6 +125,7 @@ class Heartbeat:
         self._io_lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        self._paused = False
         self._thread: threading.Thread | None = None
         self._state = {
             "rank": self.rank,
@@ -181,6 +182,8 @@ class Heartbeat:
         (see ``_io_lock``) so ``seq`` on disk is monotonic."""
         with self._io_lock:
             with self._lock:
+                if self._paused:
+                    return  # zombie mode: no beat may reach disk
                 self._state["seq"] += 1
                 snap = dict(self._state,
                             progress=dict(self._state["progress"]),
@@ -217,6 +220,8 @@ class Heartbeat:
             return self
         self._stop.clear()
         self._wake.clear()
+        with self._lock:
+            self._paused = False  # a restarted rank beats again
         self.write()
         self._thread = threading.Thread(
             target=self._tick, name=f"heartbeat.rank{self.rank}",
@@ -231,6 +236,19 @@ class Heartbeat:
             if self._stop.is_set():
                 break  # stop() writes the final beat itself
             self.write()
+
+    def pause(self) -> None:
+        """Freeze the heartbeat WITHOUT stopping the rank — every
+        subsequent write (ticker, notes, even :meth:`stop`'s final
+        beat) is suppressed until :meth:`start` is called again. This
+        is the chaos ``rank_pause`` zombie: to every observer the rank
+        is dead (its lease becomes stealable), yet it keeps computing
+        and will try to commit. Also the clean half of the LEAVE
+        runbook: pause, finish the unit in flight, exit."""
+        with self._lock:
+            self._paused = True
+        logger.warning("heartbeat rank %d: paused — no further beats "
+                       "will be written", self.rank)
 
     def stop(self, final_stage: str = "") -> None:
         """Stop the ticker and write one final beat (so the last state

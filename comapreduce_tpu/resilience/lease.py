@@ -1,0 +1,338 @@
+"""Heartbeat-fenced file leases: the elastic campaign's claim primitive.
+
+One lease file per work unit — ``lease.<key>.json`` in the run's state
+directory — makes the filesystem itself the work queue: no coordinator
+service, no locks a dead rank can hold forever. Three operations, each
+built on a primitive the filesystem makes atomic:
+
+- **claim** — publish the lease by hard-linking a fully-written,
+  fsynced temp file onto the lease name (``os.link`` fails with
+  ``EEXIST`` when the name is taken, so exactly one rank wins; the
+  loser never sees a torn file because the content was complete and
+  durable *before* the name existed). Same durability discipline as
+  ``data/durable.py``: fsync data blocks first, then the directory.
+- **steal** — reclaim an EXPIRED lease by ``os.rename``-ing it to a
+  unique tombstone (POSIX guarantees exactly one racing renamer
+  succeeds; the loser gets ``ENOENT``), then re-publishing with the
+  generation bumped. Expiry is judged by the owner's heartbeat through
+  :func:`~comapreduce_tpu.resilience.heartbeat.heartbeat_age_s` — the
+  ONE staleness rule (``tools/watchdog_report`` and the straggler
+  barrier use the same one) — so a paused-but-running zombie rank and
+  a SIGKILLed rank look identical: no fresh beat, lease reclaimable.
+- **commit** — fence-checked done marker. The committer rename-takes
+  the current lease file, verifies it still carries ITS owner and
+  generation, and only then publishes ``state: "done"``. A zombie
+  whose lease was stolen finds a higher generation (or the thief's
+  done marker) under the name and is REJECTED — the same monotonic-
+  generation gate as ``data.writeback.Writeback``'s late-commit skip,
+  applied to the work queue: a stolen-and-redone file can never be
+  double-counted or clobbered by its original owner limping back.
+
+Generations are monotonic per key: every claim/steal scans the key's
+tombstones (a stealer that crashed mid-reclaim leaves its tombstone
+behind, preserving the counter) and publishes ``max(seen) + 1``. A
+torn lease file (a partial NFS copy — the claim path itself can never
+tear one) parses as None and NEVER acts as a valid claim: it is
+reclaimable once old enough, like any expired lease.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import itertools
+import json
+import logging
+import os
+import re
+import socket
+import tempfile
+import time
+from typing import NamedTuple
+
+from comapreduce_tpu.data.durable import durable_replace, fsync_path
+from comapreduce_tpu.resilience.heartbeat import (heartbeat_age_s,
+                                                  read_heartbeats)
+
+__all__ = ["Lease", "LeaseBoard", "lease_key", "lease_path", "read_lease"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_KEY_RE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def lease_key(filename: str) -> str:
+    """Stable slug for one work unit (its basename, sanitised)."""
+    return _KEY_RE.sub("-", os.path.basename(filename)) or "unit"
+
+
+def lease_path(directory: str, key: str) -> str:
+    return os.path.join(directory or ".", f"lease.{key}.json")
+
+
+def read_lease(path: str) -> dict | None:
+    """Parse one lease/tombstone file; None for missing OR torn (a torn
+    lease must never be treated as a live claim)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class Lease(NamedTuple):
+    """One held claim (the token the committer's fence checks)."""
+
+    key: str
+    file: str
+    owner: int
+    generation: int
+    path: str
+    stolen_from: int | None = None
+
+
+class LeaseBoard:
+    """The per-run lease table: claim / steal / commit over one
+    directory of ``lease.*.json`` files.
+
+    ``heartbeat_dir`` is where the fleet's ``heartbeat.rank*.json``
+    files live (defaults to ``directory``); ``lease_ttl_s`` is the
+    owner-heartbeat age beyond which a lease is expired;
+    ``steal_after_s`` additionally requires the lease FILE itself to be
+    at least that old (0 = same as the TTL) — a fresh claim whose
+    owner simply has not beaten yet must not be stolen instantly.
+    """
+
+    def __init__(self, directory: str, rank: int = 0,
+                 heartbeat_dir: str | None = None,
+                 lease_ttl_s: float = 60.0, steal_after_s: float = 0.0,
+                 now=time.time):
+        self.directory = directory or "."
+        os.makedirs(self.directory, exist_ok=True)
+        self.rank = int(rank)
+        self.heartbeat_dir = heartbeat_dir or self.directory
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.steal_after_s = float(steal_after_s) or self.lease_ttl_s
+        self.now = now
+        self.fence_rejects = 0
+        self._nonce = itertools.count()
+
+    # -- readers -------------------------------------------------------------
+    def path_for(self, filename: str) -> str:
+        return lease_path(self.directory, lease_key(filename))
+
+    def state(self, filename: str) -> dict | None:
+        return read_lease(self.path_for(filename))
+
+    def is_done(self, filename: str) -> bool:
+        st = self.state(filename)
+        return st is not None and st.get("state") == "done"
+
+    def expired(self, filename: str, now: float | None = None) -> bool:
+        """True when the lease exists, is not done, and its owner shows
+        no live heartbeat — the steal precondition. The rule is
+        ``heartbeat_age_s`` out of ``[0, lease_ttl_s]`` (a FUTURE
+        timestamp is no evidence of life, same as the stale-rank rule
+        everywhere else), plus the lease file itself being at least
+        ``steal_after_s`` old by local mtime."""
+        path = self.path_for(filename)
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            return False  # no lease: claimable, not stealable
+        now = self.now() if now is None else now
+        if now - mtime < self.steal_after_s:
+            return False
+        st = read_lease(path)
+        if st is None:
+            # torn lease (partial copy): no valid owner to be alive —
+            # reclaimable once past the age gate above
+            return True
+        if st.get("state") == "done":
+            return False
+        hb = read_heartbeats(self.heartbeat_dir).get(int(st.get("owner",
+                                                                -1)))
+        if hb is None:
+            return True
+        return not 0.0 <= heartbeat_age_s(hb, now) <= self.lease_ttl_s
+
+    # -- writers -------------------------------------------------------------
+    def claim(self, filename: str) -> Lease | None:
+        """Claim an unleased unit; None when the name is already taken
+        (done, live, torn or mid-steal — the caller retries through
+        :meth:`steal` once :meth:`expired` says so)."""
+        key = lease_key(filename)
+        path = lease_path(self.directory, key)
+        if os.path.exists(path):
+            return None
+        gen = self._next_generation(path)
+        payload = self._payload(key, filename, gen, state="claimed")
+        if not self._publish(path, payload):
+            return None  # lost the create race
+        return Lease(key, filename, self.rank, gen, path)
+
+    def steal(self, filename: str) -> Lease | None:
+        """Reclaim an expired lease; exactly one racing stealer wins
+        (the rename-take). None when not expired, raced away, or the
+        owner committed first."""
+        if not self.expired(filename):
+            return None
+        key = lease_key(filename)
+        path = lease_path(self.directory, key)
+        tomb = f"{path}.t{self.rank}.{os.getpid()}.{next(self._nonce)}"
+        try:
+            os.rename(path, tomb)  # atomic take: one winner per inode
+        except OSError:
+            return None
+        old = read_lease(tomb)
+        if old is not None and old.get("state") == "done":
+            # raced a just-in-time commit: the work is done, put the
+            # marker back (exclusive, in case a third party republished)
+            self._restore(tomb, path)
+            return None
+        gen = max(int((old or {}).get("generation", 0)),
+                  self._next_generation(path) - 1) + 1
+        owner = None if old is None else old.get("owner")
+        payload = self._payload(key, filename, gen, state="claimed",
+                                stolen_from=owner)
+        if self._publish(path, payload):
+            os.unlink(tomb)
+            logger.warning("lease %s: stolen from rank %s (gen %d -> %d)",
+                           key, owner, gen - 1, gen)
+            return Lease(key, filename, self.rank, gen, path,
+                         stolen_from=owner)
+        os.unlink(tomb)  # a racer re-published first; its generation
+        # already accounted for ours through the tombstone scan
+        return None
+
+    def commit(self, lease: Lease) -> bool:
+        """Publish the done marker iff the on-disk lease still carries
+        ``lease``'s owner and generation — the zombie fence. False
+        (and ``fence_rejects`` incremented) when the unit was stolen:
+        the thief's work stands, ours is discarded."""
+        path = lease.path
+        tomb = f"{path}.c{self.rank}.{os.getpid()}.{next(self._nonce)}"
+        try:
+            os.rename(path, tomb)  # take the name to check-and-set
+        except OSError:
+            self.fence_rejects += 1  # vanished: a steal is in flight
+            return False
+        st = read_lease(tomb)
+        if (st is None or st.get("state") != "claimed"
+                or int(st.get("owner", -1)) != lease.owner
+                or int(st.get("generation", -1)) != lease.generation):
+            # not our claim any more (stolen — possibly already redone
+            # and committed by the thief): restore whatever was there
+            self._restore(tomb, path)
+            self.fence_rejects += 1
+            logger.warning(
+                "lease %s: commit REJECTED at the generation fence "
+                "(held gen %d, found %s gen %s) — the unit was stolen "
+                "and this rank's late result is discarded", lease.key,
+                lease.generation, (st or {}).get("state", "torn"),
+                (st or {}).get("generation"))
+            return False
+        payload = dict(st, state="done", done_by=self.rank,
+                       t_done_unix=self.now())
+        if self._publish(path, payload):
+            os.unlink(tomb)
+            return True
+        # a fresh claim landed in the take window: its generation scan
+        # saw our tombstone, so it supersedes us — reject ourselves
+        os.unlink(tomb)
+        self.fence_rejects += 1
+        return False
+
+    def release(self, lease: Lease) -> bool:
+        """Give a claim back (clean shutdown with unprocessed claims):
+        the lease file is removed iff it is still ours."""
+        tomb = f"{lease.path}.r{self.rank}.{os.getpid()}." \
+               f"{next(self._nonce)}"
+        try:
+            os.rename(lease.path, tomb)
+        except OSError:
+            return False
+        st = read_lease(tomb)
+        if (st is None or int(st.get("owner", -1)) != lease.owner
+                or int(st.get("generation", -1)) != lease.generation):
+            self._restore(tomb, lease.path)
+            return False
+        os.unlink(tomb)
+        return True
+
+    # -- internals -----------------------------------------------------------
+    def _payload(self, key, filename, gen, state, stolen_from=None):
+        return {"key": key, "file": filename, "owner": self.rank,
+                "generation": int(gen), "state": state,
+                "pid": os.getpid(), "host": socket.gethostname(),
+                "stolen_from": stolen_from,
+                "t_claim_unix": self.now(),
+                "t_wall": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())}
+
+    def _publish(self, path: str, payload: dict) -> bool:
+        """Exclusive durable publication: write + fsync a temp file,
+        then hard-link it onto the lease name (fails if taken), then
+        fsync the directory — the name never exists before its content
+        is complete and durable, so a reader can never see a torn
+        claim of OUR making."""
+        fd, tmp = tempfile.mkstemp(prefix=".lease.", suffix=".tmp",
+                                   dir=self.directory)
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            fsync_path(tmp)
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                return False
+            except OSError:
+                # no hard links (exotic FS): degrade to replace — the
+                # durable data fsync above still prevents torn content,
+                # at the cost of last-writer-wins on a true tie
+                durable_replace(tmp, path)
+                tmp = ""
+                return True
+            self._fsync_dir()
+            return True
+        finally:
+            if tmp:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def _restore(self, tomb: str, path: str) -> None:
+        """Put a taken lease file back under its name (exclusive — a
+        republished name wins over the restore) and drop the tombstone."""
+        try:
+            os.link(tomb, path)
+        except OSError:
+            pass
+        try:
+            os.unlink(tomb)
+        except OSError:
+            pass
+
+    def _next_generation(self, path: str) -> int:
+        """1 + the highest generation among the key's tombstones (a
+        crashed stealer's tombstone preserves the counter; the live
+        lease itself, when present, is handled by the caller)."""
+        gen = 0
+        for t in _glob.glob(path + ".*"):
+            st = read_lease(t)
+            if st is not None:
+                gen = max(gen, int(st.get("generation", 0)))
+        return gen + 1
+
+    def _fsync_dir(self) -> None:
+        flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+        try:
+            fd = os.open(self.directory, flags)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)

@@ -33,7 +33,11 @@ re-run — for lock contention, where the file itself is fine, and for
 ``hang``-class failures, which indict the environment — an NFS mount,
 a dead rank — rather than the data); ``stalled`` — a watchdog soft
 deadline fired mid-operation (informational; never skipped — the
-operation itself may still have succeeded).
+operation itself may still have succeeded); ``stolen`` — an elastic
+campaign survivor reclaimed this unit's expired lease from a dead or
+zombie rank (``pipeline.scheduler``; never skipped — the unit is
+being redone right now), paired with a later ``recovered`` once the
+thief commits it.
 """
 
 from __future__ import annotations

@@ -380,9 +380,13 @@ min_duration_seconds = 1.0
     produced = sorted(os.listdir(outdir))
     level2 = [p for p in produced if p.startswith("Level2_")]
     assert len(level2) == 2, produced
-    # each rank also beats its own liveness file (ISSUE 3)
-    assert [p for p in produced if p.startswith("heartbeat.rank")] == \
+    # each rank also beats its own liveness file (ISSUE 3) — run state
+    # lives under [Global] log_dir, not with the science products
+    # (ISSUE 8)
+    logs = sorted(os.listdir(tmp_path / "logs"))
+    assert [p for p in logs if p.startswith("heartbeat.rank")] == \
         ["heartbeat.rank0.json", "heartbeat.rank1.json"]
+    assert not [p for p in produced if p.startswith("heartbeat.rank")]
 
 
 def test_make_band_map_sharded_matches_single(field_dataset):

@@ -1,0 +1,269 @@
+"""Elastic campaign scheduler + lease board (ISSUE 8).
+
+Unit-level pins for the filesystem work queue: exclusive claims
+(``os.link`` publication — one winner, never a torn lease), heartbeat-
+fenced expiry, steal-with-generation-bump, the zombie commit fence
+(a stolen-and-redone unit can never be clobbered or double-counted by
+its original owner limping back), monotonic generations across crashed
+stealers' tombstones, and the ``Scheduler`` loop over all of it:
+single-rank drain, stealing from a dead rank, stall bail-out with
+ledgered abandonment. The three-process end-to-end version (real
+SIGKILL, real zombie) is ``run_elastic_drill`` — exercised here under
+the ``chaos`` marker and in CI as ``check_resilience.py
+--elastic-only``.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+
+def _age(path, seconds):
+    """Backdate a state file so age gates pass without sleeping."""
+    t = time.time() - seconds
+    os.utime(path, (t, t))
+
+
+def _beat(directory, rank, age_s=0.0):
+    """A handwritten heartbeat file ``age_s`` old (writer + mtime)."""
+    from comapreduce_tpu.resilience.heartbeat import heartbeat_path
+
+    p = heartbeat_path(str(directory), rank)
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump({"rank": rank, "seq": 1,
+                   "t_wall_unix": time.time() - age_s}, f)
+    _age(p, age_s)
+    return p
+
+
+def _board(directory, rank=0, ttl=5.0, steal_after=0.0):
+    from comapreduce_tpu.resilience.lease import LeaseBoard
+
+    return LeaseBoard(str(directory), rank=rank, lease_ttl_s=ttl,
+                      steal_after_s=steal_after)
+
+
+def test_claim_is_exclusive_and_never_torn(tmp_path):
+    b0, b1 = _board(tmp_path, 0), _board(tmp_path, 1)
+    lease = b0.claim("/data/obs-0001.hd5")
+    assert lease is not None and lease.owner == 0 and lease.generation == 1
+    # the loser of the name race gets None, and what it reads under the
+    # name is a COMPLETE claim (content was durable before the name
+    # existed), never a torn file
+    assert b1.claim("/data/obs-0001.hd5") is None
+    st = b1.state("/data/obs-0001.hd5")
+    assert st is not None and st["state"] == "claimed" and st["owner"] == 0
+
+
+def test_expiry_needs_old_file_and_stale_owner(tmp_path):
+    b0, b1 = _board(tmp_path, 0), _board(tmp_path, 1)
+    lease = b0.claim("obs.hd5")
+    path = lease.path
+    # fresh lease file: not stealable even with no owner heartbeat
+    assert not b1.expired("obs.hd5")
+    _age(path, 60)
+    # old file + NO owner heartbeat = expired
+    assert b1.expired("obs.hd5")
+    # a live owner heartbeat un-expires it
+    hb = _beat(tmp_path, 0)
+    assert not b1.expired("obs.hd5")
+    # a stale owner heartbeat expires it again
+    _beat(tmp_path, 0, age_s=60)
+    assert b1.expired("obs.hd5")
+    # a FUTURE-clock heartbeat is no evidence of life either
+    with open(hb, "w", encoding="utf-8") as f:
+        json.dump({"rank": 0, "t_wall_unix": time.time() + 3600}, f)
+    t = time.time() + 3600
+    os.utime(hb, (t, t))
+    assert b1.expired("obs.hd5")
+    # with the owner verifiably dead, the steal goes through and the
+    # name is taken again
+    os.unlink(hb)
+    assert b1.steal("obs.hd5") is not None
+    assert b1.claim("obs.hd5") is None
+
+
+def test_steal_bumps_generation_and_fences_the_zombie(tmp_path):
+    b0, b1 = _board(tmp_path, 0), _board(tmp_path, 1)
+    zombie = b0.claim("obs.hd5")
+    _age(zombie.path, 60)  # owner never beat: expired
+    stolen = b1.steal("obs.hd5")
+    assert stolen is not None
+    assert stolen.generation == zombie.generation + 1
+    assert stolen.stolen_from == 0
+    # one winner per expiry: an immediate re-steal finds a fresh file
+    assert b1.steal("obs.hd5") is None
+    # the zombie's late commit dies at the generation fence...
+    assert not b0.commit(zombie)
+    assert b0.fence_rejects == 1
+    # ...without disturbing the thief's live claim
+    st = b0.state("obs.hd5")
+    assert st["state"] == "claimed" and st["owner"] == 1
+    assert st["generation"] == stolen.generation
+    # the thief's commit stands
+    assert b1.commit(stolen)
+    st = b1.state("obs.hd5")
+    assert st["state"] == "done" and st["done_by"] == 1
+    assert b1.is_done("obs.hd5")
+    # done is terminal: no claim, no steal, even once old
+    _age(b1.path_for("obs.hd5"), 120)
+    assert b0.claim("obs.hd5") is None
+    assert b0.steal("obs.hd5") is None
+
+
+def test_torn_lease_reclaims_but_never_claims(tmp_path):
+    from comapreduce_tpu.resilience.lease import read_lease
+
+    b1 = _board(tmp_path, 1)
+    path = b1.path_for("obs.hd5")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"key": "obs.hd5", "owner":')  # partial NFS copy
+    assert read_lease(path) is None
+    # torn is not a valid claim, but it holds the name...
+    assert b1.claim("obs.hd5") is None
+    # ...and is not stealable until past the age gate
+    assert not b1.expired("obs.hd5")
+    _age(path, 60)
+    assert b1.expired("obs.hd5")
+    lease = b1.steal("obs.hd5")
+    assert lease is not None and lease.stolen_from is None
+    assert b1.commit(lease)
+
+
+def test_generations_survive_a_crashed_stealer(tmp_path):
+    """A stealer that died between rename-take and re-publish leaves
+    only its tombstone; the next claimant's generation still moves
+    FORWARD past it — the zombie fence must stay monotonic."""
+    b0 = _board(tmp_path, 0)
+    path = b0.path_for("obs.hd5")
+    tomb = path + ".t9.12345.0"
+    with open(tomb, "w", encoding="utf-8") as f:
+        json.dump({"key": "obs.hd5", "owner": 9, "generation": 5,
+                   "state": "claimed"}, f)
+    lease = b0.claim("obs.hd5")
+    assert lease is not None and lease.generation == 6
+
+
+def test_release_returns_the_unit_to_the_queue(tmp_path):
+    b0, b1 = _board(tmp_path, 0), _board(tmp_path, 1)
+    lease = b0.claim("obs.hd5")
+    assert b0.release(lease)
+    assert not os.path.exists(lease.path)
+    again = b1.claim("obs.hd5")
+    assert again is not None and again.owner == 1
+
+
+def test_scheduler_single_rank_drains_and_is_idempotent(tmp_path):
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+
+    files = [f"/data/obs-{i}.hd5" for i in range(5)]
+    s = Scheduler(files, str(tmp_path), rank=0, n_ranks=1,
+                  lease_ttl_s=5.0)
+    done = []
+    for f in s.claim_iter():
+        assert s.commit(f)
+        done.append(f)
+    assert done == files  # rank 0 of 1: rotation order is list order
+    assert s.stats["claimed"] == 5 and s.stats["committed"] == 5
+    assert s.stats["stolen"] == 0 and s.stats["fence_rejects"] == 0
+    # the manifest is what the operator report counts pending against
+    with open(tmp_path / "queue.json", encoding="utf-8") as f:
+        manifest = json.load(f)
+    assert manifest["files"] == [os.path.basename(f) for f in files]
+    # a re-run (or a late-joining rank) finds nothing to do
+    s2 = Scheduler(files, str(tmp_path), rank=1, n_ranks=2,
+                   lease_ttl_s=5.0)
+    assert list(s2.claim_iter()) == []
+    assert s2.stats["done_elsewhere"] == 5
+
+
+def test_scheduler_steals_a_dead_ranks_units_and_ledgers(tmp_path):
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+    from comapreduce_tpu.resilience.ledger import QuarantineLedger
+
+    files = [f"/data/obs-{i}.hd5" for i in range(4)]
+    dead = _board(tmp_path, 0, ttl=5.0)
+    for f in files[0::2]:  # rank 0's rotation half, never committed
+        lease = dead.claim(f)
+        _age(lease.path, 60)  # its owner never beat: expired
+    ledger = QuarantineLedger(str(tmp_path / "quarantine.rank1.jsonl"))
+    s = Scheduler(files, str(tmp_path), rank=1, n_ranks=2,
+                  lease_ttl_s=5.0, poll_s=0.01, ledger=ledger)
+    got = [f for f in s.claim_iter() if s.commit(f)]
+    assert sorted(got) == sorted(files)  # survivor finished everything
+    assert s.stats["stolen"] == 2 and s.stats["recovered"] == 2
+    assert s.stats["committed"] == 4
+    events = {(e.disposition, os.path.basename(e.unit["file"]))
+              for e in ledger.entries}
+    assert events == {("stolen", "obs-0.hd5"), ("stolen", "obs-2.hd5"),
+                      ("recovered", "obs-0.hd5"),
+                      ("recovered", "obs-2.hd5")}
+    for f in files:
+        st = s.board.state(f)
+        assert st["state"] == "done" and st["done_by"] == 1
+
+
+def test_scheduler_bails_out_of_a_wedged_queue(tmp_path):
+    """A unit held forever by a rank that stays ALIVE (fresh heartbeat,
+    never commits) must not spin the survivor for eternity: after
+    ``stall_timeout_s`` without progress the unit is abandoned and
+    ledgered ``hang``/``rejected`` for the next run."""
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+    from comapreduce_tpu.resilience.ledger import QuarantineLedger
+
+    holder = _board(tmp_path, 0, ttl=60.0)
+    assert holder.claim("obs-0.hd5") is not None
+    _beat(tmp_path, 0)  # the holder is alive, just never finishing
+    ledger = QuarantineLedger(str(tmp_path / "quarantine.rank1.jsonl"))
+    s = Scheduler(["obs-0.hd5", "obs-1.hd5"], str(tmp_path), rank=1,
+                  n_ranks=2, lease_ttl_s=60.0, poll_s=0.01,
+                  stall_timeout_s=0.3, ledger=ledger)
+    got = [f for f in s.claim_iter() if s.commit(f)]
+    assert got == ["obs-1.hd5"]
+    assert s.stats["abandoned"] == 1
+    e = ledger.latest("obs-0.hd5")
+    assert e is not None and e.failure_class == "hang"
+    assert e.disposition == "rejected"
+
+
+def test_scheduler_release_held_on_shutdown(tmp_path):
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+
+    files = ["obs-0.hd5", "obs-1.hd5"]
+    s = Scheduler(files, str(tmp_path), rank=0, n_ranks=1,
+                  lease_ttl_s=5.0)
+    it = s.claim_iter()
+    first = next(it)
+    it.close()  # clean shutdown mid-queue, first never committed
+    assert first == files[0]
+    assert s.release_held() == 1
+    # the released unit is immediately claimable again
+    s2 = Scheduler(files, str(tmp_path), rank=0, n_ranks=1,
+                   lease_ttl_s=5.0)
+    assert sorted(s2.claim_iter()) == sorted(files)
+
+
+@pytest.mark.chaos
+def test_elastic_drill_end_to_end(tmp_path):
+    """Criterion 7, the CI contract (= ``check_resilience.py
+    --elastic-only``): three real worker processes — one SIGKILLed
+    mid-lease, one zombified mid-unit, one survivor — finish the
+    campaign exactly once each, fence the zombie's late commit, ledger
+    the steals, and produce a map byte-identical to a clean run."""
+    from comapreduce_tpu.resilience.drill import run_elastic_drill
+
+    ev = run_elastic_drill(str(tmp_path / "drill"), seed=0)
+    assert ev["elastic_returncodes"]["killer"] == -9
+    assert ev["elastic_returncodes"]["zombie"] == 0
+    assert ev["elastic_returncodes"]["survivor"] == 0
+    assert ev["elastic_stats"]["survivor"]["stolen"] == 2
+    assert ev["elastic_stats"]["survivor"]["recovered"] == 2
+    assert ev["elastic_fence_rejects"] == 1
+    assert ev["elastic_stats"]["zombie"]["committed"] == 0
+    assert ev["elastic_map_byte_identical"]
+    committed = ev["elastic_committed"]["survivor"]
+    assert len(committed) == len(set(committed)) == 7  # exactly once
+    assert set(ev["elastic_stolen"]) == set(ev["elastic_recovered"])
+    assert set(ev["elastic_stolen"]) <= set(committed)

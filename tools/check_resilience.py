@@ -4,6 +4,7 @@
 Usage::
 
     python tools/check_resilience.py [--workdir DIR] [--seed N] [--keep]
+                                     [--elastic-only]
 
 Injects one fault of every class (read error, truncated file,
 first-attempt flake, NaN burst, slow read, HANGING read) over a
@@ -15,9 +16,19 @@ hard-deadline cancel triaged ``hang``/``rejected``), the destriped map
 byte-identical to the clean run with the faulted units zero-weighted,
 quarantine skip/re-admit behaving across runs, and every cancelled
 hang landing within ``hard deadline + grace`` — the watchdog contract
-is exercised on every run. Prints one JSON evidence line; non-zero
-exit (with the broken criterion named) on any failure. Also wired into
-CI as ``bench.py --config resilience``.
+is exercised on every run.
+
+``--elastic-only`` runs criterion 7 instead: the rank-kill/rank-pause
+elastic-campaign drill (``run_elastic_drill`` — three real worker
+processes; one SIGKILLed mid-lease, one zombified mid-unit, one
+survivor that steals both leases), asserting exactly-once commits, the
+zombie's late commit fence-rejected, stolen/recovered ledgered, and
+the map byte-identical to a clean run. Kept as a separate CI step
+("Rank-kill drill") because it spawns subprocesses and costs ~20 s.
+
+Prints one JSON evidence line; non-zero exit (with the broken
+criterion named) on any failure. Also wired into CI as ``bench.py
+--config resilience``.
 """
 
 from __future__ import annotations
@@ -40,15 +51,20 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--keep", action="store_true",
                     help="keep the workdir (inspect the ledger/fixtures)")
+    ap.add_argument("--elastic-only", action="store_true",
+                    help="run only criterion 7 (the rank-kill/rank-pause "
+                    "elastic-campaign drill)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from comapreduce_tpu.resilience.drill import run_drill
+    from comapreduce_tpu.resilience.drill import (run_drill,
+                                                  run_elastic_drill)
 
+    drill = run_elastic_drill if args.elastic_only else run_drill
     workdir = args.workdir or tempfile.mkdtemp(prefix="check_resilience_")
     try:
         try:
-            evidence = run_drill(workdir, seed=args.seed)
+            evidence = drill(workdir, seed=args.seed)
         except AssertionError as exc:
             print(json.dumps({"ok": False, "criterion": str(exc)}))
             return 1

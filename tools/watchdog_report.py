@@ -6,18 +6,26 @@ Usage::
     python tools/watchdog_report.py OUTPUT_DIR [--stale-s 60]
                                     [--n-ranks N] [--json]
 
-Reads every ``heartbeat.rank*.json`` and ``quarantine*.jsonl`` in the
-run's output directory and answers the on-call questions in one
-screen: which ranks are alive, where each one is (stage/unit/progress
-counters), how stale each heartbeat is, which operations stalled or
-hung, and which units the run deferred (``rejected``) or durably
-skipped (``quarantined``).
+Reads every ``heartbeat.rank*.json``, ``quarantine*.jsonl``,
+``lease.*.json`` and the ``queue.json`` manifest in the run's state
+directory (the directory given, falling back to ``<dir>/logs`` — the
+default ``[Global] log_dir`` layout) and answers the on-call questions
+in one screen: which ranks are alive, where each one is
+(stage/unit/progress counters), how stale each heartbeat is, which
+operations stalled or hung, which units the run deferred
+(``rejected``) or durably skipped (``quarantined``), and — for
+elastic campaigns (docs/OPERATIONS.md §11) — who holds which lease at
+what generation, how many units are done/claimed/pending, and whether
+any expired lease is sitting unreclaimed.
 
 Exit code: 0 when every expected rank's heartbeat is fresher than
-``--stale-s``; 1 when any rank is stale/missing (so the report doubles
-as a liveness probe in cron/CI). ``--n-ranks`` sets the expected rank
-count (default: the ranks that have heartbeat files — a fully dead
-rank that never wrote one can only be caught with an explicit count).
+``--stale-s`` AND no lease is expired-but-unreclaimed; 1 otherwise
+(so the report doubles as a liveness probe in cron/CI). ``--n-ranks``
+sets the expected rank count (default: the ranks that have heartbeat
+files — a fully dead rank that never wrote one can only be caught
+with an explicit count). ``--stale-s`` doubles as the lease-expiry
+TTL for the report (pass the campaign's ``lease_ttl_s`` to match the
+scheduler's view).
 
 The runbook lives in docs/OPERATIONS.md ("Hangs, deadlines &
 heartbeats").
@@ -35,6 +43,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _resolve_state_dir(output_dir: str) -> str:
+    """The directory actually holding the run state: ``output_dir``
+    itself, else its ``logs/`` child (the default ``[Global] log_dir``
+    routing) when only that one has state files."""
+    import glob as _glob
+
+    def has_state(d: str) -> bool:
+        return any(_glob.glob(os.path.join(d, pat))
+                   for pat in ("heartbeat.rank*.json", "lease.*.json",
+                               "queue.json", "quarantine*.jsonl"))
+
+    logs = os.path.join(output_dir, "logs")
+    if not has_state(output_dir) and os.path.isdir(logs) \
+            and has_state(logs):
+        return logs
+    return output_dir
+
+
 def build_report(output_dir: str, stale_s: float = 60.0,
                  n_ranks: int = 0) -> dict:
     """The report as data (rendering and exit policy live in main)."""
@@ -43,6 +69,7 @@ def build_report(output_dir: str, stale_s: float = 60.0,
     from comapreduce_tpu.resilience.ledger import QuarantineLedger
 
     now = time.time()
+    output_dir = _resolve_state_dir(output_dir)
     beats = read_heartbeats(output_dir)
     expected = range(n_ranks) if n_ranks > 0 else sorted(beats)
     ranks = []
@@ -89,7 +116,9 @@ def build_report(output_dir: str, stale_s: float = 60.0,
                    "disposition": e.disposition}
             (stalls if e.disposition == "stalled" else hangs).append(row)
 
+    queue, leases = _queue_report(output_dir, beats, stale_s, now)
     return {
+        "schema": 2,
         "output_dir": output_dir,
         "stale_s": stale_s,
         "ranks": ranks,
@@ -97,9 +126,74 @@ def build_report(output_dir: str, stale_s: float = 60.0,
         "ledger_files": [os.path.basename(p) for p in ledgers],
         "ledger_summary": summary,
         "n_ledger_events": len(entries),
+        "n_stolen": sum(1 for e in entries
+                        if e.disposition == "stolen"),
         "stalls": stalls[-20:],
         "hangs": hangs[-20:],
+        "queue": queue,
+        "leases": leases,
+        "n_expired_leases": sum(1 for l in leases if l["expired"]),
     }
+
+
+def _queue_report(state_dir: str, beats: dict, stale_s: float,
+                  now: float) -> tuple:
+    """Elastic-campaign state: the ``queue.json`` manifest summary and
+    one row per ``lease.*.json``. ``expired`` marks a lease whose
+    owner shows no live heartbeat within ``stale_s`` yet which no
+    survivor has reclaimed — the signal that a campaign is wedged
+    (no rank left to steal)."""
+    import glob as _glob
+
+    from comapreduce_tpu.resilience.heartbeat import heartbeat_age_s
+    from comapreduce_tpu.resilience.lease import read_lease
+
+    leases = []
+    for p in sorted(_glob.glob(os.path.join(state_dir, "lease.*.json"))):
+        try:
+            age = now - os.stat(p).st_mtime
+        except OSError:
+            continue  # vanished mid-scan (a commit or steal in flight)
+        st = read_lease(p)
+        if st is None:
+            # torn lease: no valid owner to be alive — reclaimable
+            # (and 'expired' for the probe) once past the TTL
+            leases.append({"key": os.path.basename(p), "state": "torn",
+                           "owner": None, "generation": None,
+                           "age_s": round(age, 1),
+                           "expired": age > stale_s})
+            continue
+        row = {"key": st.get("key", os.path.basename(p)),
+               "state": st.get("state", "?"),
+               "owner": st.get("owner"),
+               "generation": st.get("generation"),
+               "stolen_from": st.get("stolen_from"),
+               "done_by": st.get("done_by"),
+               "age_s": round(age, 1), "expired": False}
+        if row["state"] == "claimed" and age > stale_s:
+            hb = beats.get(int(st.get("owner", -1)))
+            row["expired"] = (hb is None or
+                              not 0.0 <= heartbeat_age_s(hb, now)
+                              <= stale_s)
+        leases.append(row)
+
+    queue = None
+    qpath = os.path.join(state_dir, "queue.json")
+    try:
+        with open(qpath, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = None
+    if manifest is not None or leases:
+        n_files = len((manifest or {}).get("files", [])) or len(leases)
+        n_done = sum(1 for l in leases if l["state"] == "done")
+        n_claimed = sum(1 for l in leases if l["state"] == "claimed")
+        queue = {"n_files": n_files, "n_done": n_done,
+                 "n_claimed": n_claimed,
+                 "n_pending": max(n_files - len(leases), 0),
+                 "n_torn": sum(1 for l in leases
+                               if l["state"] == "torn")}
+    return queue, leases
 
 
 def render_text(rep: dict) -> str:
@@ -127,6 +221,36 @@ def render_text(rep: dict) -> str:
                          f"{dl.get('state')} after "
                          f"{dl.get('elapsed_s')} s")
     lines.append("")
+    if rep.get("queue"):
+        q = rep["queue"]
+        lines.append(
+            f"queue: {q['n_files']} unit(s) — {q['n_done']} done, "
+            f"{q['n_claimed']} claimed, {q['n_pending']} pending"
+            + (f", {q['n_torn']} torn" if q["n_torn"] else "")
+            + (f", {rep['n_stolen']} steal(s) ledgered"
+               if rep.get("n_stolen") else ""))
+        held: dict = {}
+        for l in rep["leases"]:
+            if l["state"] == "claimed":
+                held.setdefault(l["owner"], []).append(l)
+        for owner in sorted(held, key=lambda o: (o is None, o)):
+            rows = held[owner]
+            lines.append(f"  rank {owner}: {len(rows)} held lease(s)")
+            for l in rows:
+                flag = "  EXPIRED (unreclaimed)" if l["expired"] else ""
+                lines.append(f"    {l['key']}  gen {l['generation']}  "
+                             f"age {l['age_s']:.1f} s{flag}")
+        torn = [l for l in rep["leases"] if l["state"] == "torn"]
+        for l in torn:
+            flag = "  EXPIRED (unreclaimed)" if l["expired"] else ""
+            lines.append(f"  TORN lease {l['key']}  "
+                         f"age {l['age_s']:.1f} s{flag}")
+        if rep.get("n_expired_leases"):
+            lines.append(
+                f"  {rep['n_expired_leases']} expired lease(s) with no "
+                "survivor reclaiming them — the campaign is wedged "
+                "(docs/OPERATIONS.md §11: start a rank, it will steal)")
+        lines.append("")
     if rep["ledger_summary"]:
         lines.append(f"ledger ({', '.join(rep['ledger_files'])}): " +
                      ", ".join(f"{k}: {v}" for k, v in
@@ -162,7 +286,9 @@ def main(argv=None) -> int:
     rep = build_report(args.output_dir, stale_s=args.stale_s,
                        n_ranks=args.n_ranks)
     print(json.dumps(rep) if args.json else render_text(rep))
-    return 1 if rep["n_stale"] else 0
+    # an expired-but-unreclaimed lease means work nobody will finish:
+    # probe-fail it like a stale rank
+    return 1 if rep["n_stale"] or rep["n_expired_leases"] else 0
 
 
 if __name__ == "__main__":
