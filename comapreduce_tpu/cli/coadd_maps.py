@@ -5,6 +5,13 @@ Usage::
     python -m comapreduce_tpu.cli.coadd_maps OUTPUT.fits RANK1.fits ...
     python -m comapreduce_tpu.cli.coadd_maps OUTPUT.fits --glob \
         'maps/co2_band0_rank*.fits'
+    python -m comapreduce_tpu.cli.coadd_maps OUTPUT.fits \
+        serving/epochs/epoch-000004 other-field/epochs
+
+An input that is a serving EPOCH (an ``epoch-NNNNNN`` dir, a
+``manifest.json``, or an epochs root — the root resolves ``current``)
+expands to the map products its manifest lists: "co-add everything in
+epoch N" without globbing, and immune to a concurrent publish.
 
 Role parity: the reference's in-MPI map Allreduce
 (``MapMaking/Destriper.py:61-75``) — here an offline inverse-variance

@@ -73,30 +73,24 @@ def main(argv=None) -> int:
     if n_ranks > 1:
         res = runner._resilience_runtime()
         if res.lease_ttl_s > 0:
-            # elastic campaign (docs/OPERATIONS.md §11): no barrier, no
-            # degraded_shard — Runner claims files under leases, dead
+            # elastic campaign (docs/OPERATIONS.md §11) — the DEFAULT:
+            # no barrier needed; Runner claims files under leases, dead
             # ranks' leases expire and survivors steal them, and a rank
             # joining late simply starts claiming
             pass
         elif res.straggler_timeout_s > 0 and res.heartbeat is not None:
-            # legacy static shard: pre-shard straggler barrier — don't
-            # start a campaign shard against ranks that are already
-            # dead; ledger their shards as rejected (re-attempted next
-            # run) and continue degraded
-            from comapreduce_tpu.parallel.multihost import (
-                degraded_shard, straggler_barrier)
+            # static shard (lease_ttl_s = 0 opt-out): the pre-shard
+            # straggler barrier names ranks that are already dead —
+            # advisory only; a dead rank's shard waits for the next
+            # launch (elastic claiming would have finished it this run)
+            from comapreduce_tpu.parallel.multihost import \
+                straggler_barrier
 
             res.heartbeat.start()
-            alive, dead = straggler_barrier(
+            straggler_barrier(
                 runner.state_dir or runner.output_dir, rank, n_ranks,
                 timeout_s=res.straggler_timeout_s,
                 heartbeat=res.heartbeat)
-            if dead:
-                # Runner.run_tod re-derives this rank's own shard; the
-                # barrier's job here is ledgering the dead ranks'
-                # shards as rejected (lowest alive rank writes)
-                degraded_shard(_read_filelist(glob["filelist"]), rank,
-                               n_ranks, dead, alive, ledger=res.ledger)
     figure_dir = figure_dir or str(glob.get("figure_dir", ""))
     if figure_dir:
         # per-obsid QA figures (reference: VaneCalibration.py:173-190,

@@ -624,7 +624,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
 def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
                             offset_length=50, n_iter=100,
                             threshold=1e-6, watchdog=None, unit="",
-                            **kw):
+                            x0=None, precond_tag="", **kw):
     """:func:`solve_band` in durable checkpoint/resume chunks
     (``[Destriper] checkpoint_every``, docs/OPERATIONS.md §11).
 
@@ -641,6 +641,14 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
     preconditioner-id mismatch) is discarded and the solve starts cold.
     The snapshot is deleted once the solve completes — it protects a
     solve in flight, not a finished map.
+
+    ``x0`` is an INITIAL warm start (the map server hands the previous
+    epoch's offsets here) used only when no snapshot resumes — a
+    snapshot is always further along. ``precond_tag`` is appended to
+    the preconditioner id; callers whose linear system changes in ways
+    the built-in id cannot see (the serving census, which grows while
+    keeping ``trimmed_sample_count``-compatible shapes) bake their own
+    discriminator in so a stale snapshot refuses to load.
 
     Falls back to one plain un-checkpointed ``solve_band`` when
     ``checkpoint_every <= 0`` or on the sharded/ground paths (no
@@ -659,7 +667,7 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
                 chunk)
         return solve_band(data, offset_length=offset_length,
                           n_iter=n_iter, threshold=threshold,
-                          watchdog=watchdog, unit=unit, **kw)
+                          watchdog=watchdog, unit=unit, x0=x0, **kw)
     # the snapshot is only valid against the SAME linear system and
     # preconditioner: bake the solve configuration and the trimmed
     # sample count into an id the loader refuses to cross
@@ -668,8 +676,11 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
         kw.get("precond", "jacobi"), int(kw.get("coarse_block", 0) or 0),
         int(mg.get("block", 0) or 0), offset_length, threshold,
         (int(data.tod.size) // offset_length) * offset_length))
+    if precond_tag:
+        precond_id = f"{precond_id}|{precond_tag}"
     snap = load_solver_checkpoint(checkpoint_path, precond_id=precond_id)
-    x0, done, residuals = None, 0, []
+    x0 = None if x0 is None else np.asarray(x0, np.float32)
+    done, residuals = 0, []
     if snap is not None:
         x0 = np.asarray(snap["offsets"])
         done = int(snap["n_done"])
@@ -1031,8 +1042,10 @@ def main(argv=None) -> int:
     from comapreduce_tpu.resilience import ResilienceConfig
 
     # coerce, not from_mapping: a typo'd knob in the dedicated section
-    # must raise, not silently run with the default
-    res_cfg = ResilienceConfig.coerce(dict(ini.get("Resilience", {})))
+    # must raise, not silently run with the default; campaign surface,
+    # so elastic claiming defaults ON (lease_ttl_s = 0 opts out)
+    res_cfg = ResilienceConfig.coerce_campaign(
+        dict(ini.get("Resilience", {})))
     if retry_quarantined:
         import dataclasses
 
@@ -1073,7 +1086,16 @@ def main(argv=None) -> int:
         # clean run over the same files.
         from comapreduce_tpu.pipeline.scheduler import Scheduler
 
-        sched = Scheduler(list(filelist), state_dir, rank=rank,
+        # leases live in a destriper-owned SUBDIRECTORY: the reduction
+        # campaign's leases in state_dir share the same basenames, and
+        # a server tailing state_dir for committed Level-2 units must
+        # never mistake a destriper commit for a reduction commit (and
+        # the destriper must never see the reduction's done leases as
+        # its own finished work). Heartbeats stay in state_dir — rank
+        # liveness is one signal for the whole run
+        sched = Scheduler(list(filelist),
+                          os.path.join(state_dir, "destriper"),
+                          heartbeat_dir=state_dir, rank=rank,
                           n_ranks=n_ranks,
                           lease_ttl_s=res_cfg.lease_ttl_s,
                           steal_after_s=res_cfg.steal_after_s,
@@ -1084,17 +1106,17 @@ def main(argv=None) -> int:
     elif n_ranks > 1:
         if resilience.straggler_timeout_s > 0 \
                 and resilience.heartbeat is not None:
-            from comapreduce_tpu.parallel.multihost import (
-                degraded_shard, straggler_barrier)
+            from comapreduce_tpu.parallel.multihost import \
+                straggler_barrier
 
-            alive, dead = straggler_barrier(
+            # advisory only on the static-shard path: dead ranks are
+            # named in the log; their shards wait for the next launch
+            # (elastic claiming, the default, finishes them this run)
+            straggler_barrier(
                 state_dir, rank, n_ranks,
                 timeout_s=resilience.straggler_timeout_s,
                 heartbeat=resilience.heartbeat)
-            filelist = degraded_shard(filelist, rank, n_ranks, dead,
-                                      alive, ledger=resilience.ledger)
-        else:
-            filelist = filelist[rank::n_ranks]
+        filelist = filelist[rank::n_ranks]
 
     if checkpoint_every > 0 and (sharded or use_ground):
         # solve_band has no x0 warm start on these paths — a "resumed"

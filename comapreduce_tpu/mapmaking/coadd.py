@@ -10,9 +10,17 @@ equivalent: inverse-variance co-addition of the rank maps —
 
 — for both the WCS FITS layout and the partial-sky HEALPix layout
 (ranks may cover different pixel sets; the union is taken).
+
+Inputs may also name a serving EPOCH (an ``epoch-NNNNNN`` directory, a
+``manifest.json`` path, or an epochs root — resolved through the
+``current`` pointer): the manifest's file census, not a glob, decides
+which map products co-add (:func:`epoch_map_inputs`), so "co-add
+everything in epoch N" cannot race a concurrent publish.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -22,7 +30,7 @@ from comapreduce_tpu.mapmaking.fits_io import (read_fits_image,
 from comapreduce_tpu.mapmaking.healpix import nside2npix
 from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
 
-__all__ = ["coadd_maps", "coadd_fits_files"]
+__all__ = ["coadd_maps", "coadd_fits_files", "epoch_map_inputs"]
 
 _WEIGHTED = ("DESTRIPED", "NAIVE")   # weight-averaged products
 _SUMMED = ("WEIGHTS", "HITS")        # additive products
@@ -59,9 +67,62 @@ def coadd_maps(rank_maps: list[dict]) -> dict:
     return out
 
 
+def epoch_map_inputs(path: str, band: int | None = None) -> list[str]:
+    """Map product paths named by a serving epoch's manifest.
+
+    ``path`` may be an ``epoch-NNNNNN`` directory, a direct
+    ``manifest.json`` path, or an epochs ROOT — the latter resolves
+    through the ``current`` pointer (falling back to the newest
+    complete epoch), so "co-add the currently-served maps" needs no
+    epoch number. ``band`` filters to one band's products. Raises
+    ``ValueError`` when no complete epoch is found — an epoch without
+    a readable manifest is not a co-addable fact.
+    """
+    from comapreduce_tpu.serving.epochs import (EpochStore,
+                                                read_epoch_manifest)
+
+    p = str(path)
+    man = read_epoch_manifest(p)
+    if man is None and os.path.isdir(p):
+        store = EpochStore(p)
+        n = store.current()
+        if n is None:
+            n = store.latest()
+        if n is not None:
+            p = store.epoch_dir(n)
+            man = store.manifest(n)
+    if man is None:
+        raise ValueError(f"coadd: {path} is not a complete epoch "
+                         "(no readable manifest.json)")
+    d = p if os.path.isdir(p) else os.path.dirname(p)
+    maps = [str(m) for m in man.get("maps", [])]
+    if band is not None:
+        maps = [m for m in maps if f"band{int(band)}" in m]
+    if not maps:
+        raise ValueError(f"coadd: epoch manifest at {d} lists no map "
+                         f"products" + (f" for band {band}"
+                                        if band is not None else ""))
+    return [os.path.join(d, m) for m in maps]
+
+
+def _expand_inputs(inputs: list[str]) -> list[str]:
+    """Resolve epoch references (dirs / manifest paths) among plain
+    FITS inputs to the manifest-listed map products."""
+    out: list[str] = []
+    for p in inputs:
+        if os.path.isdir(p) or os.path.basename(p) == "manifest.json":
+            out.extend(epoch_map_inputs(p))
+        else:
+            out.append(p)
+    return out
+
+
 def coadd_fits_files(inputs: list[str], output: str) -> dict:
     """Co-add rank map FILES (all WCS or all partial-HEALPix) into
-    ``output``. Returns the co-added maps dict."""
+    ``output``; epoch directories / manifests among ``inputs`` expand
+    to their manifest's map products (:func:`epoch_map_inputs`).
+    Returns the co-added maps dict."""
+    inputs = _expand_inputs(list(inputs))
     if not inputs:
         raise ValueError("coadd_fits_files: no inputs")
     # one parse per file; layout detected from the parsed headers so a

@@ -133,13 +133,14 @@ def straggler_barrier(heartbeat_dir: str, rank: int, n_ranks: int,
     ticker period (warned below when it does not).
 
     Ranks still unchanged at ``timeout_s`` are declared DEAD and the
-    caller enters degraded mode (:func:`degraded_shard`) instead of
-    deadlocking a collective against a rank that will never arrive.
-    The barrier is advisory and read-only: it never blocks a healthy
-    single-rank run (``n_ranks <= 1`` returns immediately) and a rank
-    declared dead by mistake (a paused VM resuming late) costs one
-    run's shard — ledgered ``rejected``, re-attempted next run — not
-    the campaign.
+    caller continues with its own static shard instead of deadlocking
+    a collective against a rank that will never arrive (elastic
+    claiming — the campaign default — makes this barrier unnecessary:
+    survivors steal a dead rank's leases and finish its files in the
+    same run). The barrier is advisory and read-only: it never blocks
+    a healthy single-rank run (``n_ranks <= 1`` returns immediately)
+    and a rank declared dead by mistake (a paused VM resuming late)
+    costs nothing — the verdict is a log line, not a ledger entry.
     """
     from comapreduce_tpu.resilience.heartbeat import read_heartbeats
 
@@ -183,45 +184,36 @@ def straggler_barrier(heartbeat_dir: str, rank: int, n_ranks: int,
         logger.warning(
             "straggler barrier: rank(s) %s missed the barrier within "
             "%.1f s (heartbeats in %s missing or stale); continuing "
-            "DEGRADED — their filelist shards will be ledgered as "
-            "rejected and re-attempted next run", dead, timeout_s,
-            heartbeat_dir)
+            "DEGRADED — their static shards wait for the next launch "
+            "(elastic claiming, the campaign default, would finish "
+            "them this run)", dead, timeout_s, heartbeat_dir)
     return sorted(alive | {rank}), dead
 
 
 def degraded_shard(filelist, rank: int, n_ranks: int, dead,
                    alive, ledger=None) -> list:
-    """DEPRECATED — this rank's round-robin shard under degraded mode.
+    """DEPRECATED shim — returns this rank's static round-robin shard.
 
-    The ledger-and-abandon path: a dead rank's files are merely
-    recorded ``hang``/``rejected`` (by the LOWEST alive rank — one
-    writer, no duplicate entries) and LOST until a manual re-run,
-    while every survivor keeps its unchanged ``i % n_ranks == r``
-    shard. Elastic campaigns supersede it: with ``[resilience]
-    lease_ttl_s > 0`` the scheduler (``pipeline.scheduler``) lets
-    survivors STEAL a dead rank's files under heartbeat-fenced leases
-    and complete the campaign in the same run. This shim keeps the
-    legacy static-shard path working and will be removed once elastic
-    claiming is the default.
+    The ledger-and-abandon path it used to implement (the lowest alive
+    rank recording every dead rank's file ``hang``/``rejected``) is
+    RETIRED: elastic claiming is now the campaign default
+    (``ResilienceConfig.coerce_campaign`` — ``pipeline.scheduler``
+    lets survivors steal a dead rank's files under heartbeat-fenced
+    leases and finish the campaign in the same run), so abandoning a
+    shard to the ledger no longer has a caller. The shim keeps the
+    signature one more release for external callers of the legacy
+    static-shard recipe; ``dead``/``alive``/``ledger`` are accepted
+    and ignored.
     """
+    del dead, alive, ledger  # retired ledger-and-abandon inputs
     warnings.warn(
-        "degraded_shard (ledger-and-abandon) is deprecated: set "
-        "[resilience] lease_ttl_s > 0 so surviving ranks steal a dead "
-        "rank's files this run (pipeline.scheduler) instead of "
-        "abandoning them to the ledger — docs/OPERATIONS.md §11",
+        "degraded_shard is a deprecated no-op shim returning the "
+        "static rank::n_ranks shard: elastic claiming ([resilience] "
+        "lease_ttl_s > 0, now the campaign default) finishes a dead "
+        "rank's files in the same run instead of abandoning them to "
+        "the ledger — docs/OPERATIONS.md §11",
         DeprecationWarning, stacklevel=2)
-    files = list(filelist)
-    dead = sorted(set(dead))
-    alive = sorted(set(alive))
-    if dead and ledger is not None and alive and rank == alive[0]:
-        for r in dead:
-            for f in files[r::n_ranks]:
-                ledger.record(
-                    f, failure_class="hang", disposition="rejected",
-                    stage="multihost.straggler",
-                    message=f"rank {r} missed the straggler barrier; "
-                            f"shard deferred to the next run")
-    return files[rank::n_ranks]
+    return list(filelist)[rank::n_ranks]
 
 
 def rank_info() -> tuple[int, int]:

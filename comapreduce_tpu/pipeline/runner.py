@@ -619,15 +619,26 @@ class Runner:
         stage = ApplyCalibration(
             calibrator_filelist=tuple(calibrator_level2),
             cache_path=cache_path)
+        # the apply pass re-walks the SAME filelist whose reduction
+        # leases are already committed in state_dir — under elastic
+        # claiming a sub-run scheduler would see every unit "done
+        # elsewhere" and apply calibration to nothing, so this pass
+        # always uses the static rank::n_ranks shard (the Level-2
+        # stores exist for every file regardless of which rank reduced
+        # it); the ledger/heartbeat/chaos objects stay shared in-place
+        res = self._resilience_runtime()
+        if res.lease_ttl_s > 0:
+            import dataclasses
+
+            res = dataclasses.replace(res, lease_ttl_s=0.0)
         sub = Runner(processes=[stage], output_dir=self.output_dir,
                      prefix=self.prefix, rank=self.rank,
                      n_ranks=self.n_ranks, timings=self.timings,
                      ingest=self.ingest, resilience=self.resilience,
                      _ingest_cache=self._ingest_cache,
-                     _resilience=self._resilience)
+                     _resilience=res)
         results = sub.run_tod(filelist)
         self._ingest_cache = sub._ingest_cache  # share warm cache back
-        self._resilience = sub._resilience      # ... and the ledger
         return results
 
     # -- config-driven construction ----------------------------------------
@@ -673,7 +684,10 @@ class Runner:
                                  os.path.join(output_dir, "logs")),
                    rank=rank, n_ranks=n_ranks,
                    ingest=IngestConfig.coerce(config.get("ingest")),
-                   resilience=ResilienceConfig.coerce(
+                   # campaign surface: elastic claiming is the DEFAULT
+                   # here — [resilience] lease_ttl_s = 0 opts back into
+                   # the static rank::n_ranks shard (OPERATIONS.md §11)
+                   resilience=ResilienceConfig.coerce_campaign(
                        config.get("resilience")),
                    campaign=CampaignConfig.coerce(
                        config.get("campaign")))
@@ -702,8 +716,10 @@ class Runner:
                    ingest=IngestConfig.from_mapping(inputs),
                    # coerce, not from_mapping: [Resilience]/[Campaign]
                    # are DEDICATED sections, so a typo'd knob must
-                   # raise instead of silently running with the default
-                   resilience=ResilienceConfig.coerce(
+                   # raise instead of silently running with the
+                   # default; campaign surface, so elastic claiming
+                   # defaults ON (lease_ttl_s = 0 opts out)
+                   resilience=ResilienceConfig.coerce_campaign(
                        dict(ini.get("Resilience", {}))),
                    campaign=CampaignConfig.coerce(
                        dict(ini.get("Campaign", {}))))

@@ -154,6 +154,14 @@ class Scheduler:
             if lease.stolen_from is not None:
                 self.stats["recovered"] += 1
                 self._ledger_recovered(filename, lease)
+            # wake any map server tailing this campaign (best effort —
+            # the done lease is the durable fact, this is only latency)
+            try:
+                from comapreduce_tpu.serving.watcher import announce_commit
+
+                announce_commit(self.state_dir, filename)
+            except Exception:  # pragma: no cover - advisory only
+                pass
         else:
             self.stats["fence_rejects"] += 1
         return ok

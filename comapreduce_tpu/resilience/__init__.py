@@ -56,6 +56,7 @@ INI ``[Resilience]`` section) -> :meth:`ResilienceConfig.make_runtime`
 
 from comapreduce_tpu.resilience.chaos import ChaosMonkey  # noqa: F401
 from comapreduce_tpu.resilience.config import (  # noqa: F401
+    DEFAULT_LEASE_TTL_S,
     Resilience,
     ResilienceConfig,
 )

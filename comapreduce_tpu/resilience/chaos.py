@@ -46,6 +46,16 @@ list of ``kind[@substr][:rate]`` with rate in [0, 1] (default 1);
   (``Heartbeat.pause``) when a matching file is claimed, but the rank
   keeps running and will try to commit late. The drill asserts the
   stolen-and-redone unit's generation fence rejects that commit.
+- ``late_file``   — a matching file's ARRIVAL is delayed: the serving
+  drill/bench replay asks :meth:`ChaosMonkey.arrival_delay` for each
+  file's extra commit latency, so freshness metrics and the incremental
+  fold see a straggler (``slow_s`` seconds; deterministic by seed).
+- ``kill_mid_publish`` — the epoch-publication ``rank_kill``: SIGKILL
+  to self between writing an epoch's temp dir and the atomic rename
+  (``serving.epochs.EpochStore.publish``). The drill asserts the
+  ``current`` pointer still resolves to a COMPLETE epoch and that a
+  restarted server republishes the lost epoch. Matches on the epoch
+  name (``@epoch-000002`` aims it), fires at most once per monkey.
 
 Whether a given file draws a given fault depends only on
 ``(seed, kind, basename)`` — stable across runs, across iteration
@@ -68,7 +78,7 @@ logger = logging.getLogger("comapreduce_tpu")
 
 CHAOS_KINDS = ("read_error", "truncate", "flaky", "nan_burst",
                "slow_read", "hang", "write_stall", "rank_kill",
-               "rank_pause")
+               "rank_pause", "late_file", "kill_mid_publish")
 
 # TOD datasets a NaN burst can poison, by payload schema
 _POISON_KEYS = ("spectrometer/tod", "averaged_tod/tod",
@@ -175,6 +185,34 @@ class ChaosMonkey:
         logger.warning("chaos: rank_pause — freezing heartbeat at "
                        "claim of %s (zombie mode)", filename)
         return True
+
+    def arrival_delay(self, filename: str) -> float:
+        """Extra seconds before ``filename``'s commit becomes visible
+        (kind ``late_file``) — the serving drill/bench replay adds this
+        to its arrival schedule so the incremental fold and the
+        freshness metrics see a straggler. 0.0 when the kind does not
+        fire; deterministic by ``(seed, kind, basename)``."""
+        if "late_file" not in self.decide(filename):
+            return 0.0
+        self._note(filename, "late_file")
+        return self.slow_s
+
+    def maybe_kill_publish(self, epoch: str) -> None:
+        """SIGKILL the whole process (kind ``kill_mid_publish``) —
+        called by ``EpochStore.publish`` after the temp epoch dir is
+        fully written and fsynced but BEFORE the atomic rename, the
+        widest window a crashing publisher can leave garbage in. At
+        most once per monkey (a real kill never returns)."""
+        if "kill_mid_publish" not in self.decide(epoch):
+            return
+        with self._lock:
+            if any(k == "kill_mid_publish" for _, k in self.injected):
+                return
+            self.injected.append((epoch, "kill_mid_publish"))
+        logger.warning("chaos: kill_mid_publish — SIGKILLing pid %d "
+                       "before the rename of %s", os.getpid(), epoch)
+        os.kill(os.getpid(), 9)  # signal.SIGKILL; never returns
+        time.sleep(60.0)  # pathological platform: at least stall
 
     def stall_write(self, path: str) -> None:
         """Block a writeback commit for ``path`` (kind ``write_stall``)

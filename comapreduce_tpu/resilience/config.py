@@ -10,7 +10,7 @@ monkey) that ``Runner``/``read_comap_data`` actually consume.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 from comapreduce_tpu.resilience.chaos import (ChaosMonkey,
                                               parse_inject_spec)
@@ -19,7 +19,16 @@ from comapreduce_tpu.resilience.ledger import QuarantineLedger
 from comapreduce_tpu.resilience.retry import RetryPolicy
 from comapreduce_tpu.resilience.watchdog import Watchdog, parse_deadlines
 
-__all__ = ["ResilienceConfig", "Resilience"]
+__all__ = ["ResilienceConfig", "Resilience", "DEFAULT_LEASE_TTL_S"]
+
+#: campaign-surface default lease TTL (seconds): the config entry
+#: points (``Runner.from_config`` / ``from_legacy_config``, the
+#: destriper CLI) turn elastic claiming ON at this TTL when the config
+#: does not mention ``lease_ttl_s`` itself (docs/OPERATIONS.md §11).
+#: An explicit ``lease_ttl_s = 0`` opts back into static shards. The
+#: DATACLASS default stays 0 so programmatic ``ResilienceConfig(...)``
+#: construction keeps the static-shard behaviour it always had.
+DEFAULT_LEASE_TTL_S = 60.0
 
 
 @dataclass(frozen=True)
@@ -75,9 +84,12 @@ class ResilienceConfig:
         static ``rank::n_ranks`` shard with lease-based claiming —
         each rank claims files under a heartbeat-fenced lease, and a
         lease whose owner's heartbeat is older than this TTL is
-        stealable by any survivor. 0 (default) keeps the static shard.
-        Requires ``heartbeat_s > 0`` (the TTL is judged against the
-        owner's heartbeat file).
+        stealable by any survivor. 0 (the dataclass default) keeps the
+        static shard — but the campaign config ENTRY POINTS default to
+        ``DEFAULT_LEASE_TTL_S`` when the config does not set this knob
+        (:meth:`coerce_campaign`); write ``lease_ttl_s = 0`` to opt
+        back into static shards. Requires ``heartbeat_s > 0`` (the TTL
+        is judged against the owner's heartbeat file).
     steal_after_s:
         Minimum age of the lease FILE itself before it may be stolen
         (a freshly-claimed lease whose owner has not beaten yet must
@@ -196,6 +208,27 @@ class ResilienceConfig:
                     f"unknown resilience keys: {sorted(unknown)}")
             return cls(**known)
         raise TypeError(f"cannot build ResilienceConfig from {type(value)}")
+
+    @classmethod
+    def coerce_campaign(cls, value) -> "ResilienceConfig":
+        """:meth:`coerce` plus the campaign-entry-point default:
+        elastic claiming ON (``lease_ttl_s = DEFAULT_LEASE_TTL_S``)
+        when the config mapping does not mention ``lease_ttl_s``.
+
+        An explicit ``lease_ttl_s = 0`` keeps the static
+        ``rank::n_ranks`` shard, and the default also stays off when
+        heartbeats are disabled — lease expiry is judged against the
+        owner's heartbeat file, so elastic claiming without heartbeats
+        could never fence a dead rank. An already-built
+        ``ResilienceConfig`` passes through untouched (programmatic
+        construction chose its own value)."""
+        mentioned = (isinstance(value, cls)
+                     or (isinstance(value, dict)
+                         and "lease_ttl_s" in value))
+        cfg = cls.coerce(value)
+        if not mentioned and cfg.lease_ttl_s <= 0 and cfg.heartbeat_s > 0:
+            cfg = replace(cfg, lease_ttl_s=DEFAULT_LEASE_TTL_S)
+        return cfg
 
     def ledger_path(self, output_dir: str = ".", rank: int = 0,
                     n_ranks: int = 1) -> str:

@@ -39,6 +39,19 @@ This is the executable form of the resilience layer's contract
    generation fence, and the map over the committed set is
    byte-identical to a clean run over the same filelist.
 
+8. incremental map serving (ISSUE 9, ``run_serving_drill``): a
+   ``serving.MapServer`` folds committed waves into versioned epochs.
+   Asserts: every committed file lands in EXACTLY one epoch's
+   ``new_files`` (exactly-once folding); a ``kill_mid_publish``
+   SIGKILL never moves ``current`` off a complete epoch; a killed and
+   resumed server's epochs are byte-identical to an uninterrupted
+   twin's (map FITS and offsets compared byte-for-byte); a cold
+   one-shot serving epoch is byte-identical to a batch
+   read+solve over the same census (incremental assembly parity); and
+   the warm-started final epoch needs STRICTLY fewer CG iterations
+   than the cold one-shot while agreeing with it modulo the offset
+   null mode (a global constant — docs/OPERATIONS.md §12).
+
 Everything is deterministic by seed (chaos decisions, jitter, synthetic
 data), so a CI failure reproduces locally bit-for-bit. (Deadline
 checks bound wall time from ABOVE only — cancels must not be late;
@@ -53,25 +66,53 @@ import time
 
 import numpy as np
 
-__all__ = ["run_drill", "run_elastic_drill"]
+__all__ = ["run_drill", "run_elastic_drill", "run_serving_drill"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
 
-def _write_level2(path: str, seed: int, F: int = 2, T: int = 600) -> None:
+def _write_level2(path: str, seed: int, F: int = 2, T: int = 600,
+                  drift: float = 0.0, rw: float = 0.0,
+                  raster: bool = False) -> None:
     """Minimal single-band Level-2 store the destriper reader accepts
-    (same schema as the pipeline's checkpoint output)."""
+    (same schema as the pipeline's checkpoint output).
+
+    ``drift`` adds slow per-feed sinusoids and ``rw`` a random walk —
+    the 1/f structure destriping exists to remove, which the SERVING
+    drill needs so a warm-started epoch has real offset structure to
+    reuse (white noise has none and warm starts save nothing).
+    ``raster`` swaps the random per-sample pointing for a smooth
+    boustrophedon sweep (scan-like pixel coupling)."""
     from comapreduce_tpu.data.hdf5io import HDF5Store
 
     rng = np.random.default_rng(seed)
     store = HDF5Store(name="l2")
+    t = np.arange(T)
     tod = (rng.normal(size=(F, 1, T))
-           + np.sin(np.arange(T) / 37.0)).astype(np.float32)
+           + np.sin(t / 37.0)).astype(np.float32)
+    if drift:
+        for f in range(F):
+            ph = rng.uniform(0.0, 2.0 * np.pi, size=3)
+            tod[f, 0] += drift * (
+                np.sin(2 * np.pi * t / 401.0 + ph[0])
+                + 0.5 * np.sin(2 * np.pi * t / 173.0 + ph[1])
+                + 0.25 * np.sin(2 * np.pi * t / 83.0 + ph[2])
+            ).astype(np.float32)
+    if rw:
+        tod += (rw * np.cumsum(rng.normal(size=(F, 1, T)),
+                               axis=-1)).astype(np.float32)
     store["averaged_tod/tod"] = tod
     store["averaged_tod/weights"] = np.ones((F, 1, T), np.float32)
     store["averaged_tod/scan_edges"] = np.array([[0, T]], np.int64)
-    ra = 170.0 + 0.5 * rng.random((F, T))
-    dec = 52.0 + 0.5 * rng.random((F, T))
+    if raster:
+        ph = rng.uniform(0.0, 2.0 * np.pi, size=(F, 1))
+        ra = (170.0 + 0.25 * (1 + np.sin(2 * np.pi * t / 97.0 + ph))
+              ) * np.ones((F, T))
+        dec = (52.0 + 0.5 * ((t[None, :] / T + rng.random((F, 1)))
+                             % 1.0)) * np.ones((F, T))
+    else:
+        ra = 170.0 + 0.5 * rng.random((F, T))
+        dec = 52.0 + 0.5 * rng.random((F, T))
     store["spectrometer/pixel_pointing/pixel_ra"] = ra
     store["spectrometer/pixel_pointing/pixel_dec"] = dec
     store["spectrometer/pixel_pointing/pixel_az"] = ra
@@ -617,7 +658,267 @@ def _elastic_worker_main(argv=None) -> int:
     return 0
 
 
+def _commit_done(state_dir: str, files) -> None:
+    """Mark ``files`` committed in ``state_dir``'s lease layout — the
+    drill's stand-in for a campaign's reduce+commit of each unit."""
+    from comapreduce_tpu.resilience.lease import LeaseBoard
+
+    board = LeaseBoard(state_dir, rank=0, lease_ttl_s=60.0)
+    for f in files:
+        lease = board.claim(f)
+        assert lease is not None, f"drill setup: could not claim {f}"
+        assert board.commit(lease), f"drill setup: could not commit {f}"
+
+
+def _epoch_products(epochs_dir: str, n: int) -> dict:
+    """Byte-compare material for epoch ``n``: raw map FITS bytes plus
+    the published offsets vector."""
+    from comapreduce_tpu.serving.epochs import EpochStore
+    from comapreduce_tpu.serving.server import load_epoch_offsets
+
+    d = EpochStore(epochs_dir).epoch_dir(n)
+    with open(os.path.join(d, "map_band0.fits"), "rb") as f:
+        fits = f.read()
+    off = load_epoch_offsets(os.path.join(d, "solver_band0.npz"))
+    return {"fits": fits, "offsets": off["offsets"]}
+
+
+def _read_epoch_map(epochs_dir: str, n: int, name: str = "DESTRIPED"):
+    from comapreduce_tpu.mapmaking.fits_io import read_fits_image
+    from comapreduce_tpu.serving.epochs import EpochStore
+
+    d = EpochStore(epochs_dir).epoch_dir(n)
+    for hname, _, arr in read_fits_image(os.path.join(d,
+                                                      "map_band0.fits")):
+        if hname.upper() == name:
+            return np.asarray(arr)
+    raise AssertionError(f"epoch {n} map has no {name} HDU")
+
+
+def run_serving_drill(workdir: str, seed: int = 0, n_files: int = 8,
+                      timeout_s: float = 300.0) -> dict:
+    """Criterion 8: the incremental map server, with REAL processes for
+    the mid-publish SIGKILL (docstring item 8 for the full contract).
+
+    Three waves of committed files drive four server invocations
+    (``python -m comapreduce_tpu.resilience.drill --serving``, one
+    epoch attempt each):
+
+    - wave 1 (``n_files - 2`` files) publishes ``epoch-000001``
+      cleanly;
+    - wave 2 (1 file) is solved but the publisher draws
+      ``kill_mid_publish`` — SIGKILLed after writing its temp epoch
+      dir, before the atomic rename;
+    - wave 3 (1 file) resumes the server: temp garbage is swept and
+      all pending files publish as ``epoch-000002``.
+
+    An uninterrupted TWIN run over the same waves and a COLD one-shot
+    run over the full census provide the byte-identity references.
+    The fixtures carry drift + random-walk noise over raster pointing
+    (``_write_level2``) so offsets have real 1/f structure — that is
+    what makes the warm-started epoch's CG converge in strictly fewer
+    iterations than the cold one-shot.
+    """
+    import json
+    import shutil
+    import subprocess
+    import sys
+
+    from comapreduce_tpu.cli.run_destriper import solve_band
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.serving.epochs import EpochStore
+    from comapreduce_tpu.serving.ledger import ServedLedger
+
+    t0 = time.perf_counter()
+    os.makedirs(workdir, exist_ok=True)
+    files = []
+    for i in range(n_files):
+        path = os.path.join(workdir, f"Level2_serving-{i:04d}.hd5")
+        if not os.path.exists(path):
+            _write_level2(path, seed=1000 + seed * 10 + i,
+                          drift=6.0, rw=0.3, raster=True)
+        files.append(os.path.abspath(path))
+    names = sorted(os.path.basename(f) for f in files)
+    wave1, wave2, wave3 = files[:-2], files[-2:-1], files[-1:]
+
+    dirs = {k: os.path.join(workdir, f"serving-{k}")
+            for k in ("state", "epochs", "twin-state", "twin",
+                      "cold-epochs")}
+    for d in dirs.values():
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run_server(state_dir, epochs_dir, chaos=""):
+        cmd = [sys.executable, "-m",
+               "comapreduce_tpu.resilience.drill", "--serving",
+               f"--state-dir={state_dir}", f"--epochs-dir={epochs_dir}",
+               f"--seed={seed}"]
+        if chaos:
+            cmd.append(f"--chaos={chaos}")
+        pr = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, timeout=timeout_s)
+        return pr.returncode, (pr.stdout or b"").decode(errors="replace")
+
+    # ---- the drilled run: publish, die mid-publish, resume ----
+    _commit_done(dirs["state"], wave1)
+    rc, out = run_server(dirs["state"], dirs["epochs"])
+    assert rc == 0, f"criterion 8: epoch-1 publish failed ({rc}):\n{out}"
+    store = EpochStore(dirs["epochs"])
+    assert store.current() == 1 and \
+        store.census(1) == {os.path.basename(f) for f in wave1}, \
+        f"criterion 8: epoch-1 wrong: current={store.current()} " \
+        f"census={store.census(1)}"
+
+    _commit_done(dirs["state"], wave2)
+    rc, out = run_server(dirs["state"], dirs["epochs"],
+                         chaos="kill_mid_publish@epoch-000002")
+    assert rc == -9, \
+        f"criterion 8: mid-publish rank exited {rc}, expected SIGKILL " \
+        f"(-9):\n{out}"
+    # the reader-facing promise: a publisher SIGKILLed mid-publish
+    # leaves `current` on a COMPLETE epoch and no epoch-2 directory
+    assert store.current() == 1 and store.manifest(1) is not None, \
+        f"criterion 8: current torn after mid-publish kill: " \
+        f"{store.current()}"
+    assert store.latest() == 1 and not os.path.isdir(store.epoch_dir(2)), \
+        "criterion 8: a half-published epoch-2 is visible"
+    tmp_left = [x for x in os.listdir(dirs["epochs"])
+                if x.startswith(".tmp-epoch.")]
+    assert tmp_left, \
+        "criterion 8: kill_mid_publish fired after the rename " \
+        "(drill aimed it before)"
+
+    _commit_done(dirs["state"], wave3)
+    rc, out = run_server(dirs["state"], dirs["epochs"])
+    assert rc == 0, f"criterion 8: resume failed ({rc}):\n{out}"
+    assert store.current() == 2 and store.census(2) == set(names), \
+        f"criterion 8: resumed epoch wrong: current={store.current()} " \
+        f"census={store.census(2)}"
+    assert not [x for x in os.listdir(dirs["epochs"])
+                if x.startswith(".tmp-epoch.")], \
+        "criterion 8: resume left dead .tmp-epoch.* garbage"
+
+    # exactly-once folding: the epochs' new_files partition the census,
+    # and the admission ledger holds each file exactly once
+    folded = []
+    for n in store.list_epochs():
+        folded += list(store.manifest(n).get("new_files", []))
+    assert sorted(folded) == names, \
+        f"criterion 8: files folded {sorted(folded)} != committed " \
+        f"{names} (lost or double-folded)"
+    ledger = ServedLedger(os.path.join(dirs["epochs"], "served.jsonl"))
+    assert sorted(ledger.files) == names and len(ledger) == len(names), \
+        f"criterion 8: admission ledger {sorted(ledger.files)} != " \
+        f"{names}"
+
+    # ---- the uninterrupted twin: same waves, no chaos ----
+    _commit_done(dirs["twin-state"], wave1)
+    rc, out = run_server(dirs["twin-state"], dirs["twin"])
+    assert rc == 0, f"criterion 8: twin epoch-1 failed ({rc}):\n{out}"
+    _commit_done(dirs["twin-state"], wave2 + wave3)
+    rc, out = run_server(dirs["twin-state"], dirs["twin"])
+    assert rc == 0, f"criterion 8: twin epoch-2 failed ({rc}):\n{out}"
+    for n in (1, 2):
+        got = _epoch_products(dirs["epochs"], n)
+        want = _epoch_products(dirs["twin"], n)
+        assert got["fits"] == want["fits"] and \
+            np.array_equal(got["offsets"], want["offsets"]), \
+            f"criterion 8: killed+resumed epoch-{n} differs from the " \
+            f"uninterrupted twin's"
+
+    # ---- cold one-shot over the full census: assembly parity ----
+    rc, out = run_server(dirs["state"], dirs["cold-epochs"])
+    assert rc == 0, f"criterion 8: cold one-shot failed ({rc}):\n{out}"
+    cold_store = EpochStore(dirs["cold-epochs"])
+    assert cold_store.current() == 1 and \
+        cold_store.census(1) == set(names), \
+        "criterion 8: cold one-shot census wrong"
+    wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
+    batch_data = read_comap_data(sorted(files), band=0, wcs=wcs,
+                                 offset_length=50, medfilt_window=201,
+                                 use_calibration=False)
+    batch = solve_band(batch_data, offset_length=50, n_iter=300,
+                       threshold=1e-8)
+    batch_map = np.asarray(batch.destriped_map).reshape(64, 64)
+    cold_map = _read_epoch_map(dirs["cold-epochs"], 1)
+    parity = bool(np.array_equal(cold_map, batch_map, equal_nan=True))
+    assert parity, \
+        "criterion 8: cold serving epoch != batch read+solve over the " \
+        "same census (incremental assembly broke parity)"
+
+    # ---- warm vs cold: fewer iterations, equal modulo the null mode
+    warm_cg = store.manifest(2)["cg"]
+    cold_cg = cold_store.manifest(1)["cg"]
+    assert warm_cg["x0"] == "epoch-000001" and cold_cg["x0"] == "cold", \
+        f"criterion 8: warm-start provenance wrong: {warm_cg} {cold_cg}"
+    assert warm_cg["n_iter"] < cold_cg["n_iter"], \
+        f"criterion 8: warm epoch used {warm_cg['n_iter']} CG " \
+        f"iterations, cold used {cold_cg['n_iter']} — warm start " \
+        f"saved nothing"
+    warm_map = _read_epoch_map(dirs["epochs"], 2)
+    wmap = _read_epoch_map(dirs["epochs"], 2, "WEIGHTS")
+    hit = wmap > 0
+    diff = warm_map[hit] - cold_map[hit]
+    null_mode = float(np.sum(diff * wmap[hit]) / np.sum(wmap[hit]))
+    resid = float(np.max(np.abs(diff - null_mode)))
+    assert resid < 1e-4, \
+        f"criterion 8: warm and cold maps disagree beyond the null " \
+        f"mode (max {resid:.2e} after removing the {null_mode:.2e} " \
+        f"constant)"
+
+    return {
+        "serving_epochs": store.list_epochs(),
+        "serving_folded": sorted(folded),
+        "serving_kill_rc": -9,
+        "serving_twin_byte_identical": True,
+        "serving_cold_parity": parity,
+        "serving_warm_iters": int(warm_cg["n_iter"]),
+        "serving_cold_iters": int(cold_cg["n_iter"]),
+        "serving_null_mode_resid": resid,
+        "serving_freshness_s": float(
+            store.manifest(2).get("freshness_s", 0.0)),
+        "serving_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def _serving_worker_main(argv=None) -> int:
+    """One serving-drill server invocation (``python -m ... --serving``):
+    build a ``MapServer`` over the shared state dir and attempt exactly
+    one epoch (``poll_once(force=True)``) — resume recovery
+    (tmp sweep + orphan adoption) runs in the constructor, so a
+    restarted invocation IS the resumed server."""
+    import argparse
+
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.resilience.chaos import ChaosMonkey
+    from comapreduce_tpu.serving.server import MapServer
+
+    p = argparse.ArgumentParser(prog="drill-serving-worker")
+    p.add_argument("--state-dir", required=True)
+    p.add_argument("--epochs-dir", required=True)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chaos", default="")
+    p.add_argument("--no-warm-start", action="store_true")
+    a = p.parse_args(argv)
+    wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
+    monkey = ChaosMonkey(a.chaos, seed=a.seed) if a.chaos else None
+    server = MapServer(
+        a.state_dir, a.epochs_dir, wcs=wcs, band=0, offset_length=50,
+        n_iter=300, threshold=1e-8, medfilt_window=201,
+        use_calibration=False, warm_start=not a.no_warm_start,
+        chaos=monkey)
+    n = server.poll_once(force=True)
+    print(f"serving-worker: published {n}")
+    return 0
+
+
 if __name__ == "__main__":
     import sys as _sys
 
-    raise SystemExit(_elastic_worker_main(_sys.argv[1:]))
+    _argv = _sys.argv[1:]
+    if "--serving" in _argv:
+        _argv.remove("--serving")
+        raise SystemExit(_serving_worker_main(_argv))
+    raise SystemExit(_elastic_worker_main(_argv))

@@ -1,0 +1,54 @@
+"""Incremental map serving: a long-lived coadd/destripe server.
+
+The serving tier for "heavy traffic from millions of users"
+(docs/OPERATIONS.md §12): a server process tails the campaign's
+committed Level-2 outputs — the PR 8 lease/commit layout under
+``[Global] log_dir`` is the shared source of truth about what is done —
+folds freshly-reduced files into the running destriper solution, and
+publishes each solve as an immutable, versioned **map epoch** behind an
+atomically-swapped ``current`` pointer. Readers never see a torn map,
+can pin any epoch, and pay a file read — never a CG solve — per
+request.
+
+Layers (each importable without jax until a solve actually runs):
+
+- :mod:`~comapreduce_tpu.serving.ledger` — the durable ``served.jsonl``
+  admission ledger (a file folds into the census exactly once).
+- :mod:`~comapreduce_tpu.serving.watcher` — tails ``lease.*.json`` done
+  markers + the scheduler's ``commits.jsonl`` announce stream.
+- :mod:`~comapreduce_tpu.serving.epochs` — the versioned epoch store:
+  immutable ``epoch-NNNNNN/`` directories published by atomic rename,
+  a ``current`` symlink swap, strict census-growth fencing against
+  zombie servers, and operator rollback.
+- :mod:`~comapreduce_tpu.serving.server` — :class:`MapServer`: the
+  incremental solver state (campaign ``PixelSpace`` union + per-file
+  aggregates, warm-started CG from the previous epoch's offsets) and
+  the serve loop.
+"""
+
+from comapreduce_tpu.serving.epochs import (CURRENT_FILE, CURRENT_LINK,
+                                            MANIFEST, EpochFenceError,
+                                            EpochStore, epoch_name,
+                                            parse_epoch_name,
+                                            read_epoch_manifest)
+from comapreduce_tpu.serving.ledger import SERVED_LEDGER, ServedLedger
+from comapreduce_tpu.serving.watcher import (ANNOUNCE_LOG, CommitWatcher,
+                                             announce_commit,
+                                             scan_committed)
+
+__all__ = [
+    "EpochFenceError", "EpochStore", "epoch_name", "parse_epoch_name",
+    "read_epoch_manifest", "MANIFEST", "CURRENT_LINK", "CURRENT_FILE",
+    "ServedLedger", "SERVED_LEDGER",
+    "scan_committed", "announce_commit", "CommitWatcher", "ANNOUNCE_LOG",
+]
+
+
+def __getattr__(name):
+    # MapServer pulls in the mapmaking/solver stack; keep the package
+    # import light for status tools by resolving it lazily
+    if name in ("MapServer", "load_epoch_offsets", "STATS_JSON"):
+        from comapreduce_tpu.serving import server
+
+        return getattr(server, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,307 @@
+"""Versioned map epochs: immutable publishes behind an atomic pointer.
+
+An *epoch* is one published solve: a directory ``epoch-NNNNNN/``
+holding the maps, the solver state the next epoch warm-starts from,
+and a ``manifest.json`` (file census, CG iterations, residual,
+freshness timestamps). Epochs are immutable once published and readers
+resolve them through a ``current`` pointer, so:
+
+- a reader never sees a torn map — the epoch directory is fully
+  written and fsynced under a dot-prefixed temp name, then renamed
+  into place in one atomic step, and ``current`` is swapped by atomic
+  rename too (``data/durable.py`` discipline throughout);
+- a reader can PIN an epoch (resolve ``current`` once, keep using that
+  directory) while newer epochs publish;
+- an operator can roll the read path back to any complete epoch
+  without touching history (:meth:`EpochStore.rollback`).
+
+Zombie fencing mirrors the lease generation fence (OPERATIONS.md §11):
+a publish must STRICTLY GROW the census of the newest complete epoch.
+A stale server that resumes after a newer epoch published solves an
+old census, fails the fence and raises :class:`EpochFenceError` — its
+late result is discarded, exactly like a zombie rank's late lease
+commit. The rename itself is the race arbiter: directory renames onto
+an existing non-empty target fail, so two servers publishing the same
+epoch number get one winner and one re-fence.
+
+``current`` is a relative symlink swapped via ``os.replace``; a
+durable ``CURRENT`` pointer file is written alongside as the fallback
+for platforms/filesystems without symlinks (readers try the symlink
+first). This module imports no jax and no mapmaking code — status
+tools stay instant.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import tempfile
+import time
+
+from comapreduce_tpu.data.durable import (_fsync_dir, durable_replace,
+                                          fsync_path)
+
+__all__ = ["EpochStore", "EpochFenceError", "read_epoch_manifest",
+           "MANIFEST", "CURRENT_LINK", "CURRENT_FILE", "epoch_name"]
+
+logger = logging.getLogger(__name__)
+
+MANIFEST = "manifest.json"
+CURRENT_LINK = "current"
+CURRENT_FILE = "CURRENT"
+_EPOCH_RE = re.compile(r"^epoch-(\d{6,})$")
+
+
+class EpochFenceError(RuntimeError):
+    """A publish lost the census fence: this server is stale (a newer
+    epoch already covers at least this census). The caller must
+    discard its solve and rescan — never retry the publish."""
+
+
+def epoch_name(n: int) -> str:
+    return f"epoch-{int(n):06d}"
+
+
+def parse_epoch_name(name: str) -> int | None:
+    m = _EPOCH_RE.match(os.path.basename(str(name).rstrip("/")))
+    return int(m.group(1)) if m else None
+
+
+def read_epoch_manifest(path: str) -> dict | None:
+    """Manifest of an epoch dir (or a direct manifest.json path);
+    None when absent/torn — an epoch without a readable manifest is
+    not a publishable fact."""
+    p = str(path)
+    if os.path.isdir(p):
+        p = os.path.join(p, MANIFEST)
+    try:
+        with open(p, encoding="utf-8") as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(man, dict) or int(man.get("schema", 0)) != 1:
+        return None
+    return man
+
+
+class EpochStore:
+    """The epochs root: list/read/publish/rollback (module docstring)."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+
+    def epoch_dir(self, n: int) -> str:
+        return os.path.join(self.root, epoch_name(n))
+
+    def manifest(self, n: int) -> dict | None:
+        return read_epoch_manifest(self.epoch_dir(n))
+
+    # -- queries ----------------------------------------------------------
+
+    def list_epochs(self) -> list[int]:
+        """Complete (manifest-bearing) epoch numbers, ascending."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            n = parse_epoch_name(name)
+            if n is not None and self.manifest(n) is not None:
+                out.append(n)
+        return sorted(out)
+
+    def latest(self) -> int | None:
+        """Newest COMPLETE epoch — the fence baseline (a publisher
+        killed between its epoch rename and the ``current`` swap
+        leaves an orphan newer than ``current``; fencing against
+        ``current`` alone would let a zombie republish over it)."""
+        eps = self.list_epochs()
+        return eps[-1] if eps else None
+
+    def current(self) -> int | None:
+        """The epoch ``current`` resolves to (symlink first, pointer
+        file fallback); None when unset or dangling."""
+        link = os.path.join(self.root, CURRENT_LINK)
+        name = ""
+        try:
+            name = os.path.basename(os.readlink(link))
+        except OSError:
+            try:
+                with open(os.path.join(self.root, CURRENT_FILE),
+                          encoding="utf-8") as f:
+                    name = f.read().strip()
+            except OSError:
+                return None
+        n = parse_epoch_name(name)
+        if n is None or self.manifest(n) is None:
+            return None
+        return n
+
+    def current_dir(self) -> str | None:
+        n = self.current()
+        return self.epoch_dir(n) if n is not None else None
+
+    def census(self, n: int | None) -> set:
+        if n is None:
+            return set()
+        man = self.manifest(n)
+        return set(man.get("census", [])) if man else set()
+
+    # -- publication ------------------------------------------------------
+
+    def publish(self, census, write_products, meta: dict | None = None,
+                chaos=None) -> int:
+        """Publish one epoch; returns its number.
+
+        ``census``: the file basenames this solve covers (manifest
+        ``census`` field, sorted). ``write_products(tmpdir) -> dict``
+        writes the maps/solver state into the (temporary) epoch dir and
+        returns manifest extras (product names, CG metrics). ``meta``
+        merges into the manifest last.
+
+        Order of operations — each step leaves a recoverable state
+        under SIGKILL: products + manifest are written and fsynced
+        under ``.tmp-epoch.*`` (invisible to readers and to
+        :meth:`list_epochs`); the census fence is checked against the
+        newest complete epoch; the temp dir renames to
+        ``epoch-NNNNNN`` (atomic; collision = lost race = re-fence);
+        the root fsyncs; ``current`` swaps. A kill before the rename
+        leaves only a temp dir (:meth:`cleanup_tmp`); a kill after it
+        leaves an orphan epoch that :meth:`adopt_latest` rolls forward
+        to — ``current`` points at a complete epoch at every instant.
+
+        ``chaos`` (a ``resilience.ChaosMonkey``) injects the
+        ``kill_mid_publish`` drill fault: SIGKILL between writing the
+        temp dir and the rename.
+        """
+        census = sorted(str(c) for c in census)
+        latest = self.latest()
+        n = (latest if latest is not None else 0) + 1
+        tmp = tempfile.mkdtemp(prefix=".tmp-epoch.", dir=self.root)
+        try:
+            extras = write_products(tmp) or {}
+            while True:
+                # fence BEFORE the manifest write so the manifest bakes
+                # the final epoch number
+                fenced = self.census(latest)
+                if not set(census) > fenced:
+                    raise EpochFenceError(
+                        f"stale publish: census of {len(census)} "
+                        f"file(s) does not strictly grow epoch "
+                        f"{latest}'s {len(fenced)} — a newer epoch "
+                        f"already covers this solve")
+                man = {"schema": 1, "epoch": n, "census": census,
+                       "n_files": len(census),
+                       "t_publish_unix": float(time.time())}
+                man.update(extras)
+                if meta:
+                    man.update(meta)
+                mtmp = os.path.join(tmp, MANIFEST + ".tmp")
+                with open(mtmp, "w", encoding="utf-8") as f:
+                    json.dump(man, f, sort_keys=True, indent=1)
+                durable_replace(mtmp, os.path.join(tmp, MANIFEST))
+                for name in os.listdir(tmp):
+                    p = os.path.join(tmp, name)
+                    if os.path.isfile(p):
+                        fsync_path(p)
+                _fsync_dir(tmp)
+                if chaos is not None and \
+                        chaos.maybe_kill_publish(epoch_name(n)):
+                    pass  # pragma: no cover - the kill does not return
+                try:
+                    os.rename(tmp, self.epoch_dir(n))
+                except OSError:
+                    # lost the rename race: someone published this
+                    # number first — re-read the fence baseline and
+                    # either reject or take the next number
+                    latest = self.latest()
+                    n = (latest if latest is not None else 0) + 1
+                    continue
+                tmp = ""
+                break
+        finally:
+            if tmp:
+                self._rmtree(tmp)
+        _fsync_dir(self.root)
+        self.set_current(n)
+        logger.info("published %s (%d files) in %s", epoch_name(n),
+                    len(census), self.root)
+        return n
+
+    def set_current(self, n: int, force: bool = False) -> None:
+        """Swap ``current`` to epoch ``n`` (atomic; readers see the old
+        or the new target, never neither). Backwards moves need
+        ``force`` (rollback) — a zombie's late swap must not regress
+        the read path."""
+        if self.manifest(n) is None:
+            raise ValueError(f"epoch {n} is not complete in {self.root}")
+        cur = self.current()
+        if cur is not None and n < cur and not force:
+            raise EpochFenceError(
+                f"current is {epoch_name(cur)}; refusing a backwards "
+                f"swap to {epoch_name(n)} (use rollback)")
+        name = epoch_name(n)
+        link = os.path.join(self.root, CURRENT_LINK)
+        tmp = os.path.join(self.root, f".{CURRENT_LINK}.tmp{os.getpid()}")
+        try:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            os.symlink(name, tmp)
+            os.replace(tmp, link)
+        except OSError:  # no symlinks here: the pointer file is primary
+            logger.debug("symlink swap unavailable in %s; pointer file "
+                         "only", self.root)
+        # durable pointer file: the fallback reader AND the fsync that
+        # makes the swap crash-durable
+        ptmp = os.path.join(self.root, f".{CURRENT_FILE}.tmp{os.getpid()}")
+        with open(ptmp, "w", encoding="utf-8") as f:
+            f.write(name + "\n")
+        durable_replace(ptmp, os.path.join(self.root, CURRENT_FILE))
+
+    def rollback(self, n: int) -> None:
+        """Point the read path at an older complete epoch. History is
+        untouched: the next publish still numbers after the newest
+        complete epoch and must strictly grow ITS census."""
+        self.set_current(n, force=True)
+
+    # -- recovery ---------------------------------------------------------
+
+    def adopt_latest(self) -> int | None:
+        """Roll ``current`` forward to the newest complete epoch (a
+        publisher killed between rename and swap left it orphaned).
+        Returns the adopted epoch, or None when nothing to do."""
+        latest = self.latest()
+        if latest is None or self.current() == latest:
+            return None
+        self.set_current(latest)
+        logger.info("adopted orphan %s (publisher died before the "
+                    "current swap)", epoch_name(latest))
+        return latest
+
+    def cleanup_tmp(self) -> int:
+        """Remove dead ``.tmp-epoch.*`` dirs (publisher killed before
+        its rename); returns how many were removed."""
+        n = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for name in names:
+            if name.startswith(".tmp-epoch."):
+                self._rmtree(os.path.join(self.root, name))
+                n += 1
+        return n
+
+    @staticmethod
+    def _rmtree(path: str) -> None:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)

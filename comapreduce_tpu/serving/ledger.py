@@ -1,0 +1,133 @@
+"""The ``served.jsonl`` admission ledger: exactly-once folding.
+
+The map server must fold each committed file into the census exactly
+once, across restarts and SIGKILLs. Admission is recorded in an
+append-only JSONL ledger in the epochs root — one JSON object per
+line, each append a single ``write`` + fsync, the same single-writer
+durability contract as the quarantine ledger. A SIGKILL mid-append
+leaves at most one torn trailing line, which the loader drops (the
+file was then NOT admitted: it re-admits on the next poll — at-least-
+once appends + first-entry-wins reads give exactly-once admission).
+
+The ledger records *census membership*, not publication: a file may be
+admitted and the server killed before its epoch publishes — the resume
+path re-solves from the ledger census against the last PUBLISHED
+epoch's census, so the file still lands as "new" in exactly one
+published epoch (``server.MapServer``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+__all__ = ["ServedLedger", "SERVED_LEDGER"]
+
+logger = logging.getLogger(__name__)
+
+SERVED_LEDGER = "served.jsonl"
+
+
+class ServedLedger:
+    """Durable exactly-once admission ledger (see module docstring).
+
+    One writer per epochs root — the same contract as every JSONL
+    ledger in the repo (concurrent writers would interleave lines).
+    A second server racing on the same root cannot corrupt maps — the
+    epoch store's census fence rejects its publishes — but it could
+    double-admit; run one server per root.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._seen: dict[str, dict] = {}
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                # torn trailing append (SIGKILL mid-write) — the entry
+                # never happened; the file re-admits on the next poll
+                logger.warning("served ledger %s: dropping one torn "
+                               "line", self.path)
+                continue
+            name = entry.get("file")
+            if name and name not in self._seen:
+                self._seen[name] = entry
+
+    # -- queries ----------------------------------------------------------
+
+    @property
+    def files(self) -> set:
+        """Basenames admitted so far (the census)."""
+        return set(self._seen)
+
+    def path_of(self, name: str) -> str:
+        return str(self._seen[name].get("path", ""))
+
+    def entries(self) -> list[dict]:
+        return list(self._seen.values())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._seen
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+    # -- admission --------------------------------------------------------
+
+    def admit(self, name: str, path: str, t_commit_unix: float = 0.0,
+              now=time.time) -> bool:
+        """Admit one file to the census; False when already admitted.
+
+        The append is durable (fsync) BEFORE True is returned — a
+        crash after admission can only re-solve, never re-admit.
+        ``t_commit_unix`` carries the reduction's done timestamp so
+        per-epoch freshness (publish - commit) is measurable.
+        """
+        if name in self._seen:
+            return False
+        entry = {"schema": 1, "file": str(name), "path": str(path),
+                 "t_commit_unix": float(t_commit_unix or 0.0),
+                 "t_admit_unix": float(now())}
+        payload = (json.dumps(entry, sort_keys=True) + "\n").encode()
+        directory = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(directory, exist_ok=True)
+        # a torn trailing append (SIGKILL mid-write) leaves the file
+        # without a final newline; appending straight onto it would
+        # glue THIS entry to the fragment and lose it on the next
+        # load — heal the tear with a newline first (no race: one
+        # writer per root is the ledger contract)
+        torn = self._tail_is_torn(self.path)
+        fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            os.write(fd, (b"\n" + payload) if torn else payload)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        self._seen[name] = entry
+        return True
+
+    @staticmethod
+    def _tail_is_torn(path: str) -> bool:
+        """True when the file is non-empty and does not end in '\\n'."""
+        try:
+            with open(path, "rb") as f:
+                end = f.seek(0, os.SEEK_END)
+                if end == 0:
+                    return False
+                f.seek(end - 1)
+                return f.read(1) != b"\n"
+        except OSError:
+            return False

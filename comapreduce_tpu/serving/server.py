@@ -1,0 +1,547 @@
+"""The long-lived incremental coadd/destripe server.
+
+:class:`MapServer` folds freshly-committed files into a running
+destriper solution and publishes each solve as a versioned epoch
+(:mod:`~comapreduce_tpu.serving.epochs`). The cost model is the whole
+point:
+
+- **O(new data) assembly.** Every committed file is read ONCE into a
+  per-file aggregate (TOD/weights/azimuth/global pixels, all in its
+  own frame — the read path processes files independently, so per-file
+  reads concatenated in census order are byte-identical to one batch
+  read over the same census). An epoch over N_old + N_new files reuses
+  the N_old cached aggregates and reads only the new files.
+- **Campaign ``PixelSpace`` union.** Each file carries its own
+  seen-pixel dictionary; the epoch's solver space is their
+  ``PixelSpace.union``, and the concatenated global pixel stream is
+  ``remap``-ed into it once per epoch — identical to the dictionary a
+  batch read would build, so compact partial maps stay coadd-able.
+- **Warm-started CG.** The published epoch keeps its offsets vector
+  (per-file slices); the next epoch re-expands that ``x0`` into the
+  grown offset space — old files' slices scatter to their new
+  positions, new files start at zero — and CG pays only the
+  increment's iterations, not a cold re-solve
+  (``solve_band_checkpointed``'s ``x0``, with the solver snapshot
+  keyed by the census digest so a stale snapshot from another census
+  refuses to load).
+
+The warm-started solution equals the cold one only modulo the offset
+null mode (a global constant — OPERATIONS.md §11 empirics); the server
+records each epoch's ``x0`` provenance in the manifest so consumers of
+absolute zero levels can tell. Run ``warm_start=False`` for strictly
+cold epochs (byte-identical to a one-shot solve over the same census).
+
+Restart semantics: admission is exactly-once (``served.jsonl``); a
+killed server re-reads its census once (O(census), steady state stays
+O(new)), re-solves deterministically, and either republishes the
+interrupted epoch or adopts an orphan that already renamed into place.
+A STALE server (resumed after a newer epoch published elsewhere) is
+fence-rejected at publish and rescans.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+
+import numpy as np
+
+from comapreduce_tpu.data.durable import durable_replace
+from comapreduce_tpu.serving.epochs import (EpochFenceError, EpochStore,
+                                            epoch_name)
+from comapreduce_tpu.serving.ledger import SERVED_LEDGER, ServedLedger
+from comapreduce_tpu.serving.watcher import CommitWatcher, scan_committed
+
+__all__ = ["MapServer", "STATS_JSON", "load_epoch_offsets"]
+
+logger = logging.getLogger(__name__)
+
+STATS_JSON = "server.stats.json"
+_OFFSETS = "solver_band{band}.npz"
+_MAP = "map_band{band}.fits"
+
+
+def load_epoch_offsets(path: str) -> dict | None:
+    """Published per-epoch solver state: ``{"offsets": f32[n],
+    "files": [basename...], "n_offsets": i64[n_files]}`` — the next
+    epoch's warm-start source. None when absent/torn/foreign."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            if int(z["schema"]) != 1:
+                return None
+            return {"offsets": np.asarray(z["offsets"], np.float32),
+                    "files": [str(s) for s in z["files"]],
+                    "n_offsets": np.asarray(z["n_offsets"], np.int64)}
+    except Exception as exc:
+        logger.warning("epoch offsets %s unreadable (%s: %s); next "
+                       "epoch starts cold", path, type(exc).__name__, exc)
+        return None
+
+
+class _FileAggregate:
+    """One committed file, read once, in file-local frame."""
+
+    __slots__ = ("name", "path", "tod", "weights", "az", "gids",
+                 "n_groups", "global_pixels", "n_offsets",
+                 "t_commit_unix")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+
+class MapServer:
+    """Incremental coadd/destripe server over one campaign state dir.
+
+    ``state_dir`` is the campaign's lease/commit dir (``[Global]
+    log_dir``); ``epochs_root`` holds the ledger, epochs and stats.
+    Exactly one of ``wcs``/``nside`` picks the pixelisation (same
+    contract as ``read_comap_data``). ``level2_dir`` maps committed
+    Level-1 names to their Level-2 checkpoints (the Runner campaign
+    layout); empty means the lease's ``file`` path IS the servable
+    file (the destriper-campaign and drill layout).
+
+    Solver knobs mirror ``[Inputs]``/``[Destriper]``; the read knobs
+    (``medfilt_window``, ``use_calibration``, ``tod_variant``,
+    ``galactic``) must match what a batch ``make_band_map`` over the
+    same files would use for parity.
+    """
+
+    def __init__(self, state_dir: str, epochs_root: str, *,
+                 wcs=None, nside: int | None = None, band: int = 0,
+                 level2_dir: str = "", level2_prefix: str = "Level2",
+                 offset_length: int = 50, n_iter: int = 100,
+                 threshold: float = 1e-6, precond: str = "jacobi",
+                 coarse_block: int = 0, mg: dict | None = None,
+                 galactic: bool = False, medfilt_window: int = 400,
+                 use_calibration: bool = True, tod_variant: str = "auto",
+                 warm_start: bool = True, checkpoint_every: int = 0,
+                 min_new_files: int = 1, poll_s: float = 2.0,
+                 chaos=None, now=time.time):
+        if (wcs is None) == (nside is None):
+            raise ValueError("pass exactly one of wcs= or nside=")
+        self.state_dir = str(state_dir)
+        self.store = EpochStore(epochs_root)
+        self.ledger = ServedLedger(os.path.join(epochs_root,
+                                                SERVED_LEDGER))
+        self.watchers = CommitWatcher(self.state_dir)
+        self.wcs, self.nside, self.band = wcs, nside, int(band)
+        self.level2_dir = str(level2_dir or "")
+        self.level2_prefix = str(level2_prefix)
+        self.offset_length = int(offset_length)
+        self.n_iter, self.threshold = int(n_iter), float(threshold)
+        self.precond, self.coarse_block = str(precond), int(coarse_block)
+        self.mg = mg
+        self.galactic = bool(galactic)
+        self.medfilt_window = int(medfilt_window)
+        self.use_calibration = bool(use_calibration)
+        self.tod_variant = str(tod_variant)
+        self.warm_start = bool(warm_start)
+        self.checkpoint_every = int(checkpoint_every)
+        self.min_new_files = max(int(min_new_files), 1)
+        self.poll_s = float(poll_s)
+        self.chaos = chaos
+        self.now = now
+        self._agg: dict[str, _FileAggregate] = {}
+        self._missing_warned: set = set()
+        self.stats = self._load_stats()
+        # crash recovery BEFORE the first poll: dead publish temps go,
+        # an orphan epoch (publisher died between rename and swap)
+        # becomes current — readers and the fence baseline agree again
+        self.store.cleanup_tmp()
+        self.store.adopt_latest()
+
+    # -- watch / admit ----------------------------------------------------
+
+    def _resolve_path(self, st: dict) -> str | None:
+        """Done-lease payload -> servable file path; None when the
+        product is not servable (yet). Failed/quarantined units are
+        committed too (doneness means handled, not mapped) — their
+        Level-2 is absent, and admission waits until it exists."""
+        fname = str(st.get("file", ""))
+        if self.level2_dir:
+            from comapreduce_tpu.pipeline.runner import level2_path
+
+            p = level2_path(self.level2_dir, os.path.basename(fname),
+                            self.level2_prefix)
+        else:
+            p = fname
+        if not os.path.exists(p):
+            if p not in self._missing_warned:
+                self._missing_warned.add(p)
+                logger.warning(
+                    "committed unit %s has no servable product at %s "
+                    "(failed/quarantined reduction?) — skipping until "
+                    "it appears", os.path.basename(fname), p)
+            return None
+        self._missing_warned.discard(p)
+        return p
+
+    def admit_new(self) -> list[str]:
+        """Scan the commit layout and admit unseen files (exactly once,
+        durable) to the census; returns the newly-admitted names."""
+        new = []
+        for name, st in sorted(scan_committed(self.state_dir).items()):
+            if name in self.ledger:
+                continue
+            path = self._resolve_path(st)
+            if path is None:
+                continue
+            if self.ledger.admit(name, path,
+                                 t_commit_unix=st.get("t_done_unix", 0.0),
+                                 now=self.now):
+                new.append(name)
+        return new
+
+    def pending(self) -> set:
+        """Admitted files not yet covered by a published epoch."""
+        return self.ledger.files - self.store.census(self.store.latest())
+
+    # -- ingest / assembly ------------------------------------------------
+
+    def _aggregate(self, name: str) -> _FileAggregate:
+        agg = self._agg.get(name)
+        if agg is not None:
+            return agg
+        from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+
+        path = self.ledger.path_of(name)
+        entry = dict(self.ledger._seen.get(name, {}))
+        # per-file read, SAME knobs as a batch read over the census:
+        # the read path treats files independently (per-file median
+        # filter, per-(file,feed) azimuth normalisation, per-scan
+        # offset-multiple truncation), so concatenating per-file
+        # results in census order reproduces the batch read exactly
+        data = read_comap_data(
+            [path], band=self.band, wcs=self.wcs, nside=self.nside,
+            galactic=self.galactic, offset_length=self.offset_length,
+            medfilt_window=self.medfilt_window,
+            use_calibration=self.use_calibration,
+            tod_variant=self.tod_variant,
+            compact=(self.nside is not None))
+        if data.tod.size % self.offset_length:
+            # cannot happen through the scan-truncation contract; if it
+            # ever does, per-file offset slices would bleed across
+            # files and the warm-start expansion would be wrong
+            raise RuntimeError(
+                f"{name}: {data.tod.size} samples is not a multiple of "
+                f"offset_length={self.offset_length}; incremental "
+                f"serving requires offset-aligned files")
+        space = data.pixel_space
+        agg = _FileAggregate(
+            name=name, path=path,
+            tod=np.asarray(data.tod, np.float32),
+            weights=np.asarray(data.weights, np.float32),
+            az=np.asarray(data.az, np.float32),
+            gids=np.asarray(data.ground_ids, np.int32),
+            n_groups=int(data.n_groups),
+            global_pixels=space.to_global(data.pixels),
+            n_offsets=int(data.tod.size) // self.offset_length,
+            t_commit_unix=float(entry.get("t_commit_unix", 0.0) or 0.0))
+        self._agg[name] = agg
+        return agg
+
+    def _assemble(self, census: list[str]):
+        """Concatenate the census's aggregates into one
+        ``DestriperData`` over the union ``PixelSpace``. Returns
+        ``(data, slices)`` with ``slices[name] = (off_start, n_off)``
+        in the epoch's offset vector."""
+        from comapreduce_tpu.mapmaking.healpix import nside2npix
+        from comapreduce_tpu.mapmaking.leveldata import DestriperData
+        from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+
+        aggs = [self._aggregate(n) for n in census]
+        npix_sky = (self.wcs.npix if self.wcs is not None
+                    else nside2npix(self.nside))
+        if self.wcs is not None:
+            space = PixelSpace.dense(npix_sky)
+        else:
+            parts = [PixelSpace.from_pixels(a.global_pixels, npix_sky)
+                     for a in aggs]
+            space = parts[0].union(*parts[1:]) if parts else \
+                PixelSpace.from_dictionary(np.empty(0, np.int64),
+                                           npix_sky)
+        gids, goff = [], 0
+        slices, ooff = {}, 0
+        for a in aggs:
+            gids.append(a.gids + np.int32(goff))
+            goff += a.n_groups
+            slices[a.name] = (ooff, a.n_offsets)
+            ooff += a.n_offsets
+        pixels_global = np.concatenate([a.global_pixels for a in aggs])
+        data = DestriperData(
+            tod=np.concatenate([a.tod for a in aggs]),
+            pixels=space.remap(pixels_global),
+            weights=np.concatenate([a.weights for a in aggs]),
+            ground_ids=np.concatenate(gids),
+            az=np.concatenate([a.az for a in aggs]),
+            n_groups=goff, npix=space.n_solve,
+            wcs=self.wcs, nside=self.nside,
+            sky_pixels=space.pixels, files=[a.path for a in aggs],
+            pixel_space=space)
+        return data, slices
+
+    # -- warm start -------------------------------------------------------
+
+    def _x0_for(self, census: list[str], slices: dict):
+        """Previous epoch's offsets re-expanded into this epoch's
+        offset space: kept files' slices scatter to their (possibly
+        shifted) new positions, new files start at zero. Returns
+        ``(x0 | None, source_label)``."""
+        latest = self.store.latest()
+        if not self.warm_start or latest is None:
+            return None, "cold"
+        prev = load_epoch_offsets(os.path.join(
+            self.store.epoch_dir(latest),
+            _OFFSETS.format(band=self.band)))
+        if prev is None:
+            return None, "cold"
+        n_total = sum(n for _, n in slices.values())
+        x0 = np.zeros(n_total, np.float32)
+        pstart, copied = {}, 0
+        off = 0
+        for name, n in zip(prev["files"], prev["n_offsets"]):
+            pstart[name] = (off, int(n))
+            off += int(n)
+        for name in census:
+            src = pstart.get(name)
+            if src is None:
+                continue
+            (ps, pn), (ds, dn) = src, slices[name]
+            if pn != dn:
+                logger.warning("%s changed offset count %d -> %d since "
+                               "%s; its slice starts cold", name, pn,
+                               dn, epoch_name(latest))
+                continue
+            x0[ds:ds + dn] = prev["offsets"][ps:ps + pn]
+            copied += 1
+        if not copied:
+            return None, "cold"
+        # new files enter the solve already destriped against the
+        # previous epoch's SKY: with the sky held fixed, the optimal
+        # offset is the per-offset weighted mean of (tod - m_prev) —
+        # far closer to the joint solution than zeros, which is where
+        # the warm epoch's CG iteration savings actually come from
+        fresh = [c for c in census if c not in pstart]
+        sky_prev = self._prev_sky(latest) if fresh else None
+        if sky_prev is not None:
+            values, wvals, space = sky_prev
+            L = self.offset_length
+            for name in fresh:
+                a = self._agg[name]
+                ids = space.remap(a.global_pixels)
+                cov = ids < space.n_solve
+                ids = np.clip(ids, 0, max(values.size - 1, 0))
+                cov &= wvals[ids] > 0
+                sky = np.where(cov, values[ids], 0.0)
+                resid = (np.asarray(a.tod, np.float64) - sky) * a.weights
+                wseg = np.asarray(a.weights,
+                                  np.float64).reshape(-1, L).sum(1)
+                seg = resid.reshape(-1, L).sum(1) / np.maximum(wseg,
+                                                               1e-30)
+                ds, dn = slices[name]
+                x0[ds:ds + dn] = seg.astype(np.float32)
+        return x0, epoch_name(latest)
+
+    def _prev_sky(self, n: int):
+        """Epoch ``n``'s published destriped sky as ``(values, weights,
+        space)`` — value and weight per solver id of ``space``. None
+        when the map is unreadable (the warm start then covers only
+        the re-used offset slices)."""
+        from comapreduce_tpu.mapmaking.fits_io import (read_fits_image,
+                                                       read_healpix_map)
+        from comapreduce_tpu.mapmaking.healpix import nside2npix
+        from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+
+        path = os.path.join(self.store.epoch_dir(n),
+                            _MAP.format(band=self.band))
+        try:
+            if self.wcs is not None:
+                hdus = {name.upper(): arr
+                        for name, _, arr in read_fits_image(path)}
+                values = np.asarray(hdus["DESTRIPED"],
+                                    np.float64).ravel()
+                wvals = np.asarray(hdus["WEIGHTS"], np.float64).ravel()
+                space = PixelSpace.dense(self.wcs.npix)
+            else:
+                maps, pixels, nside, _ = read_healpix_map(path)
+                values = np.asarray(maps["DESTRIPED"], np.float64)
+                wvals = np.asarray(maps["WEIGHTS"], np.float64)
+                space = PixelSpace.from_dictionary(
+                    np.asarray(pixels, np.int64), nside2npix(nside))
+        except (OSError, KeyError, ValueError, IndexError) as exc:
+            logger.warning("previous epoch %d map unreadable (%s: %s); "
+                           "new files start from zero offsets", n,
+                           type(exc).__name__, exc)
+            return None
+        return values, wvals, space
+
+    # -- solve / publish --------------------------------------------------
+
+    def _solve(self, data, x0, census: list[str]):
+        from comapreduce_tpu.cli.run_destriper import \
+            solve_band_checkpointed
+
+        digest = hashlib.sha1(
+            ("\n".join(census)).encode()).hexdigest()[:12]
+        ckpt = os.path.join(self.state_dir,
+                            f"solver.serving.band{self.band}.npz")
+        return solve_band_checkpointed(
+            data, ckpt, self.checkpoint_every,
+            offset_length=self.offset_length, n_iter=self.n_iter,
+            threshold=self.threshold, unit=f"serve.band{self.band}",
+            precond=self.precond, coarse_block=self.coarse_block,
+            mg=self.mg, x0=x0, precond_tag=f"census:{digest}")
+
+    def build_epoch(self) -> int | None:
+        """Solve the current census and publish one epoch. None when
+        there is nothing new or the publish was fence-rejected."""
+        prev_census = self.store.census(self.store.latest())
+        census = sorted(self.ledger.files)
+        new_files = sorted(set(census) - prev_census)
+        if not new_files:
+            return None
+        t0 = time.perf_counter()
+        data, slices = self._assemble(census)
+        x0, x0_src = self._x0_for(census, slices)
+        result = self._solve(data, x0, census)
+        t_solve = time.perf_counter() - t0
+        n_iter = int(np.asarray(result.n_iter))
+        residual = float(np.asarray(result.residual))
+        now = float(self.now())
+        commits = [self._agg[n].t_commit_unix for n in new_files
+                   if self._agg[n].t_commit_unix > 0]
+        freshness = max((now - t for t in commits), default=0.0)
+
+        def write_products(tmpdir: str) -> dict:
+            from comapreduce_tpu.cli.run_destriper import band_map_writer
+
+            map_name = _MAP.format(band=self.band)
+            band_map_writer(os.path.join(tmpdir, map_name), data,
+                            result)()
+            off_name = _OFFSETS.format(band=self.band)
+            with open(os.path.join(tmpdir, off_name), "wb") as f:
+                np.savez(f, schema=np.int64(1),
+                         offsets=np.asarray(result.offsets, np.float32),
+                         files=np.array(census),
+                         n_offsets=np.asarray(
+                             [slices[c][1] for c in census], np.int64))
+            return {"band": self.band, "maps": [map_name],
+                    "solver": off_name,
+                    "files": {c: self.ledger.path_of(c) for c in census},
+                    "n_new": len(new_files), "new_files": new_files,
+                    "cg": {"n_iter": n_iter, "residual": residual,
+                           "x0": x0_src,
+                           "diverged": int(np.any(np.asarray(
+                               result.diverged)))},
+                    "t_solve_s": t_solve, "freshness_s": freshness}
+
+        try:
+            n = self.store.publish(census, write_products,
+                                   chaos=self.chaos)
+        except EpochFenceError as exc:
+            # the lease-fence rule, one layer up: a newer epoch already
+            # covers this census — this server was stale; drop the
+            # solve and realign on the next poll
+            logger.warning("epoch publish fence-rejected: %s", exc)
+            self.stats["fence_rejects"] = \
+                self.stats.get("fence_rejects", 0) + 1
+            self._write_stats()
+            return None
+        self.stats["epochs"].append({
+            "epoch": n, "n_files": len(census), "n_new": len(new_files),
+            "n_iter": n_iter, "residual": residual, "x0": x0_src,
+            "t_solve_s": round(t_solve, 3),
+            "freshness_s": round(freshness, 3),
+            "t_publish_unix": now})
+        self._write_stats()
+        return n
+
+    # -- poll / serve loop ------------------------------------------------
+
+    def poll_once(self, force: bool = False) -> int | None:
+        """One watcher tick: admit new commits, solve + publish when at
+        least ``min_new_files`` are pending (``force`` solves any
+        non-empty pending set — the resume/flush path)."""
+        self.admit_new()
+        pending = self.pending()
+        if not pending:
+            return None
+        if len(pending) < self.min_new_files and not force:
+            return None
+        return self.build_epoch()
+
+    def serve(self, max_epochs: int | None = None,
+              idle_exit_s: float | None = None,
+              max_wall_s: float | None = None,
+              sleep=time.sleep) -> int:
+        """The serve loop; returns how many epochs were published.
+
+        Wakes on the scheduler's commit announcements
+        (``commits.jsonl`` growth) and otherwise every ``poll_s``.
+        Exits after ``max_epochs`` publishes, after ``idle_exit_s``
+        without a new commit or publish (None = run forever), or at
+        ``max_wall_s``.
+        """
+        published = 0
+        t_start = time.monotonic()
+        t_active = t_start
+        # resume flush: anything admitted before a crash publishes now
+        n = self.poll_once(force=True)
+        if n is not None:
+            published += 1
+            t_active = time.monotonic()
+        while True:
+            if max_epochs is not None and published >= max_epochs:
+                break
+            if max_wall_s is not None and \
+                    time.monotonic() - t_start >= max_wall_s:
+                break
+            if idle_exit_s is not None and \
+                    time.monotonic() - t_active >= idle_exit_s:
+                break
+            if self.watchers.changed():
+                t_active = time.monotonic()
+                n = self.poll_once(force=True)
+                if n is not None:
+                    published += 1
+                    t_active = time.monotonic()
+                    continue
+            sleep(min(self.poll_s, 0.2))
+        return published
+
+    # -- stats ------------------------------------------------------------
+
+    @property
+    def stats_path(self) -> str:
+        return os.path.join(self.store.root, STATS_JSON)
+
+    def _load_stats(self) -> dict:
+        try:
+            with open(self.stats_path, encoding="utf-8") as f:
+                st = json.load(f)
+            if isinstance(st, dict) and \
+                    isinstance(st.get("epochs"), list):
+                return st
+        except (OSError, ValueError):
+            pass
+        return {"schema": 1, "epochs": [], "fence_rejects": 0}
+
+    def _write_stats(self) -> None:
+        st = dict(self.stats)
+        st["schema"] = 1
+        st["current_epoch"] = self.store.current()
+        st["n_files_served"] = len(self.ledger)
+        st["t_update_unix"] = float(self.now())
+        warm = [e for e in st["epochs"] if e.get("x0") != "cold"]
+        st["warm_epochs"] = len(warm)
+        tmp = self.stats_path + f".tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(st, f, sort_keys=True, indent=1)
+        durable_replace(tmp, self.stats_path)
+        self.stats = st

@@ -1,0 +1,104 @@
+"""Tail the campaign's committed units from the lease/commit layout.
+
+The PR 8 elastic scheduler records every finished unit as a durable
+done marker — ``lease.<key>.json`` with ``state: "done"`` under the
+campaign's state dir (``[Global] log_dir``). Those markers are the ONE
+source of truth about what is reduced: the server scans them
+(:func:`scan_committed`) instead of globbing Level-2 outputs, so
+serving and reduction can never disagree about doneness (a half-
+written Level-2 checkpoint has no done marker yet).
+
+Scanning is cheap but not free at campaign scale, so the scheduler
+also *announces* each commit (:func:`announce_commit`, called from
+``pipeline.scheduler.Scheduler.commit``) by appending one line to
+``commits.jsonl`` in the same dir. The announce stream is a WAKE HINT,
+not a ledger: the server polls its size (:class:`CommitWatcher`) and
+only rescans the lease dir when it moved or the poll interval expires.
+Losing an announcement costs latency, never correctness.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import time
+
+__all__ = ["scan_committed", "announce_commit", "CommitWatcher",
+           "ANNOUNCE_LOG"]
+
+logger = logging.getLogger(__name__)
+
+ANNOUNCE_LOG = "commits.jsonl"
+
+
+def scan_committed(state_dir: str) -> dict[str, dict]:
+    """All committed units: ``{basename: done-lease payload}``.
+
+    Reads every ``lease.*.json`` in ``state_dir`` and keeps the ones in
+    ``state == "done"`` (``resilience.lease`` — claim/steal states are
+    in-flight work, not servable). Torn/mid-write lease files read as
+    None and are skipped; they will parse on a later scan. The payload
+    carries the full committed ``file`` path plus ``done_by`` /
+    ``t_done_unix`` for freshness metrics.
+    """
+    from comapreduce_tpu.resilience.lease import read_lease
+
+    done: dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(state_dir, "lease.*.json"))):
+        st = read_lease(path)
+        if not st or st.get("state") != "done":
+            continue
+        fname = str(st.get("file", "") or "")
+        if not fname:
+            continue
+        done[os.path.basename(fname)] = st
+    return done
+
+
+def announce_commit(state_dir: str, filename: str, now=time.time) -> None:
+    """Append one commit announcement (best effort, never raises).
+
+    Called by the scheduler right after a lease commit passes the
+    generation fence, so a map server sleeping on the announce stream
+    wakes promptly instead of waiting out its poll interval. No fsync
+    — the done lease is already durable and is the source of truth.
+    """
+    try:
+        line = json.dumps({"schema": 1, "file": str(filename),
+                           "t_unix": float(now())}) + "\n"
+        fd = os.open(os.path.join(state_dir, ANNOUNCE_LOG),
+                     os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, line.encode("utf-8"))
+        finally:
+            os.close(fd)
+    except OSError as exc:  # advisory only: never fail a commit over it
+        logger.debug("commit announce skipped (%s)", exc)
+
+
+class CommitWatcher:
+    """Cheap "anything new?" check over the announce stream.
+
+    ``changed()`` is True when ``commits.jsonl`` grew (or appeared)
+    since the last call — the server then rescans the lease dir. The
+    very first call reports True so a fresh server always scans once.
+    """
+
+    def __init__(self, state_dir: str):
+        self.state_dir = str(state_dir)
+        self._size: int | None = None
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.state_dir, ANNOUNCE_LOG)
+
+    def changed(self) -> bool:
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            size = 0
+        moved = self._size is None or size != self._size
+        self._size = size
+        return moved

@@ -499,3 +499,146 @@ def test_sigkill_mid_cg_checkpoint_resumes_from_snapshot(tmp_path):
         assert int(result.n_iter) == 16
     finally:
         rd.solve_band = real
+
+
+# ---------------------------------------------------------------------------
+# serving epochs: kill mid-publish, zombie-epoch fencing (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+_EPOCH_PUBLISHER = r"""
+import os, signal, sys
+from comapreduce_tpu.serving.epochs import EpochStore
+
+store = EpochStore(sys.argv[1])
+
+
+def ok(tmpdir):
+    with open(os.path.join(tmpdir, "map_band0.bin"), "wb") as f:
+        f.write(b"epoch-one")
+    return {"maps": ["map_band0.bin"]}
+
+
+store.publish(["obs-0000.hd5"], ok)
+print("EPOCH1_DONE", flush=True)
+
+
+def kill_mid_write(tmpdir):
+    # products written, manifest/rename still ahead: the SIGKILL lands
+    # with the epoch only existing under its dot-prefixed temp name
+    with open(os.path.join(tmpdir, "map_band0.bin"), "wb") as f:
+        f.write(b"epoch-two")
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+store.publish(["obs-0000.hd5", "obs-0001.hd5"], kill_mid_write)
+"""
+
+
+def test_sigkill_mid_epoch_publish_never_tears_current(tmp_path):
+    """ISSUE 9 satellite: SIGKILL a server mid-epoch-publish. The
+    half-written epoch exists only under ``.tmp-epoch.*`` (invisible
+    to readers), ``current`` still resolves to the last complete
+    epoch, and recovery (``cleanup_tmp`` + ``adopt_latest`` — what a
+    restarting ``MapServer`` runs) sweeps the garbage and republishes
+    cleanly."""
+    from comapreduce_tpu.serving.epochs import EpochStore
+
+    root = str(tmp_path / "epochs")
+    worker = tmp_path / "worker.py"
+    worker.write_text(_EPOCH_PUBLISHER)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON")}
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": _REPO})
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen([sys.executable, str(worker), root], env=env,
+                         stdout=subprocess.PIPE, text=True)
+    line = p.stdout.readline()
+    assert "EPOCH1_DONE" in line, line
+    assert p.wait(timeout=30) == -signal.SIGKILL
+
+    store = EpochStore(root)
+    # the torn publish is invisible: current and latest are epoch 1,
+    # complete, with the half-written epoch 2 only a temp dir
+    assert store.current() == 1 and store.latest() == 1
+    assert store.census(1) == {"obs-0000.hd5"}
+    garbage = [n for n in os.listdir(root)
+               if n.startswith(".tmp-epoch.")]
+    assert garbage, "the killed publish should leave a temp dir"
+    assert not os.path.isdir(store.epoch_dir(2))
+
+    # restart recovery (MapServer.__init__ order): sweep temps, adopt
+    # orphans (none here), then the resumed solve republishes
+    assert store.cleanup_tmp() == len(garbage)
+    assert store.adopt_latest() is None
+    assert not any(n.startswith(".tmp-epoch.")
+                   for n in os.listdir(root))
+
+    def products(tmpdir):
+        with open(os.path.join(tmpdir, "map_band0.bin"), "wb") as f:
+            f.write(b"epoch-two-redone")
+        return {"maps": ["map_band0.bin"]}
+
+    assert store.publish(["obs-0000.hd5", "obs-0001.hd5"],
+                         products) == 2
+    assert store.current() == 2
+    assert store.census(2) == {"obs-0000.hd5", "obs-0001.hd5"}
+
+    # the OTHER kill window — after the epoch rename, before the
+    # current swap — leaves a complete orphan epoch; adopt_latest
+    # rolls the read path forward to it on restart
+    orphan = store.epoch_dir(3)
+    os.makedirs(orphan)
+    with open(os.path.join(orphan, "manifest.json"), "w") as f:
+        import json
+
+        json.dump({"schema": 1, "epoch": 3,
+                   "census": ["obs-0000.hd5", "obs-0001.hd5",
+                              "obs-0002.hd5"], "n_files": 3,
+                   "t_publish_unix": 0.0}, f)
+    assert store.current() == 2 and store.latest() == 3
+    assert store.adopt_latest() == 3
+    assert store.current() == 3
+
+
+def test_zombie_epoch_publish_fence_rejected(tmp_path):
+    """ISSUE 9 satellite, mirroring the PR 8 lease generation fence: a
+    stale server that resumes after a newer epoch published must be
+    fence-rejected — its census does not STRICTLY grow the newest
+    complete epoch's (equal is stale too), and the rejection leaves no
+    partial state behind. Rollback moves only the read path: the
+    fence still judges against the newest complete epoch."""
+    from comapreduce_tpu.serving.epochs import (EpochFenceError,
+                                                EpochStore)
+
+    store = EpochStore(str(tmp_path / "epochs"))
+
+    def products(tmpdir):
+        with open(os.path.join(tmpdir, "m.bin"), "wb") as f:
+            f.write(b"m")
+        return {"maps": ["m.bin"]}
+
+    assert store.publish(["a.hd5"], products) == 1
+    assert store.publish(["a.hd5", "b.hd5"], products) == 2
+
+    # the zombie's stale solve: census ⊂ epoch 2's — rejected
+    with pytest.raises(EpochFenceError, match="strictly grow"):
+        store.publish(["a.hd5"], products)
+    # equal census is stale too (nothing new to serve) — rejected
+    with pytest.raises(EpochFenceError, match="strictly grow"):
+        store.publish(["a.hd5", "b.hd5"], products)
+    # rejections leave no trace: no epoch 3, no temp garbage, and the
+    # read path never moved
+    assert store.list_epochs() == [1, 2]
+    assert store.current() == 2
+    assert not any(n.startswith(".tmp-epoch.")
+                   for n in os.listdir(store.root))
+
+    # rollback pins readers to epoch 1 but history is untouched: the
+    # fence still judges against epoch 2's census, and the next good
+    # publish numbers 3 and retakes current
+    store.rollback(1)
+    assert store.current() == 1
+    with pytest.raises(EpochFenceError, match="strictly grow"):
+        store.publish(["a.hd5", "b.hd5"], products)
+    assert store.publish(["a.hd5", "b.hd5", "c.hd5"], products) == 3
+    assert store.current() == 3

@@ -1,0 +1,271 @@
+"""Serving-layer unit pins (ISSUE 9).
+
+Fast, jax-free checks on the pieces under ``comapreduce_tpu.serving``
+and their integration points: the exactly-once admission ledger
+(``served.jsonl`` — dedupe, durability across reload, torn-line drop),
+the commit watcher over the lease layout (done-only scans, announce
+stream as a wake hint, the scheduler's commit-side announce hook), the
+coadd read path over epoch manifests, and the elastic-by-default
+campaign coercion (``ResilienceConfig.coerce_campaign``). The solver
+end-to-end (warm-started CG, SIGKILL mid-publish, fencing) lives in
+``run_serving_drill`` / ``tests/test_resume_kill.py``.
+"""
+
+import json
+import os
+
+import pytest
+
+
+# -- served.jsonl admission ledger ----------------------------------------
+
+
+def _ledger(tmp_path):
+    from comapreduce_tpu.serving.ledger import ServedLedger
+
+    return ServedLedger(str(tmp_path / "served.jsonl"))
+
+
+def test_ledger_admits_exactly_once(tmp_path):
+    led = _ledger(tmp_path)
+    assert len(led) == 0 and led.files == set()
+    assert led.admit("obs-0001.hd5", "/data/obs-0001.hd5",
+                     t_commit_unix=123.0)
+    # second admission of the same basename is refused, even with a
+    # different path — census membership is by basename
+    assert not led.admit("obs-0001.hd5", "/elsewhere/obs-0001.hd5")
+    assert led.files == {"obs-0001.hd5"}
+    assert "obs-0001.hd5" in led
+    assert led.path_of("obs-0001.hd5") == "/data/obs-0001.hd5"
+    entry = led.entries()[0]
+    assert entry["t_commit_unix"] == 123.0 and entry["schema"] == 1
+
+
+def test_ledger_survives_reload(tmp_path):
+    led = _ledger(tmp_path)
+    led.admit("a.hd5", "/d/a.hd5")
+    led.admit("b.hd5", "/d/b.hd5")
+    # a fresh loader (restart) sees the same census and still dedupes
+    led2 = _ledger(tmp_path)
+    assert led2.files == {"a.hd5", "b.hd5"}
+    assert not led2.admit("a.hd5", "/d/a.hd5")
+    assert led2.admit("c.hd5", "/d/c.hd5")
+
+
+def test_ledger_drops_torn_trailing_line_and_readmits(tmp_path):
+    led = _ledger(tmp_path)
+    led.admit("a.hd5", "/d/a.hd5")
+    # SIGKILL mid-append: a torn half-line with no newline terminator
+    with open(led.path, "ab") as f:
+        f.write(b'{"schema": 1, "file": "b.h')
+    led2 = _ledger(tmp_path)
+    # the torn entry never happened — b.hd5 was NOT admitted and
+    # re-admits cleanly on the next poll (exactly-once via first-
+    # entry-wins reads over at-least-once appends)
+    assert led2.files == {"a.hd5"}
+    assert led2.admit("b.hd5", "/d/b.hd5")
+    led3 = _ledger(tmp_path)
+    assert led3.files == {"a.hd5", "b.hd5"}
+
+
+def test_ledger_first_entry_wins_on_duplicate_lines(tmp_path):
+    # at-least-once appends can duplicate a line (crash between write
+    # and in-memory mark on a hostile filesystem); reads keep the FIRST
+    path = tmp_path / "served.jsonl"
+    rows = [{"schema": 1, "file": "a.hd5", "path": "/first", "t_admit_unix": 1.0},
+            {"schema": 1, "file": "a.hd5", "path": "/second", "t_admit_unix": 2.0}]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    led = _ledger(tmp_path)
+    assert led.files == {"a.hd5"}
+    assert led.path_of("a.hd5") == "/first"
+
+
+# -- lease-layout scan + announce stream ----------------------------------
+
+
+def _commit_done(state_dir, filename, rank=0):
+    from comapreduce_tpu.resilience.lease import LeaseBoard
+
+    board = LeaseBoard(str(state_dir), rank=rank, lease_ttl_s=60.0)
+    lease = board.claim(filename)
+    assert lease is not None
+    assert board.commit(lease)
+    return board
+
+
+def test_scan_committed_sees_done_only(tmp_path):
+    from comapreduce_tpu.resilience.lease import LeaseBoard
+    from comapreduce_tpu.serving.watcher import scan_committed
+
+    assert scan_committed(str(tmp_path)) == {}
+    _commit_done(tmp_path, "/data/obs-0001.hd5")
+    board = LeaseBoard(str(tmp_path), rank=1, lease_ttl_s=60.0)
+    board.claim("/data/obs-0002.hd5")  # in flight, not servable
+    # torn lease file (mid-write crash): skipped, parses a later scan
+    (tmp_path / "lease.torn.json").write_text('{"state": "do')
+    done = scan_committed(str(tmp_path))
+    assert set(done) == {"obs-0001.hd5"}
+    st = done["obs-0001.hd5"]
+    assert st["state"] == "done"
+    assert st["file"] == "/data/obs-0001.hd5"
+
+
+def test_commit_watcher_wakes_on_announce_growth(tmp_path):
+    from comapreduce_tpu.serving.watcher import (CommitWatcher,
+                                                 announce_commit)
+
+    w = CommitWatcher(str(tmp_path))
+    # first call always True: a fresh server scans once uncondition-
+    # ally, even with no announce stream on disk yet
+    assert w.changed()
+    assert not w.changed()
+    announce_commit(str(tmp_path), "/data/obs-0001.hd5", now=lambda: 5.0)
+    assert w.changed()
+    assert not w.changed()
+    rows = [json.loads(line) for line in
+            open(w.path, encoding="utf-8").read().splitlines()]
+    assert rows == [{"schema": 1, "file": "/data/obs-0001.hd5",
+                     "t_unix": 5.0}]
+
+
+def test_announce_commit_is_best_effort(tmp_path):
+    from comapreduce_tpu.serving.watcher import announce_commit
+
+    # an unwritable state dir must never fail the commit that called
+    # us — losing an announcement costs latency, never correctness
+    announce_commit(str(tmp_path / "no" / "such" / "dir"), "obs.hd5")
+
+
+def test_scheduler_commit_announces(tmp_path):
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+    from comapreduce_tpu.serving.watcher import ANNOUNCE_LOG, \
+        scan_committed
+
+    files = [f"/data/obs-{i:04d}.hd5" for i in range(3)]
+    sched = Scheduler(files, str(tmp_path), rank=0, n_ranks=1,
+                      lease_ttl_s=60.0)
+    for f in sched.claim_iter():
+        sched.commit(f)
+    # every commit announced on the wake stream AND durable as a done
+    # lease — the stream is the hint, the lease layout is the truth
+    announce = tmp_path / ANNOUNCE_LOG
+    assert announce.exists()
+    announced = [json.loads(line)["file"] for line in
+                 announce.read_text().splitlines()]
+    assert sorted(os.path.basename(f) for f in announced) == \
+        sorted(os.path.basename(f) for f in files)
+    assert set(scan_committed(str(tmp_path))) == \
+        {os.path.basename(f) for f in files}
+
+
+# -- coadd read path over epoch manifests ---------------------------------
+
+
+def _publish_epoch(root, census, products):
+    from comapreduce_tpu.serving.epochs import EpochStore
+
+    store = EpochStore(str(root))
+
+    def write(tmpdir):
+        for name in products:
+            with open(os.path.join(tmpdir, name), "w") as f:
+                f.write("x")
+        return {"maps": list(products)}
+
+    n = store.publish(list(census), write)
+    return store, n
+
+
+def test_epoch_map_inputs_resolves_root_dir_and_manifest(tmp_path):
+    from comapreduce_tpu.mapmaking.coadd import epoch_map_inputs
+
+    store, n = _publish_epoch(tmp_path / "epochs", ["a.hd5"],
+                              ["map_band0.fits"])
+    epoch_dir = store.epoch_dir(n)
+    expect = [os.path.join(epoch_dir, "map_band0.fits")]
+    # all three spellings land on the same product list: the epochs
+    # ROOT (through `current`), the epoch dir, the manifest itself
+    assert epoch_map_inputs(str(tmp_path / "epochs")) == expect
+    assert epoch_map_inputs(epoch_dir) == expect
+    assert epoch_map_inputs(os.path.join(epoch_dir,
+                                         "manifest.json")) == expect
+
+
+def test_epoch_map_inputs_follows_current_after_rollback(tmp_path):
+    from comapreduce_tpu.mapmaking.coadd import epoch_map_inputs
+
+    root = tmp_path / "epochs"
+    store, n1 = _publish_epoch(root, ["a.hd5"], ["map_band0.fits"])
+    n2 = store.publish(["a.hd5", "b.hd5"],
+                       lambda d: (open(os.path.join(d, "map_band0.fits"),
+                                       "w").close(),
+                                  {"maps": ["map_band0.fits"]})[1])
+    assert epoch_map_inputs(str(root)) == \
+        [os.path.join(store.epoch_dir(n2), "map_band0.fits")]
+    # rollback moves the read path; the coadd follows `current`
+    store.rollback(n1)
+    assert epoch_map_inputs(str(root)) == \
+        [os.path.join(store.epoch_dir(n1), "map_band0.fits")]
+
+
+def test_epoch_map_inputs_rejects_non_epoch(tmp_path):
+    from comapreduce_tpu.mapmaking.coadd import epoch_map_inputs
+
+    with pytest.raises(ValueError, match="not a complete epoch"):
+        epoch_map_inputs(str(tmp_path))
+
+
+def test_coadd_expand_inputs_mixes_epochs_and_plain_fits(tmp_path):
+    from comapreduce_tpu.mapmaking.coadd import _expand_inputs
+
+    store, n = _publish_epoch(tmp_path / "epochs", ["a.hd5"],
+                              ["map_band0.fits"])
+    plain = str(tmp_path / "rank0.fits")
+    open(plain, "w").close()
+    out = _expand_inputs([plain, str(tmp_path / "epochs")])
+    assert out == [plain,
+                   os.path.join(store.epoch_dir(n), "map_band0.fits")]
+
+
+# -- elastic-by-default campaign coercion ---------------------------------
+
+
+def test_coerce_campaign_defaults_elastic_on(tmp_path):
+    from comapreduce_tpu.resilience.config import (DEFAULT_LEASE_TTL_S,
+                                                   ResilienceConfig)
+
+    # an unconfigured campaign gets elastic claiming by default
+    cfg = ResilienceConfig.coerce_campaign({})
+    assert cfg.lease_ttl_s == DEFAULT_LEASE_TTL_S
+    # mentioning OTHER knobs does not opt out
+    cfg = ResilienceConfig.coerce_campaign({"heartbeat_s": 5.0})
+    assert cfg.lease_ttl_s == DEFAULT_LEASE_TTL_S
+
+
+def test_coerce_campaign_explicit_zero_opts_out(tmp_path):
+    from comapreduce_tpu.resilience.config import ResilienceConfig
+
+    # writing lease_ttl_s — any value, including 0 — is authoritative
+    cfg = ResilienceConfig.coerce_campaign({"lease_ttl_s": 0})
+    assert cfg.lease_ttl_s == 0.0
+    cfg = ResilienceConfig.coerce_campaign({"lease_ttl_s": 30.0})
+    assert cfg.lease_ttl_s == 30.0
+
+
+def test_coerce_campaign_requires_heartbeats(tmp_path):
+    from comapreduce_tpu.resilience.config import ResilienceConfig
+
+    # no heartbeats → no lease-expiry evidence → the default stays off
+    # (an explicit elastic config with heartbeat_s = 0 raises instead;
+    # see ResilienceConfig.__post_init__)
+    cfg = ResilienceConfig.coerce_campaign({"heartbeat_s": 0})
+    assert cfg.lease_ttl_s == 0.0
+
+
+def test_coerce_campaign_passes_instances_through(tmp_path):
+    from comapreduce_tpu.resilience.config import ResilienceConfig
+
+    # an already-built config is someone's deliberate choice: coercion
+    # never rewrites it (static stays static)
+    static = ResilienceConfig(lease_ttl_s=0.0)
+    assert ResilienceConfig.coerce_campaign(static) is static

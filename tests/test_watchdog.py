@@ -412,11 +412,9 @@ def test_straggler_barrier_all_alive(tmp_path):
 
 
 def test_straggler_barrier_detects_dead_rank_and_degrades(tmp_path):
-    from comapreduce_tpu.parallel.multihost import (degraded_shard,
-                                                    straggler_barrier)
+    from comapreduce_tpu.parallel.multihost import straggler_barrier
     from comapreduce_tpu.resilience.heartbeat import (Heartbeat,
                                                       heartbeat_path)
-    from comapreduce_tpu.resilience.ledger import QuarantineLedger
 
     # rank 0: alive (it is us). rank 1: DEAD — a frozen heartbeat from
     # a crashed process (it was written RECENTLY, which must not help:
@@ -438,31 +436,24 @@ def test_straggler_barrier_detects_dead_rank_and_degrades(tmp_path):
     assert time.monotonic() - t0 < 5.0   # bounded, no deadlock
     assert alive == [0] and dead == [1, 2]
 
-    files = [f"obs{i:03d}" for i in range(7)]
-    ledger = QuarantineLedger(str(tmp_path / "quarantine.rank0.jsonl"))
-    shard = degraded_shard(files, rank=0, n_ranks=3, dead=dead,
-                           alive=alive, ledger=ledger)
-    # the shard rule itself never changes (i % n_ranks == r)
-    assert shard == files[0::3]
-    # every dead rank's file is deferred (rejected), none quarantined
-    deferred = {e.unit["file"] for e in ledger.entries}
-    assert deferred == set(files[1::3]) | set(files[2::3])
-    assert all(e.disposition == "rejected" and e.failure_class == "hang"
-               for e in ledger.entries)
-    assert ledger.quarantined_files() == set()
 
-
-def test_degraded_shard_only_lowest_alive_rank_ledgers(tmp_path):
+def test_degraded_shard_is_a_deprecated_static_shard_shim(tmp_path):
+    """The ledger-and-abandon path is retired (elastic claiming is the
+    campaign default): the shim warns, returns the plain static shard,
+    and never writes a ledger entry — from any rank."""
     from comapreduce_tpu.parallel.multihost import degraded_shard
     from comapreduce_tpu.resilience.ledger import QuarantineLedger
 
-    files = [f"obs{i:03d}" for i in range(6)]
+    files = [f"obs{i:03d}" for i in range(7)]
     ledger = QuarantineLedger(str(tmp_path / "q.jsonl"))
-    # rank 2 is alive but NOT the lowest alive rank: it must not write
-    shard = degraded_shard(files, rank=2, n_ranks=3, dead=[1],
-                           alive=[0, 2], ledger=ledger)
-    assert shard == files[2::3]
+    for rank, alive in ((0, [0]), (2, [0, 2])):
+        with pytest.warns(DeprecationWarning, match="static"):
+            shard = degraded_shard(files, rank=rank, n_ranks=3,
+                                   dead=[1], alive=alive, ledger=ledger)
+        # the shard rule itself never changes (i % n_ranks == r)
+        assert shard == files[rank::3]
     assert ledger.entries == []
+    assert not (tmp_path / "q.jsonl").exists()
 
 
 # ---------------------------------------------------------------------------

@@ -37,6 +37,13 @@ warm-up, async writeback) must show steady-state backend compiles
 ``<= bucket_count`` — a recompile-per-file regression in the shape
 canonicalisation or warm-up fails here. Machine-independent (it is a
 count, not a throughput); ``--no-campaign`` skips it.
+
+The serving warm-start gate (ISSUE 9) also runs by default: one
+``bench.py --config serving`` smoke (incremental map server folding
+three commit waves) must show the final WARM epoch converging in
+strictly fewer CG iterations than a cold solve of the same census.
+Machine-independent (an ordering of two iteration counts on one
+deterministic fixture); ``--no-serving`` skips it.
 """
 
 from __future__ import annotations
@@ -122,6 +129,30 @@ def run_destriper_bench() -> dict:
     raise RuntimeError("no destriper result line in bench.py output")
 
 
+def run_serving_bench() -> dict:
+    """One serving bench child -> its parsed JSON result line."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_SMALL": "1",
+        "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
+        "BENCH_EVIDENCE": "0",
+    })
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                          "--config", "serving"],
+                         env=env, capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py --config serving failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == "serving_freshness_s":
+            return rec
+    raise RuntimeError("no serving result line in bench.py output")
+
+
 #: compacted-path memory budget multiplier: the exact device footprint
 #: of the four map products is 4 B x (3 n_bands + 1) x n_compact
 #: (per-band destriped/naive/weight + shared hits); the gate allows 2x
@@ -166,6 +197,8 @@ def main(argv=None) -> int:
                     help="skip the campaign no-recompile gate")
     ap.add_argument("--no-destriper", action="store_true",
                     help="skip the destriper memory/iteration gate")
+    ap.add_argument("--no-serving", action="store_true",
+                    help="skip the serving warm-start gate")
     args = ap.parse_args(argv)
 
     best: dict | None = None
@@ -267,9 +300,35 @@ def main(argv=None) -> int:
                 f"not below twolevel ({it['twolevel']}) — the V-cycle "
                 "regressed to (or below) the additive two-level "
                 "preconditioner")
+    serving = None
+    if not args.no_serving:
+        # machine-independent like the campaign gate: the warm epoch's
+        # CG iteration count must order strictly below the cold solve
+        # of the same census on the bench's deterministic 1/f fixture —
+        # a warm-start regression (x0 dropped, offsets misaligned, sky
+        # estimate broken) erases the ordering, not just the margin
+        s = run_serving_bench()["detail"]
+        serving = {k: s.get(k) for k in
+                   ("warm_iters", "cold_iters", "cold_x0", "waves")}
+        serving["final_x0"] = s["epochs"][-1]["x0"] if s.get("epochs") \
+            else None
+        if not serving["warm_iters"] or not serving["cold_iters"]:
+            failures.append("serving: bench reported no CG iteration "
+                            f"counts ({serving})")
+        elif serving["final_x0"] in (None, "cold"):
+            failures.append(
+                "serving: the final epoch solved COLD "
+                f"(x0={serving['final_x0']}) — warm start never "
+                "engaged, so the iteration ordering is vacuous")
+        elif serving["warm_iters"] >= serving["cold_iters"]:
+            failures.append(
+                f"serving warm-start regression: warm epoch took "
+                f"{serving['warm_iters']} CG iterations, not below the "
+                f"cold solve's {serving['cold_iters']} on the same "
+                "census (epoch offsets/sky estimate no longer reused?)")
     print(json.dumps({"ok": not failures, "failures": failures,
                       "current": cur, "campaign": campaign,
-                      "destriper": destriper,
+                      "destriper": destriper, "serving": serving,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))

@@ -4,7 +4,7 @@
 Usage::
 
     python tools/check_resilience.py [--workdir DIR] [--seed N] [--keep]
-                                     [--elastic-only]
+                                     [--elastic-only | --serving-only]
 
 Injects one fault of every class (read error, truncated file,
 first-attempt flake, NaN burst, slow read, HANGING read) over a
@@ -25,6 +25,16 @@ survivor that steals both leases), asserting exactly-once commits, the
 zombie's late commit fence-rejected, stolen/recovered ledgered, and
 the map byte-identical to a clean run. Kept as a separate CI step
 ("Rank-kill drill") because it spawns subprocesses and costs ~20 s.
+
+``--serving-only`` runs criterion 8: the incremental map-server drill
+(``run_serving_drill`` — server subprocesses folding committed files
+in waves), asserting exactly-once folding across epochs, SIGKILL
+mid-publish leaving ``current`` on the last complete epoch and the
+resumed run byte-identical to an uninterrupted twin, an epoch built
+from per-file incremental aggregates byte-identical to a batch
+read+solve, and a warm-started epoch converging in strictly fewer CG
+iterations than a cold solve of the same census (maps agreeing modulo
+the weighted-mean null mode).
 
 Prints one JSON evidence line; non-zero exit (with the broken
 criterion named) on any failure. Also wired into CI as ``bench.py
@@ -51,16 +61,22 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--keep", action="store_true",
                     help="keep the workdir (inspect the ledger/fixtures)")
-    ap.add_argument("--elastic-only", action="store_true",
-                    help="run only criterion 7 (the rank-kill/rank-pause "
-                    "elastic-campaign drill)")
+    only = ap.add_mutually_exclusive_group()
+    only.add_argument("--elastic-only", action="store_true",
+                      help="run only criterion 7 (the rank-kill/"
+                      "rank-pause elastic-campaign drill)")
+    only.add_argument("--serving-only", action="store_true",
+                      help="run only criterion 8 (the incremental "
+                      "map-server kill/resume/warm-start drill)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from comapreduce_tpu.resilience.drill import (run_drill,
-                                                  run_elastic_drill)
+                                                  run_elastic_drill,
+                                                  run_serving_drill)
 
-    drill = run_elastic_drill if args.elastic_only else run_drill
+    drill = (run_serving_drill if args.serving_only
+             else run_elastic_drill if args.elastic_only else run_drill)
     workdir = args.workdir or tempfile.mkdtemp(prefix="check_resilience_")
     try:
         try:

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Operate the incremental map server (``comapreduce_tpu.serving``).
+
+Subcommands::
+
+    serve     run the long-lived server: tail a campaign's committed
+              units, fold new files, publish versioned map epochs
+    status    one-line health: current epoch, census size, staleness
+    epochs    list every complete epoch with its CG/freshness metrics
+    rollback  point the ``current`` read path at an older epoch
+
+Examples::
+
+    python tools/map_server.py serve --state-dir run/logs \\
+        --epochs-dir run/epochs --crval 170.25 52.25 \\
+        --cdelt 0.0166667 0.0166667 --shape 64 64 \\
+        --medfilt-window 201 --idle-exit-s 600
+    python tools/map_server.py status --epochs-dir run/epochs
+    python tools/map_server.py rollback --epochs-dir run/epochs 4
+
+``status``/``epochs``/``rollback`` import no jax and return instantly;
+``serve`` owns the epochs root exclusively (one server per root — the
+admission ledger is single-writer).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _add_epochs_dir(ap):
+    ap.add_argument("--epochs-dir", required=True,
+                    help="epochs root (ledger + epoch-NNNNNN dirs)")
+
+
+def _fmt_age(seconds: float) -> str:
+    if seconds < 120:
+        return f"{seconds:.0f}s"
+    if seconds < 7200:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def cmd_serve(args) -> int:
+    from comapreduce_tpu.serving.server import MapServer
+
+    wcs = None
+    if args.nside is None:
+        if not (args.crval and args.cdelt and args.shape):
+            print("serve: pass --nside or all of --crval/--cdelt/"
+                  "--shape", file=sys.stderr)
+            return 2
+        from comapreduce_tpu.mapmaking.wcs import WCS
+
+        wcs = WCS.from_field(tuple(args.crval), tuple(args.cdelt),
+                             (int(args.shape[0]), int(args.shape[1])))
+    mg = {"block": args.mg_block} if args.mg_block else None
+    server = MapServer(
+        args.state_dir, args.epochs_dir, wcs=wcs, nside=args.nside,
+        band=args.band, level2_dir=args.level2_dir,
+        level2_prefix=args.level2_prefix,
+        offset_length=args.offset_length, n_iter=args.n_iter,
+        threshold=args.threshold, precond=args.precond,
+        coarse_block=args.coarse_block, mg=mg, galactic=args.galactic,
+        medfilt_window=args.medfilt_window,
+        use_calibration=not args.no_calibration,
+        tod_variant=args.tod_variant, warm_start=not args.cold,
+        checkpoint_every=args.checkpoint_every,
+        min_new_files=args.min_new_files, poll_s=args.poll_s)
+    published = server.serve(
+        max_epochs=args.max_epochs, idle_exit_s=args.idle_exit_s,
+        max_wall_s=args.max_wall_s)
+    print(f"serve: published {published} epoch(s); stats at "
+          f"{server.stats_path}")
+    return 0
+
+
+def cmd_status(args) -> int:
+    from comapreduce_tpu.serving.epochs import EpochStore
+    from comapreduce_tpu.serving.server import STATS_JSON
+
+    store = EpochStore(args.epochs_dir)
+    cur = store.current()
+    if cur is None:
+        print(f"{args.epochs_dir}: no epoch published yet")
+        return 1
+    man = store.manifest(cur) or {}
+    stale = time.time() - float(man.get("t_publish_unix", 0.0))
+    line = (f"current epoch-{cur:06d}: {man.get('n_files', '?')} files, "
+            f"published {_fmt_age(stale)} ago")
+    cg = man.get("cg") or {}
+    if cg:
+        line += (f", {cg.get('n_iter', '?')} CG iters "
+                 f"({cg.get('x0', '?')} start)")
+    if man.get("freshness_s") is not None:
+        line += f", freshness {_fmt_age(float(man['freshness_s']))}"
+    print(line)
+    stats = os.path.join(args.epochs_dir, STATS_JSON)
+    if args.json and os.path.exists(stats):
+        with open(stats, encoding="utf-8") as f:
+            print(json.dumps(json.load(f), indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_epochs(args) -> int:
+    from comapreduce_tpu.serving.epochs import EpochStore
+
+    store = EpochStore(args.epochs_dir)
+    cur = store.current()
+    rows = store.list_epochs()
+    if not rows:
+        print(f"{args.epochs_dir}: no complete epochs")
+        return 1
+    for n in rows:
+        man = store.manifest(n) or {}
+        cg = man.get("cg") or {}
+        mark = "*" if n == cur else " "
+        print(f"{mark} epoch-{n:06d}  files={man.get('n_files', '?'):>4}"
+              f"  new={man.get('n_new', '?'):>3}"
+              f"  cg={cg.get('n_iter', '?'):>4}"
+              f"  x0={cg.get('x0', '?')}"
+              f"  t_solve={man.get('t_solve_s', 0.0):.1f}s")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    from comapreduce_tpu.serving.epochs import EpochStore
+
+    store = EpochStore(args.epochs_dir)
+    was = store.current()
+    store.rollback(args.epoch)
+    print(f"current: epoch-{was:06d} -> epoch-{args.epoch:06d}"
+          if was is not None else
+          f"current: epoch-{args.epoch:06d}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="run the incremental map server")
+    s.add_argument("--state-dir", required=True,
+                   help="campaign lease/commit dir ([Global] log_dir)")
+    _add_epochs_dir(s)
+    s.add_argument("--crval", nargs=2, type=float)
+    s.add_argument("--cdelt", nargs=2, type=float)
+    s.add_argument("--shape", nargs=2, type=int)
+    s.add_argument("--nside", type=int)
+    s.add_argument("--band", type=int, default=0)
+    s.add_argument("--level2-dir", default="",
+                   help="map committed names to Level-2 checkpoints "
+                   "(empty: the lease's file path is servable as-is)")
+    s.add_argument("--level2-prefix", default="Level2")
+    s.add_argument("--offset-length", type=int, default=50)
+    s.add_argument("--n-iter", type=int, default=100)
+    s.add_argument("--threshold", type=float, default=1e-6)
+    s.add_argument("--precond", default="jacobi")
+    s.add_argument("--coarse-block", type=int, default=0)
+    s.add_argument("--mg-block", type=int, default=0)
+    s.add_argument("--galactic", action="store_true")
+    s.add_argument("--medfilt-window", type=int, default=400)
+    s.add_argument("--no-calibration", action="store_true")
+    s.add_argument("--tod-variant", default="auto")
+    s.add_argument("--cold", action="store_true",
+                   help="disable warm starts (every epoch solves cold)")
+    s.add_argument("--checkpoint-every", type=int, default=0)
+    s.add_argument("--min-new-files", type=int, default=1)
+    s.add_argument("--poll-s", type=float, default=2.0)
+    s.add_argument("--max-epochs", type=int, default=None)
+    s.add_argument("--idle-exit-s", type=float, default=None,
+                   help="exit after this long with nothing new "
+                   "(default: run forever)")
+    s.add_argument("--max-wall-s", type=float, default=None)
+    s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("status", help="current epoch + staleness")
+    _add_epochs_dir(s)
+    s.add_argument("--json", action="store_true",
+                   help="also dump the full server stats JSON")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("epochs", help="list complete epochs")
+    _add_epochs_dir(s)
+    s.set_defaults(fn=cmd_epochs)
+
+    s = sub.add_parser("rollback",
+                       help="swap current back to an older epoch")
+    _add_epochs_dir(s)
+    s.add_argument("epoch", type=int)
+    s.set_defaults(fn=cmd_rollback)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
