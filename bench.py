@@ -1322,6 +1322,49 @@ def bench_resilience():
     return 0
 
 
+def _campaign_telemetry_check(log_dir, window, steady_wall, timings):
+    """Close the campaign run's telemetry stream and cross-check the
+    merged timeline against the bench's own bookkeeping: steady-state
+    backend compiles recomputed from ``jax.compile`` spans (must match
+    the CompileCounter exactly) and the read/compute overlap fraction
+    integrated from span intersections (must track the bench's
+    timings+wall estimate). ``tools/check_perf.py`` gates both."""
+    from comapreduce_tpu.telemetry import TELEMETRY, merge_streams
+    from comapreduce_tpu.telemetry.report import (chrome_trace,
+                                                  overlap_seconds)
+
+    TELEMETRY.close()
+    merged = merge_streams(log_dir)
+    w0, w1 = window
+    compile_spans = sum(1 for s in merged.spans_named("jax.compile")
+                        if w0 <= s["t"] + s["dur"] <= w1)
+    # overlap, both ways, normalised by the steady wall: telemetry
+    # integrates actual span intersections; the bench only knows
+    # per-file busy totals, where busy beyond wall = overlapped time
+    inter = overlap_seconds(merged, "ingest.read", "ingest.compute",
+                            t0=w0, t1=w1)
+    tele_frac = inter / (w1 - w0) if w1 > w0 else 0.0
+    read_s = sum(timings.get("ingest.read", [])[1:])
+    comp_s = sum(timings.get("ingest.compute", [])[1:])
+    bench_frac = (max(read_s + comp_s - steady_wall, 0.0) / steady_wall
+                  if steady_wall > 0 else 0.0)
+    try:
+        trace = json.loads(json.dumps(chrome_trace(merged)))
+        trace_valid = bool(trace.get("traceEvents"))
+    except (TypeError, ValueError):
+        trace_valid = False
+    return {
+        "trace_valid": trace_valid,
+        "steady_compile_spans": int(compile_spans),
+        "overlap_read_compute": round(tele_frac, 4),
+        "overlap_read_compute_bench": round(bench_frac, 4),
+        "spans": len(merged.spans),
+        "truncated_spans": sum(1 for s in merged.spans
+                               if s["truncated"]),
+        "dropped_lines": merged.dropped_lines,
+    }
+
+
 def bench_campaign():
     """Campaign mode: whole-filelist executor A/B (ISSUE 5).
 
@@ -1345,8 +1388,18 @@ def bench_campaign():
     persistent-cache hits, and the write-overlap fraction (share of
     async write seconds hidden behind stage compute).
 
+    The campaign run also exercises the telemetry pipeline end to end
+    (ISSUE 10): spans stream to ``events.rank0.jsonl`` in the campaign
+    outdir, and after the run the merged timeline must (a) export valid
+    Chrome trace JSON, (b) recompute the steady-state backend-compile
+    count exactly from ``jax.compile`` spans, and (c) reproduce the
+    read/compute overlap fraction the bench derives from its own
+    timings+wall bookkeeping — both gated by ``tools/check_perf.py``.
+    ``BENCH_TELEMETRY=0`` disables (used by the overhead A/B).
+
     Env: ``BENCH_SMALL=1`` tiny shapes; ``BENCH_CAMPAIGN_FILES``
-    overrides the file count.
+    overrides the file count; ``BENCH_TELEMETRY=0`` turns telemetry
+    off.
     """
     import shutil
     import tempfile
@@ -1400,34 +1453,53 @@ def bench_campaign():
         shapes = [probe_observation(f) for f in files]
         bucket_count = len(campaign_bucket_set(shapes, buckets))
 
-        def timed_run(tag, campaign, ingest):
+        telemetry_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+
+        def timed_run(tag, campaign, ingest, telemetry=None):
             outdir = os.path.join(tmp, tag)
             runner = Runner(processes=chain(), output_dir=outdir,
                             campaign=campaign, ingest=ingest,
+                            telemetry=telemetry,
                             resilience={"quarantine": "off",
                                         "heartbeat_s": 0})
             with CompileCounter() as c:
                 runner.run_tod(files[:1])      # absorb cold compiles
                 c_first = c.snapshot()
-                t0 = time.perf_counter()
+                w0 = time.time()               # steady window in the
+                t0 = time.perf_counter()       # reader's wall domain
                 runner.run_tod(files[1:])
                 steady_wall = time.perf_counter() - t0
+                w1 = time.time()
                 c_end = c.snapshot()
             steady = {k: c_end[k] - c_first[k] for k in c_end}
-            return steady_wall, steady, dict(runner.writeback_stats)
+            return (steady_wall, steady, dict(runner.writeback_stats),
+                    (w0, w1), runner)
 
         cache_dir = os.path.join(tmp, "jaxcache")
-        camp_wall, camp_steady, wb = timed_run(
+        camp_wall, camp_steady, wb, camp_win, camp_runner = timed_run(
             "campaign",
             campaign={**quanta, "warm_compile": True},
-            ingest={"compile_cache_dir": cache_dir, "writeback": 2})
+            ingest={"compile_cache_dir": cache_dir, "writeback": 2,
+                    "prefetch": 2},
+            telemetry=({"enabled": True, "flush_s": 0.2}
+                       if telemetry_on else None))
+
+        # telemetry cross-check BEFORE the baseline run: TELEMETRY is
+        # process-global, so close it here or the baseline would keep
+        # appending to the campaign's stream
+        tele = {}
+        if telemetry_on:
+            tele = _campaign_telemetry_check(
+                os.path.join(tmp, "campaign"), camp_win, camp_wall,
+                camp_runner.timings)
 
         # baseline AFTER the campaign run (see docstring) with the
         # persistent cache off — the pre-PR executor had neither
         import jax
 
         jax.config.update("jax_compilation_cache_dir", None)
-        base_wall, base_steady, _ = timed_run("baseline", None, None)
+        base_wall, base_steady, _, _, _ = timed_run(
+            "baseline", None, None)
 
         write_s = wb.get("write_s", 0.0)
         flush_wait = wb.get("flush_wait_s", 0.0)
@@ -1460,6 +1532,9 @@ def bench_campaign():
                               else v for k, v in wb.items()},
                 "write_overlap_fraction":
                     round(max(min(overlap, 1.0), 0.0), 3),
+                # {} when BENCH_TELEMETRY=0 — check_perf's telemetry
+                # gate skips on absence
+                "telemetry": tele,
             },
         }
         print(json.dumps(line))

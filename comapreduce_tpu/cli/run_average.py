@@ -108,8 +108,20 @@ def main(argv=None) -> int:
                   for f in _read_filelist(cal_list_path)]
         runner.run_astro_cal(filelist, cal_l2,
                              cache_path=glob.get("calibration_cache", ""))
-    for name, times in sorted(runner.timings.items()):
-        print(f"{name}: {sum(times):.2f} s over {len(times)} files")
+    # the end-of-run stage table goes through the telemetry summary
+    # formatter — ONE definition of count/mean/p50/p95 shared with
+    # tools/campaign_report.py and the bench (docs/OPERATIONS.md §13);
+    # skip-path placeholders are counted separately, not averaged in
+    from comapreduce_tpu.telemetry import TELEMETRY
+    from comapreduce_tpu.telemetry.report import format_duration_table
+
+    table = format_duration_table(runner.timings)
+    if table:
+        print(table)
+    if TELEMETRY.enabled:
+        TELEMETRY.close()  # drain the event buffer before exit
+        print(f"telemetry: {TELEMETRY.path} "
+              f"(merge with tools/campaign_report.py)")
     return 0
 
 

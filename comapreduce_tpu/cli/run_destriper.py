@@ -31,6 +31,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -688,9 +689,12 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
         logger.info("solver checkpoint %s: resuming %s at iteration %d "
                     "of %d", checkpoint_path, unit or "<band>", done,
                     n_iter)
+    from comapreduce_tpu.telemetry import TELEMETRY
+
     result = None
     while True:
         step = max(min(chunk, n_iter - done), 1)
+        t_chunk = time.perf_counter()
         result = solve_band(data, offset_length=offset_length,
                             n_iter=step, threshold=threshold,
                             watchdog=watchdog, unit=unit, x0=x0, **kw)
@@ -701,6 +705,14 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
         x0 = np.asarray(result.offsets)
         save_solver_checkpoint(checkpoint_path, x0, done, residuals,
                                precond_id)
+        # per-chunk CG observability: iterations actually run, the
+        # running residual and the preconditioner id — the destriper's
+        # convergence trajectory as spans on the campaign timeline
+        TELEMETRY.event_span("destriper.cg_chunk",
+                             time.perf_counter() - t_chunk,
+                             unit=unit or "<band>", iters=ran,
+                             n_done=done, residual=residual,
+                             precond_id=precond_id)
         # ran < step means the chunk converged (or was already converged
         # on entry, ran == 0) before exhausting its budget — done either
         # way; the budget and threshold exits mirror the plain solve's
@@ -1056,6 +1068,14 @@ def main(argv=None) -> int:
     state_dir = str(inputs.get("log_dir", "") or
                     os.path.join(out_dir, "logs"))
     os.makedirs(state_dir, exist_ok=True)
+    # [Telemetry] (docs/OPERATIONS.md §13): per-rank event streams in
+    # the same state dir as the leases/heartbeats — the CG-chunk spans
+    # of solve_band_checkpointed merge with any reduction campaign's
+    from comapreduce_tpu.telemetry import TELEMETRY, TelemetryConfig
+    tcfg = TelemetryConfig.coerce(dict(ini.get("Telemetry", {})) or None)
+    if tcfg.enabled and not TELEMETRY.enabled:
+        TELEMETRY.configure(state_dir, rank=rank, flush_s=tcfg.flush_s,
+                            jax_profiler=tcfg.jax_profiler)
     resilience = res_cfg.make_runtime(out_dir, rank=rank,
                                       n_ranks=n_ranks,
                                       state_dir=state_dir)

@@ -64,6 +64,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from comapreduce_tpu.telemetry import TELEMETRY
+
 __all__ = ["Writeback", "snapshot_store"]
 
 logger = logging.getLogger("comapreduce_tpu")
@@ -185,6 +187,10 @@ class Writeback:
         while not self._stop.is_set():
             try:
                 self._queue.put(job, timeout=_POLL_S)
+                # depth pinned at the bound = the writer is the
+                # bottleneck; 0 = writes are fully hidden
+                TELEMETRY.gauge("writeback.queue_depth",
+                                self._queue.qsize())
                 return
             except queue.Full:
                 continue
@@ -284,14 +290,21 @@ class Writeback:
                 chaos.stall_write(path)
                 inner()
         t0 = time.perf_counter()
+        ok = False
         try:
             if self._watchdog is not None:
                 self._watchdog.call(fn, "writeback.write", unit=job.path)
             else:
                 fn()
+            ok = True
         finally:
+            dt = time.perf_counter() - t0
             with self._lock:
-                self.stats["write_s"] += time.perf_counter() - t0
+                self.stats["write_s"] += dt
+            # commit latency on the writer thread — true intervals for
+            # campaign_report's write/compute overlap track
+            TELEMETRY.event_span("writeback.write", dt, unit=job.path,
+                                 skipped=not ok)
         with self._lock:
             self.stats["writes"] += 1
 

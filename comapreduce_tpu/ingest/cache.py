@@ -28,6 +28,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from comapreduce_tpu.telemetry import TELEMETRY
+
 __all__ = ["BlockCache", "payload_nbytes", "file_key"]
 
 logger = logging.getLogger("comapreduce_tpu")
@@ -139,6 +141,9 @@ class BlockCache:
                 with self._lock:
                     self.stats["spills"] += 1
                     self._on_disk.add(key)
+                if TELEMETRY.enabled:  # payload_nbytes walk gated
+                    TELEMETRY.counter("ingest.cache.spill_bytes",
+                                      payload_nbytes(payload))
             except OSError as exc:  # spill is best-effort
                 logger.warning("BlockCache: spill failed for %s (%s)",
                                key[0], exc)
@@ -178,6 +183,7 @@ class BlockCache:
             if hit is not None:
                 self._entries.move_to_end(key)
                 self.stats["hits"] += 1
+                TELEMETRY.counter("ingest.cache.hits")
                 return hit[0]
             # a stale same-path entry (older mtime) is dead weight: drop
             for k in [k for k in self._entries if k[0] == key[0]]:
@@ -189,6 +195,7 @@ class BlockCache:
                 with self._lock:
                     self.stats["hits"] += 1
                     self.stats["spill_hits"] += 1
+                TELEMETRY.counter("ingest.cache.hits", spill=True)
                 # promote back into memory — but an oversized payload
                 # would only bounce straight back out through another
                 # full pickle write; leave those on disk
@@ -197,6 +204,7 @@ class BlockCache:
                 return payload
         with self._lock:
             self.stats["misses"] += 1
+        TELEMETRY.counter("ingest.cache.misses")
         return None
 
     def put(self, path: str, payload, nbytes: int | None = None,

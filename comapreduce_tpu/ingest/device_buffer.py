@@ -65,21 +65,43 @@ def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
     deadline surfaces; monitoring only, no cancellation (an abandoned
     transfer would leak device buffers).
     """
+    import time
+
     import jax
+
+    from comapreduce_tpu.telemetry import TELEMETRY
 
     if size < 1:
         raise ValueError(f"size must be >= 1, got {size}")
 
-    def put(block):
-        shard = sharding(block) if callable(sharding) else sharding
-        if watchdog is not None:
-            with watchdog.watch("ingest.h2d"):
-                if shard is None:
-                    return jax.device_put(block)
-                return jax.device_put(block, shard)
+    def _issue(block, shard):
         if shard is None:
             return jax.device_put(block)
         return jax.device_put(block, shard)
+
+    def put(block):
+        shard = sharding(block) if callable(sharding) else sharding
+        if not TELEMETRY.enabled:
+            if watchdog is not None:
+                with watchdog.watch("ingest.h2d"):
+                    return _issue(block, shard)
+            return _issue(block, shard)
+        # H2D accounting: issue-time span + bytes counter (the
+        # transfer itself is async; a wedged backend blocks the issue,
+        # which is exactly what the span then shows). The tree walk
+        # only runs with telemetry on.
+        nbytes = sum(int(getattr(x, "nbytes", 0))
+                     for x in jax.tree_util.tree_leaves(block))
+        t0 = time.perf_counter()
+        if watchdog is not None:
+            with watchdog.watch("ingest.h2d"):
+                out = _issue(block, shard)
+        else:
+            out = _issue(block, shard)
+        TELEMETRY.event_span("ingest.h2d", time.perf_counter() - t0,
+                             bytes=nbytes)
+        TELEMETRY.counter("ingest.h2d.bytes", nbytes)
+        return out
 
     it = iter(blocks)
     buf: collections.deque = collections.deque()

@@ -34,6 +34,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Iterator
 
+from comapreduce_tpu.telemetry import TELEMETRY
+
 __all__ = ["Prefetcher", "PrefetchItem", "iter_serial"]
 
 logger = logging.getLogger("comapreduce_tpu")
@@ -82,9 +84,11 @@ def _load_one(index: int, filename: str, loader, cache,
         if cache is not None:
             payload = cache.get(filename)
             if payload is not None:
+                read_s = time.perf_counter() - t0
+                TELEMETRY.event_span("ingest.read", read_s,
+                                     unit=filename, cached=True)
                 return PrefetchItem(index, filename, payload=payload,
-                                    read_s=time.perf_counter() - t0,
-                                    cached=True)
+                                    read_s=read_s, cached=True)
             # identity BEFORE the (possibly long) decode: a file
             # rewritten mid-read must not pair its new mtime with the
             # stale content we are about to load
@@ -111,12 +115,20 @@ def _load_one(index: int, filename: str, loader, cache,
         # h5py handle) must never reach the pickle-based disk spill
         if cache is not None and isinstance(payload, dict):
             cache.put(filename, payload, key=key)
+        read_s = time.perf_counter() - t0
+        # the read's TRUE interval, emitted on the thread that did the
+        # I/O — campaign_report's read/compute overlap integrates
+        # these span intersections, so they must carry actual read
+        # time, not the consumer-side bookkeeping moment
+        TELEMETRY.event_span("ingest.read", read_s, unit=filename,
+                             retries=retries)
         return PrefetchItem(index, filename, payload=payload,
-                            read_s=time.perf_counter() - t0,
-                            retries=retries)
+                            read_s=read_s, retries=retries)
     except Exception as exc:  # noqa: BLE001 — per-file fault tolerance
-        return PrefetchItem(index, filename, error=exc,
-                            read_s=time.perf_counter() - t0,
+        read_s = time.perf_counter() - t0
+        TELEMETRY.event_span("ingest.read", read_s, unit=filename,
+                             skipped=True, error=type(exc).__name__)
+        return PrefetchItem(index, filename, error=exc, read_s=read_s,
                             retries=getattr(exc, "_retries", retries))
 
 
@@ -221,8 +233,13 @@ class Prefetcher:
                 self._inflight = None
                 if not self._put(item):
                     return
+                depth = self._queue.qsize()
                 self.depth_log.append((time.perf_counter() - self._t0,
-                                       self._queue.qsize()))
+                                       depth))
+                # queue depth as a counter track: depth pinned at the
+                # bound = reads are ahead (healthy); pinned at 0 = the
+                # consumer is read-starved
+                TELEMETRY.gauge("ingest.queue_depth", depth)
                 index += 1
         except BaseException as exc:  # noqa: BLE001 — even SystemExit
             # from a loader must reach the consumer as a FATAL item:

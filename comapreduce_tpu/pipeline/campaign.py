@@ -169,18 +169,29 @@ _HOOK_LOCK = threading.Lock()
 
 
 def _on_event(event: str, **kwargs) -> None:
+    from comapreduce_tpu.telemetry import TELEMETRY
+
     if event == "/jax/compilation_cache/cache_hits":
         for c in list(_ACTIVE_COUNTERS):
             c._bump("cache_hits")
+        TELEMETRY.counter("jax.compile_cache.hits")
     elif event == "/jax/compilation_cache/cache_misses":
         for c in list(_ACTIVE_COUNTERS):
             c._bump("cache_misses")
+        TELEMETRY.counter("jax.compile_cache.misses")
 
 
 def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
     if event.endswith("backend_compile_duration"):
         for c in list(_ACTIVE_COUNTERS):
             c._bump("backend_compiles", duration_secs)
+        # every backend compile becomes a span: a steady-state
+        # campaign segment must show ZERO of these — the recompile
+        # gate campaign_report and check_perf read
+        from comapreduce_tpu.telemetry import TELEMETRY
+
+        TELEMETRY.event_span("jax.compile", duration_secs,
+                             event=event)
 
 
 def _install_hooks() -> None:

@@ -15,6 +15,7 @@ or legacy-INI config through the registry.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import socket
@@ -25,6 +26,8 @@ from dataclasses import dataclass, field
 from comapreduce_tpu.data.level import COMAPLevel1, COMAPLevel2
 from comapreduce_tpu.pipeline import config as cfg_mod
 from comapreduce_tpu.pipeline.registry import resolve
+from comapreduce_tpu.telemetry import (TELEMETRY, StageTimings,
+                                       TelemetryConfig)
 
 __all__ = ["Runner", "set_logging", "level2_path"]
 
@@ -74,6 +77,17 @@ def level2_path(output_dir: str, level1_filename: str,
     return os.path.join(output_dir, f"{prefix}_{base}")
 
 
+def _record_timing(timings, name: str, seconds: float, **kw) -> None:
+    """Append into ``timings`` through the spans-backed adapter when
+    present; a caller-supplied plain dict still works (and simply has
+    no skip tracking or span emission)."""
+    rec = getattr(timings, "record", None)
+    if rec is not None:
+        rec(name, seconds, **kw)
+    else:
+        timings.setdefault(name, []).append(float(seconds))
+
+
 @dataclass
 class Runner:
     """Run a stage chain over a filelist.
@@ -89,7 +103,11 @@ class Runner:
     prefix: str = "Level2"
     rank: int = 0
     n_ranks: int = 1
-    timings: dict = field(default_factory=dict)
+    # per-stage wall times; a StageTimings (telemetry/core.py): a real
+    # dict[str, list[float]] — every historic consumer keeps working —
+    # that also publishes spans and excludes skip-path placeholders
+    # from the watchdog's adaptive percentile via .samples()
+    timings: dict = field(default_factory=StageTimings)
     # when set, each file's stage chain runs under jax.profiler.trace
     # writing TensorBoard-readable traces here (the reference has no
     # profiler at all — SURVEY.md §5 'Tracing/profiling: none')
@@ -115,6 +133,12 @@ class Runner:
     # background thread (needs [ingest] compile_cache_dir). All off by
     # default (docs/OPERATIONS.md §9).
     campaign: object = None
+    # observability knob (TOML [telemetry]): TelemetryConfig |
+    # {"enabled": ..., "flush_s": ..., "jax_profiler": ...} | None.
+    # enabled=True streams spans/counters to <state_dir>/
+    # events.rank{r}.jsonl for tools/campaign_report.py; off by
+    # default (docs/OPERATIONS.md §13)
+    telemetry: object = None
     # cumulative async-writeback stats ({"writes", "write_s",
     # "flush_wait_s", ...}) across this Runner's run_tod calls — the
     # bench's write-overlap observable
@@ -170,6 +194,14 @@ class Runner:
         os.makedirs(self.output_dir, exist_ok=True)
         cfg = IngestConfig.coerce(self.ingest)
         camp = CampaignConfig.coerce(self.campaign)
+        tcfg = TelemetryConfig.coerce(self.telemetry)
+        if tcfg.enabled and not TELEMETRY.enabled:
+            # the registry is process-wide: the first enabled Runner
+            # opens this rank's stream; sub-runs (run_astro_cal) and
+            # later run_tod calls append to the same stream
+            TELEMETRY.configure(self.state_dir or self.output_dir,
+                                rank=self.rank, flush_s=tcfg.flush_s,
+                                jax_profiler=tcfg.jax_profiler)
         buckets = camp.shape_buckets()
         if buckets.enabled:
             # campaign shape canonicalisation (docs/OPERATIONS.md §9):
@@ -348,19 +380,24 @@ class Runner:
         if res is None:  # direct callers/tests without a runtime
             res = self._resilience_runtime()
         hb, wd = res.heartbeat, res.watchdog
+        n_ok = 0
         for item in stream:
             logger.info("rank %d: processing %s", self.rank, item.filename)
             if hb is not None:
                 hb.note(stage="stage_chain", unit=item.filename)
-            # errored reads record 0.0, keeping the per-file lists
-            # index-aligned WITHOUT feeding failure durations into the
-            # adaptive deadline percentile (timings backs
+            # errored reads record a SKIPPED 0.0, keeping the per-file
+            # lists index-aligned WITHOUT feeding failure durations
+            # into the adaptive deadline percentile (timings backs
             # watchdog.deadline_for): a hang-cancelled read lasts
             # ~attempts x hard deadline, and letting that into the p95
             # would grow the very budget that cancelled it — each
-            # generation of hangs inflating the next's, unbounded
-            self.timings.setdefault("ingest.read", []).append(
-                item.read_s if item.error is None else 0.0)
+            # generation of hangs inflating the next's, unbounded.
+            # emit=False: the read's TRUE interval was already
+            # published as a span by the prefetch/serial loader
+            _record_timing(self.timings, "ingest.read",
+                           item.read_s if item.error is None else 0.0,
+                           skipped=item.error is not None,
+                           unit=item.filename, emit=False)
             t0 = time.perf_counter()
             if item.error is not None:
                 # per-file fault tolerance: a bad file never kills the
@@ -374,8 +411,14 @@ class Runner:
                 res.record_failure(item.filename, item.error,
                                    stage="ingest.read")
                 results.append(None)
-                # keep the read/compute lists index-aligned per file
-                self.timings.setdefault("ingest.compute", []).append(0.0)
+                # keep the read/compute lists index-aligned per file;
+                # skipped=True keeps this placeholder out of the
+                # adaptive percentile (a mostly-failed or mostly-
+                # resumed campaign must not drag deadline budgets
+                # toward zero) — the telemetry span carries the
+                # skipped attribute instead
+                _record_timing(self.timings, "ingest.compute", 0.0,
+                               skipped=True, unit=item.filename)
                 if hb is not None:
                     hb.advance(files_failed=1)
                 # a failed read is still a HANDLED unit (ledgered): in
@@ -386,18 +429,27 @@ class Runner:
             # a retry-saved read is bookkeeping only, never skipped
             res.record_recovered(item.filename, item.retries,
                                  stage="ingest.read")
+            # [telemetry] jax_profiler: bracket exactly ONE steady-
+            # state file (the second success — the first paid compile)
+            # so the XLA device trace lines up with the host spans
+            prof = TELEMETRY.maybe_jax_profile(steady=n_ok == 1)
             try:
-                if wd is not None:
-                    # soft/hard monitoring only: a stage chain drives
-                    # jitted device compute and cannot be cancelled in
-                    # place — a blown hard deadline is flagged (event +
-                    # heartbeat + log), never killed mid-solve
-                    with wd.watch("pipeline.stage_chain",
-                                  unit=item.filename):
+                with prof or contextlib.nullcontext(), \
+                        TELEMETRY.span("ingest.compute",
+                                       unit=item.filename):
+                    if wd is not None:
+                        # soft/hard monitoring only: a stage chain
+                        # drives jitted device compute and cannot be
+                        # cancelled in place — a blown hard deadline is
+                        # flagged (event + heartbeat + log), never
+                        # killed mid-solve
+                        with wd.watch("pipeline.stage_chain",
+                                      unit=item.filename):
+                            value = self._run_file_with_retry(item, res)
+                    else:
                         value = self._run_file_with_retry(item, res)
-                else:
-                    value = self._run_file_with_retry(item, res)
                 results.append(value)
+                n_ok += 1
                 if hb is not None:
                     hb.advance(files_done=1)
             except Exception as exc:
@@ -413,8 +465,11 @@ class Runner:
                 if hb is not None:
                     hb.advance(files_failed=1)
             finally:
-                self.timings.setdefault("ingest.compute", []).append(
-                    time.perf_counter() - t0)
+                # emit=False: the compute span above already carries
+                # the true interval (including the error attr on a
+                # failed chain); this is the list-alignment record
+                _record_timing(self.timings, "ingest.compute",
+                               time.perf_counter() - t0, emit=False)
                 self._commit_unit(item.filename)
 
     def _run_file_with_retry(self, item, res):
@@ -557,9 +612,11 @@ class Runner:
             if hasattr(process, "clear_outputs"):
                 process.clear_outputs()  # no stale outputs across files
             t0 = time.perf_counter()
-            state = process(data, lvl2)
+            with TELEMETRY.span(pname,
+                                unit=os.path.basename(filename)):
+                state = process(data, lvl2)
             dt = time.perf_counter() - t0
-            self.timings.setdefault(pname, []).append(dt)
+            _record_timing(self.timings, pname, dt, emit=False)
             logger.info("%s: %.3f s (STATE=%s)", pname, dt, bool(state))
             if not state:
                 logger.info("%s returned falsy STATE; aborting %s",
@@ -635,6 +692,8 @@ class Runner:
                      prefix=self.prefix, rank=self.rank,
                      n_ranks=self.n_ranks, timings=self.timings,
                      ingest=self.ingest, resilience=self.resilience,
+                     telemetry=self.telemetry,
+                     state_dir=self.state_dir,
                      _ingest_cache=self._ingest_cache,
                      _resilience=res)
         results = sub.run_tod(filelist)
@@ -690,7 +749,12 @@ class Runner:
                    resilience=ResilienceConfig.coerce_campaign(
                        config.get("resilience")),
                    campaign=CampaignConfig.coerce(
-                       config.get("campaign")))
+                       config.get("campaign")),
+                   # [telemetry] enabled/flush_s/jax_profiler: spans +
+                   # counters to <log_dir>/events.rank{r}.jsonl
+                   # (docs/OPERATIONS.md §13)
+                   telemetry=TelemetryConfig.coerce(
+                       config.get("telemetry")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,
@@ -722,4 +786,6 @@ class Runner:
                    resilience=ResilienceConfig.coerce_campaign(
                        dict(ini.get("Resilience", {}))),
                    campaign=CampaignConfig.coerce(
-                       dict(ini.get("Campaign", {}))))
+                       dict(ini.get("Campaign", {}))),
+                   telemetry=TelemetryConfig.coerce(
+                       dict(ini.get("Telemetry", {})) or None))

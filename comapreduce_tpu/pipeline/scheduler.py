@@ -41,6 +41,7 @@ from typing import Iterator
 
 from comapreduce_tpu.data.durable import durable_replace
 from comapreduce_tpu.resilience.lease import Lease, LeaseBoard
+from comapreduce_tpu.telemetry import TELEMETRY
 
 __all__ = ["Scheduler", "QUEUE_MANIFEST"]
 
@@ -92,6 +93,13 @@ class Scheduler:
                       "done_elsewhere": 0, "abandoned": 0}
         self._write_manifest()
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        """Stats bump mirrored into the telemetry counter stream —
+        claim/steal/fence-reject rates become cross-rank counter
+        tracks in campaign_report's merged timeline."""
+        self.stats[key] += n
+        TELEMETRY.counter("scheduler." + key, n)
+
     # -- the queue ----------------------------------------------------------
     def claim_iter(self) -> Iterator[str]:
         """Yield every file this rank gets to reduce; returns when the
@@ -104,7 +112,7 @@ class Scheduler:
         pending = []  # held by other ranks: revisit in the steal loop
         for f in order:
             if self.board.is_done(f):
-                self.stats["done_elsewhere"] += 1
+                self._bump("done_elsewhere")
                 continue
             lease = self.board.claim(f)
             if lease is None:
@@ -118,14 +126,14 @@ class Scheduler:
             progressed = False
             for f in pending:
                 if self.board.is_done(f):
-                    self.stats["done_elsewhere"] += 1
+                    self._bump("done_elsewhere")
                     progressed = True
                     continue
                 lease = self.board.claim(f)  # released or fence-gap
                 if lease is None and self.board.expired(f):
                     lease = self.board.steal(f)
                     if lease is not None:
-                        self.stats["stolen"] += 1
+                        self._bump("stolen")
                         self._ledger_steal(f, lease)
                 if lease is None:
                     still.append(f)
@@ -150,9 +158,9 @@ class Scheduler:
             return False
         ok = self.board.commit(lease)
         if ok:
-            self.stats["committed"] += 1
+            self._bump("committed")
             if lease.stolen_from is not None:
-                self.stats["recovered"] += 1
+                self._bump("recovered")
                 self._ledger_recovered(filename, lease)
             # wake any map server tailing this campaign (best effort —
             # the done lease is the durable fact, this is only latency)
@@ -163,7 +171,7 @@ class Scheduler:
             except Exception:  # pragma: no cover - advisory only
                 pass
         else:
-            self.stats["fence_rejects"] += 1
+            self._bump("fence_rejects")
         return ok
 
     def release_held(self) -> int:
@@ -179,7 +187,7 @@ class Scheduler:
     # -- internals ----------------------------------------------------------
     def _grant(self, filename: str, lease: Lease) -> str:
         self._held[filename] = lease
-        self.stats["claimed"] += 1
+        self._bump("claimed")
         if self.chaos is not None:
             # rank_kill: SIGKILL self mid-lease (the preempted rank);
             # rank_pause: freeze the heartbeat but keep working (the
@@ -191,7 +199,7 @@ class Scheduler:
         return filename
 
     def _abandon(self, pending) -> None:
-        self.stats["abandoned"] += len(pending)
+        self._bump("abandoned", len(pending))
         logger.error(
             "scheduler rank %d: queue stalled for %.0f s with %d "
             "unit(s) still leased elsewhere and not expiring — "

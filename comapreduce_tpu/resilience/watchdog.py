@@ -229,7 +229,15 @@ class Watchdog:
         with self._lock:
             own = list(self.history.get(name, ()))
         try:
-            ext = list(self.timings.get(name, ()))
+            # a spans-backed StageTimings knows which entries are
+            # skip-path placeholders (errored reads, resumed files)
+            # and excludes them here — a mostly-resumed campaign must
+            # not drag the adaptive p95 (and with it every deadline
+            # budget) toward zero; a plain dict has no skip tracking
+            # and contributes everything, as before
+            sample = getattr(self.timings, "samples", None)
+            ext = list(sample(name)) if sample is not None \
+                else list(self.timings.get(name, ()))
         except AttributeError:
             ext = []
         return own + [float(v) for v in ext]

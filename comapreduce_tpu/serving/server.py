@@ -54,6 +54,7 @@ from comapreduce_tpu.serving.epochs import (EpochFenceError, EpochStore,
                                             epoch_name)
 from comapreduce_tpu.serving.ledger import SERVED_LEDGER, ServedLedger
 from comapreduce_tpu.serving.watcher import CommitWatcher, scan_committed
+from comapreduce_tpu.telemetry import TELEMETRY
 
 __all__ = ["MapServer", "STATS_JSON", "load_epoch_offsets"]
 
@@ -451,8 +452,16 @@ class MapServer:
             logger.warning("epoch publish fence-rejected: %s", exc)
             self.stats["fence_rejects"] = \
                 self.stats.get("fence_rejects", 0) + 1
+            TELEMETRY.counter("serving.fence_rejects")
             self._write_stats()
             return None
+        # the solve interval as a span, with the epoch vitals (fold
+        # size, warm-start iteration count, freshness) as attributes —
+        # the serving lane of campaign_report's merged timeline
+        TELEMETRY.event_span(
+            "serving.epoch", t_solve, unit=f"band{self.band}", epoch=n,
+            n_files=len(census), n_new=len(new_files), cg_iters=n_iter,
+            residual=residual, x0=x0_src, freshness_s=round(freshness, 3))
         self.stats["epochs"].append({
             "epoch": n, "n_files": len(census), "n_new": len(new_files),
             "n_iter": n_iter, "residual": residual, "x0": x0_src,

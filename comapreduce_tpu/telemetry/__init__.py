@@ -1,0 +1,18 @@
+"""Unified telemetry: structured spans, counters/gauges, trace export.
+
+The one source of truth every subsystem reports into and every
+consumer (CLI summary, ``tools/campaign_report.py``, the perf gates)
+reads out of — see docs/OPERATIONS.md §13.
+
+Import surface is deliberately light (stdlib only at import time):
+``TELEMETRY`` is safe to touch from any hot path.
+"""
+
+from comapreduce_tpu.telemetry.core import (TELEMETRY, StageTimings,
+                                            Telemetry, TelemetryConfig)
+from comapreduce_tpu.telemetry.reader import (MergedStream,
+                                              merge_streams,
+                                              read_events)
+
+__all__ = ["TELEMETRY", "Telemetry", "TelemetryConfig", "StageTimings",
+           "MergedStream", "merge_streams", "read_events"]

@@ -1,0 +1,466 @@
+"""Process-wide telemetry registry: structured spans + counters/gauges.
+
+One ``Telemetry`` singleton (``TELEMETRY``) per process emits
+structured events to an append-only per-rank ``events.rank{r}.jsonl``
+stream under ``[Global] log_dir`` — the same torn-line-tolerant JSONL
+discipline as the quarantine ledger (``resilience/ledger.py``): the
+writer repairs a torn trailing stump with a newline before appending,
+so a crash mid-write costs at most one line and never glues two
+records, and the reader (``telemetry/reader.py``) drops unparseable
+lines instead of dying.
+
+Event kinds (one JSON object per line, ``mono`` = ``time.monotonic()``
+seconds in the WRITER's clock domain — cross-rank alignment happens at
+read time through each stream's ``meta`` anchor ``wall0``/``mono0``):
+
+- ``meta``    stream header: schema, rank, pid, host, wall0/mono0.
+- ``begin``   a span OPENED (id, name, unit, tid, mono, parent).
+  A span with a ``begin`` but no matching ``span`` record was left
+  open by a crash/SIGKILL; the reader renders it explicitly truncated.
+- ``span``    a span CLOSED: begin fields + ``dur`` (seconds) +
+  ``attrs`` (free-form, e.g. ``skipped``/``error``/``bytes``).
+- ``counter`` a monotonic-count DELTA sample (``value`` adds).
+- ``gauge``   a point-in-time level sample (``value`` replaces).
+
+Overhead discipline: with telemetry disabled (the default) every
+public call is one attribute check and ``span()`` returns a shared
+no-op context manager — no allocation, no lock, no clock read. Enabled,
+events buffer in memory and a daemon thread drains them every
+``flush_s`` seconds (polling registered gauge callables on the same
+beat), so the hot path never touches the filesystem.
+
+``StageTimings`` is the spans-backed drop-in for ``Runner.timings``: a
+real ``dict[str, list[float]]`` (the watchdog's ``.get(name, ())`` and
+``run_average``'s ``sorted(...items())`` keep working unchanged) whose
+``record()`` also emits a completed span and tracks which entries are
+skip-path placeholders; ``samples(name)`` returns only the real
+measurements, which is what the watchdog's adaptive percentile reads —
+a campaign of mostly-resumed files no longer drags its p95 toward zero.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+__all__ = ["TELEMETRY", "Telemetry", "TelemetryConfig", "StageTimings"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+_SCHEMA = 1
+
+
+def _json_safe(obj):
+    """Best-effort scalarisation for numpy/jax leaves in attrs."""
+    try:
+        return float(obj)
+    except Exception:
+        return str(obj)
+
+
+class _NullSpan:
+    """The disabled-path span: one shared instance, no state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """A live span (use through ``Telemetry.span`` as a context
+    manager). ``begin`` is written on entry so a rank SIGKILLed
+    mid-span still leaves evidence; the full record with ``dur``
+    replaces it on exit. ``set(**attrs)`` attaches attributes any time
+    before exit; an exception exits the span with an ``error`` attr."""
+
+    __slots__ = ("_tele", "name", "unit", "attrs", "id", "parent", "t0")
+
+    def __init__(self, tele: "Telemetry", name: str, unit: str,
+                 attrs: dict):
+        self._tele = tele
+        self.name = name
+        self.unit = unit
+        self.attrs = attrs
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        tele = self._tele
+        self.id = next(tele._ids)
+        stack = tele._stack()
+        self.parent = stack[-1] if stack else 0
+        stack.append(self.id)
+        self.t0 = time.monotonic()
+        ev = {"kind": "begin", "id": self.id, "name": self.name,
+              "mono": self.t0, "tid": threading.current_thread().name}
+        if self.unit:
+            ev["unit"] = self.unit
+        if self.parent:
+            ev["parent"] = self.parent
+        tele._emit(ev)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        tele = self._tele
+        dur = time.monotonic() - self.t0
+        stack = tele._stack()
+        if stack and stack[-1] == self.id:
+            stack.pop()
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        ev = {"kind": "span", "id": self.id, "name": self.name,
+              "mono": self.t0, "dur": dur,
+              "tid": threading.current_thread().name}
+        if self.unit:
+            ev["unit"] = self.unit
+        if self.parent:
+            ev["parent"] = self.parent
+        if self.attrs:
+            ev["attrs"] = self.attrs
+        tele._emit(ev)
+        return False
+
+
+class Telemetry:
+    """The process-wide registry. Disabled until :meth:`configure`."""
+
+    def __init__(self):
+        self._enabled = False
+        self._lock = threading.Lock()
+        self._buf: list[dict] = []
+        self._path = ""
+        self._rank = 0
+        self._flush_s = 2.0
+        self.jax_profiler = False
+        self._jax_profiled = False
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._gauges: dict = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._write_failed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def log_dir(self) -> str:
+        return os.path.dirname(self._path) if self._path else ""
+
+    def configure(self, log_dir: str, rank: int = 0, *,
+                  flush_s: float = 2.0,
+                  jax_profiler: bool = False) -> "Telemetry":
+        """Open (or re-open) the per-rank event stream and start the
+        flush thread. Re-configuring into the same file appends — the
+        stream is append-only by contract, like the quarantine ledger."""
+        self.close()
+        os.makedirs(log_dir or ".", exist_ok=True)
+        self._path = os.path.join(log_dir or ".",
+                                  f"events.rank{int(rank)}.jsonl")
+        self._rank = int(rank)
+        self._flush_s = max(float(flush_s), 0.05)
+        self.jax_profiler = bool(jax_profiler)
+        self._jax_profiled = False
+        self._write_failed = False
+        self._stop = threading.Event()
+        self._enabled = True
+        # the stream anchor: readers align this rank's mono clock onto
+        # wall time through (wall0, mono0) — mono clocks of different
+        # hosts share no epoch, so every cross-rank merge needs this
+        self._emit({"kind": "meta", "schema": _SCHEMA, "rank": self._rank,
+                    "pid": os.getpid(), "host": socket.gethostname(),
+                    "wall0": time.time(), "mono0": time.monotonic()})
+        self.flush()
+        self._thread = threading.Thread(target=self._flush_loop,
+                                        name="telemetry-flush",
+                                        daemon=True)
+        self._thread.start()
+        # compile events become spans: the jax.monitoring dispatchers
+        # are process-lifetime (no removal API), installed once here so
+        # compile spans flow even without a CompileCounter in scope
+        try:
+            from comapreduce_tpu.pipeline.campaign import _install_hooks
+
+            _install_hooks()
+        except Exception:  # jax absent/odd backend: spans still work
+            pass
+        return self
+
+    def close(self) -> None:
+        """Stop the flush thread and drain the buffer. Idempotent;
+        leaves the registry disabled (configure() re-enables)."""
+        thread, self._thread = self._thread, None
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5.0)
+        if self._enabled:
+            self._poll_gauges()
+            self.flush()
+        self._enabled = False
+        self._gauges.clear()
+
+    # -- emission ----------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _emit(self, ev: dict) -> None:
+        with self._lock:
+            self._buf.append(ev)
+
+    def span(self, name: str, unit: str = "", **attrs):
+        """Context manager timing a live region (writes begin + span)."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return Span(self, name, unit, attrs)
+
+    def event_span(self, name: str, dur_s: float, unit: str = "",
+                   skipped: bool = False, **attrs) -> None:
+        """A completed span reported post-hoc: the common pattern for
+        regions whose duration the caller already measured. Emitted
+        promptly after the region ends, its ``[now-dur, now]`` interval
+        is the region's true extent (what the overlap fractions in
+        ``campaign_report`` integrate). ``skipped`` marks placeholder
+        durations (error/resume paths) that summaries must not count."""
+        if not self._enabled:
+            return
+        end = time.monotonic()
+        dur = max(float(dur_s), 0.0)
+        if skipped:
+            attrs["skipped"] = True
+        stack = self._stack()
+        ev = {"kind": "span", "id": next(self._ids), "name": name,
+              "mono": end - dur, "dur": dur,
+              "tid": threading.current_thread().name}
+        if unit:
+            ev["unit"] = unit
+        if stack:
+            ev["parent"] = stack[-1]
+        if attrs:
+            ev["attrs"] = attrs
+        self._emit(ev)
+
+    def counter(self, name: str, value: float = 1, **attrs) -> None:
+        """A monotonic-count delta (``value`` ADDS to the series)."""
+        if not self._enabled:
+            return
+        ev = {"kind": "counter", "name": name, "mono": time.monotonic(),
+              "value": value}
+        if attrs:
+            ev["attrs"] = attrs
+        self._emit(ev)
+
+    def gauge(self, name: str, value: float, **attrs) -> None:
+        """A point-in-time level (queue depth, bytes resident)."""
+        if not self._enabled:
+            return
+        ev = {"kind": "gauge", "name": name, "mono": time.monotonic(),
+              "value": value}
+        if attrs:
+            ev["attrs"] = attrs
+        self._emit(ev)
+
+    def register_gauge(self, name: str, fn) -> None:
+        """Register ``fn() -> number|None`` to be sampled on every
+        flush beat — the zero-hot-path-cost way to track levels that
+        change constantly (cache occupancy, cumulative hit counts)."""
+        if not self._enabled:
+            return
+        with self._lock:
+            self._gauges[name] = fn
+
+    def maybe_jax_profile(self, steady: bool):
+        """The opt-in ``jax.profiler.trace`` hook: returns a context
+        manager bracketing exactly ONE steady-state file per configure
+        (``[telemetry] jax_profiler``), writing device traces under
+        ``<log_dir>/jax_trace`` so XLA timelines line up with the host
+        spans. None everywhere else."""
+        if not (self._enabled and self.jax_profiler and steady) \
+                or self._jax_profiled:
+            return None
+        self._jax_profiled = True
+        out = os.path.join(self.log_dir or ".", "jax_trace")
+        try:
+            import jax
+
+            os.makedirs(out, exist_ok=True)
+            return jax.profiler.trace(out)
+        except Exception:  # profiler unsupported on this backend
+            logger.warning("telemetry: jax.profiler.trace unavailable; "
+                           "skipping device trace")
+            return None
+
+    # -- flushing ----------------------------------------------------------
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self._flush_s):
+            self._poll_gauges()
+            self.flush()
+
+    def _poll_gauges(self) -> None:
+        with self._lock:
+            gauges = list(self._gauges.items())
+        for name, fn in gauges:
+            try:
+                value = fn()
+            except Exception:  # a closed subsystem's gauge: drop it
+                with self._lock:
+                    self._gauges.pop(name, None)
+                continue
+            if value is not None:
+                self.gauge(name, value)
+
+    def flush(self) -> None:
+        """Drain the buffer to the stream (torn-line-safe append)."""
+        with self._lock:
+            buf, self._buf = self._buf, []
+        if not buf or not self._path:
+            return
+        payload = "".join(
+            json.dumps(ev, separators=(",", ":"), default=_json_safe)
+            + "\n" for ev in buf)
+        try:
+            # "a+b", not "ab": the torn-tail probe READS the last byte,
+            # and a write-only append handle turns that read into
+            # io.UnsupportedOperation (an OSError), silently skipping
+            # the heal; O_APPEND still pins every write to the end
+            with open(self._path, "a+b") as f:
+                # heal a torn trailing line from a previous crash with
+                # a newline FIRST: the stump stays (the reader drops
+                # it), but it can never glue onto this batch's first
+                # record (the ledger's exact discipline)
+                needs_nl = False
+                try:
+                    f.seek(-1, os.SEEK_END)
+                    needs_nl = f.read(1) != b"\n"
+                except OSError:
+                    pass
+                f.write((b"\n" if needs_nl else b"")
+                        + payload.encode("utf-8"))
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as exc:
+            if not self._write_failed:  # warn once, never kill the run
+                self._write_failed = True
+                logger.warning("telemetry: cannot append to %s (%s); "
+                               "events are being dropped",
+                               self._path, exc)
+
+
+TELEMETRY = Telemetry()
+atexit.register(TELEMETRY.close)
+
+
+class TelemetryConfig:
+    """The ``[telemetry]`` config table as a value object.
+
+    Knobs (all optional):
+
+    - ``enabled``       bool, default False — the whole subsystem is
+      opt-in; disabled it costs one attribute check per call site.
+    - ``flush_s``       float, default 2.0 — event-buffer drain (and
+      gauge sampling) period.
+    - ``jax_profiler``  bool, default False — bracket one steady-state
+      file per run in ``jax.profiler.trace`` (device traces under
+      ``<log_dir>/jax_trace``).
+
+    ``coerce`` accepts a TelemetryConfig (pass-through), a mapping, or
+    None, and rejects unknown keys — the same contract as
+    ``IngestConfig.coerce`` (a typo'd knob must raise, not silently
+    run with the default).
+    """
+
+    KNOBS = ("enabled", "flush_s", "jax_profiler")
+
+    __slots__ = KNOBS
+
+    def __init__(self, enabled: bool = False, flush_s: float = 2.0,
+                 jax_profiler: bool = False):
+        self.enabled = bool(enabled)
+        self.flush_s = max(float(flush_s), 0.05)
+        self.jax_profiler = bool(jax_profiler)
+
+    @classmethod
+    def coerce(cls, value) -> "TelemetryConfig":
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        unknown = set(value) - set(cls.KNOBS)
+        if unknown:
+            raise ValueError(
+                f"unknown [telemetry] option(s) {sorted(unknown)}; "
+                f"valid: {list(cls.KNOBS)}")
+        return cls(**dict(value))
+
+    def __repr__(self) -> str:  # debugging aid
+        return (f"TelemetryConfig(enabled={self.enabled}, "
+                f"flush_s={self.flush_s}, "
+                f"jax_profiler={self.jax_profiler})")
+
+
+class StageTimings(dict):
+    """``Runner.timings``, spans-backed.
+
+    A genuine ``dict[str, list[float]]`` — every existing consumer
+    (``watchdog.timings.get(name, ())``, ``sorted(runner.timings.
+    items())``, the benches' ``sum(timings["ingest.read"])``) works
+    unchanged, and per-file index alignment across lists is preserved
+    because placeholders are still appended. On top:
+
+    - ``record(name, seconds, skipped=..., unit=..., emit=...)``
+      appends AND (when telemetry is enabled and ``emit``) publishes a
+      completed span; ``skipped=True`` marks error/resume placeholders.
+    - ``samples(name)`` returns only the non-skipped measurements —
+      the watchdog's adaptive percentile reads THIS, so placeholder
+      zeros never drag deadline budgets toward zero.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._skips: dict[str, set] = {}
+
+    def record(self, name: str, seconds: float, *,
+               skipped: bool = False, unit: str = "",
+               emit: bool = True, **attrs) -> None:
+        vals = self.setdefault(name, [])
+        vals.append(float(seconds))
+        if skipped:
+            self._skips.setdefault(name, set()).add(len(vals) - 1)
+        if emit and TELEMETRY.enabled:
+            TELEMETRY.event_span(name, seconds, unit=unit,
+                                 skipped=skipped, **attrs)
+
+    def samples(self, name: str) -> list:
+        """Non-placeholder durations (the adaptive-deadline feed)."""
+        vals = self.get(name)
+        if not vals:
+            return []
+        skips = self._skips.get(name)
+        if not skips:
+            return list(vals)
+        return [v for i, v in enumerate(vals) if i not in skips]

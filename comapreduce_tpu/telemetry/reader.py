@@ -1,0 +1,166 @@
+"""Torn-line-tolerant reader + cross-rank merge for telemetry streams.
+
+``read_events`` loads one ``events.rank{r}.jsonl`` stream, dropping
+unparseable lines (a torn tail from a crashed writer, a stump healed
+by a later append) exactly like the quarantine ledger's loader.
+
+``merge_streams`` merges every rank's stream into one timeline:
+
+- **Clock alignment.** Monotonic clocks of different hosts (or even
+  different processes) share no epoch, so each stream's ``meta``
+  anchor ``(wall0, mono0)`` maps that writer's ``mono`` values onto
+  wall time: ``t = mono + (wall0 - mono0)``. Skewed mono bases between
+  ranks therefore cannot shear the merged timeline.
+- **Truncated spans.** A ``begin`` with no matching ``span`` record
+  is a span left open by a crash/SIGKILL. It is synthesised into a
+  span running to the stream's LAST observed timestamp and marked
+  ``truncated`` — rendered explicitly in the Chrome trace rather than
+  silently dropped (the evidence of where a rank died is the point).
+- **Namespacing.** Span ids are per-process counters; the merge
+  prefixes them ``r{rank}:`` so parent links never collide across
+  ranks.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+__all__ = ["read_events", "merge_streams", "MergedStream"]
+
+_RANK_RE = re.compile(r"events\.rank(\d+)\.jsonl$")
+
+
+def read_events(path: str) -> tuple[list[dict], int]:
+    """All parseable events of one stream + the dropped-line count."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    events, dropped = [], 0
+    for line in raw.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            ev = json.loads(line)
+        except Exception:
+            dropped += 1
+            continue
+        if isinstance(ev, dict):
+            events.append(ev)
+        else:
+            dropped += 1
+    return events, dropped
+
+
+@dataclass
+class MergedStream:
+    """The merged cross-rank timeline.
+
+    ``spans``/``counters``/``gauges`` are normalised events, each with
+    ``t`` (aligned wall seconds), ``rank``, ``name``; spans add
+    ``dur``, ``unit``, ``tid``, ``id``, ``parent``, ``attrs`` and the
+    convenience flags ``skipped``/``truncated``.
+    """
+
+    spans: list = field(default_factory=list)
+    counters: list = field(default_factory=list)
+    gauges: list = field(default_factory=list)
+    ranks: list = field(default_factory=list)
+    dropped_lines: int = 0
+
+    def spans_named(self, name: str, *, skipped: bool = False) -> list:
+        """Spans called ``name`` (skip-path placeholders excluded
+        unless ``skipped=True``)."""
+        return [s for s in self.spans if s["name"] == name
+                and (skipped or not s["skipped"])]
+
+    def span_names(self) -> list:
+        return sorted({s["name"] for s in self.spans})
+
+
+def _stream_paths(source) -> list[str]:
+    if isinstance(source, (list, tuple)):
+        return [str(p) for p in source]
+    if os.path.isdir(source):
+        paths = _glob.glob(os.path.join(source, "events.rank*.jsonl"))
+        return sorted(paths, key=lambda p: (
+            int(m.group(1)) if (m := _RANK_RE.search(p)) else 1 << 30, p))
+    return [str(source)]
+
+
+def merge_streams(source) -> MergedStream:
+    """Merge rank streams into one aligned timeline.
+
+    ``source``: a run's log directory (every ``events.rank*.jsonl``
+    in it), one stream path, or an explicit list of paths.
+    """
+    merged = MergedStream()
+    for path in _stream_paths(source):
+        events, dropped = read_events(path)
+        merged.dropped_lines += dropped
+        m = _RANK_RE.search(path)
+        rank = int(m.group(1)) if m else 0
+        offset = 0.0
+        for ev in events:
+            if ev.get("kind") == "meta":
+                rank = int(ev.get("rank", rank))
+                offset = float(ev.get("wall0", 0.0)) \
+                    - float(ev.get("mono0", 0.0))
+                break
+        if rank not in merged.ranks:
+            merged.ranks.append(rank)
+        last_t = 0.0
+        open_spans: dict = {}
+        closed: set = set()
+        for ev in events:
+            kind = ev.get("kind")
+            mono = float(ev.get("mono", 0.0))
+            t = mono + offset
+            if kind == "span":
+                t_end = t + float(ev.get("dur", 0.0))
+                last_t = max(last_t, t_end)
+                closed.add(ev.get("id"))
+                merged.spans.append(_norm_span(ev, rank, t))
+            elif kind == "begin":
+                last_t = max(last_t, t)
+                open_spans[ev.get("id")] = (ev, t)
+            elif kind in ("counter", "gauge"):
+                last_t = max(last_t, t)
+                target = merged.counters if kind == "counter" \
+                    else merged.gauges
+                target.append({"name": ev.get("name", ""), "rank": rank,
+                               "t": t,
+                               "value": float(ev.get("value", 0.0)),
+                               "attrs": ev.get("attrs") or {}})
+        for sid, (ev, t) in open_spans.items():
+            if sid in closed:
+                continue
+            # the rank died (or was SIGKILLed) inside this span: render
+            # it to the stream's last heartbeat of evidence, explicitly
+            # truncated — never silently dropped, never passed off as a
+            # clean completion
+            ev = dict(ev, dur=max(last_t - t, 0.0),
+                      attrs=dict(ev.get("attrs") or {}, truncated=True))
+            merged.spans.append(_norm_span(ev, rank, t, truncated=True))
+    merged.spans.sort(key=lambda s: s["t"])
+    merged.counters.sort(key=lambda c: c["t"])
+    merged.gauges.sort(key=lambda g: g["t"])
+    merged.ranks.sort()
+    return merged
+
+
+def _norm_span(ev: dict, rank: int, t: float,
+               truncated: bool = False) -> dict:
+    attrs = ev.get("attrs") or {}
+    sid = ev.get("id")
+    parent = ev.get("parent")
+    return {"name": ev.get("name", ""), "unit": ev.get("unit", ""),
+            "rank": rank, "tid": str(ev.get("tid", "main")),
+            "t": t, "dur": float(ev.get("dur", 0.0)),
+            "id": f"r{rank}:{sid}" if sid is not None else "",
+            "parent": f"r{rank}:{parent}" if parent else "",
+            "attrs": attrs,
+            "skipped": bool(attrs.get("skipped")),
+            "truncated": truncated or bool(attrs.get("truncated"))}

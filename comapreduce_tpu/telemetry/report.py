@@ -1,0 +1,333 @@
+"""Exports and summaries over a merged telemetry timeline.
+
+Three views of one ``MergedStream`` (see ``telemetry/reader.py``):
+
+- :func:`chrome_trace` — Chrome trace-event JSON (load in Perfetto or
+  ``chrome://tracing``): each rank is a process, each writer thread a
+  track, spans are complete (``"X"``) events, counters/gauges are
+  counter (``"C"``) tracks, truncated spans carry
+  ``args.truncated = true`` and a distinct colour.
+- :func:`prom_snapshot` — a Prometheus textfile-exporter ``.prom``
+  snapshot: counter totals, last gauge levels, span-duration
+  count/sum/quantiles, ready for ``node_exporter``'s textfile
+  collector.
+- :func:`summarize` / :func:`format_summary` — the terminal view:
+  per-stage count/p50/p95, read/compute/write overlap fractions
+  integrated from span intersections, and per-rank busy-time
+  imbalance.
+
+The per-stage quantile definitions here are THE definitions: the
+``run_average`` CLI prints its end-of-run table through
+:func:`format_duration_table`, and ``tools/check_perf.py`` gates the
+bench's own overlap measurement against :func:`summarize`'s — one
+truth for CLI, report, and CI.
+"""
+
+from __future__ import annotations
+
+import json
+
+from comapreduce_tpu.resilience.watchdog import percentile
+
+__all__ = ["chrome_trace", "prom_snapshot", "summarize",
+           "format_summary", "span_overlap", "overlap_seconds",
+           "duration_rows", "format_duration_table"]
+
+
+# -- interval algebra --------------------------------------------------------
+
+def _union(intervals) -> list:
+    """Merge ``(t0, t1)`` intervals into a disjoint sorted union."""
+    out = []
+    for t0, t1 in sorted(i for i in intervals if i[1] > i[0]):
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _measure(union) -> float:
+    return sum(t1 - t0 for t0, t1 in union)
+
+
+def _intersection(ua, ub) -> float:
+    """Total overlap length of two disjoint sorted unions."""
+    total, i, j = 0.0, 0, 0
+    while i < len(ua) and j < len(ub):
+        lo = max(ua[i][0], ub[j][0])
+        hi = min(ua[i][1], ub[j][1])
+        if hi > lo:
+            total += hi - lo
+        if ua[i][1] < ub[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def span_overlap(merged, name_a: str, name_b: str,
+                 t0: float | None = None,
+                 t1: float | None = None) -> float:
+    """Fraction of the SHORTER activity hidden under the other one,
+    integrated from actual span intersections per rank (cross-rank
+    "overlap" is meaningless — two ranks are always concurrent):
+    ``sum_r |A_r ∩ B_r| / min(sum_r |A_r|, sum_r |B_r|)``.
+
+    ``t0``/``t1`` clip to a window (e.g. the steady-state segment).
+    Returns 0.0 when either side is empty.
+    """
+    inter = tot_a = tot_b = 0.0
+    for rank in merged.ranks:
+        ua = _union(_intervals(merged, name_a, rank, t0, t1))
+        ub = _union(_intervals(merged, name_b, rank, t0, t1))
+        inter += _intersection(ua, ub)
+        tot_a += _measure(ua)
+        tot_b += _measure(ub)
+    floor = min(tot_a, tot_b)
+    return inter / floor if floor > 0 else 0.0
+
+
+def overlap_seconds(merged, name_a: str, name_b: str,
+                    t0: float | None = None,
+                    t1: float | None = None) -> float:
+    """Raw intersection seconds of two span families, summed per rank
+    (the numerator of :func:`span_overlap`). The bench normalises this
+    by its own steady wall clock — a large, stable denominator — so
+    the telemetry-vs-bench overlap comparison in ``check_perf`` is not
+    hostage to the (often tiny) total read time."""
+    inter = 0.0
+    for rank in merged.ranks:
+        inter += _intersection(
+            _union(_intervals(merged, name_a, rank, t0, t1)),
+            _union(_intervals(merged, name_b, rank, t0, t1)))
+    return inter
+
+
+def _intervals(merged, name, rank, t0, t1):
+    for s in merged.spans_named(name):
+        if s["rank"] != rank:
+            continue
+        a, b = s["t"], s["t"] + s["dur"]
+        if t0 is not None:
+            a = max(a, t0)
+        if t1 is not None:
+            b = min(b, t1)
+        if b > a:
+            yield (a, b)
+
+
+# -- the shared per-stage duration table ------------------------------------
+
+def duration_rows(timings) -> list:
+    """Summary rows for a ``{name: [seconds, ...]}`` mapping (a
+    ``StageTimings``, a plain dict, or span-derived lists). Skip-path
+    placeholders are excluded when the mapping knows about them
+    (``StageTimings.samples``); the placeholder count is reported as
+    ``skipped`` so the total file count stays visible."""
+    sample_fn = getattr(timings, "samples", None)
+    rows = []
+    for name in sorted(timings):
+        vals = list(timings[name])
+        kept = list(sample_fn(name)) if sample_fn is not None else vals
+        rows.append({
+            "name": name, "count": len(kept),
+            "skipped": len(vals) - len(kept),
+            "total_s": sum(kept),
+            "mean_s": sum(kept) / len(kept) if kept else 0.0,
+            "p50_s": percentile(kept, 50.0) if kept else 0.0,
+            "p95_s": percentile(kept, 95.0) if kept else 0.0})
+    return rows
+
+
+def format_duration_table(timings) -> str:
+    """The end-of-run stage table (used by ``run_average``): one
+    definition of count/mean/p50/p95 shared with ``campaign_report``."""
+    lines = []
+    for r in duration_rows(timings):
+        skip = f" (+{r['skipped']} skipped)" if r["skipped"] else ""
+        lines.append(
+            f"{r['name']}: {r['total_s']:.2f} s over {r['count']} "
+            f"files{skip} | mean {r['mean_s']:.3f} p50 {r['p50_s']:.3f} "
+            f"p95 {r['p95_s']:.3f}")
+    return "\n".join(lines)
+
+
+# -- terminal summary --------------------------------------------------------
+
+def summarize(merged, t0: float | None = None,
+              t1: float | None = None) -> dict:
+    """The operator summary of a merged timeline: per-stage
+    count/p50/p95, overlap fractions from span intersections, per-rank
+    busy seconds + load imbalance, truncation/drop evidence."""
+    stages = {}
+    for name in merged.span_names():
+        durs = [s["dur"] for s in merged.spans_named(name)
+                if _in_window(s, t0, t1)]
+        skipped = sum(1 for s in merged.spans_named(name, skipped=True)
+                      if s["skipped"] and _in_window(s, t0, t1))
+        if durs or skipped:
+            stages[name] = {
+                "count": len(durs), "skipped": skipped,
+                "total_s": sum(durs),
+                "p50_s": percentile(durs, 50.0) if durs else 0.0,
+                "p95_s": percentile(durs, 95.0) if durs else 0.0}
+    busy = {}
+    for rank in merged.ranks:
+        busy[rank] = _measure(_union(
+            _intervals(merged, "ingest.compute", rank, t0, t1)))
+    vals = [v for v in busy.values()]
+    mean_busy = sum(vals) / len(vals) if vals else 0.0
+    return {
+        "stages": stages,
+        "overlap": {
+            "read_compute": span_overlap(merged, "ingest.read",
+                                         "ingest.compute", t0, t1),
+            "write_compute": span_overlap(merged, "writeback.write",
+                                          "ingest.compute", t0, t1)},
+        "ranks": {
+            "busy_s": {str(r): busy[r] for r in merged.ranks},
+            # max/mean busy: 1.0 = perfectly balanced; 2.0 = the
+            # slowest rank carries twice the average load
+            "imbalance": (max(vals) / mean_busy
+                          if vals and mean_busy > 0 else 1.0)},
+        "truncated_spans": sum(1 for s in merged.spans
+                               if s["truncated"]),
+        "dropped_lines": merged.dropped_lines}
+
+
+def _in_window(s, t0, t1) -> bool:
+    if t0 is not None and s["t"] + s["dur"] < t0:
+        return False
+    if t1 is not None and s["t"] > t1:
+        return False
+    return True
+
+
+def format_summary(summary: dict) -> str:
+    lines = ["per-stage durations:"]
+    for name, st in sorted(summary["stages"].items()):
+        skip = f" (+{st['skipped']} skipped)" if st["skipped"] else ""
+        lines.append(
+            f"  {name}: {st['total_s']:.2f} s over {st['count']} "
+            f"spans{skip} | p50 {st['p50_s']:.3f} p95 {st['p95_s']:.3f}")
+    ov = summary["overlap"]
+    lines.append(f"overlap: read/compute {ov['read_compute']:.2f}, "
+                 f"write/compute {ov['write_compute']:.2f}")
+    ranks = summary["ranks"]
+    per_rank = ", ".join(f"r{r}={v:.2f}s"
+                         for r, v in sorted(ranks["busy_s"].items()))
+    lines.append(f"rank busy: {per_rank} "
+                 f"(imbalance {ranks['imbalance']:.2f})")
+    if summary["truncated_spans"]:
+        lines.append(f"TRUNCATED spans (rank died mid-span): "
+                     f"{summary['truncated_spans']}")
+    if summary["dropped_lines"]:
+        lines.append(f"dropped (torn) stream lines: "
+                     f"{summary['dropped_lines']}")
+    return "\n".join(lines)
+
+
+# -- Chrome trace-event export ----------------------------------------------
+
+def chrome_trace(merged) -> dict:
+    """Chrome trace-event JSON (Perfetto-loadable): ranks as
+    processes, writer threads as tracks, counters/gauges as counter
+    tracks. Times are microseconds relative to the earliest event so
+    Perfetto's viewport opens on the data."""
+    events = []
+    starts = ([s["t"] for s in merged.spans]
+              + [c["t"] for c in merged.counters]
+              + [g["t"] for g in merged.gauges])
+    t_base = min(starts) if starts else 0.0
+    tids: dict = {}
+
+    def tid_of(rank, name):
+        key = (rank, name)
+        if key not in tids:
+            tids[key] = len([k for k in tids if k[0] == rank]) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": rank, "tid": tids[key],
+                           "args": {"name": name}})
+        return tids[key]
+
+    for rank in merged.ranks:
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+    for s in merged.spans:
+        args = {k: v for k, v in s["attrs"].items()}
+        if s["unit"]:
+            args["unit"] = s["unit"]
+        if s["truncated"]:
+            args["truncated"] = True
+        ev = {"ph": "X", "name": s["name"], "pid": s["rank"],
+              "tid": tid_of(s["rank"], s["tid"]),
+              "ts": (s["t"] - t_base) * 1e6,
+              "dur": s["dur"] * 1e6, "args": args}
+        if s["truncated"]:
+            ev["cname"] = "terrible"  # renders the cut visibly
+        events.append(ev)
+    # counters accumulate (delta samples -> running total); gauges are
+    # levels as-is — both become "C" counter tracks
+    totals: dict = {}
+    for c in merged.counters:
+        key = (c["rank"], c["name"])
+        totals[key] = totals.get(key, 0.0) + c["value"]
+        events.append({"ph": "C", "name": c["name"], "pid": c["rank"],
+                       "ts": (c["t"] - t_base) * 1e6,
+                       "args": {"value": totals[key]}})
+    for g in merged.gauges:
+        events.append({"ph": "C", "name": g["name"], "pid": g["rank"],
+                       "ts": (g["t"] - t_base) * 1e6,
+                       "args": {"value": g["value"]}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- Prometheus textfile snapshot -------------------------------------------
+
+def _prom_name(name: str) -> str:
+    return "comap_" + "".join(
+        ch if ch.isalnum() or ch == "_" else "_" for ch in name)
+
+
+def prom_snapshot(merged) -> str:
+    """A textfile-exporter snapshot: counter totals, final gauge
+    levels, span-duration count/sum + p50/p95 quantile gauges."""
+    out = []
+    totals: dict = {}
+    for c in merged.counters:
+        key = (c["name"], c["rank"])
+        totals[key] = totals.get(key, 0.0) + c["value"]
+    for (name, rank), total in sorted(totals.items()):
+        mname = _prom_name(name) + "_total"
+        out.append(f"# TYPE {mname} counter")
+        out.append(f'{mname}{{rank="{rank}"}} {total:g}')
+    last: dict = {}
+    for g in merged.gauges:  # time-sorted: last write wins
+        last[(g["name"], g["rank"])] = g["value"]
+    for (name, rank), value in sorted(last.items()):
+        mname = _prom_name(name)
+        out.append(f"# TYPE {mname} gauge")
+        out.append(f'{mname}{{rank="{rank}"}} {value:g}')
+    for name in merged.span_names():
+        durs = [s["dur"] for s in merged.spans_named(name)]
+        if not durs:
+            continue
+        base = _prom_name(name) + "_seconds"
+        out.append(f"# TYPE {base} summary")
+        for q in (50.0, 95.0):
+            out.append(f'{base}{{quantile="{q / 100:g}"}} '
+                       f"{percentile(durs, q):g}")
+        out.append(f"{base}_sum {sum(durs):g}")
+        out.append(f"{base}_count {len(durs)}")
+    return "\n".join(out) + "\n"
+
+
+def write_trace(merged, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(merged), f)
+
+
+def write_prom(merged, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(prom_snapshot(merged))

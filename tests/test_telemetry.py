@@ -1,0 +1,318 @@
+"""Unified telemetry (ISSUE 10): spans, streams, merge, reports.
+
+Covers the writer/reader round-trip (begin+span pairing, parent links,
+counters/gauges), the quarantine-ledger torn-line discipline (a
+SIGKILLed writer's stump is healed, never glued onto a later append),
+cross-rank monotonic clock skew alignment through the meta anchors,
+SIGKILL-truncated open spans rendered explicitly truncated in the
+Chrome trace, the ``StageTimings`` skip-path exclusion feeding the
+watchdog's adaptive percentile, the shared duration-table formatter,
+overlap integration, the Prometheus snapshot, disabled-path no-ops,
+config coercion, and the Runner integration end to end.
+"""
+
+import json
+
+import pytest
+
+from comapreduce_tpu.telemetry import (TELEMETRY, StageTimings,
+                                       Telemetry, TelemetryConfig,
+                                       merge_streams, read_events)
+from comapreduce_tpu.telemetry.report import (chrome_trace,
+                                              format_duration_table,
+                                              overlap_seconds,
+                                              prom_snapshot,
+                                              span_overlap, summarize)
+
+
+def _write_stream(path, events):
+    with open(path, "w", encoding="utf-8") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+# -- writer/reader round-trip -----------------------------------------------
+
+def test_span_counter_gauge_roundtrip(tmp_path):
+    tele = Telemetry()
+    tele.configure(str(tmp_path), rank=0, flush_s=60)
+    with tele.span("work", unit="f1") as sp:
+        sp.set(bytes=10)
+        with tele.span("inner"):
+            pass
+    tele.event_span("post", 0.5, unit="f2")
+    tele.counter("hits", 2)
+    tele.gauge("depth", 3)
+    tele.close()
+
+    merged = merge_streams(str(tmp_path))
+    assert merged.ranks == [0]
+    assert merged.dropped_lines == 0
+    assert merged.span_names() == ["inner", "post", "work"]
+    work = merged.spans_named("work")[0]
+    inner = merged.spans_named("inner")[0]
+    assert inner["parent"] == work["id"]  # the live-span stack nests
+    assert work["attrs"]["bytes"] == 10
+    assert work["unit"] == "f1"
+    # begin + span closed cleanly: nothing renders truncated
+    assert not any(s["truncated"] for s in merged.spans)
+    (c,) = merged.counters
+    assert (c["name"], c["value"]) == ("hits", 2)
+    (g,) = merged.gauges
+    assert (g["name"], g["value"]) == ("depth", 3)
+
+
+def test_event_span_skipped_excluded_by_default(tmp_path):
+    tele = Telemetry()
+    tele.configure(str(tmp_path), rank=0, flush_s=60)
+    tele.event_span("ingest.read", 1.0, unit="good.hd5")
+    tele.event_span("ingest.read", 0.0, unit="bad.hd5", skipped=True,
+                    error="OSError")
+    tele.close()
+    merged = merge_streams(str(tmp_path))
+    assert len(merged.spans_named("ingest.read")) == 1
+    both = merged.spans_named("ingest.read", skipped=True)
+    assert len(both) == 2
+    assert both[-1]["attrs"]["error"] == "OSError"
+
+
+# -- torn-line discipline ---------------------------------------------------
+
+def test_torn_tail_healed_not_glued(tmp_path):
+    path = tmp_path / "events.rank0.jsonl"
+    tele = Telemetry()
+    tele.configure(str(tmp_path), rank=0, flush_s=60)
+    tele.counter("first_writer", 1)
+    tele.close()
+    # SIGKILL mid-write: chop the final record mid-line
+    raw = path.read_bytes()
+    assert raw.endswith(b"\n")
+    path.write_bytes(raw[:-9])
+
+    # a later writer (the restarted rank) appends to the same stream
+    tele2 = Telemetry()
+    tele2.configure(str(tmp_path), rank=0, flush_s=60)
+    tele2.counter("second_writer", 2)
+    tele2.close()
+
+    events, dropped = read_events(str(path))
+    # the stump is dropped — but the record appended AFTER it parses,
+    # which is only possible if the writer healed the tear with a
+    # newline instead of gluing its first record onto the stump
+    assert dropped == 1
+    counters = [e["name"] for e in events if e.get("kind") == "counter"]
+    assert counters == ["second_writer"]
+    assert sum(1 for e in events if e.get("kind") == "meta") == 2
+    merged = merge_streams(str(tmp_path))
+    assert merged.dropped_lines == 1
+
+
+# -- cross-rank clock alignment ---------------------------------------------
+
+def test_merge_aligns_skewed_rank_clocks(tmp_path):
+    # two ranks whose monotonic clocks share no epoch (different boot
+    # times): the same wall instant must land at the same merged t
+    _write_stream(tmp_path / "events.rank0.jsonl", [
+        {"kind": "meta", "schema": 1, "rank": 0,
+         "wall0": 1000.0, "mono0": 0.0},
+        {"kind": "span", "id": 1, "name": "ingest.compute",
+         "mono": 5.0, "dur": 2.0},
+    ])
+    _write_stream(tmp_path / "events.rank1.jsonl", [
+        {"kind": "meta", "schema": 1, "rank": 1,
+         "wall0": 1000.0, "mono0": 700.0},
+        {"kind": "span", "id": 1, "name": "ingest.compute",
+         "mono": 705.0, "dur": 2.0},
+    ])
+    merged = merge_streams(str(tmp_path))
+    assert merged.ranks == [0, 1]
+    t0, t1 = (s["t"] for s in merged.spans)
+    assert t0 == pytest.approx(t1)      # both at wall 1005
+    assert t0 == pytest.approx(1005.0)
+    # per-rank span ids never collide across the merge
+    assert {s["id"] for s in merged.spans} == {"r0:1", "r1:1"}
+
+
+# -- truncated open spans ---------------------------------------------------
+
+def test_sigkill_open_span_rendered_truncated(tmp_path):
+    _write_stream(tmp_path / "events.rank0.jsonl", [
+        {"kind": "meta", "schema": 1, "rank": 0,
+         "wall0": 100.0, "mono0": 0.0},
+        {"kind": "begin", "id": 1, "name": "ingest.compute",
+         "mono": 1.0, "tid": "MainThread", "unit": "dead.hd5"},
+        {"kind": "counter", "name": "heartbeat", "mono": 4.0,
+         "value": 1},
+    ])
+    merged = merge_streams(str(tmp_path))
+    (tr,) = [s for s in merged.spans if s["truncated"]]
+    assert tr["name"] == "ingest.compute"
+    # the span runs to the stream's last evidence, not to zero
+    assert tr["dur"] == pytest.approx(3.0)
+
+    trace = chrome_trace(merged)
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 1
+    assert xs[0]["args"]["truncated"] is True
+    assert xs[0]["cname"] == "terrible"  # visibly marked in Perfetto
+    json.dumps(trace)  # exportable as-is
+
+    s = summarize(merged)
+    assert s["truncated_spans"] == 1
+
+
+# -- StageTimings + watchdog adaptive percentile ----------------------------
+
+def test_stage_timings_skip_exclusion_feeds_watchdog():
+    from comapreduce_tpu.resilience.watchdog import (Watchdog,
+                                                     parse_deadlines)
+
+    t = StageTimings()
+    for _ in range(8):
+        t.record("ingest.read", 10.0, emit=False)
+    for _ in range(192):  # a mostly-resumed campaign: placeholder zeros
+        t.record("ingest.read", 0.0, skipped=True, emit=False)
+    # the dict view keeps every entry (index alignment across lists)
+    assert len(t["ingest.read"]) == 200
+    assert t.samples("ingest.read") == [10.0] * 8
+
+    wd = Watchdog(parse_deadlines("ingest.read=1/2"), timings=t,
+                  scale=4.0, min_s=1.0, history_min=8)
+    # p95 over the REAL samples (10 s) x scale, not dragged to zero
+    assert wd.deadline_for("ingest.read").hard_s == pytest.approx(40.0)
+
+    # a plain dict has no skip tracking: the placeholders dominate the
+    # p95 and the adaptive extension never engages — the regression
+    # this subsystem exists to fix
+    wd2 = Watchdog(parse_deadlines("ingest.read=1/2"),
+                   timings={"ingest.read": list(t["ingest.read"])},
+                   scale=4.0, min_s=1.0, history_min=8)
+    assert wd2.deadline_for("ingest.read").hard_s == pytest.approx(2.0)
+
+
+def test_format_duration_table_marks_skips():
+    t = StageTimings()
+    t.record("stage", 1.0, emit=False)
+    t.record("stage", 3.0, emit=False)
+    t.record("stage", 0.0, skipped=True, emit=False)
+    out = format_duration_table(t)
+    assert "stage: 4.00 s over 2 files (+1 skipped)" in out
+    # a plain dict still formats (no skip tracking: everything counts)
+    assert "over 3 files" in format_duration_table(dict(t))
+
+
+# -- overlap integration ----------------------------------------------------
+
+def test_span_overlap_from_intersections(tmp_path):
+    _write_stream(tmp_path / "events.rank0.jsonl", [
+        {"kind": "meta", "schema": 1, "rank": 0,
+         "wall0": 0.0, "mono0": 0.0},
+        {"kind": "span", "id": 1, "name": "ingest.read",
+         "mono": 0.0, "dur": 1.0},
+        {"kind": "span", "id": 2, "name": "ingest.read",
+         "mono": 2.0, "dur": 1.0},
+        {"kind": "span", "id": 3, "name": "ingest.compute",
+         "mono": 0.5, "dur": 2.0},
+    ])
+    merged = merge_streams(str(tmp_path))
+    # reads [0,1]+[2,3] vs compute [0.5,2.5]: intersection 1.0 s,
+    # min(total read 2.0, total compute 2.0) = 2.0
+    assert overlap_seconds(merged, "ingest.read",
+                           "ingest.compute") == pytest.approx(1.0)
+    assert span_overlap(merged, "ingest.read",
+                        "ingest.compute") == pytest.approx(0.5)
+    # window clipping to the second read only
+    assert span_overlap(merged, "ingest.read", "ingest.compute",
+                        t0=2.0, t1=3.0) == pytest.approx(1.0)
+    s = summarize(merged)
+    assert s["overlap"]["read_compute"] == pytest.approx(0.5)
+    assert s["ranks"]["imbalance"] == pytest.approx(1.0)
+
+
+# -- exports ----------------------------------------------------------------
+
+def test_prom_snapshot_and_counter_accumulation(tmp_path):
+    _write_stream(tmp_path / "events.rank0.jsonl", [
+        {"kind": "meta", "schema": 1, "rank": 0,
+         "wall0": 0.0, "mono0": 0.0},
+        {"kind": "counter", "name": "scheduler.claimed", "mono": 1.0,
+         "value": 1},
+        {"kind": "counter", "name": "scheduler.claimed", "mono": 2.0,
+         "value": 2},
+        {"kind": "gauge", "name": "ingest.queue_depth", "mono": 2.5,
+         "value": 4},
+        {"kind": "span", "id": 1, "name": "ingest.compute",
+         "mono": 0.0, "dur": 2.0},
+    ])
+    merged = merge_streams(str(tmp_path))
+    prom = prom_snapshot(merged)
+    # counters are DELTAS: the snapshot totals them
+    assert 'comap_scheduler_claimed_total{rank="0"} 3' in prom
+    assert 'comap_ingest_queue_depth{rank="0"} 4' in prom
+    assert "comap_ingest_compute_seconds_count 1" in prom
+
+    trace = chrome_trace(merged)
+    cs = [e for e in trace["traceEvents"]
+          if e.get("ph") == "C" and e["name"] == "scheduler.claimed"]
+    # the Chrome counter track shows the running total
+    assert [c["args"]["value"] for c in cs] == [1, 3]
+
+
+# -- disabled path / config -------------------------------------------------
+
+def test_disabled_is_noop():
+    tele = Telemetry()
+    assert not tele.enabled
+    # the shared null span: no allocation on the disabled hot path
+    assert tele.span("x") is tele.span("y")
+    with tele.span("x") as sp:
+        sp.set(anything=1)
+    tele.event_span("x", 1.0)
+    tele.counter("c")
+    tele.gauge("g", 1)
+    tele.register_gauge("r", lambda: 1)
+    assert tele.maybe_jax_profile(steady=True) is None
+    assert tele.path == ""
+    tele.close()  # idempotent on a never-configured registry
+
+
+def test_config_coerce():
+    cfg = TelemetryConfig.coerce({"enabled": True, "flush_s": 0.2})
+    assert cfg.enabled and cfg.flush_s == pytest.approx(0.2)
+    assert not TelemetryConfig.coerce(None).enabled
+    assert TelemetryConfig.coerce(cfg) is cfg
+    with pytest.raises(ValueError, match="unknown"):
+        TelemetryConfig.coerce({"enable": True})  # typo'd knob raises
+    # flush floor: a zero period must not spin the flush thread
+    assert TelemetryConfig.coerce({"flush_s": 0}).flush_s >= 0.05
+
+
+# -- Runner integration -----------------------------------------------------
+
+def test_runner_emits_stream(tmp_path):
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+    from comapreduce_tpu.pipeline import Runner
+    from comapreduce_tpu.pipeline.stages import CheckLevel1File
+
+    path = str(tmp_path / "comap-0000001-synth.hd5")
+    generate_level1_file(path, SyntheticObsParams(
+        obsid=1, seed=1, n_feeds=1, n_bands=1, n_channels=4,
+        n_scans=1, scan_samples=64, vane_samples=16))
+    out = str(tmp_path / "out")
+    TELEMETRY.close()  # a previous test must not hold the singleton
+    runner = Runner(processes=[CheckLevel1File(min_duration_seconds=0.0)],
+                    output_dir=out,
+                    telemetry={"enabled": True, "flush_s": 60},
+                    resilience={"quarantine": "off", "heartbeat_s": 0})
+    try:
+        runner.run_tod([path])
+    finally:
+        TELEMETRY.close()
+    assert isinstance(runner.timings, StageTimings)
+    merged = merge_streams(out)
+    assert merged.spans_named("ingest.compute")
+    assert merged.spans_named("ingest.read", skipped=True)
+    assert merged.spans_named("CheckLevel1File")
+    # and the whole stream exports
+    json.dumps(chrome_trace(merged))

@@ -38,6 +38,16 @@ warm-up, async writeback) must show steady-state backend compiles
 canonicalisation or warm-up fails here. Machine-independent (it is a
 count, not a throughput); ``--no-campaign`` skips it.
 
+The telemetry gates (ISSUE 10) also run by default with the campaign
+gate: the campaign bench runs with telemetry enabled and its merged
+event stream must (a) export valid Chrome trace JSON, (b) recompute
+the steady-state backend-compile count EXACTLY from ``jax.compile``
+spans, and (c) reproduce the bench's own read/compute overlap fraction
+within 0.05 — all machine-independent (one run cross-checked against
+itself). A second campaign run with ``BENCH_TELEMETRY=0`` then gates
+the enabled-vs-disabled steady wall within 3% (+0.25 s floor);
+``--no-telemetry-overhead`` skips that A/B.
+
 The serving warm-start gate (ISSUE 9) also runs by default: one
 ``bench.py --config serving`` smoke (incremental map server folding
 three commit waves) must show the final WARM epoch converging in
@@ -81,13 +91,15 @@ def run_quick_bench() -> dict:
     raise RuntimeError("no bench result line found in bench.py output")
 
 
-def run_campaign_bench() -> dict:
-    """One small-shape campaign bench child -> its parsed JSON line."""
+def run_campaign_bench(telemetry: bool = True) -> dict:
+    """One small-shape campaign bench child -> its parsed JSON line.
+    ``telemetry=False`` is the overhead A/B's control run."""
     env = dict(os.environ)
     env.update({
         "BENCH_SMALL": "1",
         "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
         "BENCH_EVIDENCE": "0",
+        "BENCH_TELEMETRY": "1" if telemetry else "0",
     })
     out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
                           "--config", "campaign"],
@@ -195,6 +207,9 @@ def main(argv=None) -> int:
                          "no-recompile gates still run")
     ap.add_argument("--no-campaign", action="store_true",
                     help="skip the campaign no-recompile gate")
+    ap.add_argument("--no-telemetry-overhead", action="store_true",
+                    help="skip the telemetry disabled-overhead A/B "
+                         "(a second campaign bench run)")
     ap.add_argument("--no-destriper", action="store_true",
                     help="skip the destriper memory/iteration gate")
     ap.add_argument("--no-serving", action="store_true",
@@ -270,6 +285,54 @@ def main(argv=None) -> int:
                 f"{camp['compiles_campaign_steady']} backend compiles > "
                 f"bucket count {camp['bucket_count']} (shape "
                 f"canonicalisation or compile warm-up regressed?)")
+        # the telemetry cross-check gate (ISSUE 10): both halves are
+        # machine-independent — the span-recomputed compile count is an
+        # exact equality against the CompileCounter on the SAME run,
+        # and the overlap comparison is two measurements of one run's
+        # own timeline (never a throughput vs a committed reference)
+        tele = camp.get("telemetry") or {}
+        campaign["telemetry"] = tele or None
+        if tele:
+            if not tele.get("trace_valid"):
+                failures.append(
+                    "telemetry: the campaign event stream did not "
+                    "export valid Chrome trace JSON")
+            if tele.get("steady_compile_spans") \
+                    != camp["compiles_campaign_steady"]:
+                failures.append(
+                    f"telemetry compile-span mismatch: "
+                    f"{tele.get('steady_compile_spans')} jax.compile "
+                    f"span(s) in the steady window but the "
+                    f"CompileCounter saw "
+                    f"{camp['compiles_campaign_steady']} — span "
+                    "emission and the monitoring hooks disagree")
+            d_ov = abs(tele.get("overlap_read_compute", 0.0)
+                       - tele.get("overlap_read_compute_bench", 0.0))
+            if d_ov > 0.05:
+                failures.append(
+                    f"telemetry overlap drift: span-integrated "
+                    f"read/compute overlap "
+                    f"{tele.get('overlap_read_compute')} vs the "
+                    f"bench's timings+wall estimate "
+                    f"{tele.get('overlap_read_compute_bench')} "
+                    f"(|diff| = {d_ov:.3f} > 0.05)")
+        if tele and not args.no_telemetry_overhead:
+            # enabled-vs-disabled wall A/B: telemetry ON must cost
+            # under 3% steady wall (+0.25 s absolute floor so a tiny
+            # quick-shape wall is not hostage to scheduler noise);
+            # skipped when the bench ran without telemetry (canned or
+            # BENCH_TELEMETRY=0 runs have no instrumented side to A/B)
+            off = run_campaign_bench(telemetry=False)["detail"]
+            on_wall = float(camp["steady_wall_s"])
+            off_wall = float(off["steady_wall_s"])
+            campaign["telemetry_overhead"] = {
+                "enabled_wall_s": on_wall, "disabled_wall_s": off_wall}
+            if on_wall > off_wall * 1.03 + 0.25:
+                failures.append(
+                    f"telemetry overhead: steady wall {on_wall:.3f} s "
+                    f"enabled vs {off_wall:.3f} s disabled — more than "
+                    "3% (+0.25 s floor); the hot path is doing real "
+                    "work with telemetry on")
     destriper = None
     if not args.no_destriper:
         # both halves machine-independent: the memory gate is a byte

@@ -50,7 +50,15 @@ def _fmt_age(seconds: float) -> str:
 
 def cmd_serve(args) -> int:
     from comapreduce_tpu.serving.server import MapServer
+    from comapreduce_tpu.telemetry import TELEMETRY
 
+    if args.telemetry:
+        # the server shares the campaign's state dir, so its epoch
+        # spans land next to the reducer ranks' streams and merge into
+        # one timeline under tools/campaign_report.py; rank 1000 is the
+        # serving lane — a reducer rank would collide on the same
+        # stream file (span ids are per-process)
+        TELEMETRY.configure(args.state_dir, rank=1000)
     wcs = None
     if args.nside is None:
         if not (args.crval and args.cdelt and args.shape):
@@ -179,6 +187,9 @@ def main(argv=None) -> int:
                    help="exit after this long with nothing new "
                    "(default: run forever)")
     s.add_argument("--max-wall-s", type=float, default=None)
+    s.add_argument("--telemetry", action="store_true",
+                   help="emit serving.epoch spans into the campaign's "
+                   "state dir (merge with tools/campaign_report.py)")
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("status", help="current epoch + staleness")
