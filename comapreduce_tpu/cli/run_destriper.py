@@ -79,7 +79,8 @@ def _memoized(tag: str, pixels: np.ndarray, extra_key: tuple, build):
 def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                     n_iter: int, threshold: float, n_groups: int = 0,
                     compact: bool = False, precond: str = "jacobi",
-                    pair_batch: int | None = None, mg_smooth: int = 1):
+                    pair_batch: int | None = None, mg_smooth: int = 1,
+                    kernels: str = "auto"):
     import functools
 
     import jax
@@ -96,7 +97,8 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                                        n_groups=n_groups,
                                        dense_maps=not compact,
                                        mg_smooth=mg_smooth,
-                                       precond=precond))
+                                       precond=precond,
+                                       kernels=kernels))
         if compact:
             return fn, np.asarray(plan.uniq_pixels)
         return fn
@@ -113,7 +115,7 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
     return _memoized(tag, pixels,
                      (int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups), str(precond),
-                      pair_batch, int(mg_smooth)), build)
+                      pair_batch, int(mg_smooth), str(kernels)), build)
 
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
@@ -122,7 +124,8 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                             n_groups: int = 0,
                             with_coarse: bool = False,
                             precond: str = "jacobi",
-                            pair_batch: int | None = None):
+                            pair_batch: int | None = None,
+                            kernels: str = "auto"):
     """Memoized sharded solver (plans + ONE compiled shard_map program
     per pointing — bands share both). ``n_bands > 0`` builds the
     multi-RHS program (all bands in one CG); ``n_groups > 0`` the joint
@@ -141,14 +144,16 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                                             n_bands=n_bands,
                                             n_groups=n_groups,
                                             with_coarse=with_coarse,
-                                            precond=precond)
+                                            precond=precond,
+                                            kernels=kernels)
         return run, np.asarray(plans[0].uniq_global)
 
     return _memoized(f"sharded{n_bands}-g{n_groups}-c{int(with_coarse)}",
                      pixels,
                      (n_shards, int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups),
-                      bool(with_coarse), str(precond), pair_batch), build)
+                      bool(with_coarse), str(precond), pair_batch,
+                      str(kernels)), build)
 
 
 def _shard_quantum(mesh, offset_length: int) -> int:
@@ -206,7 +211,8 @@ def _attach_dict(data, result):
 
 def parse_destriper_section(destr: dict, coarse_default: int = 0):
     """``[Destriper]`` knobs ->
-    ``(precond, coarse_block, pair_batch, mg)`` (docs/OPERATIONS.md §3):
+    ``(precond, coarse_block, pair_batch, mg, kernels)``
+    (docs/OPERATIONS.md §3):
 
     - ``preconditioner = none | jacobi | twolevel | multigrid`` — CG
       preconditioner selection; ``twolevel`` = Jacobi + the coarse
@@ -219,6 +225,11 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
       stands.
     - ``pair_batch = N | auto`` — one-hot binning chunks merged per MXU
       matmul in the planned matvec (auto = HBM-planner sized).
+    - ``kernels = auto | xla | pallas | interpret`` — the planned
+      matvec's binning/gather implementation (PR 11): ``auto``
+      (default) resolves at trace time to the Mosaic kernels on TPU and
+      the XLA paths everywhere else; ``interpret`` runs the kernels
+      under the Pallas interpreter (CPU parity/debug — slow).
     - ``checkpoint_every = N`` — validated here (>= 0; 0 = off) but
       returned separately by the caller: every N CG iterations the
       chunked solve durably snapshots ``(x, iter, residual history,
@@ -228,7 +239,8 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
 
     A typo'd or contradictory knob raises instead of silently running
     the default (the ``[Resilience]`` section's rule)."""
-    from comapreduce_tpu.mapmaking.destriper import CONFIG_PRECONDITIONERS
+    from comapreduce_tpu.mapmaking.destriper import (CONFIG_KERNELS,
+                                                     CONFIG_PRECONDITIONERS)
 
     coarse_block = int(coarse_default)
     mg = None
@@ -290,7 +302,12 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
         raise ValueError(
             f"[Destriper] checkpoint_every must be >= 0 (0 = off), got "
             f"{destr.get('checkpoint_every')!r}")
-    return precond, coarse_block, pair_batch, mg
+    kernels = str(destr.get("kernels", "auto")).strip().lower() or "auto"
+    if kernels not in CONFIG_KERNELS:
+        raise ValueError(f"[Destriper] kernels must be "
+                         f"{'|'.join(CONFIG_KERNELS)}, got "
+                         f"{destr.get('kernels')!r}")
+    return precond, coarse_block, pair_batch, mg, kernels
 
 
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
@@ -299,7 +316,7 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   medfilt_window=400, tod_variant="auto",
                   coarse_block=0, prefetch=0, cache=None,
                   resilience=None, precond="jacobi", pair_batch=None,
-                  mg=None, compact="auto"):
+                  mg=None, compact="auto", kernels="auto"):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
@@ -328,7 +345,7 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                             watchdog=getattr(resilience, "watchdog",
                                              None),
                             unit=f"band{band}", precond=precond,
-                            pair_batch=pair_batch, mg=mg)
+                            pair_batch=pair_batch, mg=mg, kernels=kernels)
 
 
 def _watched_cg(solve, watchdog, unit: str):
@@ -352,7 +369,7 @@ def _watched_cg(solve, watchdog, unit: str):
 def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                use_ground=False, sharded=False, coarse_block=0,
                watchdog=None, unit="", precond="jacobi",
-               pair_batch=None, mg=None, x0=None):
+               pair_batch=None, mg=None, x0=None, kernels="auto"):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -404,7 +421,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                use_ground=use_ground, sharded=sharded,
                                coarse_block=coarse_block,
                                precond=precond, pair_batch=pair_batch,
-                               mg=mg, x0=x0),
+                               mg=mg, x0=x0, kernels=kernels),
             watchdog, unit)
     if sharded and mg is not None:
         # the sharded programs keep the two-level preconditioner: the
@@ -473,7 +490,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 threshold,
                 n_groups=data.n_groups if gid_off is not None else 0,
                 with_coarse=use_coarse, precond=precond,
-                pair_batch=pair_batch)
+                pair_batch=pair_batch, kernels=kernels)
             if gid_off is not None:
                 if coarse_block:
                     logger.warning("coarse_precond: the sharded ground "
@@ -535,7 +552,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                     ground_ids=data.ground_ids[:n],
                     az=data.az[:n],
                     n_groups=data.n_groups,
-                    precond=precond))
+                    precond=precond, kernels=kernels))
         kwargs = {}
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
@@ -568,7 +585,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                  offset_length, n_iter, threshold,
                                  n_groups=data.n_groups, precond=precond,
                                  pair_batch=pair_batch,
-                                 mg_smooth=mg_smooth)
+                                 mg_smooth=mg_smooth, kernels=kernels)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]),
                         ground_off=jnp.asarray(gid_off),
@@ -577,7 +594,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold,
                                  precond=precond, pair_batch=pair_batch,
-                                 mg_smooth=mg_smooth)
+                                 mg_smooth=mg_smooth, kernels=kernels)
             if x0 is not None:
                 kwargs["x0"] = jnp.asarray(x0)
             result = fn(jnp.asarray(data.tod[:n]),
@@ -735,7 +752,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          tod_variant="auto", coarse_block=0,
                          prefetch=0, cache=None, resilience=None,
                          watchdog=None, precond="jacobi",
-                         pair_batch=None, mg=None, compact="auto"):
+                         pair_batch=None, mg=None, compact="auto",
+                         kernels="auto"):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -801,7 +819,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
         run, uniq = _sharded_planned_solver(
             mesh, pix_host, npix, offset_length, n_iter, threshold,
             n_bands=nb, with_coarse=bool(coarse_block), precond=precond,
-            pair_batch=pair_batch)
+            pair_batch=pair_batch, kernels=kernels)
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
                 build_coarse_preconditioner, coarse_pattern)
@@ -877,7 +895,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
     fn, uniq = _planned_solver(pix0[:n], npix, offset_length, n_iter,
                                threshold, compact=True, precond=precond,
                                pair_batch=pair_batch,
-                               mg_smooth=mg["smooth"] if mg else 1)
+                               mg_smooth=mg["smooth"] if mg else 1,
+                               kernels=kernels)
     res = _watched_cg(
         lambda: fn(jnp.asarray(tod), jnp.asarray(wgt), **kwargs),
         watchdog, "joint")
@@ -1016,8 +1035,8 @@ def main(argv=None) -> int:
     coarse_block = int(inputs.get("coarse_precond",
                                   0 if calibrator else 8))
     destr_sec = ini.get("Destriper", {})
-    precond, coarse_block, pair_batch, mg = parse_destriper_section(
-        destr_sec, coarse_block)
+    precond, coarse_block, pair_batch, mg, kernels = \
+        parse_destriper_section(destr_sec, coarse_block)
     # CG solve checkpointing (docs/OPERATIONS.md §11): validated by
     # parse_destriper_section above, consumed here (its return tuple is
     # pinned) — 0 = off
@@ -1169,7 +1188,7 @@ def main(argv=None) -> int:
             coarse_block=coarse_block, prefetch=prefetch, cache=cache,
             resilience=resilience, watchdog=resilience.watchdog,
             precond=precond, pair_batch=pair_batch, mg=mg,
-            compact=compact)
+            compact=compact, kernels=kernels)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -1185,7 +1204,8 @@ def main(argv=None) -> int:
                                 coarse_block=coarse_block,
                                 watchdog=resilience.watchdog,
                                 unit=f"band{band}", precond=precond,
-                                pair_batch=pair_batch, mg=mg)
+                                pair_batch=pair_batch, mg=mg,
+                                kernels=kernels)
         elif checkpoint_every > 0:
             # same read as make_band_map, solve split into durable
             # checkpoint/resume chunks — a relaunch mid-CG pays only
@@ -1204,7 +1224,8 @@ def main(argv=None) -> int:
                 offset_length=offset_length, n_iter=n_iter,
                 threshold=threshold, watchdog=resilience.watchdog,
                 unit=f"band{band}", coarse_block=coarse_block,
-                precond=precond, pair_batch=pair_batch, mg=mg)
+                precond=precond, pair_batch=pair_batch, mg=mg,
+                kernels=kernels)
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
@@ -1214,7 +1235,7 @@ def main(argv=None) -> int:
                 tod_variant=tod_variant, coarse_block=coarse_block,
                 prefetch=prefetch, cache=cache, resilience=resilience,
                 precond=precond, pair_batch=pair_batch, mg=mg,
-                compact=compact)
+                compact=compact, kernels=kernels)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         if writeback is None:

@@ -41,7 +41,8 @@ from comapreduce_tpu.mapmaking.pointing_plan import (PointingPlan,
                                                      binned_window_sum)
 from comapreduce_tpu.resilience.tripwires import scrub_tod
 
-__all__ = ["CONFIG_PRECONDITIONERS", "DestriperResult", "destripe",
+__all__ = ["CONFIG_KERNELS", "CONFIG_PRECONDITIONERS",
+           "DestriperResult", "destripe",
            "destripe_jit", "destripe_planned", "ground_ids_per_offset",
            "build_coarse_preconditioner", "coarse_pattern",
            "multigrid_levels", "multigrid_patterns",
@@ -65,6 +66,15 @@ class MultigridUnavailable(ValueError):
 #: jacobi|none; twolevel = jacobi + coarse=...; multigrid = jacobi +
 #: mg=...) by design.
 CONFIG_PRECONDITIONERS = ("none", "jacobi", "twolevel", "multigrid")
+
+#: ``[Destriper] kernels`` knob values (PR 11) — re-exported from the
+#: kernel module (ONE home: ``pallas_binning.KERNELS_CHOICES``) so the
+#: CLI parser, bench, and the solver entry points can't drift. ``auto``
+#: resolves at trace time (Pallas on TPU, XLA elsewhere); ``interpret``
+#: runs the Pallas kernels under the interpreter for CPU parity
+#: testing. See ``mapmaking/pallas_binning.resolve_kernels``.
+from comapreduce_tpu.mapmaking.pallas_binning import (    # noqa: E402
+    KERNELS_CHOICES as CONFIG_KERNELS)
 
 # CG divergence tripwire: a system is diverged when its true residual
 # sits more than sqrt(DIVERGENCE_GROWTH)x above the best iterate's for
@@ -423,7 +433,8 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
              threshold: float = 1e-6, axis_name: str | None = None,
              ground_ids: jax.Array | None = None,
              az: jax.Array | None = None, n_groups: int = 0,
-             precond: str = "jacobi") -> DestriperResult:
+             precond: str = "jacobi",
+             kernels: str = "auto") -> DestriperResult:
     """Destripe a flat TOD vector.
 
     Parameters
@@ -448,8 +459,15 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
         diagonal scaling, for A/B runs and the
         ``[Destriper] preconditioner`` config knob. Same fixed point
         either way; only the iteration path changes.
+    kernels: validated for parity with :func:`destripe_planned` but a
+        NO-OP here — this scatter path is the oracle the Pallas kernels
+        are tested against, and its per-sample scatter-adds have no
+        windowed structure for them to exploit. The CLI threads the
+        ``[Destriper] kernels`` knob to both entry points uniformly.
     """
     _check_precond(precond)
+    from comapreduce_tpu.mapmaking.pallas_binning import resolve_kernels
+    resolve_kernels(kernels)   # validate the knob; path unchanged
     n = tod.shape[0]
     n_offsets = n // offset_length
     with_ground = ground_ids is not None
@@ -524,7 +542,7 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
 destripe_jit = jax.jit(
     destripe,
     static_argnames=("npix", "offset_length", "n_iter", "threshold",
-                     "axis_name", "n_groups", "precond"))
+                     "axis_name", "n_groups", "precond", "kernels"))
 
 
 def ground_ids_per_offset(ground_ids: np.ndarray,
@@ -896,7 +914,9 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      mg_smooth: int = 1,
                      mg_omega: float = 2.0 / 3.0,
                      x0: jax.Array | None = None,
-                     precond: str = "jacobi") -> DestriperResult:
+                     precond: str = "jacobi",
+                     kernels: str = "auto",
+                     kernels_platform: str | None = None) -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -982,8 +1002,30 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     requires ``precond='jacobi'``. Ground solves apply the V-cycle to
     the offsets block (identity on the small ground block, like every
     other preconditioner here).
+
+    ``kernels``: the ``[Destriper] kernels`` knob — ``auto`` (default),
+    ``xla``, ``pallas``, or ``interpret``. Resolved EAGERLY at trace
+    time via ``pallas_binning.resolve_kernels``: ``auto`` keeps the
+    historical XLA paths byte-identical on non-TPU backends (the Mosaic
+    branch never enters the jaxpr) and routes every per-iteration
+    binning — ``pair_sum``/``rank_sum``/``off_sum``, hence the CG
+    matvec, the multigrid fine smoother (which closes over ``matvec``)
+    and the multi-RHS path — plus the ground-path windowed gathers
+    through the Pallas kernels on TPU. ``interpret`` runs the same
+    kernels under the Pallas interpreter (CPU parity testing).
+    ``kernels_platform`` overrides the backend the ``auto`` resolution
+    consults (``pallas_supported(platform=...)``) so a mixed CPU+TPU
+    host can trace CPU-placed programs without pulling Mosaic calls
+    into them. Shapes the kernel VMEM gate rejects silently keep the
+    XLA path (parity holds either way).
     """
     _check_precond(precond, coarse, mg)
+    from comapreduce_tpu.mapmaking.pallas_binning import (
+        pallas_binning_ok, resolve_kernels, windowed_gather_pallas)
+    kern = resolve_kernels(kernels, platform=kernels_platform)
+    # None (not "xla") when the knob resolves to XLA: the legacy env
+    # dispatch (COMAP_BIN_IMPL included) stays byte-identical
+    bin_impl = None if kern == "xla" else kern
     if mg is not None and axis_name is not None:
         # the V-cycle's restriction/level solves are not psum-threaded
         # (each shard would correct against a partial residual — no
@@ -1017,11 +1059,12 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     def pair_sum(v):
         return binned_window_sum(v, dv["sample_pair"], dv["sample_base"],
                                  plan.sample_window, plan.sample_chunk,
-                                 P_pad)
+                                 P_pad, impl=bin_impl)
 
     def rank_sum(pv):
         return binned_window_sum(pv, dv["pair_rank"], dv["rank_base"],
-                                 plan.rank_window, plan.pair_chunk, n_rank)
+                                 plan.rank_window, plan.pair_chunk, n_rank,
+                                 impl=bin_impl)
 
     # offset-order views. The matvec runs its first half in rank order and
     # its second half in offset order, reading from the SMALL domains
@@ -1037,7 +1080,7 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         """Pair -> offset sums; input already in OFFSET order."""
         return binned_window_sum(pv_off, po_off,
                                  dv["off_base"], plan.off_window,
-                                 plan.pair_chunk, n_off)
+                                 plan.pair_chunk, n_off, impl=bin_impl)
 
     # local -> global rank-space bridge (sharded plans): shard-local
     # compact sums scatter into the global hit-pixel space (tiny static
@@ -1087,8 +1130,22 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         pazaz_off = jnp.take(pazaz, perm_off, axis=-1)
         pazd_off = jnp.take(pazd, perm_off, axis=-1)
         grp_off = jnp.asarray(ground_off, jnp.int32)
-        # offset-order coefficient gather (rank order reuses gather_a)
+        # offset-order coefficient gather (rank order reuses gather_a).
+        # po_off IS plan-windowed (off_base/off_window), so the Pallas
+        # windowed gather applies: sentinel pairs read 0.0 instead of
+        # the clamped c[n_off-1], and every use below multiplies them
+        # by a zero pair aggregate — same contribution either way.
         po_off_clip = jnp.clip(po_off, 0, n_off - 1)
+        if bin_impl is not None and pallas_binning_ok(
+                plan.off_window, plan.pair_chunk,
+                interpret=(bin_impl == "interpret")):
+            def c_gather(c):
+                return windowed_gather_pallas(
+                    c, po_off, dv["off_base"], plan.off_window,
+                    plan.pair_chunk, interpret=(bin_impl == "interpret"))
+        else:
+            def c_gather(c):
+                return jnp.take(c, po_off_clip)
 
         def group_sum(v_off):
             # psum: under shard_map each shard owns whole offsets, so
@@ -1206,8 +1263,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         # one-hot binnings + one rank/map gather pair + a tiny
         # (n_off -> n_groups) segment reduction
         def q_off_of(c0, c1):
-            return (pair_w_off * jnp.take(c0, po_off_clip)
-                    + paz_off * jnp.take(c1, po_off_clip))
+            return (pair_w_off * c_gather(c0)
+                    + paz_off * c_gather(c1))
 
         def matvec_g(x):
             a_, g = x
@@ -1217,8 +1274,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
             m = from_global(to_map(q_rank))
             gm = gather_m(m)
             off_f = off_sum(q_off_of(c0, c1) - pair_w_off * gm)
-            off_az = off_sum(paz_off * jnp.take(c0, po_off_clip)
-                             + pazaz_off * jnp.take(c1, po_off_clip)
+            off_az = off_sum(paz_off * c_gather(c0)
+                             + pazaz_off * c_gather(c1)
                              - paz_off * gm)
             return (off_f, jnp.stack([group_sum(off_f),
                                       group_sum(off_az)], -1))

@@ -1,0 +1,337 @@
+"""Mosaic (Pallas-TPU) kernels for the destriper's pointing matvec.
+
+ROOFLINE round 5 xprof pinned the fine-level CG matvec as gather-bound:
+the merged one-hot contraction in ``pointing_plan.binned_window_sum``
+re-materialises an ``(chunk, window)`` one-hot in HBM-visible form every
+chunk, and the PR 6 multigrid V-cycle re-pays that cost ``2*mg_smooth``
+extra times per iteration.  This module replaces the hot sums with real
+VMEM kernels:
+
+``binned_window_sum_pallas``
+    Segment-accumulated scatter.  Pairs are pre-sorted by the plan so
+    every chunk's ids live in one contiguous window ``[base[c],
+    base[c]+window)``: the kernel DMAs that window of the output from
+    HBM into VMEM scratch once per chunk, accumulates the chunk's
+    contribution on the MXU (equality one-hot built transposed in
+    registers, never round-tripped through HBM), and DMAs the window
+    back — one read + one write of each output window per chunk instead
+    of XLA's read-modify-write through the fori carry.  The sequential
+    grid keeps overlapping windows race-free.  Ids outside the window
+    (plan sentinels) drop, exactly like the XLA paths' one-hot
+    mismatch / ``mode="drop"``; ids ``>= out_size`` land in the sliced-
+    off alignment padding, mirroring the XLA paths' ``out_size +
+    window`` staging buffer.
+
+``windowed_gather_pallas``
+    The mirror image for windowed gathers (``out[..., e] =
+    src[..., ids[e]]``): DMA the source window once, select per element
+    with a one-hot matmul.  Out-of-window ids return 0.0 — callers must
+    only use this where sentinel lanes carry zero weight downstream
+    (true for every plan-sorted gather in ``destriper.py``).
+
+Mosaic in jax 0.4.37 lowers no gather/scatter/sort primitives, so both
+kernels are built strictly from the demonstrated-lowerable set: async
+copies with dynamic sublane/lane offsets, ``broadcasted_iota``
+equality one-hots, ``dot_general`` (MXU), and static lane sub-slices
+(dynamic LANE slicing is not lowerable — the chunk axis is walked by an
+unrolled Python loop over static ``SUB``-wide tiles).
+
+Exactness contract: the gather is bit-exact (each output element is one
+``1.0 * src`` MXU product).  The scatter is exact up to f32 summation
+order — the kernel accumulates ``chunk // SUB`` partial matmuls where
+XLA contracts the whole chunk at once — so parity is pinned at an
+accumulation-order rtol (see ``tests/test_pallas_binning.py``), the
+same contract PR 4 pinned for ``pair_batch`` re-chunking.
+
+Everything here must stay importable (and the ``interpret=True`` path
+runnable) on CPU-only hosts: ``pl.pallas_call`` only lowers Mosaic when
+actually compiled for TPU, and the trace-time gates in
+``pointing_plan.binned_window_sum``/``destriper.destripe_planned`` keep
+these kernels out of CPU jaxprs entirely.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from comapreduce_tpu.ops.pallas_median import pallas_supported
+
+__all__ = [
+    "binned_window_sum_pallas",
+    "windowed_gather_pallas",
+    "pallas_binning_ok",
+    "resolve_kernels",
+    "binning_logical_bytes",
+    "KERNELS_CHOICES",
+    "MAX_PALLAS_BIN_WINDOW",
+]
+
+_ROWS = 8          # f32 sublane tile
+_LANE = 128        # lane tile
+# Hard cap on the scatter/gather window: beyond this even a one-row
+# accumulator plus one one-hot sub-tile blows the VMEM budget.
+MAX_PALLAS_BIN_WINDOW = 16384
+# Conservative per-core VMEM budget for gating (bytes). Real cores have
+# ~16 MiB; leave headroom for Mosaic's own double-buffering.
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+KERNELS_CHOICES = ("auto", "xla", "pallas", "interpret")
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def resolve_kernels(kernels: str, platform: str | None = None) -> str:
+    """Resolve the ``[Destriper] kernels`` knob to a concrete impl.
+
+    ``auto`` becomes ``pallas`` when the (optionally overridden)
+    platform is a TPU backend and ``xla`` everywhere else — the
+    resolution happens at TRACE time, so with ``auto`` on a CPU host
+    the Mosaic branch never enters the jaxpr and CPU behaviour is
+    byte-identical to the pre-kernel pipeline.  ``platform`` is the
+    mixed-host override threaded from ``destripe_planned(...,
+    kernels_platform=)``.
+    """
+    if kernels not in KERNELS_CHOICES:
+        raise ValueError(
+            f"kernels must be one of {KERNELS_CHOICES}, got {kernels!r}")
+    if kernels == "auto":
+        return "pallas" if pallas_supported(platform=platform) else "xla"
+    return kernels
+
+
+def _pick_sub(chunk: int) -> int | None:
+    """Static lane sub-tile width for walking the chunk axis.
+
+    Mosaic cannot slice the lane axis dynamically, so the kernels unroll
+    a Python loop over static ``SUB``-wide tiles; ``SUB`` must divide
+    ``chunk`` and stay small enough that the ``(wp, SUB)`` one-hot fits
+    VMEM at production windows."""
+    if chunk <= 512:
+        return chunk
+    for s in (512, 256, 128):
+        if chunk % s == 0:
+            return s
+    return None
+
+
+def pallas_binning_ok(window: int, chunk: int, rows: int = 1,
+                      interpret: bool = False) -> bool:
+    """Trace-time gate: can the binning kernels handle this shape?
+
+    Checks the structural constraints (a static sub-tile exists, the
+    window is bounded) always, and the VMEM budget for the compiled
+    path (``interpret=True`` skips the budget — the interpreter has no
+    VMEM).  Mirrors ``pallas_window_ok`` for the median kernel: callers
+    consult this BEFORE tracing so unsupported shapes silently keep the
+    XLA path."""
+    if window <= 0 or window > MAX_PALLAS_BIN_WINDOW:
+        return False
+    sub = _pick_sub(chunk)
+    if sub is None:
+        return False
+    if interpret:
+        return True
+    if chunk % _LANE != 0:
+        return False
+    r8 = _round_up(max(rows, 1), _ROWS)
+    wp = _round_up(window + _LANE - 1, _LANE)
+    # acc scratch + one-hot sub-tile + double-buffered values block +
+    # ids block
+    need = 4 * (r8 * wp + wp * sub + 2 * r8 * chunk + 2 * chunk)
+    return need <= _VMEM_BUDGET
+
+
+def binning_logical_bytes(rows: int, M: int, window: int, chunk: int,
+                          out_size: int) -> dict:
+    """Accounted HBM traffic (bytes) for one scatter matvec, XLA fori
+    path vs the Pallas kernel — the machine-independent quantity the
+    kernels bench and ``tools/check_perf.py`` gate on."""
+    n_chunks = M // chunk if chunk else 0
+    r8 = _round_up(max(rows, 1), _ROWS)
+    wp = _round_up(window + _LANE - 1, _LANE)
+    out_pad = _round_up(out_size, _LANE) + wp
+    xla = 4 * (rows * M + M                       # values + ids read
+               + rows * (out_size + window)       # carry init
+               + 2 * rows * window * n_chunks     # RMW window per chunk
+               + rows * out_size)                 # final slice copy
+    pallas = 4 * (r8 * M + M                      # values + ids read
+                  + r8 * out_pad                  # aliased zeros init
+                  + 2 * r8 * wp * n_chunks        # DMA in + out per chunk
+                  + r8 * out_size)                # final slice copy
+    return {"xla_bytes": int(xla), "pallas_bytes": int(pallas),
+            "ratio": float(xla) / float(max(pallas, 1))}
+
+
+def _scatter_kernel(b0_ref, bc_ref, ids_ref, v_ref, oz_ref, out_hbm,
+                    acc_ref, sem_in, sem_out, *, window, wp, chunk, sub):
+    del oz_ref  # aliased straight into out_hbm; never read as an input
+    c = pl.program_id(0)
+    b0 = b0_ref[c]
+    bc = bc_ref[c]
+    cp_in = pltpu.make_async_copy(out_hbm.at[:, pl.ds(b0, wp)], acc_ref,
+                                  sem_in)
+    cp_in.start()
+    cp_in.wait()
+    ids = ids_ref[...]                                 # (1, chunk) i32
+    valid = (ids >= bc) & (ids < bc + window)
+    # -1 never matches the iota rows, so sentinel lanes drop — the same
+    # semantics as the XLA paths' one-hot mismatch / mode="drop"
+    local = jnp.where(valid, ids - b0, -1)
+    v = v_ref[...]                                     # (R8, chunk)
+    row = jax.lax.broadcasted_iota(jnp.int32, (wp, sub), 0)
+    for s in range(chunk // sub):
+        loc_s = jax.lax.slice_in_dim(local, s * sub, (s + 1) * sub,
+                                     axis=1)           # static lane slice
+        oh_t = (loc_s == row).astype(jnp.float32)      # (wp, sub)
+        v_s = jax.lax.slice_in_dim(v, s * sub, (s + 1) * sub, axis=1)
+        acc_ref[...] += jax.lax.dot_general(
+            v_s, oh_t, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)       # (R8, wp)
+    cp_out = pltpu.make_async_copy(acc_ref, out_hbm.at[:, pl.ds(b0, wp)],
+                                   sem_out)
+    cp_out.start()
+    cp_out.wait()
+
+
+def binned_window_sum_pallas(values: jax.Array, ids: jax.Array,
+                             base: jax.Array, window: int, chunk: int,
+                             out_size: int,
+                             interpret: bool = False) -> jax.Array:
+    """Pallas segment scatter with ``binned_window_sum`` semantics.
+
+    Same contract as ``pointing_plan.binned_window_sum``: ``values``
+    f32[..., M] with ``M % chunk == 0``, ids of chunk ``c`` windowed in
+    ``[base[c], base[c]+window)`` (sentinels outside drop).  Result
+    matches the XLA paths to f32 accumulation-order rtol; see module
+    docstring.  Callers gate on ``pallas_binning_ok`` first — this
+    function raises on structurally unsupported shapes."""
+    sub = _pick_sub(chunk)
+    if sub is None or window <= 0 or window > MAX_PALLAS_BIN_WINDOW:
+        raise ValueError(
+            f"binned_window_sum_pallas: unsupported shape "
+            f"(window={window}, chunk={chunk}); gate with "
+            f"pallas_binning_ok() before calling")
+    M = values.shape[-1]
+    lead = values.shape[:-1]
+    R = int(np.prod(lead)) if lead else 1
+    if M == 0:
+        return jnp.zeros(lead + (out_size,), jnp.float32)
+    n_chunks = M // chunk
+    R8 = _round_up(max(R, 1), _ROWS)
+    wp = _round_up(window + _LANE - 1, _LANE)
+    out_pad = _round_up(out_size, _LANE) + wp
+    v = jnp.pad(values.reshape(R, M).astype(jnp.float32),
+                ((0, R8 - R), (0, 0)))
+    # Clamp window starts exactly like _binned_window_sum_fori: landing
+    # positions stay absolute and out-of-range windows drop into the
+    # alignment padding.  b0 is the 128-aligned DMA base.
+    bc = jnp.clip(base, 0, out_size).astype(jnp.int32)
+    b0 = (bc // _LANE) * _LANE
+    oz = jnp.zeros((R8, out_pad), jnp.float32)
+    out = pl.pallas_call(
+        functools.partial(_scatter_kernel, window=window, wp=wp,
+                          chunk=chunk, sub=sub),
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, chunk), lambda c: (c, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R8, chunk), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((R8, out_pad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((R8, wp), jnp.float32),
+                        pltpu.SemaphoreType.DMA,
+                        pltpu.SemaphoreType.DMA],
+        input_output_aliases={4: 0},
+        interpret=interpret,
+    )(b0, bc, ids.reshape(n_chunks, chunk).astype(jnp.int32), v, oz)
+    return out[:R, :out_size].reshape(lead + (out_size,))
+
+
+def _gather_kernel(b0_ref, bc_ref, ids_ref, src_hbm, o_ref,
+                   win_ref, sem, *, window, wp, chunk, sub):
+    c = pl.program_id(0)
+    b0 = b0_ref[c]
+    bc = bc_ref[c]
+    cp = pltpu.make_async_copy(src_hbm.at[:, pl.ds(b0, wp)], win_ref, sem)
+    cp.start()
+    cp.wait()
+    ids = ids_ref[...]                                 # (1, chunk) i32
+    valid = (ids >= bc) & (ids < bc + window)
+    local = jnp.where(valid, ids - b0, -1)             # -1 -> all-zero col
+    win = win_ref[...]                                 # (R8, wp)
+    row = jax.lax.broadcasted_iota(jnp.int32, (wp, sub), 0)
+    for s in range(chunk // sub):
+        loc_s = jax.lax.slice_in_dim(local, s * sub, (s + 1) * sub,
+                                     axis=1)
+        oh = (loc_s == row).astype(jnp.float32)        # (wp, sub)
+        o_ref[:, s * sub:(s + 1) * sub] = jax.lax.dot_general(
+            win, oh, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST)       # (R8, sub)
+
+
+def windowed_gather_pallas(src: jax.Array, ids: jax.Array,
+                           base: jax.Array, window: int, chunk: int,
+                           interpret: bool = False) -> jax.Array:
+    """``out[..., e] = src[..., ids[e]]`` for plan-sorted windowed ids.
+
+    The dual of ``binned_window_sum_pallas``: chunk ``c``'s ids live in
+    ``[base[c], base[c]+window)``, so the kernel DMAs one source window
+    per chunk and selects with a one-hot MXU product — bit-exact for
+    in-window ids (one ``1.0 * src`` term each).  OUT-OF-WINDOW IDS
+    RETURN 0.0, unlike ``jnp.take(src, clip(ids, 0, S-1))`` which
+    returns a clamped element — callers must only substitute this where
+    sentinel lanes carry zero weight downstream (the destriper's
+    ground-pickup gathers, where ``paz_off``/``pair_w_off`` are zero at
+    padding pairs)."""
+    sub = _pick_sub(chunk)
+    if sub is None or window <= 0 or window > MAX_PALLAS_BIN_WINDOW:
+        raise ValueError(
+            f"windowed_gather_pallas: unsupported shape "
+            f"(window={window}, chunk={chunk}); gate with "
+            f"pallas_binning_ok() before calling")
+    S = src.shape[-1]
+    lead = src.shape[:-1]
+    M = ids.shape[0]
+    R = int(np.prod(lead)) if lead else 1
+    if M == 0:
+        return jnp.zeros(lead + (0,), jnp.float32)
+    n_chunks = M // chunk
+    R8 = _round_up(max(R, 1), _ROWS)
+    wp = _round_up(window + _LANE - 1, _LANE)
+    S_pad = _round_up(max(S, 1), _LANE) + wp
+    s2 = jnp.pad(src.reshape(R, S).astype(jnp.float32),
+                 ((0, R8 - R), (0, S_pad - S)))
+    bc = jnp.clip(base, 0, S).astype(jnp.int32)
+    b0 = (bc // _LANE) * _LANE
+    out = pl.pallas_call(
+        functools.partial(_gather_kernel, window=window, wp=wp,
+                          chunk=chunk, sub=sub),
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, chunk), lambda c: (c, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((R8, chunk), lambda c: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R8, M), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((R8, wp), jnp.float32),
+                        pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )(b0, bc, ids.reshape(n_chunks, chunk).astype(jnp.int32), s2)
+    return out[:R, :M].reshape(lead + (M,))

@@ -408,7 +408,8 @@ def build_sharded_plans(pixels: np.ndarray, npix: int, offset_length: int,
 
 def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
                       window: int, chunk: int, out_size: int,
-                      batch: int | None = None) -> jax.Array:
+                      batch: int | None = None,
+                      impl: str | None = None) -> jax.Array:
     """Sum ``values`` into ``out[..., id]`` for pre-sorted, chunk-windowed
     ids.
 
@@ -440,9 +441,35 @@ def binned_window_sum(values: jax.Array, ids: jax.Array, base: jax.Array,
     ``COMAP_BIN_IMPL`` binds the same way — an in-process impl A/B at
     one shape needs fresh processes or ``jax.clear_caches()``, or the
     cached executable silently keeps the first impl.
+
+    ``impl`` (PR 11) overrides the env dispatch from code — the
+    ``[Destriper] kernels`` knob resolves to it at trace time in
+    ``destripe_planned``.  ``None`` keeps the env path byte-identical
+    to before the knob existed; ``"xla"`` forces the fori path;
+    ``"pallas"``/``"interpret"`` route to the Mosaic segment-scatter
+    kernel (``mapmaking/pallas_binning.py``) when
+    ``pallas_binning_ok`` accepts the shape, silently falling back to
+    the fori path otherwise (the kernel's VMEM gate is shape-dependent
+    and parity holds either way — see the kernel module docstring).
     """
     if batch is None:
         batch = int(os.environ.get("COMAP_BIN_BATCH", "8"))
+    if impl in ("pallas", "interpret"):
+        if values.dtype == jnp.float32:
+            from comapreduce_tpu.mapmaking.pallas_binning import (
+                binned_window_sum_pallas, pallas_binning_ok)
+            rows = 1
+            for d in values.shape[:-1]:
+                rows *= int(d)
+            if pallas_binning_ok(window, chunk, rows=rows,
+                                 interpret=(impl == "interpret")):
+                return binned_window_sum_pallas(
+                    values, ids, base, window, chunk, out_size,
+                    interpret=(impl == "interpret"))
+        impl = "xla"
+    if impl == "xla":
+        return _binned_window_sum_fori(values, ids, base, window, chunk,
+                                       out_size)
     # default impl: the ordered fori loop — measured on-chip (round 5)
     # at production multi-RHS shape it takes the destriper 2.09 s ->
     # 1.59 s (full bench wall 4.00 s -> 3.50 s) by eliminating the

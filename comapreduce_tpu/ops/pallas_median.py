@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: exact rolling median by in-VMEM radix bisection.
+"""Pallas TPU kernels: exact rolling median and fused masked fill.
 
 The XLA formulation of the windowed median (gather the (chunk, window)
 mat, select per row — ``ops/median_filter.py``) round-trips every window
@@ -16,10 +16,23 @@ over its bandwidth bound). This kernel keeps the whole selection on-chip:
    reductions) entirely in VMEM, plus two passes for the upper median.
 
 Exact: bit-identical to ``sort -> middle`` selection, with full
-``jnp.median`` NaN semantics — any NaN inside a window yields NaN (the
-wrapper counts windowed NaNs by cumsum difference and overwrites those
-outputs; the kernel itself only orders finite keys). Handles any window;
-VMEM bounds the padded window at ``MAX_PALLAS_WINDOW``.
+``jnp.median`` NaN semantics — any NaN inside a window yields NaN. NaN
+keys map to the IMAX padding sentinel, so the per-window NaN test is one
+VMEM count over the already-built window matrix (``count(IMAX) >
+padding rows``) — no extra roll per build step and no XLA cumsum passes
+in the wrapper. Handles any window; VMEM bounds the padded window at
+``MAX_PALLAS_WINDOW``.
+
+:func:`masked_fill_pallas` (ISSUE 11) is the second kernel of the
+family: the reduction pre-filter's ``_fill_bad`` NaN fill (masked
+stride-4 median with masked-mean fallback) in ONE HBM read of the raw
+TOD + mask per row block. The XLA formulation is floored at ~34 logical
+passes because the masked-median selection re-reads the (stride-4)
+block once per radix step; here the whole bisection runs on the
+VMEM-resident rows, so the kernel's HBM traffic is exactly
+read(tod) + read(mask) + write(out) — 3 logical passes
+(:func:`masked_fill_logical_passes` is the accounting the
+compile-inspection budget test and ``tools/check_perf.py`` pin).
 """
 
 from __future__ import annotations
@@ -31,11 +44,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["rolling_median_windows_pallas", "MAX_PALLAS_WINDOW",
-           "pallas_supported", "pallas_window_ok"]
+__all__ = ["rolling_median_windows_pallas", "masked_fill_pallas",
+           "MAX_PALLAS_WINDOW", "MAX_PALLAS_FILL_LEN",
+           "pallas_supported", "pallas_window_ok", "pallas_fill_ok",
+           "masked_fill_logical_passes"]
 
 _ROWS = 8          # f32 sublane tile
 MAX_PALLAS_WINDOW = 2048   # padded-window cap: mat scratch = Wpad*8*chunk*4B
+#: row-length cap for the fused fill kernel: the whole (8, Lpad) row
+#: block plus its i32 key image stays VMEM-resident (3 x 8 x Lpad x 4 B
+#: plus bisection temporaries — ~1.6 MB at the cap, far under VMEM)
+MAX_PALLAS_FILL_LEN = 65536
 
 
 def _w_pad(window: int) -> int:
@@ -49,17 +68,31 @@ def pallas_window_ok(window: int) -> bool:
     return _w_pad(window) <= MAX_PALLAS_WINDOW
 
 
-def pallas_supported() -> bool:
-    """True when the PROCESS-DEFAULT backend can run the Mosaic
-    (TPU-only) kernel; 'axon' is the tunnelled TPU platform.
+def pallas_fill_ok(length: int) -> bool:
+    """True when a time-axis row of ``length`` samples fits the fused
+    fill kernel's whole-row VMEM residency (the analogue of
+    :func:`pallas_window_ok` for :func:`masked_fill_pallas`)."""
+    return 0 < int(length) <= MAX_PALLAS_FILL_LEN
+
+
+def pallas_supported(platform: str | None = None) -> bool:
+    """True when ``platform`` (default: the PROCESS-DEFAULT backend) can
+    run the Mosaic (TPU-only) kernels; 'axon' is the tunnelled TPU
+    platform.
 
     ``rolling_median`` uses this as its TRACE-time gate: current jax
     lowers every ``platform_dependent`` branch, so the Mosaic kernel
     must stay out of the jaxpr entirely on CPU-only hosts. On a
     TPU-default host the ``platform_dependent`` lowering-time selection
     still applies to TPU placements (CPU placements there cannot lower
-    the embedded kernel — pre-existing limitation)."""
-    backend = jax.default_backend()
+    the embedded kernel — pre-existing limitation).
+
+    ``platform=`` is the mixed-host override (ISSUE 11 satellite): a
+    host whose default backend is TPU but which places some programs on
+    CPU (or vice versa) passes the placement's platform explicitly —
+    e.g. ``destripe_planned(..., kernels_platform='cpu')`` — so the
+    trace for that placement never embeds an unlowerable kernel."""
+    backend = platform if platform is not None else jax.default_backend()
     return backend.startswith("tpu") or backend == "axon"
 
 
@@ -72,14 +105,20 @@ def _kernel(x_hbm, o_ref, seg_ref, mat_ref, sem, *, window, w_pad, chunk):
         seg_ref, sem)
     cp.start()
     cp.wait()
-    # monotone f32 -> signed i32 keys (same total order as the floats;
-    # NaN windows are overwritten by the wrapper, so NaN keys just need
-    # a consistent slot in the order)
-    u = jax.lax.bitcast_convert_type(seg_ref[...], jnp.uint32)
+    # monotone f32 -> signed i32 keys (same total order as the floats).
+    # NaNs of EITHER sign map to the IMAX padding sentinel: their
+    # windows are overwritten with NaN below, so they need no slot in
+    # the order, and sharing the sentinel makes the per-window NaN test
+    # one count over the already-built mat (no extra roll per build
+    # step, no XLA cumsum passes in the wrapper). No finite f32 key
+    # collides with IMAX (its preimage is a NaN bit pattern).
+    seg = seg_ref[...]
+    u = jax.lax.bitcast_convert_type(seg, jnp.uint32)
     neg = (u >> 31) == 1
     key_u = jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
     keys = jax.lax.bitcast_convert_type(
         key_u ^ jnp.uint32(0x80000000), jnp.int32)
+    keys = jnp.where(seg != seg, IMAX, keys)
 
     def build(jj, _):
         # positive shift: pltpu.roll miscomputes NEGATIVE dynamic shifts
@@ -112,6 +151,10 @@ def _kernel(x_hbm, o_ref, seg_ref, mat_ref, sem, *, window, w_pad, chunk):
     above = jnp.where(mat > v_lo[None, :, :], mat, IMAX)
     v_next = jnp.min(above, axis=0)
     v_hi = jnp.where(c_le >= k_hi + 1, v_lo, v_next)
+    # jnp.median NaN semantics, fused: every window with a NaN shows
+    # more IMAX entries than the (w_pad - window) padding rows alone
+    c_max = jnp.sum((mat == IMAX).astype(jnp.int32), axis=0)
+    has_nan = c_max > (w_pad - window)
 
     def tof(v_s):
         v = (jax.lax.bitcast_convert_type(v_s, jnp.uint32)
@@ -122,7 +165,8 @@ def _kernel(x_hbm, o_ref, seg_ref, mat_ref, sem, *, window, w_pad, chunk):
 
     from comapreduce_tpu.ops.stats import _median_mid
 
-    o_ref[...] = _median_mid(tof(v_lo), tof(v_hi))
+    o_ref[...] = jnp.where(has_nan, jnp.float32(jnp.nan),
+                           _median_mid(tof(v_lo), tof(v_hi)))
 
 
 @functools.partial(jax.jit,
@@ -169,13 +213,11 @@ def rolling_median_windows_pallas(padded: jax.Array, window: int,
             ],
             interpret=interpret,
         )(x)[:R, :T]
-        # jnp.median NaN semantics, outside the kernel: windowed NaN
-        # counts by cumsum difference (two cheap XLA passes) instead of
-        # an extra roll+add per kernel build step
-        cs = jnp.cumsum(jnp.isnan(x[:R]).astype(jnp.int32), axis=-1)
-        cnt = (cs[:, window - 1:window - 1 + T]
-               - jnp.pad(cs, ((0, 0), (1, 0)))[:, :T])
-        return jnp.where(cnt > 0, jnp.float32(jnp.nan), out)
+        # jnp.median NaN semantics live INSIDE the kernel (ISSUE 11):
+        # NaN keys share the IMAX padding sentinel, so the per-window
+        # NaN test is one VMEM count over the window matrix — the two
+        # XLA cumsum passes this wrapper used to spend are gone
+        return out
 
     # vmapping a pallas_call with an ANY-space input is not lowerable
     # (Mosaic requires whole-array blocks with trivial index maps there);
@@ -193,3 +235,162 @@ def rolling_median_windows_pallas(padded: jax.Array, window: int,
     lead = padded.shape[:-1]
     out = call2d(padded.reshape((-1, P)))
     return out.reshape(lead + (T,))
+
+
+def _fill_kernel(t_ref, m_ref, o_ref, *, L, Lp):
+    """Fused ``_fill_bad`` row block: masked stride-4 median (radix
+    bisection, VMEM-resident) + masked-mean fallback + select, in one
+    traversal of the (8, Lp) rows."""
+    IMAX = jnp.int32(0x7FFFFFFF)
+    t = t_ref[...]
+    m = m_ref[...]
+    valid = m > 0
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_ROWS, Lp), 1)
+    # the stride-4 subsample as a mask over the full row: same valid
+    # multiset as tod[..., ::4] / mask[..., ::4], so the selected order
+    # statistics (and hence the median) are bit-identical; lane < L
+    # also retires the zero-padded tail
+    on_grid = (lane % 4 == 0) & (lane < L)
+    sub = valid & on_grid
+    # monotone f32 -> signed i32 keys; invalid slots take the IMAX
+    # sentinel exactly like masked_median's u32 0xFFFFFFFF (same order)
+    u = jax.lax.bitcast_convert_type(t, jnp.uint32)
+    neg = (u >> 31) == 1
+    key_u = jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
+    keys = jnp.where(sub, jax.lax.bitcast_convert_type(
+        key_u ^ jnp.uint32(0x80000000), jnp.int32), IMAX)
+    cnt_sub = jnp.sum(sub.astype(jnp.int32), axis=1, keepdims=True)
+    k_lo = (jnp.maximum(cnt_sub, 1) - 1) // 2
+    k_hi = jnp.maximum(cnt_sub, 1) // 2
+    lo = jnp.full((_ROWS, 1), -0x80000000, jnp.int32)
+    hi = jnp.full((_ROWS, 1), 0x7FFFFFFF, jnp.int32)
+
+    def bis(_, lohi):
+        lo, hi = lohi
+        mid = (lo >> 1) + (hi >> 1) + (lo & hi & 1)
+        c = jnp.sum((keys <= mid).astype(jnp.int32), axis=1,
+                    keepdims=True)
+        take = c >= (k_lo + 1)
+        return (jnp.where(take, lo, mid + 1), jnp.where(take, mid, hi))
+
+    v_lo, _ = jax.lax.fori_loop(0, 32, bis, (lo, hi))
+    c_le = jnp.sum((keys <= v_lo).astype(jnp.int32), axis=1,
+                   keepdims=True)
+    above = jnp.where(keys > v_lo, keys, IMAX)
+    v_next = jnp.min(above, axis=1, keepdims=True)
+    v_hi = jnp.where(c_le >= k_hi + 1, v_lo, v_next)
+
+    def tof(v_s):
+        v = (jax.lax.bitcast_convert_type(v_s, jnp.uint32)
+             ^ jnp.uint32(0x80000000))
+        was_neg = (v >> 31) == 0
+        return jax.lax.bitcast_convert_type(
+            jnp.where(was_neg, ~v, v & jnp.uint32(0x7FFFFFFF)),
+            jnp.float32)
+
+    from comapreduce_tpu.ops.stats import _median_mid
+
+    med = jnp.where(cnt_sub > 0, _median_mid(tof(v_lo), tof(v_hi)), 0.0)
+    # _fill_bad's fallback test is the FLOAT mask sum on the stride
+    # grid (not the >0 count) and the full-length masked mean — both
+    # formulas verbatim so the fallback branch is taken identically
+    sub_f = jnp.sum(jnp.where(on_grid, m, 0.0), axis=1, keepdims=True)
+    cnt_f = jnp.sum(m, axis=1, keepdims=True)
+    mean = (jnp.sum(t * m, axis=1, keepdims=True)
+            / jnp.maximum(cnt_f, 1.0))
+    fill = jnp.where(sub_f > 0, med, mean)
+    o_ref[...] = jnp.where(valid, t, fill)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_fill_pallas(tod: jax.Array, mask: jax.Array,
+                       interpret: bool = False) -> jax.Array:
+    """``ops/reduce._fill_bad`` fused into one Mosaic kernel (ISSUE 11):
+    ``where(mask > 0, tod, fill)`` with ``fill`` the masked median of
+    the stride-4 subsample (masked-mean fallback when that subsample is
+    empty), one HBM read of tod + mask per row block.
+
+    Semantics are those of the XLA ``_fill_bad``: the median is an
+    exact order-statistic selection (radix bisection on monotone keys —
+    the same multiset, so bit-identically the same f32 element), the
+    fallback test and masked mean use the identical formulas, masked-in
+    samples (including NaN) pass through untouched and masked-out NaNs
+    are replaced by the fill. Two documented divergences: (1) the
+    masked-MEAN fallback (stride-4 subsample empty, mask non-empty)
+    sums over the kernel's zero-padded 128-lane rows, so at unaligned
+    ``L`` its f32 sum may reassociate a couple of ulp away from the
+    unpadded XLA reduce — the median path, which every realistic row
+    takes, stays bitwise; (2) a masked-IN **negative** NaN orders below
+    -inf here (monotone-key order) while the narrow-row XLA sort branch
+    sorts every NaN last — upstream ``nan_to_mask`` makes that
+    configuration unreachable.
+
+    ``interpret=True`` runs the Pallas interpreter — the CPU parity
+    path for tests and the ``bench.py --config kernels`` A/B.
+    """
+    lead = tod.shape[:-1]
+    L = tod.shape[-1]
+    if not pallas_fill_ok(L):
+        raise ValueError(f"row length {L} beyond MAX_PALLAS_FILL_LEN")
+    Lp = -(-L // 128) * 128
+
+    def call2d_raw(t2, m2):
+        R = t2.shape[0]
+        r_pad = -(-max(R, 1) // _ROWS) * _ROWS
+        t2 = jnp.pad(t2, ((0, r_pad - R), (0, Lp - L)))
+        m2 = jnp.pad(m2, ((0, r_pad - R), (0, Lp - L)))
+        spec = pl.BlockSpec((_ROWS, Lp), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        out = pl.pallas_call(
+            functools.partial(_fill_kernel, L=L, Lp=Lp),
+            grid=(r_pad // _ROWS,),
+            in_specs=[spec, spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((r_pad, Lp), jnp.float32),
+            interpret=interpret,
+        )(t2, m2)
+        return out[:R, :L]
+
+    # batching folds into the row axis (same rationale as the rolling
+    # median above: rows are embarrassingly parallel and the scan-batch
+    # vmap must not try to vmap the pallas_call itself)
+    call2d = jax.custom_batching.custom_vmap(call2d_raw)
+
+    @call2d.def_vmap
+    def _rule(axis_size, in_batched, tb, mb):  # noqa: ANN001
+        del axis_size, in_batched
+        out = call2d(tb.reshape((-1, tb.shape[-1])),
+                     mb.reshape((-1, mb.shape[-1])))
+        return out.reshape(tb.shape), True
+
+    t = tod.astype(jnp.float32).reshape((-1, L))
+    m = mask.astype(jnp.float32).reshape((-1, L))
+    return call2d(t, m).reshape(lead + (L,))
+
+
+def masked_fill_logical_passes(shape: tuple[int, ...]) -> float:
+    """Logical-HBM-pass accounting for :func:`masked_fill_pallas` on a
+    ``shape`` TOD block, in units of the block's own bytes — the
+    machine-independent number the compile-inspection budget test and
+    the ``check_perf.py`` kernel gate pin.
+
+    The kernel's HBM traffic is read(tod) + read(mask) + write(out) on
+    the (row, lane)-padded image; when padding is needed the XLA-side
+    pad copies (read + padded write per input, padded read + write for
+    the output slice) are charged too. No measurement is involved: the
+    count follows from the kernel's block plan by construction, which
+    is what makes it pinnable on a CPU-only CI host where the Mosaic
+    body cannot be compiled."""
+    L = int(shape[-1])
+    R = 1
+    for d in shape[:-1]:
+        R *= int(d)
+    Lp = -(-L // 128) * 128
+    r_pad = -(-max(R, 1) // _ROWS) * _ROWS
+    ratio = (r_pad * Lp) / float(max(R * L, 1))
+    passes = 3.0 * ratio
+    if ratio != 1.0:
+        # two input pad copies (read unpadded + write padded) and the
+        # output slice (read padded + write unpadded)
+        passes += 2.0 * (1.0 + ratio) + (ratio + 1.0)
+    return passes

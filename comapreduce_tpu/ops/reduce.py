@@ -430,7 +430,18 @@ def pad_scan_geometry(starts: np.ndarray, lengths: np.ndarray,
                                      np.asarray(lengths).dtype)]))
 
 
-def _fill_bad(tod, mask):
+def _fill_bad_xla(tod, mask):
+    """XLA branch of :func:`_fill_bad` — the reference semantics every
+    other implementation must match bit-for-bit."""
+    med = masked_median(tod[..., ::4], mask[..., ::4], axis=-1)
+    sub_cnt = jnp.sum(mask[..., ::4], axis=-1)
+    cnt = jnp.sum(mask, axis=-1)
+    mean = jnp.sum(tod * mask, axis=-1) / jnp.maximum(cnt, 1.0)
+    fill = jnp.where(sub_cnt > 0, med, mean)[..., None]
+    return jnp.where(mask > 0, tod, fill)
+
+
+def _fill_bad(tod, mask, impl: str = "auto"):
     """Replace masked samples with the per-channel masked median
     (``fill_bad_data``, ``Level1Averaging.py:658-665``).
 
@@ -440,16 +451,49 @@ def _fill_bad(tod, mask):
     samples all fall off the stride-4 grid the subsampled median is
     undefined — ``masked_median`` on an empty subsample returns its
     float32-max sort sentinel (~3.4e38), so fall back to the full-length
-    masked mean (cheap reduction) instead of filling with the sentinel."""
-    med = masked_median(tod[..., ::4], mask[..., ::4], axis=-1)
-    sub_cnt = jnp.sum(mask[..., ::4], axis=-1)
-    cnt = jnp.sum(mask, axis=-1)
-    mean = jnp.sum(tod * mask, axis=-1) / jnp.maximum(cnt, 1.0)
-    fill = jnp.where(sub_cnt > 0, med, mean)[..., None]
-    return jnp.where(mask > 0, tod, fill)
+    masked mean (cheap reduction) instead of filling with the sentinel.
+
+    The XLA formulation is the reduction pre-filter's measured floor
+    (~34 logical HBM passes: the median selection re-reads the block
+    once per radix/sort step). On TPU backends the fused Mosaic kernel
+    (``ops/pallas_median.masked_fill_pallas``) computes the identical
+    fill in 3 passes, gated exactly like ``rolling_median``'s kernel:
+    ``pallas_supported()``/``pallas_fill_ok()`` keep the Mosaic body
+    out of the jaxpr at TRACE time on CPU-only hosts, and
+    ``platform_dependent`` picks the branch per lowering platform on
+    TPU hosts. CPU-default behaviour is byte-identical by construction
+    (the gate leaves this function exactly `_fill_bad_xla` there).
+
+    ``impl`` overrides the gate for tests and benches: ``"xla"`` forces
+    the reference, ``"pallas"`` traces the kernel unconditionally (the
+    compile-inspection budget test inspects that jaxpr), ``"interpret"``
+    runs the kernel under the Pallas interpreter (CPU parity suite),
+    and ``"none"`` skips the fill entirely — test-only, so the budget
+    test can compile-inspect the rest of the pre-filter chain and add
+    the kernel's accounted passes on top."""
+    if impl == "none":
+        return tod
+    if impl == "xla":
+        return _fill_bad_xla(tod, mask)
+    from comapreduce_tpu.ops.pallas_median import (masked_fill_pallas,
+                                                   pallas_fill_ok,
+                                                   pallas_supported)
+    if impl == "pallas":
+        return masked_fill_pallas(tod, mask)
+    if impl == "interpret":
+        return masked_fill_pallas(tod, mask, interpret=True)
+    if impl != "auto":
+        raise ValueError(f"unknown _fill_bad impl {impl!r}")
+    if tod.dtype == jnp.float32 and pallas_fill_ok(tod.shape[-1]) \
+            and pallas_supported():
+        return jax.lax.platform_dependent(
+            tod, mask,
+            tpu=masked_fill_pallas, axon=masked_fill_pallas,
+            default=_fill_bad_xla)
+    return _fill_bad_xla(tod, mask)
 
 
-def _prefilter_chain(d_s, m_s, a_s, cfg: ReduceConfig):
+def _prefilter_chain(d_s, m_s, a_s, cfg: ReduceConfig, fill_impl="auto"):
     """Fused PRE-FILTER segment of the per-scan chain: NaN fill ->
     atmosphere (field) or median (calibrator) removal -> radiometer
     normalisation.
@@ -460,11 +504,18 @@ def _prefilter_chain(d_s, m_s, a_s, cfg: ReduceConfig):
     reduction math over one ``(B, C, L)`` scan block and XLA fuses the
     chain into a handful of logical HBM passes. Returns
     ``(clean_norm, norm, atmos_fit)``; ``m_s`` must already carry the
-    time-validity mask (the caller's ``tv``)."""
+    time-validity mask (the caller's ``tv``).
+
+    ``fill_impl`` routes the NaN fill (see :func:`_fill_bad`): the
+    ``"auto"`` default keeps CPU behaviour byte-identical while TPU
+    lowerings take the fused Mosaic kernel — the pre-filter's measured
+    ~34-pass floor is almost entirely the XLA fill's median selection,
+    so the kernel is what moves this chain toward the post-filter's
+    ~3-pass budget (ROOFLINE round 8)."""
     B, C, L = d_s.shape
     # NaN fill is per-scan independent; doing it here (not on the full
     # block) lets scan_batch streaming bound its memory too
-    d_s = _fill_bad(d_s, m_s)
+    d_s = _fill_bad(d_s, m_s, impl=fill_impl)
     if cfg.is_calibrator:
         med = masked_median(d_s, m_s, axis=-1)
         base, slope = med, jnp.zeros_like(med)

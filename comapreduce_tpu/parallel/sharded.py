@@ -258,7 +258,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                   n_bands: int = 0,
                                   n_groups: int = 0,
                                   with_coarse: bool = False,
-                                  precond: str = "jacobi"):
+                                  precond: str = "jacobi",
+                                  kernels: str = "auto"):
     """Build a reusable sharded planned-destriper: returns
     ``run(tod, weights) -> DestriperResult``.
 
@@ -322,7 +323,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                     threshold=threshold, axis_name=axes,
                                     dense_maps=False, device_arrays=arrs,
                                     ground_off=g_off_l, az=az_l,
-                                    n_groups=n_groups, precond=precond)
+                                    n_groups=n_groups, precond=precond,
+                                    kernels=kernels)
 
         fn = jax.jit(_shard_map(
             local_g, mesh=mesh,
@@ -343,7 +345,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
             return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
                                     threshold=threshold, axis_name=axes,
                                     dense_maps=False, device_arrays=arrs,
-                                    coarse=(grp_l, aci), precond=precond)
+                                    coarse=(grp_l, aci), precond=precond,
+                                    kernels=kernels)
 
         fn = jax.jit(_shard_map(
             local_c, mesh=mesh,
@@ -364,7 +367,7 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
         return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
                                 threshold=threshold, axis_name=axes,
                                 dense_maps=False, device_arrays=arrs,
-                                precond=precond)
+                                precond=precond, kernels=kernels)
 
     fn = jax.jit(_shard_map(local, mesh=mesh,
                             in_specs=(v_spec, v_spec, arr_specs),

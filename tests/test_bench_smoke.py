@@ -109,11 +109,25 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                                      "n_compact": 768, "n_bands": 1},
                        "survey4096": {"map_vector_bytes": 12288,
                                       "n_compact": 768, "n_bands": 1}}}
+    kern = {"metric": "kernels_prefilter_accounted_passes", "value": 25.2,
+            "detail": {"kernel_impl": "interpret",
+                       "fill": {"accounted": {
+                           "field": {"fused_passes": 25.2,
+                                     "xla_passes": 34.3},
+                           "calib": {"fused_passes": 26.9,
+                                     "xla_passes": 37.0}},
+                           "parity_maxdiff": 0.0},
+                       "binning": {"cg_iters": {"xla": 58,
+                                                "interpret": 58},
+                                   "parity_offsets_maxdiff": 1e-4},
+                       "tpu_rows": "deferred: requires TPU"}}
     monkeypatch.setattr(cp, "run_quick_bench", lambda: dict(rec))
     monkeypatch.setattr(cp, "run_campaign_bench",
                         lambda: json.loads(json.dumps(camp)))
     monkeypatch.setattr(cp, "run_destriper_bench",
                         lambda: json.loads(json.dumps(dstr)))
+    monkeypatch.setattr(cp, "run_kernels_bench",
+                        lambda: json.loads(json.dumps(kern)))
     monkeypatch.setattr(
         cp, "reference_path",
         lambda platform: str(tmp_path / f"perf_quick_{platform}.json"))
@@ -153,6 +167,29 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     assert cp.main(["--reps", "1"]) == 1
     dstr["detail"]["preconditioners"]["multigrid"]["iters_to_tol"] = 58
     assert cp.main(["--reps", "1"]) == 0
+    # the fused-kernel gate (ISSUE 11): a pass-budget breach (28 field /
+    # 30 calib, and always below the live XLA floor), a masked-fill
+    # parity drift, or a cg_iters change under the kernel impl each
+    # fail; --no-kernels skips the child entirely
+    kacct = kern["detail"]["fill"]["accounted"]
+    kacct["field"]["fused_passes"] = 30.0        # budget 28 blown
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    assert cp.main(["--reps", "1", "--no-kernels"]) == 0
+    kacct["field"]["fused_passes"] = 36.0        # above the live floor
+    kacct["field"]["xla_passes"] = 35.0
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    kacct["field"]["fused_passes"] = 25.2
+    kacct["field"]["xla_passes"] = 34.3
+    kern["detail"]["fill"]["parity_maxdiff"] = 1e-3
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1         # fill semantics broke
+    kern["detail"]["fill"]["parity_maxdiff"] = 0.0
+    kern["detail"]["binning"]["cg_iters"]["interpret"] = 61
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1         # solve perturbed
+    kern["detail"]["binning"]["cg_iters"]["interpret"] = 58
+    kern["detail"]["binning"]["parity_offsets_maxdiff"] = 0.02
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1         # converged-offset drift
+    kern["detail"]["binning"]["parity_offsets_maxdiff"] = 1e-4
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
 
 
 def test_bench_config_modes_emit_json(tmp_path):

@@ -1,0 +1,377 @@
+"""Pallas scatter/gather binning kernels (ISSUE 11 tentpole 2), interpret
+mode — the Mosaic path itself runs on the TPU bench; the kernel logic is
+identical.
+
+Contract under test: ``binned_window_sum_pallas`` reproduces the XLA
+paths to f32 accumulation-order rtol (the kernel accumulates ``chunk //
+SUB`` partial MXU products where XLA contracts once); the windowed
+gather is bit-exact for in-window ids and returns 0.0 (not a clamped
+element) outside; and the ``kernels=`` knob on ``destripe_planned``
+changes the execution path, never the solve.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from comapreduce_tpu.mapmaking.destriper import (CONFIG_KERNELS,
+                                                 build_coarse_preconditioner,
+                                                 build_multigrid_hierarchy,
+                                                 destripe_planned)
+from comapreduce_tpu.mapmaking.pallas_binning import (
+    KERNELS_CHOICES, MAX_PALLAS_BIN_WINDOW, binned_window_sum_pallas,
+    binning_logical_bytes, pallas_binning_ok, resolve_kernels,
+    windowed_gather_pallas)
+from comapreduce_tpu.mapmaking.pointing_plan import (binned_window_sum,
+                                                     build_pointing_plan)
+
+
+def _windowed(M, out_size, chunk, seed=0):
+    """Plan-style sorted ids + per-chunk window starts."""
+    rng = np.random.default_rng(seed)
+    ids = np.sort(rng.integers(0, out_size, M))
+    n_chunks = M // chunk
+    base = ids.reshape(n_chunks, chunk)[:, 0]
+    span = ids.reshape(n_chunks, chunk)[:, -1] - base + 1
+    window = int(-(-int(span.max()) // 16) * 16)
+    return ids, base, window
+
+
+# ---------------------------------------------------------------------------
+# scatter kernel
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lead,chunk", [((), 128), ((3,), 128),
+                                        ((2, 2), 256), ((2,), 512)])
+def test_scatter_matches_xla_and_bincount(lead, chunk):
+    rng = np.random.default_rng(1)
+    M, out_size = 1024, 300
+    ids, base, window = _windowed(M, out_size, chunk)
+    vals = rng.normal(size=lead + (M,)).astype(np.float32)
+    assert pallas_binning_ok(window, chunk, interpret=True)
+    got = np.asarray(binned_window_sum_pallas(
+        jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, out_size,
+        interpret=True))
+    assert got.shape == lead + (out_size,)
+    xla = np.asarray(binned_window_sum(
+        jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, out_size,
+        impl="xla"))
+    scale = float(np.abs(xla).max())
+    np.testing.assert_allclose(got, xla, rtol=2e-6, atol=2e-6 * scale)
+    want = np.apply_along_axis(
+        lambda v: np.bincount(ids, weights=v, minlength=out_size), -1,
+        vals.reshape(-1, M)).reshape(lead + (out_size,))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5 * scale)
+
+
+def test_scatter_sentinel_and_out_of_window_drop():
+    """The drop contract the planner relies on: whole sentinel chunks at,
+    past, and far past out_size contribute nothing; ids outside a chunk's
+    ``[base, base+window)`` drop on BOTH sides of the window — exactly
+    what the XLA fori path does."""
+    chunk, out_size, window = 128, 100, 64
+    ids = np.concatenate([
+        np.sort(np.random.default_rng(0).integers(10, 10 + window - 4,
+                                                  chunk)),
+        np.full(chunk, out_size), np.full(chunk, out_size + 10),
+        np.full(chunk, out_size + 1000)]).astype(np.int64)
+    # two in-chunk violations: below base and at/above base+window
+    ids[0] = 5
+    ids[chunk - 1] = 10 + window
+    base = np.array([10, out_size, out_size + 10, out_size + 1000],
+                    np.int64)
+    vals = np.ones(ids.size, np.float32)
+    in_win = (ids[:chunk] >= 10) & (ids[:chunk] < 10 + window)
+    want = np.bincount(ids[:chunk][in_win], minlength=out_size)
+    got = np.asarray(binned_window_sum_pallas(
+        jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, out_size,
+        interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=0)
+    xla = np.asarray(binned_window_sum(
+        jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, out_size,
+        impl="xla"))
+    np.testing.assert_allclose(got, xla, rtol=1e-6, atol=0)
+
+
+def test_scatter_multi_rhs_rows_match_single():
+    """Stacked RHS rows ride the same kernel launch: each row equals its
+    own single-row call bitwise (rows never mix in the one-hot dot)."""
+    rng = np.random.default_rng(2)
+    M, out_size, chunk, nb = 512, 200, 128, 3
+    ids, base, window = _windowed(M, out_size, chunk, seed=2)
+    vals = rng.normal(size=(nb, M)).astype(np.float32)
+    multi = np.asarray(binned_window_sum_pallas(
+        jnp.asarray(vals), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, out_size,
+        interpret=True))
+    for b in range(nb):
+        one = np.asarray(binned_window_sum_pallas(
+            jnp.asarray(vals[b]), jnp.asarray(ids, jnp.int32),
+            jnp.asarray(base, jnp.int32), window, chunk, out_size,
+            interpret=True))
+        np.testing.assert_array_equal(multi[b], one)
+
+
+def test_zero_length_scans():
+    """M == 0 (a rank that holds no pairs after an elastic shrink):
+    zeros of the right shape, no kernel launch."""
+    base = jnp.zeros((0,), jnp.int32)
+    e = binned_window_sum_pallas(jnp.zeros((2, 0), jnp.float32),
+                                 jnp.zeros((0,), jnp.int32), base,
+                                 64, 128, 50, interpret=True)
+    assert e.shape == (2, 50) and not np.asarray(e).any()
+    g = windowed_gather_pallas(jnp.ones((2, 30), jnp.float32),
+                               jnp.zeros((0,), jnp.int32), base,
+                               64, 128, interpret=True)
+    assert g.shape == (2, 0)
+
+
+# ---------------------------------------------------------------------------
+# gather kernel
+# ---------------------------------------------------------------------------
+
+def test_gather_matches_take_bitwise():
+    rng = np.random.default_rng(3)
+    S, M, chunk = 300, 512, 128
+    src = rng.normal(size=(2, S)).astype(np.float32)
+    ids, base, window = _windowed(M, S, chunk, seed=3)
+    got = np.asarray(windowed_gather_pallas(
+        jnp.asarray(src), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, interpret=True))
+    # in-window gather is ONE 1.0 * src MXU term -> bit-exact
+    np.testing.assert_array_equal(got, src[:, ids])
+
+
+def test_gather_out_of_window_returns_zero():
+    """Sentinel semantics differ from ``jnp.take(src, clip(ids))`` BY
+    DESIGN: out-of-window lanes read 0.0, so the substitution is only
+    valid where those lanes carry zero weight downstream (the ground
+    path's ``paz_off``/``pair_w_off`` padding) — pin the zero."""
+    S, chunk, window = 100, 128, 64
+    src = np.arange(1, S + 1, dtype=np.float32)
+    ids = np.full(chunk, 10, np.int64)
+    ids[0] = 5                  # below base
+    ids[1] = 10 + window        # at base+window
+    ids[2] = S + 20             # past the source entirely
+    base = np.array([10], np.int64)
+    got = np.asarray(windowed_gather_pallas(
+        jnp.asarray(src), jnp.asarray(ids, jnp.int32),
+        jnp.asarray(base, jnp.int32), window, chunk, interpret=True))
+    assert got[0] == 0.0 and got[1] == 0.0 and got[2] == 0.0
+    np.testing.assert_array_equal(got[3:], src[10] * np.ones(chunk - 3))
+
+
+# ---------------------------------------------------------------------------
+# gate, resolution, accounting, routing
+# ---------------------------------------------------------------------------
+
+def test_gate_and_resolve():
+    import jax
+
+    assert jax.default_backend() == "cpu"
+    # structural checks hold in both modes
+    assert pallas_binning_ok(2048, 8192, rows=4)
+    assert not pallas_binning_ok(0, 128)
+    assert not pallas_binning_ok(MAX_PALLAS_BIN_WINDOW + 16, 128)
+    # compiled path wants 128-aligned chunks + the VMEM budget; the
+    # interpreter has no VMEM and no lane tiling
+    assert not pallas_binning_ok(64, 100)
+    assert pallas_binning_ok(64, 100, interpret=True)
+    assert not pallas_binning_ok(MAX_PALLAS_BIN_WINDOW, 512)   # > budget
+    assert pallas_binning_ok(MAX_PALLAS_BIN_WINDOW, 512, interpret=True)
+    # knob resolution is trace-time and platform-aware
+    assert resolve_kernels("auto") == "xla"            # CPU host
+    assert resolve_kernels("auto", platform="tpu") == "pallas"
+    assert resolve_kernels("auto", platform="tpu v5e") == "pallas"
+    assert resolve_kernels("xla") == "xla"
+    assert resolve_kernels("pallas") == "pallas"
+    assert resolve_kernels("interpret") == "interpret"
+    with pytest.raises(ValueError, match="kernels"):
+        resolve_kernels("bogus")
+    assert CONFIG_KERNELS == KERNELS_CHOICES
+    # unsupported shapes refuse loudly when called directly
+    with pytest.raises(ValueError, match="unsupported"):
+        binned_window_sum_pallas(jnp.zeros((8,), jnp.float32),
+                                 jnp.zeros((8,), jnp.int32),
+                                 jnp.zeros((1,), jnp.int32),
+                                 MAX_PALLAS_BIN_WINDOW + 16, 8, 10)
+    with pytest.raises(ValueError, match="unsupported"):
+        windowed_gather_pallas(jnp.zeros((8,), jnp.float32),
+                               jnp.zeros((8,), jnp.int32),
+                               jnp.zeros((1,), jnp.int32), 0, 8)
+    acct = binning_logical_bytes(rows=1, M=4096, window=512, chunk=256,
+                                 out_size=1000)
+    assert acct["xla_bytes"] > 0 and acct["pallas_bytes"] > 0
+    assert acct["ratio"] == pytest.approx(
+        acct["xla_bytes"] / acct["pallas_bytes"])
+
+
+def test_binned_window_sum_impl_routing():
+    """``impl=`` threads through the dispatcher: interpret reproduces the
+    fori path; gate-rejected shapes silently fall back to fori."""
+    rng = np.random.default_rng(4)
+    M, out_size, chunk = 512, 200, 128
+    ids, base, window = _windowed(M, out_size, chunk, seed=4)
+    vals = rng.normal(size=M).astype(np.float32)
+    args = (jnp.asarray(ids, jnp.int32), jnp.asarray(base, jnp.int32))
+    xla = np.asarray(binned_window_sum(jnp.asarray(vals), *args, window,
+                                       chunk, out_size, impl="xla"))
+    itp = np.asarray(binned_window_sum(jnp.asarray(vals), *args, window,
+                                       chunk, out_size, impl="interpret"))
+    np.testing.assert_allclose(itp, xla, rtol=2e-6,
+                               atol=2e-6 * float(np.abs(xla).max()))
+    # non-f32 values cannot enter the kernel: same result as the fori
+    # path, bit-for-bit, because it IS the fori path
+    half = np.asarray(binned_window_sum(
+        jnp.asarray(vals.astype(np.float16)), *args, window, chunk,
+        out_size, impl="interpret"))
+    half_x = np.asarray(binned_window_sum(
+        jnp.asarray(vals.astype(np.float16)), *args, window, chunk,
+        out_size, impl="xla"))
+    np.testing.assert_array_equal(half, half_x)
+
+
+# ---------------------------------------------------------------------------
+# destripe_planned end-to-end: the knob changes the path, never the solve
+# ---------------------------------------------------------------------------
+
+def _raster_pixels(n, npix, n_bad=37, seed=0, n_passes=3):
+    rng = np.random.default_rng(seed)
+    nx = int(np.sqrt(npix))
+    t = np.arange(n)
+    x = np.abs(((t / 97.0) % 2.0) - 1.0) * (nx - 1)
+    y = np.abs(((t * n_passes / n) % 2.0) - 1.0) * (nx - 1)
+    pix = (np.round(y) * nx + np.round(x)).astype(np.int64)
+    bad = rng.choice(n, size=n_bad, replace=False)
+    pix[bad[: n_bad // 2]] = -1                       # invalid sentinels
+    pix[bad[n_bad // 2:]] = npix + rng.integers(0, 5, n_bad - n_bad // 2)
+    return pix
+
+
+def _problem(seed=2, n=4000, npix=144, L=50, n_bad=37):
+    rng = np.random.default_rng(seed)
+    pix = _raster_pixels(n, npix, n_bad=n_bad)
+    offs = np.repeat(rng.normal(0, 1, n // L), L)
+    sky = rng.normal(0, 1, npix + 8)
+    tod = (sky[np.clip(pix, 0, npix - 1)] + offs
+           + 0.1 * rng.normal(size=n)).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    w[rng.choice(n, 29, replace=False)] = 0.0
+    return pix, tod, w, npix, L
+
+
+def _compare(a, b, atol=5e-4):
+    np.testing.assert_allclose(np.asarray(a.offsets), np.asarray(b.offsets),
+                               rtol=0, atol=atol)
+    np.testing.assert_allclose(np.asarray(a.destriped_map),
+                               np.asarray(b.destriped_map),
+                               rtol=0, atol=atol)
+    np.testing.assert_array_equal(np.asarray(a.hit_map),
+                                  np.asarray(b.hit_map))
+    assert int(np.max(np.asarray(a.n_iter))) == int(
+        np.max(np.asarray(b.n_iter)))
+
+
+@pytest.mark.parametrize("knob", ["none", "jacobi", "coarse", "mg"])
+def test_destripe_planned_kernels_parity(knob):
+    """kernels="interpret" (real kernel arithmetic via the Pallas
+    interpreter) vs kernels="xla" under every preconditioner knob:
+    same iterations (threshold=0 pins the count), offsets and maps to
+    f32 accumulation tolerance, hits exact."""
+    pix, tod, w, npix, L = _problem()
+    plan = build_pointing_plan(pix, npix, L, sample_chunk=512,
+                               pair_chunk=256)
+    kw = {}
+    if knob == "none":
+        kw["precond"] = "none"
+    elif knob == "coarse":
+        grp, aci = build_coarse_preconditioner(pix, w, npix, L, block=8)
+        kw["coarse"] = (grp, jnp.asarray(aci))
+    elif knob == "mg":
+        kw["mg"] = build_multigrid_hierarchy(pix, w, npix, L, block=8,
+                                             levels=2)
+    res = {k: destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                               n_iter=12, threshold=0.0, kernels=k, **kw)
+           for k in ("xla", "interpret")}
+    _compare(res["interpret"], res["xla"])
+
+
+def test_destripe_planned_kernels_parity_ground():
+    """The ground-pickup path swaps its offset gathers for the Pallas
+    windowed gather — joint [offsets; ground] solve must agree."""
+    from comapreduce_tpu.mapmaking.destriper import ground_ids_per_offset
+
+    rng = np.random.default_rng(11)
+    pix, tod, w, npix, L = _problem(n_bad=0)
+    n = tod.size
+    gids = np.repeat(np.arange(2), n // 2).astype(np.int32)
+    az = np.tile(np.linspace(-1, 1, 200), n // 200).astype(np.float32)
+    tod = (tod + 0.5 * az * (2 * gids - 1)).astype(np.float32)
+    plan = build_pointing_plan(pix, npix, L, sample_chunk=512,
+                               pair_chunk=256)
+    g_off = ground_ids_per_offset(gids, L)
+    res = {k: destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                               n_iter=12, threshold=0.0,
+                               ground_off=g_off, az=jnp.asarray(az),
+                               n_groups=2, kernels=k)
+           for k in ("xla", "interpret")}
+    _compare(res["interpret"], res["xla"])
+    np.testing.assert_allclose(np.asarray(res["interpret"].ground),
+                               np.asarray(res["xla"].ground),
+                               rtol=0, atol=5e-4)
+
+
+def test_destripe_planned_kernels_parity_compact_multi_rhs():
+    """Compacted PixelSpace + stacked bands under the knob: the kernels
+    see n_compact-sized maps and a leading RHS axis at once."""
+    from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+
+    pix, tod, w, npix, L = _problem()
+    npix = 4 * npix        # embed the raster in a mostly-unhit sky
+    space = PixelSpace.from_pixels(pix, npix)
+    assert space.compacted and space.n_compact < npix
+    plan = build_pointing_plan(space.remap(pix), space, L,
+                               sample_chunk=512, pair_chunk=256)
+    tods = np.stack([tod, np.roll(tod, 7)])
+    ws = np.stack([w, w])
+    res = {k: destripe_planned(jnp.asarray(tods), jnp.asarray(ws),
+                               plan=plan, n_iter=12, threshold=0.0,
+                               kernels=k)
+           for k in ("xla", "interpret")}
+    assert res["xla"].destriped_map.shape == (2, space.n_compact)
+    _compare(res["interpret"], res["xla"])
+
+
+def test_kernels_auto_is_byte_identical_on_cpu():
+    """Acceptance criterion: ``kernels="auto"`` on a CPU host resolves to
+    the XLA path at trace time — bitwise the same solve as the default
+    (no Mosaic branch ever enters the jaxpr)."""
+    pix, tod, w, npix, L = _problem()
+    plan = build_pointing_plan(pix, npix, L)
+    dflt = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                            n_iter=15, threshold=1e-7)
+    auto = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                            n_iter=15, threshold=1e-7, kernels="auto")
+    for name in ("offsets", "destriped_map", "naive_map", "weight_map",
+                 "hit_map", "residual"):
+        np.testing.assert_array_equal(np.asarray(getattr(auto, name)),
+                                      np.asarray(getattr(dflt, name)),
+                                      err_msg=name)
+
+
+def test_kernels_knob_validates():
+    from comapreduce_tpu.mapmaking.destriper import destripe
+
+    pix, tod, w, npix, L = _problem(n=1000)
+    plan = build_pointing_plan(pix, npix, L)
+    with pytest.raises(ValueError, match="kernels"):
+        destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         n_iter=2, kernels="bogus")
+    with pytest.raises(ValueError, match="kernels"):
+        destripe(jnp.asarray(tod), jnp.asarray(pix, jnp.int32),
+                 jnp.asarray(w), npix, offset_length=L, n_iter=2,
+                 kernels="bogus")

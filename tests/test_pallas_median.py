@@ -103,3 +103,105 @@ def test_dispatch_gate_cpu():
     padded = np.pad(np.asarray(x), [(0, 0), (left, w - 1 - left)],
                     mode="edge")
     np.testing.assert_array_equal(out, np.asarray(_oracle(padded, w)))
+
+
+def test_pallas_supported_platform_override():
+    """ISSUE 11 satellite: a mixed CPU+TPU host must be able to gate
+    per-PROGRAM, not per-process — ``pallas_supported(platform=...)``
+    consults the override instead of the process-default backend (the
+    hook ``destripe_planned(..., kernels_platform=...)`` threads)."""
+    import jax
+
+    from comapreduce_tpu.ops.pallas_median import pallas_supported
+    assert jax.default_backend() == "cpu"
+    assert not pallas_supported()
+    assert not pallas_supported(platform="cpu")
+    assert pallas_supported(platform="tpu")
+    assert pallas_supported(platform="tpu v5e")
+    assert pallas_supported(platform="axon")
+    assert not pallas_supported(platform="gpu")
+
+
+def _fill_fixture(B, C, L, seed=1):
+    rng = np.random.default_rng(seed)
+    tod = rng.normal(size=(B, C, L)).astype(np.float32)
+    mask = (rng.random((B, C, L)) > 0.2).astype(np.float32)
+    # all-masked channel -> masked-mean fallback over an empty set (0.0)
+    mask[0, 0] = 0.0
+    if L >= 8 and C >= 2:
+        # valid samples ONLY off the stride-4 grid -> masked-mean branch
+        mask[0, 1] = 0.0
+        mask[0, 1, 1::4] = 1.0
+    # masked-OUT NaN must be replaced by the fill
+    tod[0, C - 1, 0] = np.nan
+    mask[0, C - 1, 0] = 0.0
+    if C >= 4:
+        # masked-IN +NaN propagates (upstream nan_to_mask only ever
+        # leaves +NaN; -NaN key order is the one documented divergence)
+        tod[0, 3, 5] = np.nan
+        mask[0, 3, 5] = 1.0
+    return tod, mask
+
+
+@pytest.mark.parametrize("shape", [(2, 3, 1024), (1, 5, 1000),
+                                   (2, 2, 4096), (1, 1, 64)])
+def test_masked_fill_interpret_bitwise(shape):
+    """ISSUE 11 tentpole 1: the fused masked-fill kernel is BIT-identical
+    to the XLA ``_fill_bad`` reference on the median path — the stride-4
+    masked median is an exact order statistic either way. The one carve
+    out: masked-MEAN fallback rows (stride-4 subsample empty, mask
+    non-empty) sum over the kernel's zero-padded 128-lane rows, so at
+    unaligned L the f32 sum reassociates ~1 ulp away from the unpadded
+    XLA reduce; those fill values are pinned at a few ulp instead."""
+    from comapreduce_tpu.ops.pallas_median import masked_fill_pallas
+    from comapreduce_tpu.ops.reduce import _fill_bad
+
+    tod, mask = _fill_fixture(*shape)
+    # masked-out positions of mean-fallback rows receive the fallback
+    # mean; everything else (median fills, pass-throughs, empty rows)
+    # must be bitwise
+    mean_rows = (mask[..., ::4].sum(-1) == 0) & (mask.sum(-1) > 0)
+    fb = mean_rows[..., None] & (mask == 0)
+
+    def check(got):
+        np.testing.assert_array_equal(
+            np.nan_to_num(got[~fb], nan=-1.25),
+            np.nan_to_num(want[~fb], nan=-1.25))
+        np.testing.assert_allclose(got[fb], want[fb], rtol=6e-7)
+
+    want = np.asarray(_fill_bad(jnp.asarray(tod), jnp.asarray(mask),
+                                impl="xla"))
+    check(np.asarray(masked_fill_pallas(jnp.asarray(tod),
+                                        jnp.asarray(mask),
+                                        interpret=True)))
+    # the dispatcher's interpret mode is the same call
+    check(np.asarray(_fill_bad(jnp.asarray(tod), jnp.asarray(mask),
+                               impl="interpret")))
+
+
+def test_masked_fill_dispatch_and_accounting():
+    """`_fill_bad` auto mode stays XLA-only on CPU (byte-identity gate);
+    the fill-length gate and the logical-pass accounting behave."""
+    from comapreduce_tpu.ops.pallas_median import (
+        MAX_PALLAS_FILL_LEN, masked_fill_logical_passes, masked_fill_pallas,
+        pallas_fill_ok)
+    from comapreduce_tpu.ops.reduce import _fill_bad
+
+    tod, mask = _fill_fixture(2, 3, 512)
+    auto = np.asarray(_fill_bad(jnp.asarray(tod), jnp.asarray(mask)))
+    xla = np.asarray(_fill_bad(jnp.asarray(tod), jnp.asarray(mask),
+                               impl="xla"))
+    np.testing.assert_array_equal(auto, xla)   # bitwise: same branch
+    with pytest.raises(ValueError):
+        _fill_bad(jnp.asarray(tod), jnp.asarray(mask), impl="bogus")
+    assert pallas_fill_ok(1024) and pallas_fill_ok(MAX_PALLAS_FILL_LEN)
+    assert not pallas_fill_ok(MAX_PALLAS_FILL_LEN + 128)
+    with pytest.raises(ValueError):
+        masked_fill_pallas(jnp.zeros((2, MAX_PALLAS_FILL_LEN + 128),
+                                     jnp.float32),
+                           jnp.ones((2, MAX_PALLAS_FILL_LEN + 128),
+                                    jnp.float32))
+    # aligned shape: exactly the 3 in-VMEM passes; padded lanes charge
+    # the pad copies on top
+    assert masked_fill_logical_passes((2, 64, 1024)) == 3.0
+    assert masked_fill_logical_passes((2, 64, 1000)) > 3.0

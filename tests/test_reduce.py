@@ -261,6 +261,62 @@ def test_fused_segment_pass_budgets():
         assert pre <= 40.0, (calib, pre)
 
 
+def test_fused_fill_pass_budget():
+    """Compile-inspection (ISSUE 11 tentpole 1): the fused Mosaic
+    masked-fill drops the pre-filter below its measured ~34.3-pass XLA
+    floor.
+
+    Mosaic kernels cannot LOWER on a CPU host, so the gated path's cost
+    is assembled from two machine-independent halves: (a) the XLA cost
+    model over the rest of the chain with the fill elided
+    (``fill_impl='none'`` — test-only mode), and (b) the kernel's
+    accounted logical passes (``masked_fill_logical_passes``: 3 HBM
+    passes of the padded block — read tod + mask, write out — plus
+    explicit pad-copy charges when the lane axis is padded). The jaxpr
+    inspection pins the structure: forcing the kernel traces exactly ONE
+    pallas_call and NO sort (tracing works everywhere; only lowering is
+    TPU-bound), and the CPU-default ``auto`` path traces no pallas at
+    all (byte-identity gate). Budgets pinned from measurement: rest
+    22.2/23.9 (field/calib) + 3.0 accounted = 25.2/26.9 vs the 34.3
+    floor ``test_fused_segment_pass_budgets`` still bounds."""
+    import functools
+
+    from comapreduce_tpu.ops.pallas_median import masked_fill_logical_passes
+    from comapreduce_tpu.ops.reduce import _fill_bad, _prefilter_chain
+
+    B, C, L = 2, 64, 1024
+    block = B * C * L * 4
+
+    def passes(fn, shapes):
+        args = [jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes]
+        compiled = jax.jit(fn).lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(dict(cost).get("bytes accessed", 0.0)) / block
+
+    fill_acct = masked_fill_logical_passes((B, C, L))
+    assert fill_acct == 3.0      # lane-aligned L: no padding charges
+    for calib in (False, True):
+        cfg = ReduceConfig(C, medfilt_window=101, is_calibrator=calib)
+        rest = passes(functools.partial(_prefilter_chain, cfg=cfg,
+                                        fill_impl="none"),
+                      [(B, C, L), (B, C, L), (L,)])
+        total = rest + fill_acct
+        assert total <= 28.0, (calib, total)    # pinned budget
+        assert total < 34.3, (calib, total)     # measurably below floor
+
+    # structural pins: forced-pallas traces ONE kernel call and no sort;
+    # the CPU-default auto path traces no pallas at all
+    args = (jnp.zeros((B, C, L), jnp.float32),
+            jnp.zeros((B, C, L), jnp.float32))
+    forced = str(jax.make_jaxpr(
+        functools.partial(_fill_bad, impl="pallas"))(*args))
+    assert forced.count("pallas_call") == 1
+    assert " sort" not in forced
+    assert "pallas_call" not in str(jax.make_jaxpr(_fill_bad)(*args))
+
+
 def test_stage_feed_batch_policy():
     """ONE sizing policy for the feed-batched stage programs (ISSUE 4
     satellite): auto = largest HBM-fitting chunk, an explicit request is

@@ -48,6 +48,16 @@ itself). A second campaign run with ``BENCH_TELEMETRY=0`` then gates
 the enabled-vs-disabled steady wall within 3% (+0.25 s floor);
 ``--no-telemetry-overhead`` skips that A/B.
 
+The fused-kernel gate (ISSUE 11) also runs by default: one ``bench.py
+--config kernels`` smoke must show (a) the fused pre-filter's accounted
+pass budget at the canonical (2, 64, 1024) shape at or under 28 passes
+AND below the live-measured XLA floor (~34.3), (b) bit-level masked-fill
+parity between the XLA and kernel paths, and (c) the destriper's CG
+iteration count UNCHANGED under the kernel binning matvec — all
+machine-independent (cost-model accounting and same-process parity
+checks, never wall clocks). Off-TPU the kernel side runs the Pallas
+interpreter; ``--no-kernels`` skips.
+
 The serving warm-start gate (ISSUE 9) also runs by default: one
 ``bench.py --config serving`` smoke (incremental map server folding
 three commit waves) must show the final WARM epoch converging in
@@ -141,6 +151,38 @@ def run_destriper_bench() -> dict:
     raise RuntimeError("no destriper result line in bench.py output")
 
 
+def run_kernels_bench() -> dict:
+    """One small-shape kernels bench child -> its parsed JSON line."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_SMALL": "1",
+        "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
+        "BENCH_EVIDENCE": "0",
+    })
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                          "--config", "kernels"],
+                         env=env, capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py --config kernels failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == "kernels_prefilter_accounted_passes":
+            return rec
+    raise RuntimeError("no kernels result line in bench.py output")
+
+
+#: ISSUE 11 pass budget for the fused pre-filter at the canonical
+#: (2, 64, 1024) shape: measured 25.2 (field) / 26.9 (calib) accounted
+#: passes vs the 34.3-pass XLA floor; the gate allows headroom to 28
+#: before failing. Machine-independent — XLA cost model + the kernel's
+#: logical-pass accounting, never a wall clock.
+FUSED_FILL_PASS_BUDGET = 28.0
+
+
 def run_serving_bench() -> dict:
     """One serving bench child -> its parsed JSON result line."""
     env = dict(os.environ)
@@ -214,6 +256,8 @@ def main(argv=None) -> int:
                     help="skip the destriper memory/iteration gate")
     ap.add_argument("--no-serving", action="store_true",
                     help="skip the serving warm-start gate")
+    ap.add_argument("--no-kernels", action="store_true",
+                    help="skip the fused-kernel pass-budget/parity gate")
     args = ap.parse_args(argv)
 
     best: dict | None = None
@@ -389,9 +433,57 @@ def main(argv=None) -> int:
                 f"{serving['warm_iters']} CG iterations, not below the "
                 f"cold solve's {serving['cold_iters']} on the same "
                 "census (epoch offsets/sky estimate no longer reused?)")
+    kernels = None
+    if not args.no_kernels:
+        # every half machine-independent (ISSUE 11): the pass budget is
+        # XLA's own cost model + logical-pass accounting, the parity
+        # halves are max|diff| and an iteration-count equality of two
+        # solves of one deterministic fixture in the same process
+        k = run_kernels_bench()["detail"]
+        impl = k["kernel_impl"]
+        acct = k["fill"]["accounted"]
+        kernels = {
+            "kernel_impl": impl,
+            "accounted": acct,
+            "fill_parity_maxdiff": k["fill"]["parity_maxdiff"],
+            "cg_iters": k["binning"]["cg_iters"],
+            "offsets_parity_maxdiff":
+                k["binning"]["parity_offsets_maxdiff"],
+            "tpu_rows": k.get("tpu_rows"),
+        }
+        for kind in ("field", "calib"):
+            fused = acct[kind]["fused_passes"]
+            floor = acct[kind]["xla_passes"]
+            budget = FUSED_FILL_PASS_BUDGET + (0.0 if kind == "field"
+                                               else 2.0)
+            if fused > budget or fused >= floor:
+                failures.append(
+                    f"kernels: fused pre-filter accounted passes "
+                    f"({kind}) = {fused} — must stay <= {budget:g} and "
+                    f"below the live XLA floor {floor} (the fused "
+                    "masked-fill stopped paying for itself?)")
+        if k["fill"]["parity_maxdiff"] > 1e-5:
+            failures.append(
+                f"kernels: masked-fill parity drift "
+                f"{k['fill']['parity_maxdiff']:.3g} > 1e-5 between the "
+                f"XLA fill and the {impl} kernel — exact fill/NaN "
+                "semantics broke")
+        it = kernels["cg_iters"]
+        if it.get("xla") != it.get(impl):
+            failures.append(
+                f"kernels: cg_iters changed under kernels={impl}: "
+                f"{it.get(impl)} vs xla's {it.get('xla')} on the same "
+                "fixture — the binning kernel perturbs the solve "
+                "beyond f32 accumulation order")
+        if kernels["offsets_parity_maxdiff"] > 5e-3:
+            failures.append(
+                f"kernels: converged-offset drift "
+                f"{kernels['offsets_parity_maxdiff']:.3g} > 5e-3 "
+                f"between kernels=xla and kernels={impl}")
     print(json.dumps({"ok": not failures, "failures": failures,
                       "current": cur, "campaign": campaign,
                       "destriper": destriper, "serving": serving,
+                      "kernels": kernels,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))
