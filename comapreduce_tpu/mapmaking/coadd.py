@@ -15,7 +15,11 @@ Inputs may also name a serving EPOCH (an ``epoch-NNNNNN`` directory, a
 ``manifest.json`` path, or an epochs root — resolved through the
 ``current`` pointer): the manifest's file census, not a glob, decides
 which map products co-add (:func:`epoch_map_inputs`), so "co-add
-everything in epoch N" cannot race a concurrent publish.
+everything in epoch N" cannot race a concurrent publish. A TILE source
+(a tiles root or a tile manifest, ``tiles/``) also works: the map is
+reassembled from its content-addressed tiles (bit-identical to the
+FITS it was cut from), so a mirror holding only the tile tier can
+co-add without the original epoch dirs.
 """
 
 from __future__ import annotations
@@ -107,14 +111,34 @@ def epoch_map_inputs(path: str, band: int | None = None) -> list[str]:
 
 def _expand_inputs(inputs: list[str]) -> list[str]:
     """Resolve epoch references (dirs / manifest paths) among plain
-    FITS inputs to the manifest-listed map products."""
+    FITS inputs to the manifest-listed map products. Tile sources
+    (a tiles root or a tile manifest — ``tiles.tiler``) pass through
+    whole; the parse stage reassembles them."""
+    from comapreduce_tpu.tiles.tiler import is_tile_source
+
     out: list[str] = []
     for p in inputs:
-        if os.path.isdir(p) or os.path.basename(p) == "manifest.json":
+        if is_tile_source(p):
+            out.append(p)
+        elif os.path.isdir(p) or os.path.basename(p) == "manifest.json":
             out.extend(epoch_map_inputs(p))
         else:
             out.append(p)
     return out
+
+
+def _parse_input(path: str) -> list:
+    """One input -> ``read_fits_image``-shaped HDU tuples. A tile
+    source reassembles through ``tiles.cutout.reconstruct_hdus`` —
+    bit-identical to the FITS it was tiled from, so a tile manifest
+    co-adds interchangeably with rank maps and epoch products."""
+    from comapreduce_tpu.tiles.tiler import is_tile_source
+
+    if is_tile_source(path):
+        from comapreduce_tpu.tiles.cutout import reconstruct_hdus
+
+        return reconstruct_hdus(path)
+    return read_fits_image(path)
 
 
 def coadd_fits_files(inputs: list[str], output: str) -> dict:
@@ -127,7 +151,7 @@ def coadd_fits_files(inputs: list[str], output: str) -> dict:
         raise ValueError("coadd_fits_files: no inputs")
     # one parse per file; layout detected from the parsed headers so a
     # glob mixing HEALPix and WCS maps fails with a clear message
-    parsed = [read_fits_image(p) for p in inputs]
+    parsed = [_parse_input(p) for p in inputs]
     is_hp = [hdus[0][1].get("PIXTYPE") == "HEALPIX" for hdus in parsed]
     if any(is_hp) and not all(is_hp):
         mixed = {p: ("healpix" if h else "wcs")

@@ -52,6 +52,22 @@ This is the executable form of the resilience layer's contract
    than the cold one-shot while agreeing with it modulo the offset
    null mode (a global constant — docs/OPERATIONS.md §12).
 
+9. the map tile read tier (ISSUE 12, ``run_tiles_drill``): served
+   epochs are cut into content-addressed tiles behind an HTTP front.
+   Asserts: a SIGKILL between the tile object writes and the tile
+   manifest rename leaves the tile tier serving the PREVIOUS complete
+   epoch whole (old-or-new, never torn) while the epoch itself stands;
+   the CLI backfill repairs the gap and a fresh-root re-tile yields
+   byte-identical tile hashes (deterministic blob encoding), making
+   the published delta the exact manifest diff; an HTTP cutout is
+   bit-identical to slicing the expanded epoch FITS and revalidates
+   (304) across an atomic ``/v1/current`` rollback; every serving
+   process lands on its own auto-incremented telemetry lane (rank >=
+   1000); and ``MapServer.evict`` publishes a ``downdated`` epoch
+   whose tiles are byte-identical to the pre-eviction epoch's
+   (content addressing across history), with the retracted file never
+   re-admitted by the commit scan.
+
 Everything is deterministic by seed (chaos decisions, jitter, synthetic
 data), so a CI failure reproduces locally bit-for-bit. (Deadline
 checks bound wall time from ABOVE only — cancels must not be late;
@@ -66,9 +82,23 @@ import time
 
 import numpy as np
 
-__all__ = ["run_drill", "run_elastic_drill", "run_serving_drill"]
+__all__ = ["run_drill", "run_elastic_drill", "run_serving_drill",
+           "run_tiles_drill"]
 
 logger = logging.getLogger("comapreduce_tpu")
+
+
+def _child_env(**extra) -> dict:
+    """Environment for drill subprocesses: CPU jax, and the repo root on
+    PYTHONPATH so ``python -m comapreduce_tpu...`` resolves regardless of
+    the caller's cwd (the package need not be installed)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **extra)
+    parts = [root] + [p for p in
+                      env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    return env
 
 
 def _write_level2(path: str, seed: int, F: int = 2, T: int = 600,
@@ -479,7 +509,7 @@ def run_elastic_drill(workdir: str, seed: int = 0, n_files: int = 7,
     # queue (nothing of its shard completed)
     kill_target = os.path.basename(files[1])
     pause_target = os.path.basename(files[2])
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = _child_env()
 
     def spawn(rank: int, **kw):
         cmd = [sys.executable, "-m", "comapreduce_tpu.resilience.drill",
@@ -748,7 +778,7 @@ def run_serving_drill(workdir: str, seed: int = 0, n_files: int = 8,
     for d in dirs.values():
         shutil.rmtree(d, ignore_errors=True)
         os.makedirs(d)
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = _child_env()
 
     def run_server(state_dir, epochs_dir, chaos=""):
         cmd = [sys.executable, "-m",
@@ -883,6 +913,261 @@ def run_serving_drill(workdir: str, seed: int = 0, n_files: int = 8,
     }
 
 
+def run_tiles_drill(workdir: str, seed: int = 0, n_files: int = 4,
+                    timeout_s: float = 300.0) -> dict:
+    """Criterion 9: the map tile read tier end-to-end (ISSUE 12).
+
+    Real server subprocesses reduce committed waves into epochs and
+    cut them into a content-addressed tiles root; a real
+    ``tools/tile_server.py serve`` process fronts it over HTTP.
+    Asserts, in order:
+
+    - wave 1 publishes ``epoch-000001`` and tiles it (the map
+      server's publish hook); the tiles ``CURRENT`` points at it;
+    - wave 2's publisher draws ``kill_mid_publish@tiles-epoch-000002``
+      — SIGKILLed after the epoch publish, after the tile OBJECTS are
+      written, before the tile manifest lands. The epoch stands, the
+      tile tier still serves epoch 1 whole (old-or-new, never torn);
+    - the CLI backfill (``tile_server.py tile``) repairs the gap
+      idempotently, and a full re-tile of epoch 2 into a FRESH root
+      yields byte-identical tile hashes (deterministic encoding), so
+      the published delta is exactly the full-retile diff;
+    - an HTTP cutout of epoch 2 is bit-identical to slicing the
+      expanded epoch FITS; conditional requests 304; a tiles rollback
+      moves ``/v1/current`` atomically while the epoch-addressed URLs
+      keep validating (a pinned reader's cache stays warm);
+    - each serving process landed on its OWN telemetry lane
+      (auto-incremented rank >= 1000 streams in the state dir);
+    - ``MapServer.evict`` retracts a served file: the downdated epoch
+      passes the (relaxed) fence with the SHRUNKEN census, its tiles
+      are byte-identical to epoch 1's (content addressing across
+      history), and the admission scan does NOT re-admit the
+      retracted file.
+    """
+    import json
+    import shutil
+    import subprocess
+    import sys
+    import urllib.error
+    import urllib.request
+
+    from comapreduce_tpu.mapmaking.fits_io import read_fits_image
+    from comapreduce_tpu.serving.epochs import EpochStore
+    from comapreduce_tpu.serving.ledger import ServedLedger
+    from comapreduce_tpu.tiles.blob import decode_tile
+    from comapreduce_tpu.tiles.tiler import TileSet, tile_epoch
+
+    t0 = time.perf_counter()
+    os.makedirs(workdir, exist_ok=True)
+    files = []
+    for i in range(n_files):
+        path = os.path.join(workdir, f"Level2_tiles-{i:04d}.hd5")
+        if not os.path.exists(path):
+            _write_level2(path, seed=2000 + seed * 10 + i,
+                          drift=6.0, rw=0.3, raster=True)
+        files.append(os.path.abspath(path))
+    names = sorted(os.path.basename(f) for f in files)
+    wave1, wave2 = files[:-1], files[-1:]
+
+    dirs = {k: os.path.join(workdir, f"tiles-{k}")
+            for k in ("state", "epochs", "root", "retile")}
+    for d in dirs.values():
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d)
+    env = _child_env()
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "tools")
+
+    def run_server(chaos=""):
+        cmd = [sys.executable, "-m",
+               "comapreduce_tpu.resilience.drill", "--serving",
+               f"--state-dir={dirs['state']}",
+               f"--epochs-dir={dirs['epochs']}",
+               f"--tiles-dir={dirs['root']}", "--tile-px=16",
+               "--telemetry", f"--seed={seed}"]
+        if chaos:
+            cmd.append(f"--chaos={chaos}")
+        pr = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, timeout=timeout_s)
+        return pr.returncode, (pr.stdout or b"").decode(errors="replace")
+
+    # ---- wave 1: publish + tile ----
+    _commit_done(dirs["state"], wave1)
+    rc, out = run_server()
+    assert rc == 0, f"criterion 9: epoch-1 publish failed ({rc}):\n{out}"
+    store = EpochStore(dirs["epochs"])
+    ts = TileSet(dirs["root"])
+    man1 = ts.manifest(1)
+    assert store.current() == 1 and ts.current() == 1 and man1, \
+        f"criterion 9: epoch-1 not tiled (tiles CURRENT={ts.current()})"
+    assert man1["n_tiles"] > 1, \
+        f"criterion 9: {man1['n_tiles']} tile(s) — the 16px grid " \
+        "should cut the 64x64 field into several"
+
+    # ---- wave 2: SIGKILL between the epoch publish and the tile
+    # manifest write (the widest tile-tier window) ----
+    _commit_done(dirs["state"], wave2)
+    rc, out = run_server(chaos="kill_mid_publish@tiles-epoch-000002")
+    assert rc == -9, \
+        f"criterion 9: mid-tile-publish rank exited {rc}, expected " \
+        f"SIGKILL (-9):\n{out}"
+    assert store.current() == 2, \
+        "criterion 9: the EPOCH publish should have completed before " \
+        f"the tile kill (current={store.current()})"
+    ts = TileSet(dirs["root"])
+    assert ts.latest() == 1 and ts.current() == 1 and \
+        ts.manifest(2) is None, \
+        "criterion 9: tile tier torn after mid-tile-publish kill " \
+        f"(latest={ts.latest()} current={ts.current()})"
+
+    # ---- CLI backfill repairs the gap ----
+    pr = subprocess.run(
+        [sys.executable, os.path.join(tools, "tile_server.py"), "tile",
+         f"--epochs-dir={dirs['epochs']}", f"--tiles-dir={dirs['root']}",
+         "--tile-px=16"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=timeout_s)
+    assert pr.returncode == 0, \
+        f"criterion 9: tile backfill failed:\n{pr.stdout.decode()}"
+    man2 = ts.manifest(2)
+    assert man2 is not None and ts.current() == 2, \
+        "criterion 9: backfill did not publish the epoch-2 tile set"
+
+    # ---- delta == full-retile diff; hashes byte-stable across roots
+    retile = tile_epoch(store.epoch_dir(2), dirs["retile"], tile_px=16)
+    assert retile["tiles"] == man2["tiles"], \
+        "criterion 9: re-tiling epoch 2 into a fresh root changed " \
+        "tile hashes — the blob encoding is not deterministic"
+    delta = ts.delta(2)
+    want_changed = {k for k, v in man2["tiles"].items()
+                    if (man1["tiles"].get(k) or [None])[0] != v[0]}
+    want_removed = sorted(k for k in man1["tiles"]
+                          if k not in man2["tiles"])
+    assert set(delta["changed"]) == want_changed and \
+        delta["removed"] == want_removed, \
+        f"criterion 9: delta ({delta['n_changed']} changed, " \
+        f"{delta['n_removed']} removed) is not the exact manifest diff"
+    n_unchanged = sum(1 for k, v in man2["tiles"].items()
+                      if (man1["tiles"].get(k) or [None])[0] == v[0])
+    assert delta["n_unchanged"] == n_unchanged, \
+        "criterion 9: delta n_unchanged miscounts byte-stable tiles"
+
+    # ---- HTTP: cutout bit-identity, 304s, rollback ----
+    srv = subprocess.Popen(
+        [sys.executable, os.path.join(tools, "tile_server.py"), "serve",
+         f"--tiles-dir={dirs['root']}", "--port=0",
+         f"--epochs-dir={dirs['epochs']}",
+         f"--telemetry-dir={dirs['state']}",
+         f"--max-wall-s={timeout_s}"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        line = srv.stdout.readline().decode()
+        assert "listening on http://" in line, \
+            f"criterion 9: tile server did not start: {line}"
+        base = line.split("listening on ")[1].split("/ ")[0]
+
+        def fetch(url, etag=None):
+            rq = urllib.request.Request(base + url)
+            if etag:
+                rq.add_header("If-None-Match", etag)
+            try:
+                with urllib.request.urlopen(rq, timeout=10) as r:
+                    return r.status, dict(r.headers), r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers), b""
+
+        st, _, body = fetch("/v1/current")
+        assert st == 200 and json.loads(body)["epoch"] == 2, \
+            f"criterion 9: /v1/current wrong: {st} {body!r}"
+        x0, y0, w, h = 5, 9, 37, 21   # crosses 16px tile boundaries
+        st, hdrs, blob = fetch(
+            f"/v1/epochs/2/cutout?x0={x0}&y0={y0}&w={w}&h={h}")
+        assert st == 200 and "immutable" in hdrs.get("Cache-Control", ""),\
+            f"criterion 9: cutout fetch failed ({st})"
+        cut = decode_tile(blob)["products"]
+        full = {nm: np.asarray(arr, np.float32) for nm, _, arr in
+                read_fits_image(os.path.join(store.epoch_dir(2),
+                                             "map_band0.fits"))}
+        for nm, ref in full.items():
+            got = cut[nm]
+            assert np.array_equal(got, ref[y0:y0 + h, x0:x0 + w]), \
+                f"criterion 9: HTTP cutout {nm} != expanded FITS slice"
+        etag = hdrs["ETag"]
+        st, _, _ = fetch(
+            f"/v1/epochs/2/cutout?x0={x0}&y0={y0}&w={w}&h={h}", etag)
+        assert st == 304, f"criterion 9: cutout revalidation got {st}"
+        st, mh, _ = fetch("/v1/epochs/2/manifest.json")
+        man_etag = mh["ETag"]
+        # rollback: /v1/current swaps atomically; epoch-addressed URLs
+        # keep validating (the pinned reader's cache stays intact)
+        ts.set_current(1, force=True)
+        st, _, body = fetch("/v1/current")
+        assert st == 200 and json.loads(body)["epoch"] == 1, \
+            "criterion 9: /v1/current did not follow the rollback"
+        st, _, _ = fetch("/v1/epochs/2/manifest.json", man_etag)
+        assert st == 304, \
+            "criterion 9: epoch-2 manifest ETag broke across rollback"
+        ts.set_current(2)
+        st, _, body = fetch("/v1/status")
+        assert st == 200 and json.loads(body)["current"] == 2
+    finally:
+        srv.kill()
+        srv.wait(timeout=30)
+
+    # ---- telemetry: every serving process on its own lane ----
+    lanes = sorted(int(f.split("rank")[1].split(".")[0])
+                   for f in os.listdir(dirs["state"])
+                   if f.startswith("events.rank")
+                   and int(f.split("rank")[1].split(".")[0]) >= 1000)
+    assert len(lanes) >= 3 and len(set(lanes)) == len(lanes), \
+        f"criterion 9: serving-lane ranks collided: {lanes} (two map " \
+        "server runs + the tile server must each get a fresh stream)"
+
+    # ---- evict: downdated epoch past the fence, byte-stable tiles --
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.serving.server import MapServer
+
+    wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
+    server = MapServer(
+        dirs["state"], dirs["epochs"], wcs=wcs, band=0,
+        offset_length=50, n_iter=300, threshold=1e-8,
+        medfilt_window=201, use_calibration=False, warm_start=False,
+        tiles_root=dirs["root"], tile_px=16)
+    evicted = os.path.basename(wave2[0])
+    n3 = server.evict(evicted)
+    assert n3 == 3, f"criterion 9: evict published {n3}, expected 3"
+    man_e = store.manifest(3)
+    assert man_e.get("downdated") is True and \
+        man_e.get("evicted") == [evicted] and \
+        store.census(3) == {os.path.basename(f) for f in wave1}, \
+        "criterion 9: downdated epoch census/flags wrong"
+    man3 = TileSet(dirs["root"]).manifest(3)
+    assert man3 is not None and man3["tiles"] == man1["tiles"], \
+        "criterion 9: evicting back to epoch-1's census did not " \
+        "reproduce epoch-1's tile hashes (content addressing broke)"
+    # the watcher still lists the evicted commit; admission must skip
+    assert server.admit_new() == [] and evicted not in server.ledger, \
+        "criterion 9: the admission scan re-admitted an evicted file"
+    led = ServedLedger(os.path.join(dirs["epochs"], "served.jsonl"))
+    assert evicted in led.retracted and evicted not in led, \
+        "criterion 9: retraction did not survive a ledger reload"
+
+    return {
+        "tiles_epochs": ts.list_tiled(),
+        "tiles_n_tiles": [man1["n_tiles"], man2["n_tiles"],
+                          man3["n_tiles"]],
+        "tiles_kill_rc": -9,
+        "tiles_delta_changed": int(delta["n_changed"]),
+        "tiles_delta_unchanged": int(delta["n_unchanged"]),
+        "tiles_retile_byte_identical": True,
+        "tiles_cutout_bit_identical": True,
+        "tiles_serving_lanes": lanes,
+        "tiles_evict_epoch": int(n3),
+        "tiles_census": names,
+        "tiles_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 def _serving_worker_main(argv=None) -> int:
     """One serving-drill server invocation (``python -m ... --serving``):
     build a ``MapServer`` over the shared state dir and attempt exactly
@@ -901,14 +1186,27 @@ def _serving_worker_main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--chaos", default="")
     p.add_argument("--no-warm-start", action="store_true")
+    p.add_argument("--tiles-dir", default="",
+                   help="also cut each published epoch into this tiles "
+                   "root (the tiles drill)")
+    p.add_argument("--tile-px", type=int, default=16)
+    p.add_argument("--telemetry", action="store_true",
+                   help="configure the serving telemetry lane (auto "
+                   "rank >= 1000) in the state dir")
     a = p.parse_args(argv)
+    if a.telemetry:
+        from comapreduce_tpu.telemetry import (TELEMETRY,
+                                               serving_lane_rank)
+
+        TELEMETRY.configure(a.state_dir,
+                            rank=serving_lane_rank(a.state_dir))
     wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
     monkey = ChaosMonkey(a.chaos, seed=a.seed) if a.chaos else None
     server = MapServer(
         a.state_dir, a.epochs_dir, wcs=wcs, band=0, offset_length=50,
         n_iter=300, threshold=1e-8, medfilt_window=201,
         use_calibration=False, warm_start=not a.no_warm_start,
-        chaos=monkey)
+        tiles_root=a.tiles_dir, tile_px=a.tile_px, chaos=monkey)
     n = server.poll_once(force=True)
     print(f"serving-worker: published {n}")
     return 0

@@ -91,7 +91,18 @@ class EpochStore:
 
     def __init__(self, root: str):
         self.root = str(root)
+        self._publish_hooks: list = []
         os.makedirs(self.root, exist_ok=True)
+
+    def add_publish_hook(self, fn) -> None:
+        """Register ``fn(n, epoch_dir, manifest)`` to run after each
+        successful publish, once the epoch is complete and ``current``
+        points at it — where derived read-side artefacts (the tile
+        tier) hang off the store. A hook failure is logged, never
+        propagated: the epoch IS published; derivation can re-run
+        idempotently (``tiles.tiler.tile_epoch``) on the next publish
+        or by hand."""
+        self._publish_hooks.append(fn)
 
     # -- paths ------------------------------------------------------------
 
@@ -156,7 +167,7 @@ class EpochStore:
     # -- publication ------------------------------------------------------
 
     def publish(self, census, write_products, meta: dict | None = None,
-                chaos=None) -> int:
+                chaos=None, downdated: bool = False) -> int:
         """Publish one epoch; returns its number.
 
         ``census``: the file basenames this solve covers (manifest
@@ -179,6 +190,13 @@ class EpochStore:
         ``chaos`` (a ``resilience.ChaosMonkey``) injects the
         ``kill_mid_publish`` drill fault: SIGKILL between writing the
         temp dir and the rename.
+
+        ``downdated`` relaxes the strictly-growing census fence for
+        DELIBERATE shrinkage (:meth:`~comapreduce_tpu.serving.server.
+        MapServer.evict`): the census must still DIFFER from the
+        fenced one (a zombie republishing the identical census is
+        still rejected), and the manifest carries ``downdated: true``
+        so consumers can tell an eviction from growth.
         """
         census = sorted(str(c) for c in census)
         latest = self.latest()
@@ -190,7 +208,13 @@ class EpochStore:
                 # fence BEFORE the manifest write so the manifest bakes
                 # the final epoch number
                 fenced = self.census(latest)
-                if not set(census) > fenced:
+                if downdated:
+                    if set(census) == fenced:
+                        raise EpochFenceError(
+                            f"downdated publish: census of "
+                            f"{len(census)} file(s) is identical to "
+                            f"epoch {latest}'s — nothing to evict")
+                elif not set(census) > fenced:
                     raise EpochFenceError(
                         f"stale publish: census of {len(census)} "
                         f"file(s) does not strictly grow epoch "
@@ -199,6 +223,8 @@ class EpochStore:
                 man = {"schema": 1, "epoch": n, "census": census,
                        "n_files": len(census),
                        "t_publish_unix": float(time.time())}
+                if downdated:
+                    man["downdated"] = True
                 man.update(extras)
                 if meta:
                     man.update(meta)
@@ -232,6 +258,14 @@ class EpochStore:
         self.set_current(n)
         logger.info("published %s (%d files) in %s", epoch_name(n),
                     len(census), self.root)
+        man = self.manifest(n) or {}
+        for hook in self._publish_hooks:
+            try:
+                hook(n, self.epoch_dir(n), man)
+            except Exception:
+                logger.exception("publish hook %r failed on %s (epoch "
+                                 "stands; derivation can re-run)",
+                                 hook, epoch_name(n))
         return n
 
     def set_current(self, n: int, force: bool = False) -> None:
@@ -286,18 +320,30 @@ class EpochStore:
                     "current swap)", epoch_name(latest))
         return latest
 
-    def cleanup_tmp(self) -> int:
+    def cleanup_tmp(self, min_age_s: float = 0.0) -> int:
         """Remove dead ``.tmp-epoch.*`` dirs (publisher killed before
-        its rename); returns how many were removed."""
+        its rename); returns how many were removed. ``min_age_s``
+        spares temps younger than that — the serve loop's periodic
+        sweep uses it so a cleanup can never race a publish in flight
+        (one server per root is the contract, the age guard is the
+        belt under the suspenders)."""
         n = 0
         try:
             names = os.listdir(self.root)
         except OSError:
             return 0
         for name in names:
-            if name.startswith(".tmp-epoch."):
-                self._rmtree(os.path.join(self.root, name))
-                n += 1
+            if not name.startswith(".tmp-epoch."):
+                continue
+            p = os.path.join(self.root, name)
+            if min_age_s > 0:
+                try:
+                    if time.time() - os.path.getmtime(p) < min_age_s:
+                        continue
+                except OSError:
+                    continue
+            self._rmtree(p)
+            n += 1
         return n
 
     @staticmethod

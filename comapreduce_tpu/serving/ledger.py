@@ -43,6 +43,7 @@ class ServedLedger:
     def __init__(self, path: str):
         self.path = str(path)
         self._seen: dict[str, dict] = {}
+        self._retracted: set = set()
         self._load()
 
     def _load(self) -> None:
@@ -50,6 +51,10 @@ class ServedLedger:
             return
         with open(self.path, "rb") as f:
             raw = f.read()
+        # sequential replay: admissions add, retractions remove, a
+        # later re-admission wins again — the census is the ledger's
+        # final state, so evictions survive restarts exactly like
+        # admissions do
         for line in raw.split(b"\n"):
             if not line.strip():
                 continue
@@ -62,8 +67,14 @@ class ServedLedger:
                                "line", self.path)
                 continue
             name = entry.get("file")
-            if name and name not in self._seen:
+            if not name:
+                continue
+            if entry.get("retract"):
+                self._seen.pop(name, None)
+                self._retracted.add(name)
+            elif name not in self._seen:
                 self._seen[name] = entry
+                self._retracted.discard(name)
 
     # -- queries ----------------------------------------------------------
 
@@ -71,6 +82,13 @@ class ServedLedger:
     def files(self) -> set:
         """Basenames admitted so far (the census)."""
         return set(self._seen)
+
+    @property
+    def retracted(self) -> set:
+        """Basenames evicted from the census. The commit watcher still
+        lists them, so the admission scan must skip this set — only an
+        EXPLICIT :meth:`admit` brings a retracted file back."""
+        return set(self._retracted)
 
     def path_of(self, name: str) -> str:
         return str(self._seen[name].get("path", ""))
@@ -100,6 +118,24 @@ class ServedLedger:
         entry = {"schema": 1, "file": str(name), "path": str(path),
                  "t_commit_unix": float(t_commit_unix or 0.0),
                  "t_admit_unix": float(now())}
+        self._append(entry)
+        self._seen[name] = entry
+        self._retracted.discard(name)
+        return True
+
+    def retract(self, name: str, now=time.time) -> bool:
+        """Evict one file from the census (durable before True). The
+        name joins :attr:`retracted`, so the admission scan will not
+        fold it back in; a later explicit :meth:`admit` re-admits."""
+        if name not in self._seen:
+            return False
+        self._append({"schema": 1, "file": str(name), "retract": True,
+                      "t_retract_unix": float(now())})
+        self._seen.pop(name, None)
+        self._retracted.add(name)
+        return True
+
+    def _append(self, entry: dict) -> None:
         payload = (json.dumps(entry, sort_keys=True) + "\n").encode()
         directory = os.path.dirname(os.path.abspath(self.path)) or "."
         os.makedirs(directory, exist_ok=True)
@@ -116,8 +152,6 @@ class ServedLedger:
             os.fsync(fd)
         finally:
             os.close(fd)
-        self._seen[name] = entry
-        return True
 
     @staticmethod
     def _tail_is_torn(path: str) -> bool:

@@ -123,6 +123,8 @@ class MapServer:
                  use_calibration: bool = True, tod_variant: str = "auto",
                  warm_start: bool = True, checkpoint_every: int = 0,
                  min_new_files: int = 1, poll_s: float = 2.0,
+                 tiles_root: str = "", tile_px: int = 64,
+                 tile_nside: int = 0, cleanup_every_s: float = 300.0,
                  chaos=None, now=time.time):
         if (wcs is None) == (nside is None):
             raise ValueError("pass exactly one of wcs= or nside=")
@@ -146,6 +148,9 @@ class MapServer:
         self.checkpoint_every = int(checkpoint_every)
         self.min_new_files = max(int(min_new_files), 1)
         self.poll_s = float(poll_s)
+        self.tiles_root = str(tiles_root or "")
+        self.tile_px, self.tile_nside = int(tile_px), int(tile_nside)
+        self.cleanup_every_s = float(cleanup_every_s)
         self.chaos = chaos
         self.now = now
         self._agg: dict[str, _FileAggregate] = {}
@@ -156,6 +161,13 @@ class MapServer:
         # becomes current — readers and the fence baseline agree again
         self.store.cleanup_tmp()
         self.store.adopt_latest()
+        if self.tiles_root:
+            # the tile tier hangs off the publish hook: every epoch
+            # that lands is cut into content-addressed tiles for the
+            # HTTP read path (tiles.tiler); tiling an orphan the ctor
+            # just adopted is covered by the resume flush's publish or
+            # by an explicit tile_epoch run
+            self.store.add_publish_hook(self._tile_hook)
 
     # -- watch / admit ----------------------------------------------------
 
@@ -185,10 +197,13 @@ class MapServer:
 
     def admit_new(self) -> list[str]:
         """Scan the commit layout and admit unseen files (exactly once,
-        durable) to the census; returns the newly-admitted names."""
+        durable) to the census; returns the newly-admitted names.
+        Retracted (evicted) files stay out — only an explicit
+        ``ledger.admit`` brings one back."""
         new = []
+        retracted = self.ledger.retracted
         for name, st in sorted(scan_committed(self.state_dir).items()):
-            if name in self.ledger:
+            if name in self.ledger or name in retracted:
                 continue
             path = self._resolve_path(st)
             if path is None:
@@ -407,6 +422,14 @@ class MapServer:
         new_files = sorted(set(census) - prev_census)
         if not new_files:
             return None
+        return self._publish_census(census, new_files)
+
+    def _publish_census(self, census: list[str], new_files: list[str],
+                        *, downdated: bool = False,
+                        evicted=()) -> int | None:
+        """Assemble + solve ``census`` and publish it as one epoch
+        (the shared tail of :meth:`build_epoch` and :meth:`evict`).
+        None when the publish was fence-rejected."""
         t0 = time.perf_counter()
         data, slices = self._assemble(census)
         x0, x0_src = self._x0_for(census, slices)
@@ -432,19 +455,24 @@ class MapServer:
                          files=np.array(census),
                          n_offsets=np.asarray(
                              [slices[c][1] for c in census], np.int64))
-            return {"band": self.band, "maps": [map_name],
-                    "solver": off_name,
-                    "files": {c: self.ledger.path_of(c) for c in census},
-                    "n_new": len(new_files), "new_files": new_files,
-                    "cg": {"n_iter": n_iter, "residual": residual,
-                           "x0": x0_src,
-                           "diverged": int(np.any(np.asarray(
-                               result.diverged)))},
-                    "t_solve_s": t_solve, "freshness_s": freshness}
+            extras = {"band": self.band, "maps": [map_name],
+                      "solver": off_name,
+                      "files": {c: self.ledger.path_of(c)
+                                for c in census},
+                      "n_new": len(new_files), "new_files": new_files,
+                      "cg": {"n_iter": n_iter, "residual": residual,
+                             "x0": x0_src,
+                             "diverged": int(np.any(np.asarray(
+                                 result.diverged)))},
+                      "t_solve_s": t_solve, "freshness_s": freshness}
+            if evicted:
+                extras["evicted"] = sorted(evicted)
+            return extras
 
         try:
             n = self.store.publish(census, write_products,
-                                   chaos=self.chaos)
+                                   chaos=self.chaos,
+                                   downdated=downdated)
         except EpochFenceError as exc:
             # the lease-fence rule, one layer up: a newer epoch already
             # covers this census — this server was stale; drop the
@@ -458,18 +486,87 @@ class MapServer:
         # the solve interval as a span, with the epoch vitals (fold
         # size, warm-start iteration count, freshness) as attributes —
         # the serving lane of campaign_report's merged timeline
+        span_attrs = {}
+        if downdated:
+            span_attrs["downdated"] = True
         TELEMETRY.event_span(
             "serving.epoch", t_solve, unit=f"band{self.band}", epoch=n,
             n_files=len(census), n_new=len(new_files), cg_iters=n_iter,
-            residual=residual, x0=x0_src, freshness_s=round(freshness, 3))
-        self.stats["epochs"].append({
+            residual=residual, x0=x0_src,
+            freshness_s=round(freshness, 3), **span_attrs)
+        entry = {
             "epoch": n, "n_files": len(census), "n_new": len(new_files),
             "n_iter": n_iter, "residual": residual, "x0": x0_src,
             "t_solve_s": round(t_solve, 3),
             "freshness_s": round(freshness, 3),
-            "t_publish_unix": now})
+            "t_publish_unix": now}
+        if downdated:
+            entry["downdated"] = True
+            entry["evicted"] = sorted(evicted)
+        self.stats["epochs"].append(entry)
         self._write_stats()
         return n
+
+    def evict(self, name: str) -> int | None:
+        """Take one served file OUT of the read path: retract it from
+        the admission ledger (durable — the watcher scan will not fold
+        it back), drop its cached aggregate, re-solve the shrunken
+        census and publish a ``downdated`` epoch past the strictly-
+        growing fence. The data-quality escape hatch: a file found bad
+        AFTER it was folded stops contaminating new epochs without
+        rewriting history (old epochs are immutable; roll back to one
+        only if you must).
+
+        Returns the downdated epoch's number; None when no published
+        epoch covered the file (retraction alone suffices) or the
+        census would become empty (nothing publishable — the old
+        epoch keeps serving until new data arrives).
+        """
+        if name not in self.ledger:
+            raise ValueError(f"{name} is not in the served census")
+        covered = name in self.store.census(self.store.latest())
+        self.ledger.retract(name, now=self.now)
+        self._agg.pop(name, None)
+        logger.info("evicted %s from the served census", name)
+        TELEMETRY.counter("serving.evictions")
+        census = sorted(self.ledger.files)
+        if not covered:
+            return None
+        if not census:
+            logger.warning(
+                "evicted the last served file %s; the published epochs "
+                "still include it — an empty census is not publishable, "
+                "so the read path is stale until new data arrives", name)
+            return None
+        return self._publish_census(census, [], downdated=True,
+                                    evicted=[name])
+
+    # -- tiles ------------------------------------------------------------
+
+    def _tile_hook(self, n: int, epoch_dir: str, man: dict) -> None:
+        """Publish hook: cut the fresh epoch into the tile tier."""
+        from comapreduce_tpu.tiles.tiler import TileSet, tile_epoch
+
+        t0 = time.perf_counter()
+        tman = tile_epoch(epoch_dir, self.tiles_root,
+                          tile_px=self.tile_px,
+                          tile_nside=self.tile_nside, chaos=self.chaos)
+        dt = time.perf_counter() - t0
+        delta = TileSet(self.tiles_root).delta(n) or {}
+        TELEMETRY.event_span(
+            "serving.tiles.publish", dt, unit=f"band{self.band}",
+            epoch=n, n_tiles=tman["n_tiles"],
+            bytes=tman["total_bytes"],
+            n_changed=delta.get("n_changed"),
+            n_removed=delta.get("n_removed"))
+        self.stats.setdefault("tiles", []).append({
+            "epoch": n, "n_tiles": tman["n_tiles"],
+            "n_empty": tman["n_empty"],
+            "total_bytes": tman["total_bytes"],
+            "n_changed": delta.get("n_changed"),
+            "n_removed": delta.get("n_removed"),
+            "changed_bytes": delta.get("changed_bytes"),
+            "t_tile_s": round(dt, 3)})
 
     # -- poll / serve loop ------------------------------------------------
 
@@ -500,6 +597,7 @@ class MapServer:
         published = 0
         t_start = time.monotonic()
         t_active = t_start
+        t_cleanup = t_start
         # resume flush: anything admitted before a crash publishes now
         n = self.poll_once(force=True)
         if n is not None:
@@ -521,6 +619,23 @@ class MapServer:
                     published += 1
                     t_active = time.monotonic()
                     continue
+            if self.cleanup_every_s > 0 and \
+                    time.monotonic() - t_cleanup >= self.cleanup_every_s:
+                # periodic hygiene between polls: dead publish temps
+                # (e.g. another server's crash before our restart) and
+                # dead tile-object temps. Age-guarded so an in-flight
+                # write can never be swept; no publish is in flight
+                # HERE (single-threaded loop), the guard is defensive
+                t_cleanup = time.monotonic()
+                age = max(60.0, 4 * self.poll_s)
+                removed = self.store.cleanup_tmp(min_age_s=age)
+                if self.tiles_root:
+                    from comapreduce_tpu.tiles.store import TileStore
+
+                    removed += TileStore(self.tiles_root).cleanup_tmp()
+                if removed:
+                    logger.info("serve-loop cleanup removed %d dead "
+                                "temp(s)", removed)
             sleep(min(self.poll_s, 0.2))
         return published
 

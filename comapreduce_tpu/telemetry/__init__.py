@@ -8,11 +8,14 @@ Import surface is deliberately light (stdlib only at import time):
 ``TELEMETRY`` is safe to touch from any hot path.
 """
 
-from comapreduce_tpu.telemetry.core import (TELEMETRY, StageTimings,
-                                            Telemetry, TelemetryConfig)
+from comapreduce_tpu.telemetry.core import (SERVING_LANE_BASE, TELEMETRY,
+                                            StageTimings, Telemetry,
+                                            TelemetryConfig,
+                                            serving_lane_rank)
 from comapreduce_tpu.telemetry.reader import (MergedStream,
                                               merge_streams,
                                               read_events)
 
 __all__ = ["TELEMETRY", "Telemetry", "TelemetryConfig", "StageTimings",
-           "MergedStream", "merge_streams", "read_events"]
+           "MergedStream", "merge_streams", "read_events",
+           "serving_lane_rank", "SERVING_LANE_BASE"]

@@ -45,15 +45,45 @@ import itertools
 import json
 import logging
 import os
+import re
 import socket
 import threading
 import time
 
-__all__ = ["TELEMETRY", "Telemetry", "TelemetryConfig", "StageTimings"]
+__all__ = ["TELEMETRY", "Telemetry", "TelemetryConfig", "StageTimings",
+           "serving_lane_rank", "SERVING_LANE_BASE"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
 _SCHEMA = 1
+
+#: first telemetry rank of the SERVING lane: reducer ranks are the
+#: campaign's real ranks (0..N-1), long-lived serving processes (map
+#: server, tile server) write at >= this so the streams never collide
+SERVING_LANE_BASE = 1000
+
+_RANK_STREAM_RE = re.compile(r"^events\.rank(\d+)\.jsonl$")
+
+
+def serving_lane_rank(log_dir: str,
+                      base: int = SERVING_LANE_BASE) -> int:
+    """The next free serving-lane rank in ``log_dir``: one past the
+    highest existing ``events.rank{r}.jsonl`` with ``r >= base``
+    (``base`` itself when the lane is empty). Span/event ids are
+    per-process, so two servers appending to one stream would
+    interleave unrelated spans — every serving process (and every
+    restart of one) takes a fresh stream instead; the reader merges
+    them by the meta anchor like any other rank."""
+    best = int(base) - 1
+    try:
+        names = os.listdir(log_dir or ".")
+    except OSError:
+        names = []
+    for name in names:
+        m = _RANK_STREAM_RE.match(name)
+        if m and int(m.group(1)) >= int(base):
+            best = max(best, int(m.group(1)))
+    return best + 1
 
 
 def _json_safe(obj):

@@ -1,0 +1,49 @@
+"""Map tile tier: content-addressed tiles over published epochs.
+
+The serving layer's versioned epochs (:mod:`comapreduce_tpu.serving`)
+are batch artifacts — reading one means mounting the epochs root and
+loading a whole FITS file. This package turns each published epoch into
+a CDN-shaped read surface:
+
+- :mod:`~comapreduce_tpu.tiles.layout` — the tile grid. HEALPix maps
+  tile by NESTED parent pixel (tile ids fall straight out of the
+  compacted ``PixelSpace``: a sparse seen-pixel dictionary IS a sparse
+  tile set); WCS maps tile on a fixed pixel grid.
+- :mod:`~comapreduce_tpu.tiles.blob` — the canonical tile byte format.
+  Deterministic by construction, so identical tile CONTENT always
+  hashes to identical bytes and unchanged tiles are cache hits across
+  epochs for free.
+- :mod:`~comapreduce_tpu.tiles.store` — the content-addressed object
+  store (``objects/<hh>/<hash>``): writes are idempotent, objects are
+  immutable, a re-tile after a crash re-derives the same names.
+- :mod:`~comapreduce_tpu.tiles.tiler` — walks an epoch dir, emits the
+  tile set plus a per-epoch manifest and a DELTA manifest against the
+  previous tiled epoch (clients refresh only changed tiles). Empty
+  tiles are never materialised.
+- :mod:`~comapreduce_tpu.tiles.cutout` — reassembles rectangular sky
+  cutouts (and whole map products, for ``coadd``) from tiles,
+  bit-identical to slicing the expanded FITS.
+- :mod:`~comapreduce_tpu.tiles.http` — the stdlib ``http.server``
+  read tier: tiles, manifests, epoch metadata and cutouts with
+  immutable-epoch ``Cache-Control``/``ETag`` headers so edge caches
+  absorb the traffic, following the epochs root's ``current`` pointer
+  atomically for freshness.
+
+Operate it with ``tools/tile_server.py`` (serve/status); docs at
+OPERATIONS.md §14.
+"""
+
+from comapreduce_tpu.tiles.blob import decode_tile, encode_tile
+from comapreduce_tpu.tiles.cutout import assemble_cutout, reconstruct_hdus
+from comapreduce_tpu.tiles.layout import (healpix_tile_ids,
+                                          healpix_tile_nside_auto,
+                                          wcs_tile_grid)
+from comapreduce_tpu.tiles.store import TileStore
+from comapreduce_tpu.tiles.tiler import (TileSet, is_tile_source,
+                                         tile_budget_bytes, tile_epoch)
+
+__all__ = ["encode_tile", "decode_tile", "assemble_cutout",
+           "reconstruct_hdus", "healpix_tile_ids",
+           "healpix_tile_nside_auto", "wcs_tile_grid", "TileStore",
+           "TileSet", "is_tile_source", "tile_budget_bytes",
+           "tile_epoch"]

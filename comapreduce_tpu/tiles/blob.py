@@ -1,0 +1,137 @@
+"""The canonical tile byte format.
+
+One blob holds every map product of one (band, tile) — one fetch gets
+everything a renderer needs. The encoding is DETERMINISTIC by
+construction (sorted-key compact JSON header, little-endian contiguous
+arrays, no timestamps), which is what makes the tier content-addressed:
+identical tile content always serialises to identical bytes, so an
+unchanged tile keeps its hash across epochs and every cache between the
+store and the reader keeps hitting.
+
+Layout::
+
+    b"CMTL1\\n"                      magic + format version
+    u32le header_len
+    header JSON (ascii, sort_keys, compact separators)
+    payload arrays, in header-declared order, little-endian, contiguous
+
+Header fields: ``kind`` (``wcs``/``healpix``), ``tile`` id,
+``products`` (array names, payload order), plus per-kind geometry —
+WCS: ``x0``/``y0``/``w``/``h`` (the clipped pixel box; each product is
+f32[h, w]); HEALPix: ``nside``/``tile_nside``/``n`` (a leading i32[n]
+array of NESTED offsets *within the tile*, sorted ascending, then each
+product as f32[n] — tiles are sparse like the partial maps they come
+from).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+__all__ = ["encode_tile", "decode_tile", "MAGIC"]
+
+MAGIC = b"CMTL1\n"
+
+
+def _canon_json(obj: dict) -> bytes:
+    return json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode("ascii")
+
+
+def _le(arr: np.ndarray, dtype: str) -> bytes:
+    return np.ascontiguousarray(np.asarray(arr).astype(dtype,
+                                                      copy=False)).tobytes()
+
+
+def encode_tile(kind: str, tile: int, products: dict,
+                **geometry) -> bytes:
+    """Serialise one tile. ``products`` maps name -> array (f32 values;
+    2-D ``(h, w)`` for WCS, 1-D ``(n,)`` for HEALPix); ``geometry`` is
+    the per-kind header extras (see module docstring) — for HEALPix it
+    must include ``local=`` the i32 within-tile NESTED offsets."""
+    names = sorted(products)
+    hdr = {"schema": 1, "kind": str(kind), "tile": int(tile),
+           "products": names}
+    local = geometry.pop("local", None)
+    for k, v in geometry.items():
+        hdr[k] = int(v)
+    payload = b""
+    if kind == "healpix":
+        if local is None:
+            raise ValueError("healpix tiles need local= offsets")
+        local = np.asarray(local, np.int64)
+        if local.ndim != 1 or (np.diff(local) <= 0).any():
+            raise ValueError("tile offsets must be 1-D sorted strictly "
+                             "increasing")
+        hdr["n"] = int(local.size)
+        payload += _le(local, "<i4")
+        for nm in names:
+            v = np.asarray(products[nm])
+            if v.shape != local.shape:
+                raise ValueError(f"product {nm} shape {v.shape} != "
+                                 f"offsets {local.shape}")
+            payload += _le(v, "<f4")
+    elif kind == "wcs":
+        h, w = int(hdr["h"]), int(hdr["w"])
+        for nm in names:
+            v = np.asarray(products[nm])
+            if v.shape != (h, w):
+                raise ValueError(f"product {nm} shape {v.shape} != "
+                                 f"tile box ({h}, {w})")
+            payload += _le(v, "<f4")
+    else:
+        raise ValueError(f"unknown tile kind {kind!r}")
+    raw = _canon_json(hdr)
+    return MAGIC + struct.pack("<I", len(raw)) + raw + payload
+
+
+def decode_tile(blob: bytes) -> dict:
+    """Parse a tile blob back to ``{"header": dict, "products":
+    {name: f32 array}, "local": i64 offsets | None}``. Raises
+    ``ValueError`` on a foreign or truncated blob — a torn object can
+    never be mistaken for a short tile."""
+    if not blob.startswith(MAGIC):
+        raise ValueError("not a tile blob (bad magic)")
+    off = len(MAGIC)
+    if len(blob) < off + 4:
+        raise ValueError("truncated tile blob (no header length)")
+    (hlen,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    try:
+        hdr = json.loads(blob[off:off + hlen].decode("ascii"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ValueError(f"torn tile header: {exc}") from exc
+    off += hlen
+    names = list(hdr.get("products", []))
+    kind = hdr.get("kind")
+    local = None
+    if kind == "healpix":
+        n = int(hdr["n"])
+        need = 4 * n * (1 + len(names))
+        if len(blob) - off != need:
+            raise ValueError(f"tile payload is {len(blob) - off} bytes, "
+                             f"expected {need}")
+        local = np.frombuffer(blob, "<i4", n, off).astype(np.int64)
+        off += 4 * n
+        products = {}
+        for nm in names:
+            products[nm] = np.frombuffer(blob, "<f4", n,
+                                         off).astype(np.float32)
+            off += 4 * n
+    elif kind == "wcs":
+        h, w = int(hdr["h"]), int(hdr["w"])
+        need = 4 * h * w * len(names)
+        if len(blob) - off != need:
+            raise ValueError(f"tile payload is {len(blob) - off} bytes, "
+                             f"expected {need}")
+        products = {}
+        for nm in names:
+            products[nm] = np.frombuffer(
+                blob, "<f4", h * w, off).astype(np.float32).reshape(h, w)
+            off += 4 * h * w
+    else:
+        raise ValueError(f"unknown tile kind {kind!r}")
+    return {"header": hdr, "products": products, "local": local}

@@ -1,0 +1,193 @@
+"""Reassemble sky from tiles: cutouts and whole map products.
+
+The inverse of the tiler, with a bit-identity contract both ways:
+
+- :func:`assemble_cutout` builds a rectangular WCS cutout
+  ``f32[h, w]`` from exactly the tiles the box touches; missing
+  (empty) tiles zero-fill, so the result is bit-identical to slicing
+  the expanded full-field FITS — the acceptance drill's check.
+- :func:`assemble_healpix` gathers a set of HEALPix tiles back into
+  ``(ring_pixels, {product: values})`` — partial-sky, sorted by RING
+  id, exactly the slice of the source partial map covered by those
+  tiles.
+- :func:`reconstruct_hdus` rebuilds a whole map product in the
+  ``fits_io.read_fits_image`` HDU-tuple shape, which is what lets
+  ``mapmaking.coadd`` accept a tile manifest as a map source without
+  ever touching the original epoch dir.
+
+Cutout/blob serialisation for the HTTP layer is deterministic
+(:func:`cutout_blob` reuses the tile encoding with kind ``wcs``), so
+cutout ``ETag``\\ s are content hashes like everything else in the tier.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from comapreduce_tpu.tiles import layout
+from comapreduce_tpu.tiles.blob import encode_tile
+from comapreduce_tpu.tiles.tiler import TileSet, is_tile_source
+
+__all__ = ["assemble_cutout", "assemble_healpix", "cutout_blob",
+           "reconstruct_hdus", "resolve_tile_manifest"]
+
+
+def resolve_tile_manifest(source: str) -> tuple[TileSet, dict]:
+    """A tile source path (tiles root, or a manifest JSON under
+    ``manifests/``) -> ``(TileSet, manifest)``. Roots resolve through
+    the tiles ``CURRENT`` pointer, falling back to the newest tiled
+    epoch."""
+    import json
+    import os
+
+    p = str(source)
+    if os.path.isdir(p):
+        ts = TileSet(p)
+        n = ts.current()
+        if n is None:
+            n = ts.latest()
+        if n is None:
+            raise ValueError(f"{p}: no complete tiled epoch")
+        return ts, ts.manifest(n)
+    if not is_tile_source(p):
+        raise ValueError(f"{p} is not a tile manifest or tiles root")
+    with open(p, encoding="utf-8") as f:
+        man = json.load(f)
+    if man.get("kind") != "tiles":
+        raise ValueError(f"{p} is a {man.get('kind')!r} manifest, not "
+                         "a full tile manifest")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(p)))
+    return TileSet(root), man
+
+
+def _wcs_geometry(man: dict) -> tuple[int, int, int]:
+    pix = man.get("pixelization") or {}
+    if pix.get("kind") != "wcs":
+        raise ValueError("rectangular cutouts need a WCS tile set "
+                         f"(this manifest is {pix.get('kind')!r}; use "
+                         "assemble_healpix for HEALPix tiles)")
+    return int(pix["nx"]), int(pix["ny"]), int(pix["tile_px"])
+
+
+def assemble_cutout(ts: TileSet, man: dict, x0: int, y0: int,
+                    w: int, h: int, band: int = 0,
+                    product: str = "DESTRIPED") -> np.ndarray:
+    """Rectangular WCS cutout ``f32[h, w]`` at field pixels
+    ``[x0, x0+w) x [y0, y0+h)`` — bit-identical to slicing the
+    expanded full-field product. Out-of-field boxes raise; empty tiles
+    inside the box zero-fill."""
+    nx, ny, tile_px = _wcs_geometry(man)
+    x0, y0, w, h = int(x0), int(y0), int(w), int(h)
+    if w < 1 or h < 1:
+        raise ValueError(f"cutout box {w}x{h} is empty")
+    if x0 < 0 or y0 < 0 or x0 + w > nx or y0 + h > ny:
+        raise ValueError(f"cutout [{x0},{x0 + w})x[{y0},{y0 + h}) "
+                         f"outside the {nx}x{ny} field")
+    if product not in man.get("products", []):
+        raise ValueError(f"product {product!r} not in this tile set "
+                         f"{man.get('products')}")
+    out = np.zeros((h, w), np.float32)
+    ntx, _ = layout.wcs_tile_grid(nx, ny, tile_px)
+    for ty in range(y0 // tile_px, (y0 + h - 1) // tile_px + 1):
+        for tx in range(x0 // tile_px, (x0 + w - 1) // tile_px + 1):
+            tile = ts.read_tile(man, band, ty * ntx + tx)
+            if tile is None:
+                continue
+            hd = tile["header"]
+            tx0, ty0 = int(hd["x0"]), int(hd["y0"])
+            arr = tile["products"].get(product)
+            if arr is None:
+                continue
+            # overlap of the tile box with the cutout box
+            ax0, ay0 = max(tx0, x0), max(ty0, y0)
+            ax1 = min(tx0 + int(hd["w"]), x0 + w)
+            ay1 = min(ty0 + int(hd["h"]), y0 + h)
+            if ax0 >= ax1 or ay0 >= ay1:
+                continue
+            out[ay0 - y0:ay1 - y0, ax0 - x0:ax1 - x0] = \
+                arr[ay0 - ty0:ay1 - ty0, ax0 - tx0:ax1 - tx0]
+    return out
+
+
+def cutout_blob(ts: TileSet, man: dict, x0: int, y0: int, w: int,
+                h: int, band: int = 0,
+                products: list[str] | None = None) -> bytes:
+    """Deterministic multi-product cutout bytes for the HTTP layer —
+    the tile encoding with the cutout box as the geometry, so clients
+    decode cutouts and tiles with the same parser."""
+    names = list(products) if products else list(man.get("products", []))
+    cut = {nm: assemble_cutout(ts, man, x0, y0, w, h, band=band,
+                               product=nm) for nm in names}
+    return encode_tile("wcs", -1, cut, x0=int(x0), y0=int(y0),
+                       w=int(w), h=int(h))
+
+
+def assemble_healpix(ts: TileSet, man: dict, tile_ids, band: int = 0):
+    """Gather HEALPix tiles back to partial-sky: ``(ring_pixels,
+    {product: f32 values})`` sorted by RING id — exactly the source
+    partial map restricted to those tiles. Unknown/empty tile ids
+    contribute nothing."""
+    from comapreduce_tpu.mapmaking.healpix import nest2ring
+
+    pix = man.get("pixelization") or {}
+    if pix.get("kind") != "healpix":
+        raise ValueError("assemble_healpix needs a HEALPix tile set")
+    nside = int(pix["nside"])
+    tile_nside = int(pix["tile_nside"])
+    k = nside // tile_nside
+    nests, parts = [], []
+    for tid in sorted(int(t) for t in tile_ids):
+        tile = ts.read_tile(man, band, tid)
+        if tile is None:
+            continue
+        nests.append(np.int64(tid) * (k * k) + tile["local"])
+        parts.append(tile["products"])
+    if not nests:
+        return (np.empty(0, np.int64),
+                {nm: np.empty(0, np.float32)
+                 for nm in man.get("products", [])})
+    nest = np.concatenate(nests)
+    ring = np.asarray(nest2ring(nside, nest), np.int64)
+    order = np.argsort(ring, kind="stable")
+    out = {}
+    for nm in man.get("products", []):
+        vals = np.concatenate([p[nm] for p in parts])
+        out[nm] = vals[order]
+    return ring[order], out
+
+
+def reconstruct_hdus(source: str, band: int | None = None) -> list:
+    """Rebuild the map product HDUs of a tile manifest in
+    ``read_fits_image`` shape: ``[(name, header, array), ...]`` —
+    the coadd adapter. WCS sets come back as the full field (empty
+    tiles zero-filled, bit-identical to the original FITS); HEALPix
+    sets as the partial map (PIXELS HDU first, RING-sorted)."""
+    ts, man = resolve_tile_manifest(source)
+    bands = man.get("bands", [0])
+    if band is None:
+        if len(bands) != 1:
+            raise ValueError(f"tile set covers bands {bands}; pass "
+                             "band= to pick one")
+        band = int(bands[0])
+    pix = man.get("pixelization") or {}
+    products = list(man.get("products", []))
+    if pix.get("kind") == "wcs":
+        nx, ny = int(pix["nx"]), int(pix["ny"])
+        hdr = dict(pix.get("cards") or {})
+        out = []
+        for nm in products:
+            full = assemble_cutout(ts, man, 0, 0, nx, ny, band=band,
+                                   product=nm)
+            out.append((nm, dict(hdr, EXTNAME=nm), full))
+        return out
+    # healpix: every non-empty tile of this band
+    prefix = f"b{int(band)}/"
+    tids = [int(key[len(prefix):]) for key in man.get("tiles", {})
+            if key.startswith(prefix)]
+    ring, maps = assemble_healpix(ts, man, tids, band=band)
+    hdr = {"PIXTYPE": "HEALPIX", "ORDERING": pix.get("ordering", "RING"),
+           "NSIDE": int(pix["nside"]), "OBJECT": "PARTIAL"}
+    out = [("PIXELS", dict(hdr, EXTNAME="PIXELS"), ring)]
+    for nm in products:
+        out.append((nm, dict(hdr, EXTNAME=nm), maps[nm]))
+    return out

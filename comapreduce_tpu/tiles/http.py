@@ -1,0 +1,386 @@
+"""The map read tier's HTTP front: epochs, manifests, tiles, cutouts.
+
+A :class:`TileServer` wraps one tiles root (:class:`tiles.tiler.TileSet`)
+in a stdlib ``ThreadingHTTPServer`` — no framework, no extra deps, and
+the threading model is safe because everything it serves is immutable
+content or an atomically-swapped pointer. The cache story IS the
+architecture:
+
+- ``/v1/tiles/<sha256>`` and ``/v1/epochs/<E>/...`` are **immutable**
+  (``Cache-Control: public, max-age=31536000, immutable`` + strong
+  ``ETag``): a tile object's name is its content hash and an epoch's
+  manifest never changes after publish, so any number of HTTP caches /
+  CDN edges between this process and millions of readers can hold them
+  forever. Scaling the read tier is deploying caches, not servers.
+- ``/v1/current`` is the ONE mutable URL (``no-cache`` + validator
+  ``ETag``): it follows the tiles ``CURRENT`` pointer at request time,
+  so a reader polls one tiny JSON, sees a new epoch, fetches that
+  epoch's delta manifest, and refreshes only the changed tiles.
+- Conditional requests (``If-None-Match``) short-circuit to ``304``
+  everywhere, including across an operator **rollback**: the pointer
+  swap changes ``/v1/current``'s ETag, while every epoch-addressed URL
+  keeps validating — a reader pinned on a rolled-back-from epoch keeps
+  its cache intact.
+
+Rectangular sky cutouts (``/v1/epochs/<E>/cutout?x0=&y0=&w=&h=``) are
+assembled server-side from exactly the tiles the box touches
+(:mod:`tiles.cutout`) and encoded with the tile blob format — and
+because that encoding is deterministic, a cutout's ETag is a content
+hash too, making even computed responses CDN-cacheable.
+
+Telemetry (when ``TELEMETRY`` is configured — the tile server runs on
+its own serving-lane rank): request count / bytes / latency counters
+per route class, plus registered gauges for the current epoch and its
+freshness.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from comapreduce_tpu.serving.epochs import (epoch_name, parse_epoch_name,
+                                            read_epoch_manifest)
+from comapreduce_tpu.tiles.tiler import TileSet
+
+__all__ = ["TileServer", "IMMUTABLE_CACHE", "MUTABLE_CACHE"]
+
+logger = logging.getLogger(__name__)
+
+IMMUTABLE_CACHE = "public, max-age=31536000, immutable"
+MUTABLE_CACHE = "no-cache"
+
+_JSON = "application/json"
+_BLOB = "application/x-comap-tile"
+
+
+class _HTTPError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+def _parse_epoch_spec(spec: str) -> int:
+    """Path epoch component: ``epoch-000007`` or plain ``7``."""
+    n = parse_epoch_name(spec)
+    if n is None and spec.isdigit():
+        n = int(spec)
+    if n is None:
+        raise _HTTPError(400, f"bad epoch {spec!r} (want N or "
+                              "epoch-NNNNNN)")
+    return n
+
+
+def _int_param(q: dict, name: str) -> int:
+    vals = q.get(name)
+    if not vals:
+        raise _HTTPError(400, f"missing cutout parameter {name!r}")
+    try:
+        return int(vals[0])
+    except ValueError:
+        raise _HTTPError(400, f"cutout parameter {name}={vals[0]!r} is "
+                              "not an integer") from None
+
+
+class _Reply:
+    """One response: status + typed body + cache class."""
+
+    __slots__ = ("status", "ctype", "body", "etag", "immutable")
+
+    def __init__(self, body: bytes, ctype: str = _JSON, *,
+                 status: int = 200, etag: str | None = None,
+                 immutable: bool = False):
+        self.status = status
+        self.ctype = ctype
+        self.body = body
+        self.etag = etag
+        self.immutable = immutable
+
+    @classmethod
+    def json(cls, obj, **kw) -> "_Reply":
+        return cls(json.dumps(obj, sort_keys=True).encode("utf-8")
+                   + b"\n", _JSON, **kw)
+
+
+class TileServer:
+    """Serve one tiles root over HTTP (see module docstring).
+
+    ``port=0`` binds an ephemeral port (tests/drills); the bound port
+    is ``self.port``. ``epochs_root`` optionally points at the source
+    ``EpochStore`` so ``/v1/epochs/<E>/meta`` can serve the solve
+    metadata (census size, CG residual) next to the tile manifest.
+    Run with :meth:`serve_forever` (blocking) or :meth:`start` (a
+    daemon thread — the in-process mode drills and tests use).
+    """
+
+    def __init__(self, tiles_root: str, host: str = "127.0.0.1",
+                 port: int = 0, epochs_root: str | None = None):
+        self.tiles = TileSet(tiles_root)
+        self.epochs_root = str(epochs_root) if epochs_root else None
+        self._lock = threading.Lock()
+        self.stats = {"t_start_unix": time.time(), "n_requests": 0,
+                      "n_304": 0, "n_errors": 0, "bytes_sent": 0,
+                      "by_route": {}}
+        self.httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.app = self
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+        self._register_gauges()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        logger.info("tile server on http://%s:%d/ (root %s)", self.host,
+                    self.port, self.tiles.root)
+        self.httpd.serve_forever(poll_interval=0.2)
+
+    def start(self) -> "TileServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="tile-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _register_gauges(self) -> None:
+        from comapreduce_tpu.telemetry import TELEMETRY
+
+        if not TELEMETRY.enabled:
+            return
+        TELEMETRY.register_gauge("serving.tiles.current_epoch",
+                                 lambda: self.tiles.current())
+        TELEMETRY.register_gauge("serving.tiles.freshness_s",
+                                 self._freshness_s)
+        TELEMETRY.register_gauge(
+            "serving.tiles.http.requests_total",
+            lambda: self.stats["n_requests"])
+
+    def _freshness_s(self) -> float | None:
+        """Age of the CURRENT tile set — the staleness a reader who
+        refreshes right now observes. None until something is tiled."""
+        n = self.tiles.current()
+        man = self.tiles.manifest(n) if n is not None else None
+        if not man:
+            return None
+        return max(0.0, time.time() - float(man.get("t_publish_unix", 0)))
+
+    def _account(self, route: str, status: int, n_bytes: int,
+                 dur_s: float) -> None:
+        from comapreduce_tpu.telemetry import TELEMETRY
+
+        with self._lock:
+            st = self.stats
+            st["n_requests"] += 1
+            st["bytes_sent"] += n_bytes
+            if status == 304:
+                st["n_304"] += 1
+            elif status >= 400:
+                st["n_errors"] += 1
+            br = st["by_route"].setdefault(route, {"n": 0, "bytes": 0})
+            br["n"] += 1
+            br["bytes"] += n_bytes
+        if TELEMETRY.enabled:
+            TELEMETRY.counter("serving.tiles.http.requests",
+                              route=route, status=int(status))
+            if n_bytes:
+                TELEMETRY.counter("serving.tiles.http.bytes", n_bytes,
+                                  route=route)
+            TELEMETRY.event_span("serving.tiles.http.request", dur_s,
+                                 unit=route, status=int(status))
+
+    # -- routing -----------------------------------------------------------
+
+    def handle(self, path: str, query: str) -> tuple[str, _Reply]:
+        """Resolve one request to ``(route_class, reply)``; raises
+        ``_HTTPError`` for client errors."""
+        parts = [p for p in path.split("/") if p]
+        if parts == ["v1", "current"]:
+            return "current", self._reply_current()
+        if parts == ["v1", "status"]:
+            return "status", _Reply.json(self.status())
+        if parts == ["v1", "epochs"]:
+            return "epochs", _Reply.json(
+                {"epochs": self.tiles.list_tiled()})
+        if len(parts) == 3 and parts[:2] == ["v1", "tiles"]:
+            return "tile", self._reply_tile(parts[2])
+        if len(parts) == 4 and parts[:2] == ["v1", "epochs"]:
+            n = _parse_epoch_spec(parts[2])
+            leaf = parts[3]
+            if leaf == "manifest.json":
+                return "manifest", self._reply_manifest_file(
+                    self.tiles.manifest_path(n), n)
+            if leaf == "delta.json":
+                return "delta", self._reply_manifest_file(
+                    self.tiles.delta_path(n), n)
+            if leaf == "meta":
+                return "meta", self._reply_meta(n)
+            if leaf == "cutout":
+                return "cutout", self._reply_cutout(n, query)
+        raise _HTTPError(404, f"no route for {path}")
+
+    def _reply_current(self) -> _Reply:
+        cur = self.tiles.current()
+        obj = {"epoch": cur,
+               "name": epoch_name(cur) if cur is not None else None,
+               "latest": self.tiles.latest()}
+        # validator ETag: a poll after a publish or rollback misses,
+        # everything else is a 304 — the pointer itself is tiny anyway
+        return _Reply.json(obj, etag=f'W/"cur-{cur}"')
+
+    def _reply_tile(self, digest: str) -> _Reply:
+        d = digest.lower()
+        if len(d) != 64 or any(c not in "0123456789abcdef" for c in d):
+            raise _HTTPError(400, f"bad tile id {digest!r} (want a "
+                                  "sha256 hex digest)")
+        try:
+            blob = self.tiles.store.get(d)
+        except OSError:
+            raise _HTTPError(404, f"no tile object {d}") from None
+        return _Reply(blob, _BLOB, etag=f'"{d}"', immutable=True)
+
+    def _reply_manifest_file(self, path: str, n: int) -> _Reply:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            raise _HTTPError(404, f"epoch {n} is not tiled") from None
+        d = self.tiles.store.digest(raw)
+        return _Reply(raw, _JSON, etag=f'"{d}"', immutable=True)
+
+    def _reply_meta(self, n: int) -> _Reply:
+        """Epoch metadata without the (possibly large) tile index: the
+        tile manifest's summary fields plus, when the source epoch
+        store is mounted, the solve manifest."""
+        man = self.tiles.manifest(n)
+        if man is None:
+            raise _HTTPError(404, f"epoch {n} is not tiled")
+        obj = {k: v for k, v in man.items() if k != "tiles"}
+        if self.epochs_root:
+            import os
+
+            src = read_epoch_manifest(
+                os.path.join(self.epochs_root, epoch_name(n)))
+            if src is not None:
+                obj["solve"] = {k: v for k, v in src.items()
+                                if k != "census"}
+        raw = json.dumps(obj, sort_keys=True).encode("utf-8") + b"\n"
+        return _Reply(raw, _JSON,
+                      etag=f'"{self.tiles.store.digest(raw)}"',
+                      immutable=True)
+
+    def _reply_cutout(self, n: int, query: str) -> _Reply:
+        from comapreduce_tpu.tiles.cutout import cutout_blob
+
+        man = self.tiles.manifest(n)
+        if man is None:
+            raise _HTTPError(404, f"epoch {n} is not tiled")
+        q = parse_qs(query)
+        x0, y0 = _int_param(q, "x0"), _int_param(q, "y0")
+        w, h = _int_param(q, "w"), _int_param(q, "h")
+        band = int(q.get("band", ["0"])[0])
+        products = None
+        if q.get("products"):
+            products = [p for p in q["products"][0].split(",") if p]
+        try:
+            blob = cutout_blob(self.tiles, man, x0, y0, w, h,
+                               band=band, products=products)
+        except ValueError as exc:
+            raise _HTTPError(400, str(exc)) from None
+        # deterministic encoding -> the ETag is a true content hash,
+        # identical across servers and epochs with the same sky
+        return _Reply(blob, _BLOB,
+                      etag=f'"{self.tiles.store.digest(blob)}"',
+                      immutable=True)
+
+    def status(self) -> dict:
+        cur = self.tiles.current()
+        man = self.tiles.manifest(cur) if cur is not None else None
+        with self._lock:
+            st = {k: (dict(v) if isinstance(v, dict) else v)
+                  for k, v in self.stats.items()}
+        return {
+            "root": self.tiles.root, "current": cur,
+            "latest": self.tiles.latest(),
+            "tiled_epochs": len(self.tiles.list_tiled()),
+            "current_tiles": (man or {}).get("n_tiles"),
+            "current_bytes": (man or {}).get("total_bytes"),
+            "freshness_s": self._freshness_s(),
+            "uptime_s": round(time.time() - st["t_start_unix"], 3),
+            "http": st,
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "comap-tiles/1"
+    protocol_version = "HTTP/1.1"
+
+    # stdlib logs every request to stderr by default; route to logging
+    def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+        logger.debug("tile-server %s - %s", self.address_string(),
+                     fmt % args)
+
+    def do_GET(self):  # noqa: N802 - stdlib casing
+        self._serve(send_body=True)
+
+    def do_HEAD(self):  # noqa: N802 - stdlib casing
+        self._serve(send_body=False)
+
+    def _serve(self, send_body: bool) -> None:
+        app: TileServer = self.server.app
+        t0 = time.monotonic()
+        url = urlsplit(self.path)
+        route = "error"
+        try:
+            route, reply = app.handle(url.path, url.query)
+        except _HTTPError as exc:
+            reply = _Reply.json({"error": str(exc)}, status=exc.status)
+        except Exception as exc:  # a bug must 500, not kill the thread
+            logger.exception("tile-server error on %s", self.path)
+            reply = _Reply.json({"error": f"internal: {exc}"},
+                                status=500)
+        sent = self._send(reply, send_body)
+        app._account(route, reply.status if sent != 304 else 304,
+                     sent if isinstance(sent, int) and sent != 304 else 0,
+                     time.monotonic() - t0)
+
+    def _send(self, reply: _Reply, send_body: bool):
+        """Write one response; returns bytes sent, or 304."""
+        inm = self.headers.get("If-None-Match")
+        if reply.etag and inm and reply.status == 200 and \
+                reply.etag in [t.strip() for t in inm.split(",")]:
+            self.send_response(304)
+            if reply.etag:
+                self.send_header("ETag", reply.etag)
+            self.send_header("Cache-Control",
+                             IMMUTABLE_CACHE if reply.immutable
+                             else MUTABLE_CACHE)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return 304
+        try:
+            self.send_response(reply.status)
+            self.send_header("Content-Type", reply.ctype)
+            self.send_header("Content-Length", str(len(reply.body)))
+            if reply.etag:
+                self.send_header("ETag", reply.etag)
+            self.send_header("Cache-Control",
+                             IMMUTABLE_CACHE if reply.immutable
+                             else MUTABLE_CACHE)
+            self.end_headers()
+            if send_body:
+                self.wfile.write(reply.body)
+        except (BrokenPipeError, ConnectionResetError):
+            return 0  # reader hung up mid-write; nothing to do
+        return len(reply.body) if send_body else 0

@@ -1,0 +1,127 @@
+"""Tile grids: which sky pixels belong to which tile.
+
+Two pixelisations, one rule each:
+
+- **HEALPix** maps tile by NESTED parent pixel. A tile is one pixel of
+  the coarser ``tile_nside`` grid; in NESTED ordering its children are
+  the contiguous id range ``[t * k^2, (t+1) * k^2)`` with
+  ``k = nside // tile_nside``, so the tile of a sky pixel is one shift:
+  ``nest_id >> (2 * log2(k))``. The repo's partial maps store RING ids
+  (``fits_io.write_healpix_map``), so the layer converts through
+  ``healpix.ring2nest`` once per tiling — and because a compacted
+  ``PixelSpace`` already holds the sorted seen-pixel dictionary, the
+  set of non-empty tiles falls straight out of it
+  (:func:`healpix_tile_ids`): a compacted epoch IS a sparse tile set.
+- **WCS** maps tile on a fixed ``tile_px`` pixel grid over the field:
+  tile ``(tx, ty)`` covers ``x in [tx*T, min(nx, (tx+1)*T))`` (same
+  for y), id ``ty * ntx + tx``. Edge tiles are clipped, never padded —
+  padding would make the tile bytes depend on the field size.
+
+Both rules are pure index math (no jax, no I/O) so the byte-budget
+gate in ``tools/check_perf.py`` can price a tile set from the
+``PixelSpace`` alone, machine-independently
+(:func:`expected_healpix_tiles`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["healpix_tile_nside_auto", "healpix_tile_of",
+           "healpix_tile_ids", "expected_healpix_tiles",
+           "wcs_tile_grid", "wcs_tile_of", "wcs_tile_box"]
+
+#: default children-per-side per tile: one HEALPix tile covers
+#: ``DEFAULT_K^2`` sky pixels (64^2 = 4096 — a few-KB f32 payload,
+#: the CDN sweet spot between request count and over-fetch)
+DEFAULT_K = 64
+
+#: default WCS tile edge in pixels
+DEFAULT_WCS_TILE = 64
+
+
+def _check_pow2(n: int, what: str) -> None:
+    n = int(n)
+    if n < 1 or (n & (n - 1)):
+        raise ValueError(f"{what} must be a power of two, got {n}")
+
+
+def healpix_tile_nside_auto(nside: int, k: int = DEFAULT_K) -> int:
+    """The coarser tile grid for a map at ``nside``: ``nside // k``
+    floored at 1 (small test nsides tile by base face)."""
+    _check_pow2(nside, "nside")
+    _check_pow2(k, "tile k")
+    return max(1, int(nside) // int(k))
+
+
+def healpix_tile_of(nest_ids, nside: int, tile_nside: int) -> np.ndarray:
+    """NESTED sky ids -> tile ids (i64). Vectorised shift — the whole
+    point of the NESTED ordering choice."""
+    _check_pow2(nside, "nside")
+    _check_pow2(tile_nside, "tile_nside")
+    k = int(nside) // int(tile_nside)
+    if k < 1:
+        raise ValueError(f"tile_nside {tile_nside} finer than map "
+                         f"nside {nside}")
+    shift = 2 * (k.bit_length() - 1)
+    return np.asarray(nest_ids, np.int64) >> shift
+
+
+def healpix_tile_ids(ring_ids, nside: int, tile_nside: int):
+    """Group RING-ordered sky ids by tile.
+
+    Returns ``(tile_ids, nest_ids, order)``: the sorted-unique tile id
+    per input pixel is ``tile_ids[...]``; ``order`` sorts the inputs by
+    ``(tile, nest-within-tile)`` so each tile's pixels come out as one
+    contiguous, deterministically-ordered slice (the blob layout).
+    """
+    from comapreduce_tpu.mapmaking.healpix import ring2nest
+
+    ring = np.asarray(ring_ids, np.int64)
+    nest = np.asarray(ring2nest(int(nside), ring), np.int64)
+    tiles = healpix_tile_of(nest, nside, tile_nside)
+    order = np.lexsort((nest, tiles))
+    return tiles, nest, order
+
+
+def expected_healpix_tiles(pixel_space, tile_nside: int) -> np.ndarray:
+    """The exact non-empty tile ids of a compacted ``PixelSpace`` —
+    the sparse tile set IS the seen-pixel dictionary, coarsened. Used
+    by the machine-independent byte-budget gate."""
+    from comapreduce_tpu.mapmaking.healpix import (npix2nside, ring2nest)
+
+    if not pixel_space.compacted:
+        raise ValueError("expected_healpix_tiles needs a compacted "
+                         "PixelSpace (a dense space tiles everywhere)")
+    nside = npix2nside(pixel_space.npix_sky)
+    nest = np.asarray(ring2nest(nside, pixel_space.pixels), np.int64)
+    return np.unique(healpix_tile_of(nest, nside, tile_nside))
+
+
+def wcs_tile_grid(nx: int, ny: int, tile_px: int = DEFAULT_WCS_TILE):
+    """``(ntx, nty)`` tile counts for an ``(nx, ny)`` field."""
+    t = int(tile_px)
+    if t < 1:
+        raise ValueError(f"tile_px must be >= 1, got {t}")
+    return (-(-int(nx) // t), -(-int(ny) // t))
+
+
+def wcs_tile_of(x, y, nx: int, tile_px: int = DEFAULT_WCS_TILE):
+    """Pixel coords -> tile id (``ty * ntx + tx``)."""
+    t = int(tile_px)
+    ntx = -(-int(nx) // t)
+    return (np.asarray(y, np.int64) // t) * ntx + \
+        (np.asarray(x, np.int64) // t)
+
+
+def wcs_tile_box(tid: int, nx: int, ny: int,
+                 tile_px: int = DEFAULT_WCS_TILE):
+    """Tile id -> clipped pixel box ``(x0, y0, w, h)``."""
+    t = int(tile_px)
+    ntx, nty = wcs_tile_grid(nx, ny, t)
+    tid = int(tid)
+    if not 0 <= tid < ntx * nty:
+        raise ValueError(f"tile id {tid} outside the {ntx}x{nty} grid")
+    tx, ty = tid % ntx, tid // ntx
+    x0, y0 = tx * t, ty * t
+    return x0, y0, min(t, int(nx) - x0), min(t, int(ny) - y0)

@@ -1,0 +1,107 @@
+"""Content-addressed tile object store.
+
+Objects live at ``objects/<hh>/<sha256>`` under the tiles root, named
+by the sha256 of their bytes. The invariants the read tier leans on:
+
+- **Immutable**: an object is never rewritten — its name IS its
+  content, so ``Cache-Control: immutable`` and strong ``ETag``s are
+  correct by construction.
+- **Idempotent writes**: ``put`` of bytes that already exist is a
+  no-op (the hash matches), so a tiler crashed mid-publish simply
+  re-puts on resume; two tilers racing on one root converge on the
+  same objects.
+- **Never torn**: writes go through tmp + fsync + atomic rename
+  (``data/durable.py``), so a SIGKILL leaves either the complete
+  object or a dead ``.tmp`` sibling (swept by :meth:`cleanup_tmp`) —
+  a reader can never fetch half a tile.
+
+Garbage (objects no manifest references, e.g. after a crash between
+object writes and the manifest rename) is bounded and harmless;
+:meth:`sweep_unreferenced` reclaims it given the live hash set.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from comapreduce_tpu.data.durable import durable_replace
+
+__all__ = ["TileStore"]
+
+OBJECTS_DIR = "objects"
+
+
+class TileStore:
+    """The ``objects/`` half of a tiles root (see module docstring)."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.objects = os.path.join(self.root, OBJECTS_DIR)
+        os.makedirs(self.objects, exist_ok=True)
+
+    @staticmethod
+    def digest(blob: bytes) -> str:
+        return hashlib.sha256(blob).hexdigest()
+
+    def path(self, digest: str) -> str:
+        d = str(digest)
+        return os.path.join(self.objects, d[:2], d)
+
+    def has(self, digest: str) -> bool:
+        return os.path.exists(self.path(digest))
+
+    def put(self, blob: bytes) -> tuple[str, bool]:
+        """Store ``blob``; returns ``(digest, was_new)``. Existing
+        objects are trusted by name — content-addressing means a
+        present object IS the bytes (rewriting it would only race
+        readers for no change)."""
+        digest = self.digest(blob)
+        dest = self.path(digest)
+        if os.path.exists(dest):
+            return digest, False
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        tmp = dest + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        durable_replace(tmp, dest)
+        return digest, True
+
+    def get(self, digest: str) -> bytes:
+        with open(self.path(digest), "rb") as f:
+            return f.read()
+
+    def size(self, digest: str) -> int:
+        return os.stat(self.path(digest)).st_size
+
+    # -- maintenance ------------------------------------------------------
+
+    def cleanup_tmp(self) -> int:
+        """Remove dead ``*.tmp*`` writes (writer killed before its
+        rename); returns how many were removed."""
+        n = 0
+        for sub, _, names in os.walk(self.objects):
+            for name in names:
+                if ".tmp" in name:
+                    try:
+                        os.remove(os.path.join(sub, name))
+                        n += 1
+                    except OSError:
+                        pass
+        return n
+
+    def sweep_unreferenced(self, live: set) -> int:
+        """Remove objects whose digest is not in ``live`` (the union of
+        every manifest's hashes — the caller computes it so rollback
+        targets stay servable); returns how many were removed."""
+        n = 0
+        for sub, _, names in os.walk(self.objects):
+            for name in names:
+                if ".tmp" in name or name in live:
+                    continue
+                try:
+                    os.remove(os.path.join(sub, name))
+                    n += 1
+                except OSError:
+                    pass
+        return n

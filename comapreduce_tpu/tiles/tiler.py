@@ -1,0 +1,401 @@
+"""Turn a published epoch into a content-addressed tile set.
+
+``tile_epoch`` walks one ``epoch-NNNNNN`` dir (:mod:`serving.epochs`),
+cuts every map product into tiles (:mod:`tiles.layout`), stores each
+tile blob by content hash (:mod:`tiles.store`) and publishes two
+manifests under ``<tiles_root>/manifests/``:
+
+- ``epoch-NNNNNN.json`` — the FULL manifest: pixelisation, products,
+  and ``tiles: {"b<band>/<tile>": [sha256, bytes, n_pix]}``. Empty
+  tiles (every product zero over the tile) are never materialised;
+  absence from the manifest IS the zero tile.
+- ``delta-epoch-NNNNNN.json`` — the DELTA against the previous tiled
+  epoch: only ``changed`` (new hash) and ``removed`` keys. Clients
+  holding epoch P refresh to N by fetching the delta and only the
+  changed tiles; unchanged tiles keep their content hash (the blob
+  encoding is deterministic) so every cached copy stays valid.
+
+Crash safety mirrors the epoch store: objects are idempotent
+content-addressed writes, manifests land via tmp + fsync + atomic
+rename, and the ``CURRENT`` pointer swaps last — a SIGKILL anywhere
+leaves readers on the previous complete tile set (old-or-new, never
+torn) and a resumed tiler re-derives identical objects and simply
+re-publishes the manifest. The ``chaos`` hook injects the drill's
+``kill_mid_publish`` between the object writes and the manifest
+rename, the widest window.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import time
+
+import numpy as np
+
+from comapreduce_tpu.data.durable import durable_replace
+from comapreduce_tpu.serving.epochs import epoch_name, parse_epoch_name
+from comapreduce_tpu.tiles import layout
+from comapreduce_tpu.tiles.blob import encode_tile
+from comapreduce_tpu.tiles.store import TileStore
+
+__all__ = ["TileSet", "tile_epoch", "is_tile_source",
+           "tile_budget_bytes", "MANIFESTS_DIR", "TILES_CURRENT"]
+
+logger = logging.getLogger(__name__)
+
+MANIFESTS_DIR = "manifests"
+TILES_CURRENT = "CURRENT"
+_BAND_RE = re.compile(r"band(\d+)")
+_DELTA_PREFIX = "delta-"
+
+#: per-tile fixed-cost bound for the machine-independent byte budget:
+#: magic + header-length word + the canonical JSON header (all fields
+#: are short ints/names; measured headers are ~160 B)
+TILE_HEADER_BOUND = 512
+
+
+def _write_json(path: str, obj: dict) -> bytes:
+    raw = json.dumps(obj, sort_keys=True, indent=1).encode("utf-8")
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(raw)
+    durable_replace(tmp, path)
+    return raw
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+class TileSet:
+    """Read/point-at side of a tiles root (manifests + CURRENT).
+
+    The write side is :func:`tile_epoch`; this class never touches
+    objects it did not come to read. Import-light (no jax) — status
+    tools stay instant.
+    """
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.manifests = os.path.join(self.root, MANIFESTS_DIR)
+        self.store = TileStore(self.root)
+        os.makedirs(self.manifests, exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+
+    def manifest_path(self, n: int) -> str:
+        return os.path.join(self.manifests, epoch_name(n) + ".json")
+
+    def delta_path(self, n: int) -> str:
+        return os.path.join(self.manifests,
+                            _DELTA_PREFIX + epoch_name(n) + ".json")
+
+    # -- queries ----------------------------------------------------------
+
+    def manifest(self, n: int) -> dict | None:
+        man = _read_json(self.manifest_path(n))
+        if man is None or man.get("kind") != "tiles" or \
+                int(man.get("schema", 0)) != 1:
+            return None
+        return man
+
+    def delta(self, n: int) -> dict | None:
+        d = _read_json(self.delta_path(n))
+        if d is None or d.get("kind") != "tiles-delta":
+            return None
+        return d
+
+    def list_tiled(self) -> list[int]:
+        out = []
+        try:
+            names = os.listdir(self.manifests)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith(_DELTA_PREFIX) or \
+                    not name.endswith(".json"):
+                continue
+            n = parse_epoch_name(name[:-len(".json")])
+            if n is not None and self.manifest(n) is not None:
+                out.append(n)
+        return sorted(out)
+
+    def latest(self) -> int | None:
+        eps = self.list_tiled()
+        return eps[-1] if eps else None
+
+    def current(self) -> int | None:
+        try:
+            with open(os.path.join(self.manifests, TILES_CURRENT),
+                      encoding="utf-8") as f:
+                name = f.read().strip()
+        except OSError:
+            return None
+        n = parse_epoch_name(name)
+        if n is None or self.manifest(n) is None:
+            return None
+        return n
+
+    def set_current(self, n: int, force: bool = False) -> None:
+        """Atomic pointer swap, forward-only unless ``force`` (the
+        rollback path) — same contract as ``EpochStore.set_current``."""
+        if self.manifest(n) is None:
+            raise ValueError(f"epoch {n} is not tiled in {self.root}")
+        cur = self.current()
+        if cur is not None and n < cur and not force:
+            raise ValueError(f"tiles CURRENT is {epoch_name(cur)}; "
+                             f"refusing a backwards swap to "
+                             f"{epoch_name(n)} (use force/rollback)")
+        tmp = os.path.join(self.manifests,
+                           f".{TILES_CURRENT}.tmp{os.getpid()}")
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(epoch_name(n) + "\n")
+        durable_replace(tmp, os.path.join(self.manifests, TILES_CURRENT))
+
+    # -- tile reads -------------------------------------------------------
+
+    def tile_entry(self, man: dict, band: int, tid: int):
+        """Manifest entry ``[hash, bytes, n_pix]`` or None (empty)."""
+        return (man.get("tiles") or {}).get(f"b{int(band)}/{int(tid)}")
+
+    def read_tile(self, man: dict, band: int, tid: int) -> dict | None:
+        from comapreduce_tpu.tiles.blob import decode_tile
+
+        entry = self.tile_entry(man, band, tid)
+        if entry is None:
+            return None
+        return decode_tile(self.store.get(entry[0]))
+
+
+# -- tiling one epoch -----------------------------------------------------
+
+
+def _band_of(map_name: str) -> int:
+    m = _BAND_RE.search(os.path.basename(map_name))
+    return int(m.group(1)) if m else 0
+
+
+def _tile_wcs(images: list, hdr0: dict, band: int, tile_px: int,
+              store: TileStore, tiles: dict, stats: dict) -> dict:
+    """Cut one WCS map's HDUs into dense tile blobs; empty (all-zero
+    across every product) tiles are skipped — absence IS the zero
+    tile, so reassembly zero-fills and stays bit-identical."""
+    products = {name: np.asarray(data, np.float32)
+                for name, _, data in images}
+    ny, nx = next(iter(products.values())).shape
+    for name, arr in products.items():
+        if arr.shape != (ny, nx):
+            raise ValueError(f"product {name} shape {arr.shape} != "
+                             f"({ny}, {nx})")
+    ntx, nty = layout.wcs_tile_grid(nx, ny, tile_px)
+    for tid in range(ntx * nty):
+        x0, y0, w, h = layout.wcs_tile_box(tid, nx, ny, tile_px)
+        cut = {k: v[y0:y0 + h, x0:x0 + w] for k, v in products.items()}
+        if not any(np.any(c) for c in cut.values()):
+            stats["n_empty"] += 1
+            continue
+        blob = encode_tile("wcs", tid, cut, x0=x0, y0=y0, w=w, h=h)
+        digest, new = store.put(blob)
+        tiles[f"b{band}/{tid}"] = [digest, len(blob), int(w * h)]
+        stats["total_bytes"] += len(blob)
+        stats["n_new_objects"] += int(new)
+    cards = {k: v for k, v in hdr0.items()
+             if k.startswith(("CRVAL", "CRPIX", "CDELT", "CTYPE",
+                              "CUNIT"))}
+    return {"kind": "wcs", "nx": int(nx), "ny": int(ny),
+            "tile_px": int(tile_px), "cards": cards}
+
+
+def _tile_healpix(images: list, hdr0: dict, band: int,
+                  tile_nside: int, store: TileStore, tiles: dict,
+                  stats: dict) -> dict:
+    """Cut one partial-sky HEALPix map into sparse tile blobs. The
+    pixel list (RING ids, sorted — the PixelSpace dictionary) groups by
+    NESTED parent: tile ids fall straight out of the seen-pixel set,
+    and a compacted epoch is already the sparse tile set."""
+    from comapreduce_tpu.mapmaking.healpix import nside2npix
+
+    pixels = next(np.asarray(d, np.int64)
+                  for n, _, d in images if n == "PIXELS")
+    products = {n: np.asarray(d, np.float32)
+                for n, _, d in images if n != "PIXELS"}
+    nside = int(hdr0["NSIDE"])
+    if hdr0.get("ORDERING", "RING") != "RING":
+        raise ValueError("tiler expects RING-ordered partial maps "
+                         "(the repo's write_healpix_map layout)")
+    npix_sky = nside2npix(nside)
+    if pixels.size and (pixels.min() < 0 or pixels.max() >= npix_sky):
+        raise ValueError(f"PIXELS outside [0, {npix_sky}) for nside "
+                         f"{nside} — corrupt partial map?")
+    if tile_nside <= 0:
+        tile_nside = layout.healpix_tile_nside_auto(nside)
+    k = nside // tile_nside
+    tids, nest, order = layout.healpix_tile_ids(pixels, nside,
+                                                tile_nside)
+    tids_s, nest_s = tids[order], nest[order]
+    bounds = np.flatnonzero(np.diff(tids_s)) + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [tids_s.size]])
+    for s, e in zip(starts, ends):
+        if s == e:
+            continue
+        tid = int(tids_s[s])
+        local = nest_s[s:e] - np.int64(tid) * (k * k)
+        sel = order[s:e]
+        cut = {nm: arr[sel] for nm, arr in products.items()}
+        blob = encode_tile("healpix", tid, cut, local=local,
+                           nside=nside, tile_nside=tile_nside)
+        digest, new = store.put(blob)
+        tiles[f"b{band}/{tid}"] = [digest, len(blob), int(e - s)]
+        stats["total_bytes"] += len(blob)
+        stats["n_new_objects"] += int(new)
+    return {"kind": "healpix", "nside": nside, "ordering": "RING",
+            "tile_nside": int(tile_nside)}
+
+
+def tile_epoch(epoch_dir: str, tiles_root: str, *,
+               tile_px: int = layout.DEFAULT_WCS_TILE,
+               tile_nside: int = 0, chaos=None,
+               now=time.time) -> dict:
+    """Tile one published epoch; returns the full manifest (already
+    durable on disk, with its delta, and ``CURRENT`` rolled forward).
+
+    ``tile_nside`` 0 = auto (``nside // 64``); ``chaos`` injects the
+    ``kill_mid_publish`` drill fault between object writes and the
+    manifest rename. Re-tiling an already-tiled epoch is idempotent:
+    objects are content-addressed and the manifest is atomically
+    replaced by an identical one.
+    """
+    from comapreduce_tpu.mapmaking.fits_io import read_fits_image
+    from comapreduce_tpu.serving.epochs import read_epoch_manifest
+
+    epoch_dir = str(epoch_dir)
+    man_src = read_epoch_manifest(epoch_dir)
+    if man_src is None:
+        raise ValueError(f"{epoch_dir} is not a complete epoch (no "
+                         "readable manifest.json)")
+    n = int(man_src["epoch"])
+    ts = TileSet(tiles_root)
+    t0 = time.perf_counter()
+    tiles: dict[str, list] = {}
+    stats = {"total_bytes": 0, "n_new_objects": 0, "n_empty": 0}
+    bands, pixelization = set(), None
+    for map_name in man_src.get("maps", []):
+        path = os.path.join(epoch_dir, str(map_name))
+        images = read_fits_image(path)
+        if not images:
+            raise ValueError(f"{path}: no image HDUs")
+        hdr0 = images[0][1]
+        band = _band_of(map_name)
+        bands.add(band)
+        if hdr0.get("PIXTYPE") == "HEALPIX":
+            pix = _tile_healpix(images, hdr0, band, tile_nside,
+                                ts.store, tiles, stats)
+        else:
+            pix = _tile_wcs(images, hdr0, band, tile_px, ts.store,
+                            tiles, stats)
+        if pixelization is not None and pixelization != pix:
+            raise ValueError(f"epoch {n} mixes pixelisations across "
+                             f"bands: {pixelization} vs {pix}")
+        pixelization = pix
+    if pixelization is None:
+        raise ValueError(f"epoch {n} manifest lists no map products")
+    products = _product_names(ts, tiles)
+    manifest = {
+        "schema": 1, "kind": "tiles", "epoch": n,
+        "pixelization": pixelization, "products": products,
+        "bands": sorted(bands), "tiles": tiles,
+        "n_tiles": len(tiles), "n_empty": stats["n_empty"],
+        "total_bytes": stats["total_bytes"],
+        "source": {"n_files": int(man_src.get("n_files", 0)),
+                   "census_sha1": hashlib.sha1("\n".join(
+                       man_src.get("census", [])).encode()).hexdigest()},
+        "t_publish_unix": float(now()),
+        "t_tile_s": round(time.perf_counter() - t0, 3),
+    }
+    prev = max((p for p in ts.list_tiled() if p < n), default=None)
+    if chaos is not None:
+        chaos.maybe_kill_publish(f"tiles-{epoch_name(n)}")
+    _write_json(ts.manifest_path(n), manifest)
+    delta = _build_delta(ts, n, manifest, prev)
+    _write_json(ts.delta_path(n), delta)
+    cur = ts.current()
+    if cur is None or n >= cur:
+        ts.set_current(n, force=True)
+    logger.info("tiled %s: %d tiles (%d empty skipped), %d bytes, "
+                "delta %d changed / %d removed vs %s", epoch_name(n),
+                len(tiles), stats["n_empty"], stats["total_bytes"],
+                len(delta["changed"]), len(delta["removed"]),
+                "nothing" if prev is None else epoch_name(prev))
+    return manifest
+
+
+def _product_names(ts: TileSet, tiles: dict) -> list[str]:
+    if not tiles:
+        return []
+    key = sorted(tiles)[0]
+    from comapreduce_tpu.tiles.blob import decode_tile
+
+    blob = decode_tile(ts.store.get(tiles[key][0]))
+    return list(blob["header"].get("products", []))
+
+
+def _build_delta(ts: TileSet, n: int, manifest: dict,
+                 prev: int | None) -> dict:
+    """Exact delta vs the previous tiled epoch: hash comparison over
+    the two manifests — correct by the blob encoding's determinism
+    (same content, same hash), so ``delta + prev == full re-tile``."""
+    prev_tiles = {}
+    if prev is not None:
+        pman = ts.manifest(prev)
+        prev_tiles = (pman or {}).get("tiles", {})
+    tiles = manifest["tiles"]
+    changed = {k: v for k, v in tiles.items()
+               if prev_tiles.get(k, [None])[0] != v[0]}
+    removed = sorted(k for k in prev_tiles if k not in tiles)
+    return {
+        "schema": 1, "kind": "tiles-delta", "epoch": n,
+        "prev": prev, "changed": changed, "removed": removed,
+        "n_changed": len(changed), "n_removed": len(removed),
+        "n_unchanged": len(tiles) - len(changed),
+        "changed_bytes": int(sum(v[1] for v in changed.values())),
+    }
+
+
+def is_tile_source(path: str) -> bool:
+    """True when ``path`` names tile content: a tiles ROOT (contains
+    ``manifests/``), a tile manifest JSON, or a delta's full sibling.
+    Cheap — filename/dirname checks first, one small JSON parse only
+    for unrecognised ``.json`` paths."""
+    p = str(path)
+    if os.path.isdir(p):
+        return os.path.isdir(os.path.join(p, MANIFESTS_DIR))
+    if not p.endswith(".json"):
+        return False
+    if os.path.basename(os.path.dirname(p)) == MANIFESTS_DIR:
+        return parse_epoch_name(
+            os.path.basename(p)[:-len(".json")]) is not None
+    obj = _read_json(p)
+    return bool(obj) and obj.get("kind") == "tiles"
+
+
+def tile_budget_bytes(pixel_space, tile_nside: int,
+                      n_products: int = 4) -> tuple[int, int]:
+    """Machine-independent byte ceiling for a compacted HEALPix tile
+    set: exact payload (4 B offset + 4 B per product per seen pixel)
+    plus :data:`TILE_HEADER_BOUND` per non-empty tile. Returns
+    ``(budget_bytes, n_tiles)`` — the perf gate asserts the tiler's
+    ``total_bytes`` under the budget and its tile count EQUAL to the
+    ``PixelSpace``-derived sparse count."""
+    tiles = layout.expected_healpix_tiles(pixel_space, tile_nside)
+    payload = 4 * (1 + int(n_products)) * pixel_space.n_compact
+    return payload + tiles.size * TILE_HEADER_BOUND, int(tiles.size)

@@ -1,0 +1,674 @@
+"""Map tile tier unit pins (ISSUE 12).
+
+Fast, jax-free checks on the pieces under ``comapreduce_tpu.tiles``
+and their integration points: tile grid math (``layout``), the
+deterministic blob encoding (``blob``), the content-addressed object
+store (``store``), the epoch tiler with exact deltas and crash
+old-or-new manifests (``tiler``), cutout/reconstruction bit-identity
+(``cutout``), the HTTP front's cache contract (``http``), the coadd
+read path over a tile source, and the serving-side satellites
+(ledger retraction, downdated epochs, publish hooks, tmp sweeps, the
+telemetry serving lane). The end-to-end kill/backfill/HTTP/evict
+contract lives in ``run_tiles_drill`` (``check_resilience.py
+--tiles-only``).
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+NY, NX, TILE = 80, 96, 32  # 3x3 tile grid; hot region leaves 5 empty
+CARDS = {"CRVAL1": 170.25, "CRVAL2": 52.25,
+         "CDELT1": 1.0 / 60, "CDELT2": 1.0 / 60,
+         "CTYPE1": "RA---CAR", "CTYPE2": "DEC--CAR"}
+
+
+def _wcs_products(seed=0):
+    """Synthetic 3-product map: non-zero only in ``[:40, :40]`` so the
+    32px tiling gives 4 occupied tiles (ids 0, 1, 3, 4) and 5 empty."""
+    rng = np.random.default_rng(seed)
+    d = np.zeros((NY, NX), np.float32)
+    w = np.zeros((NY, NX), np.float32)
+    h = np.zeros((NY, NX), np.float32)
+    d[:40, :40] = rng.normal(size=(40, 40)).astype(np.float32)
+    w[:40, :40] = rng.uniform(0.5, 2.0, size=(40, 40)).astype(np.float32)
+    h[:40, :40] = rng.integers(1, 9, size=(40, 40)).astype(np.float32)
+    return {"DESTRIPED": d, "WEIGHTS": w, "HITS": h}
+
+
+def _publish_wcs_epoch(epochs_root, n, products, census=("a.hd5",)):
+    """A complete epoch dir by hand (manifest + one band FITS) — the
+    tiler only needs the published artefacts, not a solver run."""
+    from comapreduce_tpu.mapmaking.fits_io import write_fits_image
+
+    d = os.path.join(str(epochs_root), f"epoch-{n:06d}")
+    os.makedirs(d, exist_ok=True)
+    write_fits_image(os.path.join(d, "map_band0.fits"), products,
+                     header=CARDS)
+    man = {"schema": 1, "epoch": n, "census": sorted(census),
+           "n_files": len(census), "maps": ["map_band0.fits"]}
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(man, f)
+    return d
+
+
+def _tiled(tmp_path, seed=0, **kw):
+    from comapreduce_tpu.tiles.tiler import TileSet, tile_epoch
+
+    prods = _wcs_products(seed)
+    ep = _publish_wcs_epoch(tmp_path / "epochs", 1, prods)
+    root = str(tmp_path / "tiles")
+    man = tile_epoch(ep, root, tile_px=TILE, **kw)
+    return TileSet(root), man, prods
+
+
+# -- layout: tile grid math ------------------------------------------------
+
+
+def test_wcs_grid_and_boxes():
+    from comapreduce_tpu.tiles import layout
+
+    assert layout.wcs_tile_grid(NX, NY, TILE) == (3, 3)
+    # interior tile is full-size; edge tiles clip, never pad
+    assert layout.wcs_tile_box(0, NX, NY, TILE) == (0, 0, 32, 32)
+    assert layout.wcs_tile_box(8, NX, NY, TILE) == (64, 64, 32, 16)
+    assert int(layout.wcs_tile_of(65, 70, NX, TILE)) == 8
+    with pytest.raises(ValueError):
+        layout.wcs_tile_box(9, NX, NY, TILE)
+    with pytest.raises(ValueError):
+        layout.wcs_tile_grid(NX, NY, 0)
+
+
+def test_healpix_tile_of_is_nested_shift():
+    from comapreduce_tpu.tiles import layout
+
+    nside, tile_nside = 16, 2
+    k = nside // tile_nside
+    nest = np.arange(12 * nside * nside, dtype=np.int64)
+    tiles = layout.healpix_tile_of(nest, nside, tile_nside)
+    assert np.array_equal(tiles, nest // (k * k))
+    with pytest.raises(ValueError):
+        layout.healpix_tile_of(nest, nside, 3)  # not a power of two
+    with pytest.raises(ValueError):
+        layout.healpix_tile_of(nest, 2, 4)  # tiles finer than the map
+    assert layout.healpix_tile_nside_auto(4096) == 64
+    assert layout.healpix_tile_nside_auto(16) == 1  # floored at 1
+
+
+def test_healpix_tile_ids_groups_contiguously():
+    from comapreduce_tpu.tiles import layout
+
+    nside, tile_nside = 16, 2
+    rng = np.random.default_rng(1)
+    ring = np.sort(rng.choice(12 * nside * nside, 200, replace=False))
+    tids, nest, order = layout.healpix_tile_ids(ring, nside, tile_nside)
+    ts, ns = tids[order], nest[order]
+    # sorted by (tile, nest-within-tile): each tile one contiguous run
+    assert np.all(np.diff(ts) >= 0)
+    same = np.diff(ts) == 0
+    assert np.all(np.diff(ns)[same] > 0)
+
+
+def test_expected_healpix_tiles_matches_dictionary():
+    from comapreduce_tpu.mapmaking.healpix import ring2nest
+    from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+    from comapreduce_tpu.tiles import layout
+
+    nside, tile_nside = 16, 2
+    rng = np.random.default_rng(2)
+    ring = np.sort(rng.choice(12 * nside * nside, 150, replace=False))
+    space = PixelSpace.from_pixels(ring, 12 * nside * nside)
+    tiles = layout.expected_healpix_tiles(space, tile_nside)
+    nest = np.asarray(ring2nest(nside, ring), np.int64)
+    want = np.unique(layout.healpix_tile_of(nest, nside, tile_nside))
+    assert np.array_equal(tiles, want)
+    with pytest.raises(ValueError):
+        layout.expected_healpix_tiles(
+            PixelSpace.dense(12 * nside * nside), tile_nside)
+
+
+# -- blob: deterministic encoding ------------------------------------------
+
+
+def test_blob_wcs_roundtrip_and_determinism():
+    from comapreduce_tpu.tiles.blob import decode_tile, encode_tile
+
+    rng = np.random.default_rng(3)
+    cut = {"DESTRIPED": rng.normal(size=(8, 5)).astype(np.float32),
+           "WEIGHTS": rng.uniform(size=(8, 5)).astype(np.float32)}
+    blob = encode_tile("wcs", 7, cut, x0=10, y0=16, w=5, h=8)
+    out = decode_tile(blob)
+    assert out["header"]["tile"] == 7 and out["header"]["x0"] == 10
+    assert out["local"] is None
+    for nm, arr in cut.items():
+        assert np.array_equal(out["products"][nm], arr)
+        assert out["products"][nm].dtype == np.float32
+    # dict insertion order must not leak into the bytes
+    blob2 = encode_tile("wcs", 7, dict(reversed(list(cut.items()))),
+                        x0=10, y0=16, w=5, h=8)
+    assert blob2 == blob
+
+
+def test_blob_healpix_roundtrip_and_validation():
+    from comapreduce_tpu.tiles.blob import decode_tile, encode_tile
+
+    local = np.array([0, 3, 4, 9], np.int64)
+    vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    blob = encode_tile("healpix", 2, {"DESTRIPED": vals}, local=local,
+                       nside=16, tile_nside=2)
+    out = decode_tile(blob)
+    assert np.array_equal(out["local"], local)
+    assert np.array_equal(out["products"]["DESTRIPED"], vals)
+    with pytest.raises(ValueError):  # offsets must strictly increase
+        encode_tile("healpix", 2, {"D": vals},
+                    local=np.array([0, 3, 3, 9]), nside=16, tile_nside=2)
+    with pytest.raises(ValueError):  # values must align with offsets
+        encode_tile("healpix", 2, {"D": vals[:2]}, local=local,
+                    nside=16, tile_nside=2)
+    with pytest.raises(ValueError):
+        encode_tile("mystery", 0, {})
+
+
+def test_blob_rejects_torn_bytes():
+    from comapreduce_tpu.tiles.blob import decode_tile, encode_tile
+
+    blob = encode_tile("wcs", 0, {"D": np.ones((2, 2), np.float32)},
+                       x0=0, y0=0, w=2, h=2)
+    with pytest.raises(ValueError):
+        decode_tile(b"NOPE" + blob)
+    with pytest.raises(ValueError):
+        decode_tile(blob[:-3])  # truncated payload
+    with pytest.raises(ValueError):
+        decode_tile(blob[:8])  # header cut mid-JSON
+
+
+# -- store: content addressing ---------------------------------------------
+
+
+def test_store_put_is_idempotent(tmp_path):
+    from comapreduce_tpu.tiles.store import TileStore
+
+    st = TileStore(str(tmp_path))
+    d1, new1 = st.put(b"hello tiles")
+    d2, new2 = st.put(b"hello tiles")
+    assert d1 == d2 and new1 and not new2
+    assert st.has(d1) and st.get(d1) == b"hello tiles"
+    assert st.size(d1) == len(b"hello tiles")
+
+
+def test_store_cleanup_and_sweep(tmp_path):
+    from comapreduce_tpu.tiles.store import TileStore
+
+    st = TileStore(str(tmp_path))
+    live, _ = st.put(b"live")
+    dead, _ = st.put(b"dead")
+    tmp = st.path(live) + ".tmp999"
+    with open(tmp, "wb") as f:
+        f.write(b"half-written")
+    assert st.cleanup_tmp() == 1 and not os.path.exists(tmp)
+    assert st.sweep_unreferenced({live}) == 1
+    assert st.has(live) and not st.has(dead)
+
+
+# -- tiler: WCS epochs, deltas, crash old-or-new ---------------------------
+
+
+def test_tile_epoch_wcs_skips_empty_tiles(tmp_path):
+    ts, man, prods = _tiled(tmp_path)
+    assert man["n_tiles"] == 4 and man["n_empty"] == 5
+    assert sorted(man["tiles"]) == ["b0/0", "b0/1", "b0/3", "b0/4"]
+    assert man["products"] == sorted(prods)
+    assert man["pixelization"]["kind"] == "wcs"
+    assert man["pixelization"]["cards"]["CRVAL1"] == CARDS["CRVAL1"]
+    assert man["total_bytes"] == sum(v[1] for v in man["tiles"].values())
+    assert ts.current() == 1 and ts.latest() == 1
+    assert ts.read_tile(man, 0, 8) is None  # empty: absence IS zero
+    tile = ts.read_tile(man, 0, 0)
+    assert np.array_equal(tile["products"]["DESTRIPED"],
+                          prods["DESTRIPED"][:32, :32])
+
+
+def test_tile_epoch_is_idempotent(tmp_path):
+    from comapreduce_tpu.tiles.tiler import tile_epoch
+
+    ts, man, _ = _tiled(tmp_path)
+    ep = os.path.join(str(tmp_path / "epochs"), "epoch-000001")
+    man2 = tile_epoch(ep, ts.root, tile_px=TILE)
+    assert man2["tiles"] == man["tiles"]  # same content, same hashes
+
+
+def test_delta_is_exact_manifest_diff(tmp_path):
+    from comapreduce_tpu.tiles.tiler import tile_epoch
+
+    ts, man1, prods = _tiled(tmp_path)
+    # epoch 2: touch only tile 0, empty out tile 4 — the delta must
+    # name exactly those, and the untouched tiles keep their hashes
+    p2 = {k: v.copy() for k, v in prods.items()}
+    p2["DESTRIPED"][:8, :8] += 1.0
+    for v in p2.values():
+        v[32:40, 32:40] = 0.0
+    ep2 = _publish_wcs_epoch(tmp_path / "epochs", 2, p2,
+                             census=("a.hd5", "b.hd5"))
+    man2 = tile_epoch(ep2, ts.root, tile_px=TILE)
+    d = ts.delta(2)
+    assert set(d["changed"]) == {"b0/0"} and d["removed"] == ["b0/4"]
+    assert d["n_unchanged"] == 2 and d["prev"] == 1
+    assert d["changed_bytes"] == man2["tiles"]["b0/0"][1]
+    for key in ("b0/1", "b0/3"):
+        assert man2["tiles"][key] == man1["tiles"][key]
+    assert ts.current() == 2
+
+
+def test_chaos_kill_leaves_old_manifest(tmp_path):
+    from comapreduce_tpu.tiles.tiler import TileSet, tile_epoch
+
+    class _Boom:
+        def maybe_kill_publish(self, key):
+            raise RuntimeError(f"simulated SIGKILL at {key}")
+
+    ts, man1, prods = _tiled(tmp_path)
+    p2 = {k: v.copy() for k, v in prods.items()}
+    p2["DESTRIPED"][:8, :8] += 1.0
+    ep2 = _publish_wcs_epoch(tmp_path / "epochs", 2, p2)
+    with pytest.raises(RuntimeError):
+        tile_epoch(ep2, ts.root, tile_px=TILE, chaos=_Boom())
+    # the kill window is after object writes, before the manifest:
+    # readers still see epoch 1 whole (old-or-new, never torn)
+    ts = TileSet(ts.root)
+    assert ts.manifest(2) is None and ts.delta(2) is None
+    assert ts.current() == 1 and ts.latest() == 1
+    man2 = tile_epoch(ep2, ts.root, tile_px=TILE)  # resume repairs
+    assert ts.current() == 2 and set(ts.delta(2)["changed"]) == {"b0/0"}
+    assert man2["tiles"]["b0/1"] == man1["tiles"]["b0/1"]
+
+
+def test_set_current_refuses_backwards_without_force(tmp_path):
+    from comapreduce_tpu.tiles.tiler import tile_epoch
+
+    ts, _, prods = _tiled(tmp_path)
+    ep2 = _publish_wcs_epoch(tmp_path / "epochs", 2, prods)
+    tile_epoch(ep2, ts.root, tile_px=TILE)
+    with pytest.raises(ValueError):
+        ts.set_current(1)
+    ts.set_current(1, force=True)  # the rollback path
+    assert ts.current() == 1 and ts.latest() == 2
+    with pytest.raises(ValueError):
+        ts.set_current(99)  # not tiled
+
+
+def test_is_tile_source(tmp_path):
+    from comapreduce_tpu.tiles.tiler import is_tile_source
+
+    ts, _, _ = _tiled(tmp_path)
+    assert is_tile_source(ts.root)
+    assert is_tile_source(ts.manifest_path(1))
+    assert not is_tile_source(ts.delta_path(1))  # delta is not a source
+    assert not is_tile_source(str(tmp_path / "epochs"))
+    assert not is_tile_source(str(tmp_path / "nope.fits"))
+    other = tmp_path / "other.json"
+    other.write_text('{"kind": "something-else"}')
+    assert not is_tile_source(str(other))
+
+
+# -- tiler + cutout: HEALPix ----------------------------------------------
+
+
+def _healpix_epoch(tmp_path, seed=4, nside=16, n_seen=120):
+    from comapreduce_tpu.mapmaking.fits_io import write_healpix_map
+
+    rng = np.random.default_rng(seed)
+    npix = 12 * nside * nside
+    ring = np.sort(rng.choice(npix, n_seen, replace=False))
+    maps = {"DESTRIPED": rng.normal(size=n_seen).astype(np.float32),
+            "WEIGHTS": rng.uniform(0.5, 2.0,
+                                   size=n_seen).astype(np.float32),
+            "HITS": rng.integers(1, 9, size=n_seen).astype(np.float32)}
+    d = os.path.join(str(tmp_path), "epochs", "epoch-000001")
+    os.makedirs(d, exist_ok=True)
+    write_healpix_map(os.path.join(d, "map_band0.fits"), maps, ring,
+                      nside)
+    man = {"schema": 1, "epoch": 1, "census": ["a.hd5"], "n_files": 1,
+           "maps": ["map_band0.fits"]}
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(man, f)
+    return d, ring, maps, nside
+
+
+def test_tile_epoch_healpix_budget_and_reconstruct(tmp_path):
+    from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+    from comapreduce_tpu.tiles.cutout import reconstruct_hdus
+    from comapreduce_tpu.tiles.tiler import (TileSet, tile_budget_bytes,
+                                             tile_epoch)
+
+    ep, ring, maps, nside = _healpix_epoch(tmp_path)
+    root = str(tmp_path / "tiles")
+    man = tile_epoch(ep, root, tile_nside=2)
+    space = PixelSpace.from_pixels(ring, 12 * nside * nside)
+    budget, n_tiles = tile_budget_bytes(space, 2, n_products=len(maps))
+    # the perf gate's contract: the sparse tile count falls straight
+    # out of the PixelSpace, and the bytes stay under the exact-payload
+    # + header-bound ceiling — machine-independent on both sides
+    assert man["n_tiles"] == n_tiles
+    assert man["total_bytes"] <= budget
+    assert man["pixelization"] == {"kind": "healpix", "nside": nside,
+                                   "ordering": "RING", "tile_nside": 2}
+    # round trip: the reassembled partial map is the source, bit-for-bit
+    hdus = reconstruct_hdus(root)
+    got = {nm: arr for nm, _, arr in hdus}
+    assert np.array_equal(got["PIXELS"], ring)
+    for nm, vals in maps.items():
+        assert np.array_equal(got[nm], vals)
+    ts = TileSet(root)
+    with pytest.raises(ValueError):  # no rectangles on a sphere tiling
+        from comapreduce_tpu.tiles.cutout import assemble_cutout
+
+        assemble_cutout(ts, man, 0, 0, 4, 4)
+
+
+def test_assemble_healpix_single_tile_slice(tmp_path):
+    from comapreduce_tpu.mapmaking.healpix import ring2nest
+    from comapreduce_tpu.tiles import layout
+    from comapreduce_tpu.tiles.cutout import assemble_healpix
+    from comapreduce_tpu.tiles.tiler import TileSet, tile_epoch
+
+    ep, ring, maps, nside = _healpix_epoch(tmp_path)
+    man = tile_epoch(ep, str(tmp_path / "tiles"), tile_nside=2)
+    ts = TileSet(str(tmp_path / "tiles"))
+    nest = np.asarray(ring2nest(nside, ring), np.int64)
+    tids = layout.healpix_tile_of(nest, nside, 2)
+    tid = int(tids[0])
+    sel = tids == tid
+    pix, got = assemble_healpix(ts, man, [tid])
+    assert np.array_equal(pix, ring[sel])
+    assert np.array_equal(got["DESTRIPED"], maps["DESTRIPED"][sel])
+    # unknown/empty tile ids contribute nothing
+    empty_pix, empty = assemble_healpix(ts, man, [10 ** 6])
+    assert empty_pix.size == 0 and empty["DESTRIPED"].size == 0
+
+
+# -- cutout: WCS bit-identity ----------------------------------------------
+
+
+def test_cutout_bit_identical_to_field_slice(tmp_path):
+    from comapreduce_tpu.tiles.cutout import assemble_cutout
+
+    ts, man, prods = _tiled(tmp_path)
+    # crosses tile boundaries and reaches into the empty region
+    x0, y0, w, h = 20, 25, 60, 30
+    for nm, arr in prods.items():
+        cut = assemble_cutout(ts, man, x0, y0, w, h, product=nm)
+        assert np.array_equal(cut, arr[y0:y0 + h, x0:x0 + w])
+    full = assemble_cutout(ts, man, 0, 0, NX, NY)
+    assert np.array_equal(full, prods["DESTRIPED"])
+    # a box entirely over empty tiles comes back exact zeros
+    assert not np.any(assemble_cutout(ts, man, 70, 70, 10, 10))
+
+
+def test_cutout_rejects_bad_boxes(tmp_path):
+    from comapreduce_tpu.tiles.cutout import assemble_cutout
+
+    ts, man, _ = _tiled(tmp_path)
+    with pytest.raises(ValueError):
+        assemble_cutout(ts, man, -1, 0, 4, 4)
+    with pytest.raises(ValueError):
+        assemble_cutout(ts, man, NX - 2, 0, 4, 4)  # past the field
+    with pytest.raises(ValueError):
+        assemble_cutout(ts, man, 0, 0, 0, 4)  # empty box
+    with pytest.raises(ValueError):
+        assemble_cutout(ts, man, 0, 0, 4, 4, product="NOPE")
+
+
+def test_cutout_blob_is_deterministic(tmp_path):
+    from comapreduce_tpu.tiles.blob import decode_tile
+    from comapreduce_tpu.tiles.cutout import cutout_blob
+
+    ts, man, prods = _tiled(tmp_path)
+    b1 = cutout_blob(ts, man, 5, 9, 37, 21)
+    b2 = cutout_blob(ts, man, 5, 9, 37, 21)
+    assert b1 == b2  # content-hash ETags depend on this
+    out = decode_tile(b1)
+    assert sorted(out["products"]) == sorted(prods)
+    only = decode_tile(cutout_blob(ts, man, 5, 9, 37, 21,
+                                   products=["WEIGHTS"]))
+    assert list(only["products"]) == ["WEIGHTS"]
+
+
+def test_reconstruct_hdus_wcs_matches_source(tmp_path):
+    from comapreduce_tpu.tiles.cutout import reconstruct_hdus
+
+    ts, man, prods = _tiled(tmp_path)
+    hdus = reconstruct_hdus(ts.root)
+    assert [nm for nm, _, _ in hdus] == sorted(prods)
+    for nm, hdr, arr in hdus:
+        assert np.array_equal(arr, prods[nm])
+        assert hdr["CRVAL1"] == CARDS["CRVAL1"]
+
+
+def test_coadd_accepts_tile_source(tmp_path):
+    from comapreduce_tpu.mapmaking.coadd import coadd_fits_files
+
+    ts, man, _ = _tiled(tmp_path)
+    fits = os.path.join(str(tmp_path / "epochs"), "epoch-000001",
+                        "map_band0.fits")
+    ref = coadd_fits_files([fits], str(tmp_path / "ref.fits"))
+    out = coadd_fits_files([ts.root], str(tmp_path / "out.fits"))
+    for nm in ref:
+        assert np.array_equal(out[nm], ref[nm])
+
+
+# -- http: the cache contract ----------------------------------------------
+
+
+@pytest.fixture()
+def tile_http(tmp_path):
+    from comapreduce_tpu.tiles.http import TileServer
+    from comapreduce_tpu.tiles.tiler import tile_epoch
+
+    ts, man1, prods = _tiled(tmp_path)
+    p2 = {k: v.copy() for k, v in prods.items()}
+    p2["DESTRIPED"][:8, :8] += 1.0
+    ep2 = _publish_wcs_epoch(tmp_path / "epochs", 2, p2,
+                             census=("a.hd5", "b.hd5"))
+    tile_epoch(ep2, ts.root, tile_px=TILE)
+    server = TileServer(ts.root, port=0).start()
+    yield server, ts, man1, prods
+    server.stop()
+
+
+def _fetch(server, url, etag=None, method="GET"):
+    rq = urllib.request.Request(
+        f"http://{server.host}:{server.port}{url}", method=method)
+    if etag:
+        rq.add_header("If-None-Match", etag)
+    try:
+        with urllib.request.urlopen(rq, timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_http_current_is_mutable_with_validator(tile_http):
+    server, ts, _, _ = tile_http
+    st, hdrs, body = _fetch(server, "/v1/current")
+    assert st == 200 and hdrs["Cache-Control"] == "no-cache"
+    obj = json.loads(body)
+    assert obj["epoch"] == 2 and obj["latest"] == 2
+    st, _, _ = _fetch(server, "/v1/current", etag=hdrs["ETag"])
+    assert st == 304
+    # rollback: the pointer's ETag changes, readers see it immediately
+    ts.set_current(1, force=True)
+    st, hdrs2, body = _fetch(server, "/v1/current", etag=hdrs["ETag"])
+    assert st == 200 and json.loads(body)["epoch"] == 1
+    assert hdrs2["ETag"] != hdrs["ETag"]
+
+
+def test_http_manifest_and_tiles_are_immutable(tile_http):
+    server, ts, man1, prods = tile_http
+    st, hdrs, raw = _fetch(server, "/v1/epochs/1/manifest.json")
+    assert st == 200 and "immutable" in hdrs["Cache-Control"]
+    assert json.loads(raw)["tiles"] == man1["tiles"]
+    st, _, _ = _fetch(server, "/v1/epochs/1/manifest.json",
+                      etag=hdrs["ETag"])
+    assert st == 304
+    digest = man1["tiles"]["b0/0"][0]
+    st, thdrs, blob = _fetch(server, f"/v1/tiles/{digest}")
+    assert st == 200 and "immutable" in thdrs["Cache-Control"]
+    assert ts.store.digest(blob) == digest  # ETags are content hashes
+    st, _, _ = _fetch(server, f"/v1/tiles/{digest}", etag=thdrs["ETag"])
+    assert st == 304
+    # epoch-addressed URLs keep validating across a rollback — a
+    # pinned reader's warm cache survives the pointer swap
+    ts.set_current(1, force=True)
+    st, _, _ = _fetch(server, "/v1/epochs/2/manifest.json")
+    assert st == 200
+    st, _, _ = _fetch(server, f"/v1/tiles/{digest}", etag=thdrs["ETag"])
+    assert st == 304
+
+
+def test_http_cutout_delta_and_errors(tile_http):
+    from comapreduce_tpu.tiles.blob import decode_tile
+
+    server, ts, _, prods = tile_http
+    st, hdrs, blob = _fetch(server,
+                            "/v1/epochs/1/cutout?x0=20&y0=25&w=60&h=30")
+    assert st == 200
+    out = decode_tile(blob)
+    for nm, arr in prods.items():
+        assert np.array_equal(out["products"][nm], arr[25:55, 20:80])
+    st, _, _ = _fetch(server, "/v1/epochs/1/cutout?x0=20&y0=25&w=60&h=30",
+                      etag=hdrs["ETag"])
+    assert st == 304
+    st, _, body = _fetch(server, "/v1/epochs/2/delta.json")
+    assert st == 200 and set(json.loads(body)["changed"]) == {"b0/0"}
+    for bad, want in [("/v1/epochs/1/cutout?x0=0&y0=0&w=4", 400),
+                      ("/v1/epochs/1/cutout?x0=0&y0=0&w=-4&h=4", 400),
+                      ("/v1/epochs/1/cutout?x0=0&y0=0&w=4&h=oops", 400),
+                      ("/v1/epochs/99/manifest.json", 404),
+                      ("/v1/tiles/deadbeef", 400),
+                      ("/v1/tiles/" + "0" * 64, 404),
+                      ("/v1/nope", 404),
+                      ("/v1/epochs/zzz/meta", 400)]:
+        st, _, body = _fetch(server, bad)
+        assert st == want, f"{bad}: got {st}, want {want}"
+        assert "error" in json.loads(body)
+
+
+def test_http_status_meta_and_head(tile_http):
+    server, _, man1, _ = tile_http
+    st, _, body = _fetch(server, "/v1/epochs")
+    assert st == 200 and json.loads(body)["epochs"] == [1, 2]
+    st, _, body = _fetch(server, "/v1/epochs/epoch-000001/meta")
+    meta = json.loads(body)
+    assert st == 200 and "tiles" not in meta
+    assert meta["n_tiles"] == man1["n_tiles"]
+    st, hdrs, body = _fetch(server, "/v1/epochs/1/manifest.json",
+                            method="HEAD")
+    assert st == 200 and body == b"" and int(hdrs["Content-Length"]) > 0
+    st, _, body = _fetch(server, "/v1/status")
+    obj = json.loads(body)
+    assert obj["current"] == 2 and obj["tiled_epochs"] == 2
+    # the status body snapshots stats BEFORE its own request accounts
+    assert obj["http"]["n_requests"] == 3
+
+
+# -- serving satellites: retraction, downdated epochs, hooks, lanes --------
+
+
+def test_ledger_retract_survives_reload_and_readmit(tmp_path):
+    from comapreduce_tpu.serving.ledger import ServedLedger
+
+    path = str(tmp_path / "served.jsonl")
+    led = ServedLedger(path)
+    led.admit("a.hd5", "/d/a.hd5")
+    led.admit("b.hd5", "/d/b.hd5")
+    assert led.retract("b.hd5")
+    assert not led.retract("b.hd5")  # already out
+    assert led.files == {"a.hd5"} and led.retracted == {"b.hd5"}
+    led2 = ServedLedger(path)  # the eviction is durable
+    assert led2.files == {"a.hd5"} and led2.retracted == {"b.hd5"}
+    # only an EXPLICIT admit brings a retracted file back
+    assert led2.admit("b.hd5", "/d/b.hd5")
+    assert led2.files == {"a.hd5", "b.hd5"} and led2.retracted == set()
+    led3 = ServedLedger(path)
+    assert led3.files == {"a.hd5", "b.hd5"} and led3.retracted == set()
+
+
+def _publish(store, census, downdated=False):
+    def write_products(tmpdir):
+        with open(os.path.join(tmpdir, "map_band0.fits"), "wb") as f:
+            f.write(b"x")
+        return {"maps": ["map_band0.fits"]}
+
+    return store.publish(sorted(census), write_products,
+                         downdated=downdated)
+
+
+def test_downdated_publish_relaxes_the_fence(tmp_path):
+    from comapreduce_tpu.serving.epochs import (EpochFenceError,
+                                                EpochStore)
+
+    store = EpochStore(str(tmp_path))
+    assert _publish(store, {"a", "b"}) == 1
+    with pytest.raises(EpochFenceError):  # strict growth for normal
+        _publish(store, {"a", "b"})
+    with pytest.raises(EpochFenceError):  # downdate must CHANGE it
+        _publish(store, {"a", "b"}, downdated=True)
+    n = _publish(store, {"a"}, downdated=True)
+    assert n == 2 and store.census(2) == {"a"}
+    assert store.manifest(2)["downdated"] is True
+    assert "downdated" not in store.manifest(1)
+    # and the strict fence resumes from the shrunken census
+    assert _publish(store, {"a", "c"}) == 3
+
+
+def test_publish_hooks_run_and_failures_are_isolated(tmp_path):
+    from comapreduce_tpu.serving.epochs import EpochStore
+
+    store = EpochStore(str(tmp_path))
+    calls = []
+
+    def bad_hook(n, epoch_dir, man):
+        raise RuntimeError("tiler exploded")
+
+    def good_hook(n, epoch_dir, man):
+        calls.append((n, os.path.basename(epoch_dir),
+                      sorted(man["census"])))
+
+    store.add_publish_hook(bad_hook)
+    store.add_publish_hook(good_hook)
+    assert _publish(store, {"a"}) == 1  # the bad hook cannot unpublish
+    assert calls == [(1, "epoch-000001", ["a"])]
+    assert store.current() == 1
+
+
+def test_cleanup_tmp_age_guard(tmp_path):
+    from comapreduce_tpu.serving.epochs import EpochStore
+
+    store = EpochStore(str(tmp_path))
+    young = os.path.join(str(tmp_path), ".tmp-epoch.123")
+    os.makedirs(young)
+    assert store.cleanup_tmp(min_age_s=3600.0) == 0  # spared: too young
+    assert os.path.isdir(young)
+    assert store.cleanup_tmp() == 1  # no guard: swept
+    assert not os.path.exists(young)
+
+
+def test_serving_lane_rank_auto_increments(tmp_path):
+    from comapreduce_tpu.telemetry import (SERVING_LANE_BASE,
+                                           serving_lane_rank)
+
+    d = str(tmp_path)
+    assert SERVING_LANE_BASE == 1000
+    assert serving_lane_rank(d) == 1000
+    for name in ("events.rank0.jsonl", "events.rank3.jsonl",
+                 "events.rank1000.jsonl", "events.rank1002.jsonl",
+                 "events.rank17.jsonl.bak", "notes.txt"):
+        (tmp_path / name).touch()
+    # reducer ranks (0..999) and junk never collide with the lane;
+    # the next stream is one past the highest existing lane rank
+    assert serving_lane_rank(d) == 1003
+    assert serving_lane_rank(str(tmp_path / "missing")) == 1000

@@ -64,6 +64,19 @@ three commit waves) must show the final WARM epoch converging in
 strictly fewer CG iterations than a cold solve of the same census.
 Machine-independent (an ordering of two iteration counts on one
 deterministic fixture); ``--no-serving`` skips it.
+
+The tile-tier gate (ISSUE 12) also runs by default, in-process (no
+bench child — tiling is pure index math + file I/O): two synthetic
+epochs differing on one tile are cut into a tiles root, and (a) a
+reader refreshing via the delta must fetch strictly fewer tiles and
+strictly fewer bytes than a full re-download (delta manifest smaller
+than the full manifest too — refresh cost scales with the CHANGE, not
+the field), and (b) a sparse HEALPix epoch's tile bytes must stay
+under ``tile_budget_bytes``'s exact-payload + header-bound ceiling
+with the tile count EQUAL to the ``PixelSpace``-derived sparse count
+(empty sky must cost nothing). Both halves are byte/count comparisons
+of one deterministic fixture against itself — machine-independent;
+``--no-tiles`` skips.
 """
 
 from __future__ import annotations
@@ -207,6 +220,88 @@ def run_serving_bench() -> dict:
     raise RuntimeError("no serving result line in bench.py output")
 
 
+def run_tiles_gate() -> dict:
+    """The ISSUE 12 tile-tier numbers, computed in-process on a
+    deterministic synthetic fixture (no jax, no subprocess)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from comapreduce_tpu.mapmaking.fits_io import (write_fits_image,
+                                                   write_healpix_map)
+    from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
+    from comapreduce_tpu.tiles.tiler import (TileSet, tile_budget_bytes,
+                                             tile_epoch)
+
+    work = tempfile.mkdtemp(prefix="check_perf_tiles_")
+    try:
+        def publish(n, products, kind, **hp):
+            d = os.path.join(work, kind, f"epoch-{n:06d}")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, "map_band0.fits")
+            if kind == "wcs":
+                write_fits_image(path, products,
+                                 header={"CRVAL1": 170.25,
+                                         "CDELT1": 1.0 / 60})
+            else:
+                write_healpix_map(path, products, hp["pixels"],
+                                  hp["nside"])
+            with open(os.path.join(d, "manifest.json"), "w") as f:
+                json.dump({"schema": 1, "epoch": n,
+                           "census": [f"f{i}" for i in range(n)],
+                           "n_files": n,
+                           "maps": ["map_band0.fits"]}, f)
+            return d
+
+        # -- WCS: two epochs differing on ONE 64px tile of a 256^2
+        # field — the delta side of the gate
+        rng = np.random.default_rng(12)
+        base = {nm: rng.normal(size=(256, 256)).astype(np.float32)
+                for nm in ("DESTRIPED", "WEIGHTS", "HITS")}
+        ep1 = publish(1, base, "wcs")
+        bumped = {k: v.copy() for k, v in base.items()}
+        bumped["DESTRIPED"][:32, :32] += 1.0  # inside tile 0 only
+        ep2 = publish(2, bumped, "wcs")
+        root = os.path.join(work, "tiles-wcs")
+        tile_epoch(ep1, root, tile_px=64)
+        man2 = tile_epoch(ep2, root, tile_px=64)
+        ts = TileSet(root)
+        delta = ts.delta(2)
+        wcs = {
+            "n_tiles": int(man2["n_tiles"]),
+            "total_bytes": int(man2["total_bytes"]),
+            "delta_changed": int(delta["n_changed"]),
+            "delta_removed": int(delta["n_removed"]),
+            "delta_bytes": int(delta["changed_bytes"]),
+            "full_manifest_bytes": os.path.getsize(ts.manifest_path(2)),
+            "delta_manifest_bytes": os.path.getsize(ts.delta_path(2)),
+        }
+
+        # -- HEALPix: a sparse partial map — the byte-budget side
+        nside = 64
+        npix = 12 * nside * nside
+        ring = np.sort(rng.choice(npix, 2000, replace=False))
+        maps = {nm: rng.normal(size=ring.size).astype(np.float32)
+                for nm in ("DESTRIPED", "NAIVE", "WEIGHTS", "HITS")}
+        eph = publish(1, maps, "healpix", pixels=ring, nside=nside)
+        manh = tile_epoch(eph, os.path.join(work, "tiles-hp"),
+                          tile_nside=8)
+        space = PixelSpace.from_pixels(ring, npix)
+        budget, n_expected = tile_budget_bytes(space, 8,
+                                               n_products=len(maps))
+        hp = {
+            "n_tiles": int(manh["n_tiles"]),
+            "n_expected": int(n_expected),
+            "total_bytes": int(manh["total_bytes"]),
+            "budget_bytes": int(budget),
+            "n_compact": int(space.n_compact),
+        }
+        return {"wcs": wcs, "healpix": hp}
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 #: compacted-path memory budget multiplier: the exact device footprint
 #: of the four map products is 4 B x (3 n_bands + 1) x n_compact
 #: (per-band destriped/naive/weight + shared hits); the gate allows 2x
@@ -258,6 +353,8 @@ def main(argv=None) -> int:
                     help="skip the serving warm-start gate")
     ap.add_argument("--no-kernels", action="store_true",
                     help="skip the fused-kernel pass-budget/parity gate")
+    ap.add_argument("--no-tiles", action="store_true",
+                    help="skip the tile-tier delta/byte-budget gate")
     args = ap.parse_args(argv)
 
     best: dict | None = None
@@ -480,10 +577,41 @@ def main(argv=None) -> int:
                 f"kernels: converged-offset drift "
                 f"{kernels['offsets_parity_maxdiff']:.3g} > 5e-3 "
                 f"between kernels=xla and kernels={impl}")
+    tiles = None
+    if not args.no_tiles:
+        # machine-independent on both sides (ISSUE 12): byte and count
+        # comparisons of one deterministic tile fixture against itself
+        tiles = run_tiles_gate()
+        w, hp = tiles["wcs"], tiles["healpix"]
+        if not (w["delta_changed"] < w["n_tiles"]
+                and w["delta_bytes"] < w["total_bytes"]):
+            failures.append(
+                f"tiles: a one-tile change produced a delta of "
+                f"{w['delta_changed']}/{w['n_tiles']} tiles "
+                f"({w['delta_bytes']}/{w['total_bytes']} bytes) — "
+                "refresh cost no longer scales with the change (blob "
+                "encoding picked up nondeterminism?)")
+        if w["delta_manifest_bytes"] >= w["full_manifest_bytes"]:
+            failures.append(
+                f"tiles: the delta manifest ({w['delta_manifest_bytes']}"
+                f" B) is not smaller than the full manifest "
+                f"({w['full_manifest_bytes']} B) — incremental refresh "
+                "pays the full index anyway")
+        if hp["n_tiles"] != hp["n_expected"]:
+            failures.append(
+                f"tiles: {hp['n_tiles']} HEALPix tiles materialised but "
+                f"the PixelSpace dictionary implies {hp['n_expected']} "
+                "— empty sky is being tiled (or coverage dropped)")
+        if hp["total_bytes"] > hp["budget_bytes"]:
+            failures.append(
+                f"tiles: sparse tile set costs {hp['total_bytes']} B > "
+                f"the exact-payload + header budget "
+                f"{hp['budget_bytes']} B for {hp['n_compact']} seen "
+                "pixels — tile bytes stopped scaling with coverage")
     print(json.dumps({"ok": not failures, "failures": failures,
                       "current": cur, "campaign": campaign,
                       "destriper": destriper, "serving": serving,
-                      "kernels": kernels,
+                      "kernels": kernels, "tiles": tiles,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))

@@ -4,7 +4,8 @@
 Usage::
 
     python tools/check_resilience.py [--workdir DIR] [--seed N] [--keep]
-                                     [--elastic-only | --serving-only]
+                                     [--elastic-only | --serving-only
+                                      | --tiles-only]
 
 Injects one fault of every class (read error, truncated file,
 first-attempt flake, NaN burst, slow read, HANGING read) over a
@@ -35,6 +36,18 @@ from per-file incremental aggregates byte-identical to a batch
 read+solve, and a warm-started epoch converging in strictly fewer CG
 iterations than a cold solve of the same census (maps agreeing modulo
 the weighted-mean null mode).
+
+``--tiles-only`` runs criterion 9: the map tile read tier drill
+(``run_tiles_drill`` — server subprocesses tiling published epochs
+into a content-addressed root, a real ``tools/tile_server.py`` HTTP
+front), asserting a SIGKILL between tile object writes and the
+manifest rename leaves readers on the previous complete tile set
+(old-or-new, never torn), the CLI backfill + fresh-root re-tile is
+byte-identical (deterministic encoding; exact deltas), an HTTP cutout
+is bit-identical to slicing the expanded epoch FITS with 304s
+surviving a ``/v1/current`` rollback, each serving process takes its
+own telemetry lane, and ``MapServer.evict`` reproduces the
+pre-eviction epoch's tile hashes exactly.
 
 Prints one JSON evidence line; non-zero exit (with the broken
 criterion named) on any failure. Also wired into CI as ``bench.py
@@ -68,14 +81,19 @@ def main(argv=None) -> int:
     only.add_argument("--serving-only", action="store_true",
                       help="run only criterion 8 (the incremental "
                       "map-server kill/resume/warm-start drill)")
+    only.add_argument("--tiles-only", action="store_true",
+                      help="run only criterion 9 (the map tile read "
+                      "tier kill/backfill/HTTP/evict drill)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from comapreduce_tpu.resilience.drill import (run_drill,
                                                   run_elastic_drill,
-                                                  run_serving_drill)
+                                                  run_serving_drill,
+                                                  run_tiles_drill)
 
-    drill = (run_serving_drill if args.serving_only
+    drill = (run_tiles_drill if args.tiles_only
+             else run_serving_drill if args.serving_only
              else run_elastic_drill if args.elastic_only else run_drill)
     workdir = args.workdir or tempfile.mkdtemp(prefix="check_resilience_")
     try:

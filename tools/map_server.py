@@ -53,12 +53,18 @@ def cmd_serve(args) -> int:
     from comapreduce_tpu.telemetry import TELEMETRY
 
     if args.telemetry:
+        from comapreduce_tpu.telemetry import serving_lane_rank
+
         # the server shares the campaign's state dir, so its epoch
         # spans land next to the reducer ranks' streams and merge into
-        # one timeline under tools/campaign_report.py; rank 1000 is the
-        # serving lane — a reducer rank would collide on the same
-        # stream file (span ids are per-process)
-        TELEMETRY.configure(args.state_dir, rank=1000)
+        # one timeline under tools/campaign_report.py; ranks >= 1000
+        # are the serving lane, and each serving process (map server,
+        # tile server, restarts of either) takes the next free stream
+        # — two writers on one stream would interleave span ids
+        rank = args.telemetry_rank
+        if rank is None:
+            rank = serving_lane_rank(args.state_dir)
+        TELEMETRY.configure(args.state_dir, rank=rank)
     wcs = None
     if args.nside is None:
         if not (args.crval and args.cdelt and args.shape):
@@ -81,7 +87,8 @@ def cmd_serve(args) -> int:
         use_calibration=not args.no_calibration,
         tod_variant=args.tod_variant, warm_start=not args.cold,
         checkpoint_every=args.checkpoint_every,
-        min_new_files=args.min_new_files, poll_s=args.poll_s)
+        min_new_files=args.min_new_files, poll_s=args.poll_s,
+        tiles_root=args.tiles_dir)
     published = server.serve(
         max_epochs=args.max_epochs, idle_exit_s=args.idle_exit_s,
         max_wall_s=args.max_wall_s)
@@ -190,6 +197,12 @@ def main(argv=None) -> int:
     s.add_argument("--telemetry", action="store_true",
                    help="emit serving.epoch spans into the campaign's "
                    "state dir (merge with tools/campaign_report.py)")
+    s.add_argument("--telemetry-rank", type=int, default=None,
+                   help="serving-lane telemetry rank (default: next "
+                   "free stream >= 1000 in the state dir)")
+    s.add_argument("--tiles-dir", default="",
+                   help="also tile every published epoch into this "
+                   "tiles root (the HTTP read tier's content store)")
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("status", help="current epoch + staleness")

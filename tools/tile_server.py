@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Operate the map tile read tier (``comapreduce_tpu.tiles``).
+
+Subcommands::
+
+    serve     run the HTTP tile server over one tiles root: epoch
+              manifests, content-addressed tiles, sky cutouts
+    status    one-line health of a tiles root: current epoch, tile
+              count, bytes, delta sizes
+    tile      cut published epoch(s) into the tiles root by hand
+              (the map server does this automatically with
+              ``--tiles-dir``; this is the backfill/repair path)
+
+Examples::
+
+    python tools/tile_server.py tile --epochs-dir run/epochs \\
+        --tiles-dir run/tiles
+    python tools/tile_server.py serve --tiles-dir run/tiles \\
+        --port 8080 --epochs-dir run/epochs
+    python tools/tile_server.py status --tiles-dir run/tiles --json
+
+``serve`` is read-only over immutable content — any number of tile
+servers (and HTTP caches in front of them) can share one tiles root.
+``status`` imports no jax and returns instantly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _add_tiles_dir(ap):
+    ap.add_argument("--tiles-dir", required=True,
+                    help="tiles root (objects/ + manifests/)")
+
+
+def cmd_serve(args) -> int:
+    from comapreduce_tpu.telemetry import TELEMETRY, serving_lane_rank
+    from comapreduce_tpu.tiles.http import TileServer
+
+    if args.telemetry_dir:
+        # same stream layout as the map server: the tile server is a
+        # serving-lane rank in the campaign's telemetry dir, on its
+        # own stream so it never collides with the map server's
+        rank = args.telemetry_rank
+        if rank is None:
+            rank = serving_lane_rank(args.telemetry_dir)
+        TELEMETRY.configure(args.telemetry_dir, rank=rank)
+    server = TileServer(args.tiles_dir, host=args.host, port=args.port,
+                        epochs_root=args.epochs_dir or None)
+    # the bound port on stdout FIRST: with --port 0 (tests/drills) the
+    # parent reads it from our output
+    print(f"tile-server: listening on http://{server.host}:"
+          f"{server.port}/ (root {args.tiles_dir})", flush=True)
+    if args.max_wall_s is not None:
+        server.start()
+        time.sleep(float(args.max_wall_s))
+        server.stop()
+    else:
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+    st = server.status()
+    print(f"tile-server: served {st['http']['n_requests']} request(s), "
+          f"{st['http']['bytes_sent']} byte(s)")
+    return 0
+
+
+def cmd_status(args) -> int:
+    from comapreduce_tpu.tiles.tiler import TileSet
+
+    ts = TileSet(args.tiles_dir)
+    cur = ts.current()
+    if cur is None:
+        print(f"{args.tiles_dir}: no tiled epoch yet")
+        return 1
+    man = ts.manifest(cur) or {}
+    stale = time.time() - float(man.get("t_publish_unix", 0.0))
+    line = (f"current epoch-{cur:06d}: {man.get('n_tiles', '?')} tiles "
+            f"({man.get('n_empty', 0)} empty skipped), "
+            f"{man.get('total_bytes', 0)} bytes, "
+            f"tiled {stale:.0f}s ago")
+    delta = ts.delta(cur) or {}
+    if delta.get("prev") is not None:
+        line += (f"; delta vs epoch-{delta['prev']:06d}: "
+                 f"{delta.get('n_changed', '?')} changed / "
+                 f"{delta.get('n_removed', '?')} removed "
+                 f"({delta.get('changed_bytes', 0)} bytes)")
+    print(line)
+    if args.json:
+        out = {"current": cur, "tiled": ts.list_tiled(),
+               "manifest": {k: v for k, v in man.items()
+                            if k != "tiles"},
+               "delta": delta}
+        print(json.dumps(out, indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_tile(args) -> int:
+    from comapreduce_tpu.serving.epochs import EpochStore
+    from comapreduce_tpu.tiles.tiler import TileSet, tile_epoch
+
+    store = EpochStore(args.epochs_dir)
+    ts = TileSet(args.tiles_dir)
+    if args.epoch is not None:
+        todo = [int(args.epoch)]
+    else:
+        tiled = set(ts.list_tiled())
+        todo = [n for n in store.list_epochs() if n not in tiled]
+    if not todo:
+        print("tile: nothing to do (every complete epoch is tiled)")
+        return 0
+    for n in todo:
+        man = tile_epoch(store.epoch_dir(n), args.tiles_dir,
+                         tile_px=args.tile_px,
+                         tile_nside=args.tile_nside)
+        print(f"tiled epoch-{n:06d}: {man['n_tiles']} tiles, "
+              f"{man['total_bytes']} bytes "
+              f"({man['t_tile_s']:.2f}s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="run the HTTP tile server")
+    _add_tiles_dir(s)
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8080,
+                   help="0 binds an ephemeral port (printed on stdout)")
+    s.add_argument("--epochs-dir", default="",
+                   help="source epochs root: enables /v1/epochs/N/meta "
+                   "solve metadata")
+    s.add_argument("--max-wall-s", type=float, default=None,
+                   help="exit after this long (drills; default: forever)")
+    s.add_argument("--telemetry-dir", default="",
+                   help="emit request counters/spans into this "
+                   "telemetry dir (the campaign state dir)")
+    s.add_argument("--telemetry-rank", type=int, default=None,
+                   help="serving-lane telemetry rank (default: next "
+                   "free stream >= 1000)")
+    s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("status", help="current tiled epoch + sizes")
+    _add_tiles_dir(s)
+    s.add_argument("--json", action="store_true",
+                   help="also dump manifests summary JSON")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("tile", help="tile published epoch(s) by hand")
+    _add_tiles_dir(s)
+    s.add_argument("--epochs-dir", required=True,
+                   help="source epochs root")
+    s.add_argument("--epoch", type=int, default=None,
+                   help="one epoch number (default: every complete "
+                   "epoch not yet tiled)")
+    s.add_argument("--tile-px", type=int, default=64,
+                   help="WCS tile edge in pixels")
+    s.add_argument("--tile-nside", type=int, default=0,
+                   help="HEALPix tile grid nside (0 = nside/64)")
+    s.set_defaults(fn=cmd_tile)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
