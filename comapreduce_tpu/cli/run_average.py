@@ -13,6 +13,12 @@ TOML layout::
     [StageName]
     # per-stage kwargs
 
+    [precision]
+    # optional precision policy (docs/OPERATIONS.md §15): flows
+    # through Runner.from_config as PrecisionPolicy — e.g.
+    # tod_dtype = "bf16" streams Level-1 TOD at half the HBM/H2D
+    # bytes (accumulators and products stay f32)
+
 Multi-host sharding (reference: MPI rank filelist shard,
 ``run_average.py:38-39``): rank/n_ranks come from ``jax.process_index``
 when jax.distributed is initialised, else 0/1 (single host).

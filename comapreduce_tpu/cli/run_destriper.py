@@ -80,7 +80,7 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                     n_iter: int, threshold: float, n_groups: int = 0,
                     compact: bool = False, precond: str = "jacobi",
                     pair_batch: int | None = None, mg_smooth: int = 1,
-                    kernels: str = "auto"):
+                    kernels: str = "auto", cg_dot: str = "f32"):
     import functools
 
     import jax
@@ -98,7 +98,8 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                                        dense_maps=not compact,
                                        mg_smooth=mg_smooth,
                                        precond=precond,
-                                       kernels=kernels))
+                                       kernels=kernels,
+                                       cg_dot=cg_dot))
         if compact:
             return fn, np.asarray(plan.uniq_pixels)
         return fn
@@ -115,7 +116,8 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
     return _memoized(tag, pixels,
                      (int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups), str(precond),
-                      pair_batch, int(mg_smooth), str(kernels)), build)
+                      pair_batch, int(mg_smooth), str(kernels),
+                      str(cg_dot)), build)
 
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
@@ -125,7 +127,8 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                             with_coarse: bool = False,
                             precond: str = "jacobi",
                             pair_batch: int | None = None,
-                            kernels: str = "auto"):
+                            kernels: str = "auto",
+                            cg_dot: str = "f32"):
     """Memoized sharded solver (plans + ONE compiled shard_map program
     per pointing — bands share both). ``n_bands > 0`` builds the
     multi-RHS program (all bands in one CG); ``n_groups > 0`` the joint
@@ -145,7 +148,8 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                                             n_groups=n_groups,
                                             with_coarse=with_coarse,
                                             precond=precond,
-                                            kernels=kernels)
+                                            kernels=kernels,
+                                            cg_dot=cg_dot)
         return run, np.asarray(plans[0].uniq_global)
 
     return _memoized(f"sharded{n_bands}-g{n_groups}-c{int(with_coarse)}",
@@ -153,7 +157,7 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                      (n_shards, int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups),
                       bool(with_coarse), str(precond), pair_batch,
-                      str(kernels)), build)
+                      str(kernels), str(cg_dot)), build)
 
 
 def _shard_quantum(mesh, offset_length: int) -> int:
@@ -316,7 +320,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   medfilt_window=400, tod_variant="auto",
                   coarse_block=0, prefetch=0, cache=None,
                   resilience=None, precond="jacobi", pair_batch=None,
-                  mg=None, compact="auto", kernels="auto"):
+                  mg=None, compact="auto", kernels="auto",
+                  tod_dtype="f32", cg_dot="f32"):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
@@ -337,7 +342,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                            medfilt_window=medfilt_window,
                            tod_variant=tod_variant,
                            prefetch=prefetch, cache=cache,
-                           resilience=resilience, compact=compact)
+                           resilience=resilience, compact=compact,
+                           tod_dtype=tod_dtype)
     return data, solve_band(data, offset_length=offset_length,
                             n_iter=n_iter, threshold=threshold,
                             use_ground=use_ground, sharded=sharded,
@@ -345,7 +351,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                             watchdog=getattr(resilience, "watchdog",
                                              None),
                             unit=f"band{band}", precond=precond,
-                            pair_batch=pair_batch, mg=mg, kernels=kernels)
+                            pair_batch=pair_batch, mg=mg, kernels=kernels,
+                            cg_dot=cg_dot)
 
 
 def _watched_cg(solve, watchdog, unit: str):
@@ -369,7 +376,8 @@ def _watched_cg(solve, watchdog, unit: str):
 def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                use_ground=False, sharded=False, coarse_block=0,
                watchdog=None, unit="", precond="jacobi",
-               pair_batch=None, mg=None, x0=None, kernels="auto"):
+               pair_batch=None, mg=None, x0=None, kernels="auto",
+               cg_dot="f32"):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -421,7 +429,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                use_ground=use_ground, sharded=sharded,
                                coarse_block=coarse_block,
                                precond=precond, pair_batch=pair_batch,
-                               mg=mg, x0=x0, kernels=kernels),
+                               mg=mg, x0=x0, kernels=kernels,
+                               cg_dot=cg_dot),
             watchdog, unit)
     if sharded and mg is not None:
         # the sharded programs keep the two-level preconditioner: the
@@ -469,7 +478,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 mesh, data.tod, data.pixels, data.weights, data.npix,
                 offset_length=offset_length, n_iter=n_iter,
                 threshold=threshold, ground_ids=data.ground_ids,
-                az=data.az, n_groups=data.n_groups, precond=precond)
+                az=data.az, n_groups=data.n_groups, precond=precond,
+                cg_dot=cg_dot)
         else:
             import jax.numpy as jnp
 
@@ -490,7 +500,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 threshold,
                 n_groups=data.n_groups if gid_off is not None else 0,
                 with_coarse=use_coarse, precond=precond,
-                pair_batch=pair_batch, kernels=kernels)
+                pair_batch=pair_batch, kernels=kernels, cg_dot=cg_dot)
             if gid_off is not None:
                 if coarse_block:
                     logger.warning("coarse_precond: the sharded ground "
@@ -552,7 +562,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                     ground_ids=data.ground_ids[:n],
                     az=data.az[:n],
                     n_groups=data.n_groups,
-                    precond=precond, kernels=kernels))
+                    precond=precond, kernels=kernels, cg_dot=cg_dot))
         kwargs = {}
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
@@ -585,7 +595,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                  offset_length, n_iter, threshold,
                                  n_groups=data.n_groups, precond=precond,
                                  pair_batch=pair_batch,
-                                 mg_smooth=mg_smooth, kernels=kernels)
+                                 mg_smooth=mg_smooth, kernels=kernels,
+                                 cg_dot=cg_dot)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]),
                         ground_off=jnp.asarray(gid_off),
@@ -594,7 +605,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold,
                                  precond=precond, pair_batch=pair_batch,
-                                 mg_smooth=mg_smooth, kernels=kernels)
+                                 mg_smooth=mg_smooth, kernels=kernels,
+                                 cg_dot=cg_dot)
             if x0 is not None:
                 kwargs["x0"] = jnp.asarray(x0)
             result = fn(jnp.asarray(data.tod[:n]),
@@ -694,6 +706,12 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
         kw.get("precond", "jacobi"), int(kw.get("coarse_block", 0) or 0),
         int(mg.get("block", 0) or 0), offset_length, threshold,
         (int(data.tod.size) // offset_length) * offset_length))
+    if kw.get("cg_dot", "f32") != "f32":
+        # a compensated-dot solve follows a different iterate path —
+        # refuse to resume it from (or leave behind) an f32 snapshot.
+        # Appended only when NON-default so snapshots written before
+        # this knob existed keep loading byte-identically.
+        precond_id = f"{precond_id}|cgdot={kw['cg_dot']}"
     if precond_tag:
         precond_id = f"{precond_id}|{precond_tag}"
     snap = load_solver_checkpoint(checkpoint_path, precond_id=precond_id)
@@ -753,7 +771,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          prefetch=0, cache=None, resilience=None,
                          watchdog=None, precond="jacobi",
                          pair_batch=None, mg=None, compact="auto",
-                         kernels="auto"):
+                         kernels="auto", tod_dtype="f32", cg_dot="f32"):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -785,7 +803,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                              medfilt_window=medfilt_window,
                              tod_variant=tod_variant,
                              prefetch=prefetch, cache=cache,
-                             resilience=resilience, compact=compact)
+                             resilience=resilience, compact=compact,
+                             tod_dtype=tod_dtype)
              for b in bands]
     pix0 = np.asarray(datas[0].pixels)
     for d in datas[1:]:
@@ -819,7 +838,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
         run, uniq = _sharded_planned_solver(
             mesh, pix_host, npix, offset_length, n_iter, threshold,
             n_bands=nb, with_coarse=bool(coarse_block), precond=precond,
-            pair_batch=pair_batch, kernels=kernels)
+            pair_batch=pair_batch, kernels=kernels, cg_dot=cg_dot)
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
                 build_coarse_preconditioner, coarse_pattern)
@@ -896,7 +915,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                                threshold, compact=True, precond=precond,
                                pair_batch=pair_batch,
                                mg_smooth=mg["smooth"] if mg else 1,
-                               kernels=kernels)
+                               kernels=kernels, cg_dot=cg_dot)
     res = _watched_cg(
         lambda: fn(jnp.asarray(tod), jnp.asarray(wgt), **kwargs),
         watchdog, "joint")
@@ -928,13 +947,21 @@ def band_map_writer(path, data, result):
     The seen-pixel dictionary comes from ``result.sky_pixels`` when the
     solve attached one (``_attach_dict``) — the RESULT is authoritative
     for the index space its map values live in; ``data`` supplies the
-    fallback for results produced outside the CLI solvers."""
+    fallback for results produced outside the CLI solvers.
+
+    Written products are ALWAYS f32, whatever the ``[Precision]``
+    policy did upstream (OPERATIONS.md §15): the FITS BITPIX tables
+    and the tile blob format (``CMTL1`` is little-endian f32 by spec —
+    a narrower map would silently change every tile hash) both assume
+    it, so the cast is forced and asserted here rather than trusted."""
     maps = {
-        "DESTRIPED": np.asarray(result.destriped_map),
-        "NAIVE": np.asarray(result.naive_map),
-        "WEIGHTS": np.asarray(result.weight_map),
-        "HITS": np.asarray(result.hit_map),
+        "DESTRIPED": np.asarray(result.destriped_map, np.float32),
+        "NAIVE": np.asarray(result.naive_map, np.float32),
+        "WEIGHTS": np.asarray(result.weight_map, np.float32),
+        "HITS": np.asarray(result.hit_map, np.float32),
     }
+    assert all(v.dtype == np.float32 for v in maps.values()), \
+        "map products must be f32 regardless of the precision policy"
     wcs, sky_pixels, nside = data.wcs, data.sky_pixels, data.nside
     space = getattr(data, "pixel_space", None)
     if getattr(result, "sky_pixels", None) is not None:
@@ -1051,6 +1078,24 @@ def main(argv=None) -> int:
     if compact not in ("auto", "true", "false"):
         raise ValueError(f"[Pixelization] compact must be "
                          f"auto|true|false, got {compact!r}")
+    # [Precision] (docs/OPERATIONS.md §15): bf16 TOD streaming +
+    # compensated CG dots. coerce raises on a typo'd knob — the same
+    # fail-at-config-load contract as [Destriper]/[Resilience] above
+    from comapreduce_tpu.ops.precision import PrecisionPolicy
+
+    prec = PrecisionPolicy.coerce(dict(ini.get("Precision", {})) or None)
+    if prec.tod_dtype == "bf16" and nside is not None \
+            and compact == "false":
+        # the one combination that can never pay for itself: a dense
+        # HEALPix map vector (12*nside^2 per band) dominates device
+        # memory, so halving TOD bytes buys ~nothing while the solve
+        # still eats the bf16 rounding. Refuse at config load rather
+        # than rounding a campaign for no memory win.
+        raise ValueError(
+            "[Precision] tod_dtype = bf16 with [Pixelization] "
+            "compact = false on a HEALPix grid: dense map vectors "
+            "dominate memory, so narrowed TOD buys nothing here — "
+            "set compact : auto/true, or tod_dtype : f32")
     # streaming ingest (docs/ingest.md): `[Inputs] prefetch : N` reads
     # ahead on a background thread; `cache_mb : M` caches decoded files
     # so every band after the first skips the HDF5 decode entirely
@@ -1188,7 +1233,8 @@ def main(argv=None) -> int:
             coarse_block=coarse_block, prefetch=prefetch, cache=cache,
             resilience=resilience, watchdog=resilience.watchdog,
             precond=precond, pair_batch=pair_batch, mg=mg,
-            compact=compact, kernels=kernels)
+            compact=compact, kernels=kernels,
+            tod_dtype=prec.tod_dtype, cg_dot=prec.cg_dot)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -1205,7 +1251,7 @@ def main(argv=None) -> int:
                                 watchdog=resilience.watchdog,
                                 unit=f"band{band}", precond=precond,
                                 pair_batch=pair_batch, mg=mg,
-                                kernels=kernels)
+                                kernels=kernels, cg_dot=prec.cg_dot)
         elif checkpoint_every > 0:
             # same read as make_band_map, solve split into durable
             # checkpoint/resume chunks — a relaunch mid-CG pays only
@@ -1215,7 +1261,8 @@ def main(argv=None) -> int:
                 galactic=galactic, offset_length=offset_length,
                 use_calibration=use_cal, medfilt_window=400,
                 tod_variant=tod_variant, prefetch=prefetch,
-                cache=cache, resilience=resilience, compact=compact)
+                cache=cache, resilience=resilience, compact=compact,
+                tod_dtype=prec.tod_dtype)
             ckpt = os.path.join(
                 state_dir,
                 f"solver.{prefix}.band{band}.rank{rank}.npz")
@@ -1225,7 +1272,7 @@ def main(argv=None) -> int:
                 threshold=threshold, watchdog=resilience.watchdog,
                 unit=f"band{band}", coarse_block=coarse_block,
                 precond=precond, pair_batch=pair_batch, mg=mg,
-                kernels=kernels)
+                kernels=kernels, cg_dot=prec.cg_dot)
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
@@ -1235,7 +1282,8 @@ def main(argv=None) -> int:
                 tod_variant=tod_variant, coarse_block=coarse_block,
                 prefetch=prefetch, cache=cache, resilience=resilience,
                 precond=precond, pair_batch=pair_batch, mg=mg,
-                compact=compact, kernels=kernels)
+                compact=compact, kernels=kernels,
+                tod_dtype=prec.tod_dtype, cg_dot=prec.cg_dot)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         if writeback is None:

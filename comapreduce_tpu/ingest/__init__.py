@@ -26,7 +26,11 @@ map-makers do (MAPPRAISER, arXiv:2112.03370):
 
 Config surface (``IngestConfig``): ``prefetch`` (queue depth; 0 keeps
 the serial path), ``cache_mb`` (0 disables the cache), ``spill_dir``.
-See ``docs/ingest.md`` for the design and knobs.
+See ``docs/ingest.md`` for the design and knobs. The precision policy
+(``PrecisionPolicy``, OPERATIONS.md §15) rides this subsystem: with
+``tod_dtype = "bf16"`` the loaders narrow TOD payloads on the worker
+thread, so cache bytes, queue bytes, and the H2D transfer the
+``ingest.h2d.bytes`` counter meters all halve.
 """
 
 from comapreduce_tpu.ingest.cache import BlockCache, payload_nbytes  # noqa: F401

@@ -15,6 +15,13 @@ their key — a stale spill (file changed since) is ignored and deleted.
 
 Thread-safe: the prefetcher worker thread populates the cache while the
 consumer reads it.
+
+Precision note (OPERATIONS.md §15): payload dtype is whatever the
+loader produced — under a bf16 TOD policy the cached TOD arrays are
+bf16 and the same ``cache_mb`` budget holds twice the filelist. The
+key does NOT encode the policy, so one cache instance is
+dtype-homogeneous per run; do not share a spill dir between runs with
+different ``tod_dtype`` settings.
 """
 
 from __future__ import annotations

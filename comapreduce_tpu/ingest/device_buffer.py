@@ -36,7 +36,8 @@ def sharding_for_dataset(dataset: str, mesh=None):
 
 def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
                        sharding: Any | Callable[[Any], Any] = None,
-                       watchdog: Any = None) -> Iterator[Any]:
+                       watchdog: Any = None,
+                       cast: Callable[[Any], Any] = None) -> Iterator[Any]:
     """Yield device-resident blocks, keeping ``size`` in flight.
 
     Parameters
@@ -64,6 +65,13 @@ def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
     the transfer queue fills, which is exactly the hang the soft
     deadline surfaces; monitoring only, no cancellation (an abandoned
     transfer would leak device buffers).
+
+    ``cast`` (optional ``block -> block``) runs on the host BEFORE the
+    transfer is issued — the precision-policy hook (OPERATIONS.md §15):
+    a bf16-narrowing cast here halves the bytes that actually cross
+    the bus, and the ``ingest.h2d.bytes`` counter below measures the
+    POST-cast payload, so the telemetry ledger always reports what was
+    shipped, not what was decoded.
     """
     import time
 
@@ -80,6 +88,8 @@ def prefetch_to_device(blocks: Iterable[Any], size: int = 2,
         return jax.device_put(block, shard)
 
     def put(block):
+        if cast is not None:
+            block = cast(block)
         shard = sharding(block) if callable(sharding) else sharding
         if not TELEMETRY.enabled:
             if watchdog is not None:

@@ -21,15 +21,24 @@ from typing import Iterator
 from comapreduce_tpu.data.level import COMAPLevel1, COMAPLevel2
 from comapreduce_tpu.ingest.prefetcher import (PrefetchItem, Prefetcher,
                                                iter_serial)
+from comapreduce_tpu.ops.precision import cast_payload_tod
 
 __all__ = ["load_level1", "load_level2", "level1_stream", "level2_stream"]
 
 
-def load_level1(filename: str, eager_tod: bool = True):
+def load_level1(filename: str, eager_tod: bool = True,
+                tod_dtype: str = "f32"):
     """Read a Level-1 file. ``eager_tod=True`` materialises the big
     ``spectrometer/tod`` dataset here — on the prefetcher's worker
     thread that IS the read being overlapped — and closes the file;
-    ``False`` keeps the reference behaviour (lazy handle, open file)."""
+    ``False`` keeps the reference behaviour (lazy handle, open file).
+
+    ``tod_dtype="bf16"`` narrows the exported TOD payload on the
+    worker (precision policy, OPERATIONS.md §15): the ``BlockCache``
+    then holds half the bytes and every downstream transfer — the
+    prefetch queue, ``prefetch_to_device``'s H2D copies — ships half
+    the bytes. A lazy handle (``eager_tod=False``) is returned as-is:
+    it is never cached, so there is nothing to narrow."""
     data = COMAPLevel1()
     data.read(filename)
     if not eager_tod:
@@ -38,13 +47,15 @@ def load_level1(filename: str, eager_tod: bool = True):
         if path in data:
             data.materialise(path)
     data.close()
-    return data.export_payload()
+    return cast_payload_tod(data.export_payload(), tod_dtype)
 
 
-def load_level2(filename: str):
-    """Read a Level-2 file into a decoded payload dict."""
+def load_level2(filename: str, tod_dtype: str = "f32"):
+    """Read a Level-2 file into a decoded payload dict (``tod_dtype``
+    as in :func:`load_level1` — bf16 narrows the ``averaged_tod`` /
+    ``frequency_binned`` TOD arrays, weights stay f32)."""
     lvl2 = COMAPLevel2(filename=filename)
-    return lvl2.export_payload()
+    return cast_payload_tod(lvl2.export_payload(), tod_dtype)
 
 
 def _rebuild(cls, payload, **kwargs):
@@ -102,7 +113,8 @@ def _stream(filenames, loader, rebuild, prefetch: int = 0,
 def level1_stream(filenames, prefetch: int = 0, cache=None,
                   eager_tod: bool = True, eager_for=None,
                   retry=None, chaos=None, watchdog=None,
-                  on_hang=None) -> Iterator[PrefetchItem]:
+                  on_hang=None,
+                  tod_dtype: str = "f32") -> Iterator[PrefetchItem]:
     """Ordered ``PrefetchItem``s of :class:`COMAPLevel1` views.
 
     The TOD is materialised on the worker when prefetching (that is the
@@ -127,12 +139,19 @@ def level1_stream(filenames, prefetch: int = 0, cache=None,
     retried, and only then captured); ``on_hang`` is the prefetcher's
     abandoned-worker callback (see ``Prefetcher``) — all off (None) by
     default.
+
+    ``tod_dtype`` ("f32" default, "bf16") is the precision-policy
+    storage dtype for TOD payloads (see :func:`load_level1`). The
+    conversion runs in the loader, i.e. BEFORE the cache: a given
+    ``BlockCache`` instance is dtype-homogeneous per run (its key is
+    ``(path, mtime)`` — do not share one cache across policies).
     """
     eager = eager_tod and (prefetch >= 1 or cache is not None)
 
     def loader(path):
         eager_this = eager and (eager_for is None or eager_for(path))
-        return load_level1(path, eager_tod=eager_this)
+        return load_level1(path, eager_tod=eager_this,
+                           tod_dtype=tod_dtype)
 
     return _stream(filenames, loader,
                    lambda p: _rebuild(COMAPLevel1, p),
@@ -142,11 +161,18 @@ def level1_stream(filenames, prefetch: int = 0, cache=None,
 
 def level2_stream(filenames, prefetch: int = 0, cache=None,
                   retry=None, chaos=None, watchdog=None,
-                  on_hang=None) -> Iterator[PrefetchItem]:
+                  on_hang=None,
+                  tod_dtype: str = "f32") -> Iterator[PrefetchItem]:
     """Ordered ``PrefetchItem``s of :class:`COMAPLevel2` views (the
     destriper's filelist reader; always fully decoded). ``retry``/
-    ``chaos``/``watchdog``/``on_hang`` as in :func:`level1_stream`."""
-    return _stream(filenames, load_level2,
+    ``chaos``/``watchdog``/``on_hang``/``tod_dtype`` as in
+    :func:`level1_stream` — with a bf16 policy the shared multi-band
+    cache holds half the TOD bytes, so twice the filelist fits before
+    the LRU starts evicting between band passes."""
+    def loader(path):
+        return load_level2(path, tod_dtype=tod_dtype)
+
+    return _stream(filenames, loader,
                    lambda p: _rebuild(COMAPLevel2, p, filename=""),
                    prefetch=prefetch, cache=cache, retry=retry,
                    chaos=chaos, watchdog=watchdog, on_hang=on_hang)

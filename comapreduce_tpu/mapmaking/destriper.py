@@ -263,6 +263,31 @@ def _dot(x, y, axis_name):
     return s
 
 
+def _dot_compensated(x, y, axis_name):
+    """Compensated (float-float) variant of :func:`_dot` for the
+    ``cg_dot = compensated`` precision policy (OPERATIONS.md §15).
+
+    Each leaf is contracted with :func:`~comapreduce_tpu.ops.precision.
+    precise_dot` (~f64 accuracy from f32 state); the cross-shard psum
+    stays plain f32 — it sums one term per shard, so its rounding is
+    negligible next to the per-leaf accumulation it replaces.
+    """
+    from comapreduce_tpu.ops.precision import precise_dot
+
+    s = precise_dot(x[0], y[0])
+    if axis_name is not None:
+        s = jax.lax.psum(s, axis_name)
+    if x[1] is not None:
+        s = s + precise_dot(x[1], y[1])
+    return s
+
+
+def _check_cg_dot(cg_dot: str) -> None:
+    if cg_dot not in ("f32", "compensated"):
+        raise ValueError(
+            f"cg_dot must be 'f32' or 'compensated', got {cg_dot!r}")
+
+
 def _jacobi_inverse(diag_a: jax.Array, diag_fwf: jax.Array,
                     floor: float = 1e-6) -> jax.Array:
     """1/diag(A) with fallbacks for degenerate offsets.
@@ -434,7 +459,8 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
              ground_ids: jax.Array | None = None,
              az: jax.Array | None = None, n_groups: int = 0,
              precond: str = "jacobi",
-             kernels: str = "auto") -> DestriperResult:
+             kernels: str = "auto",
+             cg_dot: str = "f32") -> DestriperResult:
     """Destripe a flat TOD vector.
 
     Parameters
@@ -464,8 +490,14 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
         are tested against, and its per-sample scatter-adds have no
         windowed structure for them to exploit. The CLI threads the
         ``[Destriper] kernels`` knob to both entry points uniformly.
+    cg_dot: ``"f32"`` (default, byte-identical to the pre-policy
+        solver) or ``"compensated"`` — swap the CG recurrence dots for
+        the float-float :func:`~comapreduce_tpu.ops.precision.
+        precise_dot` so tight tolerances stop stalling at the f32
+        rounding floor (``[Precision] cg_dot``, OPERATIONS.md §15).
     """
     _check_precond(precond)
+    _check_cg_dot(cg_dot)
     from comapreduce_tpu.mapmaking.pallas_binning import resolve_kernels
     resolve_kernels(kernels)   # validate the knob; path unchanged
     n = tod.shape[0]
@@ -521,8 +553,9 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
             # unpreconditioned directions cost a few CG iterations at most.
             return (v[0] * inv_diag, v[1])
 
+    dot = (_dot_compensated if cg_dot == "compensated" else _dot)
     x, rz, k, b_norm, diverged = _cg_loop(
-        matvec, b, lambda u, v: _dot(u, v, axis_name), n_iter, threshold,
+        matvec, b, lambda u, v: dot(u, v, axis_name), n_iter, threshold,
         precond=precond_fn)
     offsets, ground = x
 
@@ -542,7 +575,8 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
 destripe_jit = jax.jit(
     destripe,
     static_argnames=("npix", "offset_length", "n_iter", "threshold",
-                     "axis_name", "n_groups", "precond", "kernels"))
+                     "axis_name", "n_groups", "precond", "kernels",
+                     "cg_dot"))
 
 
 def ground_ids_per_offset(ground_ids: np.ndarray,
@@ -916,7 +950,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      x0: jax.Array | None = None,
                      precond: str = "jacobi",
                      kernels: str = "auto",
-                     kernels_platform: str | None = None) -> DestriperResult:
+                     kernels_platform: str | None = None,
+                     cg_dot: str = "f32") -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -1018,8 +1053,18 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     host can trace CPU-placed programs without pulling Mosaic calls
     into them. Shapes the kernel VMEM gate rejects silently keep the
     XLA path (parity holds either way).
+
+    ``cg_dot``: ``"f32"`` (default, byte-identical program) or
+    ``"compensated"`` — the CG recurrence dots (alpha/beta/residual
+    and the divergence monitor's ``|r|^2``) run through the
+    float-float :func:`~comapreduce_tpu.ops.precision.precise_dot`
+    (the ``[Precision] cg_dot`` knob, OPERATIONS.md §15). Works on
+    every branch here: multi-RHS per-band dots contract the last axis;
+    sharded dots compensate per shard and psum the few per-shard
+    partials in f32.
     """
     _check_precond(precond, coarse, mg)
+    _check_cg_dot(cg_dot)
     from comapreduce_tpu.mapmaking.pallas_binning import (
         pallas_binning_ok, resolve_kernels, windowed_gather_pallas)
     kern = resolve_kernels(kernels, platform=kernels_platform)
@@ -1285,13 +1330,21 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         if x0 is not None:
             raise ValueError("x0 warm start is offsets-only; the joint "
                              "ground solve restarts cold")
-        x, rz, k, b_norm, diverged = _cg_loop(
-            matvec_g, b_g,
+        if cg_dot == "compensated":
+            from comapreduce_tpu.ops.precision import precise_dot
+
+            def dot_g(u, v):
+                return (_psum(precise_dot(u[0], v[0]))
+                        + precise_dot(u[1], v[1]))
+        else:
             # offsets are sharded (psum the partial dot); the ground
             # block is replicated (group sums already psum'd), so its
             # dot term must NOT be psum'd again
-            lambda u, v: (_psum(jnp.sum(u[0] * v[0]))
-                          + jnp.sum(u[1] * v[1])),
+            def dot_g(u, v):
+                return (_psum(jnp.sum(u[0] * v[0]))
+                        + jnp.sum(u[1] * v[1]))
+        x, rz, k, b_norm, diverged = _cg_loop(
+            matvec_g, b_g, dot_g,
             n_iter, threshold,
             # identity on the ground block, as in the scatter path (see
             # destripe's precond comment)
@@ -1303,8 +1356,16 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     else:
         # per-band inner products (last axis only): a multi-RHS solve
         # runs independent CGs in one program
+        if cg_dot == "compensated":
+            from comapreduce_tpu.ops.precision import precise_dot
+
+            def dot_b(u, v):
+                return _psum(precise_dot(u, v, axis=-1))
+        else:
+            def dot_b(u, v):
+                return _psum(jnp.sum(u * v, axis=-1))
         a, rz, k, b_norm, diverged = _cg_loop(
-            matvec, b, lambda u, v: _psum(jnp.sum(u * v, axis=-1)),
+            matvec, b, dot_b,
             n_iter, threshold, precond=apply_precond, x0=x0)
         ground = jnp.zeros((0, 2), f32)
         pair_res = pair_wd - pair_w * gather_a(a)

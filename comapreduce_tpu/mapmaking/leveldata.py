@@ -194,7 +194,8 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     tod_variant: str = "auto",
                     prefetch: int = 0, cache=None,
                     resilience=None, compact="auto",
-                    pixel_space: PixelSpace | None = None) -> DestriperData:
+                    pixel_space: PixelSpace | None = None,
+                    tod_dtype: str = "f32") -> DestriperData:
     """Read + flatten a filelist for one band. Exactly one of ``wcs`` /
     ``nside`` selects the pixelisation. ``mask_turnarounds`` zero-weights
     samples outside the ``speed_range`` deg/s scan-speed band (the legacy
@@ -252,7 +253,18 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     (``HangError``, an ``OSError``, lands in the same per-file net
     below), retried with a fresh budget, and on exhaustion ledgered
     ``hang``/``rejected`` with the file excluded from this run's
-    map."""
+    map.
+
+    ``tod_dtype`` ("f32" default, "bf16") is the ``[Precision]``
+    policy's storage dtype for the streamed TOD payloads
+    (OPERATIONS.md §15): bf16 halves the shared multi-band cache's TOD
+    bytes. The per-feed extraction below widens back to f32 on the
+    host (``np.asarray(..., np.float32)``), so the flattened
+    ``DestriperData`` vectors — and every solve — stay f32; bf16
+    changes the stored/streamed representation only. Requires a
+    compacted pixel space for HEALPix (see the CLI's combo check): the
+    point of narrowing is memory headroom, which a dense nside-4096
+    sky map vector would instantly squander."""
     from comapreduce_tpu.ingest import level2_stream
 
     if (wcs is None) == (nside is None):
@@ -290,6 +302,7 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     group = 0
     kept_files = []
     stream = level2_stream(filenames, prefetch=prefetch, cache=cache,
+                           tod_dtype=tod_dtype,
                            retry=resilience.retry,
                            chaos=resilience.chaos,
                            watchdog=resilience.watchdog,

@@ -1,0 +1,309 @@
+"""Explicit end-to-end precision policy (ISSUE 13).
+
+The pipeline runs f32 everywhere by default, with two known pressure
+points on opposite ends of the precision axis:
+
+- **Ingest is memory-bound.** The TOD blocks dominate cache bytes,
+  HBM residency, and the H2D traffic the ``ingest.h2d.bytes`` counter
+  meters (OPERATIONS.md §13). Production map-makers stream TOD in the
+  cheapest precision the science tolerates ("fast and precise
+  map-making", arXiv 0912.2738; MAPPRAISER, arXiv 2112.03370) — bf16
+  keeps f32's exponent range (NaN sentinels and the ``scrub_tod``
+  tripwires survive the round-trip bit-exactly in their *finiteness*)
+  while halving every byte count. The fused reduction upcasts to f32
+  at the first arithmetic touch, so accumulators, band averages and
+  gain solves keep f32 semantics; only storage and transport narrow.
+- **CG recurrences are precision-bound.** The alpha/beta/residual dot
+  products accumulate rounding at tight tolerances (the f32 stall
+  edge ROOFLINE round 8 discusses; the block-8/16 twolevel divergence
+  BENCH_r06 records). A compensated (float-float, effectively
+  f64-emulated) dot restores the lost bits exactly where iteration
+  counts are precision-limited, without widening any array state.
+
+:class:`PrecisionPolicy` is the single config object for both knobs,
+threaded like ``ShapeBuckets``: ``[Precision]`` in the destriper INI,
+``[precision]`` in the runner TOML. The default policy is the identity
+— byte-identical behaviour to a build without this module.
+
+Products are NEVER narrowed: FITS maps, tile blobs (``CMTL1`` is LE
+f32 by format) and coadds stay f32 regardless of policy (enforced in
+``band_map_writer`` / ``fits_io`` / ``tiles.blob``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "PrecisionPolicy",
+    "TOD_PAYLOAD_KEYS",
+    "tod_numpy_dtype",
+    "cast_payload_tod",
+    "two_sum",
+    "two_prod",
+    "precise_sum",
+    "precise_dot",
+    "precise_norm",
+]
+
+# bf16 as a *numpy* dtype comes from ml_dtypes (a jax dependency).
+# Gated import: if the environment lacks it, requesting bf16 raises a
+# clear error instead of an ImportError at module import time.
+try:  # pragma: no cover - ml_dtypes ships with jax
+    import ml_dtypes as _ml_dtypes
+
+    _BF16 = np.dtype(_ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+# The HDF5 dataset paths whose payload arrays a bf16 policy narrows.
+# ONLY the TOD streams: weights, masks, pointing, and calibration
+# tables stay f32 (narrowing a weight changes solve semantics; the TOD
+# is re-widened at the first device-side reduce).
+TOD_PAYLOAD_KEYS = frozenset({
+    "spectrometer/tod",            # Level-1 raw counts
+    "averaged_tod/tod",            # Level-2 band averages
+    "averaged_tod/tod_original",   # Level-2, no gain subtraction
+    "frequency_binned/tod",        # Level-2 frequency-binned variant
+})
+
+_TOD_DTYPE_ALIASES = {
+    "f32": "f32", "float32": "f32", "fp32": "f32",
+    "bf16": "bf16", "bfloat16": "bf16",
+}
+_CG_DOT_VALUES = ("f32", "compensated")
+
+
+class PrecisionPolicy:
+    """End-to-end precision knobs (value-hashable like ``ShapeBuckets``).
+
+    - ``tod_dtype``: ``"f32"`` (default) or ``"bf16"`` — the dtype TOD
+      payloads are *stored and shipped* in (``BlockCache``, the
+      prefetcher queue, H2D transfers). Accumulators are always f32:
+      the fused reduction and the destriper upcast at first touch.
+    - ``cg_dot``: ``"f32"`` (default) or ``"compensated"`` — the dot
+      product the CG recurrences (alpha/beta/residual and the
+      divergence monitor) use. ``"compensated"`` swaps in
+      :func:`precise_dot`, a float-float (two-sum/two-product) dot
+      with ~2x f32's effective mantissa, so tight-tolerance solves
+      stop stalling at the f32 rounding floor.
+
+    The default instance is the identity policy: nothing changes dtype
+    and no solver code path diverges (byte-identical to policy-off).
+    """
+
+    KNOBS = ("tod_dtype", "cg_dot")
+
+    def __init__(self, tod_dtype: str = "f32", cg_dot: str = "f32"):
+        td = _TOD_DTYPE_ALIASES.get(str(tod_dtype).strip().lower())
+        if td is None:
+            raise ValueError(
+                f"[Precision] tod_dtype must be one of f32|bf16, "
+                f"got {tod_dtype!r}")
+        cd = str(cg_dot).strip().lower()
+        if cd not in _CG_DOT_VALUES:
+            raise ValueError(
+                f"[Precision] cg_dot must be one of f32|compensated, "
+                f"got {cg_dot!r}")
+        if td == "bf16" and _BF16 is None:  # pragma: no cover
+            raise ValueError(
+                "tod_dtype=bf16 requires the ml_dtypes package "
+                "(ships with jax); it is missing in this environment")
+        self.tod_dtype = td
+        self.cg_dot = cd
+
+    def _key(self):
+        return (self.tod_dtype, self.cg_dot)
+
+    def __eq__(self, other):
+        return (type(other) is PrecisionPolicy and
+                self._key() == other._key())
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return (f"PrecisionPolicy(tod_dtype={self.tod_dtype!r}, "
+                f"cg_dot={self.cg_dot!r})")
+
+    @property
+    def enabled(self) -> bool:
+        """True iff any knob departs from the identity policy."""
+        return self.tod_dtype != "f32" or self.cg_dot != "f32"
+
+    @classmethod
+    def coerce(cls, value) -> "PrecisionPolicy":
+        """None / dict / PrecisionPolicy -> PrecisionPolicy.
+
+        A typo'd knob raises instead of silently running the default —
+        the ``[Resilience]``/``[Destriper]`` section contract."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            known = {k: value[k] for k in cls.KNOBS if k in value}
+            unknown = set(value) - set(known)
+            if unknown:
+                raise ValueError(
+                    f"unknown precision keys: {sorted(unknown)} "
+                    f"(knobs: {list(cls.KNOBS)})")
+            return cls(**known)
+        raise TypeError(f"cannot build PrecisionPolicy from {type(value)}")
+
+
+def tod_numpy_dtype(tod_dtype: str):
+    """The numpy dtype a ``tod_dtype`` knob value stores TOD in."""
+    td = _TOD_DTYPE_ALIASES.get(str(tod_dtype).strip().lower())
+    if td == "f32":
+        return np.dtype(np.float32)
+    if td == "bf16":
+        if _BF16 is None:  # pragma: no cover
+            raise ValueError("bf16 requires ml_dtypes (ships with jax)")
+        return _BF16
+    raise ValueError(f"unknown tod_dtype {tod_dtype!r}")
+
+
+def cast_payload_tod(payload, tod_dtype: str):
+    """Narrow the TOD datasets of an exported store payload in place.
+
+    ``payload`` is the ``export_payload`` dict (``{"data": {path:
+    array}, "attrs": ...}``); only the :data:`TOD_PAYLOAD_KEYS` arrays
+    are cast — weights/masks/pointing stay f32. Runs on the
+    prefetcher's WORKER thread so the ``BlockCache`` holds the
+    narrowed bytes (the cache is dtype-homogeneous per run: its key is
+    ``(path, mtime)``, so one run must not mix policies on one cache).
+    Live (non-dict) payloads pass through untouched — a lazy Level-1
+    handle is never cached, so there is nothing to narrow.
+    """
+    dtype = tod_numpy_dtype(tod_dtype)
+    if dtype == np.float32:
+        return payload
+    if not (isinstance(payload, dict) and "data" in payload):
+        return payload
+    data = payload["data"]
+    for key in TOD_PAYLOAD_KEYS:
+        arr = data.get(key)
+        if arr is not None and getattr(arr, "dtype", None) != dtype:
+            data[key] = np.asarray(arr).astype(dtype)
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Compensated (float-float) arithmetic for the CG recurrences.
+#
+# Classic error-free transformations (Knuth two-sum, Dekker split /
+# two-product) carried through a pairwise tree reduction — the dot2
+# algorithm of Ogita, Rump & Oishi (2005) in a vectorised, jittable
+# form. Each value is an unevaluated (hi, lo) pair with |lo| <= ulp(hi)
+# / 2, giving ~2x the f32 mantissa (~48 effective bits): effectively
+# f64 accuracy without f64 hardware (jax_enable_x64 stays off).
+# XLA does not reassociate floating-point ops by default, so the
+# cancellation tricks below survive jit compilation.
+# ---------------------------------------------------------------------------
+
+
+def two_sum(a, b):
+    """Knuth's error-free sum: returns ``(s, err)`` with
+    ``s = fl(a + b)`` and ``a + b = s + err`` exactly."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _split(a):
+    # Dekker split for binary32: C = 2^12 + 1 halves the 24-bit
+    # mantissa into two 12-bit pieces whose products are exact in f32.
+    c = a * 4097.0
+    hi = c - (c - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """Dekker/Veltkamp error-free product: ``(p, err)`` with
+    ``p = fl(a * b)`` and ``a * b = p + err`` exactly (no FMA needed)."""
+    p = a * b
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+def _ff_add(xh, xl, yh, yl):
+    # add two float-float values, renormalised so |lo| <= ulp(hi)/2
+    s, e = two_sum(xh, yh)
+    e = e + (xl + yl)
+    hi = s + e
+    return hi, e - (hi - s)
+
+
+def _ff_tree_sum(hi, lo):
+    """Pairwise (log-depth) float-float sum over the LAST axis.
+
+    Pads to a power of two with exact zeros and halves repeatedly —
+    fully vectorised over any leading axes (the multi-RHS band axis of
+    the planned solver rides along for free) and O(log n) rounding
+    depth on top of the compensation."""
+    import jax.numpy as jnp
+
+    n = hi.shape[-1]
+    p = 1
+    while p < n:
+        p *= 2
+    if p != n:
+        pad = [(0, 0)] * (hi.ndim - 1) + [(0, p - n)]
+        hi = jnp.pad(hi, pad)
+        lo = jnp.pad(lo, pad)
+    while hi.shape[-1] > 1:
+        h = hi.shape[-1] // 2
+        hi, lo = _ff_add(hi[..., :h], lo[..., :h],
+                         hi[..., h:], lo[..., h:])
+    return hi[..., 0], lo[..., 0]
+
+
+def precise_sum(x, axis=None):
+    """Compensated sum of ``x`` (f32 in, f32 out, ~f64 internally).
+
+    ``axis=None`` sums everything; otherwise the axis must be the last
+    (the only shape the CG recurrences need)."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    if axis is None:
+        x = x.reshape(-1)
+    elif axis not in (-1, x.ndim - 1):
+        raise ValueError(f"precise_sum supports axis=None|-1, got {axis}")
+    hi, lo = _ff_tree_sum(x, jnp.zeros_like(x))
+    return hi + lo
+
+
+def precise_dot(x, y, axis=None):
+    """Compensated dot product (Ogita–Rump–Oishi dot2, pairwise form).
+
+    f32 inputs, f32 result, ~f64 internal accuracy: every elementwise
+    product is split exactly (``two_prod``) and the (value, error)
+    stream is tree-summed in float-float. ``axis=None`` contracts all
+    axes (the scalar CG dots); ``axis=-1`` contracts the last axis
+    only, vectorised over leading axes (the multi-RHS planned solver's
+    per-band dots). Cost is ~10 flops/element of cheap elementwise
+    math on data already resident for the plain dot — the recurrences
+    it feeds are latency-, not throughput-, critical."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    if axis is None:
+        x = x.reshape(-1)
+        y = y.reshape(-1)
+    elif axis not in (-1, x.ndim - 1):
+        raise ValueError(f"precise_dot supports axis=None|-1, got {axis}")
+    p, e = two_prod(x, y)
+    hi, lo = _ff_tree_sum(p, e)
+    return hi + lo
+
+
+def precise_norm(x, axis=None):
+    """Compensated squared norm: ``precise_dot(x, x)``."""
+    return precise_dot(x, x, axis=axis)

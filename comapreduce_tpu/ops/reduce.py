@@ -511,7 +511,16 @@ def _prefilter_chain(d_s, m_s, a_s, cfg: ReduceConfig, fill_impl="auto"):
     lowerings take the fused Mosaic kernel — the pre-filter's measured
     ~34-pass floor is almost entirely the XLA fill's median selection,
     so the kernel is what moves this chain toward the post-filter's
-    ~3-pass budget (ROOFLINE round 8)."""
+    ~3-pass budget (ROOFLINE round 8).
+
+    Precision contract (OPERATIONS.md §15): a bf16 TOD policy narrows
+    storage and transport only — this chain widens the scan block to
+    f32 HERE, before the first arithmetic touch, so every reduction
+    (median, airmass fit, rms) accumulates in f32. The guard is a
+    trace-time no-op for f32 inputs (default path byte-identical; the
+    pass-budget test sees the same program)."""
+    if d_s.dtype != jnp.float32:
+        d_s = d_s.astype(jnp.float32)
     B, C, L = d_s.shape
     # NaN fill is per-scan independent; doing it here (not on the full
     # block) lets scan_batch streaming bound its memory too
@@ -558,7 +567,12 @@ def _postfilter_chain(filtered, m_s, tv, norm, tsys, sys_gain,
 
     so ``tod_clean`` is ``tod_orig`` minus a per-band coefficient times
     ``dg`` — no second traversal, no intermediate blocks. Returns
-    ``(tod_clean, tod_orig, weights, dg)`` (each already tv-masked)."""
+    ``(tod_clean, tod_orig, weights, dg)`` (each already tv-masked).
+
+    Like :func:`_prefilter_chain`, the block is widened to f32 before
+    the gain solve / band average (trace-time no-op for f32 inputs)."""
+    if filtered.dtype != jnp.float32:
+        filtered = filtered.astype(jnp.float32)
     B, C, L = filtered.shape
     T2, p = gain_ops.build_templates(
         tsys, freq_scaled, cfg.mask_templates[None, :] * jnp.ones((B, 1)))
@@ -635,6 +649,12 @@ def reduce_feed_scans(tod, mask, airmass, starts, lengths,
     vmap over feeds; shard_map the feed axis over the mesh.
     """
     B, C, T = tod.shape
+    if tod.dtype != jnp.float32:
+        # bf16 TOD policy (OPERATIONS.md §15): payloads may arrive
+        # narrowed — widen at the first device touch. bf16 shares
+        # f32's exponent field, so the NaN sentinels the mask=None
+        # path keys on survive the round-trip; validity is identical.
+        tod = tod.astype(jnp.float32)
     if mask is None:
         mask = jnp.isfinite(tod).astype(tod.dtype)
         tod = jnp.nan_to_num(tod)

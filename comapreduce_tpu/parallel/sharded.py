@@ -191,7 +191,8 @@ def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
                      offset_length: int = 50, n_iter: int = 100,
                      threshold: float = 1e-6,
                      ground_ids=None, az=None, n_groups: int = 0,
-                     precond: str = "jacobi") -> DestriperResult:
+                     precond: str = "jacobi",
+                     cg_dot: str = "f32") -> DestriperResult:
     """Destripe with the flat time axis sharded over the whole mesh.
 
     ``tod``/``weights`` f32[N], ``pixels`` i32[N]; N is padded here to a
@@ -225,7 +226,7 @@ def destripe_sharded(mesh: Mesh, tod, pixels, weights, npix: int,
                         threshold=threshold, axis_name=axes,
                         ground_ids=ground_l if with_ground else None,
                         az=az_l if with_ground else None, n_groups=n_groups,
-                        precond=precond)
+                        precond=precond, cg_dot=cg_dot)
 
     out_specs = DestriperResult(
         offsets=shard, ground=repl, destriped_map=repl, naive_map=repl,
@@ -259,7 +260,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                   n_groups: int = 0,
                                   with_coarse: bool = False,
                                   precond: str = "jacobi",
-                                  kernels: str = "auto"):
+                                  kernels: str = "auto",
+                                  cg_dot: str = "f32"):
     """Build a reusable sharded planned-destriper: returns
     ``run(tod, weights) -> DestriperResult``.
 
@@ -285,6 +287,10 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     up) and ``ac_inv`` the replicated coarse inverse
     (``destriper.build_coarse_preconditioner``; stack (nb, n_c, n_c)
     for multi-RHS). Not available on the ground program.
+
+    ``cg_dot`` threads the ``[Precision] cg_dot`` knob to every branch
+    (see ``destripe_planned``): compensated per-shard dots, f32 psum of
+    the per-shard partials.
     """
     if n_bands and n_groups:
         raise ValueError("ground solves are single-RHS; run per band")
@@ -324,7 +330,7 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                     dense_maps=False, device_arrays=arrs,
                                     ground_off=g_off_l, az=az_l,
                                     n_groups=n_groups, precond=precond,
-                                    kernels=kernels)
+                                    kernels=kernels, cg_dot=cg_dot)
 
         fn = jax.jit(_shard_map(
             local_g, mesh=mesh,
@@ -346,7 +352,7 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                     threshold=threshold, axis_name=axes,
                                     dense_maps=False, device_arrays=arrs,
                                     coarse=(grp_l, aci), precond=precond,
-                                    kernels=kernels)
+                                    kernels=kernels, cg_dot=cg_dot)
 
         fn = jax.jit(_shard_map(
             local_c, mesh=mesh,
@@ -367,7 +373,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
         return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
                                 threshold=threshold, axis_name=axes,
                                 dense_maps=False, device_arrays=arrs,
-                                precond=precond, kernels=kernels)
+                                precond=precond, kernels=kernels,
+                                cg_dot=cg_dot)
 
     fn = jax.jit(_shard_map(local, mesh=mesh,
                             in_specs=(v_spec, v_spec, arr_specs),

@@ -139,6 +139,14 @@ class Runner:
     # events.rank{r}.jsonl for tools/campaign_report.py; off by
     # default (docs/OPERATIONS.md §13)
     telemetry: object = None
+    # precision knob (TOML [precision] / INI [Precision]):
+    # PrecisionPolicy | {"tod_dtype": "bf16", "cg_dot": "compensated"}
+    # | None. tod_dtype narrows streamed/cached TOD payloads (weights/
+    # masks stay f32; the fused reduce widens at first touch); cg_dot
+    # selects the destriper's CG inner product. Default None is the
+    # identity policy — byte-identical behaviour
+    # (docs/OPERATIONS.md §15).
+    precision: object = None
     # cumulative async-writeback stats ({"writes", "write_s",
     # "flush_wait_s", ...}) across this Runner's run_tod calls — the
     # bench's write-overlap observable
@@ -191,10 +199,13 @@ class Runner:
         from comapreduce_tpu.ingest import IngestConfig, level1_stream
         from comapreduce_tpu.pipeline.campaign import CampaignConfig
 
+        from comapreduce_tpu.ops.precision import PrecisionPolicy
+
         os.makedirs(self.output_dir, exist_ok=True)
         cfg = IngestConfig.coerce(self.ingest)
         camp = CampaignConfig.coerce(self.campaign)
         tcfg = TelemetryConfig.coerce(self.telemetry)
+        prec = PrecisionPolicy.coerce(self.precision)
         if tcfg.enabled and not TELEMETRY.enabled:
             # the registry is process-wide: the first enabled Runner
             # opens this rank's stream; sub-runs (run_astro_cal) and
@@ -210,6 +221,14 @@ class Runner:
             for p in self.processes:
                 if hasattr(p, "shape_buckets"):
                     p.shape_buckets = buckets
+        if prec.enabled:
+            # precision policy (docs/OPERATIONS.md §15), threaded like
+            # the shape buckets: stages that expose the knob receive
+            # the whole policy (the reduce stage widens bf16 TOD at
+            # first touch; a destriper stage would read cg_dot)
+            for p in self.processes:
+                if hasattr(p, "precision"):
+                    p.precision = prec
         if cfg.compile_cache_dir:
             from comapreduce_tpu.pipeline.campaign import \
                 enable_compile_cache
@@ -218,6 +237,19 @@ class Runner:
         if self._ingest_cache is None:
             self._ingest_cache = cfg.make_cache()
         cache = self._ingest_cache
+        if (prec.tod_dtype != "f32" and cache is None
+                and not (cfg.eager_tod and cfg.prefetch >= 1)):
+            # the narrowing happens in the eager loader, before the
+            # cache/prefetch queue; a lazy h5py handle is returned
+            # as-is (loaders.load_level1), so on the serial lazy path
+            # the knob is inert — say so instead of silently doing
+            # nothing (docs/OPERATIONS.md §15)
+            logger.warning(
+                "[precision] tod_dtype = %s has no effect on the lazy "
+                "serial ingest path (prefetch = 0, no cache): the "
+                "narrowing runs in the eager loader. Set [ingest] "
+                "prefetch >= 1 (or cache_mb > 0) to stream narrowed "
+                "TOD.", prec.tod_dtype)
         res = self._resilience_runtime()
         if camp.warm_compile:
             # AOT warm-up of the campaign's bucket set, overlapped with
@@ -291,6 +323,7 @@ class Runner:
                                prefetch=cfg.prefetch, cache=cache,
                                eager_tod=cfg.eager_tod,
                                eager_for=self._needs_tod,
+                               tod_dtype=prec.tod_dtype,
                                retry=res.retry, chaos=res.chaos,
                                watchdog=res.watchdog,
                                on_hang=lambda f: res.record_hang(
@@ -719,8 +752,12 @@ class Runner:
         chaos layer (docs/OPERATIONS.md §7); an optional ``[campaign]``
         table (``t_quantum``, ``scan_quantum``, ``l_quantum``,
         ``warm_compile``) turns on the campaign shape policy and
-        compile warm-up (docs/OPERATIONS.md §9)."""
+        compile warm-up (docs/OPERATIONS.md §9); an optional
+        ``[precision]`` table (``tod_dtype``, ``cg_dot``) sets the
+        end-to-end precision policy — a typo'd key raises here, at
+        load (docs/OPERATIONS.md §15)."""
         from comapreduce_tpu.ingest import IngestConfig
+        from comapreduce_tpu.ops.precision import PrecisionPolicy
         from comapreduce_tpu.pipeline.campaign import CampaignConfig
         from comapreduce_tpu.resilience import ResilienceConfig
 
@@ -754,7 +791,11 @@ class Runner:
                    # counters to <log_dir>/events.rank{r}.jsonl
                    # (docs/OPERATIONS.md §13)
                    telemetry=TelemetryConfig.coerce(
-                       config.get("telemetry")))
+                       config.get("telemetry")),
+                   # [precision] tod_dtype/cg_dot: the end-to-end
+                   # precision policy (docs/OPERATIONS.md §15)
+                   precision=PrecisionPolicy.coerce(
+                       config.get("precision")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,

@@ -72,6 +72,14 @@ class _StageBase:
     # scans) so a whole filelist shares one compiled program set per
     # bucket instead of recompiling per file (docs/OPERATIONS.md §9)
     shape_buckets: object = None
+    # end-to-end precision policy (ops.precision.PrecisionPolicy |
+    # None = identity). Set by the Runner from the [precision] table.
+    # Stages need no per-dtype code: a bf16 TOD payload device_puts as
+    # jnp.bfloat16 and the fused reduce chains widen to f32 at first
+    # arithmetic touch (docs/OPERATIONS.md §15); the knob is carried
+    # here so stage code CAN consult it (e.g. to size feed batches by
+    # the narrowed payload bytes)
+    precision: object = None
     _data: dict = field(default_factory=dict, repr=False)
     _attrs: dict = field(default_factory=dict, repr=False)
 

@@ -121,6 +121,24 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                                                 "interpret": 58},
                                    "parity_offsets_maxdiff": 1e-4},
                        "tpu_rows": "deferred: requires TPU"}}
+    prec = {"metric": "precision_h2d_bytes_ratio", "value": 0.515,
+            "detail": {"config": "precision",
+                       "h2d_bytes": {"f32": 715968, "bf16": 368832},
+                       "cg_ladder": {
+                           "f32": [{"threshold": 1e-6, "n_iter": 160,
+                                    "residual": 8.2e-7,
+                                    "reached": True}],
+                           "compensated": [{"threshold": 1e-6,
+                                            "n_iter": 160,
+                                            "residual": 8.3e-7,
+                                            "reached": True}]},
+                       "stall_edge": "absent: f32 dots reached every "
+                                     "rung measured on this fixture",
+                       "bf16_parity": {"offsets_maxdiff": 0.013,
+                                       "offsets_scale": 2.7,
+                                       "bf16_eps": 7.8125e-3,
+                                       "n_iter": {"f32": 160,
+                                                  "bf16": 160}}}}
     monkeypatch.setattr(cp, "run_quick_bench", lambda: dict(rec))
     monkeypatch.setattr(cp, "run_campaign_bench",
                         lambda: json.loads(json.dumps(camp)))
@@ -128,6 +146,8 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                         lambda: json.loads(json.dumps(dstr)))
     monkeypatch.setattr(cp, "run_kernels_bench",
                         lambda: json.loads(json.dumps(kern)))
+    monkeypatch.setattr(cp, "run_precision_bench",
+                        lambda: json.loads(json.dumps(prec)))
     monkeypatch.setattr(
         cp, "reference_path",
         lambda platform: str(tmp_path / f"perf_quick_{platform}.json"))
@@ -189,6 +209,25 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     kern["detail"]["binning"]["parity_offsets_maxdiff"] = 0.02
     assert cp.main(["--reps", "1", "--no-serving"]) == 1         # converged-offset drift
     kern["detail"]["binning"]["parity_offsets_maxdiff"] = 1e-4
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    # the precision gate (ISSUE 13): an H2D bytes ratio above 0.55, a
+    # ladder rung reached by f32 dots but not compensated ones, a
+    # missing stall_edge report, or a bf16 parity drift beyond the
+    # eps-scaled envelope each fail; --no-precision skips the child
+    prec["value"] = 0.8                          # bus bytes not halved
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    assert cp.main(["--reps", "1", "--no-serving",
+                    "--no-precision"]) == 0
+    prec["value"] = 0.515
+    prec["detail"]["cg_ladder"]["compensated"][0]["reached"] = False
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    prec["detail"]["cg_ladder"]["compensated"][0]["reached"] = True
+    prec["detail"]["stall_edge"] = None          # ladder contract broken
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    prec["detail"]["stall_edge"] = 1e-8          # measured-present is fine
+    prec["detail"]["bf16_parity"]["offsets_maxdiff"] = 0.5
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    prec["detail"]["bf16_parity"]["offsets_maxdiff"] = 0.013
     assert cp.main(["--reps", "1", "--no-serving"]) == 0
 
 
@@ -304,3 +343,41 @@ def test_bench_destriper_smoke(tmp_path):
     assert d["survey4096"]["n_compact"] < 10_000
     # the round-7 artifact lands next to the evidence dir
     assert (tmp_path / "BENCH_r06.json").exists()
+
+
+def test_bench_precision_smoke(tmp_path):
+    """``--config precision`` (ISSUE 13): the precision-portfolio A/B —
+    the bf16 stream must counter-measure at or under 0.55x the f32
+    H2D bytes on the same filelist, the CG ladder must report a stall
+    edge (measured-present or documented-absent), and bf16 storage
+    parity must stay inside the bf16-eps envelope."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON") and k != "XLA_FLAGS"}
+    env.update(BENCH_SMALL="1", BENCH_NO_PROBE="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo, BENCH_EVIDENCE_DIR=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--config", "precision"],
+        capture_output=True, text=True, env=env, timeout=420, cwd=repo)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "precision_h2d_bytes_ratio"
+    d = rec["detail"]
+    assert d["config"] == "precision"
+    # the headline contract: the counter saw the bf16 stream ship at
+    # most 0.55x the f32 bytes (0.5 = pure TOD; MJD keeps its width)
+    assert 0.4 < rec["value"] <= 0.55, d["h2d_bytes"]
+    assert d["h2d_bytes"]["bf16"] < d["h2d_bytes"]["f32"]
+    # the ladder is measured both ways and the stall edge is always
+    # reported — a float when present, a documented-absent note if not
+    assert d["stall_edge"] is not None
+    for mode in ("f32", "compensated"):
+        rows = d["cg_ladder"][mode]
+        assert all(r["n_iter"] > 0 for r in rows)
+    par = d["bf16_parity"]
+    assert par["offsets_maxdiff"] <= 4 * par["bf16_eps"] * max(
+        par["offsets_scale"], 1.0)
+    # the round-8 artifact lands next to the evidence dir
+    assert (tmp_path / "BENCH_r08.json").exists()

@@ -304,9 +304,9 @@ def test_resumed_files_not_materialised_by_prefetch(level1_files,
     calls = []
     orig = loaders_mod.load_level1
 
-    def spy(path, eager_tod=True):
+    def spy(path, eager_tod=True, **kw):
         calls.append((path, eager_tod))
-        return orig(path, eager_tod=eager_tod)
+        return orig(path, eager_tod=eager_tod, **kw)
 
     second = Runner(processes=_chain(), output_dir=outdir,
                     ingest={"prefetch": 2})

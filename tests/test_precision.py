@@ -1,0 +1,620 @@
+"""Precision portfolio (ISSUE 13): bf16 TOD streaming with f32
+accumulators + compensated-f64 CG recurrences.
+
+Contract under test (docs/OPERATIONS.md §15):
+
+- ``PrecisionPolicy`` is a value-hashable config object with the same
+  typo'd-knob/unknown-key contract as ``ShapeBuckets`` and the
+  ``[Resilience]`` section — a misspelled knob raises at config load,
+  never silently runs with the default;
+- ``tod_dtype = bf16`` narrows ONLY the TOD payload arrays (weights,
+  masks, MJD keep their width) and every accumulator upcasts to f32 at
+  the first reduce, so downstream results differ from the f32 stream by
+  representation error (bf16 eps 7.8e-3), never by accumulation error;
+- ``precise_dot``/``precise_sum``/``precise_norm`` are two-sum/two-prod
+  compensated reductions pinned against a NumPy f64 oracle, including
+  cancellation-heavy fixtures where naive f32 loses everything;
+- products are NEVER narrowed: FITS maps and ``CMTL1`` tile blobs are
+  f32 whatever the policy did upstream (a bf16 leak would change every
+  tile hash).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.ops.precision import (TOD_PAYLOAD_KEYS,
+                                           PrecisionPolicy,
+                                           cast_payload_tod, precise_dot,
+                                           precise_norm, precise_sum,
+                                           tod_numpy_dtype)
+
+# the HONEST bf16 stream tolerance: storage narrowing costs one bf16
+# rounding per sample (eps 7.8e-3); the f32 parity tolerances of
+# tests/test_campaign.py (2e-5) would be a lie here
+BF16_RTOL = 2e-2
+BF16_ATOL = 2e-2
+
+
+# --------------------------------------------------------------------------
+# PrecisionPolicy truth table (satellite b)
+# --------------------------------------------------------------------------
+
+def test_precision_policy_defaults_and_aliases():
+    p = PrecisionPolicy()
+    assert p.tod_dtype == "f32" and p.cg_dot == "f32"
+    assert not p.enabled
+    # dtype aliases normalise; the canonical pair is what keys caches
+    assert PrecisionPolicy(tod_dtype="bfloat16").tod_dtype == "bf16"
+    assert PrecisionPolicy(tod_dtype="fp32").tod_dtype == "f32"
+    assert PrecisionPolicy(tod_dtype="float32").tod_dtype == "f32"
+    assert PrecisionPolicy(tod_dtype="bf16").enabled
+    assert PrecisionPolicy(cg_dot="compensated").enabled
+
+
+def test_precision_policy_value_hashable():
+    a = PrecisionPolicy(tod_dtype="bf16", cg_dot="compensated")
+    b = PrecisionPolicy(tod_dtype="bfloat16", cg_dot="compensated")
+    assert a == b and hash(a) == hash(b)
+    assert a != PrecisionPolicy()
+    assert "bf16" in repr(a)
+
+
+def test_precision_policy_rejects_bad_values():
+    with pytest.raises(ValueError, match="tod_dtype"):
+        PrecisionPolicy(tod_dtype="f16")
+    with pytest.raises(ValueError, match="cg_dot"):
+        PrecisionPolicy(cg_dot="f64")
+
+
+def test_precision_policy_coerce_contract():
+    assert PrecisionPolicy.coerce(None) == PrecisionPolicy()
+    p = PrecisionPolicy(tod_dtype="bf16")
+    assert PrecisionPolicy.coerce(p) is p
+    assert PrecisionPolicy.coerce(
+        {"tod_dtype": "bf16", "cg_dot": "compensated"}).enabled
+    # the [Resilience]/[Destriper] section contract: a typo'd knob
+    # raises at load, never silently runs with the default
+    with pytest.raises(ValueError, match="unknown precision"):
+        PrecisionPolicy.coerce({"tod_dtyp": "bf16"})
+    with pytest.raises(TypeError):
+        PrecisionPolicy.coerce(42)
+
+
+def test_precision_section_from_ini_and_toml(tmp_path):
+    """The two config front doors share the coerce contract: the
+    destriper INI's ``[Precision]`` section and the Runner TOML's
+    ``[precision]`` table both land on ``PrecisionPolicy.coerce``."""
+    from comapreduce_tpu.pipeline import IniConfig, Runner
+
+    ini = IniConfig.from_text(
+        "[Precision]\ntod_dtype : bfloat16\ncg_dot : compensated\n")
+    p = PrecisionPolicy.coerce(dict(ini.get("Precision", {})) or None)
+    assert p == PrecisionPolicy(tod_dtype="bf16", cg_dot="compensated")
+    bad = IniConfig.from_text("[Precision]\ncg_dots : compensated\n")
+    with pytest.raises(ValueError, match="unknown precision"):
+        PrecisionPolicy.coerce(dict(bad.get("Precision", {})) or None)
+    runner = Runner.from_config(
+        {"Global": {"processes": [], "output_dir": str(tmp_path)},
+         "precision": {"tod_dtype": "bf16"}})
+    assert runner.precision == PrecisionPolicy(tod_dtype="bf16")
+    with pytest.raises(ValueError, match="unknown precision"):
+        Runner.from_config(
+            {"Global": {"processes": [], "output_dir": str(tmp_path)},
+             "precision": {"todd_type": "bf16"}})
+
+
+def test_bf16_dense_healpix_combo_rejected(tmp_path):
+    """``tod_dtype = bf16`` with a DENSE HEALPix map vector is the one
+    combination that can never pay for itself — refused loudly at
+    config load (next to the ``compact`` validation), before any
+    campaign-scale ingest starts."""
+    from comapreduce_tpu.cli import run_destriper
+
+    flist = tmp_path / "filelist.txt"
+    flist.write_text("/nonexistent_level2.hd5\n")
+
+    def write_ini(precision_lines):
+        ini = tmp_path / "params.ini"
+        ini.write_text(f"""
+[Inputs]
+filelist : {flist}
+output_dir : {tmp_path}/maps
+
+[Pixelization]
+type : healpix
+nside : 64
+compact : false
+
+[Precision]
+{precision_lines}
+""")
+        return str(ini)
+
+    with pytest.raises(ValueError, match="compact = false"):
+        run_destriper.main([write_ini("tod_dtype : bf16")])
+    # the typo'd-knob half of the hardening, through the same INI door
+    with pytest.raises(ValueError, match="unknown precision"):
+        run_destriper.main([write_ini("tod_dtyp : bf16")])
+
+
+# --------------------------------------------------------------------------
+# payload narrowing (tentpole part 1)
+# --------------------------------------------------------------------------
+
+def _fake_payload():
+    rng = np.random.default_rng(7)
+    return {"data": {
+        "spectrometer/tod":
+            rng.normal(size=(2, 2, 8, 64)).astype(np.float32),
+        "averaged_tod/weights":
+            rng.uniform(1, 2, (2, 2, 64)).astype(np.float32),
+        "spectrometer/MJD": np.linspace(59000.0, 59000.1, 64),
+    }, "attrs": {}}
+
+
+def test_cast_payload_tod_narrows_only_tod():
+    bf = tod_numpy_dtype("bf16")
+    assert tod_numpy_dtype("f32") == np.float32
+    p = _fake_payload()
+    tod_before = p["data"]["spectrometer/tod"].copy()
+    out = cast_payload_tod(p, "bf16")
+    assert out["data"]["spectrometer/tod"].dtype == bf
+    # weights and the time axis keep their width — only the keys in
+    # TOD_PAYLOAD_KEYS narrow
+    assert out["data"]["averaged_tod/weights"].dtype == np.float32
+    assert out["data"]["spectrometer/MJD"].dtype == np.float64
+    assert "spectrometer/tod" in TOD_PAYLOAD_KEYS
+    np.testing.assert_allclose(
+        np.asarray(out["data"]["spectrometer/tod"], np.float32),
+        tod_before, rtol=BF16_RTOL, atol=BF16_ATOL)
+    # f32 policy is the identity (the byte-identical default)
+    q = _fake_payload()
+    arr = q["data"]["spectrometer/tod"]
+    assert cast_payload_tod(q, "f32")["data"]["spectrometer/tod"] is arr
+    # non-payload objects pass through untouched (lazy Level-1 handles)
+    sentinel = object()
+    assert cast_payload_tod(sentinel, "bf16") is sentinel
+
+
+def test_bf16_roundtrip_preserves_nonfinite_and_scrub_semantics():
+    """bf16 shares f32's exponent field, so NaN/Inf survive the
+    narrow — the ``scrub_tod`` tripwire sees exactly the same bad-
+    sample set on a bf16 payload as on the f32 stream."""
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.resilience.tripwires import scrub_tod
+
+    bf = tod_numpy_dtype("bf16")
+    tod = np.array([1.0, np.nan, 2.0, np.inf, -np.inf, 3.0], np.float32)
+    narrowed = tod.astype(bf)
+    assert np.isnan(np.asarray(narrowed, np.float32)[1])
+    assert np.isinf(np.asarray(narrowed, np.float32)[3])
+    w = np.ones_like(tod)
+    t_f, w_f = scrub_tod(jnp.asarray(tod), jnp.asarray(w))
+    t_b, w_b = scrub_tod(jnp.asarray(narrowed).astype(jnp.float32),
+                         jnp.asarray(w))
+    np.testing.assert_array_equal(np.asarray(w_f), np.asarray(w_b))
+    np.testing.assert_array_equal(np.asarray(t_f), np.asarray(t_b))
+    assert np.asarray(w_b).tolist() == [1, 0, 1, 0, 0, 1]
+
+
+def test_prefetch_to_device_cast_hook_halves_h2d_counter(tmp_path):
+    """The H2D ledger measures what was SHIPPED: with the bf16 cast
+    hook installed the ``ingest.h2d.bytes`` counter reads exactly half
+    the f32 bytes for the same blocks."""
+    import jax
+
+    from comapreduce_tpu.ingest import prefetch_to_device
+    from comapreduce_tpu.telemetry import TELEMETRY
+    from comapreduce_tpu.telemetry.reader import read_events
+
+    blocks = [np.zeros((64, 32), np.float32) for _ in range(3)]
+    bf = tod_numpy_dtype("bf16")
+    counts = {}
+    for tag, cast in (("f32", None),
+                      ("bf16", lambda b: b.astype(bf))):
+        tdir = str(tmp_path / f"tele_{tag}")
+        TELEMETRY.configure(tdir, rank=0, flush_s=0.05)
+        try:
+            for out in prefetch_to_device(iter(blocks), size=2,
+                                          cast=cast):
+                jax.block_until_ready(out)
+        finally:
+            TELEMETRY.close()
+        events, _ = read_events(os.path.join(tdir, "events.rank0.jsonl"))
+        counts[tag] = sum(ev["value"] for ev in events
+                          if ev.get("kind") == "counter"
+                          and ev.get("name") == "ingest.h2d.bytes")
+    assert counts["f32"] == 3 * 64 * 32 * 4
+    assert counts["bf16"] == counts["f32"] // 2
+
+
+# --------------------------------------------------------------------------
+# compensated reductions vs the f64 oracle (tentpole part 2)
+# --------------------------------------------------------------------------
+
+def test_precise_dot_vs_f64_oracle():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    n = 100_001
+    x = rng.normal(size=n).astype(np.float32)
+    y = rng.normal(size=n).astype(np.float32)
+    oracle = float(np.dot(x.astype(np.float64), y.astype(np.float64)))
+    naive = float(jnp.dot(jnp.asarray(x), jnp.asarray(y)))
+    comp = float(precise_dot(jnp.asarray(x), jnp.asarray(y)))
+    err_naive = abs(naive - oracle) / abs(oracle)
+    err_comp = abs(comp - oracle) / abs(oracle)
+    # the compensated result sits at the f32 OUTPUT rounding floor
+    # (the hi+lo pair collapses to one f32 at the end) — ~1e-7 relative
+    # — while the naive accumulation drifts with sqrt(n)
+    assert err_comp < 5e-7, (err_comp, err_naive)
+    assert err_comp <= err_naive
+
+
+def test_precise_dot_cancellation_fixture_exact():
+    """The cancellation-heavy fixture naive f32 gets catastrophically
+    wrong: [1e8, 1, -1e8, 1, 3, -3] . ones = 2 exactly — 1 is below
+    1e8's f32 ulp, so a naive left-to-right sum returns 0."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.array([1e8, 1.0, -1e8, 1.0, 3.0, -3.0],
+                             np.float32))
+    assert float(precise_dot(x, jnp.ones_like(x))) == 2.0
+    assert float(precise_sum(x)) == 2.0
+
+
+def test_precise_sum_ill_conditioned_beats_naive():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    n = 1 << 16
+    x = (rng.normal(size=n) * 10.0 ** rng.uniform(0, 6, n)) \
+        .astype(np.float32)
+    oracle = float(np.sum(x.astype(np.float64)))
+    naive = abs(float(jnp.sum(jnp.asarray(x))) - oracle)
+    comp = abs(float(precise_sum(jnp.asarray(x))) - oracle)
+    assert comp <= naive
+
+
+def test_precise_dot_multi_rhs_and_norm():
+    import jax
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 4097)).astype(np.float32)
+    y = rng.normal(size=(4, 4097)).astype(np.float32)
+    got = np.asarray(precise_dot(x, y, axis=-1))
+    assert got.shape == (4,)
+    oracle = np.sum(x.astype(np.float64) * y.astype(np.float64), axis=-1)
+    np.testing.assert_allclose(got, oracle, rtol=5e-7)
+    # precise_norm is the SQUARED norm (what the CG recurrences use)
+    nrm = float(precise_norm(x[0]))
+    assert nrm == pytest.approx(
+        float(np.linalg.norm(x[0].astype(np.float64))) ** 2, rel=5e-7)
+    # survives jit (XLA does not reassociate the two-sum chains)
+    jitted = float(jax.jit(precise_dot)(x[0], y[0]))
+    assert jitted == pytest.approx(
+        float(np.dot(x[0].astype(np.float64),
+                     y[0].astype(np.float64))), rel=5e-7)
+    with pytest.raises(ValueError, match="axis"):
+        precise_dot(x, y, axis=0)
+
+
+# --------------------------------------------------------------------------
+# compensated CG recurrences in the destriper (tentpole part 2)
+# --------------------------------------------------------------------------
+
+def _raster_fixture(T=4000, nx=12, L=50, seed=2):
+    rng = np.random.default_rng(seed)
+    t = np.arange(T)
+    x = t % nx
+    y = (t // nx) % nx
+    pix = (y * nx + x).astype(np.int64)
+    n = (T // L) * L
+    pix = pix[:n]
+    off = np.repeat(np.cumsum(rng.normal(0, 0.5, n // L)), L)
+    sky = rng.normal(0, 1.0, nx * nx)
+    tod = (sky[pix] + off + rng.normal(0, 0.2, n)).astype(np.float32)
+    w = np.ones(n, np.float32)
+    return pix, tod, w, nx * nx, L
+
+
+def test_destripe_cg_dot_compensated_matches_f32():
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import destripe_jit
+
+    pix, tod, w, npix, L = _raster_fixture()
+    r_f = destripe_jit(jnp.asarray(tod), jnp.asarray(pix),
+                       jnp.asarray(w), npix, L, n_iter=60,
+                       threshold=1e-6, cg_dot="f32")
+    r_c = destripe_jit(jnp.asarray(tod), jnp.asarray(pix),
+                       jnp.asarray(w), npix, L, n_iter=60,
+                       threshold=1e-6, cg_dot="compensated")
+    # an easy system: both reach tolerance and agree to f32 roundoff
+    assert float(r_f.residual) <= 1e-6
+    assert float(r_c.residual) <= 1e-6
+    np.testing.assert_allclose(np.asarray(r_c.offsets),
+                               np.asarray(r_f.offsets),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_destripe_planned_cg_dot_and_validation():
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import (destripe,
+                                                     destripe_planned)
+    from comapreduce_tpu.mapmaking.pointing_plan import \
+        build_pointing_plan
+
+    pix, tod, w, npix, L = _raster_fixture()
+    plan = build_pointing_plan(pix, npix, L)
+    r = destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         n_iter=60, threshold=1e-6,
+                         cg_dot="compensated")
+    assert float(r.residual) <= 1e-6
+    # a bogus knob value fails loudly on every entry point
+    with pytest.raises(ValueError, match="cg_dot"):
+        destripe_planned(jnp.asarray(tod), jnp.asarray(w), plan=plan,
+                         cg_dot="f64")
+    with pytest.raises(ValueError, match="cg_dot"):
+        destripe(jnp.asarray(tod), jnp.asarray(pix), jnp.asarray(w),
+                 npix, L, cg_dot="f64")
+
+
+def test_checkpoint_precond_id_discriminates_cg_dot(tmp_path,
+                                                    monkeypatch):
+    """A compensated-dot solve follows a different iterate path than an
+    f32 solve — its snapshot must refuse to resume the other's. The
+    default keeps the PRE-KNOB id byte-identical so snapshots written
+    before the knob existed still load."""
+    import collections
+    import types
+
+    import comapreduce_tpu.cli.run_destriper as rd
+    import comapreduce_tpu.mapmaking.destriper as dst
+
+    seen = {}
+    monkeypatch.setattr(
+        dst, "load_solver_checkpoint",
+        lambda path, precond_id=None: seen.setdefault(
+            "ids", []).append(precond_id))
+    monkeypatch.setattr(
+        dst, "save_solver_checkpoint",
+        lambda path, x, n_done, residuals, precond_id: None)
+    FakeResult = collections.namedtuple(
+        "FakeResult", "n_iter residual offsets")
+    monkeypatch.setattr(
+        rd, "solve_band",
+        lambda data, **kw: FakeResult(np.int32(1), np.float32(1e-9),
+                                      np.zeros(4, np.float32)))
+    data = types.SimpleNamespace(tod=np.zeros(200, np.float32))
+    for cg_dot in ("compensated", "f32"):
+        rd.solve_band_checkpointed(
+            data, str(tmp_path / "snap.npz"), 5, offset_length=50,
+            n_iter=10, threshold=1e-6, cg_dot=cg_dot)
+    comp_id, f32_id = seen["ids"]
+    assert comp_id.endswith("|cgdot=compensated")
+    assert "cgdot" not in f32_id          # old snapshots keep loading
+    assert comp_id != f32_id
+
+
+# --------------------------------------------------------------------------
+# bf16 stream parity through the real chains (satellite c)
+# --------------------------------------------------------------------------
+
+def _chain():
+    from comapreduce_tpu.pipeline.stages import (
+        AssignLevel1Data, AtmosphereRemoval, CheckLevel1File,
+        Level1Averaging, Level1AveragingGainCorrection,
+        MeasureSystemTemperature)
+
+    return [CheckLevel1File(min_duration_seconds=0.0),
+            AssignLevel1Data(), MeasureSystemTemperature(),
+            AtmosphereRemoval(), Level1Averaging(frequency_bin_size=8),
+            Level1AveragingGainCorrection(medfilt_window=101)]
+
+
+def _run_chain(outdir, files, precision=None):
+    from comapreduce_tpu.pipeline import Runner
+
+    # prefetch >= 1 forces the EAGER loader — the path the narrowing
+    # lives on (the serial lazy path returns the h5py handle as-is and
+    # the knob is inert there; the Runner warns about that combination)
+    runner = Runner(processes=_chain(), output_dir=str(outdir),
+                    precision=precision, ingest={"prefetch": 1},
+                    resilience={"quarantine": "off", "heartbeat_s": 0})
+    results = runner.run_tod(files)
+    assert all(r is not None for r in results), "chain failed"
+
+
+def _level2_datasets(outdir):
+    import h5py
+
+    (name,) = [f for f in os.listdir(outdir) if f.startswith("Level2_")]
+    out = {}
+    with h5py.File(os.path.join(str(outdir), name), "r") as h:
+        def visit(path, node):
+            if isinstance(node, h5py.Dataset):
+                out[path] = node[...]
+        h.visititems(visit)
+    return out
+
+
+@pytest.fixture(scope="module")
+def precision_obs(tmp_path_factory):
+    from comapreduce_tpu.data.synthetic import (SyntheticObsParams,
+                                                generate_level1_file)
+
+    d = tmp_path_factory.mktemp("precision_obs")
+    path = str(d / "comap-0000071-synth.hd5")
+    generate_level1_file(path, SyntheticObsParams(
+        n_feeds=2, n_bands=1, n_channels=16, n_scans=3,
+        scan_samples=400, vane_samples=120, seed=71, obsid=71))
+    return path
+
+
+# datasets that never pass through the narrowed TOD payload — bf16
+# streaming must leave them bitwise untouched
+_UNTOUCHED = ("spectrometer/MJD", "spectrometer/frequency",
+              "spectrometer/pixel_pointing/pixel_az",
+              "spectrometer/pixel_pointing/pixel_el",
+              "spectrometer/pixel_pointing/pixel_ra",
+              "spectrometer/pixel_pointing/pixel_dec")
+# calibrated products: one bf16 rounding per raw sample, accumulated in
+# f32 — per-element parity at the bf16 envelope holds
+_CALIBRATED = ("vane/system_temperature", "vane/system_gain",
+               "frequency_binned/tod")
+
+
+def test_bf16_stream_band_average_parity(precision_obs, tmp_path):
+    """The reduction chain under ``tod_dtype = bf16`` vs the f32
+    stream, with HONEST per-dataset expectations.
+
+    Calibrated products (Tsys, gain, band averages) carry one bf16
+    rounding per sample into an f32 accumulator and land within the
+    bf16 envelope per element. Fluctuation-level intermediates
+    (mean-removed ``averaged_tod``, the degenerate atmosphere fit
+    coefficients, in-bin stddevs) do NOT admit per-element parity:
+    bf16 rounds the RAW counts at ~eps/sqrt(12) ≈ 0.23% rms of the
+    mean, the same order as the per-sample fluctuation signal itself,
+    and the gain-fit division amplifies the redistribution — so those
+    are pinned statistically (same finite mask, rms difference bounded
+    by the f32 signal's own rms scale), never per element. The
+    rounding noise is white and averages down: the destriped-map
+    parity test below is where it provably washes out."""
+    _run_chain(tmp_path / "f32", [precision_obs])
+    _run_chain(tmp_path / "bf16", [precision_obs],
+               precision={"tod_dtype": "bf16"})
+    exact = _level2_datasets(tmp_path / "f32")
+    narrowed = _level2_datasets(tmp_path / "bf16")
+    assert set(exact) == set(narrowed)
+    checked = 0
+    any_bits_moved = False
+    for path in sorted(exact):
+        a, b = exact[path], narrowed[path]
+        assert a.shape == b.shape, path
+        assert a.dtype == b.dtype, path   # products keep their dtype
+        if not np.issubdtype(a.dtype, np.floating):
+            continue
+        checked += 1
+        if not np.array_equal(a, b, equal_nan=True):
+            any_bits_moved = True
+        if path in _UNTOUCHED:
+            assert np.array_equal(a, b, equal_nan=True), \
+                f"{path}: non-TOD dataset changed under bf16 streaming"
+        elif path in _CALIBRATED:
+            np.testing.assert_allclose(
+                b, a, rtol=BF16_RTOL, atol=BF16_ATOL, equal_nan=True,
+                err_msg=path)
+        else:
+            # fluctuation-level: statistical envelope only
+            ma, mb = np.isfinite(a), np.isfinite(b)
+            assert np.array_equal(ma, mb), \
+                f"{path}: finite mask changed under bf16"
+            if ma.any():
+                rms_sig = float(np.sqrt(np.mean(a[ma] ** 2)))
+                rms_d = float(np.sqrt(np.mean((a[ma] - b[ma]) ** 2)))
+                assert rms_d <= 3.0 * max(rms_sig, BF16_ATOL), \
+                    (f"{path}: rms diff {rms_d:.4g} blows past the "
+                     f"signal rms {rms_sig:.4g}")
+    assert checked > 0
+    # vacuity guard: bf16 rounding of the raw counts MUST change some
+    # output bits — bitwise-identical runs mean the narrowing never
+    # happened (e.g. the stream silently fell back to the lazy loader)
+    assert any_bits_moved, \
+        "bf16 run bitwise-identical to f32: narrowing did not happen"
+
+
+def test_bf16_stream_destriped_map_parity(precision_obs, tmp_path):
+    """Level-2 read back with ``tod_dtype = bf16`` destripes to the
+    same map as the f32 stream within the bf16 envelope (the host
+    widens at extraction; the CG itself always runs f32)."""
+    from comapreduce_tpu.cli.run_destriper import solve_band
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.wcs import WCS
+
+    _run_chain(tmp_path / "l2", [precision_obs])
+    outdir = str(tmp_path / "l2")
+    (name,) = [f for f in os.listdir(outdir) if f.startswith("Level2_")]
+    l2 = [os.path.join(outdir, name)]
+    wcs = WCS.from_field((170.0, 52.0), (2.0 / 60, 2.0 / 60), (48, 48))
+    maps = {}
+    for dtype in ("f32", "bf16"):
+        data = read_comap_data(l2, band=0, wcs=wcs, offset_length=50,
+                               medfilt_window=51, use_calibration=False,
+                               tod_dtype=dtype)
+        assert data.tod.dtype == np.float32   # widened at extraction
+        maps[dtype] = np.asarray(
+            solve_band(data, offset_length=50, n_iter=50,
+                       threshold=1e-5).destriped_map)
+    np.testing.assert_allclose(maps["bf16"], maps["f32"],
+                               rtol=BF16_RTOL, atol=BF16_ATOL,
+                               equal_nan=True)
+
+
+# --------------------------------------------------------------------------
+# products are never narrowed (satellite f)
+# --------------------------------------------------------------------------
+
+def test_tile_blob_bytes_dtype_stable():
+    """``CMTL1`` is little-endian f32 by spec: the encoder casts, so a
+    map that arrives as bf16 (a leak) or f64 serialises to the SAME
+    bytes as its f32 value — tile hashes cannot depend on the upstream
+    policy."""
+    from comapreduce_tpu.tiles.blob import decode_tile, encode_tile
+
+    rng = np.random.default_rng(9)
+    vals = rng.normal(size=(8, 8)).astype(np.float32)
+    bf = tod_numpy_dtype("bf16")
+    vals_bf = vals.astype(bf)   # the would-be leak
+    geo = dict(x0=0, y0=0, w=8, h=8)
+    blob_f32 = encode_tile("wcs", 0,
+                           {"DESTRIPED": np.asarray(vals_bf,
+                                                    np.float32)}, **geo)
+    blob_bf = encode_tile("wcs", 0, {"DESTRIPED": vals_bf}, **geo)
+    blob_f64 = encode_tile("wcs", 0,
+                           {"DESTRIPED": np.asarray(vals_bf,
+                                                    np.float64)}, **geo)
+    assert blob_f32 == blob_bf == blob_f64
+    out = decode_tile(blob_bf)
+    assert out["products"]["DESTRIPED"].dtype == np.float32
+
+
+def test_band_map_writer_forces_f32_products(tmp_path):
+    """``band_map_writer`` casts and asserts f32 on every map product:
+    a bf16 result coming off a narrowed pipeline still writes standard
+    BITPIX -32 FITS (the ``_data_bytes`` table has no bf16 row — a
+    leak would KeyError, not silently write garbage)."""
+    from comapreduce_tpu.cli.run_destriper import band_map_writer
+    from comapreduce_tpu.mapmaking.fits_io import read_fits_image
+
+    bf = tod_numpy_dtype("bf16")
+    n = 12
+    rng = np.random.default_rng(13)
+
+    class Data:
+        wcs = None
+        nside = 1
+        sky_pixels = np.arange(n, dtype=np.int64)
+        pixel_space = None
+
+    class Result:
+        destriped_map = rng.normal(size=n).astype(bf)
+        naive_map = rng.normal(size=n).astype(bf)
+        weight_map = np.ones(n, bf)
+        hit_map = np.ones(n, np.float32)
+        sky_pixels = None
+
+    path = str(tmp_path / "band0.fits")
+    band_map_writer(path, Data(), Result())()
+    hdus = read_fits_image(path)
+    by_name = {name: data for name, hdr, data in hdus}
+    for nm in ("DESTRIPED", "NAIVE", "WEIGHTS", "HITS"):
+        assert by_name[nm].dtype.kind == "f"
+        assert by_name[nm].dtype.itemsize == 4, nm
+    np.testing.assert_allclose(
+        by_name["DESTRIPED"][:n],
+        np.asarray(Result.destriped_map, np.float32))

@@ -77,6 +77,17 @@ with the tile count EQUAL to the ``PixelSpace``-derived sparse count
 (empty sky must cost nothing). Both halves are byte/count comparisons
 of one deterministic fixture against itself — machine-independent;
 ``--no-tiles`` skips.
+
+The precision gate (ISSUE 13) also runs by default: one ``bench.py
+--config precision`` smoke must show (a) the bf16 run's
+``ingest.h2d.bytes`` counter at or under 0.55x the f32 run's on the
+SAME filelist (the streaming policy actually halves what crosses the
+bus — a counter ratio of one run against itself, never a wall clock),
+(b) the CG iters-to-tol ladder ordered: every rung the f32 dots reach,
+the compensated dots reach too (and the bench must report the stall
+edge, measured-present or documented-absent), and (c) bf16 storage
+parity: converged offsets within a bf16-eps-scaled envelope of the f32
+stream. All machine-independent; ``--no-precision`` skips.
 """
 
 from __future__ import annotations
@@ -302,6 +313,43 @@ def run_tiles_gate() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def run_precision_bench() -> dict:
+    """One small-shape precision bench child -> its parsed JSON line."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_SMALL": "1",
+        "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
+        "BENCH_EVIDENCE": "0",
+    })
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                          "--config", "precision"],
+                         env=env, capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py --config precision failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == "precision_h2d_bytes_ratio":
+            return rec
+    raise RuntimeError("no precision result line in bench.py output")
+
+
+#: ISSUE 13 H2D ceiling: with ``tod_dtype=bf16`` the counter-measured
+#: bytes must be at or under 0.55x the f32 run's — 0.5 is a pure-TOD
+#: payload; the 0.05 headroom covers the non-TOD arrays (MJD etc.) that
+#: keep their width. Machine-independent: a ratio of one process's
+#: ``ingest.h2d.bytes`` counter against itself, never a wall clock.
+H2D_BYTES_RATIO_MAX = 0.55
+
+#: bf16 parity envelope multiplier: converged offsets from a
+#: bf16-round-tripped stream must land within this many bf16 epsilons
+#: (7.8e-3, scaled by the offset magnitude) of the f32 stream's.
+BF16_PARITY_EPS_MULT = 4.0
+
+
 #: compacted-path memory budget multiplier: the exact device footprint
 #: of the four map products is 4 B x (3 n_bands + 1) x n_compact
 #: (per-band destriped/naive/weight + shared hits); the gate allows 2x
@@ -355,6 +403,8 @@ def main(argv=None) -> int:
                     help="skip the fused-kernel pass-budget/parity gate")
     ap.add_argument("--no-tiles", action="store_true",
                     help="skip the tile-tier delta/byte-budget gate")
+    ap.add_argument("--no-precision", action="store_true",
+                    help="skip the precision H2D/CG-ladder/parity gate")
     args = ap.parse_args(argv)
 
     best: dict | None = None
@@ -608,10 +658,60 @@ def main(argv=None) -> int:
                 f"the exact-payload + header budget "
                 f"{hp['budget_bytes']} B for {hp['n_compact']} seen "
                 "pixels — tile bytes stopped scaling with coverage")
+    precision = None
+    if not args.no_precision:
+        # every half machine-independent (ISSUE 13): a bytes-counter
+        # ratio of one run against itself, an ordering of iteration
+        # counts on one deterministic fixture, and an eps-scaled
+        # max|diff| of two solves in the same process
+        p = run_precision_bench()
+        det = p["detail"]
+        par = det["bf16_parity"]
+        precision = {
+            "h2d_bytes": det["h2d_bytes"],
+            "h2d_ratio": p["value"],
+            "stall_edge": det.get("stall_edge"),
+            "parity_offsets_maxdiff": par["offsets_maxdiff"],
+            "cg_iters": {m: [r["n_iter"] for r in rows]
+                         for m, rows in det["cg_ladder"].items()},
+        }
+        if p["value"] > H2D_BYTES_RATIO_MAX:
+            failures.append(
+                f"precision: bf16 H2D bytes ratio {p['value']:.3f} > "
+                f"{H2D_BYTES_RATIO_MAX} of the f32 run "
+                f"({det['h2d_bytes']}) — the streaming policy stopped "
+                "narrowing what actually crosses the bus (a silent "
+                "upcast before device_put?)")
+        ladder = det["cg_ladder"]
+        for i, f32_row in enumerate(ladder["f32"]):
+            comp_row = ladder["compensated"][i]
+            if f32_row["reached"] and not comp_row["reached"]:
+                failures.append(
+                    f"precision: compensated CG dots failed the "
+                    f"{f32_row['threshold']:g} rung that plain f32 dots "
+                    f"reach (residual {comp_row['residual']:.3g} after "
+                    f"{comp_row['n_iter']} iters) — the two-sum "
+                    "recurrences are hurting, not helping")
+        if det.get("stall_edge") in (None, ""):
+            failures.append(
+                "precision: bench reported no stall_edge field — the "
+                "ladder contract requires the f32 stall tolerance to be "
+                "measured-present or documented-absent, never omitted")
+        envelope = (BF16_PARITY_EPS_MULT * par["bf16_eps"]
+                    * max(par["offsets_scale"], 1.0))
+        if par["offsets_maxdiff"] > envelope:
+            failures.append(
+                f"precision: bf16-stream converged offsets drift "
+                f"{par['offsets_maxdiff']:.3g} > the "
+                f"{BF16_PARITY_EPS_MULT:g}x bf16-eps envelope "
+                f"{envelope:.3g} — storage narrowing is leaking into "
+                "the solve beyond representation error (an accumulator "
+                "went bf16?)")
     print(json.dumps({"ok": not failures, "failures": failures,
                       "current": cur, "campaign": campaign,
                       "destriper": destriper, "serving": serving,
                       "kernels": kernels, "tiles": tiles,
+                      "precision": precision,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))
