@@ -43,6 +43,7 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     figure_dir = ""
     retry_quarantined = False
+    live_port = None
     rest = []
     for a in argv:
         if a == "--figures":
@@ -54,12 +55,16 @@ def main(argv=None) -> int:
             # (each re-admission is itself a ledger event; see
             # docs/OPERATIONS.md §7)
             retry_quarantined = True
+        elif a.startswith("--live-port="):
+            # live observability sidecar (docs/OPERATIONS.md §16):
+            # /metrics, /healthz, /v1/campaign over this run's state
+            live_port = int(a.split("=", 1)[1])
         else:
             rest.append(a)
     if len(rest) != 1:
         print("usage: python -m comapreduce_tpu.cli.run_average "
               "[--figures[=DIR]] [--retry-quarantined] "
-              "configuration.toml", file=sys.stderr)
+              "[--live-port=N] configuration.toml", file=sys.stderr)
         return 2
     config = load_toml(rest[0])
     glob = config.get("Global", {})
@@ -76,6 +81,15 @@ def main(argv=None) -> int:
     set_logging(base="run_average", log_dir=log_dir,
                 rank=rank, level=str(glob.get("log_level", "INFO")))
     runner = Runner.from_config(config, rank=rank, n_ranks=n_ranks)
+    live = None
+    if live_port is not None and rank == 0:
+        # rank 0 only: the plane reads EVERY rank's on-disk state, so
+        # one sidecar per campaign is the whole picture
+        from comapreduce_tpu.telemetry.live import LiveServer
+
+        live = LiveServer(runner.state_dir or runner.output_dir,
+                          port=live_port, n_ranks=n_ranks).start()
+        print(f"live plane: http://{live.host}:{live.port}/metrics")
     if n_ranks > 1:
         res = runner._resilience_runtime()
         if res.lease_ttl_s > 0:
@@ -128,6 +142,8 @@ def main(argv=None) -> int:
         TELEMETRY.close()  # drain the event buffer before exit
         print(f"telemetry: {TELEMETRY.path} "
               f"(merge with tools/campaign_report.py)")
+    if live is not None:
+        live.stop()
     return 0
 
 

@@ -1005,9 +1005,16 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     retry_quarantined = "--retry-quarantined" in argv
     argv = [a for a in argv if a != "--retry-quarantined"]
+    live_port = None
+    for a in list(argv):
+        if a.startswith("--live-port="):
+            # live observability sidecar (docs/OPERATIONS.md §16)
+            live_port = int(a.split("=", 1)[1])
+            argv.remove(a)
     if len(argv) != 1:
         print("usage: python -m comapreduce_tpu.cli.run_destriper "
-              "[--retry-quarantined] parameters.ini", file=sys.stderr)
+              "[--retry-quarantined] [--live-port=N] parameters.ini",
+              file=sys.stderr)
         return 2
     from comapreduce_tpu.parallel.multihost import rank_info
 
@@ -1143,6 +1150,34 @@ def main(argv=None) -> int:
     resilience = res_cfg.make_runtime(out_dir, rank=rank,
                                       n_ranks=n_ranks,
                                       state_dir=state_dir)
+    live = None
+    if live_port is not None and rank == 0:
+        # one sidecar per campaign (rank 0): the plane reads every
+        # rank's state off disk (docs/OPERATIONS.md §16)
+        from comapreduce_tpu.telemetry.live import LiveServer
+
+        live = LiveServer(state_dir, port=live_port,
+                          stale_s=res_cfg.lease_ttl_s or 60.0,
+                          n_ranks=n_ranks).start()
+        print(f"live plane: http://{live.host}:{live.port}/metrics")
+    # [Slo] exclude_flagged (docs/OPERATIONS.md §16, default OFF): drop
+    # files whose latest quality record violated an SLO rule, the same
+    # way quarantined files drop out — the reduction campaign ledgered
+    # the evidence, this is the one knob that acts on it
+    from comapreduce_tpu.telemetry.quality import (SloConfig,
+                                                   flagged_files)
+
+    slo_cfg = SloConfig.coerce(dict(ini.get("Slo", {})) or None)
+    if slo_cfg.exclude_flagged:
+        bad = flagged_files(state_dir)
+        kept = [f for f in filelist
+                if os.path.basename(f) not in bad]
+        if len(kept) < len(filelist):
+            logger.warning(
+                "[Slo] exclude_flagged: dropping %d of %d file(s) "
+                "with flagged quality records",
+                len(filelist) - len(kept), len(filelist))
+        filelist = kept
     writeback = None
     if ingest_cfg.writeback >= 1:
         # async map writeback (docs/OPERATIONS.md §9): band N+1's CG
@@ -1335,6 +1370,8 @@ def main(argv=None) -> int:
               f"{resilience.ledger.summary()}")
     if resilience.heartbeat is not None:
         resilience.heartbeat.stop(final_stage="run_destriper.done")
+    if live is not None:
+        live.stop()
     return 0
 
 

@@ -147,6 +147,18 @@ class Runner:
     # identity policy — byte-identical behaviour
     # (docs/OPERATIONS.md §15).
     precision: object = None
+    # data-quality ledger knob (TOML [quality] / INI [Quality]):
+    # QualityConfig | {"enabled": ...} | None. Enabled (the default)
+    # assembles one quality record per (file, feed, band) — vane
+    # Tsys/gain, white sigma + 1/f knee/alpha, spike count, masked
+    # fraction — after each file's stage chain, appended to
+    # <state_dir>/quality.rank{r}.jsonl (docs/OPERATIONS.md §16)
+    quality: object = None
+    # SLO thresholds over quality records (TOML [slo] / INI [Slo]):
+    # SloConfig | mapping | None. Violations flag the record and fire
+    # a quality.alert telemetry counter; run_destriper can exclude
+    # flagged files behind [slo] exclude_flagged (default off)
+    slo: object = None
     # cumulative async-writeback stats ({"writes", "write_s",
     # "flush_wait_s", ...}) across this Runner's run_tod calls — the
     # bench's write-overlap observable
@@ -202,10 +214,17 @@ class Runner:
         from comapreduce_tpu.ops.precision import PrecisionPolicy
 
         os.makedirs(self.output_dir, exist_ok=True)
+        from comapreduce_tpu.telemetry.quality import (QualityConfig,
+                                                       SloConfig)
+
         cfg = IngestConfig.coerce(self.ingest)
         camp = CampaignConfig.coerce(self.campaign)
         tcfg = TelemetryConfig.coerce(self.telemetry)
         prec = PrecisionPolicy.coerce(self.precision)
+        # validate [quality]/[slo] up front so a typo'd knob raises at
+        # run start, not inside the per-file best-effort ledger path
+        QualityConfig.coerce(self.quality)
+        SloConfig.coerce(self.slo)
         if tcfg.enabled and not TELEMETRY.enabled:
             # the registry is process-wide: the first enabled Runner
             # opens this rank's stream; sub-runs (run_astro_cal) and
@@ -485,6 +504,7 @@ class Runner:
                 n_ok += 1
                 if hb is not None:
                     hb.advance(files_done=1)
+                self._ledger_quality(item.filename, value, res)
             except Exception as exc:
                 logger.exception("BAD FILE %s", item.filename)
                 # never quarantine the INPUT over a stage-chain error:
@@ -518,6 +538,35 @@ class Runner:
             label=f"stage chain {item.filename}")
         res.record_recovered(item.filename, retries, stage="stage_chain")
         return value
+
+    def _ledger_quality(self, filename: str, value, res) -> None:
+        """Assemble + ledger the per-(feed, band) quality records for
+        one finished file (docs/OPERATIONS.md §16). Strictly
+        best-effort: quality bookkeeping must never fail a file whose
+        science chain just succeeded, so every exception is logged and
+        swallowed."""
+        try:
+            from comapreduce_tpu.ops.precision import PrecisionPolicy
+            from comapreduce_tpu.telemetry import quality as q
+
+            qcfg = q.QualityConfig.coerce(self.quality)
+            if not qcfg.enabled or value is None:
+                return
+            slo = q.SloConfig.coerce(self.slo)
+            prec = PrecisionPolicy.coerce(self.precision)
+            records = q.assemble_quality_records(
+                value, filename, rank=self.rank,
+                precision_id=f"tod={prec.tod_dtype}|cgdot={prec.cg_dot}",
+                masked=q.masked_from_ledger(res.ledger, filename))
+            for rec in records:
+                rec["flags"] = q.evaluate_record(rec, slo)
+                rec["flagged"] = bool(rec["flags"])
+            q.append_quality(
+                q.quality_path(self.state_dir or self.output_dir,
+                               self.rank), records)
+            q.emit_alerts(records)
+        except Exception:
+            logger.exception("quality ledger failed for %s", filename)
 
     def _needs_tod(self, filename: str) -> bool:
         """False when every OUTPUT-producing stage of this file's chain
@@ -726,6 +775,7 @@ class Runner:
                      n_ranks=self.n_ranks, timings=self.timings,
                      ingest=self.ingest, resilience=self.resilience,
                      telemetry=self.telemetry,
+                     quality=self.quality, slo=self.slo,
                      state_dir=self.state_dir,
                      _ingest_cache=self._ingest_cache,
                      _resilience=res)
@@ -760,6 +810,8 @@ class Runner:
         from comapreduce_tpu.ops.precision import PrecisionPolicy
         from comapreduce_tpu.pipeline.campaign import CampaignConfig
         from comapreduce_tpu.resilience import ResilienceConfig
+        from comapreduce_tpu.telemetry.quality import (QualityConfig,
+                                                       SloConfig)
 
         if isinstance(config, str):
             config = cfg_mod.load_toml(config)
@@ -795,7 +847,11 @@ class Runner:
                    # [precision] tod_dtype/cg_dot: the end-to-end
                    # precision policy (docs/OPERATIONS.md §15)
                    precision=PrecisionPolicy.coerce(
-                       config.get("precision")))
+                       config.get("precision")),
+                   # [quality]/[slo]: the data-quality ledger and its
+                   # declarative thresholds (docs/OPERATIONS.md §16)
+                   quality=QualityConfig.coerce(config.get("quality")),
+                   slo=SloConfig.coerce(config.get("slo")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,
@@ -807,6 +863,8 @@ class Runner:
         from comapreduce_tpu.ingest import IngestConfig
         from comapreduce_tpu.pipeline.campaign import CampaignConfig
         from comapreduce_tpu.resilience import ResilienceConfig
+        from comapreduce_tpu.telemetry.quality import (QualityConfig,
+                                                       SloConfig)
 
         ini = cfg_mod.IniConfig(ini_path)
         processes = [resolve(name, **kwargs)
@@ -829,4 +887,8 @@ class Runner:
                    campaign=CampaignConfig.coerce(
                        dict(ini.get("Campaign", {}))),
                    telemetry=TelemetryConfig.coerce(
-                       dict(ini.get("Telemetry", {})) or None))
+                       dict(ini.get("Telemetry", {})) or None),
+                   quality=QualityConfig.coerce(
+                       dict(ini.get("Quality", {})) or None),
+                   slo=SloConfig.coerce(
+                       dict(ini.get("Slo", {})) or None))

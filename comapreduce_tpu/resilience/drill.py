@@ -82,8 +82,8 @@ import time
 
 import numpy as np
 
-__all__ = ["run_drill", "run_elastic_drill", "run_serving_drill",
-           "run_tiles_drill"]
+__all__ = ["run_drill", "run_elastic_drill", "run_live_drill",
+           "run_serving_drill", "run_tiles_drill"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -618,6 +618,169 @@ def run_elastic_drill(workdir: str, seed: int = 0, n_files: int = 7,
     }
 
 
+def run_live_drill(workdir: str, seed: int = 0, n_files: int = 6,
+                   ttl_s: float = 2.0, timeout_s: float = 180.0) -> dict:
+    """Criterion 8: the live observability plane over a real elastic
+    campaign with a real SIGKILL (docs/OPERATIONS.md §16).
+
+    Two worker ranks share one lease directory; rank 1 draws
+    ``rank_kill`` on its first unit and dies mid-claim; rank 0 (the
+    survivor) steals the leaked lease and drains the queue; rank 1 is
+    then RESTARTED (a restarted rank beats again and finds every unit
+    done elsewhere). A :class:`telemetry.live.LiveServer` watches the
+    state dir throughout.
+
+    Asserts: ``/healthz`` flips to 503 within one heartbeat TTL of the
+    SIGKILL and back to 200 after the steal + restart (clean ``.done``
+    heartbeats probe healthy); the ``/metrics`` Prometheus page parses
+    line-by-line and its ``comap_scheduler_committed_total`` summed
+    across ranks equals the scheduler's own commit count EXACTLY (one
+    counter event per commit — the live file-done count is trustworthy);
+    and ``/v1/campaign`` serves the schema-2 report.
+    """
+    import json
+    import re as _re
+    import shutil
+    import subprocess
+    import sys
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    from comapreduce_tpu.telemetry.live import LiveServer
+
+    t0 = time.perf_counter()
+    os.makedirs(workdir, exist_ok=True)
+    files = []
+    for i in range(n_files):
+        path = os.path.join(workdir, f"Level2_comap-{i:04d}.hd5")
+        if not os.path.exists(path):
+            _write_level2(path, seed=1000 + seed * 10 + i)
+        files.append(os.path.abspath(path))
+    state = os.path.join(workdir, "live")
+    shutil.rmtree(state, ignore_errors=True)
+    os.makedirs(state)
+    flist = os.path.join(state, "filelist.txt")
+    with open(flist, "w", encoding="utf-8") as f:
+        f.write("\n".join(files) + "\n")
+    kill_target = os.path.basename(files[1])
+    env = _child_env()
+    srv = LiveServer(state, port=0, stale_s=ttl_s, n_ranks=2).start()
+
+    def spawn(rank: int, **kw):
+        cmd = [sys.executable, "-m", "comapreduce_tpu.resilience.drill",
+               f"--rank={rank}", "--n-ranks=2", f"--state-dir={state}",
+               f"--filelist={flist}", f"--ttl={ttl_s}",
+               f"--seed={seed}", "--telemetry"]
+        cmd += [f"--{k.replace('_', '-')}={v}" for k, v in kw.items()]
+        return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+
+    def wait(pr):
+        try:
+            stdout, _ = pr.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+            stdout, _ = pr.communicate()
+        return pr.returncode, (stdout or b"").decode(errors="replace")
+
+    def probe() -> int:
+        try:
+            with urlopen(f"http://{srv.host}:{srv.port}/healthz",
+                         timeout=10) as r:
+                return r.status
+        except URLError as exc:
+            code = getattr(exc, "code", None)
+            if code is not None:
+                return int(code)  # urlopen raises on 503
+            raise
+
+    def poll_until(status: int, deadline_s: float, what: str) -> float:
+        t_start = time.monotonic()
+        while True:
+            if probe() == status:
+                return time.monotonic() - t_start
+            if time.monotonic() - t_start > deadline_s:
+                raise AssertionError(
+                    f"criterion 8: /healthz never reached {status} "
+                    f"within {deadline_s:.1f} s ({what})")
+            time.sleep(0.05)
+
+    try:
+        killer = spawn(1, chaos=f"rank_kill@{kill_target}")
+        survivor = spawn(0, wait_for=kill_target)
+        rc_kill, out_kill = wait(killer)
+        t_kill = time.monotonic()
+        assert rc_kill == -9, \
+            f"criterion 8: rank_kill rank exited {rc_kill}, expected " \
+            f"SIGKILL (-9):\n{out_kill}"
+        # the dead rank's heartbeat freezes mid-stage: the probe must
+        # flip unhealthy within one TTL of the kill (plus poll slack)
+        poll_until(503, ttl_s + 2.0, "after SIGKILL")
+        t_503 = time.monotonic() - t_kill
+        rc_surv, out_surv = wait(survivor)
+        assert rc_surv == 0, \
+            f"criterion 8: survivor failed ({rc_surv}):\n{out_surv}"
+        # the survivor finished cleanly (.done) but the dead rank's
+        # stale beat still pins the probe at 503 — only a restart (or
+        # operator retirement of the rank) clears it
+        assert probe() == 503, \
+            "criterion 8: /healthz went 200 while the killed rank's " \
+            "stale heartbeat was still unresolved"
+        rc_again, out_again = wait(spawn(1))
+        assert rc_again == 0, \
+            f"criterion 8: restarted rank failed ({rc_again}):" \
+            f"\n{out_again}"
+        poll_until(200, 10.0, "after steal + restart")
+
+        with urlopen(f"http://{srv.host}:{srv.port}/metrics",
+                     timeout=10) as r:
+            assert r.status == 200
+            prom = r.read().decode("utf-8")
+        line_re = _re.compile(
+            r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^{}]*\})? \S+$")
+        bad = [ln for ln in prom.splitlines()
+               if ln and not ln.startswith("#")
+               and not line_re.match(ln)]
+        assert not bad, \
+            f"criterion 8: unparseable /metrics line(s): {bad[:3]}"
+        committed_metric = 0.0
+        for ln in prom.splitlines():
+            if ln.startswith("comap_scheduler_committed_total{"):
+                committed_metric += float(ln.rsplit(" ", 1)[1])
+        results = {}
+        for rank in (0, 1):
+            with open(os.path.join(state, f"result.rank{rank}.json"),
+                      encoding="utf-8") as f:
+                results[rank] = json.load(f)
+        committed_true = sum(r["stats"]["committed"]
+                             for r in results.values())
+        # EXACT: every scheduler commit emitted exactly one counter
+        # event and the tail absorbed every one of them
+        assert committed_metric == committed_true == n_files, \
+            f"criterion 8: /metrics committed {committed_metric} != " \
+            f"scheduler committed {committed_true} (n_files {n_files})"
+        assert "comap_live_healthy 1" in prom, \
+            "criterion 8: /metrics lacks comap_live_healthy 1"
+        with urlopen(f"http://{srv.host}:{srv.port}/v1/campaign",
+                     timeout=10) as r:
+            rep = json.load(r)
+        assert rep.get("schema") == 2 and not rep.get("n_stale"), \
+            f"criterion 8: /v1/campaign unhealthy after recovery: " \
+            f"{ {k: rep.get(k) for k in ('schema', 'n_stale')} }"
+    finally:
+        srv.stop()
+
+    return {
+        "live_t_503_after_kill_s": round(t_503, 3),
+        "live_ttl_s": ttl_s,
+        "live_committed_metric": committed_metric,
+        "live_committed_true": committed_true,
+        "live_metrics_lines": len(prom.splitlines()),
+        "live_requests": srv.stats["n_requests"],
+        "live_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 def _elastic_worker_main(argv=None) -> int:
     """One elastic-drill rank (the ``python -m`` entry): heartbeat +
     scheduler over the shared state dir, committing every claimed unit.
@@ -645,9 +808,16 @@ def _elastic_worker_main(argv=None) -> int:
     p.add_argument("--wait-for", default="")
     p.add_argument("--hold-s", type=float, default=0.0)
     p.add_argument("--max-files", type=int, default=0)
+    p.add_argument("--telemetry", action="store_true")
     a = p.parse_args(argv)
     with open(a.filelist, encoding="utf-8") as f:
         files = [ln.strip() for ln in f if ln.strip()]
+    if a.telemetry:
+        # the live drill scrapes this rank's counter stream off disk
+        # while it runs — flush fast so commits land within a poll
+        from comapreduce_tpu.telemetry import TELEMETRY
+
+        TELEMETRY.configure(a.state_dir, rank=a.rank, flush_s=0.2)
     hb = Heartbeat(a.state_dir, rank=a.rank,
                    period_s=max(a.ttl / 5.0, 0.05))
     hb.start()
@@ -684,6 +854,10 @@ def _elastic_worker_main(argv=None) -> int:
         json.dump(out, f)
     os.replace(tmp, os.path.join(a.state_dir,
                                  f"result.rank{a.rank}.json"))
+    if a.telemetry:
+        from comapreduce_tpu.telemetry import TELEMETRY
+
+        TELEMETRY.close()  # drain the counter buffer before exit
     hb.stop(final_stage="drill.elastic.done")
     return 0
 

@@ -45,7 +45,7 @@ import threading
 import time
 
 __all__ = ["Heartbeat", "heartbeat_age_s", "heartbeat_path",
-           "read_heartbeats"]
+           "heartbeat_stale", "read_heartbeats", "stale_age"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -96,6 +96,28 @@ def heartbeat_age_s(hb: dict, now: float | None = None) -> float:
             now - float(hb.get("_mtime", 0.0))]
     valid = [a for a in ages if a >= 0.0]
     return min(valid) if valid else min(ages)
+
+
+def stale_age(age: float, ttl: float) -> bool:
+    """The ONE out-of-range predicate applied to a heartbeat age: too
+    old is dead, and a NEGATIVE age (future clock, see
+    :func:`heartbeat_age_s`) is a skewed host with no live evidence —
+    stale on either side. Every consumer of the rule — the operator
+    report, the lease scheduler's ``expired()``, the serving watcher's
+    freshness view and the live ``/healthz`` probe — must route through
+    here (or :func:`heartbeat_stale`) so the definitions cannot
+    drift."""
+    return not 0.0 <= age <= ttl
+
+
+def heartbeat_stale(hb: dict | None, now: float | None = None,
+                    ttl: float = 60.0) -> bool:
+    """``True`` when ``hb`` shows no evidence of life within ``ttl``
+    seconds: missing heartbeat, or :func:`heartbeat_age_s` out of the
+    ``[0, ttl]`` band (:func:`stale_age`)."""
+    if hb is None:
+        return True
+    return stale_age(heartbeat_age_s(hb, now), ttl)
 
 
 class Heartbeat:

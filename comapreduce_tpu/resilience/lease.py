@@ -50,7 +50,7 @@ import time
 from typing import NamedTuple
 
 from comapreduce_tpu.data.durable import durable_replace, fsync_path
-from comapreduce_tpu.resilience.heartbeat import (heartbeat_age_s,
+from comapreduce_tpu.resilience.heartbeat import (heartbeat_stale,
                                                   read_heartbeats)
 
 __all__ = ["Lease", "LeaseBoard", "lease_key", "lease_path", "read_lease"]
@@ -151,9 +151,7 @@ class LeaseBoard:
             return False
         hb = read_heartbeats(self.heartbeat_dir).get(int(st.get("owner",
                                                                 -1)))
-        if hb is None:
-            return True
-        return not 0.0 <= heartbeat_age_s(hb, now) <= self.lease_ttl_s
+        return heartbeat_stale(hb, now, self.lease_ttl_s)
 
     # -- writers -------------------------------------------------------------
     def claim(self, filename: str) -> Lease | None:

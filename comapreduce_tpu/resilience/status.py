@@ -1,0 +1,192 @@
+"""Campaign status as data: the schema-2 watchdog report, as a library.
+
+Relocated from ``tools/watchdog_report.py`` so the live observability
+plane (:mod:`comapreduce_tpu.telemetry.live` — the ``/v1/campaign``
+endpoint and the ``/healthz`` probe) and the CLI report render the SAME
+report from the SAME rules; the tool is now a thin wrapper. Staleness
+is judged exclusively through
+:func:`comapreduce_tpu.resilience.heartbeat.heartbeat_stale` /
+:func:`~comapreduce_tpu.resilience.heartbeat.stale_age` — one home for
+the out-of-range predicate, shared with the lease scheduler's
+``expired()``.
+
+``build_report`` reads every ``heartbeat.rank*.json``,
+``quarantine*.jsonl``, ``lease.*.json`` and the ``queue.json`` manifest
+in the run's state directory and answers the on-call questions in one
+dict: which ranks are alive, where each one is, which operations
+stalled or hung, which units the run deferred or durably skipped, and —
+for elastic campaigns (docs/OPERATIONS.md §11) — who holds which lease
+at what generation and whether any expired lease sits unreclaimed.
+
+Probe policy (the exit-code / ``/healthz`` rule): a campaign is
+UNHEALTHY when any expected rank's heartbeat is stale OR any lease is
+expired-but-unreclaimed — :func:`report_healthy`.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import time
+
+from comapreduce_tpu.resilience.heartbeat import (heartbeat_age_s,
+                                                  heartbeat_stale,
+                                                  read_heartbeats,
+                                                  stale_age)
+from comapreduce_tpu.resilience.ledger import QuarantineLedger
+from comapreduce_tpu.resilience.lease import read_lease
+
+__all__ = ["build_report", "report_healthy", "resolve_state_dir"]
+
+
+def resolve_state_dir(output_dir: str) -> str:
+    """The directory actually holding the run state: ``output_dir``
+    itself, else its ``logs/`` child (the default ``[Global] log_dir``
+    routing) when only that one has state files."""
+
+    def has_state(d: str) -> bool:
+        return any(_glob.glob(os.path.join(d, pat))
+                   for pat in ("heartbeat.rank*.json", "lease.*.json",
+                               "queue.json", "quarantine*.jsonl"))
+
+    logs = os.path.join(output_dir, "logs")
+    if not has_state(output_dir) and os.path.isdir(logs) \
+            and has_state(logs):
+        return logs
+    return output_dir
+
+
+def build_report(output_dir: str, stale_s: float = 60.0,
+                 n_ranks: int = 0) -> dict:
+    """The report as data (rendering and exit policy live with the
+    callers — ``tools/watchdog_report.py`` and the live plane)."""
+    now = time.time()
+    output_dir = resolve_state_dir(output_dir)
+    beats = read_heartbeats(output_dir)
+    expected = range(n_ranks) if n_ranks > 0 else sorted(beats)
+    ranks = []
+    for r in expected:
+        hb = beats.get(r)
+        if hb is None:
+            ranks.append({"rank": r, "present": False, "stale": True})
+            continue
+        age = heartbeat_age_s(hb, now)
+        # a rank that wrote its terminal beat ("<phase>.done" final
+        # stage) exited cleanly and is not expected to beat again — a
+        # finished campaign must probe healthy, not rot into 503/exit-1
+        # once the TTL passes its last beat
+        done = str(hb.get("stage", "")).endswith(".done")
+        ranks.append({
+            "rank": r, "present": True, "done": done,
+            "age_s": round(age, 1),
+            # out-of-range on EITHER side is stale: too old is dead,
+            # and a negative age (future clock) is a skewed host with
+            # no live evidence — exit-1 material for the cron probe
+            "stale": not done and stale_age(age, stale_s),
+            "stage": hb.get("stage", ""),
+            "unit": hb.get("unit", ""),
+            "seq": hb.get("seq", 0),
+            "pid": hb.get("pid"),
+            "host": hb.get("host", ""),
+            "progress": hb.get("progress", {}),
+            "deadline": hb.get("deadline"),
+        })
+
+    # one merged read-only view over every rank's ledger file
+    ledgers = sorted(_glob.glob(os.path.join(output_dir,
+                                             "quarantine*.jsonl")))
+    entries = []
+    summary: dict = {}
+    stalls, hangs = [], []
+    if ledgers:
+        led = QuarantineLedger(ledgers[0],
+                               read_paths=tuple(ledgers[1:]))
+        entries = led.entries
+        summary = led.summary()
+        for e in entries:
+            if e.failure_class != "hang":
+                continue
+            row = {"t": e.t, "unit": e.unit.get("file", ""),
+                   "stage": e.stage, "message": e.message,
+                   "disposition": e.disposition}
+            (stalls if e.disposition == "stalled" else hangs).append(row)
+
+    queue, leases = _queue_report(output_dir, beats, stale_s, now)
+    return {
+        "schema": 2,
+        "output_dir": output_dir,
+        "stale_s": stale_s,
+        "ranks": ranks,
+        "n_stale": sum(1 for r in ranks if r["stale"]),
+        "ledger_files": [os.path.basename(p) for p in ledgers],
+        "ledger_summary": summary,
+        "n_ledger_events": len(entries),
+        "n_stolen": sum(1 for e in entries
+                        if e.disposition == "stolen"),
+        "stalls": stalls[-20:],
+        "hangs": hangs[-20:],
+        "queue": queue,
+        "leases": leases,
+        "n_expired_leases": sum(1 for l in leases if l["expired"]),
+    }
+
+
+def report_healthy(rep: dict) -> bool:
+    """The probe rule shared by the CLI exit code and ``/healthz``: an
+    expired-but-unreclaimed lease means work nobody will finish —
+    fail it like a stale rank."""
+    return not (rep["n_stale"] or rep["n_expired_leases"])
+
+
+def _queue_report(state_dir: str, beats: dict, stale_s: float,
+                  now: float) -> tuple:
+    """Elastic-campaign state: the ``queue.json`` manifest summary and
+    one row per ``lease.*.json``. ``expired`` marks a lease whose
+    owner shows no live heartbeat within ``stale_s`` yet which no
+    survivor has reclaimed — the signal that a campaign is wedged
+    (no rank left to steal)."""
+    leases = []
+    for p in sorted(_glob.glob(os.path.join(state_dir, "lease.*.json"))):
+        try:
+            age = now - os.stat(p).st_mtime
+        except OSError:
+            continue  # vanished mid-scan (a commit or steal in flight)
+        st = read_lease(p)
+        if st is None:
+            # torn lease: no valid owner to be alive — reclaimable
+            # (and 'expired' for the probe) once past the TTL
+            leases.append({"key": os.path.basename(p), "state": "torn",
+                           "owner": None, "generation": None,
+                           "age_s": round(age, 1),
+                           "expired": age > stale_s})
+            continue
+        row = {"key": st.get("key", os.path.basename(p)),
+               "state": st.get("state", "?"),
+               "owner": st.get("owner"),
+               "generation": st.get("generation"),
+               "stolen_from": st.get("stolen_from"),
+               "done_by": st.get("done_by"),
+               "age_s": round(age, 1), "expired": False}
+        if row["state"] == "claimed" and age > stale_s:
+            hb = beats.get(int(st.get("owner", -1)))
+            row["expired"] = heartbeat_stale(hb, now, stale_s)
+        leases.append(row)
+
+    queue = None
+    qpath = os.path.join(state_dir, "queue.json")
+    try:
+        with open(qpath, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = None
+    if manifest is not None or leases:
+        n_files = len((manifest or {}).get("files", [])) or len(leases)
+        n_done = sum(1 for l in leases if l["state"] == "done")
+        n_claimed = sum(1 for l in leases if l["state"] == "claimed")
+        queue = {"n_files": n_files, "n_done": n_done,
+                 "n_claimed": n_claimed,
+                 "n_pending": max(n_files - len(leases), 0),
+                 "n_torn": sum(1 for l in leases
+                               if l["state"] == "torn")}
+    return queue, leases

@@ -156,6 +156,11 @@ class MapServer:
         self._agg: dict[str, _FileAggregate] = {}
         self._missing_warned: set = set()
         self.stats = self._load_stats()
+        # epoch/census/freshness gauges on the shared telemetry stream
+        # (and so on the live /metrics plane). register_gauge no-ops
+        # while telemetry is disabled, so _write_stats re-attempts —
+        # a server built before TELEMETRY.configure still shows up
+        self._gauges_registered = self._register_gauges()
         # crash recovery BEFORE the first poll: dead publish temps go,
         # an orphan epoch (publisher died between rename and swap)
         # becomes current — readers and the fence baseline agree again
@@ -656,7 +661,24 @@ class MapServer:
             pass
         return {"schema": 1, "epochs": [], "fence_rejects": 0}
 
+    def _register_gauges(self) -> bool:
+        if not TELEMETRY.enabled:
+            return False
+        TELEMETRY.register_gauge(
+            "serving.current_epoch",
+            lambda: float(self.store.current() or 0))
+        TELEMETRY.register_gauge(
+            "serving.files_served", lambda: float(len(self.ledger)))
+        TELEMETRY.register_gauge(
+            "serving.epoch_age_s",
+            lambda: max(0.0, float(self.now())
+                        - float((self.stats.get("epochs") or
+                                 [{}])[-1].get("t_publish_unix", 0.0))))
+        return True
+
     def _write_stats(self) -> None:
+        if not self._gauges_registered:
+            self._gauges_registered = self._register_gauges()
         st = dict(self.stats)
         st["schema"] = 1
         st["current_epoch"] = self.store.current()

@@ -5,7 +5,13 @@ consumer (CLI summary, ``tools/campaign_report.py``, the perf gates)
 reads out of — see docs/OPERATIONS.md §13.
 
 Import surface is deliberately light (stdlib only at import time):
-``TELEMETRY`` is safe to touch from any hot path.
+``TELEMETRY`` is safe to touch from any hot path. The live
+observability plane (``telemetry/live.py`` — streaming ``/metrics`` /
+``/healthz`` / ``/v1/campaign`` sidecar) and the data-quality ledger
+(``telemetry/quality.py``) pull in numpy/resilience and are imported
+as submodules by their consumers, never here; the run registry
+(``telemetry/registry.py``) is stdlib-only and re-exported.
+See docs/OPERATIONS.md §16.
 """
 
 from comapreduce_tpu.telemetry.core import (SERVING_LANE_BASE, TELEMETRY,
@@ -15,7 +21,11 @@ from comapreduce_tpu.telemetry.core import (SERVING_LANE_BASE, TELEMETRY,
 from comapreduce_tpu.telemetry.reader import (MergedStream,
                                               merge_streams,
                                               read_events)
+from comapreduce_tpu.telemetry.registry import (default_registry_path,
+                                                read_runs, record_run,
+                                                trend)
 
 __all__ = ["TELEMETRY", "Telemetry", "TelemetryConfig", "StageTimings",
            "MergedStream", "merge_streams", "read_events",
-           "serving_lane_rank", "SERVING_LANE_BASE"]
+           "serving_lane_rank", "SERVING_LANE_BASE",
+           "default_registry_path", "read_runs", "record_run", "trend"]

@@ -1,0 +1,470 @@
+"""The live observability plane: tail the event streams, serve HTTP.
+
+``tools/campaign_report.py`` is post-hoc — it merges a FINISHED run's
+streams. This module watches a RUNNING campaign:
+
+- :class:`LiveTail` incrementally tails every ``events.rank*.jsonl``
+  in a state directory with per-file byte offsets, consuming only
+  complete lines (a torn tail from an in-flight write — or from a
+  crashed writer, later healed by the flush discipline's prepended
+  newline — is simply left for the next poll; a complete-but-torn line
+  is dropped like every JSONL reader here). Counters accumulate,
+  gauges keep the last level, span durations feed bounded p50/p95
+  windows — all without re-reading a byte twice.
+- :class:`LiveServer` is a stdlib HTTP sidecar in the style of
+  ``tiles/http.py``:
+
+  ==================  ==============================================
+  ``/metrics``        Prometheus text (the ``prom_snapshot`` format
+                      family): counter totals, gauge levels, span
+                      p50/p95 summaries — plus live heartbeat ages,
+                      scheduler queue depth, serving freshness and
+                      quality-ledger flag counts.
+  ``/healthz``        exit-code-honest liveness: 200 when every
+                      expected rank beats within ``stale_s`` and no
+                      lease is expired-unreclaimed, 503 otherwise
+                      (same :func:`resilience.status.report_healthy`
+                      rule as ``watchdog_report``'s exit code).
+  ``/v1/campaign``    the schema-2 watchdog report as JSON.
+  ``/v1/quality``     quality-ledger summary (records, flags, worst
+                      feeds by knee).
+  ==================  ==============================================
+
+Exposed via ``tools/campaign_watch.py`` (serve/status/check) and the
+``--live-port`` flag on ``run_average`` / ``run_destriper`` /
+``map_server.py serve``. Scrapes never write: the plane is a read-only
+observer of the same on-disk state every other consumer uses, so it
+can run inside a rank, beside one, or on another host sharing the
+filesystem.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlsplit
+
+from comapreduce_tpu.resilience.status import (build_report,
+                                               report_healthy,
+                                               resolve_state_dir)
+from comapreduce_tpu.resilience.watchdog import percentile
+from comapreduce_tpu.telemetry.quality import flag_counts, read_quality
+from comapreduce_tpu.telemetry.report import _prom_name
+
+__all__ = ["LiveServer", "LiveTail", "PROM_CONTENT_TYPE"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+_JSON = "application/json"
+
+_RANK_RE = re.compile(r"events\.rank(\d+)\.jsonl$")
+
+#: span-duration window per name: quantiles are over the most recent
+#: samples (a live plane answers "how slow is it NOW"), while count
+#: and sum stay whole-history so rates and totals are exact
+SPAN_WINDOW = 2048
+
+
+class LiveTail:
+    """Incremental, torn-line-tolerant tail over a directory's
+    ``events.rank*.jsonl`` streams. :meth:`poll` consumes whatever
+    complete lines appeared since the last poll; accessors read the
+    accumulated state. Not thread-safe by itself — the server
+    serialises polls under a lock."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir or "."
+        self._files: dict = {}  # path -> {"offset", "rank", "align"}
+        self.counters: dict = {}  # (name, rank) -> total
+        self.gauges: dict = {}    # (name, rank) -> last value
+        self.span_windows: dict = {}  # name -> deque[dur]
+        self.span_totals: dict = {}   # name -> [count, sum]
+        self.last_event_t: dict = {}  # rank -> aligned wall seconds
+        self.dropped_lines = 0
+        self.events_consumed = 0
+
+    def poll(self) -> int:
+        """Consume new complete lines from every stream; returns the
+        number of events absorbed this poll."""
+        import glob as _glob
+
+        absorbed = 0
+        for path in sorted(_glob.glob(os.path.join(
+                self.log_dir, "events.rank*.jsonl"))):
+            absorbed += self._poll_file(path)
+        return absorbed
+
+    def _poll_file(self, path: str) -> int:
+        m = _RANK_RE.search(path)
+        state = self._files.get(path)
+        if state is None:
+            state = self._files[path] = {
+                "offset": 0, "rank": int(m.group(1)) if m else 0,
+                "align": 0.0}
+        try:
+            size = os.stat(path).st_size
+        except OSError:
+            return 0
+        if size < state["offset"]:
+            state["offset"] = 0  # replaced/rotated stream: restart
+        if size == state["offset"]:
+            return 0
+        try:
+            with open(path, "rb") as f:
+                f.seek(state["offset"])
+                chunk = f.read()
+        except OSError:
+            return 0
+        # consume only COMPLETE lines: a partial tail is an append in
+        # flight (or a crashed writer's stump the next flush will
+        # heal) — leave it for a later poll, never parse half a record
+        cut = chunk.rfind(b"\n")
+        if cut < 0:
+            return 0
+        state["offset"] += cut + 1
+        n = 0
+        for line in chunk[:cut].split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                ev = json.loads(line)
+            except Exception:
+                self.dropped_lines += 1
+                continue
+            if not isinstance(ev, dict):
+                self.dropped_lines += 1
+                continue
+            self._absorb(ev, state)
+            n += 1
+        self.events_consumed += n
+        return n
+
+    def _absorb(self, ev: dict, state: dict) -> None:
+        kind = ev.get("kind")
+        if kind == "meta":
+            state["rank"] = int(ev.get("rank", state["rank"]))
+            state["align"] = float(ev.get("wall0", 0.0)) \
+                - float(ev.get("mono0", 0.0))
+            return
+        rank = state["rank"]
+        t = float(ev.get("mono", 0.0)) + state["align"]
+        if kind == "counter":
+            key = (ev.get("name", ""), rank)
+            self.counters[key] = self.counters.get(key, 0.0) \
+                + float(ev.get("value", 0.0))
+        elif kind == "gauge":
+            self.gauges[(ev.get("name", ""), rank)] = \
+                float(ev.get("value", 0.0))
+        elif kind == "span":
+            attrs = ev.get("attrs") or {}
+            if not attrs.get("skipped"):
+                name = ev.get("name", "")
+                win = self.span_windows.get(name)
+                if win is None:
+                    win = self.span_windows[name] = \
+                        collections.deque(maxlen=SPAN_WINDOW)
+                tot = self.span_totals.setdefault(name, [0, 0.0])
+                dur = float(ev.get("dur", 0.0))
+                win.append(dur)
+                tot[0] += 1
+                tot[1] += dur
+                t += dur
+        # 'begin' advances the liveness clock too: an open span is
+        # still evidence the rank was alive at its start
+        self.last_event_t[rank] = max(self.last_event_t.get(rank, 0.0),
+                                      t)
+
+    def counter_total(self, name: str) -> float:
+        """One counter summed across ranks (e.g. the scheduler's
+        ``scheduler.committed`` — the live file-done count)."""
+        return sum(v for (n, _r), v in self.counters.items()
+                   if n == name)
+
+
+class _HTTPError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+class LiveServer:
+    """Serve one campaign state directory's live view over HTTP.
+
+    ``port=0`` binds an ephemeral port (tests/drills); the bound port
+    is ``self.port``. ``stale_s`` is the /healthz heartbeat TTL (pass
+    the campaign's ``lease_ttl_s``); ``n_ranks`` pins the expected
+    rank count so a rank that never wrote a heartbeat still fails the
+    probe. Run with :meth:`serve_forever` (blocking) or :meth:`start`
+    (daemon thread — the sidecar mode the CLIs use).
+    """
+
+    def __init__(self, state_dir: str, host: str = "127.0.0.1",
+                 port: int = 0, *, stale_s: float = 60.0,
+                 n_ranks: int = 0, stats_path: str = ""):
+        self.root = state_dir or "."
+        self.stale_s = float(stale_s)
+        self.n_ranks = int(n_ranks)
+        # the map server's stats file lives in its EPOCHS root, not the
+        # campaign state dir — pass it when serving beside one
+        self.stats_path = str(stats_path or "")
+        self._lock = threading.Lock()
+        self._tail: LiveTail | None = None
+        self.stats = {"t_start_unix": time.time(), "n_requests": 0,
+                      "n_errors": 0, "by_route": {}}
+        self.httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.app = self
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        logger.info("live plane on http://%s:%d/ (state %s)", self.host,
+                    self.port, self.root)
+        self.httpd.serve_forever(poll_interval=0.2)
+
+    def start(self) -> "LiveServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="live-plane", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- shared state ------------------------------------------------------
+
+    def _state_dir(self) -> str:
+        # resolved per request: the logs/ child may not exist until
+        # the campaign's first write
+        return resolve_state_dir(self.root)
+
+    def tail(self) -> LiveTail:
+        """Poll-and-return the (lazily created) stream tail."""
+        with self._lock:
+            d = self._state_dir()
+            if self._tail is None or self._tail.log_dir != d:
+                self._tail = LiveTail(d)
+            self._tail.poll()
+            return self._tail
+
+    def report(self) -> dict:
+        return build_report(self.root, stale_s=self.stale_s,
+                            n_ranks=self.n_ranks)
+
+    # -- routing -----------------------------------------------------------
+
+    def handle(self, path: str) -> tuple[str, int, str, bytes]:
+        """``(route, status, content_type, body)`` for one request."""
+        parts = [p for p in path.split("/") if p]
+        if parts == ["metrics"]:
+            return ("metrics", 200, PROM_CONTENT_TYPE,
+                    self.prom_text().encode("utf-8"))
+        if parts == ["healthz"]:
+            rep = self.report()
+            ok = report_healthy(rep)
+            body = json.dumps(
+                {"ok": ok, "n_stale": rep["n_stale"],
+                 "n_expired_leases": rep["n_expired_leases"],
+                 "stale_s": rep["stale_s"],
+                 "ranks": [{"rank": r["rank"],
+                            "stale": r["stale"],
+                            "age_s": r.get("age_s")}
+                           for r in rep["ranks"]]},
+                sort_keys=True).encode("utf-8") + b"\n"
+            return "healthz", (200 if ok else 503), _JSON, body
+        if parts == ["v1", "campaign"]:
+            return ("campaign", 200, _JSON,
+                    json.dumps(self.report(), sort_keys=True)
+                    .encode("utf-8") + b"\n")
+        if parts == ["v1", "quality"]:
+            return ("quality", 200, _JSON,
+                    json.dumps(self.quality_summary(), sort_keys=True)
+                    .encode("utf-8") + b"\n")
+        raise _HTTPError(404, f"no route for {path} (want /metrics, "
+                              "/healthz, /v1/campaign, /v1/quality)")
+
+    def quality_summary(self) -> dict:
+        from comapreduce_tpu.telemetry.quality import worst_feeds
+
+        records = read_quality(self._state_dir())
+        return {
+            "n_records": len(records),
+            "n_flagged": sum(1 for r in records if r.get("flagged")),
+            "flag_counts": flag_counts(records),
+            "worst_feeds": [
+                {"file": r["file"], "feed": r["feed"],
+                 "band": r["band"], "fknee_hz": r["fknee_hz"]}
+                for r in worst_feeds(records, 5)],
+        }
+
+    # -- /metrics rendering ------------------------------------------------
+
+    def prom_text(self) -> str:
+        """The live Prometheus page: the tail's counters/gauges/span
+        summaries in ``prom_snapshot``'s exact format family, then the
+        campaign-state gauges only a live observer can provide."""
+        tail = self.tail()
+        out = []
+        for (name, rank), total in sorted(tail.counters.items()):
+            mname = _prom_name(name) + "_total"
+            out.append(f"# TYPE {mname} counter")
+            out.append(f'{mname}{{rank="{rank}"}} {total:g}')
+        for (name, rank), value in sorted(tail.gauges.items()):
+            mname = _prom_name(name)
+            out.append(f"# TYPE {mname} gauge")
+            out.append(f'{mname}{{rank="{rank}"}} {value:g}')
+        for name in sorted(tail.span_windows):
+            win = list(tail.span_windows[name])
+            if not win:
+                continue
+            count, total = tail.span_totals[name]
+            base = _prom_name(name) + "_seconds"
+            out.append(f"# TYPE {base} summary")
+            for q in (50.0, 95.0):
+                out.append(f'{base}{{quantile="{q / 100:g}"}} '
+                           f"{percentile(win, q):g}")
+            out.append(f"{base}_sum {total:g}")
+            out.append(f"{base}_count {count}")
+        out.extend(self._campaign_metrics())
+        out.append(f"# TYPE comap_live_dropped_lines counter")
+        out.append(f"comap_live_dropped_lines {tail.dropped_lines}")
+        return "\n".join(out) + "\n"
+
+    def _campaign_metrics(self) -> list:
+        rep = self.report()
+        out = []
+
+        def gauge(name, value, labels=""):
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name}{labels} {value:g}")
+
+        for r in rep["ranks"]:
+            labels = f'{{rank="{r["rank"]}"}}'
+            if r.get("present"):
+                out.append("# TYPE comap_live_heartbeat_age_seconds "
+                           "gauge")
+                out.append(
+                    f"comap_live_heartbeat_age_seconds{labels} "
+                    f"{r['age_s']:g}")
+            out.append("# TYPE comap_live_rank_stale gauge")
+            out.append(f"comap_live_rank_stale{labels} "
+                       f"{1 if r['stale'] else 0}")
+        gauge("comap_live_ranks_stale", rep["n_stale"])
+        gauge("comap_live_expired_leases", rep["n_expired_leases"])
+        gauge("comap_live_healthy", 1 if report_healthy(rep) else 0)
+        q = rep.get("queue")
+        if q:
+            for k in ("n_files", "n_done", "n_claimed", "n_pending",
+                      "n_torn"):
+                gauge(f"comap_live_queue_{k[2:]}", q[k])
+        out.extend(self._freshness_metrics())
+        records = read_quality(self._state_dir())
+        gauge("comap_quality_records", len(records))
+        gauge("comap_quality_flagged",
+              sum(1 for r in records if r.get("flagged")))
+        for rule, n in sorted(flag_counts(records).items()):
+            out.append("# TYPE comap_quality_flags gauge")
+            out.append(f'comap_quality_flags{{rule="{rule}"}} {n}')
+        return out
+
+    def _freshness_metrics(self) -> list:
+        """Serving freshness: the age of the newest committed unit
+        (from the done leases), and — when a map server shares the
+        state dir — its published epoch + stats-file age."""
+        out = []
+        now = time.time()
+        d = self._state_dir()
+        try:
+            from comapreduce_tpu.serving.watcher import scan_committed
+
+            done = scan_committed(d)
+        except Exception:
+            done = {}
+        stamps = [float(p.get("t_done_unix", 0.0))
+                  for p in done.values() if p.get("t_done_unix")]
+        if stamps:
+            out.append("# TYPE comap_live_commit_freshness_seconds "
+                       "gauge")
+            out.append(f"comap_live_commit_freshness_seconds "
+                       f"{max(0.0, now - max(stamps)):g}")
+        stats_path = self.stats_path \
+            or os.path.join(d, "server.stats.json")
+        try:
+            with open(stats_path, "r", encoding="utf-8") as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            return out
+        if st.get("current_epoch") is not None:
+            out.append("# TYPE comap_live_serving_epoch gauge")
+            out.append(f"comap_live_serving_epoch "
+                       f"{int(st['current_epoch'])}")
+        if st.get("t_update_unix"):
+            out.append("# TYPE comap_live_serving_freshness_seconds "
+                       "gauge")
+            out.append(
+                f"comap_live_serving_freshness_seconds "
+                f"{max(0.0, now - float(st['t_update_unix'])):g}")
+        return out
+
+    def _account(self, route: str, status: int) -> None:
+        with self._lock:
+            self.stats["n_requests"] += 1
+            if status >= 500 and route != "healthz":
+                self.stats["n_errors"] += 1
+            br = self.stats["by_route"]
+            br[route] = br.get(route, 0) + 1
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "comap-live/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+        logger.debug("live-plane %s - %s", self.address_string(),
+                     fmt % args)
+
+    def do_GET(self):  # noqa: N802 - stdlib casing
+        self._serve(send_body=True)
+
+    def do_HEAD(self):  # noqa: N802 - stdlib casing
+        self._serve(send_body=False)
+
+    def _serve(self, send_body: bool) -> None:
+        app: LiveServer = self.server.app
+        url = urlsplit(self.path)
+        route = "error"
+        try:
+            route, status, ctype, body = app.handle(url.path)
+        except _HTTPError as exc:
+            status, ctype = exc.status, _JSON
+            body = json.dumps({"error": str(exc)}).encode("utf-8") \
+                + b"\n"
+        except Exception as exc:  # a bug must 500, not kill the thread
+            logger.exception("live-plane error on %s", self.path)
+            status, ctype = 500, _JSON
+            body = json.dumps({"error": f"internal: {exc}"}) \
+                .encode("utf-8") + b"\n"
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            if send_body:
+                self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # reader hung up mid-write; nothing to do
+        app._account(route, status)

@@ -1,0 +1,209 @@
+"""Cross-run regression registry: ``evidence/runs.jsonl``.
+
+Every completed campaign / bench / perf-gate run appends ONE summary
+record — key throughputs, CG iteration counts, gate verdicts, git sha —
+so the ``evidence/BENCH_*.json`` trajectory finally has a
+machine-readable time series behind it. ``tools/campaign_watch.py
+trend`` compares the latest record against the trailing window and
+exits nonzero on regression: a perf cliff becomes an alert, not
+archaeology.
+
+Record schema (one JSON object per line)::
+
+    {"schema": 1, "kind": "campaign" | "bench" | "perf_gate",
+     "t": "2026-08-05T07:00:00Z", "t_unix": 1785913200.0,
+     "git_sha": "cc6d92b...", "host": "vm", "ok": true,
+     "metrics": {"files_per_s": 3.2, "cg_iters": 41, ...}}
+
+Metric direction is inferred from the key name (``trend``): suffixes
+``_per_s`` / ``_throughput`` / ``_rate`` are higher-is-better;
+``_s`` / ``_seconds`` / ``_ms`` / ``_iters`` / ``_errors`` /
+``_failures`` are lower-is-better; anything else is informational and
+never gates. Appends use the quarantine ledger's torn-line-safe
+discipline; reads drop unparseable lines.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import subprocess
+import time
+
+__all__ = ["default_registry_path", "format_trend", "read_runs",
+           "record_run", "trend"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+RUNS_SCHEMA = 1
+
+_LOWER_BETTER = ("_s", "_seconds", "_ms", "_iters", "_errors",
+                 "_failures")
+_HIGHER_BETTER = ("_per_s", "_throughput", "_rate")
+
+
+def default_registry_path() -> str:
+    """``$COMAP_RUNS_REGISTRY`` when set, else ``evidence/runs.jsonl``
+    next to the package checkout (the directory the BENCH_*.json
+    snapshots already live in)."""
+    env = os.environ.get("COMAP_RUNS_REGISTRY", "")
+    if env:
+        return env
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "evidence", "runs.jsonl")
+
+
+def _git_sha() -> str:
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        out = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
+                             capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
+def record_run(kind: str, metrics: dict, *, ok: bool = True,
+               path: str | None = None, git_sha: str | None = None,
+               extra: dict | None = None) -> dict:
+    """Append one run-summary record; returns it. Non-finite / non-
+    numeric metric values are stringified rather than rejected (a
+    crashed bench's partial summary is still evidence). I/O failures
+    are logged and swallowed — the registry must never fail a run."""
+    path = path or default_registry_path()
+    clean = {}
+    for k, v in (metrics or {}).items():
+        try:
+            clean[str(k)] = float(v)
+        except (TypeError, ValueError):
+            clean[str(k)] = str(v)
+    rec = {"schema": RUNS_SCHEMA, "kind": str(kind),
+           "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "t_unix": time.time(),
+           "git_sha": _git_sha() if git_sha is None else git_sha,
+           "host": socket.gethostname(), "ok": bool(ok),
+           "metrics": clean}
+    if extra:
+        rec.update({k: v for k, v in extra.items() if k not in rec})
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        needs_nl = False
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                needs_nl = f.read(1) != b"\n"
+        except OSError:
+            pass
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(("\n" if needs_nl else "")
+                    + json.dumps(rec, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as exc:
+        logger.warning("run registry append to %s failed (%s: %s)",
+                       path, type(exc).__name__, exc)
+    return rec
+
+
+def read_runs(path: str | None = None, *,
+              kind: str | None = None) -> list:
+    """All parseable run records in append (time) order, optionally
+    filtered by ``kind``."""
+    path = path or default_registry_path()
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return []
+    runs = []
+    for line in raw.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except Exception:
+            continue
+        if not isinstance(rec, dict) or "metrics" not in rec:
+            continue
+        if kind is not None and rec.get("kind") != kind:
+            continue
+        runs.append(rec)
+    return runs
+
+
+def _direction(key: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 informational."""
+    for suf in _HIGHER_BETTER:
+        if key.endswith(suf):
+            return 1
+    for suf in _LOWER_BETTER:
+        if key.endswith(suf):
+            return -1
+    return 0
+
+
+def trend(runs: list, *, window: int = 5,
+          tolerance: float = 0.2) -> dict:
+    """Compare the LATEST run against the trailing window.
+
+    For every directional metric present in the latest record and in
+    at least one baseline record, the baseline is the window median;
+    a regression is the latest being worse than baseline by more than
+    ``tolerance`` (fractional). A latest record with ``ok: false``
+    (a failed gate) is always a regression. Returns ``{"ok", "n_runs",
+    "n_baseline", "regressions": [...], "checked": [...]}`` —
+    ``ok: True`` with fewer than 2 runs (nothing to compare yet).
+    """
+    if len(runs) < 2:
+        return {"ok": True, "n_runs": len(runs), "n_baseline": 0,
+                "regressions": [], "checked": []}
+    latest = runs[-1]
+    baseline = runs[max(0, len(runs) - 1 - window):-1]
+    regressions, checked = [], []
+    if latest.get("ok") is False:
+        regressions.append({"metric": "ok", "latest": 0.0,
+                            "baseline": 1.0, "ratio": 0.0,
+                            "direction": "gate"})
+    for key, value in sorted((latest.get("metrics") or {}).items()):
+        d = _direction(key)
+        if d == 0 or not isinstance(value, (int, float)):
+            continue
+        base_vals = sorted(
+            r["metrics"][key] for r in baseline
+            if isinstance((r.get("metrics") or {}).get(key),
+                          (int, float)))
+        if not base_vals:
+            continue
+        med = base_vals[len(base_vals) // 2]
+        checked.append(key)
+        if med == 0:
+            continue
+        ratio = float(value) / float(med)
+        worse = ratio < 1.0 - tolerance if d > 0 \
+            else ratio > 1.0 + tolerance
+        if worse:
+            regressions.append({
+                "metric": key, "latest": float(value),
+                "baseline": float(med), "ratio": round(ratio, 4),
+                "direction": "higher_better" if d > 0
+                else "lower_better"})
+    return {"ok": not regressions, "n_runs": len(runs),
+            "n_baseline": len(baseline), "regressions": regressions,
+            "checked": checked}
+
+
+def format_trend(res: dict) -> str:
+    lines = [f"trend: latest vs trailing {res['n_baseline']} run(s) — "
+             + ("OK" if res["ok"] else
+                f"{len(res['regressions'])} REGRESSION(S)")]
+    for r in res["regressions"]:
+        lines.append(
+            f"  {r['metric']}: {r['latest']:g} vs baseline "
+            f"{r['baseline']:g} (x{r['ratio']:g}, {r['direction']})")
+    if res["checked"]:
+        lines.append("  checked: " + ", ".join(res["checked"]))
+    return "\n".join(lines)

@@ -130,7 +130,7 @@ class TileServer:
         self.httpd.app = self
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: threading.Thread | None = None
-        self._register_gauges()
+        self._gauges_registered = self._register_gauges()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -154,11 +154,15 @@ class TileServer:
 
     # -- telemetry ---------------------------------------------------------
 
-    def _register_gauges(self) -> None:
+    def _register_gauges(self) -> bool:
         from comapreduce_tpu.telemetry import TELEMETRY
 
         if not TELEMETRY.enabled:
-            return
+            # register_gauge no-ops while telemetry is disabled, so a
+            # server built BEFORE TELEMETRY.configure would silently
+            # never export its gauges — _account re-attempts on the
+            # first request after telemetry comes up
+            return False
         TELEMETRY.register_gauge("serving.tiles.current_epoch",
                                  lambda: self.tiles.current())
         TELEMETRY.register_gauge("serving.tiles.freshness_s",
@@ -166,6 +170,7 @@ class TileServer:
         TELEMETRY.register_gauge(
             "serving.tiles.http.requests_total",
             lambda: self.stats["n_requests"])
+        return True
 
     def _freshness_s(self) -> float | None:
         """Age of the CURRENT tile set — the staleness a reader who
@@ -192,6 +197,8 @@ class TileServer:
             br["n"] += 1
             br["bytes"] += n_bytes
         if TELEMETRY.enabled:
+            if not self._gauges_registered:
+                self._gauges_registered = self._register_gauges()
             TELEMETRY.counter("serving.tiles.http.requests",
                               route=route, status=int(status))
             if n_bytes:

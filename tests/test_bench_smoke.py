@@ -148,6 +148,16 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                         lambda: json.loads(json.dumps(kern)))
     monkeypatch.setattr(cp, "run_precision_bench",
                         lambda: json.loads(json.dumps(prec)))
+    qual = {"n_files": 3, "poisoned": "Level2_comap-0001.hd5",
+            "flagged": ["Level2_comap-0001.hd5"],
+            "flag_counts": {"masked_high": 1}, "n_records": 6,
+            "n_flagged_records": 1, "n_alerts": 1,
+            "max_nonfinite_fraction": 0.1, "masked_threshold": 0.01}
+    monkeypatch.setattr(cp, "run_quality_gate",
+                        lambda: json.loads(json.dumps(qual)))
+    # keep the run-registry appends out of the repo's real evidence/
+    monkeypatch.setenv("COMAP_RUNS_REGISTRY",
+                       str(tmp_path / "runs.jsonl"))
     monkeypatch.setattr(
         cp, "reference_path",
         lambda platform: str(tmp_path / f"perf_quick_{platform}.json"))
@@ -229,6 +239,28 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     assert cp.main(["--reps", "1", "--no-serving"]) == 1
     prec["detail"]["bf16_parity"]["offsets_maxdiff"] = 0.013
     assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    # the quality gate (ISSUE 14): a missed poison (or a clean file
+    # flagged), a stray rule beyond masked_high, or an alert count
+    # that disagrees with the flagged-record count each fail;
+    # --no-quality skips the child
+    qual["flagged"] = []
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    assert cp.main(["--reps", "1", "--no-serving",
+                    "--no-quality"]) == 0
+    qual["flagged"] = ["Level2_comap-0001.hd5"]
+    qual["flag_counts"] = {"masked_high": 1, "fknee_high": 2}
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    qual["flag_counts"] = {"masked_high": 1}
+    qual["n_alerts"] = 0
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    qual["n_alerts"] = 1
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    # ... and every gated run landed in the (redirected) registry,
+    # honest about its own ok bit
+    runs = [json.loads(ln) for ln in
+            (tmp_path / "runs.jsonl").read_text().splitlines()]
+    assert runs and all(r["kind"] == "perf_gate" for r in runs)
+    assert runs[-1]["ok"] is True and runs[-2]["ok"] is False
 
 
 def test_bench_config_modes_emit_json(tmp_path):

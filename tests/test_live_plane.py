@@ -1,0 +1,219 @@
+"""Live observability plane: LiveTail incremental reads + the HTTP
+sidecar's endpoints (ISSUE 14). No jax — pure stream/HTTP logic."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from comapreduce_tpu.telemetry.live import LiveServer, LiveTail
+
+
+def _write_events(path, events, torn_tail=""):
+    with open(path, "w", encoding="utf-8") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        if torn_tail:
+            f.write(torn_tail)  # no newline: an append in flight
+
+
+def _meta(rank, wall0=1000.0, mono0=0.0):
+    return {"kind": "meta", "schema": 1, "rank": rank, "pid": 1,
+            "host": "t", "wall0": wall0, "mono0": mono0}
+
+
+def _heartbeat(directory, rank, age_s=0.0, stage="ingest.read"):
+    """A heartbeat whose wall stamp AND file mtime read ``age_s`` old
+    (staleness takes the freshest non-negative of the two)."""
+    now = time.time()
+    path = os.path.join(directory, f"heartbeat.rank{rank}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"rank": rank, "pid": 1, "host": "t", "seq": 1,
+                   "stage": stage, "t_wall_unix": now - age_s}, f)
+    os.utime(path, (now - age_s, now - age_s))
+    return path
+
+
+class TestLiveTail:
+    def test_counters_accumulate_gauges_last_win(self, tmp_path):
+        p = tmp_path / "events.rank0.jsonl"
+        _write_events(p, [
+            _meta(0),
+            {"kind": "counter", "name": "scheduler.committed",
+             "value": 2, "mono": 1.0},
+            {"kind": "counter", "name": "scheduler.committed",
+             "value": 3, "mono": 2.0},
+            {"kind": "gauge", "name": "ingest.queue_depth",
+             "value": 4, "mono": 3.0},
+            {"kind": "gauge", "name": "ingest.queue_depth",
+             "value": 1, "mono": 4.0},
+            {"kind": "span", "name": "ingest.read", "id": 1,
+             "mono": 5.0, "dur": 0.25},
+        ])
+        tail = LiveTail(str(tmp_path))
+        assert tail.poll() == 6
+        assert tail.counters[("scheduler.committed", 0)] == 5.0
+        assert tail.gauges[("ingest.queue_depth", 0)] == 1.0
+        assert list(tail.span_windows["ingest.read"]) == [0.25]
+        assert tail.span_totals["ingest.read"] == [1, 0.25]
+        # idempotent: nothing new, nothing re-read
+        assert tail.poll() == 0
+        assert tail.counters[("scheduler.committed", 0)] == 5.0
+
+    def test_torn_tail_left_for_next_poll(self, tmp_path):
+        p = tmp_path / "events.rank0.jsonl"
+        _write_events(p, [_meta(0)],
+                      torn_tail='{"kind": "counter", "name": "x", "va')
+        tail = LiveTail(str(tmp_path))
+        assert tail.poll() == 1  # the meta line only
+        assert not tail.counters and tail.dropped_lines == 0
+        # the writer finishes the line: the next poll absorbs it whole
+        with open(p, "a", encoding="utf-8") as f:
+            f.write('lue": 7, "mono": 1.0}\n')
+        assert tail.poll() == 1
+        assert tail.counters[("x", 0)] == 7.0
+
+    def test_garbage_line_dropped_not_fatal(self, tmp_path):
+        p = tmp_path / "events.rank0.jsonl"
+        with open(p, "w", encoding="utf-8") as f:
+            f.write("not json at all\n")
+            f.write(json.dumps({"kind": "counter", "name": "ok",
+                                "value": 1, "mono": 0.0}) + "\n")
+        tail = LiveTail(str(tmp_path))
+        tail.poll()
+        assert tail.dropped_lines == 1
+        assert tail.counters[("ok", 0)] == 1.0
+
+    def test_shrunk_stream_resets_offset(self, tmp_path):
+        p = tmp_path / "events.rank0.jsonl"
+        _write_events(p, [
+            _meta(0),
+            {"kind": "counter", "name": "c", "value": 5, "mono": 1.0},
+        ])
+        tail = LiveTail(str(tmp_path))
+        tail.poll()
+        assert tail.counters[("c", 0)] == 5.0
+        # rotated/replaced stream (smaller than the consumed offset):
+        # the tail restarts from byte 0 rather than reading past EOF
+        _write_events(p, [
+            {"kind": "counter", "name": "c", "value": 1, "mono": 1.0},
+        ])
+        tail.poll()
+        assert tail.counters[("c", 0)] == 6.0
+
+    def test_counter_total_sums_ranks(self, tmp_path):
+        _write_events(tmp_path / "events.rank0.jsonl", [
+            _meta(0),
+            {"kind": "counter", "name": "scheduler.committed",
+             "value": 2, "mono": 1.0},
+        ])
+        _write_events(tmp_path / "events.rank1.jsonl", [
+            _meta(1),
+            {"kind": "counter", "name": "scheduler.committed",
+             "value": 3, "mono": 1.0},
+            {"kind": "counter", "name": "scheduler.claimed",
+             "value": 9, "mono": 2.0},
+        ])
+        tail = LiveTail(str(tmp_path))
+        tail.poll()
+        assert tail.counter_total("scheduler.committed") == 5.0
+        assert tail.counter_total("scheduler.claimed") == 9.0
+
+
+@pytest.fixture
+def live(tmp_path):
+    srv = LiveServer(str(tmp_path), port=0, stale_s=30.0).start()
+    yield srv, tmp_path
+    srv.stop()
+
+
+def _get(srv, route):
+    url = f"http://{srv.host}:{srv.port}{route}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+class TestLiveServer:
+    def test_metrics_prometheus_text(self, live):
+        srv, tmp = live
+        _heartbeat(tmp, 0)
+        _write_events(tmp / "events.rank0.jsonl", [
+            _meta(0),
+            {"kind": "counter", "name": "scheduler.committed",
+             "value": 4, "mono": 1.0},
+            {"kind": "span", "name": "ingest.read", "id": 1,
+             "mono": 2.0, "dur": 0.5},
+        ])
+        status, body = _get(srv, "/metrics")
+        assert status == 200
+        lines = [ln for ln in body.splitlines() if ln]
+        # every non-comment line must parse as `name{labels} value`
+        import re
+        for ln in lines:
+            if ln.startswith("#"):
+                continue
+            assert re.match(
+                r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^{}]*\})? \S+$", ln), ln
+        assert 'comap_scheduler_committed_total{rank="0"} 4' in body
+        assert "comap_ingest_read_seconds_count 1" in body
+        assert "comap_live_healthy 1" in body
+
+    def test_healthz_flips_on_stale_and_honours_done(self, live):
+        srv, tmp = live
+        _heartbeat(tmp, 0, age_s=0.0)
+        status, body = _get(srv, "/healthz")
+        assert status == 200 and json.loads(body)["ok"] is True
+        # stale beat (beyond the 30 s TTL): 503, exit-code honest
+        _heartbeat(tmp, 0, age_s=120.0)
+        status, body = _get(srv, "/healthz")
+        assert status == 503 and json.loads(body)["n_stale"] == 1
+        # a terminal ".done" beat is a clean exit, not a death: 200
+        # no matter how old it grows
+        _heartbeat(tmp, 0, age_s=120.0, stage="run_tod.done")
+        status, body = _get(srv, "/healthz")
+        assert status == 200 and json.loads(body)["n_stale"] == 0
+
+    def test_missing_expected_rank_is_unhealthy(self, tmp_path):
+        srv = LiveServer(str(tmp_path), port=0, stale_s=30.0,
+                         n_ranks=2).start()
+        try:
+            _heartbeat(tmp_path, 0)
+            status, body = _get(srv, "/healthz")
+            assert status == 503
+            ranks = json.loads(body)["ranks"]
+            assert [r["stale"] for r in ranks] == [False, True]
+        finally:
+            srv.stop()
+
+    def test_campaign_and_quality_endpoints(self, live):
+        srv, tmp = live
+        _heartbeat(tmp, 0)
+        from comapreduce_tpu.telemetry import quality as q
+        rec = {"schema": 1, "file": "a.hd5", "feed": 0, "band": 0,
+               "t": "2026-01-01T00:00:00Z", "fknee_hz": 2.0,
+               "flags": ["fknee_high"], "flagged": True}
+        q.append_quality(q.quality_path(str(tmp), 0), [rec])
+        status, body = _get(srv, "/v1/campaign")
+        rep = json.loads(body)
+        assert status == 200 and rep["schema"] == 2
+        assert rep["ranks"][0]["rank"] == 0
+        status, body = _get(srv, "/v1/quality")
+        summ = json.loads(body)
+        assert status == 200
+        assert summ["n_records"] == 1 and summ["n_flagged"] == 1
+        assert summ["flag_counts"] == {"fknee_high": 1}
+        assert summ["worst_feeds"][0]["file"] == "a.hd5"
+        # the flags surface on /metrics too
+        _, prom = _get(srv, "/metrics")
+        assert 'comap_quality_flags{rule="fknee_high"} 1' in prom
+
+    def test_unknown_route_404(self, live):
+        srv, _ = live
+        status, body = _get(srv, "/nope")
+        assert status == 404 and "error" in json.loads(body)

@@ -1,0 +1,256 @@
+"""Data-quality ledger: config tables, SLO rules, record assembly and
+the torn-line-safe persistence (ISSUE 14)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.telemetry import quality as q
+
+
+class TestConfigTables:
+    def test_defaults(self):
+        assert q.QualityConfig.coerce(None).enabled is True
+        slo = q.SloConfig.coerce(None)
+        assert slo.max_masked_fraction == 0.01
+        assert slo.exclude_flagged is False
+        # every other rule starts disarmed
+        for knob in ("max_tsys_k", "min_tsys_k", "max_white_sigma",
+                     "max_fknee_hz", "max_spike_fraction"):
+            assert getattr(slo, knob) == 0.0
+
+    def test_unknown_key_raises_at_coerce(self):
+        with pytest.raises(ValueError, match="unknown .quality."):
+            q.QualityConfig.coerce({"enabld": True})
+        with pytest.raises(ValueError, match="unknown .slo."):
+            q.SloConfig.coerce({"max_tsys": 50.0})
+
+    def test_instance_passthrough_and_string_bools(self):
+        slo = q.SloConfig(exclude_flagged="yes")
+        assert q.SloConfig.coerce(slo) is slo
+        assert slo.exclude_flagged is True
+        assert q.QualityConfig.coerce({"enabled": "0"}).enabled is False
+
+
+class TestEvaluateRecord:
+    def test_each_rule_fires(self):
+        slo = q.SloConfig(max_tsys_k=50.0, min_tsys_k=20.0,
+                          max_white_sigma=0.01, max_fknee_hz=1.0,
+                          max_spike_fraction=0.001,
+                          max_masked_fraction=0.01)
+        assert q.evaluate_record({"tsys_k": 60.0}, slo) == ["tsys_high"]
+        assert q.evaluate_record({"tsys_k": 10.0}, slo) == ["tsys_low"]
+        assert q.evaluate_record({"white_sigma": 0.02}, slo) \
+            == ["white_sigma_high"]
+        assert q.evaluate_record({"fknee_hz": 2.0}, slo) \
+            == ["fknee_high"]
+        assert q.evaluate_record({"spike_fraction": 0.01}, slo) \
+            == ["spike_high"]
+        assert q.evaluate_record({"masked_fraction": 0.05}, slo) \
+            == ["masked_high"]
+        # damage is max(masked, nonfinite): either side trips the rule
+        assert q.evaluate_record({"nonfinite_fraction": 0.05}, slo) \
+            == ["masked_high"]
+
+    def test_none_fields_never_fire(self):
+        slo = q.SloConfig(max_tsys_k=50.0, min_tsys_k=20.0,
+                          max_white_sigma=0.01, max_fknee_hz=1.0,
+                          max_spike_fraction=0.001)
+        rec = {"tsys_k": None, "white_sigma": None, "fknee_hz": None,
+               "spike_fraction": None, "masked_fraction": None,
+               "nonfinite_fraction": None}
+        assert q.evaluate_record(rec, slo) == []
+
+    def test_disarmed_rules_never_fire(self):
+        rec = {"tsys_k": 1e6, "white_sigma": 1e6, "fknee_hz": 1e6,
+               "spike_fraction": 1.0, "masked_fraction": 0.0}
+        assert q.evaluate_record(rec, q.SloConfig()) == []
+
+
+def _level2(F=2, B=1, T=200, with_noise="knee", with_spikes=True):
+    from comapreduce_tpu.data.level import COMAPLevel2
+
+    rng = np.random.default_rng(3)
+    l2 = COMAPLevel2(filename="")
+    l2["averaged_tod/tod"] = rng.normal(
+        size=(F, B, T)).astype(np.float32)
+    if with_noise == "knee":
+        # knee params [sig2, fknee, alpha] per (F, B, S=2, 3)
+        p = np.tile(np.array([4.0, 1.5, -1.7]), (F, B, 2, 1))
+        l2["noise_statistics/fnoise_fit_parameters"] = p
+    elif with_noise == "red":
+        # red-noise params [sig2, red2, alpha]: with red2 == sig2 the
+        # derived knee (sig2/red2)^(1/alpha) is exactly 1.0
+        p = np.tile(np.array([2.0, 2.0, -1.5]), (F, B, 2, 1))
+        l2["fnoise_fits/fnoise_fit_parameters"] = p
+    if with_spikes:
+        m = np.zeros((F, B, T), bool)
+        m[0, 0, 10:20] = True
+        l2["spikes/spike_mask"] = m
+    return l2
+
+
+class TestAssembleRecords:
+    def test_full_records(self):
+        l2 = _level2()
+        l2["averaged_tod/tod"][0, 0, :8] = np.nan
+        recs = q.assemble_quality_records(
+            l2, "/data/Level2_comap-0001.hd5", rank=3,
+            precision_id="tod=float32|cgdot=plain",
+            masked={(0, 0): 8, None: 2})
+        assert len(recs) == 2  # (F=2, B=1)
+        by = {(r["feed"], r["band"]): r for r in recs}
+        r00 = by[(0, 0)]
+        assert r00["file"] == "Level2_comap-0001.hd5"
+        assert r00["rank"] == 3
+        assert r00["precision"] == "tod=float32|cgdot=plain"
+        assert r00["noise_model"] == "knee"
+        assert r00["white_sigma"] == pytest.approx(2.0)
+        assert r00["fknee_hz"] == pytest.approx(1.5)
+        assert r00["alpha"] == pytest.approx(-1.7)
+        assert r00["n_spikes"] == 10
+        assert r00["spike_fraction"] == pytest.approx(10 / 200)
+        assert r00["nonfinite_fraction"] == pytest.approx(8 / 200)
+        assert r00["masked_fraction"] == pytest.approx(8 / 200)
+        # feed 1 has no per-unit masked entry: the file-wide None
+        # key applies
+        r10 = by[(1, 0)]
+        assert r10["masked_fraction"] == pytest.approx(2 / 200)
+        assert r10["n_spikes"] == 0
+        assert r10["nonfinite_fraction"] == 0.0
+
+    def test_red_noise_derived_knee(self):
+        recs = q.assemble_quality_records(
+            _level2(with_noise="red", with_spikes=False), "x.hd5")
+        assert recs[0]["noise_model"] == "red_noise"
+        assert recs[0]["fknee_hz"] == pytest.approx(1.0)
+        assert recs[0]["white_sigma"] == pytest.approx(np.sqrt(2.0))
+
+    def test_minimal_chain_yields_none_fields(self):
+        recs = q.assemble_quality_records(
+            _level2(with_noise=None, with_spikes=False), "x.hd5")
+        assert len(recs) == 2
+        for r in recs:
+            assert r["tsys_k"] is None and r["noise_model"] is None
+            assert r["n_spikes"] is None and r["white_sigma"] is None
+        # ... and None fields never flag under the default table
+        slo = q.SloConfig()
+        assert all(q.evaluate_record(r, slo) == [] for r in recs)
+
+    def test_no_tod_no_records(self):
+        from comapreduce_tpu.data.level import COMAPLevel2
+
+        assert q.assemble_quality_records(COMAPLevel2(filename=""),
+                                          "x.hd5") == []
+
+
+class TestMaskedFromLedger:
+    def test_per_unit_and_filewide(self, tmp_path):
+        from comapreduce_tpu.resilience.ledger import QuarantineLedger
+
+        led = QuarantineLedger(str(tmp_path / "quarantine.jsonl"))
+        led.record("/d/a.hd5", failure_class="numerical",
+                   disposition="masked", feed=0, band=1,
+                   message="7 non-finite sample(s) zero-weighted")
+        led.record("/d/a.hd5", failure_class="numerical",
+                   disposition="masked",
+                   message="3 non-finite sample(s) zero-weighted")
+        led.record("/d/b.hd5", failure_class="numerical",
+                   disposition="masked", feed=0, band=0,
+                   message="9 non-finite sample(s) zero-weighted")
+        led.record("/d/a.hd5", failure_class="transient",
+                   disposition="quarantined", message="boom")
+        out = q.masked_from_ledger(led, "other/path/a.hd5")
+        assert out == {(0, 1): 7, None: 3}
+
+    def test_max_on_rerun_collision(self, tmp_path):
+        from comapreduce_tpu.resilience.ledger import QuarantineLedger
+
+        led = QuarantineLedger(str(tmp_path / "quarantine.jsonl"))
+        for n in (5, 5):  # a re-run re-ledgers the same scrub
+            led.record("a.hd5", disposition="masked", feed=1, band=0,
+                       message=f"{n} non-finite sample(s) "
+                               "zero-weighted")
+        assert q.masked_from_ledger(led, "a.hd5") == {(1, 0): 5}
+
+
+class TestPersistence:
+    def test_append_read_latest_wins(self, tmp_path):
+        p0 = q.quality_path(str(tmp_path), 0)
+        p1 = q.quality_path(str(tmp_path), 1)
+        assert p0.endswith("quality.rank0.jsonl")
+        old = {"schema": 1, "file": "a.hd5", "feed": 0, "band": 0,
+               "t": "2026-01-01T00:00:00Z", "flagged": False,
+               "flags": []}
+        new = dict(old, t="2026-01-02T00:00:00Z", flagged=True,
+                   flags=["masked_high"])
+        other = dict(old, file="b.hd5")
+        q.append_quality(p0, [old, other])
+        q.append_quality(p1, [new])  # another rank re-reduced the file
+        recs = q.read_quality(str(tmp_path))
+        assert len(recs) == 2
+        by_file = {r["file"]: r for r in recs}
+        assert by_file["a.hd5"]["flagged"] is True  # latest wins
+        assert q.flagged_files(str(tmp_path)) == {"a.hd5"}
+        assert q.flag_counts(recs) == {"masked_high": 1}
+
+    def test_torn_trailing_line_healed_and_dropped(self, tmp_path):
+        p = q.quality_path(str(tmp_path), 0)
+        rec = {"schema": 1, "file": "a.hd5", "feed": 0, "band": 0,
+               "t": "2026-01-01T00:00:00Z", "flagged": False}
+        q.append_quality(p, [rec])
+        with open(p, "a", encoding="utf-8") as f:
+            f.write('{"file": "torn')  # crashed writer's stump
+        q.append_quality(p, [dict(rec, file="b.hd5")])
+        recs = q.read_quality(p)
+        assert {r["file"] for r in recs} == {"a.hd5", "b.hd5"}
+        # the stump got its healing newline and was dropped on read
+        with open(p, "rb") as f:
+            assert f.read().count(b"\n") == 3
+
+    def test_append_empty_is_noop(self, tmp_path):
+        p = q.quality_path(str(tmp_path), 0)
+        q.append_quality(p, [])
+        assert not os.path.exists(p)
+
+    def test_worst_feeds_ranked_by_knee(self):
+        recs = [{"file": f, "feed": 0, "band": 0, "fknee_hz": k}
+                for f, k in (("a", 0.2), ("b", 3.0), ("c", 1.0))]
+        recs.append({"file": "d", "feed": 0, "band": 0,
+                     "fknee_hz": None})
+        worst = q.worst_feeds(recs, n=2)
+        assert [r["file"] for r in worst] == ["b", "c"]
+
+
+class TestEmitAlerts:
+    def test_alert_count_and_telemetry_counter(self, tmp_path):
+        from comapreduce_tpu.telemetry import TELEMETRY
+
+        recs = [{"file": "a.hd5", "feed": 0, "band": 0,
+                 "flags": ["masked_high"], "flagged": True},
+                {"file": "a.hd5", "feed": 1, "band": 0, "flags": [],
+                 "flagged": False}]
+        TELEMETRY.configure(str(tmp_path), rank=0, flush_s=60.0)
+        try:
+            assert q.emit_alerts(recs) == 1
+        finally:
+            TELEMETRY.close()
+        events = []
+        with open(tmp_path / "events.rank0.jsonl",
+                  encoding="utf-8") as f:
+            for line in f:
+                events.append(json.loads(line))
+        alerts = [e for e in events if e.get("kind") == "counter"
+                  and e.get("name") == "quality.alert"]
+        assert len(alerts) == 1
+        assert alerts[0]["attrs"]["rules"] == "masked_high"
+        totals = [e for e in events if e.get("kind") == "counter"
+                  and e.get("name") == "quality.records"]
+        assert totals and totals[0]["value"] == 2
+
+    def test_noop_with_telemetry_disabled(self):
+        assert q.emit_alerts([{"file": "a", "flags": ["x"],
+                               "flagged": True}]) == 1
+        assert q.emit_alerts([]) == 0

@@ -1,0 +1,141 @@
+"""Cross-run regression registry + the campaign_watch trend gate
+(ISSUE 14)."""
+
+import json
+import os
+import sys
+
+from comapreduce_tpu.telemetry import registry as reg
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _rec(path, files_per_s=10.0, cg_iters=40, ok=True,
+         kind="campaign"):
+    return reg.record_run(
+        kind, {"files_per_s": files_per_s, "cg_iters": cg_iters,
+               "note": "informational"}, ok=ok, path=path,
+        git_sha="deadbeef")
+
+
+class TestRecordAndRead:
+    def test_roundtrip_and_kind_filter(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        _rec(p)
+        _rec(p, kind="perf_gate")
+        runs = reg.read_runs(p)
+        assert len(runs) == 2
+        assert runs[0]["schema"] == 1
+        assert runs[0]["git_sha"] == "deadbeef"
+        assert runs[0]["metrics"]["files_per_s"] == 10.0
+        # non-numeric values are stringified, never rejected
+        assert runs[0]["metrics"]["note"] == "informational"
+        assert [r["kind"] for r in reg.read_runs(p, kind="perf_gate")] \
+            == ["perf_gate"]
+
+    def test_unparseable_lines_dropped(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        _rec(p)
+        with open(p, "a", encoding="utf-8") as f:
+            f.write("garbage\n")
+            f.write('{"kind": "x"}\n')  # no metrics: not a run record
+        assert len(reg.read_runs(p)) == 1
+
+    def test_default_path_env_override(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("COMAP_RUNS_REGISTRY",
+                           str(tmp_path / "r.jsonl"))
+        assert reg.default_registry_path() == str(tmp_path / "r.jsonl")
+        monkeypatch.delenv("COMAP_RUNS_REGISTRY")
+        assert reg.default_registry_path().endswith(
+            os.path.join("evidence", "runs.jsonl"))
+
+
+class TestTrend:
+    def test_too_few_runs_is_ok(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        assert reg.trend(reg.read_runs(p))["ok"] is True
+        _rec(p)
+        assert reg.trend(reg.read_runs(p))["ok"] is True
+
+    def test_steady_metrics_pass(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        for v in (10.0, 10.5, 9.8, 10.2):
+            _rec(p, files_per_s=v)
+        res = reg.trend(reg.read_runs(p))
+        assert res["ok"] is True and not res["regressions"]
+        assert set(res["checked"]) == {"files_per_s", "cg_iters"}
+
+    def test_higher_better_regression(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        for v in (10.0, 10.0, 10.0):
+            _rec(p, files_per_s=v)
+        _rec(p, files_per_s=5.0)  # 50% down >> 20% tolerance
+        res = reg.trend(reg.read_runs(p))
+        assert res["ok"] is False
+        assert res["regressions"][0]["metric"] == "files_per_s"
+        assert res["regressions"][0]["direction"] == "higher_better"
+        assert "REGRESSION" in reg.format_trend(res)
+
+    def test_lower_better_regression(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        for _ in range(3):
+            _rec(p, cg_iters=40)
+        _rec(p, cg_iters=80)  # iteration blow-up
+        res = reg.trend(reg.read_runs(p))
+        assert res["ok"] is False
+        assert res["regressions"][0]["metric"] == "cg_iters"
+        assert res["regressions"][0]["direction"] == "lower_better"
+
+    def test_tolerance_respected(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        for _ in range(3):
+            _rec(p, files_per_s=10.0)
+        _rec(p, files_per_s=8.5)  # 15% down, inside the default 20%
+        assert reg.trend(reg.read_runs(p))["ok"] is True
+        assert reg.trend(reg.read_runs(p),
+                         tolerance=0.1)["ok"] is False
+
+    def test_failed_gate_always_regresses(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        _rec(p)
+        _rec(p, ok=False)  # identical metrics, but the gate failed
+        res = reg.trend(reg.read_runs(p))
+        assert res["ok"] is False
+        assert res["regressions"][0]["metric"] == "ok"
+
+    def test_window_bounds_baseline(self, tmp_path):
+        p = str(tmp_path / "runs.jsonl")
+        _rec(p, files_per_s=100.0)  # ancient fast era
+        for _ in range(5):
+            _rec(p, files_per_s=10.0)
+        _rec(p, files_per_s=9.5)
+        # window=3 never sees the 100.0 record: no false regression
+        res = reg.trend(reg.read_runs(p), window=3)
+        assert res["ok"] is True and res["n_baseline"] == 3
+
+
+class TestCampaignWatchTrend:
+    def test_exit_codes(self, tmp_path, capsys):
+        from tools.campaign_watch import main
+
+        p = str(tmp_path / "runs.jsonl")
+        for _ in range(3):
+            _rec(p)
+        assert main(["trend", "--registry", p]) == 0
+        _rec(p, files_per_s=2.0, ok=False)
+        assert main(["trend", "--registry", p]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and p in out
+
+    def test_kind_filter(self, tmp_path):
+        from tools.campaign_watch import main
+
+        p = str(tmp_path / "runs.jsonl")
+        for _ in range(3):
+            _rec(p)
+        _rec(p, files_per_s=2.0, kind="perf_gate")
+        # the slow record is another kind: campaign trend stays green
+        assert main(["trend", "--registry", p,
+                     "--kind", "campaign"]) == 0
+        assert main(["trend", "--registry", p]) == 1

@@ -20,6 +20,12 @@ writes:
   write/compute overlap fractions integrated from span intersections,
   per-rank load imbalance (``--json`` for machine-readable form).
 
+``--quality`` folds the data-quality ledger (``quality.rank*.jsonl``,
+docs/OPERATIONS.md §16) into the summary: flag counts per SLO rule and
+the worst-N feeds by fitted 1/f knee frequency (``--worst N``,
+default 5). Works even when telemetry was off — the quality ledger is
+always written.
+
 ``--selftest`` builds a synthetic two-rank campaign (interleaved
 streams, a torn trailing line, a span left open by a "SIGKILLed"
 rank, skewed monotonic clocks), round-trips it through the full
@@ -37,16 +43,66 @@ import tempfile
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def quality_summary(log_dir: str, worst_n: int = 5) -> dict:
+    """Fold ``quality.rank*.jsonl`` into one summary dict: record and
+    flag totals, flag counts per SLO rule, and the worst-N (file, feed,
+    band) rows by fitted 1/f knee frequency."""
+    from comapreduce_tpu.telemetry.quality import (flag_counts,
+                                                   read_quality,
+                                                   worst_feeds)
+
+    records = read_quality(log_dir)
+    return {
+        "n_records": len(records),
+        "n_flagged": sum(1 for r in records if r.get("flagged")),
+        "n_files": len({r.get("file") for r in records}),
+        "flag_counts": flag_counts(records),
+        "worst_feeds": [
+            {k: r.get(k) for k in ("file", "feed", "band", "fknee_hz",
+                                   "white_sigma", "alpha", "tsys_k",
+                                   "flags")}
+            for r in worst_feeds(records, n=worst_n)],
+    }
+
+
+def format_quality(q: dict) -> str:
+    lines = [f"quality: {q['n_records']} record(s) over "
+             f"{q['n_files']} file(s), {q['n_flagged']} flagged"]
+    for rule, n in sorted(q["flag_counts"].items()):
+        lines.append(f"  flag {rule}: {n}")
+    if q["worst_feeds"]:
+        def g(v):  # absent signals are None fields, never errors
+            return "-" if v is None else format(float(v), ".3g")
+
+        lines.append(f"  worst {len(q['worst_feeds'])} by 1/f knee:")
+        for r in q["worst_feeds"]:
+            flags = ",".join(r.get("flags") or ()) or "-"
+            lines.append(
+                f"    {r['file']} feed {r['feed']} band {r['band']}: "
+                f"fknee {g(r['fknee_hz'])} Hz  "
+                f"sigma {g(r['white_sigma'])}  "
+                f"alpha {g(r['alpha'])}  flags {flags}")
+    return "\n".join(lines)
+
+
 def run_report(log_dir: str, trace_path: str = "", prom_path: str = "",
-               summary: bool = True, as_json: bool = False) -> int:
+               summary: bool = True, as_json: bool = False,
+               quality: bool = False, worst_n: int = 5) -> int:
     from comapreduce_tpu.telemetry import merge_streams
     from comapreduce_tpu.telemetry.report import (format_summary,
                                                   summarize,
                                                   write_prom,
                                                   write_trace)
 
+    qual = quality_summary(log_dir, worst_n) if quality else None
     merged = merge_streams(log_dir)
     if not (merged.spans or merged.counters or merged.gauges):
+        # the quality ledger is written even with telemetry off, so
+        # --quality still reports; without it this stays an error
+        if qual is not None and qual["n_records"]:
+            print(json.dumps({"quality": qual}) if as_json
+                  else format_quality(qual))
+            return 0
         print(f"no telemetry events under {log_dir} (is [telemetry] "
               f"enabled = true?)", file=sys.stderr)
         return 1
@@ -57,10 +113,15 @@ def run_report(log_dir: str, trace_path: str = "", prom_path: str = "",
     if summary:
         s = summarize(merged)
         if as_json:
-            print(json.dumps({"summary": s, "trace": trace_path,
-                              "prom": prom_path}))
+            blob = {"summary": s, "trace": trace_path,
+                    "prom": prom_path}
+            if qual is not None:
+                blob["quality"] = qual
+            print(json.dumps(blob))
         else:
             print(format_summary(s))
+            if qual is not None:
+                print(format_quality(qual))
             print(f"trace: {trace_path}\nprom:  {prom_path}")
     return 0
 
@@ -124,6 +185,11 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary")
     ap.add_argument("--no-summary", action="store_true")
+    ap.add_argument("--quality", action="store_true",
+                    help="fold quality.rank*.jsonl into the summary "
+                    "(flag counts per rule, worst feeds by 1/f knee)")
+    ap.add_argument("--worst", type=int, default=5,
+                    help="rows in the --quality worst-feeds table")
     ap.add_argument("--selftest", action="store_true",
                     help="synthetic round-trip (the CI smoke)")
     args = ap.parse_args(argv)
@@ -132,7 +198,8 @@ def main(argv=None) -> int:
     if not args.log_dir:
         ap.error("log_dir is required (or use --selftest)")
     return run_report(args.log_dir, args.trace, args.prom,
-                      summary=not args.no_summary, as_json=args.json)
+                      summary=not args.no_summary, as_json=args.json,
+                      quality=args.quality, worst_n=args.worst)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Watch a running campaign: live plane, health probe, run trends.
+
+Subcommands::
+
+    serve    run the live observability sidecar (blocking):
+             /metrics (Prometheus), /healthz, /v1/campaign,
+             /v1/quality over one campaign state directory
+    status   one-shot campaign report (the schema-2 watchdog report,
+             fetched from a running sidecar with --url, else built
+             straight from the state directory)
+    check    liveness probe for cron/CI: exit 0 healthy, 1 not
+             (same rule as /healthz and watchdog_report's exit code)
+    trend    compare the newest run-registry record against the
+             trailing window; exit 1 on regression
+
+Examples::
+
+    python tools/campaign_watch.py serve run/logs --port 9100
+    python tools/campaign_watch.py status run/logs --stale-s 30
+    python tools/campaign_watch.py check run/logs --n-ranks 3
+    python tools/campaign_watch.py trend --kind perf_gate --window 5
+
+``serve``/``status``/``check`` read the same on-disk state as
+``tools/watchdog_report.py`` — heartbeats, leases, the quarantine and
+quality ledgers — and never write. The runbook is
+docs/OPERATIONS.md §16.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from comapreduce_tpu.resilience.status import (build_report,  # noqa: E402
+                                               report_healthy)
+from comapreduce_tpu.telemetry.registry import (  # noqa: E402
+    default_registry_path, format_trend, read_runs, trend)
+
+
+def cmd_serve(args) -> int:
+    from comapreduce_tpu.telemetry.live import LiveServer
+
+    srv = LiveServer(args.state_dir, host=args.host, port=args.port,
+                     stale_s=args.stale_s, n_ranks=args.n_ranks)
+    print(f"live plane: http://{srv.host}:{srv.port}/metrics  "
+          f"/healthz  /v1/campaign  /v1/quality")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def _fetch_report(args) -> dict:
+    if args.url:
+        from urllib.request import urlopen
+
+        with urlopen(args.url.rstrip("/") + "/v1/campaign",
+                     timeout=10) as r:
+            return json.load(r)
+    return build_report(args.state_dir, stale_s=args.stale_s,
+                        n_ranks=args.n_ranks)
+
+
+def cmd_status(args) -> int:
+    from tools.watchdog_report import render_text
+
+    rep = _fetch_report(args)
+    print(json.dumps(rep, sort_keys=True) if args.json
+          else render_text(rep))
+    return 0 if report_healthy(rep) else 1
+
+
+def cmd_check(args) -> int:
+    rep = _fetch_report(args)
+    ok = report_healthy(rep)
+    print(f"{'healthy' if ok else 'UNHEALTHY'}: "
+          f"{rep['n_stale']} stale rank(s), "
+          f"{rep['n_expired_leases']} expired lease(s) "
+          f"({rep['output_dir']})")
+    return 0 if ok else 1
+
+
+def cmd_trend(args) -> int:
+    path = args.registry or default_registry_path()
+    runs = read_runs(path, kind=args.kind)
+    res = trend(runs, window=args.window, tolerance=args.tolerance)
+    print(f"registry: {path}"
+          + (f" (kind={args.kind})" if args.kind else ""))
+    print(format_trend(res))
+    return 0 if res["ok"] else 1
+
+
+def _add_state_args(ap) -> None:
+    ap.add_argument("state_dir", nargs="?", default=".",
+                    help="campaign state dir ([Global] log_dir; "
+                    "<output_dir>/logs also resolves)")
+    ap.add_argument("--url", default="",
+                    help="fetch from a running sidecar instead of "
+                    "reading the state dir (e.g. http://host:9100)")
+    ap.add_argument("--stale-s", type=float, default=60.0,
+                    help="heartbeat TTL for the probe (default 60; "
+                    "pass the campaign's lease_ttl_s)")
+    ap.add_argument("--n-ranks", type=int, default=0,
+                    help="expected rank count (default: ranks with "
+                    "heartbeat files)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("serve", help="run the live HTTP sidecar")
+    s.add_argument("state_dir")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=9100)
+    s.add_argument("--stale-s", type=float, default=60.0)
+    s.add_argument("--n-ranks", type=int, default=0)
+    s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("status", help="print the campaign report")
+    _add_state_args(s)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("check", help="liveness probe (exit 0/1)")
+    _add_state_args(s)
+    s.set_defaults(fn=cmd_check)
+
+    s = sub.add_parser("trend",
+                       help="latest registry record vs trailing window")
+    s.add_argument("--registry", default="",
+                   help="runs.jsonl path (default: "
+                   "$COMAP_RUNS_REGISTRY or evidence/runs.jsonl)")
+    s.add_argument("--kind", default=None,
+                   help="only compare records of this kind")
+    s.add_argument("--window", type=int, default=5)
+    s.add_argument("--tolerance", type=float, default=0.2,
+                   help="fractional slack before a metric counts as "
+                   "regressed (default 0.2)")
+    s.set_defaults(fn=cmd_trend)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -88,6 +88,19 @@ the compensated dots reach too (and the bench must report the stall
 edge, measured-present or documented-absent), and (c) bf16 storage
 parity: converged offsets within a bf16-eps-scaled envelope of the f32
 stream. All machine-independent; ``--no-precision`` skips.
+
+The quality-ledger gate (ISSUE 14) also runs by default, in-process
+(no jax, no bench child): three drill fixtures are read through a
+``nan_burst`` chaos loader and their quality records evaluated against
+the default SLO table — the poisoned file must be the ONLY flagged one
+(rule ``masked_high``, one alert per flagged record) and every clean
+file must stay unflagged. Set/count comparisons of one deterministic
+fixture against itself — machine-independent; ``--no-quality`` skips.
+
+Unless ``--no-registry``, the gate appends one ``perf_gate`` summary
+record to ``evidence/runs.jsonl`` (``telemetry/registry.py``) so
+``tools/campaign_watch.py trend`` can alert on a regression against
+the trailing window.
 """
 
 from __future__ import annotations
@@ -99,6 +112,7 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # the tiles/quality gates run in-process
 
 
 def run_quick_bench() -> dict:
@@ -313,6 +327,61 @@ def run_tiles_gate() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def run_quality_gate() -> dict:
+    """The ISSUE 14 data-quality gate, in-process on the chaos drill's
+    own Level-2 fixtures (no jax, no subprocess): a ``nan_burst``-
+    poisoned read must land in the quality ledger flagged
+    ``masked_high`` with an SLO alert fired, while every clean file's
+    records stay unflagged."""
+    import shutil
+    import tempfile
+
+    from comapreduce_tpu.data.level import COMAPLevel2
+    from comapreduce_tpu.resilience.chaos import ChaosMonkey
+    from comapreduce_tpu.resilience.drill import _write_level2
+    from comapreduce_tpu.telemetry import quality as q
+
+    work = tempfile.mkdtemp(prefix="check_perf_quality_")
+    monkey = ChaosMonkey("nan_burst@0001", seed=7, burst_frac=0.1)
+    try:
+        files = []
+        for i in range(3):
+            path = os.path.join(work, f"Level2_comap-{i:04d}.hd5")
+            _write_level2(path, seed=500 + i)
+            files.append(path)
+        loader = monkey.wrap_loader(lambda p: COMAPLevel2(filename=p))
+        slo = q.SloConfig()  # defaults: only masked_high is armed
+        state = os.path.join(work, "state")
+        n_alerts = 0
+        for path in files:
+            records = q.assemble_quality_records(
+                loader(path), path,
+                precision_id="tod=float32|cgdot=plain")
+            for rec in records:
+                rec["flags"] = q.evaluate_record(rec, slo)
+                rec["flagged"] = bool(rec["flags"])
+            q.append_quality(q.quality_path(state, 0), records)
+            n_alerts += q.emit_alerts(records)
+        latest = q.read_quality(state)
+        return {
+            "n_files": len(files),
+            "poisoned": os.path.basename(files[1]),
+            "flagged": sorted(q.flagged_files(state)),
+            "flag_counts": q.flag_counts(latest),
+            "n_records": len(latest),
+            "n_flagged_records": sum(1 for r in latest
+                                     if r.get("flagged")),
+            "n_alerts": n_alerts,
+            "max_nonfinite_fraction": max(
+                float(r.get("nonfinite_fraction") or 0.0)
+                for r in latest),
+            "masked_threshold": slo.max_masked_fraction,
+        }
+    finally:
+        monkey.release()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def run_precision_bench() -> dict:
     """One small-shape precision bench child -> its parsed JSON line."""
     env = dict(os.environ)
@@ -405,6 +474,11 @@ def main(argv=None) -> int:
                     help="skip the tile-tier delta/byte-budget gate")
     ap.add_argument("--no-precision", action="store_true",
                     help="skip the precision H2D/CG-ladder/parity gate")
+    ap.add_argument("--no-quality", action="store_true",
+                    help="skip the quality-ledger nan_burst gate")
+    ap.add_argument("--no-registry", action="store_true",
+                    help="do not append this gate run to the run "
+                         "registry (evidence/runs.jsonl)")
     args = ap.parse_args(argv)
 
     best: dict | None = None
@@ -707,11 +781,56 @@ def main(argv=None) -> int:
                 f"{envelope:.3g} — storage narrowing is leaking into "
                 "the solve beyond representation error (an accumulator "
                 "went bf16?)")
+    quality = None
+    if not args.no_quality:
+        # machine-independent (ISSUE 14): set/count comparisons of one
+        # deterministic chaos fixture's quality ledger against itself —
+        # the nan_burst file must be the ONLY flagged one, every flag
+        # must be the masked_high rule, and each flagged record must
+        # have fired exactly one alert
+        quality = run_quality_gate()
+        if quality["flagged"] != [quality["poisoned"]]:
+            failures.append(
+                f"quality: flagged files {quality['flagged']} != "
+                f"[{quality['poisoned']!r}] — the nan_burst file must "
+                "be flagged and every clean file left alone (the SLO "
+                "evaluation drifted or the burst went undetected)")
+        counts = quality["flag_counts"]
+        if set(counts) != {"masked_high"} or counts["masked_high"] < 1:
+            failures.append(
+                f"quality: flag counts {counts} — expected only "
+                "masked_high firings from a NaN burst under the "
+                "default SLO table")
+        if quality["n_alerts"] != quality["n_flagged_records"]:
+            failures.append(
+                f"quality: {quality['n_alerts']} alert(s) fired for "
+                f"{quality['n_flagged_records']} flagged record(s) — "
+                "emit_alerts and the flags disagree")
+        if quality["max_nonfinite_fraction"] \
+                <= quality["masked_threshold"]:
+            failures.append(
+                f"quality: peak nonfinite fraction "
+                f"{quality['max_nonfinite_fraction']:.3g} not above "
+                f"the {quality['masked_threshold']:g} threshold — the "
+                "fixture no longer exercises the rule")
+
+    if not args.no_registry:
+        # one summary record per gate run (ISSUE 14): the registry is
+        # what campaign_watch.py trend compares against, so the gate
+        # feeds it even when it fails — ok:false is itself a signal
+        from comapreduce_tpu.telemetry.registry import record_run
+
+        record_run("perf_gate", {
+            "tod_samples_per_s": cur["value"],
+            "dispatch_count": cur["dispatch_count"] or 0,
+            "gate_failures": len(failures),
+        }, ok=not failures, extra={"platform": platform})
+
     print(json.dumps({"ok": not failures, "failures": failures,
                       "current": cur, "campaign": campaign,
                       "destriper": destriper, "serving": serving,
                       "kernels": kernels, "tiles": tiles,
-                      "precision": precision,
+                      "precision": precision, "quality": quality,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))

@@ -37,6 +37,15 @@ read+solve, and a warm-started epoch converging in strictly fewer CG
 iterations than a cold solve of the same census (maps agreeing modulo
 the weighted-mean null mode).
 
+``--live-only`` runs the live observability drill (``run_live_drill``
+— two real worker ranks under a ``LiveServer`` sidecar, one SIGKILLed
+mid-lease then restarted), asserting ``/healthz`` flips 200→503 within
+one heartbeat TTL of the kill and back to 200 after the steal +
+restart, the ``/metrics`` Prometheus page parses with its commit
+counter equal to the scheduler's commit count EXACTLY, and
+``/v1/campaign`` serves the schema-2 report
+(docs/OPERATIONS.md §16).
+
 ``--tiles-only`` runs criterion 9: the map tile read tier drill
 (``run_tiles_drill`` — server subprocesses tiling published epochs
 into a content-addressed root, a real ``tools/tile_server.py`` HTTP
@@ -84,15 +93,21 @@ def main(argv=None) -> int:
     only.add_argument("--tiles-only", action="store_true",
                       help="run only criterion 9 (the map tile read "
                       "tier kill/backfill/HTTP/evict drill)")
+    only.add_argument("--live-only", action="store_true",
+                      help="run only the live observability drill "
+                      "(healthz flip on SIGKILL/recovery, exact "
+                      "/metrics commit counter)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from comapreduce_tpu.resilience.drill import (run_drill,
                                                   run_elastic_drill,
+                                                  run_live_drill,
                                                   run_serving_drill,
                                                   run_tiles_drill)
 
-    drill = (run_tiles_drill if args.tiles_only
+    drill = (run_live_drill if args.live_only
+             else run_tiles_drill if args.tiles_only
              else run_serving_drill if args.serving_only
              else run_elastic_drill if args.elastic_only else run_drill)
     workdir = args.workdir or tempfile.mkdtemp(prefix="check_resilience_")

@@ -89,11 +89,23 @@ def cmd_serve(args) -> int:
         checkpoint_every=args.checkpoint_every,
         min_new_files=args.min_new_files, poll_s=args.poll_s,
         tiles_root=args.tiles_dir)
+    live = None
+    if args.live_port is not None:
+        # live observability sidecar over the campaign's state dir
+        # (docs/OPERATIONS.md §16); stats_path points the serving
+        # freshness gauges at the stats file THIS server maintains
+        from comapreduce_tpu.telemetry.live import LiveServer
+
+        live = LiveServer(args.state_dir, port=args.live_port,
+                          stats_path=server.stats_path).start()
+        print(f"live plane: http://{live.host}:{live.port}/metrics")
     published = server.serve(
         max_epochs=args.max_epochs, idle_exit_s=args.idle_exit_s,
         max_wall_s=args.max_wall_s)
     print(f"serve: published {published} epoch(s); stats at "
           f"{server.stats_path}")
+    if live is not None:
+        live.stop()
     return 0
 
 
@@ -203,6 +215,9 @@ def main(argv=None) -> int:
     s.add_argument("--tiles-dir", default="",
                    help="also tile every published epoch into this "
                    "tiles root (the HTTP read tier's content store)")
+    s.add_argument("--live-port", type=int, default=None,
+                   help="serve the live observability plane (/metrics, "
+                   "/healthz, /v1/campaign) on this port")
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("status", help="current epoch + staleness")

@@ -18,6 +18,12 @@ elastic campaigns (docs/OPERATIONS.md §11) — who holds which lease at
 what generation, how many units are done/claimed/pending, and whether
 any expired lease is sitting unreclaimed.
 
+The report itself is built by
+:mod:`comapreduce_tpu.resilience.status` (shared with the live
+observability plane's ``/v1/campaign`` endpoint —
+docs/OPERATIONS.md §16); this tool only renders and sets the exit
+code.
+
 Exit code: 0 when every expected rank's heartbeat is fresher than
 ``--stale-s`` AND no lease is expired-but-unreclaimed; 1 otherwise
 (so the report doubles as a liveness probe in cron/CI). ``--n-ranks``
@@ -37,163 +43,12 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-
-def _resolve_state_dir(output_dir: str) -> str:
-    """The directory actually holding the run state: ``output_dir``
-    itself, else its ``logs/`` child (the default ``[Global] log_dir``
-    routing) when only that one has state files."""
-    import glob as _glob
-
-    def has_state(d: str) -> bool:
-        return any(_glob.glob(os.path.join(d, pat))
-                   for pat in ("heartbeat.rank*.json", "lease.*.json",
-                               "queue.json", "quarantine*.jsonl"))
-
-    logs = os.path.join(output_dir, "logs")
-    if not has_state(output_dir) and os.path.isdir(logs) \
-            and has_state(logs):
-        return logs
-    return output_dir
-
-
-def build_report(output_dir: str, stale_s: float = 60.0,
-                 n_ranks: int = 0) -> dict:
-    """The report as data (rendering and exit policy live in main)."""
-    from comapreduce_tpu.resilience.heartbeat import (heartbeat_age_s,
-                                                      read_heartbeats)
-    from comapreduce_tpu.resilience.ledger import QuarantineLedger
-
-    now = time.time()
-    output_dir = _resolve_state_dir(output_dir)
-    beats = read_heartbeats(output_dir)
-    expected = range(n_ranks) if n_ranks > 0 else sorted(beats)
-    ranks = []
-    for r in expected:
-        hb = beats.get(r)
-        if hb is None:
-            ranks.append({"rank": r, "present": False, "stale": True})
-            continue
-        age = heartbeat_age_s(hb, now)
-        ranks.append({
-            "rank": r, "present": True,
-            "age_s": round(age, 1),
-            # out-of-range on EITHER side is stale: too old is dead,
-            # and a negative age (future clock) is a skewed host with
-            # no live evidence — exit-1 material for the cron probe
-            "stale": not 0.0 <= age <= stale_s,
-            "stage": hb.get("stage", ""),
-            "unit": hb.get("unit", ""),
-            "seq": hb.get("seq", 0),
-            "pid": hb.get("pid"),
-            "host": hb.get("host", ""),
-            "progress": hb.get("progress", {}),
-            "deadline": hb.get("deadline"),
-        })
-
-    # one merged read-only view over every rank's ledger file
-    import glob as _glob
-
-    ledgers = sorted(_glob.glob(os.path.join(output_dir,
-                                             "quarantine*.jsonl")))
-    entries = []
-    summary: dict = {}
-    stalls, hangs = [], []
-    if ledgers:
-        led = QuarantineLedger(ledgers[0],
-                               read_paths=tuple(ledgers[1:]))
-        entries = led.entries
-        summary = led.summary()
-        for e in entries:
-            if e.failure_class != "hang":
-                continue
-            row = {"t": e.t, "unit": e.unit.get("file", ""),
-                   "stage": e.stage, "message": e.message,
-                   "disposition": e.disposition}
-            (stalls if e.disposition == "stalled" else hangs).append(row)
-
-    queue, leases = _queue_report(output_dir, beats, stale_s, now)
-    return {
-        "schema": 2,
-        "output_dir": output_dir,
-        "stale_s": stale_s,
-        "ranks": ranks,
-        "n_stale": sum(1 for r in ranks if r["stale"]),
-        "ledger_files": [os.path.basename(p) for p in ledgers],
-        "ledger_summary": summary,
-        "n_ledger_events": len(entries),
-        "n_stolen": sum(1 for e in entries
-                        if e.disposition == "stolen"),
-        "stalls": stalls[-20:],
-        "hangs": hangs[-20:],
-        "queue": queue,
-        "leases": leases,
-        "n_expired_leases": sum(1 for l in leases if l["expired"]),
-    }
-
-
-def _queue_report(state_dir: str, beats: dict, stale_s: float,
-                  now: float) -> tuple:
-    """Elastic-campaign state: the ``queue.json`` manifest summary and
-    one row per ``lease.*.json``. ``expired`` marks a lease whose
-    owner shows no live heartbeat within ``stale_s`` yet which no
-    survivor has reclaimed — the signal that a campaign is wedged
-    (no rank left to steal)."""
-    import glob as _glob
-
-    from comapreduce_tpu.resilience.heartbeat import heartbeat_age_s
-    from comapreduce_tpu.resilience.lease import read_lease
-
-    leases = []
-    for p in sorted(_glob.glob(os.path.join(state_dir, "lease.*.json"))):
-        try:
-            age = now - os.stat(p).st_mtime
-        except OSError:
-            continue  # vanished mid-scan (a commit or steal in flight)
-        st = read_lease(p)
-        if st is None:
-            # torn lease: no valid owner to be alive — reclaimable
-            # (and 'expired' for the probe) once past the TTL
-            leases.append({"key": os.path.basename(p), "state": "torn",
-                           "owner": None, "generation": None,
-                           "age_s": round(age, 1),
-                           "expired": age > stale_s})
-            continue
-        row = {"key": st.get("key", os.path.basename(p)),
-               "state": st.get("state", "?"),
-               "owner": st.get("owner"),
-               "generation": st.get("generation"),
-               "stolen_from": st.get("stolen_from"),
-               "done_by": st.get("done_by"),
-               "age_s": round(age, 1), "expired": False}
-        if row["state"] == "claimed" and age > stale_s:
-            hb = beats.get(int(st.get("owner", -1)))
-            row["expired"] = (hb is None or
-                              not 0.0 <= heartbeat_age_s(hb, now)
-                              <= stale_s)
-        leases.append(row)
-
-    queue = None
-    qpath = os.path.join(state_dir, "queue.json")
-    try:
-        with open(qpath, "r", encoding="utf-8") as f:
-            manifest = json.load(f)
-    except (OSError, ValueError):
-        manifest = None
-    if manifest is not None or leases:
-        n_files = len((manifest or {}).get("files", [])) or len(leases)
-        n_done = sum(1 for l in leases if l["state"] == "done")
-        n_claimed = sum(1 for l in leases if l["state"] == "claimed")
-        queue = {"n_files": n_files, "n_done": n_done,
-                 "n_claimed": n_claimed,
-                 "n_pending": max(n_files - len(leases), 0),
-                 "n_torn": sum(1 for l in leases
-                               if l["state"] == "torn")}
-    return queue, leases
+from comapreduce_tpu.resilience.status import (build_report,  # noqa: E402
+                                               report_healthy)
 
 
 def render_text(rep: dict) -> str:
@@ -286,9 +141,7 @@ def main(argv=None) -> int:
     rep = build_report(args.output_dir, stale_s=args.stale_s,
                        n_ranks=args.n_ranks)
     print(json.dumps(rep) if args.json else render_text(rep))
-    # an expired-but-unreclaimed lease means work nobody will finish:
-    # probe-fail it like a stale rank
-    return 1 if rep["n_stale"] or rep["n_expired_leases"] else 0
+    return 0 if report_healthy(rep) else 1
 
 
 if __name__ == "__main__":
