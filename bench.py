@@ -1472,17 +1472,36 @@ def bench_campaign():
                 w1 = time.time()
                 c_end = c.snapshot()
             steady = {k: c_end[k] - c_first[k] for k in c_end}
-            return (steady_wall, steady, dict(runner.writeback_stats),
-                    (w0, w1), runner)
+            return (steady_wall, steady, c_end,
+                    dict(runner.writeback_stats), (w0, w1), runner)
 
         cache_dir = os.path.join(tmp, "jaxcache")
-        camp_wall, camp_steady, wb, camp_win, camp_runner = timed_run(
-            "campaign",
-            campaign={**quanta, "warm_compile": True},
-            ingest={"compile_cache_dir": cache_dir, "writeback": 2,
-                    "prefetch": 2},
-            telemetry=({"enabled": True, "flush_s": 0.2}
-                       if telemetry_on else None))
+        camp_wall, camp_steady, camp_full, wb, camp_win, camp_runner = \
+            timed_run(
+                "campaign",
+                campaign={**quanta, "warm_compile": True},
+                ingest={"compile_cache_dir": cache_dir, "writeback": 2,
+                        "prefetch": 2},
+                telemetry=({"enabled": True, "flush_s": 0.2}
+                           if telemetry_on else None))
+
+        # program-registry cross-check: snapshot BEFORE the telemetry
+        # close below (PROGRAMS rides TELEMETRY's lifecycle) — every
+        # steady-state warmup program must carry a cost/memory record,
+        # and the registry can never have recorded more programs than
+        # the CompileCounter saw compile requests
+        progs = []
+        if telemetry_on:
+            from comapreduce_tpu.telemetry.programs import PROGRAMS
+
+            progs = PROGRAMS.snapshot()
+        programs_info = {
+            "recorded": len(progs),
+            "names": sorted({p["name"] for p in progs}),
+            "compile_requests_full_run": camp_full["backend_compiles"],
+            "within_compile_budget":
+                len(progs) <= camp_full["backend_compiles"],
+        }
 
         # telemetry cross-check BEFORE the baseline run: TELEMETRY is
         # process-global, so close it here or the baseline would keep
@@ -1498,7 +1517,7 @@ def bench_campaign():
         import jax
 
         jax.config.update("jax_compilation_cache_dir", None)
-        base_wall, base_steady, _, _, _ = timed_run(
+        base_wall, base_steady, _, _, _, _ = timed_run(
             "baseline", None, None)
 
         write_s = wb.get("write_s", 0.0)
@@ -1535,6 +1554,7 @@ def bench_campaign():
                 # {} when BENCH_TELEMETRY=0 — check_perf's telemetry
                 # gate skips on absence
                 "telemetry": tele,
+                "programs": programs_info,
             },
         }
         print(json.dumps(line))
@@ -1686,6 +1706,8 @@ def bench_destriper():
         destripe_planned)
     from comapreduce_tpu.mapmaking.pixel_space import PixelSpace
     from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+    from comapreduce_tpu.telemetry import solver_trace
+    from comapreduce_tpu.telemetry.programs import PROGRAMS, shape_bucket
 
     small = os.environ.get("BENCH_SMALL", "") == "1"
     T = 12_000 if small else 120_000
@@ -1695,18 +1717,41 @@ def bench_destriper():
     n = pix.size
     tod_j, w_j = jnp.asarray(tod), jnp.asarray(w)
 
-    def run(pixv, npixv, call_kwargs=None, **partial_kwargs):
-        """Compile+warm one planned solve, then time a repeat run.
-        Returns (result, wall_s of the timed run)."""
+    # program cost/memory registry + solver trace land next to the
+    # evidence artifacts (programs.jsonl / solver.rank0.jsonl) — the
+    # check_perf HBM gate and the trace cross-check read them back.
+    # With evidence writing off and no explicit dir (the perf gate's
+    # children), they go to a temp dir: no artifact churn in the repo
+    out_root = os.environ.get("BENCH_EVIDENCE_DIR", "")
+    if not out_root:
+        if os.environ.get("BENCH_EVIDENCE", "1") == "0":
+            import tempfile
+
+            out_root = tempfile.mkdtemp(prefix="bench_destriper_")
+        else:
+            out_root = os.path.dirname(os.path.abspath(__file__))
+    if not PROGRAMS.enabled:
+        PROGRAMS.configure(out_root)
+
+    def run(pixv, npixv, name, call_kwargs=None, **partial_kwargs):
+        """AOT-compile one planned solve (feeding the compiled
+        executable's cost/memory analysis to the program registry —
+        the SAME compile the timed run dispatches, zero double
+        compiles), warm it, then time a repeat run. Returns
+        (result, wall_s of the timed run)."""
         plan = build_pointing_plan(pixv, npixv, L)
         fn = jax.jit(functools.partial(destripe_planned, plan=plan,
                                        n_iter=n_iter, threshold=1e-6,
                                        **partial_kwargs))
         kw = call_kwargs or {}
-        r = fn(tod_j, w_j, **kw)
-        float(jnp.sum(r.destriped_map))          # compile + warm
+        compiled = fn.lower(tod_j, w_j, **kw).compile()
+        PROGRAMS.record(f"destriper.{name}", compiled,
+                        shape_bucket=shape_bucket(tod_j, w_j),
+                        precision_id="tod=f32|cgdot=f32")
+        r = compiled(tod_j, w_j, **kw)
+        float(jnp.sum(r.destriped_map))          # warm + device sync
         t0 = time.perf_counter()
-        r = fn(tod_j, w_j, **kw)
+        r = compiled(tod_j, w_j, **kw)
         float(jnp.sum(r.destriped_map))          # host fetch (see finish)
         return r, time.perf_counter() - t0
 
@@ -1725,6 +1770,7 @@ def bench_destriper():
 
     # ---- preconditioner ladder (dense map space) ------------------------
     ladder = {}
+    r_mg = None
     for name in ("none", "jacobi", "twolevel", "multigrid"):
         call_kw, part_kw, extra = {}, {}, {}
         if name == "none":
@@ -1740,7 +1786,8 @@ def bench_destriper():
                 grp, aci = build_coarse_preconditioner(pix, w, npix, L,
                                                        block=blk)
                 call_kw["coarse"] = (jnp.asarray(grp), jnp.asarray(aci))
-                r, wall = run(pix, npix, call_kwargs=call_kw)
+                r, wall = run(pix, npix, f"twolevel_b{blk}",
+                              call_kwargs=call_kw)
                 if not np.any(np.asarray(r.diverged)):
                     break
                 diverged_blocks.append(blk)
@@ -1753,13 +1800,40 @@ def bench_destriper():
                 jnp.asarray,
                 build_multigrid_hierarchy(pix, w, npix, L, block=8,
                                           levels=2))
-        r, wall = run(pix, npix, call_kwargs=call_kw, **part_kw)
+            # the acceptance rung carries the per-iteration solver
+            # trace (3 scalar scatters/iteration — noise next to the
+            # V-cycle's matvecs, and reported honestly either way)
+            part_kw["trace_iters"] = n_iter
+        r, wall = run(pix, npix, name, call_kwargs=call_kw, **part_kw)
         ladder[name] = stats(r, wall)
+        if name == "multigrid":
+            r_mg = r
+
+    # ---- solver trace cross-check: the recorded per-iteration residual
+    # records must match the solve's reported iteration count EXACTLY
+    # (both come from the same dispatch — the traced multigrid rung) ------
+    trace_path = os.path.join(out_root, "solver.rank0.jsonl")
+    try:
+        os.unlink(trace_path)        # count THIS run's records only
+    except OSError:
+        pass
+    solver_trace.record_solve(
+        r_mg, band="multigrid", path=trace_path,
+        precond_id=f"multigrid|L{L}", precision_id="tod=f32|cgdot=f32",
+        threshold=1e-6)
+    trace_recs = [rec for rec in solver_trace.read_solver(trace_path)
+                  if rec.get("kind") == "iteration"]
+    trace_info = {
+        "path": trace_path,
+        "iteration_records": len(trace_recs),
+        "reported_iters": int(r_mg.n_iter),
+        "match": len(trace_recs) == int(r_mg.n_iter),
+    }
 
     # ---- compacted vs dense (jacobi) ------------------------------------
     space = PixelSpace.from_pixels(pix, npix)
-    r_dense, wall_dense = run(pix, npix)
-    r_comp, wall_comp = run(space.remap(pix), space)
+    r_dense, wall_dense = run(pix, npix, "compact_dense")
+    r_comp, wall_comp = run(space.remap(pix), space, "compact")
     compacted = {
         "dense": {**stats(r_dense, wall_dense),
                   "map_vector_bytes": map_bytes(r_dense)},
@@ -1775,7 +1849,7 @@ def bench_destriper():
     hpix = raster_to_healpix(pix, nx, nside)
     npix_sky = hp.nside2npix(nside)
     sp4096 = PixelSpace.from_pixels(hpix, npix_sky)
-    r_s, wall_s = run(sp4096.remap(hpix), sp4096)
+    r_s, wall_s = run(sp4096.remap(hpix), sp4096, "survey4096")
     survey = {**stats(r_s, wall_s),
               "nside": nside, "npix_sky": npix_sky,
               "n_compact": sp4096.n_compact,
@@ -1801,6 +1875,8 @@ def bench_destriper():
             "preconditioners": ladder,
             "compacted": compacted,
             "survey4096": survey,
+            "solver_trace": trace_info,
+            "programs": PROGRAMS.snapshot(),
             "device": str(jax.devices()[0].platform),
         },
     }
@@ -1880,11 +1956,11 @@ def bench_kernels():
     blockc = Bc * Cc * Lc * 4
 
     def passes(fn, shapes):
+        from comapreduce_tpu.telemetry.programs import analyze
+
         args = [jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes]
-        cost = jax.jit(fn).lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        return float(dict(cost).get("bytes accessed", 0.0)) / blockc
+        cost = analyze(jax.jit(fn).lower(*args).compile())
+        return cost.get("bytes_accessed", 0.0) / blockc
 
     fill_acct = float(masked_fill_logical_passes((Bc, Cc, Lc)))
     acct = {}

@@ -80,7 +80,8 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                     n_iter: int, threshold: float, n_groups: int = 0,
                     compact: bool = False, precond: str = "jacobi",
                     pair_batch: int | None = None, mg_smooth: int = 1,
-                    kernels: str = "auto", cg_dot: str = "f32"):
+                    kernels: str = "auto", cg_dot: str = "f32",
+                    trace_iters: int = 0):
     import functools
 
     import jax
@@ -99,7 +100,8 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                                        mg_smooth=mg_smooth,
                                        precond=precond,
                                        kernels=kernels,
-                                       cg_dot=cg_dot))
+                                       cg_dot=cg_dot,
+                                       trace_iters=trace_iters))
         if compact:
             return fn, np.asarray(plan.uniq_pixels)
         return fn
@@ -117,7 +119,7 @@ def _planned_solver(pixels: np.ndarray, npix: int, offset_length: int,
                      (int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups), str(precond),
                       pair_batch, int(mg_smooth), str(kernels),
-                      str(cg_dot)), build)
+                      str(cg_dot), int(trace_iters)), build)
 
 
 def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
@@ -377,7 +379,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                use_ground=False, sharded=False, coarse_block=0,
                watchdog=None, unit="", precond="jacobi",
                pair_batch=None, mg=None, x0=None, kernels="auto",
-               cg_dot="f32"):
+               cg_dot="f32", trace_iters=None, trace_base=0):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -409,10 +411,28 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     ``x0`` warm-starts the CG from a prior iterate (the solver-
     checkpoint resume, :func:`solve_band_checkpointed`) — non-sharded
     offsets-only planned path only; ground/sharded solves ignore it
-    with a warning and start cold."""
+    with a warning and start cold.
+
+    ``trace_iters`` controls the per-iteration solver trace
+    (``telemetry.solver_trace``, docs/OPERATIONS.md §17): ``None`` (the
+    default) auto-enables depth-``n_iter`` tracing on the non-sharded
+    planned paths whenever telemetry is on, ``0`` forces it off, and an
+    explicit positive depth caps the history. Traced solves append
+    iteration + summary records to ``solver.rank{r}.jsonl`` under the
+    telemetry log dir; ``trace_base`` offsets the recorded global
+    iteration numbers (the checkpointed chunk loop passes its running
+    ``done`` count so chunked traces continue one global axis)."""
     from comapreduce_tpu.mapmaking.destriper import _check_precond
+    from comapreduce_tpu.telemetry import solver_trace
 
     _check_precond(precond, coarse=coarse_block or None, mg=mg)
+    if trace_iters is None:
+        # the sharded programs and scatter fallbacks are untraced (their
+        # CG loops are memoized per-geometry and shard_map-threaded);
+        # everything else rides the telemetry switch
+        trace_iters = (int(n_iter)
+                       if not sharded and solver_trace.trace_enabled()
+                       else 0)
     if x0 is not None and (sharded or use_ground):
         # destripe_planned's x0 is offsets-only by construction (the
         # joint ground solve raises on it) and the sharded programs
@@ -427,10 +447,11 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             lambda: solve_band(data, offset_length=offset_length,
                                n_iter=n_iter, threshold=threshold,
                                use_ground=use_ground, sharded=sharded,
-                               coarse_block=coarse_block,
+                               coarse_block=coarse_block, unit=unit,
                                precond=precond, pair_batch=pair_batch,
                                mg=mg, x0=x0, kernels=kernels,
-                               cg_dot=cg_dot),
+                               cg_dot=cg_dot, trace_iters=trace_iters,
+                               trace_base=trace_base),
             watchdog, unit)
     if sharded and mg is not None:
         # the sharded programs keep the two-level preconditioner: the
@@ -590,13 +611,29 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                "geometry (%s); running Jacobi", exc)
                 mg = None
         mg_smooth = mg["smooth"] if mg is not None else 1
+        # the applied-preconditioner label + solve configuration the
+        # trace records carry (solver_report groups convergence by it)
+        precond_used = ("multigrid" if kwargs.get("mg") is not None
+                        else "twolevel" if kwargs.get("coarse") is not None
+                        else precond)
+        precision_id = f"tod={getattr(data.tod, 'dtype', 'f32')}" \
+                       f"|cgdot={cg_dot}"
+
+        def _record_trace(res, label):
+            if getattr(res, "trace", None) is None:
+                return
+            solver_trace.record_solve(
+                res, band=unit or "band", base=trace_base,
+                precond_id=f"{label}|L{offset_length}",
+                precision_id=precision_id, threshold=threshold)
+
         if use_ground:
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold,
                                  n_groups=data.n_groups, precond=precond,
                                  pair_batch=pair_batch,
                                  mg_smooth=mg_smooth, kernels=kernels,
-                                 cg_dot=cg_dot)
+                                 cg_dot=cg_dot, trace_iters=trace_iters)
             result = fn(jnp.asarray(data.tod[:n]),
                         jnp.asarray(data.weights[:n]),
                         ground_off=jnp.asarray(gid_off),
@@ -606,7 +643,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                  offset_length, n_iter, threshold,
                                  precond=precond, pair_batch=pair_batch,
                                  mg_smooth=mg_smooth, kernels=kernels,
-                                 cg_dot=cg_dot)
+                                 cg_dot=cg_dot, trace_iters=trace_iters)
             if x0 is not None:
                 kwargs["x0"] = jnp.asarray(x0)
             result = fn(jnp.asarray(data.tod[:n]),
@@ -622,6 +659,11 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             # (x0 is offsets-only by construction). Slower but safe —
             # and recorded, not silent (docs/OPERATIONS.md §7).
             which = "multigrid" if "mg" in kwargs else "coarse"
+            # the diverged attempt's trace is recorded too — the decay
+            # that tripped the monitor is exactly what the operator
+            # opens solver_report for
+            _record_trace(result, precond_used)
+            precond_used = "jacobi-fallback"
             if use_ground:
                 logger.warning(
                     "CG diverged under the %s preconditioner "
@@ -640,6 +682,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 result = fn(jnp.asarray(data.tod[:n]),
                             jnp.asarray(data.weights[:n]),
                             x0=result.offsets)
+        _record_trace(result, precond_used)
     if sharded and bool(np.any(np.asarray(result.diverged))):
         # the sharded programs are memoized per-(geometry, coarse) pair;
         # flag the divergence for the operator instead of compiling a
@@ -730,9 +773,12 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
     while True:
         step = max(min(chunk, n_iter - done), 1)
         t_chunk = time.perf_counter()
+        # trace_base=done: a chunked trace continues the SAME global
+        # iteration axis across chunks and resumes (solver_trace)
         result = solve_band(data, offset_length=offset_length,
                             n_iter=step, threshold=threshold,
-                            watchdog=watchdog, unit=unit, x0=x0, **kw)
+                            watchdog=watchdog, unit=unit, x0=x0,
+                            trace_base=done, **kw)
         ran = int(np.asarray(result.n_iter))
         done += ran
         residual = float(np.asarray(result.residual))

@@ -117,6 +117,13 @@ class DestriperResult(NamedTuple):
     # wrappers attach it via `_replace` so writers/coadd can scatter to
     # the sky at write time without a side channel.
     sky_pixels: object = None
+    # per-iteration CG histories when the solve ran with trace_iters>0:
+    # (rr_hist, alpha_hist, beta_hist, b_norm) f32 arrays of shape
+    # (trace_iters,) + system shape. None (an empty pytree node) when
+    # untraced — sharded/scatter paths never set it, so out_specs and
+    # the compiled programs are unchanged. Hosts render it into
+    # solver.rank{r}.jsonl via telemetry.solver_trace.
+    trace: object = None
 
 
 def watched_solve(solve, watchdog=None, name: str = "mapmaking.cg_solve",
@@ -307,7 +314,7 @@ def _jacobi_inverse(diag_a: jax.Array, diag_fwf: jax.Array,
 
 
 def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
-             x0=None, divergence_k: int = DIVERGENCE_K):
+             x0=None, divergence_k: int = DIVERGENCE_K, trace_n: int = 0):
     """Shared (P)CG driver over an arbitrary pytree of unknowns.
 
     Both destriper paths (scatter and planned) use this one loop so the
@@ -320,8 +327,10 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
     psum-reduced) inner product; ``precond`` an optional SPD ``M^{-1}``
     application (e.g. Jacobi). Convergence tests the TRUE residual norm
     ``|r|^2`` against ``threshold^2 |b|^2`` in both cases. Returns
-    ``(x, rz, k, b_norm, diverged)`` with ``rz = |r|^2`` and ``diverged``
-    an i32 0/1 flag (per system).
+    ``(x, rz, k, b_norm, diverged, trace)`` with ``rz = |r|^2``,
+    ``diverged`` an i32 0/1 flag (per system) and ``trace`` either
+    ``None`` (``trace_n=0``) or ``(rr_hist, alpha_hist, beta_hist)``
+    per-iteration histories (see below).
 
     ``dot`` may return a BATCH of inner products (shape ``(nb,)`` for a
     multi-RHS solve over per-band leaves ``(nb, n)``): alpha/beta and the
@@ -344,6 +353,16 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
       Jacobi fallback restarts from exactly this point.
     - ``x0`` — optional warm start (the fallback's restart vector);
       ``None`` keeps the zero start.
+    - ``trace_n`` — static trace depth. When > 0 the loop carries
+      ``(trace_n,) + shape(b_norm)`` f32 histories of the true residual
+      ``|r|^2``, alpha and beta through the while-loop state (three
+      scalar scatters per iteration per system — negligible next to one
+      matvec) and the return gains them as a sixth element; 0 (the
+      default) keeps the compiled program identical to the untraced one
+      and returns ``None`` there. Iterations past ``trace_n`` overwrite
+      the last slot so the array bound can never be exceeded; frozen
+      (broken-down/diverged) systems keep their last recorded value,
+      matching the state's own freeze semantics.
     """
     b_norm = dot(b, b)
     minv = precond if precond is not None else (lambda v: v)
@@ -366,7 +385,7 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
         return (k < n_iter) & jnp.any(live)
 
     def body(state):
-        (x, r, p, rz, rr, k, done, xb, rrb, inc, div) = state
+        (x, r, p, rz, rr, k, done, xb, rrb, inc, div, hist) = state
         q = matvec(p)
         pq = dot(p, q)
         ok = jnp.isfinite(pq) & (pq > 0) & ~done
@@ -395,11 +414,17 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
         # on breakdown OR divergence: freeze that system's iterate, keep
         # its last good residual, and (once every system is done) exit
         adv = ok & ~div_new
+        if trace_n:
+            rr_h, al_h, be_h = hist
+            idx = jnp.minimum(k, trace_n - 1)
+            hist = (rr_h.at[idx].set(jnp.where(adv, rr_new, rr)),
+                    al_h.at[idx].set(alpha),
+                    be_h.at[idx].set(beta))
         return (sel_where(adv, x_new, x), sel_where(adv, r_new, r),
                 sel_where(adv, p_new, p),
                 jnp.where(adv, rz_new, rz), jnp.where(adv, rr_new, rr),
                 k + 1, done | ~ok | div_new, xb_new, rrb_new, inc_new,
-                div_new)
+                div_new, hist)
 
     if x0 is None:
         x_start = jax.tree.map(jnp.zeros_like, b)
@@ -411,10 +436,18 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
     rr0 = dot(r0, r0)
     z0 = minv(r0)
     zeros = jnp.zeros(jnp.shape(b_norm))
+    if trace_n:
+        tshape = (int(trace_n),) + tuple(jnp.shape(b_norm))
+        hist0 = (jnp.zeros(tshape, jnp.float32),
+                 jnp.zeros(tshape, jnp.float32),
+                 jnp.zeros(tshape, jnp.float32))
+    else:
+        hist0 = None  # empty pytree node: program identical to untraced
     state0 = (x_start, r0, z0, dot(r0, z0), rr0,
               jnp.asarray(0, jnp.int32), zeros.astype(bool),
-              x_start, rr0, zeros.astype(jnp.int32), zeros.astype(bool))
-    x, _, _, _, rr, k, _, xb, rrb, _, div = jax.lax.while_loop(
+              x_start, rr0, zeros.astype(jnp.int32), zeros.astype(bool),
+              hist0)
+    x, _, _, _, rr, k, _, xb, rrb, _, div, hist = jax.lax.while_loop(
         cond, body, state0)
     # a DIVERGED system hands back its best iterate, never the runaway
     # one. Healthy systems keep the final iterate untouched: in the
@@ -424,7 +457,7 @@ def _cg_loop(matvec, b, dot, n_iter: int, threshold: float, precond=None,
     use_best = div & (rrb < rr)
     x = sel_where(use_best, xb, x)
     rr = jnp.where(use_best, rrb, rr)
-    return x, rr, k, b_norm, div.astype(jnp.int32)
+    return x, rr, k, b_norm, div.astype(jnp.int32), hist
 
 
 def _check_precond(precond: str, coarse=None, mg=None) -> str:
@@ -554,7 +587,7 @@ def destripe(tod: jax.Array, pixels: jax.Array, weights: jax.Array,
             return (v[0] * inv_diag, v[1])
 
     dot = (_dot_compensated if cg_dot == "compensated" else _dot)
-    x, rz, k, b_norm, diverged = _cg_loop(
+    x, rz, k, b_norm, diverged, _ = _cg_loop(
         matvec, b, lambda u, v: dot(u, v, axis_name), n_iter, threshold,
         precond=precond_fn)
     offsets, ground = x
@@ -951,7 +984,8 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      precond: str = "jacobi",
                      kernels: str = "auto",
                      kernels_platform: str | None = None,
-                     cg_dot: str = "f32") -> DestriperResult:
+                     cg_dot: str = "f32",
+                     trace_iters: int = 0) -> DestriperResult:
     """Destripe with a precomputed :class:`PointingPlan` — the fast path.
 
     Mathematically identical to :func:`destripe` (same normal equations,
@@ -1343,12 +1377,13 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
             def dot_g(u, v):
                 return (_psum(jnp.sum(u[0] * v[0]))
                         + jnp.sum(u[1] * v[1]))
-        x, rz, k, b_norm, diverged = _cg_loop(
+        x, rz, k, b_norm, diverged, cg_trace = _cg_loop(
             matvec_g, b_g, dot_g,
             n_iter, threshold,
             # identity on the ground block, as in the scatter path (see
             # destripe's precond comment)
-            precond=lambda v: (apply_precond(v[0]), v[1]))
+            precond=lambda v: (apply_precond(v[0]), v[1]),
+            trace_n=trace_iters)
         a, ground = x
         c0 = a + ground[:, 0][grp_off]
         c1 = ground[:, 1][grp_off]
@@ -1364,9 +1399,10 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
         else:
             def dot_b(u, v):
                 return _psum(jnp.sum(u * v, axis=-1))
-        a, rz, k, b_norm, diverged = _cg_loop(
+        a, rz, k, b_norm, diverged, cg_trace = _cg_loop(
             matvec, b, dot_b,
-            n_iter, threshold, precond=apply_precond, x0=x0)
+            n_iter, threshold, precond=apply_precond, x0=x0,
+            trace_n=trace_iters)
         ground = jnp.zeros((0, 2), f32)
         pair_res = pair_wd - pair_w * gather_a(a)
 
@@ -1390,5 +1426,10 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     w_map = expand(sum_w)
     h_map = expand(to_global(rank_sum(pair_cnt)))
     residual = jnp.sqrt(rz / jnp.maximum(b_norm, 1e-30))
+    # histories + |b|^2 so the host can reconstruct relative residuals;
+    # None when untraced (an empty pytree node — sharded out_specs and
+    # the compiled program are unchanged, the sky_pixels precedent)
+    trace = None if cg_trace is None else (cg_trace + (b_norm,))
     return DestriperResult(a, ground, m_destriped, m_naive,
-                           w_map, h_map, k, residual, diverged)
+                           w_map, h_map, k, residual, diverged,
+                           trace=trace)

@@ -159,7 +159,7 @@ def destripe_pol(tod, pixels, weights, psi, npix: int,
     # shared (P)CG driver: same breakdown guard and convergence test as
     # every other destriper solve (without a preconditioner, rz == rr,
     # so the criterion matches the old inline loop)
-    a, rz, k, b_norm, _ = _cg_loop(matvec, b, dot, n_iter, threshold)
+    a, rz, k, b_norm, _, _ = _cg_loop(matvec, b, dot, n_iter, threshold)
 
     # A constant offset vector is (near-)degenerate with the I map — the
     # Tikhonov floor in the map solve tips the balance so CG parks the
@@ -324,7 +324,7 @@ def destripe_pol_planned(tod, weights, psi, plan, n_iter: int = 100,
     b = off_sum(pwds_off[0]
                 - jnp.sum(pws_off * gather_m(m_d), axis=0))
 
-    a, rz, k, b_norm, _ = _cg_loop(
+    a, rz, k, b_norm, _, _ = _cg_loop(
         matvec, b, lambda u, v: jnp.sum(u * v, axis=-1), n_iter,
         threshold, precond=apply_precond)
     # zero-mean pinning: same convention as the scatter path (a constant

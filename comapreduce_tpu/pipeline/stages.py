@@ -285,6 +285,24 @@ def _stage_donate(argnums: tuple) -> tuple:
     return argnums if jax.default_backend() != "cpu" else ()
 
 
+def _warm_compile(name: str, fn, *args, **kwargs):
+    """AOT lower+compile one warmup program AND feed the compiled
+    executable's cost/memory analysis to the program registry
+    (``telemetry.programs`` — flops, bytes accessed, HBM footprint per
+    program x shape bucket, docs/OPERATIONS.md §17). The warmup already
+    pays the compile; the registry just stops discarding the result."""
+    compiled = fn.lower(*args, **kwargs).compile()
+    try:
+        from comapreduce_tpu.telemetry.programs import (PROGRAMS,
+                                                        shape_bucket)
+
+        PROGRAMS.record(name, compiled,
+                        shape_bucket=shape_bucket(*args, **kwargs))
+    except Exception:   # the registry observes; it never breaks warmup
+        pass
+    return compiled
+
+
 @functools.lru_cache(maxsize=32)
 def _batched_atmosphere_fit(n_scans: int):
     """Cached jitted whole-batch atmosphere fit (one compile per scan
@@ -435,10 +453,11 @@ class SkyDip(_StageBase):
         for f in sorted({len(idx) for idx in
                          stage_feed_batches(F, B, C, Tb,
                                             self.feed_batch)}):
-            fit.lower(jax.ShapeDtypeStruct((f, B, C, Tb), f32),
-                      jax.ShapeDtypeStruct((f, Tb), f32),
-                      jax.ShapeDtypeStruct((Tb,), i32),
-                      jax.ShapeDtypeStruct((f, Tb), f32)).compile()
+            _warm_compile("skydip.atmosphere_fit", fit,
+                          jax.ShapeDtypeStruct((f, B, C, Tb), f32),
+                          jax.ShapeDtypeStruct((f, Tb), f32),
+                          jax.ShapeDtypeStruct((Tb,), i32),
+                          jax.ShapeDtypeStruct((f, Tb), f32))
 
     def _fit_sky_nod(self, data, level2) -> bool:
         from comapreduce_tpu.data.level import (COMAPLevel1,
@@ -562,10 +581,11 @@ class AtmosphereRemoval(_StageBase):
         for f in sorted({len(idx) for idx in
                          stage_feed_batches(F, B, C, Tb,
                                             self.feed_batch)}):
-            fit.lower(jax.ShapeDtypeStruct((f, B, C, Tb), f32),
-                      jax.ShapeDtypeStruct((f, Tb), f32),
-                      jax.ShapeDtypeStruct((Tb,), i32),
-                      jax.ShapeDtypeStruct((f, 1), f32)).compile()
+            _warm_compile("atmosphere.scan_fit", fit,
+                          jax.ShapeDtypeStruct((f, B, C, Tb), f32),
+                          jax.ShapeDtypeStruct((f, Tb), f32),
+                          jax.ShapeDtypeStruct((Tb,), i32),
+                          jax.ShapeDtypeStruct((f, 1), f32))
 
 
 @functools.lru_cache(maxsize=8)
@@ -670,9 +690,10 @@ class Level1Averaging(_StageBase):
         for f in sorted({len(idx) for idx in
                          stage_feed_batches(F, B, C, Tb,
                                             self.feed_batch)}):
-            fit.lower(jax.ShapeDtypeStruct((f, B, C, Tb), f32),
-                      jax.ShapeDtypeStruct((f, B, C), f32),
-                      jax.ShapeDtypeStruct((f, B, C), f32)).compile()
+            _warm_compile("level1.frequency_bin", fit,
+                          jax.ShapeDtypeStruct((f, B, C, Tb), f32),
+                          jax.ShapeDtypeStruct((f, B, C), f32),
+                          jax.ShapeDtypeStruct((f, B, C), f32))
 
 
 @register()
@@ -893,14 +914,15 @@ class Level1AveragingGainCorrection(_StageBase):
         SDS, f32, i32 = jax.ShapeDtypeStruct, jnp.float32, jnp.int32
         fold = (SDS((), i32, sharding=repl),) if bk.enabled else ()
         with mesh:
-            fn.lower(SDS((fb, B, C, Tb), f32, sharding=feed_sh),
-                     SDS((fb, Tb), f32, sharding=feed_sh),
-                     SDS((Sb,), i32, sharding=repl),
-                     SDS((Sb,), i32, sharding=repl),
-                     SDS((fb, B, C), f32, sharding=feed_sh),
-                     SDS((fb, B, C), f32, sharding=feed_sh),
-                     SDS((B, C), f32, sharding=repl),
-                     *fold).compile()
+            _warm_compile("level1.reduce_feeds", fn,
+                          SDS((fb, B, C, Tb), f32, sharding=feed_sh),
+                          SDS((fb, Tb), f32, sharding=feed_sh),
+                          SDS((Sb,), i32, sharding=repl),
+                          SDS((Sb,), i32, sharding=repl),
+                          SDS((fb, B, C), f32, sharding=feed_sh),
+                          SDS((fb, B, C), f32, sharding=feed_sh),
+                          SDS((B, C), f32, sharding=repl),
+                          *fold)
 
 
 @register()

@@ -41,6 +41,7 @@ a campaign of mostly-resumed files no longer drags its p95 toward zero.
 from __future__ import annotations
 
 import atexit
+import bisect
 import itertools
 import json
 import logging
@@ -51,6 +52,7 @@ import threading
 import time
 
 __all__ = ["TELEMETRY", "Telemetry", "TelemetryConfig", "StageTimings",
+           "RequestMetrics", "LATENCY_BUCKETS_S",
            "serving_lane_rank", "SERVING_LANE_BASE"]
 
 logger = logging.getLogger("comapreduce_tpu")
@@ -237,6 +239,15 @@ class Telemetry:
             _install_hooks()
         except Exception:  # jax absent/odd backend: spans still work
             pass
+        # the compiled-program registry rides the same switch: telemetry
+        # on means every AOT compile site self-reports cost/memory into
+        # <log_dir>/programs.jsonl (ISSUE 15) — no second knob to forget
+        try:
+            from comapreduce_tpu.telemetry.programs import PROGRAMS
+
+            PROGRAMS.configure(log_dir, rank)
+        except Exception:
+            pass
         return self
 
     def close(self) -> None:
@@ -251,6 +262,12 @@ class Telemetry:
             self.flush()
         self._enabled = False
         self._gauges.clear()
+        try:
+            from comapreduce_tpu.telemetry.programs import PROGRAMS
+
+            PROGRAMS.close()
+        except Exception:
+            pass
 
     # -- emission ----------------------------------------------------------
     def _stack(self) -> list:
@@ -494,3 +511,73 @@ class StageTimings(dict):
         if not skips:
             return list(vals)
         return [v for i, v in enumerate(vals) if i not in skips]
+
+
+#: request-latency histogram bounds (seconds) shared by every HTTP
+#: surface here — localhost JSON endpoints live in the 1-10 ms bins,
+#: tile/cutout transfers reach the 100 ms+ bins, and the +Inf bucket
+#: catches stalls. Fixed bounds keep scrapes mergeable across restarts.
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class RequestMetrics:
+    """Per-request HTTP telemetry: a Prometheus cumulative latency
+    histogram plus per-(route, status) counters, shared by the live
+    sidecar (``telemetry/live.py``) and the tile server
+    (``tiles/http.py``) so both /metrics surfaces speak the same
+    schema (ISSUE 15).
+
+    ``observe()`` is handler-thread-safe and costs one lock + one
+    bisect; ``prom_lines()`` renders::
+
+        comap_<name>_request_duration_seconds_bucket{le="0.005"} 4
+        comap_<name>_request_duration_seconds_sum 0.012
+        comap_<name>_request_duration_seconds_count 5
+        comap_<name>_requests_total{route="/metrics",status="200"} 5
+    """
+
+    def __init__(self, name: str,
+                 buckets: tuple = LATENCY_BUCKETS_S):
+        self.name = str(name)
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: +Inf
+        self._sum_s = 0.0
+        self._n = 0
+        self._routes: dict = {}
+
+    def observe(self, route: str, status: int, dur_s: float) -> None:
+        dur_s = max(float(dur_s), 0.0)
+        i = bisect.bisect_left(self.buckets, dur_s)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum_s += dur_s
+            self._n += 1
+            key = (str(route), int(status))
+            self._routes[key] = self._routes.get(key, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counts": list(self._counts), "sum_s": self._sum_s,
+                    "n": self._n, "routes": dict(self._routes)}
+
+    def prom_lines(self) -> list:
+        snap = self.snapshot()
+        base = f"comap_{self.name}_request_duration_seconds"
+        lines = [f"# TYPE {base} histogram"]
+        cum = 0
+        for bound, count in zip(self.buckets, snap["counts"]):
+            cum += count
+            lines.append(f'{base}_bucket{{le="{bound:g}"}} {cum}')
+        cum += snap["counts"][-1]
+        lines.append(f'{base}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{base}_sum {snap['sum_s']:.9g}")
+        lines.append(f"{base}_count {snap['n']}")
+        total = f"comap_{self.name}_requests_total"
+        if snap["routes"]:
+            lines.append(f"# TYPE {total} counter")
+        for (route, status), n in sorted(snap["routes"].items()):
+            lines.append(
+                f'{total}{{route="{route}",status="{status}"}} {n}')
+        return lines

@@ -43,6 +43,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import math
 import os
 import re
 import threading
@@ -54,6 +55,7 @@ from comapreduce_tpu.resilience.status import (build_report,
                                                report_healthy,
                                                resolve_state_dir)
 from comapreduce_tpu.resilience.watchdog import percentile
+from comapreduce_tpu.telemetry.core import RequestMetrics
 from comapreduce_tpu.telemetry.quality import flag_counts, read_quality
 from comapreduce_tpu.telemetry.report import _prom_name
 
@@ -79,14 +81,23 @@ class LiveTail:
     accumulated state. Not thread-safe by itself — the server
     serialises polls under a lock."""
 
+    #: stream-identity fingerprint length: the first bytes of a stream
+    #: start its meta anchor (pid + wall0/mono0 differ per writer), so
+    #: a replaced file is distinguishable from a grown one
+    HEAD_BYTES = 64
+
     def __init__(self, log_dir: str):
         self.log_dir = log_dir or "."
-        self._files: dict = {}  # path -> {"offset", "rank", "align"}
+        # path -> {"offset", "rank", "align", "mtime", "head"}
+        self._files: dict = {}
         self.counters: dict = {}  # (name, rank) -> total
         self.gauges: dict = {}    # (name, rank) -> last value
         self.span_windows: dict = {}  # name -> deque[dur]
         self.span_totals: dict = {}   # name -> [count, sum]
         self.last_event_t: dict = {}  # rank -> aligned wall seconds
+        # rank -> deque[(iteration, log10_residual, threshold)] from
+        # solver.log10_residual gauges: the ETA slope fit's input
+        self.solver_history: dict = {}
         self.dropped_lines = 0
         self.events_consumed = 0
 
@@ -107,15 +118,30 @@ class LiveTail:
         if state is None:
             state = self._files[path] = {
                 "offset": 0, "rank": int(m.group(1)) if m else 0,
-                "align": 0.0}
+                "align": 0.0, "mtime": -1, "head": b""}
         try:
-            size = os.stat(path).st_size
+            st = os.stat(path)
         except OSError:
             return 0
+        size = st.st_size
         if size < state["offset"]:
             state["offset"] = 0  # replaced/rotated stream: restart
+        elif state["offset"] and st.st_mtime_ns != state["mtime"]:
+            # a stream REPLACED at equal-or-larger size passes the size
+            # checks (the equal-size rewrite was PR 14's documented
+            # blind spot): when the mtime moved, re-verify the stream's
+            # identity by its first-bytes fingerprint and restart from
+            # byte 0 on a mismatch — re-absorbing accumulates counters,
+            # exactly the shrink case's semantics. A plain append (or a
+            # metadata-only touch) keeps the fingerprint and the offset.
+            head = self._head(path)
+            if not state["head"] \
+                    or head[:len(state["head"])] != state["head"]:
+                state["offset"] = 0
         if size == state["offset"]:
+            state["mtime"] = st.st_mtime_ns
             return 0
+        started_at_zero = state["offset"] == 0
         try:
             with open(path, "rb") as f:
                 f.seek(state["offset"])
@@ -129,6 +155,9 @@ class LiveTail:
         if cut < 0:
             return 0
         state["offset"] += cut + 1
+        if started_at_zero:
+            state["head"] = chunk[:self.HEAD_BYTES]
+        state["mtime"] = st.st_mtime_ns
         n = 0
         for line in chunk[:cut].split(b"\n"):
             if not line.strip():
@@ -146,6 +175,13 @@ class LiveTail:
         self.events_consumed += n
         return n
 
+    def _head(self, path: str) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                return f.read(self.HEAD_BYTES)
+        except OSError:
+            return b""
+
     def _absorb(self, ev: dict, state: dict) -> None:
         kind = ev.get("kind")
         if kind == "meta":
@@ -160,8 +196,20 @@ class LiveTail:
             self.counters[key] = self.counters.get(key, 0.0) \
                 + float(ev.get("value", 0.0))
         elif kind == "gauge":
-            self.gauges[(ev.get("name", ""), rank)] = \
-                float(ev.get("value", 0.0))
+            name = ev.get("name", "")
+            value = float(ev.get("value", 0.0))
+            self.gauges[(name, rank)] = value
+            if name == "solver.log10_residual":
+                # the solver trace stamps the iteration ON the gauge
+                # (no event-ordering games): the history feeds the
+                # /metrics slope-fit ETA
+                attrs = ev.get("attrs") or {}
+                hist = self.solver_history.get(rank)
+                if hist is None:
+                    hist = self.solver_history[rank] = \
+                        collections.deque(maxlen=SPAN_WINDOW)
+                hist.append((float(attrs.get("iteration", -1.0)), value,
+                             float(attrs.get("threshold", 0.0))))
         elif kind == "span":
             attrs = ev.get("attrs") or {}
             if not attrs.get("skipped"):
@@ -218,6 +266,10 @@ class LiveServer:
         self._tail: LiveTail | None = None
         self.stats = {"t_start_unix": time.time(), "n_requests": 0,
                       "n_errors": 0, "by_route": {}}
+        # per-request latency histogram + route/status counters, the
+        # schema tiles/http.py shares (ISSUE 15) — the sidecar measures
+        # itself on the same page it serves
+        self.request_metrics = RequestMetrics("live_http")
         self.httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self.httpd.daemon_threads = True
         self.httpd.app = self
@@ -338,10 +390,44 @@ class LiveServer:
                            f"{percentile(win, q):g}")
             out.append(f"{base}_sum {total:g}")
             out.append(f"{base}_count {count}")
+        out.extend(self._solver_metrics(tail))
         out.extend(self._campaign_metrics())
+        out.extend(self.request_metrics.prom_lines())
         out.append(f"# TYPE comap_live_dropped_lines counter")
         out.append(f"comap_live_dropped_lines {tail.dropped_lines}")
         return "\n".join(out) + "\n"
+
+    def _solver_metrics(self, tail: LiveTail) -> list:
+        """The slope-based iters-to-tolerance ETA: fit the
+        log10-residual history (iteration-stamped gauge samples) per
+        rank and extrapolate to the solve's threshold. -1 means
+        'stalled or diverging' (non-negative slope); no line at all
+        means no solver has reported yet. The raw progress gauges
+        (``comap_solver_iteration`` etc.) ride the generic gauge path
+        above."""
+        out = []
+        for rank in sorted(tail.solver_history):
+            hist = [h for h in tail.solver_history[rank]
+                    if h[0] >= 0.0]
+            if len(hist) < 2:
+                continue
+            (i0, r0, _), (i1, r1, thr) = hist[0], hist[-1]
+            if i1 <= i0:
+                continue
+            slope = (r1 - r0) / (i1 - i0)  # decades per iteration
+            target = math.log10(max(thr, 1e-300)) if thr > 0 else None
+            if target is None:
+                continue
+            if r1 <= target:
+                eta = 0.0
+            elif slope < 0:
+                eta = (target - r1) / slope
+            else:
+                eta = -1.0
+            out.append("# TYPE comap_solver_eta_iters gauge")
+            out.append(f'comap_solver_eta_iters{{rank="{rank}"}} '
+                       f"{eta:g}")
+        return out
 
     def _campaign_metrics(self) -> list:
         rep = self.report()
@@ -419,7 +505,9 @@ class LiveServer:
                 f"{max(0.0, now - float(st['t_update_unix'])):g}")
         return out
 
-    def _account(self, route: str, status: int) -> None:
+    def _account(self, route: str, status: int,
+                 dur_s: float = 0.0) -> None:
+        self.request_metrics.observe(route, status, dur_s)
         with self._lock:
             self.stats["n_requests"] += 1
             if status >= 500 and route != "healthz":
@@ -446,6 +534,7 @@ class _Handler(BaseHTTPRequestHandler):
         app: LiveServer = self.server.app
         url = urlsplit(self.path)
         route = "error"
+        t0 = time.monotonic()
         try:
             route, status, ctype, body = app.handle(url.path)
         except _HTTPError as exc:
@@ -467,4 +556,4 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
             pass  # reader hung up mid-write; nothing to do
-        app._account(route, status)
+        app._account(route, status, time.monotonic() - t0)

@@ -1,0 +1,324 @@
+"""Compiled-program cost/memory registry (ISSUE 15).
+
+Every steady-state XLA program the pipeline compiles — the campaign
+warmup's stage fits, the destriper's planned solvers, the bench
+harness's kernels — knows its own FLOP count, bytes accessed, and HBM
+footprint via ``compiled.cost_analysis()`` / ``memory_analysis()``,
+but until this module those numbers were scattered across two ad-hoc
+bench.py calls and hand-transcribed into ROOFLINE.md. The ``PROGRAMS``
+singleton captures them at the compile sites, keyed by stable program
+name x shape bucket x precision id, deduped in-process, and appended
+torn-line-safe to ``programs.jsonl`` under ``[Global] log_dir`` (it
+rides ``TELEMETRY.configure`` — telemetry on means the program
+registry is on).
+
+Record schema (one JSON object per line)::
+
+    {"schema": 1, "kind": "program", "name": "destriper.multigrid",
+     "shape_bucket": "f32[262144]x2", "precision_id": "tod=float32",
+     "backend": "cpu", "rank": 0, "t": "2026-08-05T07:00:00Z",
+     "flops": 1.2e9, "bytes_accessed": 3.4e8,
+     "argument_bytes": 2097152, "output_bytes": 1048576,
+     "temp_bytes": 524288, "code_bytes": 40960}
+
+Analysis keys are best-effort per backend (CPU may lack a memory
+analysis; missing keys are simply absent, never errors). The
+machine-independent HBM-regression gate (``tools/check_perf.py``)
+compares per-program ``temp_bytes + output_bytes`` against a committed
+baseline via :func:`hbm_regressions`; ``tools/roofline_report.py``
+merges the registry with measured walls.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import logging
+import os
+import threading
+import time
+
+__all__ = ["PROGRAMS", "ProgramRegistry", "analyze", "hbm_regressions",
+           "program_key", "programs_path", "read_programs",
+           "shape_bucket"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+PROGRAMS_SCHEMA = 1
+
+# HBM gate slack: temp+output bytes are exact counts from XLA's buffer
+# assignment (machine-independent for a fixed backend), but minor
+# version-to-version layout drift should not page anyone — a quarter
+# over baseline is a real regression, 2% is noise
+HBM_SLACK = 1.25
+
+
+def programs_path(directory: str) -> str:
+    return os.path.join(directory or ".", "programs.jsonl")
+
+
+def shape_bucket(*args, **kwargs) -> str:
+    """A stable shape signature from example arguments (arrays or
+    ShapeDtypeStructs): ``f32[4096,64]xf32[4096]`` — the same bucketing
+    the campaign warmup keys programs by. Non-array leaves are skipped;
+    long signatures truncate with a ``+N`` tail."""
+    try:
+        import jax
+
+        leaves = jax.tree.util.tree_leaves((args, kwargs))
+    except Exception:
+        leaves = [a for a in args] + list(kwargs.values())
+    parts = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        name = getattr(dtype, "name", str(dtype))
+        short = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
+                 "float16": "f16", "int32": "i32", "int64": "i64",
+                 "uint32": "u32", "bool": "b1"}.get(name, name)
+        parts.append(f"{short}[{','.join(str(d) for d in shape)}]")
+    if len(parts) > 12:
+        parts, extra = parts[:12], len(parts) - 12
+        parts.append(f"+{extra}")
+    return "x".join(parts)
+
+
+def program_key(name: str, bucket: str = "",
+                precision_id: str = "") -> str:
+    return f"{name}|{bucket}|{precision_id}"
+
+
+def analyze(compiled) -> dict:
+    """Best-effort cost + memory analysis of one compiled executable.
+
+    ``cost_analysis()`` may return a list/tuple (one dict per
+    computation — take the first, bench.py's long-standing idiom) or a
+    dict; ``memory_analysis()`` exposes sizes as attributes and may be
+    absent entirely on some backends. Whatever the backend won't say
+    is simply missing from the result."""
+    out: dict = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if isinstance(cost, dict):
+            if "flops" in cost:
+                out["flops"] = float(cost["flops"])
+            if "bytes accessed" in cost:
+                out["bytes_accessed"] = float(cost["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for attr, key in (("argument_size_in_bytes", "argument_bytes"),
+                          ("output_size_in_bytes", "output_bytes"),
+                          ("temp_size_in_bytes", "temp_bytes"),
+                          ("alias_size_in_bytes", "alias_bytes"),
+                          ("generated_code_size_in_bytes",
+                           "code_bytes")):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[key] = int(v)
+    except Exception:
+        pass
+    return out
+
+
+class ProgramRegistry:
+    """Process-wide compiled-program registry (the TELEMETRY shape:
+    disabled it costs one attribute check; ``configure`` rides
+    ``Telemetry.configure`` so there is no second knob to forget)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._enabled = False
+        self._path = ""
+        self._rank = 0
+        self._seen: set = set()
+        self._records: list = []
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def configure(self, log_dir: str, rank: int = 0) -> "ProgramRegistry":
+        with self._lock:
+            self._path = programs_path(log_dir)
+            self._rank = int(rank)
+            self._enabled = True
+        return self
+
+    def close(self) -> None:
+        with self._lock:
+            self._enabled = False
+            self._seen.clear()
+            self._records.clear()
+
+    def seen(self, name: str, bucket: str = "",
+             precision_id: str = "") -> bool:
+        """Dedup probe — callers about to pay an AOT lower+compile just
+        to feed the registry should skip when the key is already
+        recorded (``record_jit`` does)."""
+        return program_key(name, bucket, precision_id) in self._seen
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._records)
+
+    def record(self, name: str, compiled, *, shape_bucket: str = "",
+               precision_id: str = "", extra: dict | None = None):
+        """Analyze one compiled executable and append its record.
+        Duplicate (name, bucket, precision) keys are dropped — warmup
+        re-runs re-compile the same programs, they don't re-count."""
+        if not self._enabled:
+            return None
+        key = program_key(name, shape_bucket, precision_id)
+        with self._lock:
+            if key in self._seen:
+                return None
+            self._seen.add(key)
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = ""
+        rec = {"schema": PROGRAMS_SCHEMA, "kind": "program",
+               "name": str(name), "shape_bucket": shape_bucket,
+               "precision_id": precision_id, "backend": backend,
+               "rank": self._rank,
+               "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        rec.update(analyze(compiled))
+        if extra:
+            rec.update(extra)
+        with self._lock:
+            self._records.append(rec)
+        self._append([rec])
+        try:
+            from comapreduce_tpu.telemetry.core import TELEMETRY
+
+            TELEMETRY.counter("programs.recorded", 1, name=str(name))
+        except Exception:
+            pass
+        return rec
+
+    def record_jit(self, name: str, fn, *args, precision_id: str = "",
+                   bucket: str | None = None, **kwargs):
+        """Record a ``jax.jit`` function by AOT-compiling it for the
+        given example arguments. The dedup probe runs FIRST: the
+        lower+compile (which does not share the jit call cache) is paid
+        at most once per distinct program, and any failure is swallowed
+        — the registry observes, it never breaks a solve."""
+        if not self._enabled:
+            return None
+        if bucket is None:
+            bucket = shape_bucket(*args, **kwargs)
+        if self.seen(name, bucket, precision_id):
+            return None
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+        except Exception as exc:
+            logger.debug("programs: AOT compile of %s failed (%s: %s)",
+                         name, type(exc).__name__, exc)
+            return None
+        return self.record(name, compiled, shape_bucket=bucket,
+                           precision_id=precision_id)
+
+    def _append(self, records: list) -> None:
+        """The quality ledger's torn-line-safe append discipline; the
+        single shared ``programs.jsonl`` is safe for multi-rank appends
+        because each record lands in ONE O_APPEND write."""
+        if not self._path:
+            return
+        try:
+            os.makedirs(os.path.dirname(self._path) or ".",
+                        exist_ok=True)
+            needs_nl = False
+            try:
+                with open(self._path, "rb") as f:
+                    f.seek(-1, os.SEEK_END)
+                    needs_nl = f.read(1) != b"\n"
+            except OSError:
+                pass
+            payload = "".join(
+                json.dumps(r, separators=(",", ":")) + "\n"
+                for r in records)
+            with open(self._path, "a", encoding="utf-8") as f:
+                f.write(("\n" if needs_nl else "") + payload)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as exc:
+            logger.warning("programs registry append to %s failed "
+                           "(%s: %s)", self._path,
+                           type(exc).__name__, exc)
+
+
+PROGRAMS = ProgramRegistry()
+
+
+def read_programs(source) -> list:
+    """Program records from a directory (its ``programs.jsonl``), one
+    path, or a list of paths — latest-wins per (name, shape_bucket,
+    precision_id), torn lines dropped."""
+    if isinstance(source, (list, tuple)):
+        paths = [str(p) for p in source]
+    elif os.path.isdir(source):
+        paths = sorted(_glob.glob(os.path.join(source,
+                                               "programs*.jsonl")))
+    else:
+        paths = [str(source)]
+    latest: dict = {}
+    for path in paths:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            if not isinstance(rec, dict) or rec.get("kind") != "program":
+                continue
+            key = program_key(rec.get("name", ""),
+                              rec.get("shape_bucket", ""),
+                              rec.get("precision_id", ""))
+            latest[key] = rec
+    return [latest[k] for k in sorted(latest)]
+
+
+def hbm_regressions(current: list, baseline: dict,
+                    slack: float = HBM_SLACK) -> list:
+    """The machine-independent HBM gate: per-program
+    ``temp_bytes + output_bytes`` against a committed baseline.
+
+    ``current`` — program records (:func:`read_programs` /
+    ``PROGRAMS.snapshot()``); ``baseline`` — ``{key: hbm_bytes}`` as
+    written by ``check_perf --update``. Returns failure strings (empty
+    = pass). New programs and programs the bench no longer compiles are
+    reported by the caller as informational, never failures — byte
+    GROWTH on a program both sides know is the regression signal."""
+    failures = []
+    for rec in current:
+        key = program_key(rec.get("name", ""),
+                          rec.get("shape_bucket", ""),
+                          rec.get("precision_id", ""))
+        hbm = (rec.get("temp_bytes") or 0) + (rec.get("output_bytes")
+                                              or 0)
+        base = baseline.get(key)
+        if base is None or base <= 0 or hbm <= 0:
+            continue
+        if hbm > base * slack:
+            failures.append(
+                f"program HBM regression: {key} temp+output "
+                f"{hbm} B > baseline {base} B x {slack:.2f}")
+    return failures

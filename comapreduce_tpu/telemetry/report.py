@@ -31,7 +31,22 @@ from comapreduce_tpu.resilience.watchdog import percentile
 
 __all__ = ["chrome_trace", "prom_snapshot", "summarize",
            "format_summary", "span_overlap", "overlap_seconds",
-           "duration_rows", "format_duration_table"]
+           "duration_rows", "format_duration_table", "rank_label"]
+
+
+def rank_label(rank) -> str:
+    """Human label for a telemetry rank. Reducer ranks are the
+    campaign's real ranks (``rank 0..N-1``); streams at
+    ``SERVING_LANE_BASE`` and above are long-lived serving processes
+    (map server, tile server — each restart takes a fresh stream), so
+    the operator views name the lane instead of showing a bare
+    four-digit rank number."""
+    from comapreduce_tpu.telemetry.core import SERVING_LANE_BASE
+
+    r = int(rank)
+    if r >= SERVING_LANE_BASE:
+        return f"serving lane {r - SERVING_LANE_BASE}"
+    return f"rank {r}"
 
 
 # -- interval algebra --------------------------------------------------------
@@ -215,7 +230,13 @@ def format_summary(summary: dict) -> str:
     lines.append(f"overlap: read/compute {ov['read_compute']:.2f}, "
                  f"write/compute {ov['write_compute']:.2f}")
     ranks = summary["ranks"]
-    per_rank = ", ".join(f"r{r}={v:.2f}s"
+
+    def _short(r):   # serving-lane streams read as lanes, not ranks
+        lbl = rank_label(r)
+        return lbl.replace("serving lane ", "serving") \
+            if lbl.startswith("serving") else f"r{int(r)}"
+
+    per_rank = ", ".join(f"{_short(r)}={v:.2f}s"
                          for r, v in sorted(ranks["busy_s"].items()))
     lines.append(f"rank busy: {per_rank} "
                  f"(imbalance {ranks['imbalance']:.2f})")
@@ -253,7 +274,7 @@ def chrome_trace(merged) -> dict:
 
     for rank in merged.ranks:
         events.append({"ph": "M", "name": "process_name", "pid": rank,
-                       "args": {"name": f"rank {rank}"}})
+                       "args": {"name": rank_label(rank)}})
     for s in merged.spans:
         args = {k: v for k, v in s["attrs"].items()}
         if s["unit"]:

@@ -1,0 +1,308 @@
+"""Per-iteration CG solver traces — the convergence plane (ISSUE 15).
+
+The destriper's CG loop *is* the production cost model: the map-making
+literature (MAPPRAISER, arXiv 2112.03370; the preconditioner surveys,
+arXiv 1309.7473) evaluates entirely in iterations-to-tolerance, yet
+until this module the loop reported two scalars (final iteration count
+and residual) per solve. ``destriper._cg_loop`` now optionally carries
+per-iteration histories of the true residual ``|r|^2``, alpha and beta
+through the while-loop state (``trace_n``); the host renders them here
+into ``solver.rank{r}.jsonl`` under ``[Global] log_dir`` with the
+quarantine ledger's torn-line-safe append discipline, annotated with
+the band, preconditioner id, precision id, and divergence/stagnation
+marks, and mirrors the solve's progress onto live telemetry gauges
+(``solver.band`` / ``solver.iteration`` / ``solver.log10_residual``)
+so the ``/metrics`` sidecar can show a slope-based iters-to-tolerance
+ETA mid-solve.
+
+Record schema (one JSON object per line)::
+
+    {"schema": 1, "kind": "iteration", "band": "band0", "iter": 12,
+     "residual": 3.2e-4, "rr": 1.1e-7, "alpha": 0.9, "beta": 0.4,
+     "precond_id": "multigrid|...", "precision_id": "tod=float32|...",
+     "threshold": 1e-6, "rank": 0, "diverging": false}
+
+    {"schema": 1, "kind": "solve", "band": "band0", "n_iter": 48,
+     "residual": 8.8e-7, "converged": true, "diverged": false,
+     "stalled": false, "stalled_at": null, "base": 0,
+     "precond_id": "...", "precision_id": "...", "threshold": 1e-6,
+     "rank": 0, "t": "2026-08-05T07:00:00Z"}
+
+``iter`` is the GLOBAL iteration index: chunked solves
+(``solve_band_checkpointed``) pass ``base=n_done`` so a resumed run's
+trace continues numbering where the previous chunk stopped. Readers
+(``tools/solver_report.py``, the live plane) drop unparseable lines
+like every JSONL reader here.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import logging
+import math
+import os
+import re
+import time
+
+import numpy as np
+
+from comapreduce_tpu.telemetry.core import TELEMETRY
+
+__all__ = ["SOLVER_SCHEMA", "STALL_SLOPE", "STALL_WINDOW",
+           "append_solver", "iteration_records", "read_solver",
+           "record_solve", "solve_summary", "solver_path",
+           "trace_enabled"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+SOLVER_SCHEMA = 1
+
+# mirrors the in-loop divergence monitor (destriper.DIVERGENCE_GROWTH):
+# an iteration whose |r|^2 sits more than this factor above the best
+# seen so far is annotated "diverging" in its record
+_DIVERGING_GROWTH = 100.0
+
+# stagnation: over the trailing STALL_WINDOW iterations of an
+# UNCONVERGED solve, a log10-residual slope shallower (less negative)
+# than -STALL_SLOPE decades/iteration marks the solve stalled — the
+# preconditioner has stopped buying progress
+STALL_SLOPE = 1e-3
+STALL_WINDOW = 25
+
+_SOLVER_RE = re.compile(r"solver\.rank(\d+)\.jsonl$")
+
+
+def solver_path(directory: str, rank: int = 0) -> str:
+    return os.path.join(directory or ".",
+                        f"solver.rank{int(rank)}.jsonl")
+
+
+def trace_enabled() -> bool:
+    """The solver trace rides the telemetry switch: traced programs
+    carry three scalar scatters per iteration (negligible next to one
+    matvec) so any telemetry-on run gets the convergence plane for
+    free. ``COMAP_SOLVER_TRACE=0`` is the kill switch."""
+    if os.environ.get("COMAP_SOLVER_TRACE", "").strip() == "0":
+        return False
+    return TELEMETRY.enabled
+
+
+def append_solver(path: str, records: list) -> None:
+    """Torn-line-safe append — the quality ledger's exact discipline
+    (heal a crashed writer's stump with a newline first, then append +
+    flush + fsync). I/O failures are logged and swallowed: solver
+    bookkeeping must never kill a solve."""
+    if not records:
+        return
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        needs_nl = False
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                needs_nl = f.read(1) != b"\n"
+        except OSError:
+            pass
+        payload = "".join(json.dumps(r, separators=(",", ":")) + "\n"
+                          for r in records)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(("\n" if needs_nl else "") + payload)
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as exc:
+        logger.warning("solver trace append to %s failed (%s: %s)",
+                       path, type(exc).__name__, exc)
+
+
+def read_solver(source) -> list:
+    """All solver records from a state directory (every
+    ``solver.rank*.jsonl``), one path, or a list of paths. Torn/garbage
+    lines are dropped; records come back in file order (iteration
+    records are append-ordered within a solve by construction)."""
+    if isinstance(source, (list, tuple)):
+        paths = [str(p) for p in source]
+    elif os.path.isdir(source):
+        paths = sorted(_glob.glob(os.path.join(source,
+                                               "solver.rank*.jsonl")))
+    else:
+        paths = [str(source)]
+    out = []
+    for path in paths:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") in (
+                    "iteration", "solve"):
+                out.append(rec)
+    return out
+
+
+def _finite(v, default=None):
+    v = float(v)
+    return v if math.isfinite(v) else default
+
+
+def iteration_records(rr_hist, alpha_hist, beta_hist, b_norm, n_ran,
+                      *, band: str, precond_id: str = "",
+                      precision_id: str = "", threshold: float = 0.0,
+                      base: int = 0, rank: int = 0) -> list:
+    """Per-iteration records from one system's histories.
+
+    ``rr_hist``/``alpha_hist``/``beta_hist`` are 1-D length >= n_ran
+    (one CG system — multi-RHS callers slice their trailing system axis
+    first); ``b_norm`` is that system's ``|b|^2``; ``n_ran`` how many
+    iterations actually executed (``result.n_iter``). ``residual`` is
+    the relative norm ``sqrt(rr / |b|^2)`` — the quantity the
+    convergence criterion tests. The ``diverging`` annotation mirrors
+    the in-loop monitor: |r|^2 more than 100x above the best seen.
+    """
+    rr = np.asarray(rr_hist, dtype=np.float64).reshape(-1)
+    al = np.asarray(alpha_hist, dtype=np.float64).reshape(-1)
+    be = np.asarray(beta_hist, dtype=np.float64).reshape(-1)
+    bn = max(float(np.asarray(b_norm)), 1e-30)
+    n = int(min(int(n_ran), rr.size))
+    records = []
+    best = math.inf
+    for k in range(n):
+        rr_k = _finite(rr[k])
+        res = math.sqrt(rr_k / bn) if rr_k is not None else None
+        diverging = bool(rr_k is not None and best < math.inf
+                         and rr_k > _DIVERGING_GROWTH * best)
+        if rr_k is not None:
+            best = min(best, rr_k)
+        records.append({
+            "schema": SOLVER_SCHEMA, "kind": "iteration",
+            "band": band, "iter": int(base) + k,
+            "residual": res, "rr": rr_k,
+            "alpha": _finite(al[k]), "beta": _finite(be[k]),
+            "precond_id": precond_id, "precision_id": precision_id,
+            "threshold": float(threshold), "rank": int(rank),
+            "diverging": diverging,
+        })
+    return records
+
+
+def _stall(records: list, threshold: float) -> tuple:
+    """``(stalled, stalled_at)`` over one solve's iteration records: the
+    trailing-window log10-residual slope of an unconverged solve. A
+    converged solve is never 'stalled' — sitting at the floor is
+    success, not stagnation."""
+    resid = [(r["iter"], r["residual"]) for r in records
+             if r.get("residual")]
+    if len(resid) < 2:
+        return False, None
+    last = resid[-1][1]
+    if threshold > 0 and last <= threshold:
+        return False, None
+    window = resid[-min(len(resid), STALL_WINDOW):]
+    di = window[-1][0] - window[0][0]
+    if di <= 0:
+        return False, None
+    slope = (math.log10(max(window[-1][1], 1e-300))
+             - math.log10(max(window[0][1], 1e-300))) / di
+    if slope > -STALL_SLOPE:
+        return True, int(window[0][0])
+    return False, None
+
+
+def solve_summary(records: list, *, band: str, n_iter: int,
+                  residual: float, diverged: bool,
+                  precond_id: str = "", precision_id: str = "",
+                  threshold: float = 0.0, base: int = 0,
+                  rank: int = 0) -> dict:
+    """The per-solve summary record, with divergence/stagnation
+    annotations derived from the iteration records."""
+    stalled, stalled_at = _stall(records, threshold)
+    return {
+        "schema": SOLVER_SCHEMA, "kind": "solve", "band": band,
+        "n_iter": int(n_iter), "residual": _finite(residual),
+        "converged": bool(threshold > 0 and float(residual) <= threshold
+                          and not diverged),
+        "diverged": bool(diverged), "stalled": stalled,
+        "stalled_at": stalled_at, "base": int(base),
+        "precond_id": precond_id, "precision_id": precision_id,
+        "threshold": float(threshold), "rank": int(rank),
+        "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def _band_index(band: str) -> float:
+    m = re.search(r"(\d+)", str(band))
+    return float(m.group(1)) if m else -1.0
+
+
+def record_solve(result, *, band: str, precond_id: str = "",
+                 precision_id: str = "", threshold: float = 0.0,
+                 base: int = 0, log_dir: str | None = None,
+                 rank: int | None = None, bands: list | None = None,
+                 path: str | None = None) -> list:
+    """Render one traced ``DestriperResult`` into solver records,
+    append them to ``solver.rank{r}.jsonl``, and mirror progress onto
+    live gauges. Returns the records (callers cross-check the
+    iteration count against ``result.n_iter``).
+
+    Multi-RHS solves (histories with a trailing system axis) get one
+    record stream per system, labelled ``bands[i]`` when given else
+    ``{band}[{i}]``. A ``result`` without a trace (untraced/sharded
+    path) is a silent no-op.
+    """
+    trace = getattr(result, "trace", None)
+    if trace is None:
+        return []
+    rr_h, al_h, be_h, b_norm = (np.asarray(t) for t in trace)
+    n_ran = int(np.asarray(result.n_iter))
+    div = np.asarray(result.diverged).reshape(-1)
+    if rank is None:
+        rank = getattr(TELEMETRY, "_rank", 0)
+    if path is None:
+        directory = log_dir if log_dir is not None else TELEMETRY.log_dir
+        path = solver_path(directory, rank)
+
+    # normalise to (trace_n, n_systems)
+    if rr_h.ndim == 1:
+        rr_h, al_h, be_h = (a[:, None] for a in (rr_h, al_h, be_h))
+        b_norm = np.asarray(b_norm).reshape(1)
+    n_sys = rr_h.shape[-1]
+    res_final = np.asarray(result.residual).reshape(-1)
+    records = []
+    for i in range(n_sys):
+        label = (bands[i] if bands is not None and i < len(bands)
+                 else (band if n_sys == 1 else f"{band}[{i}]"))
+        iters = iteration_records(
+            rr_h[:, i], al_h[:, i], be_h[:, i], b_norm[i], n_ran,
+            band=label, precond_id=precond_id,
+            precision_id=precision_id, threshold=threshold,
+            base=base, rank=rank)
+        summary = solve_summary(
+            iters, band=label, n_iter=n_ran,
+            residual=float(res_final[i % res_final.size]),
+            diverged=bool(div[i % div.size]), precond_id=precond_id,
+            precision_id=precision_id, threshold=threshold,
+            base=base, rank=rank)
+        records.extend(iters)
+        records.append(summary)
+        # live progress gauges: iteration FIRST so a reader seeing the
+        # residual gauge can pair it with a current iteration; the
+        # residual gauge carries the iteration as an attribute so the
+        # live plane can fit a slope without event ordering games
+        if iters and TELEMETRY.enabled:
+            last = iters[-1]
+            if last["residual"]:
+                log_res = math.log10(max(last["residual"], 1e-300))
+                TELEMETRY.gauge("solver.band", _band_index(label))
+                TELEMETRY.gauge("solver.iteration", float(last["iter"]))
+                TELEMETRY.gauge("solver.log10_residual", log_res,
+                                iteration=last["iter"], band=label,
+                                threshold=threshold)
+    append_solver(path, records)
+    return records

@@ -56,6 +56,7 @@ MUTABLE_CACHE = "no-cache"
 
 _JSON = "application/json"
 _BLOB = "application/x-comap-tile"
+_PROM = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class _HTTPError(Exception):
@@ -125,6 +126,11 @@ class TileServer:
         self.stats = {"t_start_unix": time.time(), "n_requests": 0,
                       "n_304": 0, "n_errors": 0, "bytes_sent": 0,
                       "by_route": {}}
+        # per-request latency histogram + route/status counters in the
+        # live sidecar's exact /metrics schema (ISSUE 15)
+        from comapreduce_tpu.telemetry.core import RequestMetrics
+
+        self.request_metrics = RequestMetrics("tiles_http")
         self.httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self.httpd.daemon_threads = True
         self.httpd.app = self
@@ -185,6 +191,7 @@ class TileServer:
                  dur_s: float) -> None:
         from comapreduce_tpu.telemetry import TELEMETRY
 
+        self.request_metrics.observe(route, status, dur_s)
         with self._lock:
             st = self.stats
             st["n_requests"] += 1
@@ -207,12 +214,40 @@ class TileServer:
             TELEMETRY.event_span("serving.tiles.http.request", dur_s,
                                  unit=route, status=int(status))
 
+    def prom_text(self) -> str:
+        """The /metrics page: request-latency histogram + per-route
+        counters (``RequestMetrics``), then the serving gauges the
+        register_gauge path exports when a campaign's telemetry is up —
+        here they are scrapeable even for a standalone tile server."""
+        out = list(self.request_metrics.prom_lines())
+
+        def gauge(name, value):
+            out.append(f"# TYPE {name} gauge")
+            out.append(f"{name} {value:g}")
+
+        cur = self.tiles.current()
+        if cur is not None:
+            gauge("comap_tiles_current_epoch", int(cur))
+        fresh = self._freshness_s()
+        if fresh is not None:
+            gauge("comap_tiles_freshness_seconds", fresh)
+        with self._lock:
+            sent = self.stats["bytes_sent"]
+        out.append("# TYPE comap_tiles_http_bytes_sent_total counter")
+        out.append(f"comap_tiles_http_bytes_sent_total {sent}")
+        return "\n".join(out) + "\n"
+
     # -- routing -----------------------------------------------------------
 
     def handle(self, path: str, query: str) -> tuple[str, _Reply]:
         """Resolve one request to ``(route_class, reply)``; raises
         ``_HTTPError`` for client errors."""
         parts = [p for p in path.split("/") if p]
+        if parts == ["metrics"]:
+            # the tile tier self-surfaces its request telemetry in the
+            # live sidecar's exact Prometheus schema (ISSUE 15)
+            return "metrics", _Reply(
+                self.prom_text().encode("utf-8"), _PROM)
         if parts == ["v1", "current"]:
             return "current", self._reply_current()
         if parts == ["v1", "status"]:

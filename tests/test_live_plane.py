@@ -104,6 +104,52 @@ class TestLiveTail:
         tail.poll()
         assert tail.counters[("c", 0)] == 6.0
 
+    def test_equal_size_rewrite_detected(self, tmp_path):
+        """PR 14's documented blind spot: a stream REPLACED at exactly
+        its old byte size passed both size checks and the new writer's
+        events were silently skipped. The mtime + first-bytes
+        fingerprint now catches it: restart from 0, re-accumulate."""
+        p = tmp_path / "events.rank0.jsonl"
+        _write_events(p, [
+            dict(_meta(0), pid=1),
+            {"kind": "counter", "name": "c", "value": 5, "mono": 1.0},
+        ])
+        size = os.path.getsize(p)
+        tail = LiveTail(str(tmp_path))
+        tail.poll()
+        assert tail.counters[("c", 0)] == 5.0
+        # a NEW writer replaces the stream with the same byte count —
+        # its meta anchor (pid, inside the first-bytes fingerprint)
+        # differs, the counter value differs
+        _write_events(p, [
+            dict(_meta(0), pid=2),
+            {"kind": "counter", "name": "c", "value": 7, "mono": 1.0},
+        ])
+        assert os.path.getsize(p) == size  # the blind-spot shape
+        os.utime(p, ns=(time.time_ns(), time.time_ns() + 10_000_000))
+        tail.poll()
+        assert tail.counters[("c", 0)] == 12.0
+
+    def test_metadata_only_touch_keeps_offset(self, tmp_path):
+        """An mtime bump WITHOUT a content change (backup tooling,
+        os.utime) must not re-absorb: the fingerprint still matches."""
+        p = tmp_path / "events.rank0.jsonl"
+        _write_events(p, [
+            _meta(0),
+            {"kind": "counter", "name": "c", "value": 5, "mono": 1.0},
+        ])
+        tail = LiveTail(str(tmp_path))
+        tail.poll()
+        os.utime(p, ns=(time.time_ns(), time.time_ns() + 10_000_000))
+        assert tail.poll() == 0
+        assert tail.counters[("c", 0)] == 5.0
+        # and a plain append after the touch is read incrementally
+        with open(p, "a", encoding="utf-8") as f:
+            f.write(json.dumps({"kind": "counter", "name": "c",
+                                "value": 2, "mono": 2.0}) + "\n")
+        assert tail.poll() == 1
+        assert tail.counters[("c", 0)] == 7.0
+
     def test_counter_total_sums_ranks(self, tmp_path):
         _write_events(tmp_path / "events.rank0.jsonl", [
             _meta(0),
@@ -212,6 +258,51 @@ class TestLiveServer:
         # the flags surface on /metrics too
         _, prom = _get(srv, "/metrics")
         assert 'comap_quality_flags{rule="fknee_high"} 1' in prom
+
+    def test_request_latency_histogram_on_metrics(self, live):
+        """ISSUE 15: the sidecar measures itself — per-request latency
+        histogram + route/status counters on its own /metrics page."""
+        srv, tmp = live
+        _heartbeat(tmp, 0)
+        _get(srv, "/healthz")
+        _get(srv, "/nope")
+        _get(srv, "/metrics")
+        _, body = _get(srv, "/metrics")
+        assert "# TYPE comap_live_http_request_duration_seconds " \
+               "histogram" in body
+        assert 'comap_live_http_request_duration_seconds_bucket' \
+               '{le="+Inf"}' in body
+        assert 'comap_live_http_requests_total{route="healthz",' \
+               'status="200"} 1' in body
+        assert 'comap_live_http_requests_total{route="error",' \
+               'status="404"} 1' in body
+        assert 'comap_live_http_requests_total{route="metrics",' \
+               'status="200"}' in body
+
+    def test_solver_eta_gauge(self, live):
+        """The slope-based iters-to-tolerance ETA: iteration-stamped
+        log10-residual gauges extrapolate to the solve's threshold."""
+        srv, tmp = live
+        _heartbeat(tmp, 0)
+        _write_events(tmp / "events.rank0.jsonl", [
+            _meta(0),
+            {"kind": "gauge", "name": "solver.iteration", "value": 10,
+             "mono": 1.0},
+            {"kind": "gauge", "name": "solver.log10_residual",
+             "value": -1.0, "mono": 1.0,
+             "attrs": {"iteration": 0, "band": "band0",
+                       "threshold": 1e-6}},
+            {"kind": "gauge", "name": "solver.log10_residual",
+             "value": -3.0, "mono": 2.0,
+             "attrs": {"iteration": 10, "band": "band0",
+                       "threshold": 1e-6}},
+        ])
+        _, body = _get(srv, "/metrics")
+        # slope -0.2 dec/iter from -3 to the 1e-6 target: 15 iters out
+        assert 'comap_solver_eta_iters{rank="0"} 15' in body
+        # the raw progress gauges ride the generic gauge path
+        assert 'comap_solver_iteration{rank="0"} 10' in body
+        assert 'comap_solver_log10_residual{rank="0"} -3' in body
 
     def test_unknown_route_404(self, live):
         srv, _ = live

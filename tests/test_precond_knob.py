@@ -241,7 +241,7 @@ def test_divergence_monitor_unchanged_under_precond():
     a_mat = jnp.asarray(np.eye(n) + 3.0 * (skew - skew.T), jnp.float32)
     b = jnp.asarray(np.ones(n), jnp.float32)
     dot = lambda u, v: jnp.sum(u * v)                 # noqa: E731
-    x, rr, k, b_norm, div = _cg_loop(lambda p: a_mat @ p, b, dot,
+    x, rr, k, b_norm, div, _ = _cg_loop(lambda p: a_mat @ p, b, dot,
                                      100, 1e-8,
                                      precond=lambda v: v * 0.5)
     assert int(div) == 1

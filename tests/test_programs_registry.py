@@ -1,0 +1,164 @@
+"""Compiled-program cost/memory registry (ISSUE 15 tentpole):
+``telemetry/programs.py`` capture/dedupe/read plus the
+machine-independent HBM-regression gate ``tools/check_perf.py``
+consumes."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.telemetry import programs as prog
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+
+@pytest.fixture
+def registry(tmp_path):
+    """The PROGRAMS singleton configured into a tmp dir, always closed
+    (it is process-global — a leaked enable would bleed into other
+    tests)."""
+    prog.PROGRAMS.configure(str(tmp_path))
+    yield prog.PROGRAMS, tmp_path
+    prog.PROGRAMS.close()
+
+
+class TestShapeBucket:
+    def test_arrays_and_dtypes(self):
+        b = prog.shape_bucket(np.zeros((4, 8), np.float32),
+                              np.zeros(4, np.int32))
+        assert b == "f32[4,8]xi32[4]"
+
+    def test_non_array_leaves_skipped(self):
+        assert prog.shape_bucket(np.zeros(2, np.float32), 3,
+                                 mode="fast") == "f32[2]"
+
+    def test_long_signatures_truncate(self):
+        b = prog.shape_bucket(*[np.zeros(1, np.float32)] * 15)
+        assert b.endswith("+3") and b.count("f32[1]") == 12
+
+
+class TestRecordAndAnalyze:
+    def test_analyze_compiled_program(self):
+        compiled = jax.jit(lambda x: (x * 2.0).sum()).lower(
+            jnp.zeros(256, jnp.float32)).compile()
+        cost = prog.analyze(compiled)
+        # CPU exposes at least the cost analysis; whatever the backend
+        # won't say is absent, never an error
+        assert cost.get("flops", 0.0) >= 0.0
+        assert isinstance(cost, dict)
+
+    def test_record_dedupes_and_appends(self, registry):
+        reg, tmp = registry
+        compiled = jax.jit(lambda x: x + 1.0).lower(
+            jnp.zeros(64, jnp.float32)).compile()
+        rec = reg.record("t.plus1", compiled, shape_bucket="f32[64]",
+                         precision_id="f32")
+        assert rec is not None and rec["name"] == "t.plus1"
+        # warmup re-runs recompile the same program: they must not
+        # re-count
+        assert reg.record("t.plus1", compiled, shape_bucket="f32[64]",
+                          precision_id="f32") is None
+        # a different shape bucket is a different program
+        assert reg.record("t.plus1", compiled, shape_bucket="f32[128]",
+                          precision_id="f32") is not None
+        assert len(reg.snapshot()) == 2
+        on_disk = prog.read_programs(str(tmp))
+        assert len(on_disk) == 2
+
+    def test_record_jit_probe_before_compile(self, registry):
+        reg, tmp = registry
+        x = jnp.zeros(32, jnp.float32)
+        fn = jax.jit(lambda v: v * 3.0)
+        assert reg.record_jit("t.triple", fn, x) is not None
+        assert reg.seen("t.triple", prog.shape_bucket(x))
+        assert reg.record_jit("t.triple", fn, x) is None
+
+    def test_disabled_registry_is_inert(self, tmp_path):
+        assert not prog.PROGRAMS.enabled
+        compiled = jax.jit(lambda x: x).lower(
+            jnp.zeros(8, jnp.float32)).compile()
+        assert prog.PROGRAMS.record("t.noop", compiled) is None
+        assert not os.path.exists(prog.programs_path(str(tmp_path)))
+
+
+class TestRideTelemetry:
+    def test_configure_and_close_follow_telemetry(self, tmp_path):
+        from comapreduce_tpu.telemetry.core import TELEMETRY
+
+        TELEMETRY.configure(str(tmp_path), rank=0, flush_s=60.0)
+        try:
+            assert prog.PROGRAMS.enabled
+            assert prog.PROGRAMS.path == prog.programs_path(
+                str(tmp_path))
+        finally:
+            TELEMETRY.close()
+        assert not prog.PROGRAMS.enabled
+
+
+class TestReadPrograms:
+    def test_latest_wins_and_torn_line_dropped(self, tmp_path):
+        path = prog.programs_path(str(tmp_path))
+        recs = [{"schema": 1, "kind": "program", "name": "a",
+                 "shape_bucket": "f32[8]", "precision_id": "f32",
+                 "temp_bytes": 100},
+                {"schema": 1, "kind": "program", "name": "a",
+                 "shape_bucket": "f32[8]", "precision_id": "f32",
+                 "temp_bytes": 200}]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            f.write('{"kind": "program", "na')
+        out = prog.read_programs(str(tmp_path))
+        assert len(out) == 1 and out[0]["temp_bytes"] == 200
+
+
+class TestHBMGate:
+    def _rec(self, name="destriper.mg", temp=1000, out=500):
+        return {"kind": "program", "name": name,
+                "shape_bucket": "f32[8]", "precision_id": "f32",
+                "temp_bytes": temp, "output_bytes": out}
+
+    def _key(self, name="destriper.mg"):
+        return prog.program_key(name, "f32[8]", "f32")
+
+    def test_within_slack_passes(self):
+        base = {self._key(): 1500}
+        assert prog.hbm_regressions([self._rec()], base) == []
+        # up to slack x baseline still passes
+        assert prog.hbm_regressions([self._rec(temp=1300, out=500)],
+                                    base) == []
+
+    def test_injected_temp_regression_fails(self):
+        """The acceptance drill: the committed baseline passes, a
+        temp-HBM blow-up on the same program key fails."""
+        base = {self._key(): 1500}
+        fails = prog.hbm_regressions([self._rec(temp=3000, out=500)],
+                                     base)
+        assert len(fails) == 1
+        assert "HBM regression" in fails[0]
+        assert self._key() in fails[0]
+
+    def test_new_and_vanished_programs_never_fail(self):
+        base = {self._key("gone.program"): 1500}
+        assert prog.hbm_regressions(
+            [self._rec(name="brand.new")], base) == []
+
+    def test_zero_byte_records_skipped(self):
+        # a backend without memory_analysis yields hbm == 0: no gate
+        base = {self._key(): 1500}
+        assert prog.hbm_regressions(
+            [self._rec(temp=0, out=0)], base) == []
+
+
+def test_roofline_report_selftest_green():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.roofline_report import main
+
+    assert main(["--selftest"]) == 0

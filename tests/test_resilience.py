@@ -431,14 +431,14 @@ def test_cg_divergence_monitor_trips_and_returns_best():
     a_mat = jnp.asarray(np.eye(n) + 3.0 * (skew - skew.T), jnp.float32)
     b = jnp.asarray(np.ones(n), jnp.float32)
     dot = lambda u, v: jnp.sum(u * v)                 # noqa: E731
-    x, rr, k, b_norm, div = _cg_loop(lambda p: a_mat @ p, b, dot,
+    x, rr, k, b_norm, div, _ = _cg_loop(lambda p: a_mat @ p, b, dot,
                                      100, 1e-8)
     assert int(div) == 1
     assert int(k) < 100                               # froze early
     assert float(rr) <= float(b_norm) * (1 + 1e-6)    # never worse than x0
     # a healthy SPD system: no flag, converges to the exact solution
     diag = jnp.asarray(np.linspace(1.0, 3.0, n), jnp.float32)
-    x2, rr2, k2, bn2, div2 = _cg_loop(lambda p: diag * p, b, dot,
+    x2, rr2, k2, bn2, div2, _ = _cg_loop(lambda p: diag * p, b, dot,
                                       100, 1e-6,
                                       precond=lambda v: v / diag)
     assert int(div2) == 0

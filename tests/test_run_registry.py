@@ -115,6 +115,63 @@ class TestTrend:
         assert res["ok"] is True and res["n_baseline"] == 3
 
 
+class TestTrendDirectionEdges:
+    """Suffix-direction inference edge cases (ISSUE 15 satellite)."""
+
+    def _run(self, path, metrics, ok=True):
+        return reg.record_run("campaign", metrics, ok=ok, path=path,
+                              git_sha="deadbeef")
+
+    def test_bytes_suffix_is_informational(self, tmp_path):
+        # *_bytes has no inferred direction: a 10x blow-up never gates
+        # here (the programs HBM gate owns byte budgets, with its own
+        # committed baseline)
+        p = str(tmp_path / "runs.jsonl")
+        for _ in range(3):
+            self._run(p, {"hbm_temp_bytes": 1e6, "files_per_s": 10.0})
+        self._run(p, {"hbm_temp_bytes": 1e7, "files_per_s": 10.0})
+        res = reg.trend(reg.read_runs(p))
+        assert res["ok"] is True
+        assert "hbm_temp_bytes" not in res["checked"]
+        assert res["checked"] == ["files_per_s"]
+
+    def test_mixed_directions_in_one_record(self, tmp_path):
+        # one record carrying both polarities: each metric judged by
+        # its own direction, one regression reported, not two
+        p = str(tmp_path / "runs.jsonl")
+        for _ in range(3):
+            self._run(p, {"wall_s": 10.0, "files_per_s": 10.0})
+        self._run(p, {"wall_s": 20.0, "files_per_s": 20.0})
+        res = reg.trend(reg.read_runs(p))
+        assert res["ok"] is False
+        assert [r["metric"] for r in res["regressions"]] == ["wall_s"]
+        assert res["regressions"][0]["direction"] == "lower_better"
+        assert set(res["checked"]) == {"wall_s", "files_per_s"}
+
+    def test_window_shorter_than_requested(self, tmp_path):
+        # 3 runs, window=10: the baseline is just the 2 available
+        # predecessors — short history must not error or false-alarm
+        p = str(tmp_path / "runs.jsonl")
+        for v in (10.0, 10.5, 10.2):
+            self._run(p, {"files_per_s": v})
+        res = reg.trend(reg.read_runs(p), window=10)
+        assert res["ok"] is True and res["n_baseline"] == 2
+
+    def test_failed_gate_regresses_even_when_metrics_improve(
+            self, tmp_path):
+        # ok:false is unconditional — a faster run that FAILED its
+        # gate is still a regression (the gate verdict outranks the
+        # numbers it happened to post)
+        p = str(tmp_path / "runs.jsonl")
+        for _ in range(3):
+            self._run(p, {"files_per_s": 10.0})
+        self._run(p, {"files_per_s": 50.0}, ok=False)
+        res = reg.trend(reg.read_runs(p))
+        assert res["ok"] is False
+        assert res["regressions"][0]["metric"] == "ok"
+        assert res["regressions"][0]["direction"] == "gate"
+
+
 class TestCampaignWatchTrend:
     def test_exit_codes(self, tmp_path, capsys):
         from tools.campaign_watch import main

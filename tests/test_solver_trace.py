@@ -1,0 +1,195 @@
+"""Per-iteration CG solver traces (ISSUE 15 tentpole): the traced
+``_cg_loop`` histories, their rendering into ``solver.rank*.jsonl``,
+and the exact-count contract (iteration records == reported
+``n_iter``) the bench cross-checks."""
+
+import os
+import sys
+import types
+
+import numpy as np
+
+from comapreduce_tpu.telemetry import solver_trace as st
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _dense_problem(N=4000, L=50, npix=144, seed=0):
+    rng = np.random.default_rng(seed)
+    pix = ((np.arange(N) * 7) % npix).astype(np.int32)
+    tod = (rng.standard_normal(N)
+           + np.repeat(rng.standard_normal(N // L), L)).astype(np.float32)
+    return tod, pix, np.ones(N, np.float32), L, npix
+
+
+class TestTracedSolve:
+    def test_record_count_matches_reported_iters(self, tmp_path):
+        """The acceptance contract: one iteration record per CG
+        iteration the solver REPORTS, exactly — both counts from the
+        same traced dispatch."""
+        from comapreduce_tpu.mapmaking.destriper import destripe_planned
+        from comapreduce_tpu.mapmaking.pointing_plan import (
+            build_pointing_plan)
+
+        tod, pix, w, L, npix = _dense_problem()
+        plan = build_pointing_plan(pix, npix, L)
+        n_budget = 60
+        res = destripe_planned(tod, w, plan, n_iter=n_budget,
+                               threshold=1e-6, trace_iters=n_budget)
+        assert res.trace is not None
+        n_ran = int(np.asarray(res.n_iter))
+        assert 0 < n_ran <= n_budget
+        path = str(tmp_path / "solver.rank0.jsonl")
+        recs = st.record_solve(res, band="band0", path=path,
+                               precond_id="jacobi|L50",
+                               precision_id="tod=f32|cgdot=f32",
+                               threshold=1e-6)
+        iters = [r for r in recs if r["kind"] == "iteration"]
+        assert len(iters) == n_ran
+        # and the on-disk stream round-trips to the same count
+        on_disk = [r for r in st.read_solver(path)
+                   if r["kind"] == "iteration"]
+        assert len(on_disk) == n_ran
+        # residuals end at (or below) the converged threshold and the
+        # iteration axis is 0..n-1 without gaps
+        assert [r["iter"] for r in iters] == list(range(n_ran))
+        summaries = [r for r in recs if r["kind"] == "solve"]
+        assert len(summaries) == 1
+        assert summaries[0]["n_iter"] == n_ran
+        assert summaries[0]["converged"] is True
+        assert iters[-1]["residual"] <= 1e-6
+
+    def test_untraced_solve_has_no_trace(self):
+        from comapreduce_tpu.mapmaking.destriper import destripe_planned
+        from comapreduce_tpu.mapmaking.pointing_plan import (
+            build_pointing_plan)
+
+        tod, pix, w, L, npix = _dense_problem(N=2000)
+        plan = build_pointing_plan(pix, npix, L)
+        res = destripe_planned(tod, w, plan, n_iter=10, threshold=1e-6)
+        assert res.trace is None
+        assert st.record_solve(res, band="b") == []
+
+
+class TestIterationRecords:
+    def test_residual_is_relative_norm(self):
+        rr = np.array([4.0, 1.0, 0.25], np.float32)
+        recs = st.iteration_records(rr, np.ones(3), np.ones(3),
+                                    b_norm=4.0, n_ran=3, band="b0",
+                                    threshold=1e-6)
+        assert [r["residual"] for r in recs] == [1.0, 0.5, 0.25]
+        assert all(not r["diverging"] for r in recs)
+
+    def test_diverging_annotation_mirrors_loop_monitor(self):
+        # |r|^2 jumping 100x above the best-so-far marks the iteration
+        rr = np.array([1.0, 1e-4, 1.0, 1e-4], np.float32)
+        recs = st.iteration_records(rr, np.ones(4), np.ones(4),
+                                    b_norm=1.0, n_ran=4, band="b0")
+        assert [r["diverging"] for r in recs] == [False, False, True,
+                                                  False]
+
+    def test_n_ran_bounds_records(self):
+        rr = np.full(50, 0.5, np.float32)
+        recs = st.iteration_records(rr, np.ones(50), np.ones(50),
+                                    b_norm=1.0, n_ran=7, band="b0",
+                                    base=100)
+        assert len(recs) == 7
+        # chunked solves continue ONE global iteration axis via base
+        assert [r["iter"] for r in recs] == list(range(100, 107))
+
+
+class TestStall:
+    def _recs(self, residuals, threshold=1e-6):
+        return [{"kind": "iteration", "iter": i, "residual": r,
+                 "threshold": threshold}
+                for i, r in enumerate(residuals)]
+
+    def test_flat_unconverged_tail_stalls(self):
+        resid = [10.0 ** (-1 - 0.5 * k) for k in range(6)] \
+            + [1e-4] * st.STALL_WINDOW
+        stalled, at = st._stall(self._recs(resid), threshold=1e-6)
+        assert stalled and isinstance(at, int)
+
+    def test_converged_floor_is_not_a_stall(self):
+        # sitting at the floor BELOW threshold is success
+        resid = [10.0 ** (-1 - k) for k in range(8)] + [1e-9] * 30
+        stalled, at = st._stall(self._recs(resid), threshold=1e-6)
+        assert not stalled and at is None
+
+    def test_steady_convergence_not_stalled(self):
+        resid = [10.0 ** (-0.1 * k) for k in range(40)]
+        stalled, _ = st._stall(self._recs(resid), threshold=1e-12)
+        assert not stalled
+
+
+class TestAppendRead:
+    def test_torn_tail_healed_and_dropped(self, tmp_path):
+        path = st.solver_path(str(tmp_path), 0)
+        rec = {"schema": 1, "kind": "iteration", "band": "b", "iter": 0,
+               "residual": 0.5}
+        st.append_solver(path, [rec])
+        with open(path, "a") as f:
+            f.write('{"kind": "iteration", "ban')  # crashed writer
+        st.append_solver(path, [dict(rec, iter=1)])
+        recs = st.read_solver(str(tmp_path))
+        assert [r["iter"] for r in recs] == [0, 1]
+        # the healed stream is pure JSONL again: every line parses or
+        # is the quarantined stump
+        with open(path, "rb") as f:
+            lines = [ln for ln in f.read().split(b"\n") if ln.strip()]
+        assert len(lines) == 3
+
+    def test_read_accepts_dir_file_and_list(self, tmp_path):
+        p0 = st.solver_path(str(tmp_path), 0)
+        p1 = st.solver_path(str(tmp_path), 1)
+        st.append_solver(p0, [{"kind": "solve", "band": "a"}])
+        st.append_solver(p1, [{"kind": "solve", "band": "b"}])
+        assert len(st.read_solver(str(tmp_path))) == 2
+        assert len(st.read_solver(p0)) == 1
+        assert len(st.read_solver([p0, p1])) == 2
+
+
+class TestRecordSolveMultiRHS:
+    def test_one_stream_per_system(self, tmp_path):
+        T, n_sys = 5, 2
+        rr = np.tile(np.array([1.0, 0.5, 0.25, 0.1, 0.05],
+                              np.float32)[:, None], (1, n_sys))
+        res = types.SimpleNamespace(
+            trace=(rr, np.ones((T, n_sys), np.float32),
+                   np.ones((T, n_sys), np.float32),
+                   np.ones(n_sys, np.float32)),
+            n_iter=np.asarray(4), diverged=np.zeros(n_sys, bool),
+            residual=np.array([0.05, 0.05], np.float32))
+        path = str(tmp_path / "solver.rank0.jsonl")
+        recs = st.record_solve(res, band="calib", path=path,
+                               bands=["calibA", "calibB"],
+                               threshold=1e-6)
+        bands = {r["band"] for r in recs}
+        assert bands == {"calibA", "calibB"}
+        per_band = [r for r in recs if r["band"] == "calibA"
+                    and r["kind"] == "iteration"]
+        assert len(per_band) == 4  # n_iter bounds each stream
+
+
+class TestEnableSwitch:
+    def test_kill_switch_overrides_telemetry(self, tmp_path,
+                                             monkeypatch):
+        from comapreduce_tpu.telemetry.core import TELEMETRY
+
+        TELEMETRY.configure(str(tmp_path), rank=0, flush_s=60.0)
+        try:
+            assert st.trace_enabled() is True
+            monkeypatch.setenv("COMAP_SOLVER_TRACE", "0")
+            assert st.trace_enabled() is False
+        finally:
+            TELEMETRY.close()
+        monkeypatch.delenv("COMAP_SOLVER_TRACE")
+        assert st.trace_enabled() is False  # telemetry off -> off
+
+
+def test_solver_report_selftest_green():
+    """The CI smoke (satellite: ci.yml runs it) stays green."""
+    from tools.solver_report import main
+
+    assert main(["--selftest"]) == 0

@@ -575,6 +575,29 @@ def test_http_status_meta_and_head(tile_http):
     assert obj["http"]["n_requests"] == 3
 
 
+def test_http_metrics_request_histogram(tile_http):
+    """ISSUE 15: the tile tier self-surfaces per-request latency
+    histograms + route/status counters at /metrics, in the live
+    sidecar's exact Prometheus schema."""
+    server, _, _, _ = tile_http
+    _fetch(server, "/v1/current")
+    _fetch(server, "/v1/nope")
+    st, hdrs, body = _fetch(server, "/metrics")
+    text = body.decode("utf-8")
+    assert st == 200 and hdrs["Content-Type"].startswith("text/plain")
+    assert ("# TYPE comap_tiles_http_request_duration_seconds "
+            "histogram") in text
+    assert ('comap_tiles_http_request_duration_seconds_bucket'
+            '{le="+Inf"} 2') in text
+    assert ('comap_tiles_http_requests_total{route="current",'
+            'status="200"} 1') in text
+    assert 'status="404"} 1' in text
+    # the scrape itself is accounted: the NEXT scrape sees it
+    _, _, body2 = _fetch(server, "/metrics")
+    assert ('comap_tiles_http_requests_total{route="metrics",'
+            'status="200"} 1') in body2.decode("utf-8")
+
+
 # -- serving satellites: retraction, downdated epochs, hooks, lanes --------
 
 

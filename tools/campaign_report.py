@@ -11,8 +11,9 @@ writes:
 
 - ``--trace`` (default ``LOG_DIR/trace.json``): Chrome trace-event
   JSON. Open in https://ui.perfetto.dev or ``chrome://tracing`` —
-  ranks as processes, writer threads as tracks, counters as counter
-  tracks, crash-truncated spans flagged.
+  ranks as processes (serving-lane streams, rank >= 1000, are named
+  ``serving lane N`` rather than raw rank numbers), writer threads as
+  tracks, counters as counter tracks, crash-truncated spans flagged.
 - ``--prom``  (default ``LOG_DIR/metrics.prom``): a Prometheus
   textfile-exporter snapshot (point node_exporter's textfile
   collector at it).

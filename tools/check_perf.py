@@ -97,6 +97,20 @@ the default SLO table — the poisoned file must be the ONLY flagged one
 file must stay unflagged. Set/count comparisons of one deterministic
 fixture against itself — machine-independent; ``--no-quality`` skips.
 
+The program HBM gate (ISSUE 15) rides the destriper bench: every
+compiled program the bench registers (``telemetry/programs.py``)
+carries XLA's exact ``temp_bytes + output_bytes``, compared per
+program x shape bucket x precision against the committed baseline
+``evidence/programs_<platform>.json`` with 1.25x slack. Byte GROWTH on
+a program both sides know fails; new/vanished programs are reported
+informationally, never failures (a renamed rung must not page anyone).
+Machine-independent — XLA buffer assignment is deterministic for a
+fixed backend. ``--update`` (re)writes the baseline from the current
+run; ``--no-programs`` skips the gate. The destriper section also
+cross-checks the solver trace: the per-iteration records written to
+``solver.rank0.jsonl`` must match the solve's reported iteration count
+EXACTLY (both come from the same dispatch).
+
 Unless ``--no-registry``, the gate appends one ``perf_gate`` summary
 record to ``evidence/runs.jsonl`` (``telemetry/registry.py``) so
 ``tools/campaign_watch.py trend`` can alert on a regression against
@@ -446,6 +460,42 @@ def reference_path(platform: str) -> str:
     return os.path.join(REPO, "evidence", f"perf_quick_{platform}.json")
 
 
+def programs_reference_path(platform: str) -> str:
+    # anchored to reference_path so a test/env redirect of the quick
+    # reference moves BOTH baselines together — --update must never
+    # write the repo's committed HBM baseline from a redirected run
+    return os.path.join(os.path.dirname(reference_path(platform)),
+                        f"programs_{platform}.json")
+
+
+def programs_baseline(records: list) -> dict:
+    """``{program key: temp+output HBM bytes}`` from bench program
+    records — the committed shape of the HBM gate baseline."""
+    from comapreduce_tpu.telemetry.programs import program_key
+
+    out = {}
+    for rec in records:
+        hbm = ((rec.get("temp_bytes") or 0)
+               + (rec.get("output_bytes") or 0))
+        if hbm > 0:
+            out[program_key(rec.get("name", ""),
+                            rec.get("shape_bucket", ""),
+                            rec.get("precision_id", ""))] = int(hbm)
+    return out
+
+
+def write_programs_reference(platform: str, records: list,
+                             git_rev: str = "") -> str:
+    path = programs_reference_path(platform)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"schema": 1, "platform": platform,
+                   "git_rev": git_rev,
+                   "programs": programs_baseline(records)}, f, indent=1,
+                  sort_keys=True)
+    return path
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--update", action="store_true",
@@ -476,6 +526,10 @@ def main(argv=None) -> int:
                     help="skip the precision H2D/CG-ladder/parity gate")
     ap.add_argument("--no-quality", action="store_true",
                     help="skip the quality-ledger nan_burst gate")
+    ap.add_argument("--no-programs", action="store_true",
+                    help="skip the compiled-program HBM gate (rides "
+                         "the destriper bench; --no-destriper also "
+                         "skips it)")
     ap.add_argument("--no-registry", action="store_true",
                     help="do not append this gate run to the run "
                          "registry (evidence/runs.jsonl)")
@@ -508,7 +562,15 @@ def main(argv=None) -> int:
             pass
         with open(path, "w") as f:
             json.dump(cur, f, indent=1)
-        print(json.dumps({"ok": True, "updated": path, **cur}))
+        updated = [path]
+        if not (args.no_programs or args.no_destriper):
+            # the HBM baseline comes from the same quick destriper
+            # bench the gate will run — commit both references together
+            d = run_destriper_bench()["detail"]
+            updated.append(write_programs_reference(
+                platform, d.get("programs") or [],
+                git_rev=cur.get("git_rev", "")))
+        print(json.dumps({"ok": True, "updated": updated, **cur}))
         return 0
 
     if not os.path.exists(path):
@@ -628,6 +690,56 @@ def main(argv=None) -> int:
                 f"not below twolevel ({it['twolevel']}) — the V-cycle "
                 "regressed to (or below) the additive two-level "
                 "preconditioner")
+        # solver-trace exactness (ISSUE 15): the per-iteration records
+        # and the reported count come from the SAME traced dispatch —
+        # any mismatch means the trace scatter or the host decode
+        # broke. A detail with NO solver_trace key is a canned fixture
+        # (the live bench always emits one): skip, don't fail.
+        if "solver_trace" in d:
+            trace = d.get("solver_trace") or {}
+            destriper["solver_trace"] = {k: trace.get(k) for k in
+                                         ("iteration_records",
+                                          "reported_iters", "match")}
+            if not trace.get("match"):
+                failures.append(
+                    f"destriper: solver trace wrote "
+                    f"{trace.get('iteration_records')} iteration "
+                    f"record(s) but the solve reported "
+                    f"{trace.get('reported_iters')} CG iteration(s) — "
+                    "the per-iteration trace no longer mirrors the "
+                    "solve")
+        else:
+            destriper["solver_trace"] = {"skipped": "canned bench "
+                                         "detail has no solver_trace"}
+        if not args.no_programs:
+            # the HBM gate (ISSUE 15): machine-independent byte counts
+            # from XLA's buffer assignment vs the committed baseline;
+            # growth on a shared key fails, new/vanished programs are
+            # informational
+            from comapreduce_tpu.telemetry.programs import (
+                hbm_regressions, program_key)
+
+            progs = d.get("programs") or []
+            pref = programs_reference_path(platform)
+            if os.path.exists(pref):
+                with open(pref) as f:
+                    base = (json.load(f) or {}).get("programs", {})
+                cur_keys = {program_key(r.get("name", ""),
+                                        r.get("shape_bucket", ""),
+                                        r.get("precision_id", ""))
+                            for r in progs}
+                hbm_fails = hbm_regressions(progs, base)
+                failures.extend(hbm_fails)
+                destriper["programs_gate"] = {
+                    "checked": len(cur_keys & set(base)),
+                    "regressions": len(hbm_fails),
+                    "new_programs": sorted(cur_keys - set(base)),
+                    "vanished_programs": sorted(set(base) - cur_keys),
+                }
+            else:
+                destriper["programs_gate"] = {
+                    "skipped": f"no committed baseline {pref}; run "
+                               "tools/check_perf.py --update"}
     serving = None
     if not args.no_serving:
         # machine-independent like the campaign gate: the warm epoch's

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Roofline table from the compiled-program registry + measured walls.
+
+    python tools/roofline_report.py PROGRAMS_DIR_OR_FILE
+        [--walls BENCH_JSON] [--peak-flops TFLOPS] [--peak-bw GBS]
+        [--json]
+    python tools/roofline_report.py --selftest
+
+Earlier ROOFLINE.md rounds were assembled by hand from ad-hoc
+``cost_analysis()`` calls. This tool renders the same table from
+``programs.jsonl`` (``telemetry/programs.py`` — written by any
+telemetry-on run or by ``bench.py --config destriper``): per program
+the XLA FLOP count, bytes accessed, HBM footprint
+(argument/output/temp), and the arithmetic intensity FLOPs/byte.
+
+``--walls`` takes a bench detail JSON (any ``bench.py`` evidence blob —
+nested ``wall_s``/``ms_per_iter`` entries are found by key suffix
+match, e.g. ladder entry ``multigrid`` pairs with program
+``destriper.multigrid``) and adds achieved GFLOP/s and GB/s per
+program; with ``--peak-flops``/``--peak-bw`` (defaults: the round-3
+bench-host envelope, 45 TFLOP/s f32 MXU and 565 GB/s marginal HBM)
+each program is placed against its roofline bound: percent of the
+min(compute, bandwidth) ceiling and which side it sits on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# measured bench-host envelope (ROOFLINE.md "Platform envelope")
+DEFAULT_PEAK_TFLOPS = 45.0
+DEFAULT_PEAK_GBS = 565.0
+
+
+def collect_walls(blob, prefix: str = "") -> dict:
+    """Flatten a bench evidence blob into ``{dotted.key: wall_s}`` —
+    any dict carrying ``wall_s`` (or only ``ms_per_iter``) contributes
+    one entry under its key path."""
+    out: dict = {}
+    if not isinstance(blob, dict):
+        return out
+    if isinstance(blob.get("wall_s"), (int, float)):
+        out[prefix or "run"] = float(blob["wall_s"])
+    elif isinstance(blob.get("ms_per_iter"), (int, float)):
+        out[prefix or "run"] = float(blob["ms_per_iter"]) / 1e3
+    for k, v in blob.items():
+        if isinstance(v, dict):
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(collect_walls(v, key))
+    return out
+
+
+def match_wall(name: str, walls: dict) -> float | None:
+    """Pair program ``destriper.multigrid`` with wall key
+    ``...ladder.multigrid`` by longest suffix-segment overlap."""
+    best, best_len = None, 0
+    tail = name.split(".")[-1]
+    for key, wall in walls.items():
+        ktail = key.split(".")[-1]
+        if ktail == tail or key.endswith(name) or name.endswith(ktail):
+            score = len(os.path.commonprefix([name[::-1], key[::-1]]))
+            score = max(score, len(ktail) if ktail == tail else 0)
+            if score > best_len:
+                best, best_len = float(wall), score
+    return best
+
+
+def build_rows(records: list, walls: dict | None = None,
+               peak_tflops: float = DEFAULT_PEAK_TFLOPS,
+               peak_gbs: float = DEFAULT_PEAK_GBS) -> list:
+    rows = []
+    for rec in records:
+        flops = rec.get("flops")
+        nbytes = rec.get("bytes_accessed")
+        hbm = ((rec.get("temp_bytes") or 0)
+               + (rec.get("output_bytes") or 0))
+        row = {
+            "name": rec.get("name", ""),
+            "shape_bucket": rec.get("shape_bucket", ""),
+            "precision_id": rec.get("precision_id", ""),
+            "backend": rec.get("backend", ""),
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "intensity_flops_per_byte": (flops / nbytes
+                                         if flops and nbytes else None),
+            "argument_bytes": rec.get("argument_bytes"),
+            "hbm_temp_output_bytes": hbm or None,
+        }
+        wall = match_wall(row["name"], walls) if walls else None
+        if wall and wall > 0:
+            row["wall_s"] = wall
+            if flops:
+                row["achieved_gflops"] = flops / wall / 1e9
+            if nbytes:
+                row["achieved_gbs"] = nbytes / wall / 1e9
+            if flops and nbytes:
+                # the roofline ceiling for this intensity: bandwidth-
+                # bound below the ridge, compute-bound above it
+                intensity = flops / nbytes
+                bw_bound = peak_gbs * 1e9 * intensity   # FLOP/s
+                fl_bound = peak_tflops * 1e12
+                bound = min(bw_bound, fl_bound)
+                row["bound"] = ("bandwidth" if bw_bound < fl_bound
+                                else "compute")
+                row["pct_of_roof"] = 100.0 * (flops / wall) / bound
+        rows.append(row)
+    rows.sort(key=lambda r: -(r.get("flops") or 0))
+    return rows
+
+
+def format_table(rows: list) -> str:
+    def g(v, spec=".3g"):
+        return "-" if v is None else format(float(v), spec)
+
+    have_walls = any("wall_s" in r for r in rows)
+    head = ["program", "shapes", "GFLOP", "GB moved", "FLOP/B",
+            "HBM t+o MB"]
+    if have_walls:
+        head += ["wall s", "GFLOP/s", "GB/s", "% roof (bound)"]
+    lines = ["| " + " | ".join(head) + " |",
+             "|" + "---|" * len(head)]
+    for r in rows:
+        cells = [
+            r["name"], r["shape_bucket"] or "-",
+            g(r["flops"] / 1e9 if r["flops"] else None),
+            g(r["bytes_accessed"] / 1e9 if r["bytes_accessed"]
+              else None),
+            g(r["intensity_flops_per_byte"]),
+            g(r["hbm_temp_output_bytes"] / 1e6
+              if r["hbm_temp_output_bytes"] else None),
+        ]
+        if have_walls:
+            pct = (f"{r['pct_of_roof']:.1f} ({r['bound']})"
+                   if r.get("pct_of_roof") is not None else "-")
+            cells += [g(r.get("wall_s")), g(r.get("achieved_gflops")),
+                      g(r.get("achieved_gbs")), pct]
+        lines.append("| " + " | ".join(str(c) for c in cells) + " |")
+    return "\n".join(lines)
+
+
+def run_report(source: str, walls_path: str = "", as_json: bool = False,
+               peak_tflops: float = DEFAULT_PEAK_TFLOPS,
+               peak_gbs: float = DEFAULT_PEAK_GBS) -> int:
+    from comapreduce_tpu.telemetry.programs import read_programs
+
+    records = read_programs(source)
+    if not records:
+        print(f"no program records under {source} (run a telemetry-on "
+              "campaign or bench.py --config destriper)",
+              file=sys.stderr)
+        return 1
+    walls = None
+    if walls_path:
+        with open(walls_path) as f:
+            walls = collect_walls(json.load(f))
+    rows = build_rows(records, walls, peak_tflops, peak_gbs)
+    if as_json:
+        print(json.dumps({"programs": rows,
+                          "peak_tflops": peak_tflops,
+                          "peak_gbs": peak_gbs}))
+    else:
+        print(format_table(rows))
+    return 0
+
+
+def _selftest() -> int:
+    """Synthetic registry + walls through the full merge path."""
+    from comapreduce_tpu.telemetry.programs import programs_path
+
+    with tempfile.TemporaryDirectory() as tmp:
+        recs = [
+            {"schema": 1, "kind": "program", "name": "destriper.mg",
+             "shape_bucket": "f32[1000]", "precision_id": "f32",
+             "backend": "cpu", "flops": 2.0e9, "bytes_accessed": 1.0e8,
+             "output_bytes": 4000, "temp_bytes": 6000},
+            {"schema": 1, "kind": "program", "name": "level1.bin",
+             "shape_bucket": "f32[64]", "precision_id": "f32",
+             "backend": "cpu", "flops": 1.0e6, "bytes_accessed": 1.0e9},
+        ]
+        with open(programs_path(tmp), "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            f.write('{"kind": "program", "na')   # torn tail
+        walls = collect_walls(
+            {"detail": {"ladder": {"mg": {"wall_s": 0.5},
+                                   "bin": {"ms_per_iter": 2.0}}}})
+        from comapreduce_tpu.telemetry.programs import read_programs
+
+        rows = build_rows(read_programs(tmp), walls,
+                          peak_tflops=1.0, peak_gbs=1000.0)
+        by = {r["name"]: r for r in rows}
+        mg, b1 = by["destriper.mg"], by["level1.bin"]
+        # ridge point at these peaks: 1e12 / 1e12 = 1 FLOP/B. mg at
+        # intensity 20 sits compute-bound; achieved 2e9/0.5 = 4 GFLOP/s
+        # -> 0.4% of the 1 TFLOP/s roof. bin at 1e-3 FLOP/B is
+        # bandwidth-bound.
+        ok = (rows[0]["name"] == "destriper.mg"      # sorted by flops
+              and abs(mg["intensity_flops_per_byte"] - 20.0) < 1e-9
+              and mg["hbm_temp_output_bytes"] == 10000
+              and mg["bound"] == "compute"
+              and abs(mg["pct_of_roof"] - 0.4) < 1e-6
+              and mg["wall_s"] == 0.5
+              and b1["bound"] == "bandwidth"
+              and abs(b1["wall_s"] - 0.002) < 1e-12
+              and "% roof" in format_table(rows))
+        print(json.dumps({"selftest_ok": bool(ok),
+                          "programs": len(rows)}))
+        return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("source", nargs="?", default="",
+                    help="directory holding programs.jsonl (or one "
+                         "file)")
+    ap.add_argument("--walls", default="",
+                    help="bench evidence JSON to merge measured walls "
+                         "from")
+    ap.add_argument("--peak-flops", type=float,
+                    default=DEFAULT_PEAK_TFLOPS,
+                    help="peak TFLOP/s for the roofline ceiling")
+    ap.add_argument("--peak-bw", type=float, default=DEFAULT_PEAK_GBS,
+                    help="peak HBM GB/s for the roofline ceiling")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable rows")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic round-trip (the CI smoke)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.source:
+        ap.error("source is required (or use --selftest)")
+    return run_report(args.source, walls_path=args.walls,
+                      as_json=args.json, peak_tflops=args.peak_flops,
+                      peak_gbs=args.peak_bw)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,312 @@
+#!/usr/bin/env python
+"""Convergence diagnostics over the destriper's solver traces.
+
+    python tools/solver_report.py LOG_DIR_OR_FILE [--json]
+        [--registry PATH] [--window N]
+    python tools/solver_report.py --selftest
+
+Reads every ``solver.rank*.jsonl`` under the run's ``[Global]
+log_dir`` (``telemetry/solver_trace.py`` — written whenever telemetry
+is on) and renders, per (band, preconditioner id):
+
+- iterations run / to tolerance, first and final residual, and the
+  fitted convergence slope in decades per iteration (least squares
+  over log10 residual — the number the live plane's ETA gauge
+  extrapolates);
+- stall windows (trailing ``STALL_WINDOW`` iterations flatter than
+  ``STALL_SLOPE`` decades/iter on an unconverged solve) and divergence
+  annotations (residual growth past 100x the best-so-far);
+- per-preconditioner aggregation — iterations per rung, so a
+  preconditioner that stopped earning its matvecs is one table away;
+- with ``--registry`` (default ``evidence/runs.jsonl`` when present):
+  the preconditioner-effectiveness delta of THIS run's iteration
+  counts against the trailing run-registry window
+  (``telemetry/registry.py`` — the same series ``campaign_watch.py
+  trend`` alerts on).
+
+``--selftest`` synthesises converging / stalling / diverged bands plus
+a torn trailing line, round-trips them through the real append/read
+path and validates every diagnostic — the CI smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _slope(iters: list, residuals: list) -> float | None:
+    """Least-squares slope of log10(residual) vs iteration (decades per
+    iteration; negative = converging). None with < 2 usable points."""
+    pts = [(float(i), math.log10(r)) for i, r in zip(iters, residuals)
+           if r and r > 0.0]
+    if len(pts) < 2:
+        return None
+    n = len(pts)
+    sx = sum(p[0] for p in pts)
+    sy = sum(p[1] for p in pts)
+    sxx = sum(p[0] * p[0] for p in pts)
+    sxy = sum(p[0] * p[1] for p in pts)
+    denom = n * sxx - sx * sx
+    if denom == 0:
+        return None
+    return (n * sxy - sx * sy) / denom
+
+
+def summarize_solver(records: list) -> dict:
+    """Fold solver-trace records into the report structure: one entry
+    per (band, precond_id) plus a per-preconditioner aggregation."""
+    from comapreduce_tpu.telemetry.solver_trace import (STALL_SLOPE,
+                                                       STALL_WINDOW)
+
+    solves: dict = {}
+    for rec in records:
+        key = (str(rec.get("band", "")), str(rec.get("precond_id", "")))
+        s = solves.setdefault(key, {"iterations": [], "summaries": []})
+        if rec.get("kind") == "iteration":
+            s["iterations"].append(rec)
+        elif rec.get("kind") == "solve":
+            s["summaries"].append(rec)
+
+    bands, rungs = [], {}
+    for (band, precond_id), s in sorted(solves.items()):
+        its = sorted(s["iterations"], key=lambda r: r.get("iter", 0))
+        residuals = [float(r.get("residual") or 0.0) for r in its]
+        iter_nos = [int(r.get("iter", 0)) for r in its]
+        summaries = s["summaries"]
+        last = summaries[-1] if summaries else {}
+        n_iter = (sum(int(x.get("n_iter") or 0) for x in summaries)
+                  if summaries else len(its))
+        converged = bool(last.get("converged"))
+        diverging = sum(1 for r in its if r.get("diverging"))
+        tail = min(len(its), STALL_WINDOW)
+        tail_slope = (_slope(iter_nos[-tail:], residuals[-tail:])
+                      if tail >= 2 else None)
+        entry = {
+            "band": band,
+            "precond_id": precond_id,
+            "precision_id": str(last.get("precision_id")
+                                or (its[0].get("precision_id")
+                                    if its else "")),
+            "n_iter": int(n_iter),
+            "n_solves": len(summaries),
+            "threshold": float(last.get("threshold") or 0.0),
+            "first_residual": residuals[0] if residuals else None,
+            "final_residual": (float(last["residual"])
+                               if last.get("residual") is not None
+                               else (residuals[-1] if residuals
+                                     else None)),
+            "converged": converged,
+            "diverged": bool(last.get("diverged")) or diverging > 0,
+            "diverging_iters": diverging,
+            "stalled": any(x.get("stalled") for x in summaries),
+            "stalled_at": next((x.get("stalled_at") for x in summaries
+                                if x.get("stalled")), None),
+            "slope_decades_per_iter": _slope(iter_nos, residuals),
+            "tail_slope_decades_per_iter": tail_slope,
+            "tail_stalled": (not converged and tail_slope is not None
+                             and tail_slope > -STALL_SLOPE),
+        }
+        bands.append(entry)
+        rung = precond_id.split("|")[0] or "<unknown>"
+        agg = rungs.setdefault(rung, {"bands": 0, "iters": 0,
+                                      "converged": 0, "stalled": 0,
+                                      "diverged": 0})
+        agg["bands"] += 1
+        agg["iters"] += entry["n_iter"]
+        agg["converged"] += int(entry["converged"])
+        agg["stalled"] += int(entry["stalled"] or entry["tail_stalled"])
+        agg["diverged"] += int(entry["diverged"])
+    return {"bands": bands, "preconditioners": rungs,
+            "n_records": len(records)}
+
+
+def registry_deltas(summary: dict, registry_path: str,
+                    window: int = 5) -> dict:
+    """This run's iteration counts vs the trailing run-registry window:
+    the median of every ``*cg_iters*`` metric in the last ``window``
+    records against the traced solves' mean iterations. A preconditioner
+    suddenly needing 2x the registry's historical iterations shows up
+    here before it shows up in wall clocks."""
+    from comapreduce_tpu.telemetry.registry import read_runs
+
+    runs = read_runs(registry_path)
+    if not runs:
+        return {}
+    hist: dict = {}
+    for run in runs[-window:]:
+        for k, v in (run.get("metrics") or {}).items():
+            if "cg_iters" in k and isinstance(v, (int, float)):
+                hist.setdefault(k, []).append(float(v))
+    if not hist:
+        return {}
+    bands = summary.get("bands") or []
+    cur = (sum(b["n_iter"] for b in bands) / len(bands)
+           if bands else None)
+    out = {"current_mean_iters": cur, "window": window, "metrics": {}}
+    for k, vals in sorted(hist.items()):
+        vals = sorted(vals)
+        med = vals[len(vals) // 2]
+        out["metrics"][k] = {
+            "registry_median": med,
+            "ratio": (round(cur / med, 3)
+                      if cur is not None and med else None)}
+    return out
+
+
+def format_report(summary: dict, deltas: dict | None = None) -> str:
+    def g(v, spec=".3g"):
+        return "-" if v is None else format(float(v), spec)
+
+    lines = [f"solver traces: {len(summary['bands'])} (band, "
+             f"preconditioner) solve(s), {summary['n_records']} "
+             "record(s)"]
+    for b in summary["bands"]:
+        state = ("CONVERGED" if b["converged"] else
+                 "DIVERGED" if b["diverged"] else
+                 "STALLED" if b["stalled"] or b["tail_stalled"] else
+                 "unconverged")
+        stall = (f" (stalled at iter {b['stalled_at']})"
+                 if b["stalled_at"] is not None else "")
+        lines.append(
+            f"  {b['band']} [{b['precond_id']}]: {b['n_iter']} iters "
+            f"-> residual {g(b['final_residual'])} "
+            f"(threshold {g(b['threshold'])}) {state}{stall} | "
+            f"slope {g(b['slope_decades_per_iter'])} dec/iter "
+            f"(tail {g(b['tail_slope_decades_per_iter'])})")
+    lines.append("per-preconditioner rungs:")
+    for rung, agg in sorted(summary["preconditioners"].items()):
+        lines.append(
+            f"  {rung}: {agg['iters']} iters over {agg['bands']} "
+            f"band-solve(s) | converged {agg['converged']} "
+            f"stalled {agg['stalled']} diverged {agg['diverged']}")
+    if deltas and deltas.get("metrics"):
+        lines.append(
+            f"vs run registry (trailing {deltas['window']} runs, "
+            f"current mean {g(deltas['current_mean_iters'])} iters):")
+        for k, d in deltas["metrics"].items():
+            lines.append(f"  {k}: registry median "
+                         f"{g(d['registry_median'])} "
+                         f"(ratio {g(d['ratio'])})")
+    return "\n".join(lines)
+
+
+def run_report(source: str, as_json: bool = False,
+               registry: str = "", window: int = 5) -> int:
+    from comapreduce_tpu.telemetry.solver_trace import read_solver
+
+    records = read_solver(source)
+    if not records:
+        print(f"no solver trace records under {source} (is [telemetry] "
+              "enabled = true?)", file=sys.stderr)
+        return 1
+    summary = summarize_solver(records)
+    deltas = None
+    if registry != "none":
+        path = registry or ""
+        if not path:
+            from comapreduce_tpu.telemetry.registry import (
+                default_registry_path)
+
+            path = default_registry_path()
+        if os.path.exists(path):
+            deltas = registry_deltas(summary, path, window=window)
+    if as_json:
+        print(json.dumps({"summary": summary, "registry": deltas}))
+    else:
+        print(format_report(summary, deltas))
+    return 0
+
+
+def _selftest() -> int:
+    """Synthetic converging / stalling / diverged bands + a torn tail,
+    through the real append/read path."""
+    from comapreduce_tpu.telemetry.solver_trace import (append_solver,
+                                                       read_solver,
+                                                       solve_summary,
+                                                       solver_path)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = solver_path(tmp, 0)
+
+        def band(name, resid_fn, n, threshold=1e-6, precond="jacobi"):
+            recs = []
+            best = float("inf")
+            for k in range(n):
+                r = resid_fn(k)
+                recs.append({"schema": 1, "kind": "iteration",
+                             "band": name, "iter": k, "residual": r,
+                             "rr": r * r, "alpha": 1.0, "beta": 0.1,
+                             "precond_id": f"{precond}|L50",
+                             "precision_id": "tod=f32|cgdot=f32",
+                             "threshold": threshold, "rank": 0,
+                             "diverging": r > 100.0 * best})
+                best = min(best, r)
+            recs.append(solve_summary(
+                recs, band=name, n_iter=n, residual=resid_fn(n - 1),
+                diverged=any(r["diverging"] for r in recs),
+                precond_id=f"{precond}|L50",
+                precision_id="tod=f32|cgdot=f32", threshold=threshold,
+                base=0, rank=0))
+            append_solver(path, recs)
+
+        band("band0", lambda k: 10.0 ** (-0.2 * k), 40,
+             precond="multigrid")                     # converges
+        band("band1", lambda k: max(1e-3, 10.0 ** (-0.5 * k)),
+             60)                                      # stalls flat
+        band("band2", lambda k: 1e-3 * (10.0 ** k if k > 6 else
+                                        10.0 ** (-0.1 * k)), 10)
+        with open(path, "a") as f:
+            f.write('{"kind": "iteration", "band": "to')  # torn tail
+
+        records = read_solver(tmp)
+        summary = summarize_solver(records)
+        by_band = {b["band"]: b for b in summary["bands"]}
+        b0, b1, b2 = (by_band[f"band{i}"] for i in range(3))
+        ok = (b0["converged"] and not b0["stalled"]
+              and b0["slope_decades_per_iter"] is not None
+              and abs(b0["slope_decades_per_iter"] + 0.2) < 0.02
+              and (b1["stalled"] or b1["tail_stalled"])
+              and not b1["converged"]
+              and b2["diverged"] and b2["diverging_iters"] > 0
+              and summary["preconditioners"]["multigrid"]["iters"] == 40
+              and len(records) == 41 + 61 + 11  # torn line dropped
+              and format_report(summary))
+        print(json.dumps({"selftest_ok": bool(ok),
+                          "bands": len(summary["bands"]),
+                          "n_records": len(records)}))
+        return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("source", nargs="?", default="",
+                    help="log dir holding solver.rank*.jsonl (or one "
+                         "trace file)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary")
+    ap.add_argument("--registry", default="",
+                    help="runs.jsonl for effectiveness deltas (default "
+                         "evidence/runs.jsonl when present; 'none' "
+                         "disables)")
+    ap.add_argument("--window", type=int, default=5,
+                    help="trailing registry records to compare against")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic round-trip (the CI smoke)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.source:
+        ap.error("source is required (or use --selftest)")
+    return run_report(args.source, as_json=args.json,
+                      registry=args.registry, window=args.window)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
