@@ -2228,11 +2228,74 @@ def bench_precision():
     return 0
 
 
+def bench_synthetic():
+    """Synthetic campaign mode (ISSUE 16): the scale drill as a
+    benchmark config plus one transfer-function closure.
+
+    Runs ``synthetic.loadgen.run_synthetic_drill`` — a generated
+    ``synth://`` campaign through three real elastic reduce ranks, the
+    map server, and the tile tier, with a mid-run SIGKILL/rejoin — and
+    reports campaign files per hour of drill wall time. Every drill
+    promise (exactly-once commits, healthz flip/recovery, fresh
+    epochs, exact /metrics counters) raises on violation, so this
+    config FAILING is the signal; the throughput number is the trend
+    line. One ``synthetic.transfer.run_transfer`` campaign then closes
+    the loop against the injected truth (``check_transfer``).
+
+    ``BENCH_SMALL=1`` runs 48 files (the CI shape); the full shape is
+    200. ``BENCH_SYNTH_FILES`` overrides either.
+    """
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from comapreduce_tpu.synthetic.loadgen import run_synthetic_drill
+    from comapreduce_tpu.synthetic.transfer import (check_transfer,
+                                                    run_transfer)
+
+    small = os.environ.get("BENCH_SMALL", "0") == "1"
+    n_files = int(os.environ.get("BENCH_SYNTH_FILES",
+                                 "48" if small else "200"))
+    tmp = tempfile.mkdtemp(prefix="bench_synthetic_")
+    try:
+        evidence = run_synthetic_drill(os.path.join(tmp, "drill"),
+                                       seed=0, n_files=n_files)
+        artifact = run_transfer(os.path.join(tmp, "transfer"), seed=0)
+        check_transfer(artifact)
+        line = {
+            "metric": "synthetic_files_per_hour",
+            "value": round(3600.0 * n_files
+                           / max(evidence["wall_s"], 1e-9), 1),
+            "unit": "files/h",
+            # contract-style: reaching here IS the pass (the drill and
+            # the transfer gate both raise on any broken promise)
+            "vs_baseline": 1.0,
+            "detail": {
+                "config": "synthetic",
+                **evidence,
+                "transfer": {
+                    "map_gain": [b.get("map_gain")
+                                 for b in artifact["bands"]],
+                    "low_k_transfer": [
+                        list(b.get("transfer", [])[:2])
+                        for b in artifact["bands"]],
+                    "quality": artifact.get("quality"),
+                },
+            },
+        }
+        print(json.dumps(line))
+        write_evidence("synthetic", lambda: None, extra=line["detail"],
+                       host_only=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
 _CONFIGS = {"1": bench_config1, "2": bench_config2, "4": bench_config4,
             "ingest": bench_ingest, "resilience": bench_resilience,
             "campaign": bench_campaign, "destriper": bench_destriper,
             "serving": bench_serving, "kernels": bench_kernels,
-            "precision": bench_precision}
+            "precision": bench_precision, "synthetic": bench_synthetic}
 
 
 if __name__ == "__main__":

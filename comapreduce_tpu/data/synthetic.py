@@ -28,7 +28,8 @@ import numpy as np
 
 from comapreduce_tpu.data.hdf5io import HDF5Store
 
-__all__ = ["SyntheticObsParams", "generate_level1_file", "one_over_f_noise",
+__all__ = ["SyntheticObsParams", "generate_level1_file",
+           "generate_level1_store", "one_over_f_noise",
            "gaussian_source_sky"]
 
 SAMPLE_RATE = 50.0  # Hz, reference Level1Averaging.py:808
@@ -100,6 +101,20 @@ class SyntheticObsParams:
     dec0: float = 52.0
     source_amplitude_k: float = 0.0   # K; >0 injects a Gaussian source
     source_fwhm_deg: float = 0.075    # ~4.5 arcmin COMAP beam
+    # additive per-feed atmospheric 1/f temperature fluctuation (K). Unlike
+    # the multiplicative dg(t) gain stream — which gain correction removes —
+    # this survives reduction and is what the destriper (and the quality
+    # ledger's noise fits) actually see. >0 enables it.
+    t_atm_sigma: float = 0.0
+    t_atm_fknee: float = 0.1          # Hz
+    t_atm_alpha: float = 1.5
+    # fault mix: fraction of (feed, band, channel, sample) cells hit
+    spike_rate: float = 0.0           # multiplied 100x (cosmic-ray spikes)
+    nan_rate: float = 0.0             # set to NaN (dropped packets)
+    # optional sky model callable (lon_deg, lat_deg, freq_GHz) -> K,
+    # e.g. ``simulations.skymodel.SkyModel``; evaluated per (feed, band)
+    # at the band-centre frequency and added to t_sky.
+    sky_model: object = None
     seed: int = 1234
     truth: dict = field(default_factory=dict, repr=False)
 
@@ -120,10 +135,23 @@ def _band_frequencies(n_bands: int, n_channels: int) -> np.ndarray:
     return freq
 
 
-def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
-                         ) -> SyntheticObsParams:
-    """Write a synthetic Level-1 HDF5 file; returns params with ``truth``
-    filled in (per-channel gain/tsys, dg time stream, scan edges, sky)."""
+def generate_level1_store(params: SyntheticObsParams | None = None
+                          ) -> tuple[SyntheticObsParams, HDF5Store]:
+    """Build a synthetic Level-1 observation as an in-memory ``HDF5Store``.
+
+    Returns ``(params, store)`` with ``params.truth`` filled in (per-channel
+    gain/tsys, dg time stream, scan edges, sky). The store can be written to
+    disk (``generate_level1_file``) or served directly through the ingest
+    payload path (``comapreduce_tpu.synthetic.memsource``) — both see the
+    same arrays, so campaigns are identical with or without disk.
+
+    Determinism contract: all randomness derives from ``params.seed``. The
+    base observation draws from ``default_rng(seed)`` in a fixed order; the
+    optional scenario extensions (atmospheric 1/f, faults) draw from
+    *separate* ``default_rng([seed, k])`` streams so enabling them never
+    perturbs the base draws, and files generated with default knobs are
+    byte-identical across versions.
+    """
     p = params or SyntheticObsParams()
     rng = np.random.default_rng(p.seed)
     F, B, C, T = p.n_feeds, p.n_bands, p.n_channels, p.n_samples
@@ -144,7 +172,7 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
         t += p.scan_samples
     t += p.gap_samples
     features[t:t + p.vane_samples] = 2 ** FEATURE_VANE
-    scan_edges = np.asarray(scan_edges, dtype=np.int64)
+    scan_edges = np.asarray(scan_edges, dtype=np.int64).reshape(-1, 2)
     vane_flag = features == 2 ** FEATURE_VANE
 
     mjd = p.mjd_start + np.arange(T) / fs / 86400.0
@@ -188,15 +216,52 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
         sky = gaussian_source_sky(ra_f, dec_f, p.ra0, p.dec0,
                                   p.source_amplitude_k, p.source_fwhm_deg)
 
-    t_sky = (p.t_cmb + p.t_atm_zenith * airmass + sky)  # (F, T)
+    # additive atmospheric 1/f: per-feed, common-mode across (band, channel),
+    # present only on sky (not the vane load). Separate RNG stream keeps the
+    # base observation bit-identical when disabled.
+    t_atm = np.zeros((F, T))
+    if p.t_atm_sigma > 0:
+        rng_atm = np.random.default_rng([p.seed, 101])
+        t_atm = one_over_f_noise(rng_atm, T, p.t_atm_sigma, p.t_atm_fknee,
+                                 p.t_atm_alpha, fs, size=(F,))
+
+    t_sky = (p.t_cmb + p.t_atm_zenith * airmass + sky + t_atm)  # (F, T)
+    t_sky_b = t_sky[:, None, :]  # (F, B, T) broadcast slot
+    if p.sky_model is not None:
+        # per-band sky from the model at band-centre frequency
+        nu_c = freq.mean(axis=1)  # (B,) GHz
+        model = np.stack([np.asarray(p.sky_model(ra_f, dec_f, nu))
+                          for nu in nu_c], axis=1)  # (F, B, T)
+        t_sky_b = t_sky_b + model
     t_total = t_rx[..., None] + np.where(vane_flag[None, None, None, :],
                                          p.t_vane,
-                                         t_sky[:, None, None, :])  # (F,B,C,T)
+                                         t_sky_b[:, :, None, :])  # (F,B,C,T)
     dnu = 2.0e9 / C  # Hz per channel
     rms_frac = 1.0 / np.sqrt(dnu / fs)
     tod = gain[..., None] * t_total * (1.0 + dg[:, None, None, :])
     tod = tod * (1.0 + rms_frac * rng.normal(size=(F, B, C, T)))
     tod = tod.astype(np.float32)
+
+    # fault mix: spikes (x100 cosmic-ray hits) and NaN cells (dropped
+    # packets), confined to scan samples so vane calibration stays clean.
+    n_spikes = n_nans = 0
+    if p.spike_rate > 0 or p.nan_rate > 0:
+        rng_fault = np.random.default_rng([p.seed, 202])
+        scan_idx = np.flatnonzero(scan_flag)
+        n_cells = F * B * C * scan_idx.size
+        n_spikes = int(round(p.spike_rate * n_cells))
+        n_nans = int(round(p.nan_rate * n_cells))
+        for count, op in ((n_spikes, "spike"), (n_nans, "nan")):
+            if count <= 0 or scan_idx.size == 0:
+                continue
+            ff = rng_fault.integers(0, F, size=count)
+            bb = rng_fault.integers(0, B, size=count)
+            cc = rng_fault.integers(0, C, size=count)
+            tt = scan_idx[rng_fault.integers(0, scan_idx.size, size=count)]
+            if op == "spike":
+                tod[ff, bb, cc, tt] *= 100.0
+            else:
+                tod[ff, bb, cc, tt] = np.nan
 
     # -- housekeeping -------------------------------------------------------
     hk_n = max(T // 5, 2)  # ~10 Hz housekeeping
@@ -226,7 +291,6 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
     store.set_attrs("comap", "obsid", p.obsid)
     store.set_attrs("comap", "source", f"{p.source},sky")
     store.set_attrs("comap", "comment", p.comment)
-    store.write(filename)
 
     tsys_truth = t_rx + p.t_cmb + p.t_atm_zenith * np.mean(airmass)
     p.truth = dict(
@@ -239,5 +303,19 @@ def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
         ra=ra_f, dec=dec_f,
         sky=sky,
         t_vane=p.t_vane,
+        t_atm=t_atm,
+        noise=dict(rms_frac=rms_frac, sigma_g=p.sigma_g, fknee=p.fknee,
+                   alpha=p.alpha, t_atm_sigma=p.t_atm_sigma,
+                   t_atm_fknee=p.t_atm_fknee, t_atm_alpha=p.t_atm_alpha),
+        n_spikes=n_spikes, n_nans=n_nans,
     )
+    return p, store
+
+
+def generate_level1_file(filename: str, params: SyntheticObsParams | None = None
+                         ) -> SyntheticObsParams:
+    """Write a synthetic Level-1 HDF5 file; returns params with ``truth``
+    filled in (per-channel gain/tsys, dg time stream, scan edges, sky)."""
+    p, store = generate_level1_store(params)
+    store.write(filename)
     return p

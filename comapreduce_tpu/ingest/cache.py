@@ -62,8 +62,13 @@ def file_key(path: str) -> tuple:
     """Cache key of ``path``: ``(abspath, mtime_ns)``.
 
     Raises ``OSError`` when the file does not exist — the caller's
-    per-file fault tolerance owns that, not the cache.
+    per-file fault tolerance owns that, not the cache. ``synth://``
+    virtual scenario members (``synthetic/memsource.py``) have no inode
+    and are immutable by construction (content is a pure function of
+    the path), so the path alone is the identity.
     """
+    if path.startswith("synth://"):
+        return path, 0
     ap = os.path.abspath(path)
     return ap, os.stat(ap).st_mtime_ns
 

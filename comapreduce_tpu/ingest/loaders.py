@@ -38,7 +38,21 @@ def load_level1(filename: str, eager_tod: bool = True,
     then holds half the bytes and every downstream transfer — the
     prefetch queue, ``prefetch_to_device``'s H2D copies — ships half
     the bytes. A lazy handle (``eager_tod=False``) is returned as-is:
-    it is never cached, so there is nothing to narrow."""
+    it is never cached, so there is nothing to narrow.
+
+    ``synth://`` virtual scenario members (``synthetic/memsource.py``)
+    are generated in memory here, on the same worker thread a disk read
+    would use — the rest of the ingest machinery (cache, retry,
+    watchdog, prefetch queue) cannot tell the difference. There is no
+    handle to keep lazy, so the eager/lazy split collapses: lazy
+    consumers get the materialised store, eager ones its payload."""
+    if filename.startswith("synth://"):
+        from comapreduce_tpu.synthetic.memsource import load_virtual
+
+        data = load_virtual(filename)
+        if not eager_tod:
+            return data
+        return cast_payload_tod(data.export_payload(), tod_dtype)
     data = COMAPLevel1()
     data.read(filename)
     if not eager_tod:

@@ -116,16 +116,9 @@ def red_noise_model(params, nu):
     return sig2 + red2 * jnp.abs(nu) ** alpha
 
 
-@functools.partial(jax.jit, static_argnames=("model",))
-def fit_noise_model(nu_bin: jax.Array, p_bin: jax.Array, counts: jax.Array,
-                    p0: jax.Array, model=knee_model):
-    """Fit a 3-parameter noise model to a binned PSD by log-chi^2 BFGS.
-
-    Positivity is enforced by optimising log(sig2), log(fknee/red2) with the
-    spectral index free — the reference uses L-BFGS-B bounds instead
-    (``PowerSpectra.py:137-159``). Returns the fitted params in natural
-    units. vmap over leading axes for batch fits.
-    """
+def _fit_noise_model_with_loss(nu_bin, p_bin, counts, p0, model):
+    """One LM fit; returns ``(params_natural_units, final log-chi^2)``.
+    The loss value is what multi-start selection compares."""
     good = (counts > 0) & (p_bin > 0) & (nu_bin > 0)
     logp = jnp.where(good, jnp.log(jnp.maximum(p_bin, 1e-30)), 0.0)
     # a bin averaging k exponentially-distributed PSD samples has
@@ -143,7 +136,20 @@ def fit_noise_model(nu_bin: jax.Array, p_bin: jax.Array, counts: jax.Array,
     q0 = jnp.array([jnp.log(jnp.maximum(p0[0], 1e-20)),
                     jnp.log(jnp.maximum(p0[1], 1e-20)), p0[2]])
     q = minimize_lm(loss, q0, n_iter=60)
-    return jnp.array([jnp.exp(q[0]), jnp.exp(q[1]), q[2]])
+    return jnp.array([jnp.exp(q[0]), jnp.exp(q[1]), q[2]]), loss(q)
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def fit_noise_model(nu_bin: jax.Array, p_bin: jax.Array, counts: jax.Array,
+                    p0: jax.Array, model=knee_model):
+    """Fit a 3-parameter noise model to a binned PSD by log-chi^2 BFGS.
+
+    Positivity is enforced by optimising log(sig2), log(fknee/red2) with the
+    spectral index free — the reference uses L-BFGS-B bounds instead
+    (``PowerSpectra.py:137-159``). Returns the fitted params in natural
+    units. vmap over leading axes for batch fits.
+    """
+    return _fit_noise_model_with_loss(nu_bin, p_bin, counts, p0, model)[0]
 
 
 def minimize_lm(loss, q0: jax.Array, n_iter: int = 60,
@@ -225,7 +231,40 @@ def fit_observation_noise(blocks: jax.Array, sample_rate: float = 50.0,
         p1 = jnp.clip(nu_low * excess ** (-1.0 / alpha0),
                       nu_low, 0.5 * sample_rate)
     p0 = jnp.stack([sig2, p1, jnp.full_like(sig2, alpha0)], axis=-1)
-    if mask_peaks:
+    if model_name == "red_noise":
+        # the red-noise log-chi^2 surface is bistable (documented in
+        # OPERATIONS.md §16): a too-small sigma_r^2 start can settle in
+        # a white-only local minimum with alpha pinned near its start,
+        # making alpha recovery seed-lucky. Multi-start: also fit the
+        # KNEE model (whose fknee parametrisation does not share the
+        # degeneracy), convert its optimum algebraically —
+        # ``sig2 (1 + |nu/fknee|^a) = sig2 + (sig2 fknee^-a) |nu|^a``,
+        # i.e. red2 = sig2 * fknee^(-alpha) — into a second red-noise
+        # start, and keep whichever optimum fits better.
+        excess_k = jnp.maximum(p_low / sig2 - 1.0, 1e-3)
+        p1_k = jnp.clip(nu_low * excess_k ** (-1.0 / alpha0),
+                        nu_low, 0.5 * sample_rate)
+        p0_k = jnp.stack([sig2, p1_k, jnp.full_like(sig2, alpha0)],
+                         axis=-1)
+
+        def fit_row(pbr, cntr, p0r, p0kr):
+            pk, _ = _fit_noise_model_with_loss(nu, pbr, cntr, p0kr,
+                                               knee_model)
+            red2_k = pk[0] * jnp.maximum(pk[1], 1e-6) ** (-pk[2])
+            start_k = jnp.stack([pk[0], jnp.maximum(red2_k, 1e-20),
+                                 pk[2]])
+            pa, la = _fit_noise_model_with_loss(nu, pbr, cntr, p0r,
+                                                red_noise_model)
+            pb2, lb = _fit_noise_model_with_loss(nu, pbr, cntr, start_k,
+                                                 red_noise_model)
+            return jnp.where(jnp.isfinite(lb) & (lb < la), pb2, pa)
+
+        if mask_peaks:
+            fit = jax.vmap(fit_row)(pb_flat, cnt, p0, p0_k)
+        else:
+            fit = jax.vmap(lambda pbr, p0r, p0kr: fit_row(
+                pbr, cnt, p0r, p0kr))(pb_flat, p0, p0_k)
+    elif mask_peaks:
         fit = jax.vmap(lambda pbr, cntr, p0r: fit_noise_model(
             nu, pbr, cntr, p0r, model=model))(pb_flat, cnt, p0)
     else:

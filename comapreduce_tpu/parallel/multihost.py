@@ -37,10 +37,9 @@ from __future__ import annotations
 import logging
 import os
 import time
-import warnings
 
 __all__ = ["maybe_initialize_distributed", "rank_info",
-           "straggler_barrier", "degraded_shard"]
+           "straggler_barrier"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -188,32 +187,6 @@ def straggler_barrier(heartbeat_dir: str, rank: int, n_ranks: int,
             "(elastic claiming, the campaign default, would finish "
             "them this run)", dead, timeout_s, heartbeat_dir)
     return sorted(alive | {rank}), dead
-
-
-def degraded_shard(filelist, rank: int, n_ranks: int, dead,
-                   alive, ledger=None) -> list:
-    """DEPRECATED shim — returns this rank's static round-robin shard.
-
-    The ledger-and-abandon path it used to implement (the lowest alive
-    rank recording every dead rank's file ``hang``/``rejected``) is
-    RETIRED: elastic claiming is now the campaign default
-    (``ResilienceConfig.coerce_campaign`` — ``pipeline.scheduler``
-    lets survivors steal a dead rank's files under heartbeat-fenced
-    leases and finish the campaign in the same run), so abandoning a
-    shard to the ledger no longer has a caller. The shim keeps the
-    signature one more release for external callers of the legacy
-    static-shard recipe; ``dead``/``alive``/``ledger`` are accepted
-    and ignored.
-    """
-    del dead, alive, ledger  # retired ledger-and-abandon inputs
-    warnings.warn(
-        "degraded_shard is a deprecated no-op shim returning the "
-        "static rank::n_ranks shard: elastic claiming ([resilience] "
-        "lease_ttl_s > 0, now the campaign default) finishes a dead "
-        "rank's files in the same run instead of abandoning them to "
-        "the ledger — docs/OPERATIONS.md §11",
-        DeprecationWarning, stacklevel=2)
-    return list(filelist)[rank::n_ranks]
 
 
 def rank_info() -> tuple[int, int]:

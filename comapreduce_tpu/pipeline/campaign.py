@@ -270,6 +270,12 @@ def probe_observation(path: str, pad_to: int = 128) -> dict:
     enough to probe a whole campaign on the warm-up thread."""
     from comapreduce_tpu.data.level import COMAPLevel1
 
+    if path.startswith("synth://"):
+        # virtual scenario member: geometry is arithmetic on the
+        # scenario — no TOD generation on the warm-up thread
+        from comapreduce_tpu.synthetic.memsource import probe_virtual
+
+        return probe_virtual(path, pad_to=pad_to)
     data = COMAPLevel1()
     data.read(path)
     try:

@@ -178,6 +178,9 @@ class Runner:
     # the live elastic scheduler during a run_tod call (None = static
     # shard, the default; see [resilience] lease_ttl_s)
     _scheduler: object = field(default=None, repr=False)
+    # last run_tod's final scheduler stats dict ({} = static shard):
+    # claim/steal/commit/fence accounting for post-run audits
+    scheduler_stats: dict = field(default_factory=dict, repr=False)
 
     def shard_iter(self, filelist):
         """Lazy round-robin shard: rank r takes files ``i % n_ranks == r``.
@@ -360,6 +363,10 @@ class Runner:
                                    "claim(s) on shutdown", self.rank, n)
                 logger.info("scheduler rank %d: %s", self.rank,
                             sched.stats)
+                # the run's final claim/commit accounting, kept for
+                # callers (the synthetic scale drill's exactly-once
+                # audit reads it after run_tod returns)
+                self.scheduler_stats = dict(sched.stats)
             # deterministic shutdown even when a stage raises something
             # the per-file net does not catch and the caller keeps the
             # traceback alive: closing the generator stops the worker

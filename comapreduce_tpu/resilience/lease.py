@@ -151,7 +151,22 @@ class LeaseBoard:
             return False
         hb = read_heartbeats(self.heartbeat_dir).get(int(st.get("owner",
                                                                 -1)))
-        return heartbeat_stale(hb, now, self.lease_ttl_s)
+        if heartbeat_stale(hb, now, self.lease_ttl_s):
+            return True
+        # the rank beats, but is it the CLAIMANT beating? A fresh pulse
+        # from a different process (a same-rank restart — the rejoined
+        # rank shadows its dead predecessor's heartbeat file) is no
+        # evidence the claimant lives; without this, a claim leaked by
+        # a killed rank is pinned un-stealable the moment its successor
+        # starts beating. A split-brain claimant that somehow still
+        # runs is fenced by the commit generation as usual.
+        pid, host = st.get("pid"), st.get("host")
+        if pid is not None and hb.get("pid") is not None:
+            if int(hb["pid"]) != int(pid):
+                return True
+            if host and hb.get("host") and hb["host"] != host:
+                return True
+        return False
 
     # -- writers -------------------------------------------------------------
     def claim(self, filename: str) -> Lease | None:

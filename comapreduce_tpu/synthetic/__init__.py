@@ -1,0 +1,41 @@
+"""Synthetic campaign engine: scenario-driven Level-1 generation, known
+signal/noise injection, transfer-function measurement, and a scale-drill
+load generator.
+
+The validation workloads the reference stack proves itself on (COMAP
+Early Science III transfer functions, arXiv 2111.05929; MAPPRAISER-style
+synthetic campaigns, arXiv 2112.03370) live here:
+
+``scenario``
+    Declarative ``[scenario]`` config (TOML) describing an N-file
+    campaign — shape jitter, scan geometry, weather drift, per-feed 1/f
+    noise with *known* (sigma, fknee, alpha), fault mix, injected sky —
+    fail-at-load on unknown sections/keys, deterministic by seed.
+``generator``
+    Turns a scenario into per-file ``SyntheticObsParams``, written to
+    disk or served in memory (same bytes either way).
+``memsource``
+    ``synth://`` virtual paths: a process-global scenario registry that
+    the ingest loaders consult, so 1000-file campaigns need no disk.
+``transfer``
+    Inject a known sky, run reduce -> destripe -> map, measure the
+    pipeline transfer function per (band, pixel-scale bin), and check
+    the quality ledger recovers the injected noise parameters.
+``loadgen``
+    The >=200-file scale drill: elastic scheduler + map server + tile
+    tier under publish pressure with mid-run rank kill/join
+    (``tools/check_resilience.py --synthetic-only``).
+
+See docs/OPERATIONS.md §18 for the runbook.
+"""
+
+from comapreduce_tpu.synthetic.scenario import ScenarioConfig, load_scenario
+from comapreduce_tpu.synthetic.generator import (campaign_params,
+                                                 campaign_truth,
+                                                 file_params,
+                                                 virtual_filelist,
+                                                 write_campaign)
+
+__all__ = ["ScenarioConfig", "load_scenario", "file_params",
+           "campaign_params", "campaign_truth", "virtual_filelist",
+           "write_campaign"]

@@ -1,0 +1,148 @@
+"""Scenario -> per-file observation parameters -> Level-1 files.
+
+One deterministic mapping, used by every consumer: the disk writer
+(:func:`write_campaign`), the in-memory ingest source
+(``synthetic/memsource.py``), the transfer-function workload and the
+scale drill all call :func:`file_params`, so a campaign's bytes are
+identical however it is materialised.
+
+Per-file variation is a pure function of ``(scenario, index)``:
+
+- obsid/MJD step linearly;
+- ``shape_jitter`` perturbs ``scan_samples`` on a fixed pseudo-random
+  lattice (``(index * 29) % 97`` — the bench's shape-bucket exercise),
+  so a jittered campaign covers many TOD shapes with a bounded bucket
+  census;
+- ``weather_drift`` ramps the zenith atmosphere linearly across the
+  campaign (file 0 coldest, file N-1 wettest);
+- the per-file RNG seed is ``seed * 1_000_003 + index`` — distinct
+  streams per file, reproducible forever.
+"""
+
+from __future__ import annotations
+
+import os
+
+from comapreduce_tpu.synthetic.scenario import ScenarioConfig
+
+__all__ = ["file_basename", "file_params", "campaign_params",
+           "campaign_truth", "virtual_filelist", "write_campaign",
+           "SCHEME"]
+
+# virtual-path scheme for in-memory campaigns (see memsource.py)
+SCHEME = "synth://"
+
+
+def _jitter(cfg: ScenarioConfig, index: int) -> int:
+    """Deterministic scan_samples jitter in [-shape_jitter, +shape_jitter]."""
+    if cfg.shape_jitter <= 0:
+        return 0
+    lattice = ((index * 29) % 97) - 48  # in [-48, 48]
+    return int(round(cfg.shape_jitter * lattice / 48.0))
+
+
+def file_basename(cfg: ScenarioConfig, index: int) -> str:
+    """Campaign-unique Level-1 basename (COMAP naming scheme)."""
+    return f"comap-{cfg.obsid_start + index:07d}-{cfg.name}.hd5"
+
+
+def file_params(cfg: ScenarioConfig, index: int):
+    """The ``SyntheticObsParams`` for file ``index`` of the scenario."""
+    from comapreduce_tpu.data.synthetic import SyntheticObsParams
+
+    if not 0 <= index < cfg.n_files:
+        raise IndexError(f"file index {index} outside scenario "
+                         f"[0, {cfg.n_files})")
+    frac = index / max(cfg.n_files - 1, 1)
+    scan_samples = max(cfg.scan_samples + _jitter(cfg, index), 0)
+    return SyntheticObsParams(
+        obsid=cfg.obsid_start + index,
+        source=cfg.source,
+        n_feeds=cfg.n_feeds,
+        n_bands=cfg.n_bands,
+        n_channels=cfg.n_channels,
+        n_scans=cfg.n_scans,
+        scan_samples=scan_samples,
+        vane_samples=cfg.vane_samples,
+        gap_samples=cfg.gap_samples,
+        mjd_start=cfg.mjd_start + index * cfg.mjd_step,
+        elevation=cfg.elevation,
+        el_sweep=cfg.el_sweep,
+        az_throw=cfg.az_throw,
+        ra0=cfg.ra0,
+        dec0=cfg.dec0,
+        t_atm_zenith=(cfg.t_atm_zenith
+                      + cfg.weather_drift * (frac - 0.5)),
+        sigma_g=cfg.sigma_g,
+        fknee=cfg.fknee,
+        alpha=cfg.alpha,
+        t_atm_sigma=cfg.t_atm_sigma,
+        t_atm_fknee=cfg.t_atm_fknee,
+        t_atm_alpha=cfg.t_atm_alpha,
+        spike_rate=cfg.spike_rate,
+        nan_rate=cfg.nan_rate,
+        sky_model=cfg.sky_model(),
+        seed=cfg.seed * 1_000_003 + index,
+        comment=f"scenario={cfg.name} index={index}",
+    )
+
+
+def campaign_params(cfg: ScenarioConfig) -> list:
+    """``file_params`` for every file of the scenario, in order."""
+    return [file_params(cfg, i) for i in range(cfg.n_files)]
+
+
+def campaign_truth(cfg: ScenarioConfig) -> dict:
+    """JSON-serialisable ground truth of the campaign: per-file identity
+    plus the injected noise/sky parameters recovery is checked against
+    (docs/OPERATIONS.md §18)."""
+    files = []
+    for i in range(cfg.n_files):
+        frac = i / max(cfg.n_files - 1, 1)
+        files.append({
+            "index": i,
+            "basename": file_basename(cfg, i),
+            "obsid": cfg.obsid_start + i,
+            "seed": cfg.seed * 1_000_003 + i,
+            "scan_samples": max(cfg.scan_samples + _jitter(cfg, i), 0),
+            "t_atm_zenith": cfg.t_atm_zenith
+            + cfg.weather_drift * (frac - 0.5),
+        })
+    return {
+        "scenario": cfg.name,
+        "seed": cfg.seed,
+        "n_files": cfg.n_files,
+        "noise": {"sigma_g": cfg.sigma_g, "fknee": cfg.fknee,
+                  "alpha": cfg.alpha,
+                  "t_atm_sigma": cfg.t_atm_sigma,
+                  "t_atm_fknee": cfg.t_atm_fknee,
+                  "t_atm_alpha": cfg.t_atm_alpha},
+        "faults": {"spike_rate": cfg.spike_rate, "nan_rate": cfg.nan_rate},
+        "sky": {"amplitude_k": cfg.sky_amplitude_k,
+                "fwhm_deg": cfg.sky_fwhm_deg, "index": cfg.sky_index,
+                "ra0": cfg.ra0, "dec0": cfg.dec0},
+        "files": files,
+    }
+
+
+def virtual_filelist(cfg: ScenarioConfig) -> list:
+    """``synth://`` paths for the whole campaign — serve them through
+    the ingest loaders with zero disk (``memsource.register_scenario``
+    first)."""
+    return [f"{SCHEME}{cfg.name}/{i:05d}/{file_basename(cfg, i)}"
+            for i in range(cfg.n_files)]
+
+
+def write_campaign(cfg: ScenarioConfig, out_dir: str,
+                   indices=None) -> list:
+    """Stream the campaign to ``out_dir`` as real Level-1 HDF5 files;
+    returns the written paths (same bytes as the in-memory source)."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for i in (range(cfg.n_files) if indices is None else indices):
+        from comapreduce_tpu.data.synthetic import generate_level1_file
+
+        path = os.path.join(out_dir, file_basename(cfg, i))
+        generate_level1_file(path, file_params(cfg, i))
+        paths.append(path)
+    return paths

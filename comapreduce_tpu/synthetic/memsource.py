@@ -1,0 +1,148 @@
+"""``synth://`` virtual paths: serve scenario files straight from memory.
+
+A 1000-file campaign should not need 1000 files of disk. Scenario
+members get virtual paths::
+
+    synth://<scenario-name>/<index>/<basename>
+
+A process-global registry maps scenario names to their configs;
+``ingest.loaders.load_level1`` consults :func:`is_virtual` /
+:func:`load_virtual` before touching the filesystem, so the whole
+pipeline — prefetcher, cache, retry net, Runner, scheduler — sees
+virtual members through the exact code path a disk file takes. Content
+is a pure function of the path (the determinism contract), which is
+what makes the cache key ``(path, 0)`` sound and lets every worker
+process regenerate identical bytes after re-registering the scenario
+(``register_scenario_file`` — subprocess workers pass the scenario TOML
+on their command line).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from comapreduce_tpu.synthetic.generator import SCHEME, file_basename
+from comapreduce_tpu.synthetic.scenario import ScenarioConfig
+
+__all__ = ["is_virtual", "parse_virtual", "register_scenario",
+           "register_scenario_file", "registered", "clear_registry",
+           "load_virtual", "probe_virtual", "virtual_store"]
+
+_LOCK = threading.Lock()
+_REGISTRY: dict = {}
+
+
+def is_virtual(path: str) -> bool:
+    """True for ``synth://`` scenario-member paths."""
+    return isinstance(path, str) and path.startswith(SCHEME)
+
+
+def register_scenario(cfg: ScenarioConfig) -> ScenarioConfig:
+    """Make ``cfg``'s members resolvable in this process; returns it.
+
+    Re-registering the same name with an identical config is a no-op;
+    a *different* config under the same name raises — two scenarios
+    sharing a name would make path -> bytes ambiguous.
+    """
+    cfg = ScenarioConfig.coerce(cfg)
+    with _LOCK:
+        held = _REGISTRY.get(cfg.name)
+        if held is not None and held != cfg:
+            raise ValueError(
+                f"scenario {cfg.name!r} already registered with a "
+                "different config")
+        _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+def register_scenario_file(path: str) -> ScenarioConfig:
+    """Load + register a scenario TOML (subprocess worker entry)."""
+    from comapreduce_tpu.synthetic.scenario import load_scenario
+
+    return register_scenario(load_scenario(path))
+
+
+def registered(name: str) -> ScenarioConfig | None:
+    with _LOCK:
+        return _REGISTRY.get(name)
+
+
+def clear_registry() -> None:
+    """Drop all registrations (test isolation)."""
+    with _LOCK:
+        _REGISTRY.clear()
+
+
+def parse_virtual(path: str) -> tuple:
+    """``synth://name/00042/basename.hd5 -> (config, 42)``.
+
+    Raises ``FileNotFoundError`` (the error class a missing disk file
+    would produce, so the per-file fault net triages it identically)
+    when the scenario is unregistered or the member is out of range or
+    misnamed.
+    """
+    if not is_virtual(path):
+        raise ValueError(f"not a synth:// path: {path}")
+    parts = path[len(SCHEME):].split("/")
+    if len(parts) != 3:
+        raise FileNotFoundError(
+            f"malformed virtual path (want synth://name/index/file): "
+            f"{path}")
+    name, idx_s, base = parts
+    cfg = registered(name)
+    if cfg is None:
+        raise FileNotFoundError(
+            f"scenario {name!r} not registered in this process "
+            f"(synthetic.memsource.register_scenario): {path}")
+    try:
+        index = int(idx_s)
+    except ValueError:
+        raise FileNotFoundError(f"bad member index in {path}") from None
+    if not 0 <= index < cfg.n_files or base != file_basename(cfg, index):
+        raise FileNotFoundError(f"no such scenario member: {path}")
+    return cfg, index
+
+
+def virtual_store(path: str):
+    """Generate the member's Level-1 content: ``(params, HDF5Store)``."""
+    from comapreduce_tpu.synthetic.generator import file_params
+    from comapreduce_tpu.data.synthetic import generate_level1_store
+
+    cfg, index = parse_virtual(path)
+    return generate_level1_store(file_params(cfg, index))
+
+
+def load_virtual(path: str):
+    """The member as a :class:`COMAPLevel1` (fully materialised — there
+    is no file handle to keep lazy)."""
+    from comapreduce_tpu.data.level import COMAPLevel1
+
+    _, store = virtual_store(path)
+    payload = store.export_payload()
+    payload["source"] = path
+    data = COMAPLevel1()
+    data.adopt_payload(payload)
+    return data
+
+
+def probe_virtual(path: str, pad_to: int = 128) -> dict:
+    """Shape metadata for campaign warm-up (``probe_observation``
+    parity) WITHOUT generating the TOD: pure arithmetic on the scenario.
+
+    ``L`` is the scan length padded as ``ops.reduce.scan_starts_lengths``
+    pads it; the feature-derived edges the pipeline later recovers may
+    trim a sample or two, but ``ShapeBuckets.canonical`` collapses that
+    to the same bucket (a mismatch costs one extra compile, never an
+    error)."""
+    from comapreduce_tpu.data.level import CALIBRATOR_NAMES
+    from comapreduce_tpu.synthetic.generator import file_params
+
+    cfg, index = parse_virtual(path)
+    p = file_params(cfg, index)
+    L = p.scan_samples if p.n_scans and p.scan_samples else pad_to
+    L = -(-L // pad_to) * pad_to
+    return {
+        "F": p.n_feeds, "B": p.n_bands, "C": p.n_channels,
+        "T": p.n_samples, "S": p.n_scans, "L": int(L),
+        "calibrator": p.source in CALIBRATOR_NAMES,
+    }

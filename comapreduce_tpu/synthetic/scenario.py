@@ -1,0 +1,190 @@
+"""Scenario configuration: the declarative ``[scenario]`` campaign table.
+
+A scenario file is a TOML document with exactly one table::
+
+    [scenario]
+    name = "smoke"
+    n_files = 8
+    seed = 0
+    t_atm_sigma = 0.02      # additive 1/f, known (sigma, fknee, alpha)
+    sky_amplitude_k = 0.5   # injected Gaussian sky, known truth
+    ...
+
+Loading is strict both ways: unknown *sections* (a stray ``[Destriper]``
+pasted from a pipeline config) and unknown *keys* inside ``[scenario]``
+raise ``ValueError`` at load, never at file 738 of a campaign. The knob
+names live once, in :attr:`ScenarioConfig.KNOBS`, following the
+``IngestConfig`` idiom so the coercion rules cannot drift between entry
+points (CLI, bench, drill, tests).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["ScenarioConfig", "load_scenario"]
+
+
+@dataclass(frozen=True)
+class ScenarioConfig:
+    """Knobs for one synthetic campaign.
+
+    Geometry/shape knobs mirror ``SyntheticObsParams`` (they are per-file
+    seeds for it); campaign-level knobs add file count, shape jitter,
+    weather drift across the campaign, and the injected-sky description.
+
+    Determinism contract: every byte of every generated file is a pure
+    function of ``(the scenario, file index)``. Same scenario => byte
+    identical Level-1 files, whether streamed to disk or served from
+    memory, on any host (docs/OPERATIONS.md §18).
+    """
+
+    name: str = "scenario"
+    n_files: int = 8
+    seed: int = 0
+    obsid_start: int = 9_000_001
+    source: str = "co2"
+    # per-file observation shape (SyntheticObsParams units)
+    n_feeds: int = 2
+    n_bands: int = 1
+    n_channels: int = 16
+    n_scans: int = 3
+    scan_samples: int = 400
+    vane_samples: int = 120
+    gap_samples: int = 40
+    # +- peak scan_samples jitter across files (deterministic triangle
+    # wave in the file index — exercises shape-bucket reuse, see §9)
+    shape_jitter: int = 0
+    mjd_start: float = 59620.0
+    mjd_step: float = 0.02          # days between file starts
+    # scan geometry
+    elevation: float = 55.0
+    el_sweep: float = 0.0
+    az_throw: float = 4.0
+    ra0: float = 170.25
+    dec0: float = 52.25
+    # weather: zenith atmosphere ramps linearly across the campaign by
+    # +- weather_drift/2 around t_atm_zenith
+    t_atm_zenith: float = 10.0
+    weather_drift: float = 0.0
+    # per-feed 1/f gain fluctuations with known parameters
+    sigma_g: float = 5.0e-4
+    fknee: float = 1.0
+    alpha: float = 1.5
+    # additive per-feed atmospheric 1/f (the injection the quality
+    # ledger's noise fits must recover — survives gain correction)
+    t_atm_sigma: float = 0.0
+    t_atm_fknee: float = 0.1
+    t_atm_alpha: float = 1.5
+    # fault mix (fraction of scan cells)
+    spike_rate: float = 0.0
+    nan_rate: float = 0.0
+    # injected sky: a GaussianComponent SkyModel at (ra0, dec0) with an
+    # optional power-law spectral index across bands
+    sky_amplitude_k: float = 0.0
+    sky_fwhm_deg: float = 0.45
+    sky_index: float = 0.0
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", str(self.name or "scenario"))
+        object.__setattr__(self, "source", str(self.source or "co2"))
+        for key in ("n_files", "seed", "obsid_start", "n_feeds", "n_bands",
+                    "n_channels", "n_scans", "scan_samples", "vane_samples",
+                    "gap_samples", "shape_jitter"):
+            object.__setattr__(self, key, int(getattr(self, key) or 0))
+        for key in ("mjd_start", "mjd_step", "elevation", "el_sweep",
+                    "az_throw", "ra0", "dec0", "t_atm_zenith",
+                    "weather_drift", "sigma_g", "fknee", "alpha",
+                    "t_atm_sigma", "t_atm_fknee", "t_atm_alpha",
+                    "spike_rate", "nan_rate", "sky_amplitude_k",
+                    "sky_fwhm_deg", "sky_index"):
+            object.__setattr__(self, key, float(getattr(self, key) or 0.0))
+        if self.n_files < 1:
+            raise ValueError(f"scenario needs n_files >= 1, got "
+                             f"{self.n_files}")
+        if self.n_feeds < 1 or self.n_bands < 1 or self.n_channels < 1:
+            raise ValueError("scenario needs n_feeds/n_bands/n_channels "
+                             ">= 1")
+        if self.scan_samples < 0 or self.n_scans < 0:
+            raise ValueError("scenario scan_samples/n_scans must be >= 0")
+
+    KNOBS = ("name", "n_files", "seed", "obsid_start", "source",
+             "n_feeds", "n_bands", "n_channels", "n_scans", "scan_samples",
+             "vane_samples", "gap_samples", "shape_jitter",
+             "mjd_start", "mjd_step",
+             "elevation", "el_sweep", "az_throw", "ra0", "dec0",
+             "t_atm_zenith", "weather_drift",
+             "sigma_g", "fknee", "alpha",
+             "t_atm_sigma", "t_atm_fknee", "t_atm_alpha",
+             "spike_rate", "nan_rate",
+             "sky_amplitude_k", "sky_fwhm_deg", "sky_index")
+
+    @classmethod
+    def from_mapping(cls, mapping) -> "ScenarioConfig":
+        """Pick the scenario knobs out of a wider mapping, ignoring
+        unrelated keys (for embedding in a pipeline TOML)."""
+        return cls(**{k: mapping[k] for k in cls.KNOBS if k in mapping})
+
+    @classmethod
+    def coerce(cls, value) -> "ScenarioConfig":
+        """Build from None / dict / ScenarioConfig; unknown keys raise."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            known = {k: value[k] for k in cls.KNOBS if k in value}
+            unknown = set(value) - set(known)
+            if unknown:
+                raise ValueError(
+                    f"unknown scenario keys: {sorted(unknown)}")
+            return cls(**known)
+        raise TypeError(f"cannot build ScenarioConfig from {type(value)}")
+
+    def sky_model(self):
+        """The injected-sky ``SkyModel`` (None when no sky is injected)."""
+        if self.sky_amplitude_k <= 0:
+            return None
+        from comapreduce_tpu.simulations import (GaussianComponent,
+                                                 SkyModel, power_law)
+
+        law = None
+        if self.sky_index:
+            index = self.sky_index
+
+            def law(freq_ghz, _index=index):
+                return power_law(freq_ghz, freq0_ghz=30.0, index=_index)
+
+        comp = (GaussianComponent(self.ra0, self.dec0, self.sky_amplitude_k,
+                                  self.sky_fwhm_deg, freq_law=law)
+                if law is not None else
+                GaussianComponent(self.ra0, self.dec0, self.sky_amplitude_k,
+                                  self.sky_fwhm_deg))
+        return SkyModel([comp])
+
+
+def load_scenario(path: str) -> ScenarioConfig:
+    """Parse a scenario TOML file, strictly.
+
+    The document must contain a ``[scenario]`` table; any *other*
+    top-level section (``[Destriper]``, ``[Global]``, ...) and any
+    unknown key inside ``[scenario]`` is a ``ValueError`` — a typo'd
+    campaign config fails at load, not 20 minutes into generation.
+    """
+    from comapreduce_tpu.pipeline.config import load_toml
+
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"scenario file not found: {path}")
+    doc = load_toml(path)
+    if "scenario" not in doc:
+        raise ValueError(f"{path}: missing required [scenario] section")
+    extra_sections = sorted(set(doc) - {"scenario"})
+    if extra_sections:
+        raise ValueError(
+            f"{path}: unknown sections {extra_sections} — a scenario "
+            f"file holds exactly one [scenario] table")
+    try:
+        return ScenarioConfig.coerce(dict(doc["scenario"]))
+    except (TypeError, ValueError) as exc:
+        raise ValueError(f"{path}: {exc}") from None

@@ -1,0 +1,363 @@
+"""Transfer-function workload: injected sky through the REAL pipeline.
+
+The scenario describes a campaign with a known Gaussian sky and known
+1/f noise. This module generates the campaign *without* the sky,
+injects the sky into the written Level-1 files through
+``simulations.skymodel.inject_level1`` (the production injection path,
+using the generator's truth gains), reduces the files with the
+standard stage chain (``Runner``), destripes and maps each band
+(``read_comap_data`` + ``solve_band`` — the same read/solve the drill
+and the map server use), and compares the recovered map against the
+injected truth per (band, radial-k bin):
+
+    T_b(k) = sum_k Re[conj(F{truth}) F{recovered}] / sum_k |F{truth}|^2
+
+Two closures come out of one run:
+
+- the **map transfer function** per band — how much injected sky the
+  reduce + destripe chain returns at each angular scale (the medfilt
+  high-pass and the offset subtraction both eat large scales, and the
+  artifact quantifies exactly how much);
+- the **quality-ledger noise closure** — the ledgered (white_sigma,
+  fknee, alpha) must agree with what the scenario's known
+  ``(t_atm_sigma, t_atm_fknee, t_atm_alpha)`` predict for the
+  band-averaged TOD. The atmospheric stream is common-mode across a
+  band's channels, so band averaging leaves sigma_atm intact while the
+  radiometer white level drops by sqrt(C); the knee the ledger's fit
+  sees is the *effective* knee of white + atm:
+
+      fknee_eff = t_atm_fknee * (sigma_atm^2 / white_sigma_fit^2)^(1/alpha)
+
+Everything is deterministic in the scenario seed — ``check_transfer``
+gates on physics ratios, never on wall time, so the gate is
+machine-independent (tools/check_perf.py --transfer-gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import json
+import logging
+import os
+
+import numpy as np
+
+from comapreduce_tpu.synthetic.generator import file_basename, file_params
+from comapreduce_tpu.synthetic.scenario import ScenarioConfig
+
+__all__ = ["TRANSFER_SCENARIO", "transfer_scenario", "run_transfer",
+           "check_transfer", "transfer_curve"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+# The gate scenario: small enough for CI (two files, one band, ~3k
+# samples each), hot enough that every closure has signal. C=64 keeps
+# the gain-fluctuation solve conditioned (at C=16 the two gain
+# templates are nearly degenerate over ~14 usable channels and the
+# solve's white amplification swamps everything); the atm 1/f at
+# 0.08 K dominates the band-averaged radiometer noise (~0.006 K), so
+# the ledger's knee fit recovers (t_atm_sigma, fknee, alpha) directly.
+# The 0.5 K / 0.12 deg sky is compact against the medfilt high-pass
+# (a 3 s window crosses the source in ~0.5 s of az sweep).
+TRANSFER_SCENARIO = dict(
+    name="transfer",
+    source="TauA",           # calibrator path: median removal, no gain
+                             # solve — a bright injected source would be
+                             # partially absorbed by the (deliberately
+                             # ill-conditioned) field gain estimator,
+                             # exactly why the reference routes bright
+                             # sources through the calibrator chain
+    n_files=2,
+    n_feeds=2,
+    n_bands=1,
+    n_channels=64,
+    n_scans=4,
+    scan_samples=600,
+    vane_samples=120,
+    gap_samples=40,
+    az_throw=0.25,           # keeps the RA sweep inside the 64' field
+    t_atm_sigma=0.08,        # K; dominates band-avg white -> clean knee
+    t_atm_fknee=2.0,
+    t_atm_alpha=1.5,
+    sky_amplitude_k=0.5,
+    sky_fwhm_deg=0.12,       # ~7 px: truth power spans the low-k bins
+    sky_index=0.0,
+)
+
+MAP_SHAPE = (64, 64)         # 64' x 64' at 1'/px, centred on (ra0, dec0)
+CDELT = (1.0 / 60.0, 1.0 / 60.0)
+
+
+def transfer_scenario(seed: int = 0, **overrides) -> ScenarioConfig:
+    """The gate scenario at ``seed`` (overrides must be known knobs)."""
+    knobs = dict(TRANSFER_SCENARIO)
+    knobs.update(overrides)
+    knobs["seed"] = int(seed)
+    return ScenarioConfig.coerce(knobs)
+
+
+def _reduce_config(out_dir: str) -> dict:
+    """The standard reduce chain (examples/configs/configuration.toml)
+    sized for the gate scenario's 600-sample scans; single-rank static
+    shard (no lease files — the scale drill owns the elastic path)."""
+    return {
+        "Global": {
+            "processes": ["CheckLevel1File", "AssignLevel1Data",
+                          "MeasureSystemTemperature", "AtmosphereRemoval",
+                          "Level1AveragingGainCorrection", "Spikes",
+                          "Level2FitPowerSpectrum", "NoiseStatistics"],
+            "output_dir": out_dir,
+            "log_dir": os.path.join(out_dir, "logs"),
+        },
+        "CheckLevel1File": {"min_duration_seconds": 30.0},
+        # medfilt_window clamps to the scan length (600): the high-pass
+        # removes only the slowest per-scan structure, so the injected
+        # sky and the atm 1/f both reach the fits and the destriper
+        "Level1AveragingGainCorrection": {"feed_batch": 2},
+        "Spikes": {"window": 101, "pad": 10},
+        "Level2FitPowerSpectrum": {"nbins": 12},
+        "NoiseStatistics": {"nbins": 12},
+        "resilience": {"lease_ttl_s": 0},
+    }
+
+
+def transfer_curve(truth, recovered, n_bins: int = 6):
+    """Radial-k transfer bins between two maps on the same grid.
+
+    Pixels the pipeline never hit (NaN in ``recovered``) are excluded
+    from BOTH maps (mean removed over the common hit set, unhit set to
+    zero) so coverage gaps bias truth and recovery identically. Returns
+    ``(k_centres, transfer, n_modes)`` with k in cycles/pixel.
+    """
+    truth = np.asarray(truth, np.float64)
+    recovered = np.asarray(recovered, np.float64)
+    if truth.shape != recovered.shape or truth.ndim != 2:
+        raise ValueError(f"map shape mismatch: {truth.shape} vs "
+                         f"{recovered.shape}")
+    hit = np.isfinite(recovered)
+    if not hit.any():
+        raise ValueError("recovered map has no hit pixels")
+    t = np.where(hit, truth - truth[hit].mean(), 0.0)
+    r = np.where(hit, recovered - recovered[hit].mean(), 0.0)
+    tf = np.fft.fft2(t)
+    rf = np.fft.fft2(r)
+    ky = np.fft.fftfreq(truth.shape[0])[:, None]
+    kx = np.fft.fftfreq(truth.shape[1])[None, :]
+    k = np.hypot(ky, kx)
+    cross = (np.conj(tf) * rf).real
+    auto = (tf.real ** 2 + tf.imag ** 2)
+    edges = np.linspace(0.0, 0.5, n_bins + 1)
+    centres, transfer, n_modes = [], [], []
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        sel = (k >= lo) & (k < hi) & (k > 0)
+        centres.append(0.5 * (lo + hi))
+        n_modes.append(int(sel.sum()))
+        denom = float(auto[sel].sum()) if sel.any() else 0.0
+        transfer.append(float(cross[sel].sum() / denom)
+                        if denom > 0 else float("nan"))
+    return np.asarray(centres), np.asarray(transfer), np.asarray(n_modes)
+
+
+def _truth_map(model, wcs, freq_ghz: float) -> np.ndarray:
+    """The injected sky evaluated on the map grid at one frequency."""
+    lon, lat = wcs.pixel_centers()
+    vals = np.asarray(model(lon, lat, np.asarray([float(freq_ghz)])))
+    return vals[..., 0] if vals.ndim == 3 else vals
+
+
+def _quality_closure(state_dir: str, cfg: ScenarioConfig,
+                     file_base: str | None = None) -> dict:
+    """Ledgered noise fits vs the scenario's known injection.
+
+    ``file_base`` restricts the closure to one Level-1 file — the
+    blind noise-reference file, whose fits see only the scenario's
+    known noise (the injected source adds sweep-synchronous power
+    that would bias the knee on the injected files).
+    """
+    from comapreduce_tpu.telemetry.quality import read_quality
+
+    records = read_quality(state_dir)
+    if file_base is not None:
+        records = [r for r in records
+                   if os.path.basename(str(r.get("file", ""))) == file_base]
+    alphas = [r["alpha"] for r in records
+              if r.get("alpha") is not None]
+    fknees = [r["fknee_hz"] for r in records
+              if r.get("fknee_hz") is not None]
+    whites = [r["white_sigma"] for r in records
+              if r.get("white_sigma") is not None]
+    out = {"n_records": len(records),
+           "n_fitted": len(alphas),
+           "alpha_expected": -cfg.t_atm_alpha,
+           "alpha_median": (float(np.median(alphas)) if alphas else None),
+           "white_sigma_median": (float(np.median(whites))
+                                  if whites else None),
+           "fknee_median": (float(np.median(fknees)) if fknees else None),
+           "fknee_expected": None}
+    if whites and cfg.t_atm_sigma > 0 and cfg.t_atm_alpha > 0:
+        # knee fit of white + atm: sig2_fit = sig_w^2 + sig_atm^2, and
+        # the effective knee satisfies
+        # sig_atm^2 (fk/f)^a = sig2_fit (fk_eff/f)^a
+        w2 = float(np.median(whites)) ** 2
+        ratio = min(cfg.t_atm_sigma ** 2 / w2, 1.0)
+        out["fknee_expected"] = float(
+            cfg.t_atm_fknee * ratio ** (1.0 / cfg.t_atm_alpha))
+    return out
+
+
+def run_transfer(workdir: str, seed: int = 0, n_bins: int = 6,
+                 overrides: dict | None = None) -> dict:
+    """Generate -> inject -> reduce -> destripe -> compare; returns the
+    transfer artifact (also written to ``<workdir>/transfer.json``).
+
+    The campaign is generated with ``sky_amplitude_k = 0`` and the sky
+    is injected afterwards through ``skymodel.inject_level1`` with the
+    generator's truth gains — the production injection path, so the
+    artifact measures the pipeline, not a generator shortcut.
+    """
+    from comapreduce_tpu.cli.run_destriper import solve_band
+    from comapreduce_tpu.data.synthetic import generate_level1_file
+    from comapreduce_tpu.mapmaking.leveldata import read_comap_data
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.pipeline.runner import Runner
+    from comapreduce_tpu.simulations.skymodel import inject_level1
+
+    cfg = transfer_scenario(seed, **(overrides or {}))
+    model = cfg.sky_model()
+    if model is None:
+        raise ValueError("transfer scenario needs sky_amplitude_k > 0")
+    blind = dataclasses.replace(cfg, sky_amplitude_k=0.0)
+
+    level1_dir = os.path.join(workdir, "level1")
+    out_dir = os.path.join(workdir, "level2")
+    os.makedirs(level1_dir, exist_ok=True)
+
+    # -- generate (no sky) then inject (production path, truth gains) --
+    files = []
+    for i in range(cfg.n_files):
+        path = os.path.join(level1_dir, file_basename(cfg, i))
+        p = generate_level1_file(path, file_params(blind, i))
+        inject_level1(path, model, gain_estimate=p.truth["gain"])
+        files.append(path)
+    # one extra BLIND file: the noise reference for the ledger closure
+    # (on the injected files the source's sweep-synchronous power
+    # inflates the fitted knee — a physics effect, not a pipeline bug)
+    ref_cfg = dataclasses.replace(blind, n_files=cfg.n_files + 1)
+    ref_base = file_basename(ref_cfg, cfg.n_files)
+    ref_path = os.path.join(level1_dir, ref_base)
+    generate_level1_file(ref_path, file_params(ref_cfg, cfg.n_files))
+
+    # -- reduce with the standard chain ---------------------------------
+    runner = Runner.from_config(_reduce_config(out_dir))
+    runner.run_tod(files + [ref_path])
+
+    all_l2 = sorted(_glob.glob(
+        os.path.join(out_dir, f"{runner.prefix}_*.hd5")))
+    if len(all_l2) != len(files) + 1:
+        raise RuntimeError(f"reduce produced {len(all_l2)} Level-2 "
+                           f"files for {len(files) + 1} inputs")
+    # the map uses only the injected files (matched by obsid)
+    obsids = [f"{cfg.obsid_start + i:07d}" for i in range(cfg.n_files)]
+    l2files = [p for p in all_l2
+               if any(o in os.path.basename(p) for o in obsids)]
+    if len(l2files) != len(files):
+        raise RuntimeError(f"could not match Level-2 outputs to the "
+                           f"{len(files)} injected files: {all_l2}")
+
+    # -- destripe + map each band, compare to the injected truth --------
+    wcs = WCS.from_field((cfg.ra0, cfg.dec0), CDELT, MAP_SHAPE)
+    from comapreduce_tpu.data.synthetic import _band_frequencies
+
+    nu_c = _band_frequencies(cfg.n_bands, cfg.n_channels).mean(axis=1)
+    bands = []
+    for band in range(cfg.n_bands):
+        data = read_comap_data(l2files, band=band, wcs=wcs,
+                               offset_length=50, medfilt_window=401,
+                               use_calibration=False)
+        result = solve_band(data, offset_length=50, n_iter=100,
+                            threshold=1e-6)
+        recovered = np.asarray(result.destriped_map,
+                               np.float64).reshape(MAP_SHAPE)
+        hits = np.asarray(result.hit_map, np.float64).reshape(MAP_SHAPE)
+        recovered = np.where(hits > 0, recovered, np.nan)
+        truth = _truth_map(model, wcs, nu_c[band])
+        k, tr, n_modes = transfer_curve(truth, recovered, n_bins=n_bins)
+        hit = np.isfinite(recovered)
+        # map gain: least-squares coefficient of truth in the recovered
+        # map over the hit pixels (both mean-subtracted). A single
+        # scale-free scalar — the map-domain analogue of the k=0+
+        # transfer bin, robust to the source filling the field
+        t_c = truth[hit] - truth[hit].mean()
+        r_c = recovered[hit] - recovered[hit].mean()
+        denom = float((t_c * t_c).sum())
+        map_gain = (float((t_c * r_c).sum() / denom)
+                    if denom > 0 else None)
+        bands.append({
+            "band": band,
+            "freq_ghz": float(nu_c[band]),
+            "k_bins": [float(v) for v in k],
+            "transfer": [float(v) for v in tr],
+            "n_modes": [int(v) for v in n_modes],
+            "hit_fraction": float(hit.mean()),
+            "map_gain": map_gain,
+        })
+
+    artifact = {
+        "schema": 1,
+        "scenario": cfg.name,
+        "seed": int(seed),
+        "n_files": cfg.n_files,
+        "sky": {"amplitude_k": cfg.sky_amplitude_k,
+                "fwhm_deg": cfg.sky_fwhm_deg, "index": cfg.sky_index},
+        "bands": bands,
+        "quality": _quality_closure(runner.state_dir, cfg, ref_base),
+    }
+    path = os.path.join(workdir, "transfer.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+    artifact["artifact_path"] = path
+    return artifact
+
+
+def check_transfer(artifact: dict) -> None:
+    """Machine-independent closure gate over one transfer artifact.
+
+    Raises ``AssertionError`` with a named criterion. Thresholds are
+    physics ratios calibrated on seeds 0-4 of the gate scenario (see
+    docs/OPERATIONS.md §18) with ~2x headroom over the observed
+    scatter — loose enough to survive BLAS/FFT differences across
+    hosts, tight enough that a broken stage (lost gain correction,
+    destriper regression, ledger drift) fails immediately.
+    """
+    bands = artifact.get("bands") or []
+    assert bands, "transfer: no bands in artifact"
+    for b in bands:
+        tr = np.asarray(b["transfer"], np.float64)
+        assert np.isfinite(tr).all(), \
+            f"transfer: non-finite transfer bins (band {b['band']}): {tr}"
+        # the sky is beam-scale (FWHM ~7 px): the truth's power lives in
+        # the first two k bins; higher bins divide noise by ~zero truth
+        # power, so only the signal-carrying bins are gated.
+        low = tr[:2]
+        assert low.min() > 0.30, \
+            f"transfer: low-k transfer collapsed (band {b['band']}): {tr}"
+        assert low.max() < 1.30, \
+            f"transfer: low-k transfer > 1.3 — injected power " \
+            f"amplified (band {b['band']}): {tr}"
+        assert b["hit_fraction"] > 0.10, \
+            f"transfer: map coverage {b['hit_fraction']:.3f} too small"
+        g = b["map_gain"]
+        assert g is not None and 0.45 < g < 1.30, \
+            f"transfer: map gain {g} outside [0.45, 1.30]"
+    q = artifact.get("quality") or {}
+    assert q.get("n_fitted", 0) > 0, \
+        "transfer: quality ledger has no noise fits"
+    a_med, a_exp = q.get("alpha_median"), q.get("alpha_expected")
+    assert a_med is not None and abs(a_med - a_exp) < 0.7, \
+        f"transfer: ledger alpha {a_med} != expected {a_exp} +- 0.7"
+    fk_med, fk_exp = q.get("fknee_median"), q.get("fknee_expected")
+    assert fk_med is not None and fk_exp is not None \
+        and 0.4 < fk_med / fk_exp < 2.5, \
+        f"transfer: ledger fknee {fk_med} vs expected {fk_exp} " \
+        f"outside [0.4, 2.5]x"

@@ -41,6 +41,7 @@ filesystem.
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import logging
 import math
@@ -81,10 +82,16 @@ class LiveTail:
     accumulated state. Not thread-safe by itself — the server
     serialises polls under a lock."""
 
-    #: stream-identity fingerprint length: the first bytes of a stream
-    #: start its meta anchor (pid + wall0/mono0 differ per writer), so
-    #: a replaced file is distinguishable from a grown one
-    HEAD_BYTES = 64
+    #: stream-identity fingerprint window: sha1 over the first bytes of
+    #: a stream (its meta anchor — pid + wall0/mono0 differ per writer
+    #: — plus the first real events), so a replaced file is
+    #: distinguishable from a grown one. 4 KiB (not the old 64-byte raw
+    #: prefix, PR 15's documented blind spot: a same-size rewrite
+    #: differing only past byte 64 read as no-change) — combined with
+    #: the mtime_ns + size tiebreak this catches any rewrite that
+    #: touches the first page, while a metadata-only touch (equal
+    #: content, new mtime) keeps its offset
+    HEAD_BYTES = 4096
 
     def __init__(self, log_dir: str):
         self.log_dir = log_dir or "."
@@ -118,7 +125,7 @@ class LiveTail:
         if state is None:
             state = self._files[path] = {
                 "offset": 0, "rank": int(m.group(1)) if m else 0,
-                "align": 0.0, "mtime": -1, "head": b""}
+                "align": 0.0, "mtime": -1, "head": None}
         try:
             st = os.stat(path)
         except OSError:
@@ -130,13 +137,13 @@ class LiveTail:
             # a stream REPLACED at equal-or-larger size passes the size
             # checks (the equal-size rewrite was PR 14's documented
             # blind spot): when the mtime moved, re-verify the stream's
-            # identity by its first-bytes fingerprint and restart from
+            # identity by its head-hash fingerprint and restart from
             # byte 0 on a mismatch — re-absorbing accumulates counters,
             # exactly the shrink case's semantics. A plain append (or a
             # metadata-only touch) keeps the fingerprint and the offset.
-            head = self._head(path)
-            if not state["head"] \
-                    or head[:len(state["head"])] != state["head"]:
+            if state["head"] is None \
+                    or self._fingerprint(path,
+                                         state["head"][0]) != state["head"]:
                 state["offset"] = 0
         if size == state["offset"]:
             state["mtime"] = st.st_mtime_ns
@@ -156,7 +163,8 @@ class LiveTail:
             return 0
         state["offset"] += cut + 1
         if started_at_zero:
-            state["head"] = chunk[:self.HEAD_BYTES]
+            head = chunk[:self.HEAD_BYTES]
+            state["head"] = (len(head), hashlib.sha1(head).hexdigest())
         state["mtime"] = st.st_mtime_ns
         n = 0
         for line in chunk[:cut].split(b"\n"):
@@ -175,12 +183,16 @@ class LiveTail:
         self.events_consumed += n
         return n
 
-    def _head(self, path: str) -> bytes:
+    def _fingerprint(self, path: str, length: int):
+        """(length, sha1) over the file's first ``length`` bytes —
+        compared against the fingerprint captured when the stream was
+        first consumed; None (unreadable) never matches."""
         try:
             with open(path, "rb") as f:
-                return f.read(self.HEAD_BYTES)
+                head = f.read(length)
         except OSError:
-            return b""
+            return None
+        return (len(head), hashlib.sha1(head).hexdigest())
 
     def _absorb(self, ev: dict, state: dict) -> None:
         kind = ev.get("kind")
@@ -546,6 +558,13 @@ class _Handler(BaseHTTPRequestHandler):
             status, ctype = 500, _JSON
             body = json.dumps({"error": f"internal: {exc}"}) \
                 .encode("utf-8") + b"\n"
+        # account BEFORE writing the response (but after rendering, so
+        # a scrape never includes itself): each connection gets its own
+        # handler thread, so a client that has read response N can race
+        # a post-write account line and scrape N+1 without N's request
+        # in it. The measured duration excludes the socket write — the
+        # histogram prices rendering, which is the part we own.
+        app._account(route, status, time.monotonic() - t0)
         try:
             self.send_response(status)
             self.send_header("Content-Type", ctype)
@@ -556,4 +575,3 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
             pass  # reader hung up mid-write; nothing to do
-        app._account(route, status, time.monotonic() - t0)

@@ -76,10 +76,12 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     """The perf gate (tools/check_perf.py, wired next to
     check_resilience.py): --update writes the reference; a matching run
     passes; a >tolerance samples/s drop or ANY dispatch_count increase
-    fails; a missing reference is its own exit code. The bench child is
-    canned here — the real quick-shape run is covered by
-    test_bench_small_emits_json_line and the committed
-    evidence/perf_quick_<platform>.json."""
+    fails; a missing reference is its own exit code. EVERY child is
+    canned here — this test owns the gate logic; the real quick-shape
+    run is covered by test_bench_small_emits_json_line and the
+    committed evidence/perf_quick_<platform>.json, and the live
+    serving/tiles/quality/transfer fixtures by the CI drills and their
+    own suites."""
     import importlib.util
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -155,6 +157,32 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
             "max_nonfinite_fraction": 0.1, "masked_threshold": 0.01}
     monkeypatch.setattr(cp, "run_quality_gate",
                         lambda: json.loads(json.dumps(qual)))
+    tfer = {"0": {"map_gain": [0.81], "low_k_transfer": [[0.80, 0.85]],
+                  "alpha_median": -1.43, "fknee_ratio": 0.99}}
+    tfer_fails = []
+    monkeypatch.setattr(
+        cp, "run_transfer_gate",
+        lambda seeds: (json.loads(json.dumps(tfer)), list(tfer_fails)))
+    # the serving and tiles children are canned too — this test owns
+    # the GATE logic; their real fixtures run in the CI drills
+    # (check_resilience --serving-only / --tiles-only) and their own
+    # tier-1 suites, and ~35 cp.main() calls below would otherwise pay
+    # for a live destriper warm-start + tile build each
+    serv = {"metric": "serving_warm_iters", "value": 40.0,
+            "detail": {"warm_iters": 40, "cold_iters": 60,
+                       "cold_x0": "cold", "waves": 2,
+                       "epochs": [{"x0": "cold"}, {"x0": "warm"}]}}
+    til = {"wcs": {"delta_changed": 1, "n_tiles": 9,
+                   "delta_bytes": 1200, "total_bytes": 11000,
+                   "delta_manifest_bytes": 300,
+                   "full_manifest_bytes": 2100},
+           "healpix": {"n_tiles": 7, "n_expected": 7,
+                       "total_bytes": 9000, "budget_bytes": 10000,
+                       "n_compact": 768}}
+    monkeypatch.setattr(cp, "run_serving_bench",
+                        lambda: json.loads(json.dumps(serv)))
+    monkeypatch.setattr(cp, "run_tiles_gate",
+                        lambda: json.loads(json.dumps(til)))
     # keep the run-registry appends out of the repo's real evidence/
     monkeypatch.setenv("COMAP_RUNS_REGISTRY",
                        str(tmp_path / "runs.jsonl"))
@@ -196,6 +224,28 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     dstr["detail"]["preconditioners"]["multigrid"]["iters_to_tol"] = None
     assert cp.main(["--reps", "1"]) == 1
     dstr["detail"]["preconditioners"]["multigrid"]["iters_to_tol"] = 58
+    assert cp.main(["--reps", "1"]) == 0
+    # the serving warm-start gate (ISSUE 8): warm epoch iterations not
+    # strictly below the cold solve fail, as does a final epoch that
+    # never warm-started; --no-serving skips
+    serv["detail"]["warm_iters"] = 60
+    assert cp.main(["--reps", "1"]) == 1
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    serv["detail"]["warm_iters"] = 40
+    serv["detail"]["epochs"][-1]["x0"] = "cold"
+    assert cp.main(["--reps", "1"]) == 1
+    serv["detail"]["epochs"][-1]["x0"] = "warm"
+    # the tile gate (ISSUE 12): a one-tile change refreshing the whole
+    # set, or a HEALPix tile count off the PixelSpace dictionary, each
+    # fail; --no-tiles skips
+    til["wcs"]["delta_changed"] = 9
+    til["wcs"]["delta_bytes"] = 11000
+    assert cp.main(["--reps", "1"]) == 1
+    assert cp.main(["--reps", "1", "--no-tiles"]) == 0
+    til["wcs"]["delta_changed"], til["wcs"]["delta_bytes"] = 1, 1200
+    til["healpix"]["n_tiles"] = 6
+    assert cp.main(["--reps", "1"]) == 1
+    til["healpix"]["n_tiles"] = 7
     assert cp.main(["--reps", "1"]) == 0
     # the fused-kernel gate (ISSUE 11): a pass-budget breach (28 field /
     # 30 calib, and always below the live XLA floor), a masked-fill
@@ -254,6 +304,15 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     qual["n_alerts"] = 0
     assert cp.main(["--reps", "1", "--no-serving"]) == 1
     qual["n_alerts"] = 1
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    # the transfer-function gate (ISSUE 16): a closure miss on any
+    # seed fails the gate; --no-transfer skips the campaigns entirely
+    assert cp.main(["--reps", "1", "--no-serving",
+                    "--no-transfer"]) == 0
+    tfer_fails.append("transfer (seed 0): map_gain 0.1 outside "
+                      "(0.45, 1.30)")
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    tfer_fails.clear()
     assert cp.main(["--reps", "1", "--no-serving"]) == 0
     # ... and every gated run landed in the (redirected) registry,
     # honest about its own ok bit

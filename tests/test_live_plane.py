@@ -130,6 +130,36 @@ class TestLiveTail:
         tail.poll()
         assert tail.counters[("c", 0)] == 12.0
 
+    def test_equal_size_rewrite_past_byte_64_detected(self, tmp_path):
+        """PR 15's empiric: a same-size rewrite whose bytes differ only
+        PAST the old 64-byte raw-prefix fingerprint (identical meta
+        anchor, different later events) read as no-change and the new
+        events were skipped. The sha1 head hash (4 KiB window) with the
+        mtime_ns + size tiebreak catches it: restart from 0."""
+        p = tmp_path / "events.rank0.jsonl"
+        meta = _meta(0)  # the serialised meta line alone exceeds 64 B
+        assert len(json.dumps(meta)) + 1 > 64
+        _write_events(p, [
+            meta,
+            {"kind": "counter", "name": "c", "value": 5, "mono": 1.0},
+        ])
+        size = os.path.getsize(p)
+        head64 = p.read_bytes()[:64]
+        tail = LiveTail(str(tmp_path))
+        tail.poll()
+        assert tail.counters[("c", 0)] == 5.0
+        # same meta anchor (identical first 64 bytes), same byte count,
+        # different payload beyond byte 64
+        _write_events(p, [
+            meta,
+            {"kind": "counter", "name": "c", "value": 7, "mono": 1.0},
+        ])
+        assert os.path.getsize(p) == size
+        assert p.read_bytes()[:64] == head64  # the old-fingerprint shape
+        os.utime(p, ns=(time.time_ns(), time.time_ns() + 10_000_000))
+        tail.poll()
+        assert tail.counters[("c", 0)] == 12.0
+
     def test_metadata_only_touch_keeps_offset(self, tmp_path):
         """An mtime bump WITHOUT a content change (backup tooling,
         os.utime) must not re-absorb: the fingerprint still matches."""

@@ -172,17 +172,22 @@ class TestObservationNoiseFit:
             assert 0.5 * self.FKNEE < fknee < 2.0 * self.FKNEE
             assert -self.ALPHA - 0.7 < alpha < -self.ALPHA + 0.7
 
-    def test_red_noise_branch_consistent_knee(self):
+    @pytest.mark.parametrize("seed", [1, 2, 5, 8, 10])
+    def test_red_noise_branch_consistent_knee(self, seed):
         # the red-noise log-chi^2 surface is bistable on some noise
-        # draws (a steep-alpha degenerate minimum); seed 5 is a draw
-        # that lands in the physical basin — deterministic, so the
-        # pin is reproducible bit-for-bit
+        # draws (a steep-alpha degenerate minimum: alpha ~ -6, red2 ~ 0,
+        # inflated sig2). The multi-start hardening (second start
+        # converted from the knee-model optimum, better loss wins) must
+        # land every draw in the physical basin: seeds 1/2/8/10 were in
+        # the degenerate basin under the old single start, seed 5 the
+        # old pinned-good draw
         fits = np.asarray(power.fit_observation_noise(
-            jnp.asarray(self._blocks((1, 1, 1), seed=5)),
+            jnp.asarray(self._blocks((1, 1, 1), seed=seed)),
             model_name="red_noise"))[0, 0, 0]
         sig2, red2, alpha = (float(v) for v in fits)
         assert sig2 == pytest.approx(self.SIGMA ** 2, rel=0.35)
         assert alpha < 0 and red2 > 0
+        assert alpha == pytest.approx(-self.ALPHA, abs=0.7)
         # the derived knee (where red power crosses white) must agree
         # with the generator's — same rule quality._noise_fit applies
         fknee = (sig2 / red2) ** (1.0 / alpha)

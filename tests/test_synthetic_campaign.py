@@ -1,0 +1,289 @@
+"""The synthetic campaign engine (ISSUE 16): scenario parsing strictness,
+the byte-determinism contract (disk, memory, and through the prefetching
+ingest path), the ``synth://`` registry semantics, the transfer-curve
+estimator, and the pid-keyed lease liveness the scale drill's same-rank
+rejoin depends on."""
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.synthetic import memsource
+from comapreduce_tpu.synthetic.generator import (file_basename,
+                                                 file_params,
+                                                 virtual_filelist,
+                                                 write_campaign)
+from comapreduce_tpu.synthetic.scenario import ScenarioConfig, load_scenario
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    memsource.clear_registry()
+    yield
+    memsource.clear_registry()
+
+
+def _tiny(**over):
+    knobs = dict(name="tinytest", n_files=2, seed=3, n_feeds=1, n_bands=1,
+                 n_channels=4, n_scans=2, scan_samples=96, vane_samples=48,
+                 gap_samples=24)
+    knobs.update(over)
+    return ScenarioConfig.coerce(knobs)
+
+
+# ---------------------------------------------------------- scenario I/O
+class TestScenarioStrictness:
+    def test_typod_key_raises_at_load(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text('[scenario]\nname = "x"\nn_fils = 10\n')
+        with pytest.raises(ValueError, match="n_fils"):
+            load_scenario(str(p))
+
+    def test_extra_section_raises_at_load(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text('[scenario]\nname = "x"\n\n[Destriper]\nniter = 5\n')
+        with pytest.raises(ValueError, match="Destriper"):
+            load_scenario(str(p))
+
+    def test_missing_scenario_section_raises(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text('[Global]\nx = 1\n')
+        with pytest.raises(ValueError, match="scenario"):
+            load_scenario(str(p))
+
+    def test_coerce_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="sky_amplitude"):
+            ScenarioConfig.coerce({"sky_amplitude": 1.0})
+
+    def test_loadgen_toml_round_trips(self, tmp_path):
+        from comapreduce_tpu.synthetic.loadgen import (scale_scenario,
+                                                       write_scenario_toml)
+
+        cfg = scale_scenario(seed=7, n_files=5)
+        path = write_scenario_toml(cfg, str(tmp_path / "scale.toml"))
+        back = load_scenario(path)
+        assert back == cfg  # every knob survives the round trip
+
+
+# ------------------------------------------------------------ determinism
+class TestByteDeterminism:
+    def test_same_seed_byte_identical_on_disk(self, tmp_path):
+        cfg = _tiny()
+        a = write_campaign(cfg, str(tmp_path / "a"), indices=[0])[0]
+        b = write_campaign(cfg, str(tmp_path / "b"), indices=[0])[0]
+        ba, bb = open(a, "rb").read(), open(b, "rb").read()
+        assert ba == bb
+        # and a different seed is a different campaign
+        c = write_campaign(dataclasses.replace(cfg, seed=4),
+                           str(tmp_path / "c"), indices=[0])[0]
+        assert open(c, "rb").read() != ba
+
+    def test_memory_matches_disk(self, tmp_path):
+        cfg = memsource.register_scenario(_tiny())
+        path = write_campaign(cfg, str(tmp_path), indices=[1])[1 - 1]
+        import h5py
+
+        virt = memsource.load_virtual(virtual_filelist(cfg)[1])
+        with h5py.File(path) as h:
+            disk_tod = h["spectrometer/tod"][...]
+            disk_mjd = h["spectrometer/MJD"][...]
+        np.testing.assert_array_equal(np.asarray(virt["spectrometer/tod"]),
+                                      disk_tod)
+        np.testing.assert_array_equal(np.asarray(virt["spectrometer/MJD"]),
+                                      disk_mjd)
+
+    @pytest.mark.slow
+    def test_reduce_identical_with_prefetch_on_and_off(self, tmp_path):
+        """The ingest path must not perturb bytes: one synth:// member
+        reduced serially and through the prefetcher+cache produces the
+        SAME Level-2 arrays."""
+        import h5py
+
+        from comapreduce_tpu.pipeline.runner import Runner
+        from comapreduce_tpu.synthetic.loadgen import (_reduce_config,
+                                                       scale_scenario)
+
+        cfg = memsource.register_scenario(scale_scenario(seed=2, n_files=1))
+        files = virtual_filelist(cfg)
+        got = {}
+        for tag, ingest in (("serial", None),
+                            ("prefetch", {"prefetch": 2, "cache_mb": 64})):
+            out = tmp_path / tag
+            conf = _reduce_config(str(out), str(out / "logs"), 0.0)
+            conf["resilience"] = {"lease_ttl_s": 0}
+            if ingest:
+                conf["ingest"] = ingest
+            Runner.from_config(conf).run_tod(list(files))
+            l2 = out / f"Level2_{file_basename(cfg, 0)}"
+            with h5py.File(l2) as h:
+                got[tag] = (h["averaged_tod/tod"][...],
+                            h["averaged_tod/weights"][...])
+        np.testing.assert_array_equal(got["serial"][0], got["prefetch"][0])
+        np.testing.assert_array_equal(got["serial"][1], got["prefetch"][1])
+
+
+# ---------------------------------------------------------- edge scenarios
+class TestEdgeScenarios:
+    def test_zero_length_scan_file_still_generates(self, tmp_path):
+        # jitter bigger than scan_samples: the triangle wave drives some
+        # member's scans to length 0 — generation must clamp, not crash
+        cfg = _tiny(n_files=6, scan_samples=8, shape_jitter=16)
+        lengths = [file_params(cfg, i).scan_samples
+                   for i in range(cfg.n_files)]
+        assert min(lengths) == 0  # the edge is actually exercised
+        idx = int(np.argmin(lengths))
+        path = write_campaign(cfg, str(tmp_path), indices=[idx])[0]
+        import h5py
+
+        with h5py.File(path) as h:
+            tod = h["spectrometer/tod"]
+            assert tod.shape[-1] > 0  # vane + gaps remain
+            assert np.isfinite(h["spectrometer/MJD"][...]).all()
+
+    def test_single_file_scenario(self, tmp_path):
+        cfg = memsource.register_scenario(_tiny(n_files=1))
+        files = virtual_filelist(cfg)
+        assert len(files) == 1
+        data = memsource.load_virtual(files[0])
+        assert np.asarray(data["spectrometer/tod"]).ndim == 4
+
+    def test_zero_scans_scenario_generates(self, tmp_path):
+        cfg = _tiny(n_scans=0, n_files=1)
+        path = write_campaign(cfg, str(tmp_path), indices=[0])[0]
+        assert os.path.getsize(path) > 0
+
+
+# ------------------------------------------------------------ registry
+class TestRegistry:
+    def test_unregistered_scenario_is_file_not_found(self):
+        with pytest.raises(FileNotFoundError, match="not registered"):
+            memsource.parse_virtual("synth://nope/00000/x.hd5")
+
+    def test_out_of_range_member_is_file_not_found(self):
+        cfg = memsource.register_scenario(_tiny(n_files=2))
+        bad = (f"synth://{cfg.name}/00002/"
+               f"{file_basename(dataclasses.replace(cfg, n_files=3), 2)}")
+        with pytest.raises(FileNotFoundError, match="no such"):
+            memsource.parse_virtual(bad)
+
+    def test_registered_member_parses(self):
+        cfg = memsource.register_scenario(_tiny())
+        got_cfg, idx = memsource.parse_virtual(virtual_filelist(cfg)[1])
+        assert got_cfg == cfg and idx == 1
+
+    def test_cache_file_key_synth_branch_never_stats(self):
+        from comapreduce_tpu.ingest.cache import file_key
+
+        # no registration, no stat: the path alone is the identity
+        p = "synth://whatever/00000/file.hd5"
+        assert file_key(p) == (p, 0)
+
+
+# ------------------------------------------------------- transfer curve
+class TestTransferCurve:
+    def _field(self, seed=0):
+        rng = np.random.default_rng(seed)
+        # beam-scale truth: power concentrated at low k, like the gate's
+        yy, xx = np.mgrid[:64, :64]
+        truth = 2.0 * np.exp(-((xx - 30) ** 2 + (yy - 34) ** 2) / 18.0)
+        unhit = rng.uniform(size=truth.shape) < 0.2
+        return truth.astype(np.float64), unhit
+
+    def test_unity_for_perfect_recovery(self):
+        from comapreduce_tpu.synthetic.transfer import transfer_curve
+
+        truth, unhit = self._field()
+        recovered = truth.copy()
+        recovered[unhit] = np.nan  # coverage gaps, exact elsewhere
+        k, tr, n = transfer_curve(truth, recovered)
+        assert len(k) == len(tr) == len(n)
+        good = n > 0
+        np.testing.assert_allclose(tr[good], 1.0, atol=1e-5)
+
+    def test_scales_linearly_with_recovered_amplitude(self):
+        from comapreduce_tpu.synthetic.transfer import transfer_curve
+
+        truth, unhit = self._field(1)
+        recovered = 0.5 * truth
+        recovered[unhit] = np.nan
+        _, tr, n = transfer_curve(truth, recovered)
+        good = n > 0
+        np.testing.assert_allclose(tr[good], 0.5, atol=1e-5)
+
+    def test_shape_mismatch_raises(self):
+        from comapreduce_tpu.synthetic.transfer import transfer_curve
+
+        with pytest.raises(ValueError, match="mismatch"):
+            transfer_curve(np.zeros((8, 8)), np.zeros((8, 9)))
+
+
+# --------------------------------------------- pid-keyed lease liveness
+class TestSameRankRestartLease:
+    """A claim leaked by a killed process must stay stealable after a
+    NEW process rejoins under the same rank id (its fresh heartbeat
+    shadows the dead one's file) — ``LeaseBoard.expired`` keys claim
+    liveness on the claimant's pid, not the rank alone."""
+
+    def _board(self, tmp_path, **kw):
+        from comapreduce_tpu.resilience.lease import LeaseBoard
+
+        return LeaseBoard(str(tmp_path), rank=1, lease_ttl_s=5.0,
+                          steal_after_s=0.001, **kw)
+
+    def _beat(self, tmp_path, pid, age_s=0.0):
+        from comapreduce_tpu.resilience.heartbeat import heartbeat_path
+
+        import socket
+
+        t = time.time() - age_s
+        path = heartbeat_path(str(tmp_path), 1)
+        payload = {"rank": 1, "pid": pid, "host": socket.gethostname(),
+                   "seq": 1, "t_wall_unix": t, "stage": "", "unit": ""}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        os.utime(path, (t, t))  # age applies to the file mtime too
+
+    def test_fresh_beat_from_claimant_pid_not_expired(self, tmp_path):
+        board = self._board(tmp_path)
+        assert board.claim("a.hd5") is not None
+        time.sleep(0.01)  # past steal_after_s
+        self._beat(tmp_path, os.getpid())
+        assert not board.expired("a.hd5")
+
+    def test_fresh_beat_from_other_pid_is_expired(self, tmp_path):
+        board = self._board(tmp_path)
+        assert board.claim("a.hd5") is not None
+        time.sleep(0.01)
+        self._beat(tmp_path, os.getpid() + 1)  # the same-rank successor
+        assert board.expired("a.hd5")
+        # and the successor can actually take it
+        lease = board.steal("a.hd5")
+        assert lease is not None
+        assert board.commit(lease)
+        assert board.is_done("a.hd5")
+
+    def test_stale_beat_still_expires(self, tmp_path):
+        board = self._board(tmp_path)
+        assert board.claim("a.hd5") is not None
+        time.sleep(0.01)
+        self._beat(tmp_path, os.getpid(), age_s=60.0)
+        assert board.expired("a.hd5")
+
+
+# ------------------------------------------------------------ the drill
+@pytest.mark.slow
+def test_full_scale_drill_200_files(tmp_path):
+    """The ISSUE 16 acceptance drill at full size: a 200-file synth://
+    campaign through three elastic ranks + map server + tile tier,
+    with the mid-run SIGKILL/rejoin. Every promise is asserted inside
+    ``run_synthetic_drill``; this test pins the acceptance numbers."""
+    from comapreduce_tpu.synthetic.loadgen import run_synthetic_drill
+
+    ev = run_synthetic_drill(str(tmp_path), seed=1, n_files=200)
+    assert sum(ev["commits_by_rank"].values()) + ev["stolen"] >= 200
+    assert ev["rejoin_commits"] >= 1
+    assert len(ev["epochs"]) >= 2

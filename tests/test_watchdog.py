@@ -437,25 +437,6 @@ def test_straggler_barrier_detects_dead_rank_and_degrades(tmp_path):
     assert alive == [0] and dead == [1, 2]
 
 
-def test_degraded_shard_is_a_deprecated_static_shard_shim(tmp_path):
-    """The ledger-and-abandon path is retired (elastic claiming is the
-    campaign default): the shim warns, returns the plain static shard,
-    and never writes a ledger entry — from any rank."""
-    from comapreduce_tpu.parallel.multihost import degraded_shard
-    from comapreduce_tpu.resilience.ledger import QuarantineLedger
-
-    files = [f"obs{i:03d}" for i in range(7)]
-    ledger = QuarantineLedger(str(tmp_path / "q.jsonl"))
-    for rank, alive in ((0, [0]), (2, [0, 2])):
-        with pytest.warns(DeprecationWarning, match="static"):
-            shard = degraded_shard(files, rank=rank, n_ranks=3,
-                                   dead=[1], alive=alive, ledger=ledger)
-        # the shard rule itself never changes (i % n_ranks == r)
-        assert shard == files[rank::3]
-    assert ledger.entries == []
-    assert not (tmp_path / "q.jsonl").exists()
-
-
 # ---------------------------------------------------------------------------
 # poisoned prefetcher
 # ---------------------------------------------------------------------------

@@ -111,6 +111,17 @@ cross-checks the solver trace: the per-iteration records written to
 ``solver.rank0.jsonl`` must match the solve's reported iteration count
 EXACTLY (both come from the same dispatch).
 
+The transfer-function gate (ISSUE 16) also runs by default,
+in-process: for each of ``--transfer-seeds`` seeds (default 3) a
+synthetic calibrator campaign with a KNOWN injected sky is generated
+in memory (``synth://``), pushed through the real reduce -> destripe
+-> map chain, and the recovered map compared against the injected
+truth. ``check_transfer`` gates the signal-carrying low-k transfer
+bins, the map-domain regression gain, and the quality ledger's
+recovery of the scenario's KNOWN noise parameters on a blind reference
+file — all physics ratios of one deterministic campaign against its
+own truth, machine-independent; ``--no-transfer`` skips.
+
 Unless ``--no-registry``, the gate appends one ``perf_gate`` summary
 record to ``evidence/runs.jsonl`` (``telemetry/registry.py``) so
 ``tools/campaign_watch.py trend`` can alert on a regression against
@@ -341,6 +352,44 @@ def run_tiles_gate() -> dict:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def run_transfer_gate(seeds) -> tuple[dict, list]:
+    """The ISSUE 16 transfer-function closure, in-process: one
+    end-to-end synthetic campaign per seed, each gated by
+    ``check_transfer`` against the scenario's own injected truth."""
+    import shutil
+    import tempfile
+
+    from comapreduce_tpu.synthetic.transfer import (check_transfer,
+                                                    run_transfer)
+
+    failures, per_seed = [], {}
+    for seed in seeds:
+        work = tempfile.mkdtemp(prefix=f"check_perf_transfer_s{seed}_")
+        try:
+            artifact = run_transfer(work, seed=seed)
+            bands = artifact.get("bands") or []
+            q = artifact.get("quality") or {}
+            per_seed[str(seed)] = {
+                "map_gain": [b.get("map_gain") for b in bands],
+                "low_k_transfer": [list(b.get("transfer", [])[:2])
+                                   for b in bands],
+                "alpha_median": q.get("alpha_median"),
+                "fknee_ratio": (
+                    q["fknee_median"] / q["fknee_expected"]
+                    if q.get("fknee_median") and q.get("fknee_expected")
+                    else None),
+            }
+            check_transfer(artifact)
+        except AssertionError as exc:
+            failures.append(f"transfer (seed {seed}): {exc}")
+        except Exception as exc:  # a broken stage, not a closure miss
+            failures.append(f"transfer (seed {seed}): campaign raised "
+                            f"{type(exc).__name__}: {exc}")
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+    return per_seed, failures
+
+
 def run_quality_gate() -> dict:
     """The ISSUE 14 data-quality gate, in-process on the chaos drill's
     own Level-2 fixtures (no jax, no subprocess): a ``nan_burst``-
@@ -526,6 +575,11 @@ def main(argv=None) -> int:
                     help="skip the precision H2D/CG-ladder/parity gate")
     ap.add_argument("--no-quality", action="store_true",
                     help="skip the quality-ledger nan_burst gate")
+    ap.add_argument("--no-transfer", action="store_true",
+                    help="skip the synthetic transfer-function gate")
+    ap.add_argument("--transfer-seeds", type=int, default=3,
+                    help="number of seeds for the transfer gate "
+                         "(default 3)")
     ap.add_argument("--no-programs", action="store_true",
                     help="skip the compiled-program HBM gate (rides "
                          "the destriper bench; --no-destriper also "
@@ -926,6 +980,16 @@ def main(argv=None) -> int:
                 f"the {quality['masked_threshold']:g} threshold — the "
                 "fixture no longer exercises the rule")
 
+    transfer = None
+    if not args.no_transfer:
+        # machine-independent (ISSUE 16): closure of the end-to-end
+        # pipeline against a synthetic campaign's OWN injected truth —
+        # physics ratios with ~2x headroom over the cross-seed scatter,
+        # never a wall clock or a committed reference
+        transfer, t_fails = run_transfer_gate(
+            range(max(args.transfer_seeds, 1)))
+        failures.extend(t_fails)
+
     if not args.no_registry:
         # one summary record per gate run (ISSUE 14): the registry is
         # what campaign_watch.py trend compares against, so the gate
@@ -943,6 +1007,7 @@ def main(argv=None) -> int:
                       "destriper": destriper, "serving": serving,
                       "kernels": kernels, "tiles": tiles,
                       "precision": precision, "quality": quality,
+                      "transfer": transfer,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))

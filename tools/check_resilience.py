@@ -5,7 +5,7 @@ Usage::
 
     python tools/check_resilience.py [--workdir DIR] [--seed N] [--keep]
                                      [--elastic-only | --serving-only
-                                      | --tiles-only]
+                                      | --tiles-only | --synthetic-only]
 
 Injects one fault of every class (read error, truncated file,
 first-attempt flake, NaN burst, slow read, HANGING read) over a
@@ -58,6 +58,19 @@ surviving a ``/v1/current`` rollback, each serving process takes its
 own telemetry lane, and ``MapServer.evict`` reproduces the
 pre-eviction epoch's tile hashes exactly.
 
+``--synthetic-only`` runs the synthetic scale drill
+(``comapreduce_tpu/synthetic/loadgen.py`` — a generated ``synth://``
+campaign of ``--n-files`` virtual Level-1 files pointed at three real
+elastic reduce ranks, the map server, and the tile tier
+simultaneously): rank 1 is SIGKILLed while holding a live lease and a
+fresh process rejoins mid-run, asserting exactly-once lease commits
+(survivor counts + the stolen leak sum to the campaign), ``/healthz``
+flipping 503 within one TTL and recovering after the rejoin, a
+mid-run epoch published under load plus a fresh final epoch whose
+census is the full campaign, the tile manifest tracking ``current``,
+and the ``/metrics`` per-rank commit counters EXACTLY equal to each
+surviving scheduler's own count (docs/OPERATIONS.md §18).
+
 Prints one JSON evidence line; non-zero exit (with the broken
 criterion named) on any failure. Also wired into CI as ``bench.py
 --config resilience``.
@@ -97,6 +110,14 @@ def main(argv=None) -> int:
                       help="run only the live observability drill "
                       "(healthz flip on SIGKILL/recovery, exact "
                       "/metrics commit counter)")
+    only.add_argument("--synthetic-only", action="store_true",
+                      help="run only the synthetic scale drill (a "
+                      "generated synth:// campaign through elastic "
+                      "ranks + map server + tile tier with a mid-run "
+                      "rank kill/rejoin)")
+    ap.add_argument("--n-files", type=int, default=200,
+                    help="campaign size for --synthetic-only "
+                    "(default 200)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -106,10 +127,18 @@ def main(argv=None) -> int:
                                                   run_serving_drill,
                                                   run_tiles_drill)
 
-    drill = (run_live_drill if args.live_only
-             else run_tiles_drill if args.tiles_only
-             else run_serving_drill if args.serving_only
-             else run_elastic_drill if args.elastic_only else run_drill)
+    if args.synthetic_only:
+        from comapreduce_tpu.synthetic.loadgen import run_synthetic_drill
+
+        def drill(workdir, seed=0):
+            return run_synthetic_drill(workdir, seed=seed,
+                                       n_files=args.n_files)
+    else:
+        drill = (run_live_drill if args.live_only
+                 else run_tiles_drill if args.tiles_only
+                 else run_serving_drill if args.serving_only
+                 else run_elastic_drill if args.elastic_only
+                 else run_drill)
     workdir = args.workdir or tempfile.mkdtemp(prefix="check_resilience_")
     try:
         try:
