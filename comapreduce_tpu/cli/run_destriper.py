@@ -1224,6 +1224,56 @@ def main(argv=None) -> int:
                 "with flagged quality records",
                 len(filelist) - len(kept), len(filelist))
         filelist = kept
+    # [Control] solver_policy (docs/OPERATIONS.md §19, default OFF):
+    # re-pick preconditioner/mg_block/pair_batch from evidence — this
+    # state dir's solver traces, the run-registry iteration delta, and
+    # the XLA program cost model — instead of trusting the static
+    # [Destriper] knobs for every shape the campaign will see. Every
+    # override is an auditable control.decision event; no evidence
+    # leaves the static config byte-for-byte.
+    from comapreduce_tpu.control.config import ControlConfig
+
+    control_cfg = ControlConfig.coerce(dict(ini.get("Control", {}))
+                                       or None)
+    if control_cfg.solver_policy:
+        from comapreduce_tpu.control.policy import choose_solver
+        from comapreduce_tpu.telemetry.registry import \
+            default_registry_path
+
+        # the effective rung the decisions are measured against (the
+        # parse collapses twolevel/multigrid into flags)
+        rung = ("multigrid" if mg is not None
+                else "twolevel" if coarse_block > 0
+                else precond)
+        choice = choose_solver(
+            state_dir,
+            static={"preconditioner": rung,
+                    "mg_block": mg["block"] if mg else None,
+                    "pair_batch": pair_batch},
+            registry_path=default_registry_path())
+        for reason in choice.get("reasons", ()):
+            logger.warning("[Control] solver_policy: %s", reason)
+        overrides = {k: v for k, v in choice.items() if k != "reasons"}
+        if overrides:
+            # apply by re-parsing an overridden copy of [Destriper] so
+            # every existing knob validation (mg ranges, coarse_block
+            # gating) governs the policy's picks too
+            destr_over = dict(destr_sec)
+            new_rung = str(overrides.get("preconditioner", rung))
+            destr_over["preconditioner"] = new_rung
+            if new_rung != "twolevel":
+                destr_over.pop("coarse_block", None)
+            if new_rung != "multigrid":
+                for k in ("mg_levels", "mg_smooth", "mg_block"):
+                    destr_over.pop(k, None)
+            elif "mg_block" in overrides:
+                destr_over["mg_block"] = int(overrides["mg_block"])
+            if "pair_batch" in overrides:
+                destr_over["pair_batch"] = int(overrides["pair_batch"])
+            precond, coarse_block, pair_batch, mg, kernels = \
+                parse_destriper_section(
+                    destr_over, int(inputs.get("coarse_precond",
+                                               0 if calibrator else 8)))
     writeback = None
     if ingest_cfg.writeback >= 1:
         # async map writeback (docs/OPERATIONS.md §9): band N+1's CG

@@ -1,0 +1,41 @@
+"""The closed-loop control plane: sensors to actions.
+
+Everything below the pipeline is a sensor — live ``/metrics``
+(:mod:`~comapreduce_tpu.telemetry.live`), per-rank heartbeats, the
+quarantine and data-quality ledgers with their SLO rules, per-solve
+convergence traces, and the lease-based elastic work queue. This
+package is the actuator side: three independent control loops, each
+drillable on its own with :class:`~comapreduce_tpu.resilience.chaos
+.ChaosMonkey`, each auditable through ``control.decision`` telemetry
+events and the ``decisions.*.jsonl`` ledger.
+
+- :mod:`~comapreduce_tpu.control.supervisor` /
+  :mod:`~comapreduce_tpu.control.autoscaler` — the campaign
+  supervisor: watches queue depth (``queue.json`` + lease states),
+  rank liveness (the CHANGE-based
+  :class:`~comapreduce_tpu.resilience.heartbeat.HeartbeatWatch` rule)
+  and measured throughput, and decides when to spawn replacement or
+  additional elastic ranks (:mod:`~comapreduce_tpu.control.manager`
+  actually forks and reaps them) and when to retire idle ones.
+- :mod:`~comapreduce_tpu.control.admission` — SLO-pressure admission
+  control: sheds quality-flagged files while the queue backlog sits
+  above the high-water mark, every shed ledgered ``deferred`` and
+  re-admitted when pressure clears — shed, never dropped (the
+  automatic version of the manual ``[slo] exclude_flagged`` knob).
+- :mod:`~comapreduce_tpu.control.policy` — the solver policy engine:
+  picks ``preconditioner``/``mg_block``/``pair_batch`` per shape
+  bucket from the solver traces, ``solver_report --registry`` deltas
+  and the ``programs.jsonl`` cost model instead of static config.
+
+All three loops are OFF by default: ``[control]`` absent is
+byte-for-byte the uncontrolled pipeline (docs/OPERATIONS.md §19).
+"""
+
+from comapreduce_tpu.control.config import ControlConfig
+from comapreduce_tpu.control.decisions import (DECISION_SCHEMA,
+                                               decisions_paths,
+                                               read_decisions,
+                                               record_decision)
+
+__all__ = ["ControlConfig", "DECISION_SCHEMA", "decisions_paths",
+           "read_decisions", "record_decision"]

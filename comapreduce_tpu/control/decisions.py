@@ -1,0 +1,119 @@
+"""The control-plane decision ledger: every action, auditable.
+
+A control loop that acts silently is indistinguishable from a bug, so
+every decision any loop takes — spawn a rank, shed a file, switch a
+preconditioner — lands twice:
+
+- one line in ``decisions.{writer}.jsonl`` in the run's state
+  directory (per-writer files: JSONL appends only interleave safely
+  with one writer per file, the quarantine-ledger discipline — the
+  supervisor writes ``decisions.supervisor.jsonl``, rank ``r``'s
+  admission gate ``decisions.rank{r}.jsonl``);
+- one ``control.decision`` telemetry counter with ``loop``/``action``
+  attributes, which the live plane exports generically as
+  ``comap_control_decision_total`` and ``tools/campaign_watch.py``
+  surfaces in its live view.
+
+Entry schema (one JSON object per line)::
+
+    {"schema": 1, "t": "2026-08-07T07:00:00Z", "t_unix": 1786…,
+     "loop": "autoscaler" | "admission" | "solver",
+     "action": "spawn" | "retire" | "shed_on" | "shed_off" | "defer"
+               | "readmit" | "override" | ...,
+     "reason": "...", ...loop-specific attributes...}
+
+Reading is merge-all-writers sorted by ``t_unix``, torn lines dropped
+— the same tolerance as every JSONL reader here.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import logging
+import os
+import time
+
+from comapreduce_tpu.telemetry import TELEMETRY
+
+__all__ = ["DECISION_SCHEMA", "decisions_path", "decisions_paths",
+           "read_decisions", "record_decision"]
+
+logger = logging.getLogger("comapreduce_tpu")
+
+DECISION_SCHEMA = 1
+
+
+def decisions_path(state_dir: str, writer: str = "supervisor") -> str:
+    return os.path.join(state_dir or ".", f"decisions.{writer}.jsonl")
+
+
+def decisions_paths(state_dir: str) -> list:
+    return sorted(_glob.glob(os.path.join(state_dir or ".",
+                                          "decisions.*.jsonl")))
+
+
+def record_decision(state_dir: str, loop: str, action: str,
+                    reason: str, writer: str = "supervisor",
+                    **attrs) -> dict:
+    """Append one decision (torn-line-safe) + fire the telemetry
+    counter + log it. I/O failures are logged and swallowed — the
+    decision was already TAKEN; bookkeeping must not undo it."""
+    entry = {"schema": DECISION_SCHEMA,
+             "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "t_unix": time.time(), "loop": str(loop),
+             "action": str(action), "reason": str(reason)}
+    entry.update(attrs)
+    logger.warning("control decision [%s] %s: %s", loop, action, reason)
+    TELEMETRY.counter("control.decision", 1, loop=str(loop),
+                      action=str(action))
+    path = decisions_path(state_dir, writer)
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        needs_nl = False
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                needs_nl = f.read(1) != b"\n"
+        except OSError:
+            pass
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(("\n" if needs_nl else "")
+                    + json.dumps(entry, separators=(",", ":"),
+                                 default=str) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as exc:
+        logger.warning("decision ledger append to %s failed (%s: %s)",
+                       path, type(exc).__name__, exc)
+    return entry
+
+
+def read_decisions(source) -> list:
+    """All decisions merged across writers, sorted by ``t_unix``.
+    ``source``: a state directory, one path, or a list of paths.
+    Torn/garbled lines are dropped, never fatal."""
+    if isinstance(source, (list, tuple)):
+        paths = [str(p) for p in source]
+    elif os.path.isdir(source):
+        paths = decisions_paths(source)
+    else:
+        paths = [str(source)]
+    out = []
+    for path in paths:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            if isinstance(rec, dict) and "loop" in rec:
+                out.append(rec)
+    out.sort(key=lambda r: r.get("t_unix") or 0.0)
+    return out

@@ -141,7 +141,8 @@ def straggler_barrier(heartbeat_dir: str, rank: int, n_ranks: int,
     and a rank declared dead by mistake (a paused VM resuming late)
     costs nothing — the verdict is a log line, not a ledger entry.
     """
-    from comapreduce_tpu.resilience.heartbeat import read_heartbeats
+    from comapreduce_tpu.resilience.heartbeat import (HeartbeatWatch,
+                                                      read_heartbeats)
 
     if heartbeat is not None:
         # our own barrier-entry beat doubles as the change siblings
@@ -159,25 +160,21 @@ def straggler_barrier(heartbeat_dir: str, rank: int, n_ranks: int,
         return [rank], []
     others = [r for r in range(n_ranks) if r != rank]
 
-    def signature(hb: dict) -> tuple:
-        return (hb.get("seq"), hb.get("t_wall_unix"), hb.get("_mtime"))
-
-    # baseline scan: whatever is on disk NOW proves nothing (it may be
-    # a dead rank's last beat); only change from here on does
-    baseline = {r: signature(hb)
-                for r, hb in read_heartbeats(heartbeat_dir).items()
-                if r in others}
+    # the ONE change-based liveness rule (resilience.heartbeat
+    # .HeartbeatWatch, shared with the control-plane supervisor): the
+    # first observe is the baseline scan — whatever is on disk NOW
+    # proves nothing (it may be a dead rank's last beat); only change
+    # from here on does. ttl_s = the whole barrier window, so a rank
+    # proven alive once stays alive for the barrier's purposes.
+    watch = HeartbeatWatch(ttl_s=max(timeout_s, 0.0), clock=clock)
+    watch.observe(read_heartbeats(heartbeat_dir))
     alive: set = set()
     deadline = clock() + max(timeout_s, 0.0)
     while clock() < deadline and len(alive) < len(others):
         sleep(poll_s)
-        hbs = read_heartbeats(heartbeat_dir)
-        for r in others:
-            hb = hbs.get(r)
-            if hb is None or r in alive:
-                continue
-            if r not in baseline or signature(hb) != baseline[r]:
-                alive.add(r)  # appeared or changed: someone is home
+        verdicts = watch.observe(read_heartbeats(heartbeat_dir))
+        alive |= {r for r in others
+                  if verdicts.get(r) == HeartbeatWatch.ALIVE}
     dead = sorted(set(others) - alive)
     if dead:
         logger.warning(

@@ -159,6 +159,14 @@ class Runner:
     # a quality.alert telemetry counter; run_destriper can exclude
     # flagged files behind [slo] exclude_flagged (default off)
     slo: object = None
+    # control-plane knob (TOML [control] / INI [Control]):
+    # ControlConfig | mapping | None. admission=True gates the elastic
+    # scheduler's claims behind the SLO-driven shed/defer loop;
+    # autoscale/solver_policy are consumed by the supervisor sidecar
+    # and the destriper CLI respectively. Default None = every loop
+    # off, byte-for-byte the uncontrolled pipeline
+    # (docs/OPERATIONS.md §19)
+    control: object = None
     # cumulative async-writeback stats ({"writes", "write_s",
     # "flush_wait_s", ...}) across this Runner's run_tod calls — the
     # bench's write-overlap observable
@@ -335,9 +343,16 @@ class Runner:
                 lease_ttl_s=res.lease_ttl_s,
                 steal_after_s=res.steal_after_s,
                 ledger=res.ledger, chaos=res.chaos,
-                heartbeat=res.heartbeat)
+                heartbeat=res.heartbeat,
+                admission=self._admission_gate(res))
             source = sched.claim_iter()
         else:
+            if self._admission_gate(res) is not None:
+                logger.warning(
+                    "[control] admission is on but the shard is STATIC "
+                    "([resilience] lease_ttl_s = 0): a static shard "
+                    "has no claim/defer cycle, so admission control "
+                    "is inert for this run")
             source = self.shard_iter(filelist)
         self._scheduler = sched
         results = []
@@ -403,6 +418,22 @@ class Runner:
                 # durations, floored by config)
                 self._resilience.watchdog.timings = self.timings
         return self._resilience
+
+    def _admission_gate(self, res):
+        """The SLO-driven admission controller for this rank's elastic
+        scheduler, or None when ``[control] admission`` is off — None
+        keeps the scheduler byte-for-byte on its uncontrolled path
+        (docs/OPERATIONS.md §19)."""
+        from comapreduce_tpu.control.config import ControlConfig
+
+        ccfg = ControlConfig.coerce(self.control)
+        if not ccfg.admission:
+            return None
+        from comapreduce_tpu.control.admission import AdmissionController
+
+        return AdmissionController(
+            ccfg, res.state_dir or self.state_dir or self.output_dir,
+            rank=self.rank)
 
     def _admitted(self, source, res):
         """``source`` (this rank's static shard, or its elastic claim
@@ -813,6 +844,7 @@ class Runner:
         ``[precision]`` table (``tod_dtype``, ``cg_dot``) sets the
         end-to-end precision policy — a typo'd key raises here, at
         load (docs/OPERATIONS.md §15)."""
+        from comapreduce_tpu.control.config import ControlConfig
         from comapreduce_tpu.ingest import IngestConfig
         from comapreduce_tpu.ops.precision import PrecisionPolicy
         from comapreduce_tpu.pipeline.campaign import CampaignConfig
@@ -858,7 +890,11 @@ class Runner:
                    # [quality]/[slo]: the data-quality ledger and its
                    # declarative thresholds (docs/OPERATIONS.md §16)
                    quality=QualityConfig.coerce(config.get("quality")),
-                   slo=SloConfig.coerce(config.get("slo")))
+                   slo=SloConfig.coerce(config.get("slo")),
+                   # [control]: supervisor/admission/solver-policy
+                   # loops — absent table = every loop off
+                   # (docs/OPERATIONS.md §19)
+                   control=ControlConfig.coerce(config.get("control")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,
@@ -867,6 +903,7 @@ class Runner:
         ``Tools/Parser.py:44-96``). Resilience knobs live in a
         ``[Resilience]`` section, campaign knobs in a ``[Campaign]``
         section (same names as the TOML tables)."""
+        from comapreduce_tpu.control.config import ControlConfig
         from comapreduce_tpu.ingest import IngestConfig
         from comapreduce_tpu.pipeline.campaign import CampaignConfig
         from comapreduce_tpu.resilience import ResilienceConfig
@@ -898,4 +935,6 @@ class Runner:
                    quality=QualityConfig.coerce(
                        dict(ini.get("Quality", {})) or None),
                    slo=SloConfig.coerce(
-                       dict(ini.get("Slo", {})) or None))
+                       dict(ini.get("Slo", {})) or None),
+                   control=ControlConfig.coerce(
+                       dict(ini.get("Control", {})) or None))

@@ -43,11 +43,57 @@ from comapreduce_tpu.data.durable import durable_replace
 from comapreduce_tpu.resilience.lease import Lease, LeaseBoard
 from comapreduce_tpu.telemetry import TELEMETRY
 
-__all__ = ["Scheduler", "QUEUE_MANIFEST"]
+__all__ = ["Scheduler", "QUEUE_MANIFEST", "extend_manifest",
+           "read_manifest"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
 QUEUE_MANIFEST = "queue.json"
+
+
+def read_manifest(state_dir: str) -> dict | None:
+    """Parse the shared queue manifest; None when missing/torn."""
+    try:
+        with open(os.path.join(state_dir or ".", QUEUE_MANIFEST), "r",
+                  encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def extend_manifest(state_dir: str, new_files) -> list:
+    """Append late-arriving units to the shared ``queue.json`` — a
+    chaos ``load_spike``, or an operator dropping a fresh observing
+    session into a live campaign. Returns the full paths actually
+    added (units already queued, by basename, are skipped).
+
+    The manifest keeps listing basenames in ``files`` (what the
+    operator report counts); full paths for the additions ride in
+    ``added_paths`` so sibling ranks re-polling the manifest
+    (``Scheduler`` steal loop) can claim units their own filelist
+    never mentioned. Durable replace, same discipline as the first
+    write — the burst either landed whole or not at all."""
+    man = read_manifest(state_dir) or {"schema": 1, "files": []}
+    have = set(man.get("files", []))
+    added = [f for f in new_files if os.path.basename(f) not in have]
+    if not added:
+        return []
+    man["files"] = list(man.get("files", [])) + \
+        [os.path.basename(f) for f in added]
+    man["n"] = len(man["files"])
+    paths = dict(man.get("added_paths", {}))
+    paths.update({os.path.basename(f): f for f in added})
+    man["added_paths"] = paths
+    man["t_wall"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    os.makedirs(state_dir or ".", exist_ok=True)
+    tmp = os.path.join(state_dir or ".",
+                       f".{QUEUE_MANIFEST}.{os.getpid()}.ext.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(man, f)
+    durable_replace(tmp, os.path.join(state_dir or ".", QUEUE_MANIFEST))
+    logger.warning("queue manifest %s: %d late unit(s) appended "
+                   "(%d total)", state_dir, len(added), man["n"])
+    return added
 
 
 class Scheduler:
@@ -60,6 +106,14 @@ class Scheduler:
     :class:`~comapreduce_tpu.resilience.config.Resilience` members —
     the chaos hooks (``rank_kill`` / ``rank_pause``) fire at claim
     time, which is exactly where a preemption or a zombie hurts most.
+
+    ``admission`` is the optional control-plane gate (duck-typed as
+    :class:`~comapreduce_tpu.control.admission.AdmissionController`):
+    consulted on every just-claimed unit, it may answer with a defer
+    reason, in which case the claim is released, the unit is ledgered
+    ``deferred``, and it re-enters the queue when pressure clears —
+    shed, never dropped. ``None`` (the default) admits everything,
+    byte-for-byte the pre-control behavior.
     """
 
     def __init__(self, filelist, state_dir: str, rank: int = 0,
@@ -67,7 +121,8 @@ class Scheduler:
                  lease_ttl_s: float = 60.0, steal_after_s: float = 0.0,
                  poll_s: float = 0.25, stall_timeout_s: float = 0.0,
                  ledger=None, chaos=None, heartbeat=None,
-                 clock=time.monotonic, sleep=time.sleep):
+                 admission=None, clock=time.monotonic,
+                 sleep=time.sleep):
         self.files = list(filelist)
         self.state_dir = state_dir or "."
         self.rank = int(rank)
@@ -85,12 +140,16 @@ class Scheduler:
         self.ledger = ledger
         self.chaos = chaos
         self.heartbeat = heartbeat
+        self.admission = admission
         self.clock = clock
         self.sleep = sleep
         self._held: dict[str, Lease] = {}
+        self._deferred: list[str] = []
+        self._outstanding: set[str] = set(self.files)
         self.stats = {"claimed": 0, "stolen": 0, "committed": 0,
                       "recovered": 0, "fence_rejects": 0,
-                      "done_elsewhere": 0, "abandoned": 0}
+                      "done_elsewhere": 0, "abandoned": 0,
+                      "deferred": 0, "readmitted": 0, "spiked": 0}
         self._write_manifest()
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -113,20 +172,25 @@ class Scheduler:
         for f in order:
             if self.board.is_done(f):
                 self._bump("done_elsewhere")
+                self._outstanding.discard(f)
                 continue
             lease = self.board.claim(f)
             if lease is None:
                 pending.append(f)
                 continue
+            if self._shed(f, lease):
+                continue
             yield self._grant(f, lease)
         # steal loop: wait out the other ranks' units
+        pending.extend(self._poll_manifest())
         last_progress = self.clock()
-        while pending:
+        while pending or self._deferred:
             still = []
             progressed = False
             for f in pending:
                 if self.board.is_done(f):
                     self._bump("done_elsewhere")
+                    self._outstanding.discard(f)
                     progressed = True
                     continue
                 lease = self.board.claim(f)  # released or fence-gap
@@ -139,15 +203,59 @@ class Scheduler:
                     still.append(f)
                     continue
                 progressed = True
+                if self._shed(f, lease):
+                    continue
                 yield self._grant(f, lease)
             pending = still
+            # late arrivals: a load_spike burst or an operator append
+            # lands in the shared manifest mid-campaign
+            new = self._poll_manifest()
+            if new:
+                pending.extend(new)
+                progressed = True
+            # re-admission pass: shed units return when admission
+            # pressure clears, when they finished elsewhere, or when
+            # nothing but deferred work remains — a shed unit is
+            # never silently dropped
+            if self._deferred:
+                clear = (self.admission is None or
+                         self.admission.pressure_cleared(self.backlog()))
+                for f in list(self._deferred):
+                    if self.board.is_done(f):
+                        self._deferred.remove(f)
+                        self._outstanding.discard(f)
+                        self._bump("done_elsewhere")
+                        progressed = True
+                        continue
+                    if not clear and pending:
+                        continue
+                    lease = self.board.claim(f)
+                    if lease is None and self.board.expired(f):
+                        lease = self.board.steal(f)
+                        if lease is not None:
+                            self._bump("stolen")
+                            self._ledger_steal(f, lease)
+                    if lease is None:
+                        continue
+                    self._deferred.remove(f)
+                    self._bump("readmitted")
+                    self._ledger_readmitted(f)
+                    progressed = True
+                    yield self._grant(f, lease)
             if progressed:
                 last_progress = self.clock()
             elif self.clock() - last_progress > self.stall_timeout_s:
                 self._abandon(pending)
                 return
-            if pending:
+            if pending or self._deferred:
                 self.sleep(self.poll_s)
+
+    def backlog(self) -> int:
+        """Units this rank still sees as not done anywhere, excluding
+        ones it shed (``deferred``) or currently holds — the
+        admission-control pressure signal."""
+        return max(len(self._outstanding) - len(self._deferred)
+                   - len(self._held), 0)
 
     def commit(self, filename: str) -> bool:
         """Publish ``filename`` done through the generation fence.
@@ -159,6 +267,7 @@ class Scheduler:
         ok = self.board.commit(lease)
         if ok:
             self._bump("committed")
+            self._outstanding.discard(filename)
             if lease.stolen_from is not None:
                 self._bump("recovered")
                 self._ledger_recovered(filename, lease)
@@ -170,6 +279,15 @@ class Scheduler:
                 announce_commit(self.state_dir, filename)
             except Exception:  # pragma: no cover - advisory only
                 pass
+            if self.chaos is not None:
+                # load_spike: a burst of extra units arrives at commit
+                # time — published to the shared manifest so EVERY
+                # rank's steal loop (including ours) picks them up
+                burst = self.chaos.maybe_spike(filename)
+                if burst:
+                    added = extend_manifest(self.state_dir, burst)
+                    if added:
+                        self._bump("spiked", len(added))
         else:
             self._bump("fence_rejects")
         return ok
@@ -198,8 +316,55 @@ class Scheduler:
                 self.heartbeat.pause()
         return filename
 
+    def _shed(self, filename: str, lease: Lease) -> bool:
+        """Admission-control gate on a just-claimed unit. True = the
+        unit was shed: claim released, ledgered ``deferred``, queued
+        locally for re-admission when pressure clears."""
+        if self.admission is None:
+            return False
+        reason = self.admission.should_defer(filename, self.backlog())
+        if not reason:
+            return False
+        self.board.release(lease)
+        self._deferred.append(filename)
+        self._bump("deferred")
+        logger.warning("scheduler rank %d: unit %s deferred under "
+                       "admission pressure (%s)", self.rank,
+                       os.path.basename(filename), reason)
+        if self.ledger is not None:
+            self.ledger.record(
+                filename, error=None, failure_class="quality",
+                disposition="deferred", stage="control.admission",
+                message=reason)
+        return True
+
+    def _poll_manifest(self) -> list:
+        """Pick up units appended to the shared manifest after this
+        rank built its queue (:func:`extend_manifest` — a load_spike
+        burst or an operator append); returns their full paths."""
+        man = read_manifest(self.state_dir)
+        if man is None:
+            return []
+        known = {os.path.basename(f) for f in self.files}
+        paths = man.get("added_paths", {})
+        home = os.path.dirname(self.files[0]) if self.files else ""
+        new = []
+        for name in man.get("files", []):
+            if name in known:
+                continue
+            new.append(paths.get(name) or
+                       (os.path.join(home, name) if home else name))
+        if new:
+            self.files.extend(new)
+            self._outstanding.update(new)
+            logger.info("scheduler rank %d: %d late unit(s) joined "
+                        "the queue", self.rank, len(new))
+        return new
+
     def _abandon(self, pending) -> None:
         self._bump("abandoned", len(pending))
+        for f in pending:
+            self._outstanding.discard(f)
         logger.error(
             "scheduler rank %d: queue stalled for %.0f s with %d "
             "unit(s) still leased elsewhere and not expiring — "
@@ -226,6 +391,15 @@ class Scheduler:
                     f"(heartbeat stale past "
                     f"{self.board.lease_ttl_s:g} s); redoing here as "
                     f"gen {lease.generation}")
+
+    def _ledger_readmitted(self, filename: str) -> None:
+        if self.ledger is None:
+            return
+        self.ledger.record(
+            filename, error=None, failure_class="quality",
+            disposition="readmitted", stage="control.admission",
+            message=f"admission pressure cleared; unit re-admitted "
+                    f"on rank {self.rank}")
 
     def _ledger_recovered(self, filename: str, lease: Lease) -> None:
         if self.ledger is None:

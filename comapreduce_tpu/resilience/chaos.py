@@ -56,6 +56,14 @@ list of ``kind[@substr][:rate]`` with rate in [0, 1] (default 1);
   ``current`` pointer still resolves to a COMPLETE epoch and that a
   restarted server republishes the lost epoch. Matches on the epoch
   name (``@epoch-000002`` aims it), fires at most once per monkey.
+- ``load_spike`` — a deterministic BURST of extra queued files lands
+  mid-run: when a matching file is committed, the elastic scheduler
+  asks :meth:`ChaosMonkey.maybe_spike` and appends the monkey's
+  ``spike_files`` (set by the drill harness) to the shared
+  ``queue.json`` manifest, exactly as a late observing session being
+  dropped into a live campaign would. Fires at most once per monkey —
+  the drill for admission control (``control.admission``), the same
+  way ``rank_kill`` drills the autoscaler.
 
 Whether a given file draws a given fault depends only on
 ``(seed, kind, basename)`` — stable across runs, across iteration
@@ -78,7 +86,8 @@ logger = logging.getLogger("comapreduce_tpu")
 
 CHAOS_KINDS = ("read_error", "truncate", "flaky", "nan_burst",
                "slow_read", "hang", "write_stall", "rank_kill",
-               "rank_pause", "late_file", "kill_mid_publish")
+               "rank_pause", "late_file", "kill_mid_publish",
+               "load_spike")
 
 # TOD datasets a NaN burst can poison, by payload schema
 _POISON_KEYS = ("spectrometer/tod", "averaged_tod/tod",
@@ -126,6 +135,9 @@ class ChaosMonkey:
         self.burst_frac = float(burst_frac)
         self.hang_s = float(hang_s)
         self.injected: list[tuple[str, str]] = []
+        # the burst a ``load_spike`` releases (maybe_spike): the drill
+        # harness fills this with the spike's filenames before the run
+        self.spike_files: list[str] = []
         self._attempts: dict[str, int] = {}
         self._lock = threading.Lock()
         self._release = threading.Event()
@@ -185,6 +197,25 @@ class ChaosMonkey:
         logger.warning("chaos: rank_pause — freezing heartbeat at "
                        "claim of %s (zombie mode)", filename)
         return True
+
+    def maybe_spike(self, filename: str) -> list:
+        """The burst of extra queued files a ``load_spike`` releases
+        when ``filename``'s commit matches — the elastic scheduler
+        appends these to the shared ``queue.json`` manifest mid-run
+        (``pipeline.scheduler.extend_manifest``). Empty when the kind
+        does not fire, the burst list is empty, or the spike already
+        fired (at most once per monkey: one spike with a known file
+        set keeps the drill's exactly-once audit exact)."""
+        if not self.spike_files or \
+                "load_spike" not in self.decide(filename):
+            return []
+        with self._lock:
+            if any(k == "load_spike" for _, k in self.injected):
+                return []
+            self.injected.append((filename, "load_spike"))
+        logger.warning("chaos: load_spike — %d extra file(s) queued at "
+                       "commit of %s", len(self.spike_files), filename)
+        return list(self.spike_files)
 
     def arrival_delay(self, filename: str) -> float:
         """Extra seconds before ``filename``'s commit becomes visible

@@ -44,8 +44,9 @@ import tempfile
 import threading
 import time
 
-__all__ = ["Heartbeat", "heartbeat_age_s", "heartbeat_path",
-           "heartbeat_stale", "read_heartbeats", "stale_age"]
+__all__ = ["Heartbeat", "HeartbeatWatch", "heartbeat_age_s",
+           "heartbeat_path", "heartbeat_signature", "heartbeat_stale",
+           "read_heartbeats", "stale_age"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -118,6 +119,102 @@ def heartbeat_stale(hb: dict | None, now: float | None = None,
     if hb is None:
         return True
     return stale_age(heartbeat_age_s(hb, now), ttl)
+
+
+def heartbeat_signature(hb: dict | None) -> tuple | None:
+    """The change-detection identity of one heartbeat: ``(seq,
+    t_wall_unix, _mtime)``. Two reads with the same signature carry no
+    evidence the writer lived between them; ANY component moving does.
+    One home for the tuple — the straggler barrier
+    (``parallel.multihost``) and the control-plane supervisor
+    (``control.supervisor``) must judge liveness by the same rule."""
+    if hb is None:
+        return None
+    return (hb.get("seq"), hb.get("t_wall_unix"), hb.get("_mtime"))
+
+
+class HeartbeatWatch:
+    """CHANGE-based liveness over a fleet of heartbeats.
+
+    A rank counts as ALIVE only when its heartbeat is *observed to
+    change* (a new :func:`heartbeat_signature` — advancing ``seq``,
+    fresh stamp or mtime — or a file appearing after the watch began)
+    within the trailing ``ttl_s`` window. A file already on disk at the
+    first :meth:`observe` proves nothing: it may be a crashed rank's
+    final beat, written milliseconds before the SIGKILL and fresh by
+    every timestamp — the exact artefact that must never read alive to
+    an autoscaler deciding whether to spawn a replacement. The rule is
+    also immune to cross-host clock skew: a future-stamped heartbeat
+    from a dead rank never changes, so it goes ``dead`` like any other
+    frozen file, while a skewed-but-beating rank still proves itself by
+    advancing ``seq``.
+
+    Verdicts per rank: ``"alive"`` (change observed within ``ttl_s``),
+    ``"unknown"`` (watched for less than ``ttl_s`` with no change yet —
+    the proving window of a fleet the watch just started over), and
+    ``"dead"`` (no change for at least ``ttl_s``; a rank never seen at
+    all is also ``dead``). The price of change-based proof is latency —
+    ``ttl_s`` must comfortably exceed the fleet's ``heartbeat_s``
+    ticker period or healthy ranks flap through ``dead`` between beats.
+
+    Both the pre-shard straggler barrier and the control plane's
+    supervisor poll through one instance of this class; the inline
+    baseline/signature logic they would otherwise each re-derive lives
+    here and nowhere else.
+    """
+
+    ALIVE = "alive"
+    UNKNOWN = "unknown"
+    DEAD = "dead"
+
+    def __init__(self, ttl_s: float, clock=time.monotonic):
+        self.ttl_s = float(ttl_s)
+        self.clock = clock
+        self._started: float | None = None
+        # rank -> [signature, t_ref, ever_changed]; t_ref is the last
+        # observed change (or first sighting while unchanged)
+        self._tracks: dict[int, list] = {}
+
+    def observe(self, heartbeats: dict) -> dict:
+        """Fold one ``read_heartbeats`` snapshot in; returns
+        ``{rank: verdict}`` for every rank ever seen."""
+        t = self.clock()
+        if self._started is None:
+            self._started = t
+        for rank, hb in heartbeats.items():
+            sig = heartbeat_signature(hb)
+            tr = self._tracks.get(rank)
+            if tr is None:
+                # first sighting: at the baseline scan (the watch's
+                # very first observe) the file proves nothing; a file
+                # APPEARING after the watch began is itself a change
+                self._tracks[rank] = [sig, t, t > self._started]
+            elif sig != tr[0]:
+                tr[0], tr[1], tr[2] = sig, t, True
+        return {rank: self.verdict(rank, now=t) for rank in self._tracks}
+
+    def verdict(self, rank: int, now: float | None = None) -> str:
+        """This rank's current liveness verdict (see class docstring)."""
+        tr = self._tracks.get(rank)
+        if tr is None:
+            return self.DEAD
+        t = self.clock() if now is None else now
+        sig, t_ref, changed = tr
+        if t - t_ref > self.ttl_s:
+            return self.DEAD
+        return self.ALIVE if changed else self.UNKNOWN
+
+    def alive_ranks(self) -> list:
+        return sorted(r for r in self._tracks
+                      if self.verdict(r) == self.ALIVE)
+
+    def dead_ranks(self, expected=()) -> list:
+        """Ranks with a ``dead`` verdict; ``expected`` ranks never seen
+        at all (no heartbeat file was ever observed) count too."""
+        seen = set(self._tracks)
+        dead = {r for r in seen if self.verdict(r) == self.DEAD}
+        dead |= {int(r) for r in expected} - seen
+        return sorted(dead)
 
 
 class Heartbeat:

@@ -37,7 +37,11 @@ operation itself may still have succeeded); ``stolen`` — an elastic
 campaign survivor reclaimed this unit's expired lease from a dead or
 zombie rank (``pipeline.scheduler``; never skipped — the unit is
 being redone right now), paired with a later ``recovered`` once the
-thief commits it.
+thief commits it; ``deferred`` — the control plane's admission gate
+shed this (quality-flagged) unit under SLO pressure
+(``control.admission``; never skipped — the unit stays in the queue
+and is paired with a later ``readmitted`` when pressure clears or
+the rest of the queue drains: shed, never dropped).
 """
 
 from __future__ import annotations
@@ -84,6 +88,10 @@ class LedgerEntry:
     disposition: str = "quarantined"
     stage: str = ""
     t: str = ""
+    # sub-second companion to ``t``: cross-rank latest-wins must order
+    # a defer and its re-admission correctly even within one second
+    # (0.0 on pre-control ledger lines — they sort first in their tie)
+    t_unix: float = 0.0
 
     @property
     def key(self) -> tuple:
@@ -153,15 +161,18 @@ class QuarantineLedger:
         mid-append) is dropped with a warning; a garbled line in the
         *middle* of a file is dropped too — one corrupt event must not
         cost the whole ledger. Cross-file ordering for latest-wins is
-        by timestamp (ISO strings sort), stable with the OWN file's
-        entries read last so they win same-second ties."""
+        by timestamp — ISO second first, then the sub-second ``t_unix``
+        (admission control defers and re-admits within one second) —
+        stable with the OWN file's entries read last so they win exact
+        ties."""
         self.entries = []
         self._latest = {}
         merged = []
         for p in self.read_paths:
             merged.extend(self._read_file(p))
         merged.extend(self._read_file(self.path))
-        merged.sort(key=lambda e: e.t)  # stable: own-file ties win
+        # stable: own-file exact ties win
+        merged.sort(key=lambda e: (e.t, e.t_unix))
         for entry in merged:
             self._remember(entry)
         return len(self.entries)
@@ -207,7 +218,8 @@ class QuarantineLedger:
             retries=int(retries),
             disposition=disposition,
             stage=stage,
-            t=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            t=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            t_unix=time.time())
         with self._lock:
             self._append(entry)
             self._remember(entry)

@@ -113,8 +113,14 @@ def build_report(output_dir: str, stale_s: float = 60.0,
             (stalls if e.disposition == "stalled" else hangs).append(row)
 
     queue, leases = _queue_report(output_dir, beats, stale_s, now)
+    supervisor = _supervisor_report(output_dir, now)
     return {
-        "schema": 2,
+        # schema 3 adds the "supervisor" block — ONLY when a control
+        # plane actually ran here (supervisor.json exists); runs with
+        # no supervisor stay byte-for-byte schema 2
+        "schema": 3 if supervisor is not None else 2,
+        **({"supervisor": supervisor}
+           if supervisor is not None else {}),
         "output_dir": output_dir,
         "stale_s": stale_s,
         "ranks": ranks,
@@ -135,8 +141,39 @@ def build_report(output_dir: str, stale_s: float = 60.0,
 def report_healthy(rep: dict) -> bool:
     """The probe rule shared by the CLI exit code and ``/healthz``: an
     expired-but-unreclaimed lease means work nobody will finish —
-    fail it like a stale rank."""
-    return not (rep["n_stale"] or rep["n_expired_leases"])
+    fail it like a stale rank. Schema 3 adds: a supervisor that
+    stopped republishing mid-campaign is a dead control loop — the
+    autoscaler will never replace the NEXT dead rank."""
+    stuck = bool((rep.get("supervisor") or {}).get("stuck"))
+    return not (rep["n_stale"] or rep["n_expired_leases"] or stuck)
+
+
+def _supervisor_report(state_dir: str, now: float) -> dict | None:
+    """The control-plane block of the schema-3 report: the latest
+    ``supervisor.json`` snapshot plus the stuck verdict and the last
+    recorded ``control.decision``; None (stay schema 2) when no
+    supervisor ever published here."""
+    from comapreduce_tpu.control.supervisor import (read_supervisor,
+                                                    supervisor_stuck)
+
+    snap = read_supervisor(state_dir)
+    if snap is None:
+        return None
+    return {
+        "t_unix": snap.get("t_unix"),
+        "age_s": round(now - float(snap.get("t_unix") or 0.0), 1),
+        "desired_ranks": snap.get("desired_ranks"),
+        "live_ranks": snap.get("live_ranks", []),
+        "dead_ranks": snap.get("dead_ranks", []),
+        "backlog": snap.get("backlog"),
+        "shed_backlog": snap.get("shed_backlog"),
+        "files_per_hour": snap.get("files_per_hour"),
+        "eta_s": snap.get("eta_s"),
+        "drained": bool(snap.get("drained")),
+        "n_decisions": snap.get("n_decisions", 0),
+        "last_decision": snap.get("last_decision"),
+        "stuck": supervisor_stuck(snap, now),
+    }
 
 
 def _queue_report(state_dir: str, beats: dict, stale_s: float,

@@ -1,0 +1,592 @@
+"""Closed-loop control plane (ISSUE 17).
+
+Unit-level pins for the three control loops and their shared
+plumbing: the strict ``[control]`` config table, the decision ledger
+(every action auditable), the pure autoscale policy (replace the
+dead, fill to the floor, cooldown-hysteresis scale-up, advisory
+retire, never reuse a dead rank's id), the supervisor's sense cycle
+(a crashed rank's final heartbeat must never read alive to the
+autoscaler — the satellite regression), SLO-driven admission control
+(shed ``deferred``, never dropped; re-admitted when pressure clears)
+through the real elastic scheduler, the evidence-driven solver policy
+over synthetic traces/registry/programs, and the schema-3 watchdog
+report. The four-rank end-to-end version (real SIGKILLs, real
+load_spike, exact /metrics audit, byte-identical map) is
+``run_control_drill`` — exercised here under the ``slow`` marker and
+in CI as ``check_resilience.py --control-only``.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from comapreduce_tpu.control.config import ControlConfig
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _beat(directory, rank, seq=1):
+    """A handwritten heartbeat file with a FRESH wall time — the watch
+    must judge by change, never by apparent freshness."""
+    from comapreduce_tpu.resilience.heartbeat import heartbeat_path
+
+    p = heartbeat_path(str(directory), rank)
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump({"rank": rank, "seq": seq,
+                   "t_wall_unix": time.time()}, f)
+    return p
+
+
+def _manifest(directory, files):
+    with open(os.path.join(str(directory), "queue.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"schema": 1, "n": len(files),
+                   "files": [os.path.basename(x) for x in files],
+                   "t_wall": "2026-08-07T00:00:00Z"}, f)
+
+
+# -- [control] config ------------------------------------------------------
+
+def test_config_defaults_every_loop_off():
+    cfg = ControlConfig.coerce(None)
+    assert not cfg.autoscale and not cfg.admission \
+        and not cfg.solver_policy
+    assert not cfg.enabled
+    # coercing an instance is the identity
+    assert ControlConfig.coerce(cfg) is cfg
+
+
+def test_config_strict_coerce_rejects_typos():
+    with pytest.raises(ValueError, match="unknown"):
+        ControlConfig.coerce({"autoscael": True})
+
+
+def test_config_ini_string_knobs():
+    # legacy INI delivers strings; bools must parse, not truthy-cast
+    cfg = ControlConfig.coerce({"autoscale": "true",
+                                "admission": "no",
+                                "min_ranks": "2", "max_ranks": "4",
+                                "poll_s": "0.5"})
+    assert cfg.autoscale and not cfg.admission
+    assert cfg.min_ranks == 2 and cfg.max_ranks == 4
+    assert cfg.poll_s == 0.5 and cfg.enabled
+
+
+@pytest.mark.parametrize("bad", [
+    {"min_ranks": 0},
+    {"min_ranks": 4, "max_ranks": 2},
+    {"shed_low_water": 9, "shed_high_water": 4},
+    {"poll_s": 0},
+    {"cooldown_s": -1},
+])
+def test_config_validation_raises(bad):
+    with pytest.raises(ValueError):
+        ControlConfig.coerce(bad)
+
+
+# -- decision ledger -------------------------------------------------------
+
+def test_decisions_roundtrip_merge_and_torn_line(tmp_path):
+    from comapreduce_tpu.control.decisions import (read_decisions,
+                                                   record_decision)
+
+    record_decision(str(tmp_path), "autoscaler", "spawn", "r0",
+                    ranks=[4])
+    record_decision(str(tmp_path), "admission", "defer", "r1",
+                    writer="rank2", file="x.hd5")
+    # a torn trailing line (kill mid-append) is dropped, never fatal
+    with open(tmp_path / "decisions.rank2.jsonl", "a",
+              encoding="utf-8") as f:
+        f.write('{"loop": "adm')
+    got = read_decisions(str(tmp_path))
+    assert [g["action"] for g in got] == ["spawn", "defer"]
+    assert got[0]["ranks"] == [4] and got[1]["file"] == "x.hd5"
+    assert all(g["schema"] == 1 and g["t_unix"] > 0 for g in got)
+
+
+# -- autoscale policy (pure decisions) -------------------------------------
+
+def test_policy_replaces_dead_with_fresh_ids_no_cooldown():
+    from comapreduce_tpu.control.autoscaler import AutoscalePolicy
+
+    clock = FakeClock()
+    pol = AutoscalePolicy(ControlConfig(autoscale=True, min_ranks=2,
+                                        max_ranks=8, cooldown_s=1e9),
+                          clock=clock)
+    d = pol.decide(backlog=5, live_ranks=[2, 3], dead_ranks=[0, 1])
+    # a crash never waits out the cooldown, and a replacement never
+    # reuses a dead rank's id — its stale lease/heartbeat files must
+    # not masquerade as the newcomer's
+    assert d is not None and d.action == "spawn"
+    assert d.ranks == (4, 5)
+    # reserved ids (ranks ever seen by the manager) also floor fresh
+    # allocation
+    d = pol.decide(backlog=5, live_ranks=[2, 3], dead_ranks=[0],
+                   reserved_ranks=[7])
+    assert d.ranks == (8,)
+
+
+def test_policy_dead_without_backlog_spawns_nothing():
+    from comapreduce_tpu.control.autoscaler import AutoscalePolicy
+
+    pol = AutoscalePolicy(ControlConfig(autoscale=True, min_ranks=1),
+                          clock=FakeClock())
+    assert pol.decide(backlog=0, live_ranks=[1], dead_ranks=[0]) is None
+
+
+def test_policy_fills_to_the_floor():
+    from comapreduce_tpu.control.autoscaler import AutoscalePolicy
+
+    pol = AutoscalePolicy(ControlConfig(autoscale=True, min_ranks=4,
+                                        max_ranks=8, cooldown_s=1e9),
+                          clock=FakeClock())
+    d = pol.decide(backlog=10, live_ranks=[0])
+    assert d.action == "spawn" and d.ranks == (1, 2, 3)
+
+
+def test_policy_scale_up_respects_cooldown_and_note_spawned():
+    from comapreduce_tpu.control.autoscaler import AutoscalePolicy
+
+    clock = FakeClock()
+    pol = AutoscalePolicy(ControlConfig(autoscale=True, min_ranks=1,
+                                        max_ranks=8, cooldown_s=30.0),
+                          clock=clock)
+    # backlog > 2 x live: one rank per cooldown window, not a thundering
+    # herd
+    d = pol.decide(backlog=10, live_ranks=[0])
+    assert d.action == "spawn" and d.ranks == (1,)
+    assert pol.decide(backlog=10, live_ranks=[0, 1]) is None
+    clock.advance(31.0)
+    d = pol.decide(backlog=10, live_ranks=[0, 1])
+    assert d is not None and d.ranks == (2,)
+    # an out-of-band spawn (replacement / floor fill) restarts the
+    # spacing too
+    clock.advance(31.0)
+    pol.note_spawned()
+    assert pol.decide(backlog=10, live_ranks=[0, 1, 2]) is None
+
+
+def test_policy_target_rate_rule():
+    from comapreduce_tpu.control.autoscaler import AutoscalePolicy
+
+    pol = AutoscalePolicy(
+        ControlConfig(autoscale=True, min_ranks=1, max_ranks=8,
+                      target_files_per_hour=100.0, cooldown_s=0.0),
+        clock=FakeClock())
+    # shallow backlog but measured rate below target: still scale up
+    d = pol.decide(backlog=1, live_ranks=[0], files_per_hour=10.0)
+    assert d is not None and "below" in d.reason
+    # rate at target, shallow backlog: steady state
+    assert pol.decide(backlog=1, live_ranks=[0],
+                      files_per_hour=200.0) is None
+
+
+def test_policy_retire_is_advisory_and_once_per_idle_episode():
+    from comapreduce_tpu.control.autoscaler import AutoscalePolicy
+
+    pol = AutoscalePolicy(ControlConfig(autoscale=True, min_ranks=1,
+                                        max_ranks=8),
+                          clock=FakeClock())
+    d = pol.decide(backlog=0, live_ranks=[0, 1, 2])
+    assert d.action == "retire" and d.ranks == (1, 2)
+    # one retire line per idle episode, not one per poll
+    assert pol.decide(backlog=0, live_ranks=[0, 1, 2]) is None
+    pol.decide(backlog=3, live_ranks=[0, 1, 2])  # work returns
+    d = pol.decide(backlog=0, live_ranks=[0, 1, 2])
+    assert d is not None and d.action == "retire"
+
+
+def test_policy_capped_at_max_ranks():
+    from comapreduce_tpu.control.autoscaler import AutoscalePolicy
+
+    pol = AutoscalePolicy(ControlConfig(autoscale=True, min_ranks=1,
+                                        max_ranks=2, cooldown_s=0.0),
+                          clock=FakeClock())
+    assert pol.decide(backlog=50, live_ranks=[0, 1],
+                      dead_ranks=[2]) is None
+
+
+# -- supervisor sense (the liveness satellite) -----------------------------
+
+class FakeManager:
+    """RankManager stand-in: scripted reaps, recorded spawns."""
+
+    def __init__(self):
+        self.to_reap = []
+        self.live = []
+        self.spawned = []
+        self.exited = []
+
+    def reap(self):
+        out, self.to_reap = self.to_reap, []
+        self.exited.extend(out)
+        return out
+
+    def live_ranks(self):
+        return list(self.live)
+
+    def all_ranks(self):
+        return sorted(set(self.live) | {r for r, _ in self.exited}
+                      | set(self.spawned))
+
+    def spawn(self, rank):
+        self.spawned.append(int(rank))
+        self.live.append(int(rank))
+        return 12345
+
+
+def test_crashed_ranks_final_beat_never_reads_alive(tmp_path):
+    """The satellite regression: a SIGKILLed rank's last heartbeat
+    still looks wall-clock fresh (and sits inside the watch TTL), but
+    the supervisor must count the rank dead the moment the manager
+    reaps it — and its replacement must take a FRESH id."""
+    from comapreduce_tpu.control.supervisor import Supervisor
+
+    clock = FakeClock()
+    mgr = FakeManager()
+    cfg = ControlConfig(autoscale=True, min_ranks=1, max_ranks=4,
+                        liveness_ttl_s=1000.0)
+    sup = Supervisor(str(tmp_path), cfg, manager=mgr,
+                     lease_ttl_s=5.0, clock=clock)
+    _manifest(tmp_path, ["a.hd5", "b.hd5"])  # backlog 2, nothing done
+    mgr.live = [0]
+    _beat(tmp_path, 0, seq=1)
+    sup.sense()                      # first observe: presence proves 0
+    clock.advance(0.5)
+    _beat(tmp_path, 0, seq=2)        # a CHANGE: now genuinely alive
+    s = sup.sense()
+    assert s["live_ranks"] == [0] and s["dead_ranks"] == []
+    # SIGKILL: the manager reaps rc=-9 while the final beat is still
+    # well inside the liveness TTL and carries a fresh wall time
+    mgr.live = []
+    mgr.to_reap = [(0, -9)]
+    s = sup.sense()
+    assert s["live_ranks"] == []     # the final beat does NOT read alive
+    assert s["dead_ranks"] == [0]
+    snap = sup.step()                # decide + act on the next cycle
+    assert mgr.spawned and mgr.spawned[0] != 0
+    assert snap["last_decision"]["action"] == "spawn"
+    assert 0 in snap["dead_ranks"]
+    # replaced at most once: the next sense no longer lists 0 dead
+    assert sup.sense()["dead_ranks"] == []
+
+
+def test_just_spawned_child_without_heartbeat_counts_live(tmp_path):
+    """A child in its python-startup window (no heartbeat file yet)
+    is STARTING, not dead — or fill-to-the-floor would refire every
+    poll and fork-bomb the host."""
+    from comapreduce_tpu.control.supervisor import Supervisor
+
+    clock = FakeClock()
+    mgr = FakeManager()
+    cfg = ControlConfig(autoscale=True, min_ranks=2, max_ranks=4)
+    sup = Supervisor(str(tmp_path), cfg, manager=mgr,
+                     lease_ttl_s=5.0, clock=clock)
+    _manifest(tmp_path, ["a.hd5", "b.hd5", "c.hd5"])
+    sup.step()                       # floor fill: spawns 0 and 1
+    assert sorted(mgr.spawned) == [0, 1]
+    sup.step()                       # no beats yet — must NOT respawn
+    sup.step()
+    assert sorted(mgr.spawned) == [0, 1]
+
+
+def test_supervisor_snapshot_and_stuck_rule(tmp_path):
+    from comapreduce_tpu.control.supervisor import (Supervisor,
+                                                    read_supervisor,
+                                                    supervisor_stuck)
+
+    assert read_supervisor(str(tmp_path)) is None
+    sup = Supervisor(str(tmp_path), ControlConfig(autoscale=True),
+                     manager=None, lease_ttl_s=5.0, clock=FakeClock())
+    _manifest(tmp_path, ["a.hd5"])
+    snap = sup.step()
+    assert read_supervisor(str(tmp_path))["backlog"] == 1
+    assert not snap["drained"]
+    # freshly published: not stuck; silent for 5 polls + grace: stuck
+    assert not supervisor_stuck(snap, now=snap["t_unix"] + 1.0)
+    assert supervisor_stuck(snap, now=snap["t_unix"] + 1e4)
+    # a drained campaign's supervisor legitimately stops publishing
+    assert not supervisor_stuck({"drained": True, "t_unix": 0.0,
+                                 "poll_s": 1.0})
+    assert not supervisor_stuck(None)
+
+
+def test_watchdog_report_gains_supervisor_block_only_when_present(
+        tmp_path):
+    """Schema 3 only when a control plane ran here — a run without
+    ``supervisor.json`` stays byte-for-byte the schema-2 report."""
+    from comapreduce_tpu.resilience.status import (build_report,
+                                                   report_healthy)
+
+    rep = build_report(str(tmp_path), stale_s=60.0)
+    assert rep["schema"] == 2 and "supervisor" not in rep
+    assert report_healthy(rep)
+    with open(tmp_path / "supervisor.json", "w", encoding="utf-8") as f:
+        json.dump({"schema": 1, "t_unix": time.time(), "poll_s": 0.5,
+                   "desired_ranks": 4, "live_ranks": [0, 1],
+                   "dead_ranks": [2], "backlog": 3, "shed_backlog": 1,
+                   "files_per_hour": 12.0, "eta_s": 900.0,
+                   "drained": False, "n_decisions": 2,
+                   "last_decision": {"loop": "autoscaler",
+                                     "action": "spawn",
+                                     "reason": "r"}}, f)
+    rep = build_report(str(tmp_path), stale_s=60.0)
+    assert rep["schema"] == 3
+    sup = rep["supervisor"]
+    assert sup["desired_ranks"] == 4 and sup["live_ranks"] == [0, 1]
+    assert sup["shed_backlog"] == 1 and not sup["stuck"]
+    assert report_healthy(rep)
+    # the tool renders the block without crashing
+    import tools.watchdog_report as wr
+
+    text = wr.render_text(rep)
+    assert "supervisor:" in text and "last decision" in text
+    # a supervisor that stopped republishing mid-campaign fails the
+    # probe — the autoscaler will never replace the NEXT dead rank
+    with open(tmp_path / "supervisor.json", "w", encoding="utf-8") as f:
+        json.dump({"schema": 1, "t_unix": time.time() - 1e4,
+                   "poll_s": 0.5, "drained": False}, f)
+    rep = build_report(str(tmp_path), stale_s=60.0)
+    assert rep["supervisor"]["stuck"] and not report_healthy(rep)
+
+
+# -- admission control -----------------------------------------------------
+
+def test_admission_hysteresis_and_flag_gate(tmp_path):
+    from comapreduce_tpu.control.admission import AdmissionController
+    from comapreduce_tpu.control.decisions import read_decisions
+
+    cfg = ControlConfig(admission=True, shed_high_water=4,
+                        shed_low_water=1)
+    gate = AdmissionController(cfg, str(tmp_path), rank=2,
+                               flagged=["/x/bad.hd5"])
+    # below the high water: nothing shed, flagged or not
+    assert gate.should_defer("bad.hd5", 3) is None
+    # at the high water mark shedding latches ON — but only
+    # SLO-flagged files are ever shed; pressure never touches healthy
+    # data
+    assert gate.should_defer("good.hd5", 4) is None
+    assert gate.should_defer("bad.hd5", 4) is not None
+    # hysteresis: inside the band (low < backlog < high) it stays on
+    assert gate.should_defer("bad.hd5", 2) is not None
+    assert not gate.pressure_cleared(2)
+    # at the low water it unlatches and deferred work may return
+    assert gate.pressure_cleared(1)
+    assert gate.should_defer("bad.hd5", 1) is None
+    acts = [d["action"] for d in read_decisions(str(tmp_path))]
+    assert acts == ["shed_on", "defer", "defer", "shed_off"]
+
+
+def test_scheduler_sheds_deferred_and_readmits(tmp_path):
+    """The shed/defer loop through the real elastic scheduler: a
+    flagged unit under pressure is released + ledgered ``deferred``,
+    then re-admitted and committed when pressure clears — delayed,
+    never dropped."""
+    from comapreduce_tpu.control.admission import AdmissionController
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+    from comapreduce_tpu.resilience.ledger import QuarantineLedger
+
+    files = ["/d/obs-0.hd5", "/d/obs-1.hd5", "/d/flagged.hd5"]
+    cfg = ControlConfig(admission=True, shed_high_water=2,
+                        shed_low_water=0)
+    gate = AdmissionController(cfg, str(tmp_path), rank=0,
+                               flagged=["flagged.hd5"])
+    ledger = QuarantineLedger(str(tmp_path / "quarantine.rank0.jsonl"))
+    s = Scheduler(files, str(tmp_path), rank=0, n_ranks=1,
+                  lease_ttl_s=5.0, poll_s=0.01, ledger=ledger,
+                  admission=gate)
+    got = [f for f in s.claim_iter() if s.commit(f)]
+    # every unit committed exactly once, the flagged one LAST (it sat
+    # deferred until the healthy bulk drained)
+    assert sorted(got) == sorted(files)
+    assert got[-1] == "/d/flagged.hd5"
+    assert s.stats["deferred"] == 1 and s.stats["readmitted"] == 1
+    assert s.stats["committed"] == 3
+    disps = [e.disposition for e in ledger.entries
+             if os.path.basename(e.unit["file"]) == "flagged.hd5"]
+    assert disps == ["deferred", "readmitted"]
+    # the ledger's latest-wins view shows no shed backlog left
+    assert not any(k.endswith(":deferred")
+                   for k in ledger.summary())
+
+
+def test_admission_off_is_byte_identical_schedule(tmp_path):
+    """No [control] table → the scheduler takes the uncontrolled path:
+    identical claim order, zero control artifacts."""
+    from comapreduce_tpu.pipeline.scheduler import Scheduler
+
+    files = [f"/d/obs-{i}.hd5" for i in range(4)]
+    s = Scheduler(files, str(tmp_path), rank=0, n_ranks=1,
+                  lease_ttl_s=5.0, admission=None)
+    got = [f for f in s.claim_iter() if s.commit(f)]
+    assert got == files
+    assert s.stats["deferred"] == 0 and s.stats["readmitted"] == 0
+    assert not list(tmp_path.glob("decisions.*.jsonl"))
+
+
+def test_runner_admission_gate_coercion(tmp_path):
+    """[control]/[Control] ride both config loaders; admission only
+    materialises a controller when the knob is on."""
+    from comapreduce_tpu.pipeline.runner import Runner
+
+    r = Runner.from_config({
+        "Global": {"processes": [], "output_dir": str(tmp_path)},
+        "control": {"admission": True, "shed_high_water": 9},
+    })
+    assert isinstance(r.control, ControlConfig)
+    assert r.control.admission and r.control.shed_high_water == 9
+
+    class Res:
+        state_dir = str(tmp_path)
+
+    gate = r._admission_gate(Res())
+    assert gate is not None and gate.cfg.shed_high_water == 9
+    # default: loop off, gate None — the scheduler never sees it
+    r2 = Runner.from_config({
+        "Global": {"processes": [], "output_dir": str(tmp_path)}})
+    assert not r2.control.enabled
+    assert r2._admission_gate(Res()) is None
+
+
+# -- solver policy ---------------------------------------------------------
+
+def _solves(rung, n, iters, converged=True, stalled=False):
+    return [{"schema": 1, "kind": "solve", "band": "band0",
+             "n_iter": iters, "residual": 1e-7,
+             "converged": converged, "diverged": False,
+             "stalled": stalled, "stalled_at": None, "base": 0,
+             "precond_id": f"{rung}|block=8", "precision_id": ""}
+            for _ in range(n)]
+
+
+def _write_trace(tmp_path, records):
+    with open(tmp_path / "solver.rank0.jsonl", "w",
+              encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_rung_order_mirrors_the_destriper_config():
+    """RUNG_ORDER and CONFIG_PRECONDITIONERS are two homes for one
+    ladder — this pin is what keeps them from drifting."""
+    from comapreduce_tpu.control.policy import RUNG_ORDER
+    from comapreduce_tpu.mapmaking.destriper import \
+        CONFIG_PRECONDITIONERS
+
+    assert RUNG_ORDER == tuple(CONFIG_PRECONDITIONERS)
+
+
+def test_choose_solver_no_evidence_no_overrides(tmp_path):
+    from comapreduce_tpu.control.policy import choose_solver
+
+    out = choose_solver(str(tmp_path), static={"preconditioner":
+                                               "jacobi"})
+    assert out == {"reasons": []}
+    assert not list(tmp_path.glob("decisions.*.jsonl"))
+
+
+def test_choose_solver_picks_cheapest_healthy_rung(tmp_path):
+    from comapreduce_tpu.control.decisions import read_decisions
+    from comapreduce_tpu.control.policy import choose_solver
+
+    _write_trace(tmp_path, _solves("jacobi", 3, 12)
+                 + _solves("multigrid", 3, 30))
+    out = choose_solver(str(tmp_path),
+                        static={"preconditioner": "multigrid",
+                                "mg_block": 8})
+    assert out["preconditioner"] == "jacobi"
+    assert any("iters/solve" in r for r in out["reasons"])
+    # the override is an auditable control.decision event
+    dec = read_decisions(str(tmp_path))
+    assert dec and dec[0]["loop"] == "solver" \
+        and dec[0]["action"] == "override" \
+        and dec[0]["knob"] == "preconditioner"
+
+
+def test_choose_solver_escalates_off_a_sick_rung(tmp_path):
+    from comapreduce_tpu.control.policy import choose_solver
+
+    _write_trace(tmp_path,
+                 _solves("jacobi", 2, 400, converged=False,
+                         stalled=True)
+                 + _solves("twolevel", 2, 40))
+    out = choose_solver(str(tmp_path),
+                        static={"preconditioner": "jacobi"},
+                        record=False)
+    assert out["preconditioner"] == "twolevel"
+    assert any("stalled/diverged" in r for r in out["reasons"])
+    # record=False (dry-run / report use) writes no ledger
+    assert not list(tmp_path.glob("decisions.*.jsonl"))
+
+
+def test_choose_solver_registry_delta_escalates_one_rung(tmp_path):
+    from comapreduce_tpu.control.policy import choose_solver
+
+    _write_trace(tmp_path, _solves("twolevel", 2, 60))
+    reg = tmp_path / "runs.jsonl"
+    with open(reg, "w", encoding="utf-8") as f:
+        for _ in range(5):
+            f.write(json.dumps({"kind": "perf",
+                                "metrics": {"destriper_cg_iters": 20}})
+                    + "\n")
+    out = choose_solver(str(tmp_path),
+                        static={"preconditioner": "twolevel"},
+                        registry_path=str(reg), record=False)
+    # 60 iters vs a trailing median of 20: 3x >= the 1.5 threshold —
+    # escalate one rung up the ladder, and escalating INTO multigrid
+    # with no block configured gets the documented default
+    assert out["preconditioner"] == "multigrid"
+    assert out["mg_block"] == 8
+    assert any("registry median" in r for r in out["reasons"])
+
+
+def test_choose_solver_halves_pair_batch_on_hbm_pressure(tmp_path):
+    from comapreduce_tpu.control.policy import (PAIR_TEMP_BUDGET,
+                                                choose_solver)
+
+    _write_trace(tmp_path, _solves("jacobi", 2, 10))
+    with open(tmp_path / "programs.jsonl", "w", encoding="utf-8") as f:
+        f.write(json.dumps({"schema": 1, "kind": "program",
+                            "name": "planned_matvec",
+                            "shape_bucket": "f32[1048576]x8",
+                            "precision_id": "tod=float32",
+                            "temp_bytes": PAIR_TEMP_BUDGET + 1,
+                            "output_bytes": 0}) + "\n")
+    out = choose_solver(str(tmp_path),
+                        static={"preconditioner": "jacobi",
+                                "pair_batch": 8}, record=False)
+    assert out["pair_batch"] == 4
+    assert "preconditioner" not in out  # jacobi healthy: rung stands
+
+
+# -- the end-to-end drill (CI: check_resilience.py --control-only) ---------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_control_drill_end_to_end(tmp_path):
+    """The acceptance drill: supervisor rollout of 4 worker ranks, 2
+    SIGKILLed mid-campaign and replaced within one policy decision, a
+    load_spike landing flagged files that admission sheds and
+    re-admits, exact /metrics commit audit, byte-identical final
+    map."""
+    from comapreduce_tpu.control.drill import run_control_drill
+
+    ev = run_control_drill(str(tmp_path), seed=0)
+    assert ev["control_drained"] and ev["control_n_done"] == 15
+    assert ev["control_replaced"] == [0, 1]
+    assert len(ev["control_spawned"]) >= 2
+    assert len(ev["control_shed"]) == 3
+    assert ev["control_committed_metric"] == 15.0
+    assert ev["control_map_byte_identical"]
+    assert ev["control_supervisor_snapshot"]["shed_backlog"] == 0

@@ -6,9 +6,12 @@ Subcommands::
     serve    run the live observability sidecar (blocking):
              /metrics (Prometheus), /healthz, /v1/campaign,
              /v1/quality over one campaign state directory
-    status   one-shot campaign report (the schema-2 watchdog report,
-             fetched from a running sidecar with --url, else built
-             straight from the state directory)
+    status   one-shot campaign report (the watchdog report — schema 3
+             with the supervisor block when a control plane ran,
+             schema 2 otherwise — fetched from a running sidecar with
+             --url, else built straight from the state directory;
+             local reads also surface the latest control.decision
+             events, docs/OPERATIONS.md §19)
     check    liveness probe for cron/CI: exit 0 healthy, 1 not
              (same rule as /healthz and watchdog_report's exit code)
     trend    compare the newest run-registry record against the
@@ -68,22 +71,52 @@ def _fetch_report(args) -> dict:
                         n_ranks=args.n_ranks)
 
 
+def _render_decisions(state_dir: str, last: int = 10) -> str:
+    """The latest ``control.decision`` events of this campaign, one
+    line each — the control plane's audit trail in the live view
+    (docs/OPERATIONS.md §19). Empty string when no loop ever decided
+    anything here (no control plane ran, or every loop is off)."""
+    from comapreduce_tpu.control.decisions import read_decisions
+
+    events = read_decisions(state_dir)
+    if not events:
+        return ""
+    lines = [f"control decisions ({len(events)} total, "
+             f"latest {min(last, len(events))}):"]
+    for e in events[-last:]:
+        lines.append(f"  {e.get('t')} [{e.get('loop')}] "
+                     f"{e.get('action'):<10} {e.get('reason')}")
+    return "\n".join(lines)
+
+
 def cmd_status(args) -> int:
     from tools.watchdog_report import render_text
 
     rep = _fetch_report(args)
-    print(json.dumps(rep, sort_keys=True) if args.json
-          else render_text(rep))
+    if args.json:
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print(render_text(rep))
+        # the decision ledger is on-disk state (not part of the
+        # /v1/campaign payload) — readable only when we have the dir
+        if not args.url:
+            dec = _render_decisions(rep.get("output_dir")
+                                    or args.state_dir)
+            if dec:
+                print()
+                print(dec)
     return 0 if report_healthy(rep) else 1
 
 
 def cmd_check(args) -> int:
     rep = _fetch_report(args)
     ok = report_healthy(rep)
+    stuck = bool((rep.get("supervisor") or {}).get("stuck"))
     print(f"{'healthy' if ok else 'UNHEALTHY'}: "
           f"{rep['n_stale']} stale rank(s), "
-          f"{rep['n_expired_leases']} expired lease(s) "
-          f"({rep['output_dir']})")
+          f"{rep['n_expired_leases']} expired lease(s)"
+          + (", STUCK supervisor" if stuck else "")
+          + f" ({rep['output_dir']})")
     return 0 if ok else 1
 
 

@@ -71,6 +71,19 @@ census is the full campaign, the tile manifest tracking ``current``,
 and the ``/metrics`` per-rank commit counters EXACTLY equal to each
 surviving scheduler's own count (docs/OPERATIONS.md §18).
 
+``--control-only`` runs the closed-loop control-plane drill
+(``comapreduce_tpu/control/drill.py`` — a ``Supervisor`` + real
+``RankManager`` children over a 12-file elastic campaign): the
+autoscaler's fill-to-the-floor performs the initial 4-rank rollout,
+ranks 0 and 1 are SIGKILLed at their third claim and replaced by
+fresh rank ids within one policy decision, a ``load_spike`` chaos
+fault lands 3 pre-flagged files mid-run which every rank's admission
+gate sheds ``deferred`` under SLO pressure and re-admits when it
+clears (never dropped — asserted through the merged quarantine
+ledgers), the ``/metrics`` commit counter equals the lease board's
+done count EXACTLY, and the final map over the committed set is
+byte-identical to an undisturbed run (docs/OPERATIONS.md §19).
+
 Prints one JSON evidence line; non-zero exit (with the broken
 criterion named) on any failure. Also wired into CI as ``bench.py
 --config resilience``.
@@ -115,6 +128,14 @@ def main(argv=None) -> int:
                       "generated synth:// campaign through elastic "
                       "ranks + map server + tile tier with a mid-run "
                       "rank kill/rejoin)")
+    only.add_argument("--control-only", action="store_true",
+                      help="run only the control-plane drill (the "
+                      "supervisor rolls out 4 worker ranks, 2 are "
+                      "SIGKILLed mid-campaign and replaced within the "
+                      "policy, a load_spike lands flagged files that "
+                      "admission sheds 'deferred' and re-admits, with "
+                      "exact /metrics commit audit and a byte-"
+                      "identical final map)")
     ap.add_argument("--n-files", type=int, default=200,
                     help="campaign size for --synthetic-only "
                     "(default 200)")
@@ -133,6 +154,10 @@ def main(argv=None) -> int:
         def drill(workdir, seed=0):
             return run_synthetic_drill(workdir, seed=seed,
                                        n_files=args.n_files)
+    elif args.control_only:
+        from comapreduce_tpu.control.drill import run_control_drill
+
+        drill = run_control_drill
     else:
         drill = (run_live_drill if args.live_only
                  else run_tiles_drill if args.tiles_only

@@ -24,8 +24,15 @@ observability plane's ``/v1/campaign`` endpoint —
 docs/OPERATIONS.md §16); this tool only renders and sets the exit
 code.
 
+When a control-plane supervisor ran in the same state directory
+(``supervisor.json`` present — docs/OPERATIONS.md §19) the report is
+schema 3 and adds the supervisor block: desired vs live ranks, the
+last ``control.decision``, the shed backlog, and a STUCK verdict when
+the supervisor stopped republishing mid-campaign.
+
 Exit code: 0 when every expected rank's heartbeat is fresher than
-``--stale-s`` AND no lease is expired-but-unreclaimed; 1 otherwise
+``--stale-s`` AND no lease is expired-but-unreclaimed AND no stuck
+supervisor; 1 otherwise
 (so the report doubles as a liveness probe in cron/CI). ``--n-ranks``
 sets the expected rank count (default: the ranks that have heartbeat
 files — a fully dead rank that never wrote one can only be caught
@@ -76,6 +83,32 @@ def render_text(rep: dict) -> str:
                          f"{dl.get('state')} after "
                          f"{dl.get('elapsed_s')} s")
     lines.append("")
+    sup = rep.get("supervisor")
+    if sup:
+        # schema 3: a control plane ran here — desired vs live ranks,
+        # the last decision, and the shed backlog are the on-call view
+        flag = ("  STUCK (stopped republishing mid-campaign)"
+                if sup.get("stuck")
+                else "  drained" if sup.get("drained") else "")
+        lines.append(
+            f"supervisor: desired {sup.get('desired_ranks')} rank(s), "
+            f"live {sup.get('live_ranks')}, dead {sup.get('dead_ranks')}"
+            f"  (snapshot age {sup.get('age_s', 0):.1f} s){flag}")
+        lines.append(
+            f"  backlog {sup.get('backlog')}  shed backlog "
+            f"{sup.get('shed_backlog')}  "
+            f"{sup.get('files_per_hour') or 0:.1f} files/h  "
+            f"eta {sup.get('eta_s') if sup.get('eta_s') is not None else '-'} s  "
+            f"{sup.get('n_decisions', 0)} decision(s)")
+        last = sup.get("last_decision") or {}
+        if last:
+            lines.append(f"  last decision: [{last.get('loop')}] "
+                         f"{last.get('action')} — {last.get('reason')}")
+        if sup.get("stuck"):
+            lines.append(
+                "  a stuck supervisor cannot replace the next dead "
+                "rank — restart it (docs/OPERATIONS.md §19)")
+        lines.append("")
     if rep.get("queue"):
         q = rep["queue"]
         lines.append(
