@@ -160,6 +160,17 @@ class HDF5Store:
         self._data = {}
         self._attrs = {}
         self._mirrors = os.path.abspath(filename)
+        # verify-on-read: when this file was committed with an
+        # integrity sidecar (atomic checkpoint writes do that), prove
+        # the bytes still match before handing them to h5py — a
+        # flipped bit in a checkpoint must raise CorruptArtifactError
+        # (failure class "corrupt": unlink-and-rebuild), not decode
+        # into a silently wrong map. Files without a sidecar (Level-1
+        # inputs
+        # staged outside the pipeline) read unverified, as ever.
+        from comapreduce_tpu.resilience.integrity import verify_file
+
+        verify_file(filename, kind="checkpoint")
         f = safe_hdf5_open(filename, "r")
         self._file = f
         # root attributes
@@ -239,9 +250,15 @@ class HDF5Store:
                     self._write_into(tmp, "w")
                     # the file now equals this store's content exactly
                     self._mirrors = target
-                from comapreduce_tpu.data.durable import durable_replace
+                from comapreduce_tpu.resilience.integrity import (
+                    committed_replace)
 
-                durable_replace(tmp, filename, durable=durable)
+                # sidecar-first commit: the .s256 manifest lands before
+                # the payload rename, so a kill between the two leaves
+                # old-payload-under-new-sidecar — still verifiable via
+                # the sidecar's digest history, never condemnable
+                committed_replace(tmp, filename, kind="checkpoint",
+                                  durable=durable)
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
@@ -250,6 +267,11 @@ class HDF5Store:
 
         mode = "a" if os.path.exists(filename) else "w"
         self._write_into(filename, mode)
+        # an in-place append honestly mutated the bytes: re-seal an
+        # existing sidecar so the stale manifest can't condemn them
+        from comapreduce_tpu.resilience.integrity import refresh_sidecar
+
+        refresh_sidecar(filename, kind="checkpoint", durable=durable)
 
     def _write_into(self, filename: str, mode: str) -> None:
         with safe_hdf5_open(filename, mode) as out:

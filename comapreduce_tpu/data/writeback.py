@@ -202,8 +202,9 @@ class Writeback:
     def _store_writer(self, payload: dict, path: str, durable: bool,
                       job: _Job) -> Callable[[], None]:
         def write() -> None:
-            from comapreduce_tpu.data.durable import durable_replace
             from comapreduce_tpu.data.hdf5io import HDF5Store
+            from comapreduce_tpu.resilience.integrity import (
+                committed_replace)
 
             store = HDF5Store(name="writeback")
             store.adopt_payload(payload)
@@ -224,7 +225,13 @@ class Writeback:
                              or self._committed_gen.get(path, -1)
                              > job.gen)
                     if not stale:
-                        durable_replace(tmp, path, durable=durable)
+                        # sidecar-first inside the same commit gate: the
+                        # .s256 manifest and the payload rename share the
+                        # generation fence, so a late writer can't land a
+                        # stale sidecar over a newer checkpoint either
+                        committed_replace(tmp, path, kind="checkpoint",
+                                          durable=durable,
+                                          chaos=self._chaos)
                         self._committed_gen[path] = job.gen
                 if stale:
                     os.unlink(tmp)

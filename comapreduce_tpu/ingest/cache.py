@@ -141,15 +141,16 @@ class BlockCache:
                 if key in self._on_disk:
                     continue
             try:
-                from comapreduce_tpu.data.durable import durable_replace
+                from comapreduce_tpu.resilience.integrity import (
+                    committed_replace)
 
                 os.makedirs(self.spill_dir, exist_ok=True)
                 tmp = self._spill_path(key) + ".tmp"
                 with open(tmp, "wb") as f:
                     pickle.dump((key, payload), f,
                                 protocol=pickle.HIGHEST_PROTOCOL)
-                durable_replace(tmp, self._spill_path(key),
-                                durable=self.durable)
+                committed_replace(tmp, self._spill_path(key),
+                                  kind="spill", durable=self.durable)
                 with self._lock:
                     self.stats["spills"] += 1
                     self._on_disk.add(key)
@@ -161,7 +162,26 @@ class BlockCache:
                                key[0], exc)
 
     def _load_spill(self, key: tuple):
+        from comapreduce_tpu.resilience.integrity import (
+            CorruptArtifactError, drop_sidecar, verify_file)
+
         path = self._spill_path(key)
+        try:
+            # verify BEFORE unpickling: a rotted spill entry must cost
+            # one cache miss (re-read from Level-1), never feed damaged
+            # bytes to pickle — and certainly never reach a solve
+            verify_file(path, kind="spill")
+        except CorruptArtifactError as exc:
+            logger.warning("BlockCache: corrupt spill for %s dropped "
+                           "(%s); re-reading from source", key[0], exc)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            drop_sidecar(path)
+            with self._lock:
+                self._on_disk.discard(key)
+            return None
         try:
             with open(path, "rb") as f:
                 stored_key, payload = pickle.load(f)
@@ -172,6 +192,7 @@ class BlockCache:
                 os.unlink(path)
             except OSError:
                 pass
+            drop_sidecar(path)
             with self._lock:
                 self._on_disk.discard(stored_key)
             return None

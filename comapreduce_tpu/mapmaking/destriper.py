@@ -167,7 +167,7 @@ def save_solver_checkpoint(path: str, offsets, n_done: int,
     complete snapshot or a stray temp file, never a torn snapshot
     under the live name.
     """
-    from comapreduce_tpu.data.durable import durable_replace
+    from comapreduce_tpu.resilience.integrity import committed_replace
 
     directory = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(directory, exist_ok=True)
@@ -181,7 +181,7 @@ def save_solver_checkpoint(path: str, offsets, n_done: int,
                      residuals=np.asarray(residuals, dtype=np.float64),
                      precond_id=np.bytes_(
                          str(precond_id).encode("utf-8")))
-        durable_replace(tmp, path, durable=durable)
+        committed_replace(tmp, path, kind="solver", durable=durable)
         tmp = ""
     finally:
         if tmp:
@@ -202,7 +202,25 @@ def load_solver_checkpoint(path: str,
     Returns ``{"offsets": f32[n], "n_done": int, "residuals":
     [float...], "precond_id": str}``.
     """
+    from comapreduce_tpu.resilience.integrity import (
+        CorruptArtifactError, drop_sidecar, verify_file)
+
     if not path or not os.path.exists(path):
+        return None
+    try:
+        # verify-on-read: a bit-rotted snapshot must be detected here
+        # and cost a cold solve — warm-starting CG from damaged floats
+        # would converge to a silently wrong map
+        verify_file(path, kind="solver")
+    except CorruptArtifactError as exc:
+        logger.warning("solver checkpoint %s failed its sha256 "
+                       "manifest (%s); unlinking — the solve restarts "
+                       "fresh", path, exc)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        drop_sidecar(path)
         return None
     try:
         with np.load(path) as z:

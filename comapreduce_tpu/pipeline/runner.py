@@ -625,6 +625,13 @@ class Runner:
         if not os.path.exists(l2path):
             return True  # checkpoint missing: normal first-run state
         try:
+            # verify against the commit-time sha256 sidecar BEFORE
+            # trusting the group listing: HDF5 can parse a bit-rotted
+            # file whose data blocks are damaged, and resume-skipping
+            # on it would fold the damage into the map
+            from comapreduce_tpu.resilience.integrity import verify_file
+
+            verify_file(l2path, kind="checkpoint")
             with safe_hdf5_open(l2path, "r") as f:
                 have = set(f.keys())
         except Exception as exc:
@@ -664,9 +671,15 @@ class Runner:
         if res.ledger is None or is_lock_error(exc) \
                 or res.ledger.is_quarantined(l2path):
             return
+        fclass = classify_error(exc)
+        # checksum-proven damage gets the first-class ``corrupt``
+        # disposition (skipped like quarantined, lifted by the same
+        # ``recovered`` once the re-reduction rewrites the file) with
+        # the digest evidence in the message
         res.ledger.record(l2path, error=exc,
-                          failure_class=classify_error(exc),
-                          disposition="quarantined",
+                          failure_class=fclass,
+                          disposition=("corrupt" if fclass == "corrupt"
+                                       else "quarantined"),
                           stage="resume.checkpoint",
                           message=f"unreadable checkpoint for "
                                   f"{filename}: {exc}")
@@ -720,6 +733,9 @@ class Runner:
                 os.unlink(l2path)
             except OSError:
                 pass
+            from comapreduce_tpu.resilience.integrity import drop_sidecar
+
+            drop_sidecar(l2path)
             lvl2 = COMAPLevel2(filename="")
             lvl2.filename = l2path
         wrote = False
@@ -779,6 +795,13 @@ class Runner:
         wb = self._writeback
         if wb is None:
             lvl2.write(lvl2.filename, atomic=True)
+            res = self._resilience_runtime()
+            if res.chaos is not None:
+                # bit_rot drills damage the COMMITTED checkpoint —
+                # after the atomic write sealed its sidecar, so the
+                # injected rot is detectable rot (the async path gets
+                # the same shot inside Writeback's commit)
+                res.chaos.maybe_bit_rot(lvl2.filename)
             return
         from comapreduce_tpu.data.writeback import snapshot_store
 

@@ -40,6 +40,7 @@ import time
 from typing import Iterator
 
 from comapreduce_tpu.data.durable import durable_replace
+from comapreduce_tpu.resilience.integrity import check_json, seal_json
 from comapreduce_tpu.resilience.lease import Lease, LeaseBoard
 from comapreduce_tpu.telemetry import TELEMETRY
 
@@ -52,13 +53,25 @@ QUEUE_MANIFEST = "queue.json"
 
 
 def read_manifest(state_dir: str) -> dict | None:
-    """Parse the shared queue manifest; None when missing/torn."""
+    """Parse the shared queue manifest; None when missing/torn — or
+    when the manifest parses but fails its embedded ``_sha256`` seal
+    (rotted in place: a wrong file census silently shrinking the
+    campaign is the failure mode this rejects)."""
     try:
         with open(os.path.join(state_dir or ".", QUEUE_MANIFEST), "r",
                   encoding="utf-8") as f:
-            return json.load(f)
+            man = json.load(f)
     except (OSError, ValueError):
         return None
+    if not isinstance(man, dict):
+        return None
+    man, verdict = check_json(man)
+    if verdict is False:
+        logger.warning("queue manifest in %s fails its _sha256 seal; "
+                       "ignoring it (run tools/campaign_fsck.py)",
+                       state_dir)
+        return None
+    return man
 
 
 def extend_manifest(state_dir: str, new_files) -> list:
@@ -89,7 +102,7 @@ def extend_manifest(state_dir: str, new_files) -> list:
     tmp = os.path.join(state_dir or ".",
                        f".{QUEUE_MANIFEST}.{os.getpid()}.ext.tmp")
     with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(man, f)
+        json.dump(seal_json(man), f)
     durable_replace(tmp, os.path.join(state_dir or ".", QUEUE_MANIFEST))
     logger.warning("queue manifest %s: %d late unit(s) appended "
                    "(%d total)", state_dir, len(added), man["n"])
@@ -432,7 +445,8 @@ class Scheduler:
         tmp = os.path.join(self.state_dir,
                            f".{QUEUE_MANIFEST}.{os.getpid()}.tmp")
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"schema": 1, "n": len(names), "files": names,
-                       "t_wall": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                               time.gmtime())}, f)
+            json.dump(seal_json(
+                {"schema": 1, "n": len(names), "files": names,
+                 "t_wall": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())}), f)
         durable_replace(tmp, path)

@@ -36,6 +36,13 @@ gives the framework the same property:
   ``rejected`` on exhaustion. Deadlines are static from config plus
   adaptive from recorded stage durations (p95 x scale, floored by
   config).
+- ``integrity`` — end-to-end artifact integrity: every durable commit
+  carries a sha256 sidecar or embedded seal, every load boundary
+  verifies before trusting bytes, and a mismatch raises
+  :class:`CorruptArtifactError` → the non-retryable ``corrupt``
+  failure class → per-artifact-class triage (unlink-and-rebuild vs
+  quarantine-with-evidence). Audited offline by
+  ``tools/campaign_fsck.py`` (docs/OPERATIONS.md §20).
 - :class:`Heartbeat` (``heartbeat``) — atomic per-rank
   ``heartbeat.rank{r}.json`` (stage, unit, progress counters, last
   deadline state, monotonic + wall clocks) on a background ticker;
@@ -55,6 +62,17 @@ INI ``[Resilience]`` section) -> :meth:`ResilienceConfig.make_runtime`
 """
 
 from comapreduce_tpu.resilience.chaos import ChaosMonkey  # noqa: F401
+from comapreduce_tpu.resilience.integrity import (  # noqa: F401
+    CorruptArtifactError,
+    committed_replace,
+    seal_json,
+    check_json,
+    verify_file,
+    verify_enabled,
+    write_sidecar,
+    read_sidecar,
+    sha256_path,
+)
 from comapreduce_tpu.resilience.config import (  # noqa: F401
     DEFAULT_LEASE_TTL_S,
     Resilience,

@@ -64,6 +64,14 @@ list of ``kind[@substr][:rate]`` with rate in [0, 1] (default 1);
   dropped into a live campaign would. Fires at most once per monkey —
   the drill for admission control (``control.admission``), the same
   way ``rank_kill`` drills the autoscaler.
+- ``bit_rot`` — media decay: one byte of a COMMITTED artifact is
+  flipped in place (deterministic offset and xor mask by
+  ``(seed, kind, basename)``), AFTER the integrity sidecar recorded
+  the honest digest — so the rot is always detectable, exactly like
+  real rot under a real checksum. Invoked post-commit by the integrity
+  plane (:func:`resilience.integrity.committed_replace`) and directly
+  by drills; fires at most once per matching basename so a repaired
+  artifact stays repaired.
 
 Whether a given file draws a given fault depends only on
 ``(seed, kind, basename)`` — stable across runs, across iteration
@@ -80,18 +88,38 @@ import time
 
 import numpy as np
 
-__all__ = ["ChaosMonkey", "parse_inject_spec", "CHAOS_KINDS"]
+__all__ = ["ChaosMonkey", "parse_inject_spec", "CHAOS_KINDS",
+           "flip_byte"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
 CHAOS_KINDS = ("read_error", "truncate", "flaky", "nan_burst",
                "slow_read", "hang", "write_stall", "rank_kill",
                "rank_pause", "late_file", "kill_mid_publish",
-               "load_spike")
+               "load_spike", "bit_rot")
 
 # TOD datasets a NaN burst can poison, by payload schema
 _POISON_KEYS = ("spectrometer/tod", "averaged_tod/tod",
                 "frequency_binned/tod")
+
+
+def flip_byte(path: str, seed: int = 0) -> tuple[int, int]:
+    """Flip one byte of ``path`` in place — deterministic offset and
+    (never-zero) xor mask from ``(seed, basename)``. Returns
+    ``(offset, mask)`` so drills/tests can assert or undo the exact
+    damage. Empty files are left alone (nothing to rot)."""
+    size = os.path.getsize(path)
+    if size <= 0:
+        return (-1, 0)
+    rng = random.Random(f"{seed}:bit_rot_at:{os.path.basename(path)}")
+    offset = rng.randrange(size)
+    mask = 1 + rng.randrange(255)  # never 0: the flip always flips
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ mask]))
+    return (offset, mask)
 
 
 def parse_inject_spec(spec: str) -> list:
@@ -244,6 +272,32 @@ class ChaosMonkey:
                        "before the rename of %s", os.getpid(), epoch)
         os.kill(os.getpid(), 9)  # signal.SIGKILL; never returns
         time.sleep(60.0)  # pathological platform: at least stall
+
+    def maybe_bit_rot(self, path: str) -> bool:
+        """Flip one byte of the committed artifact at ``path`` (kind
+        ``bit_rot``) — called post-commit by the integrity plane's
+        :func:`~comapreduce_tpu.resilience.integrity.committed_replace`
+        (i.e. AFTER the sidecar hashed the honest bytes, so injected
+        rot is always detectable rot). At most once per basename: a
+        rebuilt/repaired artifact is not re-rotted, so the recovery
+        the drill asserts can actually converge. True when it fired."""
+        if "bit_rot" not in self.decide(path):
+            return False
+        base = os.path.basename(path)
+        with self._lock:
+            if any(k == "bit_rot" and os.path.basename(f) == base
+                   for f, k in self.injected):
+                return False
+            self.injected.append((path, "bit_rot"))
+        try:
+            offset, mask = flip_byte(path, self.seed)
+        except OSError as exc:  # artifact raced away: nothing to rot
+            logger.warning("chaos: bit_rot skipped for %s (%s)",
+                           path, exc)
+            return False
+        logger.warning("chaos: bit_rot — flipped byte %d (xor 0x%02x) "
+                       "of committed %s", offset, mask, path)
+        return True
 
     def stall_write(self, path: str) -> None:
         """Block a writeback commit for ``path`` (kind ``write_stall``)

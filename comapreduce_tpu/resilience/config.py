@@ -378,7 +378,15 @@ class Resilience:
         failures from OUTSIDE the file's own read
         (``may_quarantine=False`` — e.g. a stage chain whose checkpoint
         WRITE hit a full output disk) must never durably skip the input
-        over an environment problem."""
+        over an environment problem.
+
+        A ``corrupt`` failure (checksum-proven damage,
+        :class:`~comapreduce_tpu.resilience.integrity.
+        CorruptArtifactError`) gets its own first-class disposition
+        regardless of ``may_quarantine``: the artifact's bytes are
+        wrong no matter who reports it, the unit must be skipped until
+        repaired, and the entry carries the digest evidence in the
+        message."""
         if self.ledger is None:
             return
         from comapreduce_tpu.resilience.retry import (classify_error,
@@ -386,6 +394,12 @@ class Resilience:
 
         failure_class = getattr(error, "_failure_class",
                                 classify_error(error))
+        if failure_class == "corrupt":
+            self.ledger.record(
+                filename, error=error, failure_class="corrupt",
+                retries=getattr(error, "_retries", 0),
+                disposition="corrupt", stage=stage, **unit)
+            return
         quarantine = (may_quarantine and failure_class == "transient"
                       and not is_lock_error(error))
         self.ledger.record(

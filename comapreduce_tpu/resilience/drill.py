@@ -68,6 +68,20 @@ This is the executable form of the resilience layer's contract
    (content addressing across history), with the retracted file never
    re-admitted by the commit scan.
 
+10. the integrity plane (docs/OPERATIONS.md §20,
+   ``run_integrity_drill``): one byte is flipped in a committed
+   artifact of EVERY class — Level-2 checkpoint, spill entry, solver
+   snapshot, epoch FITS, tile object, ledger line. Asserts 100%
+   detection by ``tools/campaign_fsck.py``, the correct per-class
+   triage at each read boundary (checkpoint -> ``corrupt`` ledger
+   disposition, spill -> cache miss + unlink, snapshot -> cold solve,
+   epoch -> ``verify_epoch`` problem, tile ->
+   ``CorruptArtifactError`` + unlink, ledger line ->
+   dropped-and-counted), that chaos ``bit_rot`` rots only
+   post-commit (always detectable) at most once per basename, and
+   that ``--repair`` + re-derivation yields a final map
+   byte-identical to the clean run's.
+
 Everything is deterministic by seed (chaos decisions, jitter, synthetic
 data), so a CI failure reproduces locally bit-for-bit. (Deadline
 checks bound wall time from ABOVE only — cancels must not be late;
@@ -82,8 +96,8 @@ import time
 
 import numpy as np
 
-__all__ = ["run_drill", "run_elastic_drill", "run_live_drill",
-           "run_serving_drill", "run_tiles_drill"]
+__all__ = ["run_drill", "run_elastic_drill", "run_integrity_drill",
+           "run_live_drill", "run_serving_drill", "run_tiles_drill"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
@@ -1339,6 +1353,243 @@ def run_tiles_drill(workdir: str, seed: int = 0, n_files: int = 4,
         "tiles_evict_epoch": int(n3),
         "tiles_census": names,
         "tiles_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def run_integrity_drill(workdir: str, seed: int = 0,
+                        n_files: int = 4) -> dict:
+    """Criterion 10 (the integrity plane, docs/OPERATIONS.md §20): one
+    byte flipped per durable artifact class — Level-2 checkpoint,
+    BlockCache spill, solver snapshot, epoch FITS, tile object, ledger
+    line — asserting 100% detection by the offline fsck, the correct
+    per-class triage at every read boundary (``corrupt`` ledger
+    disposition + skip for the checkpoint; cache-miss + unlink for the
+    spill; cold solve for the snapshot; ``verify_epoch`` problems for
+    the FITS; ``CorruptArtifactError`` + unlink for the tile;
+    dropped-and-counted for the ledger line), that chaos ``bit_rot``
+    fires post-commit (always detectable) at most once per basename,
+    and that after ``campaign_fsck --repair`` + re-derivation the
+    final map is byte-identical to the clean run's."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    from comapreduce_tpu.ingest.cache import BlockCache
+    from comapreduce_tpu.mapmaking.destriper import (
+        load_solver_checkpoint, save_solver_checkpoint)
+    from comapreduce_tpu.mapmaking.wcs import WCS
+    from comapreduce_tpu.resilience import QuarantineLedger, Resilience
+    from comapreduce_tpu.resilience.chaos import ChaosMonkey, flip_byte
+    from comapreduce_tpu.resilience.integrity import (
+        CorruptArtifactError, seal_json, verify_file, write_sidecar)
+    from comapreduce_tpu.resilience.retry import RetryPolicy
+    from comapreduce_tpu.serving.epochs import (EpochStore, verify_epoch,
+                                                verify_epoch_product)
+    from comapreduce_tpu.tiles.store import TileStore
+
+    t0 = time.perf_counter()
+    workdir = os.path.abspath(workdir)
+    os.makedirs(workdir, exist_ok=True)
+
+    def _fixture(i: int) -> None:
+        path = os.path.join(workdir, f"Level2_comap-{i:04d}.hd5")
+        if os.path.exists(path):
+            os.unlink(path)  # HDF5Store.write appends into rotted files
+        _write_level2(path, seed=3000 + seed * 10 + i)
+        write_sidecar(path, path, kind="checkpoint")
+        return path
+
+    files = [_fixture(i) for i in range(n_files)]
+    wcs = WCS.from_field((170.25, 52.25), (1.0 / 60, 1.0 / 60), (64, 64))
+    clean_map = np.asarray(_solve(_read(files, wcs)).destriped_map
+                           ).tobytes()
+
+    # -- one committed artifact of every other class --------------------
+    spill_dir = os.path.join(workdir, "spill")
+    cache = BlockCache(max_bytes=64, spill_dir=spill_dir)
+    spill_payload = np.arange(4096, dtype=np.float32)
+    cache.put(files[0], spill_payload)   # oversized -> straight to disk
+    spill_file = [os.path.join(spill_dir, n)
+                  for n in sorted(os.listdir(spill_dir))
+                  if not n.endswith(".s256")][0]
+
+    sck = os.path.join(workdir, "solver_band0.npz")
+    save_solver_checkpoint(sck, np.ones(32, np.float32), 7,
+                           [1e-3, 1e-4], "precond-drill")
+
+    epochs_dir = os.path.join(workdir, "epochs")
+    es = EpochStore(epochs_dir)
+
+    def _products(tmpdir: str) -> dict:
+        with open(os.path.join(tmpdir, "map_band0.fits"), "wb") as f:
+            f.write(b"SIMPLE  =                    T" + b"\x07" * 256)
+        return {"maps": ["map_band0.fits"]}
+
+    n_epoch = es.publish([os.path.basename(f) for f in files],
+                         _products)
+    epoch_dir = es.epoch_dir(n_epoch)
+    fits_path = os.path.join(epoch_dir, "map_band0.fits")
+
+    tiles_root = os.path.join(workdir, "tiles")
+    tstore = TileStore(tiles_root)
+    tile_blob = bytes(range(256)) * 3
+    digest, _ = tstore.put(tile_blob)
+    os.makedirs(os.path.join(tiles_root, "manifests"), exist_ok=True)
+    with open(os.path.join(tiles_root, "manifests",
+                           "epoch-000001.json"), "w",
+              encoding="utf-8") as f:
+        _json.dump(seal_json({"schema": 1, "kind": "tiles", "epoch": 1,
+                              "tiles": {"b0/0": [digest, len(tile_blob),
+                                                 256]}}), f)
+
+    ledger_path = os.path.join(workdir, "quarantine.jsonl")
+    led = QuarantineLedger(ledger_path)
+    for f in (files[2], files[3]):
+        led.record(f, failure_class="transient",
+                   disposition="recovered", stage="drill",
+                   message="integrity-drill warmup")
+
+    # -- chaos bit_rot: post-commit, once per basename, detectable ------
+    monkey = ChaosMonkey("bit_rot", seed=seed)
+    assert monkey.maybe_bit_rot(files[2]), \
+        "criterion 10: bit_rot did not fire on a committed checkpoint"
+    assert not monkey.maybe_bit_rot(files[2]), \
+        "criterion 10: bit_rot re-rotted the same basename (repairs " \
+        "could never converge)"
+    try:
+        verify_file(files[2], kind="checkpoint")
+        raise AssertionError(
+            "criterion 10: post-commit bit_rot escaped verify_file — "
+            "the sidecar did not hash the honest bytes")
+    except CorruptArtifactError:
+        pass
+    _fixture(2)  # re-derive: same seed, same data, fresh sidecar
+
+    # -- flip one byte per artifact class -------------------------------
+    victims = {"checkpoint": files[1], "spill": spill_file,
+               "solver": sck, "epoch": fits_path,
+               "tile": tstore.path(digest)}
+    for path in victims.values():
+        flip_byte(path, seed=seed + 1)
+    with open(ledger_path, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    doc = _json.loads(lines[0])
+    doc["disposition"] = "quarantined"  # body edited, seal left stale
+    lines[0] = _json.dumps(doc, separators=(",", ":"), default=str)
+    with open(ledger_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+
+    # -- 100% detection: the offline fsck sees every class --------------
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    fsck = os.path.join(root, "tools", "campaign_fsck.py")
+
+    def _fsck(*extra) -> tuple:
+        proc = subprocess.run(
+            [_sys.executable, fsck, workdir, "--json", *extra],
+            capture_output=True, text=True, env=_child_env())
+        assert proc.stdout, \
+            f"criterion 10: fsck produced no report: {proc.stderr}"
+        return proc.returncode, _json.loads(proc.stdout)
+
+    rc, rep = _fsck()
+    corrupt_paths = {p["path"] for p in rep["problems"]
+                     if p["problem"] == "corrupt"}
+    missed = {cls for cls, path in victims.items()
+              if path not in corrupt_paths}
+    assert not missed, \
+        f"criterion 10: fsck missed corrupt class(es) {sorted(missed)}"
+    assert ledger_path in corrupt_paths, \
+        "criterion 10: fsck missed the corrupt ledger line"
+    assert rc == 1, "criterion 10: fsck exited 0 over corruption"
+
+    # -- per-class runtime triage ---------------------------------------
+    triage_path = os.path.join(workdir, "quarantine-triage.jsonl")
+    res = Resilience(ledger=QuarantineLedger(triage_path),
+                     retry=RetryPolicy(max_retries=1, base_s=0.0,
+                                       seed=seed))
+    data_tri = _read(files, wcs, resilience=res)
+    assert files[1] not in data_tri.files, \
+        "criterion 10: a corrupt checkpoint fed the solve"
+    tri = QuarantineLedger(triage_path)
+    assert any(e.failure_class == "corrupt"
+               and e.disposition == "corrupt"
+               and e.unit.get("file") == files[1]
+               for e in tri.entries), \
+        "criterion 10: corrupt checkpoint not ledgered corrupt/corrupt"
+    assert not any(e.disposition == "quarantined" for e in tri.entries), \
+        "criterion 10: corruption mis-triaged as a quarantine"
+
+    assert cache.get(files[0]) is None, \
+        "criterion 10: a rotted spill entry was served"
+    assert not os.path.exists(spill_file), \
+        "criterion 10: rotted spill entry not unlinked"
+    cache.put(files[0], spill_payload)
+    assert np.array_equal(cache.get(files[0]), spill_payload), \
+        "criterion 10: re-spilled entry unreadable"
+
+    assert load_solver_checkpoint(sck, "precond-drill") is None, \
+        "criterion 10: a rotted solver snapshot warm-started a solve"
+    assert not os.path.exists(sck), \
+        "criterion 10: rotted solver snapshot not unlinked"
+    save_solver_checkpoint(sck, np.ones(32, np.float32), 7,
+                           [1e-3, 1e-4], "precond-drill")
+    assert load_solver_checkpoint(sck, "precond-drill")["n_done"] == 7
+
+    nok, problems = verify_epoch(epoch_dir)
+    assert [p[0] for p in problems] == ["map_band0.fits"], \
+        f"criterion 10: verify_epoch reported {problems}"
+    assert verify_epoch_product(epoch_dir, "map_band0.fits") is False, \
+        "criterion 10: rotted epoch product verified True/None"
+
+    try:
+        tstore.get(digest)
+        raise AssertionError("criterion 10: a rotted tile object was "
+                             "served (CAS name no longer matches "
+                             "content)")
+    except CorruptArtifactError:
+        pass
+    assert not os.path.exists(tstore.path(digest)), \
+        "criterion 10: rotted tile object not unlinked"
+    d2, renewed = tstore.put(tile_blob)
+    assert d2 == digest and renewed and tstore.get(digest) == tile_blob, \
+        "criterion 10: tile re-put did not repair the object"
+
+    led2 = QuarantineLedger(ledger_path)
+    assert led2.corrupt_lines == 1, \
+        f"criterion 10: expected 1 dropped ledger line, counted " \
+        f"{led2.corrupt_lines}"
+    assert len(led2.entries) == 1, \
+        "criterion 10: the intact ledger line did not survive the drop"
+
+    # -- fsck --repair + re-derivation -> byte-identical map ------------
+    rc, rep = _fsck("--repair")
+    assert rc == 0 and rep["ok"], \
+        f"criterion 10: fsck --repair did not converge: " \
+        f"{rep['problems']}"
+    assert not os.path.exists(files[1]), \
+        "criterion 10: repair kept a corrupt re-derivable checkpoint"
+    assert not os.path.exists(epoch_dir), \
+        "criterion 10: repair kept a corrupt epoch"
+    _fixture(1)  # the re-reduction the runner would perform
+    n2 = EpochStore(epochs_dir).publish(
+        [os.path.basename(f) for f in files], _products)
+    assert not verify_epoch(es.epoch_dir(n2))[1], \
+        "criterion 10: republished epoch failed verification"
+    final_map = np.asarray(_solve(_read(files, wcs)).destriped_map
+                           ).tobytes()
+    assert final_map == clean_map, \
+        "criterion 10: repaired campaign's map != clean run's map"
+
+    return {
+        "criterion": "10-integrity",
+        "n_classes": 6,
+        "n_detected": 6,
+        "corrupt_paths": sorted(os.path.basename(p)
+                                for p in corrupt_paths),
+        "ledger_lines_dropped": led2.corrupt_lines,
+        "map_identical": True,
+        "integrity_wall_s": round(time.perf_counter() - t0, 3),
     }
 
 

@@ -73,10 +73,22 @@ def read_heartbeats(directory: str) -> dict:
         try:
             with open(path, "r", encoding="utf-8") as f:
                 hb = json.load(f)
-            hb["_mtime"] = os.stat(path).st_mtime
         except (OSError, ValueError) as exc:
             logger.warning("unreadable heartbeat %s (%s: %s)", path,
                            type(exc).__name__, exc)
+            continue
+        from comapreduce_tpu.resilience.integrity import check_json
+
+        hb, verdict = check_json(hb)
+        if verdict is False:
+            # a rotted heartbeat is as unreadable as a torn one: skip
+            # it (the rank looks silent, which is the honest signal)
+            logger.warning("heartbeat %s fails its _sha256 seal; "
+                           "skipped", path)
+            continue
+        try:
+            hb["_mtime"] = os.stat(path).st_mtime
+        except OSError:
             continue
         out[int(m.group(1))] = hb
     return out
@@ -317,7 +329,10 @@ class Heartbeat:
                     dir=self.directory)
                 try:
                     with os.fdopen(fd, "w", encoding="utf-8") as f:
-                        json.dump(snap, f)
+                        from comapreduce_tpu.resilience.integrity import (
+                            seal_json)
+
+                        json.dump(seal_json(snap), f)
                     os.replace(tmp, self.path)
                 except BaseException:
                     try:

@@ -1,0 +1,315 @@
+"""End-to-end artifact integrity: checksums, sidecars, verify-on-read.
+
+Every durable artifact the campaign writes — Level-2 HDF5 checkpoints,
+BlockCache disk spill, solver npz snapshots, epoch FITS products, tile
+blobs, and the JSONL/JSON control state — is committed with a sha256
+manifest, and every load boundary re-verifies before trusting the
+bytes.  Atomicity (``data.durable``) guarantees the *rename* is
+all-or-nothing; this module guarantees the *content* under the name is
+the content that was committed.  A mismatch raises
+:class:`CorruptArtifactError`, which :func:`resilience.retry.
+classify_error` maps to the non-retryable ``corrupt`` class so the
+per-file safety nets triage it (unlink-and-rebuild for re-derivable
+state, quarantine-with-evidence for Level-1 inputs) instead of
+retrying a deterministic failure.
+
+Two manifest shapes cover every artifact:
+
+**Sidecar** (``<name>.s256``) — for opaque binary payloads (HDF5, npz,
+pickle spill).  A small JSON document next to the artifact::
+
+    {"schema": 1, "kind": "checkpoint", "algo": "sha256",
+     "digests": ["<newest>", "<previous>", ...], "size": 12345}
+
+``digests`` keeps a short history (newest first, capped at
+:data:`HISTORY`): the sidecar is committed *before* the payload rename
+inside :func:`committed_replace`, so a crash between the two renames
+leaves the OLD payload under a NEW sidecar — the old digest is still
+in the history, and verification passes.  Old-or-new, never
+unverifiable.
+
+**Embedded** — for JSON/JSONL state the pipeline already parses.  A
+``_sha256`` key holding the digest of the canonical serialisation of
+the document *without* that key (``json.dumps(..., sort_keys=True,
+separators=(",", ":"))``).  :func:`seal_json` adds it,
+:func:`check_json` verifies and strips it.  Documents written before
+this scheme existed have no ``_sha256`` and verify as *unverified*
+(``None``), never as corrupt — the scheme is additive.
+
+Verification is pure host-side hashing (hashlib over file bytes):
+it adds zero jax dispatches, so a clean campaign's compile profile is
+byte-identical with verification on or off.  The
+``COMAP_VERIFY_READS`` environment knob (default on) exists for
+forensics — turning it off makes readers trust bytes again, e.g. to
+copy a corrupt artifact out of a run dir for inspection.
+
+Offline, ``tools/campaign_fsck.py`` walks a whole run directory
+through these same primitives.  Runbook: docs/OPERATIONS.md §20.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+
+from ..data import durable as _durable
+from ..telemetry.core import TELEMETRY
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CorruptArtifactError", "verify_enabled", "sha256_path",
+    "sidecar_path", "read_sidecar", "write_sidecar",
+    "committed_replace", "refresh_sidecar", "drop_sidecar",
+    "verify_file", "seal_json", "check_json", "seal_line",
+    "check_line",
+]
+
+#: suffix of sidecar manifests (``map_band0.fits`` → ``map_band0.fits.s256``)
+SIDECAR_SUFFIX = ".s256"
+
+#: digest generations kept in a sidecar.  One would satisfy a clean
+#: commit; the history absorbs the sidecar-first commit window (crash
+#: after sidecar rename, before payload rename → old payload must
+#: still verify) and repeated crashed commits in a row.
+HISTORY = 4
+
+_CHUNK = 1 << 20  # 1 MiB read chunks for hashing
+
+#: embedded-checksum key for JSON documents / JSONL lines
+SEAL_KEY = "_sha256"
+
+
+class CorruptArtifactError(OSError):
+    """Committed artifact whose bytes no longer match their manifest.
+
+    An :class:`OSError` subclass so it rides the existing per-file
+    safety nets (``TRANSIENT_ERRORS`` catch arcs), but
+    ``classify_error`` recognises it FIRST and returns ``"corrupt"``:
+    deterministic damage, never retried.  Carries the evidence the
+    ledger records (expected vs actual digest)."""
+
+    def __init__(self, path: str, kind: str = "",
+                 expected: str = "", actual: str = "",
+                 detail: str = ""):
+        self.path = path
+        self.kind = kind
+        self.expected = expected
+        self.actual = actual
+        msg = f"corrupt artifact {path!r}"
+        if kind:
+            msg += f" (kind={kind})"
+        if expected or actual:
+            msg += (f": sha256 {actual[:12] or '?'} != committed "
+                    f"{expected[:12] or '?'}")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+def verify_enabled() -> bool:
+    """Verify-on-read master switch: ``COMAP_VERIFY_READS`` (default
+    on; ``0``/``false``/``off``/``no`` disable)."""
+    v = os.environ.get("COMAP_VERIFY_READS", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def sha256_path(path: str) -> str:
+    """Hex sha256 of a file's bytes, chunked (constant memory)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(_CHUNK)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def sidecar_path(path: str) -> str:
+    return os.fspath(path) + SIDECAR_SUFFIX
+
+
+def read_sidecar(path: str) -> dict | None:
+    """The sidecar manifest for artifact ``path``, or None when
+    absent/torn/foreign-schema (an unreadable sidecar means the
+    artifact is *unverified*, not corrupt — sidecars are advisory
+    metadata; the payload's own commit protocol guarantees its
+    atomicity)."""
+    sc = sidecar_path(path)
+    try:
+        with open(sc, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != 1:
+        return None
+    digs = doc.get("digests")
+    if not isinstance(digs, list) or not all(
+            isinstance(d, str) for d in digs):
+        return None
+    return doc
+
+
+def write_sidecar(payload: str, dst: str, kind: str,
+                  durable: bool = True) -> dict:
+    """Commit a sidecar for artifact ``dst`` recording the digest of
+    ``payload`` (usually the tmp file about to be renamed onto
+    ``dst``).  Merges the existing sidecar's digest history so the
+    sidecar-first commit window keeps the previous generation
+    verifiable.  Atomic + durable like every other commit."""
+    digest = sha256_path(payload)
+    prev = read_sidecar(dst)
+    history = [digest]
+    if prev:
+        for d in prev.get("digests", []):
+            if d not in history:
+                history.append(d)
+    doc = {"schema": 1, "kind": kind, "algo": "sha256",
+           "digests": history[:HISTORY],
+           "size": os.path.getsize(payload)}
+    sc = sidecar_path(dst)
+    tmp = sc + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+    try:
+        # module attribute, not a from-import: fault-injection tests
+        # patch data.durable.durable_replace and the sidecar commit
+        # must honour the same fault as the payload commit
+        _durable.durable_replace(tmp, sc, durable=durable)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return doc
+
+
+def committed_replace(tmp: str, dst: str, kind: str,
+                      durable: bool = True, chaos=None) -> None:
+    """The integrity-aware commit: sidecar first, then the payload's
+    fsync-before-rename.  Ordering is the crash-safety argument —
+    whatever point a SIGKILL lands, the payload under ``dst`` has its
+    digest in the sidecar's history (old payload + old sidecar, old
+    payload + new sidecar via history, or new payload + new sidecar).
+    ``chaos`` (a ``ChaosMonkey`` or None) gets a post-commit
+    ``maybe_bit_rot(dst)`` shot so injected rot is always *detectable*
+    rot (flipped after hashing, like real media decay)."""
+    write_sidecar(tmp, dst, kind, durable=durable)
+    _durable.durable_replace(tmp, dst, durable=durable)
+    if chaos is not None:
+        chaos.maybe_bit_rot(dst)
+
+
+def refresh_sidecar(dst: str, kind: str = "",
+                    durable: bool = False) -> None:
+    """Re-seal an artifact that was (legitimately) mutated in place —
+    e.g. ``HDF5Store.write(atomic=False)`` appending groups to an
+    existing checkpoint.  Only rewrites when a sidecar already exists
+    (in-place writers of never-sealed files stay sidecar-less), so a
+    stale manifest can never condemn honestly-updated bytes."""
+    prev = read_sidecar(dst)
+    if prev is None:
+        return
+    write_sidecar(dst, dst, kind or str(prev.get("kind", "")),
+                  durable=durable)
+
+
+def drop_sidecar(path: str) -> None:
+    """Remove the sidecar alongside a condemned/unlinked artifact."""
+    try:
+        os.unlink(sidecar_path(path))
+    except OSError:
+        pass
+
+
+def verify_file(path: str, kind: str = "",
+                required: bool = False) -> bool | None:
+    """Verify artifact ``path`` against its sidecar.
+
+    Returns True (digest in the committed history), None (no usable
+    sidecar — unverified; unless ``required``), or raises
+    :class:`CorruptArtifactError` on mismatch (counting an
+    ``integrity.violations`` telemetry tick first, so /metrics shows
+    ``comap_integrity_violations_total`` moving).  Honors
+    :func:`verify_enabled` — disabled verification reads as
+    unverified, never as OK."""
+    if not verify_enabled():
+        return None
+    doc = read_sidecar(path)
+    if doc is None:
+        if required:
+            raise CorruptArtifactError(
+                path, kind=kind, detail="required sidecar missing")
+        return None
+    actual = sha256_path(path)
+    digests = doc.get("digests", [])
+    if actual in digests:
+        return True
+    TELEMETRY.counter("integrity.violations",
+                      kind=str(doc.get("kind", kind) or kind))
+    raise CorruptArtifactError(
+        path, kind=str(doc.get("kind", "")) or kind,
+        expected=digests[0] if digests else "", actual=actual)
+
+
+# ---------------------------------------------------------------- JSON
+
+def _canonical(doc: dict) -> bytes:
+    body = {k: v for k, v in doc.items() if k != SEAL_KEY}
+    return json.dumps(body, sort_keys=True, default=str,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def seal_json(doc: dict) -> dict:
+    """Return ``doc`` with an embedded ``_sha256`` over its canonical
+    serialisation (sorted keys, tight separators, minus the seal key
+    itself).  Idempotent; does not mutate the input."""
+    out = dict(doc)
+    out[SEAL_KEY] = hashlib.sha256(_canonical(out)).hexdigest()
+    return out
+
+
+def check_json(doc: dict) -> tuple[dict, bool | None]:
+    """Verify an embedded-seal document.  Returns ``(body, verdict)``
+    where ``body`` is the document WITHOUT the seal key and
+    ``verdict`` is True (seal matches), None (no seal — legacy
+    document, unverified), or False (mismatch — the caller decides
+    whether that's a drop, a None, or a raise; a tick is counted
+    here either way)."""
+    if SEAL_KEY not in doc:
+        return doc, None
+    body = {k: v for k, v in doc.items() if k != SEAL_KEY}
+    if not verify_enabled():
+        return body, None
+    want = doc.get(SEAL_KEY)
+    got = hashlib.sha256(_canonical(doc)).hexdigest()
+    if got == want:
+        return body, True
+    TELEMETRY.counter("integrity.violations", kind="json")
+    return body, False
+
+
+def seal_line(doc: dict) -> str:
+    """One sealed JSONL line (no trailing newline)."""
+    return json.dumps(seal_json(doc), default=str,
+                      separators=(",", ":"))
+
+
+def check_line(raw: str) -> tuple[dict | None, bool | None]:
+    """Parse + verify one JSONL line.  ``(None, False)`` when the line
+    is unparseable or fails its seal; otherwise ``(body, verdict)``
+    as :func:`check_json`."""
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None, False
+    if not isinstance(doc, dict):
+        return None, False
+    body, verdict = check_json(doc)
+    if verdict is False:
+        return None, False
+    return body, verdict

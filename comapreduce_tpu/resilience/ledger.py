@@ -41,7 +41,21 @@ thief commits it; ``deferred`` — the control plane's admission gate
 shed this (quality-flagged) unit under SLO pressure
 (``control.admission``; never skipped — the unit stays in the queue
 and is paired with a later ``readmitted`` when pressure clears or
-the rest of the queue drains: shed, never dropped).
+the rest of the queue drains: shed, never dropped); ``corrupt`` — a
+committed artifact for this unit failed sha256 verification
+(``resilience.integrity``): skipped like ``quarantined``, with the
+digest evidence in the message. Re-derivable artifacts (Level-2
+checkpoints, spill, snapshots, tiles) are unlinked and rebuilt from
+source, which appends the lifting ``recovered``; non-derivable
+Level-1 inputs stay corrupt until an operator re-stages the data and
+``--retry-quarantined``s the unit.
+
+Every line appended since the integrity plane landed carries an
+embedded ``_sha256`` seal (``resilience.integrity.seal_line``); a
+line whose seal fails verification is dropped-and-counted on load
+exactly like a torn line (``tools/campaign_fsck.py --repair`` rewrites
+the file without them). Pre-integrity lines have no seal and load
+unverified — the scheme is additive.
 """
 
 from __future__ import annotations
@@ -55,12 +69,14 @@ import time
 import traceback
 from dataclasses import asdict, dataclass, field
 
+from comapreduce_tpu.resilience.integrity import check_line, seal_line
+
 __all__ = ["LedgerEntry", "QuarantineLedger", "traceback_digest"]
 
 logger = logging.getLogger("comapreduce_tpu")
 
 # dispositions that make a unit skippable on the next run
-_SKIPPING = ("quarantined",)
+_SKIPPING = ("quarantined", "corrupt")
 _MSG_LIMIT = 500
 
 
@@ -126,6 +142,9 @@ class QuarantineLedger:
         self._lock = threading.Lock()
         self._latest: dict[tuple, LedgerEntry] = {}
         self.entries: list[LedgerEntry] = []
+        # seal-failing lines dropped across load()s — surfaced by the
+        # watchdog report so silent rot in the ledger itself is loud
+        self.corrupt_lines = 0
         self.load()
 
     # -- persistence -------------------------------------------------------
@@ -135,13 +154,24 @@ class QuarantineLedger:
         with open(path, "r", encoding="utf-8") as f:
             lines = f.read().splitlines()
         dropped = 0
+        corrupt = 0
         out = []
         for line in lines:
             line = line.strip()
             if not line:
                 continue
+            raw, verdict = check_line(line)
+            if raw is None:
+                # unparseable (torn by a kill) or failed its seal
+                # (rotted in place) — either way one line is dropped,
+                # never the ledger
+                try:
+                    json.loads(line)
+                    corrupt += 1  # parsed fine: the seal failed
+                except ValueError:
+                    dropped += 1
+                continue
             try:
-                raw = json.loads(line)
                 out.append(LedgerEntry(
                     **{k: raw[k] for k in
                        LedgerEntry.__dataclass_fields__ if k in raw}))
@@ -151,6 +181,12 @@ class QuarantineLedger:
             logger.warning("quarantine ledger %s: dropped %d unparseable "
                            "line(s) (truncated by a kill?)", path,
                            dropped)
+        if corrupt:
+            logger.warning("quarantine ledger %s: dropped %d line(s) "
+                           "failing their _sha256 seal (bit rot? run "
+                           "tools/campaign_fsck.py --repair)", path,
+                           corrupt)
+        self.corrupt_lines += corrupt
         return out
 
     def load(self) -> int:
@@ -167,6 +203,7 @@ class QuarantineLedger:
         ties."""
         self.entries = []
         self._latest = {}
+        self.corrupt_lines = 0
         merged = []
         for p in self.read_paths:
             merged.extend(self._read_file(p))
@@ -196,7 +233,7 @@ class QuarantineLedger:
             pass
         with open(self.path, "a", encoding="utf-8") as f:
             f.write(("\n" if needs_nl else "")
-                    + json.dumps(asdict(entry), default=str) + "\n")
+                    + seal_line(asdict(entry)) + "\n")
             f.flush()
             os.fsync(f.fileno())
 

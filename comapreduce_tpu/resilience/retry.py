@@ -52,15 +52,21 @@ def classify_error(exc: BaseException) -> str:
     """``"transient"`` (worth retrying), ``"hang"`` (a cancelled
     deadline — retried like a transient, but ledgered ``rejected``
     rather than quarantined: a hang indicts the environment, not the
-    file) or ``"permanent"`` (never retried).
+    file), ``"corrupt"`` (a committed artifact failed checksum
+    verification — deterministic damage, never retried, triaged per
+    artifact class: see ``resilience.integrity``) or ``"permanent"``
+    (never retried).
 
-    ``HangError`` subclasses ``OSError`` so existing per-file nets
-    catch it; it must therefore be checked BEFORE the transient class.
-    Unknown exception types classify permanent: retrying a failure mode
-    nobody has triaged just delays the quarantine entry that gets it
-    triaged."""
+    ``HangError`` and ``CorruptArtifactError`` subclass ``OSError`` so
+    existing per-file nets catch them; both must therefore be checked
+    BEFORE the transient class. Unknown exception types classify
+    permanent: retrying a failure mode nobody has triaged just delays
+    the quarantine entry that gets it triaged."""
+    from comapreduce_tpu.resilience.integrity import CorruptArtifactError
     from comapreduce_tpu.resilience.watchdog import HangError
 
+    if isinstance(exc, CorruptArtifactError):
+        return "corrupt"
     if isinstance(exc, HangError):
         return "hang"
     if isinstance(exc, TRANSIENT_ERRORS):

@@ -98,13 +98,20 @@ def build_report(output_dir: str, stale_s: float = 60.0,
                                              "quarantine*.jsonl")))
     entries = []
     summary: dict = {}
-    stalls, hangs = [], []
+    stalls, hangs, corruption = [], [], []
+    corrupt_lines = 0
     if ledgers:
         led = QuarantineLedger(ledgers[0],
                                read_paths=tuple(ledgers[1:]))
         entries = led.entries
         summary = led.summary()
+        corrupt_lines = led.corrupt_lines
         for e in entries:
+            if e.disposition == "corrupt":
+                corruption.append({
+                    "t": e.t, "unit": e.unit.get("file", ""),
+                    "stage": e.stage, "message": e.message,
+                    "disposition": e.disposition})
             if e.failure_class != "hang":
                 continue
             row = {"t": e.t, "unit": e.unit.get("file", ""),
@@ -132,6 +139,12 @@ def build_report(output_dir: str, stale_s: float = 60.0,
                         if e.disposition == "stolen"),
         "stalls": stalls[-20:],
         "hangs": hangs[-20:],
+        # integrity plane (docs/OPERATIONS.md §20): artifacts whose
+        # checksum verification failed, plus ledger lines dropped for
+        # failing their own embedded seal
+        "corruption": corruption[-20:],
+        "n_corrupt": len(corruption),
+        "n_corrupt_ledger_lines": corrupt_lines,
         "queue": queue,
         "leases": leases,
         "n_expired_leases": sum(1 for l in leases if l["expired"]),

@@ -42,13 +42,21 @@ import time
 
 from comapreduce_tpu.data.durable import (_fsync_dir, durable_replace,
                                           fsync_path)
+from comapreduce_tpu.resilience.integrity import (check_json, seal_json,
+                                                  sha256_path,
+                                                  verify_enabled)
+from comapreduce_tpu.telemetry.core import TELEMETRY
 
 __all__ = ["EpochStore", "EpochFenceError", "read_epoch_manifest",
-           "MANIFEST", "CURRENT_LINK", "CURRENT_FILE", "epoch_name"]
+           "read_epoch_integrity", "verify_epoch",
+           "verify_epoch_product",
+           "MANIFEST", "INTEGRITY", "CURRENT_LINK", "CURRENT_FILE",
+           "epoch_name"]
 
 logger = logging.getLogger(__name__)
 
 MANIFEST = "manifest.json"
+INTEGRITY = "integrity.json"
 CURRENT_LINK = "current"
 CURRENT_FILE = "CURRENT"
 _EPOCH_RE = re.compile(r"^epoch-(\d{6,})$")
@@ -81,9 +89,99 @@ def read_epoch_manifest(path: str) -> dict | None:
             man = json.load(f)
     except (OSError, ValueError):
         return None
-    if not isinstance(man, dict) or int(man.get("schema", 0)) != 1:
+    if not isinstance(man, dict):
+        return None
+    man, verdict = check_json(man)
+    if verdict is False:
+        # the manifest parsed but its embedded seal does not match:
+        # rotted in place — this epoch is no longer a publishable fact
+        logger.warning("epoch manifest %s fails its _sha256 seal; "
+                       "treating the epoch as incomplete (run "
+                       "tools/campaign_fsck.py)", p)
+        return None
+    if int(man.get("schema", 0)) != 1:
         return None
     return man
+
+
+def read_epoch_integrity(path: str) -> dict | None:
+    """The product-digest manifest of an epoch dir (or a direct
+    integrity.json path); None when absent/torn/failing its own seal.
+    Shape: ``{"schema": 1, "algo": "sha256",
+    "products": {filename: hexdigest}}``."""
+    p = str(path)
+    if os.path.isdir(p):
+        p = os.path.join(p, INTEGRITY)
+    try:
+        with open(p, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    doc, verdict = check_json(doc)
+    if verdict is False or int(doc.get("schema", 0)) != 1:
+        return None
+    return doc
+
+
+def verify_epoch(epoch_dir: str) -> tuple[int, list]:
+    """Verify every published product of ``epoch_dir`` against its
+    ``integrity.json``. Returns ``(n_verified, problems)`` where each
+    problem is ``(filename, detail)``. Epochs published before the
+    integrity plane (no integrity.json) — and disabled verification —
+    report ``(0, [])``: unverified, never condemned. Mismatches tick
+    the ``integrity.violations`` counter; the caller chooses between
+    raising (``tiles.tiler``) and reporting (``campaign_fsck``)."""
+    ipath = os.path.join(epoch_dir, INTEGRITY)
+    if not verify_enabled() or not os.path.exists(ipath):
+        return (0, [])
+    body = read_epoch_integrity(epoch_dir)
+    if body is None:
+        TELEMETRY.counter("integrity.violations", kind="epoch")
+        return (0, [(INTEGRITY,
+                     "integrity manifest torn or failing its seal")])
+    problems = []
+    n_ok = 0
+    for name, want in sorted(body.get("products", {}).items()):
+        p = os.path.join(epoch_dir, name)
+        try:
+            got = sha256_path(p)
+        except OSError as exc:
+            problems.append((name, f"unreadable: {exc}"))
+            continue
+        if got != want:
+            problems.append((name, f"sha256 {got[:12]} != committed "
+                                   f"{want[:12]}"))
+        else:
+            n_ok += 1
+    if problems:
+        TELEMETRY.counter("integrity.violations",
+                          value=len(problems), kind="epoch")
+    return (n_ok, problems)
+
+
+def verify_epoch_product(epoch_dir: str, name: str) -> bool | None:
+    """Verify ONE product of ``epoch_dir`` against its integrity
+    manifest: True (digest matches), None (unverified — no manifest,
+    product not listed, or verification disabled), False (mismatch or
+    unreadable; counted)."""
+    if not verify_enabled():
+        return None
+    body = read_epoch_integrity(epoch_dir)
+    if not body:
+        return None
+    want = body.get("products", {}).get(name)
+    if not want:
+        return None
+    try:
+        got = sha256_path(os.path.join(epoch_dir, name))
+    except OSError:
+        return False
+    if got == want:
+        return True
+    TELEMETRY.counter("integrity.violations", kind="epoch")
+    return False
 
 
 class EpochStore:
@@ -204,6 +302,21 @@ class EpochStore:
         tmp = tempfile.mkdtemp(prefix=".tmp-epoch.", dir=self.root)
         try:
             extras = write_products(tmp) or {}
+            # the epoch's integrity manifest: sha256 of every product
+            # as written, sealed, committed inside the same tmp dir —
+            # it rides the atomic epoch rename, so a complete epoch
+            # ALWAYS carries verifiable digests (fence retries rewrite
+            # only manifest.json; the products never change)
+            products = {name: sha256_path(os.path.join(tmp, name))
+                        for name in sorted(os.listdir(tmp))
+                        if os.path.isfile(os.path.join(tmp, name))
+                        and not name.endswith(".tmp")}
+            itmp = os.path.join(tmp, INTEGRITY + ".tmp")
+            with open(itmp, "w", encoding="utf-8") as f:
+                json.dump(seal_json({"schema": 1, "algo": "sha256",
+                                     "products": products}),
+                          f, sort_keys=True, indent=1)
+            durable_replace(itmp, os.path.join(tmp, INTEGRITY))
             while True:
                 # fence BEFORE the manifest write so the manifest bakes
                 # the final epoch number
@@ -230,7 +343,8 @@ class EpochStore:
                     man.update(meta)
                 mtmp = os.path.join(tmp, MANIFEST + ".tmp")
                 with open(mtmp, "w", encoding="utf-8") as f:
-                    json.dump(man, f, sort_keys=True, indent=1)
+                    json.dump(seal_json(man), f, sort_keys=True,
+                              indent=1)
                 durable_replace(mtmp, os.path.join(tmp, MANIFEST))
                 for name in os.listdir(tmp):
                     p = os.path.join(tmp, name)
@@ -255,6 +369,16 @@ class EpochStore:
             if tmp:
                 self._rmtree(tmp)
         _fsync_dir(self.root)
+        if chaos is not None:
+            # bit_rot drills hit the COMMITTED products — after the
+            # integrity manifest hashed the honest bytes, so injected
+            # rot is always detectable rot (the manifests themselves
+            # are exempt: the drill's subject is product damage)
+            for name in sorted(os.listdir(self.epoch_dir(n))):
+                p = os.path.join(self.epoch_dir(n), name)
+                if os.path.isfile(p) and name not in (MANIFEST,
+                                                      INTEGRITY):
+                    chaos.maybe_bit_rot(p)
         self.set_current(n)
         logger.info("published %s (%d files) in %s", epoch_name(n),
                     len(census), self.root)

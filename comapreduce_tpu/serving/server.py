@@ -68,8 +68,19 @@ _MAP = "map_band{band}.fits"
 def load_epoch_offsets(path: str) -> dict | None:
     """Published per-epoch solver state: ``{"offsets": f32[n],
     "files": [basename...], "n_offsets": i64[n_files]}`` — the next
-    epoch's warm-start source. None when absent/torn/foreign."""
+    epoch's warm-start source. None when absent/torn/foreign — or
+    when the product fails its epoch integrity manifest
+    (``serving.epochs.verify_epoch_product``): warm-starting CG from
+    bit-rotted offsets would converge to a silently wrong map, so a
+    corrupt warm start costs iterations, never correctness."""
     if not os.path.exists(path):
+        return None
+    from comapreduce_tpu.serving.epochs import verify_epoch_product
+
+    if verify_epoch_product(os.path.dirname(os.path.abspath(path)),
+                            os.path.basename(path)) is False:
+        logger.warning("epoch offsets %s fail their integrity "
+                       "manifest; next epoch starts cold", path)
         return None
     try:
         with np.load(path) as z:

@@ -463,6 +463,13 @@ class LiveServer:
         gauge("comap_live_ranks_stale", rep["n_stale"])
         gauge("comap_live_expired_leases", rep["n_expired_leases"])
         gauge("comap_live_healthy", 1 if report_healthy(rep) else 0)
+        # integrity plane (docs/OPERATIONS.md §20): ledger-derived, so
+        # corruption found by any past rank surfaces even when no live
+        # rank is currently ticking comap_integrity_violations_total
+        gauge("comap_integrity_corrupt_artifacts",
+              rep.get("n_corrupt", 0))
+        gauge("comap_integrity_corrupt_ledger_lines",
+              rep.get("n_corrupt_ledger_lines", 0))
         q = rep.get("queue")
         if q:
             for k in ("n_files", "n_done", "n_claimed", "n_pending",

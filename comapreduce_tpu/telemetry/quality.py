@@ -368,8 +368,9 @@ def append_quality(path: str, records: list) -> None:
                 needs_nl = f.read(1) != b"\n"
         except OSError:
             pass
-        payload = "".join(json.dumps(r, separators=(",", ":")) + "\n"
-                          for r in records)
+        from comapreduce_tpu.resilience.integrity import seal_line
+
+        payload = "".join(seal_line(r) + "\n" for r in records)
         with open(path, "a", encoding="utf-8") as f:
             f.write(("\n" if needs_nl else "") + payload)
             f.flush()
@@ -384,7 +385,13 @@ def read_quality(source) -> list:
 
     ``source``: a state directory (every ``quality.rank*.jsonl`` in
     it), one path, or a list of paths. Torn lines are dropped like
-    every JSONL reader here."""
+    every JSONL reader here; so are lines failing their embedded
+    ``_sha256`` seal (a rotted flag flipping a file in or out of the
+    destriper's exclusion set is a map-level corruption, not a
+    bookkeeping blip) — ``tools/campaign_fsck.py --repair`` rewrites
+    the file without them."""
+    from comapreduce_tpu.resilience.integrity import check_line
+
     if isinstance(source, (list, tuple)):
         paths = [str(p) for p in source]
     elif os.path.isdir(source):
@@ -393,6 +400,7 @@ def read_quality(source) -> list:
     else:
         paths = [str(source)]
     latest: dict = {}
+    corrupt = 0
     for path in paths:
         try:
             with open(path, "rb") as f:
@@ -403,8 +411,13 @@ def read_quality(source) -> list:
             if not line.strip():
                 continue
             try:
-                rec = json.loads(line)
-            except Exception:
+                text = line.decode("utf-8")
+            except UnicodeDecodeError:
+                continue
+            rec, verdict = check_line(text)
+            if rec is None:
+                if verdict is False and b"_sha256" in line:
+                    corrupt += 1
                 continue
             if not isinstance(rec, dict) or "file" not in rec:
                 continue
@@ -413,6 +426,9 @@ def read_quality(source) -> list:
             if prev is None or str(rec.get("t", "")) >= \
                     str(prev.get("t", "")):
                 latest[key] = rec
+    if corrupt:
+        logger.warning("read_quality: dropped %d line(s) failing "
+                       "their integrity seal", corrupt)
     return sorted(latest.values(),
                   key=lambda r: (str(r.get("file")),
                                  r.get("feed") or 0, r.get("band") or 0))

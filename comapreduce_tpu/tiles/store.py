@@ -23,13 +23,32 @@ object writes and the manifest rename) is bounded and harmless;
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
+import time
 
 from comapreduce_tpu.data.durable import durable_replace
+from comapreduce_tpu.resilience.integrity import (CorruptArtifactError,
+                                                  verify_enabled)
+from comapreduce_tpu.telemetry.core import TELEMETRY
 
-__all__ = ["TileStore"]
+__all__ = ["TileStore", "PUBLISH_MARKER_PREFIX"]
+
+logger = logging.getLogger(__name__)
 
 OBJECTS_DIR = "objects"
+
+#: in-flight tile publish sentinel (``tiles-epoch-NNNNNN.tmp<pid>`` in
+#: the tiles root) — created by ``tiles.tiler.tile_epoch`` before its
+#: first object write, removed after the CURRENT swap. While a FRESH
+#: one exists, :meth:`TileStore.sweep_unreferenced` refuses to run:
+#: GC racing a publish must not delete an object the in-flight
+#: manifest is about to reference.
+PUBLISH_MARKER_PREFIX = "tiles-epoch-"
+
+#: objects younger than this are never swept (seconds) — the window
+#: between an object's ``put`` and its manifest's rename, with margin
+DEFAULT_SWEEP_GRACE_S = 300.0
 
 
 class TileStore:
@@ -68,8 +87,28 @@ class TileStore:
         return digest, True
 
     def get(self, digest: str) -> bytes:
-        with open(self.path(digest), "rb") as f:
-            return f.read()
+        """Read an object, verifying content-addressing on the way
+        out: the name IS the committed sha256, so a rehash mismatch is
+        proof of in-place damage. A corrupt object is unlinked (an
+        idempotent re-put repairs it — the bytes rebuild from the
+        epoch FITS) and :class:`CorruptArtifactError` raised so the
+        HTTP plane 404s instead of serving rot under an immutable
+        cache header."""
+        path = self.path(digest)
+        with open(path, "rb") as f:
+            blob = f.read()
+        if verify_enabled() and self.digest(blob) != str(digest):
+            TELEMETRY.counter("integrity.violations", kind="tile")
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            logger.warning("tile object %s fails its content hash; "
+                           "unlinked (re-put rebuilds it)", digest)
+            raise CorruptArtifactError(path, kind="tile",
+                                       expected=str(digest),
+                                       actual=self.digest(blob))
+        return blob
 
     def size(self, digest: str) -> int:
         return os.stat(self.path(digest)).st_size
@@ -90,17 +129,63 @@ class TileStore:
                         pass
         return n
 
-    def sweep_unreferenced(self, live: set) -> int:
+    def publish_in_flight(self, max_age_s: float = 3600.0) -> bool:
+        """True while a fresh ``tiles-epoch-*.tmp*`` publish marker
+        exists in the tiles root — a tiler is between its first object
+        write and its CURRENT swap. Markers older than ``max_age_s``
+        are a crashed publisher's litter and do not count (a killed
+        tiler must not block GC forever)."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return False
+        now = time.time()
+        for name in names:
+            if not (name.startswith(PUBLISH_MARKER_PREFIX)
+                    and ".tmp" in name):
+                continue
+            try:
+                age = now - os.path.getmtime(
+                    os.path.join(self.root, name))
+            except OSError:
+                continue
+            if age < max_age_s:
+                return True
+        return False
+
+    def sweep_unreferenced(self, live: set,
+                           grace_s: float = DEFAULT_SWEEP_GRACE_S) -> int:
         """Remove objects whose digest is not in ``live`` (the union of
         every manifest's hashes — the caller computes it so rollback
-        targets stay servable); returns how many were removed."""
+        targets stay servable); returns how many were removed.
+
+        Two guards against GC racing a concurrent publish: the sweep
+        refuses outright while a fresh ``tiles-epoch-*`` publish marker
+        exists (:meth:`publish_in_flight` — that tiler's manifest is
+        not on disk yet, so ``live`` cannot include its objects), and
+        objects younger than ``grace_s`` are always spared (a put whose
+        manifest is still being written looks unreferenced for a few
+        seconds even without a marker — e.g. a publisher on another
+        host whose marker write raced this listing)."""
+        if self.publish_in_flight():
+            logger.info("tile sweep skipped: a tiles-epoch publish is "
+                        "in flight in %s", self.root)
+            return 0
         n = 0
+        now = time.time()
         for sub, _, names in os.walk(self.objects):
             for name in names:
                 if ".tmp" in name or name in live:
                     continue
+                path = os.path.join(sub, name)
+                if grace_s > 0:
+                    try:
+                        if now - os.path.getmtime(path) < grace_s:
+                            continue
+                    except OSError:
+                        continue
                 try:
-                    os.remove(os.path.join(sub, name))
+                    os.remove(path)
                     n += 1
                 except OSError:
                     pass

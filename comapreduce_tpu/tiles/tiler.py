@@ -37,6 +37,7 @@ import time
 import numpy as np
 
 from comapreduce_tpu.data.durable import durable_replace
+from comapreduce_tpu.resilience.integrity import check_json, seal_json
 from comapreduce_tpu.serving.epochs import epoch_name, parse_epoch_name
 from comapreduce_tpu.tiles import layout
 from comapreduce_tpu.tiles.blob import encode_tile
@@ -59,7 +60,8 @@ TILE_HEADER_BOUND = 512
 
 
 def _write_json(path: str, obj: dict) -> bytes:
-    raw = json.dumps(obj, sort_keys=True, indent=1).encode("utf-8")
+    raw = json.dumps(seal_json(obj), sort_keys=True,
+                     indent=1).encode("utf-8")
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(raw)
@@ -73,7 +75,17 @@ def _read_json(path: str) -> dict | None:
             obj = json.load(f)
     except (OSError, ValueError):
         return None
-    return obj if isinstance(obj, dict) else None
+    if not isinstance(obj, dict):
+        return None
+    obj, verdict = check_json(obj)
+    if verdict is False:
+        # a manifest that parses but fails its seal rotted in place —
+        # unusable exactly like a torn one (re-tiling rebuilds it)
+        logger.warning("tile manifest %s fails its _sha256 seal; "
+                       "ignoring it (re-tile the epoch or run "
+                       "tools/campaign_fsck.py)", path)
+        return None
+    return obj
 
 
 class TileSet:
@@ -274,63 +286,102 @@ def tile_epoch(epoch_dir: str, tiles_root: str, *,
     manifest rename. Re-tiling an already-tiled epoch is idempotent:
     objects are content-addressed and the manifest is atomically
     replaced by an identical one.
+
+    A ``tiles-epoch-NNNNNN.tmp<pid>`` publish marker sits in the tiles
+    root from before the first object write until after the CURRENT
+    swap: while it exists, ``TileStore.sweep_unreferenced`` refuses to
+    GC — the in-flight manifest references objects no on-disk manifest
+    does yet. A killed tiler's stale marker ages out
+    (``TileStore.publish_in_flight``); the next re-tile removes it.
+
+    The source epoch is verified against its ``integrity.json``
+    first: tiling a bit-rotted FITS would launder the damage into
+    content-addressed tiles that verify forever after.
     """
     from comapreduce_tpu.mapmaking.fits_io import read_fits_image
-    from comapreduce_tpu.serving.epochs import read_epoch_manifest
+    from comapreduce_tpu.resilience.integrity import CorruptArtifactError
+    from comapreduce_tpu.serving.epochs import (read_epoch_manifest,
+                                                verify_epoch)
 
     epoch_dir = str(epoch_dir)
     man_src = read_epoch_manifest(epoch_dir)
     if man_src is None:
         raise ValueError(f"{epoch_dir} is not a complete epoch (no "
                          "readable manifest.json)")
+    _, problems = verify_epoch(epoch_dir)
+    if problems:
+        name, detail = problems[0]
+        raise CorruptArtifactError(os.path.join(epoch_dir, name),
+                                   kind="epoch", detail=detail)
     n = int(man_src["epoch"])
     ts = TileSet(tiles_root)
+    marker = os.path.join(str(tiles_root),
+                          f"tiles-{epoch_name(n)}.tmp{os.getpid()}")
     t0 = time.perf_counter()
     tiles: dict[str, list] = {}
     stats = {"total_bytes": 0, "n_new_objects": 0, "n_empty": 0}
     bands, pixelization = set(), None
-    for map_name in man_src.get("maps", []):
-        path = os.path.join(epoch_dir, str(map_name))
-        images = read_fits_image(path)
-        if not images:
-            raise ValueError(f"{path}: no image HDUs")
-        hdr0 = images[0][1]
-        band = _band_of(map_name)
-        bands.add(band)
-        if hdr0.get("PIXTYPE") == "HEALPIX":
-            pix = _tile_healpix(images, hdr0, band, tile_nside,
-                                ts.store, tiles, stats)
-        else:
-            pix = _tile_wcs(images, hdr0, band, tile_px, ts.store,
-                            tiles, stats)
-        if pixelization is not None and pixelization != pix:
-            raise ValueError(f"epoch {n} mixes pixelisations across "
-                             f"bands: {pixelization} vs {pix}")
-        pixelization = pix
-    if pixelization is None:
-        raise ValueError(f"epoch {n} manifest lists no map products")
-    products = _product_names(ts, tiles)
-    manifest = {
-        "schema": 1, "kind": "tiles", "epoch": n,
-        "pixelization": pixelization, "products": products,
-        "bands": sorted(bands), "tiles": tiles,
-        "n_tiles": len(tiles), "n_empty": stats["n_empty"],
-        "total_bytes": stats["total_bytes"],
-        "source": {"n_files": int(man_src.get("n_files", 0)),
-                   "census_sha1": hashlib.sha1("\n".join(
-                       man_src.get("census", [])).encode()).hexdigest()},
-        "t_publish_unix": float(now()),
-        "t_tile_s": round(time.perf_counter() - t0, 3),
-    }
-    prev = max((p for p in ts.list_tiled() if p < n), default=None)
-    if chaos is not None:
-        chaos.maybe_kill_publish(f"tiles-{epoch_name(n)}")
-    _write_json(ts.manifest_path(n), manifest)
-    delta = _build_delta(ts, n, manifest, prev)
-    _write_json(ts.delta_path(n), delta)
-    cur = ts.current()
-    if cur is None or n >= cur:
-        ts.set_current(n, force=True)
+    with open(marker, "w") as f:
+        f.write(f"{os.getpid()}\n")
+    try:
+        for map_name in man_src.get("maps", []):
+            path = os.path.join(epoch_dir, str(map_name))
+            images = read_fits_image(path)
+            if not images:
+                raise ValueError(f"{path}: no image HDUs")
+            hdr0 = images[0][1]
+            band = _band_of(map_name)
+            bands.add(band)
+            if hdr0.get("PIXTYPE") == "HEALPIX":
+                pix = _tile_healpix(images, hdr0, band, tile_nside,
+                                    ts.store, tiles, stats)
+            else:
+                pix = _tile_wcs(images, hdr0, band, tile_px, ts.store,
+                                tiles, stats)
+            if pixelization is not None and pixelization != pix:
+                raise ValueError(f"epoch {n} mixes pixelisations "
+                                 f"across bands: {pixelization} vs "
+                                 f"{pix}")
+            pixelization = pix
+        if pixelization is None:
+            raise ValueError(f"epoch {n} manifest lists no map "
+                             "products")
+        products = _product_names(ts, tiles)
+        manifest = {
+            "schema": 1, "kind": "tiles", "epoch": n,
+            "pixelization": pixelization, "products": products,
+            "bands": sorted(bands), "tiles": tiles,
+            "n_tiles": len(tiles), "n_empty": stats["n_empty"],
+            "total_bytes": stats["total_bytes"],
+            "source": {"n_files": int(man_src.get("n_files", 0)),
+                       "census_sha1": hashlib.sha1("\n".join(
+                           man_src.get("census", [])
+                       ).encode()).hexdigest()},
+            "t_publish_unix": float(now()),
+            "t_tile_s": round(time.perf_counter() - t0, 3),
+        }
+        prev = max((p for p in ts.list_tiled() if p < n), default=None)
+        if chaos is not None:
+            chaos.maybe_kill_publish(f"tiles-{epoch_name(n)}")
+        _write_json(ts.manifest_path(n), manifest)
+        delta = _build_delta(ts, n, manifest, prev)
+        _write_json(ts.delta_path(n), delta)
+        cur = ts.current()
+        if cur is None or n >= cur:
+            ts.set_current(n, force=True)
+    finally:
+        # the marker outlives a SIGKILL by design (it ages out /
+        # the re-tile clears it) but never an ordinary exception —
+        # GC must not stay blocked for an hour over a config error.
+        # Stale same-epoch markers from a killed predecessor go too:
+        # this (re-)tile just committed or failed; either way no
+        # in-flight manifest references unreachable objects.
+        for name in os.listdir(str(tiles_root)):
+            if name.startswith(f"tiles-{epoch_name(n)}.tmp"):
+                try:
+                    os.unlink(os.path.join(str(tiles_root), name))
+                except OSError:
+                    pass
     logger.info("tiled %s: %d tiles (%d empty skipped), %d bytes, "
                 "delta %d changed / %d removed vs %s", epoch_name(n),
                 len(tiles), stats["n_empty"], stats["total_bytes"],

@@ -137,7 +137,8 @@ def _run_chain(outdir, files, campaign=None, ingest=None):
 def _level2_datasets(outdir):
     import h5py
 
-    (name,) = [f for f in os.listdir(outdir) if f.startswith("Level2_")]
+    (name,) = [f for f in os.listdir(outdir)
+               if f.startswith("Level2_") and not f.endswith(".s256")]
     out = {}
     with h5py.File(os.path.join(str(outdir), name), "r") as h:
         def visit(path, node):
@@ -218,7 +219,8 @@ def test_bucketed_destriped_map_parity(synth_obs, tmp_path):
     for tag in ("exact", "bucketed"):
         outdir = str(tmp_path / tag)
         (name,) = [f for f in os.listdir(outdir)
-                   if f.startswith("Level2_")]
+                   if f.startswith("Level2_")
+                   and not f.endswith(".s256")]
         data = read_comap_data([os.path.join(outdir, name)], band=0,
                                wcs=wcs, offset_length=50,
                                medfilt_window=51, use_calibration=False)

@@ -1,0 +1,440 @@
+"""End-to-end integrity plane (OPERATIONS §20): sidecars, seals,
+verify-on-read, per-class bit-flip triage, and crash-window safety.
+
+The heart is the parametrized corruption matrix: for EVERY durable
+artifact class — Level-2 checkpoint, BlockCache spill entry, solver
+snapshot, epoch product, tile object, quarantine ledger line, quality
+ledger line — flip one committed byte and assert the read boundary
+detects it, triages it correctly (rebuild for re-derivable state,
+drop-and-count for ledger lines), and that re-derivation repairs it.
+The crash-window tests pin the ``committed_replace`` ordering promise:
+a SIGKILL at ANY point between the sidecar write and the payload
+rename leaves an artifact that is old-or-new, never unverifiable.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.resilience.chaos import ChaosMonkey, flip_byte
+from comapreduce_tpu.resilience.integrity import (CorruptArtifactError,
+                                                  check_json, check_line,
+                                                  committed_replace,
+                                                  read_sidecar, seal_json,
+                                                  seal_line, sha256_path,
+                                                  sidecar_path,
+                                                  verify_enabled,
+                                                  verify_file,
+                                                  write_sidecar)
+from comapreduce_tpu.resilience.ledger import QuarantineLedger
+from comapreduce_tpu.resilience.retry import classify_error
+
+
+def _commit(path: str, payload: bytes, kind: str = "blob") -> None:
+    tmp = path + ".tmp1"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    committed_replace(tmp, path, kind=kind)
+
+
+# -- sidecar + seal primitives ---------------------------------------------
+
+def test_sidecar_roundtrip_and_verify(tmp_path):
+    p = str(tmp_path / "artifact.bin")
+    _commit(p, b"payload-bytes", kind="checkpoint")
+    sc = read_sidecar(p)
+    assert sc["kind"] == "checkpoint" and sc["algo"] == "sha256"
+    assert sc["digests"] == [sha256_path(p)]
+    assert verify_file(p, kind="checkpoint") is True
+    # no sidecar: unverified (None), unless the caller requires one
+    bare = str(tmp_path / "bare.bin")
+    with open(bare, "wb") as f:
+        f.write(b"x")
+    assert verify_file(bare) is None
+    with pytest.raises(CorruptArtifactError):
+        verify_file(bare, required=True)
+
+
+def test_verify_raises_on_flip_and_knob_disables(tmp_path, monkeypatch):
+    p = str(tmp_path / "artifact.bin")
+    _commit(p, b"payload-bytes" * 100)
+    flip_byte(p, seed=3)
+    with pytest.raises(CorruptArtifactError) as ei:
+        verify_file(p)
+    assert ei.value.path == p
+    # forensics knob: disabled verification reads as UNVERIFIED (None),
+    # never as OK (True)
+    monkeypatch.setenv("COMAP_VERIFY_READS", "0")
+    assert not verify_enabled()
+    assert verify_file(p) is None
+
+
+def test_digest_history_keeps_rewrites_verifiable(tmp_path):
+    p = str(tmp_path / "artifact.bin")
+    for i in range(3):
+        _commit(p, b"generation-%d" % i)
+    sc = read_sidecar(p)
+    assert len(sc["digests"]) == 3
+    assert verify_file(p) is True
+
+
+def test_seal_json_roundtrip_tamper_and_legacy():
+    body = {"schema": 1, "files": ["a", "b"], "n": 3}
+    sealed = seal_json(body)
+    got, verdict = check_json(sealed)
+    assert verdict is True and got == body
+    sealed["n"] = 4  # tamper after sealing
+    _, verdict = check_json(sealed)
+    assert verdict is False
+    # pre-plane documents carry no seal: unverified, never condemned
+    assert check_json({"schema": 1, "n": 3})[1] is None
+
+
+def test_seal_line_roundtrip_and_torn():
+    line = seal_line({"t": "now", "disposition": "ok"})
+    body, verdict = check_line(line)
+    assert verdict is True and body["disposition"] == "ok"
+    assert check_line(line[: len(line) // 2]) == (None, False)  # torn
+    tampered = line.replace('"ok"', '"no"')
+    assert check_line(tampered) == (None, False)
+
+
+# -- the crash window: old-or-new, never unverifiable ----------------------
+
+def test_kill_between_sidecar_and_payload_rename(tmp_path):
+    """committed_replace writes the sidecar FIRST: a SIGKILL after the
+    sidecar rename but before the payload rename leaves the OLD
+    payload under the NEW sidecar — the digest history still holds the
+    old digest, so the artifact verifies."""
+    p = str(tmp_path / "artifact.bin")
+    _commit(p, b"old-generation")
+    # simulate the torn second commit: new sidecar lands, payload
+    # rename never happens (the crash point)
+    tmp = p + ".tmp2"
+    with open(tmp, "wb") as f:
+        f.write(b"new-generation")
+    write_sidecar(tmp, p, kind="blob")
+    os.unlink(tmp)
+    assert verify_file(p) is True  # old payload, new sidecar: verifies
+
+
+def test_kill_during_sidecar_write_leaves_old_verifiable(tmp_path):
+    """A SIGKILL mid-sidecar-write leaves only a sidecar temp stump;
+    the committed sidecar+payload pair is untouched and verifies."""
+    p = str(tmp_path / "artifact.bin")
+    _commit(p, b"old-generation")
+    stump = sidecar_path(p) + ".tmp999"
+    with open(stump, "w") as f:
+        f.write('{"schema": 1, "digests": ["tor')  # torn mid-write
+    assert verify_file(p) is True
+    # and a torn COMMITTED sidecar reads as absent -> unverified
+    with open(sidecar_path(p), "w") as f:
+        f.write('{"schema": 1, "digests": ["tor')
+    assert read_sidecar(p) is None
+    assert verify_file(p) is None
+
+
+# -- chaos bit_rot ---------------------------------------------------------
+
+def test_flip_byte_is_deterministic_and_always_flips(tmp_path):
+    p = str(tmp_path / "a.bin")
+    with open(p, "wb") as f:
+        f.write(b"0123456789" * 20)
+    before = sha256_path(p)
+    off1, mask1 = flip_byte(p, seed=11)
+    assert mask1 != 0 and sha256_path(p) != before
+    flip_byte(p, seed=11)  # same (seed, basename): same byte flips back
+    assert sha256_path(p) == before
+    assert (off1, mask1) == flip_byte(p, seed=11)
+    # empty files: nothing to rot
+    e = str(tmp_path / "empty.bin")
+    open(e, "wb").close()
+    assert flip_byte(e, seed=11) == (-1, 0)
+
+
+def test_bit_rot_fires_once_per_basename_and_is_detectable(tmp_path):
+    p = str(tmp_path / "victim.bin")
+    _commit(p, b"committed-honestly" * 10)
+    monkey = ChaosMonkey("bit_rot", seed=5)
+    assert monkey.maybe_bit_rot(p)
+    with pytest.raises(CorruptArtifactError):
+        verify_file(p)  # rot landed AFTER the honest hash
+    assert not monkey.maybe_bit_rot(p)  # repaired artifacts stay fixed
+
+
+def test_bit_rot_in_chaos_kinds():
+    from comapreduce_tpu.resilience.chaos import CHAOS_KINDS
+
+    assert "bit_rot" in CHAOS_KINDS
+
+
+# -- triage plumbing -------------------------------------------------------
+
+def test_classify_and_ledger_corrupt_disposition(tmp_path):
+    exc = CorruptArtifactError("/d/x.hd5", kind="checkpoint",
+                               expected="aa" * 32, actual="bb" * 32)
+    assert classify_error(exc) == "corrupt"
+    led = QuarantineLedger(str(tmp_path / "q.jsonl"))
+    led.record("/d/x.hd5", error=exc, failure_class="corrupt",
+               disposition="corrupt", stage="ingest.read")
+    assert led.is_quarantined("/d/x.hd5")  # corrupt skips like
+    led.record("/d/x.hd5", disposition="recovered", stage="rebuild")
+    assert not led.is_quarantined("/d/x.hd5")  # ...and lifts like
+
+
+def test_record_failure_routes_corrupt_even_without_quarantine(tmp_path):
+    from comapreduce_tpu.resilience import Resilience
+
+    res = Resilience(ledger=QuarantineLedger(str(tmp_path / "q.jsonl")))
+    exc = CorruptArtifactError("/d/x.hd5", kind="checkpoint")
+    res.record_failure("/d/x.hd5", exc, stage="stage.write",
+                       may_quarantine=False)
+    e = res.ledger.latest("/d/x.hd5")
+    assert e.failure_class == "corrupt" and e.disposition == "corrupt"
+
+
+# -- the corruption matrix: one committed artifact per class ---------------
+
+
+def _case_checkpoint(tmp_path):
+    from comapreduce_tpu.data.hdf5io import HDF5Store
+
+    p = str(tmp_path / "Level2_x.hd5")
+    store = HDF5Store(name="l2")
+    store["g/data"] = np.arange(64, dtype=np.float32)
+    store.write(p, atomic=True)
+
+    def detect():
+        with pytest.raises(CorruptArtifactError):
+            HDF5Store().read(p)
+
+    def rebuild():
+        os.unlink(p)
+        s2 = HDF5Store(name="l2")
+        s2["g/data"] = np.arange(64, dtype=np.float32)
+        s2.write(p, atomic=True)
+        got = HDF5Store().read(p)
+        assert np.array_equal(np.asarray(got["g/data"]),
+                              np.arange(64, dtype=np.float32))
+
+    return p, detect, rebuild
+
+
+def _case_spill(tmp_path):
+    from comapreduce_tpu.ingest.cache import BlockCache
+
+    src = str(tmp_path / "src.bin")
+    with open(src, "wb") as f:
+        f.write(b"source")
+    cache = BlockCache(max_bytes=16, spill_dir=str(tmp_path / "spill"))
+    payload = np.arange(1024, dtype=np.float64)
+    cache.put(src, payload)
+    spill = [str(tmp_path / "spill" / n)
+             for n in os.listdir(tmp_path / "spill")
+             if not n.endswith(".s256")][0]
+
+    def detect():
+        assert cache.get(src) is None  # one cache miss, not bad bytes
+        assert not os.path.exists(spill)  # unlinked for rebuild
+
+    def rebuild():
+        cache.put(src, payload)
+        assert np.array_equal(cache.get(src), payload)
+
+    return spill, detect, rebuild
+
+
+def _case_solver(tmp_path):
+    from comapreduce_tpu.mapmaking.destriper import (
+        load_solver_checkpoint, save_solver_checkpoint)
+
+    p = str(tmp_path / "solver_band0.npz")
+    save_solver_checkpoint(p, np.ones(16, np.float32), 5, [0.1], "pc-a")
+
+    def detect():
+        assert load_solver_checkpoint(p, "pc-a") is None  # cold solve
+        assert not os.path.exists(p)
+
+    def rebuild():
+        save_solver_checkpoint(p, np.ones(16, np.float32), 5, [0.1],
+                               "pc-a")
+        assert load_solver_checkpoint(p, "pc-a")["n_done"] == 5
+
+    return p, detect, rebuild
+
+
+def _case_epoch(tmp_path):
+    from comapreduce_tpu.serving.epochs import (EpochStore, verify_epoch,
+                                                verify_epoch_product)
+
+    es = EpochStore(str(tmp_path / "epochs"))
+
+    def products(d):
+        with open(os.path.join(d, "map_band0.fits"), "wb") as f:
+            f.write(b"FITS-ish" * 64)
+        return {"maps": ["map_band0.fits"]}
+
+    n = es.publish(["a.hd5"], products)
+    ed = es.epoch_dir(n)
+    assert verify_epoch(ed) == (1, [])
+
+    def detect():
+        nok, problems = verify_epoch(ed)
+        assert [p[0] for p in problems] == ["map_band0.fits"]
+        assert verify_epoch_product(ed, "map_band0.fits") is False
+
+    def rebuild():
+        n2 = es.publish(["a.hd5", "b.hd5"], products)
+        assert verify_epoch(es.epoch_dir(n2)) == (1, [])
+
+    return os.path.join(ed, "map_band0.fits"), detect, rebuild
+
+
+def _case_tile(tmp_path):
+    from comapreduce_tpu.tiles.store import TileStore
+
+    st = TileStore(str(tmp_path / "tiles"))
+    blob = bytes(range(256)) * 2
+    digest, _ = st.put(blob)
+
+    def detect():
+        with pytest.raises(CorruptArtifactError):
+            st.get(digest)
+        assert not st.has(digest)  # unlinked: re-put repairs
+
+    def rebuild():
+        d2, renewed = st.put(blob)
+        assert d2 == digest and renewed and st.get(digest) == blob
+
+    return st.path(digest), detect, rebuild
+
+
+def _case_ledger_line(tmp_path):
+    p = str(tmp_path / "quarantine.jsonl")
+    led = QuarantineLedger(p)
+    led.record("/d/a.hd5", failure_class="transient",
+               disposition="quarantined", stage="ingest.read")
+    led.record("/d/b.hd5", failure_class="transient",
+               disposition="recovered", stage="ingest.read")
+
+    def corrupt():
+        with open(p, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        doc = json.loads(lines[0])
+        doc["disposition"] = "recovered"  # body edited, seal now stale
+        lines[0] = json.dumps(doc, separators=(",", ":"), default=str)
+        with open(p, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def detect():
+        led2 = QuarantineLedger(p)
+        assert led2.corrupt_lines == 1
+        assert len(led2.entries) == 1  # the intact line survives
+        # the rotted quarantine flip is NOT honoured
+        assert not led2.is_quarantined("/d/a.hd5")
+
+    def rebuild():
+        led3 = QuarantineLedger(p)
+        led3.record("/d/a.hd5", failure_class="transient",
+                    disposition="quarantined", stage="ingest.read")
+        led4 = QuarantineLedger(p)
+        assert led4.is_quarantined("/d/a.hd5")
+
+    return corrupt, detect, rebuild
+
+
+def _case_quality_line(tmp_path):
+    from comapreduce_tpu.telemetry.quality import (append_quality,
+                                                   read_quality)
+
+    p = str(tmp_path / "quality.rank0.jsonl")
+    append_quality(p, [{"file": "a.hd5", "feed": 1, "band": 0,
+                        "flagged": False, "t": "1"},
+                       {"file": "b.hd5", "feed": 1, "band": 0,
+                        "flagged": True, "t": "1"}])
+
+    def corrupt():
+        with open(p, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        doc = json.loads(lines[1])
+        doc["flagged"] = False  # rot flips a file out of the exclusion set
+        lines[1] = json.dumps(doc, separators=(",", ":"), default=str)
+        with open(p, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def detect():
+        recs = read_quality(p)
+        assert [r["file"] for r in recs] == ["a.hd5"]  # dropped, not trusted
+
+    def rebuild():
+        append_quality(p, [{"file": "b.hd5", "feed": 1, "band": 0,
+                            "flagged": True, "t": "2"}])
+        assert {r["file"] for r in read_quality(p)} == {"a.hd5", "b.hd5"}
+
+    return corrupt, detect, rebuild
+
+
+_MATRIX = {
+    "checkpoint": _case_checkpoint,
+    "spill": _case_spill,
+    "solver": _case_solver,
+    "epoch": _case_epoch,
+    "tile": _case_tile,
+    "ledger_line": _case_ledger_line,
+    "quality_line": _case_quality_line,
+}
+
+
+@pytest.mark.parametrize("klass", sorted(_MATRIX))
+def test_bit_flip_matrix_detect_triage_rebuild(tmp_path, klass):
+    """One flipped byte per artifact class: detected at the read
+    boundary, triaged per class, repaired by re-derivation."""
+    target, detect, rebuild = _MATRIX[klass](tmp_path)
+    if callable(target):
+        target()  # in-place line corruption (no single payload file)
+    else:
+        flip_byte(target, seed=17)
+    detect()
+    rebuild()
+
+
+# -- fsck ------------------------------------------------------------------
+
+def test_campaign_fsck_scan_detects_and_repairs(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools.campaign_fsck import repair, scan
+
+    run = str(tmp_path)
+    p = os.path.join(run, "Level2_x.hd5")
+    _commit(p, b"checkpoint-bytes" * 8, kind="checkpoint")
+    assert scan(run)["ok"]
+    flip_byte(p, seed=23)
+    rep = scan(run)
+    assert not rep["ok"] and rep["n_corrupt"] == 1
+    repair(run, rep)
+    rep2 = scan(run)
+    assert rep2["ok"] and not os.path.exists(p)  # unlinked for rebuild
+
+
+def test_campaign_fsck_orphan_sidecar_and_stump(tmp_path):
+    from tools.campaign_fsck import repair, scan
+
+    run = str(tmp_path)
+    p = os.path.join(run, "gone.bin")
+    with open(p, "wb") as f:
+        f.write(b"x")
+    write_sidecar(p, p, kind="blob")
+    os.unlink(p)  # payload vanished: sidecar is an orphan
+    with open(os.path.join(run, "half.bin.tmp42"), "wb") as f:
+        f.write(b"torn")
+    rep = scan(run)
+    assert any(q["problem"] == "orphan-sidecar" for q in rep["problems"])
+    assert rep["stumps"]
+    repair(run, rep)
+    rep2 = scan(run)
+    assert rep2["ok"] and not rep2["stumps"]

@@ -433,7 +433,8 @@ def _run_chain(outdir, files, precision=None):
 def _level2_datasets(outdir):
     import h5py
 
-    (name,) = [f for f in os.listdir(outdir) if f.startswith("Level2_")]
+    (name,) = [f for f in os.listdir(outdir)
+               if f.startswith("Level2_") and not f.endswith(".s256")]
     out = {}
     with h5py.File(os.path.join(str(outdir), name), "r") as h:
         def visit(path, node):
@@ -538,7 +539,8 @@ def test_bf16_stream_destriped_map_parity(precision_obs, tmp_path):
 
     _run_chain(tmp_path / "l2", [precision_obs])
     outdir = str(tmp_path / "l2")
-    (name,) = [f for f in os.listdir(outdir) if f.startswith("Level2_")]
+    (name,) = [f for f in os.listdir(outdir)
+               if f.startswith("Level2_") and not f.endswith(".s256")]
     l2 = [os.path.join(outdir, name)]
     wcs = WCS.from_field((170.0, 52.0), (2.0 / 60, 2.0 / 60), (48, 48))
     maps = {}

@@ -237,7 +237,10 @@ def test_corrupt_checkpoint_detected_and_requarantined(tmp_path):
     assert lvl2b is not None
     led = QuarantineLedger(os.path.join(outdir, "quarantine.jsonl"))
     mine = [e for e in led.entries if e.unit["file"] == l2path]
-    assert [e.disposition for e in mine] == ["quarantined", "recovered"]
+    # the integrity plane triages a checksum-failing checkpoint as the
+    # first-class ``corrupt`` disposition (docs/OPERATIONS.md §20) —
+    # same skip semantics as quarantined, lifted by the same recovery
+    assert [e.disposition for e in mine] == ["corrupt", "recovered"]
     assert mine[0].stage == "resume.checkpoint"
     # the rewritten checkpoint is live again (a destriper filelist
     # containing it must not skip it)

@@ -15,6 +15,7 @@ contract lives in ``run_tiles_drill`` (``check_resilience.py
 
 import json
 import os
+import time
 import urllib.error
 import urllib.request
 
@@ -210,8 +211,46 @@ def test_store_cleanup_and_sweep(tmp_path):
     with open(tmp, "wb") as f:
         f.write(b"half-written")
     assert st.cleanup_tmp() == 1 and not os.path.exists(tmp)
-    assert st.sweep_unreferenced({live}) == 1
+    # the default grace window spares just-written objects (a put whose
+    # manifest is not on disk yet must not be swept)
+    assert st.sweep_unreferenced({live}) == 0
+    assert st.has(live) and st.has(dead)
+    assert st.sweep_unreferenced({live}, grace_s=0.0) == 1
     assert st.has(live) and not st.has(dead)
+
+
+def test_sweep_refuses_while_publish_in_flight(tmp_path):
+    from comapreduce_tpu.tiles.store import TileStore
+
+    st = TileStore(str(tmp_path))
+    st.put(b"live")
+    dead, _ = st.put(b"dead")
+    marker = os.path.join(str(tmp_path), "tiles-epoch-000002.tmp4242")
+    with open(marker, "w") as f:
+        f.write("4242\n")
+    assert st.publish_in_flight()
+    # an in-flight tiler may reference objects no on-disk manifest does
+    # yet: GC must refuse outright, not just spare young objects
+    assert st.sweep_unreferenced(set(), grace_s=0.0) == 0
+    assert st.has(dead)
+    os.unlink(marker)
+    assert not st.publish_in_flight()
+    assert st.sweep_unreferenced(set(), grace_s=0.0) == 2
+
+
+def test_stale_publish_marker_ages_out(tmp_path):
+    from comapreduce_tpu.tiles.store import TileStore
+
+    st = TileStore(str(tmp_path))
+    dead, _ = st.put(b"dead")
+    marker = os.path.join(str(tmp_path), "tiles-epoch-000002.tmp4242")
+    with open(marker, "w") as f:
+        f.write("4242\n")
+    old = time.time() - 7200.0
+    os.utime(marker, (old, old))
+    # a SIGKILLed tiler's marker must not block GC forever
+    assert not st.publish_in_flight()
+    assert st.sweep_unreferenced(set(), grace_s=0.0) == 1
 
 
 # -- tiler: WCS epochs, deltas, crash old-or-new ---------------------------

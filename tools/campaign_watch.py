@@ -112,10 +112,14 @@ def cmd_check(args) -> int:
     rep = _fetch_report(args)
     ok = report_healthy(rep)
     stuck = bool((rep.get("supervisor") or {}).get("stuck"))
+    n_corrupt = (rep.get("n_corrupt", 0)
+                 + rep.get("n_corrupt_ledger_lines", 0))
     print(f"{'healthy' if ok else 'UNHEALTHY'}: "
           f"{rep['n_stale']} stale rank(s), "
           f"{rep['n_expired_leases']} expired lease(s)"
           + (", STUCK supervisor" if stuck else "")
+          + (f", {n_corrupt} CORRUPT artifact(s)/line(s) — run "
+             "tools/campaign_fsck.py" if n_corrupt else "")
           + f" ({rep['output_dir']})")
     return 0 if ok else 1
 

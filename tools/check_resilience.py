@@ -71,6 +71,18 @@ census is the full campaign, the tile manifest tracking ``current``,
 and the ``/metrics`` per-rank commit counters EXACTLY equal to each
 surviving scheduler's own count (docs/OPERATIONS.md §18).
 
+``--integrity-only`` runs criterion 10: the end-to-end integrity
+plane (docs/OPERATIONS.md §20). One byte is flipped in a committed
+artifact of every durable class — Level-2 checkpoint, BlockCache
+spill entry, solver snapshot, epoch FITS, tile object, quarantine
+ledger line — and the drill asserts ``tools/campaign_fsck.py``
+detects 100% of the damage, every read boundary triages its class
+correctly (corrupt disposition / cache miss / cold solve /
+``verify_epoch`` problem / ``CorruptArtifactError`` / dropped line),
+chaos ``bit_rot`` rot is always detectable and fires at most once per
+basename, and ``--repair`` plus re-derivation converges to a final
+map byte-identical to the clean run's.
+
 ``--control-only`` runs the closed-loop control-plane drill
 (``comapreduce_tpu/control/drill.py`` — a ``Supervisor`` + real
 ``RankManager`` children over a 12-file elastic campaign): the
@@ -128,6 +140,12 @@ def main(argv=None) -> int:
                       "generated synth:// campaign through elastic "
                       "ranks + map server + tile tier with a mid-run "
                       "rank kill/rejoin)")
+    only.add_argument("--integrity-only", action="store_true",
+                      help="run only criterion 10 (the integrity "
+                      "plane: one byte flipped per artifact class, "
+                      "100%% fsck detection, correct per-class "
+                      "triage, repair converges to a byte-identical "
+                      "map)")
     only.add_argument("--control-only", action="store_true",
                       help="run only the control-plane drill (the "
                       "supervisor rolls out 4 worker ranks, 2 are "
@@ -144,6 +162,7 @@ def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from comapreduce_tpu.resilience.drill import (run_drill,
                                                   run_elastic_drill,
+                                                  run_integrity_drill,
                                                   run_live_drill,
                                                   run_serving_drill,
                                                   run_tiles_drill)
@@ -160,6 +179,7 @@ def main(argv=None) -> int:
         drill = run_control_drill
     else:
         drill = (run_live_drill if args.live_only
+                 else run_integrity_drill if args.integrity_only
                  else run_tiles_drill if args.tiles_only
                  else run_serving_drill if args.serving_only
                  else run_elastic_drill if args.elastic_only

@@ -146,7 +146,9 @@ def render_text(rep: dict) -> str:
     else:
         lines.append("ledger: no events")
     for title, rows in (("stall warnings", rep["stalls"]),
-                        ("hangs / deferred shards", rep["hangs"])):
+                        ("hangs / deferred shards", rep["hangs"]),
+                        ("corrupt artifacts",
+                         rep.get("corruption", []))):
         if rows:
             lines.append(f"{title} (latest {len(rows)}):")
             for e in rows:
@@ -154,6 +156,10 @@ def render_text(rep: dict) -> str:
                              f"{e['stage']:<22} "
                              f"{os.path.basename(e['unit'] or '')} "
                              f"{e['message']}")
+    if rep.get("n_corrupt_ledger_lines"):
+        lines.append(f"{rep['n_corrupt_ledger_lines']} ledger line(s) "
+                     "dropped for failing their integrity seal — run "
+                     "tools/campaign_fsck.py (docs/OPERATIONS.md §20)")
     return "\n".join(lines)
 
 
