@@ -1733,6 +1733,13 @@ def bench_destriper():
     if not PROGRAMS.enabled:
         PROGRAMS.configure(out_root)
 
+    # the registry key carries the RESOLVED binning implementation
+    # (ISSUE 19): 'auto' compiles genuinely different programs on TPU
+    # (pallas) vs everywhere else (xla), and one shared key would let
+    # whichever ran last corrupt the HBM gate baseline
+    from comapreduce_tpu.mapmaking.pallas_binning import resolve_kernels
+    resolved_impl = resolve_kernels("auto")
+
     def run(pixv, npixv, name, call_kwargs=None, **partial_kwargs):
         """AOT-compile one planned solve (feeding the compiled
         executable's cost/memory analysis to the program registry —
@@ -1747,7 +1754,8 @@ def bench_destriper():
         compiled = fn.lower(tod_j, w_j, **kw).compile()
         PROGRAMS.record(f"destriper.{name}", compiled,
                         shape_bucket=shape_bucket(tod_j, w_j),
-                        precision_id="tod=f32|cgdot=f32")
+                        precision_id="tod=f32|cgdot=f32",
+                        kernels=resolved_impl)
         r = compiled(tod_j, w_j, **kw)
         float(jnp.sum(r.destriped_map))          # warm + device sync
         t0 = time.perf_counter()
@@ -1888,6 +1896,279 @@ def bench_destriper():
             json.dump(line, f, indent=1)
     write_evidence("destriper", lambda: None, extra=line["detail"],
                    host_only=True)
+    return 0
+
+
+def bench_destriper_sharded():
+    """Sharded-solver mode (ISSUE 19): the campaign solver path's
+    iteration ladder UNDER SHARDING, plus measured-noise banded
+    weighting — the two moves that stop the 1.65x iteration tax.
+
+    Measurements (``BENCH_r09.json``, the round-10 ROOFLINE artifact):
+
+    - **sharded preconditioner ladder**: iterations-to-tol for the
+      single-device multigrid reference, sharded twolevel, and the
+      native sharded MULTIGRID program (``with_mg=True`` — the rung
+      that used to fall back to twolevel with a warning) on the
+      weight-spread raster. Acceptance: sharded multigrid matches the
+      single-device iteration count (same operator, psum-assembled
+      coarse residual) and strictly beats sharded twolevel;
+    - **offsets parity**: sharded-vs-single multigrid solutions agree;
+    - **solver-trace cross-check**: the traced sharded rung's
+      per-iteration records match its reported count EXACTLY;
+    - **banded noise weighting**: on a 1/f fixture whose noise is drawn
+      from the same PSD the quality fit reports (``sigma^2
+      (f/fknee)^alpha``) with inverse-variance weights, map RMS error
+      and iterations for white vs banded (single device), plus
+      sharded-banded vs single-banded offsets parity (the no-halo
+      boundary-zeroing contract).
+
+    Needs >= 2 devices: when the host would expose one CPU device the
+    conftest idiom (``--xla_force_host_platform_device_count``) forces
+    a multi-device CPU mesh — set BEFORE jax imports, so this config
+    must run in a fresh process (the ``BENCH_CONFIG`` contract).
+    ``BENCH_SHARDS`` overrides the forced count (default 4).
+    ``BENCH_SMALL=1`` shrinks both fixtures (CI smoke).
+    """
+    n_want = max(int(os.environ.get("BENCH_SHARDS", "4")), 2)
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_want}").strip()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from comapreduce_tpu.mapmaking.destriper import (
+        build_coarse_preconditioner, build_multigrid_hierarchy,
+        destripe_planned)
+    from comapreduce_tpu.mapmaking.noise_weight import build_banded_weight
+    from comapreduce_tpu.mapmaking.pointing_plan import (
+        build_pointing_plan, build_sharded_plans)
+    from comapreduce_tpu.parallel.sharded import (
+        make_destripe_sharded_planned)
+    from comapreduce_tpu.telemetry import solver_trace
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        print("bench: destriper-sharded needs >= 2 devices; got "
+              f"{len(devices)} ({devices[0].platform}). Run in a fresh "
+              "process (the XLA device-count flag cannot apply after "
+              "jax import).", file=sys.stderr)
+        return 3
+    n_shards = len(devices)
+    mesh = Mesh(np.array(devices), ("time",))
+
+    small = os.environ.get("BENCH_SMALL", "") == "1"
+    T = 12_000 if small else 120_000
+    nx = 32 if small else 64
+    L, n_iter, threshold = 50, 2000, 1e-6
+    pix, tod, w, npix, _ = weight_spread_raster(T=T, nx=nx, L=L)
+
+    # every shard owns whole offsets: pad to the shard quantum with the
+    # zero-weight npix sentinel (the CLI's _pad_pixels rule), and run
+    # the single-device reference on the SAME padded vectors so the
+    # iteration counts compare the sharding alone
+    n_pad = (-pix.size) % (n_shards * L)
+    if n_pad:
+        pix = np.concatenate([pix, np.full(n_pad, npix, pix.dtype)])
+        tod = np.concatenate([tod, np.zeros(n_pad, tod.dtype)])
+        w = np.concatenate([w, np.zeros(n_pad, w.dtype)])
+    tod_j, w_j = jnp.asarray(tod), jnp.asarray(w)
+
+    out_root = os.environ.get("BENCH_EVIDENCE_DIR", "")
+    if not out_root:
+        if os.environ.get("BENCH_EVIDENCE", "1") == "0":
+            import tempfile
+
+            out_root = tempfile.mkdtemp(prefix="bench_sharded_")
+        else:
+            out_root = os.path.dirname(os.path.abspath(__file__))
+
+    def stats(r, wall):
+        resid = float(np.max(np.asarray(r.residual)))
+        iters = int(r.n_iter)
+        return {"iters_to_tol": iters if resid <= threshold else None,
+                "residual": round(resid, 9),
+                "diverged": bool(np.any(np.asarray(r.diverged))),
+                "wall_s": round(wall, 4),
+                "ms_per_iter": round(1e3 * wall / max(iters, 1), 3)}
+
+    def timed(fn, *args, **kw):
+        r = fn(*args, **kw)
+        int(r.n_iter)                          # warm + device sync
+        t0 = time.perf_counter()
+        r = fn(*args, **kw)
+        int(r.n_iter)
+        return r, time.perf_counter() - t0
+
+    # ---- sharded preconditioner ladder ----------------------------------
+    hier = build_multigrid_hierarchy(pix, w, npix, L, block=8, levels=2)
+    plan = build_pointing_plan(pix, npix, L)
+    single = jax.jit(functools.partial(destripe_planned, plan=plan,
+                                       n_iter=n_iter,
+                                       threshold=threshold))
+    r_single, wall_single = timed(single, tod_j, w_j, mg=hier)
+
+    plans = build_sharded_plans(pix, npix, L, n_shards)
+    run_mg = make_destripe_sharded_planned(
+        mesh, plans, n_iter=n_iter, threshold=threshold, with_mg=True,
+        trace_iters=n_iter)
+    r_mg, wall_mg = timed(run_mg, tod_j, w_j, mg=hier)
+    run_tw = make_destripe_sharded_planned(
+        mesh, plans, n_iter=n_iter, threshold=threshold,
+        with_coarse=True)
+    # the default block (8) can lose SPD in the f32 coarse inverse on
+    # some raster geometries (the same documented failure the
+    # single-device ladder escalates through) — escalate identically
+    # and record every diverged attempt rather than hiding it
+    diverged_blocks = []
+    for blk in (8, 16, 32):
+        coarse = build_coarse_preconditioner(pix, w, npix, L, block=blk)
+        r_tw, wall_tw = timed(run_tw, tod_j, w_j, coarse=coarse)
+        if not np.any(np.asarray(r_tw.diverged)):
+            break
+        diverged_blocks.append(blk)
+
+    ladder = {"single_multigrid": stats(r_single, wall_single),
+              "sharded_multigrid": stats(r_mg, wall_mg),
+              "sharded_twolevel": {**stats(r_tw, wall_tw),
+                                   "coarse_block": blk,
+                                   "diverged_blocks": diverged_blocks}}
+    parity = {
+        "max_offset_diff": round(float(np.abs(
+            np.asarray(r_single.offsets)
+            - np.asarray(r_mg.offsets)).max()), 9),
+        "iters_single": int(r_single.n_iter),
+        "iters_sharded": int(r_mg.n_iter),
+    }
+
+    # ---- solver trace cross-check on the traced sharded rung ------------
+    trace_path = os.path.join(out_root, "solver.rank0.jsonl")
+    try:
+        os.unlink(trace_path)
+    except OSError:
+        pass
+    solver_trace.record_solve(
+        r_mg, band="multigrid-sharded", path=trace_path,
+        precond_id=f"multigrid|L{L}", precision_id="tod=f32|cgdot=f32",
+        threshold=threshold)
+    trace_recs = [rec for rec in solver_trace.read_solver(trace_path)
+                  if rec.get("kind") == "iteration"]
+    trace_info = {"path": trace_path,
+                  "iteration_records": len(trace_recs),
+                  "reported_iters": int(r_mg.n_iter),
+                  "match": len(trace_recs) == int(r_mg.n_iter)}
+
+    # ---- banded noise weighting on a matched 1/f fixture ----------------
+    # noise drawn from the SAME per-sample PSD the quality fit reports,
+    # inverse-variance weights — the regime the prior's normalisation
+    # balances against (w = 1/sigma^2, so b0/A_diag stays O(0.1))
+    rng = np.random.default_rng(7)
+    Tb = 8_000 if small else 40_000
+    Lb, nxb = 10, 16
+    npix_b = nxb * nxb
+    pix_b = ((np.arange(Tb) * 7) % npix_b).astype(np.int64)
+    sky = rng.normal(0, 1.0, npix_b).astype(np.float32)
+    sigma, fknee, alpha, fs = 0.05, 1.0, -1.5, 50.0
+    freqs = np.fft.rfftfreq(Tb, d=1.0 / fs)
+    psd = np.zeros_like(freqs)
+    psd[1:] = sigma ** 2 * (freqs[1:] / fknee) ** alpha
+    amp = np.sqrt(psd * Tb * fs / 2.0) / np.sqrt(fs)
+    ph = rng.normal(size=freqs.size) + 1j * rng.normal(size=freqs.size)
+    corr = np.fft.irfft(amp * ph, n=Tb).astype(np.float32)
+    tod_b = (sky[pix_b] + corr
+             + sigma * rng.normal(size=Tb).astype(np.float32)
+             ).astype(np.float32)
+    w_b = np.full(Tb, 1.0 / sigma ** 2, np.float32)
+    n_off_b = Tb // Lb
+
+    groups = [{"file": "synthetic.h5", "feed": 0, "sample_rate": fs,
+               "n_samples": Tb}]
+    quality = [{"file": "synthetic.h5", "feed": 0, "band": 0,
+                "white_sigma": sigma, "fknee_hz": fknee, "alpha": alpha,
+                "flagged": False}]
+    banded1, report = build_banded_weight(groups, quality, n_off_b, Lb,
+                                          n_shards=1)
+    plan_b = build_pointing_plan(pix_b, npix_b, Lb)
+    solve_b = jax.jit(functools.partial(destripe_planned, plan=plan_b,
+                                        n_iter=n_iter, threshold=1e-8))
+    r_white = solve_b(jnp.asarray(tod_b), jnp.asarray(w_b))
+    r_band = solve_b(jnp.asarray(tod_b), jnp.asarray(w_b),
+                     banded=(jnp.asarray(banded1[0]),
+                             jnp.asarray(banded1[1])))
+    hit = np.asarray(r_white.hit_map) > 0
+
+    def map_err(r):
+        d = np.asarray(r.destriped_map)[hit] - sky[hit]
+        d -= d.mean()
+        return round(float(np.sqrt((d * d).mean())), 6)
+
+    # sharded banded parity: the shard-aware prior through the sharded
+    # program vs the same prior on one device (boundary couplings
+    # zeroed identically in both)
+    banded_s, _ = build_banded_weight(groups, quality, n_off_b, Lb,
+                                      n_shards=n_shards)
+    plans_b = build_sharded_plans(pix_b, npix_b, Lb, n_shards)
+    run_banded = make_destripe_sharded_planned(
+        mesh, plans_b, n_iter=n_iter, threshold=1e-8, with_banded=True)
+    r_band_sh = run_banded(jnp.asarray(tod_b), jnp.asarray(w_b),
+                           banded=banded_s)
+    r_band_1 = solve_b(jnp.asarray(tod_b), jnp.asarray(w_b),
+                       banded=(jnp.asarray(banded_s[0]),
+                               jnp.asarray(banded_s[1])))
+    banded_detail = {
+        "fixture": {"T": Tb, "offset_length": Lb, "white_sigma": sigma,
+                    "fknee_hz": fknee, "alpha": alpha,
+                    "sample_rate": fs, "threshold": 1e-8},
+        "white": {"iters": int(r_white.n_iter),
+                  "map_rms_err": map_err(r_white)},
+        "banded": {"iters": int(r_band.n_iter),
+                   "map_rms_err": map_err(r_band),
+                   "diverged": bool(np.any(np.asarray(r_band.diverged)))},
+        "report": report,
+        "sharded_parity_max_diff": round(float(np.abs(
+            np.asarray(r_band_sh.offsets)
+            - np.asarray(r_band_1.offsets)).max()), 9),
+    }
+
+    line = {
+        "metric": "destriper_sharded_mg_iters_to_tol",
+        "value": ladder["sharded_multigrid"]["iters_to_tol"],
+        "unit": "iterations",
+        # the acceptance ratio: sharded twolevel vs sharded multigrid
+        # iterations (the 1.65x the fallback used to cost; None when
+        # either burned its budget unconverged — never pretend)
+        "vs_baseline": (round(ladder["sharded_twolevel"]["iters_to_tol"]
+                              / ladder["sharded_multigrid"]
+                                      ["iters_to_tol"], 3)
+                        if ladder["sharded_multigrid"]["iters_to_tol"]
+                        and ladder["sharded_twolevel"]["iters_to_tol"]
+                        else None),
+        "detail": {
+            "config": "destriper-sharded",
+            "n_shards": n_shards,
+            "fixture": {"T": int(pix.size), "nx": nx,
+                        "offset_length": L,
+                        "n_offsets": pix.size // L,
+                        "threshold": threshold, "pad": int(n_pad)},
+            "ladder": ladder,
+            "parity": parity,
+            "solver_trace": trace_info,
+            "banded": banded_detail,
+            "device": str(devices[0].platform),
+        },
+    }
+    print(json.dumps(line))
+    if os.environ.get("BENCH_EVIDENCE", "1") != "0":
+        ev_root = (os.environ.get("BENCH_EVIDENCE_DIR", "")
+                   or os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(ev_root, "BENCH_r09.json"), "w") as f:
+            json.dump(line, f, indent=1)
+    write_evidence("destriper-sharded", lambda: None,
+                   extra=line["detail"], host_only=True)
     return 0
 
 
@@ -2294,6 +2575,7 @@ def bench_synthetic():
 _CONFIGS = {"1": bench_config1, "2": bench_config2, "4": bench_config4,
             "ingest": bench_ingest, "resilience": bench_resilience,
             "campaign": bench_campaign, "destriper": bench_destriper,
+            "destriper-sharded": bench_destriper_sharded,
             "serving": bench_serving, "kernels": bench_kernels,
             "precision": bench_precision, "synthetic": bench_synthetic}
 

@@ -127,14 +127,20 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                             threshold: float, n_bands: int = 0,
                             n_groups: int = 0,
                             with_coarse: bool = False,
+                            with_mg: bool = False, mg_smooth: int = 1,
+                            mg_omega: float = 2.0 / 3.0,
+                            with_banded: bool = False,
                             precond: str = "jacobi",
                             pair_batch: int | None = None,
                             kernels: str = "auto",
-                            cg_dot: str = "f32"):
+                            cg_dot: str = "f32",
+                            trace_iters: int = 0):
     """Memoized sharded solver (plans + ONE compiled shard_map program
     per pointing — bands share both). ``n_bands > 0`` builds the
     multi-RHS program (all bands in one CG); ``n_groups > 0`` the joint
-    ground program; ``with_coarse`` the two-level-preconditioned one."""
+    ground program; ``with_coarse`` the two-level-preconditioned one;
+    ``with_mg`` the multigrid V-cycle one (hierarchy passed at call
+    time); ``with_banded`` the measured-noise banded-weighted one."""
     from comapreduce_tpu.mapmaking.pointing_plan import build_sharded_plans
     from comapreduce_tpu.parallel.sharded import (
         make_destripe_sharded_planned)
@@ -149,17 +155,25 @@ def _sharded_planned_solver(mesh, pixels: np.ndarray, npix: int,
                                             n_bands=n_bands,
                                             n_groups=n_groups,
                                             with_coarse=with_coarse,
+                                            with_mg=with_mg,
+                                            mg_smooth=mg_smooth,
+                                            mg_omega=mg_omega,
+                                            with_banded=with_banded,
                                             precond=precond,
                                             kernels=kernels,
-                                            cg_dot=cg_dot)
+                                            cg_dot=cg_dot,
+                                            trace_iters=trace_iters)
         return run, np.asarray(plans[0].uniq_global)
 
-    return _memoized(f"sharded{n_bands}-g{n_groups}-c{int(with_coarse)}",
+    return _memoized(f"sharded{n_bands}-g{n_groups}-c{int(with_coarse)}"
+                     f"-m{int(with_mg)}-b{int(with_banded)}",
                      pixels,
                      (n_shards, int(npix), int(offset_length), int(n_iter),
                       float(threshold), int(n_groups),
-                      bool(with_coarse), str(precond), pair_batch,
-                      str(kernels), str(cg_dot)), build)
+                      bool(with_coarse), bool(with_mg), int(mg_smooth),
+                      float(mg_omega), bool(with_banded), str(precond),
+                      pair_batch, str(kernels), str(cg_dot),
+                      int(trace_iters)), build)
 
 
 def _shard_quantum(mesh, offset_length: int) -> int:
@@ -217,7 +231,7 @@ def _attach_dict(data, result):
 
 def parse_destriper_section(destr: dict, coarse_default: int = 0):
     """``[Destriper]`` knobs ->
-    ``(precond, coarse_block, pair_batch, mg, kernels)``
+    ``(precond, coarse_block, pair_batch, mg, kernels, noise_weight)``
     (docs/OPERATIONS.md §3):
 
     - ``preconditioner = none | jacobi | twolevel | multigrid`` — CG
@@ -313,7 +327,24 @@ def parse_destriper_section(destr: dict, coarse_default: int = 0):
         raise ValueError(f"[Destriper] kernels must be "
                          f"{'|'.join(CONFIG_KERNELS)}, got "
                          f"{destr.get('kernels')!r}")
-    return precond, coarse_block, pair_batch, mg, kernels
+    nw_raw = str(destr.get("noise_weight", "white")).strip().lower()
+    if nw_raw not in ("", "white", "banded"):
+        raise ValueError(f"[Destriper] noise_weight must be white|banded, "
+                         f"got {destr.get('noise_weight')!r}")
+    if "noise_bandwidth" in destr and nw_raw != "banded":
+        # same silent-drop rule as coarse_block/mg_* above
+        raise ValueError(
+            "[Destriper] noise_bandwidth only applies under noise_weight"
+            f"=banded (noise_weight is {nw_raw or 'absent'!r}); remove "
+            "the knob or select banded")
+    noise_weight = None
+    if nw_raw == "banded":
+        noise_weight = {"bandwidth": int(destr.get("noise_bandwidth", 4))}
+        if noise_weight["bandwidth"] < 1:
+            raise ValueError(
+                f"[Destriper] noise_bandwidth must be >= 1, got "
+                f"{destr.get('noise_bandwidth')!r}")
+    return precond, coarse_block, pair_batch, mg, kernels, noise_weight
 
 
 def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
@@ -323,7 +354,8 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                   coarse_block=0, prefetch=0, cache=None,
                   resilience=None, precond="jacobi", pair_batch=None,
                   mg=None, compact="auto", kernels="auto",
-                  tod_dtype="f32", cg_dot="f32"):
+                  tod_dtype="f32", cg_dot="f32", noise_weight=None,
+                  quality=None):
     """Read one band and destripe it. Returns (DestriperData, result).
 
     The scatter-free planned destriper (``destripe_planned``, >10x per CG
@@ -354,7 +386,45 @@ def make_band_map(filenames, band, wcs=None, nside=None, galactic=False,
                                              None),
                             unit=f"band{band}", precond=precond,
                             pair_batch=pair_batch, mg=mg, kernels=kernels,
-                            cg_dot=cg_dot)
+                            cg_dot=cg_dot, noise_weight=noise_weight,
+                            quality=quality, band=band)
+
+
+def _build_banded(data, noise_weight, quality, band, offset_length,
+                  n_offsets, n_shards, unit=""):
+    """Assemble the measured-noise banded offset prior for one band's
+    solve (``[Destriper] noise_weight = banded``) and ledger every white
+    fallback — the operator must be able to answer "which files kept
+    white weighting, and why" from the log alone. Returns the
+    ``(c0, cs)`` pair, or None when the knob is off or EVERY group fell
+    back (callers then omit the kwarg — byte-identical white program)."""
+    if not noise_weight:
+        return None
+    from comapreduce_tpu.mapmaking.noise_weight import build_banded_weight
+
+    banded, report = build_banded_weight(
+        getattr(data, "groups", None) or [], quality or [], n_offsets,
+        offset_length, band=band,
+        bandwidth=int(noise_weight.get("bandwidth", 4)),
+        n_shards=n_shards)
+    if report["fallbacks"]:
+        detail = ", ".join(f"{f['file']}/feed{f['feed']}:{f['reason']}"
+                           for f in report["fallbacks"][:8])
+        more = len(report["fallbacks"]) - 8
+        logger.warning(
+            "noise_weight=banded %s: %d group(s) kept white weighting "
+            "(%s%s)", unit or "<band>", report["white"], detail,
+            f", +{more} more" if more > 0 else "")
+    if banded is None:
+        logger.warning(
+            "noise_weight=banded %s: every group fell back to white — "
+            "running the white-weight program (exact parity)",
+            unit or "<band>")
+    else:
+        logger.info("noise_weight=banded %s: %d/%d group(s) weighted "
+                    "from measured fits", unit or "<band>",
+                    report["banded"], report["banded"] + report["white"])
+    return banded
 
 
 def _watched_cg(solve, watchdog, unit: str):
@@ -379,7 +449,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                use_ground=False, sharded=False, coarse_block=0,
                watchdog=None, unit="", precond="jacobi",
                pair_batch=None, mg=None, x0=None, kernels="auto",
-               cg_dot="f32", trace_iters=None, trace_base=0):
+               cg_dot="f32", noise_weight=None, quality=None, band=0,
+               trace_iters=None, trace_base=0):
     """Destripe one already-read band (the solve half of
     :func:`make_band_map` — callers holding ``DestriperData`` reuse it
     without re-reading the filelist).
@@ -401,12 +472,22 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     knobs (docs/OPERATIONS.md §3): CG preconditioner selection
     ('jacobi'|'none'; the two-level upgrade rides ``coarse_block``, the
     multigrid V-cycle the ``mg`` config dict) and the merged one-hot
-    binning batch (None = HBM-planner auto). Multigrid runs on the
-    non-sharded planned paths (plain AND offset-aligned ground); the
-    sharded programs fall back to the two-level preconditioner with a
-    warning (the V-cycle's per-level scatter lattice is not yet
-    shard_map-threaded), and the scatter fallbacks keep Jacobi like
-    they do for ``coarse_block``.
+    binning batch (None = HBM-planner auto). Multigrid runs on BOTH
+    planned offsets-only paths — non-sharded AND sharded (the hierarchy
+    is built host-side from the padded global pointing/weights and the
+    V-cycle's level-0 restriction is psum-assembled under shard_map) —
+    plus the non-sharded offset-aligned ground solve; the scatter
+    fallbacks and the sharded ground program keep Jacobi like they do
+    for ``coarse_block``.
+
+    ``noise_weight``/``quality``/``band`` enable the measured-noise
+    banded offset weighting (``[Destriper] noise_weight = banded``,
+    docs/OPERATIONS.md §3): the quality ledger's per-(file, feed, band)
+    ``white_sigma/fknee_hz/alpha`` fits become a banded inverse-
+    covariance prior on the offset amplitudes, applied inside the CG
+    matvec on both planned paths. Groups without a usable fit keep
+    white weighting, ledgered per file; the joint ground solve always
+    keeps white (the prior composes with offsets-only solves).
 
     ``x0`` warm-starts the CG from a prior iterate (the solver-
     checkpoint resume, :func:`solve_band_checkpointed`) — non-sharded
@@ -427,12 +508,17 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
 
     _check_precond(precond, coarse=coarse_block or None, mg=mg)
     if trace_iters is None:
-        # the sharded programs and scatter fallbacks are untraced (their
-        # CG loops are memoized per-geometry and shard_map-threaded);
-        # everything else rides the telemetry switch
-        trace_iters = (int(n_iter)
-                       if not sharded and solver_trace.trace_enabled()
-                       else 0)
+        # the planned paths — non-sharded AND sharded (the shard_map
+        # programs thread trace_iters and return replicated histories) —
+        # ride the telemetry switch; the scatter fallbacks stay untraced
+        trace_iters = int(n_iter) if solver_trace.trace_enabled() else 0
+    if noise_weight and use_ground:
+        # the banded prior composes with the offsets-only normal
+        # operator; the joint ground solve keeps the white-weight system
+        # (destripe_planned raises on the combination) — loud, ledgered
+        logger.warning("noise_weight=banded: the joint ground solve "
+                       "keeps white weighting")
+        noise_weight = None
     if x0 is not None and (sharded or use_ground):
         # destripe_planned's x0 is offsets-only by construction (the
         # joint ground solve raises on it) and the sharded programs
@@ -450,18 +536,25 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                coarse_block=coarse_block, unit=unit,
                                precond=precond, pair_batch=pair_batch,
                                mg=mg, x0=x0, kernels=kernels,
-                               cg_dot=cg_dot, trace_iters=trace_iters,
+                               cg_dot=cg_dot, noise_weight=noise_weight,
+                               quality=quality, band=band,
+                               trace_iters=trace_iters,
                                trace_base=trace_base),
             watchdog, unit)
-    if sharded and mg is not None:
-        # the sharded programs keep the two-level preconditioner: the
-        # V-cycle's intermediate-level operators are whole-offset-domain
-        # lattices that would need their own psum threading. Loud, not
-        # silent — and the fallback is the next-strongest knob.
-        logger.warning("preconditioner=multigrid: the sharded programs "
-                       "fall back to twolevel (coarse block %d)",
-                       mg["block"])
-        coarse_block, mg = mg["block"], None
+    # the applied-preconditioner label + solve configuration the trace
+    # records carry (solver_report groups convergence by it) — shared by
+    # the sharded and non-sharded planned paths below
+    precision_id = f"tod={getattr(data.tod, 'dtype', 'f32')}" \
+                   f"|cgdot={cg_dot}"
+
+    def _record_trace(res, label):
+        if getattr(res, "trace", None) is None:
+            return
+        solver_trace.record_solve(
+            res, band=unit or "band", base=trace_base,
+            precond_id=f"{label}|L{offset_length}",
+            precision_id=precision_id, threshold=threshold)
+
     if sharded:
         import jax
 
@@ -490,11 +583,13 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             except ValueError:
                 gid_off = None   # misaligned: scatter fallback below
         if use_ground and gid_off is None:
-            if coarse_block:
-                logger.warning("coarse_precond active (default 8 for field "
-                               "runs) but the ground groups are not "
+            if coarse_block or mg:
+                logger.warning("%s active but the ground groups are not "
                                "offset-aligned; sharded scatter "
-                               "fallback runs Jacobi only")
+                               "fallback runs Jacobi only",
+                               "multigrid" if mg else
+                               "coarse_precond (default 8 for field "
+                               "runs)")
             result = destripe_sharded(
                 mesh, data.tod, data.pixels, data.weights, data.npix,
                 offset_length=offset_length, n_iter=n_iter,
@@ -516,33 +611,75 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                 weights = jnp.concatenate(
                     [jnp.asarray(weights), jnp.zeros(n_pad, jnp.float32)])
             use_coarse = bool(coarse_block) and gid_off is None
+            use_mg = mg is not None and gid_off is None
+            # the coarse/multigrid systems and the banded prior are all
+            # built host-side from the GLOBAL padded pointing/weights
+            # (padding samples carry zero weight, so they contribute
+            # nothing — same idiom for every operator-shaping input)
+            w_host = None
+            if use_coarse or use_mg:
+                w_host = np.zeros(pix_host.size, np.float32)
+                w_host[:data.tod.size] = np.asarray(data.weights)
+            mg_hier = None
+            if use_mg:
+                from comapreduce_tpu.mapmaking.destriper import (
+                    MultigridUnavailable, build_multigrid_hierarchy)
+
+                try:
+                    mg_hier = build_multigrid_hierarchy(
+                        pix_host, w_host, data.npix, offset_length,
+                        block=mg["block"], levels=mg["levels"])
+                except MultigridUnavailable as exc:
+                    # same degenerate-geometry fallback as the
+                    # non-sharded branch below
+                    logger.warning("multigrid unavailable for this "
+                                   "geometry (%s); running Jacobi", exc)
+                    use_mg = False
+            banded = None
+            if gid_off is None:
+                banded = _build_banded(
+                    data, noise_weight, quality, band, offset_length,
+                    pix_host.size // offset_length,
+                    len(mesh.devices.ravel()), unit=unit)
             run, uniq = _sharded_planned_solver(
                 mesh, pix_host, data.npix, offset_length, n_iter,
                 threshold,
                 n_groups=data.n_groups if gid_off is not None else 0,
-                with_coarse=use_coarse, precond=precond,
-                pair_batch=pair_batch, kernels=kernels, cg_dot=cg_dot)
+                with_coarse=use_coarse, with_mg=use_mg,
+                mg_smooth=mg["smooth"] if use_mg else 1,
+                with_banded=banded is not None, precond=precond,
+                pair_batch=pair_batch, kernels=kernels, cg_dot=cg_dot,
+                trace_iters=trace_iters)
             if gid_off is not None:
-                if coarse_block:
-                    logger.warning("coarse_precond: the sharded ground "
-                                   "program keeps Jacobi")
+                if coarse_block or mg:
+                    logger.warning("%s: the sharded ground program "
+                                   "keeps Jacobi",
+                                   "multigrid" if mg else
+                                   "coarse_precond")
                 az = np.asarray(data.az, np.float32)
                 if n_pad:
                     az = np.concatenate([az, np.zeros(n_pad, np.float32)])
                 result = run(tod, weights, ground_off=gid_off, az=az)
-            elif use_coarse:
-                from comapreduce_tpu.mapmaking.destriper import (
-                    build_coarse_preconditioner)
-
-                w_host = np.zeros(pix_host.size, np.float32)
-                w_host[:data.tod.size] = np.asarray(data.weights)
-                result = run(tod, weights,
-                             coarse=build_coarse_preconditioner(
-                                 pix_host, w_host, data.npix,
-                                 offset_length,
-                                 block=int(coarse_block)))
+                _record_trace(result, f"{precond}-sharded")
             else:
-                result = run(tod, weights)
+                kw_run = {}
+                if use_coarse:
+                    from comapreduce_tpu.mapmaking.destriper import (
+                        build_coarse_preconditioner)
+
+                    kw_run["coarse"] = build_coarse_preconditioner(
+                        pix_host, w_host, data.npix, offset_length,
+                        block=int(coarse_block))
+                elif use_mg:
+                    kw_run["mg"] = mg_hier
+                if banded is not None:
+                    kw_run["banded"] = banded
+                result = run(tod, weights, **kw_run)
+                label = ("multigrid" if use_mg else
+                         "twolevel" if use_coarse else precond)
+                if banded is not None:
+                    label += "|nw=banded"
+                _record_trace(result, f"{label}-sharded")
             result = result._replace(
                 destriped_map=_expand_compact(uniq, data.npix,
                                               result.destriped_map),
@@ -611,22 +748,23 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                                "geometry (%s); running Jacobi", exc)
                 mg = None
         mg_smooth = mg["smooth"] if mg is not None else 1
-        # the applied-preconditioner label + solve configuration the
-        # trace records carry (solver_report groups convergence by it)
+        banded = None
+        if not use_ground:
+            banded = _build_banded(data, noise_weight, quality, band,
+                                   offset_length, n // offset_length, 1,
+                                   unit=unit)
+            if banded is not None:
+                kwargs["banded"] = (jnp.asarray(banded[0]),
+                                    jnp.asarray(banded[1]))
+        # the banded prior is part of the linear SYSTEM (A + B), not
+        # the preconditioner: every re-solve below must keep it
+        sys_kw = ({"banded": kwargs["banded"]} if "banded" in kwargs
+                  else {})
         precond_used = ("multigrid" if kwargs.get("mg") is not None
                         else "twolevel" if kwargs.get("coarse") is not None
                         else precond)
-        precision_id = f"tod={getattr(data.tod, 'dtype', 'f32')}" \
-                       f"|cgdot={cg_dot}"
-
-        def _record_trace(res, label):
-            if getattr(res, "trace", None) is None:
-                return
-            solver_trace.record_solve(
-                res, band=unit or "band", base=trace_base,
-                precond_id=f"{label}|L{offset_length}",
-                precision_id=precision_id, threshold=threshold)
-
+        if banded is not None:
+            precond_used += "|nw=banded"
         if use_ground:
             fn = _planned_solver(np.asarray(data.pixels[:n]), data.npix,
                                  offset_length, n_iter, threshold,
@@ -663,7 +801,8 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
             # that tripped the monitor is exactly what the operator
             # opens solver_report for
             _record_trace(result, precond_used)
-            precond_used = "jacobi-fallback"
+            precond_used = ("jacobi-fallback" if banded is None
+                            else "jacobi-fallback|nw=banded")
             if use_ground:
                 logger.warning(
                     "CG diverged under the %s preconditioner "
@@ -681,7 +820,7 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
                     "best iterate", which, np.asarray(result.diverged))
                 result = fn(jnp.asarray(data.tod[:n]),
                             jnp.asarray(data.weights[:n]),
-                            x0=result.offsets)
+                            x0=result.offsets, **sys_kw)
         _record_trace(result, precond_used)
     if sharded and bool(np.any(np.asarray(result.diverged))):
         # the sharded programs are memoized per-(geometry, coarse) pair;
@@ -755,6 +894,11 @@ def solve_band_checkpointed(data, checkpoint_path, checkpoint_every,
         # Appended only when NON-default so snapshots written before
         # this knob existed keep loading byte-identically.
         precond_id = f"{precond_id}|cgdot={kw['cg_dot']}"
+    if kw.get("noise_weight"):
+        # the banded prior changes the linear system itself — a white
+        # snapshot must never resume into a banded solve (or vice
+        # versa). Non-default-only append, same rule as cg_dot.
+        precond_id = f"{precond_id}|nw=banded"
     if precond_tag:
         precond_id = f"{precond_id}|{precond_tag}"
     snap = load_solver_checkpoint(checkpoint_path, precond_id=precond_id)
@@ -817,7 +961,8 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                          prefetch=0, cache=None, resilience=None,
                          watchdog=None, precond="jacobi",
                          pair_batch=None, mg=None, compact="auto",
-                         kernels="auto", tod_dtype="f32", cg_dot="f32"):
+                         kernels="auto", tod_dtype="f32", cg_dot="f32",
+                         noise_weight=None, quality=None):
     """ALL bands in one multi-RHS planned solve.
 
     The per-band loop's pixel stream comes from pointing alone, so when
@@ -863,13 +1008,6 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
         import jax
         from jax.sharding import Mesh
 
-        if mg is not None:
-            # same fallback as solve_band's sharded branch: the V-cycle
-            # is not shard_map-threaded yet — loud two-level downgrade
-            logger.warning("preconditioner=multigrid: the sharded joint "
-                           "program falls back to twolevel (coarse "
-                           "block %d)", mg["block"])
-            coarse_block, mg = mg["block"], None
         mesh = Mesh(np.array(jax.local_devices()), ("time",))
         N = datas[0].tod.size
         n_pad = (-N) % _shard_quantum(mesh, offset_length)
@@ -881,10 +1019,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
         for i, d in enumerate(datas):
             tod[i, :N] = d.tod
             wgt[i, :N] = d.weights
-        run, uniq = _sharded_planned_solver(
-            mesh, pix_host, npix, offset_length, n_iter, threshold,
-            n_bands=nb, with_coarse=bool(coarse_block), precond=precond,
-            pair_batch=pair_batch, kernels=kernels, cg_dot=cg_dot)
+        kw_run = {}
         if coarse_block:
             from comapreduce_tpu.mapmaking.destriper import (
                 build_coarse_preconditioner, coarse_pattern)
@@ -896,15 +1031,52 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
                                                block=int(coarse_block),
                                                pattern=pat)
                    for i in range(nb)]
-            res = _watched_cg(
-                lambda: run(jnp.asarray(tod), jnp.asarray(wgt),
-                            coarse=(pre[0][0],
-                                    np.stack([p[1] for p in pre]))),
-                watchdog, "joint(sharded)")
-        else:
-            res = _watched_cg(
-                lambda: run(jnp.asarray(tod), jnp.asarray(wgt)),
-                watchdog, "joint(sharded)")
+            kw_run["coarse"] = (pre[0][0],
+                                np.stack([p[1] for p in pre]))
+        elif mg is not None:
+            from comapreduce_tpu.mapmaking.destriper import (
+                MultigridUnavailable, build_multigrid_hierarchy,
+                multigrid_patterns, stack_multigrid)
+
+            # same build as the non-sharded joint branch below, run on
+            # the PADDED global pointing/weights (the sharded-operator
+            # idiom: padding carries zero weight everywhere)
+            try:
+                pats = multigrid_patterns(pix_host, npix, offset_length,
+                                          block=mg["block"],
+                                          levels=mg["levels"])
+                kw_run["mg"] = stack_multigrid(
+                    [build_multigrid_hierarchy(pix_host, wgt[i], npix,
+                                               offset_length,
+                                               patterns=pats)
+                     for i in range(nb)])
+            except MultigridUnavailable as exc:
+                logger.warning("multigrid unavailable for this "
+                               "geometry (%s); running Jacobi", exc)
+                mg = None
+        if noise_weight:
+            from comapreduce_tpu.mapmaking.noise_weight import (
+                stack_banded)
+
+            banded = stack_banded(
+                [_build_banded(datas[i], noise_weight, quality, b,
+                               offset_length,
+                               pix_host.size // offset_length,
+                               len(mesh.devices.ravel()),
+                               unit=f"band{b}(joint)")
+                 for i, b in enumerate(bands)])
+            if banded is not None:
+                kw_run["banded"] = banded
+        run, uniq = _sharded_planned_solver(
+            mesh, pix_host, npix, offset_length, n_iter, threshold,
+            n_bands=nb, with_coarse=bool(coarse_block),
+            with_mg="mg" in kw_run,
+            mg_smooth=mg["smooth"] if mg is not None else 1,
+            with_banded="banded" in kw_run, precond=precond,
+            pair_batch=pair_batch, kernels=kernels, cg_dot=cg_dot)
+        res = _watched_cg(
+            lambda: run(jnp.asarray(tod), jnp.asarray(wgt), **kw_run),
+            watchdog, "joint(sharded)")
         if bool(np.any(np.asarray(res.diverged))):
             # same operator contract as solve_band's sharded branch:
             # the memoized program is not recompiled mid-run, but a
@@ -954,6 +1126,20 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
             logger.warning("multigrid unavailable for this geometry "
                            "(%s); running Jacobi", exc)
             mg = None
+    if noise_weight:
+        from comapreduce_tpu.mapmaking.noise_weight import stack_banded
+
+        banded = stack_banded(
+            [_build_banded(datas[i], noise_weight, quality, b,
+                           offset_length, n // offset_length, 1,
+                           unit=f"band{b}(joint)")
+             for i, b in enumerate(bands)])
+        if banded is not None:
+            kwargs["banded"] = (jnp.asarray(banded[0]),
+                                jnp.asarray(banded[1]))
+    # the banded prior is part of the linear system, not the
+    # preconditioner — the divergence fallback re-solve keeps it
+    sys_kw = {"banded": kwargs["banded"]} if "banded" in kwargs else {}
     # compact solve + host expansion (same shape handling as the sharded
     # branch above): the joint program only ever holds (nb, n_rank)
     # compact products on device, never (nb, npix) dense maps
@@ -977,7 +1163,7 @@ def make_band_maps_joint(filenames, bands, wcs=None, nside=None,
             np.asarray(res.diverged))
         res = _watched_cg(
             lambda: fn(jnp.asarray(tod), jnp.asarray(wgt),
-                       x0=res.offsets),
+                       x0=res.offsets, **sys_kw),
             watchdog, "joint(fallback)")
     return datas, [_attach_dict(d, r) for d, r in
                    zip(datas, _expand_joint_results(res, uniq, npix, nb))]
@@ -1115,7 +1301,7 @@ def main(argv=None) -> int:
     coarse_block = int(inputs.get("coarse_precond",
                                   0 if calibrator else 8))
     destr_sec = ini.get("Destriper", {})
-    precond, coarse_block, pair_batch, mg, kernels = \
+    precond, coarse_block, pair_batch, mg, kernels, noise_weight = \
         parse_destriper_section(destr_sec, coarse_block)
     # CG solve checkpointing (docs/OPERATIONS.md §11): validated by
     # parse_destriper_section above, consumed here (its return tuple is
@@ -1270,8 +1456,8 @@ def main(argv=None) -> int:
                 destr_over["mg_block"] = int(overrides["mg_block"])
             if "pair_batch" in overrides:
                 destr_over["pair_batch"] = int(overrides["pair_batch"])
-            precond, coarse_block, pair_batch, mg, kernels = \
-                parse_destriper_section(
+            precond, coarse_block, pair_batch, mg, kernels, \
+                noise_weight = parse_destriper_section(
                     destr_over, int(inputs.get("coarse_precond",
                                                0 if calibrator else 8)))
     writeback = None
@@ -1333,6 +1519,20 @@ def main(argv=None) -> int:
                 heartbeat=resilience.heartbeat)
         filelist = filelist[rank::n_ranks]
 
+    quality = None
+    if noise_weight:
+        # [Destriper] noise_weight = banded: the measured per-(file,
+        # feed, band) noise fits come from the quality ledger in the
+        # SAME state dir the reduction campaign wrote (latest-wins,
+        # seal-checked). An empty/absent ledger is not an error — every
+        # group then falls back to white, ledgered per file downstream.
+        from comapreduce_tpu.telemetry.quality import read_quality
+
+        quality = read_quality(state_dir)
+        if not quality:
+            logger.warning(
+                "noise_weight=banded: no quality records under %s — "
+                "all groups will keep white weighting", state_dir)
     if checkpoint_every > 0 and (sharded or use_ground):
         # solve_band has no x0 warm start on these paths — a "resumed"
         # chunk would restart cold every time and only pay snapshot I/O
@@ -1365,7 +1565,8 @@ def main(argv=None) -> int:
             resilience=resilience, watchdog=resilience.watchdog,
             precond=precond, pair_batch=pair_batch, mg=mg,
             compact=compact, kernels=kernels,
-            tod_dtype=prec.tod_dtype, cg_dot=prec.cg_dot)
+            tod_dtype=prec.tod_dtype, cg_dot=prec.cg_dot,
+            noise_weight=noise_weight, quality=quality)
         if joint_results is None:
             print("bands read different sample sets; falling back to "
                   "per-band solves (reusing the reads)")
@@ -1382,7 +1583,9 @@ def main(argv=None) -> int:
                                 watchdog=resilience.watchdog,
                                 unit=f"band{band}", precond=precond,
                                 pair_batch=pair_batch, mg=mg,
-                                kernels=kernels, cg_dot=prec.cg_dot)
+                                kernels=kernels, cg_dot=prec.cg_dot,
+                                noise_weight=noise_weight,
+                                quality=quality, band=band)
         elif checkpoint_every > 0:
             # same read as make_band_map, solve split into durable
             # checkpoint/resume chunks — a relaunch mid-CG pays only
@@ -1403,7 +1606,8 @@ def main(argv=None) -> int:
                 threshold=threshold, watchdog=resilience.watchdog,
                 unit=f"band{band}", coarse_block=coarse_block,
                 precond=precond, pair_batch=pair_batch, mg=mg,
-                kernels=kernels, cg_dot=prec.cg_dot)
+                kernels=kernels, cg_dot=prec.cg_dot,
+                noise_weight=noise_weight, quality=quality, band=band)
         else:
             data, result = make_band_map(
                 filelist, band, wcs=wcs, nside=nside, galactic=galactic,
@@ -1414,7 +1618,8 @@ def main(argv=None) -> int:
                 prefetch=prefetch, cache=cache, resilience=resilience,
                 precond=precond, pair_batch=pair_batch, mg=mg,
                 compact=compact, kernels=kernels,
-                tod_dtype=prec.tod_dtype, cg_dot=prec.cg_dot)
+                tod_dtype=prec.tod_dtype, cg_dot=prec.cg_dot,
+                noise_weight=noise_weight, quality=quality)
         tag = f"_rank{rank}" if n_ranks > 1 else ""
         path = os.path.join(out_dir, f"{prefix}_band{band}{tag}.fits")
         if writeback is None:

@@ -998,6 +998,7 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                      mg: tuple | None = None,
                      mg_smooth: int = 1,
                      mg_omega: float = 2.0 / 3.0,
+                     banded: tuple | None = None,
                      x0: jax.Array | None = None,
                      precond: str = "jacobi",
                      kernels: str = "auto",
@@ -1088,7 +1089,31 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     ``mg_omega`` are static. Mutually exclusive with ``coarse``;
     requires ``precond='jacobi'``. Ground solves apply the V-cycle to
     the offsets block (identity on the small ground block, like every
-    other preconditioner here).
+    other preconditioner here). Under ``axis_name`` (shard_map) the
+    hierarchy must be built from the GLOBAL padded pixel/weight
+    vectors: level 0's ``grp`` is then each shard's contiguous slice
+    of the global offset->block map (whole offsets per shard, so the
+    slice lines up) while every other leaf is replicated — the level-0
+    restriction is psum-assembled exactly like the two-level coarse
+    vector, the coarser levels run redundantly per shard on the
+    replicated global vectors, and prolongation is each shard's own
+    gather. The fine smoother's operator is the psum-threaded
+    ``matvec`` already, so the cycle stays ONE SPD operator across the
+    mesh.
+
+    ``banded``: optional ``(c0, cs)`` from
+    :func:`~comapreduce_tpu.mapmaking.noise_weight.
+    build_banded_weight` — adds a symmetric banded offset-rate noise
+    prior ``B`` to the normal operator (``A' = F^T W Z F + B``, the
+    MADAM/MAPPRAISER destriping prior built from the quality ledger's
+    measured 1/f fits): ``c0`` f32[..., n_off] is the diagonal,
+    ``cs`` f32[..., q, n_off] the ``q`` upper off-diagonal bands
+    (``cs[..., j-1, i] = B[i, i+j]``). Applied inside the CG matvec
+    and the Jacobi diagonal; the RHS is unchanged (zero-mean prior).
+    Couplings across (file, feed) group and shard boundaries are
+    zeroed by the builder, so the sharded apply is purely local (no
+    halo exchange). Leading band axes broadcast like every other
+    multi-RHS operand. Not available on the joint ground solve.
 
     ``kernels``: the ``[Destriper] kernels`` knob — ``auto`` (default),
     ``xla``, ``pallas``, or ``interpret``. Resolved EAGERLY at trace
@@ -1123,20 +1148,16 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
     # None (not "xla") when the knob resolves to XLA: the legacy env
     # dispatch (COMAP_BIN_IMPL included) stays byte-identical
     bin_impl = None if kern == "xla" else kern
-    if mg is not None and axis_name is not None:
-        # the V-cycle's restriction/level solves are not psum-threaded
-        # (each shard would correct against a partial residual — no
-        # longer one SPD operator); every other knob either works
-        # sharded or raises, so this one raises too. The CLI downgrades
-        # sharded multigrid runs to the two-level preconditioner.
-        raise ValueError("mg (multigrid) is not supported under "
-                         "shard_map (axis_name=...); use coarse=... — "
-                         "the two-level preconditioner is psum-aware")
     dv = device_arrays if device_arrays is not None else plan.device()
     with_ground = ground_off is not None
     if with_ground and tod.ndim != 1:
         raise ValueError("the planned ground solve is single-RHS; "
                          "use destripe() or per-band solves otherwise")
+    if banded is not None and with_ground:
+        raise ValueError("banded noise weighting composes with the "
+                         "offsets-only solves; the joint ground solve "
+                         "keeps the white-weight operator (run "
+                         "noise_weight = white there)")
     # numerical tripwire (see destripe): non-finite samples -> (0, 0)
     tod, weights = scrub_tod(tod, weights)
 
@@ -1266,10 +1287,35 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                          jnp.take(m, jnp.clip(pr_off, 0, n_rank - 1),
                                   axis=-1), 0.0)
 
+    # banded offset-rate noise prior B (see the ``banded`` doc above):
+    # symmetric application from the stored diagonal + upper bands via
+    # shifted adds with zero fill — group/shard boundary couplings are
+    # zeroed by the builder, so the shifts never need a halo exchange
+    if banded is not None:
+        b_c0 = jnp.asarray(banded[0], f32)
+        b_cs = jnp.asarray(banded[1], f32)
+        n_bw = int(b_cs.shape[-2])
+
+        def banded_apply(a):
+            out = b_c0 * a
+            for j in range(1, n_bw + 1):
+                cj = b_cs[..., j - 1, :]
+                zj = jnp.zeros(a.shape[:-1] + (j,), f32)
+                # upper band: row i adds cj[i] * a[i+j] ...
+                out = out + cj * jnp.concatenate(
+                    [a[..., j:], zj], axis=-1)
+                # ... and its transpose: row i+j adds cj[i] * a[i]
+                out = out + jnp.concatenate(
+                    [zj, (cj * a)[..., :-j]], axis=-1)
+            return out
+
     def matvec(a):
         pav = pair_w * gather_a(a)                 # rank order
         m = from_global(to_map(pav))
-        return diag * a - off_sum(pair_w_off * gather_m(m))
+        out = diag * a - off_sum(pair_w_off * gather_m(m))
+        if banded is not None:
+            out = out + banded_apply(a)
+        return out
 
     m_d = to_map(pair_wd)
     gm_md = gather_m(from_global(m_d))
@@ -1282,7 +1328,13 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
                            1.0 / jnp.maximum(sum_w, 1e-30), 0.0)
         corr = off_sum(pair_w_off * pair_w_off
                        * gather_m(from_global(inv_sw)))
-        inv_diag = _jacobi_inverse(diag - corr, diag)
+        if banded is not None:
+            # diag(A + B): the prior's diagonal rides both the true
+            # diagonal and the degenerate-offset fallback (B is SPD, so
+            # an offset the projection Z absorbs is still pinned by it)
+            inv_diag = _jacobi_inverse(diag - corr + b_c0, diag + b_c0)
+        else:
+            inv_diag = _jacobi_inverse(diag - corr, diag)
 
     if precond == "none":
         def apply_precond(v):
@@ -1320,10 +1372,18 @@ def destripe_planned(tod: jax.Array, weights: jax.Array, plan: PointingPlan,
             res = r - apply_a(x)
             if "ac_inv" in lv:          # coarsest: dense ridged inverse
                 rc = restrict(grp, res, lv["ac_inv"].shape[-1])
+                if idx == 0:
+                    # sharded: each shard restricts its own offsets into
+                    # the GLOBAL coarse vector; psum assembles it (blocks
+                    # may span shards) — the two-level coarse idiom.
+                    # Coarser levels already hold replicated globals.
+                    rc = _psum(rc)
                 ec = jnp.einsum("...ij,...j->...i", lv["ac_inv"], rc)
             else:
                 invd_n = lv["invd"]
                 rc = restrict(grp, res, invd_n.shape[-1])
+                if idx == 0:
+                    rc = _psum(rc)
                 ec = vcycle(idx + 1, rc,
                             lambda v, lv=lv: coo_apply(lv, v), invd_n)
             x = x + jnp.take(ec, grp, axis=-1)

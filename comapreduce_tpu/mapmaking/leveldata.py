@@ -23,6 +23,7 @@ Capability parity with ``read_comap_data`` (``COMAPData.py:471-577``) and
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 
 import jax.numpy as jnp
@@ -86,6 +87,11 @@ class DestriperData:
     # through it at write time (PixelSpace.expand) — the only place an
     # npix_sky-sized vector may exist.
     pixel_space: PixelSpace | None = None
+    # per ground-id group (one per kept (file, feed), in ground_ids
+    # order): {"file": basename, "feed": i, "sample_rate": Hz,
+    # "n_samples": kept samples} — the noise_weight builder joins these
+    # against the quality ledger's per-(file, feed, band) 1/f fits
+    groups: list = field(default_factory=list)
 
     def expand_map(self, compact_map: np.ndarray) -> np.ndarray:
         """Compact-pixel map -> full-sky-indexable (pixels, values)."""
@@ -301,6 +307,7 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
     tods, pixs, wgts, gids, azs = [], [], [], [], []
     group = 0
     kept_files = []
+    groups_meta = []
     stream = level2_stream(filenames, prefetch=prefetch, cache=cache,
                            tod_dtype=tod_dtype,
                            retry=resilience.retry,
@@ -423,6 +430,17 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                     weights[near] = 0.0
             else:
                 lon, lat = (e2g(ra, dec) if galactic else (ra, dec))
+            # per-file sample rate from the MJD axis (the quality
+            # ledger's 1/f fits are in Hz; the noise_weight builder
+            # needs the offset rate fs/L). 50 Hz is the COMAP default
+            # when the store carries no usable time axis.
+            try:
+                mjd_t = np.asarray(lvl2.mjd, np.float64)
+                dt_s = (np.median(np.diff(mjd_t)) * 86400.0
+                        if mjd_t.size > 1 else 0.0)
+                fs = 1.0 / dt_s if dt_s > 0 else 50.0
+            except (AttributeError, KeyError, TypeError, ValueError):
+                fs = 50.0
             for ifeed in range(F):
                 if feed_mask is not None and not feed_mask[ifeed]:
                     continue
@@ -449,6 +467,10 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                 wgts.append(w_f)
                 gids.append(np.full(w_f.size, group, np.int32))
                 azs.append(a_norm)
+                groups_meta.append({"file": os.path.basename(fname),
+                                    "feed": int(ifeed),
+                                    "sample_rate": float(fs),
+                                    "n_samples": int(w_f.size)})
                 group += 1
             kept_files.append(fname)
     finally:
@@ -486,7 +508,7 @@ def read_comap_data(filenames, band: int = 0, wcs: WCS | None = None,
                          ground_ids=ground_ids, az=az, n_groups=group,
                          npix=space.n_solve, wcs=wcs, nside=nside,
                          sky_pixels=space.pixels, files=kept_files,
-                         pixel_space=space)
+                         pixel_space=space, groups=groups_meta)
 
 
 def export_madam(data: DestriperData, path: str) -> None:

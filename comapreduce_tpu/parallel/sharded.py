@@ -259,9 +259,14 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                   n_bands: int = 0,
                                   n_groups: int = 0,
                                   with_coarse: bool = False,
+                                  with_mg: bool = False,
+                                  mg_smooth: int = 1,
+                                  mg_omega: float = 2.0 / 3.0,
+                                  with_banded: bool = False,
                                   precond: str = "jacobi",
                                   kernels: str = "auto",
-                                  cg_dot: str = "f32"):
+                                  cg_dot: str = "f32",
+                                  trace_iters: int = 0):
     """Build a reusable sharded planned-destriper: returns
     ``run(tod, weights) -> DestriperResult``.
 
@@ -288,16 +293,42 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     (``destriper.build_coarse_preconditioner``; stack (nb, n_c, n_c)
     for multi-RHS). Not available on the ground program.
 
+    ``with_mg=True`` builds the native sharded MULTIGRID program:
+    ``run(tod, weights, mg=hierarchy)`` with the hierarchy from
+    ``destriper.build_multigrid_hierarchy`` (or ``stack_multigrid``)
+    built over the GLOBAL padded pixel/weight vectors. Level 0's
+    ``grp`` is sharded like the two-level ``grp`` (whole offsets per
+    shard — the slice lines up); every other leaf is replicated, the
+    level-0 restriction psum-assembles the global coarse residual and
+    the coarser levels run redundantly per shard (see
+    ``destripe_planned``'s ``mg`` doc). ``mg_smooth``/``mg_omega``
+    are static. Mutually exclusive with ``with_coarse``.
+
+    ``with_banded=True`` adds the measured-noise banded prior inputs:
+    ``run(..., banded=(c0, cs))`` from
+    ``mapmaking.noise_weight.build_banded_weight`` built with
+    ``n_shards`` = this mesh's device count over the PADDED global
+    offset count — ``c0``/``cs`` are sharded on their offset (last)
+    axis and the apply is purely local (boundary couplings are zeroed
+    by the builder). Composes with any preconditioner program.
+
+    ``trace_iters > 0`` threads the solver-trace depth: the result's
+    ``trace`` histories come back replicated (the traced dots are
+    psum'd), so ``telemetry.solver_trace.record_solve`` works on
+    sharded solves exactly as on single-device ones.
+
     ``cg_dot`` threads the ``[Precision] cg_dot`` knob to every branch
     (see ``destripe_planned``): compensated per-shard dots, f32 psum of
     the per-shard partials.
     """
     if n_bands and n_groups:
         raise ValueError("ground solves are single-RHS; run per band")
-    _check_precond(precond, coarse="coarse" if with_coarse else None)
-    if with_coarse and n_groups:
-        raise ValueError("the sharded ground program keeps Jacobi; "
-                         "with_coarse applies to the plain/multi-RHS "
+    _check_precond(precond, coarse="coarse" if with_coarse else None,
+                   mg="mg" if with_mg else None)
+    if n_groups and (with_coarse or with_mg or with_banded):
+        raise ValueError("the sharded ground program keeps Jacobi and "
+                         "white weighting; with_coarse/with_mg/"
+                         "with_banded apply to the plain/multi-RHS "
                          "programs")
     axes = tuple(mesh.axis_names)
     n_shards = int(np.prod([mesh.shape[a] for a in axes]))
@@ -320,7 +351,11 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
     out_specs = DestriperResult(
         offsets=v_spec, ground=repl, destriped_map=band_repl,
         naive_map=band_repl, weight_map=band_repl, hit_map=repl,
-        n_iter=repl, residual=band_repl, diverged=band_repl)
+        n_iter=repl, residual=band_repl, diverged=band_repl,
+        # traced histories are replicated (every traced dot is psum'd);
+        # untraced solves return None there — an empty pytree node, so
+        # the specs pytree matches either way
+        trace=((repl, repl, repl, repl) if trace_iters else None))
 
     if n_groups:
         def local_g(tod_l, w_l, g_off_l, az_l, arrs):
@@ -330,7 +365,8 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
                                     dense_maps=False, device_arrays=arrs,
                                     ground_off=g_off_l, az=az_l,
                                     n_groups=n_groups, precond=precond,
-                                    kernels=kernels, cg_dot=cg_dot)
+                                    kernels=kernels, cg_dot=cg_dot,
+                                    trace_iters=trace_iters)
 
         fn = jax.jit(_shard_map(
             local_g, mesh=mesh,
@@ -345,44 +381,98 @@ def make_destripe_sharded_planned(mesh: Mesh, plans: list[PointingPlan],
 
         return run
 
-    if with_coarse:
-        def local_c(tod_l, w_l, grp_l, aci, arrs):
-            arrs = {k: v[0] for k, v in arrs.items()}
-            return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
-                                    threshold=threshold, axis_name=axes,
-                                    dense_maps=False, device_arrays=arrs,
-                                    coarse=(grp_l, aci), precond=precond,
-                                    kernels=kernels, cg_dot=cg_dot)
-
-        fn = jax.jit(_shard_map(
-            local_c, mesh=mesh,
-            in_specs=(v_spec, v_spec, shard, band_repl, arr_specs),
-            out_specs=out_specs, check_vma=False))
-
-        def run(tod, weights, coarse) -> DestriperResult:
-            grp, aci = coarse
-            with mesh:
-                return fn(jnp.asarray(tod), jnp.asarray(weights),
-                          jnp.asarray(grp, jnp.int32),
-                          jnp.asarray(aci, jnp.float32), stacked)
-
-        return run
-
-    def local(tod_l, w_l, arrs):
+    # ONE local body for every non-ground program: the optional inputs
+    # (two-level coarse pair, multigrid hierarchy, banded prior) ride a
+    # dict whose in_specs mirror its structure — built lazily per
+    # structure because the mg hierarchy's level count is a call-time
+    # fact, then cached (jit dedupes recompiles by structure anyway)
+    def local(tod_l, w_l, extra, arrs):
         arrs = {k: v[0] for k, v in arrs.items()}
+        kw = {}
+        if "coarse_grp" in extra:
+            kw["coarse"] = (extra["coarse_grp"], extra["coarse_inv"])
+        if "mg" in extra:
+            kw["mg"] = extra["mg"]
+            kw["mg_smooth"] = mg_smooth
+            kw["mg_omega"] = mg_omega
+        if "banded_c0" in extra:
+            kw["banded"] = (extra["banded_c0"], extra["banded_cs"])
         return destripe_planned(tod_l, w_l, p0, n_iter=n_iter,
                                 threshold=threshold, axis_name=axes,
                                 dense_maps=False, device_arrays=arrs,
                                 precond=precond, kernels=kernels,
-                                cg_dot=cg_dot)
+                                cg_dot=cg_dot, trace_iters=trace_iters,
+                                **kw)
 
-    fn = jax.jit(_shard_map(local, mesh=mesh,
-                            in_specs=(v_spec, v_spec, arr_specs),
-                            out_specs=out_specs, check_vma=False))
+    def extra_specs(extra):
+        specs = {}
+        for k, v in extra.items():
+            if k == "coarse_grp":
+                specs[k] = shard          # whole offsets per shard
+            elif k == "coarse_inv":
+                specs[k] = band_repl
+            elif k == "mg":
+                # level 0's grp is each shard's slice of the global
+                # offset->block map; every other leaf (coarser stencils,
+                # operator values, dense inverse) is replicated
+                specs[k] = tuple(
+                    {kk: (shard if (i == 0 and kk == "grp") else repl)
+                     for kk in lv}
+                    for i, lv in enumerate(v))
+            elif k == "banded_c0":
+                specs[k] = v_spec         # offset axis sharded
+            elif k == "banded_cs":
+                specs[k] = (P(None, None, axes) if n_bands
+                            else P(None, axes))
+        return specs
 
-    def run(tod, weights) -> DestriperResult:
+    compiled: dict = {}
+
+    def get_fn(extra):
+        key = jax.tree_util.tree_structure(extra)
+        if key not in compiled:
+            compiled[key] = jax.jit(_shard_map(
+                local, mesh=mesh,
+                in_specs=(v_spec, v_spec, extra_specs(extra), arr_specs),
+                out_specs=out_specs, check_vma=False))
+        return compiled[key]
+
+    def run(tod, weights, coarse=None, mg=None,
+            banded=None) -> DestriperResult:
+        extra = {}
+        if with_coarse:
+            if coarse is None:
+                raise ValueError("this program was built with_coarse; "
+                                 "pass coarse=(grp, ac_inv)")
+            grp, aci = coarse
+            extra["coarse_grp"] = jnp.asarray(grp, jnp.int32)
+            extra["coarse_inv"] = jnp.asarray(aci, jnp.float32)
+        elif coarse is not None:
+            raise ValueError("coarse passed but the program was built "
+                             "without with_coarse")
+        if with_mg:
+            if mg is None:
+                raise ValueError("this program was built with_mg; pass "
+                                 "mg=build_multigrid_hierarchy(...) over "
+                                 "the GLOBAL padded vectors")
+            extra["mg"] = jax.tree_util.tree_map(jnp.asarray, tuple(mg))
+        elif mg is not None:
+            raise ValueError("mg passed but the program was built "
+                             "without with_mg")
+        if with_banded:
+            if banded is None:
+                raise ValueError("this program was built with_banded; "
+                                 "pass banded=(c0, cs) from "
+                                 "noise_weight.build_banded_weight")
+            extra["banded_c0"] = jnp.asarray(banded[0], jnp.float32)
+            extra["banded_cs"] = jnp.asarray(banded[1], jnp.float32)
+        elif banded is not None:
+            raise ValueError("banded passed but the program was built "
+                             "without with_banded")
+        fn = get_fn(extra)
         with mesh:
-            return fn(jnp.asarray(tod), jnp.asarray(weights), stacked)
+            return fn(jnp.asarray(tod), jnp.asarray(weights), extra,
+                      stacked)
 
     return run
 

@@ -72,6 +72,11 @@ SCALE_SCENARIO = dict(
 )
 
 _N_RANKS = 3
+# the drill's MeasureSystemTemperature window pad — ONE constant shared
+# by _reduce_config and the load-time pad-vs-gap fault trap
+# (ScenarioConfig.validate_vane_pad), so the stage chain and the
+# validation can never drift apart
+_VANE_PAD = 30
 MAP_SHAPE = (64, 64)
 CDELT = (1.0 / 60.0, 1.0 / 60.0)
 
@@ -123,7 +128,7 @@ def _reduce_config(out_dir: str, state_dir: str, ttl_s: float) -> dict:
         # scan cells where the scenario's spike/NaN faults live — one
         # fault inside the window NaNs the range normalisation and
         # zeroes the whole event's Tsys (hence every Level-2 weight).
-        "MeasureSystemTemperature": {"pad": 30},
+        "MeasureSystemTemperature": {"pad": _VANE_PAD},
         "Level1AveragingGainCorrection": {"feed_batch": 1},
         "resilience": {"lease_ttl_s": ttl_s,
                        "heartbeat_s": max(ttl_s / 5.0, 0.05)},
@@ -156,7 +161,10 @@ def _worker_main(argv=None) -> int:
         from comapreduce_tpu.telemetry import TELEMETRY
 
         TELEMETRY.configure(a.state_dir, rank=a.rank, flush_s=0.2)
-    cfg = register_scenario_file(a.scenario)
+    # vane_pad threads the chain's window pad into the load-time
+    # pad-vs-gap fault trap: a scenario whose gap the padded vane
+    # windows would overrun fails HERE, not as silently-zero weights
+    cfg = register_scenario_file(a.scenario, vane_pad=_VANE_PAD)
     files = virtual_filelist(cfg)
     runner = Runner.from_config(
         _reduce_config(a.output_dir, a.state_dir, a.ttl),
@@ -214,7 +222,9 @@ def run_synthetic_drill(workdir: str, seed: int = 0, n_files: int = 200,
     for d in dirs.values():
         os.makedirs(d, exist_ok=True)
 
-    cfg = scale_scenario(seed, n_files)
+    # the same trap the workers run at registration — fired before any
+    # process spawns, so a pad-vs-gap override fails in one stack trace
+    cfg = scale_scenario(seed, n_files).validate_vane_pad(_VANE_PAD)
     register_scenario(cfg)
     scenario_toml = write_scenario_toml(
         cfg, os.path.join(workdir, "scenario.toml"))

@@ -55,11 +55,13 @@ def register_scenario(cfg: ScenarioConfig) -> ScenarioConfig:
     return cfg
 
 
-def register_scenario_file(path: str) -> ScenarioConfig:
-    """Load + register a scenario TOML (subprocess worker entry)."""
+def register_scenario_file(path: str, vane_pad=None) -> ScenarioConfig:
+    """Load + register a scenario TOML (subprocess worker entry).
+    ``vane_pad`` threads the consumer's vane-window pad into the
+    load-time pad-vs-gap fault trap (``load_scenario``)."""
     from comapreduce_tpu.synthetic.scenario import load_scenario
 
-    return register_scenario(load_scenario(path))
+    return register_scenario(load_scenario(path, vane_pad=vane_pad))
 
 
 def registered(name: str) -> ScenarioConfig | None:

@@ -142,6 +142,36 @@ class ScenarioConfig:
             return cls(**known)
         raise TypeError(f"cannot build ScenarioConfig from {type(value)}")
 
+    def validate_vane_pad(self, pad) -> "ScenarioConfig":
+        """Fail FAST when a vane-measurement window pad would swallow
+        faulted scan samples (ISSUE 19 bugfix).
+
+        ``MeasureSystemTemperature`` widens each vane window by ``pad``
+        samples to catch post-retraction sky; when ``pad >=
+        gap_samples`` the widened window reaches past the gap into the
+        scan cells. On a fault-injecting scenario (``spike_rate`` /
+        ``nan_rate`` > 0) one NaN inside the window breaks the range
+        normalisation and zeroes the whole event's Tsys — every
+        Level-2 weight silently becomes zero, file after file. Raise
+        at scenario load instead, naming both knobs.
+
+        Fault-free scenarios pass: with no spikes/NaNs in the scan
+        cells the widened window only averages clean sky (the transfer
+        scenario runs gap=40 under the stage default pad=50 by
+        design). Returns ``self`` so call sites can chain."""
+        pad = int(pad)
+        if (self.vane_samples > 0 and self.gap_samples <= pad
+                and (self.spike_rate > 0 or self.nan_rate > 0)):
+            raise ValueError(
+                f"scenario {self.name!r}: vane window pad {pad} >= "
+                f"gap_samples {self.gap_samples} on a fault-injecting "
+                f"scenario (spike_rate={self.spike_rate}, "
+                f"nan_rate={self.nan_rate}) — the widened vane windows "
+                "would swallow faulted scan samples and zero every "
+                "Level-2 weight; raise gap_samples or lower the "
+                "MeasureSystemTemperature pad")
+        return self
+
     def sky_model(self):
         """The injected-sky ``SkyModel`` (None when no sky is injected)."""
         if self.sky_amplitude_k <= 0:
@@ -164,13 +194,19 @@ class ScenarioConfig:
         return SkyModel([comp])
 
 
-def load_scenario(path: str) -> ScenarioConfig:
+def load_scenario(path: str, vane_pad=None) -> ScenarioConfig:
     """Parse a scenario TOML file, strictly.
 
     The document must contain a ``[scenario]`` table; any *other*
     top-level section (``[Destriper]``, ``[Global]``, ...) and any
     unknown key inside ``[scenario]`` is a ``ValueError`` — a typo'd
     campaign config fails at load, not 20 minutes into generation.
+
+    ``vane_pad`` is the consuming stage chain's
+    ``MeasureSystemTemperature`` window pad, when the caller knows it:
+    the pad-vs-gap fault trap (:meth:`ScenarioConfig.validate_vane_pad`)
+    then fires HERE, at load, instead of zeroing every Level-2 weight
+    mid-campaign.
     """
     from comapreduce_tpu.pipeline.config import load_toml
 
@@ -185,6 +221,9 @@ def load_scenario(path: str) -> ScenarioConfig:
             f"{path}: unknown sections {extra_sections} — a scenario "
             f"file holds exactly one [scenario] table")
     try:
-        return ScenarioConfig.coerce(dict(doc["scenario"]))
+        cfg = ScenarioConfig.coerce(dict(doc["scenario"]))
+        if vane_pad is not None:
+            cfg.validate_vane_pad(vane_pad)
+        return cfg
     except (TypeError, ValueError) as exc:
         raise ValueError(f"{path}: {exc}") from None

@@ -86,8 +86,20 @@ def shape_bucket(*args, **kwargs) -> str:
 
 
 def program_key(name: str, bucket: str = "",
-                precision_id: str = "") -> str:
-    return f"{name}|{bucket}|{precision_id}"
+                precision_id: str = "", kernels: str = "") -> str:
+    """Registry identity of one compiled program.
+
+    ``kernels`` is the RESOLVED binning/gather implementation the
+    program compiled with ('xla'|'pallas'|'interpret' — never 'auto'):
+    the same (name, bucket, precision) triple compiles to genuinely
+    different programs per implementation, and folding them onto one
+    key would let whichever ran last overwrite the other's HBM
+    baseline. Appended only when non-empty, so keys from stages that
+    predate the field (and every non-destriper program) stay stable."""
+    key = f"{name}|{bucket}|{precision_id}"
+    if kernels:
+        key = f"{key}|kernels={kernels}"
+    return key
 
 
 def analyze(compiled) -> dict:
@@ -161,24 +173,30 @@ class ProgramRegistry:
             self._records.clear()
 
     def seen(self, name: str, bucket: str = "",
-             precision_id: str = "") -> bool:
+             precision_id: str = "", kernels: str = "") -> bool:
         """Dedup probe — callers about to pay an AOT lower+compile just
         to feed the registry should skip when the key is already
         recorded (``record_jit`` does)."""
-        return program_key(name, bucket, precision_id) in self._seen
+        return program_key(name, bucket, precision_id,
+                           kernels) in self._seen
 
     def snapshot(self) -> list:
         with self._lock:
             return list(self._records)
 
     def record(self, name: str, compiled, *, shape_bucket: str = "",
-               precision_id: str = "", extra: dict | None = None):
+               precision_id: str = "", kernels: str = "",
+               extra: dict | None = None):
         """Analyze one compiled executable and append its record.
-        Duplicate (name, bucket, precision) keys are dropped — warmup
-        re-runs re-compile the same programs, they don't re-count."""
+        Duplicate (name, bucket, precision, kernels) keys are dropped —
+        warmup re-runs re-compile the same programs, they don't
+        re-count. ``kernels`` is the RESOLVED matvec implementation
+        (see :func:`program_key`) — without it the xla and pallas
+        compiles of one destriper program collide on one key and the
+        last writer corrupts the HBM gate baseline."""
         if not self._enabled:
             return None
-        key = program_key(name, shape_bucket, precision_id)
+        key = program_key(name, shape_bucket, precision_id, kernels)
         with self._lock:
             if key in self._seen:
                 return None
@@ -194,6 +212,8 @@ class ProgramRegistry:
                "precision_id": precision_id, "backend": backend,
                "rank": self._rank,
                "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        if kernels:
+            rec["kernels"] = str(kernels)
         rec.update(analyze(compiled))
         if extra:
             rec.update(extra)
@@ -209,7 +229,8 @@ class ProgramRegistry:
         return rec
 
     def record_jit(self, name: str, fn, *args, precision_id: str = "",
-                   bucket: str | None = None, **kwargs):
+                   bucket: str | None = None, kernels: str = "",
+                   **kwargs):
         """Record a ``jax.jit`` function by AOT-compiling it for the
         given example arguments. The dedup probe runs FIRST: the
         lower+compile (which does not share the jit call cache) is paid
@@ -219,7 +240,7 @@ class ProgramRegistry:
             return None
         if bucket is None:
             bucket = shape_bucket(*args, **kwargs)
-        if self.seen(name, bucket, precision_id):
+        if self.seen(name, bucket, precision_id, kernels):
             return None
         try:
             compiled = fn.lower(*args, **kwargs).compile()
@@ -228,7 +249,7 @@ class ProgramRegistry:
                          name, type(exc).__name__, exc)
             return None
         return self.record(name, compiled, shape_bucket=bucket,
-                           precision_id=precision_id)
+                           precision_id=precision_id, kernels=kernels)
 
     def _append(self, records: list) -> None:
         """The quality ledger's torn-line-safe append discipline; the
@@ -291,7 +312,8 @@ def read_programs(source) -> list:
                 continue
             key = program_key(rec.get("name", ""),
                               rec.get("shape_bucket", ""),
-                              rec.get("precision_id", ""))
+                              rec.get("precision_id", ""),
+                              rec.get("kernels", ""))
             latest[key] = rec
     return [latest[k] for k in sorted(latest)]
 
@@ -311,7 +333,8 @@ def hbm_regressions(current: list, baseline: dict,
     for rec in current:
         key = program_key(rec.get("name", ""),
                           rec.get("shape_bucket", ""),
-                          rec.get("precision_id", ""))
+                          rec.get("precision_id", ""),
+                          rec.get("kernels", ""))
         hbm = (rec.get("temp_bytes") or 0) + (rec.get("output_bytes")
                                               or 0)
         base = baseline.get(key)

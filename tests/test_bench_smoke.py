@@ -183,6 +183,31 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                         lambda: json.loads(json.dumps(serv)))
     monkeypatch.setattr(cp, "run_tiles_gate",
                         lambda: json.loads(json.dumps(til)))
+    # ... and the sharded-solver children (ISSUE 19): the real
+    # multi-device bench + builder run in CI's check_perf step; here
+    # every cp.main() would otherwise pay for a 4-device mesh solve
+    shrd = {"metric": "destriper_sharded_mg_iters_to_tol", "value": 58,
+            "detail": {"n_shards": 4,
+                       "ladder": {
+                           "single_multigrid": {"iters_to_tol": 58},
+                           "sharded_multigrid": {"iters_to_tol": 58},
+                           "sharded_twolevel": {"iters_to_tol": 81}},
+                       "parity": {"max_offset_diff": 1.5e-4},
+                       "solver_trace": {"iteration_records": 58,
+                                        "reported_iters": 58,
+                                        "match": True},
+                       "banded": {
+                           "white": {"iters": 48,
+                                     "map_rms_err": 0.0151},
+                           "banded": {"iters": 29,
+                                      "map_rms_err": 0.0107},
+                           "sharded_parity_max_diff": 4.8e-7}}}
+    wpar = {"banded_is_none": True, "reasons": ["absent", "fknee_low"],
+            "report": {"banded": 0, "white": 2, "fallbacks": []}}
+    monkeypatch.setattr(cp, "run_sharded_bench",
+                        lambda: json.loads(json.dumps(shrd)))
+    monkeypatch.setattr(cp, "banded_white_parity_check",
+                        lambda: json.loads(json.dumps(wpar)))
     # keep the run-registry appends out of the repo's real evidence/
     monkeypatch.setenv("COMAP_RUNS_REGISTRY",
                        str(tmp_path / "runs.jsonl"))
@@ -313,6 +338,38 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                       "(0.45, 1.30)")
     assert cp.main(["--reps", "1", "--no-serving"]) == 1
     tfer_fails.clear()
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    # the sharded-solver gates (ISSUE 19): losing the strict ordering
+    # over sharded twolevel, never converging, drifting >10% off the
+    # single-device count, a trace mismatch, a banded prior that stops
+    # beating white, a shard-parity breach, or a white-noise scenario
+    # that yields a banded operand each fail; --no-sharded skips both
+    lad = shrd["detail"]["ladder"]
+    lad["sharded_multigrid"]["iters_to_tol"] = 81       # ties twolevel
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    assert cp.main(["--reps", "1", "--no-serving",
+                    "--no-sharded"]) == 0
+    lad["sharded_multigrid"]["iters_to_tol"] = None     # never reached
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    lad["sharded_multigrid"]["iters_to_tol"] = 70       # >1.1x single
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    lad["sharded_multigrid"]["iters_to_tol"] = 58
+    shrd["detail"]["solver_trace"]["match"] = False
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    shrd["detail"]["solver_trace"]["match"] = True
+    bnd = shrd["detail"]["banded"]
+    bnd["banded"]["iters"] = 48          # prior stopped earning
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    bnd["banded"]["iters"] = 29
+    bnd["sharded_parity_max_diff"] = 1e-3   # coupling crossed a shard
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    bnd["sharded_parity_max_diff"] = 4.8e-7
+    wpar["banded_is_none"] = False
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    wpar["banded_is_none"] = True
+    wpar["reasons"] = ["absent", "bad_fit"]   # reasons drifted
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    wpar["reasons"] = ["absent", "fknee_low"]
     assert cp.main(["--reps", "1", "--no-serving"]) == 0
     # ... and every gated run landed in the (redirected) registry,
     # honest about its own ok bit

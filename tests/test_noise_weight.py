@@ -1,0 +1,246 @@
+"""Measured-noise banded offset weighting (ISSUE 19 —
+``[Destriper] noise_weight = banded``): builder fallback ledger, SPD
+band structure, group/shard boundary zeroing, multi-RHS stacking, the
+exact-white-parity contract, and the matched-1/f improvement the knob
+exists for."""
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.mapmaking.noise_weight import (build_banded_weight,
+                                                    quality_index,
+                                                    stack_banded)
+
+L = 10
+FS = 50.0
+
+
+def _group(file="a.h5", feed=0, n_samples=400, fs=FS):
+    return {"file": file, "feed": feed, "sample_rate": fs,
+            "n_samples": n_samples}
+
+
+def _fit(file="a.h5", feed=0, band=0, sigma=0.05, fknee=1.0,
+         alpha=-1.5, **over):
+    rec = {"file": file, "feed": feed, "band": band,
+           "white_sigma": sigma, "fknee_hz": fknee, "alpha": alpha}
+    rec.update(over)
+    return rec
+
+
+class TestBuilder:
+    def test_good_fit_builds_spd_band(self):
+        g = [_group(n_samples=800)]
+        n_off = 120  # 80 group offsets + 40 padding
+        banded, report = build_banded_weight(g, [_fit()], n_off, L)
+        assert banded is not None
+        c0, cs = banded
+        assert c0.shape == (n_off,) and cs.shape == (4, n_off)
+        assert c0.dtype == np.float32 and cs.dtype == np.float32
+        # prior lives exactly on the group's offsets; padding stays 0
+        assert (c0[:80] > 0).all()
+        assert (c0[80:] == 0).all() and (cs[:, 80:] == 0).all()
+        # strict diagonal dominance (the SPD guarantee): the full
+        # symmetric row sum 2*sum_j |b_j| never exceeds 0.95*b_0
+        off = 2.0 * np.abs(cs[:, :80]).astype(np.float64).sum(0)
+        assert (off <= 0.95 * c0[:80].astype(np.float64)
+                + 1e-6 * c0[0]).all()
+        assert report == {"banded": 1, "white": 0, "fallbacks": []}
+
+    def test_every_fallback_reason_ledgered(self):
+        groups = [_group("absent.h5", 0), _group("flagged.h5", 1),
+                  _group("badfit.h5", 2), _group("lowknee.h5", 3)]
+        quality = [_fit("flagged.h5", 1, flagged=True),
+                   _fit("badfit.h5", 2, alpha=+1.0),
+                   _fit("lowknee.h5", 3, fknee=1e-6)]
+        banded, report = build_banded_weight(groups, quality, 160, L)
+        # every group fell back -> None (callers omit the kwarg: the
+        # compiled program is byte-identical to noise_weight = white)
+        assert banded is None
+        assert report["banded"] == 0 and report["white"] == 4
+        by_file = {f["file"]: f["reason"] for f in report["fallbacks"]}
+        assert by_file == {"absent.h5": "absent",
+                          "flagged.h5": "flagged",
+                          "badfit.h5": "bad_fit",
+                          "lowknee.h5": "fknee_low"}
+
+    def test_group_boundary_couplings_zeroed(self):
+        groups = [_group("a.h5", 0, n_samples=400),
+                  _group("b.h5", 1, n_samples=400)]
+        quality = [_fit("a.h5", 0), _fit("b.h5", 1)]
+        banded, report = build_banded_weight(groups, quality, 80, L,
+                                             bandwidth=3)
+        assert report["banded"] == 2
+        c0, cs = banded
+        assert (c0 > 0).all()
+        # lag j from offset i reaches i+j: the last j offsets of group
+        # a (ends at 40) would couple into group b — must be zero
+        for j in range(1, 4):
+            assert (cs[j - 1, 40 - j:40] == 0).all()
+            assert (cs[j - 1, :40 - j] != 0).all()
+
+    def test_shard_boundary_couplings_zeroed(self):
+        banded, _ = build_banded_weight(
+            [_group(n_samples=800)], [_fit()], 80, L, bandwidth=3,
+            n_shards=4)
+        c0, cs = banded
+        per = 80 // 4
+        idx = np.arange(80)
+        for j in range(1, 4):
+            cross = (idx // per) != ((idx + j) // per)
+            assert (cs[j - 1, cross] == 0).all()
+            interior = ~cross & (idx + j < 80)
+            assert (cs[j - 1, interior] != 0).all()
+
+    def test_shard_misaligned_offsets_raise(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            build_banded_weight([_group(n_samples=800)], [_fit()],
+                                81, L, n_shards=4)
+
+    def test_quality_index_filters_band_and_basename(self):
+        recs = [_fit("/deep/path/a.h5", 0, band=0),
+                _fit("a.h5", 0, band=1, sigma=9.0),
+                {"file": None, "feed": "x", "band": 0}]
+        idx = quality_index(recs, band=0)
+        assert set(idx) == {("a.h5", 0)}
+        assert idx[("a.h5", 0)]["white_sigma"] == 0.05
+
+
+class TestStackBanded:
+    def test_all_none_is_none(self):
+        assert stack_banded([None, None]) is None
+
+    def test_none_bands_become_zero_blocks(self):
+        b, _ = build_banded_weight([_group(n_samples=800)], [_fit()],
+                                   80, L)
+        stacked = stack_banded([b, None])
+        c0, cs = stacked
+        assert c0.shape == (2, 80) and cs.shape == (2, 4, 80)
+        np.testing.assert_array_equal(c0[0], b[0])
+        assert (c0[1] == 0).all() and (cs[1] == 0).all()
+
+    def test_geometry_mismatch_raises(self):
+        a, _ = build_banded_weight([_group(n_samples=800)], [_fit()],
+                                   80, L)
+        b, _ = build_banded_weight([_group(n_samples=800)], [_fit()],
+                                   100, L)
+        with pytest.raises(ValueError, match="geometry"):
+            stack_banded([a, b])
+
+
+def _matched_1f_problem(T=8_000, nx=16, seed=0):
+    """The bench fixture: sky raster + correlated noise drawn from the
+    SAME per-sample PSD the quality fit reports, inverse-variance
+    weights (only then does the prior normalization balance)."""
+    rng = np.random.default_rng(seed)
+    npix = nx * nx
+    pix = ((np.arange(T) * 7) % npix).astype(np.int64)
+    sky = rng.normal(0, 1.0, npix).astype(np.float32)
+    sigma, fknee, alpha = 0.05, 1.0, -1.5
+    freqs = np.fft.rfftfreq(T, d=1.0 / FS)
+    psd = np.zeros_like(freqs)
+    psd[1:] = sigma ** 2 * (freqs[1:] / fknee) ** alpha
+    amp = np.sqrt(psd * T * FS / 2.0) / np.sqrt(FS)
+    ph = rng.normal(size=freqs.size) + 1j * rng.normal(size=freqs.size)
+    corr = np.fft.irfft(amp * ph, n=T).astype(np.float32)
+    tod = (sky[pix] + corr
+           + sigma * rng.normal(size=T).astype(np.float32)
+           ).astype(np.float32)
+    w = np.full(T, 1.0 / sigma ** 2, np.float32)
+    groups = [{"file": "synthetic.h5", "feed": 0, "sample_rate": FS,
+               "n_samples": T}]
+    quality = [_fit("synthetic.h5", 0, sigma=sigma, fknee=fknee,
+                    alpha=alpha)]
+    return pix, tod, w, sky, npix, groups, quality
+
+
+class TestSolve:
+    def test_zero_prior_is_white_parity(self):
+        """A zero (c0, cs) operand adds exact zeros in the matvec —
+        same iterate sequence, same count, same offsets as omitting
+        the kwarg (the numeric half of the byte-identical-program
+        parity rule)."""
+        import jax.numpy as jnp
+
+        from comapreduce_tpu.mapmaking.destriper import destripe_planned
+        from comapreduce_tpu.mapmaking.pointing_plan import (
+            build_pointing_plan)
+
+        pix, tod, w, _, npix, _, _ = _matched_1f_problem(T=2_000)
+        plan = build_pointing_plan(pix, npix, L)
+        n_off = tod.size // L
+        r_white = destripe_planned(jnp.asarray(tod), jnp.asarray(w),
+                                   plan=plan, n_iter=300,
+                                   threshold=1e-8)
+        r_zero = destripe_planned(
+            jnp.asarray(tod), jnp.asarray(w), plan=plan, n_iter=300,
+            threshold=1e-8,
+            banded=(jnp.zeros(n_off, jnp.float32),
+                    jnp.zeros((4, n_off), jnp.float32)))
+        assert int(r_zero.n_iter) == int(r_white.n_iter)
+        np.testing.assert_allclose(np.asarray(r_zero.offsets),
+                                   np.asarray(r_white.offsets),
+                                   rtol=0, atol=1e-6)
+
+    def test_banded_beats_white_on_matched_1f(self):
+        """The headline claim: with noise drawn from the fitted PSD and
+        inverse-variance weights, the banded prior converges in fewer
+        CG iterations AND lands closer to the injected sky."""
+        import jax.numpy as jnp
+
+        from comapreduce_tpu.mapmaking.destriper import destripe_planned
+        from comapreduce_tpu.mapmaking.pointing_plan import (
+            build_pointing_plan)
+
+        pix, tod, w, sky, npix, groups, quality = _matched_1f_problem()
+        n_off = tod.size // L
+        banded, report = build_banded_weight(groups, quality, n_off, L)
+        assert report["banded"] == 1
+        plan = build_pointing_plan(pix, npix, L)
+        tod_j, w_j = jnp.asarray(tod), jnp.asarray(w)
+
+        def map_err(r):
+            hit = np.asarray(r.hit_map) > 0
+            d = np.asarray(r.destriped_map)[hit] - sky[hit]
+            return float(np.sqrt(np.mean((d - d.mean()) ** 2)))
+
+        r_white = destripe_planned(tod_j, w_j, plan=plan, n_iter=500,
+                                   threshold=1e-8)
+        r_band = destripe_planned(tod_j, w_j, plan=plan, n_iter=500,
+                                  threshold=1e-8,
+                                  banded=(jnp.asarray(banded[0]),
+                                          jnp.asarray(banded[1])))
+        assert float(r_band.residual) < 1e-8
+        assert int(r_band.n_iter) < int(r_white.n_iter)
+        assert map_err(r_band) < map_err(r_white)
+
+
+class TestParseKnob:
+    def _parse(self, destr):
+        from comapreduce_tpu.cli.run_destriper import (
+            parse_destriper_section)
+
+        return parse_destriper_section(destr)[5]
+
+    def test_default_is_white(self):
+        assert self._parse({}) is None
+        assert self._parse({"noise_weight": "white"}) is None
+
+    def test_banded_resolves_bandwidth(self):
+        assert self._parse({"noise_weight": "banded"}) == {
+            "bandwidth": 4}
+        assert self._parse({"noise_weight": "banded",
+                            "noise_bandwidth": 6}) == {"bandwidth": 6}
+
+    def test_typo_raises(self):
+        with pytest.raises(ValueError, match="white|banded"):
+            self._parse({"noise_weight": "toeplitz"})
+
+    def test_bandwidth_under_white_raises(self):
+        with pytest.raises(ValueError, match="noise_bandwidth"):
+            self._parse({"noise_bandwidth": 3})
+
+    def test_bandwidth_floor_raises(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            self._parse({"noise_weight": "banded",
+                         "noise_bandwidth": 0})

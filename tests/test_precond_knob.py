@@ -172,17 +172,19 @@ def test_parse_destriper_section():
     from comapreduce_tpu.cli.run_destriper import parse_destriper_section
 
     # absent section: the legacy [Inputs] coarse_precond default stands
+    # (trailing None = noise_weight stays white; see test_noise_weight
+    # for the banded parse surface)
     assert parse_destriper_section({}, 8) \
-        == ("jacobi", 8, None, None, "auto")
+        == ("jacobi", 8, None, None, "auto", None)
     assert parse_destriper_section({"preconditioner": "none"}, 8) \
-        == ("none", 0, None, None, "auto")
+        == ("none", 0, None, None, "auto", None)
     assert parse_destriper_section({"preconditioner": "jacobi"}, 8) \
-        == ("jacobi", 0, None, None, "auto")
+        == ("jacobi", 0, None, None, "auto", None)
     assert parse_destriper_section({"preconditioner": "twolevel"}, 0) \
-        == ("jacobi", 8, None, None, "auto")
+        == ("jacobi", 8, None, None, "auto", None)
     assert parse_destriper_section(
         {"preconditioner": "twolevel", "coarse_block": 16}, 0) \
-        == ("jacobi", 16, None, None, "auto")
+        == ("jacobi", 16, None, None, "auto", None)
     assert parse_destriper_section({"pair_batch": 4}, 0)[2] == 4
     assert parse_destriper_section({"pair_batch": "auto"}, 0)[2] is None
     # kernels knob (PR 11): parsed, normalised, typos raise
@@ -194,12 +196,12 @@ def test_parse_destriper_section():
     # multigrid: jacobi at the solver level + the mg config dict
     assert parse_destriper_section({"preconditioner": "multigrid"}, 8) \
         == ("jacobi", 0, None, {"levels": 2, "smooth": 1, "block": 8},
-            "auto")
+            "auto", None)
     assert parse_destriper_section(
         {"preconditioner": "multigrid", "mg_levels": 3, "mg_smooth": 2,
          "mg_block": 4}, 0) \
         == ("jacobi", 0, None, {"levels": 3, "smooth": 2, "block": 4},
-            "auto")
+            "auto", None)
     # mg knobs without multigrid selected: silent-drop forbidden
     with pytest.raises(ValueError, match="mg_levels"):
         parse_destriper_section({"mg_levels": 3}, 0)

@@ -76,6 +76,25 @@ class TestRecordAndAnalyze:
         assert reg.seen("t.triple", prog.shape_bucket(x))
         assert reg.record_jit("t.triple", fn, x) is None
 
+    def test_kernels_separates_registry_keys(self, registry):
+        """ISSUE 19 bugfix: the xla and pallas compiles of one
+        (name, bucket, precision) triple are DIFFERENT programs — one
+        shared key let the last writer corrupt the HBM baseline."""
+        reg, tmp = registry
+        compiled = jax.jit(lambda x: x + 1.0).lower(
+            jnp.zeros(64, jnp.float32)).compile()
+        assert reg.record("d.mg", compiled, shape_bucket="f32[64]",
+                          precision_id="f32", kernels="xla") is not None
+        # same triple, different resolved implementation: NOT a dupe
+        assert reg.record("d.mg", compiled, shape_bucket="f32[64]",
+                          precision_id="f32",
+                          kernels="pallas") is not None
+        assert reg.record("d.mg", compiled, shape_bucket="f32[64]",
+                          precision_id="f32", kernels="xla") is None
+        on_disk = prog.read_programs(str(tmp))
+        assert len(on_disk) == 2
+        assert {r.get("kernels") for r in on_disk} == {"xla", "pallas"}
+
     def test_disabled_registry_is_inert(self, tmp_path):
         assert not prog.PROGRAMS.enabled
         compiled = jax.jit(lambda x: x).lower(
@@ -151,6 +170,24 @@ class TestHBMGate:
         base = {self._key(): 1500}
         assert prog.hbm_regressions(
             [self._rec(temp=0, out=0)], base) == []
+
+    def test_kernels_key_suffix_only_when_set(self):
+        # legacy records (no kernels field) keep their committed keys
+        assert prog.program_key("a", "b", "c") == "a|b|c"
+        assert prog.program_key("a", "b", "c",
+                                "xla") == "a|b|c|kernels=xla"
+
+    def test_kernels_scopes_hbm_baseline(self):
+        """An xla-keyed baseline must not gate (or be overwritten by)
+        the pallas compile of the same program."""
+        base = {prog.program_key("destriper.mg", "f32[8]", "f32",
+                                 "xla"): 1500}
+        rec_pallas = {**self._rec(temp=9000, out=500),
+                      "kernels": "pallas"}
+        assert prog.hbm_regressions([rec_pallas], base) == []
+        rec_xla = {**self._rec(temp=9000, out=500), "kernels": "xla"}
+        fails = prog.hbm_regressions([rec_xla], base)
+        assert len(fails) == 1 and "kernels=xla" in fails[0]
 
 
 def test_roofline_report_selftest_green():

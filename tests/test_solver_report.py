@@ -1,0 +1,195 @@
+"""``tools/solver_report.py --registry`` (ISSUE 19): the
+preconditioner-effectiveness deltas of a traced run's iteration counts
+against the trailing run-registry window — fixture covers converged,
+stalled, and diverged rungs plus the trailing-median arithmetic the
+campaign trend alerts hang off."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.solver_report import (main, registry_deltas,  # noqa: E402
+                                 run_report, summarize_solver)
+
+
+def _write_band(path, name, resid_fn, n, precond="jacobi",
+                threshold=1e-6):
+    """One band's iteration records + summary through the REAL
+    append/read path (the selftest idiom)."""
+    from comapreduce_tpu.telemetry.solver_trace import (append_solver,
+                                                        solve_summary)
+
+    recs = []
+    best = float("inf")
+    for k in range(n):
+        r = resid_fn(k)
+        recs.append({"schema": 1, "kind": "iteration", "band": name,
+                     "iter": k, "residual": r, "rr": r * r,
+                     "alpha": 1.0, "beta": 0.1,
+                     "precond_id": f"{precond}|L50",
+                     "precision_id": "tod=f32|cgdot=f32",
+                     "threshold": threshold, "rank": 0,
+                     "diverging": r > 100.0 * best})
+        best = min(best, r)
+    recs.append(solve_summary(
+        recs, band=name, n_iter=n, residual=resid_fn(n - 1),
+        diverged=any(r["diverging"] for r in recs),
+        precond_id=f"{precond}|L50",
+        precision_id="tod=f32|cgdot=f32", threshold=threshold,
+        base=0, rank=0))
+    append_solver(path, recs)
+
+
+@pytest.fixture
+def traced_run(tmp_path):
+    """A multi-rung trace: a converged sharded-multigrid solve (40
+    iters), a stalled jacobi one (60), a diverged twolevel one (10) —
+    mean n_iter is (40 + 60 + 10) / 3."""
+    from comapreduce_tpu.telemetry.solver_trace import solver_path
+
+    log_dir = tmp_path / "logs"
+    log_dir.mkdir()
+    path = solver_path(str(log_dir), 0)
+    _write_band(path, "band0", lambda k: 10.0 ** (-0.2 * k), 40,
+                precond="multigrid-sharded")
+    _write_band(path, "band1",
+                lambda k: max(1e-3, 10.0 ** (-0.5 * k)), 60)
+    _write_band(path, "band2",
+                lambda k: 1e-3 * (10.0 ** k if k > 6
+                                  else 10.0 ** (-0.1 * k)), 10,
+                precond="twolevel")
+    return str(log_dir)
+
+
+@pytest.fixture
+def registry(tmp_path):
+    """Six perf_gate records with *cg_iters* metrics — one more than
+    the default trailing window, so window truncation is observable.
+    The oldest record carries outlier values that would move every
+    median were it not dropped."""
+    from comapreduce_tpu.telemetry.registry import record_run
+
+    path = str(tmp_path / "runs.jsonl")
+    rows = [{"sharded_mg_cg_iters": 400, "banded_cg_iters": 900},
+            {"sharded_mg_cg_iters": 40, "banded_cg_iters": 28},
+            {"sharded_mg_cg_iters": 42, "banded_cg_iters": 30},
+            {"sharded_mg_cg_iters": 44, "banded_cg_iters": 26},
+            {"sharded_mg_cg_iters": 38, "banded_cg_iters": 32},
+            {"sharded_mg_cg_iters": 41, "banded_cg_iters": 29,
+             "wall_s": 3.5, "note": "not-a-number"}]
+    for m in rows:
+        record_run("perf_gate", m, path=path)
+    return path
+
+
+class TestRegistryDeltas:
+    def test_trailing_median_math(self, traced_run, registry):
+        from comapreduce_tpu.telemetry.solver_trace import read_solver
+
+        summary = summarize_solver(read_solver(traced_run))
+        out = registry_deltas(summary, registry, window=5)
+        # mean of the three solves' n_iter
+        assert out["current_mean_iters"] == pytest.approx(110 / 3)
+        assert out["window"] == 5
+        # trailing 5 only: the 400/900 outlier record is outside the
+        # window and must not move the medians
+        mg = out["metrics"]["sharded_mg_cg_iters"]
+        vals = sorted([40, 42, 44, 38, 41])
+        assert mg["registry_median"] == vals[len(vals) // 2] == 41
+        assert mg["ratio"] == round((110 / 3) / 41, 3)
+        bd = out["metrics"]["banded_cg_iters"]
+        assert bd["registry_median"] == sorted([28, 30, 26, 32,
+                                                29])[2] == 29
+        # non-cg_iters and non-numeric metrics never become rows
+        assert set(out["metrics"]) == {"sharded_mg_cg_iters",
+                                       "banded_cg_iters"}
+
+    def test_window_one_takes_latest(self, traced_run, registry):
+        from comapreduce_tpu.telemetry.solver_trace import read_solver
+
+        summary = summarize_solver(read_solver(traced_run))
+        out = registry_deltas(summary, registry, window=1)
+        assert out["metrics"]["sharded_mg_cg_iters"][
+            "registry_median"] == 41
+
+    def test_empty_registry_is_empty(self, traced_run, tmp_path):
+        from comapreduce_tpu.telemetry.solver_trace import read_solver
+
+        summary = summarize_solver(read_solver(traced_run))
+        empty = str(tmp_path / "none.jsonl")
+        assert registry_deltas(summary, empty) == {}
+
+    def test_no_cg_metrics_is_empty(self, traced_run, tmp_path):
+        from comapreduce_tpu.telemetry.registry import record_run
+        from comapreduce_tpu.telemetry.solver_trace import read_solver
+
+        path = str(tmp_path / "runs.jsonl")
+        record_run("perf_gate", {"wall_s": 1.0}, path=path)
+        summary = summarize_solver(read_solver(traced_run))
+        assert registry_deltas(summary, path) == {}
+
+    def test_zero_median_yields_null_ratio(self, traced_run, tmp_path):
+        from comapreduce_tpu.telemetry.registry import record_run
+        from comapreduce_tpu.telemetry.solver_trace import read_solver
+
+        path = str(tmp_path / "runs.jsonl")
+        record_run("perf_gate", {"stalled_cg_iters": 0}, path=path)
+        summary = summarize_solver(read_solver(traced_run))
+        out = registry_deltas(summary, path)
+        assert out["metrics"]["stalled_cg_iters"]["ratio"] is None
+
+
+class TestSummaryStates:
+    def test_rung_states_and_sharded_label(self, traced_run):
+        """The fixture's three rungs land in their three states, and
+        the ``-sharded`` suffix keys its own rung (a sharded multigrid
+        regression must not hide inside the single-device series)."""
+        from comapreduce_tpu.telemetry.solver_trace import read_solver
+
+        summary = summarize_solver(read_solver(traced_run))
+        by_band = {b["band"]: b for b in summary["bands"]}
+        assert by_band["band0"]["converged"]
+        assert (by_band["band1"]["stalled"]
+                or by_band["band1"]["tail_stalled"])
+        assert not by_band["band1"]["converged"]
+        assert by_band["band2"]["diverged"]
+        rungs = summary["preconditioners"]
+        assert rungs["multigrid-sharded"]["iters"] == 40
+        assert rungs["multigrid-sharded"]["converged"] == 1
+        assert rungs["twolevel"]["diverged"] == 1
+        assert "multigrid" not in rungs  # suffix keys a separate rung
+
+
+class TestEndToEnd:
+    def test_run_report_json_carries_deltas(self, traced_run, registry,
+                                            capsys):
+        assert run_report(traced_run, as_json=True,
+                          registry=registry) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["registry"]["metrics"]["sharded_mg_cg_iters"][
+            "registry_median"] == 41
+        assert len(doc["summary"]["bands"]) == 3
+
+    def test_cli_window_flag(self, traced_run, registry, capsys):
+        assert main([traced_run, "--json", "--registry", registry,
+                     "--window", "1"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["registry"]["window"] == 1
+
+    def test_registry_none_disables(self, traced_run, capsys):
+        assert run_report(traced_run, as_json=True,
+                          registry="none") == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["registry"] is None
+
+    def test_human_report_renders_deltas(self, traced_run, registry,
+                                         capsys):
+        assert run_report(traced_run, registry=registry) == 0
+        text = capsys.readouterr().out
+        assert "vs run registry" in text
+        assert "sharded_mg_cg_iters" in text

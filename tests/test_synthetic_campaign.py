@@ -69,6 +69,59 @@ class TestScenarioStrictness:
         assert back == cfg  # every knob survives the round trip
 
 
+class TestVanePadTrap:
+    """ISSUE 19 bugfix: a vane window pad >= gap_samples on a
+    fault-injecting scenario zeroes every Level-2 weight mid-campaign;
+    it must fail at scenario load instead."""
+
+    def test_faulted_pad_past_gap_raises(self):
+        cfg = _tiny(gap_samples=24, spike_rate=0.01)
+        with pytest.raises(ValueError, match="gap_samples"):
+            cfg.validate_vane_pad(24)
+        with pytest.raises(ValueError, match="vane window pad"):
+            _tiny(gap_samples=24, nan_rate=0.01).validate_vane_pad(50)
+
+    def test_fault_free_pad_past_gap_passes(self):
+        # the transfer scenario runs gap=40 under pad=50 by design
+        cfg = _tiny(gap_samples=24)
+        assert cfg.validate_vane_pad(50) is cfg
+
+    def test_pad_within_gap_passes_even_faulted(self):
+        cfg = _tiny(gap_samples=24, spike_rate=0.01, nan_rate=0.01)
+        assert cfg.validate_vane_pad(23) is cfg
+
+    def test_no_vane_windows_passes(self):
+        cfg = _tiny(vane_samples=0, gap_samples=8, spike_rate=0.01)
+        assert cfg.validate_vane_pad(50) is cfg
+
+    def test_load_scenario_threads_pad_with_path_prefix(self, tmp_path):
+        p = tmp_path / "faulted.toml"
+        p.write_text('[scenario]\nname = "x"\ngap_samples = 10\n'
+                     'spike_rate = 0.01\n')
+        with pytest.raises(ValueError, match="faulted.toml.*gap_samples"):
+            load_scenario(str(p), vane_pad=30)
+        # without the consumer's pad the trap cannot (and must not) fire
+        assert load_scenario(str(p)).gap_samples == 10
+
+    def test_register_scenario_file_threads_pad(self, tmp_path):
+        p = tmp_path / "faulted.toml"
+        p.write_text('[scenario]\nname = "x"\ngap_samples = 10\n'
+                     'nan_rate = 0.01\n')
+        with pytest.raises(ValueError, match="gap_samples"):
+            memsource.register_scenario_file(str(p), vane_pad=30)
+        assert memsource.registered("x") is None  # nothing registered
+
+    def test_scale_scenario_clears_worker_pad(self):
+        """The drill's own scenario must stay on the passing side of
+        its own trap (loadgen pins _VANE_PAD for every worker)."""
+        from comapreduce_tpu.synthetic.loadgen import (_VANE_PAD,
+                                                       scale_scenario)
+
+        cfg = scale_scenario(seed=0, n_files=4)
+        assert cfg.spike_rate > 0 and cfg.nan_rate > 0
+        assert cfg.validate_vane_pad(_VANE_PAD) is cfg
+
+
 # ------------------------------------------------------------ determinism
 class TestByteDeterminism:
     def test_same_seed_byte_identical_on_disk(self, tmp_path):

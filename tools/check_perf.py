@@ -111,6 +111,24 @@ cross-checks the solver trace: the per-iteration records written to
 ``solver.rank0.jsonl`` must match the solve's reported iteration count
 EXACTLY (both come from the same dispatch).
 
+The sharded-solver gates (ISSUE 19) also run by default: one
+``bench.py --config destriper-sharded`` child (forced multi-device CPU
+mesh) must show (a) the NATIVE sharded multigrid program converging in
+strictly fewer iterations than sharded twolevel and within 10% of the
+single-device count on the same fixture (the rung that used to fall
+back with a warning), with its per-iteration solver-trace records
+matching the reported count exactly, and (b) measured-noise banded
+weighting beating white on both iterations and map RMS on a matched
+1/f fixture with sharded-vs-single offset parity under 1e-5. An
+in-process builder check then pins EXACT white parity: a
+white-noise-only scenario must yield no banded operand at all (kwarg
+omitted -> byte-identical compiled program), every fallback ledgered
+with its reason. All iteration/count/parity comparisons of
+deterministic fixtures — machine-independent; the iteration rungs are
+recorded to the run registry (``*_cg_iters`` — the series
+``solver_report.py --registry`` deltas against). ``--no-sharded``
+skips.
+
 The transfer-function gate (ISSUE 16) also runs by default,
 in-process: for each of ``--transfer-seeds`` seeds (default 3) a
 synthetic calibrator campaign with a KNOWN injected sky is generated
@@ -212,6 +230,68 @@ def run_destriper_bench() -> dict:
         if rec.get("metric") == "destriper_cg_iters_to_tol":
             return rec
     raise RuntimeError("no destriper result line in bench.py output")
+
+
+def run_sharded_bench() -> dict | None:
+    """One small-shape sharded-destriper bench child -> its parsed JSON
+    line, or None when the host cannot present >= 2 devices (the bench
+    exits 3 then — a single-accelerator box without a CPU fallback; the
+    gate records the skip instead of failing a box that cannot run the
+    program class)."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_SMALL": "1",
+        "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
+        "BENCH_EVIDENCE": "0",
+    })
+    # the bench forces a multi-device CPU mesh pre-jax-import when the
+    # platform is CPU; pin CPU here so the gate's iteration ORDERING
+    # stays machine-independent (counts, never wall clocks)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                          "--config", "destriper-sharded"],
+                         env=env, capture_output=True, text=True, cwd=REPO)
+    if out.returncode == 3:
+        return None
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py --config destriper-sharded failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == "destriper_sharded_mg_iters_to_tol":
+            return rec
+    raise RuntimeError("no destriper-sharded result line in bench.py "
+                       "output")
+
+
+def banded_white_parity_check() -> dict:
+    """In-process half of the banded gate (ISSUE 19): on a
+    white-noise-only scenario — quality fits with no usable correlated
+    power — ``build_banded_weight`` must return ``None`` with every
+    fallback ledgered, so the solve omits the kwarg and runs the
+    byte-identical white program (exact parity by construction, no
+    tolerance). Pure numpy; no jax, no bench child."""
+    from comapreduce_tpu.mapmaking.noise_weight import build_banded_weight
+
+    groups = [{"file": "white_a.h5", "feed": 0, "sample_rate": 50.0,
+               "n_samples": 1000},
+              {"file": "white_b.h5", "feed": 1, "sample_rate": 50.0,
+               "n_samples": 1000}]
+    # one fit with the knee below the resolvable offset-rate band, one
+    # file with no fit at all — the two ways a white-noise campaign
+    # presents to the builder
+    quality = [{"file": "white_a.h5", "feed": 0, "band": 0,
+                "white_sigma": 0.05, "fknee_hz": 1e-6, "alpha": -1.5,
+                "flagged": False}]
+    banded, report = build_banded_weight(groups, quality, 200, 10,
+                                         band=0)
+    return {"banded_is_none": banded is None,
+            "reasons": sorted(f["reason"]
+                              for f in report["fallbacks"]),
+            "report": report}
 
 
 def run_kernels_bench() -> dict:
@@ -529,7 +609,8 @@ def programs_baseline(records: list) -> dict:
         if hbm > 0:
             out[program_key(rec.get("name", ""),
                             rec.get("shape_bucket", ""),
-                            rec.get("precision_id", ""))] = int(hbm)
+                            rec.get("precision_id", ""),
+                            rec.get("kernels", ""))] = int(hbm)
     return out
 
 
@@ -584,6 +665,10 @@ def main(argv=None) -> int:
                     help="skip the compiled-program HBM gate (rides "
                          "the destriper bench; --no-destriper also "
                          "skips it)")
+    ap.add_argument("--no-sharded", action="store_true",
+                    help="skip the sharded-solver gates (sharded "
+                         "multigrid iteration ordering + banded-weight "
+                         "white parity)")
     ap.add_argument("--no-registry", action="store_true",
                     help="do not append this gate run to the run "
                          "registry (evidence/runs.jsonl)")
@@ -780,7 +865,8 @@ def main(argv=None) -> int:
                     base = (json.load(f) or {}).get("programs", {})
                 cur_keys = {program_key(r.get("name", ""),
                                         r.get("shape_bucket", ""),
-                                        r.get("precision_id", ""))
+                                        r.get("precision_id", ""),
+                                        r.get("kernels", ""))
                             for r in progs}
                 hbm_fails = hbm_regressions(progs, base)
                 failures.extend(hbm_fails)
@@ -794,6 +880,94 @@ def main(argv=None) -> int:
                 destriper["programs_gate"] = {
                     "skipped": f"no committed baseline {pref}; run "
                                "tools/check_perf.py --update"}
+    sharded = None
+    if not args.no_sharded:
+        # both halves machine-independent (ISSUE 19): iteration-count
+        # orderings of solves on one deterministic fixture, and an
+        # exact-parity-by-construction builder check — never wall clocks
+        s = run_sharded_bench()
+        if s is None:
+            sharded = {"skipped": "host cannot present >= 2 devices"}
+        else:
+            d = s["detail"]
+            ladder = d["ladder"]
+            banded = d["banded"]
+            sharded = {
+                "n_shards": d.get("n_shards"),
+                "iters": {k: v.get("iters_to_tol")
+                          for k, v in ladder.items()},
+                "parity_max_offset_diff":
+                    d["parity"]["max_offset_diff"],
+                "solver_trace": {k: (d.get("solver_trace") or {}).get(k)
+                                 for k in ("iteration_records",
+                                           "reported_iters", "match")},
+                "banded": {"white_iters": banded["white"]["iters"],
+                           "banded_iters": banded["banded"]["iters"],
+                           "white_err": banded["white"]["map_rms_err"],
+                           "banded_err": banded["banded"]["map_rms_err"],
+                           "sharded_parity_max_diff":
+                               banded["sharded_parity_max_diff"]},
+            }
+            it = sharded["iters"]
+            if it.get("sharded_multigrid") is None:
+                failures.append(
+                    "sharded: the native sharded multigrid program did "
+                    "not reach tolerance within the iteration budget — "
+                    "the rung the fallback deletion promised is broken")
+            else:
+                if it.get("sharded_twolevel") is not None \
+                        and it["sharded_multigrid"] \
+                        >= it["sharded_twolevel"]:
+                    failures.append(
+                        f"sharded: multigrid iterations "
+                        f"({it['sharded_multigrid']}) not strictly below "
+                        f"sharded twolevel ({it['sharded_twolevel']}) — "
+                        "the psum-threaded V-cycle stopped out-earning "
+                        "the rung it replaced as the fallback")
+                single = it.get("single_multigrid")
+                if single and it["sharded_multigrid"] > 1.1 * single:
+                    failures.append(
+                        f"sharded: multigrid took "
+                        f"{it['sharded_multigrid']} iterations sharded "
+                        f"vs {single} on one device (> 10% — the "
+                        "level-0 psum no longer assembles the same "
+                        "coarse operator)")
+            if not (d.get("solver_trace") or {}).get("match"):
+                failures.append(
+                    "sharded: the traced sharded solve's per-iteration "
+                    "records do not match its reported count — the "
+                    "psum'd trace dots broke under shard_map")
+            b = sharded["banded"]
+            if b["banded_iters"] >= b["white_iters"] \
+                    or b["banded_err"] >= b["white_err"]:
+                failures.append(
+                    f"sharded: banded weighting on the matched 1/f "
+                    f"fixture — {b['banded_iters']} iters / "
+                    f"{b['banded_err']} map RMS vs white's "
+                    f"{b['white_iters']} / {b['white_err']} — the "
+                    "measured-noise prior stopped earning its band")
+            if b["sharded_parity_max_diff"] > 1e-5:
+                failures.append(
+                    f"sharded: banded sharded-vs-single offset drift "
+                    f"{b['sharded_parity_max_diff']:.3g} > 1e-5 — a "
+                    "prior coupling crossed a shard boundary (the "
+                    "no-halo zeroing contract broke)")
+        # white-noise parity half: a campaign with no usable correlated
+        # power must yield NO banded operand at all (kwarg omitted ->
+        # byte-identical white program), with every fallback ledgered
+        wp = banded_white_parity_check()
+        sharded["white_parity"] = wp
+        if not wp["banded_is_none"]:
+            failures.append(
+                "sharded: build_banded_weight returned a banded operand "
+                "on a white-noise-only scenario — exact white parity by "
+                "kwarg omission is broken")
+        if wp["reasons"] != ["absent", "fknee_low"]:
+            failures.append(
+                f"sharded: white-noise fallbacks ledgered as "
+                f"{wp['reasons']}, expected ['absent', 'fknee_low'] — "
+                "the per-file fallback reasons drifted")
+
     serving = None
     if not args.no_serving:
         # machine-independent like the campaign gate: the warm epoch's
@@ -996,15 +1170,31 @@ def main(argv=None) -> int:
         # feeds it even when it fails — ok:false is itself a signal
         from comapreduce_tpu.telemetry.registry import record_run
 
-        record_run("perf_gate", {
+        metrics = {
             "tod_samples_per_s": cur["value"],
             "dispatch_count": cur["dispatch_count"] or 0,
             "gate_failures": len(failures),
-        }, ok=not failures, extra={"platform": platform})
+        }
+        if sharded and "iters" in sharded:
+            # *cg_iters* keys feed solver_report.py --registry's
+            # trailing-window deltas — the sharded rungs become part of
+            # the same trend series campaign_watch alerts on
+            it = sharded["iters"]
+            metrics["sharded_mg_cg_iters"] = it.get(
+                "sharded_multigrid") or 0
+            metrics["sharded_twolevel_cg_iters"] = it.get(
+                "sharded_twolevel") or 0
+            metrics["banded_cg_iters"] = \
+                sharded["banded"]["banded_iters"]
+            metrics["banded_white_cg_iters"] = \
+                sharded["banded"]["white_iters"]
+        record_run("perf_gate", metrics, ok=not failures,
+                   extra={"platform": platform})
 
     print(json.dumps({"ok": not failures, "failures": failures,
                       "current": cur, "campaign": campaign,
-                      "destriper": destriper, "serving": serving,
+                      "destriper": destriper, "sharded": sharded,
+                      "serving": serving,
                       "kernels": kernels, "tiles": tiles,
                       "precision": precision, "quality": quality,
                       "transfer": transfer,
