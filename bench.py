@@ -2572,12 +2572,234 @@ def bench_synthetic():
     return 0
 
 
+def bench_tune():
+    """The shape-bucket autotuner A/B (ISSUE 20): sweep cost, tuned-vs-
+    default campaign throughput, and the warm-cache promise, on real
+    jitted destriper programs.
+
+    Three legs (``BENCH_r10.json``, the round-11 ROOFLINE artifact):
+
+    - **cold sweep**: for two distinct (N, L) shape buckets, tune the
+      ``plan`` group (pair_batch) and the ``solver`` group (mg_block x
+      mg_smooth) by wall-timing the ACTUAL jitted ``destripe_planned``
+      programs under successive halving. Every proposed combo passed the
+      validity rules (``invalid_proposed`` must stay 0 — check_perf
+      gates it);
+    - **campaign A/B**: the same solves run default-config and
+      tuned-config (winners consulted through the REAL plumbing:
+      ``TUNING`` configured + ``build_pointing_plan(pair_batch=None)``
+      + ``TUNING.winner("solver", ...)`` — the run_destriper consult).
+      Tuned throughput must be >= default beyond the noise floor, BY
+      CONSTRUCTION: a winner only replaces the default when it measured
+      ``min_improvement`` faster;
+    - **warm re-run**: a fresh Tuner against the same ``tuning.jsonl``
+      re-tunes every bucket — zero new measurements, one cache hit per
+      bucket (the memoisation promise, also gated).
+
+    The amortization curve prices the sweep: cumulative campaign
+    seconds for n runs, default vs sweep + tuned.
+
+    ``BENCH_SMALL=1`` shrinks the fixtures (CI smoke / the check_perf
+    child). The winners cache lives in a temp dir — the bench never
+    writes ``tuning.jsonl`` into the repo.
+    """
+    import math
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from comapreduce_tpu.mapmaking.destriper import (
+        build_multigrid_hierarchy, destripe_planned)
+    from comapreduce_tpu.mapmaking.pointing_plan import build_pointing_plan
+    from comapreduce_tpu.ops.reduce import device_hbm_bytes
+    from comapreduce_tpu.tuning.cache import (TUNING, TuningConfig,
+                                              TuningCache, tuning_path,
+                                              _backend_identity)
+    from comapreduce_tpu.tuning.space import (SpaceContext, plan_bucket,
+                                              solver_bucket)
+    from comapreduce_tpu.tuning.tuner import Tuner
+
+    small = os.environ.get("BENCH_SMALL", "") == "1"
+    n_iter, threshold = 60, 1e-6
+    n_runs = 2 if small else 4        # timed campaign passes per leg
+    fixtures = []
+    for seed, T, nx, L in ((0, 8_000 if small else 60_000,
+                            24 if small else 48, 50),
+                           (1, 6_400 if small else 40_000,
+                            16 if small else 32, 64)):
+        pix, tod, w, npix, _ = weight_spread_raster(seed=seed, T=T,
+                                                    nx=nx, L=L)
+        fixtures.append({"pix": pix, "npix": npix, "L": int(L),
+                         "N": int(pix.size),
+                         "tod": jnp.asarray(tod), "w": jnp.asarray(w),
+                         "w_np": w})
+
+    platform, device_kind = _backend_identity()
+    hbm = device_hbm_bytes()
+    tmp = tempfile.mkdtemp(prefix="bench_tune_")
+    cache = TuningCache(tuning_path(tmp))
+    cfg = TuningConfig(enabled=True, max_candidates=6,
+                       repeats=2 if small else 3)
+    tuner = Tuner(cache, platform, device_kind,
+                  max_candidates=cfg.max_candidates, repeats=cfg.repeats,
+                  min_improvement=cfg.min_improvement)
+
+    def solve_thunk(fx, pair_batch, mg_block, mg_smooth):
+        """One jitted solve of this fixture under the given knobs; the
+        returned thunk blocks (the wall time is the program's)."""
+        plan = build_pointing_plan(fx["pix"], fx["npix"], fx["L"],
+                                   pair_batch=int(pair_batch))
+        hier = build_multigrid_hierarchy(fx["pix"], fx["w_np"],
+                                         fx["npix"], fx["L"],
+                                         block=int(mg_block), levels=2)
+        fn = jax.jit(functools.partial(destripe_planned, plan=plan,
+                                       n_iter=n_iter,
+                                       threshold=threshold,
+                                       mg_smooth=int(mg_smooth)))
+
+        def thunk():
+            jax.block_until_ready(fn(fx["tod"], fx["w"], mg=hier).offsets)
+
+        return thunk
+
+    # ---- cold sweep: 2 groups x 2 buckets, real programs ----------------
+    t_sweep = time.perf_counter()
+    winners: dict = {}
+    for fx in fixtures:
+        ctx = SpaceContext(F=1, B=1, C=1, T=fx["N"], S=1, L=fx["L"],
+                           n_samples=fx["N"], offset_length=fx["L"],
+                           platform=platform, hbm_bytes=hbm)
+        rec_p = tuner.tune(
+            "plan", plan_bucket(fx["N"], fx["L"]), ctx,
+            lambda combo, fx=fx: solve_thunk(fx, combo["pair_batch"],
+                                             8, 1),
+            {"pair_batch": 1})
+        rec_s = tuner.tune(
+            "solver", solver_bucket(fx["L"]), ctx,
+            lambda combo, fx=fx, rec_p=rec_p: solve_thunk(
+                fx, rec_p["winner"]["pair_batch"], combo["mg_block"],
+                combo["mg_smooth"]),
+            {"mg_block": 8, "mg_smooth": 1})
+        winners[f"L={fx['L']}|N={fx['N']}"] = {
+            "plan": rec_p["winner"], "solver": rec_s["winner"]}
+    sweep = {"wall_s": round(time.perf_counter() - t_sweep, 3),
+             "measurements": tuner.measurements,
+             "invalid_proposed": tuner.invalid_proposed,
+             "pruned": tuner.pruned, "winners": winners}
+
+    # ---- campaign A/B: default config vs tuned-consult plumbing ---------
+    def campaign_leg() -> float:
+        """One full campaign pass over both buckets through the REAL
+        consult path: auto pair_batch (build_pointing_plan asks TUNING
+        when enabled) + the destriper CLI's solver-winner consult."""
+        fns = []
+        for fx in fixtures:
+            plan = build_pointing_plan(fx["pix"], fx["npix"], fx["L"],
+                                       pair_batch=None)
+            win = TUNING.winner("solver", solver_bucket(fx["L"])) or {}
+            hier = build_multigrid_hierarchy(
+                fx["pix"], fx["w_np"], fx["npix"], fx["L"],
+                block=int(win.get("mg_block", 8)), levels=2)
+            fns.append((jax.jit(functools.partial(
+                destripe_planned, plan=plan, n_iter=n_iter,
+                threshold=threshold,
+                mg_smooth=int(win.get("mg_smooth", 1)))), fx, hier))
+        for fn, fx, hier in fns:                  # absorb compiles
+            jax.block_until_ready(fn(fx["tod"], fx["w"],
+                                     mg=hier).offsets)
+        t0 = time.perf_counter()
+        for _ in range(n_runs):
+            for fn, fx, hier in fns:
+                jax.block_until_ready(fn(fx["tod"], fx["w"],
+                                         mg=hier).offsets)
+        return time.perf_counter() - t0
+
+    total_samples = n_runs * sum(fx["N"] for fx in fixtures)
+    TUNING.close()
+    wall_default = campaign_leg()
+    TUNING.configure(tmp, cfg)
+    try:
+        wall_tuned = campaign_leg()
+        # ---- warm re-run: the memoisation promise -----------------------
+        warm_cache = TuningCache(tuning_path(tmp))
+        warm = Tuner(warm_cache, platform, device_kind,
+                     max_candidates=cfg.max_candidates,
+                     repeats=cfg.repeats)
+        for fx in fixtures:
+            ctx = SpaceContext(F=1, B=1, C=1, T=fx["N"], S=1,
+                               L=fx["L"], n_samples=fx["N"],
+                               offset_length=fx["L"],
+                               platform=platform, hbm_bytes=hbm)
+            warm.tune("plan", plan_bucket(fx["N"], fx["L"]), ctx,
+                      lambda combo: (lambda: None), {"pair_batch": 1})
+            warm.tune("solver", solver_bucket(fx["L"]), ctx,
+                      lambda combo: (lambda: None),
+                      {"mg_block": 8, "mg_smooth": 1})
+    finally:
+        TUNING.close()
+    bucket_count = 2 * len(fixtures)
+
+    saving = wall_default - wall_tuned
+    amortization = {
+        "sweep_wall_s": sweep["wall_s"],
+        "per_campaign_saving_s": round(saving, 3),
+        "campaigns_to_amortize": (math.ceil(sweep["wall_s"] / saving)
+                                  if saving > 1e-9 else None),
+        "curve": [{"campaigns": n,
+                   "default_s": round(n * wall_default, 3),
+                   "swept_s": round(sweep["wall_s"] + n * wall_tuned, 3)}
+                  for n in (1, 2, 5, 10, 20, 50)],
+    }
+    line = {
+        "metric": "tune_campaign_samples_per_s",
+        "value": round(total_samples / max(wall_tuned, 1e-9), 1),
+        "unit": "samples/s",
+        "vs_baseline": round(wall_default / max(wall_tuned, 1e-9), 3),
+        "detail": {
+            "config": "tune",
+            "fixtures": [{"N": fx["N"], "L": fx["L"]}
+                         for fx in fixtures],
+            "bucket_count": bucket_count,
+            "sweep": sweep,
+            "warm": {"measurements": warm.measurements,
+                     "cache_hits": warm.cache_hits,
+                     "buckets_hit": warm.cache_hits},
+            "campaign": {
+                "runs": n_runs, "total_samples": total_samples,
+                "default": {"wall_s": round(wall_default, 3),
+                            "samples_per_s": round(
+                                total_samples
+                                / max(wall_default, 1e-9), 1)},
+                "tuned": {"wall_s": round(wall_tuned, 3),
+                          "samples_per_s": round(
+                              total_samples
+                              / max(wall_tuned, 1e-9), 1)},
+            },
+            "amortization": amortization,
+            "device": platform,
+        },
+    }
+    print(json.dumps(line))
+    if os.environ.get("BENCH_EVIDENCE", "1") != "0":
+        out_root = (os.environ.get("BENCH_EVIDENCE_DIR", "")
+                    or os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(out_root, "BENCH_r10.json"), "w") as f:
+            json.dump(line, f, indent=1)
+    write_evidence("tune", lambda: None, extra=line["detail"],
+                   host_only=True)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
 _CONFIGS = {"1": bench_config1, "2": bench_config2, "4": bench_config4,
             "ingest": bench_ingest, "resilience": bench_resilience,
             "campaign": bench_campaign, "destriper": bench_destriper,
             "destriper-sharded": bench_destriper_sharded,
             "serving": bench_serving, "kernels": bench_kernels,
-            "precision": bench_precision, "synthetic": bench_synthetic}
+            "precision": bench_precision, "synthetic": bench_synthetic,
+            "tune": bench_tune}
 
 
 if __name__ == "__main__":

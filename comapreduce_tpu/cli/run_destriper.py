@@ -547,13 +547,19 @@ def solve_band(data, offset_length=50, n_iter=100, threshold=1e-6,
     precision_id = f"tod={getattr(data.tod, 'dtype', 'f32')}" \
                    f"|cgdot={cg_dot}"
 
+    # the shape-bucket stamp the per-bucket solver policy groups by
+    # (ISSUE 20): offset length + flat sample count — the two axes the
+    # solve's conditioning and cost actually follow
+    bucket_id = f"L={offset_length}|N={int(np.size(data.tod))}"
+
     def _record_trace(res, label):
         if getattr(res, "trace", None) is None:
             return
         solver_trace.record_solve(
             res, band=unit or "band", base=trace_base,
             precond_id=f"{label}|L{offset_length}",
-            precision_id=precision_id, threshold=threshold)
+            precision_id=precision_id, threshold=threshold,
+            bucket=bucket_id)
 
     if sharded:
         import jax
@@ -1410,13 +1416,57 @@ def main(argv=None) -> int:
                 "with flagged quality records",
                 len(filelist) - len(kept), len(filelist))
         filelist = kept
+    # [Tuning] (docs/OPERATIONS.md §21, default OFF): the shape-bucket
+    # autotuner's winners cache. Enabled, every auto-sized knob
+    # downstream — build_pointing_plan's pair_batch, the stage HBM
+    # planner's feed_batch, and the solver policy's mg_block — consults
+    # measured winners from <log_dir>/tuning.jsonl; device_hbm_mb
+    # declares accelerator memory for backends that cannot report it.
+    # Absent section = byte-identical untuned pipeline.
+    from comapreduce_tpu.tuning import TUNING, TuningConfig, \
+        solver_bucket
+
+    tuning_cfg = TuningConfig.coerce(dict(ini.get("Tuning", {}))
+                                     or None)
+    if tuning_cfg.enabled:
+        TUNING.configure(state_dir, tuning_cfg)
+        win = TUNING.winner("solver", solver_bucket(offset_length))
+        if win:
+            # apply measured destriper winners by re-parsing an
+            # overridden copy of [Destriper] (the solver_policy
+            # discipline below) — and only where the operator left the
+            # knob to auto: an explicit config value always wins over
+            # a measurement
+            destr_tuned = dict(destr_sec)
+            applied = []
+            if mg is not None:
+                for knob, val in (("mg_block", win.get("mg_block")),
+                                  ("mg_smooth",
+                                   win.get("mg_smooth"))):
+                    if val and knob not in destr_sec:
+                        destr_tuned[knob] = int(val)
+                        applied.append(f"{knob}={int(val)}")
+            if win.get("kernels") and "kernels" not in destr_sec:
+                destr_tuned["kernels"] = str(win["kernels"])
+                applied.append(f"kernels={win['kernels']}")
+            if applied:
+                logger.warning("[Tuning] applying measured winners "
+                               "for bucket L=%d: %s", offset_length,
+                               ", ".join(applied))
+                precond, coarse_block, pair_batch, mg, kernels, \
+                    noise_weight = parse_destriper_section(
+                        destr_tuned,
+                        int(inputs.get("coarse_precond",
+                                       0 if calibrator else 8)))
     # [Control] solver_policy (docs/OPERATIONS.md §19, default OFF):
     # re-pick preconditioner/mg_block/pair_batch from evidence — this
     # state dir's solver traces, the run-registry iteration delta, and
     # the XLA program cost model — instead of trusting the static
     # [Destriper] knobs for every shape the campaign will see. Every
     # override is an auditable control.decision event; no evidence
-    # leaves the static config byte-for-byte.
+    # leaves the static config byte-for-byte. Rung evidence is folded
+    # PER SHAPE BUCKET (ISSUE 20): only solves stamped with this run's
+    # offset-length bucket argue its rungs.
     from comapreduce_tpu.control.config import ControlConfig
 
     control_cfg = ControlConfig.coerce(dict(ini.get("Control", {}))
@@ -1435,8 +1485,10 @@ def main(argv=None) -> int:
             state_dir,
             static={"preconditioner": rung,
                     "mg_block": mg["block"] if mg else None,
-                    "pair_batch": pair_batch},
-            registry_path=default_registry_path())
+                    "pair_batch": pair_batch,
+                    "offset_length": offset_length},
+            registry_path=default_registry_path(),
+            bucket=f"L={offset_length}")
         for reason in choice.get("reasons", ()):
             logger.warning("[Control] solver_policy: %s", reason)
         overrides = {k: v for k, v in choice.items() if k != "reasons"}

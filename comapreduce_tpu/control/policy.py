@@ -47,14 +47,23 @@ ESCALATE_RATIO = 1.5
 PAIR_TEMP_BUDGET = 2 << 30
 
 
-def rung_health(records: list) -> dict:
+def rung_health(records: list, bucket: str = "") -> dict:
     """Fold solver-trace records into per-preconditioner-rung health:
     ``{rung: {"solves", "iters", "converged", "stalled", "diverged"}}``
     — the same rung key ``tools/solver_report.py`` aggregates by (the
-    first ``|`` segment of ``precond_id``)."""
+    first ``|`` segment of ``precond_id``).
+
+    ``bucket`` restricts the fold to solves whose stamped shape-bucket
+    id starts with the given prefix (ISSUE 20: per-shape-bucket rungs —
+    ``"L=50"`` matches every ``"L=50|N=..."`` stamp). Records without
+    a stamp only count under the unrestricted fold, so evidence from
+    one geometry never argues a rung for another."""
     out: dict = {}
     for rec in records:
         if rec.get("kind") != "solve":
+            continue
+        if bucket and not str(rec.get("bucket") or "").startswith(
+                str(bucket)):
             continue
         rung = str(rec.get("precond_id") or "").split("|")[0]
         if not rung:
@@ -106,7 +115,7 @@ def _escalate(rung: str) -> str | None:
 
 def choose_solver(state_dir: str, static: dict | None = None,
                   registry_path: str = "", window: int = 5,
-                  record: bool = True) -> dict:
+                  record: bool = True, bucket: str = "") -> dict:
     """Evidence-driven overrides for the destriper's solver knobs.
 
     ``static`` carries the configured values (``preconditioner``,
@@ -114,7 +123,17 @@ def choose_solver(state_dir: str, static: dict | None = None,
     Returns only the knobs the evidence argues to CHANGE, plus a
     ``reasons`` list; an empty dict (modulo ``reasons``) means the
     static config stands. ``record=False`` suppresses the decision
-    ledger (dry-run / report use)."""
+    ledger (dry-run / report use).
+
+    ``bucket`` (ISSUE 20) restricts the rung-health evidence to solves
+    stamped with that shape-bucket prefix — one rung PER BUCKET instead
+    of one per run, so a calibrator geometry's easy converges can never
+    argue the survey geometry down a rung. When no stamped record
+    matches the bucket, the fold falls back to all records (the
+    pre-bucket behaviour — old traces stay actionable). When the
+    ``[tuning]`` winners cache is enabled and holds a measured
+    ``mg_block`` for this bucket, escalations into multigrid use it
+    instead of the documented default of 8."""
     static = dict(static or {})
     out: dict = {"reasons": []}
 
@@ -136,7 +155,11 @@ def choose_solver(state_dir: str, static: dict | None = None,
         return out
     if not records:
         return out
-    rungs = rung_health(records)
+    rungs = rung_health(records, bucket=bucket)
+    if bucket and not rungs:
+        # no stamped evidence for THIS bucket yet: fall back to the
+        # whole-run fold rather than flying blind
+        rungs = rung_health(records)
 
     # 1. pick the cheapest HEALTHY rung: converged solves, no stall or
     # divergence on the rung, fewest iterations per solve
@@ -213,10 +236,28 @@ def choose_solver(state_dir: str, static: dict | None = None,
                    f"the {PAIR_TEMP_BUDGET} budget")
 
     # 4. mg_block: escalating INTO multigrid with no block configured
-    # gets the documented default so the ladder actually builds
+    # gets the measured [tuning] winner for this bucket when the cache
+    # holds one, else the documented default so the ladder builds
     if out.get("preconditioner") == "multigrid" \
             and not static.get("mg_block"):
-        decide("mg_block", static.get("mg_block"), 8,
+        block, source = 8, "the documented default block of 8"
+        try:
+            from comapreduce_tpu.tuning.cache import TUNING
+            from comapreduce_tpu.tuning.space import solver_bucket
+
+            if TUNING.enabled:
+                win = TUNING.winner(
+                    "solver",
+                    solver_bucket(int(static.get("offset_length")
+                                      or 0)))
+                if win and win.get("mg_block"):
+                    block = int(win["mg_block"])
+                    source = (f"the measured [tuning] winner "
+                              f"(mg_block={block})")
+        except Exception:
+            logger.exception("solver policy: tuning cache consult "
+                             "failed; using the default block")
+        decide("mg_block", static.get("mg_block"), block,
                "multigrid selected with no mg_block configured; "
-               "using the documented default block of 8")
+               f"using {source}")
     return out

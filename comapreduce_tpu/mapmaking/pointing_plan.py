@@ -285,6 +285,20 @@ def build_pointing_plan(pixels: np.ndarray, npix: int, offset_length: int,
                 off_window)
 
     pb = _resolve_pair_batch(pair_batch)
+    if pb == 0:
+        # [tuning]: a MEASURED winner for this (N, L) bucket outranks
+        # both the MXU heuristic and the HBM budget walk below —
+        # measurement is exactly what those two approximate. Disabled
+        # (absent [tuning] table) this is one attribute check and the
+        # auto path is byte-identical to the untuned planner.
+        from comapreduce_tpu.tuning.cache import TUNING
+
+        if TUNING.enabled:
+            from comapreduce_tpu.tuning.space import plan_bucket
+
+            win = TUNING.winner("plan", plan_bucket(N, offset_length))
+            if win and win.get("pair_batch"):
+                pb = max(int(win["pair_batch"]), 1)
     if pb == 0 and not _mxu_backend():
         pb = 1  # merged windows only pay on the MXU (see _mxu_backend)
     if pb == 0:  # auto: largest candidate whose merged one-hot fits

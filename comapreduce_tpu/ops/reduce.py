@@ -21,6 +21,7 @@ MPI-over-files analogue, SURVEY.md §2.5).
 from __future__ import annotations
 
 import functools
+import logging
 import os
 
 import jax
@@ -32,6 +33,8 @@ from comapreduce_tpu.ops.atmosphere import fit_airmass_block
 from comapreduce_tpu.ops.average import edge_channel_mask
 from comapreduce_tpu.ops.median_filter import medfilt_highpass
 from comapreduce_tpu.ops.stats import masked_median, masked_std
+
+logger = logging.getLogger("comapreduce_tpu")
 
 __all__ = ["scan_starts_lengths", "extract_scan_blocks",
            "scatter_scan_blocks", "reduce_feed_scans", "ReduceConfig",
@@ -181,14 +184,40 @@ def estimate_reduce_hbm(feed_batch: int, B: int, C: int, T: int,
     return int(feed_batch) * (inputs + REDUCE_CHAIN_BLOCKS * k * blk)
 
 
+# [tuning] device_hbm_mb, installed by TUNING.configure (0 = unset):
+# the declared-capacity override for backends whose memory_stats is
+# unsupported, so the auto-sizers stop guessing
+_HBM_OVERRIDE_BYTES = 0
+_HBM_DEFAULT_WARNED = False
+
+
+def set_device_hbm_override(n_bytes: int) -> None:
+    """Install (or clear, with 0) the ``[tuning] device_hbm_mb``
+    declared-capacity override consulted by :func:`device_hbm_bytes`.
+    Clearing also re-arms the silent-default warning so the next run
+    in this process warns again."""
+    global _HBM_OVERRIDE_BYTES, _HBM_DEFAULT_WARNED
+    _HBM_OVERRIDE_BYTES = max(int(n_bytes), 0)
+    if not _HBM_OVERRIDE_BYTES:
+        _HBM_DEFAULT_WARNED = False
+
+
 def device_hbm_bytes(default: int = 16 << 30) -> int:
     """Accelerator memory of local device 0, or ``default`` (16 GB — the
     v5e/v5p-class floor this framework budgets for) when the backend does
-    not report it (CPU meshes, older runtimes). Override with
-    ``COMAP_HBM_BYTES`` for planning against a different part."""
+    not report it (CPU meshes, GPU runtimes without ``memory_stats``,
+    older runtimes). Override with ``COMAP_HBM_BYTES`` for planning
+    against a different part, or declare the capacity once with
+    ``[tuning] device_hbm_mb``. Falling back to the default is WARNED
+    once per process — every HBM auto-sizer in the pipeline inherits a
+    guess at that point, and a GPU whose real memory is smaller would
+    OOM where the planner promised fit."""
+    global _HBM_DEFAULT_WARNED
     env = os.environ.get("COMAP_HBM_BYTES", "")
     if env:
         return int(env)
+    if _HBM_OVERRIDE_BYTES:
+        return _HBM_OVERRIDE_BYTES
     try:
         import jax
 
@@ -197,6 +226,14 @@ def device_hbm_bytes(default: int = 16 << 30) -> int:
             return int(stats["bytes_limit"])
     except Exception:  # CPU backend: memory_stats is None/unsupported
         pass
+    if not _HBM_DEFAULT_WARNED:
+        _HBM_DEFAULT_WARNED = True
+        logger.warning(
+            "device_hbm_bytes: backend does not report memory "
+            "(memory_stats unsupported); assuming the %.0f GiB "
+            "default for every HBM auto-sizer. Set [tuning] "
+            "device_hbm_mb (or COMAP_HBM_BYTES) to plan against the "
+            "real part.", default / 2**30)
     return default
 
 
@@ -273,13 +310,30 @@ def plan_stage_feed_batch(F: int, B: int, C: int, T: int,
     per feed — the raw counts, plus e.g. a dense per-feed mask where a
     stage ships one). Returns the largest feed chunk that fits the HBM
     budget; ``requested`` > 0 acts as an upper bound (the stage knob),
-    0/None means auto. Always >= 1: a single feed that cannot fit is a
-    geometry problem the downstream OOM reports better than a zero
-    batch would."""
+    0/None means auto — and on the auto path a measured ``[tuning]``
+    winner for this (F, B, C, T) bucket, when one is cached, becomes
+    the bound instead of "as many as fit" (the HBM fit still caps it:
+    a tuned winner can shrink the chunk, never blow the budget).
+    Always >= 1: a single feed that cannot fit is a geometry problem
+    the downstream OOM reports better than a zero batch would."""
     budget = int((hbm_bytes or device_hbm_bytes()) * headroom)
     unit = B * C * T * 4 * max(int(n_arrays), 1)
     work = STAGE_CHAIN_BLOCKS * B * C * T * 4
     fit = max((budget - work) // max(unit, 1), 1)
+    if not requested:
+        # [tuning]: consult the winners cache on the auto path only —
+        # an explicit stage knob always wins. Lazy import, and a no-op
+        # attribute check when the table is absent (TUNING disabled):
+        # byte-identical to the untuned planner.
+        from comapreduce_tpu.tuning.cache import TUNING
+
+        if TUNING.enabled:
+            from comapreduce_tpu.tuning.space import stage_bucket
+
+            win = TUNING.winner("stage",
+                                stage_bucket(F, B, C, T, n_arrays))
+            if win and win.get("feed_batch"):
+                requested = int(win["feed_batch"])
     fb = F if not requested else min(int(requested), F)
     return int(max(min(fb, fit), 1))
 

@@ -167,6 +167,13 @@ class Runner:
     # off, byte-for-byte the uncontrolled pipeline
     # (docs/OPERATIONS.md §19)
     control: object = None
+    # shape-bucket autotuner knob (TOML [tuning] / INI [Tuning]):
+    # TuningConfig | {"enabled": ..., "device_hbm_mb": ...} | None.
+    # Enabled, the HBM auto-sizers (stage feed_batch, plan pair_batch)
+    # consult measured winners from <state_dir>/tuning.jsonl; absent
+    # table = byte-identical untuned pipeline
+    # (docs/OPERATIONS.md §21)
+    tuning: object = None
     # cumulative async-writeback stats ({"writes", "write_s",
     # "flush_wait_s", ...}) across this Runner's run_tod calls — the
     # bench's write-overlap observable
@@ -236,6 +243,14 @@ class Runner:
         # run start, not inside the per-file best-effort ledger path
         QualityConfig.coerce(self.quality)
         SloConfig.coerce(self.slo)
+        from comapreduce_tpu.tuning.cache import TUNING, TuningConfig
+
+        tun = TuningConfig.coerce(self.tuning)
+        if tun.enabled and not TUNING.enabled:
+            # like the telemetry registry, the winners cache is
+            # process-wide: the first enabled Runner opens it; every
+            # later auto-sized plan in the process consults it
+            TUNING.configure(self.state_dir or self.output_dir, tun)
         if tcfg.enabled and not TELEMETRY.enabled:
             # the registry is process-wide: the first enabled Runner
             # opens this rank's stream; sub-runs (run_astro_cal) and
@@ -874,6 +889,7 @@ class Runner:
         from comapreduce_tpu.resilience import ResilienceConfig
         from comapreduce_tpu.telemetry.quality import (QualityConfig,
                                                        SloConfig)
+        from comapreduce_tpu.tuning.cache import TuningConfig
 
         if isinstance(config, str):
             config = cfg_mod.load_toml(config)
@@ -917,7 +933,10 @@ class Runner:
                    # [control]: supervisor/admission/solver-policy
                    # loops — absent table = every loop off
                    # (docs/OPERATIONS.md §19)
-                   control=ControlConfig.coerce(config.get("control")))
+                   control=ControlConfig.coerce(config.get("control")),
+                   # [tuning]: shape-bucket autotuner winners cache —
+                   # absent table = untuned (docs/OPERATIONS.md §21)
+                   tuning=TuningConfig.coerce(config.get("tuning")))
 
     @classmethod
     def from_legacy_config(cls, ini_path: str, rank: int = 0,
@@ -932,6 +951,7 @@ class Runner:
         from comapreduce_tpu.resilience import ResilienceConfig
         from comapreduce_tpu.telemetry.quality import (QualityConfig,
                                                        SloConfig)
+        from comapreduce_tpu.tuning.cache import TuningConfig
 
         ini = cfg_mod.IniConfig(ini_path)
         processes = [resolve(name, **kwargs)
@@ -960,4 +980,6 @@ class Runner:
                    slo=SloConfig.coerce(
                        dict(ini.get("Slo", {})) or None),
                    control=ControlConfig.coerce(
-                       dict(ini.get("Control", {})) or None))
+                       dict(ini.get("Control", {})) or None),
+                   tuning=TuningConfig.coerce(
+                       dict(ini.get("Tuning", {})) or None))

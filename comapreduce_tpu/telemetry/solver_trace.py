@@ -155,7 +155,8 @@ def _finite(v, default=None):
 def iteration_records(rr_hist, alpha_hist, beta_hist, b_norm, n_ran,
                       *, band: str, precond_id: str = "",
                       precision_id: str = "", threshold: float = 0.0,
-                      base: int = 0, rank: int = 0) -> list:
+                      base: int = 0, rank: int = 0,
+                      bucket: str = "") -> list:
     """Per-iteration records from one system's histories.
 
     ``rr_hist``/``alpha_hist``/``beta_hist`` are 1-D length >= n_ran
@@ -165,6 +166,9 @@ def iteration_records(rr_hist, alpha_hist, beta_hist, b_norm, n_ran,
     the relative norm ``sqrt(rr / |b|^2)`` — the quantity the
     convergence criterion tests. The ``diverging`` annotation mirrors
     the in-loop monitor: |r|^2 more than 100x above the best seen.
+    ``bucket`` is the solve's shape-bucket id (``"L=50|N=36864"``) the
+    per-bucket solver policy groups by (ISSUE 20); empty = unstamped
+    (records predating the field parse identically).
     """
     rr = np.asarray(rr_hist, dtype=np.float64).reshape(-1)
     al = np.asarray(alpha_hist, dtype=np.float64).reshape(-1)
@@ -180,7 +184,7 @@ def iteration_records(rr_hist, alpha_hist, beta_hist, b_norm, n_ran,
                          and rr_k > _DIVERGING_GROWTH * best)
         if rr_k is not None:
             best = min(best, rr_k)
-        records.append({
+        rec = {
             "schema": SOLVER_SCHEMA, "kind": "iteration",
             "band": band, "iter": int(base) + k,
             "residual": res, "rr": rr_k,
@@ -188,7 +192,10 @@ def iteration_records(rr_hist, alpha_hist, beta_hist, b_norm, n_ran,
             "precond_id": precond_id, "precision_id": precision_id,
             "threshold": float(threshold), "rank": int(rank),
             "diverging": diverging,
-        })
+        }
+        if bucket:
+            rec["bucket"] = str(bucket)
+        records.append(rec)
     return records
 
 
@@ -219,11 +226,13 @@ def solve_summary(records: list, *, band: str, n_iter: int,
                   residual: float, diverged: bool,
                   precond_id: str = "", precision_id: str = "",
                   threshold: float = 0.0, base: int = 0,
-                  rank: int = 0) -> dict:
+                  rank: int = 0, bucket: str = "") -> dict:
     """The per-solve summary record, with divergence/stagnation
-    annotations derived from the iteration records."""
+    annotations derived from the iteration records. ``bucket`` stamps
+    the solve's shape bucket for the per-bucket solver policy (empty =
+    unstamped, the pre-ISSUE-20 record shape)."""
     stalled, stalled_at = _stall(records, threshold)
-    return {
+    out = {
         "schema": SOLVER_SCHEMA, "kind": "solve", "band": band,
         "n_iter": int(n_iter), "residual": _finite(residual),
         "converged": bool(threshold > 0 and float(residual) <= threshold
@@ -234,6 +243,9 @@ def solve_summary(records: list, *, band: str, n_iter: int,
         "threshold": float(threshold), "rank": int(rank),
         "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if bucket:
+        out["bucket"] = str(bucket)
+    return out
 
 
 def _band_index(band: str) -> float:
@@ -245,7 +257,7 @@ def record_solve(result, *, band: str, precond_id: str = "",
                  precision_id: str = "", threshold: float = 0.0,
                  base: int = 0, log_dir: str | None = None,
                  rank: int | None = None, bands: list | None = None,
-                 path: str | None = None) -> list:
+                 path: str | None = None, bucket: str = "") -> list:
     """Render one traced ``DestriperResult`` into solver records,
     append them to ``solver.rank{r}.jsonl``, and mirror progress onto
     live gauges. Returns the records (callers cross-check the
@@ -254,7 +266,10 @@ def record_solve(result, *, band: str, precond_id: str = "",
     Multi-RHS solves (histories with a trailing system axis) get one
     record stream per system, labelled ``bands[i]`` when given else
     ``{band}[{i}]``. A ``result`` without a trace (untraced/sharded
-    path) is a silent no-op.
+    path) is a silent no-op. ``bucket`` stamps every record with the
+    solve's shape-bucket id so the control plane's solver policy can
+    pick rungs per bucket (ISSUE 20); empty keeps the legacy record
+    shape.
     """
     trace = getattr(result, "trace", None)
     if trace is None:
@@ -282,13 +297,13 @@ def record_solve(result, *, band: str, precond_id: str = "",
             rr_h[:, i], al_h[:, i], be_h[:, i], b_norm[i], n_ran,
             band=label, precond_id=precond_id,
             precision_id=precision_id, threshold=threshold,
-            base=base, rank=rank)
+            base=base, rank=rank, bucket=bucket)
         summary = solve_summary(
             iters, band=label, n_iter=n_ran,
             residual=float(res_final[i % res_final.size]),
             diverged=bool(div[i % div.size]), precond_id=precond_id,
             precision_id=precision_id, threshold=threshold,
-            base=base, rank=rank)
+            base=base, rank=rank, bucket=bucket)
         records.extend(iters)
         records.append(summary)
         # live progress gauges: iteration FIRST so a reader seeing the

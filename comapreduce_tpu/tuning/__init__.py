@@ -1,0 +1,32 @@
+"""Shape-bucket autotuning (ISSUE 20): measurement-driven knob
+selection, memoised into the campaign plan.
+
+- :mod:`~comapreduce_tpu.tuning.space` — the declarative knob space,
+  with validity rules reusing the pipeline's own validators so a
+  sweep can never propose an invalid combo;
+- :mod:`~comapreduce_tpu.tuning.tuner` — per-(platform, device kind,
+  shape bucket, precision) sweeps over the *actual* compiled
+  programs, pruned by the program-registry cost prior and bounded by
+  successive halving;
+- :mod:`~comapreduce_tpu.tuning.cache` — the durable ``tuning.jsonl``
+  winners ledger (sealed lines, torn-line-safe appends, content-hash
+  keys) plus the process-wide :data:`TUNING` lookup the integration
+  points consult behind the strict ``[tuning]`` config table.
+
+Absent ``[tuning]`` table = TUNING disabled = byte-identical pipeline.
+"""
+
+from comapreduce_tpu.tuning.cache import (TUNING, TuningCache,
+                                          TuningConfig, content_key,
+                                          read_tuning, tuning_path)
+from comapreduce_tpu.tuning.space import (SPACE_VERSION, SpaceContext,
+                                          enumerate_group, plan_bucket,
+                                          solver_bucket, stage_bucket,
+                                          validate_combo)
+from comapreduce_tpu.tuning.tuner import Tuner, registry_prior
+
+__all__ = ["SPACE_VERSION", "SpaceContext", "TUNING", "Tuner",
+           "TuningCache", "TuningConfig", "content_key",
+           "enumerate_group", "plan_bucket", "read_tuning",
+           "registry_prior", "solver_bucket", "stage_bucket",
+           "tuning_path", "validate_combo"]

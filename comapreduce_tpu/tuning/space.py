@@ -1,0 +1,226 @@
+"""The declarative knob space the autotuner sweeps.
+
+Every hot path's free performance knob, with its candidate values and
+— the part that keeps sweeps safe — its VALIDITY rule, expressed by
+calling the same validators the pipeline itself trusts at run time:
+
+- ``feed_batch``  (group ``stage``): stage feed chunks, validated by
+  :func:`~comapreduce_tpu.ops.reduce.plan_stage_feed_batch` /
+  :func:`~comapreduce_tpu.ops.reduce.plan_reduce_memory` — a candidate
+  the HBM planner would shrink or reject is never proposed;
+- ``pair_batch``  (group ``plan``): merged one-hot binning windows,
+  validated by the planner's own budget rule (the merged one-hot must
+  fit ``device_hbm_bytes()/64``, exactly ``build_pointing_plan``'s
+  auto rule) and, for the Pallas kernels, by
+  :func:`~comapreduce_tpu.mapmaking.pallas_binning.pallas_binning_ok`;
+- ``mg_block`` / ``mg_smooth`` (group ``solver``): the multigrid
+  ladder's geometry, validated by
+  ``destriper._check_precond`` plus the config layer's range rules
+  (``mg_block >= 2``, ``mg_smooth >= 1`` — ``parse_destriper_section``)
+  and the ladder-buildability rule (a block larger than the offset
+  count has no level to build);
+- ``kernels``     (group ``solver``): the binning/gather
+  implementation — ``pallas`` is only proposed where
+  ``pallas_binning_ok`` accepts the bucket's window geometry (and the
+  backend is TPU).
+
+:func:`enumerate_group` returns only combos that pass every rule and
+counts what it filtered (``SpaceResult.invalid_filtered``) — the
+check_perf autotune gate asserts the tuner never *measured* an
+invalid combo (``invalid_proposed == 0``), which this module makes
+true by construction.
+
+``SPACE_VERSION`` is part of every cache key (``cache.content_key``):
+revising the candidate grid or a validity rule bumps it and retires
+every stale winner at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["SPACE_VERSION", "SpaceContext", "SpaceResult",
+           "enumerate_group", "plan_bucket", "solver_bucket",
+           "stage_bucket", "validate_combo"]
+
+SPACE_VERSION = 1
+
+#: candidate grids per knob — the measured ROOFLINE levers (pair_batch
+#: mirrors pointing_plan._PAIR_BATCH_CANDIDATES; mg_block spans the
+#: r10 sweep where 32 converged and 8/16 diverged on spread weights)
+CANDIDATES = {
+    "feed_batch": (1, 2, 4, 8, 19),
+    "pair_batch": (1, 2, 4, 8),
+    "mg_block": (8, 16, 32),
+    "mg_smooth": (1, 2),
+    "kernels": ("xla", "pallas"),
+}
+
+GROUPS = ("stage", "plan", "solver")
+
+
+@dataclasses.dataclass(frozen=True)
+class SpaceContext:
+    """The shape/backend facts validity is judged against — one bucket
+    of one campaign. Axes default to 0 = "not constrained" so each
+    group only needs its own geometry filled in."""
+
+    F: int = 0           # feeds
+    B: int = 0           # bands
+    C: int = 0           # channels
+    T: int = 0           # samples per scan axis (stage group)
+    S: int = 0           # scans
+    L: int = 0           # padded scan-block / offset length
+    n_samples: int = 0   # flat destriper sample count (plan group)
+    offset_length: int = 0
+    n_arrays: int = 1
+    platform: str = ""
+    hbm_bytes: int = 0   # 0 = ask device_hbm_bytes()
+
+
+@dataclasses.dataclass
+class SpaceResult:
+    combos: list
+    invalid_filtered: int
+
+
+def stage_bucket(F: int, B: int, C: int, T: int,
+                 n_arrays: int = 1) -> dict:
+    """The stage group's cache-key bucket (the feed-batched program's
+    shape identity)."""
+    return {"group": "stage", "F": int(F), "B": int(B), "C": int(C),
+            "T": int(T), "n_arrays": int(n_arrays)}
+
+
+def plan_bucket(n_samples: int, offset_length: int) -> dict:
+    """The plan group's cache-key bucket: the pointing plan's flat
+    sample count and offset length (the two axes the merged one-hot
+    geometry depends on)."""
+    return {"group": "plan", "N": int(n_samples),
+            "L": int(offset_length)}
+
+
+def solver_bucket(offset_length: int, n_samples: int = 0) -> dict:
+    """The solver group's cache-key bucket. ``n_samples`` may be 0 at
+    config time (the destriper CLI keys on offset length before any
+    file is read); sweeps that know the flat length include it."""
+    out = {"group": "solver", "L": int(offset_length)}
+    if n_samples:
+        out["N"] = int(n_samples)
+    return out
+
+
+def _hbm(ctx: SpaceContext) -> int:
+    from comapreduce_tpu.ops.reduce import device_hbm_bytes
+
+    return int(ctx.hbm_bytes) or device_hbm_bytes()
+
+
+def _valid_feed_batch(fb: int, ctx: SpaceContext) -> bool:
+    """A feed_batch candidate is valid iff the stage HBM planner keeps
+    it as-is (would neither shrink nor reject it) and the reduce-chain
+    planner accepts it with some scan streaming."""
+    from comapreduce_tpu.ops.reduce import (plan_reduce_memory,
+                                            plan_stage_feed_batch)
+
+    if ctx.F and fb > ctx.F:
+        return False
+    hbm = _hbm(ctx)
+    kept = plan_stage_feed_batch(ctx.F or fb, ctx.B, ctx.C, ctx.T,
+                                 requested=fb, n_arrays=ctx.n_arrays,
+                                 hbm_bytes=hbm)
+    if kept != fb:
+        return False
+    if ctx.S and ctx.L:
+        try:
+            plan_reduce_memory(fb, ctx.B, ctx.C, ctx.T, ctx.S, ctx.L,
+                               scan_batch=None, hbm_bytes=hbm)
+        except ValueError:
+            return False
+    return True
+
+
+def _valid_pair_batch(pb: int, ctx: SpaceContext,
+                      pair_chunk: int = 4096) -> bool:
+    """``build_pointing_plan``'s auto budget rule, applied to a
+    candidate: the merged chunk's one-hot block (chunk x window, f32)
+    must fit the planner's budget. The true window needs the built
+    plan; the conservative bound here is the merged chunk's own id
+    span (window <= chunk_eff rounded to the 128 alignment), which is
+    exact for dense rank spaces — the regime batching targets."""
+    from comapreduce_tpu.mapmaking.pointing_plan import _round_up
+
+    budget = max(_hbm(ctx) // 64, 64 << 20)
+    chunk_eff = pair_chunk * pb
+    window = _round_up(min(chunk_eff,
+                           max(ctx.n_samples // max(ctx.offset_length
+                                                    or 1, 1), 1)),
+                       128)
+    return chunk_eff * window * 4 <= budget
+
+
+def _valid_solver(combo: dict, ctx: SpaceContext) -> bool:
+    """The destriper's own preconditioner rule plus the config layer's
+    mg ranges and the ladder-buildability bound."""
+    from comapreduce_tpu.mapmaking.destriper import _check_precond
+
+    mg_block = int(combo.get("mg_block", 8))
+    mg_smooth = int(combo.get("mg_smooth", 1))
+    if mg_block < 2 or mg_smooth < 1:
+        return False
+    mg = {"levels": 2, "smooth": mg_smooth, "block": mg_block}
+    try:
+        _check_precond("jacobi", coarse=None, mg=mg)
+    except ValueError:
+        return False
+    if ctx.n_samples and ctx.offset_length:
+        n_offsets = ctx.n_samples // max(ctx.offset_length, 1)
+        if mg_block >= max(n_offsets, 2):
+            return False  # no coarse level to build
+    kern = str(combo.get("kernels", "xla"))
+    if kern == "pallas":
+        if ctx.platform and ctx.platform != "tpu":
+            return False
+        from comapreduce_tpu.mapmaking.pallas_binning import \
+            pallas_binning_ok
+
+        window = 128 * max(int(combo.get("pair_batch", 1)), 1)
+        if not pallas_binning_ok(window, 4096):
+            return False
+    return True
+
+
+def validate_combo(group: str, combo: dict, ctx: SpaceContext) -> bool:
+    """True iff ``combo`` passes the group's validity rules — the rule
+    the tuner re-checks before measuring anything (belt and braces:
+    enumerate_group only yields valid combos in the first place)."""
+    if group == "stage":
+        return _valid_feed_batch(int(combo.get("feed_batch", 1)), ctx)
+    if group == "plan":
+        return _valid_pair_batch(int(combo.get("pair_batch", 1)), ctx)
+    if group == "solver":
+        return _valid_solver(combo, ctx)
+    raise ValueError(f"unknown tuning group {group!r} "
+                     f"(groups: {list(GROUPS)})")
+
+
+def enumerate_group(group: str, ctx: SpaceContext) -> SpaceResult:
+    """All VALID candidate combos for one group at one bucket, plus
+    the count of grid points the validity rules filtered out."""
+    if group == "stage":
+        grid = [{"feed_batch": fb} for fb in CANDIDATES["feed_batch"]]
+    elif group == "plan":
+        grid = [{"pair_batch": pb} for pb in CANDIDATES["pair_batch"]]
+    elif group == "solver":
+        grid = [{"mg_block": b, "mg_smooth": s}
+                for b in CANDIDATES["mg_block"]
+                for s in CANDIDATES["mg_smooth"]]
+        if ctx.platform == "tpu":
+            grid = [dict(g, kernels=k) for g in grid
+                    for k in CANDIDATES["kernels"]]
+    else:
+        raise ValueError(f"unknown tuning group {group!r} "
+                         f"(groups: {list(GROUPS)})")
+    combos = [g for g in grid if validate_combo(group, g, ctx)]
+    return SpaceResult(combos=combos,
+                       invalid_filtered=len(grid) - len(combos))

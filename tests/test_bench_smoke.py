@@ -208,6 +208,19 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
                         lambda: json.loads(json.dumps(shrd)))
     monkeypatch.setattr(cp, "banded_white_parity_check",
                         lambda: json.loads(json.dumps(wpar)))
+    # ... and the autotuner child (ISSUE 20): the real sweep times
+    # actual jitted destriper programs, so every cp.main() below would
+    # otherwise pay a full cold sweep + A/B campaign
+    tune = {"metric": "tune_campaign_samples_per_s", "value": 52000.0,
+            "vs_baseline": 1.014,
+            "detail": {"config": "tune", "bucket_count": 4,
+                       "sweep": {"wall_s": 12.0, "measurements": 40,
+                                 "invalid_proposed": 0, "pruned": 0,
+                                 "winners": {}},
+                       "warm": {"measurements": 0, "cache_hits": 4,
+                                "buckets_hit": 4}}}
+    monkeypatch.setattr(cp, "run_tune_bench",
+                        lambda: json.loads(json.dumps(tune)))
     # keep the run-registry appends out of the repo's real evidence/
     monkeypatch.setenv("COMAP_RUNS_REGISTRY",
                        str(tmp_path / "runs.jsonl"))
@@ -370,6 +383,28 @@ def test_check_perf_gate_logic(tmp_path, monkeypatch):
     wpar["reasons"] = ["absent", "bad_fit"]   # reasons drifted
     assert cp.main(["--reps", "1", "--no-serving"]) == 1
     wpar["reasons"] = ["absent", "fknee_low"]
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    # the autotune gate (ISSUE 20): a tuned leg below the noise-floored
+    # default ordering, a warm re-run that re-measures anything, a
+    # bucket that missed the cache, or an invalid combo reaching the
+    # timer each fail; a canned detail without the sweep section skips
+    # with a recorded reason; --no-tune skips the child entirely
+    tune["vs_baseline"] = 0.8            # consult applied a non-winner
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    assert cp.main(["--reps", "1", "--no-serving", "--no-tune"]) == 0
+    tune["vs_baseline"] = 1.014
+    tune["detail"]["warm"]["measurements"] = 3   # memoisation broke
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    tune["detail"]["warm"]["measurements"] = 0
+    tune["detail"]["warm"]["buckets_hit"] = 2    # a bucket re-swept
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    tune["detail"]["warm"]["buckets_hit"] = 4
+    sweep = tune["detail"].pop("sweep")  # canned-detail skip path
+    assert cp.main(["--reps", "1", "--no-serving"]) == 0
+    tune["detail"]["sweep"] = sweep
+    tune["detail"]["sweep"]["invalid_proposed"] = 1
+    assert cp.main(["--reps", "1", "--no-serving"]) == 1
+    tune["detail"]["sweep"]["invalid_proposed"] = 0
     assert cp.main(["--reps", "1", "--no-serving"]) == 0
     # ... and every gated run landed in the (redirected) registry,
     # honest about its own ok bit

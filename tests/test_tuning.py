@@ -1,0 +1,584 @@
+"""Shape-bucket autotuner (ISSUE 20, OPERATIONS §21): the knob space's
+validity wall, the measurement loop's halving/noise-floor/memoisation
+contracts, the sealed winners ledger, and the consult plumbing that
+actually applies winners — plus the strict-config and byte-identity
+promises (absent ``[tuning]`` table = untuned pipeline, exactly).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from comapreduce_tpu.tuning.cache import (TUNING, TuningCache,
+                                          TuningConfig,
+                                          _backend_identity,
+                                          content_key, read_tuning,
+                                          tuning_path)
+from comapreduce_tpu.tuning.space import (SPACE_VERSION, SpaceContext,
+                                          enumerate_group, plan_bucket,
+                                          solver_bucket, stage_bucket,
+                                          validate_combo)
+from comapreduce_tpu.tuning.tuner import Tuner, registry_prior
+
+
+@pytest.fixture(autouse=True)
+def _reset_tuning_runtime():
+    """The TUNING singleton is process-wide (like TELEMETRY): every
+    test starts and ends disabled, with the HBM override cleared."""
+    TUNING.close()
+    yield
+    TUNING.close()
+
+
+def _ctx(**kw):
+    base = dict(F=19, B=4, C=64, T=4096, S=2, L=50, n_samples=36864,
+                offset_length=50, platform="cpu", hbm_bytes=1 << 30)
+    base.update(kw)
+    return SpaceContext(**base)
+
+
+def _put_winner(tmp_path, group, bucket, winner, default,
+                precision_id=""):
+    """Seed one winner record keyed exactly as the runtime will look
+    it up (this process's backend identity + the live space version)."""
+    platform, kind = _backend_identity()
+    key = content_key(platform, kind, bucket, precision_id=precision_id,
+                      space_version=SPACE_VERSION, group=group)
+    cache = TuningCache(tuning_path(str(tmp_path)))
+    cache.put({"key": key, "group": group, "platform": platform,
+               "device_kind": kind, "bucket": bucket,
+               "precision_id": precision_id,
+               "space_version": SPACE_VERSION, "winner": winner,
+               "default": default, "best_ms": 1.0, "default_ms": 2.0,
+               "candidates": 2, "measurements": 3})
+    return key
+
+
+# ---------------------------------------------------------------------------
+# cache keys + config
+
+
+def test_content_key_dict_order_stable():
+    a = content_key("cpu", "cpu", {"group": "plan", "N": 1, "L": 2},
+                    "p", 1, "plan")
+    b = content_key("cpu", "cpu", {"L": 2, "N": 1, "group": "plan"},
+                    "p", 1, "plan")
+    assert a == b and len(a) == 64
+
+
+def test_content_key_axes_all_distinguish():
+    base = ("cpu", "cpu", {"N": 1}, "p", 1, "g")
+    k0 = content_key(*base)
+    assert content_key("tpu", "cpu", {"N": 1}, "p", 1, "g") != k0
+    assert content_key("cpu", "v4", {"N": 1}, "p", 1, "g") != k0
+    assert content_key("cpu", "cpu", {"N": 2}, "p", 1, "g") != k0
+    assert content_key("cpu", "cpu", {"N": 1}, "q", 1, "g") != k0
+    # a space revision retires every stale winner by key change alone
+    assert content_key("cpu", "cpu", {"N": 1}, "p", 2, "g") != k0
+    assert content_key("cpu", "cpu", {"N": 1}, "p", 1, "h") != k0
+
+
+def test_tuning_config_absent_is_disabled():
+    cfg = TuningConfig.coerce(None)
+    assert not cfg.enabled
+
+
+def test_tuning_config_table_implies_enabled():
+    # writing any [tuning] knob means the operator wants the tuner on
+    assert TuningConfig.coerce({"repeats": 2}).enabled
+    assert not TuningConfig.coerce({"enabled": "false",
+                                    "repeats": 2}).enabled
+    assert TuningConfig.coerce({"enabled": "true"}).enabled
+
+
+def test_tuning_config_unknown_key_raises():
+    with pytest.raises(ValueError, match="unknown tuning keys"):
+        TuningConfig.coerce({"repeat": 3})  # typo'd knob
+
+
+@pytest.mark.parametrize("bad", [{"device_hbm_mb": -1},
+                                 {"max_candidates": 0},
+                                 {"repeats": 0},
+                                 {"min_improvement": 1.5}])
+def test_tuning_config_range_validation(bad):
+    with pytest.raises(ValueError):
+        TuningConfig.coerce(bad)
+
+
+# ---------------------------------------------------------------------------
+# the winners ledger
+
+
+def test_tuning_ledger_torn_line_heal_and_latest_wins(tmp_path):
+    path = tuning_path(str(tmp_path))
+    cache = TuningCache(path)
+    cache.put({"key": "k1", "group": "plan", "bucket": {"N": 1},
+               "winner": {"pair_batch": 8}})
+    # a crash mid-append leaves a torn trailing line with no newline
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "tuning", "key": "torn-partial')
+    # the next append must heal (newline first), and the torn line
+    # must never surface from a read
+    cache2 = TuningCache(path)
+    cache2.put({"key": "k1", "group": "plan", "bucket": {"N": 1},
+                "winner": {"pair_batch": 4}})
+    records = read_tuning(path)
+    assert set(records) == {"k1"}
+    assert records["k1"]["winner"] == {"pair_batch": 4}  # latest wins
+
+
+def test_tuning_ledger_tampered_line_dropped(tmp_path):
+    path = tuning_path(str(tmp_path))
+    TuningCache(path).put({"key": "k1", "group": "plan",
+                           "bucket": {"N": 1}, "winner": {"p": 1}})
+    raw = open(path, "rb").read()
+    # flip the winner inside the sealed body: the seal must catch it
+    bad = raw.replace(b'"winner":{"p":1}', b'"winner":{"p":9}')
+    assert bad != raw
+    with open(path, "wb") as f:
+        f.write(bad)
+    assert read_tuning(path) == {}
+
+
+def test_read_tuning_accepts_directory_or_path(tmp_path):
+    path = tuning_path(str(tmp_path))
+    TuningCache(path).put({"key": "k", "group": "plan",
+                           "bucket": {}, "winner": {}})
+    assert set(read_tuning(str(tmp_path))) == {"k"}
+    assert set(read_tuning(path)) == {"k"}
+
+
+# ---------------------------------------------------------------------------
+# the knob space
+
+
+def test_space_every_proposed_combo_validates():
+    ctx = _ctx()
+    for group in ("stage", "plan", "solver"):
+        res = enumerate_group(group, ctx)
+        assert res.combos, f"{group}: empty candidate list"
+        for combo in res.combos:
+            assert validate_combo(group, combo, ctx), (group, combo)
+
+
+def test_space_filters_oversized_feed_batch():
+    # F=2: the 4/8/19 grid points are invalid (a batch can't exceed
+    # the feed count) and must be filtered, never proposed
+    res = enumerate_group("stage", _ctx(F=2))
+    assert all(c["feed_batch"] <= 2 for c in res.combos)
+    assert res.invalid_filtered >= 3
+
+
+def test_space_filters_pair_batch_over_budget():
+    # a tiny declared HBM shrinks the planner budget's 1/64 share to
+    # the 64 MiB floor; the conservative window bound then rejects the
+    # largest merged chunks
+    tight = enumerate_group("plan", _ctx(hbm_bytes=1 << 20,
+                                         n_samples=4096 * 8 * 200,
+                                         offset_length=8))
+    roomy = enumerate_group("plan", _ctx(hbm_bytes=64 << 30,
+                                         n_samples=4096 * 8 * 200,
+                                         offset_length=8))
+    assert len(tight.combos) < len(roomy.combos)
+    assert tight.invalid_filtered > 0
+
+
+def test_space_solver_pallas_only_on_tpu():
+    cpu = enumerate_group("solver", _ctx(platform="cpu"))
+    assert all("kernels" not in c for c in cpu.combos)
+    tpu = enumerate_group("solver", _ctx(platform="tpu"))
+    kerns = {c.get("kernels") for c in tpu.combos}
+    assert "xla" in kerns
+    # pallas combos appear on the tpu grid iff the window geometry
+    # passes pallas_binning_ok — and never validate off-TPU
+    for c in tpu.combos:
+        if c.get("kernels") == "pallas":
+            assert not validate_combo("solver", c, _ctx(platform="cpu"))
+
+
+def test_space_solver_block_needs_a_coarse_level():
+    # 16 offsets: mg_block 16/32 have no level to build
+    res = enumerate_group("solver", _ctx(n_samples=16 * 50,
+                                         offset_length=50))
+    assert all(c["mg_block"] < 16 for c in res.combos)
+    assert res.invalid_filtered > 0
+
+
+def test_space_unknown_group_raises():
+    with pytest.raises(ValueError, match="unknown tuning group"):
+        enumerate_group("nope", _ctx())
+    with pytest.raises(ValueError, match="unknown tuning group"):
+        validate_combo("nope", {}, _ctx())
+
+
+# ---------------------------------------------------------------------------
+# the tuner
+
+
+def _counting_build(walls):
+    """build(combo) -> thunk that records every run per combo (the
+    walls dict is unused by default — timing comes from the real
+    clock; tests that need determinism monkeypatch perf_counter)."""
+    calls = {}
+
+    def build(combo):
+        cid = json.dumps(combo, sort_keys=True)
+
+        def thunk():
+            calls[cid] = calls.get(cid, 0) + 1
+
+        return thunk
+
+    return build, calls
+
+
+def test_tuner_memoises_and_halving_bounds_measurements(tmp_path):
+    cache = TuningCache(tuning_path(str(tmp_path)))
+    t = Tuner(cache, "cpu", "cpu", max_candidates=8, repeats=4)
+    ctx = _ctx()
+    build, _ = _counting_build({})
+    rec = t.tune("solver", solver_bucket(50, 36864), ctx, build,
+                 {"mg_block": 8, "mg_smooth": 1})
+    n_cand = rec["candidates"]
+    assert n_cand >= 2
+    # successive halving: strictly fewer timed runs than the flat
+    # n * repeats grid (plus: the record counts THIS sweep only)
+    assert 0 < rec["measurements"] == t.measurements
+    assert t.measurements < n_cand * 4
+    assert t.invalid_proposed == 0
+    # warm: same bucket answers from the cache — zero new measurements
+    before = t.measurements
+    rec2 = t.tune("solver", solver_bucket(50, 36864), ctx, build,
+                  {"mg_block": 8, "mg_smooth": 1})
+    assert t.measurements == before
+    assert t.cache_hits >= 1
+    assert rec2["winner"] == rec["winner"]
+    # and across a process restart (fresh cache object, same file)
+    t2 = Tuner(TuningCache(tuning_path(str(tmp_path))), "cpu", "cpu")
+    rec3 = t2.tune("solver", solver_bucket(50, 36864), ctx, build,
+                   {"mg_block": 8, "mg_smooth": 1})
+    assert t2.measurements == 0 and rec3["winner"] == rec["winner"]
+
+
+def test_tuner_noise_floor_keeps_default(tmp_path, monkeypatch):
+    """A candidate 2% faster than the default must NOT dethrone it
+    under the 5% noise floor — tuned is never slower than default
+    beyond noise, by construction."""
+    cache = TuningCache(tuning_path(str(tmp_path)))
+    t = Tuner(cache, "cpu", "cpu", repeats=1, min_improvement=0.05)
+    walls = {1: 1.00, 2: 0.98, 4: 1.50, 8: 2.00}  # virtual seconds
+    clock = [0.0]
+
+    def fake_perf_counter():
+        return clock[0]
+
+    monkeypatch.setattr("comapreduce_tpu.tuning.tuner.time.perf_counter",
+                        fake_perf_counter)
+
+    def build(combo):
+        def thunk():
+            clock[0] += walls[int(combo["pair_batch"])]
+
+        return thunk
+
+    rec = t.tune("plan", plan_bucket(36864, 50), _ctx(), build,
+                 {"pair_batch": 1})
+    assert rec["winner"] == {"pair_batch": 1}  # 2% < the 5% floor
+    assert rec["default_ms"] == pytest.approx(1000.0)
+
+    # a 40% faster candidate DOES win
+    walls[2] = 0.6
+    rec2 = t.tune("plan", plan_bucket(99999 * 50, 50), _ctx(), build,
+                  {"pair_batch": 1})
+    assert rec2["winner"] == {"pair_batch": 2}
+
+
+def test_tuner_invalid_candidates_never_measured(tmp_path):
+    cache = TuningCache(tuning_path(str(tmp_path)))
+    t = Tuner(cache, "cpu", "cpu")
+    build, calls = _counting_build({})
+    # hand the tuner an explicitly invalid candidate (mg_smooth=0):
+    # it must be counted and never built/timed
+    rec = t.tune("solver", solver_bucket(50), _ctx(), build,
+                 {"mg_block": 8, "mg_smooth": 1},
+                 candidates=[{"mg_block": 8, "mg_smooth": 1},
+                             {"mg_block": 8, "mg_smooth": 0}])
+    assert t.invalid_proposed == 1
+    assert rec["candidates"] == 1
+    assert json.dumps({"mg_block": 8, "mg_smooth": 0},
+                      sort_keys=True) not in calls
+
+
+def test_tuner_prior_prunes_but_default_survives(tmp_path):
+    cache = TuningCache(tuning_path(str(tmp_path)))
+    t = Tuner(cache, "cpu", "cpu", max_candidates=2, repeats=1)
+    build, calls = _counting_build({})
+    prior = registry_prior([{"name": "destripe",
+                             "bytes_accessed": 1e6}])
+    # prior ranks by pair_batch scale: 1 cheapest ... 8 dearest; cap=2
+    # keeps {1, 2} — but the default (8) must be forced back in
+    rec = t.tune("plan", plan_bucket(36864, 50), _ctx(), build,
+                 {"pair_batch": 8}, prior=prior)
+    assert t.pruned > 0
+    measured = {json.loads(c)["pair_batch"] for c in calls}
+    assert 8 in measured and len(measured) <= 2
+    assert rec["default_ms"] is not None
+
+
+def test_registry_prior_empty_registry_ranks_none():
+    prior = registry_prior([])
+    assert prior({"pair_batch": 4}) is None
+
+
+class _FakeSolve:
+    """A traced DestriperResult stand-in record_solve accepts: a
+    geometric residual history down to ``residual`` over ``n_iter``
+    steps."""
+
+    def __init__(self, n_iter=30, residual=1e-8, diverged=False):
+        self.n_iter = n_iter
+        self.residual = np.float32(residual)
+        self.diverged = np.array(diverged)
+        hist = np.geomspace(1.0, max(residual, 1e-12), n_iter + 1
+                            ).astype(np.float32)
+        self.trace = (hist, np.ones_like(hist), np.zeros_like(hist),
+                      np.float32(1.0))
+
+
+# ---------------------------------------------------------------------------
+# winners actually applied (the consult plumbing)
+
+
+def test_stage_winner_applied_and_absent_table_identity(tmp_path):
+    from comapreduce_tpu.ops.reduce import plan_stage_feed_batch
+
+    F, B, C, T = 19, 4, 64, 4096
+    hbm = 16 << 30
+    untuned = plan_stage_feed_batch(F, B, C, T, hbm_bytes=hbm)
+    _put_winner(tmp_path, "stage", stage_bucket(F, B, C, T),
+                {"feed_batch": 2}, {"feed_batch": untuned})
+
+    # cache on disk but [tuning] absent: byte-identical auto sizing
+    assert plan_stage_feed_batch(F, B, C, T, hbm_bytes=hbm) == untuned
+
+    TUNING.configure(str(tmp_path), TuningConfig(enabled=True))
+    assert plan_stage_feed_batch(F, B, C, T, hbm_bytes=hbm) == 2
+    # an explicit request always outranks the winner
+    assert plan_stage_feed_batch(F, B, C, T, requested=4,
+                                 hbm_bytes=hbm) == 4
+    TUNING.close()
+    assert plan_stage_feed_batch(F, B, C, T, hbm_bytes=hbm) == untuned
+
+
+def test_plan_winner_applied_and_absent_table_identity(tmp_path,
+                                                       monkeypatch):
+    from comapreduce_tpu.mapmaking.pointing_plan import \
+        build_pointing_plan
+
+    monkeypatch.delenv("COMAP_PAIR_BATCH", raising=False)
+    rng = np.random.default_rng(0)
+    L, npix = 16, 64
+    pix = rng.integers(0, npix, 16 * 40)
+    untuned = build_pointing_plan(pix, npix, L)
+    _put_winner(tmp_path, "plan", plan_bucket(pix.size, L),
+                {"pair_batch": 2}, {"pair_batch": untuned.pair_batch})
+
+    assert build_pointing_plan(pix, npix, L).pair_batch \
+        == untuned.pair_batch  # cache present, table absent
+
+    TUNING.configure(str(tmp_path), TuningConfig(enabled=True))
+    assert build_pointing_plan(pix, npix, L).pair_batch == 2
+    # explicit pair_batch (arg or env) outranks the winner
+    assert build_pointing_plan(pix, npix, L, pair_batch=4).pair_batch \
+        == 4
+    monkeypatch.setenv("COMAP_PAIR_BATCH", "1")
+    assert build_pointing_plan(pix, npix, L).pair_batch == 1
+
+
+def test_solver_policy_consults_winner_for_mg_block(tmp_path):
+    from comapreduce_tpu.control.policy import choose_solver
+    from comapreduce_tpu.telemetry.solver_trace import record_solve
+
+    state = str(tmp_path / "state")
+    os.makedirs(state, exist_ok=True)
+    path = os.path.join(state, "solver.rank0.jsonl")
+    # multigrid healthy, jacobi diverged -> policy escalates to
+    # multigrid with no mg_block configured
+    record_solve(_FakeSolve(n_iter=30, residual=1e-8), band="b0",
+                 path=path, precond_id="multigrid|L50", threshold=1e-6)
+    record_solve(_FakeSolve(n_iter=400, residual=10.0, diverged=True),
+                 band="b1", path=path, precond_id="jacobi|L50",
+                 threshold=1e-6)
+
+    _put_winner(tmp_path, "solver", solver_bucket(50),
+                {"mg_block": 32, "mg_smooth": 2},
+                {"mg_block": 8, "mg_smooth": 1})
+    out = choose_solver(state, {"preconditioner": "jacobi",
+                                "offset_length": 50}, record=False)
+    assert out.get("preconditioner") == "multigrid"
+    assert out.get("mg_block") == 8  # table absent: documented default
+
+    TUNING.configure(str(tmp_path), TuningConfig(enabled=True))
+    out = choose_solver(state, {"preconditioner": "jacobi",
+                                "offset_length": 50}, record=False)
+    assert out.get("mg_block") == 32  # the measured winner
+    assert any("tuning" in r for r in out["reasons"])
+
+
+# ---------------------------------------------------------------------------
+# per-bucket solver rungs
+
+
+def _solve_rec(precond, bucket="", **kw):
+    rec = {"kind": "solve", "precond_id": precond, "n_iter": 10,
+           "converged": True, "stalled": False, "diverged": False}
+    if bucket:
+        rec["bucket"] = bucket
+    rec.update(kw)
+    return rec
+
+
+def test_rung_health_bucket_prefix_filter():
+    from comapreduce_tpu.control.policy import rung_health
+
+    records = [
+        _solve_rec("jacobi|L50", bucket="L=50|N=36864", n_iter=200),
+        _solve_rec("multigrid|L50", bucket="L=50|N=36864", n_iter=20),
+        _solve_rec("jacobi|L10", bucket="L=10|N=4000", n_iter=8),
+        _solve_rec("jacobi|old"),  # unstamped legacy record
+    ]
+    allr = rung_health(records)
+    assert allr["jacobi"]["solves"] == 3
+    l50 = rung_health(records, bucket="L=50")
+    # the prefix matches the full "L=50|N=..." stamp; the easy L=10
+    # geometry and unstamped records stay out
+    assert l50["jacobi"]["solves"] == 1
+    assert l50["jacobi"]["iters"] == 200
+    assert l50["multigrid"]["solves"] == 1
+    assert "jacobi" in rung_health(records, bucket="L=10")
+    assert rung_health(records, bucket="L=99") == {}
+
+
+def test_choose_solver_per_bucket_rungs(tmp_path):
+    from comapreduce_tpu.control.policy import choose_solver
+    from comapreduce_tpu.telemetry.solver_trace import record_solve
+
+    state = str(tmp_path)
+    path = os.path.join(state, "solver.rank0.jsonl")
+    # survey bucket (L=50): jacobi diverges, multigrid cheap
+    record_solve(_FakeSolve(n_iter=400, residual=10.0, diverged=True),
+                 band="s", path=path, precond_id="jacobi|L50",
+                 threshold=1e-6, bucket="L=50|N=36864")
+    record_solve(_FakeSolve(n_iter=20, residual=1e-8), band="s",
+                 path=path, precond_id="multigrid|L50",
+                 threshold=1e-6, bucket="L=50|N=36864")
+    # calibrator bucket (L=10): jacobi converges instantly
+    record_solve(_FakeSolve(n_iter=3, residual=1e-8), band="c",
+                 path=path, precond_id="jacobi|L10",
+                 threshold=1e-6, bucket="L=10|N=4000")
+
+    # per-bucket: the survey bucket escalates, the calibrator bucket
+    # keeps its cheap rung — one rung PER BUCKET, not per run
+    survey = choose_solver(state, {"preconditioner": "jacobi"},
+                           record=False, bucket="L=50")
+    assert survey.get("preconditioner") == "multigrid"
+    calib = choose_solver(state, {"preconditioner": "jacobi"},
+                          record=False, bucket="L=10")
+    assert "preconditioner" not in calib
+    # unmatched bucket: falls back to the whole-run fold (old traces
+    # without stamps stay actionable)
+    fallback = choose_solver(state, {"preconditioner": "jacobi"},
+                             record=False, bucket="L=77")
+    assert fallback.get("preconditioner") == "multigrid"
+
+
+def test_record_solve_stamps_bucket(tmp_path):
+    from comapreduce_tpu.telemetry.solver_trace import (read_solver,
+                                                        record_solve)
+
+    path = str(tmp_path / "solver.rank0.jsonl")
+    record_solve(_FakeSolve(n_iter=2, residual=1e-9), band="b0",
+                 path=path, precond_id="jacobi|L50", threshold=1e-6,
+                 bucket="L=50|N=100")
+    record_solve(_FakeSolve(n_iter=2, residual=1e-9), band="b1",
+                 path=path, precond_id="jacobi|L50", threshold=1e-6)
+    recs = read_solver(path)
+    stamped = [r for r in recs if r.get("band") == "b0"]
+    legacy = [r for r in recs if r.get("band") == "b1"]
+    assert stamped and all(r["bucket"] == "L=50|N=100"
+                           for r in stamped)
+    # records without a stamp keep the legacy shape (no bucket key)
+    assert legacy and all("bucket" not in r for r in legacy)
+
+
+# ---------------------------------------------------------------------------
+# satellite: device_hbm_bytes honesty
+
+
+def test_device_hbm_default_warns_once_and_override(monkeypatch,
+                                                    caplog):
+    import comapreduce_tpu.ops.reduce as reduce_mod
+
+    monkeypatch.delenv("COMAP_HBM_BYTES", raising=False)
+    monkeypatch.setattr(reduce_mod, "_HBM_DEFAULT_WARNED", False)
+
+    class NoStats:
+        def memory_stats(self):
+            raise NotImplementedError
+
+    import jax
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [NoStats()])
+    with caplog.at_level("WARNING", logger="comapreduce_tpu"):
+        assert reduce_mod.device_hbm_bytes() == 16 << 30
+        assert reduce_mod.device_hbm_bytes() == 16 << 30
+    warns = [r for r in caplog.records
+             if "does not report memory" in r.message]
+    assert len(warns) == 1  # once per process, not per plan
+
+    # the [tuning] device_hbm_mb override silences the guess entirely
+    reduce_mod.set_device_hbm_override(4 << 30)
+    try:
+        assert reduce_mod.device_hbm_bytes() == 4 << 30
+    finally:
+        reduce_mod.set_device_hbm_override(0)
+    # env pin outranks everything (the existing contract)
+    monkeypatch.setenv("COMAP_HBM_BYTES", str(1 << 30))
+    reduce_mod.set_device_hbm_override(2 << 30)
+    try:
+        assert reduce_mod.device_hbm_bytes() == 1 << 30
+    finally:
+        reduce_mod.set_device_hbm_override(0)
+
+
+def test_tuning_configure_wires_hbm_override(tmp_path, monkeypatch):
+    import comapreduce_tpu.ops.reduce as reduce_mod
+
+    monkeypatch.delenv("COMAP_HBM_BYTES", raising=False)
+    TUNING.configure(str(tmp_path),
+                     TuningConfig(enabled=True, device_hbm_mb=2048))
+    assert reduce_mod.device_hbm_bytes() == 2048 << 20
+    TUNING.close()
+
+
+# ---------------------------------------------------------------------------
+# runner / CLI config wiring
+
+
+def test_runner_coerces_tuning_table(tmp_path):
+    from comapreduce_tpu.pipeline.runner import Runner
+
+    r = Runner.from_config(
+        {"Global": {"processes": [], "output_dir": str(tmp_path)},
+         "tuning": {"repeats": 2}})
+    assert r.tuning.enabled and r.tuning.repeats == 2
+    # absent table = disabled, and a typo'd knob fails at load
+    r2 = Runner.from_config(
+        {"Global": {"processes": [], "output_dir": str(tmp_path)}})
+    assert not r2.tuning.enabled
+    with pytest.raises(ValueError, match="unknown tuning keys"):
+        Runner.from_config(
+            {"Global": {"processes": [], "output_dir": str(tmp_path)},
+             "tuning": {"repeat": 2}})

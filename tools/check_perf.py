@@ -140,6 +140,19 @@ recovery of the scenario's KNOWN noise parameters on a blind reference
 file — all physics ratios of one deterministic campaign against its
 own truth, machine-independent; ``--no-transfer`` skips.
 
+The autotune gate (ISSUE 20) also runs by default: one ``bench.py
+--config tune`` child (shape-bucket autotuner A/B on real jitted
+destriper programs) must show (a) the tuned campaign leg's throughput
+at or above the default leg's beyond a noise floor — true BY
+CONSTRUCTION (a winner only replaces the default when it measured
+``min_improvement`` faster), so a violation means the consult plumbing
+applies something the sweep never picked; (b) the warm re-run
+re-measuring NOTHING with one cache hit per shape bucket (the
+``tuning.jsonl`` memoisation promise); and (c) ``invalid_proposed``
+at 0 — the knob space's validity rules must filter every combo before
+the tuner times it. All ratios/counts of one run against itself —
+machine-independent; ``--no-tune`` skips.
+
 Unless ``--no-registry``, the gate appends one ``perf_gate`` summary
 record to ``evidence/runs.jsonl`` (``telemetry/registry.py``) so
 ``tools/campaign_watch.py trend`` can alert on a regression against
@@ -549,6 +562,40 @@ def run_precision_bench() -> dict:
     raise RuntimeError("no precision result line in bench.py output")
 
 
+def run_tune_bench() -> dict:
+    """One small-shape autotuner bench child -> its parsed JSON line."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_SMALL": "1",
+        "BENCH_NO_PROBE": env.get("BENCH_NO_PROBE", "1"),
+        "BENCH_EVIDENCE": "0",
+    })
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                          "--config", "tune"],
+                         env=env, capture_output=True, text=True, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench.py --config tune failed "
+                           f"(rc={out.returncode}):\n{out.stderr[-2000:]}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == "tune_campaign_samples_per_s":
+            return rec
+    raise RuntimeError("no tune result line in bench.py output")
+
+
+#: ISSUE 20 noise floor for the tuned-vs-default campaign A/B: the
+#: tuner only replaces a default when the candidate measured
+#: ``min_improvement`` (5%) faster, so tuned throughput below
+#: (1 - floor) x default means the CONSULT plumbing applied knobs the
+#: sweep never picked. 10% absorbs run-to-run scheduler noise on the
+#: quick shape — the gate is an ordering of one process's two legs,
+#: never a committed-reference throughput.
+TUNE_NOISE_FLOOR = 0.10
+
+
 #: ISSUE 13 H2D ceiling: with ``tod_dtype=bf16`` the counter-measured
 #: bytes must be at or under 0.55x the f32 run's — 0.5 is a pure-TOD
 #: payload; the 0.05 headroom covers the non-TOD arrays (MJD etc.) that
@@ -669,6 +716,9 @@ def main(argv=None) -> int:
                     help="skip the sharded-solver gates (sharded "
                          "multigrid iteration ordering + banded-weight "
                          "white parity)")
+    ap.add_argument("--no-tune", action="store_true",
+                    help="skip the shape-bucket autotuner gate "
+                         "(tuned>=default A/B + warm-cache memoisation)")
     ap.add_argument("--no-registry", action="store_true",
                     help="do not append this gate run to the run "
                          "registry (evidence/runs.jsonl)")
@@ -1154,6 +1204,58 @@ def main(argv=None) -> int:
                 f"the {quality['masked_threshold']:g} threshold — the "
                 "fixture no longer exercises the rule")
 
+    tune = None
+    if not args.no_tune:
+        # every half machine-independent (ISSUE 20): a throughput
+        # ordering of one process's two legs (tuned vs default, where
+        # tuned>=default holds by the tuner's min_improvement rule),
+        # and exact counts of the warm re-run's measurements and cache
+        # hits — never a committed-reference wall clock
+        t = run_tune_bench()
+        det = t["detail"]
+        tune = {
+            "vs_default": t["value"] and t.get("vs_baseline"),
+            "bucket_count": det.get("bucket_count"),
+            "sweep_measurements": (det.get("sweep") or {}).get(
+                "measurements"),
+            "invalid_proposed": (det.get("sweep") or {}).get(
+                "invalid_proposed"),
+            "warm": det.get("warm"),
+            "winners": (det.get("sweep") or {}).get("winners"),
+        }
+        if "sweep" not in det:
+            # a canned fixture (the live bench always emits the sweep
+            # section): record the skip, don't fail
+            tune = {"skipped": "canned bench detail has no sweep"}
+        else:
+            ratio = float(t.get("vs_baseline") or 0.0)
+            if ratio < 1.0 - TUNE_NOISE_FLOOR:
+                failures.append(
+                    f"tune: tuned campaign leg at {ratio:.3f}x the "
+                    f"default leg's throughput (< {1 - TUNE_NOISE_FLOOR:g}"
+                    ") — the consult plumbing applies knobs the sweep "
+                    "never picked as winners")
+            warm = det.get("warm") or {}
+            if int(warm.get("measurements") or 0) != 0:
+                failures.append(
+                    f"tune: warm re-run took "
+                    f"{warm.get('measurements')} new measurement(s) — "
+                    "the tuning.jsonl memoisation broke (key drift "
+                    "between write and read?)")
+            if int(warm.get("buckets_hit") or 0) \
+                    != int(det.get("bucket_count") or -1):
+                failures.append(
+                    f"tune: warm re-run hit {warm.get('buckets_hit')} "
+                    f"cache entr(ies) for {det.get('bucket_count')} "
+                    "bucket(s) — a bucket re-swept or vanished")
+            if int((det.get("sweep") or {}).get("invalid_proposed")
+                   or 0) != 0:
+                failures.append(
+                    f"tune: the sweep proposed "
+                    f"{det['sweep']['invalid_proposed']} invalid "
+                    "combo(s) — the knob space's validity rules must "
+                    "filter every candidate before it is timed")
+
     transfer = None
     if not args.no_transfer:
         # machine-independent (ISSUE 16): closure of the end-to-end
@@ -1197,7 +1299,7 @@ def main(argv=None) -> int:
                       "serving": serving,
                       "kernels": kernels, "tiles": tiles,
                       "precision": precision, "quality": quality,
-                      "transfer": transfer,
+                      "tune": tune, "transfer": transfer,
                       "reference": {k: ref.get(k) for k in
                                     ("value", "dispatch_count",
                                      "git_rev")}}))

@@ -12,7 +12,13 @@ Runs, in order, against the real chip:
    retained reference path, where ``COMAP_BIN_BATCH`` applies),
    reusing the measured baseline so each point only pays TPU wall;
 5. a joint multi-RHS vs per-band destriper timing at production pointing
-   (the round-4 multi-RHS lever).
+   (the round-4 multi-RHS lever);
+6. a shape-bucket autotuner session (``bench.py --config tune``,
+   ISSUE 20): the cold sweep + tuned-vs-default campaign A/B + warm
+   cache verification ON THE CHIP — the on-TPU winners (pair_batch,
+   mg_block x mg_smooth, and the pallas-vs-xla kernel axis that only
+   exists on TPU) land in the session log for the committed-evidence
+   discussion.
 
 Appends one JSON line per measurement to ``SWEEP_r05.jsonl`` (repo root)
 so a wedge mid-session loses nothing. Never signals a child process (a
@@ -196,6 +202,20 @@ print(json.dumps({"joint_4band_s": round(tj, 3),
     else:
         log_line({"kind": "multi-rhs-failed", "rc": proc.returncode,
                   "err": proc.stderr.strip()[-400:]})
+
+    # autotuner session (ISSUE 20): the sweep measures REAL on-chip
+    # programs, so its winners (including the TPU-only pallas kernel
+    # axis) are the production numbers; the bench asserts the warm
+    # cache promise itself and its JSON line carries the amortization
+    # curve — log_line preserves all of it
+    tune = run_bench({"BENCH_EVIDENCE": "0"}, "tune",
+                     argv=("--config", "tune"))
+    if tune is not None:
+        det = tune.get("detail") or {}
+        log_line({"kind": "tune-winners",
+                  "winners": (det.get("sweep") or {}).get("winners"),
+                  "warm": det.get("warm"),
+                  "amortization": det.get("amortization")})
     return 0
 
 
